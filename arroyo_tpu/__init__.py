@@ -1,0 +1,27 @@
+"""arroyo_tpu — a TPU-native distributed stream-processing framework.
+
+Capabilities modeled on ArroyoSystems/arroyo (Rust, reference at
+/root/reference): SQL-defined streaming pipelines compiled to a dataflow DAG
+of Arrow-native operators with event-time watermarks, windowed/updating
+aggregations and joins, exactly-once checkpointing, and a connector library.
+The execution layer is TPU-first: window aggregates, joins and UDAFs run as
+jax.jit/XLA kernels over Arrow batches, keyed state lives in device memory as
+mesh-shardable arrays, and keyed shuffles map onto ICI collectives.
+
+Layer map (mirrors SURVEY.md §1):
+  api/         REST control surface (reference: crates/arroyo-api)
+  controller/  job state machine + schedulers (crates/arroyo-controller)
+  sql/         SQL → logical dataflow graph (crates/arroyo-planner)
+  graph/       DAG types + chaining optimizer (crates/arroyo-datastream)
+  operators/   operator framework (crates/arroyo-operator)
+  engine/      physical execution engine (crates/arroyo-worker)
+  connectors/  sources and sinks (crates/arroyo-connectors)
+  formats/     serialization (crates/arroyo-formats)
+  state/       checkpointed state (crates/arroyo-state{,-protocol}, -storage)
+  ops/         TPU compute kernels (jax/XLA/pallas) — the hot data path
+  parallel/    device mesh, sharding, collective shuffle
+  udf/         user-defined scalar/aggregate/async functions
+  utils/       logging, shutdown, misc substrate
+"""
+
+__version__ = "0.1.0"

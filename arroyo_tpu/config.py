@@ -1,0 +1,237 @@
+"""Layered configuration tree.
+
+Capability parity with the reference's config system
+(/root/reference/crates/arroyo-rpc/src/config.rs:195-278): a single typed
+tree with layered sources — built-in defaults → config file(s)
+(`arroyo.yaml` / path given via ARROYO_CONFIG) → `ARROYO__SECTION__KEY`
+environment overrides — plus a hot-accessible global `config()` and a
+test-only `update()` context manager. Durations accept humanized strings
+("10ms", "5s", "1m"); sizes accept "64KB"/"1MB".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import copy
+import dataclasses
+import os
+import re
+from pathlib import Path
+from typing import Any, Optional
+
+_DUR_RE = re.compile(r"^\s*([\d.]+)\s*(ns|us|ms|s|m|h|d)?\s*$")
+_SIZE_RE = re.compile(r"^\s*([\d.]+)\s*(b|kb|mb|gb|tb|kib|mib|gib)?\s*$", re.I)
+
+_DUR_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0, None: 1.0,
+    "m": 60.0, "h": 3600.0, "d": 86400.0,
+}
+_SIZE_UNITS = {
+    None: 1, "b": 1, "kb": 10**3, "mb": 10**6, "gb": 10**9, "tb": 10**12,
+    "kib": 2**10, "mib": 2**20, "gib": 2**30,
+}
+
+
+def parse_duration(v) -> float:
+    """Humanized duration → seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = _DUR_RE.match(str(v))
+    if not m:
+        raise ValueError(f"invalid duration: {v!r}")
+    return float(m.group(1)) * _DUR_UNITS[m.group(2)]
+
+
+def parse_size(v) -> int:
+    if isinstance(v, int):
+        return v
+    m = _SIZE_RE.match(str(v))
+    if not m:
+        raise ValueError(f"invalid size: {v!r}")
+    return int(float(m.group(1)) * _SIZE_UNITS[(m.group(2) or "").lower() or None])
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    interval: float = 10.0  # seconds between checkpoints
+    storage_url: str = "/tmp/arroyo-tpu/checkpoints"
+    compaction_enabled: bool = True
+    # compact an operator once it has this many epochs of small files
+    compaction_epoch_threshold: int = 4
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    source_batch_size: int = 512
+    source_batch_linger: float = 0.1  # seconds
+    queue_size: int = 64  # batches per edge queue
+    queue_bytes: int = 32 * 2**20  # byte bound per edge queue
+    chaining_enabled: bool = True
+    update_aggregate_flush_interval: float = 1.0
+    allowed_lateness: float = 0.0
+    checkpointing: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+
+@dataclasses.dataclass
+class TpuConfig:
+    enabled: bool = True  # use device kernels when a TPU/accelerator exists
+    # pad batch key-cardinality to these bucket sizes to bound recompilation
+    shape_buckets: tuple = (256, 1024, 4096, 16384, 65536)
+    max_keys_per_shard: int = 1 << 20  # device state capacity per subtask
+    donate_state: bool = True
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    rpc_port: int = 9190
+    scheduler: str = "embedded"  # embedded | process | node | kubernetes
+    heartbeat_timeout: float = 30.0
+    update_interval: float = 0.5
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    rpc_port: int = 0  # 0 = ephemeral
+    data_port: int = 0
+    task_slots: int = 4
+    bind_address: str = "127.0.0.1"
+
+
+@dataclasses.dataclass
+class ApiConfig:
+    http_port: int = 8000
+    bind_address: str = "127.0.0.1"
+    run_http_port: int = 0
+
+
+@dataclasses.dataclass
+class AdminConfig:
+    http_port: int = 0
+    bind_address: str = "127.0.0.1"
+
+
+@dataclasses.dataclass
+class DatabaseConfig:
+    backend: str = "sqlite"  # sqlite | postgres(stub)
+    path: str = "/tmp/arroyo-tpu/arroyo.db"
+
+
+@dataclasses.dataclass
+class LoggingConfig:
+    format: str = "console"  # console | json | logfmt
+    level: str = "INFO"
+    file: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Config:
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
+    controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
+    worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)
+    api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
+    admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)
+    database: DatabaseConfig = dataclasses.field(default_factory=DatabaseConfig)
+    logging: LoggingConfig = dataclasses.field(default_factory=LoggingConfig)
+
+
+def _coerce(current: Any, raw: Any) -> Any:
+    if isinstance(current, bool):
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(current, float):
+        return parse_duration(raw)
+    if isinstance(current, int) and not isinstance(current, bool):
+        if isinstance(raw, str):
+            raw = raw.strip()
+            m = _SIZE_RE.match(raw)
+            if m and m.group(2):  # explicit unit ("64KB") → size parse
+                return parse_size(raw)
+            return int(raw)  # raises on "2.5" rather than truncating
+        return int(raw)
+    if isinstance(current, tuple):
+        if isinstance(raw, str):
+            raw = [int(x) for x in raw.split(",") if x.strip()]
+        return tuple(raw)
+    return raw
+
+
+def _apply_dict(cfg: Any, values: dict) -> None:
+    for key, val in values.items():
+        key = key.replace("-", "_")
+        if not hasattr(cfg, key):
+            raise ValueError(f"unknown config key: {key} on {type(cfg).__name__}")
+        cur = getattr(cfg, key)
+        if dataclasses.is_dataclass(cur) and isinstance(val, dict):
+            _apply_dict(cur, val)
+        else:
+            setattr(cfg, key, _coerce(cur, val))
+
+
+def _apply_env(cfg: Config, environ) -> None:
+    for name, raw in environ.items():
+        if not name.startswith("ARROYO__"):
+            continue
+        path = [p.lower() for p in name[len("ARROYO__"):].split("__") if p]
+        node: Any = cfg
+        for part in path[:-1]:
+            if not hasattr(node, part):
+                raise ValueError(f"unknown config section {part} in ${name}")
+            node = getattr(node, part)
+        leaf = path[-1]
+        if not hasattr(node, leaf):
+            raise ValueError(f"unknown config key {leaf} in ${name}")
+        setattr(node, leaf, _coerce(getattr(node, leaf), raw))
+
+
+def load_config(path: Optional[str] = None, environ=None) -> Config:
+    import yaml
+
+    cfg = Config()
+    environ = os.environ if environ is None else environ
+    explicit = path or environ.get("ARROYO_CONFIG")
+    if explicit:
+        p = Path(explicit)
+        if not p.exists():
+            raise FileNotFoundError(f"config file not found: {explicit}")
+        candidates = [explicit]
+    else:
+        candidates = ["arroyo.yaml", str(Path.home() / ".config/arroyo/arroyo.yaml")]
+    for cand in candidates:
+        p = Path(cand)
+        if p.exists():
+            data = yaml.safe_load(p.read_text()) or {}
+            _apply_dict(cfg, data)
+            break
+    _apply_env(cfg, environ)
+    return cfg
+
+
+_CONFIG: Optional[Config] = None
+
+
+def config() -> Config:
+    global _CONFIG
+    if _CONFIG is None:
+        _CONFIG = load_config()
+    return _CONFIG
+
+
+def initialize_config(path: Optional[str] = None) -> Config:
+    global _CONFIG
+    _CONFIG = load_config(path)
+    return _CONFIG
+
+
+@contextlib.contextmanager
+def update(**sections):
+    """Test-only scoped override: update(pipeline={'source_batch_size': 32})."""
+    global _CONFIG
+    old = _CONFIG
+    _CONFIG = copy.deepcopy(config())
+    try:
+        _apply_dict(_CONFIG, sections)
+        yield _CONFIG
+    finally:
+        _CONFIG = old

@@ -1,0 +1,140 @@
+"""Metrics registry with Prometheus text exposition.
+
+Capability parity with the reference's `arroyo-metrics` crate +
+TaskCounters (/root/reference/crates/arroyo-operator/src/context.rs):
+per-task messages/batches/bytes rx-tx counters, per-queue occupancy gauges,
+and UI-facing 5-minute rate windows (computed in engine.job_metrics).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Tuple
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.values: Dict[LabelSet, float] = defaultdict(float)
+        self.lock = threading.Lock()
+
+    def labels(self, **labels: str) -> "_Handle":
+        key = tuple(sorted(labels.items()))
+        return _Handle(self, key)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self.lock:
+            for key, val in self.values.items():
+                if key:
+                    label_s = ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in key)
+                    lines.append(f"{self.name}{{{label_s}}} {val}")
+                else:
+                    lines.append(f"{self.name} {val}")
+        return "\n".join(lines)
+
+
+class _Handle:
+    __slots__ = ("metric", "key")
+
+    def __init__(self, metric: _Metric, key: LabelSet):
+        self.metric = metric
+        self.key = key
+
+    def inc(self, amount: float = 1.0):
+        with self.metric.lock:
+            self.metric.values[self.key] += amount
+
+    def set(self, value: float):
+        with self.metric.lock:
+            self.metric.values[self.key] = value
+
+    def get(self) -> float:
+        with self.metric.lock:
+            return self.metric.values[self.key]
+
+
+class Registry:
+    def __init__(self):
+        self.metrics: Dict[str, _Metric] = {}
+        self.lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> _Metric:
+        return self._get(name, help_, "counter")
+
+    def gauge(self, name: str, help_: str = "") -> _Metric:
+        return self._get(name, help_, "gauge")
+
+    def _get(self, name: str, help_: str, kind: str) -> _Metric:
+        with self.lock:
+            if name not in self.metrics:
+                self.metrics[name] = _Metric(name, help_, kind)
+            return self.metrics[name]
+
+    def expose(self) -> str:
+        with self.lock:
+            metrics = list(self.metrics.values())
+        return "\n".join(m.expose() for m in metrics) + "\n"
+
+    def reset(self):
+        with self.lock:
+            self.metrics.clear()
+
+
+REGISTRY = Registry()
+
+# Task-level counters, one label-set per subtask (reference TaskCounters).
+MESSAGES_RECV = REGISTRY.counter(
+    "arroyo_worker_messages_recv", "messages received by a subtask")
+MESSAGES_SENT = REGISTRY.counter(
+    "arroyo_worker_messages_sent", "messages sent by a subtask")
+BATCHES_RECV = REGISTRY.counter(
+    "arroyo_worker_batches_recv", "batches received by a subtask")
+BATCHES_SENT = REGISTRY.counter(
+    "arroyo_worker_batches_sent", "batches sent by a subtask")
+BYTES_RECV = REGISTRY.counter(
+    "arroyo_worker_bytes_recv", "bytes received by a subtask")
+BYTES_SENT = REGISTRY.counter(
+    "arroyo_worker_bytes_sent", "bytes sent by a subtask")
+ERRORS = REGISTRY.counter(
+    "arroyo_worker_errors", "deserialization/user errors in a subtask")
+QUEUE_SIZE = REGISTRY.gauge(
+    "arroyo_worker_queue_size", "occupancy of an edge queue (batches)")
+QUEUE_BYTES = REGISTRY.gauge(
+    "arroyo_worker_queue_bytes", "occupancy of an edge queue (bytes)")
+TPU_KERNEL_MILLIS = REGISTRY.counter(
+    "arroyo_tpu_kernel_millis", "wall millis spent inside device kernels")
+
+
+class RateWindow:
+    """Fixed 5-minute circular buffer of (t, value) samples for UI rates
+    (reference: job_metrics.rs:188-265)."""
+
+    WINDOW = 300.0
+
+    def __init__(self):
+        self.samples: list[tuple[float, float]] = []
+
+    def add(self, value: float, now: float | None = None):
+        now = time.monotonic() if now is None else now
+        self.samples.append((now, value))
+        cutoff = now - self.WINDOW
+        while self.samples and self.samples[0][0] < cutoff:
+            self.samples.pop(0)
+
+    def rate(self) -> float:
+        if len(self.samples) < 2:
+            return 0.0
+        (t0, v0), (t1, v1) = self.samples[0], self.samples[-1]
+        return (v1 - v0) / (t1 - t0) if t1 > t0 else 0.0

@@ -1,0 +1,2 @@
+from .logging import init_logging, get_logger  # noqa: F401
+from .shutdown import Shutdown, ShutdownGuard  # noqa: F401

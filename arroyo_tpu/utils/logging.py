@@ -1,0 +1,59 @@
+"""Structured logging with console/json/logfmt formats.
+
+Capability parity with the reference's init_logging
+(/root/reference/crates/arroyo-server-common/src/lib.rs:57-190).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Optional
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+class _LogfmtFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = (record.getMessage()
+               .replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n"))
+        return (
+            f'ts={time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))} '
+            f'level={record.levelname.lower()} target={record.name} msg="{msg}"'
+        )
+
+
+def init_logging(
+    fmt: str = "console", level: str = "INFO", file: Optional[str] = None
+) -> None:
+    root = logging.getLogger("arroyo")
+    root.setLevel(level.upper())
+    root.handlers.clear()
+    handler = logging.FileHandler(file) if file else logging.StreamHandler(sys.stderr)
+    if fmt == "json":
+        handler.setFormatter(_JsonFormatter())
+    elif fmt == "logfmt":
+        handler.setFormatter(_LogfmtFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)-5s %(name)s: %(message)s")
+        )
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"arroyo.{name}")

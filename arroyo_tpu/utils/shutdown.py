@@ -1,0 +1,103 @@
+"""Cooperative shutdown token tree.
+
+Capability parity with the reference's Shutdown/ShutdownGuard
+(/root/reference/crates/arroyo-server-common/src/shutdown.rs:17-133):
+a root token with child guards; cancelling the root signals every guard,
+then waits (with a deadline) for all guards to drop before returning.
+asyncio-native: guards wrap tasks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+from typing import Optional
+
+
+class ShutdownGuard:
+    def __init__(self, shutdown: "Shutdown", name: str):
+        self._shutdown = shutdown
+        self.name = name
+        self._done = asyncio.Event()
+
+    def child(self, name: str) -> "ShutdownGuard":
+        return self._shutdown.guard(name)
+
+    @property
+    def cancelled(self) -> asyncio.Event:
+        return self._shutdown._cancelled
+
+    def is_cancelled(self) -> bool:
+        return self._shutdown._cancelled.is_set()
+
+    async def wait_cancelled(self):
+        await self._shutdown._cancelled.wait()
+
+    def done(self):
+        if not self._done.is_set():
+            self._done.set()
+            self._shutdown._guards.discard(self)
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Run a coroutine; the guard completes when it returns."""
+
+        async def runner():
+            try:
+                await coro
+            finally:
+                self.done()
+
+        task = asyncio.ensure_future(runner())
+        self._shutdown._tasks.append(task)
+        return task
+
+
+class Shutdown:
+    def __init__(self, name: str = "cluster"):
+        self.name = name
+        self._cancelled = asyncio.Event()
+        self._guards: set[ShutdownGuard] = set()
+        self._tasks: list[asyncio.Task] = []
+
+    def guard(self, name: str) -> ShutdownGuard:
+        g = ShutdownGuard(self, name)
+        self._guards.add(g)
+        return g
+
+    def cancel(self):
+        self._cancelled.set()
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def handle_signals(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        loop = loop or asyncio.get_event_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self.cancel)
+            except (NotImplementedError, RuntimeError):
+                pass
+
+    async def wait(self, deadline: float = 30.0) -> bool:
+        """Wait for cancellation, then drain guards. Returns True on clean
+        drain, False if the deadline expired (guards abandoned)."""
+        await self._cancelled.wait()
+        try:
+            await asyncio.wait_for(self._drain(), timeout=deadline)
+            return True
+        except asyncio.TimeoutError:
+            for t in self._tasks:
+                t.cancel()
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+            return False
+
+    async def _drain(self):
+        while self._guards:
+            guard = next(iter(self._guards))
+            await guard._done.wait()
+        for t in self._tasks:
+            if not t.done():
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass

@@ -1,0 +1,19 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+sharding/collective paths are exercised without TPU hardware, per the build
+environment contract. Must run before jax is imported anywhere."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_storage(tmp_path):
+    return str(tmp_path / "storage")

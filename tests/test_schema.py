@@ -1,0 +1,57 @@
+import numpy as np
+import pyarrow as pa
+
+from arroyo_tpu.schema import TIMESTAMP_FIELD, StreamSchema
+
+
+def make_batch(schema: StreamSchema, n: int, keys=None):
+    rng = np.random.default_rng(7)
+    arrays = []
+    for f in schema.schema:
+        if f.name == TIMESTAMP_FIELD:
+            arrays.append(pa.array(np.arange(n, dtype="int64"), type=pa.int64()).cast(f.type))
+        elif f.name == "k":
+            vals = keys if keys is not None else rng.integers(0, 10, n)
+            arrays.append(pa.array(np.asarray(vals, dtype="int64")))
+        else:
+            arrays.append(pa.array(rng.random(n)))
+    return pa.RecordBatch.from_arrays(arrays, schema=schema.schema)
+
+
+def test_timestamp_injected():
+    s = StreamSchema.from_fields([("k", pa.int64()), ("v", pa.float64())])
+    assert TIMESTAMP_FIELD in s.names
+    assert s.timestamp_index == 2
+
+
+def test_partition_is_complete_and_consistent():
+    s = StreamSchema.from_fields([("k", pa.int64()), ("v", pa.float64())], key_names=["k"])
+    batch = make_batch(s, 500)
+    parts = s.partition(batch, 4)
+    total = sum(p.num_rows for p in parts if p is not None)
+    assert total == 500
+    # same key always lands in the same partition
+    key_to_part = {}
+    for i, p in enumerate(parts):
+        if p is None:
+            continue
+        for k in p.column(0).to_pylist():
+            assert key_to_part.setdefault(k, i) == i
+
+
+def test_partition_unkeyed_single():
+    s = StreamSchema.from_fields([("v", pa.float64())])
+    batch = make_batch(s, 10)
+    assert s.partition(batch, 1) == [batch]
+
+
+def test_hash_keys_null_handling():
+    s = StreamSchema.from_fields([("k", pa.int64())], key_names=["k"])
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([1, None, 1], type=pa.int64()),
+         pa.array([0, 0, 0], type=pa.int64()).cast(pa.timestamp("ns"))],
+        schema=s.schema,
+    )
+    h = s.hash_keys(batch)
+    assert h[0] == h[2]
+    assert h[1] != h[0]

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from arroyo_tpu.types import (
+    Watermark,
+    hash_arrays,
+    hash_column,
+    range_for_server,
+    server_for_hash,
+    server_for_hash_array,
+)
+
+
+def test_ranges_cover_u64_space_exactly():
+    for n in (1, 2, 3, 7, 8, 128):
+        prev_end = 0
+        for i in range(n):
+            lo, hi = range_for_server(i, n)
+            assert lo == prev_end
+            assert hi > lo
+            prev_end = hi
+        assert prev_end == 1 << 64
+
+
+def test_server_for_hash_matches_ranges():
+    rng = np.random.default_rng(0)
+    hashes = rng.integers(0, 1 << 64, size=1000, dtype=np.uint64)
+    for n in (1, 2, 5, 16):
+        vec = server_for_hash_array(hashes, n)
+        for h, p in zip(hashes[:50], vec[:50]):
+            assert server_for_hash(int(h), n) == p
+            lo, hi = range_for_server(int(p), n)
+            assert lo <= int(h) < hi
+        assert vec.min() >= 0 and vec.max() < n
+
+
+def test_hash_deterministic_across_dtypes():
+    a = hash_column(np.array([1, 2, 3], dtype=np.int64))
+    b = hash_column(np.array([1, 2, 3], dtype=np.int32))
+    np.testing.assert_array_equal(a, b)
+    s1 = hash_column(np.array(["x", "y", "x"], dtype=object))
+    assert s1[0] == s1[2] and s1[0] != s1[1]
+
+
+def test_hash_combine_order_sensitive():
+    c1 = hash_column(np.array([1, 2]))
+    c2 = hash_column(np.array([5, 6]))
+    combined = hash_arrays([c1, c2])
+    swapped = hash_arrays([c2, c1])
+    assert combined.dtype == np.uint64
+    assert not np.array_equal(combined, swapped)
+
+
+def test_float_negative_zero_normalized():
+    h = hash_column(np.array([0.0, -0.0]))
+    assert h[0] == h[1]
+
+
+def test_watermark_kinds():
+    w = Watermark.event_time(100)
+    assert not w.is_idle() and w.timestamp == 100
+    assert Watermark.idle().is_idle()

@@ -1,0 +1,62 @@
+"""Connector registry + the CONNECTOR_SOURCE / CONNECTOR_SINK factories.
+
+Importing this package registers all built-in connectors (reference:
+crates/arroyo-connectors/src/lib.rs:39-65 connectors()).
+"""
+
+from __future__ import annotations
+
+from ..engine.construct import register_operator
+from ..graph.logical import OperatorName
+from .base import (  # noqa: F401
+    ConnectionSchema,
+    Connector,
+    connectors,
+    get_connector,
+    register_connector,
+)
+
+# import order = registry order; each module self-registers
+from . import impulse  # noqa: F401,E402
+from . import debug  # noqa: F401,E402
+from . import single_file  # noqa: F401,E402
+from . import nexmark  # noqa: F401,E402
+from . import filesystem  # noqa: F401,E402
+from . import sse  # noqa: F401,E402
+from . import websocket  # noqa: F401,E402
+from . import polling_http  # noqa: F401,E402
+from . import webhook  # noqa: F401,E402
+from . import kafka  # noqa: F401,E402
+from . import redis  # noqa: F401,E402
+from . import mqtt  # noqa: F401,E402
+from . import nats  # noqa: F401,E402
+from . import rabbitmq  # noqa: F401,E402
+from . import kinesis  # noqa: F401,E402
+from . import fluvio  # noqa: F401,E402
+
+
+def _conn_schema(config: dict) -> ConnectionSchema:
+    cs = config.get("connection_schema")
+    if isinstance(cs, ConnectionSchema):
+        return cs
+    return ConnectionSchema(
+        stream_schema=config.get("schema"),
+        format=config.get("format"),
+        bad_data=config.get("bad_data", "fail"),
+        framing=config.get("framing"),
+    )
+
+
+@register_operator(OperatorName.CONNECTOR_SOURCE)
+def _make_source(config: dict):
+    conn = get_connector(config["connector"])
+    op = conn.make_source(config, _conn_schema(config))
+    if getattr(op, "out_schema", None) is None and config.get("schema"):
+        op.out_schema = config["schema"]
+    return op
+
+
+@register_operator(OperatorName.CONNECTOR_SINK)
+def _make_sink(config: dict):
+    conn = get_connector(config["connector"])
+    return conn.make_sink(config, _conn_schema(config))

@@ -1,0 +1,88 @@
+"""Connector framework: registry + traits.
+
+Capability parity with the reference's Connector/ErasedConnector traits and
+registry (/root/reference/crates/arroyo-operator/src/connector.rs:68-175,
+/root/reference/crates/arroyo-connectors/src/lib.rs:39-65): each connector
+declares metadata (name, type support, config schema for the UI), validates
+WITH-options from SQL, and constructs source/sink operators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..operators.base import Operator
+from ..schema import StreamSchema
+
+
+@dataclasses.dataclass
+class ConnectionSchema:
+    """Schema + format info resolved from a CREATE TABLE statement."""
+
+    stream_schema: StreamSchema
+    format: Optional[str] = None  # json | raw_string | raw_bytes | avro | proto
+    bad_data: str = "fail"  # fail | drop
+    framing: Optional[str] = None
+    event_time_field: Optional[str] = None
+    watermark_field: Optional[str] = None
+
+
+class Connector:
+    """Subclass per external system. `name` keys the SQL `connector` option."""
+
+    name: str = ""
+    description: str = ""
+    source: bool = False
+    sink: bool = False
+    # JSON-schema-ish description of accepted options, surfaced by the API
+    config_schema: Dict[str, Any] = {}
+
+    def validate_options(
+        self, options: Dict[str, str], schema: Optional[ConnectionSchema]
+    ) -> Dict[str, Any]:
+        """Parse/validate WITH options into an operator config dict.
+        Raises ValueError on bad config."""
+        return dict(options)
+
+    def make_source(self, config: Dict[str, Any], schema: ConnectionSchema) -> Operator:
+        raise NotImplementedError(f"{self.name} is not a source")
+
+    def make_sink(self, config: Dict[str, Any], schema: ConnectionSchema) -> Operator:
+        raise NotImplementedError(f"{self.name} is not a sink")
+
+    def test(self, config: Dict[str, Any]) -> tuple[bool, str]:
+        """Connection test for the API's /connection_tables/test."""
+        return True, "ok"
+
+    def metadata(self) -> Dict[str, Any]:
+        return {
+            "id": self.name,
+            "name": self.name,
+            "description": self.description,
+            "source": self.source,
+            "sink": self.sink,
+            "config_schema": self.config_schema,
+        }
+
+
+_CONNECTORS: Dict[str, Connector] = {}
+
+
+def register_connector(cls):
+    inst = cls()
+    assert inst.name, f"{cls} missing name"
+    _CONNECTORS[inst.name] = inst
+    return cls
+
+
+def get_connector(name: str) -> Connector:
+    if name not in _CONNECTORS:
+        raise ValueError(
+            f"unknown connector {name!r}; available: {sorted(_CONNECTORS)}"
+        )
+    return _CONNECTORS[name]
+
+
+def connectors() -> List[Connector]:
+    return [v for _, v in sorted(_CONNECTORS.items())]

@@ -1,0 +1,101 @@
+"""Debug connectors: vec (in-memory capture), stdout, blackhole, preview.
+
+Capability parity with the reference's stdout/blackhole/preview sinks
+(/root/reference/crates/arroyo-connectors/src/{stdout,blackhole,preview}).
+`vec` is the in-process capture sink the test harness uses (the reference
+uses its single_file connector for that; we offer both).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+import pyarrow as pa
+
+from ..operators.base import Operator
+from .base import ConnectionSchema, Connector, register_connector
+
+
+class VecSink(Operator):
+    """Collects all rows into an in-memory list (shared via config)."""
+
+    def __init__(self, results: list, batches: Optional[list] = None):
+        super().__init__("vec_sink")
+        self.results = results
+        self.batches = batches
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        if self.batches is not None:
+            self.batches.append(batch)
+        self.results.extend(batch.to_pylist())
+
+
+@register_connector
+class VecConnector(Connector):
+    name = "vec"
+    description = "in-memory capture sink for tests"
+    sink = True
+
+    def make_sink(self, config, schema):
+        return VecSink(config["results"], config.get("batches"))
+
+
+class StdoutSink(Operator):
+    def __init__(self, serializer=None):
+        super().__init__("stdout_sink")
+        self.serializer = serializer
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        if self.serializer is not None:
+            for line in self.serializer.serialize(batch):
+                sys.stdout.write(line.decode() + "\n")
+        else:
+            for row in batch.to_pylist():
+                sys.stdout.write(json.dumps(row, default=str) + "\n")
+        sys.stdout.flush()
+
+
+@register_connector
+class StdoutConnector(Connector):
+    name = "stdout"
+    description = "writes each row as JSON to stdout"
+    sink = True
+
+    def make_sink(self, config, schema):
+        from ..formats.ser import make_serializer
+
+        ser = make_serializer(schema) if schema and schema.format else None
+        return StdoutSink(ser)
+
+
+class BlackholeSink(Operator):
+    def __init__(self):
+        super().__init__("blackhole_sink")
+        self.rows = 0
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        self.rows += batch.num_rows
+
+
+@register_connector
+class BlackholeConnector(Connector):
+    name = "blackhole"
+    description = "null sink for benchmarking"
+    sink = True
+
+    def make_sink(self, config, schema):
+        return BlackholeSink()
+
+
+@register_connector
+class PreviewConnector(Connector):
+    name = "preview"
+    description = "streams rows to the controller for UI preview"
+    sink = True
+
+    def make_sink(self, config, schema):
+        # rows land in the shared session list that the API tails over its
+        # websocket (in-process path); cross-process preview goes over gRPC
+        return VecSink(config.setdefault("results", []))

@@ -1,0 +1,1 @@
+"""Placeholder: filesystem connector lands with the connector milestone."""

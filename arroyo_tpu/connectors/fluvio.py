@@ -1,0 +1,1 @@
+"""Placeholder: fluvio connector lands with the connector milestone."""

@@ -1,0 +1,120 @@
+"""Impulse connector — synthetic counter source for tests and benchmarks.
+
+Capability parity with the reference's impulse connector
+(/root/reference/crates/arroyo-connectors/src/impulse/mod.rs:182): emits
+rows {counter, subtask_index} at `event_rate` events/sec/subtask, optionally
+bounded by `message_count`; counter offset persists in state so restores
+resume exactly. Deterministic event-time mode (`start_time` + i/rate) for
+reproducible tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import pyarrow as pa
+
+from ..operators.base import SourceFinishType, SourceOperator
+from ..schema import StreamSchema
+from ..types import now_nanos
+from .base import ConnectionSchema, Connector, register_connector
+
+IMPULSE_SCHEMA = StreamSchema.from_fields(
+    [("counter", pa.uint64()), ("subtask_index", pa.uint64())]
+)
+
+
+class ImpulseSource(SourceOperator):
+    def __init__(
+        self,
+        event_rate: float = 10_000.0,
+        message_count: Optional[int] = None,
+        start_time: Optional[int] = None,
+        realtime: bool = False,
+    ):
+        super().__init__("impulse")
+        self.event_rate = event_rate
+        self.message_count = message_count
+        self.start_time = start_time
+        self.realtime = realtime
+        self.out_schema = IMPULSE_SCHEMA
+        self.counter = 0
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"i": global_table("i")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("i")
+            stored = table.get(ctx.task_info.task_index)
+            if stored is not None:
+                self.counter = stored
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("i")
+            table.put(ctx.task_info.task_index, self.counter)
+
+    async def run(self, ctx, collector) -> SourceFinishType:
+        subtask = ctx.task_info.task_index
+        start = self.start_time if self.start_time is not None else now_nanos()
+        period = 1.0 / self.event_rate if self.event_rate > 0 else 0.0
+        wall_start = time.monotonic()
+        while self.message_count is None or self.counter < self.message_count:
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                return finish
+            if self.realtime:
+                target = wall_start + self.counter * period
+                delay = target - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                ts = now_nanos()
+            else:
+                ts = start + int(round(self.counter * (1e9 / self.event_rate)))
+            ctx.buffer_row(
+                {"counter": self.counter, "subtask_index": subtask,
+                 "_timestamp": ts}
+            )
+            self.counter += 1
+            if ctx.should_flush():
+                await self.flush_buffer(ctx, collector)
+                # yield so queues/control stay live even in non-realtime mode
+                await asyncio.sleep(0)
+        await self.flush_buffer(ctx, collector)
+        return SourceFinishType.FINAL
+
+
+@register_connector
+class ImpulseConnector(Connector):
+    name = "impulse"
+    description = "synthetic counter source at a fixed event rate"
+    source = True
+    config_schema = {
+        "event_rate": {"type": "number", "required": True},
+        "message_count": {"type": "integer"},
+        "realtime": {"type": "boolean"},
+    }
+
+    def validate_options(self, options, schema):
+        out = {
+            "event_rate": float(options.get("event_rate", 10_000)),
+            "realtime": str(options.get("realtime", "false")).lower() == "true",
+        }
+        if "message_count" in options:
+            out["message_count"] = int(options["message_count"])
+        if "start_time" in options:
+            out["start_time"] = int(options["start_time"])
+        return out
+
+    def make_source(self, config, schema: ConnectionSchema) -> ImpulseSource:
+        return ImpulseSource(
+            event_rate=config.get("event_rate", 10_000.0),
+            message_count=config.get("message_count"),
+            start_time=config.get("start_time"),
+            realtime=config.get("realtime", False),
+        )

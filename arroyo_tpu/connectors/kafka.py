@@ -1,0 +1,1 @@
+"""Placeholder: kafka connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: kinesis connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: mqtt connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: nats connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: nexmark connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: polling_http connector lands with the connector milestone."""

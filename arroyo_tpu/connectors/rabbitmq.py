@@ -1,0 +1,1 @@
+"""Placeholder: rabbitmq connector lands with the connector milestone."""

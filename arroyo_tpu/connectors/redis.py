@@ -1,0 +1,1 @@
+"""Placeholder: redis connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: single_file connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: sse connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: webhook connector lands with the connector milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: websocket connector lands with the connector milestone."""

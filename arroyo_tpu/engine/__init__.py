@@ -1,0 +1,3 @@
+from .construct import construct_chain, register_operator  # noqa: F401
+from .engine import Engine, RunningEngine  # noqa: F401
+from .program import Program  # noqa: F401

@@ -1,0 +1,54 @@
+"""Operator construction registry.
+
+Capability parity with the reference's construct_operator dispatch
+(/root/reference/crates/arroyo-worker/src/engine.rs:805-900): maps each
+OperatorName to a factory that decodes the node's config into a runnable
+Operator. This is the single seam where execution backends are chosen — the
+window/join factories consult config.tpu to pick device (JAX) or host
+(numpy) kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..graph.logical import ChainedOp, LogicalNode, OperatorName
+from ..operators.base import Operator
+
+_REGISTRY: Dict[OperatorName, Callable[[dict], Operator]] = {}
+
+
+def register_operator(name: OperatorName):
+    def deco(factory: Callable[[dict], Operator]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def construct_operator(op: ChainedOp) -> Operator:
+    _ensure_registered()
+    if op.operator not in _REGISTRY:
+        raise ValueError(f"no operator factory registered for {op.operator}")
+    operator = _REGISTRY[op.operator](op.config)
+    if op.description:
+        operator.name = op.description
+    return operator
+
+
+def construct_chain(node: LogicalNode) -> List[Operator]:
+    return [construct_operator(op) for op in node.chain]
+
+
+_LOADED = False
+
+
+def _ensure_registered():
+    """Import the modules whose import side-effect registers factories."""
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    from ..operators import projection, watermark_generator, windows  # noqa: F401
+    from ..operators import joins, updating, window_fn, async_udf  # noqa: F401
+    from .. import connectors  # noqa: F401

@@ -1,0 +1,185 @@
+"""In-process engine: spawn subtasks, drive checkpoints, await completion.
+
+Capability parity with the reference's Engine::start / RunningEngine
+(/root/reference/crates/arroyo-worker/src/engine.rs:385-565): barrier-
+synchronized start, per-subtask control handles, checkpoint initiation on
+sources only (barriers flow in-band), failure propagation. The full
+multi-process job controller lives in arroyo_tpu.controller; this engine is
+the worker-local core it drives (and what `run()` uses for local mode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from ..types import CheckpointBarrier, StopMode, now_nanos
+from ..utils.logging import get_logger
+from ..operators.control import (
+    CheckpointCompletedResp,
+    CheckpointEventResp,
+    CheckpointMsg,
+    CommitMsg,
+    StopMsg,
+    TaskFailedResp,
+    TaskFinishedResp,
+)
+from .program import Program, Subtask
+
+logger = get_logger("engine")
+
+
+class JobFailed(Exception):
+    pass
+
+
+class RunningEngine:
+    def __init__(self, program: Program):
+        self.program = program
+        self.backend = program._state_backend
+        self.tasks: List[asyncio.Task] = []
+        self.finished: set = set()
+        self.failed: Optional[TaskFailedResp] = None
+        self.checkpoint_events: List[CheckpointEventResp] = []
+        # epoch -> task_id -> CheckpointCompletedResp
+        self.checkpoints: Dict[int, Dict[str, CheckpointCompletedResp]] = {}
+        self._epoch = 0
+
+    @property
+    def n_subtasks(self) -> int:
+        return len(self.program.subtasks)
+
+    def start(self):
+        for sub in self.program.subtasks:
+            self.tasks.append(asyncio.ensure_future(sub.runner.run()))
+        return self
+
+    # -- control ------------------------------------------------------------
+
+    async def checkpoint(self, epoch: Optional[int] = None, then_stop: bool = False) -> int:
+        """Inject a checkpoint barrier at all sources; in-band alignment does
+        the rest. Returns the epoch used."""
+        if epoch is None:
+            self._epoch += 1
+            epoch = self._epoch
+        else:
+            self._epoch = max(self._epoch, epoch)
+        barrier = CheckpointBarrier(
+            epoch=epoch, min_epoch=0, timestamp=now_nanos(), then_stop=then_stop
+        )
+        for sub in self.program.source_subtasks():
+            sub.control_rx.put_nowait(CheckpointMsg(barrier))
+        return epoch
+
+    async def wait_checkpoint(self, epoch: int, timeout: float = 60.0):
+        """Wait until every subtask reported CheckpointCompleted for epoch,
+        then publish the manifest (durability point)."""
+        deadline = time.monotonic() + timeout
+        while len(self.checkpoints.get(epoch, {})) < self.n_subtasks:
+            await self._pump(deadline)
+        reports = self.checkpoints[epoch]
+        if self.backend is not None:
+            manifest = self.backend.publish_checkpoint(epoch, reports)
+            if manifest.get("committing"):
+                await self.commit_epoch(epoch, manifest["committing"])
+        return reports
+
+    async def commit_epoch(self, epoch: int, committing: Dict[str, dict]):
+        """Second phase of 2PC: authorized exactly-once via the commit
+        record, then fanned out to sink subtasks."""
+        if self.backend is not None and not self.backend.claim_commit(epoch):
+            return  # another (older-generation) controller already committed
+        data: Dict[int, dict] = {}
+        for node_id, subs in committing.items():
+            data[int(node_id)] = {
+                "data": {int(s): v for s, v in subs.items()}
+            }
+        for sub in self.program.subtasks:
+            sub.control_rx.put_nowait(CommitMsg(epoch, data))
+
+    async def checkpoint_and_wait(self, then_stop: bool = False) -> Dict[str, CheckpointCompletedResp]:
+        epoch = await self.checkpoint(then_stop=then_stop)
+        return await self.wait_checkpoint(epoch)
+
+    async def commit(self, epoch: int, committing_data: Optional[dict] = None):
+        for sub in self.program.subtasks:
+            sub.control_rx.put_nowait(CommitMsg(epoch, committing_data or {}))
+
+    async def stop(self, mode: StopMode = StopMode.GRACEFUL):
+        targets = (
+            self.program.source_subtasks()
+            if mode == StopMode.GRACEFUL
+            else self.program.subtasks
+        )
+        for sub in targets:
+            sub.control_rx.put_nowait(StopMsg(mode))
+
+    async def join(self, timeout: float = 300.0):
+        """Wait for all subtasks to finish; raises JobFailed on task error."""
+        deadline = time.monotonic() + timeout
+        while len(self.finished) < self.n_subtasks:
+            await self._pump(deadline)
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+
+    # -- response pump -------------------------------------------------------
+
+    async def _pump(self, deadline: float):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError("engine wait timed out")
+        try:
+            resp = await asyncio.wait_for(
+                self.program.control_resp.get(), timeout=min(remaining, 1.0)
+            )
+        except asyncio.TimeoutError:
+            return
+        self._handle_resp(resp)
+
+    def _handle_resp(self, resp):
+        if isinstance(resp, TaskFinishedResp):
+            self.finished.add(resp.task_id)
+        elif isinstance(resp, TaskFailedResp):
+            self.failed = resp
+            for t in self.tasks:
+                t.cancel()
+            raise JobFailed(f"task {resp.task_id} failed:\n{resp.error}")
+        elif isinstance(resp, CheckpointCompletedResp):
+            self.checkpoints.setdefault(resp.epoch, {})[resp.task_id] = resp
+        elif isinstance(resp, CheckpointEventResp):
+            self.checkpoint_events.append(resp)
+
+    def drain_responses(self):
+        while True:
+            try:
+                self._handle_resp(self.program.control_resp.get_nowait())
+            except asyncio.QueueEmpty:
+                return
+
+
+class Engine:
+    """Convenience façade: build a program from a logical graph and run it.
+
+    With `storage_url`, state is checkpointed through a StateBackend; if the
+    job has a durable checkpoint it restores from it (epoch pinned via
+    `restore_epoch`)."""
+
+    def __init__(self, graph, job_id: str = "job", state_backend=None,
+                 storage_url: Optional[str] = None,
+                 restore_epoch: Optional[int] = None):
+        self.program = Program(graph, job_id)
+        if state_backend is None and storage_url is not None:
+            from ..state.backend import StateBackend
+
+            state_backend = StateBackend(storage_url, job_id).initialize(
+                restore_epoch
+            )
+        if state_backend is not None:
+            self.program.with_state(state_backend)
+
+    def start(self) -> RunningEngine:
+        self.program.build()
+        eng = RunningEngine(self.program).start()
+        if self.program._state_backend is not None:
+            eng._epoch = self.program._state_backend.restore_epoch or 0
+        return eng

@@ -1,0 +1,183 @@
+"""Physical program: expand the logical DAG into subtasks wired by queues.
+
+Capability parity with the reference's Program::from_logical
+(/root/reference/crates/arroyo-worker/src/engine.rs:209-365): each
+LogicalNode becomes `parallelism` subtasks; Forward edges wire subtask i→i
+with one queue; shuffle-class edges wire all-to-all with one queue per
+(src_subtask, dst_subtask) pair. Join-side edges map to logical input 0
+(left) / 1 (right); all other in-edges merge into logical input 0 (union
+semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..config import config
+from ..graph.logical import EdgeType, LogicalGraph, LogicalNode
+from ..operators.base import SourceOperator
+from ..operators.collector import Collector, EdgeSender
+from ..operators.context import (
+    OperatorContext,
+    SourceContext,
+    WatermarkHolder,
+)
+from ..operators.queues import BatchQueue, InputQueue
+from ..operators.runner import SubtaskRunner
+from ..types import TaskInfo
+from .construct import construct_chain
+
+
+@dataclasses.dataclass
+class Subtask:
+    node: LogicalNode
+    index: int
+    runner: SubtaskRunner
+    control_rx: asyncio.Queue
+
+    @property
+    def key(self) -> Tuple[int, int]:
+        return (self.node.node_id, self.index)
+
+
+class Program:
+    """The physical (in-process) expansion of a LogicalGraph."""
+
+    def __init__(self, graph: LogicalGraph, job_id: str = "job"):
+        self.graph = graph
+        self.job_id = job_id
+        self.subtasks: List[Subtask] = []
+        self.control_resp: asyncio.Queue = asyncio.Queue()
+        self._state_backend = None  # set via with_state before build
+
+    def with_state(self, backend) -> "Program":
+        self._state_backend = backend
+        return self
+
+    def build(self, restore_metadata: Optional[dict] = None) -> "Program":
+        """Construct all operators, queues and runners.
+
+        restore_metadata: checkpoint metadata dict (node_id -> op tables
+        metadata) when restoring from a checkpoint.
+        """
+        cfg = config()
+        qsize, qbytes = cfg.pipeline.queue_size, cfg.pipeline.queue_bytes
+
+        # queues[(edge_idx, src_sub, dst_sub)] -> BatchQueue
+        in_queues: Dict[Tuple[int, int], List[InputQueue]] = {}
+        out_senders: Dict[Tuple[int, int], List[EdgeSender]] = {}
+        for nid, node in self.graph.nodes.items():
+            for i in range(node.parallelism):
+                in_queues[(nid, i)] = []
+                out_senders[(nid, i)] = []
+
+        for edge_idx, edge in enumerate(self.graph.edges):
+            src = self.graph.nodes[edge.src]
+            dst = self.graph.nodes[edge.dst]
+            logical_input = edge.edge_type.join_side() or 0
+            if edge.edge_type == EdgeType.FORWARD:
+                assert src.parallelism == dst.parallelism, (
+                    f"forward edge {edge.src}->{edge.dst} requires equal "
+                    f"parallelism ({src.parallelism} != {dst.parallelism})"
+                )
+                for i in range(src.parallelism):
+                    q = BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{i}")
+                    in_queues[(edge.dst, i)].append(
+                        InputQueue(q, logical_input, f"{edge.src}-{i}")
+                    )
+                    out_senders[(edge.src, i)].append(
+                        EdgeSender(edge.edge_type, edge.schema, [q], i)
+                    )
+            else:
+                # all-to-all: dst subtask j owns one queue per src subtask i
+                queues = [
+                    [
+                        BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{j}")
+                        for j in range(dst.parallelism)
+                    ]
+                    for i in range(src.parallelism)
+                ]
+                for j in range(dst.parallelism):
+                    for i in range(src.parallelism):
+                        in_queues[(edge.dst, j)].append(
+                            InputQueue(queues[i][j], logical_input, f"{edge.src}-{i}")
+                        )
+                for i in range(src.parallelism):
+                    out_senders[(edge.src, i)].append(
+                        EdgeSender(edge.edge_type, edge.schema, queues[i], i)
+                    )
+
+        for node in self.graph.topo_order():
+            in_edges = self.graph.in_edges(node.node_id)
+            out_edges = self.graph.out_edges(node.node_id)
+            for i in range(node.parallelism):
+                ops = construct_chain(node)
+                task_info = TaskInfo(
+                    self.job_id, node.node_id, node.description, i,
+                    node.parallelism,
+                )
+                inputs = in_queues[(node.node_id, i)]
+                holder = WatermarkHolder(len(inputs))
+                edge_in_schemas = [e.schema for e in in_edges]
+                out_schema = out_edges[0].schema if out_edges else None
+                ctxs = []
+                prev_out = None
+                for op_idx, op in enumerate(ops):
+                    tm = self._make_table_manager(task_info, op_idx, op)
+                    # a chained op's input is its predecessor's output, not
+                    # the node's in-edge (only op 0 sees the edges)
+                    if op_idx == 0:
+                        in_schemas = edge_in_schemas
+                    else:
+                        in_schemas = [prev_out] if prev_out else []
+                    op_out = getattr(op, "out_schema", None) or node.chain[
+                        op_idx
+                    ].config.get("schema")
+                    if op_out is None:
+                        # pass-through op: same schema as its input; the tail
+                        # op inherits the out-edge schema
+                        if op_idx == len(ops) - 1:
+                            op_out = out_schema
+                        elif in_schemas:
+                            op_out = in_schemas[0]
+                    if op_idx == 0 and isinstance(op, SourceOperator):
+                        ctx = SourceContext(
+                            task_info, in_schemas, op_out, holder, tm,
+                            batch_size=cfg.pipeline.source_batch_size,
+                            linger=cfg.pipeline.source_batch_linger,
+                        )
+                    else:
+                        ctx = OperatorContext(
+                            task_info, in_schemas, op_out, holder, tm
+                        )
+                    prev_out = op_out
+                    ctxs.append(ctx)
+                tail = Collector(
+                    out_senders[(node.node_id, i)], task_info.task_id
+                )
+                control_rx: asyncio.Queue = asyncio.Queue()
+                runner = SubtaskRunner(
+                    ops, ctxs, inputs, tail, control_rx, self.control_resp
+                )
+                self.subtasks.append(Subtask(node, i, runner, control_rx))
+        return self
+
+    def _make_table_manager(self, task_info: TaskInfo, op_idx: int, op):
+        if self._state_backend is None or not op.tables():
+            return None
+        from ..state.table_manager import TableManager
+
+        return TableManager(self._state_backend, task_info, op_idx)
+
+    # -- lookups ------------------------------------------------------------
+
+    def source_subtasks(self) -> List[Subtask]:
+        return [s for s in self.subtasks if s.node.is_source]
+
+    def subtask(self, node_id: int, index: int) -> Subtask:
+        for s in self.subtasks:
+            if s.key == (node_id, index):
+                return s
+        raise KeyError((node_id, index))

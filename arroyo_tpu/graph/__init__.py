@@ -1,0 +1,8 @@
+from .logical import (  # noqa: F401
+    EdgeType,
+    LogicalEdge,
+    LogicalGraph,
+    LogicalNode,
+    OperatorName,
+)
+from .optimizer import ChainingOptimizer  # noqa: F401

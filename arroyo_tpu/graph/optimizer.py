@@ -1,0 +1,48 @@
+"""Graph optimizers — operator chaining.
+
+Capability parity with the reference's ChainingOptimizer
+(/root/reference/crates/arroyo-datastream/src/optimizers.rs:6-18): fuse
+Forward-connected, same-parallelism operator pairs into one node so a chain
+executes in a single subtask with direct calls (Flink-style chaining).
+Sources with multiple outputs, shuffle edges, and fan-in nodes break chains.
+"""
+
+from __future__ import annotations
+
+from .logical import EdgeType, LogicalGraph, LogicalNode
+
+
+class ChainingOptimizer:
+    def optimize(self, graph: LogicalGraph) -> LogicalGraph:
+        changed = True
+        while changed:
+            changed = False
+            for edge in list(graph.edges):
+                if edge.edge_type != EdgeType.FORWARD:
+                    continue
+                src = graph.nodes[edge.src]
+                dst = graph.nodes[edge.dst]
+                if src.parallelism != dst.parallelism:
+                    continue
+                # only fuse linear connections: src has exactly one out edge,
+                # dst exactly one in edge
+                if len(graph.out_edges(src.node_id)) != 1:
+                    continue
+                if len(graph.in_edges(dst.node_id)) != 1:
+                    continue
+                # don't chain across sinks-with-commit semantics; sinks may
+                # be chained as tail but never have outputs anyway.
+                self._fuse(graph, src, dst, edge)
+                changed = True
+                break
+        return graph
+
+    @staticmethod
+    def _fuse(graph: LogicalGraph, src: LogicalNode, dst: LogicalNode, edge):
+        src.chain.extend(dst.chain)
+        src.description = f"{src.description} -> {dst.description}"
+        graph.edges.remove(edge)
+        for e in list(graph.edges):
+            if e.src == dst.node_id:
+                e.src = src.node_id
+        del graph.nodes[dst.node_id]

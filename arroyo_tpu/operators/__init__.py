@@ -1,0 +1,11 @@
+from .base import Operator, SourceOperator, SourceFinishType  # noqa: F401
+from .control import (  # noqa: F401
+    CheckpointMsg,
+    CommitMsg,
+    ControlResp,
+    LoadCompactedMsg,
+    StopMsg,
+)
+from .collector import Collector, EdgeSender  # noqa: F401
+from .context import OperatorContext, SourceContext, WatermarkHolder  # noqa: F401
+from .queues import BatchQueue, InputQueue  # noqa: F401

@@ -1,0 +1,1 @@
+"""Placeholder: async_udf operators land with the window/join milestone."""

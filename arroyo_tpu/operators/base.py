@@ -1,0 +1,105 @@
+"""Operator traits.
+
+Capability parity with the reference's ArrowOperator / SourceOperator traits
+(/root/reference/crates/arroyo-operator/src/operator.rs:1144-1257, :320-377):
+lifecycle hooks, batch processing, watermark handling (return None to hold),
+checkpoint state-snapshot hook, 2PC commit hook, periodic tick, and the
+state-table declaration. Sources run their own loop and poll the control
+queue between emissions (checkpoint barriers are injected at clean points).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Optional
+
+import pyarrow as pa
+
+from ..types import CheckpointBarrier, Watermark
+from .collector import Collector
+from .context import OperatorContext, SourceContext
+
+
+class SourceFinishType(enum.Enum):
+    GRACEFUL = "graceful"  # stop requested: propagate Stop, no final watermark
+    IMMEDIATE = "immediate"  # tear down without draining
+    FINAL = "final"  # source exhausted: final watermark + EndOfData
+
+
+class Operator:
+    """Base class for dataflow operators. Subclasses override the hooks they
+    need; `process_batch` is the hot path."""
+
+    def __init__(self, name: str = ""):
+        self.name = name or type(self).__name__
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def on_start(self, ctx: OperatorContext):
+        pass
+
+    async def process_batch(
+        self,
+        batch: pa.RecordBatch,
+        ctx: OperatorContext,
+        collector: "ChainCollector",
+        input_index: int = 0,
+    ):
+        raise NotImplementedError
+
+    async def handle_watermark(
+        self, watermark: Watermark, ctx: OperatorContext, collector
+    ) -> Optional[Watermark]:
+        """Called when the combined input watermark advances. Return the
+        watermark to propagate (possibly modified) or None to hold it."""
+        return watermark
+
+    async def handle_checkpoint(
+        self, barrier: CheckpointBarrier, ctx: OperatorContext, collector
+    ):
+        """Snapshot in-memory state into ctx state tables; called after
+        barrier alignment, before the table flush."""
+
+    async def handle_commit(
+        self, epoch: int, commit_data: Dict[int, list], ctx: OperatorContext
+    ):
+        """Second phase of 2PC for transactional sinks."""
+
+    async def handle_tick(self, tick: int, ctx: OperatorContext, collector):
+        pass
+
+    def tick_interval(self) -> Optional[float]:
+        return None
+
+    async def on_close(
+        self, ctx: OperatorContext, collector, is_eod: bool
+    ) -> Optional[Watermark]:
+        """Called when all inputs finished. May emit final data via the
+        collector; a returned watermark is run through the rest of the chain
+        and broadcast (the watermark generator returns the end-of-time
+        watermark here so windows flush)."""
+        return None
+
+    def tables(self) -> Dict[str, Any]:
+        """State tables this operator needs: name -> TableConfig."""
+        return {}
+
+    def display(self) -> str:
+        return self.name
+
+
+class SourceOperator(Operator):
+    """Sources drive their own loop. Implementations must call
+    `await ctx.check_control(collector)` regularly (between batches) and
+    return when it yields a finish type."""
+
+    async def run(self, ctx: SourceContext, collector) -> SourceFinishType:
+        raise NotImplementedError
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        raise RuntimeError("sources do not process input batches")
+
+    async def flush_buffer(self, ctx: SourceContext, collector):
+        batch = ctx.take_buffer()
+        if batch is not None:
+            await collector.collect(batch)

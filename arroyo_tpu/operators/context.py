@@ -1,0 +1,160 @@
+"""Operator and source contexts: watermark tracking, state access, metrics.
+
+Capability parity with the reference's OperatorContext/SourceContext
+(/root/reference/crates/arroyo-operator/src/context.rs): WatermarkHolder
+min-merges per-input watermarks (:35-89) with idle handling; SourceContext
+buffers rows by size+time before emitting (:219-437) and rate-limits user
+error reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+import pyarrow as pa
+
+from ..metrics import ERRORS
+from ..types import TaskInfo, Watermark, WatermarkKind
+from ..schema import StreamSchema
+
+if TYPE_CHECKING:
+    from ..state.table_manager import TableManager
+
+
+class WatermarkHolder:
+    """Tracks the last watermark per input queue; the operator's effective
+    watermark is the min over non-idle inputs (all-idle → Idle)."""
+
+    def __init__(self, n_inputs: int):
+        self.watermarks: List[Optional[Watermark]] = [None] * max(1, n_inputs)
+
+    def set(self, input_idx: int, wm: Watermark) -> Optional[Watermark]:
+        """Record a new watermark; returns the new combined watermark if it
+        changed the operator's effective watermark, else None."""
+        before = self.combined()
+        self.watermarks[input_idx] = wm
+        after = self.combined()
+        if after is None:
+            return None
+        if before is None or before != after:
+            return after
+        return None
+
+    def combined(self) -> Optional[Watermark]:
+        # every input must have reported at least once
+        if any(w is None for w in self.watermarks):
+            return None
+        active = [w.timestamp for w in self.watermarks
+                  if w.kind == WatermarkKind.EVENT_TIME]
+        if not active:
+            return Watermark.idle()
+        return Watermark.event_time(min(active))
+
+    def current_nanos(self) -> Optional[int]:
+        c = self.combined()
+        if c is None or c.is_idle():
+            return None
+        return c.timestamp
+
+
+@dataclasses.dataclass
+class ErrorReporter:
+    """Rate-limited non-fatal error reporting (reference: bad-data handling
+    in SourceCollector)."""
+
+    task_info: TaskInfo
+    max_per_interval: int = 10
+    interval: float = 10.0
+    _count: int = 0
+    _window_start: float = 0.0
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    def report(self, message: str, details: str = ""):
+        ERRORS.labels(task=self.task_info.task_id).inc()
+        now = time.monotonic()
+        if now - self._window_start > self.interval:
+            self._window_start = now
+            self._count = 0
+        self._count += 1
+        if self._count <= self.max_per_interval:
+            self.errors.append(f"{message}: {details}" if details else message)
+            if len(self.errors) > 100:
+                del self.errors[:50]
+
+
+class OperatorContext:
+    """Per-(operator, subtask) context handed to every operator callback."""
+
+    def __init__(
+        self,
+        task_info: TaskInfo,
+        in_schemas: List[StreamSchema],
+        out_schema: Optional[StreamSchema],
+        watermarks: WatermarkHolder,
+        table_manager: Optional["TableManager"] = None,
+    ):
+        self.task_info = task_info
+        self.in_schemas = in_schemas
+        self.out_schema = out_schema
+        self.watermarks = watermarks
+        self.table_manager = table_manager
+        self.error_reporter = ErrorReporter(task_info)
+        # sink commit payloads stashed at checkpoint, committed on CommitMsg
+        self.commit_data: Optional[bytes] = None
+        self._runner = None  # back-ref set by SubtaskRunner
+
+    def last_watermark(self) -> Optional[int]:
+        return self.watermarks.current_nanos()
+
+    async def table(self, name: str):
+        assert self.table_manager is not None, "operator has no state tables"
+        return await self.table_manager.get_table(name)
+
+
+class SourceContext(OperatorContext):
+    """Adds source-side row buffering: rows accumulate until batch-size or
+    linger-time flush (reference SourceCollector::should_flush)."""
+
+    def __init__(self, *args, batch_size: int = 512, linger: float = 0.1, **kw):
+        super().__init__(*args, **kw)
+        self.batch_size = batch_size
+        self.linger = linger
+        self._buffer: List[Dict[str, Any]] = []
+        self._buffer_started: Optional[float] = None
+        self._runner = None  # set by SubtaskRunner before run()
+
+    async def check_control(self, collector):
+        """Drain pending control messages (checkpoint barriers, stop); call
+        between emissions. Returns a SourceFinishType when the source should
+        return, else None."""
+        assert self._runner is not None
+        return await self._runner.source_handle_control(collector)
+
+    def buffer_row(self, row: Dict[str, Any]):
+        if self._buffer_started is None:
+            self._buffer_started = time.monotonic()
+        self._buffer.append(row)
+
+    def should_flush(self) -> bool:
+        if not self._buffer:
+            return False
+        if len(self._buffer) >= self.batch_size:
+            return True
+        return (time.monotonic() - (self._buffer_started or 0)) >= self.linger
+
+    def take_buffer(self) -> Optional[pa.RecordBatch]:
+        if not self._buffer:
+            return None
+        rows, self._buffer = self._buffer, []
+        self._buffer_started = None
+        assert self.out_schema is not None
+        cols = {name: [] for name in self.out_schema.names}
+        for row in rows:
+            for name in cols:
+                cols[name].append(row.get(name))
+        arrays = [
+            pa.array(cols[f.name], type=f.type) for f in self.out_schema.schema
+        ]
+        return pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)

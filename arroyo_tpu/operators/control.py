@@ -1,0 +1,86 @@
+"""Control-plane messages between the engine/job-controller and subtasks.
+
+Capability parity with the reference's ControlMessage/ControlResp
+(/root/reference/crates/arroyo-rpc/src/lib.rs:180-229). These flow over
+per-subtask asyncio queues in-process (and over gRPC across workers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..types import CheckpointBarrier, StopMode
+
+
+@dataclasses.dataclass
+class CheckpointMsg:
+    barrier: CheckpointBarrier
+
+
+@dataclasses.dataclass
+class StopMsg:
+    mode: StopMode = StopMode.GRACEFUL
+
+
+@dataclasses.dataclass
+class CommitMsg:
+    epoch: int
+    # node_id -> table -> subtask -> payload (committing data from manifest)
+    committing_data: Dict[int, Dict[str, Dict[int, List[bytes]]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class LoadCompactedMsg:
+    node_id: int
+    table: str
+    # table -> new file paths that replace the pre-compaction files
+    paths: List[str] = dataclasses.field(default_factory=list)
+
+
+ControlMessage = Any  # union of the above
+
+
+# -- responses (subtask -> engine/job controller) ---------------------------
+
+
+@dataclasses.dataclass
+class CheckpointEventResp:
+    task_id: str
+    node_id: int
+    subtask_index: int
+    epoch: int
+    event: str  # started_alignment | started_checkpointing | finished_sync | ...
+
+
+@dataclasses.dataclass
+class CheckpointCompletedResp:
+    task_id: str
+    node_id: int
+    subtask_index: int
+    epoch: int
+    # per-table metadata produced by the table manager flush
+    subtask_metadata: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    watermark: Optional[int] = None
+    has_commit_data: bool = False
+    commit_data: Optional[bytes] = None
+
+
+@dataclasses.dataclass
+class TaskFailedResp:
+    task_id: str
+    node_id: int
+    subtask_index: int
+    error: str
+
+
+@dataclasses.dataclass
+class TaskFinishedResp:
+    task_id: str
+    node_id: int
+    subtask_index: int
+
+
+ControlResp = Any  # union of the above

@@ -1,0 +1,1 @@
+"""Placeholder: joins operators land with the window/join milestone."""

@@ -1,0 +1,1 @@
+"""Placeholder: updating operators land with the window/join milestone."""

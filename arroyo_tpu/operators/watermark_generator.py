@@ -1,0 +1,116 @@
+"""Watermark generator operator.
+
+Capability parity with the reference's watermark_generator.rs
+(/root/reference/crates/arroyo-worker/src/arrow/watermark_generator.rs):
+watermark = max(_timestamp seen) - allowed_lateness interval, emitted as the
+data flows; idleness detection emits Watermark::Idle after `idle_time`
+without data so an empty partition doesn't hold back the pipeline; the
+end-of-time watermark is emitted on EndOfData so all windows flush; the max
+watermark is persisted per-subtask in global state and restored.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..graph.logical import OperatorName
+from ..engine.construct import register_operator
+from ..types import Watermark, WATERMARK_END
+from .base import Operator
+
+
+class WatermarkGenerator(Operator):
+    def __init__(
+        self,
+        interval_nanos: int = 0,
+        idle_time: Optional[float] = None,
+        period_nanos: int = 0,
+    ):
+        super().__init__("watermark")
+        self.interval = interval_nanos  # lateness allowance subtracted
+        self.idle_time = idle_time
+        self.period = period_nanos  # min watermark advance between emissions
+        self.max_ts: Optional[int] = None
+        self.last_emitted: Optional[int] = None
+        self.last_data_at = time.monotonic()
+        self.idle = False
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"w": global_table("w")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table(("w"))
+            stored = table.get(ctx.task_info.task_index)
+            if stored is not None:
+                self.max_ts = stored
+                self.last_emitted = None  # re-emit after restore
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        # locate _timestamp in the batch itself: chained upstream ops may
+        # have reshaped the schema relative to the node's in-edge
+        import pyarrow as pa
+
+        from ..schema import TIMESTAMP_FIELD
+
+        if TIMESTAMP_FIELD not in batch.schema.names or batch.num_rows == 0:
+            await collector.collect(batch)
+            return
+        col = batch.column(batch.schema.names.index(TIMESTAMP_FIELD))
+        m = int(pa.compute.max(col.cast(pa.int64())).as_py())
+        if self.max_ts is None or m > self.max_ts:
+            self.max_ts = m
+        self.last_data_at = time.monotonic()
+        self.idle = False
+        await collector.collect(batch)
+        wm = self.max_ts - self.interval
+        if self.last_emitted is None or wm - self.last_emitted >= self.period:
+            self.last_emitted = wm
+            await self._emit(ctx, Watermark.event_time(wm))
+
+    async def _emit(self, ctx, wm: Watermark):
+        # inject into the chain *after* this operator and broadcast
+        runner = _runner_of(ctx)
+        if runner is not None:
+            idx = runner.ops.index(self)
+            await runner._chain_watermark(idx + 1, wm)
+
+    def tick_interval(self) -> Optional[float]:
+        return self.idle_time / 2 if self.idle_time else None
+
+    async def handle_tick(self, tick, ctx, collector):
+        if (
+            self.idle_time
+            and not self.idle
+            and time.monotonic() - self.last_data_at > self.idle_time
+        ):
+            self.idle = True
+            await self._emit(ctx, Watermark.idle())
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None and self.max_ts is not None:
+            table = await ctx.table("w")
+            table.put(ctx.task_info.task_index, self.max_ts)
+
+    async def on_close(self, ctx, collector, is_eod: bool):
+        if is_eod:
+            return Watermark.event_time(WATERMARK_END)
+        return None
+
+
+def _runner_of(ctx):
+    # the runner stashes itself on source contexts; for mid-chain watermark
+    # generators we find it via the context's back-reference set at build
+    return getattr(ctx, "_runner", None)
+
+
+@register_operator(OperatorName.EXPRESSION_WATERMARK)
+def _make_watermark(config: dict) -> Operator:
+    return WatermarkGenerator(
+        interval_nanos=int(config.get("interval_nanos", 0)),
+        idle_time=config.get("idle_time"),
+        period_nanos=int(config.get("period_nanos", 0)),
+    )

@@ -1,0 +1,1 @@
+"""Placeholder: window_fn operators land with the window/join milestone."""

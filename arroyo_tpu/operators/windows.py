@@ -1,0 +1,641 @@
+"""Windowed aggregation operators: tumbling, sliding (hop), session.
+
+Capability parity with the reference's window operators
+(/root/reference/crates/arroyo-worker/src/arrow/
+{tumbling,sliding,session}_aggregating_window.rs): event-time bins advance
+with the watermark; tumbling emits a bin when the watermark passes its end;
+sliding maintains slide-granularity partials merged per emitted window;
+session windows gap-merge per key and emit when the watermark passes
+last-event + gap. Late rows (whose windows already emitted) are dropped.
+
+TPU-native redesign: instead of one DataFusion partial-aggregation stream
+per bin, all (bin, key) groups share flat device accumulator arrays
+(ops/aggregates.py) updated by one jitted scatter-reduce per batch; the
+host-side SlotDirectory owns group->slot assignment. Emission gathers slots
+to host once per watermark advance. Output rows carry
+_timestamp = window_end - 1ns (inside the window, reference behavior) and
+optional window start/end columns.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..engine.construct import register_operator
+from ..graph.logical import OperatorName
+from ..ops.aggregates import AggSpec, _neutral, _np_dtype, make_accumulator
+from ..ops.directory import SlotDirectory, unintern_value
+from ..schema import StreamSchema, TIMESTAMP_FIELD
+from ..types import WatermarkKind
+from .base import Operator
+
+
+def _specs_from_config(config: dict) -> List[AggSpec]:
+    return [
+        AggSpec(
+            kind=a["kind"],
+            col=a.get("col"),
+            name=a["name"],
+            is_float=a.get("is_float", False),
+        )
+        for a in config["aggregates"]
+    ]
+
+
+class WindowOperatorBase(Operator):
+    """Shared machinery: accumulator, directory, output batch building."""
+
+    def __init__(self, config: dict, name: str):
+        super().__init__(name)
+        self.specs = _specs_from_config(config)
+        self.key_cols: List[int] = list(config.get("key_cols", []))
+        self.out_schema: StreamSchema = config["schema"]
+        self.window_start_field: Optional[str] = config.get("window_start_field")
+        self.window_end_field: Optional[str] = config.get("window_end_field")
+        self.backend = config.get("backend")
+        self.acc = make_accumulator(self.specs, backend=self.backend)
+        self.dir = SlotDirectory()
+        self._key_types: Optional[List[pa.DataType]] = None
+        self._key_names: Optional[List[str]] = None
+
+    def _capture_key_meta(self, ctx):
+        if self._key_types is None:
+            in_schema = ctx.in_schemas[0].schema
+            self._key_types = [in_schema.field(i).type for i in self.key_cols]
+            self._key_names = [in_schema.field(i).name for i in self.key_cols]
+
+    def _ensure_capacity(self):
+        need = self.dir.required_capacity()
+        if need > self.acc.capacity - 1:
+            self.acc.grow(need + 1)
+
+    def _key_arrays(self, batch: pa.RecordBatch) -> List[np.ndarray]:
+        out = []
+        for i in self.key_cols:
+            col = batch.column(i)
+            try:
+                out.append(col.to_numpy(zero_copy_only=False))
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                out.append(np.array(col.to_pylist(), dtype=object))
+        return out
+
+    def _agg_input_cols(self, batch: pa.RecordBatch) -> Dict[int, np.ndarray]:
+        cols: Dict[int, np.ndarray] = {}
+        for spec in self.specs:
+            if spec.col is not None and spec.col not in cols:
+                arr = batch.column(spec.col)
+                if spec.is_float:
+                    cols[spec.col] = np.asarray(
+                        arr.to_numpy(zero_copy_only=False), dtype=np.float64
+                    )
+                else:
+                    cols[spec.col] = np.asarray(
+                        arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+                    )
+        return cols
+
+    def _build_output(
+        self,
+        keys: List[tuple],
+        agg_cols: List[np.ndarray],
+        start: int,
+        end: int,
+    ) -> pa.RecordBatch:
+        """Build an output batch for one window [start, end)."""
+        n = len(keys)
+        arrays = []
+        for f in self.out_schema.schema:
+            if f.name == TIMESTAMP_FIELD:
+                arrays.append(
+                    pa.array(np.full(n, end - 1, dtype=np.int64)).cast(f.type)
+                )
+            elif f.name == self.window_start_field:
+                arrays.append(
+                    pa.array(np.full(n, start, dtype=np.int64)).cast(f.type)
+                )
+            elif f.name == self.window_end_field:
+                arrays.append(
+                    pa.array(np.full(n, end, dtype=np.int64)).cast(f.type)
+                )
+            elif f.name in (self._key_names or []):
+                ki = self._key_names.index(f.name)
+                vals = [_to_py(k[ki]) for k in keys]
+                kt = self._key_types[ki]
+                if _is_interned_type(kt):
+                    arrays.append(
+                        pa.array([unintern_value(v) for v in vals], type=kt)
+                    )
+                elif pa.types.is_unsigned_integer(kt):
+                    # directory codes are bit-preserving int64; normalize back
+                    arrays.append(
+                        pa.array([v % (1 << 64) for v in vals], type=kt)
+                    )
+                elif pa.types.is_timestamp(kt):
+                    arrays.append(pa.array(vals, type=pa.int64()).cast(kt))
+                else:
+                    arrays.append(pa.array(vals, type=kt))
+            else:
+                ai = next(
+                    j for j, s in enumerate(self.specs) if s.name == f.name
+                )
+                col = agg_cols[ai]
+                if pa.types.is_floating(f.type):
+                    arrays.append(pa.array(col.astype(np.float64), type=f.type))
+                else:
+                    arrays.append(pa.array(col.astype(np.int64), type=f.type))
+        return pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
+
+    # -- checkpoint form ----------------------------------------------------
+
+    def _key_tuple_to_values(self, key: tuple) -> list:
+        """Directory key tuple (codes) -> portable key values."""
+        out = []
+        for ki, k in enumerate(key):
+            if _is_interned_type(self._key_types[ki]):
+                out.append(unintern_value(_to_py(k)))
+            else:
+                out.append(_to_py(k))
+        return out
+
+    def _snapshot_rows(self) -> dict:
+        """Directory + accumulator values as plain lists (checkpoint form).
+        Interned key codes are resolved to their values: codes are
+        process-local and must never leave the process."""
+        bins, keys, slots = [], [], []
+        for b, key, slot in self.dir.items():
+            bins.append(int(b))
+            keys.append(self._key_tuple_to_values(key))
+            slots.append(int(slot))
+        slots_arr = np.asarray(slots, dtype=np.int64)
+        values = self.acc.snapshot(slots_arr) if len(slots) else []
+        return {"bins": bins, "keys": keys, "values": [v.tolist() for v in values]}
+
+    def _restore_rows(self, snap: dict, ctx=None):
+        """Rebuild directory+accumulator from a snapshot. Snapshots from ALL
+        pre-restart subtasks are replayed; rows outside this subtask's key
+        range are skipped, which makes rescaling a restore-time re-read
+        (reference: key-range sharding, arroyo-types lib.rs:640)."""
+        bins = snap["bins"]
+        if not bins:
+            return
+        keys = snap["keys"]
+        mask = self._range_mask(keys, ctx)
+        if mask is not None:
+            bins = [b for b, m in zip(bins, mask) if m]
+            keys = [k for k, m in zip(keys, mask) if m]
+            if not bins:
+                return
+        n_keycols = len(keys[0]) if keys else 0
+        key_cols = []
+        for i in range(n_keycols):
+            vals = [k[i] for k in keys]
+            if _is_interned_type(self._key_types[i]):
+                # dtype=object routes through the interning path in assign()
+                key_cols.append(np.asarray(vals, dtype=object))
+            else:
+                key_cols.append(np.asarray(vals, dtype=np.int64))
+        slots = self.dir.assign(np.asarray(bins, dtype=np.int64), key_cols)
+        self._ensure_capacity()
+        values = [np.asarray(v) for v in snap["values"]]
+        if mask is not None:
+            marr = np.asarray(mask)
+            values = [v[marr] for v in values]
+        self.acc.restore(slots, values)
+
+    def _range_mask(self, keys: List[list], ctx) -> Optional[List[bool]]:
+        """True per row iff the key hashes into this subtask's range."""
+        if ctx is None or ctx.task_info.parallelism <= 1 or not keys:
+            return None
+        if not self.key_cols:
+            return None
+        from ..types import hash_arrays, hash_column, server_for_hash_array
+
+        cols = []
+        for i in range(len(keys[0])):
+            vals = [k[i] for k in keys]
+            kt = self._key_types[i]
+            # dtype must match what the shuffle hashed (schema.hash_keys)
+            if pa.types.is_floating(kt):
+                arr = np.asarray(vals, dtype=np.float64)
+            elif _is_interned_type(kt):
+                arr = np.asarray(vals, dtype=object)
+            else:
+                arr = np.asarray(vals, dtype=np.int64)
+            cols.append(hash_column(arr))
+        owners = server_for_hash_array(
+            hash_arrays(cols), ctx.task_info.parallelism
+        )
+        return list(owners == ctx.task_info.task_index)
+
+
+def _to_py(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def _snaps_for_me(table, ctx, keyed: bool):
+    """Snapshots this subtask should replay: keyed state replays every
+    subtask's snapshot (rows are filtered by key range inside
+    _restore_rows); unkeyed state maps old subtask i onto new subtask
+    i % parallelism so exactly one new subtask owns each old snapshot."""
+    p = ctx.task_info.parallelism
+    for snap in table.all_values():
+        if snap is None:
+            continue
+        if keyed or snap.get("subtask", 0) % p == ctx.task_info.task_index:
+            yield snap
+
+
+def _is_interned_type(t: pa.DataType) -> bool:
+    return not (
+        pa.types.is_integer(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_timestamp(t)
+    )
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class TumblingWindowOperator(WindowOperatorBase):
+    """Fixed-width windows: bin = ts // width; emit at watermark >= end
+    (reference tumbling_aggregating_window.rs:66-321)."""
+
+    def __init__(self, config: dict):
+        super().__init__(config, "tumbling_window")
+        self.width = int(config["width_nanos"])
+        assert self.width > 0
+        self.emitted_up_to: Optional[int] = None
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"t": global_table("t")}
+
+    async def on_start(self, ctx):
+        self._capture_key_meta(ctx)
+        if ctx.table_manager is not None:
+            table = await ctx.table("t")
+            for snap in _snaps_for_me(table, ctx, bool(self.key_cols)):
+                if snap.get("emitted_up_to") is not None:
+                    self.emitted_up_to = max(
+                        self.emitted_up_to or 0, snap["emitted_up_to"]
+                    )
+                self._restore_rows(snap, ctx)
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("t")
+            snap = self._snapshot_rows()
+            snap["emitted_up_to"] = self.emitted_up_to
+            snap["subtask"] = ctx.task_info.task_index
+            table.put(ctx.task_info.task_index, snap)
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        self._capture_key_meta(ctx)
+        ts = ctx.in_schemas[0].timestamps(batch)
+        bins = ts // self.width
+        if self.emitted_up_to is not None:
+            live = (bins + 1) * self.width > self.emitted_up_to
+            if not live.all():
+                if not live.any():
+                    return
+                batch = batch.filter(pa.array(live))
+                bins = bins[live]
+        keys = self._key_arrays(batch)
+        slots = self.dir.assign(bins, keys)
+        self._ensure_capacity()
+        self.acc.update(slots, self._agg_input_cols(batch))
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        if watermark.kind != WatermarkKind.EVENT_TIME:
+            return watermark
+        t = watermark.timestamp
+        for b in self.dir.bins_up_to(_ceil_div(t, self.width)):
+            end = (b + 1) * self.width
+            if end > t:
+                continue
+            keys, slots = self.dir.take_bin(b)
+            gathered = self.acc.gather(slots)
+            self.acc.reset_slots(slots)
+            agg_cols = self.acc.finalize(gathered)
+            out = self._build_output(keys, agg_cols, b * self.width, end)
+            await collector.collect(out)
+            self.emitted_up_to = max(self.emitted_up_to or 0, end)
+        return watermark
+
+
+class SlidingWindowOperator(WindowOperatorBase):
+    """Hop windows: slide-granularity partial bins; each emitted window
+    merges width/slide bins (reference sliding_aggregating_window.rs:64-753).
+    Requires width % slide == 0."""
+
+    def __init__(self, config: dict):
+        super().__init__(config, "sliding_window")
+        self.width = int(config["width_nanos"])
+        self.slide = int(config["slide_nanos"])
+        assert self.slide > 0 and self.width % self.slide == 0, (
+            "window width must be a positive multiple of slide"
+        )
+        self.k = self.width // self.slide
+        self.next_emit: Optional[int] = None
+        self.last_freed_bin: Optional[int] = None
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"s": global_table("s")}
+
+    async def on_start(self, ctx):
+        self._capture_key_meta(ctx)
+        if ctx.table_manager is not None:
+            table = await ctx.table("s")
+            for snap in _snaps_for_me(table, ctx, bool(self.key_cols)):
+                if snap.get("next_emit") is not None:
+                    self.next_emit = (
+                        snap["next_emit"] if self.next_emit is None
+                        else min(self.next_emit, snap["next_emit"])
+                    )
+                if snap.get("last_freed_bin") is not None:
+                    self.last_freed_bin = (
+                        snap["last_freed_bin"] if self.last_freed_bin is None
+                        else min(self.last_freed_bin, snap["last_freed_bin"])
+                    )
+                self._restore_rows(snap, ctx)
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("s")
+            snap = self._snapshot_rows()
+            snap["next_emit"] = self.next_emit
+            snap["last_freed_bin"] = self.last_freed_bin
+            snap["subtask"] = ctx.task_info.task_index
+            table.put(ctx.task_info.task_index, snap)
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        self._capture_key_meta(ctx)
+        ts = ctx.in_schemas[0].timestamps(batch)
+        bins = ts // self.slide
+        if self.last_freed_bin is not None:
+            live = bins > self.last_freed_bin
+            if not live.all():
+                if not live.any():
+                    return
+                batch = batch.filter(pa.array(live))
+                bins = bins[live]
+        if self.next_emit is None and len(bins):
+            self.next_emit = (int(bins.min()) + 1) * self.slide
+        keys = self._key_arrays(batch)
+        slots = self.dir.assign(bins, keys)
+        self._ensure_capacity()
+        self.acc.update(slots, self._agg_input_cols(batch))
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        if watermark.kind != WatermarkKind.EVENT_TIME:
+            return watermark
+        t = watermark.timestamp
+        while self.next_emit is not None and self.next_emit <= t:
+            await self._emit_window(self.next_emit, collector)
+            if not self.dir.by_bin:
+                self.next_emit = None  # drained; restart at next data
+            else:
+                self.next_emit += self.slide
+        return watermark
+
+    async def _emit_window(self, end: int, collector):
+        end_bin = end // self.slide  # window covers bins [end_bin-k, end_bin)
+        lo_bin = end_bin - self.k
+        # merge per-key across participating bins (host merge: runs once per
+        # slide period; the per-event scatter stays on device)
+        merged: Dict[tuple, List[int]] = {}
+        for b in range(lo_bin, end_bin):
+            bin_map = self.dir.peek_bin(b)
+            if not bin_map:
+                continue
+            for key, slot in bin_map.items():
+                merged.setdefault(key, []).append(slot)
+        if merged:
+            all_slots = np.fromiter(
+                (s for slots in merged.values() for s in slots), dtype=np.int64
+            )
+            seg_ids = np.fromiter(
+                (i for i, slots in enumerate(merged.values()) for _ in slots),
+                dtype=np.int64,
+            )
+            gathered = self.acc.gather(all_slots)
+            n_keys = len(merged)
+            combined = []
+            for (op, dt, _, _), vals in zip(self.acc.phys, gathered):
+                out = np.full(n_keys, _neutral(op, dt), dtype=_np_dtype(dt))
+                if op == "add":
+                    np.add.at(out, seg_ids, vals)
+                elif op == "min":
+                    np.minimum.at(out, seg_ids, vals)
+                else:
+                    np.maximum.at(out, seg_ids, vals)
+                combined.append(out)
+            agg_cols = self.acc.finalize(combined)
+            out_batch = self._build_output(
+                list(merged.keys()), agg_cols, end - self.width, end
+            )
+            await collector.collect(out_batch)
+        # the oldest bin exits the window range: free it
+        _, freed = self.dir.take_bin(lo_bin)
+        if len(freed):
+            self.acc.reset_slots(freed)
+        self.last_freed_bin = max(self.last_freed_bin or lo_bin, lo_bin)
+
+
+class SessionWindowOperator(WindowOperatorBase):
+    """Per-key gap-merged sessions
+    (reference session_aggregating_window.rs:51-942). Session bookkeeping is
+    inherently scalar, so this operator runs on the host numpy backend (a
+    pallas sorted-segment kernel can replace it later)."""
+
+    def __init__(self, config: dict):
+        config = dict(config)
+        config["backend"] = "numpy"
+        super().__init__(config, "session_window")
+        self.gap = int(config["gap_nanos"])
+        assert self.gap > 0
+        # key -> list of [start, last_ts, slot], sorted by start
+        self.sessions: Dict[tuple, List[List]] = {}
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"sess": global_table("sess")}
+
+    async def on_start(self, ctx):
+        self._capture_key_meta(ctx)
+        if ctx.table_manager is not None:
+            table = await ctx.table("sess")
+            for snap in _snaps_for_me(table, ctx, bool(self.key_cols)):
+                self._restore_sessions(snap, ctx)
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("sess")
+            snap = self._snapshot_sessions()
+            snap["subtask"] = ctx.task_info.task_index
+            table.put(ctx.task_info.task_index, snap)
+
+    def _snapshot_sessions(self) -> dict:
+        slots = [s[2] for v in self.sessions.values() for s in v]
+        slots_arr = np.asarray(slots, dtype=np.int64)
+        values = self.acc.snapshot(slots_arr) if slots else []
+        return {
+            "sessions": [
+                [self._key_tuple_to_values(key), [[int(x) for x in s] for s in v]]
+                for key, v in self.sessions.items()
+            ],
+            "slots": [int(s) for s in slots],
+            "values": [v.tolist() for v in values],
+        }
+
+    def _restore_sessions(self, snap: dict, ctx=None):
+        """Replay one pre-restart subtask's sessions, remapping slots (old
+        slot ids collide across subtasks) and skipping keys outside this
+        subtask's range."""
+        from ..ops.directory import intern_value
+
+        def to_key(vals: list) -> tuple:
+            return tuple(
+                intern_value(v) if _is_interned_type(self._key_types[i]) else v
+                for i, v in enumerate(vals)
+            )
+
+        slot_pos = {s: i for i, s in enumerate(snap["slots"])}
+        values = [np.asarray(v) for v in snap["values"]]
+        key_rows = [key_vals for key_vals, _ in snap["sessions"]]
+        mask = self._range_mask(key_rows, ctx) if key_rows else None
+        for si, (key_vals, sess_list) in enumerate(snap["sessions"]):
+            if mask is not None and not mask[si]:
+                continue
+            key = to_key(key_vals)
+            cur = self.sessions.setdefault(key, [])
+            for s in sess_list:
+                new_slot = (
+                    self.dir.free.pop() if self.dir.free else self.dir._alloc()
+                )
+                self._ensure_capacity()
+                pos = slot_pos[s[2]]
+                self.acc.restore(
+                    np.asarray([new_slot], dtype=np.int64),
+                    [v[pos: pos + 1] for v in values],
+                )
+                cur.append([s[0], s[1], new_slot])
+            cur.sort(key=lambda x: x[0])
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        self._capture_key_meta(ctx)
+        ts = ctx.in_schemas[0].timestamps(batch)
+        wm = ctx.watermarks.current_nanos()
+        keys = self._key_arrays(batch)
+        cols = self._agg_input_cols(batch)
+        order = np.argsort(ts, kind="stable")
+        row_slots = np.empty(len(ts), dtype=np.int64)
+        for ri in order:
+            t = int(ts[ri])
+            if wm is not None and t + self.gap <= wm:
+                row_slots[ri] = -1  # fully late: its session already emitted
+                continue
+            key = tuple(_to_py(k[ri]) for k in keys)
+            row_slots[ri] = self._place(key, t)
+        keep = row_slots >= 0
+        if keep.any():
+            self._ensure_capacity()
+            self.acc.update(
+                row_slots[keep], {c: v[keep] for c, v in cols.items()}
+            )
+
+    def _place(self, key: tuple, t: int) -> int:
+        """Find/extend/merge the session containing t; returns its slot."""
+        sess = self.sessions.setdefault(key, [])
+        hit = None
+        for s in sess:
+            if s[0] - self.gap < t < s[1] + self.gap or s[0] <= t <= s[1]:
+                hit = s
+                break
+        if hit is None:
+            slot = self.dir.free.pop() if self.dir.free else self.dir._alloc()
+            self._ensure_capacity()
+            sess.append([t, t, slot])
+            sess.sort(key=lambda s: s[0])
+            return slot
+        hit[0] = min(hit[0], t)
+        hit[1] = max(hit[1], t)
+        # the extension may bridge adjacent sessions: merge while overlapping
+        sess.sort(key=lambda s: s[0])
+        i = 0
+        while i < len(sess) - 1:
+            a, b = sess[i], sess[i + 1]
+            if b[0] < a[1] + self.gap:
+                self._merge_slots(a, b)
+                sess.pop(i + 1)
+            else:
+                i += 1
+        return hit[2]
+
+    def _merge_slots(self, a: List, b: List):
+        """Fold session b's accumulator into a's; free b's slot."""
+        ga = self.acc.gather(np.asarray([a[2], b[2]], dtype=np.int64))
+        combined = []
+        for (op, dt, _, _), vals in zip(self.acc.phys, ga):
+            if op == "add":
+                combined.append(np.asarray([vals[0] + vals[1]]))
+            elif op == "min":
+                combined.append(np.asarray([min(vals[0], vals[1])]))
+            else:
+                combined.append(np.asarray([max(vals[0], vals[1])]))
+        self.acc.restore(np.asarray([a[2]], dtype=np.int64), combined)
+        self.acc.reset_slots(np.asarray([b[2]], dtype=np.int64))
+        self.dir.free.append(int(b[2]))
+        a[0] = min(a[0], b[0])
+        a[1] = max(a[1], b[1])
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        if watermark.kind != WatermarkKind.EVENT_TIME:
+            return watermark
+        t = watermark.timestamp
+        for key in list(self.sessions):
+            remaining = []
+            for s in self.sessions[key]:
+                if s[1] + self.gap <= t:
+                    slot_arr = np.asarray([s[2]], dtype=np.int64)
+                    gathered = self.acc.gather(slot_arr)
+                    self.acc.reset_slots(slot_arr)
+                    self.dir.free.append(int(s[2]))
+                    agg_cols = self.acc.finalize(gathered)
+                    out = self._build_output([key], agg_cols, s[0], s[1] + self.gap)
+                    await collector.collect(out)
+                else:
+                    remaining.append(s)
+            if remaining:
+                self.sessions[key] = remaining
+            else:
+                del self.sessions[key]
+        return watermark
+
+    def _ensure_capacity(self):
+        need = self.dir.next_slot + 1
+        if need > self.acc.capacity - 1:
+            self.acc.grow(need + 1)
+
+
+@register_operator(OperatorName.TUMBLING_WINDOW_AGGREGATE)
+def _make_tumbling(config: dict) -> Operator:
+    return TumblingWindowOperator(config)
+
+
+@register_operator(OperatorName.SLIDING_WINDOW_AGGREGATE)
+def _make_sliding(config: dict) -> Operator:
+    return SlidingWindowOperator(config)
+
+
+@register_operator(OperatorName.SESSION_WINDOW_AGGREGATE)
+def _make_session(config: dict) -> Operator:
+    return SessionWindowOperator(config)

@@ -1,0 +1,15 @@
+"""TPU compute kernels (jax/XLA/pallas) — the hot data path.
+
+All device code lives here. Everything is shape-bucketed: variable-length
+batches are padded to the next bucket size so XLA compiles a bounded set of
+programs. jax is imported lazily (aggregates._get_jax) so host-only
+deployments can run numpy-backend pipelines without it; the first device
+use enables x64 (SQL semantics: COUNT/SUM(int) are 64-bit; the
+bit-identical-aggregates target requires exact integer arithmetic).
+"""
+
+from .aggregates import (  # noqa: F401
+    AggSpec,
+    Accumulator,
+    make_accumulator,
+)

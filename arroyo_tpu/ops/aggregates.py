@@ -1,0 +1,309 @@
+"""Keyed aggregate accumulators: the device-resident window state.
+
+This is the TPU-native replacement for the reference's per-bin DataFusion
+partial-aggregation streams (/root/reference/crates/arroyo-worker/src/arrow/
+tumbling_aggregating_window.rs:66-110): instead of running a CPU physical
+plan per bin, ALL (bin, key) groups share flat device arrays of accumulator
+slots, updated with one jitted scatter-reduce per batch and drained with one
+gather per watermark. Slot assignment (the "hash table") stays host-side in
+round 1 — a python dict over unique (bin, key) pairs, O(unique) per batch —
+while the O(rows) arithmetic runs on device.
+
+Shape discipline: `slots`/value arrays are padded to bucket sizes
+(config.tpu.shape_buckets) so XLA compiles O(buckets × capacities) programs,
+not one per batch size. Padded rows scatter neutral elements into a
+reserved scratch slot.
+
+Supported aggregate kinds: count, sum, min, max, avg (each decomposes into
+"physical" accumulators: add/min/max over a column or the constant 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import config
+
+# jax import deferred so host-only deployments can import the module tree
+_jax = None
+
+
+def _get_jax():
+    global _jax
+    if _jax is None:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _jax = jax
+    return _jax
+
+
+INT_MIN = np.iinfo(np.int64).min
+INT_MAX = np.iinfo(np.int64).max
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    kind: str  # count | sum | min | max | avg
+    col: Optional[int]  # input column index (None for count(*))
+    name: str  # output field name
+    is_float: bool = False  # input/output numeric class
+
+    def phys(self) -> List[Tuple[str, str, str]]:
+        """[(op, dtype, source)]: op in add|min|max, dtype i8|f8,
+        source col|one."""
+        if self.kind == "count":
+            return [("add", "i8", "one")]
+        d = "f8" if self.is_float else "i8"
+        if self.kind == "sum":
+            return [("add", d, "col")]
+        if self.kind == "min":
+            return [("min", d, "col")]
+        if self.kind == "max":
+            return [("max", d, "col")]
+        if self.kind == "avg":
+            return [("add", "f8", "col"), ("add", "i8", "one")]
+        raise ValueError(f"unknown aggregate {self.kind}")
+
+
+def _neutral(op: str, dtype: str):
+    if op == "add":
+        return 0
+    if op == "min":
+        return np.inf if dtype == "f8" else INT_MAX
+    return -np.inf if dtype == "f8" else INT_MIN
+
+
+def _np_dtype(d: str):
+    return np.float64 if d == "f8" else np.int64
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(2 ** np.ceil(np.log2(max(n, 1))))
+
+
+class Accumulator:
+    """Flat slot-indexed accumulator state shared by all (bin, key) groups of
+    one window-operator subtask. Backend 'jax' (device) or 'numpy' (host)."""
+
+    def __init__(self, specs: List[AggSpec], capacity: int = 4096,
+                 backend: str = "jax"):
+        self.specs = specs
+        self.backend = backend
+        self.capacity = capacity  # last slot is scratch for padded rows
+        self.phys: List[Tuple[str, str, str, int]] = []  # op,dtype,src,spec_idx
+        for si, spec in enumerate(specs):
+            for op, dtype, src in spec.phys():
+                self.phys.append((op, dtype, src, si))
+        self._buckets = tuple(config().tpu.shape_buckets)
+        if backend == "jax":
+            jnp = _get_jax().numpy
+            self.state = [
+                jnp.full(capacity, _neutral(op, dt), dtype=_np_dtype(dt))
+                for op, dt, _, _ in self.phys
+            ]
+            self._update_fn = self._make_update_fn()
+            self._gather_fn = self._make_gather_fn()
+        else:
+            self.state = [
+                np.full(capacity, _neutral(op, dt), dtype=_np_dtype(dt))
+                for op, dt, _, _ in self.phys
+            ]
+
+    # -- capacity -----------------------------------------------------------
+
+    def grow(self, min_capacity: int):
+        new_cap = self.capacity
+        while new_cap < min_capacity:
+            new_cap *= 2
+        if new_cap == self.capacity:
+            return
+        if self.backend == "jax":
+            jnp = _get_jax().numpy
+            self.state = [
+                jnp.concatenate(
+                    [s, jnp.full(new_cap - self.capacity,
+                                 _neutral(op, dt), dtype=_np_dtype(dt))]
+                )
+                for s, (op, dt, _, _) in zip(self.state, self.phys)
+            ]
+        else:
+            self.state = [
+                np.concatenate(
+                    [s, np.full(new_cap - self.capacity,
+                                _neutral(op, dt), dtype=_np_dtype(dt))]
+                )
+                for s, (op, dt, _, _) in zip(self.state, self.phys)
+            ]
+        self.capacity = new_cap
+
+    # -- update (hot path) --------------------------------------------------
+
+    def update(self, slots: np.ndarray, cols: Dict[int, np.ndarray]):
+        """Scatter-reduce a batch. slots[i] = accumulator slot of row i
+        (must be < capacity-1; capacity-1 is scratch). cols maps input column
+        index -> numpy array of row values."""
+        n = len(slots)
+        if n == 0:
+            return
+        if self.backend == "numpy":
+            self._np_update(slots, cols)
+            return
+        jnp = _get_jax().numpy
+        padded = _bucket(n, self._buckets)
+        slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        slots_p[:n] = slots
+        valid = np.zeros(padded, dtype=np.int64)
+        valid[:n] = 1
+        inputs = []
+        for op, dt, src, si in self.phys:
+            spec = self.specs[si]
+            if src == "one":
+                vals = valid
+            else:
+                vals = np.zeros(padded, dtype=_np_dtype(dt))
+                vals[:n] = cols[spec.col]
+                if op != "add":
+                    vals[n:] = _neutral(op, dt)
+            inputs.append(jnp.asarray(vals))
+        self.state = self._update_fn(self.state, jnp.asarray(slots_p), *inputs)
+
+    def _make_update_fn(self):
+        jax = _get_jax()
+        phys = list(self.phys)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(state, slots, *vals):
+            out = []
+            for (op, dt, src, si), s, v in zip(phys, state, vals):
+                if op == "add":
+                    out.append(s.at[slots].add(v))
+                elif op == "min":
+                    out.append(s.at[slots].min(v))
+                else:
+                    out.append(s.at[slots].max(v))
+            return out
+
+        return update
+
+    def _np_update(self, slots, cols):
+        for (op, dt, src, si), s in zip(self.phys, self.state):
+            spec = self.specs[si]
+            if src == "one":
+                vals = np.ones(len(slots), dtype=np.int64)
+            else:
+                vals = cols[spec.col].astype(_np_dtype(dt), copy=False)
+            if op == "add":
+                np.add.at(s, slots, vals)
+            elif op == "min":
+                np.minimum.at(s, slots, vals)
+            else:
+                np.maximum.at(s, slots, vals)
+
+    # -- drain --------------------------------------------------------------
+
+    def gather(self, slots: np.ndarray) -> List[np.ndarray]:
+        """Read accumulator values for `slots` (emission); returns one numpy
+        array per physical accumulator."""
+        if len(slots) == 0:
+            return [np.empty(0, dtype=s.dtype) for s in
+                    (self.state if self.backend == "numpy" else self.state)]
+        if self.backend == "numpy":
+            return [s[slots] for s in self.state]
+        jnp = _get_jax().numpy
+        padded = _bucket(len(slots), self._buckets)
+        slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        slots_p[: len(slots)] = slots
+        outs = self._gather_fn(self.state, jnp.asarray(slots_p))
+        return [np.asarray(o)[: len(slots)] for o in outs]
+
+    def _make_gather_fn(self):
+        jax = _get_jax()
+
+        @jax.jit
+        def gather(state, slots):
+            return [s[slots] for s in state]
+
+        return gather
+
+    def reset_slots(self, slots: np.ndarray):
+        """Return emitted slots to neutral so they can be reused."""
+        if len(slots) == 0:
+            return
+        if self.backend == "numpy":
+            for (op, dt, _, _), s in zip(self.phys, self.state):
+                s[slots] = _neutral(op, dt)
+            return
+        jnp = _get_jax().numpy
+        padded = _bucket(len(slots), self._buckets)
+        slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        slots_p[: len(slots)] = slots
+        if not hasattr(self, "_reset_fn"):
+            jax = _get_jax()
+            phys = list(self.phys)
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def reset(state, s_idx):
+                out = []
+                for (op, dt, _, _), s in zip(phys, state):
+                    out.append(s.at[s_idx].set(_neutral(op, dt)))
+                return out
+
+            self._reset_fn = reset
+        self.state = self._reset_fn(self.state, jnp.asarray(slots_p))
+
+    # -- finalize -----------------------------------------------------------
+
+    def finalize(self, gathered: List[np.ndarray]) -> List[np.ndarray]:
+        """Physical accumulator values -> one output column per spec."""
+        out = []
+        pi = 0
+        for spec in self.specs:
+            n_phys = len(spec.phys())
+            vals = gathered[pi: pi + n_phys]
+            pi += n_phys
+            if spec.kind == "avg":
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out.append(vals[0] / np.maximum(vals[1], 1))
+            else:
+                out.append(vals[0])
+        return out
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def snapshot(self, slots: np.ndarray) -> List[np.ndarray]:
+        """Device->host copy of live slots for checkpointing."""
+        return self.gather(slots)
+
+    def restore(self, slots: np.ndarray, values: List[np.ndarray]):
+        """Write physical accumulator values back into `slots`."""
+        if len(slots) == 0:
+            return
+        if self.backend == "numpy":
+            for s, v in zip(self.state, values):
+                s[slots] = v
+            return
+        jnp = _get_jax().numpy
+        self.state = [
+            s.at[jnp.asarray(slots)].set(jnp.asarray(v))
+            for s, v in zip(self.state, values)
+        ]
+
+    def block_until_ready(self):
+        if self.backend == "jax":
+            for s in self.state:
+                s.block_until_ready()
+
+
+def make_accumulator(specs: List[AggSpec], capacity: int = 4096,
+                     backend: Optional[str] = None) -> Accumulator:
+    if backend is None:
+        backend = "jax" if config().tpu.enabled else "numpy"
+    return Accumulator(specs, capacity, backend)

@@ -1,0 +1,128 @@
+"""Host-side slot directory: maps (bin, key) groups to accumulator slots.
+
+This is the "hash table on TPU" compromise documented in SURVEY.md §7:
+slot assignment is a host dict over the *unique* (bin, key) pairs of each
+batch (vectorized uniquing via numpy), while the O(rows) arithmetic runs on
+device. A pallas open-addressing kernel can replace this later without
+changing the operator contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SlotDirectory:
+    def __init__(self, scratch_slot_reserved: bool = True):
+        self.by_bin: Dict[int, Dict[tuple, int]] = {}
+        self.free: List[int] = []
+        self.next_slot = 0
+        self.n_live = 0
+
+    def required_capacity(self) -> int:
+        # +1 for the scratch slot used by shape padding
+        return self.next_slot + 1
+
+    def assign(
+        self, bins: np.ndarray, key_cols: List[np.ndarray]
+    ) -> np.ndarray:
+        """Vectorized slot assignment for a batch. Returns slots[i] per row;
+        allocates new slots for unseen (bin, key) pairs."""
+        n = len(bins)
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        uniq, inverse = _unique_pairs(bins, key_cols)
+        slot_of_unique = np.empty(len(uniq), dtype=np.int64)
+        for u, row in enumerate(uniq):
+            b = int(row[0])
+            key = tuple(row[1:])
+            bin_map = self.by_bin.setdefault(b, {})
+            slot = bin_map.get(key)
+            if slot is None:
+                slot = self.free.pop() if self.free else self._alloc()
+                bin_map[key] = slot
+                self.n_live += 1
+            slot_of_unique[u] = slot
+        return slot_of_unique[inverse]
+
+    def _alloc(self) -> int:
+        s = self.next_slot
+        self.next_slot += 1
+        return s
+
+    def bins_up_to(self, bin_exclusive: int) -> List[int]:
+        return sorted(b for b in self.by_bin if b < bin_exclusive)
+
+    def live_bins(self) -> List[int]:
+        return sorted(self.by_bin)
+
+    def peek_bin(self, b: int) -> Optional[Dict[tuple, int]]:
+        return self.by_bin.get(b)
+
+    def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+        """Remove a bin for emission: returns (keys, slots) and frees the
+        slots (caller must reset accumulator slots before reuse)."""
+        bin_map = self.by_bin.pop(b, {})
+        keys = list(bin_map.keys())
+        slots = np.fromiter(bin_map.values(), dtype=np.int64, count=len(bin_map))
+        self.free.extend(int(s) for s in slots)
+        self.n_live -= len(bin_map)
+        return keys, slots
+
+    def items(self):
+        for b, bin_map in self.by_bin.items():
+            for key, slot in bin_map.items():
+                yield b, key, slot
+
+
+def _unique_pairs(
+    bins: np.ndarray, key_cols: List[np.ndarray]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Unique (bin, *keys) rows + inverse mapping. Fast path stacks numeric
+    columns into one int64/struct matrix; object columns fall back to pandas
+    factorize per column."""
+    cols = [np.asarray(bins)]
+    for c in key_cols:
+        c = np.asarray(c)
+        if c.dtype.kind == "M":
+            c = c.view("i8")
+        if c.dtype == np.uint64:
+            # bit-preserving: values >= 2^63 become negative codes; window
+            # emission normalizes back mod 2^64
+            c = c.view(np.int64)
+        if c.dtype.kind not in "iub":
+            c = _factorize_to_codes(c, cols)
+            cols.append(c)
+        else:
+            cols.append(c.astype(np.int64, copy=False))
+    mat = np.stack([c.astype(np.int64, copy=False) for c in cols], axis=1)
+    uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+    return uniq, inverse.ravel()
+
+
+# object-key interning: codes are only used within one assign() call for
+# uniquing; the directory's tuples store the *codes*... that would break
+# cross-batch identity, so we intern values globally instead.
+_INTERN: Dict[object, int] = {}
+_INTERN_REV: List[object] = []
+
+
+def intern_value(v) -> int:
+    code = _INTERN.get(v)
+    if code is None:
+        code = len(_INTERN_REV)
+        _INTERN[v] = code
+        _INTERN_REV.append(v)
+    return code
+
+
+def unintern_value(code: int):
+    return _INTERN_REV[code]
+
+
+def _factorize_to_codes(col: np.ndarray, _cols) -> np.ndarray:
+    return np.fromiter(
+        (intern_value(v) for v in col), dtype=np.int64, count=len(col)
+    )

@@ -1,0 +1,1 @@
+from .table_config import TableConfig, global_table, time_key_table  # noqa: F401

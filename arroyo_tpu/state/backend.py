@@ -1,0 +1,198 @@
+"""StateBackend — the engine-facing checkpoint store.
+
+Capability parity with the reference's ParquetBackend + checkpoint metadata
+flow (/root/reference/crates/arroyo-state/src/parquet.rs:25-171 and
+arroyo-worker/src/job_controller/checkpoint_state.rs): owns the storage
+provider + protocol paths, writes per-(node, op, table, subtask) data files,
+assembles/publishes the epoch manifest from subtask reports, resolves
+restore manifests, compacts small per-epoch files, and retires old epochs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+from ..utils.logging import get_logger
+from . import protocol
+from .protocol import ProtocolPaths
+from .storage import StorageProvider
+
+logger = get_logger("state")
+
+
+class StateBackend:
+    def __init__(self, storage_url: str, job_id: str):
+        self.storage = StorageProvider(storage_url)
+        self.paths = ProtocolPaths(job_id)
+        self.job_id = job_id
+        self.generation: Optional[int] = None
+        self.restore_manifest: Optional[Dict[str, Any]] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def initialize(self, restore_epoch: Optional[int] = None) -> "StateBackend":
+        """Claim a generation; resolve the restore manifest (latest durable
+        checkpoint, or a specific epoch)."""
+        self.generation = protocol.initialize_generation(self.storage, self.paths)
+        if restore_epoch is not None:
+            self.restore_manifest = protocol.load_manifest(
+                self.storage, self.paths, restore_epoch
+            )
+            if self.restore_manifest is None:
+                raise ValueError(f"no checkpoint manifest for epoch {restore_epoch}")
+        else:
+            self.restore_manifest = protocol.resolve_latest(self.storage, self.paths)
+        return self
+
+    @property
+    def restore_epoch(self) -> Optional[int]:
+        return self.restore_manifest["epoch"] if self.restore_manifest else None
+
+    # -- data files ---------------------------------------------------------
+
+    def write_global_blob(self, epoch: int, node_id: int, op_idx: int,
+                          table: str, subtask: int, blob: bytes) -> str:
+        path = self.paths.data_file(epoch, node_id, op_idx, table, subtask, "bin")
+        self.storage.put(path, blob)
+        return path
+
+    def write_time_key_file(self, epoch: int, node_id: int, op_idx: int,
+                            table: str, subtask: int,
+                            data: pa.Table) -> Dict[str, Any]:
+        path = self.paths.data_file(
+            epoch, node_id, op_idx, table, subtask, "parquet"
+        )
+        size = self.storage.write_parquet(path, data)
+        ts_col = data.column("_timestamp").cast(pa.int64())
+        import pyarrow.compute as pc
+
+        return {
+            "path": path,
+            "bytes": size,
+            "rows": data.num_rows,
+            "min_ts": pc.min(ts_col).as_py() or 0,
+            "max_ts": pc.max(ts_col).as_py() or 0,
+        }
+
+    def read_blob(self, path: str) -> Optional[bytes]:
+        return self.storage.get(path)
+
+    def read_parquet(self, path: str):
+        return self.storage.read_parquet(path)
+
+    # -- manifest assembly --------------------------------------------------
+
+    def publish_checkpoint(
+        self,
+        epoch: int,
+        task_reports: Dict[str, Any],  # task_id -> CheckpointCompletedResp
+    ) -> Dict[str, Any]:
+        tasks = {}
+        committing: Dict[str, Any] = {}
+        watermarks = {}
+        for task_id, resp in task_reports.items():
+            tasks[task_id] = {
+                "node_id": resp.node_id,
+                "subtask": resp.subtask_index,
+                "op_tables": resp.subtask_metadata,
+            }
+            watermarks[task_id] = resp.watermark
+            if getattr(resp, "commit_data", None):
+                cd = resp.commit_data
+                if isinstance(cd, bytes):
+                    cd = {"__hex__": cd.hex()}
+                committing.setdefault(str(resp.node_id), {})[
+                    str(resp.subtask_index)
+                ] = cd
+        manifest = {
+            "job_id": self.job_id,
+            "tasks": tasks,
+            "watermarks": watermarks,
+            "committing": committing,
+            "created_at": time.time(),
+        }
+        protocol.publish_checkpoint(
+            self.storage, self.paths, self.generation, epoch, manifest
+        )
+        if committing:
+            protocol.prepare_commit(
+                self.storage, self.paths, self.generation, epoch, committing
+            )
+        return manifest
+
+    def claim_commit(self, epoch: int) -> bool:
+        return protocol.claim_commit(
+            self.storage, self.paths, self.generation, epoch
+        )
+
+    def latest_manifest(self) -> Optional[Dict[str, Any]]:
+        return protocol.resolve_latest(self.storage, self.paths)
+
+    # -- restore lookups ----------------------------------------------------
+
+    def tables_for(
+        self, node_id: int, op_idx: int
+    ) -> List[Dict[str, Any]]:
+        """All subtasks' table metadata for (node, op) in the restore
+        manifest: [{subtask, tables: {name: meta}}]."""
+        if not self.restore_manifest:
+            return []
+        out = []
+        for task in self.restore_manifest["tasks"].values():
+            if task["node_id"] != node_id:
+                continue
+            op_tables = task["op_tables"].get(f"op{op_idx}")
+            if op_tables:
+                out.append({"subtask": task["subtask"], "tables": op_tables})
+        return out
+
+    def restore_watermark(self, task_id: str) -> Optional[int]:
+        if not self.restore_manifest:
+            return None
+        return self.restore_manifest["watermarks"].get(task_id)
+
+    # -- compaction ---------------------------------------------------------
+
+    def compact_time_key_files(
+        self, epoch: int, node_id: int, op_idx: int, table: str,
+        files: List[dict],
+    ) -> Optional[dict]:
+        """Merge small per-epoch parquet files into one (reference
+        parquet.rs:171 compact_operator). Returns the new file's metadata;
+        old files stay until their manifests are GC'd."""
+        if len(files) < 2:
+            return None
+        tables = []
+        for f in files:
+            t = self.storage.read_parquet(f["path"])
+            if t is not None:
+                tables.append(t)
+        if not tables:
+            return None
+        merged = pa.concat_tables(tables, promote_options="default")
+        path = self.paths.compacted_file(epoch, node_id, op_idx, table)
+        size = self.storage.write_parquet(path, merged)
+        return {
+            "path": path,
+            "bytes": size,
+            "rows": merged.num_rows,
+            "min_ts": min(f["min_ts"] for f in files),
+            "max_ts": max(f["max_ts"] for f in files),
+        }
+
+    def cleanup(self, min_epoch: int):
+        known = []
+        for key in self.storage.list(f"{self.job_id}/checkpoints"):
+            parts = key.split("/")
+            for p in parts:
+                if p.startswith("checkpoint-"):
+                    try:
+                        known.append(int(p.split("-")[1]))
+                    except ValueError:
+                        pass
+        protocol.cleanup_checkpoints(
+            self.storage, self.paths, min_epoch, sorted(set(known))
+        )

@@ -1,0 +1,205 @@
+"""Generation/epoch checkpoint protocol: fencing, manifests, 2PC records.
+
+Capability parity with the reference's arroyo-state-protocol crate
+(/root/reference/crates/arroyo-state-protocol/src/workflow.rs): a new
+*generation* is initialized each time a job (re)starts its controller
+(:223 initialize_generation) — generation files are CAS-created so exactly
+one writer owns a generation; checkpoint manifests are CAS-published
+(:527 publish_checkpoint) under the owning generation, so a zombie
+controller from an older generation cannot publish after failover;
+sink commits are authorized by per-epoch records (:428 prepare_commit,
+:495 complete_commit) so a 2PC commit happens exactly once even across
+controller failover. Path layout mirrors ProtocolPaths (lib.rs:22-70).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .storage import CasConflict, StorageProvider
+
+
+class Fenced(Exception):
+    """The caller's generation is no longer current."""
+
+
+class ProtocolPaths:
+    def __init__(self, job_id: str):
+        self.job_id = job_id
+
+    @property
+    def current_generation(self) -> str:
+        return f"{self.job_id}/current-generation.json"
+
+    def generation(self, gen: int) -> str:
+        return f"{self.job_id}/generations/gen-{gen:05d}.json"
+
+    def checkpoint_dir(self, epoch: int) -> str:
+        return f"{self.job_id}/checkpoints/checkpoint-{epoch:07d}"
+
+    def manifest(self, epoch: int) -> str:
+        return f"{self.checkpoint_dir(epoch)}/checkpoint-manifest.json"
+
+    def data_file(
+        self, epoch: int, node_id: int, op_idx: int, table: str,
+        subtask: int, ext: str,
+    ) -> str:
+        return (
+            f"{self.checkpoint_dir(epoch)}/data/"
+            f"{node_id:03d}-{op_idx}-{table}-{subtask:03d}.{ext}"
+        )
+
+    def compacted_file(self, epoch: int, node_id: int, op_idx: int,
+                       table: str) -> str:
+        return (
+            f"{self.job_id}/compacted/"
+            f"{node_id:03d}-{op_idx}-{table}-epoch{epoch:07d}-"
+            f"{uuid.uuid4().hex[:8]}.parquet"
+        )
+
+    @property
+    def latest(self) -> str:
+        return f"{self.job_id}/latest.json"
+
+    def commit_pending(self, epoch: int) -> str:
+        return f"{self.job_id}/commits/epoch-{epoch:07d}-pending.json"
+
+    def commit_done(self, epoch: int) -> str:
+        return f"{self.job_id}/commits/epoch-{epoch:07d}-done.json"
+
+
+# -- generations ------------------------------------------------------------
+
+
+def initialize_generation(storage: StorageProvider, paths: ProtocolPaths) -> int:
+    """Claim the next generation; the CAS-created generation file is the
+    fencing token (reference workflow.rs:223)."""
+    cur = read_json(storage, paths.current_generation)
+    gen = (cur["generation"] if cur else 0) + 1
+    while True:
+        try:
+            storage.put_if_not_exists(
+                paths.generation(gen),
+                _enc({"generation": gen, "claimed_at": time.time()}),
+            )
+            break
+        except CasConflict:
+            gen += 1  # another controller raced us; take the next slot
+    storage.put(paths.current_generation, _enc({"generation": gen}))
+    return gen
+
+
+def check_current(storage: StorageProvider, paths: ProtocolPaths, gen: int):
+    cur = read_json(storage, paths.current_generation)
+    if cur is None or cur["generation"] != gen:
+        raise Fenced(f"generation {gen} superseded by {cur}")
+
+
+# -- checkpoints ------------------------------------------------------------
+
+
+def publish_checkpoint(
+    storage: StorageProvider,
+    paths: ProtocolPaths,
+    gen: int,
+    epoch: int,
+    manifest: Dict[str, Any],
+):
+    """CAS-publish a checkpoint manifest under the owning generation
+    (reference workflow.rs:527). Raises Fenced for zombie writers."""
+    check_current(storage, paths, gen)
+    manifest = {**manifest, "epoch": epoch, "generation": gen,
+                "published_at": time.time()}
+    try:
+        storage.put_if_not_exists(paths.manifest(epoch), _enc(manifest))
+    except CasConflict:
+        existing = read_json(storage, paths.manifest(epoch))
+        if existing and existing.get("generation") == gen:
+            return  # idempotent re-publish by the same generation
+        raise Fenced(f"epoch {epoch} already published by {existing}")
+    check_current(storage, paths, gen)  # re-check: fence the slow path
+    storage.put(paths.latest, _enc({"epoch": epoch, "generation": gen}))
+
+
+def resolve_latest(
+    storage: StorageProvider, paths: ProtocolPaths
+) -> Optional[Dict[str, Any]]:
+    latest = read_json(storage, paths.latest)
+    if latest is None:
+        return None
+    return read_json(storage, paths.manifest(latest["epoch"]))
+
+
+def load_manifest(
+    storage: StorageProvider, paths: ProtocolPaths, epoch: int
+) -> Optional[Dict[str, Any]]:
+    return read_json(storage, paths.manifest(epoch))
+
+
+def cleanup_checkpoints(
+    storage: StorageProvider, paths: ProtocolPaths, min_epoch: int,
+    known_epochs: List[int],
+):
+    """Drop checkpoints older than min_epoch (reference gc.rs:19). Files
+    referenced by newer manifests live outside the deleted dirs (compacted/
+    or newer epochs' data dirs) except carried-forward incremental files —
+    so only epochs whose data is no longer referenced may be passed here."""
+    for e in known_epochs:
+        if e < min_epoch:
+            storage.delete_directory(paths.checkpoint_dir(e))
+
+
+# -- 2PC commit records -----------------------------------------------------
+
+
+def prepare_commit(
+    storage: StorageProvider, paths: ProtocolPaths, gen: int, epoch: int,
+    committing: Dict[str, Any],
+):
+    """Record intent-to-commit (reference workflow.rs:428)."""
+    check_current(storage, paths, gen)
+    try:
+        storage.put_if_not_exists(
+            paths.commit_pending(epoch),
+            _enc({"epoch": epoch, "generation": gen, "committing": committing}),
+        )
+    except CasConflict:
+        pass  # already prepared (recovery replays are fine pre-commit)
+
+
+def claim_commit(
+    storage: StorageProvider, paths: ProtocolPaths, gen: int, epoch: int
+) -> bool:
+    """Exactly-once commit authorization (reference claim_epoch_record
+    workflow.rs:829): returns True iff this caller owns the commit."""
+    try:
+        storage.put_if_not_exists(
+            paths.commit_done(epoch),
+            _enc({"epoch": epoch, "generation": gen, "committed_at": time.time()}),
+        )
+        return True
+    except CasConflict:
+        return False
+
+
+def pending_commit(
+    storage: StorageProvider, paths: ProtocolPaths, epoch: int
+) -> Optional[Dict[str, Any]]:
+    if storage.get(paths.commit_done(epoch)) is not None:
+        return None  # already committed
+    return read_json(storage, paths.commit_pending(epoch))
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def read_json(storage: StorageProvider, key: str) -> Optional[dict]:
+    data = storage.get(key)
+    return None if data is None else json.loads(data)
+
+
+def _enc(obj: dict) -> bytes:
+    return json.dumps(obj).encode()

@@ -1,0 +1,50 @@
+"""State table descriptors, declared by operators via Operator.tables().
+
+Capability parity with the reference's table config protos
+(/root/reference/crates/arroyo-rpc/proto/rpc.proto checkpoint metadata +
+arroyo-state/src/tables): two table kinds —
+  * global: small bincode-style KV replicated to all subtasks on restore
+    (reference GlobalKeyedTable, tables/global_keyed_map.rs:47)
+  * expiring_time_key: RecordBatch rows bucketed by time with a retention,
+    key-range filtered on restore (reference ExpiringTimeKeyTable,
+    tables/expiring_time_key_map.rs:53)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TableConfig:
+    name: str
+    kind: str  # "global" | "expiring_time_key"
+    retention_nanos: Optional[int] = None  # expiring tables only
+    # schema of stored batches (expiring tables); None = same as input edge
+    schema: object = None
+    # which column holds the bucketing timestamp (defaults to _timestamp)
+    timestamp_field: str = "_timestamp"
+    # key columns used for key-range filtering on restore
+    key_fields: tuple = ()
+
+
+def global_table(name: str) -> TableConfig:
+    return TableConfig(name=name, kind="global")
+
+
+def time_key_table(
+    name: str,
+    retention_nanos: Optional[int] = None,
+    schema=None,
+    timestamp_field: str = "_timestamp",
+    key_fields: tuple = (),
+) -> TableConfig:
+    return TableConfig(
+        name=name,
+        kind="expiring_time_key",
+        retention_nanos=retention_nanos,
+        schema=schema,
+        timestamp_field=timestamp_field,
+        key_fields=key_fields,
+    )

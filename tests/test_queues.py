@@ -1,0 +1,76 @@
+import asyncio
+
+import numpy as np
+import pyarrow as pa
+
+from arroyo_tpu.operators.queues import BatchQueue, QueueClosed
+from arroyo_tpu.types import SignalMessage
+
+
+def make_batch(n=10):
+    return pa.RecordBatch.from_arrays([pa.array(np.arange(n))], names=["x"])
+
+
+def test_queue_backpressure_on_count():
+    async def run():
+        q = BatchQueue(max_batches=2, max_bytes=1 << 30)
+        await q.send(make_batch())
+        await q.send(make_batch())
+        send3 = asyncio.ensure_future(q.send(make_batch()))
+        await asyncio.sleep(0.01)
+        assert not send3.done()  # blocked at capacity
+        await q.recv()
+        await asyncio.sleep(0.01)
+        assert send3.done()
+
+    asyncio.run(run())
+
+
+def test_queue_backpressure_on_bytes():
+    async def run():
+        q = BatchQueue(max_batches=100, max_bytes=100)
+        big = make_batch(1000)  # 8KB > 100 bytes
+        await q.send(big)  # first send always admitted
+        send2 = asyncio.ensure_future(q.send(make_batch(1)))
+        await asyncio.sleep(0.01)
+        assert not send2.done()
+        await q.recv()
+        await asyncio.sleep(0.01)
+        assert send2.done()
+
+    asyncio.run(run())
+
+
+def test_signals_bypass_bounds():
+    async def run():
+        q = BatchQueue(max_batches=1, max_bytes=1)
+        await q.send(make_batch())
+        # queue is full but a signal must never block
+        await asyncio.wait_for(q.send(SignalMessage.stop()), timeout=1.0)
+        assert q.qsize() == 2
+
+    asyncio.run(run())
+
+
+def test_fifo_order_preserved():
+    async def run():
+        q = BatchQueue(8, 1 << 30)
+        for i in range(5):
+            await q.send(make_batch(i + 1))
+        sizes = [(await q.recv()).num_rows for _ in range(5)]
+        assert sizes == [1, 2, 3, 4, 5]
+
+    asyncio.run(run())
+
+
+def test_closed_queue_raises():
+    async def run():
+        q = BatchQueue(8, 1 << 30)
+        q.close()
+        try:
+            await q.recv()
+            assert False
+        except QueueClosed:
+            pass
+
+    asyncio.run(run())

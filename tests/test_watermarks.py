@@ -1,0 +1,37 @@
+from arroyo_tpu.operators.context import WatermarkHolder
+from arroyo_tpu.types import Watermark
+
+
+def test_min_merge_waits_for_all_inputs():
+    h = WatermarkHolder(2)
+    assert h.set(0, Watermark.event_time(100)) is None  # input 1 unseen
+    got = h.set(1, Watermark.event_time(50))
+    assert got == Watermark.event_time(50)
+
+
+def test_min_merge_advances_only_on_min_change():
+    h = WatermarkHolder(2)
+    h.set(0, Watermark.event_time(100))
+    h.set(1, Watermark.event_time(50))
+    assert h.set(0, Watermark.event_time(200)) is None  # min still 50
+    assert h.set(1, Watermark.event_time(80)) == Watermark.event_time(80)
+
+
+def test_idle_inputs_excluded_from_min():
+    h = WatermarkHolder(2)
+    h.set(0, Watermark.event_time(100))
+    got = h.set(1, Watermark.idle())
+    assert got == Watermark.event_time(100)  # idle doesn't hold back
+
+
+def test_all_idle_propagates_idle():
+    h = WatermarkHolder(2)
+    h.set(0, Watermark.idle())
+    got = h.set(1, Watermark.idle())
+    assert got is not None and got.is_idle()
+
+
+def test_single_input():
+    h = WatermarkHolder(1)
+    assert h.set(0, Watermark.event_time(5)) == Watermark.event_time(5)
+    assert h.current_nanos() == 5

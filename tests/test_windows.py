@@ -1,0 +1,232 @@
+"""Window operators end-to-end through the engine: impulse -> watermark ->
+shuffle -> window aggregate -> sink, on both backends."""
+
+import asyncio
+
+import pyarrow as pa
+import pytest
+
+from arroyo_tpu.config import update
+from arroyo_tpu.connectors.impulse import IMPULSE_SCHEMA
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.graph import EdgeType, LogicalGraph, OperatorName
+from arroyo_tpu.graph.logical import ChainedOp, LogicalNode
+from arroyo_tpu.schema import StreamSchema
+
+MS = 1_000_000  # nanos
+
+
+def window_pipeline(
+    op_name,
+    window_config,
+    aggregates,
+    out_fields,
+    n_events=10_000,
+    event_rate=1e6,  # 1 event per us
+    parallelism=1,
+    backend="numpy",
+    results=None,
+):
+    g = LogicalGraph()
+    g.add_node(
+        LogicalNode(
+            1,
+            "impulse",
+            [
+                ChainedOp(
+                    OperatorName.CONNECTOR_SOURCE,
+                    {
+                        "connector": "impulse",
+                        "event_rate": event_rate,
+                        "message_count": n_events,
+                        "start_time": 0,
+                        "schema": IMPULSE_SCHEMA,
+                    },
+                ),
+                ChainedOp(OperatorName.EXPRESSION_WATERMARK, {"interval_nanos": 0}),
+            ],
+            1,
+        )
+    )
+    out_schema = StreamSchema.from_fields(out_fields)
+    g.add_node(
+        LogicalNode.single(
+            2,
+            op_name,
+            {
+                **window_config,
+                "aggregates": aggregates,
+                "key_cols": [1],  # subtask_index
+                "schema": out_schema,
+                "backend": backend,
+            },
+            parallelism=parallelism,
+        )
+    )
+    g.add_node(
+        LogicalNode.single(
+            3,
+            OperatorName.CONNECTOR_SINK,
+            {"connector": "vec", "results": results},
+            parallelism=parallelism,
+        )
+    )
+    g.add_edge(1, 2, EdgeType.SHUFFLE, IMPULSE_SCHEMA.with_keys(["subtask_index"]))
+    g.add_edge(2, 3, EdgeType.FORWARD, out_schema)
+    return g
+
+
+def run(g):
+    async def go():
+        eng = Engine(g).start()
+        await eng.join(60)
+
+    asyncio.run(go())
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_tumbling_count_sum(backend):
+    results = []
+    # 10k events at 1/us from t=0 -> 10ms of data; 1ms windows -> 10 bins
+    g = window_pipeline(
+        OperatorName.TUMBLING_WINDOW_AGGREGATE,
+        {"width_nanos": MS, "window_start_field": "ws", "window_end_field": "we"},
+        [
+            {"kind": "count", "name": "cnt"},
+            {"kind": "sum", "col": 0, "name": "total"},
+        ],
+        [
+            ("ws", pa.int64()),
+            ("we", pa.int64()),
+            ("subtask_index", pa.uint64()),
+            ("cnt", pa.int64()),
+            ("total", pa.int64()),
+        ],
+        backend=backend,
+        results=results,
+    )
+    with update(pipeline={"source_batch_size": 512}):
+        run(g)
+    assert len(results) == 10
+    results.sort(key=lambda r: r["ws"])
+    for i, r in enumerate(results):
+        assert r["ws"] == i * MS and r["we"] == (i + 1) * MS
+        assert r["cnt"] == 1000
+        lo = i * 1000
+        assert r["total"] == sum(range(lo, lo + 1000))
+    # output timestamps sit inside the window (end - 1ns)
+    assert all(r["_timestamp"] is not None for r in results)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_sliding_window_counts(backend):
+    results = []
+    # 5ms of data; width 2ms, slide 1ms
+    g = window_pipeline(
+        OperatorName.SLIDING_WINDOW_AGGREGATE,
+        {
+            "width_nanos": 2 * MS,
+            "slide_nanos": MS,
+            "window_start_field": "ws",
+            "window_end_field": "we",
+        },
+        [{"kind": "count", "name": "cnt"}],
+        [
+            ("ws", pa.int64()),
+            ("we", pa.int64()),
+            ("subtask_index", pa.uint64()),
+            ("cnt", pa.int64()),
+        ],
+        n_events=5000,
+        backend=backend,
+        results=results,
+    )
+    run(g)
+    results.sort(key=lambda r: r["we"])
+    # windows ending at 1ms..6ms; first/last are partial
+    want = {1 * MS: 1000, 2 * MS: 2000, 3 * MS: 2000, 4 * MS: 2000,
+            5 * MS: 2000, 6 * MS: 1000}
+    got = {r["we"]: r["cnt"] for r in results}
+    assert got == want
+    for r in results:
+        assert r["we"] - r["ws"] == 2 * MS
+
+
+def test_session_windows_gap_merge():
+    """Rows at t=0..4ms (1/ms), gap at 5-9ms, rows at 10ms..12ms; session
+    gap 2ms -> two sessions per key."""
+    results = []
+
+    def sparse(batch: pa.RecordBatch):
+        import numpy as np
+
+        ts = batch.column(2).cast(pa.int64()).to_numpy()
+        keep = (ts < 5 * MS) | (ts >= 10 * MS)
+        return batch.filter(pa.array(keep))
+
+    g = window_pipeline(
+        OperatorName.SESSION_WINDOW_AGGREGATE,
+        {"gap_nanos": 2 * MS, "window_start_field": "ws",
+         "window_end_field": "we"},
+        [{"kind": "count", "name": "cnt"}],
+        [
+            ("ws", pa.int64()),
+            ("we", pa.int64()),
+            ("subtask_index", pa.uint64()),
+            ("cnt", pa.int64()),
+        ],
+        n_events=13,
+        event_rate=1000.0,  # 1 event per ms
+        results=results,
+    )
+    # inject the filter between source and window
+    g.nodes[1].chain.insert(
+        1, ChainedOp(OperatorName.ARROW_VALUE, {"py_fn": sparse})
+    )
+    run(g)
+    results.sort(key=lambda r: r["ws"])
+    assert len(results) == 2
+    s1, s2 = results
+    assert s1["cnt"] == 5 and s1["ws"] == 0 and s1["we"] == 4 * MS + 2 * MS
+    assert s2["cnt"] == 3 and s2["ws"] == 10 * MS and s2["we"] == 12 * MS + 2 * MS
+
+
+def test_tumbling_parallel_2_partitions_by_key():
+    """Two window subtasks via keyed shuffle on counter%4 (as key col)."""
+    results = []
+
+    def with_key(batch: pa.RecordBatch):
+        import pyarrow.compute as pc
+
+        k = pc.bit_wise_and(batch.column(0), 3)
+        return pa.RecordBatch.from_arrays(
+            [k, batch.column(1), batch.column(2)],
+            schema=pa.schema(
+                [
+                    pa.field("counter", pa.uint64()),
+                    batch.schema.field(1),
+                    batch.schema.field(2),
+                ]
+            ),
+        )
+
+    g = window_pipeline(
+        OperatorName.TUMBLING_WINDOW_AGGREGATE,
+        {"width_nanos": MS},
+        [{"kind": "count", "name": "cnt"}],
+        [("counter", pa.uint64()), ("cnt", pa.int64())],
+        n_events=4000,
+        parallelism=2,
+        results=results,
+    )
+    g.nodes[1].chain.insert(
+        1, ChainedOp(OperatorName.ARROW_VALUE, {"py_fn": with_key})
+    )
+    # window keys on the rewritten counter column
+    g.nodes[2].chain[0].config["key_cols"] = [0]
+    g.edges[0].schema = IMPULSE_SCHEMA.with_keys(["counter"])
+    run(g)
+    # 4ms of data -> 4 bins x 4 keys = 16 windows of 250 each
+    assert len(results) == 16
+    assert all(r["cnt"] == 250 for r in results)
+    assert sorted({r["counter"] for r in results}) == [0, 1, 2, 3]

@@ -1,0 +1,21 @@
+"""Helper for connectors whose client libraries are absent in this
+environment (no egress, no broker clients baked in): the connector surface
+(validation, planning, API metadata) works; operators raise a clear error
+when started."""
+
+from __future__ import annotations
+
+
+def require_client(*modules: str):
+    import importlib
+
+    errors = []
+    for m in modules:
+        try:
+            return importlib.import_module(m)
+        except ImportError as e:
+            errors.append(str(e))
+    raise RuntimeError(
+        f"this connector requires one of the client libraries {modules}, "
+        f"none of which is available in this environment: {errors}"
+    )

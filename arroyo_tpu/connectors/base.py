@@ -55,6 +55,11 @@ class Connector:
         """Connection test for the API's /connection_tables/test."""
         return True, "ok"
 
+    def table_schema(self) -> Optional["StreamSchema"]:
+        """Fixed schema for connectors that define their own (impulse,
+        nexmark); None when CREATE TABLE must declare columns."""
+        return None
+
     def metadata(self) -> Dict[str, Any]:
         return {
             "id": self.name,

@@ -1,1 +1,105 @@
-"""Placeholder: fluvio connector lands with the connector milestone."""
+"""Fluvio connector (reference: crates/arroyo-connectors/src/fluvio/,
+541 LoC). Client gated on the fluvio python client."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..operators.base import Operator, SourceFinishType, SourceOperator
+from ..formats.de import Deserializer
+from ..formats.ser import Serializer
+from ._gated import require_client
+from .base import ConnectionSchema, Connector, register_connector
+
+
+class FluvioSource(SourceOperator):
+    def __init__(self, endpoint: Optional[str], topic: str, schema, format,
+                 bad_data):
+        super().__init__("fluvio_source")
+        self.endpoint = endpoint
+        self.topic = topic
+        self.out_schema = schema
+        self.format = format
+        self.bad_data = bad_data
+        self.offset = 0
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"flv": global_table("flv")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("flv")
+            stored = table.get(ctx.task_info.task_index)
+            if stored is not None:
+                self.offset = stored
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("flv")
+            table.put(ctx.task_info.task_index, self.offset)
+
+    async def run(self, ctx, collector) -> SourceFinishType:
+        fluvio = require_client("fluvio")
+        deser = Deserializer(self.out_schema, format=self.format or "json",
+                             bad_data=self.bad_data)
+        client = fluvio.Fluvio.connect()
+        consumer = client.partition_consumer(
+            self.topic, ctx.task_info.task_index
+        )
+        for record in consumer.stream(fluvio.Offset.absolute(self.offset)):
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                return finish
+            for row in deser.deserialize_slice(
+                bytes(record.value()), error_reporter=ctx.error_reporter
+            ):
+                ctx.buffer_row(row)
+            self.offset = record.offset() + 1
+            if ctx.should_flush():
+                await self.flush_buffer(ctx, collector)
+        return SourceFinishType.FINAL
+
+
+class FluvioSink(Operator):
+    def __init__(self, endpoint: Optional[str], topic: str, format):
+        super().__init__("fluvio_sink")
+        self.endpoint = endpoint
+        self.topic = topic
+        self.serializer = Serializer(format=format or "json")
+        self.producer = None
+
+    async def on_start(self, ctx):
+        fluvio = require_client("fluvio")
+        self.producer = fluvio.Fluvio.connect().topic_producer(self.topic)
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        for rec in self.serializer.serialize(batch):
+            self.producer.send(b"", rec)
+
+
+@register_connector
+class FluvioConnector(Connector):
+    name = "fluvio"
+    description = "Fluvio source and sink"
+    source = True
+    sink = True
+    config_schema = {
+        "endpoint": {"type": "string"},
+        "topic": {"type": "string", "required": True},
+    }
+
+    def validate_options(self, options, schema):
+        if "topic" not in options:
+            raise ValueError("fluvio requires a topic option")
+        return {"endpoint": options.get("endpoint"), "topic": options["topic"]}
+
+    def make_source(self, config, schema: ConnectionSchema):
+        return FluvioSource(config.get("endpoint"), config["topic"],
+                            config.get("schema"), config.get("format"),
+                            config.get("bad_data", "fail"))
+
+    def make_sink(self, config, schema: ConnectionSchema):
+        return FluvioSink(config.get("endpoint"), config["topic"],
+                          config.get("format"))

@@ -111,6 +111,9 @@ class ImpulseConnector(Connector):
             out["start_time"] = int(options["start_time"])
         return out
 
+    def table_schema(self):
+        return IMPULSE_SCHEMA
+
     def make_source(self, config, schema: ConnectionSchema) -> ImpulseSource:
         return ImpulseSource(
             event_rate=config.get("event_rate", 10_000.0),

@@ -1,1 +1,312 @@
-"""Placeholder: nexmark connector lands with the connector milestone."""
+"""Nexmark benchmark generator source.
+
+Capability parity with the reference's nexmark connector
+(/root/reference/crates/arroyo-connectors/src/nexmark/, 1,190 LoC), which
+implements the standard Nexmark generator (Apache Beam lineage): one table
+with nullable person/auction/bid struct columns, event kinds interleaved at
+the canonical 1:3:46 proportions per 50-event epoch, rate-controlled
+(`event_rate` events/sec, optional bound via `message_count` or
+`event_rate * runtime`). IDs are deterministic functions of the event
+sequence number so runs are reproducible; bids skew toward recent ("hot")
+auctions and people as in the standard generator.
+
+This is a fresh implementation of the public Nexmark semantics, not a
+translation of the reference's code.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ..operators.base import SourceFinishType, SourceOperator
+from ..schema import StreamSchema
+from ..types import now_nanos
+from .base import ConnectionSchema, Connector, register_connector
+
+PERSON_T = pa.struct(
+    [
+        ("id", pa.int64()),
+        ("name", pa.string()),
+        ("email_address", pa.string()),
+        ("credit_card", pa.string()),
+        ("city", pa.string()),
+        ("state", pa.string()),
+        ("datetime", pa.timestamp("ns")),
+        ("extra", pa.string()),
+    ]
+)
+AUCTION_T = pa.struct(
+    [
+        ("id", pa.int64()),
+        ("item_name", pa.string()),
+        ("description", pa.string()),
+        ("initial_bid", pa.int64()),
+        ("reserve", pa.int64()),
+        ("datetime", pa.timestamp("ns")),
+        ("expires", pa.timestamp("ns")),
+        ("seller", pa.int64()),
+        ("category", pa.int64()),
+        ("extra", pa.string()),
+    ]
+)
+BID_T = pa.struct(
+    [
+        ("auction", pa.int64()),
+        ("bidder", pa.int64()),
+        ("price", pa.int64()),
+        ("channel", pa.string()),
+        ("url", pa.string()),
+        ("datetime", pa.timestamp("ns")),
+        ("extra", pa.string()),
+    ]
+)
+
+NEXMARK_SCHEMA = StreamSchema.from_fields(
+    [("person", PERSON_T), ("auction", AUCTION_T), ("bid", BID_T)]
+)
+
+# canonical proportions per 50-event epoch
+PERSON_PROPORTION = 1
+AUCTION_PROPORTION = 3
+PROPORTION_DENOMINATOR = 50
+FIRST_PERSON_ID = 1000
+FIRST_AUCTION_ID = 1000
+FIRST_CATEGORY_ID = 10
+NUM_CATEGORIES = 5
+HOT_AUCTION_RATIO = 2  # 1/2 of bids go to hot auctions
+HOT_SELLER_RATIO = 4
+HOT_BIDDER_RATIO = 4
+
+_STATES = ["AZ", "CA", "ID", "OR", "WA", "WY"]
+_CITIES = ["Phoenix", "Los Angeles", "San Francisco", "Boise", "Portland",
+           "Bend", "Redmond", "Seattle", "Kent", "Cheyenne"]
+_FIRST = ["Peter", "Paul", "Luke", "John", "Saul", "Vicky", "Kate", "Julie",
+          "Sarah", "Deiter", "Walter"]
+_LAST = ["Shultz", "Abrams", "Spencer", "White", "Bartels", "Walton",
+         "Smith", "Jones", "Noris"]
+_CHANNELS = ["Google", "Facebook", "Baidu", "Apple"]
+
+
+def _rng(n: int) -> np.random.Generator:
+    return np.random.default_rng(0x5EED ^ n)
+
+
+class NexmarkGenerator:
+    """Pure event generator: sequence number -> event dict."""
+
+    def __init__(self, first_event_id: int = 0):
+        self.first_event_id = first_event_id
+
+    @staticmethod
+    def kind_of(n: int) -> str:
+        r = n % PROPORTION_DENOMINATOR
+        if r < PERSON_PROPORTION:
+            return "person"
+        if r < PERSON_PROPORTION + AUCTION_PROPORTION:
+            return "auction"
+        return "bid"
+
+    @staticmethod
+    def last_person_id(n: int) -> int:
+        # inclusive of the epoch's person event (persons lead each epoch),
+        # mirroring last_auction_id's inclusive counting
+        epoch = n // PROPORTION_DENOMINATOR
+        return FIRST_PERSON_ID + epoch
+
+    @staticmethod
+    def last_auction_id(n: int) -> int:
+        epoch, offset = divmod(n, PROPORTION_DENOMINATOR)
+        done = min(max(offset - PERSON_PROPORTION + 1, 0), AUCTION_PROPORTION)
+        return FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + done - 1
+
+    def event(self, n: int, ts: int) -> dict:
+        kind = self.kind_of(n)
+        rng = _rng(n)
+        if kind == "person":
+            pid = self.last_person_id(n)
+            name = f"{_FIRST[int(rng.integers(len(_FIRST)))]} " \
+                   f"{_LAST[int(rng.integers(len(_LAST)))]}"
+            return {
+                "person": {
+                    "id": pid,
+                    "name": name,
+                    "email_address": f"{name.replace(' ', '.').lower()}@example.com",
+                    "credit_card": " ".join(
+                        f"{int(rng.integers(10000)):04d}" for _ in range(4)
+                    ),
+                    "city": _CITIES[int(rng.integers(len(_CITIES)))],
+                    "state": _STATES[int(rng.integers(len(_STATES)))],
+                    "datetime": ts,
+                    "extra": "",
+                },
+                "auction": None,
+                "bid": None,
+                "_timestamp": ts,
+            }
+        if kind == "auction":
+            aid = self.last_auction_id(n)
+            # hot sellers: most auctions come from recent people
+            if rng.integers(HOT_SELLER_RATIO):
+                seller = (self.last_person_id(n) // HOT_SELLER_RATIO) * \
+                    HOT_SELLER_RATIO
+            else:
+                seller = FIRST_PERSON_ID + int(
+                    rng.integers(max(self.last_person_id(n) - FIRST_PERSON_ID + 1, 1))
+                )
+            initial = 1 + int(rng.integers(100))
+            return {
+                "person": None,
+                "auction": {
+                    "id": aid,
+                    "item_name": f"item-{aid}",
+                    "description": f"description of item {aid}",
+                    "initial_bid": initial,
+                    "reserve": initial + int(rng.integers(100)),
+                    "datetime": ts,
+                    "expires": ts + int(rng.integers(1, 10)) * 1_000_000_000,
+                    "seller": max(seller, FIRST_PERSON_ID),
+                    "category": FIRST_CATEGORY_ID + int(
+                        rng.integers(NUM_CATEGORIES)),
+                    "extra": "",
+                },
+                "bid": None,
+                "_timestamp": ts,
+            }
+        # bid
+        last_auction = self.last_auction_id(n)
+        if rng.integers(HOT_AUCTION_RATIO):
+            auction = (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO
+        else:
+            auction = FIRST_AUCTION_ID + int(
+                rng.integers(max(last_auction - FIRST_AUCTION_ID + 1, 1))
+            )
+        last_person = self.last_person_id(n)
+        if rng.integers(HOT_BIDDER_RATIO):
+            bidder = (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1
+        else:
+            bidder = FIRST_PERSON_ID + int(
+                rng.integers(max(last_person - FIRST_PERSON_ID + 1, 1))
+            )
+        price = int(100 * (10 ** rng.random() * 2))
+        ch = int(rng.integers(len(_CHANNELS)))
+        return {
+            "person": None,
+            "auction": None,
+            "bid": {
+                "auction": max(auction, FIRST_AUCTION_ID),
+                "bidder": max(bidder, FIRST_PERSON_ID),
+                "price": price,
+                "channel": _CHANNELS[ch],
+                "url": f"https://auction.example.com/item/{auction}",
+                "datetime": ts,
+                "extra": "",
+            },
+            "_timestamp": ts,
+        }
+
+
+class NexmarkSource(SourceOperator):
+    def __init__(
+        self,
+        event_rate: float = 10_000.0,
+        message_count: Optional[int] = None,
+        runtime: Optional[float] = None,
+        start_time: Optional[int] = None,
+        realtime: bool = False,
+    ):
+        super().__init__("nexmark")
+        self.event_rate = event_rate
+        if message_count is None and runtime is not None:
+            message_count = int(event_rate * runtime)
+        self.message_count = message_count
+        self.start_time = start_time
+        self.realtime = realtime
+        self.out_schema = NEXMARK_SCHEMA
+        self.gen = NexmarkGenerator()
+        self.index = 0  # local sequence position (strided by parallelism)
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"n": global_table("n")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("n")
+            stored = table.get(ctx.task_info.task_index)
+            if stored is not None:
+                self.index = stored
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("n")
+            table.put(ctx.task_info.task_index, self.index)
+
+    async def run(self, ctx, collector) -> SourceFinishType:
+        p = ctx.task_info.parallelism
+        me = ctx.task_info.task_index
+        start = self.start_time if self.start_time is not None else now_nanos()
+        nanos_per_event = 1e9 / self.event_rate if self.event_rate > 0 else 0
+        wall_start = time.monotonic()
+        while True:
+            n = self.index * p + me  # global sequence number
+            if self.message_count is not None and n >= self.message_count:
+                break
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                return finish
+            if self.realtime:
+                target = wall_start + (self.index * p) * nanos_per_event / 1e9
+                delay = target - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                ts = now_nanos()
+            else:
+                ts = start + int(round(n * nanos_per_event))
+            ctx.buffer_row(self.gen.event(n, ts))
+            self.index += 1
+            if ctx.should_flush():
+                await self.flush_buffer(ctx, collector)
+                await asyncio.sleep(0)
+        await self.flush_buffer(ctx, collector)
+        return SourceFinishType.FINAL
+
+
+@register_connector
+class NexmarkConnector(Connector):
+    name = "nexmark"
+    description = "Nexmark benchmark event generator"
+    source = True
+    config_schema = {
+        "event_rate": {"type": "number", "required": True},
+        "runtime": {"type": "number"},
+        "message_count": {"type": "integer"},
+    }
+
+    def validate_options(self, options, schema):
+        out = {"event_rate": float(options.get("event_rate", 10_000))}
+        for k in ("message_count", "start_time"):
+            if k in options:
+                out[k] = int(options[k])
+        if "runtime" in options:
+            out["runtime"] = float(options["runtime"])
+        if "realtime" in options:
+            out["realtime"] = str(options["realtime"]).lower() == "true"
+        return out
+
+    def table_schema(self):
+        return NEXMARK_SCHEMA
+
+    def make_source(self, config, schema: ConnectionSchema):
+        return NexmarkSource(
+            event_rate=config.get("event_rate", 10_000.0),
+            message_count=config.get("message_count"),
+            runtime=config.get("runtime"),
+            start_time=config.get("start_time"),
+            realtime=config.get("realtime", False),
+        )

@@ -1,1 +1,81 @@
-"""Placeholder: webhook connector lands with the connector milestone."""
+"""Webhook sink: HTTP POST per record with retry.
+
+Capability parity with the reference's webhook connector
+(/root/reference/crates/arroyo-connectors/src/webhook/, 368 LoC).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..operators.base import Operator
+from ..formats.ser import Serializer
+from .base import ConnectionSchema, Connector, register_connector
+
+
+class WebhookSink(Operator):
+    def __init__(self, endpoint: str, headers: dict, format: str,
+                 max_retries: int = 5):
+        super().__init__("webhook_sink")
+        self.endpoint = endpoint
+        self.headers = {"Content-Type": "application/json", **headers}
+        self.serializer = Serializer(format=format or "json")
+        self.max_retries = max_retries
+        self._session = None
+
+    async def on_start(self, ctx):
+        import aiohttp
+
+        self._session = aiohttp.ClientSession()
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        import aiohttp
+
+        for rec in self.serializer.serialize(batch):
+            delay = 0.1
+            for attempt in range(self.max_retries):
+                try:
+                    async with self._session.post(
+                        self.endpoint, data=rec, headers=self.headers
+                    ) as resp:
+                        if resp.status < 400:
+                            break
+                        err = f"HTTP {resp.status}"
+                except aiohttp.ClientError as e:
+                    err = str(e)
+                if attempt == self.max_retries - 1:
+                    raise RuntimeError(f"webhook delivery failed: {err}")
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+    async def on_close(self, ctx, collector, is_eod: bool):
+        if self._session is not None:
+            await self._session.close()
+        return None
+
+
+@register_connector
+class WebhookConnector(Connector):
+    name = "webhook"
+    description = "HTTP POST sink with retry"
+    sink = True
+    config_schema = {
+        "endpoint": {"type": "string", "required": True},
+        "headers": {"type": "string"},
+    }
+
+    def validate_options(self, options, schema):
+        if "endpoint" not in options:
+            raise ValueError("webhook requires an endpoint option")
+        headers = {}
+        for pair in (options.get("headers") or "").split(","):
+            if ":" in pair:
+                k, v = pair.split(":", 1)
+                headers[k.strip()] = v.strip()
+        return {"endpoint": options["endpoint"], "headers": headers}
+
+    def make_sink(self, config, schema: ConnectionSchema):
+        return WebhookSink(
+            config["endpoint"], config.get("headers", {}),
+            config.get("format"),
+        )

@@ -1,0 +1,174 @@
+"""Minimal Avro binary codec (record of primitives + nullable unions).
+
+Capability parity target: the reference decodes Avro with apache-avro and
+resolves writer schemas from a Confluent schema registry
+(/root/reference/crates/arroyo-formats/src/avro/*). This is a dependency-
+free subset: record schemas of null/boolean/int/long/float/double/string/
+bytes and 2-branch nullable unions, plus the Confluent wire framing
+(magic 0 + 4-byte schema id) which is skipped when present.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+
+def _zigzag_encode(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def bytes_(self) -> bytes:
+        n = self.long()
+        out = self.data[self.pos: self.pos + n]
+        self.pos += n
+        return out
+
+    def float_(self) -> float:
+        (v,) = struct.unpack_from("<f", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def double(self) -> float:
+        (v,) = struct.unpack_from("<d", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def boolean(self) -> bool:
+        v = self.data[self.pos] == 1
+        self.pos += 1
+        return v
+
+
+class AvroDecoder:
+    def __init__(self, schema_json: Optional[str]):
+        if not schema_json:
+            raise ValueError("avro format requires avro.schema option")
+        self.schema = json.loads(schema_json)
+        assert self.schema["type"] == "record"
+        self.fields: List[Dict] = self.schema["fields"]
+
+    def decode(self, record: bytes) -> Dict[str, Any]:
+        if len(record) > 5 and record[0] == 0:
+            # Confluent wire format: magic 0 + schema id
+            record = record[5:]
+        r = _Reader(record)
+        return {f["name"]: self._read(r, f["type"]) for f in self.fields}
+
+    def _read(self, r: _Reader, t) -> Any:
+        if isinstance(t, list):  # union
+            idx = r.long()
+            return self._read(r, t[idx])
+        if isinstance(t, dict):
+            t = t.get("logicalType") and t["type"] or t["type"]
+        if t == "null":
+            return None
+        if t == "boolean":
+            return r.boolean()
+        if t in ("int", "long"):
+            return r.long()
+        if t == "float":
+            return r.float_()
+        if t == "double":
+            return r.double()
+        if t == "string":
+            return r.bytes_().decode()
+        if t == "bytes":
+            return r.bytes_()
+        raise ValueError(f"unsupported avro type {t!r}")
+
+
+class AvroEncoder:
+    def __init__(self, schema_json: Optional[str], arrow_schema: pa.Schema):
+        if schema_json:
+            self.schema = json.loads(schema_json)
+        else:
+            self.schema = schema_from_arrow(arrow_schema)
+        self.fields = self.schema["fields"]
+
+    def encode(self, row: Dict[str, Any]) -> bytes:
+        out = bytearray()
+        for f in self.fields:
+            self._write(out, f["type"], row.get(f["name"]))
+        return bytes(out)
+
+    def _write(self, out: bytearray, t, v):
+        if isinstance(t, list):
+            if v is None:
+                out += _zigzag_encode(t.index("null"))
+                return
+            branch = next(i for i, b in enumerate(t) if b != "null")
+            out += _zigzag_encode(branch)
+            self._write(out, t[branch], v)
+            return
+        if t == "boolean":
+            out.append(1 if v else 0)
+        elif t in ("int", "long"):
+            out += _zigzag_encode(int(v))
+        elif t == "float":
+            out += struct.pack("<f", float(v))
+        elif t == "double":
+            out += struct.pack("<d", float(v))
+        elif t == "string":
+            b = str(v).encode()
+            out += _zigzag_encode(len(b)) + b
+        elif t == "bytes":
+            out += _zigzag_encode(len(v)) + v
+        else:
+            raise ValueError(f"unsupported avro type {t!r}")
+
+
+def schema_from_arrow(schema: pa.Schema, name: str = "Record") -> dict:
+    """Arrow schema -> Avro record schema (sink schema generator,
+    reference ser.rs:89-101)."""
+    fields = []
+    for f in schema:
+        if f.name.startswith("_"):
+            continue
+        if pa.types.is_boolean(f.type):
+            t = "boolean"
+        elif pa.types.is_integer(f.type):
+            t = "long"
+        elif pa.types.is_float32(f.type):
+            t = "float"
+        elif pa.types.is_floating(f.type):
+            t = "double"
+        elif pa.types.is_binary(f.type):
+            t = "bytes"
+        elif pa.types.is_timestamp(f.type):
+            t = {"type": "long", "logicalType": "timestamp-micros"}
+        else:
+            t = "string"
+        fields.append(
+            {"name": f.name, "type": ["null", t] if f.nullable else t}
+        )
+    return {"type": "record", "name": name, "fields": fields}

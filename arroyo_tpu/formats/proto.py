@@ -1,0 +1,40 @@
+"""Protobuf decoding via dynamic messages.
+
+Capability parity with the reference's prost-reflect path
+(/root/reference/crates/arroyo-formats/src/proto/*): a compiled
+FileDescriptorSet (bytes of `protoc --descriptor_set_out`) + message name
+produce a dynamic decoder; fields map to columns by name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class ProtoDecoder:
+    def __init__(self, descriptor: Optional[dict]):
+        if not descriptor or "descriptor_set" not in descriptor:
+            raise ValueError(
+                "protobuf format requires protobuf.descriptor_set (bytes of a "
+                "compiled FileDescriptorSet) and protobuf.message_name"
+            )
+        from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+        fds = descriptor_pb2.FileDescriptorSet()
+        fds.ParseFromString(descriptor["descriptor_set"])
+        pool = descriptor_pool.DescriptorPool()
+        for f in fds.file:
+            pool.Add(f)
+        desc = pool.FindMessageTypeByName(descriptor["message_name"])
+        self.cls = message_factory.GetMessageClass(desc)
+
+    def decode(self, record: bytes) -> Dict[str, Any]:
+        msg = self.cls()
+        msg.ParseFromString(record)
+        out = {}
+        for field in msg.DESCRIPTOR.fields:
+            v = getattr(msg, field.name)
+            if field.type == field.TYPE_MESSAGE:
+                v = str(v)
+            out[field.name] = v
+        return out

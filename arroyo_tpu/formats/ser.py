@@ -1,5 +1,85 @@
-"""Placeholder: serializers land with the formats milestone."""
+"""Serialization: Arrow RecordBatches -> encoded records for sinks.
+
+Capability parity with the reference's ArrowSerializer
+(/root/reference/crates/arroyo-formats/src/ser.rs:54): JSON (one object per
+row), Debezium-JSON envelopes for updating streams, raw string, Avro and
+Protobuf encodings (pure python).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from ..schema import TIMESTAMP_FIELD, UPDATING_META_FIELD
 
 
-def make_serializer(schema):
-    raise NotImplementedError("formats milestone pending")
+class Serializer:
+    def __init__(self, format: str = "json", include_timestamp: bool = False,
+                 avro_schema: Optional[str] = None):
+        self.format = format or "json"
+        self.include_timestamp = include_timestamp
+        self.avro_schema = avro_schema
+
+    def serialize(self, batch: pa.RecordBatch) -> Iterator[bytes]:
+        if self.format in ("json", "debezium_json"):
+            yield from self._json(batch)
+        elif self.format == "raw_string":
+            col = batch.column(0)
+            for v in col.to_pylist():
+                yield (v if isinstance(v, str) else str(v)).encode()
+        elif self.format == "avro":
+            from .avro import AvroEncoder
+
+            enc = AvroEncoder(self.avro_schema, batch.schema)
+            for row in self._rows(batch):
+                yield enc.encode(row)
+        elif self.format in ("protobuf", "proto"):
+            raise NotImplementedError(
+                "protobuf sink encoding requires a descriptor (see formats/proto)"
+            )
+        else:
+            raise ValueError(f"unknown sink format {self.format!r}")
+
+    def _rows(self, batch: pa.RecordBatch) -> List[dict]:
+        drop = {TIMESTAMP_FIELD} if not self.include_timestamp else set()
+        drop.add(UPDATING_META_FIELD)
+        names = [n for n in batch.schema.names if n not in drop]
+        return batch.select(names).to_pylist()
+
+    def _json(self, batch: pa.RecordBatch) -> Iterator[bytes]:
+        is_updating = UPDATING_META_FIELD in batch.schema.names
+        metas = (
+            batch.column(batch.schema.names.index(UPDATING_META_FIELD))
+            .to_pylist()
+            if is_updating
+            else None
+        )
+        for i, row in enumerate(self._rows(batch)):
+            obj = {k: _json_value(v) for k, v in row.items()}
+            if self.format == "debezium_json":
+                if metas is not None and metas[i]["is_retract"]:
+                    env = {"before": obj, "after": None, "op": "d"}
+                else:
+                    env = {"before": None, "after": obj, "op": "c"}
+                yield json.dumps(env, default=str).encode()
+            else:
+                yield json.dumps(obj, default=str).encode()
+
+
+def _json_value(v):
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        return v.isoformat()
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if isinstance(v, dict):
+        return {k: _json_value(x) for k, x in v.items()}
+    return v
+
+
+def make_serializer(conn_schema) -> Serializer:
+    return Serializer(format=getattr(conn_schema, "format", None) or "json")

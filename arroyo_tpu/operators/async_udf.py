@@ -1,1 +1,2 @@
-"""Placeholder: async_udf operators land with the window/join milestone."""
+"""Placeholder: async UDF operator (reference async_udf.rs) lands with the
+UDF milestone."""

@@ -1,1 +1,2 @@
-"""Placeholder: updating operators land with the window/join milestone."""
+"""Placeholder: updating aggregates / retractions (reference
+incremental_aggregator.rs) land with the updating milestone."""

@@ -1,1 +1,2 @@
-"""Placeholder: window_fn operators land with the window/join milestone."""
+"""Placeholder: SQL window functions (ROW_NUMBER etc., reference
+window_fn.rs) land with the window-function milestone."""

@@ -55,6 +55,7 @@ class WindowOperatorBase(Operator):
         self.out_schema: StreamSchema = config["schema"]
         self.window_start_field: Optional[str] = config.get("window_start_field")
         self.window_end_field: Optional[str] = config.get("window_end_field")
+        self.window_field: Optional[str] = config.get("window_field")
         self.backend = config.get("backend")
         self.acc = make_accumulator(self.specs, backend=self.backend)
         self.dir = SlotDirectory()
@@ -76,6 +77,24 @@ class WindowOperatorBase(Operator):
         out = []
         for i in self.key_cols:
             col = batch.column(i)
+            if pa.types.is_struct(col.type):
+                # struct keys (window structs) become tuples of child values
+                children = [
+                    np.asarray(col.field(j).cast(pa.int64()))
+                    if _is_temporal_or_int(col.type.field(j).type)
+                    else np.array(col.field(j).to_pylist(), dtype=object)
+                    for j in range(col.type.num_fields)
+                ]
+                out.append(
+                    np.fromiter(
+                        (tuple(int(c[r]) if isinstance(c[r], np.integer)
+                               else c[r] for c in children)
+                         for r in range(batch.num_rows)),
+                        dtype=object,
+                        count=batch.num_rows,
+                    )
+                )
+                continue
             try:
                 out.append(col.to_numpy(zero_copy_only=False))
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
@@ -103,14 +122,30 @@ class WindowOperatorBase(Operator):
         agg_cols: List[np.ndarray],
         start: int,
         end: int,
+        ts_value: Optional[int] = None,
     ) -> pa.RecordBatch:
         """Build an output batch for one window [start, end)."""
         n = len(keys)
+        window_field = getattr(self, "window_field", None)
         arrays = []
         for f in self.out_schema.schema:
             if f.name == TIMESTAMP_FIELD:
+                ts = ts_value if ts_value is not None else end - 1
                 arrays.append(
-                    pa.array(np.full(n, end - 1, dtype=np.int64)).cast(f.type)
+                    pa.array(np.full(n, ts, dtype=np.int64)).cast(f.type)
+                )
+            elif f.name == window_field and pa.types.is_struct(f.type):
+                s = pa.array(np.full(n, start, dtype=np.int64)).cast(
+                    f.type.field(0).type
+                )
+                e = pa.array(np.full(n, end, dtype=np.int64)).cast(
+                    f.type.field(1).type
+                )
+                arrays.append(
+                    pa.StructArray.from_arrays(
+                        [s, e], names=[f.type.field(0).name,
+                                       f.type.field(1).name]
+                    )
                 )
             elif f.name == self.window_start_field:
                 arrays.append(
@@ -124,7 +159,25 @@ class WindowOperatorBase(Operator):
                 ki = self._key_names.index(f.name)
                 vals = [_to_py(k[ki]) for k in keys]
                 kt = self._key_types[ki]
-                if _is_interned_type(kt):
+                if pa.types.is_struct(kt):
+                    tuples = [unintern_value(v) for v in vals]
+                    children = [
+                        pa.array(
+                            [t[j] for t in tuples], type=pa.int64()
+                        ).cast(kt.field(j).type)
+                        if _is_temporal_or_int(kt.field(j).type)
+                        else pa.array([t[j] for t in tuples],
+                                      type=kt.field(j).type)
+                        for j in range(kt.num_fields)
+                    ]
+                    arrays.append(
+                        pa.StructArray.from_arrays(
+                            children,
+                            names=[kt.field(j).name
+                                   for j in range(kt.num_fields)],
+                        )
+                    )
+                elif _is_interned_type(kt):
                     arrays.append(
                         pa.array([unintern_value(v) for v in vals], type=kt)
                     )
@@ -218,6 +271,14 @@ class WindowOperatorBase(Operator):
             vals = [k[i] for k in keys]
             kt = self._key_types[i]
             # dtype must match what the shuffle hashed (schema.hash_keys)
+            if pa.types.is_struct(kt):
+                # shuffle hashes struct children in order
+                tuples = [unintern_value(_to_py(v)) for v in vals]
+                for j in range(kt.num_fields):
+                    cols.append(hash_column(
+                        np.asarray([t[j] for t in tuples], dtype=np.int64)
+                    ))
+                continue
             if pa.types.is_floating(kt):
                 arr = np.asarray(vals, dtype=np.float64)
             elif _is_interned_type(kt):
@@ -233,6 +294,10 @@ class WindowOperatorBase(Operator):
 
 def _to_py(v):
     return v.item() if isinstance(v, np.generic) else v
+
+
+def _is_temporal_or_int(t: pa.DataType) -> bool:
+    return pa.types.is_integer(t) or pa.types.is_timestamp(t)
 
 
 def _snaps_for_me(table, ctx, keyed: bool):
@@ -262,13 +327,16 @@ def _ceil_div(a: int, b: int) -> int:
 
 class TumblingWindowOperator(WindowOperatorBase):
     """Fixed-width windows: bin = ts // width; emit at watermark >= end
-    (reference tumbling_aggregating_window.rs:66-321)."""
+    (reference tumbling_aggregating_window.rs:66-321).
+
+    width_nanos == 0 is *instant* mode: rows group by their exact
+    _timestamp — used to aggregate already-windowed streams
+    (GROUP BY window), where every row of a window shares one timestamp."""
 
     def __init__(self, config: dict):
         super().__init__(config, "tumbling_window")
-        self.width = int(config["width_nanos"])
-        assert self.width > 0
-        self.emitted_up_to: Optional[int] = None
+        self.width = int(config.get("width_nanos", 0))
+        self.emitted_up_to: Optional[int] = None  # last emitted bin END
 
     def tables(self):
         from ..state.table_config import global_table
@@ -294,12 +362,21 @@ class TumblingWindowOperator(WindowOperatorBase):
             snap["subtask"] = ctx.task_info.task_index
             table.put(ctx.task_info.task_index, snap)
 
+    def _bin_of(self, ts: np.ndarray) -> np.ndarray:
+        return ts // self.width if self.width else ts
+
+    def _bin_end(self, b: int) -> int:
+        return (b + 1) * self.width if self.width else b
+
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         self._capture_key_meta(ctx)
         ts = ctx.in_schemas[0].timestamps(batch)
-        bins = ts // self.width
+        bins = self._bin_of(ts)
         if self.emitted_up_to is not None:
-            live = (bins + 1) * self.width > self.emitted_up_to
+            if self.width:
+                live = (bins + 1) * self.width > self.emitted_up_to
+            else:
+                live = bins > self.emitted_up_to
             if not live.all():
                 if not live.any():
                     return
@@ -314,15 +391,20 @@ class TumblingWindowOperator(WindowOperatorBase):
         if watermark.kind != WatermarkKind.EVENT_TIME:
             return watermark
         t = watermark.timestamp
-        for b in self.dir.bins_up_to(_ceil_div(t, self.width)):
-            end = (b + 1) * self.width
+        limit = _ceil_div(t, self.width) if self.width else t + 1
+        for b in self.dir.bins_up_to(limit):
+            end = self._bin_end(b)
             if end > t:
                 continue
             keys, slots = self.dir.take_bin(b)
             gathered = self.acc.gather(slots)
             self.acc.reset_slots(slots)
             agg_cols = self.acc.finalize(gathered)
-            out = self._build_output(keys, agg_cols, b * self.width, end)
+            if self.width:
+                out = self._build_output(keys, agg_cols, b * self.width, end)
+            else:
+                # instant mode: preserve the window's timestamp exactly
+                out = self._build_output(keys, agg_cols, b, b, ts_value=b)
             await collector.collect(out)
             self.emitted_up_to = max(self.emitted_up_to or 0, end)
         return watermark

@@ -110,6 +110,8 @@ _INTERN_REV: List[object] = []
 
 
 def intern_value(v) -> int:
+    if isinstance(v, list):  # msgpack round-trips tuples as lists
+        v = tuple(tuple(x) if isinstance(x, list) else x for x in v)
     code = _INTERN.get(v)
     if code is None:
         code = len(_INTERN_REV)

@@ -99,16 +99,18 @@ class StreamSchema:
 
     def hash_keys(self, batch: pa.RecordBatch) -> np.ndarray:
         """uint64 hash of the routing-key columns, the canonical hash used by
-        shuffle + state sharding. Unkeyed schemas hash to zeros."""
+        shuffle + state sharding. Unkeyed schemas hash to zeros. Struct
+        columns (e.g. window structs) hash their children in order."""
         if not self.key_indices:
             return np.zeros(batch.num_rows, dtype=np.uint64)
         cols = []
         for i in self.key_indices:
             col = batch.column(i)
-            if col.null_count:
-                # nulls hash as a fixed sentinel: substitute before hashing
-                col = col.fill_null(_null_sentinel(col.type))
-            cols.append(hash_column(_to_numpy(col)))
+            if pa.types.is_struct(col.type):
+                for j in range(col.type.num_fields):
+                    cols.append(_hash_one(col.field(j)))
+                continue
+            cols.append(_hash_one(col))
         return hash_arrays(cols)
 
     def partition(self, batch: pa.RecordBatch, n: int) -> list[Optional[pa.RecordBatch]]:
@@ -128,6 +130,13 @@ class StreamSchema:
             lo, hi = int(boundaries[i]), int(boundaries[i + 1])
             out.append(taken.slice(lo, hi - lo) if hi > lo else None)
         return out
+
+
+def _hash_one(col: pa.Array) -> np.ndarray:
+    if col.null_count:
+        # nulls hash as a fixed sentinel: substitute before hashing
+        col = col.fill_null(_null_sentinel(col.type))
+    return hash_column(_to_numpy(col))
 
 
 def _to_numpy(col: pa.Array) -> np.ndarray:

@@ -1,0 +1,12 @@
+"""SQL frontend: lexer -> parser -> logical planner -> LogicalGraph.
+
+Capability parity with the reference's arroyo-planner crate
+(/root/reference/crates/arroyo-planner/src/lib.rs:789
+parse_and_get_arrow_program), rebuilt from scratch in Python (the reference
+sits on Rust DataFusion, unavailable here): a recursive-descent SQL parser,
+a vectorized expression compiler over pyarrow.compute kernels, and a
+planner that rewrites SELECTs into the engine's operator DAG (source +
+watermark, projections/filters, window TVF aggregates, joins, sinks).
+"""
+
+from .planner import SchemaProvider, plan_query  # noqa: F401

@@ -1,0 +1,110 @@
+"""SQL tokenizer."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional
+
+
+class SqlError(Exception):
+    """User-facing SQL error (parse or plan time)."""
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str  # ident | number | string | op | punct | eof
+    value: str
+    pos: int  # character offset (for error messages)
+    upper: str = ""
+
+    def __post_init__(self):
+        self.upper = self.value.upper()
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`[^`]*`)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|<>|!=|==|\|\||->>|->|[+\-*/%<>=])
+  | (?P<punct>[(),.;\[\]])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise SqlError(f"unexpected character {sql[pos]!r} at offset {pos}")
+        kind = m.lastgroup
+        text = m.group()
+        if kind != "ws":
+            if kind == "qident":
+                out.append(Token("ident", text[1:-1].replace('""', '"'), pos))
+            elif kind == "string":
+                out.append(Token("string", text[1:-1].replace("''", "'"), pos))
+            else:
+                out.append(Token(kind, text, pos))
+        pos = m.end()
+    out.append(Token("eof", "", pos))
+    return out
+
+
+class TokenStream:
+    def __init__(self, tokens: List[Token], sql: str = ""):
+        self.tokens = tokens
+        self.i = 0
+        self.sql = sql
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.peek()
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_keyword(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in words
+
+    def accept_keyword(self, *words: str) -> Optional[Token]:
+        if self.at_keyword(*words):
+            return self.next()
+        return None
+
+    def expect_keyword(self, word: str) -> Token:
+        t = self.next()
+        if t.kind != "ident" or t.upper != word:
+            raise SqlError(
+                f"expected {word}, found {t.value!r} at offset {t.pos}"
+            )
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> Token:
+        t = self.next()
+        if t.kind != kind or (value is not None and t.value != value):
+            want = value or kind
+            raise SqlError(
+                f"expected {want!r}, found {t.value or t.kind!r} at offset {t.pos}"
+            )
+        return t
+
+    def error(self, message: str) -> SqlError:
+        t = self.peek()
+        return SqlError(f"{message} (near {t.value!r} at offset {t.pos})")

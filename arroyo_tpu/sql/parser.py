@@ -1,0 +1,656 @@
+"""Recursive-descent SQL parser for the engine's dialect.
+
+Dialect follows the reference's test-suite SQL (DataFusion/Postgres style):
+CREATE TABLE ... WITH (connector options), CREATE VIEW, INSERT INTO ...
+SELECT, WITH CTEs, subqueries, joins with ON conditions, GROUP BY with
+ordinals and window TVFs (tumble/hop/session), HAVING, UNION [ALL],
+window functions with OVER, CASE/CAST/IN/BETWEEN/IS NULL, intervals,
+`==` as equality (the reference accepts it), `--` comments.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .ast import (
+    Between,
+    BinaryOp,
+    Case,
+    Cast,
+    Column,
+    ColumnDef,
+    CreateTable,
+    CreateView,
+    Expr,
+    FieldAccess,
+    FuncCall,
+    InList,
+    Insert,
+    Interval,
+    IsNull,
+    Join,
+    Literal,
+    OverClause,
+    Relation,
+    Select,
+    SelectItem,
+    Star,
+    SubqueryRef,
+    TableRef,
+    UnaryOp,
+    Unnest,
+)
+from .lexer import SqlError, Token, TokenStream, tokenize
+
+RESERVED_STOP = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "JOIN",
+    "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON", "AS", "AND", "OR",
+    "NOT", "WHEN", "THEN", "ELSE", "END", "BY", "ASC", "DESC", "INSERT",
+    "CREATE", "SELECT", "WITH", "INTO", "VALUES", "SET", "DISTINCT",
+}
+
+INTERVAL_UNITS = {
+    "NANOSECOND": 1,
+    "NANOSECONDS": 1,
+    "MICROSECOND": 1_000,
+    "MICROSECONDS": 1_000,
+    "MILLISECOND": 1_000_000,
+    "MILLISECONDS": 1_000_000,
+    "SECOND": 1_000_000_000,
+    "SECONDS": 1_000_000_000,
+    "MINUTE": 60 * 1_000_000_000,
+    "MINUTES": 60 * 1_000_000_000,
+    "HOUR": 3_600 * 1_000_000_000,
+    "HOURS": 3_600 * 1_000_000_000,
+    "DAY": 86_400 * 1_000_000_000,
+    "DAYS": 86_400 * 1_000_000_000,
+    "WEEK": 7 * 86_400 * 1_000_000_000,
+    "WEEKS": 7 * 86_400 * 1_000_000_000,
+    "MONTH": 30 * 86_400 * 1_000_000_000,  # calendar months approximated
+    "MONTHS": 30 * 86_400 * 1_000_000_000,
+}
+
+
+def parse_statements(sql: str) -> List[object]:
+    ts = TokenStream(tokenize(sql), sql)
+    out = []
+    while ts.peek().kind != "eof":
+        if ts.accept("punct", ";"):
+            continue
+        out.append(_statement(ts))
+    return out
+
+
+def parse_expr_text(sql: str) -> Expr:
+    ts = TokenStream(tokenize(sql), sql)
+    e = _expr(ts)
+    if ts.peek().kind != "eof":
+        raise ts.error("trailing tokens after expression")
+    return e
+
+
+# -- statements -------------------------------------------------------------
+
+
+def _statement(ts: TokenStream):
+    if ts.at_keyword("CREATE"):
+        return _create(ts)
+    if ts.at_keyword("INSERT"):
+        return _insert(ts)
+    if ts.at_keyword("SELECT", "WITH"):
+        return _select(ts)
+    raise ts.error("expected CREATE, INSERT, SELECT or WITH")
+
+
+def _create(ts: TokenStream):
+    ts.expect_keyword("CREATE")
+    ts.accept_keyword("OR")  # CREATE OR REPLACE
+    ts.accept_keyword("REPLACE")
+    temp = ts.accept_keyword("TEMPORARY", "TEMP")
+    if ts.accept_keyword("VIEW"):
+        name = _name(ts)
+        ts.expect_keyword("AS")
+        paren = ts.accept("punct", "(")
+        q = _select(ts)
+        if paren:
+            ts.expect("punct", ")")
+        return CreateView(name, q)
+    ts.expect_keyword("TABLE")
+    ts.accept_keyword("IF")  # IF NOT EXISTS
+    ts.accept_keyword("NOT")
+    ts.accept_keyword("EXISTS")
+    name = _name(ts)
+    columns: List[ColumnDef] = []
+    pk: List[str] = []
+    if ts.accept("punct", "("):
+        while True:
+            if ts.at_keyword("PRIMARY"):
+                ts.next()
+                ts.expect_keyword("KEY")
+                ts.expect("punct", "(")
+                while True:
+                    pk.append(_name(ts))
+                    if not ts.accept("punct", ","):
+                        break
+                ts.expect("punct", ")")
+            else:
+                columns.append(_column_def(ts))
+            if not ts.accept("punct", ","):
+                break
+        ts.expect("punct", ")")
+    options: Dict[str, str] = {}
+    if ts.accept_keyword("WITH"):
+        ts.expect("punct", "(")
+        while True:
+            key_tok = ts.next()
+            if key_tok.kind not in ("ident", "string"):
+                raise ts.error("expected option name")
+            key = key_tok.value
+            while ts.accept("punct", "."):
+                key += "." + ts.next().value
+            ts.expect("op", "=")
+            val = ts.next()
+            if val.kind not in ("string", "number", "ident"):
+                raise ts.error("expected option value")
+            options[key] = val.value
+            if not ts.accept("punct", ","):
+                break
+        ts.expect("punct", ")")
+    if pk:
+        options["__pk__"] = ",".join(pk)
+    if ts.accept_keyword("AS"):
+        # CREATE TABLE x AS SELECT -- an in-memory (virtual) table
+        q = _select(ts)
+        return CreateView(name, q)
+    return CreateTable(name, columns, options)
+
+
+def _column_def(ts: TokenStream) -> ColumnDef:
+    name = _name(ts)
+    type_name = _type_name(ts)
+    nullable = True
+    generated = None
+    metadata_key = None
+    while True:
+        if ts.accept_keyword("NOT"):
+            ts.expect_keyword("NULL")
+            nullable = False
+        elif ts.accept_keyword("NULL"):
+            nullable = True
+        elif ts.accept_keyword("PRIMARY"):
+            ts.expect_keyword("KEY")
+        elif ts.accept_keyword("METADATA"):
+            ts.expect_keyword("FROM")
+            metadata_key = ts.expect("string").value
+        elif ts.accept_keyword("GENERATED"):
+            ts.expect_keyword("ALWAYS")
+            ts.expect_keyword("AS")
+            ts.expect("punct", "(")
+            generated = _expr(ts)
+            ts.expect("punct", ")")
+            ts.accept_keyword("STORED")
+        else:
+            break
+    return ColumnDef(name, type_name, nullable, generated, metadata_key)
+
+
+def _type_name(ts: TokenStream) -> str:
+    parts = [ts.expect("ident").upper]
+    # multi-word types and modifiers
+    while ts.at_keyword("UNSIGNED", "PRECISION", "VARYING"):
+        parts.append(ts.next().upper)
+    if ts.accept("punct", "("):
+        # e.g. VARCHAR(10), DECIMAL(10, 2) -- sizes ignored
+        while not ts.accept("punct", ")"):
+            ts.next()
+    if ts.accept("punct", "["):
+        ts.expect("punct", "]")
+        parts.append("ARRAY")
+    return " ".join(parts)
+
+
+def _insert(ts: TokenStream) -> Insert:
+    ts.expect_keyword("INSERT")
+    ts.expect_keyword("INTO")
+    table = _name(ts)
+    if ts.accept("punct", "("):
+        while not ts.accept("punct", ")"):
+            ts.next()
+    q = _select(ts)
+    return Insert(table, q)
+
+
+# -- select -----------------------------------------------------------------
+
+
+def _select(ts: TokenStream) -> Select:
+    ctes: List[Tuple[str, Select]] = []
+    if ts.accept_keyword("WITH"):
+        while True:
+            name = _name(ts)
+            ts.expect_keyword("AS")
+            ts.expect("punct", "(")
+            q = _select(ts)
+            ts.expect("punct", ")")
+            ctes.append((name, q))
+            if not ts.accept("punct", ","):
+                break
+    sel = _select_body(ts)
+    # attach ctes (planner resolves them as scoped views)
+    sel.ctes = ctes  # type: ignore[attr-defined]
+    while ts.at_keyword("UNION"):
+        ts.next()
+        if not ts.accept_keyword("ALL"):
+            sel.distinct_union = True  # type: ignore[attr-defined]
+        sel.unions.append(_select_body(ts))
+    if ts.accept_keyword("ORDER"):
+        ts.expect_keyword("BY")
+        while True:
+            e = _expr(ts)
+            desc = bool(ts.accept_keyword("DESC"))
+            ts.accept_keyword("ASC")
+            sel.order_by.append((e, desc))
+            if not ts.accept("punct", ","):
+                break
+    if ts.accept_keyword("LIMIT"):
+        sel.limit = int(ts.expect("number").value)
+    return sel
+
+
+def _select_body(ts: TokenStream) -> Select:
+    if ts.accept("punct", "("):
+        q = _select(ts)
+        ts.expect("punct", ")")
+        return q
+    ts.expect_keyword("SELECT")
+    distinct = bool(ts.accept_keyword("DISTINCT"))
+    ts.accept_keyword("ALL")
+    items: List[SelectItem] = []
+    while True:
+        items.append(_select_item(ts))
+        if not ts.accept("punct", ","):
+            break
+    from_ = None
+    if ts.accept_keyword("FROM"):
+        from_ = _relation(ts)
+    where = None
+    if ts.accept_keyword("WHERE"):
+        where = _expr(ts)
+    group_by: List[Expr] = []
+    if ts.accept_keyword("GROUP"):
+        ts.expect_keyword("BY")
+        while True:
+            group_by.append(_expr(ts))
+            if not ts.accept("punct", ","):
+                break
+    having = None
+    if ts.accept_keyword("HAVING"):
+        having = _expr(ts)
+    return Select(items, from_, where, group_by, having, distinct)
+
+
+def _select_item(ts: TokenStream) -> SelectItem:
+    if ts.accept("op", "*"):
+        return SelectItem(Star())
+    # t.* qualified star
+    t = ts.peek()
+    if (
+        t.kind == "ident"
+        and ts.peek(1).kind == "punct"
+        and ts.peek(1).value == "."
+        and ts.peek(2).kind == "op"
+        and ts.peek(2).value == "*"
+    ):
+        ts.next()
+        ts.next()
+        ts.next()
+        return SelectItem(Star(table=t.value))
+    e = _expr(ts)
+    alias = None
+    if ts.accept_keyword("AS"):
+        alias = _name(ts)
+    elif ts.peek().kind == "ident" and ts.peek().upper not in RESERVED_STOP:
+        alias = _name(ts)
+    return SelectItem(e, alias)
+
+
+# -- relations --------------------------------------------------------------
+
+
+def _relation(ts: TokenStream) -> Relation:
+    rel = _relation_primary(ts)
+    while True:
+        join_type = None
+        if ts.accept_keyword("JOIN"):
+            join_type = "inner"
+        elif ts.at_keyword("INNER") and ts.peek(1).upper == "JOIN":
+            ts.next()
+            ts.next()
+            join_type = "inner"
+        elif ts.at_keyword("LEFT", "RIGHT", "FULL"):
+            jt = ts.next().upper.lower()
+            ts.accept_keyword("OUTER")
+            ts.expect_keyword("JOIN")
+            join_type = jt
+        elif ts.at_keyword("CROSS") and ts.peek(1).upper == "JOIN":
+            ts.next()
+            ts.next()
+            join_type = "cross"
+        elif ts.accept("punct", ","):
+            join_type = "cross"
+        else:
+            break
+        right = _relation_primary(ts)
+        cond = None
+        if join_type != "cross":
+            ts.expect_keyword("ON")
+            cond = _expr(ts)
+        rel = Join(rel, right, "inner" if join_type == "cross" else join_type,
+                   cond)
+    return rel
+
+
+def _relation_primary(ts: TokenStream) -> Relation:
+    if ts.accept("punct", "("):
+        if ts.at_keyword("SELECT", "WITH"):
+            q = _select(ts)
+            ts.expect("punct", ")")
+            alias = _opt_alias(ts)
+            return SubqueryRef(q, alias)
+        rel = _relation(ts)
+        ts.expect("punct", ")")
+        a = _opt_alias(ts)
+        if a is not None and isinstance(rel, (TableRef, SubqueryRef)):
+            rel.alias = a
+        return rel
+    if ts.at_keyword("UNNEST"):
+        ts.next()
+        ts.expect("punct", "(")
+        e = _expr(ts)
+        ts.expect("punct", ")")
+        return Unnest(e, _opt_alias(ts))
+    name = _name(ts)
+    return TableRef(name, _opt_alias(ts))
+
+
+def _opt_alias(ts: TokenStream) -> Optional[str]:
+    if ts.accept_keyword("AS"):
+        return _name(ts)
+    t = ts.peek()
+    if t.kind == "ident" and t.upper not in RESERVED_STOP:
+        return _name(ts)
+    return None
+
+
+def _name(ts: TokenStream) -> str:
+    t = ts.next()
+    if t.kind != "ident":
+        raise SqlError(f"expected name, found {t.value!r} at offset {t.pos}")
+    return t.value
+
+
+# -- expressions (precedence climbing) --------------------------------------
+
+
+def _expr(ts: TokenStream) -> Expr:
+    return _or_expr(ts)
+
+
+def _or_expr(ts: TokenStream) -> Expr:
+    left = _and_expr(ts)
+    while ts.accept_keyword("OR"):
+        left = BinaryOp("OR", left, _and_expr(ts))
+    return left
+
+
+def _and_expr(ts: TokenStream) -> Expr:
+    left = _not_expr(ts)
+    while ts.accept_keyword("AND"):
+        left = BinaryOp("AND", left, _not_expr(ts))
+    return left
+
+
+def _not_expr(ts: TokenStream) -> Expr:
+    if ts.accept_keyword("NOT"):
+        return UnaryOp("NOT", _not_expr(ts))
+    return _comparison(ts)
+
+
+_CMP_OPS = {"=", "==", "!=", "<>", "<", "<=", ">", ">="}
+
+
+def _comparison(ts: TokenStream) -> Expr:
+    left = _additive(ts)
+    while True:
+        t = ts.peek()
+        if t.kind == "op" and t.value in _CMP_OPS:
+            ts.next()
+            op = "=" if t.value == "==" else ("!=" if t.value == "<>" else t.value)
+            left = BinaryOp(op, left, _additive(ts))
+        elif ts.at_keyword("IS"):
+            ts.next()
+            negated = bool(ts.accept_keyword("NOT"))
+            ts.expect_keyword("NULL")
+            left = IsNull(left, negated)
+        elif ts.at_keyword("IN"):
+            ts.next()
+            ts.expect("punct", "(")
+            items = [_expr(ts)]
+            while ts.accept("punct", ","):
+                items.append(_expr(ts))
+            ts.expect("punct", ")")
+            left = InList(left, items)
+        elif ts.at_keyword("NOT") and ts.peek(1).upper in ("IN", "BETWEEN", "LIKE"):
+            ts.next()
+            if ts.accept_keyword("IN"):
+                ts.expect("punct", "(")
+                items = [_expr(ts)]
+                while ts.accept("punct", ","):
+                    items.append(_expr(ts))
+                ts.expect("punct", ")")
+                left = InList(left, items, negated=True)
+            elif ts.accept_keyword("BETWEEN"):
+                low = _additive(ts)
+                ts.expect_keyword("AND")
+                left = Between(left, low, _additive(ts), negated=True)
+            else:
+                ts.expect_keyword("LIKE")
+                left = UnaryOp("NOT", FuncCall("like", [left, _additive(ts)]))
+        elif ts.at_keyword("BETWEEN"):
+            ts.next()
+            low = _additive(ts)
+            ts.expect_keyword("AND")
+            left = Between(left, low, _additive(ts))
+        elif ts.at_keyword("LIKE"):
+            ts.next()
+            left = FuncCall("like", [left, _additive(ts)])
+        else:
+            return left
+
+
+def _additive(ts: TokenStream) -> Expr:
+    left = _multiplicative(ts)
+    while True:
+        t = ts.peek()
+        if t.kind == "op" and t.value in ("+", "-", "||", "->", "->>"):
+            ts.next()
+            left = BinaryOp(t.value, left, _multiplicative(ts))
+        else:
+            return left
+
+
+def _multiplicative(ts: TokenStream) -> Expr:
+    left = _unary(ts)
+    while True:
+        t = ts.peek()
+        if t.kind == "op" and t.value in ("*", "/", "%"):
+            ts.next()
+            left = BinaryOp(t.value, left, _unary(ts))
+        else:
+            return left
+
+
+def _unary(ts: TokenStream) -> Expr:
+    t = ts.peek()
+    if t.kind == "op" and t.value == "-":
+        ts.next()
+        return UnaryOp("-", _unary(ts))
+    if t.kind == "op" and t.value == "+":
+        ts.next()
+        return _unary(ts)
+    return _postfix(ts)
+
+
+def _postfix(ts: TokenStream) -> Expr:
+    e = _primary(ts)
+    while True:
+        if ts.peek().kind == "punct" and ts.peek().value == ".":
+            ts.next()
+            field = _name(ts)
+            if isinstance(e, Column) and e.table is None:
+                e = Column(field, table=e.name)
+            else:
+                e = FieldAccess(e, field)
+        elif ts.peek().kind == "punct" and ts.peek().value == "[":
+            ts.next()
+            idx = _expr(ts)
+            ts.expect("punct", "]")
+            e = FuncCall("array_element", [e, idx])
+        else:
+            return e
+
+
+def _primary(ts: TokenStream) -> Expr:
+    t = ts.peek()
+    if t.kind == "number":
+        ts.next()
+        v = float(t.value) if any(c in t.value for c in ".eE") else int(t.value)
+        return Literal(v)
+    if t.kind == "string":
+        ts.next()
+        return Literal(t.value)
+    if t.kind == "punct" and t.value == "(":
+        ts.next()
+        if ts.at_keyword("SELECT", "WITH"):
+            raise ts.error("scalar subqueries are not supported")
+        e = _expr(ts)
+        ts.expect("punct", ")")
+        return e
+    if t.kind != "ident":
+        raise SqlError(f"unexpected token {t.value!r} at offset {t.pos}")
+    up = t.upper
+    if up == "NULL":
+        ts.next()
+        return Literal(None)
+    if up in ("TRUE", "FALSE"):
+        ts.next()
+        return Literal(up == "TRUE")
+    if up == "INTERVAL":
+        ts.next()
+        return _interval(ts)
+    if up == "CAST":
+        ts.next()
+        ts.expect("punct", "(")
+        e = _expr(ts)
+        ts.expect_keyword("AS")
+        type_name = _type_name(ts)
+        ts.expect("punct", ")")
+        return Cast(e, type_name)
+    if up == "CASE":
+        ts.next()
+        return _case(ts)
+    if up == "EXTRACT":
+        ts.next()
+        ts.expect("punct", "(")
+        part = _name(ts)
+        ts.expect_keyword("FROM")
+        e = _expr(ts)
+        ts.expect("punct", ")")
+        return FuncCall("extract", [Literal(part.lower()), e])
+    # function call or column
+    if ts.peek(1).kind == "punct" and ts.peek(1).value == "(":
+        name = ts.next().value
+        ts.expect("punct", "(")
+        distinct = bool(ts.accept_keyword("DISTINCT"))
+        star = False
+        args: List[Expr] = []
+        if ts.accept("op", "*"):
+            star = True
+        elif not (ts.peek().kind == "punct" and ts.peek().value == ")"):
+            args.append(_expr(ts))
+            while ts.accept("punct", ","):
+                args.append(_expr(ts))
+        ts.expect("punct", ")")
+        over = None
+        if ts.at_keyword("OVER"):
+            ts.next()
+            ts.expect("punct", "(")
+            partition: List[Expr] = []
+            order: List[Tuple[Expr, bool]] = []
+            if ts.accept_keyword("PARTITION"):
+                ts.expect_keyword("BY")
+                partition.append(_expr(ts))
+                while ts.accept("punct", ","):
+                    partition.append(_expr(ts))
+            if ts.accept_keyword("ORDER"):
+                ts.expect_keyword("BY")
+                while True:
+                    e = _expr(ts)
+                    desc = bool(ts.accept_keyword("DESC"))
+                    ts.accept_keyword("ASC")
+                    order.append((e, desc))
+                    if not ts.accept("punct", ","):
+                        break
+            ts.expect("punct", ")")
+            over = OverClause(partition, order)
+        return FuncCall(name.lower(), args, distinct, star, over)
+    ts.next()
+    return Column(t.value)
+
+
+def _case(ts: TokenStream) -> Case:
+    operand = None
+    if not ts.at_keyword("WHEN"):
+        operand = _expr(ts)
+    branches = []
+    while ts.accept_keyword("WHEN"):
+        when = _expr(ts)
+        ts.expect_keyword("THEN")
+        branches.append((when, _expr(ts)))
+    else_ = None
+    if ts.accept_keyword("ELSE"):
+        else_ = _expr(ts)
+    ts.expect_keyword("END")
+    return Case(operand, branches, else_)
+
+
+def _interval(ts: TokenStream) -> Interval:
+    s = ts.expect("string").value.strip()
+    parts = s.split()
+    if len(parts) == 2 and parts[0].replace(".", "").isdigit():
+        qty = float(parts[0])
+        unit = parts[1].upper()
+        if unit not in INTERVAL_UNITS:
+            raise SqlError(f"unknown interval unit {parts[1]!r}")
+        return Interval(int(qty * INTERVAL_UNITS[unit]))
+    # INTERVAL '1' HOUR style: unit follows as a keyword
+    if s.replace(".", "").isdigit():
+        unit_tok = ts.peek()
+        if unit_tok.kind == "ident" and unit_tok.upper in INTERVAL_UNITS:
+            ts.next()
+            return Interval(int(float(s) * INTERVAL_UNITS[unit_tok.upper]))
+        # bare number defaults to seconds
+        return Interval(int(float(s) * 1_000_000_000))
+    # compound strings like '1 hour 30 minutes'
+    total = 0
+    i = 0
+    while i < len(parts) - 1:
+        qty = float(parts[i])
+        unit = parts[i + 1].upper()
+        if unit not in INTERVAL_UNITS:
+            raise SqlError(f"unknown interval unit {parts[i + 1]!r}")
+        total += int(qty * INTERVAL_UNITS[unit])
+        i += 2
+    if i != len(parts):
+        raise SqlError(f"cannot parse interval {s!r}")
+    return Interval(total)

@@ -1,0 +1,1404 @@
+"""SQL logical planner: statements -> LogicalGraph.
+
+Capability parity with the reference's planner pipeline
+(/root/reference/crates/arroyo-planner/src/lib.rs:789
+parse_and_get_arrow_program + src/rewriters.rs + src/plan/*): CREATE TABLE
+connector tables, views/CTEs, INSERT INTO sinks, source rewriting (event
+time + watermark injection), projection/filter planning, window-TVF
+aggregate detection (tumble/hop/session in GROUP BY, ordinals and aliases
+resolved), window struct columns with .start/.end access, windowed
+(instant) joins with residual predicates, expiring non-windowed joins,
+unions, and sink wiring. Unsupported constructs raise SqlError with the
+reference feature named, so gaps are visible rather than silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from ..graph.logical import (
+    ChainedOp,
+    EdgeType,
+    LogicalGraph,
+    LogicalNode,
+    OperatorName,
+)
+from ..schema import StreamSchema, TIMESTAMP_FIELD, add_timestamp_field
+from .ast import (
+    BinaryOp,
+    Column,
+    CreateTable,
+    CreateView,
+    Expr,
+    FieldAccess,
+    FuncCall,
+    Insert,
+    Interval,
+    Join,
+    Literal,
+    Relation,
+    Select,
+    SelectItem,
+    Star,
+    SubqueryRef,
+    TableRef,
+    Unnest,
+)
+from .expressions import BoundExpr, CompiledProjection, Scope, bind
+from .lexer import SqlError
+from .parser import parse_statements
+from .types import WINDOW_TYPE, sql_type_to_arrow
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "mean"}
+WINDOW_TVFS = {"tumble", "hop", "session"}
+DEFAULT_WATERMARK_DELAY = 1_000_000_000  # 1s, reference default
+
+
+# ---------------------------------------------------------------------------
+# Catalog
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TableDef:
+    name: str
+    fields: List[pa.Field]
+    options: Dict[str, str]
+
+    @property
+    def connector(self) -> str:
+        c = self.options.get("connector")
+        if not c:
+            raise SqlError(f"table {self.name} has no connector option")
+        return c
+
+    @property
+    def table_type(self) -> str:
+        # source | sink (some connectors imply one)
+        return self.options.get("type", "")
+
+    def schema(self) -> pa.Schema:
+        return pa.schema(self.fields)
+
+
+class SchemaProvider:
+    """Table/view/UDF catalog (reference: ArroyoSchemaProvider, lib.rs:112)."""
+
+    def __init__(self):
+        self.tables: Dict[str, TableDef] = {}
+        self.views: Dict[str, Select] = {}
+
+    def add_table(self, t: TableDef):
+        self.tables[t.name.lower()] = t
+
+    def add_view(self, name: str, q: Select):
+        self.views[name.lower()] = q
+
+    def get_table(self, name: str) -> Optional[TableDef]:
+        return self.tables.get(name.lower())
+
+    def get_view(self, name: str) -> Optional[Select]:
+        return self.views.get(name.lower())
+
+
+# ---------------------------------------------------------------------------
+# Window specs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    kind: str  # tumbling | sliding | session
+    width: int = 0  # nanos (tumbling/sliding)
+    slide: int = 0
+    gap: int = 0
+
+    @staticmethod
+    def from_call(call: FuncCall) -> "WindowSpec":
+        def iv(e: Expr) -> int:
+            if not isinstance(e, Interval):
+                raise SqlError(
+                    f"{call.name}() arguments must be INTERVAL literals"
+                )
+            return e.nanos
+
+        if call.name == "tumble":
+            if len(call.args) != 1:
+                raise SqlError("tumble(width) takes one interval")
+            return WindowSpec("tumbling", width=iv(call.args[0]))
+        if call.name == "hop":
+            if len(call.args) != 2:
+                raise SqlError("hop(slide, width) takes two intervals")
+            return WindowSpec(
+                "sliding", slide=iv(call.args[0]), width=iv(call.args[1])
+            )
+        if len(call.args) != 1:
+            raise SqlError("session(gap) takes one interval")
+        return WindowSpec("session", gap=iv(call.args[0]))
+
+
+# ---------------------------------------------------------------------------
+# Relation plans
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RelOutput:
+    node_id: int
+    schema: StreamSchema  # includes _timestamp
+    scope: Scope  # qualifier-aware name resolution over schema
+    window: Optional[WindowSpec] = None  # set when rows are window outputs
+    window_field: Optional[str] = None  # name of the window struct column
+    updating: bool = False
+
+
+class Planner:
+    def __init__(self, provider: SchemaProvider, parallelism: int = 1):
+        self.provider = provider
+        self.graph = LogicalGraph()
+        self.parallelism = parallelism
+        self._source_cache: Dict[str, RelOutput] = {}
+        self._cte_stack: List[Dict[str, Select]] = []
+        self._counter = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _next_id(self) -> int:
+        return self.graph.next_id()
+
+    def _fresh(self, prefix: str) -> str:
+        self._counter += 1
+        return f"__{prefix}_{self._counter}"
+
+    def _edge(self, src_node_id: int, dst_parallelism: int) -> EdgeType:
+        """Forward when parallelism matches (chainable); otherwise an
+        unkeyed shuffle (round-robin)."""
+        if self.graph.nodes[src_node_id].parallelism == dst_parallelism:
+            return EdgeType.FORWARD
+        return EdgeType.SHUFFLE
+
+    def _add_value_node(
+        self,
+        upstream: RelOutput,
+        exprs: List[BoundExpr],
+        names: List[str],
+        predicate: Optional[BoundExpr],
+        description: str,
+        keep_timestamp_from: Optional[BoundExpr] = None,
+    ) -> RelOutput:
+        """Append a projection/filter node fed by `upstream` via a forward
+        edge. `exprs` excludes _timestamp, which is passed through (or
+        computed by keep_timestamp_from)."""
+        out_fields = [pa.field(n, e.dtype) for n, e in zip(names, exprs)]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        ts_idx = upstream.schema.timestamp_index
+
+        ts_expr = keep_timestamp_from or BoundExpr(
+            lambda b: b.column(ts_idx), pa.timestamp("ns"), TIMESTAMP_FIELD
+        )
+        prog = CompiledProjection(
+            exprs + [ts_expr], out_schema.schema, predicate
+        )
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.ARROW_VALUE,
+                {"py_fn": prog, "schema": out_schema, "name": description},
+                description,
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            upstream.node_id, node.node_id,
+            self._edge(upstream.node_id, self.parallelism), upstream.schema,
+        )
+        return RelOutput(
+            node.node_id,
+            out_schema,
+            Scope.from_schema(out_schema.schema),
+            window=upstream.window,
+            window_field=_passthrough_window_field(upstream, names),
+            updating=upstream.updating,
+        )
+
+    # -- entry points -------------------------------------------------------
+
+    def plan_source_table(self, t: TableDef, alias: Optional[str]) -> RelOutput:
+        cache_key = t.name.lower()
+        if cache_key in self._source_cache:
+            cached = self._source_cache[cache_key]
+            return RelOutput(
+                cached.node_id,
+                cached.schema,
+                Scope.from_schema(cached.schema.schema, alias or t.name),
+                cached.window,
+                cached.window_field,
+                cached.updating,
+            )
+        from ..connectors import get_connector
+
+        conn = get_connector(t.connector)
+        options = conn.validate_options(
+            {k: v for k, v in t.options.items()
+             if k not in ("connector", "type", "format")},
+            None,
+        )
+        event_time_field = t.options.get("event_time_field")
+        watermark_delay = DEFAULT_WATERMARK_DELAY
+        if "watermark_delay" in t.options:
+            from .parser import parse_expr_text
+
+            wd = parse_expr_text(f"interval '{t.options['watermark_delay']}'")
+            watermark_delay = wd.nanos  # type: ignore[union-attr]
+
+        if t.fields:
+            source_schema = StreamSchema(
+                add_timestamp_field(pa.schema(list(t.fields)))
+            )
+        else:
+            # column-less CREATE TABLE: the connector defines the schema
+            # (impulse, nexmark)
+            fixed = conn.table_schema()
+            if fixed is None:
+                raise SqlError(
+                    f"table {t.name} must declare columns (connector "
+                    f"{t.connector} has no fixed schema)"
+                )
+            source_schema = fixed
+        config = {
+            "connector": t.connector,
+            "schema": source_schema,
+            "format": t.options.get("format"),
+            "bad_data": t.options.get("bad_data", "fail"),
+            "event_time_field": event_time_field,
+            **options,
+        }
+        chain = [ChainedOp(OperatorName.CONNECTOR_SOURCE, config, t.name)]
+        # event-time rewrite: _timestamp = event_time_field (reference
+        # SourceRewriter, rewriters.rs)
+        if event_time_field:
+            scope = Scope.from_schema(source_schema.schema)
+            et = bind(Column(event_time_field), scope)
+            if not pa.types.is_timestamp(et.dtype):
+                raise SqlError(
+                    f"event_time_field {event_time_field} must be TIMESTAMP"
+                )
+            idxs = list(range(len(source_schema.schema) - 1))
+            exprs = [
+                BoundExpr(
+                    (lambda i: lambda b: b.column(i))(i),
+                    source_schema.schema.field(i).type,
+                    source_schema.schema.field(i).name,
+                )
+                for i in idxs
+            ]
+            prog = CompiledProjection(exprs + [et], source_schema.schema, None)
+            chain.append(
+                ChainedOp(
+                    OperatorName.PROJECTION,
+                    {"py_fn": prog, "schema": source_schema},
+                    "event_time",
+                )
+            )
+        chain.append(
+            ChainedOp(
+                OperatorName.EXPRESSION_WATERMARK,
+                {"interval_nanos": watermark_delay,
+                 "idle_time": _opt_float(t.options.get("idle_time"))},
+                "watermark",
+            )
+        )
+        node = self.graph.add_node(
+            LogicalNode(self._next_id(), t.name, chain, parallelism=1)
+        )
+        out = RelOutput(
+            node.node_id,
+            source_schema,
+            Scope.from_schema(source_schema.schema, alias or t.name),
+        )
+        self._source_cache[cache_key] = out
+        return out
+
+    # -- relations ----------------------------------------------------------
+
+    def plan_relation(self, rel: Relation) -> RelOutput:
+        if isinstance(rel, TableRef):
+            view = self._resolve_view(rel.name)
+            if view is not None:
+                out = self.plan_select(view)
+                return _requalify(out, rel.alias or rel.name)
+            t = self.provider.get_table(rel.name)
+            if t is None:
+                raise SqlError(f"unknown table {rel.name}")
+            return self.plan_source_table(t, rel.alias)
+        if isinstance(rel, SubqueryRef):
+            out = self.plan_select(rel.query)
+            return _requalify(out, rel.alias)
+        if isinstance(rel, Join):
+            return self.plan_join(rel)
+        if isinstance(rel, Unnest):
+            raise SqlError("UNNEST is not yet supported in FROM")
+        raise SqlError(f"unsupported relation {rel!r}")
+
+    def _resolve_view(self, name: str) -> Optional[Select]:
+        for scope in reversed(self._cte_stack):
+            if name.lower() in scope:
+                return scope[name.lower()]
+        return self.provider.get_view(name)
+
+    # -- select -------------------------------------------------------------
+
+    def plan_select(self, sel: Select) -> RelOutput:
+        ctes = getattr(sel, "ctes", [])
+        if ctes:
+            self._cte_stack.append({n.lower(): q for n, q in ctes})
+        try:
+            out = self._plan_select_body(sel)
+            for u in sel.unions:
+                out = self._plan_union(out, self._plan_select_body(u))
+            if sel.order_by or sel.limit is not None:
+                raise SqlError(
+                    "ORDER BY/LIMIT on unbounded streams is not supported "
+                    "(use window functions for top-N)"
+                )
+            return out
+        finally:
+            if ctes:
+                self._cte_stack.pop()
+
+    def _plan_select_body(self, sel: Select) -> RelOutput:
+        if sel.from_ is None:
+            raise SqlError("SELECT without FROM is not supported")
+        upstream = self.plan_relation(sel.from_)
+        where = bind(sel.where, upstream.scope) if sel.where is not None else None
+
+        items = self._expand_stars(sel.items, upstream)
+        has_window_fn = any(
+            isinstance(it.expr, FuncCall) and it.expr.over is not None
+            for it in items
+        )
+        if has_window_fn:
+            raise SqlError(
+                "SQL window functions (OVER) are not yet supported"
+            )
+        if sel.group_by or self._has_aggregate(items):
+            return self._plan_aggregate(sel, items, upstream, where)
+        if sel.distinct:
+            raise SqlError("SELECT DISTINCT is not yet supported")
+        # plain projection/filter
+        exprs, names = self._bind_items(items, upstream.scope)
+        return self._add_value_node(
+            upstream, exprs, names, where, _describe_items(names)
+        )
+
+    def _expand_stars(
+        self, items: List[SelectItem], upstream: RelOutput
+    ) -> List[SelectItem]:
+        out: List[SelectItem] = []
+        for it in items:
+            if isinstance(it.expr, Star):
+                for c in upstream.scope.cols:
+                    if c.name == TIMESTAMP_FIELD or c.name.startswith("__"):
+                        continue
+                    if it.expr.table and c.qualifier != it.expr.table:
+                        continue
+                    out.append(
+                        SelectItem(Column(c.name, table=c.qualifier), c.name)
+                    )
+            else:
+                out.append(it)
+        return out
+
+    def _bind_items(
+        self, items: List[SelectItem], scope: Scope
+    ) -> Tuple[List[BoundExpr], List[str]]:
+        exprs, names = [], []
+        for it in items:
+            e = bind(it.expr, scope)
+            exprs.append(e)
+            names.append(it.alias or _default_name(it.expr, e))
+        return exprs, _dedup(names)
+
+    @staticmethod
+    def _has_aggregate(items: List[SelectItem]) -> bool:
+        return any(_find_aggregates(it.expr) for it in items)
+
+    # -- aggregates ---------------------------------------------------------
+
+    def _plan_aggregate(
+        self,
+        sel: Select,
+        items: List[SelectItem],
+        upstream: RelOutput,
+        where: Optional[BoundExpr],
+    ) -> RelOutput:
+        # resolve group-by entries: ordinals and select-alias references
+        group_exprs: List[Expr] = []
+        window_spec: Optional[WindowSpec] = None
+        window_alias: Optional[str] = None
+        for g in sel.group_by:
+            g = self._resolve_group_ref(g, items)
+            if isinstance(g, FuncCall) and g.name in WINDOW_TVFS:
+                if window_spec is not None:
+                    raise SqlError("only one window function per GROUP BY")
+                window_spec = WindowSpec.from_call(g)
+                continue
+            if isinstance(g, Column):
+                # group by an alias of the window TVF select item
+                hit = _find_item_by_alias(items, g.name)
+                if hit is not None and isinstance(hit.expr, FuncCall) and (
+                    hit.expr.name in WINDOW_TVFS
+                ):
+                    window_spec = WindowSpec.from_call(hit.expr)
+                    window_alias = hit.alias
+                    continue
+            group_exprs.append(g)
+
+        # select items referencing the window TVF directly
+        for it in items:
+            if isinstance(it.expr, FuncCall) and it.expr.name in WINDOW_TVFS:
+                spec = WindowSpec.from_call(it.expr)
+                if window_spec is None:
+                    window_spec = spec
+                elif spec != window_spec:
+                    raise SqlError("conflicting window specifications")
+                window_alias = it.alias or "window"
+
+        # GROUP BY over a window struct COLUMN (aggregating an already-
+        # windowed stream, e.g. nexmark q5's MaxBids): instant mode — rows
+        # of one window share a _timestamp, so bins are exact timestamps
+        key_bound = [bind(g, upstream.scope) for g in group_exprs]
+        instant = window_spec is None and any(
+            pa.types.is_struct(b.dtype) for b in key_bound
+        )
+        if window_spec is None and not instant:
+            raise SqlError(
+                "non-windowed GROUP BY (updating aggregates) requires an "
+                "updating sink; not yet supported -- add tumble()/hop()/"
+                "session() to GROUP BY"
+            )
+
+        key_names = _dedup([_default_name(g, b) for g, b in
+                            zip(group_exprs, key_bound)])
+        agg_calls: List[FuncCall] = []
+        for it in items:
+            for call in _find_aggregates(it.expr):
+                if call not in agg_calls:
+                    agg_calls.append(call)
+        if any(c.distinct for c in agg_calls):
+            if instant or len(agg_calls) > 1 or window_spec.kind == "session":
+                raise SqlError(
+                    "count(DISTINCT) is supported alone with tumble()/hop() "
+                    "windows (two-stage rewrite)"
+                )
+            return self._plan_count_distinct(
+                sel, items, upstream, where, window_spec, window_alias,
+                group_exprs, key_bound, key_names, agg_calls[0],
+            )
+        agg_inputs: List[Optional[BoundExpr]] = []
+        for call in agg_calls:
+            if call.star or not call.args:
+                agg_inputs.append(None)
+            else:
+                if len(call.args) != 1:
+                    raise SqlError(
+                        f"{call.name}() takes one argument"
+                    )
+                agg_inputs.append(bind(call.args[0], upstream.scope))
+
+        pre_exprs = list(key_bound)
+        pre_names = list(key_names)
+        agg_col_idx: List[Optional[int]] = []
+        for b in agg_inputs:
+            if b is None:
+                agg_col_idx.append(None)
+            else:
+                pre_exprs.append(b)
+                idx = len(pre_exprs) - 1
+                pre_names.append(self._fresh("agg_in"))
+                agg_col_idx.append(idx)
+        pre = self._add_value_node(
+            upstream, pre_exprs, pre_names, where, "agg_input"
+        )
+
+        # aggregate specs
+        specs = []
+        agg_out_names = []
+        for call, col_idx in zip(agg_calls, agg_col_idx):
+            kind = call.name
+            if kind == "mean":
+                kind = "avg"
+            if call.distinct:
+                if kind != "count":
+                    raise SqlError(
+                        f"DISTINCT is only supported with count(), not {kind}"
+                    )
+                kind = "count_distinct"
+            is_float = (
+                col_idx is not None
+                and pa.types.is_floating(pre_exprs[col_idx].dtype)
+            ) or kind == "avg"
+            name = self._fresh("agg_out")
+            agg_out_names.append(name)
+            specs.append(
+                {
+                    "kind": kind,
+                    "col": col_idx,
+                    "name": name,
+                    "is_float": is_float,
+                    "in_type": (
+                        str(pre_exprs[col_idx].dtype) if col_idx is not None
+                        else None
+                    ),
+                }
+            )
+
+        # window operator output schema: keys + aggs + window struct
+        out_fields = [
+            pa.field(n, pre.schema.schema.field(i).type)
+            for i, n in enumerate(key_names)
+        ]
+        for spec, call in zip(specs, agg_calls):
+            out_fields.append(pa.field(spec["name"], _agg_output_type(
+                spec, call, pre.schema.schema)))
+        if instant:
+            wfield = None
+        else:
+            wfield = window_alias or "window"
+            out_fields.append(pa.field(wfield, WINDOW_TYPE))
+        agg_out_schema = StreamSchema(
+            add_timestamp_field(pa.schema(out_fields))
+        )
+
+        window_config: Dict = {
+            "aggregates": specs,
+            "key_cols": list(range(len(key_names))),
+            "schema": agg_out_schema,
+        }
+        if instant:
+            op_name = OperatorName.TUMBLING_WINDOW_AGGREGATE
+            window_config["width_nanos"] = 0
+            description = "instant_window"
+        else:
+            op_name = {
+                "tumbling": OperatorName.TUMBLING_WINDOW_AGGREGATE,
+                "sliding": OperatorName.SLIDING_WINDOW_AGGREGATE,
+                "session": OperatorName.SESSION_WINDOW_AGGREGATE,
+            }[window_spec.kind]
+            window_config["window_field"] = wfield
+            description = f"{window_spec.kind}_window"
+            if window_spec.kind == "tumbling":
+                window_config["width_nanos"] = window_spec.width
+            elif window_spec.kind == "sliding":
+                window_config["width_nanos"] = window_spec.width
+                window_config["slide_nanos"] = window_spec.slide
+            else:
+                window_config["gap_nanos"] = window_spec.gap
+
+        agg_node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                op_name,
+                window_config,
+                description,
+                parallelism=self.parallelism,
+            )
+        )
+        shuffle_schema = pre.schema.with_keys(key_names) if key_names else pre.schema
+        self.graph.add_edge(
+            pre.node_id, agg_node.node_id, EdgeType.SHUFFLE, shuffle_schema
+        )
+        out_window_field = wfield
+        if instant:
+            # the window struct key column carries the window downstream
+            for i, b in enumerate(key_bound):
+                if pa.types.is_struct(b.dtype):
+                    out_window_field = key_names[i]
+                    break
+        agg_out = RelOutput(
+            agg_node.node_id,
+            agg_out_schema,
+            Scope.from_schema(agg_out_schema.schema),
+            window=window_spec if not instant else upstream.window,
+            window_field=out_window_field,
+        )
+
+        # post-projection: map select items onto agg outputs; having filter
+        post_scope = _agg_post_scope(
+            agg_out, key_names, group_exprs, agg_calls, agg_out_names
+        )
+        having = (
+            bind(
+                _rewrite_group_refs(
+                    _rewrite_aggregates(sel.having, agg_calls, agg_out_names),
+                    group_exprs, key_names,
+                ),
+                post_scope,
+            )
+            if sel.having is not None
+            else None
+        )
+        post_exprs: List[BoundExpr] = []
+        post_names: List[str] = []
+        for it in items:
+            rewritten = _rewrite_aggregates(it.expr, agg_calls, agg_out_names)
+            rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
+            if isinstance(rewritten, FuncCall) and rewritten.name in WINDOW_TVFS:
+                rewritten = Column(wfield)
+            e = bind(rewritten, post_scope)
+            post_exprs.append(e)
+            post_names.append(it.alias or _default_name(it.expr, e))
+        return self._add_value_node(
+            agg_out, post_exprs, _dedup(post_names), having,
+            _describe_items(post_names),
+        )
+
+    def _plan_count_distinct(
+        self, sel, items, upstream, where, window_spec, window_alias,
+        group_exprs, key_bound, key_names, call,
+    ) -> RelOutput:
+        """count(DISTINCT x) via two stages (the reference evaluates it
+        inside DataFusion; here: windowed dedup on (keys, x) then an instant
+        count per (window, keys))."""
+        x = bind(call.args[0], upstream.scope) if call.args else None
+        if x is None:
+            raise SqlError("count(DISTINCT *) is not valid")
+        # stage 1: dedup rows per (window, keys, x): window agg with no
+        # aggregate outputs
+        pre = self._add_value_node(
+            upstream, key_bound + [x], key_names + ["__dx"], where, "distinct_in"
+        )
+        s1_fields = [
+            pa.field(n, pre.schema.schema.field(i).type)
+            for i, n in enumerate(key_names + ["__dx"])
+        ]
+        s1_fields.append(pa.field("__w", WINDOW_TYPE))
+        s1_schema = StreamSchema(add_timestamp_field(pa.schema(s1_fields)))
+        op_name = (
+            OperatorName.TUMBLING_WINDOW_AGGREGATE
+            if window_spec.kind == "tumbling"
+            else OperatorName.SLIDING_WINDOW_AGGREGATE
+        )
+        cfg: Dict = {
+            "aggregates": [],
+            "key_cols": list(range(len(key_names) + 1)),
+            "schema": s1_schema,
+            "window_field": "__w",
+            "width_nanos": window_spec.width,
+        }
+        if window_spec.kind == "sliding":
+            cfg["slide_nanos"] = window_spec.slide
+        s1 = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(), op_name, cfg, "distinct_dedup",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, s1.node_id, EdgeType.SHUFFLE,
+            pre.schema.with_keys(key_names + ["__dx"]),
+        )
+        s1_out = RelOutput(
+            s1.node_id, s1_schema, Scope.from_schema(s1_schema.schema),
+            window=window_spec, window_field="__w",
+        )
+        # stage 2: instant count per (window, keys)
+        cname = self._fresh("agg_out")
+        s2_fields = [
+            pa.field("__w", WINDOW_TYPE)
+        ] + [
+            pa.field(n, s1_schema.schema.field(i).type)
+            for i, n in enumerate(key_names)
+        ] + [pa.field(cname, pa.int64())]
+        s2_schema = StreamSchema(add_timestamp_field(pa.schema(s2_fields)))
+        s2_keys = ["__w"] + key_names
+        cfg2: Dict = {
+            "aggregates": [
+                {"kind": "count", "col": None, "name": cname,
+                 "is_float": False}
+            ],
+            "key_cols": [s1_schema.schema.names.index(k) for k in s2_keys],
+            "schema": s2_schema,
+            "width_nanos": 0,
+        }
+        s2 = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.TUMBLING_WINDOW_AGGREGATE,
+                cfg2,
+                "distinct_count",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            s1.node_id, s2.node_id, EdgeType.SHUFFLE,
+            s1_schema.with_keys(s2_keys),
+        )
+        agg_out = RelOutput(
+            s2.node_id, s2_schema, Scope.from_schema(s2_schema.schema),
+            window=window_spec, window_field="__w",
+        )
+        # post-projection
+        wfield = window_alias or "window"
+        post_scope = _agg_post_scope(
+            agg_out, key_names, group_exprs, [call], [cname]
+        )
+        having = (
+            bind(
+                _rewrite_group_refs(
+                    _rewrite_aggregates(sel.having, [call], [cname]),
+                    group_exprs, key_names,
+                ),
+                post_scope,
+            )
+            if sel.having is not None
+            else None
+        )
+        post_exprs: List[BoundExpr] = []
+        post_names: List[str] = []
+        for it in items:
+            rewritten = _rewrite_aggregates(it.expr, [call], [cname])
+            rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
+            if isinstance(rewritten, FuncCall) and rewritten.name in WINDOW_TVFS:
+                rewritten = Column("__w")
+            e = bind(rewritten, post_scope)
+            post_exprs.append(e)
+            post_names.append(it.alias or _default_name(it.expr, e))
+        out = self._add_value_node(
+            agg_out, post_exprs, _dedup(post_names), having,
+            _describe_items(post_names),
+        )
+        return dataclasses.replace(
+            out, window=window_spec,
+            window_field=wfield if wfield in post_names else
+            ("__w" if "__w" in post_names else None),
+        )
+
+    def _resolve_group_ref(self, g: Expr, items: List[SelectItem]) -> Expr:
+        if isinstance(g, Literal) and isinstance(g.value, int):
+            idx = g.value - 1
+            if idx < 0 or idx >= len(items):
+                raise SqlError(f"GROUP BY ordinal {g.value} out of range")
+            return items[idx].expr
+        if isinstance(g, Column) and g.table is None:
+            hit = _find_item_by_alias(items, g.name)
+            if hit is not None and not isinstance(hit.expr, Column):
+                return hit.expr
+        return g
+
+    # -- joins --------------------------------------------------------------
+
+    def plan_join(self, rel: Join) -> RelOutput:
+        left = self.plan_relation(rel.left)
+        right = self.plan_relation(rel.right)
+        if rel.condition is None:
+            raise SqlError("JOIN requires an ON condition")
+        merged_scope = left.scope.merge(
+            right.scope, len(left.schema.schema)
+        )
+        equi, residual = _split_join_condition(rel.condition)
+        if not equi:
+            raise SqlError("JOIN requires at least one equality condition")
+        left_keys: List[BoundExpr] = []
+        right_keys: List[BoundExpr] = []
+        for a, b in equi:
+            sides = _classify_sides(a, b, left.scope, right.scope)
+            if sides is None:
+                raise SqlError(
+                    f"join condition {a} = {b} must compare the two inputs"
+                )
+            le, re_ = sides
+            left_keys.append(bind(le, left.scope))
+            right_keys.append(bind(re_, right.scope))
+
+        windowed = (
+            left.window is not None
+            and right.window is not None
+            and left.window == right.window
+        )
+        if not windowed and rel.join_type != "inner":
+            raise SqlError(
+                "non-windowed outer joins produce updating output; updating "
+                "joins are not yet supported"
+            )
+
+        # project each side to key columns + payload
+        lpre, nkeys = self._join_side_projection(left, left_keys, "jl")
+        rpre, _ = self._join_side_projection(right, right_keys, "jr")
+
+        out_fields, left_names, right_names = _join_output_fields(
+            lpre, rpre, nkeys
+        )
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        residual_text = None
+        config = {
+            "n_keys": nkeys,
+            "join_type": rel.join_type,
+            "schema": out_schema,
+            "left_fields": left_names,
+            "right_fields": right_names,
+            "left_schema": lpre.schema,
+            "right_schema": rpre.schema,
+        }
+        if residual:
+            config["residual_py"] = self._bind_residual(
+                residual, out_schema, left, right, lpre, rpre, nkeys
+            )
+        if windowed:
+            op = OperatorName.INSTANT_JOIN
+            config["window"] = dataclasses.asdict(left.window)
+        else:
+            op = OperatorName.JOIN
+            config["ttl_nanos"] = 24 * 3600 * 1_000_000_000
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(), op, config, f"{rel.join_type}_join",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            lpre.node_id, node.node_id, EdgeType.LEFT_JOIN,
+            lpre.schema.with_keys(lpre.schema.names[:nkeys]),
+        )
+        self.graph.add_edge(
+            rpre.node_id, node.node_id, EdgeType.RIGHT_JOIN,
+            rpre.schema.with_keys(rpre.schema.names[:nkeys]),
+        )
+        scope = _join_output_scope(left, right, lpre, rpre, out_schema, nkeys)
+        return RelOutput(
+            node.node_id, out_schema, scope,
+            window=left.window if windowed else None,
+            window_field=None,
+        )
+
+    def _join_side_projection(
+        self, side: RelOutput, keys: List[BoundExpr], tag: str
+    ) -> Tuple[RelOutput, int]:
+        """Key columns first, then all original columns. Struct keys (window
+        structs) are exploded into child columns — Arrow's hash join does
+        not take struct keys. Returns (projection, physical key count)."""
+        import pyarrow.compute as pc
+
+        exprs: List[BoundExpr] = []
+        for k in keys:
+            if pa.types.is_struct(k.dtype):
+                for j in range(k.dtype.num_fields):
+                    fname = k.dtype.field(j).name
+                    exprs.append(
+                        BoundExpr(
+                            (lambda kk, fn: lambda b: pc.struct_field(
+                                kk.eval(b), fn))(k, fname),
+                            k.dtype.field(j).type,
+                            fname,
+                        )
+                    )
+            else:
+                exprs.append(k)
+        n_phys = len(exprs)
+        names = [f"__key{i}" for i in range(n_phys)]
+        for i, f in enumerate(side.schema.schema):
+            if f.name == TIMESTAMP_FIELD:
+                continue
+            exprs.append(
+                BoundExpr((lambda j: lambda b: b.column(j))(i), f.type, f.name)
+            )
+            names.append(f.name)
+        return self._add_value_node(side, exprs, _dedup(names), None, tag), n_phys
+
+    def _bind_residual(self, residual, out_schema, left, right, lpre, rpre,
+                       nkeys):
+        scope = _join_output_scope(left, right, lpre, rpre, out_schema, nkeys)
+        from functools import reduce
+
+        cond = reduce(lambda a, b: BinaryOp("AND", a, b), residual)
+        bound = bind(cond, scope)
+
+        def residual_fn(batch: pa.RecordBatch):
+            return bound.eval(batch)
+
+        return residual_fn
+
+    # -- unions -------------------------------------------------------------
+
+    def _plan_union(self, a: RelOutput, b: RelOutput) -> RelOutput:
+        if len(a.schema.schema) != len(b.schema.schema):
+            raise SqlError("UNION inputs must have the same number of columns")
+        # align b's columns to a's schema (by position, cast types)
+        exprs = []
+        names = []
+        for i, f in enumerate(a.schema.schema):
+            if f.name == TIMESTAMP_FIELD:
+                continue
+            bf = b.schema.schema.field(i)
+            be = BoundExpr(
+                (lambda j: lambda bt: bt.column(j))(i), bf.type, f.name
+            )
+            if not bf.type.equals(f.type):
+                from .expressions import _cast
+
+                be = BoundExpr(
+                    (lambda j, t: lambda bt: _cast(bt.column(j), t))(i, f.type),
+                    f.type,
+                    f.name,
+                )
+            exprs.append(be)
+            names.append(f.name)
+        b_aligned = self._add_value_node(b, exprs, names, None, "union_align")
+        # merge node: identity op with two forward-ish edges (shuffle to
+        # allow differing parallelism)
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.ARROW_VALUE,
+                {"py_fn": lambda batch: batch, "schema": a.schema},
+                "union",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(a.node_id, node.node_id, EdgeType.SHUFFLE, a.schema)
+        self.graph.add_edge(
+            b_aligned.node_id, node.node_id, EdgeType.SHUFFLE, b_aligned.schema
+        )
+        return RelOutput(
+            node.node_id, a.schema, Scope.from_schema(a.schema.schema)
+        )
+
+    # -- sinks --------------------------------------------------------------
+
+    def plan_insert(self, ins: Insert) -> int:
+        sink_table = self.provider.get_table(ins.table)
+        if sink_table is None:
+            raise SqlError(f"unknown sink table {ins.table}")
+        out = self.plan_select(ins.query)
+        return self._connect_sink(sink_table, out)
+
+    def _connect_sink(self, t: TableDef, out: RelOutput) -> int:
+        from ..connectors import get_connector
+
+        conn = get_connector(t.connector)
+        # cast/select columns to the declared sink schema by position
+        declared = t.fields
+        data_cols = [
+            f for f in out.schema.schema if f.name != TIMESTAMP_FIELD
+        ]
+        if declared and len(declared) != len(data_cols):
+            raise SqlError(
+                f"sink {t.name} expects {len(declared)} columns, query "
+                f"produces {len(data_cols)}"
+            )
+        rel = out
+        if declared:
+            exprs = []
+            names = []
+            for i, (df, qf) in enumerate(zip(declared, data_cols)):
+                idx = out.schema.schema.names.index(qf.name)
+                be = BoundExpr(
+                    (lambda j: lambda b: b.column(j))(idx), qf.type, df.name
+                )
+                if not qf.type.equals(df.type):
+                    from .expressions import _cast
+
+                    be = BoundExpr(
+                        (lambda j, tt: lambda b: _cast(b.column(j), tt))(
+                            idx, df.type
+                        ),
+                        df.type,
+                        df.name,
+                    )
+                exprs.append(be)
+                names.append(df.name)
+            rel = self._add_value_node(out, exprs, names, None, "sink_cast")
+        options = conn.validate_options(
+            {k: v for k, v in t.options.items()
+             if k not in ("connector", "type", "format")},
+            None,
+        )
+        config = {
+            "connector": t.connector,
+            "schema": rel.schema,
+            "format": t.options.get("format"),
+            **options,
+        }
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.CONNECTOR_SINK,
+                config,
+                t.name,
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            rel.node_id, node.node_id,
+            self._edge(rel.node_id, self.parallelism), rel.schema,
+        )
+        return node.node_id
+
+
+# ---------------------------------------------------------------------------
+# Aggregate helpers
+# ---------------------------------------------------------------------------
+
+
+def _find_aggregates(e: Expr) -> List[FuncCall]:
+    out: List[FuncCall] = []
+
+    def walk(x):
+        if isinstance(x, FuncCall):
+            if x.name in AGG_FUNCS and x.over is None:
+                out.append(x)
+                return  # don't descend into agg args
+            for a in x.args:
+                walk(a)
+        elif isinstance(x, BinaryOp):
+            walk(x.left)
+            walk(x.right)
+        elif isinstance(x, FieldAccess):
+            walk(x.base)
+        elif hasattr(x, "operand"):
+            walk(x.operand)
+
+    walk(e)
+    return out
+
+
+def _rewrite_group_refs(
+    e: Expr, group_exprs: List[Expr], key_names: List[str]
+) -> Expr:
+    """Replace subtrees structurally equal to a group-by expression with a
+    reference to the aggregate's key output column."""
+    if e is None:
+        return None
+    for g, name in zip(group_exprs, key_names):
+        if e == g:
+            return Column(name)
+    if isinstance(e, BinaryOp):
+        return BinaryOp(
+            e.op,
+            _rewrite_group_refs(e.left, group_exprs, key_names),
+            _rewrite_group_refs(e.right, group_exprs, key_names),
+        )
+    if isinstance(e, FieldAccess):
+        return FieldAccess(
+            _rewrite_group_refs(e.base, group_exprs, key_names), e.field
+        )
+    if isinstance(e, FuncCall):
+        return FuncCall(
+            e.name,
+            [_rewrite_group_refs(a, group_exprs, key_names) for a in e.args],
+            e.distinct,
+            e.star,
+            e.over,
+        )
+    return e
+
+
+def _rewrite_aggregates(
+    e: Expr, calls: List[FuncCall], names: List[str]
+) -> Expr:
+    """Replace aggregate calls in an expression with references to the
+    window operator's output columns."""
+    if e is None:
+        return None
+    for call, name in zip(calls, names):
+        if e == call:
+            return Column(name)
+    if isinstance(e, BinaryOp):
+        return BinaryOp(
+            e.op,
+            _rewrite_aggregates(e.left, calls, names),
+            _rewrite_aggregates(e.right, calls, names),
+        )
+    if isinstance(e, FieldAccess):
+        return FieldAccess(_rewrite_aggregates(e.base, calls, names), e.field)
+    if isinstance(e, FuncCall) and not (e.name in AGG_FUNCS and e.over is None):
+        return FuncCall(
+            e.name,
+            [_rewrite_aggregates(a, calls, names) for a in e.args],
+            e.distinct,
+            e.star,
+            e.over,
+        )
+    return e
+
+
+def _agg_output_type(spec: dict, call: FuncCall, pre_schema: pa.Schema):
+    kind = spec["kind"]
+    if kind in ("count", "count_distinct"):
+        return pa.int64()
+    if kind == "avg":
+        return pa.float64()
+    col_t = pre_schema.field(spec["col"]).type
+    if kind == "sum":
+        if pa.types.is_floating(col_t):
+            return pa.float64()
+        return pa.int64()
+    return col_t  # min/max preserve type
+
+
+def _agg_post_scope(agg_out, key_names, group_exprs, agg_calls, agg_names):
+    """Scope over the window op output: group keys resolvable by their
+    original names AND qualified forms."""
+    scope = Scope.from_schema(agg_out.schema.schema)
+    for i, g in enumerate(group_exprs):
+        if isinstance(g, Column) and g.table is not None:
+            scope.add(g.table, g.name, i, agg_out.schema.schema.field(i).type)
+    return scope
+
+
+# ---------------------------------------------------------------------------
+# Join helpers
+# ---------------------------------------------------------------------------
+
+
+def _split_join_condition(cond: Expr):
+    """AND-split into (equi pairs, residual exprs)."""
+    conjuncts: List[Expr] = []
+
+    def flat(e):
+        if isinstance(e, BinaryOp) and e.op == "AND":
+            flat(e.left)
+            flat(e.right)
+        else:
+            conjuncts.append(e)
+
+    flat(cond)
+    equi, residual = [], []
+    for c in conjuncts:
+        if isinstance(c, BinaryOp) and c.op == "=":
+            equi.append((c.left, c.right))
+        else:
+            residual.append(c)
+    return equi, residual
+
+
+def _side_of(e: Expr, scope: Scope) -> bool:
+    """True if every column in e resolves in scope."""
+    ok = True
+
+    def walk(x):
+        nonlocal ok
+        if isinstance(x, Column):
+            if scope.try_resolve(x.name, x.table) is None:
+                ok = False
+        elif isinstance(x, BinaryOp):
+            walk(x.left)
+            walk(x.right)
+        elif isinstance(x, FieldAccess):
+            walk(x.base)
+        elif isinstance(x, FuncCall):
+            for a in x.args:
+                walk(a)
+        elif hasattr(x, "operand"):
+            walk(x.operand)
+
+    walk(e)
+    return ok
+
+
+def _classify_sides(a: Expr, b: Expr, lscope: Scope, rscope: Scope):
+    if _side_of(a, lscope) and _side_of(b, rscope):
+        return a, b
+    if _side_of(b, lscope) and _side_of(a, rscope):
+        return b, a
+    return None
+
+
+def _join_output_fields(lpre: RelOutput, rpre: RelOutput, nkeys: int):
+    """Left columns (keys + payload) then right payload; duplicate names get
+    _right suffix. Returns (fields, left_names, right_names)."""
+    fields: List[pa.Field] = []
+    left_names: List[str] = []
+    right_names: List[str] = []
+    seen = set()
+    for f in lpre.schema.schema:
+        if f.name == TIMESTAMP_FIELD:
+            continue
+        fields.append(f)
+        left_names.append(f.name)
+        seen.add(f.name)
+    for i, f in enumerate(rpre.schema.schema):
+        if f.name == TIMESTAMP_FIELD or i < nkeys:
+            continue
+        name = f.name
+        while name in seen:
+            name += "_right"
+        seen.add(name)
+        fields.append(pa.field(name, f.type))
+        right_names.append(name)
+    return fields, left_names, right_names
+
+
+def _join_output_scope(left, right, lpre, rpre, out_schema, nkeys) -> Scope:
+    scope = Scope.from_schema(out_schema.schema)
+    # qualified access: left alias columns at their positions; right alias
+    # payload after left block; right KEY columns resolve to the coalesced
+    # left key positions
+    left_quals = {c.qualifier for c in left.scope.cols if c.qualifier}
+    right_quals = {c.qualifier for c in right.scope.cols if c.qualifier}
+    n_left = len([f for f in lpre.schema.schema if f.name != TIMESTAMP_FIELD])
+    for q in left_quals:
+        for c in left.scope.cols:
+            if c.qualifier != q:
+                continue
+            hit = _find_field(out_schema, c.name)
+            if hit is not None:
+                scope.add(q, c.name, hit, out_schema.schema.field(hit).type)
+    offset = n_left
+    right_payload = [
+        f for i, f in enumerate(rpre.schema.schema)
+        if f.name != TIMESTAMP_FIELD and i >= nkeys
+    ]
+    for q in right_quals:
+        for c in right.scope.cols:
+            if c.qualifier != q:
+                continue
+            # payload position
+            for j, f in enumerate(right_payload):
+                if f.name == c.name or f.name == c.name + "_right":
+                    idx = offset + j
+                    scope.add(q, c.name, idx,
+                              out_schema.schema.field(idx).type)
+                    break
+            else:
+                # fall back to the coalesced left copy (join key)
+                hit = _find_field(out_schema, c.name)
+                if hit is not None:
+                    scope.add(q, c.name, hit,
+                              out_schema.schema.field(hit).type)
+    return scope
+
+
+def _find_field(schema: StreamSchema, name: str) -> Optional[int]:
+    try:
+        return schema.schema.names.index(name)
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# misc helpers
+# ---------------------------------------------------------------------------
+
+
+def _find_item_by_alias(items: List[SelectItem], name: str):
+    for it in items:
+        if it.alias == name:
+            return it
+    return None
+
+
+def _default_name(e: Expr, bound: BoundExpr) -> str:
+    if isinstance(e, Column):
+        return e.name
+    if isinstance(e, FieldAccess):
+        return e.field
+    if isinstance(e, FuncCall):
+        return e.name
+    return bound.name
+
+
+def _dedup(names: List[str]) -> List[str]:
+    seen: Dict[str, int] = {}
+    out = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            out.append(f"{n}_{seen[n]}")
+        else:
+            seen[n] = 0
+            out.append(n)
+    return out
+
+
+def _describe_items(names: List[str]) -> str:
+    s = ", ".join(names[:4])
+    return f"select({s}{'...' if len(names) > 4 else ''})"
+
+
+def _passthrough_window_field(upstream: RelOutput, names: List[str]):
+    if upstream.window_field and upstream.window_field in names:
+        return upstream.window_field
+    return None
+
+
+def _requalify(out: RelOutput, alias: Optional[str]) -> RelOutput:
+    scope = Scope.from_schema(out.schema.schema, alias)
+    return RelOutput(
+        out.node_id, out.schema, scope, out.window, out.window_field,
+        out.updating,
+    )
+
+
+def _opt_float(v):
+    return float(v) if v is not None else None
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PlanResult:
+    graph: LogicalGraph
+    provider: SchemaProvider
+    sink_nodes: List[int]
+
+
+def plan_query(
+    sql: str,
+    provider: Optional[SchemaProvider] = None,
+    parallelism: int = 1,
+    preview_results: Optional[list] = None,
+) -> PlanResult:
+    """Compile a SQL script (CREATE TABLE/VIEW + INSERT/SELECT statements)
+    into a LogicalGraph (reference: parse_and_get_arrow_program)."""
+    provider = provider or SchemaProvider()
+    statements = parse_statements(sql)
+    planner = Planner(provider, parallelism)
+    sinks: List[int] = []
+    queries: List[Select] = []
+    inserts: List[Insert] = []
+    for st in statements:
+        if isinstance(st, CreateTable):
+            fields = [
+                pa.field(c.name, sql_type_to_arrow(c.type_name), c.nullable)
+                for c in st.columns
+            ]
+            provider.add_table(TableDef(st.name, fields, st.options))
+        elif isinstance(st, CreateView):
+            provider.add_view(st.name, st.query)
+        elif isinstance(st, Insert):
+            inserts.append(st)
+        elif isinstance(st, Select):
+            queries.append(st)
+    for ins in inserts:
+        sinks.append(planner.plan_insert(ins))
+    for q in queries:
+        out = planner.plan_select(q)
+        # bare SELECT: attach a preview sink
+        node = planner.graph.add_node(
+            LogicalNode.single(
+                planner._next_id(),
+                OperatorName.CONNECTOR_SINK,
+                {
+                    "connector": "preview",
+                    "results": preview_results if preview_results is not None
+                    else [],
+                    "schema": out.schema,
+                },
+                "preview",
+            )
+        )
+        planner.graph.add_edge(
+            out.node_id, node.node_id,
+            planner._edge(out.node_id, 1), out.schema,
+        )
+        sinks.append(node.node_id)
+    if not sinks:
+        raise SqlError("query contains no INSERT or SELECT statement")
+    return PlanResult(planner.graph, provider, sinks)

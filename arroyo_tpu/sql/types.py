@@ -1,0 +1,102 @@
+"""SQL <-> Arrow type mapping."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from .lexer import SqlError
+
+_TYPES = {
+    "BOOLEAN": pa.bool_(),
+    "BOOL": pa.bool_(),
+    "TINYINT": pa.int8(),
+    "SMALLINT": pa.int16(),
+    "INT": pa.int32(),
+    "INTEGER": pa.int32(),
+    "BIGINT": pa.int64(),
+    "INT UNSIGNED": pa.uint32(),
+    "INTEGER UNSIGNED": pa.uint32(),
+    "BIGINT UNSIGNED": pa.uint64(),
+    "SMALLINT UNSIGNED": pa.uint16(),
+    "TINYINT UNSIGNED": pa.uint8(),
+    "FLOAT": pa.float32(),
+    "REAL": pa.float32(),
+    "DOUBLE": pa.float64(),
+    "DOUBLE PRECISION": pa.float64(),
+    "DECIMAL": pa.float64(),
+    "NUMERIC": pa.float64(),
+    "TEXT": pa.string(),
+    "STRING": pa.string(),
+    "VARCHAR": pa.string(),
+    "CHAR": pa.string(),
+    "CHARACTER VARYING": pa.string(),
+    "BYTEA": pa.binary(),
+    "BYTES": pa.binary(),
+    "TIMESTAMP": pa.timestamp("ns"),
+    "DATETIME": pa.timestamp("ns"),
+    "DATE": pa.date32(),
+    "TIME": pa.time64("ns"),
+    "JSON": pa.string(),
+}
+
+WINDOW_TYPE = pa.struct(
+    [
+        pa.field("start", pa.timestamp("ns")),
+        pa.field("end", pa.timestamp("ns")),
+    ]
+)
+
+
+def sql_type_to_arrow(name: str) -> pa.DataType:
+    up = name.upper().strip()
+    if up.endswith(" ARRAY"):
+        return pa.list_(sql_type_to_arrow(up[: -len(" ARRAY")]))
+    if up in _TYPES:
+        return _TYPES[up]
+    raise SqlError(f"unsupported SQL type {name!r}")
+
+
+def arrow_type_to_sql(t: pa.DataType) -> str:
+    if pa.types.is_boolean(t):
+        return "BOOLEAN"
+    if pa.types.is_integer(t):
+        if pa.types.is_unsigned_integer(t):
+            return "BIGINT UNSIGNED"
+        return "BIGINT" if t.bit_width == 64 else "INT"
+    if pa.types.is_floating(t):
+        return "DOUBLE" if t.bit_width == 64 else "FLOAT"
+    if pa.types.is_timestamp(t):
+        return "TIMESTAMP"
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "TEXT"
+    if pa.types.is_binary(t):
+        return "BYTEA"
+    if pa.types.is_struct(t):
+        return "STRUCT"
+    if pa.types.is_list(t):
+        return f"{arrow_type_to_sql(t.value_type)} ARRAY"
+    return str(t).upper()
+
+
+def is_numeric(t: pa.DataType) -> bool:
+    return pa.types.is_integer(t) or pa.types.is_floating(t)
+
+
+def common_type(a: pa.DataType, b: pa.DataType) -> pa.DataType:
+    """Binary-op result type promotion."""
+    if a.equals(b):
+        return a
+    if pa.types.is_floating(a) or pa.types.is_floating(b):
+        return pa.float64()
+    if pa.types.is_integer(a) and pa.types.is_integer(b):
+        if pa.types.is_unsigned_integer(a) != pa.types.is_unsigned_integer(b):
+            return pa.int64()
+        t = a if a.bit_width >= b.bit_width else b
+        return t
+    if pa.types.is_timestamp(a) and pa.types.is_integer(b):
+        return a
+    if pa.types.is_integer(a) and pa.types.is_timestamp(b):
+        return b
+    if (pa.types.is_string(a) and pa.types.is_string(b)):
+        return pa.string()
+    raise SqlError(f"incompatible types {a} and {b}")

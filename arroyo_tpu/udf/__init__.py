@@ -1,0 +1,2 @@
+from . import registry  # noqa: F401
+from .registry import udf, udaf, PythonUdf  # noqa: F401

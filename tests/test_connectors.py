@@ -1,0 +1,286 @@
+"""Connectors + formats: serde roundtrips, single_file through SQL with
+restore, nexmark generation + a nexmark query, filesystem sink 2PC."""
+
+import asyncio
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from arroyo_tpu.config import update
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.formats.de import BadDataError, Deserializer
+from arroyo_tpu.formats.ser import Serializer
+from arroyo_tpu.schema import StreamSchema
+from arroyo_tpu.sql import plan_query
+
+
+def run_plan(plan, storage_url=None, job_id="t", timeout=60.0):
+    async def go():
+        eng = Engine(plan.graph, job_id=job_id, storage_url=storage_url).start()
+        await eng.join(timeout)
+        return eng
+
+    return asyncio.run(go())
+
+
+# -- formats ------------------------------------------------------------------
+
+
+def test_json_deserialize_schema_and_baddata():
+    s = StreamSchema.from_fields([("a", pa.int64()), ("b", pa.string())])
+    d = Deserializer(s, format="json", bad_data="drop", framing="newline")
+    rows = d.deserialize_slice(b'{"a": 1, "b": "x"}\nnot json\n{"a": 2}')
+    assert len(rows) == 2
+    assert rows[0]["a"] == 1 and rows[0]["b"] == "x"
+    assert rows[1]["b"] is None
+    d_fail = Deserializer(s, format="json", bad_data="fail")
+    with pytest.raises(BadDataError):
+        d_fail.deserialize_slice(b"not json")
+
+
+def test_json_timestamp_parsing_scales():
+    s = StreamSchema.from_fields([("t", pa.timestamp("ns"))])
+    d = Deserializer(s, format="json", framing="newline")
+    rows = d.deserialize_slice(
+        b'{"t": 1000000000}\n'  # seconds
+        b'{"t": 1000000000000}\n'  # millis
+        b'{"t": "2020-01-01T00:00:00Z"}',
+        timestamp=0,
+    )
+    assert rows[0]["t"] == 1_000_000_000 * 1_000_000_000
+    assert rows[1]["t"] == 1_000_000_000_000 * 1_000_000
+    assert rows[2]["t"] == 1_577_836_800 * 1_000_000_000
+
+
+def test_serializer_json_and_debezium():
+    s = StreamSchema.from_fields([("a", pa.int64())])
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array([1, 2]), pa.array([0, 0], type=pa.int64()).cast(pa.timestamp("ns"))],
+        schema=s.schema,
+    )
+    recs = list(Serializer("json").serialize(batch))
+    assert [json.loads(r) for r in recs] == [{"a": 1}, {"a": 2}]
+    dbz = [json.loads(r) for r in Serializer("debezium_json").serialize(batch)]
+    assert dbz[0]["op"] == "c" and dbz[0]["after"] == {"a": 1}
+
+
+def test_avro_roundtrip():
+    from arroyo_tpu.formats.avro import AvroDecoder, AvroEncoder, schema_from_arrow
+
+    schema = pa.schema([("x", pa.int64()), ("name", pa.string()),
+                        ("score", pa.float64())])
+    avro_schema = json.dumps(schema_from_arrow(schema))
+    enc = AvroEncoder(avro_schema, schema)
+    dec = AvroDecoder(avro_schema)
+    row = {"x": 42, "name": "hello", "score": 2.5}
+    assert dec.decode(enc.encode(row)) == row
+    assert dec.decode(enc.encode({"x": None, "name": "a", "score": 0.0}))["x"] is None
+
+
+# -- single_file through SQL with checkpoint/restore --------------------------
+
+
+def make_cars(path, n=200):
+    import random
+
+    random.seed(7)
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "timestamp": f"2023-01-01T00:00:{i % 60:02d}.{i:03d}Z",
+                "driver_id": 100 + i % 5,
+                "event_type": "pickup" if i % 2 else "dropoff",
+            }) + "\n")
+
+
+def sql_for(tmp, out_name="out.json", throttle=""):
+    return f"""
+    CREATE TABLE cars (
+      timestamp TIMESTAMP,
+      driver_id BIGINT,
+      event_type TEXT
+    ) WITH (
+      connector = 'single_file',
+      path = '{tmp}/cars.json',
+      format = 'json',
+      type = 'source',
+      event_time_field = 'timestamp'{throttle}
+    );
+    CREATE TABLE out (
+      driver_id BIGINT,
+      cnt BIGINT
+    ) WITH (
+      connector = 'single_file',
+      path = '{tmp}/{out_name}',
+      format = 'json',
+      type = 'sink'
+    );
+    INSERT INTO out
+    SELECT driver_id, cnt FROM (
+      SELECT driver_id, tumble(interval '1 minute') as w, count(*) as cnt
+      FROM cars
+      GROUP BY 1, 2
+    );
+    """
+
+
+def read_output(path):
+    with open(path) as f:
+        return sorted(
+            (json.loads(line)["driver_id"], json.loads(line)["cnt"])
+            for line in f if line.strip()
+        )
+
+
+def test_single_file_sql_roundtrip(tmp_path):
+    make_cars(tmp_path / "cars.json")
+    plan = plan_query(sql_for(tmp_path))
+    run_plan(plan)
+    out = read_output(tmp_path / "out.json")
+    assert len(out) == 5
+    assert sum(c for _, c in out) == 200
+
+
+def test_single_file_checkpoint_restore_same_output(tmp_path):
+    make_cars(tmp_path / "cars.json")
+    golden = plan_query(sql_for(tmp_path, "golden.json"))
+    run_plan(golden)
+    want = read_output(tmp_path / "golden.json")
+
+    url = str(tmp_path / "ckpt")
+
+    async def run_and_stop():
+        plan = plan_query(
+            sql_for(tmp_path, throttle=",\n      throttle_per_sec = '1000'")
+        )
+        eng = Engine(plan.graph, job_id="sfr", storage_url=url).start()
+        # let some rows flow (throttled to 1k/s), checkpoint-stop mid-stream
+        await asyncio.sleep(0.1)
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(60)
+
+    asyncio.run(run_and_stop())
+
+    plan2 = plan_query(sql_for(tmp_path))
+    run_plan(plan2, storage_url=url, job_id="sfr")
+    assert read_output(tmp_path / "out.json") == want
+
+
+# -- nexmark ------------------------------------------------------------------
+
+
+def test_nexmark_generator_proportions():
+    from arroyo_tpu.connectors.nexmark import NexmarkGenerator
+
+    g = NexmarkGenerator()
+    kinds = [g.kind_of(n) for n in range(5000)]
+    assert kinds.count("person") == 100
+    assert kinds.count("auction") == 300
+    assert kinds.count("bid") == 4600
+    # deterministic
+    e1 = g.event(77, 123)
+    e2 = NexmarkGenerator().event(77, 123)
+    assert e1 == e2
+    # bids reference existing auctions
+    for n in range(4, 50):
+        ev = g.event(n, 0)
+        if ev["bid"]:
+            assert 1000 <= ev["bid"]["auction"] <= g.last_auction_id(n)
+
+
+def test_nexmark_sql_query():
+    """q1-flavored query over the nexmark connector table."""
+    results = []
+    plan = plan_query(
+        """
+        CREATE TABLE nexmark WITH (
+          connector = 'nexmark',
+          event_rate = '100000',
+          message_count = '5000',
+          start_time = '0'
+        );
+        SELECT bid.auction as auction, bid.price * 100 as price
+        FROM nexmark WHERE bid IS NOT NULL;
+        """,
+        preview_results=results,
+    )
+    run_plan(plan)
+    assert len(results) == 4600
+    assert all(r["price"] % 100 == 0 for r in results)
+
+
+def test_nexmark_q5_shape():
+    """hop-window count grouped by auction (the q5 inner query)."""
+    results = []
+    plan = plan_query(
+        """
+        CREATE TABLE nexmark WITH (
+          connector = 'nexmark',
+          event_rate = '1000000',
+          message_count = '50000',
+          start_time = '0'
+        );
+        SELECT auction, num FROM (
+          SELECT bid.auction as auction, count(*) AS num,
+                 hop(interval '10 millisecond', interval '20 millisecond') as window
+          FROM nexmark WHERE bid IS NOT NULL
+          GROUP BY 1, window
+        );
+        """,
+        preview_results=results,
+    )
+    run_plan(plan)
+    assert len(results) > 0
+    total = sum(r["num"] for r in results)
+    # each bid appears in width/slide = 2 windows
+    assert total == 2 * 4600 * 10
+
+
+# -- filesystem sink -----------------------------------------------------------
+
+
+def test_filesystem_sink_parquet(tmp_path):
+    out_dir = tmp_path / "fs_out"
+    plan = plan_query(
+        f"""
+        CREATE TABLE impulse WITH (
+          connector = 'impulse', event_rate = '1000000',
+          message_count = '1000', start_time = '0'
+        );
+        CREATE TABLE out (
+          counter BIGINT UNSIGNED
+        ) WITH (
+          connector = 'filesystem',
+          path = '{out_dir}',
+          format = 'parquet',
+          rollover_rows = '400',
+          type = 'sink'
+        );
+        INSERT INTO out SELECT counter FROM impulse;
+        """
+    )
+    run_plan(plan)
+    import pyarrow.parquet as pq
+
+    files = [f for f in os.listdir(out_dir) if f.endswith(".parquet")]
+    assert len(files) >= 2  # rolled at 400 rows
+    total = sum(pq.read_table(out_dir / f).num_rows for f in files)
+    assert total == 1000
+    assert not [f for f in os.listdir(out_dir) if f.endswith(".tmp")]
+
+
+def test_connector_registry_metadata():
+    from arroyo_tpu.connectors import connectors
+
+    names = {c.name for c in connectors()}
+    assert {
+        "kafka", "impulse", "nexmark", "single_file", "filesystem", "sse",
+        "websocket", "polling_http", "webhook", "redis", "mqtt", "nats",
+        "rabbitmq", "kinesis", "fluvio", "stdout", "blackhole", "preview",
+        "confluent", "vec",
+    } <= names
+    for c in connectors():
+        md = c.metadata()
+        assert md["id"] and isinstance(md["config_schema"], dict)

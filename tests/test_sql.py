@@ -1,0 +1,246 @@
+"""SQL frontend: parse, plan, and execute queries end-to-end."""
+
+import asyncio
+
+import pyarrow as pa
+import pytest
+
+from arroyo_tpu.config import update
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.sql import plan_query
+from arroyo_tpu.sql.lexer import SqlError
+from arroyo_tpu.sql.parser import parse_statements
+from arroyo_tpu.sql.ast import CreateTable, CreateView, Insert
+
+MS = 1_000_000
+
+IMPULSE_DDL = """
+CREATE TABLE impulse (
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'impulse',
+  event_rate = '1000000',
+  message_count = '10000',
+  start_time = '0'
+);
+"""
+
+
+def run_sql(sql, parallelism=1, timeout=60.0):
+    results = []
+    plan = plan_query(sql, parallelism=parallelism, preview_results=results)
+
+    async def go():
+        eng = Engine(plan.graph).start()
+        await eng.join(timeout)
+
+    asyncio.run(go())
+    return results
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def test_parse_nexmark_q5():
+    sql = open("/root/reference/crates/arroyo-sql-testing/src/test/queries/nexmark_q5.sql").read()
+    stmts = parse_statements(sql)
+    assert len(stmts) == 3
+    assert isinstance(stmts[0], CreateTable)
+    assert stmts[0].options["connector"] == "single_file"
+    assert isinstance(stmts[2], Insert)
+
+
+def test_parse_views_and_intervals():
+    stmts = parse_statements(
+        """
+        CREATE VIEW v AS (SELECT * FROM t WHERE x == 1);
+        SELECT tumble(interval '1' HOUR) as w, count(*) FROM v GROUP BY 1;
+        """
+    )
+    assert isinstance(stmts[0], CreateView)
+    sel = stmts[1]
+    assert sel.group_by and len(sel.items) == 2
+
+
+def test_parse_error_has_position():
+    with pytest.raises(SqlError, match="offset"):
+        parse_statements("SELECT FROM WHERE")
+
+
+# -- execution --------------------------------------------------------------
+
+
+def test_select_projection_filter():
+    rows = run_sql(
+        IMPULSE_DDL
+        + "SELECT counter * 2 AS double, counter FROM impulse WHERE counter < 5;"
+    )
+    assert sorted(r["double"] for r in rows) == [0, 2, 4, 6, 8]
+    assert all(r["double"] == 2 * r["counter"] for r in rows)
+
+
+def test_tumbling_aggregate_with_window_access():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT window.start as s, window.end as e, cnt, total FROM (
+          SELECT tumble(interval '1 millisecond') as window,
+                 count(*) as cnt, sum(counter) as total
+          FROM impulse
+          GROUP BY 1
+        );
+        """
+    )
+    assert len(rows) == 10
+    rows.sort(key=lambda r: r["s"])
+    for i, r in enumerate(rows):
+        assert r["cnt"] == 1000
+        lo = i * 1000
+        assert r["total"] == sum(range(lo, lo + 1000))
+        assert (r["e"] - r["s"]).total_seconds() == 0.001
+
+
+def test_grouped_aggregate_parallel():
+    with update(pipeline={"source_batch_size": 256}):
+        rows = run_sql(
+            IMPULSE_DDL
+            + """
+            SELECT counter % 4 as k, tumble(interval '2 millisecond') as w,
+                   count(*) as cnt, min(counter) as lo, max(counter) as hi,
+                   avg(counter) as mean
+            FROM impulse
+            GROUP BY 1, 2;
+            """,
+            parallelism=2,
+        )
+    # 10ms data / 2ms windows = 5 windows x 4 keys
+    assert len(rows) == 20
+    for r in rows:
+        assert r["cnt"] == 500
+        assert r["lo"] % 4 == r["k"] and r["hi"] % 4 == r["k"]
+        assert r["mean"] == pytest.approx((r["lo"] + r["hi"]) / 2)
+
+
+def test_having_filters_groups():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT counter % 3 as k, tumble(interval '10 millisecond') as w,
+               count(*) as cnt
+        FROM impulse
+        GROUP BY 1, 2
+        HAVING count(*) > 3333;
+        """
+    )
+    assert len(rows) == 1  # counts: k=0 -> 3334, k=1/k=2 -> 3333
+    assert rows[0]["k"] == 0 and rows[0]["cnt"] == 3334
+
+
+def test_windowed_join_with_residual():
+    """nexmark-q5 shape: windowed counts joined with windowed max."""
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT AuctionBids.k, AuctionBids.num
+        FROM (
+          SELECT counter % 4 as k, count(*) AS num,
+                 hop(interval '2 millisecond', interval '4 millisecond') as window
+          FROM impulse
+          GROUP BY 1, window
+        ) AS AuctionBids
+        JOIN (
+          SELECT max(CountBids.num) AS maxn, CountBids.window
+          FROM (
+            SELECT counter % 4 as k, count(*) AS num,
+                   hop(interval '2 millisecond', interval '4 millisecond') as window
+            FROM impulse
+            GROUP BY 1, window
+          ) AS CountBids
+          GROUP BY CountBids.window
+        ) AS MaxBids
+        ON AuctionBids.window = MaxBids.window
+           AND AuctionBids.num >= MaxBids.maxn;
+        """
+    )
+    # every window: 4 keys with equal counts -> all rows are max
+    assert len(rows) > 0
+    # windows: hop windows over 10ms of data with 2ms slide
+    # all keys tie for max in each window, so count % 4 == 0
+    assert len(rows) % 4 == 0
+
+
+def test_union_all():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT counter FROM impulse WHERE counter < 3
+        UNION ALL
+        SELECT counter FROM impulse WHERE counter >= 9997;
+        """
+    )
+    assert sorted(r["counter"] for r in rows) == [0, 1, 2, 9997, 9998, 9999]
+
+
+def test_view_and_cte():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        CREATE VIEW odd AS SELECT * FROM impulse WHERE counter % 2 == 1;
+        WITH small AS (SELECT * FROM odd WHERE counter < 10)
+        SELECT counter FROM small;
+        """
+    )
+    assert sorted(r["counter"] for r in rows) == [1, 3, 5, 7, 9]
+
+
+def test_count_distinct_two_stage():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT tumble(interval '5 millisecond') as w,
+               count(distinct counter % 10) as dk
+        FROM impulse
+        GROUP BY 1;
+        """
+    )
+    assert len(rows) == 2
+    assert all(r["dk"] == 10 for r in rows)
+
+
+def test_case_and_scalar_functions():
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT counter,
+               CASE WHEN counter % 2 = 0 THEN 'even' ELSE 'odd' END as parity,
+               abs(counter - 5) as dist
+        FROM impulse WHERE counter < 4;
+        """
+    )
+    rows.sort(key=lambda r: r["counter"])
+    assert [r["parity"] for r in rows] == ["even", "odd", "even", "odd"]
+    assert [r["dist"] for r in rows] == [5, 4, 3, 2]
+
+
+def test_python_udf():
+    from arroyo_tpu.udf import udf
+
+    @udf(pa.int64(), [pa.int64()])
+    def triple(xs):
+        return xs * 3
+
+    rows = run_sql(
+        IMPULSE_DDL + "SELECT triple(counter) as t FROM impulse WHERE counter < 3;"
+    )
+    assert sorted(r["t"] for r in rows) == [0, 3, 6]
+
+
+def test_unknown_column_error():
+    with pytest.raises(SqlError, match="unknown column nope"):
+        plan_query(IMPULSE_DDL + "SELECT nope FROM impulse;")
+
+
+def test_unknown_table_error():
+    with pytest.raises(SqlError, match="unknown table ghost"):
+        plan_query("SELECT x FROM ghost;")

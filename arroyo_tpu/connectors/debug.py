@@ -14,7 +14,7 @@ from typing import List, Optional
 
 import pyarrow as pa
 
-from ..operators.base import Operator
+from ..operators.base import Operator, SourceFinishType, SourceOperator
 from .base import ConnectionSchema, Connector, register_connector
 
 
@@ -32,11 +32,56 @@ class VecSink(Operator):
         self.results.extend(batch.to_pylist())
 
 
+class VecSource(SourceOperator):
+    """Replays pre-built RecordBatches (benchmark/test source that isolates
+    engine throughput from data generation)."""
+
+    def __init__(self, batches: list, loops: int = 1):
+        super().__init__("vec_source")
+        self.batches = batches
+        self.loops = loops
+        self.position = 0  # (loop * len + idx), checkpointed
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"v": global_table("v")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("v")
+            stored = table.get(ctx.task_info.task_index)
+            if stored is not None:
+                self.position = stored
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("v")
+            table.put(ctx.task_info.task_index, self.position)
+
+    async def run(self, ctx, collector):
+        import asyncio
+
+        total = len(self.batches) * self.loops
+        while self.position < total:
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                return finish
+            await collector.collect(self.batches[self.position % len(self.batches)])
+            self.position += 1
+            await asyncio.sleep(0)
+        return SourceFinishType.FINAL
+
+
 @register_connector
 class VecConnector(Connector):
     name = "vec"
-    description = "in-memory capture sink for tests"
+    description = "in-memory capture sink / replay source for tests"
+    source = True
     sink = True
+
+    def make_source(self, config, schema):
+        return VecSource(config["batches"], config.get("loops", 1))
 
     def make_sink(self, config, schema):
         return VecSink(config["results"], config.get("batches"))

@@ -96,6 +96,50 @@ def _rng(n: int) -> np.random.Generator:
     return np.random.default_rng(0x5EED ^ n)
 
 
+def _u01(ns, salt: int) -> np.ndarray:
+    """Deterministic per-sequence-number uniform [0,1): counter-based via
+    splitmix64, so scalar and vectorized paths produce IDENTICAL events for
+    the same n regardless of batching."""
+    from ..types import _splitmix64
+
+    arr = np.asarray(ns, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        h = _splitmix64(arr ^ np.uint64(salt))
+    return h.astype(np.float64) / float(1 << 64)
+
+
+def _bid_fields(ns):
+    """Vectorized bid field generation shared by event() and gen_batch()."""
+    ns = np.asarray(ns, dtype=np.int64)
+    epoch = ns // PROPORTION_DENOMINATOR
+    offset = ns % PROPORTION_DENOMINATOR
+    done = np.minimum(np.maximum(offset - PERSON_PROPORTION + 1, 0),
+                      AUCTION_PROPORTION)
+    last_auction = FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + done - 1
+    last_person = FIRST_PERSON_ID + epoch
+    hot = _u01(ns, 0xA1) < (HOT_AUCTION_RATIO - 1) / HOT_AUCTION_RATIO
+    cold = FIRST_AUCTION_ID + (
+        _u01(ns, 0xA2) * np.maximum(last_auction - FIRST_AUCTION_ID + 1, 1)
+    ).astype(np.int64)
+    auction = np.where(
+        hot, (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO, cold
+    )
+    auction = np.maximum(auction, FIRST_AUCTION_ID)
+    hot_b = _u01(ns, 0xB1) < (HOT_BIDDER_RATIO - 1) / HOT_BIDDER_RATIO
+    cold_b = FIRST_PERSON_ID + (
+        _u01(ns, 0xB2) * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
+    ).astype(np.int64)
+    bidder = np.where(
+        hot_b, (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1,
+        cold_b,
+    )
+    bidder = np.maximum(bidder, FIRST_PERSON_ID)
+    # canonical Nexmark price distribution: 10^(r*6) * 100
+    price = (100.0 * 10.0 ** (_u01(ns, 0xC1) * 6.0)).astype(np.int64)
+    channel = (_u01(ns, 0xD1) * len(_CHANNELS)).astype(np.int64)
+    return auction, bidder, price, channel
+
+
 class NexmarkGenerator:
     """Pure event generator: sequence number -> event dict."""
 
@@ -177,37 +221,67 @@ class NexmarkGenerator:
                 "bid": None,
                 "_timestamp": ts,
             }
-        # bid
-        last_auction = self.last_auction_id(n)
-        if rng.integers(HOT_AUCTION_RATIO):
-            auction = (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO
-        else:
-            auction = FIRST_AUCTION_ID + int(
-                rng.integers(max(last_auction - FIRST_AUCTION_ID + 1, 1))
-            )
-        last_person = self.last_person_id(n)
-        if rng.integers(HOT_BIDDER_RATIO):
-            bidder = (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1
-        else:
-            bidder = FIRST_PERSON_ID + int(
-                rng.integers(max(last_person - FIRST_PERSON_ID + 1, 1))
-            )
-        price = int(100 * (10 ** rng.random() * 2))
-        ch = int(rng.integers(len(_CHANNELS)))
+        # bid: shared deterministic field generation (identical to the
+        # vectorized gen_batch path for the same sequence number)
+        auction, bidder, price, channel = _bid_fields([n])
+        a = int(auction[0])
         return {
             "person": None,
             "auction": None,
             "bid": {
-                "auction": max(auction, FIRST_AUCTION_ID),
-                "bidder": max(bidder, FIRST_PERSON_ID),
-                "price": price,
-                "channel": _CHANNELS[ch],
-                "url": f"https://auction.example.com/item/{auction}",
+                "auction": a,
+                "bidder": int(bidder[0]),
+                "price": int(price[0]),
+                "channel": _CHANNELS[int(channel[0])],
+                "url": f"https://auction.example.com/item/{a}",
                 "datetime": ts,
                 "extra": "",
             },
             "_timestamp": ts,
         }
+
+
+def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
+    """Vectorized batch generation for a range of sequence numbers: bids
+    (92% of events) are produced with numpy array ops; the rare person/
+    auction events go through the scalar generator. Deterministic in the
+    sequence-number range. Used by the source hot loop and benchmarks."""
+    g = NexmarkGenerator()
+    offs = ns % PROPORTION_DENOMINATOR
+    is_bid = offs >= PERSON_PROPORTION + AUCTION_PROPORTION
+    n = len(ns)
+    person_col = [None] * n
+    auction_col = [None] * n
+    bid_col = [None] * n
+    # scalar path for persons/auctions (4 of every 50 events)
+    for i in np.nonzero(~is_bid)[0]:
+        ev = g.event(int(ns[i]), int(ts[i]))
+        person_col[i] = ev["person"]
+        auction_col[i] = ev["auction"]
+    bi = np.nonzero(is_bid)[0]
+    if len(bi):
+        auction, bidder, price, channel = _bid_fields(ns[bi])
+        for j, i in enumerate(bi):
+            a = int(auction[j])
+            bid_col[i] = {
+                "auction": a,
+                "bidder": int(bidder[j]),
+                "price": int(price[j]),
+                "channel": _CHANNELS[int(channel[j])],
+                "url": f"https://auction.example.com/item/{a}",
+                "datetime": int(ts[i]),
+                "extra": "",
+            }
+    schema = NEXMARK_SCHEMA.schema
+    return pa.RecordBatch.from_arrays(
+        [
+            pa.array(person_col, type=PERSON_T),
+            pa.array(auction_col, type=AUCTION_T),
+            pa.array(bid_col, type=BID_T),
+            pa.array(ts, type=pa.int64()).cast(pa.timestamp("ns")),
+        ],
+        schema=schema,
+    )
 
 
 class NexmarkSource(SourceOperator):
@@ -253,6 +327,28 @@ class NexmarkSource(SourceOperator):
         start = self.start_time if self.start_time is not None else now_nanos()
         nanos_per_event = 1e9 / self.event_rate if self.event_rate > 0 else 0
         wall_start = time.monotonic()
+        if not self.realtime:
+            # vectorized batch generation (the benchmark hot path)
+            import numpy as np
+
+            bs = ctx.batch_size
+            while True:
+                n0 = self.index * p + me
+                if self.message_count is not None and n0 >= self.message_count:
+                    break
+                finish = await ctx.check_control(collector)
+                if finish is not None:
+                    return finish
+                count = bs
+                if self.message_count is not None:
+                    remaining = (self.message_count - 1 - n0) // p + 1
+                    count = min(bs, remaining)
+                ns = n0 + np.arange(count, dtype=np.int64) * p
+                ts = start + np.round(ns * nanos_per_event).astype(np.int64)
+                await collector.collect(gen_batch(ns, ts))
+                self.index += count
+                await asyncio.sleep(0)
+            return SourceFinishType.FINAL
         while True:
             n = self.index * p + me  # global sequence number
             if self.message_count is not None and n >= self.message_count:
@@ -260,15 +356,11 @@ class NexmarkSource(SourceOperator):
             finish = await ctx.check_control(collector)
             if finish is not None:
                 return finish
-            if self.realtime:
-                target = wall_start + (self.index * p) * nanos_per_event / 1e9
-                delay = target - time.monotonic()
-                if delay > 0:
-                    await asyncio.sleep(delay)
-                ts = now_nanos()
-            else:
-                ts = start + int(round(n * nanos_per_event))
-            ctx.buffer_row(self.gen.event(n, ts))
+            target = wall_start + (self.index * p) * nanos_per_event / 1e9
+            delay = target - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            ctx.buffer_row(self.gen.event(n, now_nanos()))
             self.index += 1
             if ctx.should_flush():
                 await self.flush_buffer(ctx, collector)

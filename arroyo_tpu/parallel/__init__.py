@@ -1,0 +1,2 @@
+from .mesh import key_mesh  # noqa: F401
+from .sharded_state import ShardedAccumulator  # noqa: F401

@@ -1,0 +1,23 @@
+"""Device mesh helpers.
+
+The engine's multi-chip axis is the KEY dimension of the keyed stream
+(SURVEY.md §5.7/§5.8): hash-range key shards map onto devices of a 1-D
+mesh, so the keyed shuffle becomes an on-device all-to-all over ICI inside
+a slice, while the host data plane (engine/network.py) carries batches
+across slices and to connectors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def key_mesh(devices: Optional[Sequence] = None, axis: str = "keys"):
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    import numpy as np
+
+    return Mesh(np.array(devices), (axis,))

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Benchmark: Nexmark q5 events/sec through the full engine.
+
+Runs the headline query (hop-window COUNT per auction joined with the
+per-window MAX — the reference's CI-covered nexmark_q5.sql shape) twice:
+  * CPU baseline: window aggregation on the numpy host backend
+  * device path:  window aggregation on the JAX backend (TPU when present)
+and prints ONE json line {"metric", "value", "unit", "vs_baseline"}.
+
+Each measurement runs in a subprocess so a wedged accelerator tunnel can
+never hang the bench; on device-path failure the CPU number is reported
+with vs_baseline 1.0.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+Q5 = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark',
+  event_rate = '{rate}',
+  message_count = '{events}',
+  start_time = '0'
+);
+SELECT AuctionBids.auction, AuctionBids.num
+FROM (
+  SELECT bid.auction as auction, count(*) AS num,
+         hop(interval '2 second', interval '10 second') as window
+  FROM nexmark WHERE bid IS NOT NULL
+  GROUP BY 1, window
+) AS AuctionBids
+JOIN (
+  SELECT max(CountBids.num) AS maxn, CountBids.window
+  FROM (
+    SELECT bid.auction as auction, count(*) AS num,
+           hop(interval '2 second', interval '10 second') as window
+    FROM nexmark WHERE bid IS NOT NULL
+    GROUP BY 1, window
+  ) AS CountBids
+  GROUP BY CountBids.window
+) AS MaxBids
+ON AuctionBids.window = MaxBids.window
+   AND AuctionBids.num >= MaxBids.maxn;
+"""
+
+
+def child(events: int, backend: str) -> None:
+    """Run q5 once; print 'RESULT <events/sec> <rows>'."""
+    import asyncio
+    import time
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from arroyo_tpu.config import config
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.sql import plan_query
+
+    config().tpu.enabled = backend == "jax"
+    config().pipeline.source_batch_size = 8192
+    # ~60s of event time so hop windows fire repeatedly mid-run
+    rate = max(events // 60, 1)
+    results = []
+    plan = plan_query(
+        Q5.format(rate=rate, events=events), preview_results=results
+    )
+    for node in plan.graph.nodes.values():
+        for op in node.chain:
+            if "backend" in op.config or op.operator.value.endswith("aggregate"):
+                op.config["backend"] = backend
+
+    async def go():
+        eng = Engine(plan.graph).start()
+        await eng.join(600)
+
+    t0 = time.monotonic()
+    asyncio.run(go())
+    dt = time.monotonic() - t0
+    print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
+
+
+def run_child(events: int, backend: str, timeout: float, env=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
+           "--events", str(events)]
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, env=env
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("RESULT "):
+            parts = line.split()
+            return {"eps": float(parts[1]), "rows": int(parts[2]),
+                    "secs": float(parts[3])}
+    sys.stderr.write(out.stderr[-2000:] + "\n")
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=1_000_000)
+    ap.add_argument("--child", choices=["numpy", "jax"])
+    ap.add_argument("--timeout", type=float, default=420.0)
+    args = ap.parse_args()
+    if args.child:
+        child(args.events, args.child)
+        return
+
+    cpu_env = dict(os.environ)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    baseline = run_child(args.events, "numpy", args.timeout, env=cpu_env)
+    device = run_child(args.events, "jax", args.timeout)
+    if device is None and baseline is None:
+        print(json.dumps({
+            "metric": "nexmark_q5_events_per_sec", "value": 0,
+            "unit": "events/s", "vs_baseline": 0.0,
+            "error": "both paths failed",
+        }))
+        return
+    if device is None:
+        device = baseline
+    if baseline is None:
+        baseline = device
+    print(json.dumps({
+        "metric": "nexmark_q5_events_per_sec",
+        "value": round(device["eps"], 1),
+        "unit": "events/s",
+        "vs_baseline": round(device["eps"] / baseline["eps"], 3),
+        "baseline_cpu_eps": round(baseline["eps"], 1),
+        "events": args.events,
+        "result_rows": device["rows"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

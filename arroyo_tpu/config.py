@@ -68,6 +68,7 @@ class PipelineConfig:
     queue_bytes: int = 32 * 2**20  # byte bound per edge queue
     chaining_enabled: bool = True
     update_aggregate_flush_interval: float = 1.0
+    update_aggregate_ttl: float = 86400.0  # idle-key eviction (1 day)
     allowed_lateness: float = 0.0
     checkpointing: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
 

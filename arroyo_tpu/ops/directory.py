@@ -20,6 +20,8 @@ class SlotDirectory:
         self.free: List[int] = []
         self.next_slot = 0
         self.n_live = 0
+        # slot -> (bin, key) reverse map, maintained by assign/take/remove
+        self.key_of: Dict[int, tuple] = {}
 
     def required_capacity(self) -> int:
         # +1 for the scratch slot used by shape padding
@@ -43,6 +45,7 @@ class SlotDirectory:
             if slot is None:
                 slot = self.free.pop() if self.free else self._alloc()
                 bin_map[key] = slot
+                self.key_of[slot] = (b, key)
                 self.n_live += 1
             slot_of_unique[u] = slot
         return slot_of_unique[inverse]
@@ -67,9 +70,34 @@ class SlotDirectory:
         bin_map = self.by_bin.pop(b, {})
         keys = list(bin_map.keys())
         slots = np.fromiter(bin_map.values(), dtype=np.int64, count=len(bin_map))
-        self.free.extend(int(s) for s in slots)
+        for s in slots:
+            self.free.append(int(s))
+            self.key_of.pop(int(s), None)
         self.n_live -= len(bin_map)
         return keys, slots
+
+    def remove(self, b: int, keys: List[tuple]) -> np.ndarray:
+        """Remove specific keys from a bin (TTL eviction); returns the freed
+        slots (caller must reset accumulator slots before reuse)."""
+        bin_map = self.by_bin.get(b)
+        if not bin_map:
+            return np.empty(0, dtype=np.int64)
+        freed = []
+        for k in keys:
+            slot = bin_map.pop(k, None)
+            if slot is not None:
+                freed.append(slot)
+                self.free.append(slot)
+                self.key_of.pop(slot, None)
+                self.n_live -= 1
+        if not bin_map:
+            self.by_bin.pop(b, None)
+        return np.asarray(freed, dtype=np.int64)
+
+    def keys_for_slots(self, slots: np.ndarray) -> List[Optional[tuple]]:
+        """Resolve slots back to their live (bin, key) in O(len(slots)) via
+        the incrementally-maintained reverse map."""
+        return [self.key_of.get(int(s)) for s in slots]
 
     def items(self):
         for b, bin_map in self.by_bin.items():

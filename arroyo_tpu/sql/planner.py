@@ -199,6 +199,27 @@ class Planner:
         ts_expr = keep_timestamp_from or BoundExpr(
             lambda b: b.column(ts_idx), pa.timestamp("ns"), TIMESTAMP_FIELD
         )
+        # updating streams carry __updating_meta through every projection
+        from ..schema import UPDATING_META_FIELD, UPDATING_META_TYPE
+
+        meta_idx = (
+            upstream.schema.schema.names.index(UPDATING_META_FIELD)
+            if UPDATING_META_FIELD in upstream.schema.schema.names
+            else None
+        )
+        if meta_idx is not None and UPDATING_META_FIELD not in names:
+            exprs = exprs + [
+                BoundExpr(
+                    (lambda j: lambda b: b.column(j))(meta_idx),
+                    UPDATING_META_TYPE,
+                    UPDATING_META_FIELD,
+                )
+            ]
+            names = names + [UPDATING_META_FIELD]
+            out_fields = [pa.field(n, e.dtype) for n, e in zip(names, exprs)]
+            out_schema = StreamSchema(
+                add_timestamp_field(pa.schema(out_fields))
+            )
         prog = CompiledProjection(
             exprs + [ts_expr], out_schema.schema, predicate
         )
@@ -475,10 +496,13 @@ class Planner:
             pa.types.is_struct(b.dtype) for b in key_bound
         )
         if window_spec is None and not instant:
+            return self._plan_updating_aggregate(
+                sel, items, upstream, where, group_exprs, key_bound
+            )
+        if upstream.updating:
             raise SqlError(
-                "non-windowed GROUP BY (updating aggregates) requires an "
-                "updating sink; not yet supported -- add tumble()/hop()/"
-                "session() to GROUP BY"
+                "windowed aggregation over an updating (retracting) input "
+                "is not yet supported"
             )
 
         key_names = _dedup([_default_name(g, b) for g, b in
@@ -598,13 +622,17 @@ class Planner:
             else:
                 window_config["gap_nanos"] = window_spec.gap
 
+        # global (unkeyed) aggregates cannot shard: all rows of a window
+        # must meet in one accumulator, so the node runs at parallelism 1
+        # (keyed aggregates shard by group key)
+        agg_par = self.parallelism if key_names else 1
         agg_node = self.graph.add_node(
             LogicalNode.single(
                 self._next_id(),
                 op_name,
                 window_config,
                 description,
-                parallelism=self.parallelism,
+                parallelism=agg_par,
             )
         )
         shuffle_schema = pre.schema.with_keys(key_names) if key_names else pre.schema
@@ -648,6 +676,128 @@ class Planner:
             rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
             if isinstance(rewritten, FuncCall) and rewritten.name in WINDOW_TVFS:
                 rewritten = Column(wfield)
+            e = bind(rewritten, post_scope)
+            post_exprs.append(e)
+            post_names.append(it.alias or _default_name(it.expr, e))
+        return self._add_value_node(
+            agg_out, post_exprs, _dedup(post_names), having,
+            _describe_items(post_names),
+        )
+
+    def _plan_updating_aggregate(
+        self, sel, items, upstream, where, group_exprs, key_bound
+    ) -> RelOutput:
+        """Non-windowed GROUP BY: updating aggregate emitting retract/append
+        pairs (reference incremental_aggregator.rs / plan/aggregate.rs
+        UpdatingAggregateExtension)."""
+        from ..schema import UPDATING_META_FIELD, UPDATING_META_TYPE
+
+        if upstream.updating:
+            raise SqlError(
+                "aggregating an updating input (retraction-consuming "
+                "aggregates) is not yet supported"
+            )
+        key_names = _dedup(
+            [_default_name(g, b) for g, b in zip(group_exprs, key_bound)]
+        )
+        agg_calls: List[FuncCall] = []
+        for it in items:
+            for call in _find_aggregates(it.expr):
+                if call not in agg_calls:
+                    agg_calls.append(call)
+        if any(c.distinct for c in agg_calls):
+            raise SqlError(
+                "count(DISTINCT) in updating aggregates is not yet supported"
+            )
+        agg_inputs: List[Optional[BoundExpr]] = []
+        for call in agg_calls:
+            if call.star or not call.args:
+                agg_inputs.append(None)
+            else:
+                agg_inputs.append(bind(call.args[0], upstream.scope))
+        pre_exprs = list(key_bound)
+        pre_names = list(key_names)
+        agg_col_idx: List[Optional[int]] = []
+        for b in agg_inputs:
+            if b is None:
+                agg_col_idx.append(None)
+            else:
+                pre_exprs.append(b)
+                pre_names.append(self._fresh("agg_in"))
+                agg_col_idx.append(len(pre_exprs) - 1)
+        pre = self._add_value_node(
+            upstream, pre_exprs, pre_names, where, "agg_input"
+        )
+        specs = []
+        agg_out_names = []
+        for call, col_idx in zip(agg_calls, agg_col_idx):
+            kind = "avg" if call.name == "mean" else call.name
+            is_float = (
+                col_idx is not None
+                and pa.types.is_floating(pre_exprs[col_idx].dtype)
+            ) or kind == "avg"
+            name = self._fresh("agg_out")
+            agg_out_names.append(name)
+            specs.append(
+                {"kind": kind, "col": col_idx, "name": name,
+                 "is_float": is_float}
+            )
+        out_fields = [
+            pa.field(n, pre.schema.schema.field(i).type)
+            for i, n in enumerate(key_names)
+        ]
+        for spec, call in zip(specs, agg_calls):
+            out_fields.append(
+                pa.field(spec["name"],
+                         _agg_output_type(spec, call, pre.schema.schema))
+            )
+        out_fields.append(pa.field(UPDATING_META_FIELD, UPDATING_META_TYPE))
+        agg_out_schema = StreamSchema(
+            add_timestamp_field(pa.schema(out_fields))
+        )
+        agg_par = self.parallelism if key_names else 1
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.UPDATING_AGGREGATE,
+                {
+                    "aggregates": specs,
+                    "key_cols": list(range(len(key_names))),
+                    "schema": agg_out_schema,
+                },
+                "updating_aggregate",
+                parallelism=agg_par,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id, EdgeType.SHUFFLE,
+            pre.schema.with_keys(key_names) if key_names else pre.schema,
+        )
+        agg_out = RelOutput(
+            node.node_id,
+            agg_out_schema,
+            Scope.from_schema(agg_out_schema.schema),
+            updating=True,
+        )
+        post_scope = _agg_post_scope(
+            agg_out, key_names, group_exprs, agg_calls, agg_out_names
+        )
+        having = (
+            bind(
+                _rewrite_group_refs(
+                    _rewrite_aggregates(sel.having, agg_calls, agg_out_names),
+                    group_exprs, key_names,
+                ),
+                post_scope,
+            )
+            if sel.having is not None
+            else None
+        )
+        post_exprs: List[BoundExpr] = []
+        post_names: List[str] = []
+        for it in items:
+            rewritten = _rewrite_aggregates(it.expr, agg_calls, agg_out_names)
+            rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
             e = bind(rewritten, post_scope)
             post_exprs.append(e)
             post_names.append(it.alias or _default_name(it.expr, e))
@@ -796,6 +946,10 @@ class Planner:
         right = self.plan_relation(rel.right)
         if rel.condition is None:
             raise SqlError("JOIN requires an ON condition")
+        if left.updating or right.updating:
+            raise SqlError(
+                "joining updating (retracting) inputs is not yet supported"
+            )
         merged_scope = left.scope.merge(
             right.scope, len(left.schema.schema)
         )
@@ -980,9 +1134,23 @@ class Planner:
 
         conn = get_connector(t.connector)
         # cast/select columns to the declared sink schema by position
+        from ..schema import UPDATING_META_FIELD
+
+        if out.updating:
+            # retract rows need an encoding; plain json/raw sinks would
+            # silently serialize them as appends
+            fmt = t.options.get("format")
+            if fmt != "debezium_json" and t.connector not in (
+                "vec", "preview", "blackhole"
+            ):
+                raise SqlError(
+                    f"sink {t.name} receives an updating stream and must use "
+                    "format = 'debezium_json' (or a debug sink)"
+                )
         declared = t.fields
         data_cols = [
-            f for f in out.schema.schema if f.name != TIMESTAMP_FIELD
+            f for f in out.schema.schema
+            if f.name not in (TIMESTAMP_FIELD, UPDATING_META_FIELD)
         ]
         if declared and len(declared) != len(data_cols):
             raise SqlError(
@@ -1022,18 +1190,21 @@ class Planner:
             "format": t.options.get("format"),
             **options,
         }
+        # sinks default to parallelism 1 (single_file/stdout write one
+        # stream; scalable sinks opt in via the sink_parallelism option)
+        sink_par = int(t.options.get("sink_parallelism", 1))
         node = self.graph.add_node(
             LogicalNode.single(
                 self._next_id(),
                 OperatorName.CONNECTOR_SINK,
                 config,
                 t.name,
-                parallelism=self.parallelism,
+                parallelism=sink_par,
             )
         )
         self.graph.add_edge(
             rel.node_id, node.node_id,
-            self._edge(rel.node_id, self.parallelism), rel.schema,
+            self._edge(rel.node_id, sink_par), rel.schema,
         )
         return node.node_id
 

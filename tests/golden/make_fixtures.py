@@ -1,0 +1,78 @@
+"""Deterministic input fixtures for the golden-query harness.
+
+Run `python tests/golden/make_fixtures.py` to regenerate
+tests/golden/inputs/*.json (committed; the harness only reads them).
+"""
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+INPUTS = os.path.join(HERE, "inputs")
+
+
+def impulse(n=600):
+    # one event per 100ms from t0; counter + subtask_index
+    t0 = "2023-03-01T00:00:"
+    rows = []
+    for i in range(n):
+        secs = i // 10
+        ms = (i % 10) * 100
+        ts = f"2023-03-01T00:{secs // 60:02d}:{secs % 60:02d}.{ms:03d}Z"
+        rows.append({"timestamp": ts, "counter": i, "subtask_index": 0})
+    return rows
+
+
+def cars(n=400):
+    rows = []
+    for i in range(n):
+        # monotone through 5 minutes with bounded (sub-watermark) disorder
+        secs = (i * 300) // n + (i * 7) % 2
+        ts = f"2023-03-01T01:{secs // 60:02d}:{secs % 60:02d}Z"
+        rows.append(
+            {
+                "timestamp": ts,
+                "driver_id": 100 + (i * 13) % 7,
+                "event_type": "pickup" if (i * 5) % 3 else "dropoff",
+                "location": ["downtown", "airport", "suburb"][(i * 11) % 3],
+            }
+        )
+    return rows
+
+
+def bids(n=2000):
+    rows = []
+    for i in range(n):
+        # monotone through one minute with bounded disorder
+        millis = i * 30 + (i * 37) % 500
+        secs = millis // 1000
+        ts = (
+            f"2023-03-01T02:{secs // 60:02d}:{secs % 60:02d}"
+            f".{millis % 1000:03d}Z"
+        )
+        rows.append(
+            {
+                "datetime": ts,
+                "auction": 1000 + (i * 17) % 20,
+                "bidder": 2000 + (i * 29) % 50,
+                "price": 100 + (i * 71) % 9000,
+            }
+        )
+    return rows
+
+
+def main():
+    os.makedirs(INPUTS, exist_ok=True)
+    for name, rows in [
+        ("impulse.json", impulse()),
+        ("cars.json", cars()),
+        ("nexmark_bids.json", bids()),
+    ]:
+        with open(os.path.join(INPUTS, name), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        print(f"wrote {name}: {len(rows)} rows")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  mn BIGINT, mx BIGINT, s BIGINT, cnt BIGINT, mean DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT min(counter), max(counter), sum(counter), count(*), avg(counter) FROM (
+  SELECT counter, tumble(interval '10 second') as w FROM impulse GROUP BY counter, w
+) GROUP BY w;

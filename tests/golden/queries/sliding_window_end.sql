@@ -1,0 +1,26 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  start TIMESTAMP, end TIMESTAMP, cnt BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT window.start, window.end, cnt FROM (
+  SELECT hop(interval '5 second', interval '15 second') as window,
+         count(*) as cnt
+  FROM impulse
+  GROUP BY 1
+);

@@ -1,0 +1,22 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE VIEW odd AS (SELECT counter FROM impulse WHERE counter % 2 == 1);
+CREATE TABLE out (counter BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT counter FROM odd WHERE counter < 10
+UNION ALL
+SELECT counter FROM impulse WHERE counter >= 595;

@@ -1,0 +1,22 @@
+--pk=k
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (k BIGINT, cnt BIGINT, total BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT counter % 7 as k, count(*) as cnt, sum(counter) as total
+FROM impulse
+GROUP BY 1;

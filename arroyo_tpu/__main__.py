@@ -1,0 +1,177 @@
+"""CLI: all roles as subcommands of one entrypoint.
+
+Capability parity with the reference binary
+(/root/reference/crates/arroyo/src/main.rs:43-120): `run` (single-process
+cluster for one query), `worker`, `controller`, `api`, `cluster`
+(api+controller), `visualize` (DAG dump), plus `bench` for the nexmark
+benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="arroyo_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="run a query in an embedded cluster")
+    run_p.add_argument("query", help="SQL text or path to a .sql file")
+    run_p.add_argument("--parallelism", type=int, default=1)
+    run_p.add_argument("--state-dir", default=None,
+                       help="checkpoint storage URL (enables durability)")
+    run_p.add_argument("--workers", type=int, default=1)
+    run_p.add_argument("--scheduler", default="embedded",
+                       choices=["embedded", "process"])
+
+    w_p = sub.add_parser("worker", help="start a worker")
+    w_p.add_argument("--controller", required=True)
+
+    c_p = sub.add_parser("controller", help="start a controller")
+    c_p.add_argument("--scheduler", default=None,
+                     choices=["embedded", "process", "manual", "kubernetes"])
+    c_p.add_argument("--port", type=int, default=None)
+
+    api_p = sub.add_parser("api", help="start the REST API server")
+    api_p.add_argument("--port", type=int, default=None)
+
+    cl_p = sub.add_parser("cluster", help="start api + controller")
+    cl_p.add_argument("--port", type=int, default=None)
+    cl_p.add_argument("--scheduler", default="process")
+
+    v_p = sub.add_parser("visualize", help="print a query's dataflow DAG")
+    v_p.add_argument("query")
+
+    sub.add_parser("bench", help="run the nexmark benchmark")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "run":
+        return asyncio.run(_run(args))
+    if args.cmd == "worker":
+        return asyncio.run(_worker(args))
+    if args.cmd == "controller":
+        return asyncio.run(_controller(args))
+    if args.cmd == "api":
+        return asyncio.run(_api(args))
+    if args.cmd == "cluster":
+        return asyncio.run(_cluster(args))
+    if args.cmd == "visualize":
+        return _visualize(args)
+    if args.cmd == "bench":
+        import subprocess
+
+        return subprocess.call([sys.executable, "bench.py"])
+
+
+def _load_sql(q: str) -> str:
+    import os
+
+    if os.path.exists(q) and q.endswith(".sql"):
+        return open(q).read()
+    return q
+
+
+async def _run(args):
+    """reference crates/arroyo/src/run.rs: embedded cluster, one query."""
+    from .controller.controller import ControllerServer
+    from .controller.scheduler import make_scheduler
+    from .controller.state_machine import JobState
+    from .sql import plan_query
+    from .utils import init_logging
+
+    init_logging()
+    sql = _load_sql(args.query)
+    plan_query(sql, parallelism=args.parallelism)  # validate before boot
+    controller = await ControllerServer(
+        make_scheduler(args.scheduler)
+    ).start()
+    job = await controller.submit_job(
+        "job_cli", sql=sql, storage_url=args.state_dir,
+        n_workers=args.workers, parallelism=args.parallelism,
+    )
+    try:
+        state = await controller.wait_for_state(
+            "job_cli", JobState.FINISHED, JobState.FAILED, JobState.STOPPED,
+            timeout=86400,
+        )
+        print(f"job {state.value.lower()}")
+        return 0 if state != JobState.FAILED else 1
+    except KeyboardInterrupt:
+        await controller.stop_job("job_cli", "checkpoint"
+                                  if args.state_dir else "graceful")
+        await controller.wait_for_state(
+            "job_cli", JobState.STOPPED, JobState.FAILED, timeout=60
+        )
+        return 0
+    finally:
+        await controller.stop()
+
+
+async def _worker(args):
+    from .engine.worker import worker_main
+    from .utils import init_logging
+
+    init_logging()
+    await worker_main(args.controller)
+
+
+async def _controller(args):
+    from .config import config
+    from .controller.controller import ControllerServer
+    from .controller.scheduler import make_scheduler
+    from .utils import init_logging
+
+    init_logging()
+    sched = make_scheduler(args.scheduler or config().controller.scheduler)
+    c = ControllerServer(sched)
+    if args.port:
+        c.rpc.port = args.port
+    await c.start()
+    print(f"controller listening at {c.addr}")
+    await asyncio.Event().wait()
+
+
+async def _api(args):
+    from .api.rest import serve_api
+    from .utils import init_logging
+
+    init_logging()
+    await serve_api(port=args.port)
+
+
+async def _cluster(args):
+    from .api.rest import serve_api
+    from .config import config
+    from .controller.controller import ControllerServer
+    from .controller.scheduler import make_scheduler
+    from .utils import init_logging
+
+    init_logging()
+    c = ControllerServer(make_scheduler(args.scheduler))
+    await c.start()
+    print(f"controller at {c.addr}")
+    await serve_api(port=args.port, controller=c)
+
+
+def _visualize(args):
+    from .sql import plan_query
+
+    plan = plan_query(_load_sql(args.query))
+    g = plan.graph
+    print("digraph pipeline {")
+    for n in g.nodes.values():
+        ops = " | ".join(op.operator.value for op in n.chain)
+        print(f'  n{n.node_id} [label="{n.description}\\n{ops}\\n'
+              f'p={n.parallelism}"];')
+    for e in g.edges:
+        print(f'  n{e.src} -> n{e.dst} [label="{e.edge_type.value}"];')
+    print("}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

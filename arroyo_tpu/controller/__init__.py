@@ -1,0 +1,2 @@
+from .controller import ControllerServer  # noqa: F401
+from .state_machine import JobState  # noqa: F401

@@ -1,0 +1,403 @@
+"""Controller server: job lifecycle, scheduling, checkpoint cadence, 2PC.
+
+Capability parity with the reference's controller
+(/root/reference/crates/arroyo-controller/src/lib.rs:547-706 +
+src/job_controller/): hosts ControllerGrpc (worker registration,
+heartbeats, task/checkpoint events), drives each job's state machine
+(Scheduling: compute slots, round-robin TaskAssignments, StartExecution to
+every worker — scheduling.rs:65-100; Running: periodic checkpoints,
+manifest assembly + publication through the generation protocol, phase-2
+commits — job_controller/controller.rs; failure handling: task errors and
+heartbeat timeouts escalate to Recovering, which tears the job down and
+reschedules from the latest durable checkpoint — states/recovering.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from ..config import config
+from ..graph.logical import LogicalGraph
+from ..state.backend import StateBackend
+from ..types import now_nanos
+from ..utils.logging import get_logger
+from ..engine.rpc import RpcClient, RpcServer
+from .scheduler import Scheduler, make_scheduler
+from .state_machine import JobState, check_transition
+
+logger = get_logger("controller")
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: int, rpc_addr: str, data_addr: str,
+                 slots: int):
+        self.worker_id = worker_id
+        self.rpc_addr = rpc_addr
+        self.data_addr = data_addr
+        self.slots = slots
+        self.last_heartbeat = time.monotonic()
+        self.client = RpcClient(rpc_addr)
+        self.job_id: Optional[str] = None
+
+
+class JobHandle:
+    def __init__(self, job_id: str, graph: LogicalGraph,
+                 storage_url: Optional[str], sql: Optional[str] = None,
+                 parallelism: int = 1):
+        self.job_id = job_id
+        self.graph = graph
+        self.sql = sql  # canonical program: workers re-plan deterministically
+        self.parallelism = parallelism
+        self.storage_url = storage_url
+        self.state = JobState.CREATED
+        self.backend: Optional[StateBackend] = None
+        self.workers: List[WorkerHandle] = []
+        self.assignments: Dict[tuple, int] = {}
+        self.epoch = 0
+        self.n_subtasks = sum(n.parallelism for n in graph.nodes.values())
+        # epoch -> {task_id: report}
+        self.checkpoints: Dict[int, Dict[str, dict]] = {}
+        self.finished_tasks: set = set()
+        self.failure: Optional[str] = None
+        self.stop_requested: Optional[str] = None
+        self.restarts = 0
+        self.events: List[dict] = []
+
+    def transition(self, nxt: JobState):
+        check_transition(self.state, nxt)
+        logger.info("job %s: %s -> %s", self.job_id, self.state.value,
+                    nxt.value)
+        self.events.append(
+            {"time": now_nanos(), "from": self.state.value, "to": nxt.value}
+        )
+        self.state = nxt
+
+
+class ControllerServer:
+    def __init__(self, scheduler: Optional[Scheduler] = None,
+                 bind: str = "127.0.0.1", max_restarts: int = 3):
+        self.scheduler = scheduler or make_scheduler(
+            config().controller.scheduler
+        )
+        self.rpc = RpcServer(bind)
+        self.bind = bind
+        self.workers: Dict[int, WorkerHandle] = {}
+        self.jobs: Dict[str, JobHandle] = {}
+        self.max_restarts = max_restarts
+        self._job_tasks: Dict[str, asyncio.Task] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "ControllerServer":
+        self.rpc.add_service(
+            "ControllerGrpc",
+            {
+                "RegisterWorker": self._register_worker,
+                "Heartbeat": self._heartbeat,
+                "TaskCheckpointEvent": self._task_checkpoint_event,
+                "TaskCheckpointCompleted": self._task_checkpoint_completed,
+                "TaskFinished": self._task_finished,
+                "TaskFailed": self._task_failed,
+                "WorkerFinished": self._worker_finished,
+            },
+        )
+        port = await self.rpc.start()
+        self.addr = f"{self.bind}:{port}"
+        logger.info("controller up at %s", self.addr)
+        return self
+
+    async def stop(self):
+        for t in self._job_tasks.values():
+            t.cancel()
+        await asyncio.gather(*self._job_tasks.values(),
+                             return_exceptions=True)
+        for w in self.workers.values():
+            await w.client.close()
+        for job in self.jobs.values():
+            for w in job.workers:
+                await w.client.close()
+        await self.rpc.stop()
+
+    # -- ControllerGrpc -----------------------------------------------------
+
+    async def _register_worker(self, req: dict) -> dict:
+        w = WorkerHandle(req["worker_id"], req["rpc_addr"], req["data_addr"],
+                         req.get("slots", 1))
+        self.workers[w.worker_id] = w
+        logger.info("worker %s registered (%s)", w.worker_id, w.rpc_addr)
+        return {}
+
+    async def _heartbeat(self, req: dict) -> dict:
+        w = self.workers.get(req["worker_id"])
+        if w is not None:
+            w.last_heartbeat = time.monotonic()
+        return {}
+
+    async def _task_checkpoint_event(self, req: dict) -> dict:
+        return {}
+
+    async def _task_checkpoint_completed(self, req: dict) -> dict:
+        for job in self.jobs.values():
+            if any(w.worker_id == req["worker_id"] for w in job.workers):
+                job.checkpoints.setdefault(req["epoch"], {})[req["task_id"]] = req
+        return {}
+
+    async def _task_finished(self, req: dict) -> dict:
+        for job in self.jobs.values():
+            if any(w.worker_id == req["worker_id"] for w in job.workers):
+                job.finished_tasks.add(req["task_id"])
+        return {}
+
+    async def _task_failed(self, req: dict) -> dict:
+        for job in self.jobs.values():
+            if any(w.worker_id == req["worker_id"] for w in job.workers):
+                if job.failure is None:
+                    job.failure = f"{req['task_id']}: {req['error']}"
+        return {}
+
+    async def _worker_finished(self, req: dict) -> dict:
+        return {}
+
+    # -- job API ------------------------------------------------------------
+
+    async def submit_job(
+        self,
+        job_id: str,
+        sql: Optional[str] = None,
+        graph: Optional[LogicalGraph] = None,
+        storage_url: Optional[str] = None,
+        n_workers: int = 1,
+        parallelism: int = 1,
+    ) -> JobHandle:
+        """Submit by SQL (workers re-plan the canonical text — the moral
+        equivalent of shipping the reference's ArrowProgram proto) or by a
+        pre-built LogicalGraph (single-process/embedded paths)."""
+        if graph is None:
+            from ..sql import plan_query
+
+            graph = plan_query(sql, parallelism=parallelism).graph
+        job = JobHandle(job_id, graph, storage_url, sql=sql,
+                        parallelism=parallelism)
+        self.jobs[job_id] = job
+        self._job_tasks[job_id] = asyncio.ensure_future(
+            self._drive_job(job, n_workers)
+        )
+        return job
+
+    async def stop_job(self, job_id: str, mode: str = "checkpoint"):
+        self.jobs[job_id].stop_requested = mode
+
+    async def wait_for_state(self, job_id: str, *states: JobState,
+                             timeout: float = 120.0):
+        deadline = time.monotonic() + timeout
+        job = self.jobs[job_id]
+        while job.state not in states:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {job_id} stuck in {job.state} waiting for {states}"
+                )
+            await asyncio.sleep(0.02)
+        return job.state
+
+    # -- state machine driver ----------------------------------------------
+
+    async def _drive_job(self, job: JobHandle, n_workers: int):
+        try:
+            while not job.state.is_terminal():
+                if job.state == JobState.CREATED:
+                    job.transition(JobState.SCHEDULING)
+                elif job.state == JobState.SCHEDULING:
+                    await self._schedule(job, n_workers)
+                elif job.state == JobState.RUNNING:
+                    await self._run(job)
+                elif job.state == JobState.RECOVERING:
+                    await self._recover(job, n_workers)
+                else:
+                    break
+        except Exception:
+            logger.exception("job %s driver crashed", job.job_id)
+            job.failure = job.failure or "driver crashed"
+            if not job.state.is_terminal():
+                job.state = JobState.FAILED
+
+    async def _schedule(self, job: JobHandle, n_workers: int):
+        """reference scheduling.rs:65-100."""
+        if job.storage_url and job.backend is None:
+            job.backend = StateBackend(job.storage_url, job.job_id).initialize()
+        await self.scheduler.start_workers(self.addr, n_workers, job.job_id)
+        deadline = time.monotonic() + 30
+        while len(self._free_workers()) < n_workers:
+            if time.monotonic() > deadline:
+                raise TimeoutError("workers did not register in time")
+            await asyncio.sleep(0.02)
+        job.workers = self._free_workers()[:n_workers]
+        for w in job.workers:
+            w.job_id = job.job_id
+        # round-robin subtask assignment
+        job.assignments = {}
+        wi = 0
+        for node in job.graph.topo_order():
+            for i in range(node.parallelism):
+                job.assignments[(node.node_id, i)] = (
+                    job.workers[wi % len(job.workers)].worker_id
+                )
+                wi += 1
+        job.checkpoints.clear()
+        job.finished_tasks.clear()
+        job.failure = None
+        req = {
+            "job_id": job.job_id,
+            "sql": job.sql,
+            "parallelism": job.parallelism,
+            "graph": None if job.sql else job.graph.to_json(),
+            "assignments": [
+                {"node_id": n, "subtask": s, "worker_id": w}
+                for (n, s), w in job.assignments.items()
+            ],
+            "worker_data_addrs": {
+                str(w.worker_id): w.data_addr for w in job.workers
+            },
+            "storage_url": job.storage_url,
+            "generation": job.backend.generation if job.backend else None,
+            "restore_epoch": job.backend.restore_epoch if job.backend else None,
+        }
+        if job.backend and job.backend.restore_epoch:
+            job.epoch = job.backend.restore_epoch
+        for w in job.workers:
+            await w.client.call("WorkerGrpc", "StartExecution", req)
+        # all partitions built + routes registered: release the sources
+        for w in job.workers:
+            await w.client.call("WorkerGrpc", "StartProcessing", {})
+        job.transition(JobState.RUNNING)
+
+    async def _run(self, job: JobHandle):
+        """Checkpoint cadence + completion/failure watching
+        (reference job_controller/controller.rs:292-551)."""
+        cfg = config()
+        interval = cfg.pipeline.checkpointing.interval
+        last_checkpoint = time.monotonic()
+        while True:
+            await asyncio.sleep(0.02)
+            if job.failure is not None:
+                job.transition(JobState.RECOVERING)
+                return
+            if self._heartbeat_expired(job):
+                job.failure = "worker heartbeat timeout"
+                job.transition(JobState.RECOVERING)
+                return
+            if len(job.finished_tasks) >= job.n_subtasks:
+                job.transition(JobState.FINISHING)
+                job.transition(JobState.FINISHED)
+                await self.scheduler.stop_workers(job.job_id)
+                return
+            if job.stop_requested:
+                mode = job.stop_requested
+                job.stop_requested = None
+                if mode == "checkpoint" and job.backend:
+                    job.transition(JobState.CHECKPOINT_STOPPING)
+                    await self._checkpoint(job, then_stop=True)
+                    await self._await_all_finished(job)
+                    job.transition(JobState.STOPPED)
+                else:
+                    job.transition(JobState.STOPPING)
+                    for w in job.workers:
+                        await w.client.call(
+                            "WorkerGrpc", "StopExecution",
+                            {"mode": "graceful" if mode == "graceful"
+                             else "immediate"},
+                        )
+                    await self._await_all_finished(job)
+                    job.transition(JobState.STOPPED)
+                await self.scheduler.stop_workers(job.job_id)
+                return
+            if (
+                job.backend is not None
+                and time.monotonic() - last_checkpoint >= interval
+            ):
+                last_checkpoint = time.monotonic()
+                await self._checkpoint(job)
+
+    async def _checkpoint(self, job: JobHandle, then_stop: bool = False):
+        job.epoch += 1
+        epoch = job.epoch
+        for w in job.workers:
+            await w.client.call(
+                "WorkerGrpc", "Checkpoint",
+                {"epoch": epoch, "then_stop": then_stop},
+            )
+        deadline = time.monotonic() + 60
+        while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
+            if job.failure is not None or time.monotonic() > deadline:
+                logger.warning("checkpoint %d incomplete", epoch)
+                return
+            await asyncio.sleep(0.02)
+        reports = job.checkpoints[epoch]
+        manifest = job.backend.publish_checkpoint(
+            epoch, {tid: _Report(r) for tid, r in reports.items()}
+        )
+        if manifest.get("committing") and job.backend.claim_commit(epoch):
+            for w in job.workers:
+                await w.client.call(
+                    "WorkerGrpc", "Commit",
+                    {"epoch": epoch, "committing": manifest["committing"]},
+                )
+
+    async def _await_all_finished(self, job: JobHandle, timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        while len(job.finished_tasks) < job.n_subtasks:
+            if time.monotonic() > deadline:
+                logger.warning("job %s: tasks did not finish in time",
+                               job.job_id)
+                return
+            await asyncio.sleep(0.02)
+
+    async def _recover(self, job: JobHandle, n_workers: int):
+        """reference states/recovering.rs:24-60 (escalating teardown) then
+        reschedule from the latest durable checkpoint."""
+        job.restarts += 1
+        if job.restarts > self.max_restarts:
+            job.transition(JobState.FAILED)
+            await self.scheduler.stop_workers(job.job_id, force=True)
+            return
+        logger.warning("job %s recovering (%s)", job.job_id, job.failure)
+        for w in job.workers:
+            try:
+                await w.client.call(
+                    "WorkerGrpc", "StopExecution", {"mode": "immediate"},
+                    timeout=2.0,
+                )
+            except Exception:  # noqa: BLE001 - worker may be dead
+                pass
+            self.workers.pop(w.worker_id, None)
+        await self.scheduler.stop_workers(job.job_id, force=True)
+        # new generation fences the old one; restore from latest manifest
+        if job.backend is not None:
+            job.backend = StateBackend(
+                job.storage_url, job.job_id
+            ).initialize()
+        job.transition(JobState.SCHEDULING)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _free_workers(self) -> List[WorkerHandle]:
+        return [w for w in self.workers.values() if w.job_id is None]
+
+    def _heartbeat_expired(self, job: JobHandle) -> bool:
+        timeout = config().controller.heartbeat_timeout
+        return any(
+            time.monotonic() - w.last_heartbeat > timeout for w in job.workers
+        )
+
+
+class _Report:
+    """Adapts the rpc dict to the CheckpointCompletedResp shape the backend
+    expects."""
+
+    def __init__(self, d: dict):
+        self.node_id = d["node_id"]
+        self.subtask_index = d["subtask"]
+        self.subtask_metadata = d.get("metadata") or {}
+        self.watermark = d.get("watermark")
+        self.commit_data = d.get("commit_data")

@@ -1,0 +1,69 @@
+"""Job state machine.
+
+Capability parity with the reference's controller states
+(/root/reference/crates/arroyo-controller/src/states/mod.rs:98-186):
+Created -> Scheduling -> Running with Recovering (task/worker failure ->
+teardown -> reschedule from the latest durable checkpoint), Rescaling
+(checkpoint-stop -> reschedule with new parallelism), Restarting
+(safe|force), Stopping/CheckpointStopping, and terminal
+Stopped/Finished/Failed; retryable transitions with bounded backoff
+(states/mod.rs:559).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class JobState(enum.Enum):
+    CREATED = "Created"
+    COMPILING = "Compiling"
+    SCHEDULING = "Scheduling"
+    RUNNING = "Running"
+    RESCALING = "Rescaling"
+    RESTARTING = "Restarting"
+    RECOVERING = "Recovering"
+    STOPPING = "Stopping"
+    CHECKPOINT_STOPPING = "CheckpointStopping"
+    FINISHING = "Finishing"
+    FAILING = "Failing"
+    STOPPED = "Stopped"
+    FINISHED = "Finished"
+    FAILED = "Failed"
+
+    def is_terminal(self) -> bool:
+        return self in (JobState.STOPPED, JobState.FINISHED, JobState.FAILED)
+
+
+# legal transitions (superset; the controller drives the actual flow)
+TRANSITIONS = {
+    JobState.CREATED: {JobState.COMPILING, JobState.SCHEDULING, JobState.FAILED},
+    JobState.COMPILING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.SCHEDULING: {JobState.RUNNING, JobState.FAILED, JobState.STOPPED},
+    JobState.RUNNING: {
+        JobState.RECOVERING,
+        JobState.RESCALING,
+        JobState.RESTARTING,
+        JobState.STOPPING,
+        JobState.CHECKPOINT_STOPPING,
+        JobState.FINISHING,
+        JobState.FAILING,
+        JobState.FINISHED,
+    },
+    JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.RESTARTING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
+    JobState.CHECKPOINT_STOPPING: {JobState.STOPPED, JobState.FAILED},
+    JobState.FINISHING: {JobState.FINISHED, JobState.FAILED},
+    JobState.FAILING: {JobState.FAILED},
+}
+
+
+class IllegalTransition(Exception):
+    pass
+
+
+def check_transition(cur: JobState, nxt: JobState):
+    if nxt not in TRANSITIONS.get(cur, set()):
+        raise IllegalTransition(f"{cur.value} -> {nxt.value}")

@@ -50,28 +50,74 @@ class Program:
         self.job_id = job_id
         self.subtasks: List[Subtask] = []
         self.control_resp: asyncio.Queue = asyncio.Queue()
+        self.remote_senders: List = []  # cross-worker edge pumps
         self._state_backend = None  # set via with_state before build
 
     def with_state(self, backend) -> "Program":
         self._state_backend = backend
         return self
 
-    def build(self, restore_metadata: Optional[dict] = None) -> "Program":
-        """Construct all operators, queues and runners.
+    def build(
+        self,
+        restore_metadata: Optional[dict] = None,
+        assignments: Optional[Dict[Tuple[int, int], int]] = None,
+        my_worker: Optional[int] = None,
+        worker_addrs: Optional[Dict[int, str]] = None,
+        data_server=None,
+    ) -> "Program":
+        """Construct operators, queues and runners.
 
-        restore_metadata: checkpoint metadata dict (node_id -> op tables
-        metadata) when restoring from a checkpoint.
+        Single-process by default. For multi-worker execution
+        (reference: Program::from_logical + network connect, engine.rs:525):
+        `assignments` maps (node_id, subtask) -> worker_id; only this
+        worker's subtasks are constructed; edges crossing workers are
+        bridged by RemoteEdgeSender pumps (outgoing) and queues registered
+        on the DataPlaneServer (incoming), keyed by the routing Quad.
         """
         cfg = config()
         qsize, qbytes = cfg.pipeline.queue_size, cfg.pipeline.queue_bytes
 
-        # queues[(edge_idx, src_sub, dst_sub)] -> BatchQueue
+        def owner(nid: int, sub: int) -> Optional[int]:
+            if assignments is None:
+                return my_worker  # everything local
+            return assignments.get((nid, sub))
+
+        def is_mine(nid: int, sub: int) -> bool:
+            return assignments is None or owner(nid, sub) == my_worker
+
+        self.remote_senders = []
+
         in_queues: Dict[Tuple[int, int], List[InputQueue]] = {}
         out_senders: Dict[Tuple[int, int], List[EdgeSender]] = {}
         for nid, node in self.graph.nodes.items():
             for i in range(node.parallelism):
                 in_queues[(nid, i)] = []
                 out_senders[(nid, i)] = []
+
+        def wire(edge_idx, edge, i, j, logical_input):
+            """Create the queue/bridge for edge pair (src sub i -> dst sub j);
+            returns the queue for the sender side or None."""
+            src_local = is_mine(edge.src, i)
+            dst_local = is_mine(edge.dst, j)
+            quad = (edge.src, i, edge.dst, j)
+            if not src_local and not dst_local:
+                return None
+            q = BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{j}")
+            if dst_local:
+                in_queues[(edge.dst, j)].append(
+                    InputQueue(q, logical_input, f"{edge.src}-{i}")
+                )
+                if not src_local:
+                    assert data_server is not None
+                    data_server.register(quad, q)
+                    return None  # sender is remote
+                return q
+            # src local, dst remote: pump the queue over TCP
+            from .network import RemoteEdgeSender
+
+            addr = worker_addrs[owner(edge.dst, j)]
+            self.remote_senders.append(RemoteEdgeSender(addr, quad, q))
+            return q
 
         for edge_idx, edge in enumerate(self.graph.edges):
             src = self.graph.nodes[edge.src]
@@ -83,36 +129,32 @@ class Program:
                     f"parallelism ({src.parallelism} != {dst.parallelism})"
                 )
                 for i in range(src.parallelism):
-                    q = BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{i}")
-                    in_queues[(edge.dst, i)].append(
-                        InputQueue(q, logical_input, f"{edge.src}-{i}")
-                    )
-                    out_senders[(edge.src, i)].append(
-                        EdgeSender(edge.edge_type, edge.schema, [q], i)
-                    )
+                    q = wire(edge_idx, edge, i, i, logical_input)
+                    if is_mine(edge.src, i):
+                        out_senders[(edge.src, i)].append(
+                            EdgeSender(edge.edge_type, edge.schema, [q], i)
+                        )
             else:
                 # all-to-all: dst subtask j owns one queue per src subtask i
-                queues = [
-                    [
-                        BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{j}")
+                for i in range(src.parallelism):
+                    if not is_mine(edge.src, i):
+                        for j in range(dst.parallelism):
+                            wire(edge_idx, edge, i, j, logical_input)
+                        continue
+                    qs = [
+                        wire(edge_idx, edge, i, j, logical_input)
                         for j in range(dst.parallelism)
                     ]
-                    for i in range(src.parallelism)
-                ]
-                for j in range(dst.parallelism):
-                    for i in range(src.parallelism):
-                        in_queues[(edge.dst, j)].append(
-                            InputQueue(queues[i][j], logical_input, f"{edge.src}-{i}")
-                        )
-                for i in range(src.parallelism):
                     out_senders[(edge.src, i)].append(
-                        EdgeSender(edge.edge_type, edge.schema, queues[i], i)
+                        EdgeSender(edge.edge_type, edge.schema, qs, i)
                     )
 
         for node in self.graph.topo_order():
             in_edges = self.graph.in_edges(node.node_id)
             out_edges = self.graph.out_edges(node.node_id)
             for i in range(node.parallelism):
+                if not is_mine(node.node_id, i):
+                    continue
                 ops = construct_chain(node)
                 task_info = TaskInfo(
                     self.job_id, node.node_id, node.description, i,

@@ -1,0 +1,287 @@
+"""Worker server: runs a partition of a job's subtasks.
+
+Capability parity with the reference's WorkerServer
+(/root/reference/crates/arroyo-worker/src/lib.rs:666-1197): registers with
+the controller (RegisterWorkerReq), serves WorkerGrpc (StartExecution,
+Checkpoint, Commit, StopExecution), heartbeats, streams task events
+(checkpoint progress, finish/failure) back to the controller, and hosts the
+TCP data plane endpoint for cross-worker edges.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+from ..config import config
+from ..graph.logical import LogicalGraph
+from ..operators.control import (
+    CheckpointCompletedResp,
+    CheckpointEventResp,
+    CheckpointMsg,
+    CommitMsg,
+    StopMsg,
+    TaskFailedResp,
+    TaskFinishedResp,
+)
+from ..types import CheckpointBarrier, StopMode, now_nanos
+from ..utils.logging import get_logger
+from .network import DataPlaneServer
+from .program import Program
+from .rpc import RpcClient, RpcServer
+
+logger = get_logger("worker")
+
+
+class WorkerServer:
+    def __init__(self, controller_addr: str, worker_id: Optional[int] = None,
+                 bind: str = "127.0.0.1"):
+        self.controller_addr = controller_addr
+        if worker_id is None:
+            worker_id = int(os.environ.get("ARROYO_WORKER_ID", os.getpid()))
+        self.worker_id = worker_id
+        self.bind = bind
+        self.rpc = RpcServer(bind)
+        self.data = DataPlaneServer(bind)
+        self.controller: Optional[RpcClient] = None
+        self.program: Optional[Program] = None
+        self.tasks = []
+        self._running = asyncio.Event()
+        self._finished = asyncio.Event()
+        self._n_running = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self):
+        self.rpc.add_service(
+            "WorkerGrpc",
+            {
+                "StartExecution": self.start_execution,
+                "StartProcessing": self.start_processing,
+                "Checkpoint": self.checkpoint,
+                "Commit": self.commit,
+                "StopExecution": self.stop_execution,
+                "GetMetrics": self.get_metrics,
+            },
+        )
+        rpc_port = await self.rpc.start()
+        data_port = await self.data.start()
+        self.rpc_addr = f"{self.bind}:{rpc_port}"
+        self.data_addr = f"{self.bind}:{data_port}"
+        self.controller = RpcClient(self.controller_addr)
+        await self.controller.call(
+            "ControllerGrpc",
+            "RegisterWorker",
+            {
+                "worker_id": self.worker_id,
+                "rpc_addr": self.rpc_addr,
+                "data_addr": self.data_addr,
+                "slots": config().worker.task_slots,
+            },
+        )
+        self._hb = asyncio.ensure_future(self._heartbeat())
+        logger.info(
+            "worker %s up (rpc %s, data %s)", self.worker_id, self.rpc_addr,
+            self.data_addr,
+        )
+        return self
+
+    async def _heartbeat(self):
+        while not self._finished.is_set():
+            try:
+                await self.controller.call(
+                    "ControllerGrpc", "Heartbeat",
+                    {"worker_id": self.worker_id, "time": now_nanos()},
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("heartbeat failed: %s", e)
+            await asyncio.sleep(2.0)
+
+    # -- WorkerGrpc ---------------------------------------------------------
+
+    async def start_execution(self, req: dict) -> dict:
+        if req.get("sql"):
+            from ..sql import plan_query
+
+            graph = plan_query(
+                req["sql"], parallelism=req.get("parallelism", 1)
+            ).graph
+        else:
+            graph = LogicalGraph.from_json(req["graph"])
+        assignments = {
+            (a["node_id"], a["subtask"]): a["worker_id"]
+            for a in req["assignments"]
+        }
+        worker_addrs = {
+            int(w): addr for w, addr in req["worker_data_addrs"].items()
+        }
+        self.job_id = req["job_id"]
+        program = Program(graph, self.job_id)
+        if req.get("storage_url"):
+            from ..state.backend import StateBackend
+
+            backend = StateBackend(req["storage_url"], self.job_id)
+            backend.generation = req.get("generation")
+            if req.get("restore_epoch") is not None:
+                import copy
+
+                from ..state import protocol
+
+                backend.restore_manifest = protocol.load_manifest(
+                    backend.storage, backend.paths, req["restore_epoch"]
+                )
+            program.with_state(backend)
+        program.build(
+            assignments=assignments,
+            my_worker=self.worker_id,
+            worker_addrs=worker_addrs,
+            data_server=self.data,
+        )
+        self.program = program
+
+        def pump_failed(quad, exc):
+            program.control_resp.put_nowait(
+                TaskFailedResp(
+                    f"net-{quad[0]}-{quad[1]}", quad[0], quad[1],
+                    f"data plane edge {quad} failed: {exc!r}",
+                )
+            )
+
+        for rs in program.remote_senders:
+            rs.on_error = pump_failed
+            await rs.start()
+        return {"subtasks": len(program.subtasks)}
+
+    async def start_processing(self, req: dict) -> dict:
+        """Phase 2 of the barrier-synchronized start (reference
+        Engine::start, engine.rs:525): runners only spawn once every worker
+        has built its partition and registered its data-plane routes, so a
+        fast source can't race peers' route registration."""
+        program = self.program
+        for sub in program.subtasks:
+            self.tasks.append(asyncio.ensure_future(sub.runner.run()))
+        self._n_running = len(program.subtasks)
+        self._pump_task = asyncio.ensure_future(self._pump_responses())
+        self._running.set()
+        return {}
+
+    async def checkpoint(self, req: dict) -> dict:
+        barrier = CheckpointBarrier(
+            epoch=req["epoch"], min_epoch=req.get("min_epoch", 0),
+            timestamp=now_nanos(), then_stop=req.get("then_stop", False),
+        )
+        for sub in self.program.source_subtasks():
+            sub.control_rx.put_nowait(CheckpointMsg(barrier))
+        return {}
+
+    async def commit(self, req: dict) -> dict:
+        data: Dict[int, dict] = {}
+        for node_id, subs in (req.get("committing") or {}).items():
+            data[int(node_id)] = {"data": {int(s): v for s, v in subs.items()}}
+        for sub in self.program.subtasks:
+            sub.control_rx.put_nowait(CommitMsg(req["epoch"], data))
+        return {}
+
+    async def stop_execution(self, req: dict) -> dict:
+        mode = StopMode(req.get("mode", "graceful"))
+        targets = (
+            self.program.source_subtasks()
+            if mode == StopMode.GRACEFUL
+            else self.program.subtasks
+        )
+        for sub in targets:
+            sub.control_rx.put_nowait(StopMsg(mode))
+        return {}
+
+    async def get_metrics(self, req: dict) -> dict:
+        from ..metrics import REGISTRY
+
+        return {"prometheus": REGISTRY.expose()}
+
+    # -- task event forwarding ---------------------------------------------
+
+    async def _pump_responses(self):
+        q = self.program.control_resp
+        while self._n_running > 0:
+            resp = await q.get()
+            try:
+                await self._forward(resp)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("event forward failed: %s", e)
+        self._finished.set()
+        await self.controller.call(
+            "ControllerGrpc", "WorkerFinished", {"worker_id": self.worker_id}
+        )
+
+    async def _forward(self, resp):
+        c = self.controller
+        wid = self.worker_id
+        if isinstance(resp, CheckpointCompletedResp):
+            await c.call(
+                "ControllerGrpc", "TaskCheckpointCompleted",
+                {
+                    "worker_id": wid,
+                    "task_id": resp.task_id,
+                    "node_id": resp.node_id,
+                    "subtask": resp.subtask_index,
+                    "epoch": resp.epoch,
+                    "metadata": resp.subtask_metadata,
+                    "watermark": resp.watermark,
+                    "commit_data": resp.commit_data,
+                },
+            )
+        elif isinstance(resp, CheckpointEventResp):
+            await c.call(
+                "ControllerGrpc", "TaskCheckpointEvent",
+                {
+                    "worker_id": wid, "task_id": resp.task_id,
+                    "epoch": resp.epoch, "event": resp.event,
+                },
+            )
+        elif isinstance(resp, TaskFinishedResp):
+            self._n_running -= 1
+            await c.call(
+                "ControllerGrpc", "TaskFinished",
+                {"worker_id": wid, "task_id": resp.task_id},
+            )
+        elif isinstance(resp, TaskFailedResp):
+            self._n_running -= 1
+            await c.call(
+                "ControllerGrpc", "TaskFailed",
+                {"worker_id": wid, "task_id": resp.task_id,
+                 "error": resp.error},
+            )
+
+    async def shutdown(self):
+        """Force teardown: cancel every task and close servers/clients so a
+        force-stopped embedded worker leaves no heartbeats or runners
+        behind."""
+        self._finished.set()
+        for t in self.tasks:
+            t.cancel()
+        for attr in ("_hb", "_pump_task"):
+            t = getattr(self, attr, None)
+            if t is not None:
+                t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        if self.controller is not None:
+            await self.controller.close()
+        await self.rpc.stop(grace=0.1)
+        await self.data.stop()
+
+    async def run_until_finished(self):
+        await self._finished.wait()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        self._hb.cancel()
+        await asyncio.gather(self._hb, return_exceptions=True)
+        await self.controller.close()
+        await self.rpc.stop()
+        await self.data.stop()
+
+
+async def worker_main(controller_addr: str):
+    w = WorkerServer(controller_addr)
+    await w.start()
+    await w.run_until_finished()

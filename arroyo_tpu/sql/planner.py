@@ -192,6 +192,15 @@ class Planner:
         """Append a projection/filter node fed by `upstream` via a forward
         edge. `exprs` excludes _timestamp, which is passed through (or
         computed by keep_timestamp_from)."""
+        # updating streams must keep retract/append ordering: the projection
+        # runs at the upstream node's parallelism so the edge stays FORWARD
+        # (an unkeyed shuffle would round-robin a flush's retract batch and
+        # append batch onto different subtasks)
+        node_par = (
+            self.graph.nodes[upstream.node_id].parallelism
+            if upstream.updating
+            else self.parallelism
+        )
         out_fields = [pa.field(n, e.dtype) for n, e in zip(names, exprs)]
         out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
         ts_idx = upstream.schema.timestamp_index
@@ -229,12 +238,12 @@ class Planner:
                 OperatorName.ARROW_VALUE,
                 {"py_fn": prog, "schema": out_schema, "name": description},
                 description,
-                parallelism=self.parallelism,
+                parallelism=node_par,
             )
         )
         self.graph.add_edge(
             upstream.node_id, node.node_id,
-            self._edge(upstream.node_id, self.parallelism), upstream.schema,
+            self._edge(upstream.node_id, node_par), upstream.schema,
         )
         return RelOutput(
             node.node_id,
@@ -507,11 +516,7 @@ class Planner:
 
         key_names = _dedup([_default_name(g, b) for g, b in
                             zip(group_exprs, key_bound)])
-        agg_calls: List[FuncCall] = []
-        for it in items:
-            for call in _find_aggregates(it.expr):
-                if call not in agg_calls:
-                    agg_calls.append(call)
+        agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
         if any(c.distinct for c in agg_calls):
             if instant or len(agg_calls) > 1 or window_spec.kind == "session":
                 raise SqlError(
@@ -522,17 +527,6 @@ class Planner:
                 sel, items, upstream, where, window_spec, window_alias,
                 group_exprs, key_bound, key_names, agg_calls[0],
             )
-        agg_inputs: List[Optional[BoundExpr]] = []
-        for call in agg_calls:
-            if call.star or not call.args:
-                agg_inputs.append(None)
-            else:
-                if len(call.args) != 1:
-                    raise SqlError(
-                        f"{call.name}() takes one argument"
-                    )
-                agg_inputs.append(bind(call.args[0], upstream.scope))
-
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
         agg_col_idx: List[Optional[int]] = []
@@ -700,21 +694,11 @@ class Planner:
         key_names = _dedup(
             [_default_name(g, b) for g, b in zip(group_exprs, key_bound)]
         )
-        agg_calls: List[FuncCall] = []
-        for it in items:
-            for call in _find_aggregates(it.expr):
-                if call not in agg_calls:
-                    agg_calls.append(call)
+        agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
         if any(c.distinct for c in agg_calls):
             raise SqlError(
                 "count(DISTINCT) in updating aggregates is not yet supported"
             )
-        agg_inputs: List[Optional[BoundExpr]] = []
-        for call in agg_calls:
-            if call.star or not call.args:
-                agg_inputs.append(None)
-            else:
-                agg_inputs.append(bind(call.args[0], upstream.scope))
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
         agg_col_idx: List[Optional[int]] = []
@@ -1234,6 +1218,25 @@ def _find_aggregates(e: Expr) -> List[FuncCall]:
 
     walk(e)
     return out
+
+
+def _collect_aggregates(items, scope):
+    """Unique aggregate calls across select items + their bound inputs
+    (one-argument arity enforced here for every aggregate path)."""
+    agg_calls: List[FuncCall] = []
+    for it in items:
+        for call in _find_aggregates(it.expr):
+            if call not in agg_calls:
+                agg_calls.append(call)
+    agg_inputs: List[Optional[BoundExpr]] = []
+    for call in agg_calls:
+        if call.star or not call.args:
+            agg_inputs.append(None)
+            continue
+        if len(call.args) != 1:
+            raise SqlError(f"{call.name}() takes one argument")
+        agg_inputs.append(bind(call.args[0], scope))
+    return agg_calls, agg_inputs
 
 
 def _rewrite_group_refs(

@@ -1,0 +1,1 @@
+from .rest import build_app, serve_api  # noqa: F401

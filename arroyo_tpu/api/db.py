@@ -1,0 +1,247 @@
+"""SQLite persistence for the REST API.
+
+Capability parity with the reference's database layer
+(/root/reference/crates/arroyo-api: cornucopia-generated queries over
+Postgres, parallel SQLite migrations for `arroyo run`): pipelines, jobs,
+udfs, connection profiles/tables. SQLite only in this build (the reference
+also speaks Postgres); the schema mirrors the reference's logical model.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+MIGRATIONS = [
+    """
+    CREATE TABLE IF NOT EXISTS pipelines (
+        id TEXT PRIMARY KEY,
+        name TEXT NOT NULL,
+        query TEXT NOT NULL,
+        parallelism INTEGER NOT NULL DEFAULT 1,
+        state TEXT NOT NULL DEFAULT 'Created',
+        graph_json TEXT,
+        created_at REAL NOT NULL
+    )
+    """,
+    """
+    CREATE TABLE IF NOT EXISTS jobs (
+        id TEXT PRIMARY KEY,
+        pipeline_id TEXT NOT NULL REFERENCES pipelines(id),
+        state TEXT NOT NULL,
+        restarts INTEGER NOT NULL DEFAULT 0,
+        created_at REAL NOT NULL,
+        finished_at REAL
+    )
+    """,
+    """
+    CREATE TABLE IF NOT EXISTS udfs (
+        id TEXT PRIMARY KEY,
+        prefix TEXT,
+        name TEXT NOT NULL,
+        definition TEXT NOT NULL,
+        language TEXT NOT NULL DEFAULT 'python',
+        created_at REAL NOT NULL
+    )
+    """,
+    """
+    CREATE TABLE IF NOT EXISTS connection_profiles (
+        id TEXT PRIMARY KEY,
+        name TEXT NOT NULL,
+        connector TEXT NOT NULL,
+        config TEXT NOT NULL,
+        created_at REAL NOT NULL
+    )
+    """,
+    """
+    CREATE TABLE IF NOT EXISTS connection_tables (
+        id TEXT PRIMARY KEY,
+        name TEXT NOT NULL,
+        connector TEXT NOT NULL,
+        profile_id TEXT,
+        config TEXT NOT NULL,
+        schema_json TEXT,
+        table_type TEXT,
+        created_at REAL NOT NULL
+    )
+    """,
+]
+
+
+class ApiDb:
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self.conn = sqlite3.connect(path)
+        self.conn.row_factory = sqlite3.Row
+        for m in MIGRATIONS:
+            self.conn.execute(m)
+        self.conn.commit()
+
+    # -- pipelines ----------------------------------------------------------
+
+    def create_pipeline(self, name: str, query: str, parallelism: int,
+                        graph_json: Optional[dict] = None) -> dict:
+        pid = "pl_" + uuid.uuid4().hex[:12]
+        self.conn.execute(
+            "INSERT INTO pipelines (id, name, query, parallelism, state, "
+            "graph_json, created_at) VALUES (?,?,?,?,?,?,?)",
+            (pid, name, query, parallelism, "Created",
+             json.dumps(graph_json) if graph_json else None, time.time()),
+        )
+        self.conn.commit()
+        return self.get_pipeline(pid)
+
+    def list_pipelines(self) -> List[dict]:
+        rows = self.conn.execute(
+            "SELECT * FROM pipelines ORDER BY created_at DESC"
+        ).fetchall()
+        return [self._pipeline(r) for r in rows]
+
+    def get_pipeline(self, pid: str) -> Optional[dict]:
+        r = self.conn.execute(
+            "SELECT * FROM pipelines WHERE id = ?", (pid,)
+        ).fetchone()
+        return self._pipeline(r) if r else None
+
+    def set_pipeline_state(self, pid: str, state: str):
+        self.conn.execute(
+            "UPDATE pipelines SET state = ? WHERE id = ?", (state, pid)
+        )
+        self.conn.commit()
+
+    def delete_pipeline(self, pid: str):
+        self.conn.execute("DELETE FROM jobs WHERE pipeline_id = ?", (pid,))
+        self.conn.execute("DELETE FROM pipelines WHERE id = ?", (pid,))
+        self.conn.commit()
+
+    @staticmethod
+    def _pipeline(r) -> dict:
+        return {
+            "id": r["id"],
+            "name": r["name"],
+            "query": r["query"],
+            "parallelism": r["parallelism"],
+            "state": r["state"],
+            "created_at": r["created_at"],
+        }
+
+    # -- jobs ---------------------------------------------------------------
+
+    def create_job(self, pipeline_id: str) -> dict:
+        jid = "job_" + uuid.uuid4().hex[:12]
+        self.conn.execute(
+            "INSERT INTO jobs (id, pipeline_id, state, created_at) "
+            "VALUES (?,?,?,?)",
+            (jid, pipeline_id, "Created", time.time()),
+        )
+        self.conn.commit()
+        return {"id": jid, "pipeline_id": pipeline_id, "state": "Created"}
+
+    def update_job(self, jid: str, state: str, restarts: int = 0):
+        finished = (
+            time.time()
+            if state in ("Finished", "Failed", "Stopped")
+            else None
+        )
+        self.conn.execute(
+            "UPDATE jobs SET state = ?, restarts = ?, finished_at = "
+            "COALESCE(?, finished_at) WHERE id = ?",
+            (state, restarts, finished, jid),
+        )
+        self.conn.commit()
+
+    def jobs_for_pipeline(self, pid: str) -> List[dict]:
+        rows = self.conn.execute(
+            "SELECT * FROM jobs WHERE pipeline_id = ? ORDER BY created_at",
+            (pid,),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    def all_jobs(self) -> List[dict]:
+        return [dict(r) for r in self.conn.execute(
+            "SELECT * FROM jobs ORDER BY created_at DESC"
+        ).fetchall()]
+
+    # -- udfs ---------------------------------------------------------------
+
+    def create_udf(self, name: str, definition: str, prefix: str = "",
+                   language: str = "python") -> dict:
+        uid = "udf_" + uuid.uuid4().hex[:12]
+        self.conn.execute(
+            "INSERT INTO udfs (id, prefix, name, definition, language, "
+            "created_at) VALUES (?,?,?,?,?,?)",
+            (uid, prefix, name, definition, language, time.time()),
+        )
+        self.conn.commit()
+        return {"id": uid, "name": name, "definition": definition,
+                "language": language}
+
+    def list_udfs(self) -> List[dict]:
+        return [dict(r) for r in self.conn.execute(
+            "SELECT * FROM udfs ORDER BY created_at"
+        ).fetchall()]
+
+    def delete_udf(self, uid: str):
+        self.conn.execute("DELETE FROM udfs WHERE id = ?", (uid,))
+        self.conn.commit()
+
+    # -- connections --------------------------------------------------------
+
+    def create_connection_profile(self, name: str, connector: str,
+                                  config: dict) -> dict:
+        cid = "cp_" + uuid.uuid4().hex[:12]
+        self.conn.execute(
+            "INSERT INTO connection_profiles (id, name, connector, config, "
+            "created_at) VALUES (?,?,?,?,?)",
+            (cid, name, connector, json.dumps(config), time.time()),
+        )
+        self.conn.commit()
+        return {"id": cid, "name": name, "connector": connector,
+                "config": config}
+
+    def list_connection_profiles(self) -> List[dict]:
+        out = []
+        for r in self.conn.execute(
+            "SELECT * FROM connection_profiles ORDER BY created_at"
+        ).fetchall():
+            d = dict(r)
+            d["config"] = json.loads(d["config"])
+            out.append(d)
+        return out
+
+    def create_connection_table(self, name: str, connector: str, config: dict,
+                                schema: Optional[dict], table_type: str,
+                                profile_id: Optional[str]) -> dict:
+        cid = "ct_" + uuid.uuid4().hex[:12]
+        self.conn.execute(
+            "INSERT INTO connection_tables (id, name, connector, profile_id, "
+            "config, schema_json, table_type, created_at) "
+            "VALUES (?,?,?,?,?,?,?,?)",
+            (cid, name, connector, profile_id, json.dumps(config),
+             json.dumps(schema) if schema else None, table_type, time.time()),
+        )
+        self.conn.commit()
+        return {"id": cid, "name": name, "connector": connector,
+                "config": config, "table_type": table_type}
+
+    def list_connection_tables(self) -> List[dict]:
+        out = []
+        for r in self.conn.execute(
+            "SELECT * FROM connection_tables ORDER BY created_at"
+        ).fetchall():
+            d = dict(r)
+            d["config"] = json.loads(d["config"])
+            if d["schema_json"]:
+                d["schema"] = json.loads(d["schema_json"])
+            del d["schema_json"]
+            out.append(d)
+        return out
+
+    def delete_connection_table(self, cid: str):
+        self.conn.execute("DELETE FROM connection_tables WHERE id = ?", (cid,))
+        self.conn.commit()

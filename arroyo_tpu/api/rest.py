@@ -1,0 +1,430 @@
+"""REST API server (aiohttp).
+
+Capability parity with the reference's API routes
+(/root/reference/crates/arroyo-api/src/rest.rs:65-243): pipelines
+CRUD/validate/preview/stop/restart, jobs, checkpoint listings, operator
+metric groups, connectors metadata, connection profiles/tables (+test),
+UDFs CRUD/validate, websocket tail of preview output. Served under
+/api/v1; job output and state come straight from the in-process controller
+(the reference couples these through Postgres + gRPC; this build embeds
+the controller in the API process or is pointed at one).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+from ..config import config
+from ..controller.controller import ControllerServer
+from ..controller.state_machine import JobState
+from ..sql import plan_query
+from ..sql.lexer import SqlError
+from ..utils.logging import get_logger
+from .db import ApiDb
+
+logger = get_logger("api")
+
+
+def json_response(data, status=200):
+    return web.json_response(data, status=status, dumps=lambda d: json.dumps(
+        d, default=str))
+
+
+def error(status: int, message: str):
+    return web.json_response({"error": message}, status=status)
+
+
+class ApiServer:
+    def __init__(self, controller: Optional[ControllerServer] = None,
+                 db_path: Optional[str] = None):
+        self.controller = controller
+        self.db = ApiDb(db_path or config().database.path)
+        self.previews: dict = {}  # pipeline id -> preview rows list
+
+    # -- pipelines ----------------------------------------------------------
+
+    async def validate_query(self, request: web.Request):
+        body = await request.json()
+        try:
+            plan = plan_query(body["query"],
+                              parallelism=body.get("parallelism", 1))
+        except SqlError as e:
+            return json_response({"errors": [str(e)]}, status=400)
+        g = plan.graph
+        return json_response(
+            {
+                "graph": {
+                    "nodes": [
+                        {
+                            "node_id": n.node_id,
+                            "description": n.description,
+                            "operator": " -> ".join(
+                                op.operator.value for op in n.chain
+                            ),
+                            "parallelism": n.parallelism,
+                        }
+                        for n in g.nodes.values()
+                    ],
+                    "edges": [
+                        {"src": e.src, "dst": e.dst,
+                         "edge_type": e.edge_type.value}
+                        for e in g.edges
+                    ],
+                },
+                "errors": [],
+            }
+        )
+
+    async def create_pipeline(self, request: web.Request):
+        body = await request.json()
+        name = body.get("name") or "pipeline"
+        query = body.get("query")
+        parallelism = int(body.get("parallelism", 1))
+        if not query:
+            return error(400, "query is required")
+        try:
+            plan = plan_query(query, parallelism=parallelism)
+        except SqlError as e:
+            return error(400, str(e))
+        pipeline = self.db.create_pipeline(name, query, parallelism)
+        if self.controller is not None:
+            job = self.db.create_job(pipeline["id"])
+            storage = config().pipeline.checkpointing.storage_url
+            await self.controller.submit_job(
+                job["id"], sql=query,
+                storage_url=f"{storage}/{job['id']}" if storage else None,
+                parallelism=parallelism,
+            )
+            asyncio.ensure_future(self._track_job(pipeline["id"], job["id"]))
+        return json_response(pipeline)
+
+    async def _track_job(self, pid: str, jid: str):
+        job = self.controller.jobs.get(jid)
+        while job is not None and not job.state.is_terminal():
+            self.db.update_job(jid, job.state.value, job.restarts)
+            self.db.set_pipeline_state(pid, job.state.value)
+            await asyncio.sleep(0.2)
+        if job is not None:
+            self.db.update_job(jid, job.state.value, job.restarts)
+            self.db.set_pipeline_state(pid, job.state.value)
+
+    async def list_pipelines(self, request: web.Request):
+        return json_response({"data": self.db.list_pipelines()})
+
+    async def get_pipeline(self, request: web.Request):
+        p = self.db.get_pipeline(request.match_info["id"])
+        if p is None:
+            return error(404, "pipeline not found")
+        return json_response(p)
+
+    async def delete_pipeline(self, request: web.Request):
+        pid = request.match_info["id"]
+        p = self.db.get_pipeline(pid)
+        if p is None:
+            return error(404, "pipeline not found")
+        await self._stop_pipeline_jobs(pid, "immediate")
+        self.db.delete_pipeline(pid)
+        return json_response({"deleted": pid})
+
+    async def patch_pipeline(self, request: web.Request):
+        """stop modes (reference: PATCH /pipelines/{id} with stop field)."""
+        pid = request.match_info["id"]
+        body = await request.json()
+        stop = body.get("stop")
+        if stop not in (None, "none", "checkpoint", "graceful", "immediate"):
+            return error(400, f"invalid stop mode {stop}")
+        if stop and stop != "none":
+            await self._stop_pipeline_jobs(pid, stop)
+        return json_response(self.db.get_pipeline(pid) or {})
+
+    async def restart_pipeline(self, request: web.Request):
+        pid = request.match_info["id"]
+        p = self.db.get_pipeline(pid)
+        if p is None:
+            return error(404, "pipeline not found")
+        if self.controller is None:
+            return error(400, "no controller attached")
+        await self._stop_pipeline_jobs(pid, "checkpoint")
+        job = self.db.create_job(pid)
+        storage = config().pipeline.checkpointing.storage_url
+        await self.controller.submit_job(
+            job["id"], sql=p["query"],
+            storage_url=f"{storage}/{job['id']}" if storage else None,
+            parallelism=p["parallelism"],
+        )
+        asyncio.ensure_future(self._track_job(pid, job["id"]))
+        return json_response(job)
+
+    async def _stop_pipeline_jobs(self, pid: str, mode: str):
+        if self.controller is None:
+            return
+        for j in self.db.jobs_for_pipeline(pid):
+            cjob = self.controller.jobs.get(j["id"])
+            if cjob is not None and not cjob.state.is_terminal():
+                await self.controller.stop_job(j["id"], mode)
+                try:
+                    await self.controller.wait_for_state(
+                        j["id"], JobState.STOPPED, JobState.FAILED,
+                        JobState.FINISHED, timeout=60,
+                    )
+                except TimeoutError:
+                    pass
+                self.db.update_job(j["id"], self.controller.jobs[j["id"]].state.value)
+
+    # -- jobs / checkpoints -------------------------------------------------
+
+    async def pipeline_jobs(self, request: web.Request):
+        return json_response(
+            {"data": self.db.jobs_for_pipeline(request.match_info["id"])}
+        )
+
+    async def all_jobs(self, request: web.Request):
+        return json_response({"data": self.db.all_jobs()})
+
+    async def job_checkpoints(self, request: web.Request):
+        jid = request.match_info["job_id"]
+        if self.controller is None or jid not in self.controller.jobs:
+            return json_response({"data": []})
+        job = self.controller.jobs[jid]
+        out = []
+        if job.backend is not None:
+            for epoch in sorted(job.checkpoints):
+                out.append(
+                    {
+                        "epoch": epoch,
+                        "tasks": len(job.checkpoints[epoch]),
+                        "backend": job.backend.paths.checkpoint_dir(epoch),
+                    }
+                )
+        return json_response({"data": out})
+
+    async def job_errors(self, request: web.Request):
+        jid = request.match_info["job_id"]
+        job = (self.controller or ControllerServer()).jobs.get(jid)
+        return json_response(
+            {"data": [{"message": job.failure}] if job and job.failure else []}
+        )
+
+    async def operator_metric_groups(self, request: web.Request):
+        from ..metrics import REGISTRY
+
+        return json_response({"prometheus": REGISTRY.expose()})
+
+    # -- preview ------------------------------------------------------------
+
+    async def preview_pipeline(self, request: web.Request):
+        """Bounded preview run executed in-process (reference: preview
+        pipelines with the preview sink + websocket output tail)."""
+        body = await request.json()
+        query = body.get("query")
+        if not query:
+            return error(400, "query is required")
+        results: list = []
+        try:
+            plan = plan_query(query, preview_results=results)
+        except SqlError as e:
+            return error(400, str(e))
+        from ..engine import Engine
+
+        pid = self.db.create_pipeline(body.get("name", "preview"), query, 1)
+        self.previews[pid["id"]] = {"rows": results, "done": False}
+
+        async def run():
+            try:
+                eng = Engine(plan.graph).start()
+                await eng.join(body.get("timeout", 60))
+            except Exception as e:  # noqa: BLE001
+                self.previews[pid["id"]]["error"] = str(e)
+            finally:
+                self.previews[pid["id"]]["done"] = True
+
+        asyncio.ensure_future(run())
+        return json_response(pid)
+
+    async def preview_output(self, request: web.Request):
+        pv = self.previews.get(request.match_info["id"])
+        if pv is None:
+            return error(404, "no preview for pipeline")
+        return json_response(
+            {"rows": pv["rows"], "done": pv["done"],
+             "error": pv.get("error")}
+        )
+
+    async def preview_output_ws(self, request: web.Request):
+        """Websocket tail of preview rows (reference: job output ws)."""
+        pv = self.previews.get(request.match_info["id"])
+        if pv is None:
+            return error(404, "no preview for pipeline")
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        sent = 0
+        while not ws.closed:
+            rows = pv["rows"]
+            while sent < len(rows):
+                await ws.send_json(rows[sent], dumps=lambda d: json.dumps(
+                    d, default=str))
+                sent += 1
+            if pv["done"]:
+                break
+            await asyncio.sleep(0.1)
+        await ws.close()
+        return ws
+
+    # -- connectors / connections ------------------------------------------
+
+    async def list_connectors(self, request: web.Request):
+        from ..connectors import connectors
+
+        return json_response({"data": [c.metadata() for c in connectors()]})
+
+    async def list_connection_profiles(self, request: web.Request):
+        return json_response({"data": self.db.list_connection_profiles()})
+
+    async def create_connection_profile(self, request: web.Request):
+        body = await request.json()
+        return json_response(
+            self.db.create_connection_profile(
+                body["name"], body["connector"], body.get("config", {})
+            )
+        )
+
+    async def list_connection_tables(self, request: web.Request):
+        return json_response({"data": self.db.list_connection_tables()})
+
+    async def create_connection_table(self, request: web.Request):
+        from ..connectors import get_connector
+
+        body = await request.json()
+        try:
+            conn = get_connector(body["connector"])
+            conn.validate_options(body.get("config", {}), None)
+        except (ValueError, KeyError) as e:
+            return error(400, str(e))
+        return json_response(
+            self.db.create_connection_table(
+                body["name"], body["connector"], body.get("config", {}),
+                body.get("schema"), body.get("table_type", "source"),
+                body.get("profile_id"),
+            )
+        )
+
+    async def delete_connection_table(self, request: web.Request):
+        self.db.delete_connection_table(request.match_info["id"])
+        return json_response({"deleted": request.match_info["id"]})
+
+    async def test_connection_table(self, request: web.Request):
+        from ..connectors import get_connector
+
+        body = await request.json()
+        try:
+            conn = get_connector(body["connector"])
+            cfg = conn.validate_options(body.get("config", {}), None)
+            ok, message = conn.test(cfg)
+        except (ValueError, KeyError) as e:
+            ok, message = False, str(e)
+        return json_response({"ok": ok, "message": message})
+
+    # -- udfs ---------------------------------------------------------------
+
+    async def validate_udf(self, request: web.Request):
+        from ..udf import registry
+
+        body = await request.json()
+        try:
+            names = registry.register_from_source(body["definition"])
+            registry.clear_dynamic(names)
+        except Exception as e:  # noqa: BLE001 - user code boundary
+            return json_response({"errors": [str(e)]}, status=400)
+        return json_response({"udfs": names, "errors": []})
+
+    async def create_udf(self, request: web.Request):
+        from ..udf import registry
+
+        body = await request.json()
+        try:
+            names = registry.register_from_source(body["definition"])
+        except Exception as e:  # noqa: BLE001
+            return error(400, str(e))
+        if not names:
+            return error(400, "definition registers no UDFs")
+        return json_response(
+            self.db.create_udf(names[0], body["definition"])
+        )
+
+    async def list_udfs(self, request: web.Request):
+        return json_response({"data": self.db.list_udfs()})
+
+    async def delete_udf(self, request: web.Request):
+        self.db.delete_udf(request.match_info["id"])
+        return json_response({"deleted": request.match_info["id"]})
+
+    async def ping(self, request: web.Request):
+        return json_response({"pong": True})
+
+
+def build_app(controller: Optional[ControllerServer] = None,
+              db_path: Optional[str] = None) -> web.Application:
+    api = ApiServer(controller, db_path)
+    # re-register saved UDFs so pipelines can use them after restarts
+    from ..udf import registry as udf_registry
+
+    for u in api.db.list_udfs():
+        try:
+            udf_registry.register_from_source(u["definition"])
+        except Exception:  # noqa: BLE001
+            logger.warning("failed to re-register udf %s", u["name"])
+
+    app = web.Application()
+    r = app.router
+    v1 = "/api/v1"
+    r.add_get(f"{v1}/ping", api.ping)
+    r.add_post(f"{v1}/pipelines/validate_query", api.validate_query)
+    r.add_post(f"{v1}/pipelines/preview", api.preview_pipeline)
+    r.add_get(f"{v1}/pipelines/preview/{{id}}/output", api.preview_output)
+    r.add_get(f"{v1}/pipelines/preview/{{id}}/output/ws",
+              api.preview_output_ws)
+    r.add_post(f"{v1}/pipelines", api.create_pipeline)
+    r.add_get(f"{v1}/pipelines", api.list_pipelines)
+    r.add_get(f"{v1}/pipelines/{{id}}", api.get_pipeline)
+    r.add_patch(f"{v1}/pipelines/{{id}}", api.patch_pipeline)
+    r.add_delete(f"{v1}/pipelines/{{id}}", api.delete_pipeline)
+    r.add_post(f"{v1}/pipelines/{{id}}/restart", api.restart_pipeline)
+    r.add_get(f"{v1}/pipelines/{{id}}/jobs", api.pipeline_jobs)
+    r.add_get(f"{v1}/jobs", api.all_jobs)
+    r.add_get(f"{v1}/jobs/{{job_id}}/checkpoints", api.job_checkpoints)
+    r.add_get(f"{v1}/jobs/{{job_id}}/errors", api.job_errors)
+    r.add_get(f"{v1}/jobs/{{job_id}}/operator_metric_groups",
+              api.operator_metric_groups)
+    r.add_get(f"{v1}/connectors", api.list_connectors)
+    r.add_get(f"{v1}/connection_profiles", api.list_connection_profiles)
+    r.add_post(f"{v1}/connection_profiles", api.create_connection_profile)
+    r.add_get(f"{v1}/connection_tables", api.list_connection_tables)
+    r.add_post(f"{v1}/connection_tables", api.create_connection_table)
+    r.add_delete(f"{v1}/connection_tables/{{id}}",
+                 api.delete_connection_table)
+    r.add_post(f"{v1}/connection_tables/test", api.test_connection_table)
+    r.add_post(f"{v1}/udfs/validate", api.validate_udf)
+    r.add_post(f"{v1}/udfs", api.create_udf)
+    r.add_get(f"{v1}/udfs", api.list_udfs)
+    r.add_delete(f"{v1}/udfs/{{id}}", api.delete_udf)
+    app["api"] = api
+    return app
+
+
+async def serve_api(port: Optional[int] = None,
+                    controller: Optional[ControllerServer] = None):
+    cfg = config()
+    app = build_app(controller)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(
+        runner, cfg.api.bind_address, port or cfg.api.http_port
+    )
+    await site.start()
+    logger.info("api listening on %s:%s", cfg.api.bind_address,
+                port or cfg.api.http_port)
+    await asyncio.Event().wait()

@@ -145,6 +145,8 @@ class SingleFileConnector(Connector):
         out = {"path": options["path"]}
         if "throttle_per_sec" in options:
             out["throttle_per_sec"] = float(options["throttle_per_sec"])
+        if "lookup_key" in options:
+            out["lookup_key"] = options["lookup_key"]
         return out
 
     def make_source(self, config, schema: ConnectionSchema):
@@ -158,3 +160,25 @@ class SingleFileConnector(Connector):
 
     def make_sink(self, config, schema: ConnectionSchema):
         return SingleFileSink(config["path"], config.get("format"))
+
+    def make_lookup(self, config):
+        """Lookup-join support for tests: the JSON-lines file loads into a
+        dict keyed by the `lookup_key` field."""
+        import json
+
+        key_field = config.get("lookup_key", "key")
+        table = {}
+        with open(config["path"]) as f:
+            for line in f:
+                if line.strip():
+                    row = json.loads(line)
+                    table[str(row[key_field])] = row
+        return _DictLookup(table)
+
+
+class _DictLookup:
+    def __init__(self, table: dict):
+        self.table = table
+
+    def lookup(self, key: str):
+        return self.table.get(key)

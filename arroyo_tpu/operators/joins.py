@@ -410,6 +410,71 @@ def _ipc_read(blob: bytes) -> pa.RecordBatch:
     return t.to_batches()[0] if t.num_rows else batches[0]
 
 
+class LookupJoinOperator(Operator):
+    """Lookup join against an external store (reference lookup_join.rs:274):
+    each batch's join keys resolve through the connector's LookupConnector
+    (reference connector.rs:421; caching, when any, lives in the connector's
+    lookup implementation — e.g. the redis lookup keeps a TTL'd cache);
+    inner joins drop misses, left joins emit nulls."""
+
+    def __init__(self, config: dict):
+        super().__init__("lookup_join")
+        self.connector_name = config["connector"]
+        self.connector_config = config["connector_config"]
+        self.key_col: int = config["key_col"]
+        self.join_type: str = config.get("join_type", "inner")
+        self.right_fields: List[str] = config["right_fields"]
+        self.out_schema: StreamSchema = config["schema"]
+        self.lookup = None
+
+    async def on_start(self, ctx):
+        from ..connectors import get_connector
+
+        conn = get_connector(self.connector_name)
+        if not hasattr(conn, "make_lookup"):
+            raise ValueError(
+                f"connector {self.connector_name} does not support lookups"
+            )
+        self.lookup = conn.make_lookup(self.connector_config)
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        import json
+
+        keys = batch.column(self.key_col).to_pylist()
+        rows = []
+        hits = []
+        for k in keys:
+            raw = self.lookup.lookup(str(k))
+            if raw is None:
+                hits.append(self.join_type == "left")
+                rows.append({})
+            else:
+                hits.append(True)
+                rows.append(json.loads(raw) if isinstance(raw, (bytes, str))
+                            else raw)
+        mask = pa.array(hits)
+        kept = batch.filter(mask)
+        kept_rows = [r for r, h in zip(rows, hits) if h]
+        if kept.num_rows == 0:
+            return
+        arrays = []
+        for f in self.out_schema.schema:
+            if f.name in self.right_fields:
+                arrays.append(
+                    pa.array([r.get(f.name) for r in kept_rows], type=f.type)
+                )
+            else:
+                arrays.append(kept.column(kept.schema.names.index(f.name)))
+        await collector.collect(
+            pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
+        )
+
+
+@register_operator(OperatorName.LOOKUP_JOIN)
+def _make_lookup(config: dict) -> Operator:
+    return LookupJoinOperator(config)
+
+
 @register_operator(OperatorName.INSTANT_JOIN)
 def _make_instant(config: dict) -> Operator:
     return InstantJoinOperator(config)

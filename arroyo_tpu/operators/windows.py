@@ -26,7 +26,7 @@ import pyarrow as pa
 
 from ..engine.construct import register_operator
 from ..graph.logical import OperatorName
-from ..ops.aggregates import AggSpec, _neutral, _np_dtype, make_accumulator
+from ..ops.aggregates import AggSpec, make_accumulator
 from ..ops.directory import SlotDirectory, unintern_value
 from ..schema import StreamSchema, TIMESTAMP_FIELD
 from ..types import WatermarkKind
@@ -40,6 +40,7 @@ def _specs_from_config(config: dict) -> List[AggSpec]:
             col=a.get("col"),
             name=a["name"],
             is_float=a.get("is_float", False),
+            udaf=a.get("udaf"),
         )
         for a in config["aggregates"]
     ]
@@ -398,8 +399,8 @@ class TumblingWindowOperator(WindowOperatorBase):
                 continue
             keys, slots = self.dir.take_bin(b)
             gathered = self.acc.gather(slots)
-            self.acc.reset_slots(slots)
             agg_cols = self.acc.finalize(gathered)
+            self.acc.reset_slots(slots)
             if self.width:
                 out = self._build_output(keys, agg_cols, b * self.width, end)
             else:
@@ -507,18 +508,9 @@ class SlidingWindowOperator(WindowOperatorBase):
                 (i for i, slots in enumerate(merged.values()) for _ in slots),
                 dtype=np.int64,
             )
-            gathered = self.acc.gather(all_slots)
-            n_keys = len(merged)
-            combined = []
-            for (op, dt, _, _), vals in zip(self.acc.phys, gathered):
-                out = np.full(n_keys, _neutral(op, dt), dtype=_np_dtype(dt))
-                if op == "add":
-                    np.add.at(out, seg_ids, vals)
-                elif op == "min":
-                    np.minimum.at(out, seg_ids, vals)
-                else:
-                    np.maximum.at(out, seg_ids, vals)
-                combined.append(out)
+            combined = self.acc.combine_for_segments(
+                all_slots, seg_ids, len(merged)
+            )
             agg_cols = self.acc.finalize(combined)
             out_batch = self._build_output(
                 list(merged.keys()), agg_cols, end - self.width, end
@@ -664,6 +656,7 @@ class SessionWindowOperator(WindowOperatorBase):
 
     def _merge_slots(self, a: List, b: List):
         """Fold session b's accumulator into a's; free b's slot."""
+        self.acc.merge_slot_into(a[2], b[2])
         ga = self.acc.gather(np.asarray([a[2], b[2]], dtype=np.int64))
         combined = []
         for (op, dt, _, _), vals in zip(self.acc.phys, ga):
@@ -689,9 +682,9 @@ class SessionWindowOperator(WindowOperatorBase):
                 if s[1] + self.gap <= t:
                     slot_arr = np.asarray([s[2]], dtype=np.int64)
                     gathered = self.acc.gather(slot_arr)
+                    agg_cols = self.acc.finalize(gathered)
                     self.acc.reset_slots(slot_arr)
                     self.dir.free.append(int(s[2]))
-                    agg_cols = self.acc.finalize(gathered)
                     out = self._build_output([key], agg_cols, s[0], s[1] + self.gap)
                     await collector.collect(out)
                 else:

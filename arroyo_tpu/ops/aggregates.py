@@ -48,14 +48,19 @@ INT_MAX = np.iinfo(np.int64).max
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    kind: str  # count | sum | min | max | avg
+    kind: str  # count | sum | min | max | avg | udaf
     col: Optional[int]  # input column index (None for count(*))
     name: str  # output field name
     is_float: bool = False  # input/output numeric class
+    udaf: Optional[str] = None  # registered UDAF name when kind == "udaf"
 
     def phys(self) -> List[Tuple[str, str, str]]:
         """[(op, dtype, source)]: op in add|min|max, dtype i8|f8,
         source col|one."""
+        if self.kind == "udaf":
+            # user-defined aggregates buffer raw values host-side (the
+            # reference hands all values to the UDAF too, udafs.rs)
+            return []
         if self.kind == "count":
             return [("add", "i8", "one")]
         d = "f8" if self.is_float else "i8"
@@ -103,6 +108,13 @@ class Accumulator:
             for op, dtype, src in spec.phys():
                 self.phys.append((op, dtype, src, si))
         self._buckets = tuple(config().tpu.shape_buckets)
+        # host-side raw-value buffers for UDAF specs: spec idx -> slot -> chunks
+        self.udaf_idx = [i for i, s in enumerate(specs) if s.kind == "udaf"]
+        self.udaf_store: Dict[int, Dict[int, list]] = {
+            i: {} for i in self.udaf_idx
+        }
+        self._gather_slots: Optional[np.ndarray] = None
+        self._segment_udaf: Optional[Dict[int, list]] = None
         if backend == "jax":
             jnp = _get_jax().numpy
             self.state = [
@@ -152,6 +164,21 @@ class Accumulator:
         index -> numpy array of row values."""
         n = len(slots)
         if n == 0:
+            return
+        if self.udaf_idx:
+            order = np.argsort(slots, kind="stable")
+            s_sorted = slots[order]
+            bounds = np.nonzero(np.diff(s_sorted))[0] + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [n]])
+            for si in self.udaf_idx:
+                vals = cols[self.specs[si].col][order]
+                store = self.udaf_store[si]
+                for lo, hi in zip(starts, ends):
+                    store.setdefault(int(s_sorted[lo]), []).append(
+                        vals[lo:hi]
+                    )
+        if not self.phys:
             return
         if self.backend == "numpy":
             self._np_update(slots, cols)
@@ -211,7 +238,10 @@ class Accumulator:
 
     def gather(self, slots: np.ndarray) -> List[np.ndarray]:
         """Read accumulator values for `slots` (emission); returns one numpy
-        array per physical accumulator."""
+        array per physical accumulator. The slots are remembered so
+        finalize() can resolve UDAF value buffers for the same emission."""
+        self._gather_slots = np.asarray(slots)
+        self._segment_udaf = None
         if len(slots) == 0:
             return [np.empty(0, dtype=s.dtype) for s in
                     (self.state if self.backend == "numpy" else self.state)]
@@ -235,7 +265,11 @@ class Accumulator:
 
     def reset_slots(self, slots: np.ndarray):
         """Return emitted slots to neutral so they can be reused."""
-        if len(slots) == 0:
+        for si in self.udaf_idx:
+            store = self.udaf_store[si]
+            for s in slots:
+                store.pop(int(s), None)
+        if len(slots) == 0 or not self.phys:
             return
         if self.backend == "numpy":
             for (op, dt, _, _), s in zip(self.phys, self.state):
@@ -262,10 +296,15 @@ class Accumulator:
     # -- finalize -----------------------------------------------------------
 
     def finalize(self, gathered: List[np.ndarray]) -> List[np.ndarray]:
-        """Physical accumulator values -> one output column per spec."""
+        """Physical accumulator values -> one output column per spec.
+        UDAF specs evaluate their user function over the buffered values of
+        the slots from the preceding gather()/combine_for_segments()."""
         out = []
         pi = 0
-        for spec in self.specs:
+        for si, spec in enumerate(self.specs):
+            if spec.kind == "udaf":
+                out.append(self._finalize_udaf(si))
+                continue
             n_phys = len(spec.phys())
             vals = gathered[pi: pi + n_phys]
             pi += n_phys
@@ -276,15 +315,90 @@ class Accumulator:
                 out.append(vals[0])
         return out
 
+    def _finalize_udaf(self, si: int) -> np.ndarray:
+        from ..udf.registry import get_udaf
+
+        spec = self.specs[si]
+        u = get_udaf(spec.udaf)
+        if u is None:
+            raise ValueError(f"unknown UDAF {spec.udaf!r}")
+        if self._segment_udaf is not None:
+            groups = self._segment_udaf.get(si, [])
+        else:
+            store = self.udaf_store[si]
+            groups = [
+                np.concatenate(store.get(int(s), [np.empty(0)]))
+                for s in self._gather_slots
+            ]
+        return np.asarray([u.fn(g) for g in groups])
+
+    def combine_for_segments(
+        self, slots: np.ndarray, seg_ids: np.ndarray, n_segments: int
+    ) -> List[np.ndarray]:
+        """Merge per-slot accumulators into per-segment values (sliding
+        window emission): device phys arrays segment-reduce on host; UDAF
+        buffers concatenate per segment for the subsequent finalize()."""
+        gathered = self.gather(slots)
+        combined = []
+        for (op, dt, _, _), vals in zip(self.phys, gathered):
+            outv = np.full(n_segments, _neutral(op, dt), dtype=_np_dtype(dt))
+            if op == "add":
+                np.add.at(outv, seg_ids, vals)
+            elif op == "min":
+                np.minimum.at(outv, seg_ids, vals)
+            else:
+                np.maximum.at(outv, seg_ids, vals)
+            combined.append(outv)
+        if self.udaf_idx:
+            seg_map: Dict[int, list] = {}
+            for si in self.udaf_idx:
+                store = self.udaf_store[si]
+                groups = [[] for _ in range(n_segments)]
+                for s, seg in zip(slots, seg_ids):
+                    groups[int(seg)].extend(store.get(int(s), []))
+                seg_map[si] = [
+                    np.concatenate(g) if g else np.empty(0) for g in groups
+                ]
+            self._segment_udaf = seg_map
+        return combined
+
+    def merge_slot_into(self, dst: int, src: int):
+        """Fold slot src into dst (session merges): device phys via
+        gather/restore is handled by the caller; UDAF buffers move here."""
+        for si in self.udaf_idx:
+            store = self.udaf_store[si]
+            if src in store:
+                store.setdefault(dst, []).extend(store.pop(src))
+
     # -- checkpoint ---------------------------------------------------------
 
     def snapshot(self, slots: np.ndarray) -> List[np.ndarray]:
-        """Device->host copy of live slots for checkpointing."""
-        return self.gather(slots)
+        """Device->host copy of live slots for checkpointing; UDAF value
+        buffers ride along as one list-valued column per UDAF spec."""
+        out = self.gather(slots)
+        for si in self.udaf_idx:
+            store = self.udaf_store[si]
+            out.append(np.asarray(
+                [np.concatenate(store.get(int(s), [np.empty(0)])).tolist()
+                 for s in slots],
+                dtype=object,
+            ))
+        return out
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
-        """Write physical accumulator values back into `slots`."""
-        if len(slots) == 0:
+        """Write physical accumulator values back into `slots` (the tail
+        columns are UDAF value buffers when UDAF specs exist)."""
+        if self.udaf_idx:
+            n_phys = len(self.phys)
+            udaf_cols = values[n_phys:]
+            values = values[:n_phys]
+            for si, col in zip(self.udaf_idx, udaf_cols):
+                store = self.udaf_store[si]
+                for s, vals in zip(slots, col):
+                    arr = np.asarray(list(vals))
+                    if len(arr):
+                        store.setdefault(int(s), []).append(arr)
+        if len(slots) == 0 or not self.phys:
             return
         if self.backend == "numpy":
             for s, v in zip(self.state, values):

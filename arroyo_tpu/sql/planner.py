@@ -411,9 +411,18 @@ class Planner:
             for it in items
         )
         if has_window_fn:
-            raise SqlError(
-                "SQL window functions (OVER) are not yet supported"
-            )
+            return self._plan_window_function(sel, items, upstream, where)
+        from ..udf import registry as udf_registry
+
+        async_items = [
+            it for it in items
+            if isinstance(it.expr, FuncCall)
+            and (u := udf_registry.get(it.expr.name)) is not None
+            and u.is_async
+        ]
+        if async_items:
+            return self._plan_async_udf(sel, items, async_items, upstream,
+                                        where)
         if sel.group_by or self._has_aggregate(items):
             return self._plan_aggregate(sel, items, upstream, where)
         if sel.distinct:
@@ -546,33 +555,10 @@ class Planner:
         specs = []
         agg_out_names = []
         for call, col_idx in zip(agg_calls, agg_col_idx):
-            kind = call.name
-            if kind == "mean":
-                kind = "avg"
-            if call.distinct:
-                if kind != "count":
-                    raise SqlError(
-                        f"DISTINCT is only supported with count(), not {kind}"
-                    )
-                kind = "count_distinct"
-            is_float = (
-                col_idx is not None
-                and pa.types.is_floating(pre_exprs[col_idx].dtype)
-            ) or kind == "avg"
-            name = self._fresh("agg_out")
-            agg_out_names.append(name)
             specs.append(
-                {
-                    "kind": kind,
-                    "col": col_idx,
-                    "name": name,
-                    "is_float": is_float,
-                    "in_type": (
-                        str(pre_exprs[col_idx].dtype) if col_idx is not None
-                        else None
-                    ),
-                }
+                _make_spec(call, col_idx, pre_exprs, self._fresh("agg_out"))
             )
+            agg_out_names.append(specs[-1]["name"])
 
         # window operator output schema: keys + aggs + window struct
         out_fields = [
@@ -678,6 +664,174 @@ class Planner:
             _describe_items(post_names),
         )
 
+    def _restore_select_order(
+        self, out: RelOutput, items, special_item, out_name: str,
+        plain_items, plain_names, description: str,
+    ) -> RelOutput:
+        """Final projection restoring the SELECT item order after an
+        operator that appends one computed column (window fn / async udf)."""
+        final_exprs, final_names = [], []
+        for it in items:
+            if it is special_item:
+                final_exprs.append(bind(Column(out_name), out.scope))
+                final_names.append(out_name)
+            else:
+                idx = plain_items.index(it)
+                final_exprs.append(bind(Column(plain_names[idx]), out.scope))
+                final_names.append(it.alias or plain_names[idx])
+        return self._add_value_node(
+            out, final_exprs, _dedup(final_names), None, description
+        )
+
+    def _plan_async_udf(
+        self, sel, items, async_items, upstream: RelOutput, where
+    ) -> RelOutput:
+        """Async UDF select items plan as an AsyncUdf operator
+        (reference async_udf.rs + planner AsyncUdf node): pre-project the
+        plain items + the UDF's argument columns, run the async operator
+        (which appends the result column), then restore SELECT order."""
+        from ..udf import registry as udf_registry
+
+        if len(async_items) != 1:
+            raise SqlError("one async UDF per SELECT is supported")
+        call = async_items[0].expr
+        u = udf_registry.get(call.name)
+        out_name = async_items[0].alias or call.name
+        plain_items = [it for it in items if it is not async_items[0]]
+        exprs, names = self._bind_items(plain_items, upstream.scope)
+        arg_cols = []
+        for a in call.args:
+            exprs.append(bind(a, upstream.scope))
+            names.append(self._fresh("aarg"))
+            arg_cols.append(len(exprs) - 1)
+        names = _dedup(names)
+        pre = self._add_value_node(
+            upstream, exprs, names, where, "async_udf_input"
+        )
+        out_fields = [
+            pa.field(n, f.type)
+            for n, f in zip(names, pre.schema.schema)
+            if n != TIMESTAMP_FIELD
+        ] + [pa.field(out_name, u.return_type)]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.ASYNC_UDF,
+                {
+                    "udf": call.name,
+                    "arg_cols": arg_cols,
+                    "out_field": out_name,
+                    "schema": out_schema,
+                    "ordered": True,
+                },
+                f"async_{call.name}",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id,
+            self._edge(pre.node_id, self.parallelism), pre.schema,
+        )
+        out = RelOutput(
+            node.node_id, out_schema, Scope.from_schema(out_schema.schema),
+            window=upstream.window,
+        )
+        return self._restore_select_order(
+            out, items, async_items[0], out_name, plain_items, names,
+            "async_udf_select",
+        )
+
+    def _plan_window_function(
+        self, sel, items, upstream: RelOutput, where
+    ) -> RelOutput:
+        """SQL window functions (ROW_NUMBER/RANK/DENSE_RANK OVER
+        (PARTITION BY ... ORDER BY ...)) evaluated per event-time window
+        (reference plan/window_fn.rs + arrow/window_fn.rs)."""
+        over_items = [
+            it for it in items
+            if isinstance(it.expr, FuncCall) and it.expr.over is not None
+        ]
+        if len(over_items) != 1:
+            raise SqlError(
+                "exactly one window function per SELECT is supported"
+            )
+        if upstream.window is None:
+            raise SqlError(
+                "window functions require a windowed input (aggregate with "
+                "tumble()/hop()/session() first)"
+            )
+        call = over_items[0].expr
+        if call.name not in ("row_number", "rank", "dense_rank"):
+            raise SqlError(
+                f"unsupported window function {call.name}()"
+            )
+        out_name = over_items[0].alias or call.name
+        # pre-projection: every non-over select item + partition/order exprs
+        plain_items = [it for it in items if it is not over_items[0]]
+        exprs, names = self._bind_items(plain_items, upstream.scope)
+        part_idx: List[int] = []
+        for p in call.over.partition_by:
+            # the window column partitions implicitly (rows bin by their
+            # window's timestamp), so drop it from PARTITION BY
+            b = bind(p, upstream.scope)
+            if pa.types.is_struct(b.dtype):
+                continue
+            if p in [it.expr for it in plain_items]:
+                part_idx.append([it.expr for it in plain_items].index(p))
+            else:
+                exprs.append(b)
+                names.append(self._fresh("part"))
+                part_idx.append(len(exprs) - 1)
+        order_by: List[tuple] = []
+        for o, desc in call.over.order_by:
+            b = bind(o, upstream.scope)
+            if o in [it.expr for it in plain_items]:
+                order_by.append(
+                    ([it.expr for it in plain_items].index(o), desc)
+                )
+            else:
+                exprs.append(b)
+                names.append(self._fresh("ord"))
+                order_by.append((len(exprs) - 1, desc))
+        names = _dedup(names)
+        pre = self._add_value_node(
+            upstream, exprs, names, where, "window_fn_input"
+        )
+        out_fields = [
+            pa.field(n, f.type)
+            for n, f in zip(names, pre.schema.schema)
+            if n != TIMESTAMP_FIELD
+        ] + [pa.field(out_name, pa.int64())]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.WINDOW_FUNCTION,
+                {
+                    "fn": call.name,
+                    "partition_cols": part_idx,
+                    "order_by": [list(o) for o in order_by],
+                    "schema": out_schema,
+                    "out_field": out_name,
+                },
+                f"{call.name}_over",
+                parallelism=1,  # bins must see all partitions' rows
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id, self._edge(pre.node_id, 1), pre.schema
+        )
+        out = RelOutput(
+            node.node_id, out_schema, Scope.from_schema(out_schema.schema),
+            window=upstream.window, window_field=upstream.window_field
+            if upstream.window_field in out_schema.names else None,
+        )
+        return self._restore_select_order(
+            out, items, over_items[0], out_name, plain_items, names,
+            "window_fn_select",
+        )
+
     def _plan_updating_aggregate(
         self, sel, items, upstream, where, group_exprs, key_bound
     ) -> RelOutput:
@@ -715,17 +869,10 @@ class Planner:
         specs = []
         agg_out_names = []
         for call, col_idx in zip(agg_calls, agg_col_idx):
-            kind = "avg" if call.name == "mean" else call.name
-            is_float = (
-                col_idx is not None
-                and pa.types.is_floating(pre_exprs[col_idx].dtype)
-            ) or kind == "avg"
-            name = self._fresh("agg_out")
-            agg_out_names.append(name)
             specs.append(
-                {"kind": kind, "col": col_idx, "name": name,
-                 "is_float": is_float}
+                _make_spec(call, col_idx, pre_exprs, self._fresh("agg_out"))
             )
+            agg_out_names.append(specs[-1]["name"])
         out_fields = [
             pa.field(n, pre.schema.schema.field(i).type)
             for i, n in enumerate(key_names)
@@ -926,6 +1073,12 @@ class Planner:
     # -- joins --------------------------------------------------------------
 
     def plan_join(self, rel: Join) -> RelOutput:
+        # lookup tables join via the LookupConnector path (reference:
+        # LookupExtension + lookup_join.rs)
+        if isinstance(rel.right, TableRef):
+            t = self.provider.get_table(rel.right.name)
+            if t is not None and t.table_type == "lookup":
+                return self._plan_lookup_join(rel, t)
         left = self.plan_relation(rel.left)
         right = self.plan_relation(rel.right)
         if rel.condition is None:
@@ -1011,6 +1164,102 @@ class Planner:
             window=left.window if windowed else None,
             window_field=None,
         )
+
+    def _plan_lookup_join(self, rel: Join, t: TableDef) -> RelOutput:
+        from ..connectors import get_connector
+
+        left = self.plan_relation(rel.left)
+        if rel.join_type not in ("inner", "left"):
+            raise SqlError("lookup joins support INNER and LEFT JOIN")
+        alias = rel.right.alias or rel.right.name
+        right_fields = [f.name for f in t.fields]
+        # condition must be stream_expr = lookup_key_column
+        equi, residual = _split_join_condition(rel.condition)
+        if len(equi) != 1 or residual:
+            raise SqlError(
+                "lookup joins require exactly one equality condition on the "
+                "lookup table's key column"
+            )
+        a, b = equi[0]
+        right_scope = Scope.from_schema(pa.schema(list(t.fields)), alias)
+        sides = _classify_sides(a, b, left.scope, right_scope)
+        if sides is None:
+            raise SqlError("lookup join condition must compare the stream "
+                           "with the lookup table")
+        stream_expr, key_expr = sides
+        lookup_key = t.options.get(
+            "lookup_key", t.fields[0].name if t.fields else None
+        )
+        if not (
+            isinstance(key_expr, Column) and key_expr.name == lookup_key
+        ):
+            raise SqlError(
+                f"lookup joins must equate against {t.name}'s key column "
+                f"{lookup_key!r} (got {key_expr})"
+            )
+        collisions = {f.name for f in t.fields} & {
+            f.name for f in left.schema.schema if f.name != TIMESTAMP_FIELD
+        }
+        if collisions:
+            raise SqlError(
+                f"lookup table {t.name} fields collide with stream columns: "
+                f"{sorted(collisions)} — alias or rename them"
+            )
+        conn = get_connector(t.connector)
+        options = conn.validate_options(
+            {k: v for k, v in t.options.items()
+             if k not in ("connector", "type", "format")},
+            None,
+        )
+        key_bound = bind(stream_expr, left.scope)
+        exprs = [key_bound]
+        names = ["__lookup_key"]
+        for i, f in enumerate(left.schema.schema):
+            if f.name == TIMESTAMP_FIELD:
+                continue
+            exprs.append(
+                BoundExpr((lambda j: lambda bt: bt.column(j))(i), f.type,
+                          f.name)
+            )
+            names.append(f.name)
+        pre = self._add_value_node(left, exprs, _dedup(names), None, "lookup_in")
+        out_fields = [
+            f for f in pre.schema.schema
+            if f.name not in (TIMESTAMP_FIELD, "__lookup_key")
+        ] + [f for f in t.fields]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.LOOKUP_JOIN,
+                {
+                    "connector": t.connector,
+                    "connector_config": options,
+                    "key_col": 0,
+                    "join_type": rel.join_type,
+                    "right_fields": right_fields,
+                    "schema": out_schema,
+                },
+                f"lookup_{t.name}",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id,
+            self._edge(pre.node_id, self.parallelism), pre.schema,
+        )
+        scope = Scope.from_schema(out_schema.schema)
+        for c in left.scope.cols:
+            if c.qualifier and c.name in out_schema.names:
+                scope.add(c.qualifier, c.name,
+                          out_schema.names.index(c.name),
+                          out_schema.schema.field(c.name).type)
+        for f in t.fields:
+            if f.name in out_schema.names:
+                scope.add(alias, f.name, out_schema.names.index(f.name),
+                          f.type)
+        return RelOutput(node.node_id, out_schema, scope, window=left.window,
+                         window_field=left.window_field)
 
     def _join_side_projection(
         self, side: RelOutput, keys: List[BoundExpr], tag: str
@@ -1198,12 +1447,20 @@ class Planner:
 # ---------------------------------------------------------------------------
 
 
+def _is_aggregate_name(name: str) -> bool:
+    if name in AGG_FUNCS:
+        return True
+    from ..udf.registry import get_udaf
+
+    return get_udaf(name) is not None
+
+
 def _find_aggregates(e: Expr) -> List[FuncCall]:
     out: List[FuncCall] = []
 
     def walk(x):
         if isinstance(x, FuncCall):
-            if x.name in AGG_FUNCS and x.over is None:
+            if _is_aggregate_name(x.name) and x.over is None:
                 out.append(x)
                 return  # don't descend into agg args
             for a in x.args:
@@ -1288,7 +1545,9 @@ def _rewrite_aggregates(
         )
     if isinstance(e, FieldAccess):
         return FieldAccess(_rewrite_aggregates(e.base, calls, names), e.field)
-    if isinstance(e, FuncCall) and not (e.name in AGG_FUNCS and e.over is None):
+    if isinstance(e, FuncCall) and not (
+        _is_aggregate_name(e.name) and e.over is None
+    ):
         return FuncCall(
             e.name,
             [_rewrite_aggregates(a, calls, names) for a in e.args],
@@ -1299,8 +1558,33 @@ def _rewrite_aggregates(
     return e
 
 
+def _make_spec(call: FuncCall, col_idx, pre_exprs, name: str) -> dict:
+    from ..udf.registry import get_udaf
+
+    kind = "avg" if call.name == "mean" else call.name
+    udaf = None
+    if kind not in AGG_FUNCS and get_udaf(call.name) is not None:
+        kind, udaf = "udaf", call.name
+    if call.distinct:
+        if kind != "count":
+            raise SqlError(
+                f"DISTINCT is only supported with count(), not {kind}"
+            )
+        kind = "count_distinct"
+    is_float = (
+        col_idx is not None
+        and pa.types.is_floating(pre_exprs[col_idx].dtype)
+    ) or kind == "avg"
+    return {"kind": kind, "col": col_idx, "name": name,
+            "is_float": is_float, "udaf": udaf}
+
+
 def _agg_output_type(spec: dict, call: FuncCall, pre_schema: pa.Schema):
     kind = spec["kind"]
+    if kind == "udaf":
+        from ..udf.registry import get_udaf
+
+        return get_udaf(spec["udaf"]).return_type
     if kind in ("count", "count_distinct"):
         return pa.int64()
     if kind == "avg":

@@ -26,7 +26,20 @@ class PythonUdf:
     return_type: pa.DataType
     vectorized: bool = True
 
+    @property
+    def is_async(self) -> bool:
+        import inspect
+
+        return inspect.iscoroutinefunction(self.fn)
+
     def bind(self, args):
+        if self.is_async:
+            from ..sql.lexer import SqlError
+
+            raise SqlError(
+                f"{self.name}() is an async UDF and must be a top-level "
+                "SELECT item (planned as an async operator)"
+            )
         from ..sql.expressions import BoundExpr
 
         def call(batch):

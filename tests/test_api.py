@@ -1,0 +1,214 @@
+"""REST API: pipelines lifecycle, preview, connectors, UDFs, connections."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from arroyo_tpu.api.rest import build_app
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+IMPULSE_SQL = """
+CREATE TABLE impulse WITH (
+  connector = 'impulse', event_rate = '1000000',
+  message_count = '1000', start_time = '0'
+);
+SELECT counter FROM impulse WHERE counter < 5;
+"""
+
+
+def with_client(fn):
+    async def run():
+        controller = await ControllerServer(EmbeddedScheduler()).start()
+        app = build_app(controller, db_path=":memory:")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await fn(client, app["api"], controller)
+        finally:
+            await client.close()
+            await controller.stop()
+
+    asyncio.run(run())
+
+
+def test_ping_and_connectors():
+    async def body(client, api, controller):
+        r = await client.get("/api/v1/ping")
+        assert (await r.json())["pong"] is True
+        r = await client.get("/api/v1/connectors")
+        names = {c["id"] for c in (await r.json())["data"]}
+        assert {"kafka", "impulse", "nexmark", "single_file"} <= names
+
+    with_client(body)
+
+
+def test_validate_query():
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/pipelines/validate_query", json={"query": IMPULSE_SQL}
+        )
+        out = await r.json()
+        assert out["errors"] == []
+        assert len(out["graph"]["nodes"]) >= 3
+        r = await client.post(
+            "/api/v1/pipelines/validate_query",
+            json={"query": "SELECT x FROM ghost"},
+        )
+        assert r.status == 400
+        assert "unknown table" in (await r.json())["errors"][0]
+
+    with_client(body)
+
+
+def test_pipeline_lifecycle_with_controller(tmp_path):
+    sink = tmp_path / "out.json"
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '1000000',
+      message_count = '2000', start_time = '0'
+    );
+    CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+      connector = 'single_file', path = '{sink}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out SELECT counter FROM impulse WHERE counter % 2 = 0;
+    """
+
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/pipelines", json={"name": "p1", "query": sql}
+        )
+        assert r.status == 200
+        pid = (await r.json())["id"]
+        # wait for the tracked job to finish
+        for _ in range(300):
+            r = await client.get(f"/api/v1/pipelines/{pid}")
+            state = (await r.json())["state"]
+            if state in ("Finished", "Failed"):
+                break
+            await asyncio.sleep(0.05)
+        assert state == "Finished"
+        r = await client.get(f"/api/v1/pipelines/{pid}/jobs")
+        jobs = (await r.json())["data"]
+        assert len(jobs) == 1 and jobs[0]["state"] == "Finished"
+        r = await client.get("/api/v1/jobs")
+        assert len((await r.json())["data"]) == 1
+
+    with_client(body)
+    rows = [json.loads(l) for l in open(sink)]
+    assert len(rows) == 1000
+
+
+def test_preview_returns_rows():
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/pipelines/preview", json={"query": IMPULSE_SQL}
+        )
+        pid = (await r.json())["id"]
+        for _ in range(200):
+            r = await client.get(f"/api/v1/pipelines/preview/{pid}/output")
+            out = await r.json()
+            if out["done"]:
+                break
+            await asyncio.sleep(0.05)
+        assert out["error"] is None
+        assert sorted(row["counter"] for row in out["rows"]) == [0, 1, 2, 3, 4]
+
+    with_client(body)
+
+
+def test_udf_endpoints():
+    udf_src = """
+@udf(pa.int64(), [pa.int64()], name="plus_one_api")
+def plus_one_api(xs):
+    return xs + 1
+"""
+
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/udfs/validate", json={"definition": udf_src}
+        )
+        assert (await r.json())["udfs"] == ["plus_one_api"]
+        r = await client.post("/api/v1/udfs", json={"definition": udf_src})
+        uid = (await r.json())["id"]
+        r = await client.get("/api/v1/udfs")
+        assert any(u["id"] == uid for u in (await r.json())["data"])
+        # the registered udf is usable in queries
+        r = await client.post(
+            "/api/v1/pipelines/validate_query",
+            json={"query": IMPULSE_SQL.replace(
+                "SELECT counter", "SELECT plus_one_api(counter)"
+            )},
+        )
+        assert (await r.json())["errors"] == []
+        r = await client.post(
+            "/api/v1/udfs/validate", json={"definition": "not python ("}
+        )
+        assert r.status == 400
+
+    with_client(body)
+
+
+def test_connection_tables():
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/connection_tables",
+            json={
+                "name": "t1", "connector": "impulse",
+                "config": {"event_rate": "100"}, "table_type": "source",
+            },
+        )
+        assert r.status == 200
+        r = await client.get("/api/v1/connection_tables")
+        assert len((await r.json())["data"]) == 1
+        r = await client.post(
+            "/api/v1/connection_tables",
+            json={"name": "bad", "connector": "kafka", "config": {}},
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/api/v1/connection_tables/test",
+            json={"connector": "kafka",
+                  "config": {"bootstrap_servers": "x:9092", "topic": "t"}},
+        )
+        out = await r.json()
+        assert out["ok"] is False  # no kafka client in this environment
+
+    with_client(body)
+
+
+def test_stop_pipeline_via_patch(tmp_path):
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '5000', realtime = 'true',
+      start_time = '0'
+    );
+    CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+      connector = 'single_file', path = '{tmp_path}/out.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out SELECT counter FROM impulse;
+    """
+
+    async def body(client, api, controller):
+        r = await client.post(
+            "/api/v1/pipelines", json={"name": "p2", "query": sql}
+        )
+        pid = (await r.json())["id"]
+        await asyncio.sleep(0.3)
+        r = await client.patch(
+            f"/api/v1/pipelines/{pid}", json={"stop": "graceful"}
+        )
+        assert r.status == 200
+        for _ in range(200):
+            r = await client.get(f"/api/v1/pipelines/{pid}")
+            state = (await r.json())["state"]
+            if state in ("Stopped", "Failed", "Finished"):
+                break
+            await asyncio.sleep(0.05)
+        assert state == "Stopped"
+
+    with_client(body)

@@ -1,0 +1,177 @@
+"""Advanced SQL features: window functions (OVER), UDAFs, async UDFs,
+lookup joins."""
+
+import asyncio
+import json
+
+import pyarrow as pa
+import pytest
+
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.sql import plan_query
+from arroyo_tpu.sql.lexer import SqlError
+
+IMPULSE = """
+CREATE TABLE impulse WITH (
+  connector = 'impulse', event_rate = '1000000',
+  message_count = '8000', start_time = '0'
+);
+"""
+
+
+def run_sql(sql, parallelism=1):
+    results = []
+    plan = plan_query(sql, parallelism=parallelism, preview_results=results)
+
+    async def go():
+        eng = Engine(plan.graph).start()
+        await eng.join(60)
+
+    asyncio.run(go())
+    return results
+
+
+def test_row_number_top_n():
+    """Top-2 keys per window by count (the q5-style topN pattern,
+    reference reinvoke_window_function.sql)."""
+    rows = run_sql(
+        IMPULSE
+        + """
+        SELECT k, cnt, rn FROM (
+          SELECT k, cnt,
+                 row_number() OVER (PARTITION BY w ORDER BY cnt DESC, k ASC)
+                   as rn
+          FROM (
+            SELECT counter % 4 as k, tumble(interval '2 millisecond') as w,
+                   count(*) as cnt
+            FROM impulse WHERE counter % 4 < 3 OR counter % 8 = 3
+            GROUP BY 1, 2
+          )
+        ) WHERE rn <= 2;
+        """
+    )
+    # 8ms of data / 2ms windows = 4 windows; keys 0,1,2 have 500/window,
+    # key 3 has 250 -> top2 = two of {0,1,2} (ties broken by k asc)
+    assert len(rows) == 8
+    by_rn = {}
+    for r in rows:
+        by_rn.setdefault(r["rn"], []).append(r)
+    assert len(by_rn[1]) == 4 and len(by_rn[2]) == 4
+    assert all(r["cnt"] == 500 for r in rows)
+    assert all(r["k"] in (0, 1) for r in rows)  # tie-break by k
+
+
+def test_rank_and_dense_rank():
+    rows = run_sql(
+        IMPULSE
+        + """
+        SELECT k, cnt, rank() OVER (PARTITION BY w ORDER BY cnt DESC) as r
+        FROM (
+          SELECT counter % 4 as k, tumble(interval '8 millisecond') as w,
+                 count(*) as cnt
+          FROM impulse GROUP BY 1, 2
+        );
+        """
+    )
+    # single window, all four keys tie at 2000 -> all rank 1
+    assert len(rows) == 4
+    assert all(r["r"] == 1 for r in rows)
+
+
+def test_udaf_in_window():
+    from arroyo_tpu.udf import udaf
+
+    @udaf(pa.float64(), [pa.int64()], name="median_t")
+    def median_t(values):
+        import numpy as np
+
+        return float(np.median(values)) if len(values) else None
+
+    rows = run_sql(
+        IMPULSE
+        + """
+        SELECT k, med, cnt FROM (
+          SELECT counter % 2 as k, tumble(interval '4 millisecond') as w,
+                 median_t(counter) as med, count(*) as cnt
+          FROM impulse GROUP BY 1, 2
+        );
+        """
+    )
+    # 2 windows x 2 keys; window 0 has counters 0..3999
+    assert len(rows) == 4
+    rows.sort(key=lambda r: (r["med"]))
+    assert rows[0]["cnt"] == 2000
+    # k=0 window0: evens 0..3998 -> median 1999; k=1: odds -> 2000
+    meds = sorted(r["med"] for r in rows)
+    assert meds == [1999.0, 2000.0, 5999.0, 6000.0]
+
+
+def test_async_udf():
+    from arroyo_tpu.udf import udf
+
+    @udf(pa.int64(), [pa.int64()], name="slow_double")
+    async def slow_double(x):
+        await asyncio.sleep(0.001)
+        return x * 2
+
+    rows = run_sql(
+        IMPULSE.replace("'8000'", "'50'")
+        + "SELECT counter, slow_double(counter) as d FROM impulse;"
+    )
+    assert len(rows) == 50
+    assert all(r["d"] == 2 * r["counter"] for r in rows)
+
+
+def test_lookup_join(tmp_path):
+    lookup_file = tmp_path / "users.json"
+    with open(lookup_file, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({"uid": i, "name": f"user-{i}"}) + "\n")
+    rows = run_sql(
+        IMPULSE.replace("'8000'", "'10'")
+        + f"""
+        CREATE TABLE users (
+          uid BIGINT,
+          name TEXT
+        ) WITH (
+          connector = 'single_file', path = '{lookup_file}',
+          format = 'json', type = 'lookup', lookup_key = 'uid'
+        );
+        SELECT counter, name FROM impulse
+        JOIN users ON counter % 5 = users.uid;
+        """
+    )
+    # counters 0..9; keys 0..4 looked up; uid 4 missing -> inner join drops
+    assert len(rows) == 8
+    assert all(r["name"] == f"user-{r['counter'] % 5}" for r in rows)
+
+
+def test_lookup_left_join(tmp_path):
+    lookup_file = tmp_path / "users.json"
+    with open(lookup_file, "w") as f:
+        f.write(json.dumps({"uid": 0, "name": "zero"}) + "\n")
+    rows = run_sql(
+        IMPULSE.replace("'8000'", "'4'")
+        + f"""
+        CREATE TABLE users (uid BIGINT, name TEXT) WITH (
+          connector = 'single_file', path = '{lookup_file}',
+          format = 'json', type = 'lookup', lookup_key = 'uid'
+        );
+        SELECT counter, name FROM impulse
+        LEFT JOIN users ON counter = users.uid;
+        """
+    )
+    assert len(rows) == 4
+    named = {r["counter"]: r["name"] for r in rows}
+    assert named[0] == "zero" and named[1] is None
+
+
+def test_async_udf_nested_rejected():
+    from arroyo_tpu.udf import udf
+
+    @udf(pa.int64(), [pa.int64()], name="slow_inc")
+    async def slow_inc(x):
+        return x + 1
+
+    with pytest.raises(SqlError, match="async UDF"):
+        plan_query(IMPULSE + "SELECT slow_inc(counter) + 1 FROM impulse;")

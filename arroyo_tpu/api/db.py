@@ -142,15 +142,16 @@ class ApiDb:
         self.conn.commit()
         return {"id": jid, "pipeline_id": pipeline_id, "state": "Created"}
 
-    def update_job(self, jid: str, state: str, restarts: int = 0):
+    def update_job(self, jid: str, state: str,
+                   restarts: Optional[int] = None):
         finished = (
             time.time()
             if state in ("Finished", "Failed", "Stopped")
             else None
         )
         self.conn.execute(
-            "UPDATE jobs SET state = ?, restarts = ?, finished_at = "
-            "COALESCE(?, finished_at) WHERE id = ?",
+            "UPDATE jobs SET state = ?, restarts = COALESCE(?, restarts), "
+            "finished_at = COALESCE(?, finished_at) WHERE id = ?",
             (state, restarts, finished, jid),
         )
         self.conn.commit()

@@ -133,13 +133,15 @@ class ApiServer:
     async def patch_pipeline(self, request: web.Request):
         """stop modes (reference: PATCH /pipelines/{id} with stop field)."""
         pid = request.match_info["id"]
+        if self.db.get_pipeline(pid) is None:
+            return error(404, "pipeline not found")
         body = await request.json()
         stop = body.get("stop")
         if stop not in (None, "none", "checkpoint", "graceful", "immediate"):
             return error(400, f"invalid stop mode {stop}")
         if stop and stop != "none":
             await self._stop_pipeline_jobs(pid, stop)
-        return json_response(self.db.get_pipeline(pid) or {})
+        return json_response(self.db.get_pipeline(pid))
 
     async def restart_pipeline(self, request: web.Request):
         pid = request.match_info["id"]
@@ -173,7 +175,8 @@ class ApiServer:
                     )
                 except TimeoutError:
                     pass
-                self.db.update_job(j["id"], self.controller.jobs[j["id"]].state.value)
+                cj = self.controller.jobs[j["id"]]
+                self.db.update_job(j["id"], cj.state.value, cj.restarts)
 
     # -- jobs / checkpoints -------------------------------------------------
 
@@ -204,7 +207,7 @@ class ApiServer:
 
     async def job_errors(self, request: web.Request):
         jid = request.match_info["job_id"]
-        job = (self.controller or ControllerServer()).jobs.get(jid)
+        job = self.controller.jobs.get(jid) if self.controller else None
         return json_response(
             {"data": [{"message": job.failure}] if job and job.failure else []}
         )
@@ -234,13 +237,23 @@ class ApiServer:
         self.previews[pid["id"]] = {"rows": results, "done": False}
 
         async def run():
+            eng = None
             try:
                 eng = Engine(plan.graph).start()
                 await eng.join(body.get("timeout", 60))
             except Exception as e:  # noqa: BLE001
                 self.previews[pid["id"]]["error"] = str(e)
+                if eng is not None:
+                    # a timed-out preview must not keep burning CPU
+                    from ..types import StopMode
+
+                    await eng.stop(StopMode.IMMEDIATE)
+                    for t in eng.tasks:
+                        t.cancel()
             finally:
                 self.previews[pid["id"]]["done"] = True
+                while len(self.previews) > 20:  # bound retained previews
+                    self.previews.pop(next(iter(self.previews)))
 
         asyncio.ensure_future(run())
         return json_response(pid)
@@ -334,11 +347,13 @@ class ApiServer:
         from ..udf import registry
 
         body = await request.json()
+        snap = registry.snapshot()
         try:
             names = registry.register_from_source(body["definition"])
-            registry.clear_dynamic(names)
         except Exception as e:  # noqa: BLE001 - user code boundary
             return json_response({"errors": [str(e)]}, status=400)
+        finally:
+            registry.restore(snap)  # validation must not mutate the registry
         return json_response({"udfs": names, "errors": []})
 
     async def create_udf(self, request: web.Request):

@@ -259,25 +259,46 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
         person_col[i] = ev["person"]
         auction_col[i] = ev["auction"]
     bi = np.nonzero(is_bid)[0]
+    bid_arr = pa.array(bid_col, type=BID_T)
     if len(bi):
+        # vectorized struct construction: children built as flat arrays with
+        # a validity mask (no python dict per bid)
         auction, bidder, price, channel = _bid_fields(ns[bi])
-        for j, i in enumerate(bi):
-            a = int(auction[j])
-            bid_col[i] = {
-                "auction": a,
-                "bidder": int(bidder[j]),
-                "price": int(price[j]),
-                "channel": _CHANNELS[int(channel[j])],
-                "url": f"https://auction.example.com/item/{a}",
-                "datetime": int(ts[i]),
-                "extra": "",
-            }
+        full = np.zeros(n, dtype=np.int64)
+        valid = np.zeros(n, dtype=bool)
+        valid[bi] = True
+
+        def scatter(vals):
+            out = full.copy()
+            out[bi] = vals
+            return out
+
+        urls = np.full(n, "", dtype=object)
+        urls[bi] = [
+            f"https://auction.example.com/item/{int(a)}" for a in auction
+        ]
+        chans = np.full(n, "", dtype=object)
+        chans[bi] = [_CHANNELS[int(c)] for c in channel]
+        mask = pa.array(~valid)
+        bid_arr = pa.StructArray.from_arrays(
+            [
+                pa.array(scatter(auction)),
+                pa.array(scatter(bidder)),
+                pa.array(scatter(price)),
+                pa.array(chans, type=pa.string()),
+                pa.array(urls, type=pa.string()),
+                pa.array(np.where(valid, ts, 0)).cast(pa.timestamp("ns")),
+                pa.array([""] * n, type=pa.string()),
+            ],
+            fields=list(BID_T),
+            mask=mask,
+        )
     schema = NEXMARK_SCHEMA.schema
     return pa.RecordBatch.from_arrays(
         [
             pa.array(person_col, type=PERSON_T),
             pa.array(auction_col, type=AUCTION_T),
-            pa.array(bid_col, type=BID_T),
+            bid_arr,
             pa.array(ts, type=pa.int64()).cast(pa.timestamp("ns")),
         ],
         schema=schema,

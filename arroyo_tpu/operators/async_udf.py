@@ -53,10 +53,13 @@ class AsyncUdfOperator(Operator):
         cols = [
             batch.column(i).to_pylist() for i in self.arg_cols
         ]
+        if cols:
+            arg_rows = zip(*cols)
+        else:
+            arg_rows = (() for _ in range(batch.num_rows))
         tasks = [
-            asyncio.ensure_future(self._invoke(args))
-            for args in zip(*cols)
-        ] if cols else []
+            asyncio.ensure_future(self._invoke(args)) for args in arg_rows
+        ]
         try:
             if self.ordered:
                 results = await asyncio.gather(*tasks)

@@ -63,11 +63,26 @@ class WindowOperatorBase(Operator):
         self._key_types: Optional[List[pa.DataType]] = None
         self._key_names: Optional[List[str]] = None
 
+    # operators that only use assign/take_bin/bin_entries/items can swap in
+    # the C++ directory for single-integer keys (tumbling, sliding)
+    _native_ok = False
+
     def _capture_key_meta(self, ctx):
         if self._key_types is None:
             in_schema = ctx.in_schemas[0].schema
             self._key_types = [in_schema.field(i).type for i in self.key_cols]
             self._key_names = [in_schema.field(i).name for i in self.key_cols]
+            if self._native_ok and self.dir.n_live == 0:
+                from ..ops.native import (
+                    NativeSlotDirectory,
+                    load_native,
+                    supports_native,
+                )
+
+                if supports_native(self._key_types):
+                    self.dir = NativeSlotDirectory(
+                        load_native(), n_keys=len(self._key_types)
+                    )
 
     def _ensure_capacity(self):
         need = self.dir.required_capacity()
@@ -79,22 +94,30 @@ class WindowOperatorBase(Operator):
         for i in self.key_cols:
             col = batch.column(i)
             if pa.types.is_struct(col.type):
-                # struct keys (window structs) become tuples of child values
+                # struct keys (window structs) become tuples of child values;
+                # tuples are built per UNIQUE row (batches share few windows)
                 children = [
                     np.asarray(col.field(j).cast(pa.int64()))
                     if _is_temporal_or_int(col.type.field(j).type)
                     else np.array(col.field(j).to_pylist(), dtype=object)
                     for j in range(col.type.num_fields)
                 ]
-                out.append(
-                    np.fromiter(
-                        (tuple(int(c[r]) if isinstance(c[r], np.integer)
-                               else c[r] for c in children)
-                         for r in range(batch.num_rows)),
-                        dtype=object,
-                        count=batch.num_rows,
+                if all(c.dtype != object for c in children):
+                    mat = np.stack(children, axis=1)
+                    uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
+                    tuples = np.empty(len(uniq), dtype=object)
+                    tuples[:] = [tuple(int(x) for x in row) for row in uniq]
+                    out.append(tuples[inverse.ravel()])
+                else:
+                    out.append(
+                        np.fromiter(
+                            (tuple(int(c[r]) if isinstance(c[r], np.integer)
+                                   else c[r] for c in children)
+                             for r in range(batch.num_rows)),
+                            dtype=object,
+                            count=batch.num_rows,
+                        )
                     )
-                )
                 continue
             try:
                 out.append(col.to_numpy(zero_copy_only=False))
@@ -107,7 +130,13 @@ class WindowOperatorBase(Operator):
         for spec in self.specs:
             if spec.col is not None and spec.col not in cols:
                 arr = batch.column(spec.col)
-                if spec.is_float:
+                if spec.kind == "udaf":
+                    # UDAFs receive raw values (no numeric cast): strings,
+                    # timestamps etc. buffer host-side untouched
+                    cols[spec.col] = np.asarray(
+                        arr.to_numpy(zero_copy_only=False)
+                    )
+                elif spec.is_float:
                     cols[spec.col] = np.asarray(
                         arr.to_numpy(zero_copy_only=False), dtype=np.float64
                     )
@@ -327,6 +356,8 @@ def _ceil_div(a: int, b: int) -> int:
 
 
 class TumblingWindowOperator(WindowOperatorBase):
+    _native_ok = True
+
     """Fixed-width windows: bin = ts // width; emit at watermark >= end
     (reference tumbling_aggregating_window.rs:66-321).
 
@@ -416,6 +447,8 @@ class SlidingWindowOperator(WindowOperatorBase):
     merges width/slide bins (reference sliding_aggregating_window.rs:64-753).
     Requires width % slide == 0."""
 
+    _native_ok = True
+
     def __init__(self, config: dict):
         super().__init__(config, "sliding_window")
         self.width = int(config["width_nanos"])
@@ -493,27 +526,41 @@ class SlidingWindowOperator(WindowOperatorBase):
         lo_bin = end_bin - self.k
         # merge per-key across participating bins (host merge: runs once per
         # slide period; the per-event scatter stays on device)
-        merged: Dict[tuple, List[int]] = {}
+        key_chunks = []
+        slot_chunks = []
         for b in range(lo_bin, end_bin):
-            bin_map = self.dir.peek_bin(b)
-            if not bin_map:
-                continue
-            for key, slot in bin_map.items():
-                merged.setdefault(key, []).append(slot)
-        if merged:
-            all_slots = np.fromiter(
-                (s for slots in merged.values() for s in slots), dtype=np.int64
-            )
-            seg_ids = np.fromiter(
-                (i for i, slots in enumerate(merged.values()) for _ in slots),
-                dtype=np.int64,
-            )
+            keys_b, slots_b = self.dir.bin_entries(b)
+            if len(slots_b):
+                key_chunks.append(keys_b)
+                slot_chunks.append(slots_b)
+        if slot_chunks:
+            all_slots = np.concatenate(slot_chunks)
+            if isinstance(key_chunks[0], np.ndarray):
+                # native path: vectorized key-union over int64 arrays
+                all_keys = np.concatenate(key_chunks)
+                uniq, seg_ids = np.unique(all_keys, return_inverse=True)
+                if self.key_cols:
+                    out_keys = [(int(k),) for k in uniq]
+                else:
+                    out_keys = [() for _ in uniq]
+                n_keys = len(uniq)
+            else:
+                index: Dict[tuple, int] = {}
+                seg = np.empty(len(all_slots), dtype=np.int64)
+                i = 0
+                for chunk in key_chunks:
+                    for key in chunk:
+                        seg[i] = index.setdefault(key, len(index))
+                        i += 1
+                seg_ids = seg
+                out_keys = list(index.keys())
+                n_keys = len(index)
             combined = self.acc.combine_for_segments(
-                all_slots, seg_ids, len(merged)
+                all_slots, seg_ids, n_keys
             )
             agg_cols = self.acc.finalize(combined)
             out_batch = self._build_output(
-                list(merged.keys()), agg_cols, end - self.width, end
+                out_keys, agg_cols, end - self.width, end
             )
             await collector.collect(out_batch)
         # the oldest bin exits the window range: free it

@@ -64,6 +64,14 @@ class SlotDirectory:
     def peek_bin(self, b: int) -> Optional[Dict[tuple, int]]:
         return self.by_bin.get(b)
 
+    def bin_entries(self, b: int):
+        """(keys, slots) of a live bin without removal; keys as a list of
+        tuples (the native directory returns int64 arrays instead)."""
+        bin_map = self.by_bin.get(b, {})
+        return list(bin_map.keys()), np.fromiter(
+            bin_map.values(), dtype=np.int64, count=len(bin_map)
+        )
+
     def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
         """Remove a bin for emission: returns (keys, slots) and frees the
         slots (caller must reset accumulator slots before reuse)."""

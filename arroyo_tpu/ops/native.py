@@ -1,0 +1,139 @@
+"""Loader + wrapper for the native (C++) slot directory.
+
+The native path handles the common single-int64-key case; everything else
+falls back to the python SlotDirectory. Build happens lazily on first use
+(g++ is in the image); failures degrade silently to the python
+implementation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_native = None
+_tried = False
+
+
+def load_native():
+    global _native, _tried
+    if _tried:
+        return _native
+    _tried = True
+    if os.environ.get("ARROYO_DISABLE_NATIVE"):
+        return None
+    try:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        native_dir = os.path.join(repo_root, "native")
+        sys.path.insert(0, native_dir)
+        try:
+            import arroyo_native  # noqa: F401
+        except ImportError:
+            from importlib import invalidate_caches
+
+            sys.path.insert(0, native_dir)
+            build_py = os.path.join(native_dir, "build.py")
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location("_anb", build_py)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.build()
+            invalidate_caches()
+            import arroyo_native  # noqa: F401
+        _native = arroyo_native
+    except Exception:  # noqa: BLE001 - silent fallback to python impl
+        _native = None
+    return _native
+
+
+class NativeSlotDirectory:
+    """Single-int64-key directory over the C++ open-addressing table,
+    API-compatible with ops.directory.SlotDirectory for the paths the
+    window operators use. Keys surface as 1-tuples like the python impl."""
+
+    def __init__(self, native_mod, n_keys: int = 1):
+        self._d = native_mod.SlotDir()
+        self.n_keys = n_keys  # 0 = unkeyed (synthetic zero key, empty tuples)
+        self.free: list = []  # parity attribute; slot reuse lives natively
+
+    @property
+    def n_live(self) -> int:
+        return self._d.n_live()
+
+    def required_capacity(self) -> int:
+        return self._d.required_capacity()
+
+    def assign(self, bins: np.ndarray, key_cols: List[np.ndarray]) -> np.ndarray:
+        key = key_cols[0] if key_cols else np.zeros(len(bins), dtype=np.int64)
+        if key.dtype == np.uint64:
+            key = key.view(np.int64)
+        out = self._d.assign(
+            np.ascontiguousarray(bins, dtype=np.int64),
+            np.ascontiguousarray(key, dtype=np.int64),
+        )
+        return np.frombuffer(out, dtype=np.int64)
+
+    def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+        keys_raw, slots_raw = self._d.take_bin(int(b))
+        keys = np.frombuffer(keys_raw, dtype=np.int64)
+        slots = np.frombuffer(slots_raw, dtype=np.int64).copy()
+        if self.n_keys == 0:
+            return [() for _ in keys], slots
+        return [(int(k),) for k in keys], slots
+
+    def bin_entries(self, b: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys int64, slots int64) of a live bin, without removal."""
+        keys_raw, slots_raw = self._d.get_bin(int(b))
+        return (
+            np.frombuffer(keys_raw, dtype=np.int64),
+            np.frombuffer(slots_raw, dtype=np.int64),
+        )
+
+    @property
+    def by_bin(self):
+        # truthiness probe used by the sliding operator ("anything live?")
+        return {b: True for b in self._d.live_bins()}
+
+    def peek_bin(self, b: int):
+        keys, _ = self.bin_entries(b)
+        if not len(keys):
+            return None
+        if self.n_keys == 0:
+            return {(): None}
+        return {(int(k),): None for k in keys}
+
+    def live_bins(self) -> List[int]:
+        return sorted(self._d.live_bins())
+
+    def bins_up_to(self, limit: int) -> List[int]:
+        return sorted(b for b in self._d.live_bins() if b < limit)
+
+    def items(self):
+        bins_raw, keys_raw, slots_raw = self._d.entries()
+        bins = np.frombuffer(bins_raw, dtype=np.int64)
+        keys = np.frombuffer(keys_raw, dtype=np.int64)
+        slots = np.frombuffer(slots_raw, dtype=np.int64)
+        for b, k, s in zip(bins, keys, slots):
+            yield int(b), (() if self.n_keys == 0 else (int(k),)), int(s)
+
+
+def supports_native(key_types) -> bool:
+    """Native fast path: zero or one key column of integer/timestamp type."""
+    if load_native() is None:
+        return False
+    if len(key_types) > 1:
+        return False
+    if not key_types:
+        return True
+    import pyarrow as pa
+
+    t = key_types[0]
+    # bool keys stay on the python path: native returns python ints and
+    # pa.array(ints, type=bool_) is rejected at emission
+    return pa.types.is_integer(t) or pa.types.is_timestamp(t)

@@ -104,12 +104,28 @@ def get_udaf(name: str) -> Optional[PythonUdaf]:
 def register_from_source(source: str) -> List[str]:
     """Register UDFs from python source text (the API's CREATE-UDF path,
     reference: arroyo-api udfs.rs). The source must call @udf/@udaf.
-    Returns the names registered."""
-    before = set(_UDFS) | set(_UDAFS)
+    Returns every name the source (re)registered."""
+    before_u = dict(_UDFS)
+    before_a = dict(_UDAFS)
     namespace = {"udf": udf, "udaf": udaf, "pa": pa, "np": np}
     exec(compile(source, "<udf>", "exec"), namespace)  # noqa: S102
-    after = set(_UDFS) | set(_UDAFS)
-    return sorted(after - before)
+    changed = [
+        n for n in _UDFS if _UDFS[n] is not before_u.get(n)
+    ] + [n for n in _UDAFS if _UDAFS[n] is not before_a.get(n)]
+    return sorted(set(changed))
+
+
+def snapshot() -> tuple:
+    """Capture registry state so a validation-only registration can be
+    rolled back exactly (including redefinitions of existing names)."""
+    return dict(_UDFS), dict(_UDAFS)
+
+
+def restore(snap: tuple):
+    _UDFS.clear()
+    _UDFS.update(snap[0])
+    _UDAFS.clear()
+    _UDAFS.update(snap[1])
 
 
 def clear_dynamic(names: List[str]):

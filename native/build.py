@@ -1,0 +1,38 @@
+"""Build the native extension: g++ -> arroyo_native.so next to this file.
+
+Invoked automatically on first import attempt (ops/native.py) and cached;
+run manually with `python native/build.py` to rebuild.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "slotdir.cpp")
+OUT = os.path.join(
+    HERE, f"arroyo_native{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}"
+)
+
+
+def build(force: bool = False) -> str:
+    if (
+        not force
+        and os.path.exists(OUT)
+        and os.path.getmtime(OUT) >= os.path.getmtime(SRC)
+    ):
+        return OUT
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", SRC, "-o", OUT,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

@@ -1,0 +1,377 @@
+// Native slot directory: the host-side (bin, key) -> accumulator-slot hash
+// table on the window operators' per-batch path.
+//
+// The reference engine's equivalent hot structure is the per-bin DataFusion
+// hash-aggregation state (/root/reference/crates/arroyo-worker/src/arrow/
+// tumbling_aggregating_window.rs) maintained in native Rust; here the
+// directory is the piece of per-row work that stays on the host next to the
+// XLA scatter-reduce, so it gets the native treatment: an open-addressing
+// table over (bin i64, key i64) pairs with splitmix64 probing, a slot free
+// list, and per-bin entry chains for O(bin size) emission.
+//
+// Exposed to Python via the raw CPython API (no pybind11 in this image);
+// arrays cross the boundary through the buffer protocol (numpy int64).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    int64_t bin;
+    int64_t key;
+    int64_t slot;
+    int32_t next_in_bin;  // index of next entry of the same bin, -1 = end
+    uint8_t live;
+};
+
+struct BinHead {
+    int64_t bin;
+    int32_t head;   // first entry index
+    int32_t count;  // live entries in this bin
+    uint8_t used;
+};
+
+static inline uint64_t splitmix64(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+}
+
+static inline uint64_t hash_pair(int64_t bin, int64_t key) {
+    return splitmix64(splitmix64((uint64_t)bin) ^ (uint64_t)key);
+}
+
+struct SlotDir {
+    PyObject_HEAD
+    // open-addressing index: maps hash(bin,key) -> entry idx (+1, 0=empty)
+    std::vector<int32_t>* index;
+    std::vector<Entry>* entries;
+    std::vector<int32_t>* free_entries;  // recycled entry indices
+    std::vector<int64_t>* free_slots;
+    std::vector<BinHead>* bin_index;  // open addressing over bins
+    int64_t next_slot;
+    int64_t n_live;
+    int64_t n_used;  // index slots holding a ref (live or dead)
+    size_t mask;
+    size_t bin_mask;
+};
+
+static void rehash(SlotDir* self, size_t new_size) {
+    std::vector<int32_t> fresh(new_size, 0);
+    size_t mask = new_size - 1;
+    for (size_t i = 0; i < self->entries->size(); i++) {
+        const Entry& e = (*self->entries)[i];
+        if (!e.live) continue;
+        size_t h = hash_pair(e.bin, e.key) & mask;
+        while (fresh[h] != 0) h = (h + 1) & mask;
+        fresh[h] = (int32_t)i + 1;
+    }
+    self->index->swap(fresh);
+    self->mask = mask;
+    self->n_used = self->n_live;  // dead refs dropped by the rebuild
+}
+
+static void bin_rehash(SlotDir* self, size_t new_size) {
+    std::vector<BinHead> fresh(new_size);
+    size_t mask = new_size - 1;
+    for (const BinHead& b : *self->bin_index) {
+        if (!b.used || b.count == 0) continue;
+        size_t h = splitmix64((uint64_t)b.bin) & mask;
+        while (fresh[h].used) h = (h + 1) & mask;
+        fresh[h] = b;
+    }
+    self->bin_index->swap(fresh);
+    self->bin_mask = mask;
+}
+
+static BinHead* bin_lookup(SlotDir* self, int64_t bin, bool create) {
+    if (self->bin_index->size() == 0 ||
+        (create && self->n_live * 2 + 16 > (int64_t)self->bin_index->size()))
+        bin_rehash(self, self->bin_index->size() ? self->bin_index->size() * 2
+                                                 : 1024);
+    size_t h = splitmix64((uint64_t)bin) & self->bin_mask;
+    for (;;) {
+        BinHead& b = (*self->bin_index)[h];
+        if (!b.used) {
+            if (!create) return nullptr;
+            b.used = 1;
+            b.bin = bin;
+            b.head = -1;
+            b.count = 0;
+            return &b;
+        }
+        if (b.bin == bin && b.count >= 0) return &b;
+        h = (h + 1) & self->bin_mask;
+    }
+}
+
+static PyObject* SlotDir_new(PyTypeObject* type, PyObject*, PyObject*) {
+    SlotDir* self = (SlotDir*)type->tp_alloc(type, 0);
+    if (!self) return nullptr;
+    self->index = new std::vector<int32_t>(4096, 0);
+    self->entries = new std::vector<Entry>();
+    self->free_entries = new std::vector<int32_t>();
+    self->free_slots = new std::vector<int64_t>();
+    self->bin_index = new std::vector<BinHead>(1024);
+    self->next_slot = 0;
+    self->n_live = 0;
+    self->n_used = 0;
+    self->mask = 4095;
+    self->bin_mask = 1023;
+    return (PyObject*)self;
+}
+
+static void SlotDir_dealloc(SlotDir* self) {
+    delete self->index;
+    delete self->entries;
+    delete self->free_entries;
+    delete self->free_slots;
+    delete self->bin_index;
+    Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static int get_i64_buffer(PyObject* obj, Py_buffer* view) {
+    if (PyObject_GetBuffer(obj, view, PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0)
+        return -1;
+    if (view->itemsize != 8) {
+        PyBuffer_Release(view);
+        PyErr_SetString(PyExc_TypeError, "expected int64 array");
+        return -1;
+    }
+    return 0;
+}
+
+// assign(bins, keys) -> bytes holding int64 slots
+static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
+    PyObject *bins_obj, *keys_obj;
+    if (!PyArg_ParseTuple(args, "OO", &bins_obj, &keys_obj)) return nullptr;
+    Py_buffer bins, keys;
+    if (get_i64_buffer(bins_obj, &bins) != 0) return nullptr;
+    if (get_i64_buffer(keys_obj, &keys) != 0) {
+        PyBuffer_Release(&bins);
+        return nullptr;
+    }
+    Py_ssize_t n = bins.len / 8;
+    PyObject* out = PyBytes_FromStringAndSize(nullptr, n * 8);
+    if (!out) {
+        PyBuffer_Release(&bins);
+        PyBuffer_Release(&keys);
+        return nullptr;
+    }
+    int64_t* slots = (int64_t*)PyBytes_AS_STRING(out);
+    const int64_t* b = (const int64_t*)bins.buf;
+    const int64_t* k = (const int64_t*)keys.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        // occupancy (live + tombstoned refs) drives the load factor; a
+        // rehash drops tombstones, growing only when live entries need it
+        if ((self->n_used + 1) * 4 > (int64_t)self->index->size() * 3) {
+            size_t size = self->index->size();
+            if ((self->n_live + 1) * 4 > (int64_t)size * 3) size *= 2;
+            rehash(self, size);
+        }
+        size_t h = hash_pair(b[i], k[i]) & self->mask;
+        int32_t entry_idx = -1;
+        int64_t first_dead = -1;
+        for (;;) {
+            int32_t slot_ref = (*self->index)[h];
+            if (slot_ref == 0) break;
+            Entry& e = (*self->entries)[slot_ref - 1];
+            if (e.live && e.bin == b[i] && e.key == k[i]) {
+                entry_idx = slot_ref - 1;
+                break;
+            }
+            if (!e.live && first_dead < 0) first_dead = (int64_t)h;
+            h = (h + 1) & self->mask;
+        }
+        if (entry_idx >= 0) {
+            slots[i] = (*self->entries)[entry_idx].slot;
+            continue;
+        }
+        if (first_dead >= 0) {
+            h = (size_t)first_dead;  // reuse a tombstoned index slot
+            self->n_used -= 1;       // net zero after the insert below
+        }
+        int64_t slot;
+        if (!self->free_slots->empty()) {
+            slot = self->free_slots->back();
+            self->free_slots->pop_back();
+        } else {
+            slot = self->next_slot++;
+        }
+        int32_t idx;
+        if (!self->free_entries->empty()) {
+            idx = self->free_entries->back();
+            self->free_entries->pop_back();
+        } else {
+            idx = (int32_t)self->entries->size();
+            self->entries->push_back(Entry());
+        }
+        BinHead* bh = bin_lookup(self, b[i], true);
+        Entry& e = (*self->entries)[idx];
+        e.bin = b[i];
+        e.key = k[i];
+        e.slot = slot;
+        e.live = 1;
+        e.next_in_bin = bh->head;
+        bh->head = idx;
+        bh->count += 1;
+        (*self->index)[h] = idx + 1;
+        self->n_live += 1;
+        self->n_used += 1;
+        slots[i] = slot;
+    }
+    PyBuffer_Release(&bins);
+    PyBuffer_Release(&keys);
+    return out;
+}
+
+// take_bin(bin) -> (keys_bytes, slots_bytes); removes the bin
+static PyObject* SlotDir_take_bin(SlotDir* self, PyObject* args) {
+    int64_t bin;
+    if (!PyArg_ParseTuple(args, "L", &bin)) return nullptr;
+    BinHead* bh = bin_lookup(self, bin, false);
+    int32_t count = bh ? bh->count : 0;
+    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    if (!keys || !slots) return nullptr;
+    int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
+    int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
+    if (bh) {
+        int32_t idx = bh->head;
+        int32_t i = 0;
+        while (idx >= 0) {
+            Entry& e = (*self->entries)[idx];
+            kout[i] = e.key;
+            sout[i] = e.slot;
+            i++;
+            // remove from the open-addressing index lazily: mark dead and
+            // reinsert cost is avoided by tombstone-free probing on rehash
+            e.live = 0;
+            self->free_entries->push_back(idx);
+            self->free_slots->push_back(e.slot);
+            idx = e.next_in_bin;
+        }
+        self->n_live -= bh->count;
+        bh->count = 0;
+        bh->head = -1;
+        // rebuild the index when dead entries dominate (keeps probes short)
+        if ((int64_t)self->free_entries->size() > self->n_live + 1024)
+            rehash(self, self->index->size());
+    }
+    return Py_BuildValue("(NN)", keys, slots);
+}
+
+// get_bin(bin) -> (keys_bytes, slots_bytes) WITHOUT removing (sliding merge)
+static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
+    int64_t bin;
+    if (!PyArg_ParseTuple(args, "L", &bin)) return nullptr;
+    BinHead* bh = bin_lookup(self, bin, false);
+    int32_t count = bh ? bh->count : 0;
+    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    if (!keys || !slots) return nullptr;
+    int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
+    int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
+    if (bh) {
+        int32_t idx = bh->head;
+        int32_t i = 0;
+        while (idx >= 0) {
+            const Entry& e = (*self->entries)[idx];
+            kout[i] = e.key;
+            sout[i] = e.slot;
+            i++;
+            idx = e.next_in_bin;
+        }
+    }
+    return Py_BuildValue("(NN)", keys, slots);
+}
+
+// entries() -> (bins_bytes, keys_bytes, slots_bytes) over all live entries
+static PyObject* SlotDir_entries(SlotDir* self, PyObject*) {
+    int64_t count = self->n_live;
+    PyObject* bins = PyBytes_FromStringAndSize(nullptr, count * 8);
+    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    if (!bins || !keys || !slots) return nullptr;
+    int64_t* bout = (int64_t*)PyBytes_AS_STRING(bins);
+    int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
+    int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
+    int64_t i = 0;
+    for (const Entry& e : *self->entries) {
+        if (!e.live) continue;
+        bout[i] = e.bin;
+        kout[i] = e.key;
+        sout[i] = e.slot;
+        i++;
+    }
+    return Py_BuildValue("(NNN)", bins, keys, slots);
+}
+
+static PyObject* SlotDir_live_bins(SlotDir* self, PyObject*) {
+    PyObject* out = PyList_New(0);
+    for (const BinHead& b : *self->bin_index) {
+        if (b.used && b.count > 0) {
+            PyObject* v = PyLong_FromLongLong(b.bin);
+            PyList_Append(out, v);
+            Py_DECREF(v);
+        }
+    }
+    return out;
+}
+
+static PyObject* SlotDir_required_capacity(SlotDir* self, PyObject*) {
+    return PyLong_FromLongLong(self->next_slot + 1);
+}
+
+static PyObject* SlotDir_n_live(SlotDir* self, PyObject*) {
+    return PyLong_FromLongLong(self->n_live);
+}
+
+static PyMethodDef SlotDir_methods[] = {
+    {"assign", (PyCFunction)SlotDir_assign, METH_VARARGS,
+     "assign(bins_i64, keys_i64) -> slots bytes"},
+    {"take_bin", (PyCFunction)SlotDir_take_bin, METH_VARARGS,
+     "take_bin(bin) -> (keys bytes, slots bytes)"},
+    {"get_bin", (PyCFunction)SlotDir_get_bin, METH_VARARGS,
+     "get_bin(bin) -> (keys bytes, slots bytes) without removing"},
+    {"entries", (PyCFunction)SlotDir_entries, METH_NOARGS,
+     "entries() -> (bins bytes, keys bytes, slots bytes)"},
+    {"live_bins", (PyCFunction)SlotDir_live_bins, METH_NOARGS, ""},
+    {"required_capacity", (PyCFunction)SlotDir_required_capacity,
+     METH_NOARGS, ""},
+    {"n_live", (PyCFunction)SlotDir_n_live, METH_NOARGS, ""},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyTypeObject SlotDirType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+static PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "arroyo_native",
+    "native slot directory for arroyo_tpu window operators", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_arroyo_native(void) {
+    SlotDirType.tp_name = "arroyo_native.SlotDir";
+    SlotDirType.tp_basicsize = sizeof(SlotDir);
+    SlotDirType.tp_flags = Py_TPFLAGS_DEFAULT;
+    SlotDirType.tp_new = SlotDir_new;
+    SlotDirType.tp_dealloc = (destructor)SlotDir_dealloc;
+    SlotDirType.tp_methods = SlotDir_methods;
+    if (PyType_Ready(&SlotDirType) < 0) return nullptr;
+    PyObject* m = PyModule_Create(&moduledef);
+    if (!m) return nullptr;
+    Py_INCREF(&SlotDirType);
+    PyModule_AddObject(m, "SlotDir", (PyObject*)&SlotDirType);
+    return m;
+}

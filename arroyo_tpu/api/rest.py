@@ -252,8 +252,14 @@ class ApiServer:
                         t.cancel()
             finally:
                 self.previews[pid["id"]]["done"] = True
-                while len(self.previews) > 20:  # bound retained previews
-                    self.previews.pop(next(iter(self.previews)))
+                done_ids = [
+                    k for k, v in self.previews.items()
+                    if v.get("done") and k != pid["id"]
+                ]
+                while len(self.previews) > 20 and done_ids:
+                    # evict finished previews only: a running preview's
+                    # cleanup still needs its entry
+                    self.previews.pop(done_ids.pop(0), None)
 
         asyncio.ensure_future(run())
         return json_response(pid)
@@ -426,6 +432,9 @@ def build_app(controller: Optional[ControllerServer] = None,
     r.add_post(f"{v1}/udfs", api.create_udf)
     r.add_get(f"{v1}/udfs", api.list_udfs)
     r.add_delete(f"{v1}/udfs/{{id}}", api.delete_udf)
+    from .console import add_console_routes
+
+    add_console_routes(app)
     app["api"] = api
     return app
 

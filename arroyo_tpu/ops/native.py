@@ -32,20 +32,27 @@ def load_native():
         native_dir = os.path.join(repo_root, "native")
         sys.path.insert(0, native_dir)
         try:
-            import arroyo_native  # noqa: F401
-        except ImportError:
-            from importlib import invalidate_caches
+            try:
+                import arroyo_native  # noqa: F401
+            except ImportError:
+                from importlib import invalidate_caches
 
-            sys.path.insert(0, native_dir)
-            build_py = os.path.join(native_dir, "build.py")
-            import importlib.util
+                build_py = os.path.join(native_dir, "build.py")
+                import importlib.util
 
-            spec = importlib.util.spec_from_file_location("_anb", build_py)
-            mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(mod)
-            mod.build()
-            invalidate_caches()
-            import arroyo_native  # noqa: F401
+                spec = importlib.util.spec_from_file_location("_anb", build_py)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                mod.build()
+                invalidate_caches()
+                import arroyo_native  # noqa: F401
+        finally:
+            # the extension stays imported; nothing else should resolve
+            # through native/ (it contains a generic build.py)
+            try:
+                sys.path.remove(native_dir)
+            except ValueError:
+                pass
         _native = arroyo_native
     except Exception:  # noqa: BLE001 - silent fallback to python impl
         _native = None

@@ -57,7 +57,8 @@ struct SlotDir {
     std::vector<BinHead>* bin_index;  // open addressing over bins
     int64_t next_slot;
     int64_t n_live;
-    int64_t n_used;  // index slots holding a ref (live or dead)
+    int64_t n_used;      // index slots holding a ref (live or dead)
+    int64_t n_bins_used; // bin heads marked used (live or emptied)
     size_t mask;
     size_t bin_mask;
 };
@@ -80,21 +81,31 @@ static void rehash(SlotDir* self, size_t new_size) {
 static void bin_rehash(SlotDir* self, size_t new_size) {
     std::vector<BinHead> fresh(new_size);
     size_t mask = new_size - 1;
+    int64_t used = 0;
     for (const BinHead& b : *self->bin_index) {
-        if (!b.used || b.count == 0) continue;
+        if (!b.used || b.count == 0) continue;  // emptied heads drop here
         size_t h = splitmix64((uint64_t)b.bin) & mask;
         while (fresh[h].used) h = (h + 1) & mask;
         fresh[h] = b;
+        used++;
     }
     self->bin_index->swap(fresh);
     self->bin_mask = mask;
+    self->n_bins_used = used;
 }
 
 static BinHead* bin_lookup(SlotDir* self, int64_t bin, bool create) {
-    if (self->bin_index->size() == 0 ||
-        (create && self->n_live * 2 + 16 > (int64_t)self->bin_index->size()))
-        bin_rehash(self, self->bin_index->size() ? self->bin_index->size() * 2
-                                                 : 1024);
+    // occupancy counts USED heads (incl. emptied bins, which only a rehash
+    // reclaims) so the probe loops below always find a free stop slot
+    if (create && (self->n_bins_used + 1) * 2 > (int64_t)self->bin_index->size()) {
+        size_t size = self->bin_index->size();
+        // grow only if live bins actually need the room
+        int64_t live_bins = 0;
+        for (const BinHead& b : *self->bin_index)
+            if (b.used && b.count > 0) live_bins++;
+        if ((live_bins + 1) * 2 > (int64_t)size) size *= 2;
+        bin_rehash(self, size);
+    }
     size_t h = splitmix64((uint64_t)bin) & self->bin_mask;
     for (;;) {
         BinHead& b = (*self->bin_index)[h];
@@ -104,6 +115,7 @@ static BinHead* bin_lookup(SlotDir* self, int64_t bin, bool create) {
             b.bin = bin;
             b.head = -1;
             b.count = 0;
+            self->n_bins_used += 1;
             return &b;
         }
         if (b.bin == bin && b.count >= 0) return &b;
@@ -122,6 +134,7 @@ static PyObject* SlotDir_new(PyTypeObject* type, PyObject*, PyObject*) {
     self->next_slot = 0;
     self->n_live = 0;
     self->n_used = 0;
+    self->n_bins_used = 0;
     self->mask = 4095;
     self->bin_mask = 1023;
     return (PyObject*)self;

@@ -482,4 +482,8 @@ def _make_instant(config: dict) -> Operator:
 
 @register_operator(OperatorName.JOIN)
 def _make_join(config: dict) -> Operator:
+    if config.get("mode") == "updating":
+        from .updating_join import make_updating_join
+
+        return make_updating_join(config)
     return JoinWithExpirationOperator(config)

@@ -50,6 +50,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self.emitted: Dict[tuple, List] = {}
         self.dirty: set = set()
         self.last_seen: Dict[tuple, int] = {}
+        self.max_ts = 0  # max event time seen (flush timestamp fallback)
 
     def tables(self):
         from ..state.table_config import global_table
@@ -126,6 +127,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self._ensure_capacity()
         self.acc.update(slots, self._agg_input_cols(batch))
         now = int(ts.max()) if len(ts) else 0
+        self.max_ts = max(self.max_ts, now)
         # mark touched keys dirty: O(unique-in-batch) via the directory's
         # reverse map, not O(live keys)
         for entry in self.dir.keys_for_slots(np.unique(slots)):
@@ -137,6 +139,13 @@ class UpdatingAggregateOperator(WindowOperatorBase):
     async def handle_tick(self, tick, ctx, collector):
         await self._flush(ctx, collector)
         self._evict(ctx)
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        # flush BEFORE forwarding so downstream sees the deltas ahead of the
+        # watermark (the end-of-stream watermark must trail the final
+        # retract/append pairs, or downstream TTLs act on stale state)
+        await self._flush(ctx, collector)
+        return watermark
 
     async def on_close(self, ctx, collector, is_eod: bool):
         if is_eod:
@@ -170,7 +179,10 @@ class UpdatingAggregateOperator(WindowOperatorBase):
             append_keys.append(key)
             append_vals.append(new_vals)
             self.emitted[key] = new_vals
-        ts = ctx.watermarks.current_nanos() or 0
+        # flushes before the first watermark stamp rows with the max
+        # event time seen — a zero timestamp would look ancient to
+        # downstream event-time TTLs and get evicted immediately
+        ts = ctx.watermarks.current_nanos() or self.max_ts
         if retract_keys:
             await collector.collect(
                 self._build_updating(retract_keys, retract_vals, True, ts)
@@ -193,21 +205,9 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                     pa.array(np.full(n, ts, dtype=np.int64)).cast(f.type)
                 )
             elif f.name == UPDATING_META_FIELD:
-                import os as _os
+                from ..schema import updating_meta_array
 
-                blob = _os.urandom(16 * n)
-                arrays.append(
-                    pa.StructArray.from_arrays(
-                        [
-                            pa.array([is_retract] * n),
-                            pa.array(
-                                [blob[16 * i: 16 * (i + 1)] for i in range(n)],
-                                type=pa.binary(16),
-                            ),
-                        ],
-                        names=["is_retract", "id"],
-                    )
-                )
+                arrays.append(updating_meta_array(n, is_retract))
             elif f.name in (self._key_names or []):
                 ki = self._key_names.index(f.name)
                 kt = self._key_types[ki]
@@ -236,6 +236,10 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         wm = ctx.watermarks.current_nanos()
         if wm is None:
             return
+        from ..types import WATERMARK_END
+
+        if wm >= WATERMARK_END:
+            return  # end-of-stream marker, not a real event time
         cutoff = wm - self.ttl_nanos
         stale = [k for k, seen in self.last_seen.items() if seen < cutoff]
         if not stale:

@@ -1,0 +1,308 @@
+"""Updating (non-windowed) joins with retractions.
+
+Capability parity with the reference's updating join support
+(/root/reference/crates/arroyo-sql-testing/src/test/queries/
+updating_{inner,left,right,full}_join.sql + planner plan/join.rs updating
+path): both sides materialize per join key; every arriving append/retract
+incrementally emits the delta of the join result as append/retract rows
+tagged with __updating_meta, including the null-padded transitions of
+outer joins (a side's first match retracts its null-padded row; losing the
+last match re-emits it).
+
+Streams reaching this operator are post-shuffle (keyed on the equi keys),
+so each subtask owns its key range. Rates here are typically
+post-aggregation, so the per-row host loop favors correctness; state
+checkpoints as msgpack'd row lists per key.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from ..engine.construct import register_operator
+from ..graph.logical import OperatorName
+from ..schema import StreamSchema, TIMESTAMP_FIELD, UPDATING_META_FIELD
+from .base import Operator
+
+
+class UpdatingJoinOperator(Operator):
+    def __init__(self, config: dict):
+        super().__init__("updating_join")
+        self.n_keys = int(config["n_keys"])
+        self.join_type = config["join_type"]  # inner | left | right | full
+        self.out_schema: StreamSchema = config["schema"]
+        key_names = {f"__key{i}" for i in range(self.n_keys)}
+        skip = key_names | {TIMESTAMP_FIELD, UPDATING_META_FIELD}
+        # SOURCE payload column names per side (input batch names) and the
+        # OUTPUT names they map to (right side may be _right-renamed,
+        # positionally aligned with the source order)
+        self.left_src: List[str] = [
+            f.name for f in config["left_schema"].schema
+            if f.name not in skip
+        ]
+        self.left_out: List[str] = self.left_src
+        self.right_src: List[str] = [
+            f.name for f in config["right_schema"].schema
+            if f.name not in skip
+        ]
+        self.right_out: List[str] = config["right_fields"]
+        self.residual = config.get("residual_py")
+        from ..config import config as get_config
+
+        ttl = config.get(
+            "ttl_nanos", int(get_config().pipeline.update_aggregate_ttl * 1e9)
+        )
+        self.ttl_nanos: Optional[int] = int(ttl) if ttl else None
+        # key -> list of payload tuples (may contain duplicates)
+        self.state: List[Dict[tuple, List[tuple]]] = [{}, {}]
+        self.last_seen: Dict[tuple, int] = {}
+        self._lmap = {f: i for i, f in enumerate(self.left_out)}
+        self._rmap = {f: i for i, f in enumerate(self.right_out)}
+        self._kmap = {f"__key{i}": i for i in range(self.n_keys)}
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"uj": global_table("uj")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("uj")
+            for snap in table.all_values():
+                for side in (0, 1):
+                    for key_vals, rows in snap[str(side)]:
+                        key = tuple(key_vals)
+                        if self._owns(key, ctx):
+                            self.state[side].setdefault(key, []).extend(
+                                tuple(r) for r in rows
+                            )
+
+    def _owns(self, key: tuple, ctx) -> bool:
+        p = ctx.task_info.parallelism
+        if p <= 1:
+            return True
+        from ..types import hash_arrays, hash_column, server_for_hash_array
+
+        cols = [
+            hash_column(np.asarray([k])) for k in key
+        ]
+        owner = server_for_hash_array(hash_arrays(cols), p)[0]
+        return owner == ctx.task_info.task_index
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("uj")
+            table.put(
+                ctx.task_info.task_index,
+                {
+                    "subtask": ctx.task_info.task_index,
+                    "0": [
+                        [list(k), [list(r) for r in rows]]
+                        for k, rows in self.state[0].items()
+                    ],
+                    "1": [
+                        [list(k), [list(r) for r in rows]]
+                        for k, rows in self.state[1].items()
+                    ],
+                },
+            )
+
+    # -- processing ---------------------------------------------------------
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        side = input_index
+        schema_names = batch.schema.names
+        src_fields = self.left_src if side == 0 else self.right_src
+        rows = batch.to_pylist()
+        ts = int(
+            np.asarray(
+                batch.column(schema_names.index(TIMESTAMP_FIELD)).cast(
+                    pa.int64()
+                )
+            ).max()
+        )
+        # deltas accumulate IN INPUT ORDER as (is_retract, row) so a
+        # retract never overtakes the append it cancels within a batch
+        deltas: List[Tuple[bool, tuple]] = []
+        for row in rows:
+            key = tuple(
+                _norm(row[f"__key{i}"]) for i in range(self.n_keys)
+            )
+            payload = tuple(_norm(row[f]) for f in src_fields)
+            meta = row.get(UPDATING_META_FIELD)
+            self.last_seen[key] = ts
+            if meta and meta.get("is_retract"):
+                self._retract_row(side, key, payload, deltas)
+            else:
+                self._append_row(side, key, payload, deltas)
+        # emit maximal same-kind runs as batches, preserving order
+        i = 0
+        while i < len(deltas):
+            j = i
+            while j < len(deltas) and deltas[j][0] == deltas[i][0]:
+                j += 1
+            batch_out = self._build(
+                [d[1] for d in deltas[i:j]], deltas[i][0], ts
+            )
+            if batch_out is not None and batch_out.num_rows:
+                await collector.collect(batch_out)
+            i = j
+
+    # join-delta helpers: rows are (key, left_payload|None, right_payload|None)
+
+    def _null_padded(self, side: int, key: tuple, payload: tuple) -> tuple:
+        return (key, payload, None) if side == 0 else (key, None, payload)
+
+    def _joined(self, key: tuple, l: tuple, r: tuple) -> tuple:
+        return (key, l, r)
+
+    def _append_row(self, side, key, payload, deltas):
+        out_append = _DeltaSink(deltas, False)
+        out_retract = _DeltaSink(deltas, True)
+        mine = self.state[side].setdefault(key, [])
+        other = self.state[1 - side].get(key, [])
+        other_outer = (
+            self.join_type in ("left", "full") if side == 1
+            else self.join_type in ("right", "full")
+        )
+        my_outer = (
+            self.join_type in ("left", "full") if side == 0
+            else self.join_type in ("right", "full")
+        )
+        if other:
+            for o in other:
+                l, r = (payload, o) if side == 0 else (o, payload)
+                out_append.append(self._joined(key, l, r))
+            # first row on MY side: the other side's null-padded rows retract
+            if not mine and other_outer:
+                for o in other:
+                    out_retract.append(self._null_padded(1 - side, key, o))
+        elif my_outer:
+            out_append.append(self._null_padded(side, key, payload))
+        mine.append(payload)
+
+    def _retract_row(self, side, key, payload, deltas):
+        out_append = _DeltaSink(deltas, False)
+        out_retract = _DeltaSink(deltas, True)
+        mine = self.state[side].get(key, [])
+        try:
+            mine.remove(payload)
+        except ValueError:
+            return  # retraction for an unknown row: drop
+        other = self.state[1 - side].get(key, [])
+        other_outer = (
+            self.join_type in ("left", "full") if side == 1
+            else self.join_type in ("right", "full")
+        )
+        my_outer = (
+            self.join_type in ("left", "full") if side == 0
+            else self.join_type in ("right", "full")
+        )
+        if other:
+            for o in other:
+                l, r = (payload, o) if side == 0 else (o, payload)
+                out_retract.append(self._joined(key, l, r))
+            # last row on MY side gone: other side's rows become null-padded
+            if not mine and other_outer:
+                for o in other:
+                    out_append.append(self._null_padded(1 - side, key, o))
+        elif my_outer:
+            out_retract.append(self._null_padded(side, key, payload))
+        if not mine:
+            self.state[side].pop(key, None)
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        """TTL eviction of idle keys (the reference bounds updating state
+        with updating_cache.rs the same way). Evicted keys silently drop
+        their materialized rows — late retractions for them are ignored."""
+        from ..types import WATERMARK_END, WatermarkKind
+
+        if (
+            watermark.kind == WatermarkKind.EVENT_TIME
+            and self.ttl_nanos
+            and watermark.timestamp < WATERMARK_END
+        ):
+            cutoff = watermark.timestamp - self.ttl_nanos
+            stale = [k for k, seen in self.last_seen.items() if seen < cutoff]
+            for k in stale:
+                self.state[0].pop(k, None)
+                self.state[1].pop(k, None)
+                self.last_seen.pop(k, None)
+        return watermark
+
+    # -- output -------------------------------------------------------------
+
+    def _build(self, rows: List[tuple], is_retract: bool, ts: int):
+        n = len(rows)
+        lmap, rmap, kmap = self._lmap, self._rmap, self._kmap
+        arrays = []
+        for f in self.out_schema.schema:
+            if f.name in kmap:
+                ki = kmap[f.name]
+                arrays.append(
+                    pa.array([r[0][ki] for r in rows], type=f.type)
+                )
+            elif f.name == TIMESTAMP_FIELD:
+                arrays.append(
+                    pa.array(np.full(n, ts, dtype=np.int64)).cast(f.type)
+                )
+            elif f.name == UPDATING_META_FIELD:
+                from ..schema import updating_meta_array
+
+                arrays.append(updating_meta_array(n, is_retract))
+            elif f.name in lmap:
+                li = lmap[f.name]
+                arrays.append(_col(
+                    [r[1][li] if r[1] is not None else None for r in rows],
+                    f.type,
+                ))
+            elif f.name in rmap:
+                ri = rmap[f.name]
+                arrays.append(_col(
+                    [r[2][ri] if r[2] is not None else None for r in rows],
+                    f.type,
+                ))
+            else:
+                raise KeyError(f"updating join output missing {f.name}")
+        batch = pa.RecordBatch.from_arrays(
+            arrays, schema=self.out_schema.schema
+        )
+        if self.residual is not None:
+            mask = self.residual(batch)
+            batch = batch.filter(mask)
+        return batch
+
+
+def _norm(v):
+    """State values must be msgpack-serializable and hashable; pandas
+    Timestamps become int nanos."""
+    if isinstance(v, pd.Timestamp):
+        return v.value
+    return v
+
+
+class _DeltaSink:
+    """Appends (is_retract, row) onto the shared in-order delta list."""
+
+    __slots__ = ("deltas", "is_retract")
+
+    def __init__(self, deltas, is_retract):
+        self.deltas = deltas
+        self.is_retract = is_retract
+
+    def append(self, row):
+        self.deltas.append((self.is_retract, row))
+
+
+def _col(vals, t: pa.DataType) -> pa.Array:
+    if pa.types.is_timestamp(t):
+        return pa.array(vals, type=pa.int64()).cast(t)
+    return pa.array(vals, type=t)
+
+
+def make_updating_join(config: dict) -> Operator:
+    return UpdatingJoinOperator(config)

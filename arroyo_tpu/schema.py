@@ -28,6 +28,24 @@ UPDATING_META_TYPE = pa.struct(
 )
 
 
+def updating_meta_array(n: int, is_retract: bool) -> "pa.StructArray":
+    """__updating_meta column for n rows (random ids, shared by the
+    updating aggregate and updating join)."""
+    import os
+
+    blob = os.urandom(16 * n)
+    return pa.StructArray.from_arrays(
+        [
+            pa.array([is_retract] * n),
+            pa.array(
+                [blob[16 * i: 16 * (i + 1)] for i in range(n)],
+                type=pa.binary(16),
+            ),
+        ],
+        names=["is_retract", "id"],
+    )
+
+
 def add_timestamp_field(schema: pa.Schema) -> pa.Schema:
     """Append `_timestamp` if absent (reference: planner schemas.rs
     add_timestamp_field)."""

@@ -1083,10 +1083,6 @@ class Planner:
         right = self.plan_relation(rel.right)
         if rel.condition is None:
             raise SqlError("JOIN requires an ON condition")
-        if left.updating or right.updating:
-            raise SqlError(
-                "joining updating (retracting) inputs is not yet supported"
-            )
         merged_scope = left.scope.merge(
             right.scope, len(left.schema.schema)
         )
@@ -1105,16 +1101,16 @@ class Planner:
             left_keys.append(bind(le, left.scope))
             right_keys.append(bind(re_, right.scope))
 
-        windowed = (
+        both_windowed = (
             left.window is not None
             and right.window is not None
             and left.window == right.window
         )
-        if not windowed and rel.join_type != "inner":
+        if both_windowed and (left.updating or right.updating):
             raise SqlError(
-                "non-windowed outer joins produce updating output; updating "
-                "joins are not yet supported"
+                "windowed joins over updating inputs are not yet supported"
             )
+        windowed = both_windowed
 
         # project each side to key columns + payload
         lpre, nkeys = self._join_side_projection(left, left_keys, "jl")
@@ -1124,7 +1120,6 @@ class Planner:
             lpre, rpre, nkeys
         )
         out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
-        residual_text = None
         config = {
             "n_keys": nkeys,
             "join_type": rel.join_type,
@@ -1135,6 +1130,13 @@ class Planner:
             "right_schema": rpre.schema,
         }
         if residual:
+            if not windowed and rel.join_type != "inner":
+                raise SqlError(
+                    "non-equality conditions on updating outer joins are "
+                    "not yet supported (they change match semantics)"
+                )
+            # inner joins filter joined rows symmetrically (appends and the
+            # retracts that cancel them see the same predicate)
             config["residual_py"] = self._bind_residual(
                 residual, out_schema, left, right, lpre, rpre, nkeys
             )
@@ -1142,8 +1144,20 @@ class Planner:
             op = OperatorName.INSTANT_JOIN
             config["window"] = dataclasses.asdict(left.window)
         else:
+            # non-windowed joins materialize both sides and emit retraction
+            # deltas (reference: updating joins); output is an updating
+            # stream requiring a debezium-capable sink
             op = OperatorName.JOIN
-            config["ttl_nanos"] = 24 * 3600 * 1_000_000_000
+            config["mode"] = "updating"
+            from ..schema import UPDATING_META_FIELD, UPDATING_META_TYPE
+
+            out_fields = out_fields + [
+                pa.field(UPDATING_META_FIELD, UPDATING_META_TYPE)
+            ]
+            out_schema = StreamSchema(
+                add_timestamp_field(pa.schema(out_fields))
+            )
+            config["schema"] = out_schema
         node = self.graph.add_node(
             LogicalNode.single(
                 self._next_id(), op, config, f"{rel.join_type}_join",
@@ -1163,6 +1177,7 @@ class Planner:
             node.node_id, out_schema, scope,
             window=left.window if windowed else None,
             window_field=None,
+            updating=not windowed,
         )
 
     def _plan_lookup_join(self, rel: Join, t: TableDef) -> RelOutput:
@@ -1667,19 +1682,23 @@ def _classify_sides(a: Expr, b: Expr, lscope: Scope, rscope: Scope):
 
 def _join_output_fields(lpre: RelOutput, rpre: RelOutput, nkeys: int):
     """Left columns (keys + payload) then right payload; duplicate names get
-    _right suffix. Returns (fields, left_names, right_names)."""
+    _right suffix. Input __updating_meta columns are consumed by the join
+    itself (retraction routing), never forwarded. Returns
+    (fields, left_names, right_names)."""
+    from ..schema import UPDATING_META_FIELD
+
     fields: List[pa.Field] = []
     left_names: List[str] = []
     right_names: List[str] = []
     seen = set()
     for f in lpre.schema.schema:
-        if f.name == TIMESTAMP_FIELD:
+        if f.name in (TIMESTAMP_FIELD, UPDATING_META_FIELD):
             continue
         fields.append(f)
         left_names.append(f.name)
         seen.add(f.name)
     for i, f in enumerate(rpre.schema.schema):
-        if f.name == TIMESTAMP_FIELD or i < nkeys:
+        if f.name in (TIMESTAMP_FIELD, UPDATING_META_FIELD) or i < nkeys:
             continue
         name = f.name
         while name in seen:
@@ -1695,9 +1714,14 @@ def _join_output_scope(left, right, lpre, rpre, out_schema, nkeys) -> Scope:
     # qualified access: left alias columns at their positions; right alias
     # payload after left block; right KEY columns resolve to the coalesced
     # left key positions
+    from ..schema import UPDATING_META_FIELD
+
     left_quals = {c.qualifier for c in left.scope.cols if c.qualifier}
     right_quals = {c.qualifier for c in right.scope.cols if c.qualifier}
-    n_left = len([f for f in lpre.schema.schema if f.name != TIMESTAMP_FIELD])
+    n_left = len([
+        f for f in lpre.schema.schema
+        if f.name not in (TIMESTAMP_FIELD, UPDATING_META_FIELD)
+    ])
     for q in left_quals:
         for c in left.scope.cols:
             if c.qualifier != q:
@@ -1708,7 +1732,7 @@ def _join_output_scope(left, right, lpre, rpre, out_schema, nkeys) -> Scope:
     offset = n_left
     right_payload = [
         f for i, f in enumerate(rpre.schema.schema)
-        if f.name != TIMESTAMP_FIELD and i >= nkeys
+        if f.name not in (TIMESTAMP_FIELD, UPDATING_META_FIELD) and i >= nkeys
     ]
     for q in right_quals:
         for c in right.scope.cols:

@@ -1,0 +1,25 @@
+--pk=left_count
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE VIEW impulse_odd AS (
+  SELECT counter FROM impulse WHERE counter % 2 == 1
+);
+CREATE TABLE output (left_count BIGINT, right_count BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT A.counter, B.counter
+FROM impulse A
+JOIN impulse_odd B ON A.counter = B.counter;

@@ -16,7 +16,7 @@ import asyncio
 import json
 from typing import Optional
 
-from aiohttp import WSMsgType, web
+from aiohttp import web
 
 from ..config import config
 from ..controller.controller import ControllerServer

@@ -10,9 +10,7 @@ from __future__ import annotations
 
 import json
 import sys
-from typing import List, Optional
-
-import pyarrow as pa
+from typing import Optional
 
 from ..operators.base import Operator, SourceFinishType, SourceOperator
 from .base import ConnectionSchema, Connector, register_connector

@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 import os
 import uuid
-from typing import List, Optional
+from typing import List
 
 import pyarrow as pa
 import pyarrow.parquet as pq

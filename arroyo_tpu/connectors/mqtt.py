@@ -3,8 +3,6 @@ with rumqttc + QoS levels). Client gated on paho-mqtt/aiomqtt."""
 
 from __future__ import annotations
 
-import asyncio
-
 from ..operators.base import Operator, SourceFinishType, SourceOperator
 from ..formats.de import Deserializer
 from ..formats.ser import Serializer

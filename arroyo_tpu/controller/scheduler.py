@@ -17,7 +17,7 @@ import asyncio
 import os
 import subprocess
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..utils.logging import get_logger
 

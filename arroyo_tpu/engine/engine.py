@@ -25,7 +25,7 @@ from ..operators.control import (
     TaskFailedResp,
     TaskFinishedResp,
 )
-from .program import Program, Subtask
+from .program import Program
 
 logger = get_logger("engine")
 

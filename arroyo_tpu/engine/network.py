@@ -22,7 +22,7 @@ from __future__ import annotations
 import asyncio
 import io
 import struct
-from typing import Callable, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import pyarrow as pa
 

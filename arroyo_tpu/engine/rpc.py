@@ -11,7 +11,7 @@ contract lives in the method tables below).
 from __future__ import annotations
 
 import asyncio
-from typing import Awaitable, Callable, Dict, Optional
+from typing import Awaitable, Callable, Dict
 
 import grpc
 import msgpack

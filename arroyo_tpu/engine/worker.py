@@ -11,9 +11,8 @@ TCP data plane endpoint for cross-worker edges.
 from __future__ import annotations
 
 import asyncio
-import dataclasses
 import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from ..config import config
 from ..graph.logical import LogicalGraph
@@ -125,8 +124,6 @@ class WorkerServer:
             backend = StateBackend(req["storage_url"], self.job_id)
             backend.generation = req.get("generation")
             if req.get("restore_epoch") is not None:
-                import copy
-
                 from ..state import protocol
 
                 backend.restore_manifest = protocol.load_manifest(

@@ -11,7 +11,7 @@ use pure-python decoders gated on schema availability.
 from __future__ import annotations
 
 import json
-from typing import Iterable, Iterator, List, Optional
+from typing import Iterator, List, Optional
 
 import pyarrow as pa
 

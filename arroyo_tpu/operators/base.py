@@ -16,7 +16,6 @@ from typing import Any, Dict, Optional
 import pyarrow as pa
 
 from ..types import CheckpointBarrier, Watermark
-from .collector import Collector
 from .context import OperatorContext, SourceContext
 
 

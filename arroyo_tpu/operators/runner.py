@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 import pyarrow as pa
 
 from ..metrics import BATCHES_RECV, BYTES_RECV, MESSAGES_RECV
-from ..types import SignalKind, SignalMessage, StopMode, Watermark, WATERMARK_END
+from ..types import SignalKind, SignalMessage, StopMode, Watermark
 from ..utils.logging import get_logger
 from .base import Operator, SourceFinishType, SourceOperator
 from .collector import Collector

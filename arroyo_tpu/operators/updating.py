@@ -25,7 +25,7 @@ import pyarrow as pa
 
 from ..engine.construct import register_operator
 from ..graph.logical import OperatorName
-from ..schema import StreamSchema, TIMESTAMP_FIELD, UPDATING_META_FIELD
+from ..schema import TIMESTAMP_FIELD, UPDATING_META_FIELD
 from .base import Operator
 from .windows import WindowOperatorBase, _is_interned_type, _to_py
 

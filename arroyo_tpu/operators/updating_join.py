@@ -23,8 +23,6 @@ import numpy as np
 import pandas as pd
 import pyarrow as pa
 
-from ..engine.construct import register_operator
-from ..graph.logical import OperatorName
 from ..schema import StreamSchema, TIMESTAMP_FIELD, UPDATING_META_FIELD
 from .base import Operator
 

@@ -40,7 +40,7 @@ from .ast import (
     UnaryOp,
     Unnest,
 )
-from .lexer import SqlError, Token, TokenStream, tokenize
+from .lexer import SqlError, TokenStream, tokenize
 
 RESERVED_STOP = {
     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "UNION", "JOIN",
@@ -197,12 +197,14 @@ def _column_def(ts: TokenStream) -> ColumnDef:
 def _type_name(ts: TokenStream) -> str:
     parts = [ts.expect("ident").upper]
     # multi-word types and modifiers
-    while ts.at_keyword("UNSIGNED", "PRECISION", "VARYING"):
+    while ts.at_keyword("UNSIGNED", "PRECISION", "VARYING", "ARRAY"):
         parts.append(ts.next().upper)
     if ts.accept("punct", "("):
         # e.g. VARCHAR(10), DECIMAL(10, 2) -- sizes ignored
         while not ts.accept("punct", ")"):
             ts.next()
+        if ts.accept_keyword("ARRAY"):  # VARCHAR(10) ARRAY
+            parts.append("ARRAY")
     if ts.accept("punct", "["):
         ts.expect("punct", "]")
         parts.append("ARRAY")

@@ -370,7 +370,10 @@ class Planner:
         if isinstance(rel, Join):
             return self.plan_join(rel)
         if isinstance(rel, Unnest):
-            raise SqlError("UNNEST is not yet supported in FROM")
+            raise SqlError(
+                "UNNEST in FROM is not supported; use unnest(col) as a "
+                "SELECT item"
+            )
         raise SqlError(f"unsupported relation {rel!r}")
 
     def _resolve_view(self, name: str) -> Optional[Select]:
@@ -423,6 +426,13 @@ class Planner:
         if async_items:
             return self._plan_async_udf(sel, items, async_items, upstream,
                                         where)
+        unnest_items = [
+            it for it in items
+            if isinstance(it.expr, FuncCall) and it.expr.name == "unnest"
+        ]
+        if unnest_items:
+            return self._plan_unnest(sel, items, unnest_items, upstream,
+                                     where)
         if sel.group_by or self._has_aggregate(items):
             return self._plan_aggregate(sel, items, upstream, where)
         if sel.distinct:
@@ -681,6 +691,97 @@ class Planner:
                 final_names.append(it.alias or plain_names[idx])
         return self._add_value_node(
             out, final_exprs, _dedup(final_names), None, description
+        )
+
+    def _plan_unnest(
+        self, sel, items, unnest_items, upstream: RelOutput, where
+    ) -> RelOutput:
+        """unnest(list_col) explodes each row into one row per element
+        (reference UnnestRewriter, rewriters.rs); other select items
+        replicate across the exploded rows."""
+        if len(unnest_items) != 1:
+            raise SqlError("one unnest() per SELECT is supported")
+        if upstream.updating:
+            raise SqlError(
+                "unnest() over an updating (retracting) input is not yet "
+                "supported"
+            )
+        if sel.distinct or sel.group_by:
+            raise SqlError(
+                "unnest() cannot be combined with DISTINCT or GROUP BY in "
+                "one SELECT; unnest in a subquery first"
+            )
+        call = unnest_items[0].expr
+        if len(call.args) != 1:
+            raise SqlError("unnest() takes one list-typed argument")
+        list_expr = bind(call.args[0], upstream.scope)
+        if not pa.types.is_list(list_expr.dtype):
+            raise SqlError(
+                f"unnest() requires a list argument, got {list_expr.dtype}"
+            )
+        out_name = unnest_items[0].alias or "unnest"
+        plain_items = [it for it in items if it is not unnest_items[0]]
+        exprs, names = self._bind_items(plain_items, upstream.scope)
+        exprs = exprs + [list_expr]
+        names = _dedup(names + [self._fresh("list")])
+        pre = self._add_value_node(
+            upstream, exprs, names, where, "unnest_input"
+        )
+        list_idx = len(names) - 1
+        value_type = list_expr.dtype.value_type
+        out_fields = [
+            pa.field(n, f.type)
+            for n, f in zip(names[:-1], pre.schema.schema)
+        ] + [pa.field(out_name, value_type)]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        ts_idx = pre.schema.timestamp_index
+        # static plan-time mapping: output field -> source column index
+        # (-1 = the flattened values, -2 = timestamp)
+        src_idx = [
+            -1 if f.name == out_name
+            else (-2 if f.name == TIMESTAMP_FIELD
+                  else pre.schema.schema.names.index(f.name))
+            for f in out_schema.schema
+        ]
+
+        def explode(batch):
+            import pyarrow.compute as pc
+
+            col = batch.column(list_idx)
+            parents = pc.list_parent_indices(col)
+            flat = pc.list_flatten(col)
+            if len(flat) == 0:
+                return None
+            taken = batch.take(parents)
+            arrays = [
+                flat if i == -1
+                else taken.column(ts_idx if i == -2 else i)
+                for i in src_idx
+            ]
+            return pa.RecordBatch.from_arrays(
+                arrays, schema=out_schema.schema
+            )
+
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.ARROW_VALUE,
+                {"py_fn": explode, "schema": out_schema},
+                "unnest",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id,
+            self._edge(pre.node_id, self.parallelism), pre.schema,
+        )
+        out = RelOutput(
+            node.node_id, out_schema, Scope.from_schema(out_schema.schema),
+            window=upstream.window,
+        )
+        return self._restore_select_order(
+            out, items, unnest_items[0], out_name, plain_items, names[:-1],
+            "unnest_select",
         )
 
     def _plan_async_udf(

@@ -14,7 +14,6 @@ Values are msgpack-encoded (the reference uses bincode).
 
 from __future__ import annotations
 
-import io
 from typing import Any, Dict, List, Optional
 
 import msgpack

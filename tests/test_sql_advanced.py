@@ -175,3 +175,62 @@ def test_async_udf_nested_rejected():
 
     with pytest.raises(SqlError, match="async UDF"):
         plan_query(IMPULSE + "SELECT slow_inc(counter) + 1 FROM impulse;")
+
+
+def test_unnest(tmp_path):
+    data = tmp_path / "lists.json"
+    with open(data, "w") as f:
+        f.write(json.dumps({"id": 1, "tags": [10, 20]}) + "\n")
+        f.write(json.dumps({"id": 2, "tags": []}) + "\n")
+        f.write(json.dumps({"id": 3, "tags": [30]}) + "\n")
+    rows = run_sql(
+        f"""
+        CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+          connector = 'single_file', path = '{data}',
+          format = 'json', type = 'source'
+        );
+        SELECT id, unnest(tags) as tag FROM t;
+        """
+    )
+    assert sorted((r["id"], r["tag"]) for r in rows) == [
+        (1, 10), (1, 20), (3, 30)
+    ]
+
+
+def test_unnest_requires_list():
+    with pytest.raises(SqlError, match="list argument"):
+        plan_query(IMPULSE + "SELECT unnest(counter) FROM impulse;")
+
+
+def test_unnest_guards():
+    with pytest.raises(SqlError, match="DISTINCT or GROUP BY"):
+        plan_query(
+            """
+            CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+              connector = 'single_file', path = '/tmp/x', format = 'json',
+              type = 'source'
+            );
+            SELECT id, unnest(tags) FROM t GROUP BY id;
+            """
+        )
+    with pytest.raises(SqlError, match="updating"):
+        plan_query(
+            IMPULSE
+            + """
+            CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+              connector = 'single_file', path = '/tmp/x', format = 'json',
+              type = 'source'
+            );
+            SELECT unnest(t.tags) FROM t
+            JOIN impulse ON t.id = impulse.counter;
+            """
+        )
+
+
+def test_sized_array_type_parses():
+    from arroyo_tpu.sql.parser import parse_statements
+
+    stmts = parse_statements(
+        "CREATE TABLE t (tags VARCHAR(10) ARRAY) WITH (connector='x')"
+    )
+    assert stmts[0].columns[0].type_name == "VARCHAR ARRAY"

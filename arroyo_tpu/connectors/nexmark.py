@@ -92,10 +92,6 @@ _LAST = ["Shultz", "Abrams", "Spencer", "White", "Bartels", "Walton",
 _CHANNELS = ["Google", "Facebook", "Baidu", "Apple"]
 
 
-def _rng(n: int) -> np.random.Generator:
-    return np.random.default_rng(0x5EED ^ n)
-
-
 def _u01(ns, salt: int) -> np.ndarray:
     """Deterministic per-sequence-number uniform [0,1): counter-based via
     splitmix64, so scalar and vectorized paths produce IDENTICAL events for
@@ -106,6 +102,41 @@ def _u01(ns, salt: int) -> np.ndarray:
     with np.errstate(over="ignore"):
         h = _splitmix64(arr ^ np.uint64(salt))
     return h.astype(np.float64) / float(1 << 64)
+
+
+def _person_fields(ns):
+    """Vectorized person field generation (counter-based, deterministic)."""
+    ns = np.asarray(ns, dtype=np.int64)
+    first = (_u01(ns, 0xE1) * len(_FIRST)).astype(np.int64)
+    last = (_u01(ns, 0xE2) * len(_LAST)).astype(np.int64)
+    city = (_u01(ns, 0xE3) * len(_CITIES)).astype(np.int64)
+    state = (_u01(ns, 0xE4) * len(_STATES)).astype(np.int64)
+    cc = [
+        (_u01(ns, 0xE5 + j) * 10000).astype(np.int64) for j in range(4)
+    ]
+    return first, last, city, state, cc
+
+
+def _auction_fields(ns):
+    """Vectorized auction field generation."""
+    ns = np.asarray(ns, dtype=np.int64)
+    epoch = ns // PROPORTION_DENOMINATOR
+    last_person = FIRST_PERSON_ID + epoch
+    hot = _u01(ns, 0xF1) < (HOT_SELLER_RATIO - 1) / HOT_SELLER_RATIO
+    cold = FIRST_PERSON_ID + (
+        _u01(ns, 0xF2) * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
+    ).astype(np.int64)
+    seller = np.where(
+        hot, (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO, cold
+    )
+    seller = np.maximum(seller, FIRST_PERSON_ID)
+    initial = 1 + (_u01(ns, 0xF3) * 100).astype(np.int64)
+    reserve = initial + (_u01(ns, 0xF4) * 100).astype(np.int64)
+    expires_s = 1 + (_u01(ns, 0xF5) * 9).astype(np.int64)
+    category = FIRST_CATEGORY_ID + (
+        _u01(ns, 0xF6) * NUM_CATEGORIES
+    ).astype(np.int64)
+    return seller, initial, reserve, expires_s, category
 
 
 def _bid_fields(ns):
@@ -170,21 +201,21 @@ class NexmarkGenerator:
 
     def event(self, n: int, ts: int) -> dict:
         kind = self.kind_of(n)
-        rng = _rng(n)
         if kind == "person":
             pid = self.last_person_id(n)
-            name = f"{_FIRST[int(rng.integers(len(_FIRST)))]} " \
-                   f"{_LAST[int(rng.integers(len(_LAST)))]}"
+            first, last, city, state, cc = _person_fields([n])
+            name = f"{_FIRST[int(first[0])]} {_LAST[int(last[0])]}"
             return {
                 "person": {
                     "id": pid,
                     "name": name,
-                    "email_address": f"{name.replace(' ', '.').lower()}@example.com",
+                    "email_address": f"{name.replace(' ', '.').lower()}"
+                                     "@example.com",
                     "credit_card": " ".join(
-                        f"{int(rng.integers(10000)):04d}" for _ in range(4)
+                        f"{int(c[0]):04d}" for c in cc
                     ),
-                    "city": _CITIES[int(rng.integers(len(_CITIES)))],
-                    "state": _STATES[int(rng.integers(len(_STATES)))],
+                    "city": _CITIES[int(city[0])],
+                    "state": _STATES[int(state[0])],
                     "datetime": ts,
                     "extra": "",
                 },
@@ -194,28 +225,21 @@ class NexmarkGenerator:
             }
         if kind == "auction":
             aid = self.last_auction_id(n)
-            # hot sellers: most auctions come from recent people
-            if rng.integers(HOT_SELLER_RATIO):
-                seller = (self.last_person_id(n) // HOT_SELLER_RATIO) * \
-                    HOT_SELLER_RATIO
-            else:
-                seller = FIRST_PERSON_ID + int(
-                    rng.integers(max(self.last_person_id(n) - FIRST_PERSON_ID + 1, 1))
-                )
-            initial = 1 + int(rng.integers(100))
+            seller, initial, reserve, expires_s, category = _auction_fields(
+                [n]
+            )
             return {
                 "person": None,
                 "auction": {
                     "id": aid,
                     "item_name": f"item-{aid}",
                     "description": f"description of item {aid}",
-                    "initial_bid": initial,
-                    "reserve": initial + int(rng.integers(100)),
+                    "initial_bid": int(initial[0]),
+                    "reserve": int(reserve[0]),
                     "datetime": ts,
-                    "expires": ts + int(rng.integers(1, 10)) * 1_000_000_000,
-                    "seller": max(seller, FIRST_PERSON_ID),
-                    "category": FIRST_CATEGORY_ID + int(
-                        rng.integers(NUM_CATEGORIES)),
+                    "expires": ts + int(expires_s[0]) * 1_000_000_000,
+                    "seller": int(seller[0]),
+                    "category": int(category[0]),
                     "extra": "",
                 },
                 "bid": None,
@@ -249,15 +273,49 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
     g = NexmarkGenerator()
     offs = ns % PROPORTION_DENOMINATOR
     is_bid = offs >= PERSON_PROPORTION + AUCTION_PROPORTION
+    is_person = offs < PERSON_PROPORTION
     n = len(ns)
     person_col = [None] * n
     auction_col = [None] * n
     bid_col = [None] * n
-    # scalar path for persons/auctions (4 of every 50 events)
-    for i in np.nonzero(~is_bid)[0]:
-        ev = g.event(int(ns[i]), int(ts[i]))
-        person_col[i] = ev["person"]
-        auction_col[i] = ev["auction"]
+    # persons/auctions share the vectorized field helpers with event()
+    # (bit-identical), evaluated ONCE per batch over the index arrays
+    pi = np.nonzero(is_person)[0]
+    if len(pi):
+        pns = ns[pi]
+        first, last, city, state, cc = _person_fields(pns)
+        epoch = pns // PROPORTION_DENOMINATOR
+        for j, i in enumerate(pi):
+            name = f"{_FIRST[int(first[j])]} {_LAST[int(last[j])]}"
+            person_col[i] = {
+                "id": FIRST_PERSON_ID + int(epoch[j]),
+                "name": name,
+                "email_address": f"{name.replace(' ', '.').lower()}"
+                                 "@example.com",
+                "credit_card": " ".join(f"{int(c[j]):04d}" for c in cc),
+                "city": _CITIES[int(city[j])],
+                "state": _STATES[int(state[j])],
+                "datetime": int(ts[i]),
+                "extra": "",
+            }
+    ai = np.nonzero(~is_bid & ~is_person)[0]
+    if len(ai):
+        ans = ns[ai]
+        seller, initial, reserve, expires_s, category = _auction_fields(ans)
+        for j, i in enumerate(ai):
+            aid = g.last_auction_id(int(ans[j]))
+            auction_col[i] = {
+                "id": aid,
+                "item_name": f"item-{aid}",
+                "description": f"description of item {aid}",
+                "initial_bid": int(initial[j]),
+                "reserve": int(reserve[j]),
+                "datetime": int(ts[i]),
+                "expires": int(ts[i]) + int(expires_s[j]) * 1_000_000_000,
+                "seller": int(seller[j]),
+                "category": int(category[j]),
+                "extra": "",
+            }
     bi = np.nonzero(is_bid)[0]
     bid_arr = pa.array(bid_col, type=BID_T)
     if len(bi):

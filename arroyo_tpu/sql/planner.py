@@ -430,7 +430,12 @@ class Planner:
             it for it in items
             if isinstance(it.expr, FuncCall) and it.expr.name == "unnest"
         ]
-        if unnest_items:
+        if unnest_items or any(_contains_unnest(it.expr) for it in items):
+            if not unnest_items:
+                raise SqlError(
+                    "unnest() must be a top-level SELECT item (wrap other "
+                    "expressions around it in an outer query)"
+                )
             return self._plan_unnest(sel, items, unnest_items, upstream,
                                      where)
         if sel.group_by or self._has_aggregate(items):
@@ -677,14 +682,17 @@ class Planner:
     def _restore_select_order(
         self, out: RelOutput, items, special_item, out_name: str,
         plain_items, plain_names, description: str,
+        final_name: Optional[str] = None,
     ) -> RelOutput:
         """Final projection restoring the SELECT item order after an
-        operator that appends one computed column (window fn / async udf)."""
+        operator that appends one computed column (window fn / async udf /
+        unnest). `out_name` is the (fresh, collision-free) internal column;
+        `final_name` the user-facing name it takes in the output."""
         final_exprs, final_names = [], []
         for it in items:
             if it is special_item:
                 final_exprs.append(bind(Column(out_name), out.scope))
-                final_names.append(out_name)
+                final_names.append(final_name or out_name)
             else:
                 idx = plain_items.index(it)
                 final_exprs.append(bind(Column(plain_names[idx]), out.scope))
@@ -706,11 +714,19 @@ class Planner:
                 "unnest() over an updating (retracting) input is not yet "
                 "supported"
             )
-        if sel.distinct or sel.group_by:
+        if sel.distinct or sel.group_by or self._has_aggregate(items):
             raise SqlError(
-                "unnest() cannot be combined with DISTINCT or GROUP BY in "
-                "one SELECT; unnest in a subquery first"
+                "unnest() cannot be combined with DISTINCT, GROUP BY or "
+                "aggregates in one SELECT; unnest in a subquery first"
             )
+        for it in items:
+            if it is unnest_items[0]:
+                continue
+            if _contains_unnest(it.expr):
+                raise SqlError(
+                    "unnest() must be a top-level SELECT item (wrap other "
+                    "expressions around it in an outer query)"
+                )
         call = unnest_items[0].expr
         if len(call.args) != 1:
             raise SqlError("unnest() takes one list-typed argument")
@@ -719,7 +735,10 @@ class Planner:
             raise SqlError(
                 f"unnest() requires a list argument, got {list_expr.dtype}"
             )
-        out_name = unnest_items[0].alias or "unnest"
+        display_name = unnest_items[0].alias or "unnest"
+        # fresh internal name: a plain item aliased to the same name (e.g.
+        # `SELECT id AS unnest, unnest(tags)`) must not collide in src_idx
+        out_name = self._fresh("unnest")
         plain_items = [it for it in items if it is not unnest_items[0]]
         exprs, names = self._bind_items(plain_items, upstream.scope)
         exprs = exprs + [list_expr]
@@ -781,7 +800,7 @@ class Planner:
         )
         return self._restore_select_order(
             out, items, unnest_items[0], out_name, plain_items, names[:-1],
-            "unnest_select",
+            "unnest_select", final_name=display_name,
         )
 
     def _plan_async_udf(
@@ -797,7 +816,8 @@ class Planner:
             raise SqlError("one async UDF per SELECT is supported")
         call = async_items[0].expr
         u = udf_registry.get(call.name)
-        out_name = async_items[0].alias or call.name
+        display_name = async_items[0].alias or call.name
+        out_name = self._fresh("audf")  # internal; no alias collisions
         plain_items = [it for it in items if it is not async_items[0]]
         exprs, names = self._bind_items(plain_items, upstream.scope)
         arg_cols = []
@@ -840,7 +860,7 @@ class Planner:
         )
         return self._restore_select_order(
             out, items, async_items[0], out_name, plain_items, names,
-            "async_udf_select",
+            "async_udf_select", final_name=display_name,
         )
 
     def _plan_window_function(
@@ -867,7 +887,8 @@ class Planner:
             raise SqlError(
                 f"unsupported window function {call.name}()"
             )
-        out_name = over_items[0].alias or call.name
+        display_name = over_items[0].alias or call.name
+        out_name = self._fresh("wfn")  # internal; no alias collisions
         # pre-projection: every non-over select item + partition/order exprs
         plain_items = [it for it in items if it is not over_items[0]]
         exprs, names = self._bind_items(plain_items, upstream.scope)
@@ -930,7 +951,7 @@ class Planner:
         )
         return self._restore_select_order(
             out, items, over_items[0], out_name, plain_items, names,
-            "window_fn_select",
+            "window_fn_select", final_name=display_name,
         )
 
     def _plan_updating_aggregate(
@@ -1571,23 +1592,35 @@ def _is_aggregate_name(name: str) -> bool:
     return get_udaf(name) is not None
 
 
+def _expr_children(e: Expr):
+    """Immediate child expressions of an AST node, discovered generically
+    through its dataclass fields (lists/tuples flattened) so walkers never
+    miss a position — CASE branches, IN lists, BETWEEN bounds included."""
+
+    def flatten(v):
+        if isinstance(v, Expr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                yield from flatten(item)
+
+    for f in dataclasses.fields(e):
+        yield from flatten(getattr(e, f.name))
+
+
 def _find_aggregates(e: Expr) -> List[FuncCall]:
     out: List[FuncCall] = []
 
     def walk(x):
-        if isinstance(x, FuncCall):
-            if _is_aggregate_name(x.name) and x.over is None:
-                out.append(x)
-                return  # don't descend into agg args
-            for a in x.args:
-                walk(a)
-        elif isinstance(x, BinaryOp):
-            walk(x.left)
-            walk(x.right)
-        elif isinstance(x, FieldAccess):
-            walk(x.base)
-        elif hasattr(x, "operand"):
-            walk(x.operand)
+        if (
+            isinstance(x, FuncCall)
+            and _is_aggregate_name(x.name)
+            and x.over is None
+        ):
+            out.append(x)
+            return  # don't descend into agg args
+        for c in _expr_children(x):
+            walk(c)
 
     walk(e)
     return out
@@ -1865,6 +1898,12 @@ def _find_field(schema: StreamSchema, name: str) -> Optional[int]:
 # ---------------------------------------------------------------------------
 # misc helpers
 # ---------------------------------------------------------------------------
+
+
+def _contains_unnest(e: Expr) -> bool:
+    if isinstance(e, FuncCall) and e.name == "unnest":
+        return True
+    return any(_contains_unnest(c) for c in _expr_children(e))
 
 
 def _find_item_by_alias(items: List[SelectItem], name: str):

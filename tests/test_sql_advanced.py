@@ -203,7 +203,7 @@ def test_unnest_requires_list():
 
 
 def test_unnest_guards():
-    with pytest.raises(SqlError, match="DISTINCT or GROUP BY"):
+    with pytest.raises(SqlError, match="DISTINCT, GROUP BY"):
         plan_query(
             """
             CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
@@ -225,6 +225,47 @@ def test_unnest_guards():
             JOIN impulse ON t.id = impulse.counter;
             """
         )
+    with pytest.raises(SqlError, match="top-level"):
+        plan_query(
+            """
+            CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+              connector = 'single_file', path = '/tmp/x', format = 'json',
+              type = 'source'
+            );
+            SELECT unnest(tags) + 1 FROM t;
+            """
+        )
+    # nested in a CASE branch: the generic expression walker must see it
+    with pytest.raises(SqlError, match="top-level"):
+        plan_query(
+            """
+            CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+              connector = 'single_file', path = '/tmp/x', format = 'json',
+              type = 'source'
+            );
+            SELECT CASE WHEN id > 0 THEN unnest(tags) ELSE 0 END FROM t;
+            """
+        )
+
+
+def test_unnest_alias_collision(tmp_path):
+    """A plain column aliased to the unnest output's name must not collide
+    with the exploded column's internal mapping."""
+    data = tmp_path / "lists.json"
+    with open(data, "w") as f:
+        f.write(json.dumps({"id": 7, "tags": [1, 2]}) + "\n")
+    rows = run_sql(
+        f"""
+        CREATE TABLE t (id BIGINT, tags BIGINT ARRAY) WITH (
+          connector = 'single_file', path = '{data}',
+          format = 'json', type = 'source'
+        );
+        SELECT id AS unnest, unnest(tags) FROM t;
+        """
+    )
+    assert len(rows) == 2
+    vals = sorted(r["unnest_1"] for r in rows)
+    assert vals == [1, 2] and all(r["unnest"] == 7 for r in rows)
 
 
 def test_sized_array_type_parses():

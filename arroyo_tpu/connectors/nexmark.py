@@ -171,6 +171,42 @@ def _bid_fields(ns):
     return auction, bidder, price, channel
 
 
+def _person_row(fields, j: int, pid: int, ts: int) -> dict:
+    """Build one person dict from row j of _person_fields output — the
+    single definition shared by event() and gen_batch() so the scalar and
+    vectorized paths stay bit-identical."""
+    first, last, city, state, cc = fields
+    name = f"{_FIRST[int(first[j])]} {_LAST[int(last[j])]}"
+    return {
+        "id": pid,
+        "name": name,
+        "email_address": f"{name.replace(' ', '.').lower()}@example.com",
+        "credit_card": " ".join(f"{int(c[j]):04d}" for c in cc),
+        "city": _CITIES[int(city[j])],
+        "state": _STATES[int(state[j])],
+        "datetime": ts,
+        "extra": "",
+    }
+
+
+def _auction_row(fields, j: int, aid: int, ts: int) -> dict:
+    """Build one auction dict from row j of _auction_fields output (shared
+    by the scalar and vectorized paths, like _person_row)."""
+    seller, initial, reserve, expires_s, category = fields
+    return {
+        "id": aid,
+        "item_name": f"item-{aid}",
+        "description": f"description of item {aid}",
+        "initial_bid": int(initial[j]),
+        "reserve": int(reserve[j]),
+        "datetime": ts,
+        "expires": ts + int(expires_s[j]) * 1_000_000_000,
+        "seller": int(seller[j]),
+        "category": int(category[j]),
+        "extra": "",
+    }
+
+
 class NexmarkGenerator:
     """Pure event generator: sequence number -> event dict."""
 
@@ -202,46 +238,20 @@ class NexmarkGenerator:
     def event(self, n: int, ts: int) -> dict:
         kind = self.kind_of(n)
         if kind == "person":
-            pid = self.last_person_id(n)
-            first, last, city, state, cc = _person_fields([n])
-            name = f"{_FIRST[int(first[0])]} {_LAST[int(last[0])]}"
             return {
-                "person": {
-                    "id": pid,
-                    "name": name,
-                    "email_address": f"{name.replace(' ', '.').lower()}"
-                                     "@example.com",
-                    "credit_card": " ".join(
-                        f"{int(c[0]):04d}" for c in cc
-                    ),
-                    "city": _CITIES[int(city[0])],
-                    "state": _STATES[int(state[0])],
-                    "datetime": ts,
-                    "extra": "",
-                },
+                "person": _person_row(
+                    _person_fields([n]), 0, self.last_person_id(n), ts
+                ),
                 "auction": None,
                 "bid": None,
                 "_timestamp": ts,
             }
         if kind == "auction":
-            aid = self.last_auction_id(n)
-            seller, initial, reserve, expires_s, category = _auction_fields(
-                [n]
-            )
             return {
                 "person": None,
-                "auction": {
-                    "id": aid,
-                    "item_name": f"item-{aid}",
-                    "description": f"description of item {aid}",
-                    "initial_bid": int(initial[0]),
-                    "reserve": int(reserve[0]),
-                    "datetime": ts,
-                    "expires": ts + int(expires_s[0]) * 1_000_000_000,
-                    "seller": int(seller[0]),
-                    "category": int(category[0]),
-                    "extra": "",
-                },
+                "auction": _auction_row(
+                    _auction_fields([n]), 0, self.last_auction_id(n), ts
+                ),
                 "bid": None,
                 "_timestamp": ts,
             }
@@ -283,39 +293,20 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
     pi = np.nonzero(is_person)[0]
     if len(pi):
         pns = ns[pi]
-        first, last, city, state, cc = _person_fields(pns)
+        pfields = _person_fields(pns)
         epoch = pns // PROPORTION_DENOMINATOR
         for j, i in enumerate(pi):
-            name = f"{_FIRST[int(first[j])]} {_LAST[int(last[j])]}"
-            person_col[i] = {
-                "id": FIRST_PERSON_ID + int(epoch[j]),
-                "name": name,
-                "email_address": f"{name.replace(' ', '.').lower()}"
-                                 "@example.com",
-                "credit_card": " ".join(f"{int(c[j]):04d}" for c in cc),
-                "city": _CITIES[int(city[j])],
-                "state": _STATES[int(state[j])],
-                "datetime": int(ts[i]),
-                "extra": "",
-            }
+            person_col[i] = _person_row(
+                pfields, j, FIRST_PERSON_ID + int(epoch[j]), int(ts[i])
+            )
     ai = np.nonzero(~is_bid & ~is_person)[0]
     if len(ai):
         ans = ns[ai]
-        seller, initial, reserve, expires_s, category = _auction_fields(ans)
+        afields = _auction_fields(ans)
         for j, i in enumerate(ai):
-            aid = g.last_auction_id(int(ans[j]))
-            auction_col[i] = {
-                "id": aid,
-                "item_name": f"item-{aid}",
-                "description": f"description of item {aid}",
-                "initial_bid": int(initial[j]),
-                "reserve": int(reserve[j]),
-                "datetime": int(ts[i]),
-                "expires": int(ts[i]) + int(expires_s[j]) * 1_000_000_000,
-                "seller": int(seller[j]),
-                "category": int(category[j]),
-                "extra": "",
-            }
+            auction_col[i] = _auction_row(
+                afields, j, g.last_auction_id(int(ans[j])), int(ts[i])
+            )
     bi = np.nonzero(is_bid)[0]
     bid_arr = pa.array(bid_col, type=BID_T)
     if len(bi):

@@ -10,8 +10,11 @@ updating_meta_fields); a TTL evicts idle keys (reference updating_cache.rs).
 
 Aggregation arithmetic runs on the shared device accumulator
 (ops/aggregates.py) — count/sum/avg are incrementally updatable; min/max are
-valid over append-only input (monotone). Retractable (updating) INPUT
-streams are a planner-rejected gap this round.
+valid over append-only input (monotone). With `retractable` set (the input
+is itself an updating stream), retract rows apply with sign -1 and a
+per-key live-row count deletes keys whose rows have all been retracted
+(emitting a final retraction); the planner restricts this mode to the
+invertible aggregates (count/sum/avg).
 """
 
 from __future__ import annotations
@@ -51,6 +54,12 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self.dirty: set = set()
         self.last_seen: Dict[tuple, int] = {}
         self.max_ts = 0  # max event time seen (flush timestamp fallback)
+        # retraction-consuming mode: input rows carry __updating_meta and
+        # apply with sign -1 when is_retract; live row-count per key drives
+        # key deletion once everything contributing has been retracted
+        self.retractable: bool = bool(config.get("retractable"))
+        self.meta_col: Optional[int] = config.get("meta_col")
+        self.live: Dict[tuple, int] = {}
 
     def tables(self):
         from ..state.table_config import global_table
@@ -88,6 +97,15 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                     if ls_mask is not None and not ls_mask[i]:
                         continue
                     self.last_seen[self._intern_key(key_vals)] = seen
+                lv_rows = snap.get("live", [])
+                lv_mask = (
+                    self._range_mask([kv for kv, _ in lv_rows], ctx)
+                    if lv_rows else None
+                )
+                for i, (key_vals, cnt) in enumerate(lv_rows):
+                    if lv_mask is not None and not lv_mask[i]:
+                        continue
+                    self.live[self._intern_key(key_vals)] = cnt
         # everything restored must re-verify against emitted on next flush
         for _, key, _slot in self.dir.items():
             self.dirty.add(key)
@@ -108,6 +126,11 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                 [self._key_tuple_to_values(k), v]
                 for k, v in self.last_seen.items()
             ]
+            if self.retractable:
+                snap["live"] = [
+                    [self._key_tuple_to_values(k), v]
+                    for k, v in self.live.items()
+                ]
             table.put(ctx.task_info.task_index, snap)
 
     def _intern_key(self, key_vals: list) -> tuple:
@@ -125,16 +148,30 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         keys = self._key_arrays(batch)
         slots = self.dir.assign(bins, keys)
         self._ensure_capacity()
-        self.acc.update(slots, self._agg_input_cols(batch))
+        signs = None
+        if self.retractable:
+            is_retract = np.asarray(
+                batch.column(self.meta_col).field("is_retract")
+                .to_numpy(zero_copy_only=False)
+            )
+            signs = np.where(is_retract, -1, 1).astype(np.int64)
+        self.acc.update(slots, self._agg_input_cols(batch), signs=signs)
         now = int(ts.max()) if len(ts) else 0
         self.max_ts = max(self.max_ts, now)
         # mark touched keys dirty: O(unique-in-batch) via the directory's
         # reverse map, not O(live keys)
-        for entry in self.dir.keys_for_slots(np.unique(slots)):
+        uniq, inv = np.unique(slots, return_inverse=True)
+        if signs is not None:
+            # per-unique-slot signed row delta, O(batch) memory (bincount
+            # over raw slot ids would size by the largest live slot)
+            per_uniq = np.bincount(inv, weights=signs)
+        for i, entry in enumerate(self.dir.keys_for_slots(uniq)):
             if entry is not None:
                 _, key = entry
                 self.dirty.add(key)
                 self.last_seen[key] = now
+                if signs is not None:
+                    self.live[key] = self.live.get(key, 0) + int(per_uniq[i])
 
     async def handle_tick(self, tick, ctx, collector):
         await self._flush(ctx, collector)
@@ -162,23 +199,42 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self.dirty.clear()
         if not keys:
             return
-        slots = np.asarray([bin_map[k] for k in keys], dtype=np.int64)
-        agg_cols = self.acc.finalize(self.acc.gather(slots))
         retract_keys: List[tuple] = []
         retract_vals: List[List] = []
         append_keys: List[tuple] = []
         append_vals: List[List] = []
-        for i, key in enumerate(keys):
-            new_vals = [_to_py(c[i]) for c in agg_cols]
-            old = self.emitted.get(key)
-            if old == new_vals:
-                continue
-            if old is not None:
-                retract_keys.append(key)
-                retract_vals.append(old)
-            append_keys.append(key)
-            append_vals.append(new_vals)
-            self.emitted[key] = new_vals
+        if self.retractable:
+            # keys whose every contributing row was retracted: emit a final
+            # retraction of the last emitted values and drop all state
+            dead = [k for k in keys if self.live.get(k, 0) <= 0]
+            if dead:
+                keys = [k for k in keys if self.live.get(k, 0) > 0]
+                for k in dead:
+                    old = self.emitted.pop(k, None)
+                    if old is not None:
+                        retract_keys.append(k)
+                        retract_vals.append(old)
+                    self.last_seen.pop(k, None)
+                    self.live.pop(k, None)
+                freed = self.dir.remove(0, dead)
+                if len(freed):
+                    self.acc.reset_slots(freed)
+        if keys:
+            slots = np.asarray([bin_map[k] for k in keys], dtype=np.int64)
+            agg_cols = self.acc.finalize(self.acc.gather(slots))
+            for i, key in enumerate(keys):
+                new_vals = [_to_py(c[i]) for c in agg_cols]
+                old = self.emitted.get(key)
+                if old == new_vals:
+                    continue
+                if old is not None:
+                    retract_keys.append(key)
+                    retract_vals.append(old)
+                append_keys.append(key)
+                append_vals.append(new_vals)
+                self.emitted[key] = new_vals
+        if not retract_keys and not append_keys:
+            return
         # flushes before the first watermark stamp rows with the max
         # event time seen — a zero timestamp would look ancient to
         # downstream event-time TTLs and get evicted immediately
@@ -250,6 +306,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         for k in stale:
             self.last_seen.pop(k, None)
             self.emitted.pop(k, None)
+            self.live.pop(k, None)
             self.dirty.discard(k)
 
 

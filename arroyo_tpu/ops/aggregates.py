@@ -158,13 +158,23 @@ class Accumulator:
 
     # -- update (hot path) --------------------------------------------------
 
-    def update(self, slots: np.ndarray, cols: Dict[int, np.ndarray]):
+    def update(self, slots: np.ndarray, cols: Dict[int, np.ndarray],
+               signs: Optional[np.ndarray] = None):
         """Scatter-reduce a batch. slots[i] = accumulator slot of row i
         (must be < capacity-1; capacity-1 is scratch). cols maps input column
-        index -> numpy array of row values."""
+        index -> numpy array of row values. `signs` (+1 append / -1 retract
+        per row) makes the update invertible for retraction-consuming
+        aggregates; only add-reductions (count/sum/avg) support it."""
         n = len(slots)
         if n == 0:
             return
+        if signs is not None and (
+            self.udaf_idx or any(op != "add" for op, _, _, _ in self.phys)
+        ):
+            raise ValueError(
+                "signed (retractable) update requires invertible aggregates "
+                "(count/sum/avg)"
+            )
         if self.udaf_idx:
             order = np.argsort(slots, kind="stable")
             s_sorted = slots[order]
@@ -181,14 +191,14 @@ class Accumulator:
         if not self.phys:
             return
         if self.backend == "numpy":
-            self._np_update(slots, cols)
+            self._np_update(slots, cols, signs)
             return
         jnp = _get_jax().numpy
         padded = _bucket(n, self._buckets)
         slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
         slots_p[:n] = slots
         valid = np.zeros(padded, dtype=np.int64)
-        valid[:n] = 1
+        valid[:n] = 1 if signs is None else signs
         inputs = []
         for op, dt, src, si in self.phys:
             spec = self.specs[si]
@@ -196,7 +206,10 @@ class Accumulator:
                 vals = valid
             else:
                 vals = np.zeros(padded, dtype=_np_dtype(dt))
-                vals[:n] = cols[spec.col]
+                vals[:n] = (
+                    cols[spec.col] if signs is None
+                    else cols[spec.col] * signs
+                )
                 if op != "add":
                     vals[n:] = _neutral(op, dt)
             inputs.append(jnp.asarray(vals))
@@ -220,13 +233,18 @@ class Accumulator:
 
         return update
 
-    def _np_update(self, slots, cols):
+    def _np_update(self, slots, cols, signs=None):
         for (op, dt, src, si), s in zip(self.phys, self.state):
             spec = self.specs[si]
             if src == "one":
-                vals = np.ones(len(slots), dtype=np.int64)
+                vals = (
+                    np.ones(len(slots), dtype=np.int64)
+                    if signs is None else signs.astype(np.int64)
+                )
             else:
                 vals = cols[spec.col].astype(_np_dtype(dt), copy=False)
+                if signs is not None:
+                    vals = vals * signs
             if op == "add":
                 np.add.at(s, slots, vals)
             elif op == "min":

@@ -962,11 +962,6 @@ class Planner:
         UpdatingAggregateExtension)."""
         from ..schema import UPDATING_META_FIELD, UPDATING_META_TYPE
 
-        if upstream.updating:
-            raise SqlError(
-                "aggregating an updating input (retraction-consuming "
-                "aggregates) is not yet supported"
-            )
         key_names = _dedup(
             [_default_name(g, b) for g, b in zip(group_exprs, key_bound)]
         )
@@ -975,6 +970,22 @@ class Planner:
             raise SqlError(
                 "count(DISTINCT) in updating aggregates is not yet supported"
             )
+        if upstream.updating:
+            # retraction-consuming aggregation: retract rows apply with
+            # sign -1, so only invertible aggregates work (reference
+            # incremental_aggregator.rs supports the same add-reductions)
+            bad = [
+                c.name for c in agg_calls
+                if ("avg" if c.name == "mean" else c.name)
+                not in ("count", "sum", "avg")
+            ]
+            if bad:
+                raise SqlError(
+                    f"{bad[0]}() over an updating (retracting) input is not "
+                    "supported — only invertible aggregates (count/sum/avg) "
+                    "can consume retractions; aggregate before the updating "
+                    "stage instead"
+                )
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
         agg_col_idx: List[Optional[int]] = []
@@ -1009,15 +1020,21 @@ class Planner:
             add_timestamp_field(pa.schema(out_fields))
         )
         agg_par = self.parallelism if key_names else 1
+        agg_config = {
+            "aggregates": specs,
+            "key_cols": list(range(len(key_names))),
+            "schema": agg_out_schema,
+        }
+        if upstream.updating:
+            agg_config["retractable"] = True
+            agg_config["meta_col"] = pre.schema.schema.names.index(
+                UPDATING_META_FIELD
+            )
         node = self.graph.add_node(
             LogicalNode.single(
                 self._next_id(),
                 OperatorName.UPDATING_AGGREGATE,
-                {
-                    "aggregates": specs,
-                    "key_cols": list(range(len(key_names))),
-                    "schema": agg_out_schema,
-                },
+                agg_config,
                 "updating_aggregate",
                 parallelism=agg_par,
             )

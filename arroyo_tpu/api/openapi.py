@@ -1,0 +1,312 @@
+"""OpenAPI 3.0 spec for the REST API, generated from the route table.
+
+Capability parity with the reference's utoipa-generated spec
+(/root/reference/crates/arroyo-api/src/lib.rs ApiDoc + api-types): the same
+route table drives BOTH aiohttp router registration (rest.py build_app) and
+the spec served at /api/v1/openapi.json, so the document cannot drift from
+the actual surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# (method, path, handler attr, summary, tag, request schema, response schema)
+Route = Tuple[str, str, str, str, str, Optional[str], Optional[str]]
+
+ROUTES: List[Route] = [
+    ("get", "/ping", "ping", "Liveness check", "ping", None, None),
+    ("post", "/pipelines/validate_query", "validate_query",
+     "Validate SQL and return the planned dataflow graph or errors",
+     "pipelines", "ValidateQueryPost", "QueryValidationResult"),
+    ("post", "/pipelines/preview", "preview_pipeline",
+     "Run a bounded preview of a query, buffering sampled output",
+     "pipelines", "PipelinePost", "Pipeline"),
+    ("get", "/pipelines/preview/{id}/output", "preview_output",
+     "Fetch buffered preview output rows", "pipelines", None,
+     "OutputData"),
+    ("get", "/pipelines/preview/{id}/output/ws", "preview_output_ws",
+     "Stream preview output over a websocket", "pipelines", None, None),
+    ("post", "/pipelines", "create_pipeline",
+     "Create and start a pipeline", "pipelines", "PipelinePost",
+     "Pipeline"),
+    ("get", "/pipelines", "list_pipelines", "List pipelines",
+     "pipelines", None, "PipelineCollection"),
+    ("get", "/pipelines/{id}", "get_pipeline", "Get one pipeline",
+     "pipelines", None, "Pipeline"),
+    ("patch", "/pipelines/{id}", "patch_pipeline",
+     "Update stop mode / parallelism / checkpoint interval",
+     "pipelines", "PipelinePatch", "Pipeline"),
+    ("delete", "/pipelines/{id}", "delete_pipeline",
+     "Stop and delete a pipeline", "pipelines", None, None),
+    ("post", "/pipelines/{id}/restart", "restart_pipeline",
+     "Restart a pipeline (optionally force without checkpoint)",
+     "pipelines", "PipelineRestart", "Pipeline"),
+    ("get", "/pipelines/{id}/jobs", "pipeline_jobs",
+     "Jobs for one pipeline", "jobs", None, "JobCollection"),
+    ("get", "/jobs", "all_jobs", "All jobs across pipelines", "jobs",
+     None, "JobCollection"),
+    ("get", "/jobs/{job_id}/checkpoints", "job_checkpoints",
+     "Checkpoints of a job", "jobs", None, "CheckpointCollection"),
+    ("get", "/jobs/{job_id}/errors", "job_errors",
+     "Operator error reports of a job", "jobs", None,
+     "JobLogMessageCollection"),
+    ("get", "/jobs/{job_id}/operator_metric_groups",
+     "operator_metric_groups", "Per-operator metric groups", "jobs",
+     None, "OperatorMetricGroupCollection"),
+    ("get", "/connectors", "list_connectors",
+     "Available connector types with config schemas", "connectors",
+     None, "ConnectorCollection"),
+    ("get", "/connection_profiles", "list_connection_profiles",
+     "List stored connection profiles", "connections", None,
+     "ConnectionProfileCollection"),
+    ("post", "/connection_profiles", "create_connection_profile",
+     "Store a connection profile", "connections",
+     "ConnectionProfilePost", "ConnectionProfile"),
+    ("get", "/connection_tables", "list_connection_tables",
+     "List stored connection tables", "connections", None,
+     "ConnectionTableCollection"),
+    ("post", "/connection_tables", "create_connection_table",
+     "Store a connection table", "connections", "ConnectionTablePost",
+     "ConnectionTable"),
+    ("delete", "/connection_tables/{id}", "delete_connection_table",
+     "Delete a connection table", "connections", None, None),
+    ("post", "/connection_tables/test", "test_connection_table",
+     "Validate a connection table config against its connector",
+     "connections", "ConnectionTablePost", "TestSourceMessage"),
+    ("post", "/udfs/validate", "validate_udf",
+     "Validate a UDF definition", "udfs", "ValidateUdfPost",
+     "UdfValidationResult"),
+    ("post", "/udfs", "create_udf", "Register a global UDF", "udfs",
+     "UdfPost", "GlobalUdf"),
+    ("get", "/udfs", "list_udfs", "List global UDFs", "udfs", None,
+     "GlobalUdfCollection"),
+    ("delete", "/udfs/{id}", "delete_udf", "Delete a global UDF",
+     "udfs", None, None),
+]
+
+
+def _obj(props: Dict[str, Any], required: Optional[List[str]] = None):
+    out: Dict[str, Any] = {"type": "object", "properties": props}
+    if required:
+        out["required"] = required
+    return out
+
+
+def _str():
+    return {"type": "string"}
+
+
+def _int():
+    return {"type": "integer", "format": "int64"}
+
+
+def _ref(name: str):
+    return {"$ref": f"#/components/schemas/{name}"}
+
+
+def _collection(item: str):
+    return _obj({"data": {"type": "array", "items": _ref(item)},
+                 "hasMore": {"type": "boolean"}}, ["data"])
+
+
+def _schemas() -> Dict[str, Any]:
+    s: Dict[str, Any] = {
+        "ValidateQueryPost": _obj(
+            {"query": _str(), "udfs": {"type": "array", "items": _str()}},
+            ["query"],
+        ),
+        "QueryValidationResult": _obj(
+            {"graph": {"type": "object", "nullable": True},
+             "errors": {"type": "array", "items": _str()}},
+        ),
+        "PipelinePost": _obj(
+            {"name": _str(), "query": _str(),
+             "parallelism": _int(),
+             "checkpointIntervalMicros": _int(),
+             "udfs": {"type": "array", "items": _str()},
+             "previewSink": {"type": "boolean"}},
+            ["name", "query"],
+        ),
+        "PipelinePatch": _obj(
+            {"stop": {"type": "string",
+                      "enum": ["none", "graceful", "immediate",
+                               "checkpoint", "force"]},
+             "parallelism": _int(),
+             "checkpointIntervalMicros": _int()},
+        ),
+        "PipelineRestart": _obj({"force": {"type": "boolean"}}),
+        "Pipeline": _obj(
+            {"id": _str(), "name": _str(), "query": _str(),
+             "stop": _str(), "createdAt": _int(),
+             "graph": {"type": "object"},
+             "preview": {"type": "boolean"}},
+            ["id", "name", "query"],
+        ),
+        "Job": _obj(
+            {"id": _str(), "pipelineId": _str(), "state": _str(),
+             "runId": _int(), "startTime": {**_int(), "nullable": True},
+             "finishTime": {**_int(), "nullable": True},
+             "tasks": {**_int(), "nullable": True},
+             "failureMessage": {**_str(), "nullable": True}},
+            ["id", "state"],
+        ),
+        "Checkpoint": _obj(
+            {"epoch": _int(), "backend": _str(),
+             "startTime": _int(),
+             "finishTime": {**_int(), "nullable": True},
+             "spanTypes": {"type": "array", "items": _str()}},
+            ["epoch"],
+        ),
+        "JobLogMessage": _obj(
+            {"createdAt": _int(), "operatorId": {**_str(),
+                                                 "nullable": True},
+             "taskIndex": {**_int(), "nullable": True},
+             "level": {"type": "string",
+                       "enum": ["info", "warn", "error"]},
+             "message": _str(), "details": _str()},
+            ["message"],
+        ),
+        "Metric": _obj({"time": _int(), "value": {"type": "number"}}),
+        "SubtaskMetrics": _obj(
+            {"index": _int(),
+             "metrics": {"type": "array", "items": _ref("Metric")}},
+        ),
+        "MetricGroup": _obj(
+            {"name": _str(),
+             "subtasks": {"type": "array",
+                          "items": _ref("SubtaskMetrics")}},
+        ),
+        "OperatorMetricGroup": _obj(
+            {"operatorId": _str(),
+             "metricGroups": {"type": "array",
+                              "items": _ref("MetricGroup")}},
+        ),
+        "Connector": _obj(
+            {"id": _str(), "name": _str(), "description": _str(),
+             "source": {"type": "boolean"}, "sink": {"type": "boolean"},
+             "connectionConfig": {"type": "object"},
+             "tableConfig": {"type": "object"}},
+            ["id", "name"],
+        ),
+        "ConnectionProfilePost": _obj(
+            {"name": _str(), "connector": _str(),
+             "config": {"type": "object"}},
+            ["name", "connector", "config"],
+        ),
+        "ConnectionProfile": _obj(
+            {"id": _str(), "name": _str(), "connector": _str(),
+             "config": {"type": "object"}},
+            ["id", "name", "connector"],
+        ),
+        "ConnectionSchemaDef": _obj(
+            {"fields": {"type": "array", "items": _obj(
+                {"name": _str(), "type": _str(),
+                 "nullable": {"type": "boolean"}})},
+             "format": {**_str(), "nullable": True},
+             "badData": {**_str(), "nullable": True}},
+        ),
+        "ConnectionTablePost": _obj(
+            {"name": _str(), "connector": _str(),
+             "connectionProfileId": {**_str(), "nullable": True},
+             "config": {"type": "object"},
+             "schema": {**_ref("ConnectionSchemaDef"),
+                        "nullable": True}},
+            ["name", "connector", "config"],
+        ),
+        "ConnectionTable": _obj(
+            {"id": _str(), "name": _str(), "connector": _str(),
+             "tableType": {"type": "string",
+                           "enum": ["source", "sink", "lookup"]},
+             "config": {"type": "object"},
+             "schema": _ref("ConnectionSchemaDef")},
+            ["id", "name", "connector"],
+        ),
+        "TestSourceMessage": _obj(
+            {"error": {"type": "boolean"}, "done": {"type": "boolean"},
+             "message": _str()},
+            ["error", "done", "message"],
+        ),
+        "ValidateUdfPost": _obj({"definition": _str()}, ["definition"]),
+        "UdfValidationResult": _obj(
+            {"udfName": {**_str(), "nullable": True},
+             "errors": {"type": "array", "items": _str()}},
+        ),
+        "UdfPost": _obj(
+            {"prefix": {**_str(), "nullable": True},
+             "definition": _str(),
+             "description": {**_str(), "nullable": True}},
+            ["definition"],
+        ),
+        "GlobalUdf": _obj(
+            {"id": _str(), "name": _str(), "definition": _str(),
+             "description": {**_str(), "nullable": True},
+             "createdAt": _int()},
+            ["id", "name", "definition"],
+        ),
+        "OutputData": _obj(
+            {"operatorId": _str(), "timestamp": _int(),
+             "batch": _str(), "startId": _int()},
+        ),
+        "ErrorResp": _obj({"error": _str()}, ["error"]),
+    }
+    for item, name in [
+        ("Pipeline", "PipelineCollection"),
+        ("Job", "JobCollection"),
+        ("Checkpoint", "CheckpointCollection"),
+        ("JobLogMessage", "JobLogMessageCollection"),
+        ("OperatorMetricGroup", "OperatorMetricGroupCollection"),
+        ("Connector", "ConnectorCollection"),
+        ("ConnectionProfile", "ConnectionProfileCollection"),
+        ("ConnectionTable", "ConnectionTableCollection"),
+        ("GlobalUdf", "GlobalUdfCollection"),
+    ]:
+        s[name] = _collection(item)
+    return s
+
+
+def build_spec(prefix: str = "/api/v1") -> Dict[str, Any]:
+    """OpenAPI 3.0.3 document covering every registered /api/v1 route."""
+    paths: Dict[str, Any] = {}
+    for method, path, handler, summary, tag, req, resp in ROUTES:
+        op: Dict[str, Any] = {
+            "summary": summary,
+            "operationId": handler,
+            "tags": [tag],
+            "responses": {
+                "200": {"description": "OK"},
+                "400": {"description": "Bad request",
+                        "content": {"application/json": {
+                            "schema": _ref("ErrorResp")}}},
+            },
+        }
+        if resp:
+            op["responses"]["200"]["content"] = {
+                "application/json": {"schema": _ref(resp)}
+            }
+        if req:
+            op["requestBody"] = {
+                "required": True,
+                "content": {"application/json": {"schema": _ref(req)}},
+            }
+        params = [
+            seg[1:-1] for seg in path.split("/")
+            if seg.startswith("{") and seg.endswith("}")
+        ]
+        if params:
+            op["parameters"] = [
+                {"name": p, "in": "path", "required": True,
+                 "schema": _str()} for p in params
+            ]
+        paths.setdefault(prefix + path, {})[method] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "arroyo-tpu REST API",
+            "description": "Pipeline management API "
+                           "(reference parity: arroyo-api ApiDoc)",
+            "version": "1.0.0",
+        },
+        "paths": paths,
+        "components": {"schemas": _schemas()},
+    }

@@ -402,36 +402,22 @@ def build_app(controller: Optional[ControllerServer] = None,
     app = web.Application()
     r = app.router
     v1 = "/api/v1"
-    r.add_get(f"{v1}/ping", api.ping)
-    r.add_post(f"{v1}/pipelines/validate_query", api.validate_query)
-    r.add_post(f"{v1}/pipelines/preview", api.preview_pipeline)
-    r.add_get(f"{v1}/pipelines/preview/{{id}}/output", api.preview_output)
-    r.add_get(f"{v1}/pipelines/preview/{{id}}/output/ws",
-              api.preview_output_ws)
-    r.add_post(f"{v1}/pipelines", api.create_pipeline)
-    r.add_get(f"{v1}/pipelines", api.list_pipelines)
-    r.add_get(f"{v1}/pipelines/{{id}}", api.get_pipeline)
-    r.add_patch(f"{v1}/pipelines/{{id}}", api.patch_pipeline)
-    r.add_delete(f"{v1}/pipelines/{{id}}", api.delete_pipeline)
-    r.add_post(f"{v1}/pipelines/{{id}}/restart", api.restart_pipeline)
-    r.add_get(f"{v1}/pipelines/{{id}}/jobs", api.pipeline_jobs)
-    r.add_get(f"{v1}/jobs", api.all_jobs)
-    r.add_get(f"{v1}/jobs/{{job_id}}/checkpoints", api.job_checkpoints)
-    r.add_get(f"{v1}/jobs/{{job_id}}/errors", api.job_errors)
-    r.add_get(f"{v1}/jobs/{{job_id}}/operator_metric_groups",
-              api.operator_metric_groups)
-    r.add_get(f"{v1}/connectors", api.list_connectors)
-    r.add_get(f"{v1}/connection_profiles", api.list_connection_profiles)
-    r.add_post(f"{v1}/connection_profiles", api.create_connection_profile)
-    r.add_get(f"{v1}/connection_tables", api.list_connection_tables)
-    r.add_post(f"{v1}/connection_tables", api.create_connection_table)
-    r.add_delete(f"{v1}/connection_tables/{{id}}",
-                 api.delete_connection_table)
-    r.add_post(f"{v1}/connection_tables/test", api.test_connection_table)
-    r.add_post(f"{v1}/udfs/validate", api.validate_udf)
-    r.add_post(f"{v1}/udfs", api.create_udf)
-    r.add_get(f"{v1}/udfs", api.list_udfs)
-    r.add_delete(f"{v1}/udfs/{{id}}", api.delete_udf)
+    # routes register from the same table that generates the OpenAPI spec
+    # (openapi.py ROUTES), so /api/v1/openapi.json cannot drift
+    from .openapi import ROUTES, build_spec
+
+    for method, path, handler, *_ in ROUTES:
+        if method == "get":  # add_get also registers HEAD
+            r.add_get(v1 + path, getattr(api, handler))
+        else:
+            r.add_route(method.upper(), v1 + path, getattr(api, handler))
+
+    spec = build_spec(v1)
+
+    async def openapi_json(request: web.Request):
+        return json_response(spec)
+
+    r.add_get(f"{v1}/openapi.json", openapi_json)
     from .console import add_console_routes
 
     add_console_routes(app)

@@ -160,11 +160,13 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self.max_ts = max(self.max_ts, now)
         # mark touched keys dirty: O(unique-in-batch) via the directory's
         # reverse map, not O(live keys)
-        uniq, inv = np.unique(slots, return_inverse=True)
         if signs is not None:
             # per-unique-slot signed row delta, O(batch) memory (bincount
             # over raw slot ids would size by the largest live slot)
+            uniq, inv = np.unique(slots, return_inverse=True)
             per_uniq = np.bincount(inv, weights=signs)
+        else:
+            uniq = np.unique(slots)
         for i, entry in enumerate(self.dir.keys_for_slots(uniq)):
             if entry is not None:
                 _, key = entry

@@ -212,3 +212,34 @@ def test_stop_pipeline_via_patch(tmp_path):
         assert state == "Stopped"
 
     with_client(body)
+
+
+def test_openapi_spec():
+    @with_client
+    async def _(client, api, controller):
+        resp = await client.get("/api/v1/openapi.json")
+        assert resp.status == 200
+        spec = await resp.json()
+        assert spec["openapi"].startswith("3.0")
+        # every ROUTES entry appears in the spec and is actually routed
+        from arroyo_tpu.api.openapi import ROUTES
+
+        assert len(ROUTES) == sum(len(ms) for ms in spec["paths"].values())
+        for method, path, *_ in ROUTES:
+            assert method in spec["paths"]["/api/v1" + path], path
+        # all $ref targets resolve against components
+        comps = spec["components"]["schemas"]
+
+        def refs(node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "$ref":
+                        yield v
+                    else:
+                        yield from refs(v)
+            elif isinstance(node, list):
+                for item in node:
+                    yield from refs(item)
+
+        for ref in refs(spec):
+            assert ref.split("/")[-1] in comps, ref

@@ -245,8 +245,10 @@ def _schemas() -> Dict[str, Any]:
             ["id", "name", "definition"],
         ),
         "OutputData": _obj(
-            {"operatorId": _str(), "timestamp": _int(),
-             "batch": _str(), "startId": _int()},
+            {"rows": {"type": "array", "items": {"type": "object"}},
+             "done": {"type": "boolean"},
+             "error": {**_str(), "nullable": True}},
+            ["rows", "done"],
         ),
         "ErrorResp": _obj({"error": _str()}, ["error"]),
     }

@@ -343,6 +343,24 @@ class ControllerServer:
                     "WorkerGrpc", "Commit",
                     {"epoch": epoch, "committing": manifest["committing"]},
                 )
+        # compaction cadence: merge small carried-forward files (off the
+        # event loop — merges are data-proportional), tell the owning
+        # subtasks to swap references, GC unreferenced epochs. Advisory:
+        # a failed swap delivery must not fail the job (old files stay
+        # referenced until the swap lands in a later manifest).
+        swaps = await asyncio.to_thread(
+            job.backend.compact_epoch, epoch, manifest
+        )
+        for swap in swaps:
+            for w in job.workers:
+                try:
+                    await w.client.call("WorkerGrpc", "LoadCompacted", swap)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "LoadCompacted to worker %s failed: %s",
+                        w.worker_id, e,
+                    )
+        await asyncio.to_thread(job.backend.retire_unreferenced)
 
     async def _await_all_finished(self, job: JobHandle, timeout: float = 60.0):
         deadline = time.monotonic() + timeout

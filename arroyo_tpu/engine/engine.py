@@ -83,7 +83,20 @@ class RunningEngine:
             manifest = self.backend.publish_checkpoint(epoch, reports)
             if manifest.get("committing"):
                 await self.commit_epoch(epoch, manifest["committing"])
+            await self._compact(epoch, manifest)
         return reports
+
+    async def _compact(self, epoch: int, manifest: dict):
+        """Controller-side compaction cadence: merge operators' small
+        carried-forward files (off the event loop) and tell their subtasks
+        to swap references (reference ControlMessage::LoadCompacted); then
+        GC epochs nothing references anymore."""
+        swaps = await asyncio.to_thread(
+            self.backend.compact_epoch, epoch, manifest
+        )
+        for swap in swaps:
+            self.program.send_load_compacted(swap)
+        await asyncio.to_thread(self.backend.retire_unreferenced)
 
     async def commit_epoch(self, epoch: int, committing: Dict[str, dict]):
         """Second phase of 2PC: authorized exactly-once via the commit

@@ -223,3 +223,17 @@ class Program:
             if s.key == (node_id, index):
                 return s
         raise KeyError((node_id, index))
+
+    def send_load_compacted(self, swap: dict):
+        """Deliver a compaction file swap to the node's local subtasks
+        (shared by the embedded engine and the worker RPC handler)."""
+        from ..operators.control import LoadCompactedMsg
+
+        for s in self.subtasks:
+            if s.node.node_id == swap["node_id"]:
+                s.control_rx.put_nowait(
+                    LoadCompactedMsg(
+                        swap["node_id"], swap["table"], swap["files"],
+                        op_idx=swap.get("op_idx"),
+                    )
+                )

@@ -61,6 +61,7 @@ class WorkerServer:
                 "StartProcessing": self.start_processing,
                 "Checkpoint": self.checkpoint,
                 "Commit": self.commit,
+                "LoadCompacted": self.load_compacted,
                 "StopExecution": self.stop_execution,
                 "GetMetrics": self.get_metrics,
             },
@@ -179,6 +180,13 @@ class WorkerServer:
             data[int(node_id)] = {"data": {int(s): v for s, v in subs.items()}}
         for sub in self.program.subtasks:
             sub.control_rx.put_nowait(CommitMsg(req["epoch"], data))
+        return {}
+
+    async def load_compacted(self, req: dict) -> dict:
+        """Swap an operator table's file references for a compacted file
+        (controller-driven compaction; reference LoadCompacted control)."""
+        if self.program is not None:
+            self.program.send_load_compacted(req)
         return {}
 
     async def stop_execution(self, req: dict) -> dict:

@@ -36,8 +36,10 @@ class CommitMsg:
 class LoadCompactedMsg:
     node_id: int
     table: str
-    # table -> new file paths that replace the pre-compaction files
-    paths: List[str] = dataclasses.field(default_factory=list)
+    # new file metadata dicts that replace the pre-compaction files
+    paths: List[dict] = dataclasses.field(default_factory=list)
+    # chain position of the op owning the table (None = every op in chain)
+    op_idx: Optional[int] = None
 
 
 ControlMessage = Any  # union of the above

@@ -385,7 +385,9 @@ class SubtaskRunner:
             await op.handle_commit(msg.epoch, node_data, ctx)
 
     async def _load_compacted(self, msg: LoadCompactedMsg):
-        for ctx in self.ctxs:
+        for idx, ctx in enumerate(self.ctxs):
+            if msg.op_idx is not None and idx != msg.op_idx:
+                continue
             if ctx.table_manager is not None:
                 await ctx.table_manager.load_compacted(msg.table, msg.paths)
 

@@ -183,16 +183,105 @@ class StateBackend:
             "max_ts": max(f["max_ts"] for f in files),
         }
 
-    def cleanup(self, min_epoch: int):
-        known = []
-        for key in self.storage.list(f"{self.job_id}/checkpoints"):
-            parts = key.split("/")
-            for p in parts:
-                if p.startswith("checkpoint-"):
+    def compact_epoch(self, epoch: int, manifest: Dict[str, Any]) -> List[dict]:
+        """Scan a just-published manifest for (node, op, table) groups whose
+        carried-forward file count reached the configured threshold and merge
+        each into one compacted file (reference: controller-driven compaction,
+        compaction.rs + ControlMessage::LoadCompacted). Returns swap
+        instructions [{node_id, op_idx, table, files}] for the workers; the
+        swapped references land in the NEXT manifest, old files stay durable
+        until retire_unreferenced() sees nothing pointing at them."""
+        from ..config import config as get_config
+
+        cfg = get_config().pipeline.checkpointing
+        if not cfg.compaction_enabled or not manifest:
+            return []
+        groups: Dict[tuple, Dict[str, dict]] = {}
+        for task in manifest.get("tasks", {}).values():
+            node_id = task["node_id"]
+            for op_key, tables in (task.get("op_tables") or {}).items():
+                for tname, meta in tables.items():
+                    if meta.get("kind") != "time_key":
+                        continue
+                    g = groups.setdefault(
+                        (node_id, int(op_key[2:]), tname), {}
+                    )
+                    for f in meta.get("files", []):
+                        g[f["path"]] = f
+        out = []
+        for (node_id, op_idx, tname), by_path in groups.items():
+            files = list(by_path.values())
+            if len(files) < cfg.compaction_epoch_threshold:
+                continue
+            merged = self.compact_time_key_files(
+                epoch, node_id, op_idx, tname, files
+            )
+            if merged is not None:
+                logger.info(
+                    "compacted %d files -> %s (node %d op %d table %s)",
+                    len(files), merged["path"], node_id, op_idx, tname,
+                )
+                out.append({
+                    "node_id": node_id, "op_idx": op_idx, "table": tname,
+                    "files": [merged],
+                })
+        return out
+
+    def retire_unreferenced(self):
+        """GC checkpoint epochs older than the latest manifest whose data
+        directories contain no file the manifest still references, plus
+        superseded compacted files no manifest points at anymore
+        (reference gc.rs — safe min_epoch derived from live references)."""
+        manifest = self.latest_manifest()
+        if not manifest:
+            return
+        referenced = set()
+        for task in manifest.get("tasks", {}).values():
+            for tables in (task.get("op_tables") or {}).values():
+                for meta in tables.values():
+                    if meta.get("path"):
+                        referenced.add(meta["path"])
+                    for f in meta.get("files", []):
+                        referenced.add(f["path"])
+        latest_epoch = manifest.get("epoch")
+        if latest_epoch is None:
+            return
+        for e in self._known_epochs():
+            if e >= latest_epoch:
+                continue
+            prefix = self.paths.checkpoint_dir(e)
+            if any(r.startswith(prefix) for r in referenced):
+                continue
+            self.storage.delete_directory(prefix)
+        # a re-merge supersedes the previous compacted file: delete merges
+        # the latest manifest no longer references. Merges stamped at the
+        # latest epoch or later are NOT yet referenced by any manifest
+        # (workers swap first, the next checkpoint records them) — keep them.
+        for key in self.storage.list(f"{self.job_id}/compacted"):
+            if key in referenced:
+                continue
+            merge_epoch = None
+            for part in key.split("-"):
+                if part.startswith("epoch"):
                     try:
-                        known.append(int(p.split("-")[1]))
+                        merge_epoch = int(part[len("epoch"):])
                     except ValueError:
                         pass
+            if merge_epoch is not None and merge_epoch < latest_epoch:
+                self.storage.delete(key)
+
+    def _known_epochs(self) -> List[int]:
+        epochs = set()
+        for key in self.storage.list(f"{self.job_id}/checkpoints"):
+            for p in key.split("/"):
+                if p.startswith("checkpoint-"):
+                    try:
+                        epochs.add(int(p.split("-")[1]))
+                    except ValueError:
+                        pass
+        return sorted(epochs)
+
+    def cleanup(self, min_epoch: int):
         protocol.cleanup_checkpoints(
-            self.storage, self.paths, min_epoch, sorted(set(known))
+            self.storage, self.paths, min_epoch, self._known_epochs()
         )

@@ -231,3 +231,73 @@ def test_backend_manifest_roundtrip(tmp_storage):
         {"subtask": 0, "tables": {"t": {"kind": "global", "path": "x"}}}
     ]
     assert b2.restore_watermark("2-0") == 123
+
+
+def test_compaction_cadence_and_gc(tmp_storage):
+    """Controller-driven compaction: once an operator carries
+    compaction_epoch_threshold small files, compact_epoch merges them, the
+    table swaps references (LoadCompacted), restore reads the compacted
+    file, and epochs nothing references anymore are GC'd."""
+    from arroyo_tpu.operators.control import CheckpointCompletedResp
+    from arroyo_tpu.state.table_manager import TableManager
+    from arroyo_tpu.types import TaskInfo
+
+    url = f"{tmp_storage}/c"
+
+    def batch(v):
+        return pa.RecordBatch.from_arrays(
+            [pa.array([v]),
+             pa.array([v * MS]).cast(pa.timestamp("ns"))],
+            names=["v", "_timestamp"],
+        )
+
+    async def run():
+        b = StateBackend(url, "cj").initialize()
+        ti = TaskInfo("cj", 5, "op", 0, 1)
+        tm = TableManager(b, ti, 0)
+        await tm.open({"tk": time_key_table("tk")})
+        table = await tm.get_table("tk")
+        all_swaps = []
+        for epoch in range(1, 9):
+            table.insert(batch(epoch))
+            meta = await tm.checkpoint(epoch, None)
+            resp = CheckpointCompletedResp(
+                "5-0", 5, 0, epoch, subtask_metadata={"op0": meta},
+                watermark=None,
+            )
+            manifest = b.publish_checkpoint(epoch, {"5-0": resp})
+            swaps = b.compact_epoch(epoch, manifest)
+            for s in swaps:
+                assert (s["node_id"], s["op_idx"], s["table"]) == (5, 0, "tk")
+                await tm.load_compacted(s["table"], s["files"])
+            all_swaps.extend(swaps)
+            b.retire_unreferenced()
+        return all_swaps, table
+
+    with update(pipeline={"checkpointing": {
+            "compaction_enabled": True, "compaction_epoch_threshold": 4}}):
+        swaps, table = asyncio.run(run())
+        # threshold 4 -> merge at epoch 4 (4 small files) and a re-merge at
+        # epoch 7 ([compacted4, f5, f6, f7])
+        assert [s["files"][0]["rows"] for s in swaps] == [4, 7]
+        assert all("/compacted/" in s["files"][0]["path"] for s in swaps)
+        assert len(table.files) == 2  # [compacted7, epoch-8 file]
+        s = StorageProvider(url)
+        # epochs 1-7 unreferenced by the latest manifest and GC'd
+        dirs = {k.split("/")[2] for k in s.list("cj/checkpoints")}
+        assert dirs == {"checkpoint-0000008"}
+        # the epoch-4 merge was superseded by the epoch-7 re-merge and GC'd
+        compacted = s.list("cj/compacted")
+        assert len(compacted) == 1 and "epoch0000007" in compacted[0]
+
+        async def restore():
+            b2 = StateBackend(url, "cj").initialize()
+            assert b2.restore_epoch == 8
+            tm2 = TableManager(b2, TaskInfo("cj", 5, "op", 0, 1), 0)
+            await tm2.open({"tk": time_key_table("tk")})
+            t2 = await tm2.get_table("tk")
+            return sorted(
+                v for bt in t2.all_batches() for v in bt.column(0).to_pylist()
+            )
+
+        assert asyncio.run(restore()) == [1, 2, 3, 4, 5, 6, 7, 8]

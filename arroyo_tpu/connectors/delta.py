@@ -1,0 +1,247 @@
+"""Delta Lake sink: rolling parquet files + a hand-maintained transaction
+log.
+
+Capability parity with the reference's delta support inside the filesystem
+connector (/root/reference/crates/arroyo-connectors/src/filesystem/sink/
+delta.rs): data lands as parquet through the filesystem sink's two-phase
+commit, and every durable commit appends a `_delta_log/<version>.json`
+entry with `add` actions, so any Delta reader (Spark, DuckDB, deltalake)
+sees an atomic, exactly-once table. The log protocol is written directly
+(protocol 1/2, metaData on version 0, add actions with stats) — no
+deltalake library dependency.
+
+Crash safety: file visibility is governed by the parent's 2PC (rename on
+commit, re-finalized from checkpointed state after a crash). The log append
+happens after the rename; if a crash lands between them, `on_start`
+reconciles by appending a recovery version for visible parquet files the
+log doesn't know yet (re-adding the same path is idempotent in Delta —
+it replaces the file's metadata, no data duplication).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+import uuid
+
+import pyarrow as pa
+
+from .base import ConnectionSchema, Connector, register_connector
+from .filesystem import FileSystemSink
+
+LOG_DIR = "_delta_log"
+
+
+def _delta_type(t: pa.DataType):
+    """Arrow -> Delta (Spark SQL) type mapping for schemaString."""
+    if pa.types.is_boolean(t):
+        return "boolean"
+    if pa.types.is_int8(t):
+        return "byte"
+    if pa.types.is_int16(t):
+        return "short"
+    if pa.types.is_int32(t):
+        return "integer"
+    if pa.types.is_integer(t):  # int64 + unsigned widths
+        return "long"
+    if pa.types.is_float32(t):
+        return "float"
+    if pa.types.is_floating(t):
+        return "double"
+    if pa.types.is_timestamp(t):
+        return "timestamp"
+    if pa.types.is_date(t):
+        return "date"
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return "binary"
+    if pa.types.is_decimal(t):
+        return f"decimal({t.precision},{t.scale})"
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return {
+            "type": "array",
+            "elementType": _delta_type(t.value_type),
+            "containsNull": True,
+        }
+    if pa.types.is_struct(t):
+        return _delta_struct(t)
+    return "string"
+
+
+def _delta_struct(t) -> dict:
+    return {
+        "type": "struct",
+        "fields": [
+            {
+                "name": f.name,
+                "type": _delta_type(f.type),
+                "nullable": bool(f.nullable),
+                "metadata": {},
+            }
+            for f in t
+        ],
+    }
+
+
+def schema_string(schema: pa.Schema) -> str:
+    """Delta metaData.schemaString for an arrow schema."""
+    return json.dumps(_delta_struct(schema))
+
+
+class DeltaSink(FileSystemSink):
+    """Filesystem parquet sink that also maintains the Delta log."""
+
+    def __init__(self, path: str, rollover_rows: int = 100_000):
+        super().__init__(path, "parquet", rollover_rows)
+        self._arrow_schema: Optional[pa.Schema] = None
+        self._table_id = str(uuid.uuid4())
+
+    # -- log plumbing -------------------------------------------------------
+
+    def _log_dir(self) -> str:
+        return os.path.join(self.path, LOG_DIR)
+
+    def _log_versions(self) -> List[int]:
+        d = self._log_dir()
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for n in os.listdir(d):
+            if n.endswith(".json"):
+                try:
+                    out.append(int(n[: -len(".json")]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _logged_paths(self) -> set:
+        """File names already recorded by an add action in any version."""
+        seen = set()
+        d = self._log_dir()
+        for v in self._log_versions():
+            with open(os.path.join(d, f"{v:020d}.json")) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    action = json.loads(line)
+                    if "add" in action:
+                        seen.add(action["add"]["path"])
+        return seen
+
+    def _append_log(self, adds: List[dict]):
+        """CAS-append the next log version (O_EXCL create). Retries the
+        version number on a concurrent writer; actions carry only this
+        subtask's files so retried versions stay disjoint."""
+        if not adds:
+            return
+        os.makedirs(self._log_dir(), exist_ok=True)
+        versions = self._log_versions()
+        next_v = (versions[-1] + 1) if versions else 0
+        while True:
+            actions = []
+            if next_v == 0:
+                # version 0 carries the table's protocol + metadata; a CAS
+                # retry at a later version must NOT repeat them
+                actions.append({
+                    "protocol": {"minReaderVersion": 1,
+                                 "minWriterVersion": 2}
+                })
+                actions.append({
+                    "metaData": {
+                        "id": self._table_id,
+                        "format": {"provider": "parquet", "options": {}},
+                        "schemaString": schema_string(self._arrow_schema),
+                        "partitionColumns": [],
+                        "configuration": {},
+                        "createdTime": int(time.time() * 1000),
+                    },
+                })
+            actions.extend({"add": a} for a in adds)
+            payload = "\n".join(json.dumps(a) for a in actions) + "\n"
+            target = os.path.join(self._log_dir(), f"{next_v:020d}.json")
+            try:
+                with open(target, "x") as f:
+                    f.write(payload)
+                return
+            except FileExistsError:
+                next_v += 1
+
+    def _add_action(self, fpath: str) -> dict:
+        st = os.stat(fpath)
+        action = {
+            "path": os.path.relpath(fpath, self.path),
+            "size": st.st_size,
+            "modificationTime": int(st.st_mtime * 1000),
+            "dataChange": True,
+            "partitionValues": {},
+        }
+        try:
+            import pyarrow.parquet as pq
+
+            action["stats"] = json.dumps(
+                {"numRecords": pq.read_metadata(fpath).num_rows}
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        return action
+
+    # -- sink hooks ---------------------------------------------------------
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        if self._arrow_schema is None:
+            self._arrow_schema = batch.schema
+        await super().process_batch(batch, ctx, collector, input_index)
+
+    async def on_start(self, ctx):
+        await super().on_start(ctx)  # re-finalizes committed .tmp files
+        # crash between rename and log append: visible parquet files not in
+        # the log get a recovery version (idempotent re-add by path)
+        if not os.path.isdir(self.path):
+            return
+        logged = self._logged_paths()
+        orphans = [
+            os.path.join(self.path, n)
+            for n in sorted(os.listdir(self.path))
+            if n.endswith(".parquet") and n not in logged
+        ]
+        if orphans:
+            if self._arrow_schema is None:
+                import pyarrow.parquet as pq
+
+                self._arrow_schema = pq.read_schema(orphans[0])
+            self._append_log([self._add_action(f) for f in orphans])
+
+    async def _committed(self, files: List[str], ctx):
+        self._append_log(
+            [self._add_action(f) for f in files if os.path.exists(f)]
+        )
+
+
+@register_connector
+class DeltaConnector(Connector):
+    name = "delta"
+    description = "Delta Lake table sink (parquet + transaction log)"
+    source = False
+    sink = True
+    config_schema = {
+        "path": {"type": "string", "required": True},
+        "rollover_rows": {"type": "integer"},
+    }
+
+    def validate_options(self, options, schema):
+        if "path" not in options:
+            raise ValueError("delta requires a path option")
+        out = {"path": options["path"]}
+        if "rollover_rows" in options:
+            out["rollover_rows"] = int(options["rollover_rows"])
+        return out
+
+    def make_sink(self, config, schema: ConnectionSchema):
+        return DeltaSink(
+            config["path"], config.get("rollover_rows", 100_000)
+        )
+
+    def make_source(self, config, schema: ConnectionSchema):
+        raise ValueError("delta is sink-only; use the filesystem source")

@@ -212,18 +212,31 @@ class FileSystemSink(Operator):
                 sealed = json.loads(bytes.fromhex(payload["__hex__"]))
             else:
                 sealed = []
-        for tmp in sealed:
+        finalized = self._finalize(sealed)
+        await self._committed(finalized, ctx)
+        return finalized
+
+    @staticmethod
+    def _finalize(tmps: List[str]) -> List[str]:
+        """Rename committed .tmp files visible; returns the final paths."""
+        out = []
+        for tmp in tmps:
             if os.path.exists(tmp):
                 os.replace(tmp, tmp[: -len(".tmp")])
+                out.append(tmp[: -len(".tmp")])
+        return out
+
+    async def _committed(self, files: List[str], ctx):
+        """Hook: files became visible under a durable commit (DeltaSink
+        appends them to the transaction log)."""
 
     async def on_close(self, ctx, collector, is_eod: bool):
         # EOD without a final checkpoint: finalize remaining data directly
         if is_eod:
             self._roll(ctx)
-            for tmp in self._pending_tmp:
-                if os.path.exists(tmp):
-                    os.replace(tmp, tmp[: -len(".tmp")])
+            finalized = self._finalize(self._pending_tmp)
             self._pending_tmp = []
+            await self._committed(finalized, ctx)
             for epoch in list(self._committing):
                 await self.handle_commit(epoch, {}, ctx)
         return None

@@ -284,3 +284,93 @@ def test_connector_registry_metadata():
     for c in connectors():
         md = c.metadata()
         assert md["id"] and isinstance(md["config_schema"], dict)
+
+
+def test_delta_sink(tmp_path):
+    """Delta log written on commit: protocol + metaData at version 0, add
+    actions matching the visible parquet files, stats row counts exact."""
+    out_dir = tmp_path / "delta_out"
+    plan = plan_query(
+        f"""
+        CREATE TABLE impulse WITH (
+          connector = 'impulse', event_rate = '1000000',
+          message_count = '1000', start_time = '0'
+        );
+        CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+          connector = 'delta', path = '{out_dir}',
+          rollover_rows = '400', type = 'sink'
+        );
+        INSERT INTO out SELECT counter FROM impulse;
+        """
+    )
+    run_plan(plan)
+    import pyarrow.parquet as pq
+
+    log_dir = out_dir / "_delta_log"
+    versions = sorted(log_dir.glob("*.json"))
+    assert versions, "no delta log written"
+    actions = []
+    for v in versions:
+        with open(v) as f:
+            actions.extend(json.loads(l) for l in f if l.strip())
+    protos = [a for a in actions if "protocol" in a]
+    metas = [a for a in actions if "metaData" in a]
+    adds = [a["add"] for a in actions if "add" in a]
+    assert len(protos) == 1 and protos[0]["protocol"]["minReaderVersion"] == 1
+    assert len(metas) == 1
+    schema = json.loads(metas[0]["metaData"]["schemaString"])
+    assert {f["name"] for f in schema["fields"]} == {"counter", "_timestamp"}
+    assert {f["name"]: f["type"] for f in schema["fields"]}["counter"] == "long"
+    # every visible parquet file is added exactly once; stats are exact
+    files = {f for f in os.listdir(out_dir) if f.endswith(".parquet")}
+    assert {a["path"] for a in adds} == files and len(adds) == len(files)
+    assert sum(json.loads(a["stats"])["numRecords"] for a in adds) == 1000
+    assert sum(pq.read_table(out_dir / f).num_rows for f in files) == 1000
+    assert not [f for f in os.listdir(out_dir) if f.endswith(".tmp")]
+
+
+def test_delta_sink_exactly_once_across_restart(tmp_path):
+    """Stop-with-checkpoint mid-stream, restart from the checkpoint: the
+    table nets exactly one add per file and no duplicated rows."""
+    out_dir = tmp_path / "delta_ft"
+    url = str(tmp_path / "ck")
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '20000',
+      message_count = '4000', start_time = '0', realtime = 'true'
+    );
+    CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+      connector = 'delta', path = '{out_dir}',
+      rollover_rows = '500', type = 'sink'
+    );
+    INSERT INTO out SELECT counter FROM impulse;
+    """
+
+    async def phase1():
+        plan = plan_query(sql)
+        eng = Engine(plan.graph, job_id="dft", storage_url=url).start()
+        await asyncio.sleep(0.08)
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(60)
+
+    asyncio.run(phase1())
+
+    async def phase2():
+        plan = plan_query(sql)
+        eng = Engine(plan.graph, job_id="dft", storage_url=url).start()
+        await eng.join(60)
+
+    asyncio.run(phase2())
+    import pyarrow.parquet as pq
+
+    actions = []
+    for v in sorted((out_dir / "_delta_log").glob("*.json")):
+        with open(v) as f:
+            actions.extend(json.loads(l) for l in f if l.strip())
+    adds = [a["add"] for a in actions if "add" in a]
+    files = {f for f in os.listdir(out_dir) if f.endswith(".parquet")}
+    assert {a["path"] for a in adds} == files
+    counters = []
+    for f in files:
+        counters.extend(pq.read_table(out_dir / f).column("counter").to_pylist())
+    assert sorted(counters) == list(range(4000))

@@ -283,14 +283,17 @@ class ControllerServer:
             if job.failure is not None:
                 job.transition(JobState.RECOVERING)
                 return
-            if self._heartbeat_expired(job):
-                job.failure = "worker heartbeat timeout"
-                job.transition(JobState.RECOVERING)
-                return
+            # finished-check MUST precede heartbeat expiry: a cleanly
+            # finished worker stops heartbeating, and treating that as a
+            # timeout would recover (and re-finish, and re-recover) forever
             if len(job.finished_tasks) >= job.n_subtasks:
                 job.transition(JobState.FINISHING)
                 job.transition(JobState.FINISHED)
                 await self.scheduler.stop_workers(job.job_id)
+                return
+            if self._heartbeat_expired(job):
+                job.failure = "worker heartbeat timeout"
+                job.transition(JobState.RECOVERING)
                 return
             if job.stop_requested:
                 mode = job.stop_requested
@@ -331,6 +334,12 @@ class ControllerServer:
         while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
             if job.failure is not None or time.monotonic() > deadline:
                 logger.warning("checkpoint %d incomplete", epoch)
+                return
+            if len(job.finished_tasks) >= job.n_subtasks:
+                # the job completed while the barrier was in flight; a
+                # finished task can never report, so stop waiting and let
+                # _run see the finish
+                logger.info("checkpoint %d abandoned: job finished", epoch)
                 return
             await asyncio.sleep(0.02)
         reports = job.checkpoints[epoch]

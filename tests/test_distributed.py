@@ -196,3 +196,35 @@ def test_multiprocess_cluster(tmp_path):
     assert "job finished" in out.stdout, out.stdout + out.stderr
     counts = read_counts(tmp_path / "out.json")
     assert counts == {k: 500 for k in range(8)}
+
+
+def test_finish_racing_inflight_checkpoint(tmp_path):
+    """A checkpoint issued just before the stream ends can never complete
+    (finished tasks don't report); the controller must see the finish and
+    NOT misread the cleanly-stopped worker's silence as a heartbeat
+    timeout (regression: endless recover/re-finish loop)."""
+
+    async def go():
+        from arroyo_tpu.config import update
+
+        c = await ControllerServer(EmbeddedScheduler()).start()
+        # heartbeat_timeout must exceed the worker's 2s heartbeat period or
+        # the timeout itself fires spuriously mid-run
+        with update(pipeline={"checkpointing": {"interval": 0.01}},
+                    controller={"heartbeat_timeout": 5.0}):
+            await c.submit_job(
+                "d5", sql=sql_pipeline(tmp_path, n=20000),
+                storage_url=str(tmp_path / "ck"), n_workers=1,
+            )
+            state = await c.wait_for_state(
+                "d5", JobState.FINISHED, JobState.FAILED, timeout=30
+            )
+        job = c.jobs["d5"]
+        await c.stop()
+        return state, job.restarts
+
+    state, restarts = asyncio.run(go())
+    assert state == JobState.FINISHED
+    assert restarts == 0
+    counts = read_counts(tmp_path / "out.json")
+    assert sum(counts.values()) == 20000

@@ -125,8 +125,25 @@ class LoggingConfig:
 
 
 @dataclasses.dataclass
+class TlsConfig:
+    """TLS for the control plane (gRPC) and data plane (Arrow-IPC TCP)
+    (reference: arroyo-server-common tls; config.rs tls sections). All of
+    cert/key/ca are required when enabled: the cluster authenticates both
+    directions against the explicit `ca` bundle (mutual TLS), never system
+    roots. Certs must carry the DNS SAN `server_name` — connections
+    address workers by IP, so hostname verification pins this name."""
+
+    enabled: bool = False
+    cert: str = ""  # PEM server/client certificate chain path
+    key: str = ""  # PEM private key path
+    ca: str = ""  # PEM CA bundle path (trust root; mTLS when set)
+    server_name: str = "arroyo-tpu"
+
+
+@dataclasses.dataclass
 class Config:
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)

@@ -121,8 +121,10 @@ class DataPlaneServer:
         self.routes[quad] = queue
 
     async def start(self) -> int:
+        from ..utils.tls import data_server_context
+
         self._server = await asyncio.start_server(
-            self._handle, self.bind, self.port
+            self._handle, self.bind, self.port, ssl=data_server_context()
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
@@ -169,8 +171,14 @@ class RemoteEdgeSender:
         self.writer: Optional[asyncio.StreamWriter] = None
 
     async def start(self):
+        from ..utils.tls import data_client_context
+
         host, port = self.address.rsplit(":", 1)
-        _, self.writer = await asyncio.open_connection(host, int(port))
+        ctx, server_name = data_client_context()
+        _, self.writer = await asyncio.open_connection(
+            host, int(port), ssl=ctx,
+            server_hostname=server_name if ctx is not None else None,
+        )
         self.task = asyncio.ensure_future(self._pump())
 
     async def _pump(self):

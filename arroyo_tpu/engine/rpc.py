@@ -67,7 +67,17 @@ class RpcServer:
         )
 
     async def start(self) -> int:
-        self.port = self.server.add_insecure_port(f"{self.bind}:{self.port}")
+        from ..utils.tls import grpc_server_credentials
+
+        creds = grpc_server_credentials()
+        if creds is not None:
+            self.port = self.server.add_secure_port(
+                f"{self.bind}:{self.port}", creds
+            )
+        else:
+            self.port = self.server.add_insecure_port(
+                f"{self.bind}:{self.port}"
+            )
         await self.server.start()
         return self.port
 
@@ -77,8 +87,16 @@ class RpcServer:
 
 class RpcClient:
     def __init__(self, address: str):
+        from ..utils.tls import grpc_channel_credentials
+
         self.address = address
-        self.channel = grpc.aio.insecure_channel(address)
+        creds, options = grpc_channel_credentials()
+        if creds is not None:
+            self.channel = grpc.aio.secure_channel(
+                address, creds, options=options
+            )
+        else:
+            self.channel = grpc.aio.insecure_channel(address)
         _KEEPALIVE.append(self.channel)
 
     async def call(self, service: str, method: str, message: dict,

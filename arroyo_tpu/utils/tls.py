@@ -1,0 +1,108 @@
+"""TLS material for the control plane (gRPC) and data plane (TCP).
+
+Capability parity with the reference's TLS support
+(/root/reference/crates/arroyo-server-common/src/lib.rs tls +
+config.rs TlsConfig): one config section supplies cert/key/ca for both
+transports. An explicit `ca` trust root is REQUIRED when TLS is enabled —
+cluster planes authenticate against it (mutual TLS: servers also require
+client certificates signed by it), never against system roots, so both
+planes behave identically and there is no encrypted-but-unauthenticated
+mode. Connections dial workers by IP, so hostname verification pins the
+configured `server_name` DNS SAN.
+"""
+
+from __future__ import annotations
+
+import ssl
+from functools import lru_cache
+from typing import Optional, Tuple
+
+from ..config import config
+
+
+def _settings() -> Optional[tuple]:
+    """Validated (cert, key, ca, server_name) from config, or None when
+    TLS is off. Hashable so per-connection callers hit the context cache."""
+    t = config().tls
+    if not t.enabled:
+        return None
+    if not (t.cert and t.key and t.ca):
+        raise ValueError(
+            "tls.enabled requires tls.cert, tls.key and tls.ca — cluster "
+            "planes authenticate against the explicit CA bundle (no "
+            "system-trust mode)"
+        )
+    return (t.cert, t.key, t.ca, t.server_name)
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def grpc_server_credentials():
+    """grpc.ssl_server_credentials from config, or None when TLS is off."""
+    s = _settings()
+    if s is None:
+        return None
+    cert, key, ca, _ = s
+    import grpc
+
+    return grpc.ssl_server_credentials(
+        [(_read(key), _read(cert))],
+        root_certificates=_read(ca),
+        require_client_auth=True,
+    )
+
+
+def grpc_channel_credentials() -> Tuple[Optional[object], list]:
+    """(channel credentials, channel options) for a client, or (None, [])
+    when TLS is off."""
+    s = _settings()
+    if s is None:
+        return None, []
+    cert, key, ca, server_name = s
+    import grpc
+
+    creds = grpc.ssl_channel_credentials(
+        root_certificates=_read(ca),
+        private_key=_read(key),
+        certificate_chain=_read(cert),
+    )
+    return creds, [("grpc.ssl_target_name_override", server_name)]
+
+
+@lru_cache(maxsize=8)
+def _server_context(cert: str, key: str, ca: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    ctx.load_verify_locations(ca)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+@lru_cache(maxsize=8)
+def _client_context(cert: str, key: str, ca: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(ca)
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def data_server_context() -> Optional[ssl.SSLContext]:
+    s = _settings()
+    if s is None:
+        return None
+    cert, key, ca, _ = s
+    return _server_context(cert, key, ca)
+
+
+def data_client_context() -> Tuple[Optional[ssl.SSLContext], Optional[str]]:
+    """(client ssl context, server_hostname) for the data plane. Contexts
+    are cached per (cert, key, ca) so the O(edges x parallelism) senders
+    of a shuffle don't re-read key material per connection."""
+    s = _settings()
+    if s is None:
+        return None, None
+    cert, key, ca, server_name = s
+    return _client_context(cert, key, ca), server_name

@@ -1,11 +1,15 @@
 #!/usr/bin/env python
-"""Benchmark: Nexmark q5 events/sec through the full engine.
+"""Benchmark: Nexmark q1/q5/q7/q8 events/sec through the full engine.
 
-Runs the headline query (hop-window COUNT per auction joined with the
-per-window MAX — the reference's CI-covered nexmark_q5.sql shape) twice:
+The headline metric is q5 (hop-window COUNT per auction joined with the
+per-window MAX — the reference's CI-covered nexmark_q5.sql shape), run
+twice:
   * CPU baseline: window aggregation on the numpy host backend
   * device path:  window aggregation on the JAX backend (TPU when present)
-and prints ONE json line {"metric", "value", "unit", "vs_baseline"}.
+q1 (stateless currency projection), q7 (per-window highest bid join) and
+q8 (person x auction same-window join) run once on the device path and
+ride along as extra fields in the SAME single json line
+{"metric", "value", "unit", "vs_baseline", "q1_eps", "q7_eps", "q8_eps"}.
 
 Each measurement runs in a subprocess so a wedged accelerator tunnel can
 never hang the bench; on device-path failure the CPU number is reported
@@ -18,13 +22,16 @@ import os
 import subprocess
 import sys
 
-Q5 = """
+DDL = """
 CREATE TABLE nexmark WITH (
   connector = 'nexmark',
   event_rate = '{rate}',
   message_count = '{events}',
   start_time = '0'
 );
+"""
+
+Q5 = DDL + """
 SELECT AuctionBids.auction, AuctionBids.num
 FROM (
   SELECT bid.auction as auction, count(*) AS num,
@@ -46,9 +53,44 @@ ON AuctionBids.window = MaxBids.window
    AND AuctionBids.num >= MaxBids.maxn;
 """
 
+Q1 = DDL + """
+CREATE TABLE sink (
+  auction BIGINT, price_eur BIGINT, bidder BIGINT
+) WITH (connector = 'blackhole', type = 'sink');
+INSERT INTO sink
+SELECT bid.auction as auction, bid.price * 100 / 121 as price_eur,
+       bid.bidder as bidder
+FROM nexmark WHERE bid IS NOT NULL;
+"""
 
-def child(events: int, backend: str) -> None:
-    """Run q5 once; print 'RESULT <events/sec> <rows>'."""
+Q7 = DDL + """
+SELECT W.auction, W.price, W.bidder FROM (
+  SELECT bid.auction as auction, bid.price as price, bid.bidder as bidder,
+         tumble(interval '10 second') as w, count(*) as c
+  FROM nexmark WHERE bid IS NOT NULL GROUP BY 1, 2, 3, w
+) AS W JOIN (
+  SELECT max(bid.price) as maxprice, tumble(interval '10 second') as w
+  FROM nexmark WHERE bid IS NOT NULL GROUP BY w
+) AS M ON W.w = M.w AND W.price = M.maxprice;
+"""
+
+Q8 = DDL + """
+SELECT P.id, P.name FROM (
+  SELECT person.id as id, person.name as name,
+         tumble(interval '10 second') as w, count(*) as c
+  FROM nexmark WHERE person IS NOT NULL GROUP BY 1, 2, w
+) AS P JOIN (
+  SELECT auction.seller as seller, tumble(interval '10 second') as w,
+         count(*) as c2
+  FROM nexmark WHERE auction IS NOT NULL GROUP BY 1, w
+) AS A ON P.id = A.seller AND P.w = A.w;
+"""
+
+QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8}
+
+
+def child(events: int, backend: str, query: str = "q5") -> None:
+    """Run one nexmark query; print 'RESULT <events/sec> <rows>'."""
     import asyncio
     import time
 
@@ -63,7 +105,8 @@ def child(events: int, backend: str) -> None:
     rate = max(events // 60, 1)
     results = []
     plan = plan_query(
-        Q5.format(rate=rate, events=events), preview_results=results
+        QUERIES[query].format(rate=rate, events=events),
+        preview_results=results,
     )
     for node in plan.graph.nodes.values():
         for op in node.chain:
@@ -80,9 +123,10 @@ def child(events: int, backend: str) -> None:
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
-def run_child(events: int, backend: str, timeout: float, env=None):
+def run_child(events: int, backend: str, timeout: float, env=None,
+              query: str = "q5"):
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
-           "--events", str(events)]
+           "--events", str(events), "--query", query]
     try:
         out = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout, env=env
@@ -102,10 +146,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=1_000_000)
     ap.add_argument("--child", choices=["numpy", "jax"])
+    ap.add_argument("--query", choices=sorted(QUERIES), default="q5")
     ap.add_argument("--timeout", type=float, default=420.0)
     args = ap.parse_args()
     if args.child:
-        child(args.events, args.child)
+        child(args.events, args.child, args.query)
         return
 
     cpu_env = dict(os.environ)
@@ -119,6 +164,15 @@ def main():
             "error": "both paths failed",
         }))
         return
+    side_env = cpu_env if device is None else None
+    side_backend = "numpy" if device is None else "jax"
+    sides = {}
+    for q in ("q1", "q7", "q8"):
+        # half the events: side metrics, not the headline measurement
+        r = run_child(args.events // 2, side_backend, args.timeout,
+                      env=side_env, query=q)
+        # 0 = that query failed/timed out (distinguishable from "not run")
+        sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
     if device is None:
         device = baseline
     if baseline is None:
@@ -131,6 +185,7 @@ def main():
         "baseline_cpu_eps": round(baseline["eps"], 1),
         "events": args.events,
         "result_rows": device["rows"],
+        **sides,
     }))
 
 

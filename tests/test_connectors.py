@@ -374,3 +374,19 @@ def test_delta_sink_exactly_once_across_restart(tmp_path):
     for f in files:
         counters.extend(pq.read_table(out_dir / f).column("counter").to_pylist())
     assert sorted(counters) == list(range(4000))
+
+
+def test_nexmark_q7_q8():
+    """Canonical Nexmark q7 (per-window highest bid) and q8 (person x
+    auction same-window join) plan and produce deterministic results on
+    the counter-based generator."""
+    from bench import QUERIES
+
+    for name, want in [("q7", 1), ("q8", 222)]:
+        res = []
+        plan = plan_query(
+            QUERIES[name].format(rate=5000, events=20000),
+            preview_results=res,
+        )
+        run_plan(plan, timeout=120)
+        assert len(res) == want, (name, len(res))

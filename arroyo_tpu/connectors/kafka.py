@@ -41,7 +41,8 @@ def _load_client():
 class KafkaSource(SourceOperator):
     def __init__(self, bootstrap: str, topic: str, group_id: Optional[str],
                  offset_mode: str, client_configs: Dict[str, str],
-                 schema, format: str, bad_data: str, framing: Optional[str]):
+                 schema, format: str, bad_data: str, framing: Optional[str],
+                 proto_descriptor: Optional[dict] = None):
         super().__init__("kafka_source")
         self.bootstrap = bootstrap
         self.topic = topic
@@ -52,6 +53,7 @@ class KafkaSource(SourceOperator):
         self.format = format
         self.bad_data = bad_data
         self.framing = framing
+        self.proto_descriptor = proto_descriptor
         # partition -> next offset (checkpointed)
         self.offsets: Dict[int, int] = {}
 
@@ -78,7 +80,8 @@ class KafkaSource(SourceOperator):
     async def run(self, ctx, collector) -> SourceFinishType:
         kafka = _load_client()
         deser = Deserializer(self.out_schema, format=self.format or "json",
-                             bad_data=self.bad_data, framing=self.framing)
+                             bad_data=self.bad_data, framing=self.framing,
+                             proto_descriptor=self.proto_descriptor)
         consumer = kafka.Consumer(
             {
                 "bootstrap.servers": self.bootstrap,
@@ -135,13 +138,15 @@ class KafkaSource(SourceOperator):
 class KafkaSink(Operator):
     def __init__(self, bootstrap: str, topic: str, semantics: str,
                  client_configs: Dict[str, str], format: str,
-                 key_field: Optional[str]):
+                 key_field: Optional[str],
+                 proto_descriptor: Optional[dict] = None):
         super().__init__("kafka_sink")
         self.bootstrap = bootstrap
         self.topic = topic
         self.semantics = semantics  # exactly_once | at_least_once
         self.client_configs = client_configs
-        self.serializer = Serializer(format=format or "json")
+        self.serializer = Serializer(format=format or "json",
+                                     proto_descriptor=proto_descriptor)
         self.key_field = key_field
         self.producer = None
         self.epoch = 0
@@ -251,6 +256,7 @@ class KafkaConnector(Connector):
             config.get("client_configs", {}), config.get("schema"),
             config.get("format"), config.get("bad_data", "fail"),
             config.get("framing"),
+            proto_descriptor=config.get("proto_descriptor"),
         )
 
     def make_sink(self, config, schema: ConnectionSchema):
@@ -259,6 +265,7 @@ class KafkaConnector(Connector):
             config.get("semantics", "at_least_once"),
             config.get("client_configs", {}), config.get("format"),
             config.get("key_field"),
+            proto_descriptor=config.get("proto_descriptor"),
         )
 
     def test(self, config):

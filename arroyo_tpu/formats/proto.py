@@ -1,9 +1,10 @@
-"""Protobuf decoding via dynamic messages.
+"""Protobuf decoding/encoding via dynamic messages.
 
 Capability parity with the reference's prost-reflect path
-(/root/reference/crates/arroyo-formats/src/proto/*): a compiled
-FileDescriptorSet (bytes of `protoc --descriptor_set_out`) + message name
-produce a dynamic decoder; fields map to columns by name.
+(/root/reference/crates/arroyo-formats/src/proto/* for decode and
+ser.rs protobuf encode): a compiled FileDescriptorSet (bytes of
+`protoc --descriptor_set_out`) + message name produce a dynamic message
+class; fields map to columns by name in both directions.
 """
 
 from __future__ import annotations
@@ -11,30 +12,116 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 
+def message_class(descriptor: Optional[dict]):
+    """Dynamic message class from {'descriptor_set': bytes,
+    'message_name': str} (shared by decoder and encoder)."""
+    if not descriptor or "descriptor_set" not in descriptor:
+        raise ValueError(
+            "protobuf format requires protobuf.descriptor_set (bytes of a "
+            "compiled FileDescriptorSet) and protobuf.message_name"
+        )
+    from google.protobuf import (
+        descriptor_pb2,
+        descriptor_pool,
+        message_factory,
+    )
+
+    fds = descriptor_pb2.FileDescriptorSet()
+    ds = descriptor["descriptor_set"]
+    if isinstance(ds, str):
+        ds = bytes.fromhex(ds)
+    fds.ParseFromString(ds)
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    desc = pool.FindMessageTypeByName(descriptor["message_name"])
+    return message_factory.GetMessageClass(desc)
+
+
+def _is_repeated(field) -> bool:
+    if hasattr(field, "is_repeated"):
+        return field.is_repeated
+    return field.label == field.LABEL_REPEATED
+
+
+def _msg_to_dict(msg) -> Dict[str, Any]:
+    """Structured decode: nested/repeated messages become dicts/lists so a
+    proto source piped to a proto sink round-trips losslessly."""
+    out: Dict[str, Any] = {}
+    for field in msg.DESCRIPTOR.fields:
+        v = getattr(msg, field.name)
+        if _is_repeated(field):
+            if field.type == field.TYPE_MESSAGE:
+                out[field.name] = [_msg_to_dict(m) for m in v]
+            else:
+                out[field.name] = list(v)
+        elif field.type == field.TYPE_MESSAGE:
+            out[field.name] = _msg_to_dict(v)
+        else:
+            out[field.name] = v
+    return out
+
+
 class ProtoDecoder:
     def __init__(self, descriptor: Optional[dict]):
-        if not descriptor or "descriptor_set" not in descriptor:
-            raise ValueError(
-                "protobuf format requires protobuf.descriptor_set (bytes of a "
-                "compiled FileDescriptorSet) and protobuf.message_name"
-            )
-        from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
-
-        fds = descriptor_pb2.FileDescriptorSet()
-        fds.ParseFromString(descriptor["descriptor_set"])
-        pool = descriptor_pool.DescriptorPool()
-        for f in fds.file:
-            pool.Add(f)
-        desc = pool.FindMessageTypeByName(descriptor["message_name"])
-        self.cls = message_factory.GetMessageClass(desc)
+        self.cls = message_class(descriptor)
 
     def decode(self, record: bytes) -> Dict[str, Any]:
         msg = self.cls()
         msg.ParseFromString(record)
-        out = {}
-        for field in msg.DESCRIPTOR.fields:
-            v = getattr(msg, field.name)
-            if field.type == field.TYPE_MESSAGE:
-                v = str(v)
-            out[field.name] = v
-        return out
+        return _msg_to_dict(msg)
+
+
+def _coerce_scalar(field, v):
+    """Column value -> settable proto scalar. Arrow timestamps surface as
+    datetime.datetime; int proto fields get exact epoch nanos."""
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        if field.type == field.TYPE_STRING:
+            return v.isoformat()
+        if v.tzinfo is None:
+            v = v.replace(tzinfo=datetime.timezone.utc)
+        delta = v - datetime.datetime(
+            1970, 1, 1, tzinfo=datetime.timezone.utc
+        )
+        return ((delta.days * 86400 + delta.seconds) * 10**9
+                + delta.microseconds * 1000)
+    if hasattr(v, "value") and not isinstance(
+        v, (int, float, str, bytes, bool)
+    ):
+        return v.value  # pandas Timestamp -> epoch nanos
+    if field.type == field.TYPE_STRING and not isinstance(v, str):
+        return str(v)
+    return v
+
+
+def _fill(msg, row: Dict[str, Any]):
+    for field in msg.DESCRIPTOR.fields:
+        v = row.get(field.name)
+        if v is None:
+            continue
+        if field.type == field.TYPE_MESSAGE:
+            if _is_repeated(field):
+                container = getattr(msg, field.name)
+                for item in v:
+                    if isinstance(item, dict):
+                        _fill(container.add(), item)
+            elif isinstance(v, dict):
+                _fill(getattr(msg, field.name), v)
+        elif _is_repeated(field):
+            getattr(msg, field.name).extend(
+                _coerce_scalar(field, x) for x in v
+            )
+        else:
+            setattr(msg, field.name, _coerce_scalar(field, v))
+
+
+class ProtoEncoder:
+    def __init__(self, descriptor: Optional[dict]):
+        self.cls = message_class(descriptor)
+
+    def encode(self, row: Dict[str, Any]) -> bytes:
+        msg = self.cls()
+        _fill(msg, row)
+        return msg.SerializeToString()

@@ -18,10 +18,16 @@ from ..schema import TIMESTAMP_FIELD, UPDATING_META_FIELD
 
 class Serializer:
     def __init__(self, format: str = "json", include_timestamp: bool = False,
-                 avro_schema: Optional[str] = None):
+                 avro_schema: Optional[str] = None,
+                 proto_descriptor: Optional[dict] = None):
         self.format = format or "json"
         self.include_timestamp = include_timestamp
         self.avro_schema = avro_schema
+        self.proto = None
+        if self.format in ("protobuf", "proto"):
+            from .proto import ProtoEncoder
+
+            self.proto = ProtoEncoder(proto_descriptor)
 
     def serialize(self, batch: pa.RecordBatch) -> Iterator[bytes]:
         if self.format in ("json", "debezium_json"):
@@ -37,9 +43,8 @@ class Serializer:
             for row in self._rows(batch):
                 yield enc.encode(row)
         elif self.format in ("protobuf", "proto"):
-            raise NotImplementedError(
-                "protobuf sink encoding requires a descriptor (see formats/proto)"
-            )
+            for row in self._rows(batch):
+                yield self.proto.encode(row)
         else:
             raise ValueError(f"unknown sink format {self.format!r}")
 

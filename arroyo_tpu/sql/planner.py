@@ -304,6 +304,7 @@ class Planner:
             "format": t.options.get("format"),
             "bad_data": t.options.get("bad_data", "fail"),
             "event_time_field": event_time_field,
+            "proto_descriptor": _proto_descriptor(t),
             **options,
         }
         chain = [ChainedOp(OperatorName.CONNECTOR_SOURCE, config, t.name)]
@@ -1575,6 +1576,7 @@ class Planner:
             "connector": t.connector,
             "schema": rel.schema,
             "format": t.options.get("format"),
+            "proto_descriptor": _proto_descriptor(t),
             **options,
         }
         # sinks default to parallelism 1 (single_file/stdout write one
@@ -1607,6 +1609,35 @@ def _is_aggregate_name(name: str) -> bool:
     from ..udf.registry import get_udaf
 
     return get_udaf(name) is not None
+
+
+def _proto_descriptor(t) -> Optional[dict]:
+    """Load {'descriptor_set', 'message_name'} from the table's
+    proto.descriptor_file / proto.message options when format='protobuf'
+    (reference proto/schema resolution, arroyo-formats/src/proto)."""
+    if t.options.get("format") not in ("protobuf", "proto"):
+        return None
+    path = t.options.get("proto.descriptor_file")
+    msg = t.options.get("proto.message")
+    if not path or not msg:
+        raise SqlError(
+            "format = 'protobuf' requires the proto.descriptor_file "
+            "(compiled FileDescriptorSet from `protoc "
+            "--descriptor_set_out`) and proto.message options"
+        )
+    if t.connector in ("single_file", "filesystem"):
+        raise SqlError(
+            "protobuf is message-framed binary and cannot ride "
+            "newline-framed file connectors; use a message-based "
+            "connector (e.g. kafka)"
+        )
+    if t.connector not in ("kafka", "confluent"):
+        raise SqlError(
+            f"format = 'protobuf' is wired to the kafka/confluent "
+            f"connectors; {t.connector} does not carry a descriptor yet"
+        )
+    with open(path, "rb") as f:
+        return {"descriptor_set": f.read(), "message_name": msg}
 
 
 def _expr_children(e: Expr):

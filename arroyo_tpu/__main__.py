@@ -85,25 +85,44 @@ async def _run(args):
     init_logging()
     sql = _load_sql(args.query)
     plan_query(sql, parallelism=args.parallelism)  # validate before boot
+    # idempotent reuse (reference crates/arroyo run.rs: pipelines are keyed
+    # by query): with a state dir, the job id derives from the query text,
+    # so re-running the same query resumes its own checkpoints and a
+    # different query never collides with stale state
+    if args.state_dir:
+        import hashlib
+
+        job_id = "q" + hashlib.sha256(sql.encode()).hexdigest()[:12]
+        from .state import protocol
+        from .state.storage import StorageProvider
+
+        latest = protocol.resolve_latest(
+            StorageProvider(args.state_dir), protocol.ProtocolPaths(job_id)
+        )
+        if latest:
+            print(f"resuming pipeline {job_id} from epoch "
+                  f"{latest['epoch']}")
+    else:
+        job_id = "job_cli"
     controller = await ControllerServer(
         make_scheduler(args.scheduler)
     ).start()
-    job = await controller.submit_job(
-        "job_cli", sql=sql, storage_url=args.state_dir,
+    await controller.submit_job(
+        job_id, sql=sql, storage_url=args.state_dir,
         n_workers=args.workers, parallelism=args.parallelism,
     )
     try:
         state = await controller.wait_for_state(
-            "job_cli", JobState.FINISHED, JobState.FAILED, JobState.STOPPED,
+            job_id, JobState.FINISHED, JobState.FAILED, JobState.STOPPED,
             timeout=86400,
         )
         print(f"job {state.value.lower()}")
         return 0 if state != JobState.FAILED else 1
     except KeyboardInterrupt:
-        await controller.stop_job("job_cli", "checkpoint"
+        await controller.stop_job(job_id, "checkpoint"
                                   if args.state_dir else "graceful")
         await controller.wait_for_state(
-            "job_cli", JobState.STOPPED, JobState.FAILED, timeout=60
+            job_id, JobState.STOPPED, JobState.FAILED, timeout=60
         )
         return 0
     finally:

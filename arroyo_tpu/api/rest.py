@@ -213,9 +213,54 @@ class ApiServer:
         )
 
     async def operator_metric_groups(self, request: web.Request):
+        """Per-operator metric groups (reference api/src/metrics.rs
+        OperatorMetricGroup): task-labeled counters grouped by logical
+        node, one single-point series per subtask (the UI polls and
+        accumulates). The raw Prometheus text rides along for debugging."""
+        import time as _time
+
         from ..metrics import REGISTRY
 
-        return json_response({"prometheus": REGISTRY.expose()})
+        now = int(_time.time() * 1000)
+        job_id = request.match_info["job_id"]
+        # operator id -> metric name -> subtask index -> value
+        ops: dict = {}
+        for name, entries in REGISTRY.snapshot().items():
+            short = name.removeprefix("arroyo_worker_")
+            for labels, value in entries:
+                task = labels.get("task")
+                if task is None or "-" not in task:
+                    continue
+                if labels.get("job") != job_id:
+                    continue  # counters from other jobs in this process
+                node_id, _, sub = task.rpartition("-")
+                try:
+                    sub_i = int(sub)
+                except ValueError:
+                    continue
+                ops.setdefault(node_id, {}).setdefault(short, {})[
+                    sub_i
+                ] = value
+        data = [
+            {
+                "operatorId": op,
+                "metricGroups": [
+                    {
+                        "name": metric,
+                        "subtasks": [
+                            {"index": i,
+                             "metrics": [{"time": now, "value": v}]}
+                            for i, v in sorted(subs.items())
+                        ],
+                    }
+                    for metric, subs in sorted(groups.items())
+                ],
+            }
+            for op, groups in sorted(ops.items())
+        ]
+        return json_response(
+            {"data": data, "prometheus": REGISTRY.expose()}
+        )
 
     # -- preview ------------------------------------------------------------
 

@@ -197,7 +197,8 @@ class Program:
                     prev_out = op_out
                     ctxs.append(ctx)
                 tail = Collector(
-                    out_senders[(node.node_id, i)], task_info.task_id
+                    out_senders[(node.node_id, i)], task_info.task_id,
+                    job_id=task_info.job_id,
                 )
                 control_rx: asyncio.Queue = asyncio.Queue()
                 runner = SubtaskRunner(

@@ -56,7 +56,12 @@ def _msg_to_dict(msg) -> Dict[str, Any]:
             else:
                 out[field.name] = list(v)
         elif field.type == field.TYPE_MESSAGE:
-            out[field.name] = _msg_to_dict(v)
+            # proto3 message fields have explicit presence: unset -> NULL
+            # (not a struct of zero-defaults), and re-encoding must not
+            # mark the field present
+            out[field.name] = (
+                _msg_to_dict(v) if msg.HasField(field.name) else None
+            )
         else:
             out[field.name] = v
     return out

@@ -87,6 +87,17 @@ class Registry:
             metrics = list(self.metrics.values())
         return "\n".join(m.expose() for m in metrics) + "\n"
 
+    def snapshot(self) -> Dict[str, list]:
+        """{metric name: [(labels dict, value)]} for structured consumers
+        (the API's operator metric groups)."""
+        with self.lock:
+            metrics = list(self.metrics.items())
+        out: Dict[str, list] = {}
+        for name, m in metrics:
+            with m.lock:
+                out[name] = [(dict(k), v) for k, v in m.values.items()]
+        return out
+
     def reset(self):
         with self.lock:
             self.metrics.clear()

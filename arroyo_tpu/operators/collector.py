@@ -65,12 +65,13 @@ class Collector:
     """The tail collector of a subtask: fans output to all out edges and
     maintains tx counters."""
 
-    def __init__(self, edges: List[EdgeSender], task_id: str = ""):
+    def __init__(self, edges: List[EdgeSender], task_id: str = "",
+                 job_id: str = ""):
         self.edges = edges
         self.task_id = task_id
-        self._batch_counter = BATCHES_SENT.labels(task=task_id)
-        self._msg_counter = MESSAGES_SENT.labels(task=task_id)
-        self._bytes_counter = BYTES_SENT.labels(task=task_id)
+        self._batch_counter = BATCHES_SENT.labels(job=job_id, task=task_id)
+        self._msg_counter = MESSAGES_SENT.labels(job=job_id, task=task_id)
+        self._bytes_counter = BYTES_SENT.labels(job=job_id, task=task_id)
         # sink-side hook: engine-level capture of terminal output (preview)
         self.collected: Optional[list] = None
 

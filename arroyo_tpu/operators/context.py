@@ -72,7 +72,8 @@ class ErrorReporter:
     errors: List[str] = dataclasses.field(default_factory=list)
 
     def report(self, message: str, details: str = ""):
-        ERRORS.labels(task=self.task_info.task_id).inc()
+        ERRORS.labels(job=self.task_info.job_id,
+                      task=self.task_info.task_id).inc()
         now = time.monotonic()
         if now - self._window_start > self.interval:
             self._window_start = now

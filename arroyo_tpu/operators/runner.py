@@ -92,9 +92,10 @@ class SubtaskRunner:
         self._current_barrier = None
         self._stopping = False
         tid = self.task_info.task_id
-        self._batches_recv = BATCHES_RECV.labels(task=tid)
-        self._msgs_recv = MESSAGES_RECV.labels(task=tid)
-        self._bytes_recv = BYTES_RECV.labels(task=tid)
+        jid = self.task_info.job_id
+        self._batches_recv = BATCHES_RECV.labels(job=jid, task=tid)
+        self._msgs_recv = MESSAGES_RECV.labels(job=jid, task=tid)
+        self._bytes_recv = BYTES_RECV.labels(job=jid, task=tid)
 
     @property
     def is_source(self) -> bool:

@@ -1636,8 +1636,11 @@ def _proto_descriptor(t) -> Optional[dict]:
             f"format = 'protobuf' is wired to the kafka/confluent "
             f"connectors; {t.connector} does not carry a descriptor yet"
         )
-    with open(path, "rb") as f:
-        return {"descriptor_set": f.read(), "message_name": msg}
+    try:
+        with open(path, "rb") as f:
+            return {"descriptor_set": f.read(), "message_name": msg}
+    except OSError as e:
+        raise SqlError(f"cannot read proto.descriptor_file {path!r}: {e}")
 
 
 def _expr_children(e: Expr):

@@ -243,3 +243,34 @@ def test_openapi_spec():
 
         for ref in refs(spec):
             assert ref.split("/")[-1] in comps, ref
+
+
+def test_operator_metric_groups_structured(tmp_path):
+    @with_client
+    async def _(client, api, controller):
+        # run a short pipeline so task-labeled counters exist
+        resp = await client.post("/api/v1/pipelines", json={
+            "name": "m1", "query": IMPULSE_SQL})
+        assert resp.status == 200
+        pid = (await resp.json())["id"]
+        import asyncio as _a
+
+        for _ in range(100):
+            jobs = await (await client.get("/api/v1/jobs")).json()
+            if any(j["state"] == "Finished" for j in jobs["data"]):
+                break
+            await _a.sleep(0.05)
+        jobs = await (await client.get("/api/v1/jobs")).json()
+        jid = jobs["data"][0]["id"]
+        resp = await client.get(
+            f"/api/v1/jobs/{jid}/operator_metric_groups")
+        body = await resp.json()
+        assert body["data"], "no operator groups"
+        by_metric = {
+            g["name"]: g
+            for op in body["data"] for g in op["metricGroups"]
+        }
+        assert "messages_sent" in by_metric
+        sub = by_metric["messages_sent"]["subtasks"][0]
+        assert sub["index"] == 0 and sub["metrics"][0]["value"] > 0
+        assert "prometheus" in body

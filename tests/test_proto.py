@@ -159,3 +159,6 @@ def test_proto_nested_roundtrip(tmp_path):
     d2 = dec.decode(enc.encode({"name": ts, "one": {"a": ts}}))
     assert d2["one"]["a"] == int(ts.timestamp()) * 10**9 + 456789000
     assert d2["name"] == ts.isoformat()
+    # unset singular message fields decode to NULL, not zero-structs
+    d3 = dec.decode(enc.encode({"name": "y"}))
+    assert d3["one"] is None and d3["many"] == []

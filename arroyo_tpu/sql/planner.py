@@ -543,15 +543,45 @@ class Planner:
                             zip(group_exprs, key_bound)])
         agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
         if any(c.distinct for c in agg_calls):
-            if instant or len(agg_calls) > 1 or window_spec.kind == "session":
+            if instant or window_spec.kind == "session":
                 raise SqlError(
-                    "count(DISTINCT) is supported alone with tumble()/hop() "
+                    "count(DISTINCT) is supported with tumble()/hop() "
                     "windows (two-stage rewrite)"
+                )
+            if sum(c.distinct for c in agg_calls) > 1:
+                raise SqlError(
+                    "one count(DISTINCT) per query is supported"
+                )
+            if len(agg_calls) > 1:
+                # mixed with regular aggregates: distinct branch joined to
+                # the regular-aggregate branch on (window, keys)
+                return self._plan_mixed_distinct(
+                    sel, items, upstream, where, window_spec, window_alias,
+                    group_exprs, key_bound, key_names, agg_calls,
+                    agg_inputs,
                 )
             return self._plan_count_distinct(
                 sel, items, upstream, where, window_spec, window_alias,
                 group_exprs, key_bound, key_names, agg_calls[0],
             )
+        wfield = None if instant else (window_alias or "window")
+        agg_out, agg_out_names = self._windowed_agg_node(
+            upstream, where, window_spec, key_bound, key_names,
+            agg_calls, agg_inputs, wfield, instant,
+        )
+        out, _ = self._agg_post_projection(
+            sel, items, agg_out, key_names, group_exprs, agg_calls,
+            agg_out_names, wfield,
+        )
+        return out
+
+    def _windowed_agg_node(
+        self, upstream, where, window_spec, key_bound, key_names,
+        agg_calls, agg_inputs, wfield: Optional[str], instant: bool,
+    ) -> Tuple[RelOutput, List[str]]:
+        """Pre-projection + window-aggregate node for one aggregate branch
+        (shared by the plain windowed path and the mixed-distinct regular
+        branch). Output schema: [keys..., agg outs..., wfield?]."""
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
         agg_col_idx: List[Optional[int]] = []
@@ -584,10 +614,7 @@ class Planner:
         for spec, call in zip(specs, agg_calls):
             out_fields.append(pa.field(spec["name"], _agg_output_type(
                 spec, call, pre.schema.schema)))
-        if instant:
-            wfield = None
-        else:
-            wfield = window_alias or "window"
+        if not instant:
             out_fields.append(pa.field(wfield, WINDOW_TYPE))
         agg_out_schema = StreamSchema(
             add_timestamp_field(pa.schema(out_fields))
@@ -649,15 +676,23 @@ class Planner:
             window=window_spec if not instant else upstream.window,
             window_field=out_window_field,
         )
+        return agg_out, agg_out_names
 
-        # post-projection: map select items onto agg outputs; having filter
+    def _agg_post_projection(
+        self, sel, items, agg_out, key_names, group_exprs, agg_calls,
+        call_names, wcol: Optional[str],
+    ) -> Tuple[RelOutput, List[str]]:
+        """Select-item/HAVING projection over an aggregate (or joined
+        aggregate) output: aggregate calls map to their output columns,
+        group expressions to key columns, window TVF refs to `wcol`
+        (shared by the windowed, count-distinct and mixed-distinct paths)."""
         post_scope = _agg_post_scope(
-            agg_out, key_names, group_exprs, agg_calls, agg_out_names
+            agg_out, key_names, group_exprs, agg_calls, call_names
         )
         having = (
             bind(
                 _rewrite_group_refs(
-                    _rewrite_aggregates(sel.having, agg_calls, agg_out_names),
+                    _rewrite_aggregates(sel.having, agg_calls, call_names),
                     group_exprs, key_names,
                 ),
                 post_scope,
@@ -668,17 +703,22 @@ class Planner:
         post_exprs: List[BoundExpr] = []
         post_names: List[str] = []
         for it in items:
-            rewritten = _rewrite_aggregates(it.expr, agg_calls, agg_out_names)
+            rewritten = _rewrite_aggregates(it.expr, agg_calls, call_names)
             rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
-            if isinstance(rewritten, FuncCall) and rewritten.name in WINDOW_TVFS:
-                rewritten = Column(wfield)
+            if (
+                isinstance(rewritten, FuncCall)
+                and rewritten.name in WINDOW_TVFS
+                and wcol is not None
+            ):
+                rewritten = Column(wcol)
             e = bind(rewritten, post_scope)
             post_exprs.append(e)
             post_names.append(it.alias or _default_name(it.expr, e))
-        return self._add_value_node(
+        out = self._add_value_node(
             agg_out, post_exprs, _dedup(post_names), having,
             _describe_items(post_names),
         )
+        return out, post_names
 
     def _restore_select_order(
         self, out: RelOutput, items, special_item, out_name: str,
@@ -1077,13 +1117,13 @@ class Planner:
             _describe_items(post_names),
         )
 
-    def _plan_count_distinct(
-        self, sel, items, upstream, where, window_spec, window_alias,
-        group_exprs, key_bound, key_names, call,
-    ) -> RelOutput:
-        """count(DISTINCT x) via two stages (the reference evaluates it
-        inside DataFusion; here: windowed dedup on (keys, x) then an instant
-        count per (window, keys))."""
+    def _count_distinct_core(
+        self, upstream, where, window_spec, key_bound, key_names, call,
+    ) -> Tuple[RelOutput, str]:
+        """Two-stage distinct count (the reference evaluates it inside
+        DataFusion; here: windowed dedup on (keys, x) then an instant count
+        per (window, keys)). Returns (agg_out, count column name); agg_out's
+        schema leads with the join keys [__w, keys...]."""
         x = bind(call.args[0], upstream.scope) if call.args else None
         if x is None:
             raise SqlError("count(DISTINCT *) is not valid")
@@ -1162,35 +1202,104 @@ class Planner:
             s2.node_id, s2_schema, Scope.from_schema(s2_schema.schema),
             window=window_spec, window_field="__w",
         )
-        # post-projection
+        return agg_out, cname
+
+    def _plan_count_distinct(
+        self, sel, items, upstream, where, window_spec, window_alias,
+        group_exprs, key_bound, key_names, call,
+    ) -> RelOutput:
+        agg_out, cname = self._count_distinct_core(
+            upstream, where, window_spec, key_bound, key_names, call
+        )
         wfield = window_alias or "window"
-        post_scope = _agg_post_scope(
-            agg_out, key_names, group_exprs, [call], [cname]
+        out, post_names = self._agg_post_projection(
+            sel, items, agg_out, key_names, group_exprs, [call], [cname],
+            "__w",
         )
-        having = (
-            bind(
-                _rewrite_group_refs(
-                    _rewrite_aggregates(sel.having, [call], [cname]),
-                    group_exprs, key_names,
-                ),
-                post_scope,
+        return dataclasses.replace(
+            out, window=window_spec,
+            window_field=wfield if wfield in post_names else
+            ("__w" if "__w" in post_names else None),
+        )
+
+    def _plan_mixed_distinct(
+        self, sel, items, upstream, where, window_spec, window_alias,
+        group_exprs, key_bound, key_names, agg_calls, agg_inputs,
+    ) -> RelOutput:
+        """count(DISTINCT x) mixed with regular aggregates in one SELECT:
+        the two-stage distinct branch and a regular windowed-aggregate
+        branch both consume the upstream, then an instant join on
+        (window, keys) re-unites them — the same shape a user would write
+        by hand (and the nexmark q5 join pattern)."""
+        distinct_call = next(c for c in agg_calls if c.distinct)
+        regular = [
+            (c, b) for c, b in zip(agg_calls, agg_inputs) if not c.distinct
+        ]
+        d_out, cname = self._count_distinct_core(
+            upstream, where, window_spec, key_bound, key_names,
+            distinct_call,
+        )
+        # regular branch: the plain windowed-aggregate builder with a fresh
+        # window column name (the distinct branch owns "__w")
+        rw = self._fresh("w")
+        r_out, reg_names = self._windowed_agg_node(
+            upstream, where, window_spec, key_bound, key_names,
+            [c for c, _ in regular], [b for _, b in regular], rw,
+            instant=False,
+        )
+        # instant join on (window, keys); _join_side_projection explodes
+        # the window struct into physical __keyN columns like plan_join
+        lkeys = [bind(Column("__w"), d_out.scope)] + [
+            bind(Column(k), d_out.scope) for k in key_names
+        ]
+        rkeys = [bind(Column(rw), r_out.scope)] + [
+            bind(Column(k), r_out.scope) for k in key_names
+        ]
+        lpre, nkeys = self._join_side_projection(d_out, lkeys, "mixed_jl")
+        rpre, _ = self._join_side_projection(r_out, rkeys, "mixed_jr")
+        fields, lnames, rnames = _join_output_fields(lpre, rpre, nkeys)
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(fields)))
+        jconfig = {
+            "n_keys": nkeys,
+            "join_type": "inner",
+            "schema": out_schema,
+            "left_fields": lnames,
+            "right_fields": rnames,
+            "left_schema": lpre.schema,
+            "right_schema": rpre.schema,
+            "window": dataclasses.asdict(window_spec),
+        }
+        jnode = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(), OperatorName.INSTANT_JOIN, jconfig,
+                "mixed_distinct_join", parallelism=self.parallelism,
             )
-            if sel.having is not None
-            else None
         )
-        post_exprs: List[BoundExpr] = []
-        post_names: List[str] = []
-        for it in items:
-            rewritten = _rewrite_aggregates(it.expr, [call], [cname])
-            rewritten = _rewrite_group_refs(rewritten, group_exprs, key_names)
-            if isinstance(rewritten, FuncCall) and rewritten.name in WINDOW_TVFS:
-                rewritten = Column("__w")
-            e = bind(rewritten, post_scope)
-            post_exprs.append(e)
-            post_names.append(it.alias or _default_name(it.expr, e))
-        out = self._add_value_node(
-            agg_out, post_exprs, _dedup(post_names), having,
-            _describe_items(post_names),
+        self.graph.add_edge(
+            lpre.node_id, jnode.node_id, EdgeType.LEFT_JOIN,
+            lpre.schema.with_keys(list(lpre.schema.schema.names[:nkeys])),
+        )
+        self.graph.add_edge(
+            rpre.node_id, jnode.node_id, EdgeType.RIGHT_JOIN,
+            rpre.schema.with_keys(list(rpre.schema.schema.names[:nkeys])),
+        )
+        joined = RelOutput(
+            jnode.node_id, out_schema, Scope.from_schema(out_schema.schema),
+            window=window_spec, window_field="__w",
+        )
+        # post-projection over the joined row
+        call_names: List[str] = []
+        ri = 0
+        for c in agg_calls:
+            if c.distinct:
+                call_names.append(cname)
+            else:
+                call_names.append(reg_names[ri])
+                ri += 1
+        wfield = window_alias or "window"
+        out, post_names = self._agg_post_projection(
+            sel, items, joined, key_names, group_exprs, agg_calls,
+            call_names, "__w",
         )
         return dataclasses.replace(
             out, window=window_spec,

@@ -208,6 +208,34 @@ def test_count_distinct_two_stage():
     assert all(r["dk"] == 10 for r in rows)
 
 
+def test_count_distinct_mixed_with_aggregates():
+    """count(DISTINCT) alongside regular aggregates: two-branch rewrite
+    joined on (window, keys), including expressions over both."""
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT counter % 2 as k, count(distinct counter % 100) as d,
+               count(*) as c, sum(counter % 10) as s
+        FROM impulse GROUP BY 1, tumble(interval '5 millisecond');
+        """
+    )
+    got = sorted((r["k"], r["d"], r["c"], r["s"]) for r in rows)
+    assert got == [(0, 50, 2500, 10000), (0, 50, 2500, 10000),
+                   (1, 50, 2500, 12500), (1, 50, 2500, 12500)]
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT counter % 4 as k,
+               count(distinct counter % 8) * 1000 / count(*) as ratio,
+               max(counter) as mx
+        FROM impulse GROUP BY 1, tumble(interval '10 millisecond')
+        HAVING max(counter) > 9995;
+        """
+    )
+    got = sorted((r["k"], r["ratio"], r["mx"]) for r in rows)
+    assert got == [(0, 0, 9996), (1, 0, 9997), (2, 0, 9998), (3, 0, 9999)]
+
+
 def test_case_and_scalar_functions():
     rows = run_sql(
         IMPULSE_DDL

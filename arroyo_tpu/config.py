@@ -88,6 +88,10 @@ class ControllerConfig:
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
     heartbeat_timeout: float = 30.0
     update_interval: float = 0.5
+    # where the per-job control loop (checkpoint cadence, manifest
+    # assembly, 2PC) runs: "controller" (central) or "worker"
+    # (worker-leader mode — the first worker of each job leads it)
+    job_controller_mode: str = "controller"
 
 
 @dataclasses.dataclass

@@ -24,6 +24,7 @@ from ..state.backend import StateBackend
 from ..types import now_nanos
 from ..utils.logging import get_logger
 from ..engine.rpc import RpcClient, RpcServer
+from ..operators.control import CheckpointReport
 from .scheduler import Scheduler, make_scheduler
 from .state_machine import JobState, check_transition
 
@@ -64,6 +65,9 @@ class JobHandle:
         self.stop_requested: Optional[str] = None
         self.restarts = 0
         self.events: List[dict] = []
+        # worker-leader mode: the leader finished its local work and handed
+        # the checkpoint cadence back to the controller
+        self.leader_resigned = False
 
     def transition(self, nxt: JobState):
         check_transition(self.state, nxt)
@@ -101,6 +105,8 @@ class ControllerServer:
                 "TaskFinished": self._task_finished,
                 "TaskFailed": self._task_failed,
                 "WorkerFinished": self._worker_finished,
+                "LeaderCheckpointFinished": self._leader_checkpoint_finished,
+                "LeaderResigned": self._leader_resigned,
             },
         )
         port = await self.rpc.start()
@@ -158,6 +164,26 @@ class ControllerServer:
         return {}
 
     async def _worker_finished(self, req: dict) -> dict:
+        return {}
+
+    async def _leader_checkpoint_finished(self, req: dict) -> dict:
+        """Worker-leader mode: the leader published a checkpoint manifest;
+        track the epoch for observability and stop/restore bookkeeping."""
+        for job in self.jobs.values():
+            if any(w.worker_id == req["worker_id"] for w in job.workers):
+                job.epoch = max(job.epoch, req["epoch"])
+        return {}
+
+    async def _leader_resigned(self, req: dict) -> dict:
+        """The job leader's local work ended before the job did: the
+        controller takes the checkpoint cadence back (workers fall back to
+        forwarding reports here when the leader stops answering)."""
+        for job in self.jobs.values():
+            if any(w.worker_id == req["worker_id"] for w in job.workers):
+                job.leader_resigned = True
+                # skip past every epoch the leader ISSUED (published or
+                # not) so controller-driven barriers never reuse one
+                job.epoch = max(job.epoch, req.get("epoch", 0))
         return {}
 
     # -- job API ------------------------------------------------------------
@@ -247,6 +273,7 @@ class ControllerServer:
         job.checkpoints.clear()
         job.finished_tasks.clear()
         job.failure = None
+        job.leader_resigned = False
         req = {
             "job_id": job.job_id,
             "sql": job.sql,
@@ -265,8 +292,27 @@ class ControllerServer:
         }
         if job.backend and job.backend.restore_epoch:
             job.epoch = job.backend.restore_epoch
+        # worker-leader mode: the first worker runs the job-control loop
+        # (checkpoint cadence, manifests, 2PC); the controller only
+        # supervises scheduling/recovery/stop (reference JobControllerMode)
+        leader_mode = (
+            config().controller.job_controller_mode == "worker"
+            and job.backend is not None
+        )
+        if leader_mode:
+            req["leader_addr"] = job.workers[0].rpc_addr
+            req["worker_rpc_addrs"] = {
+                str(w.worker_id): w.rpc_addr for w in job.workers
+            }
+            req["checkpoint_interval"] = (
+                config().pipeline.checkpointing.interval
+            )
+            req["n_subtasks"] = len(job.assignments)
         for w in job.workers:
-            await w.client.call("WorkerGrpc", "StartExecution", req)
+            await w.client.call(
+                "WorkerGrpc", "StartExecution",
+                {**req, "is_leader": leader_mode and w is job.workers[0]},
+            )
         # all partitions built + routes registered: release the sources
         for w in job.workers:
             await w.client.call("WorkerGrpc", "StartProcessing", {})
@@ -277,6 +323,7 @@ class ControllerServer:
         (reference job_controller/controller.rs:292-551)."""
         cfg = config()
         interval = cfg.pipeline.checkpointing.interval
+        leader_mode = cfg.controller.job_controller_mode == "worker"
         last_checkpoint = time.monotonic()
         while True:
             await asyncio.sleep(0.02)
@@ -300,7 +347,38 @@ class ControllerServer:
                 job.stop_requested = None
                 if mode == "checkpoint" and job.backend:
                     job.transition(JobState.CHECKPOINT_STOPPING)
-                    await self._checkpoint(job, then_stop=True)
+                    if leader_mode and not job.leader_resigned:
+                        # the leader runs the stopping checkpoint itself
+                        try:
+                            resp = await job.workers[0].client.call(
+                                "WorkerGrpc", "CheckpointStop", {},
+                                timeout=90.0,
+                            )
+                            job.epoch = max(job.epoch, resp.get("epoch", 0))
+                        except Exception as e:  # noqa: BLE001
+                            if len(job.finished_tasks) >= job.n_subtasks:
+                                logger.warning(
+                                    "leader CheckpointStop raced job "
+                                    "finish: %s", e,
+                                )
+                            else:
+                                # wedged leader: fall back to a plain
+                                # graceful stop so the job doesn't zombie
+                                logger.warning(
+                                    "leader CheckpointStop failed; falling "
+                                    "back to graceful stop: %s", e,
+                                )
+                                for w in job.workers:
+                                    try:
+                                        await w.client.call(
+                                            "WorkerGrpc", "StopExecution",
+                                            {"mode": "graceful"},
+                                            timeout=5.0,
+                                        )
+                                    except Exception:  # noqa: BLE001
+                                        pass
+                    else:
+                        await self._checkpoint(job, then_stop=True)
                     await self._await_all_finished(job)
                     job.transition(JobState.STOPPED)
                 else:
@@ -317,6 +395,7 @@ class ControllerServer:
                 return
             if (
                 job.backend is not None
+                and (not leader_mode or job.leader_resigned)
                 and time.monotonic() - last_checkpoint >= interval
             ):
                 last_checkpoint = time.monotonic()
@@ -344,7 +423,7 @@ class ControllerServer:
             await asyncio.sleep(0.02)
         reports = job.checkpoints[epoch]
         manifest = job.backend.publish_checkpoint(
-            epoch, {tid: _Report(r) for tid, r in reports.items()}
+            epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
         )
         if manifest.get("committing") and job.backend.claim_commit(epoch):
             for w in job.workers:
@@ -418,13 +497,4 @@ class ControllerServer:
         )
 
 
-class _Report:
-    """Adapts the rpc dict to the CheckpointCompletedResp shape the backend
-    expects."""
 
-    def __init__(self, d: dict):
-        self.node_id = d["node_id"]
-        self.subtask_index = d["subtask"]
-        self.subtask_metadata = d.get("metadata") or {}
-        self.watermark = d.get("watermark")
-        self.commit_data = d.get("commit_data")

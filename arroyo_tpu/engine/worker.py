@@ -12,12 +12,14 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from typing import Dict, Optional
 
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..operators.control import (
     CheckpointCompletedResp,
+    CheckpointReport,
     CheckpointEventResp,
     CheckpointMsg,
     CommitMsg,
@@ -50,6 +52,30 @@ class WorkerServer:
         self._running = asyncio.Event()
         self._finished = asyncio.Event()
         self._n_running = 0
+        # worker-leader mode (reference job_controller/: the elected worker
+        # runs the job-control loop — checkpoint cadence, manifest
+        # assembly, 2PC — and peers forward checkpoint events to it)
+        self._is_leader = False
+        self._leader_client: Optional[RpcClient] = None
+        self._peer_clients: Dict[int, RpcClient] = {}
+        self._worker_rpc_addrs: Dict[int, str] = {}
+        self._leader_reports: Dict[int, Dict[str, dict]] = {}
+        self._leader_epoch = 0
+        self._lead_interval: Optional[float] = None
+        self._lead_task = None
+        self._n_total_subtasks = 0
+        # set while no leader checkpoint is in flight: teardown must not
+        # close the rpc server under an active leadership duty (peers are
+        # still delivering reports, the manifest isn't published yet).
+        # Counted, because a cancelled cadence checkpoint's cleanup must
+        # not mark idle while a stop checkpoint is still running.
+        self._lead_active = 0
+        self._lead_idle = asyncio.Event()
+        self._lead_idle.set()
+        self._current_ck = None  # in-flight cadence checkpoint task
+        self._leader_published = 0  # highest epoch published or abandoned
+        self._leader_durable = 0  # highest epoch with a published manifest
+        self._resigned = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -62,6 +88,8 @@ class WorkerServer:
                 "Checkpoint": self.checkpoint,
                 "Commit": self.commit,
                 "LoadCompacted": self.load_compacted,
+                "TaskCheckpointCompleted": self.task_checkpoint_completed,
+                "CheckpointStop": self.checkpoint_stop,
                 "StopExecution": self.stop_execution,
                 "GetMetrics": self.get_metrics,
             },
@@ -138,6 +166,18 @@ class WorkerServer:
             data_server=self.data,
         )
         self.program = program
+        self._is_leader = bool(req.get("is_leader"))
+        self._worker_rpc_addrs = {
+            int(w): a for w, a in (req.get("worker_rpc_addrs") or {}).items()
+        }
+        self._lead_interval = req.get("checkpoint_interval")
+        self._n_total_subtasks = req.get("n_subtasks") or len(
+            req["assignments"]
+        )
+        self._leader_epoch = req.get("restore_epoch") or 0
+        leader_addr = req.get("leader_addr")
+        if leader_addr and not self._is_leader:
+            self._leader_client = RpcClient(leader_addr)
 
         def pump_failed(quad, exc):
             program.control_resp.put_nowait(
@@ -163,6 +203,8 @@ class WorkerServer:
         self._n_running = len(program.subtasks)
         self._pump_task = asyncio.ensure_future(self._pump_responses())
         self._running.set()
+        if self._is_leader and self._lead_interval is not None:
+            self._lead_task = asyncio.ensure_future(self._lead_loop())
         return {}
 
     async def checkpoint(self, req: dict) -> dict:
@@ -205,6 +247,153 @@ class WorkerServer:
 
         return {"prometheus": REGISTRY.expose()}
 
+    # -- worker-leader job control ------------------------------------------
+
+    async def task_checkpoint_completed(self, req: dict) -> dict:
+        """Leader intake: a peer subtask finished its checkpoint. A
+        resigned leader relays to the controller (which took the cadence)
+        instead of swallowing the report."""
+        if self._resigned:
+            await self.controller.call(
+                "ControllerGrpc", "TaskCheckpointCompleted", req
+            )
+        else:
+            self._leader_intake(req)
+        return {}
+
+    async def checkpoint_stop(self, req: dict) -> dict:
+        """Leader: run a stop-with-checkpoint cadence (controller's stop
+        path in worker-leader mode). An in-flight cadence checkpoint runs
+        to completion first — cancelling it mid barrier fan-out would
+        interleave two epochs' barriers in the pipeline."""
+        if self._lead_task is not None:
+            self._lead_task.cancel()
+        ck = self._current_ck
+        if ck is not None:
+            await asyncio.gather(ck, return_exceptions=True)
+        await self._lead_checkpoint(then_stop=True)
+        # report only durable progress: an incomplete/timed-out stop
+        # checkpoint must not advance the controller's epoch bookkeeping
+        return {"epoch": self._leader_durable}
+
+    def _leader_intake(self, d: dict):
+        # late reports for epochs already published/abandoned would leak
+        if d["epoch"] <= self._leader_published:
+            return
+        self._leader_reports.setdefault(d["epoch"], {})[d["task_id"]] = d
+
+    def _evict_reports(self, up_to_epoch: int):
+        """Drop report state for epochs <= up_to_epoch (published, timed
+        out, or abandoned) so stragglers can't grow memory unboundedly."""
+        self._leader_published = max(self._leader_published, up_to_epoch)
+        for e in [e for e in self._leader_reports if e <= up_to_epoch]:
+            del self._leader_reports[e]
+
+    def _peer(self, wid: int) -> RpcClient:
+        if wid not in self._peer_clients:
+            self._peer_clients[wid] = RpcClient(self._worker_rpc_addrs[wid])
+        return self._peer_clients[wid]
+
+    async def _lead_loop(self):
+        try:
+            while not self._finished.is_set():
+                await asyncio.sleep(self._lead_interval)
+                if self._finished.is_set() or self._n_running <= 0:
+                    return
+                # shielded: a CheckpointStop cancels THIS loop but must let
+                # the in-flight checkpoint finish (it reaps _current_ck)
+                self._current_ck = asyncio.ensure_future(
+                    self._lead_checkpoint(then_stop=False)
+                )
+                try:
+                    await asyncio.shield(self._current_ck)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    # one failed checkpoint (peer rpc blip, publish error)
+                    # must not kill the cadence; the next tick retries
+                    logger.exception("leader checkpoint failed; continuing")
+        except asyncio.CancelledError:
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("leader checkpoint loop failed")
+
+    async def _lead_checkpoint(self, then_stop: bool) -> int:
+        """One full checkpoint driven by the leader worker: barrier fan-out,
+        report collection, manifest publish, 2PC commit, compaction + GC
+        (reference WorkerJobController, job_controller/controller.rs)."""
+        backend = self.program._state_backend
+        if backend is None:
+            return 0
+        self._lead_active += 1
+        self._lead_idle.clear()
+        try:
+            return await self._lead_checkpoint_inner(then_stop, backend)
+        finally:
+            self._lead_active -= 1
+            if self._lead_active == 0:
+                self._lead_idle.set()
+
+    async def _lead_checkpoint_inner(self, then_stop: bool, backend) -> int:
+        self._leader_epoch += 1
+        epoch = self._leader_epoch
+        for wid in self._worker_rpc_addrs:
+            payload = {"epoch": epoch, "then_stop": then_stop}
+            if wid == self.worker_id:
+                await self.checkpoint(payload)
+            else:
+                await self._peer(wid).call("WorkerGrpc", "Checkpoint", payload)
+        deadline = time.monotonic() + 60
+        while len(self._leader_reports.get(epoch, {})) < self._n_total_subtasks:
+            if time.monotonic() > deadline:
+                logger.warning("leader: checkpoint %d incomplete", epoch)
+                self._evict_reports(epoch)
+                return epoch
+            if self._n_running <= 0 and not then_stop:
+                logger.info("leader: checkpoint %d abandoned (job finished)",
+                            epoch)
+                self._evict_reports(epoch)
+                return epoch
+            await asyncio.sleep(0.02)
+        reports = self._leader_reports.pop(epoch)
+        self._evict_reports(epoch)
+        manifest = backend.publish_checkpoint(
+            epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
+        )
+        self._leader_durable = epoch
+        if manifest.get("committing") and backend.claim_commit(epoch):
+            for wid in self._worker_rpc_addrs:
+                payload = {"epoch": epoch,
+                           "committing": manifest["committing"]}
+                if wid == self.worker_id:
+                    await self.commit(payload)
+                else:
+                    await self._peer(wid).call(
+                        "WorkerGrpc", "Commit", payload
+                    )
+        swaps = await asyncio.to_thread(backend.compact_epoch, epoch, manifest)
+        for swap in swaps:
+            for wid in self._worker_rpc_addrs:
+                if wid == self.worker_id:
+                    self.program.send_load_compacted(swap)
+                else:
+                    try:
+                        await self._peer(wid).call(
+                            "WorkerGrpc", "LoadCompacted", swap
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning("LoadCompacted to %s failed: %s",
+                                       wid, e)
+        await asyncio.to_thread(backend.retire_unreferenced)
+        try:
+            await self.controller.call(
+                "ControllerGrpc", "LeaderCheckpointFinished",
+                {"worker_id": self.worker_id, "epoch": epoch},
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("leader checkpoint report failed: %s", e)
+        return epoch
+
     # -- task event forwarding ---------------------------------------------
 
     async def _pump_responses(self):
@@ -216,6 +405,23 @@ class WorkerServer:
             except Exception as e:  # noqa: BLE001
                 logger.warning("event forward failed: %s", e)
         self._finished.set()
+        if self._is_leader:
+            # local work ended; resign leadership so the controller takes
+            # over the checkpoint cadence for any still-running peers. Wait
+            # out an in-flight leader checkpoint first: resigning mid-epoch
+            # would let the controller drive the same epoch concurrently.
+            if self._lead_task is not None:
+                self._lead_task.cancel()
+            await self._lead_idle.wait()
+            self._resigned = True
+            try:
+                await self.controller.call(
+                    "ControllerGrpc", "LeaderResigned",
+                    {"worker_id": self.worker_id,
+                     "epoch": self._leader_epoch},
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("leader resignation failed: %s", e)
         await self.controller.call(
             "ControllerGrpc", "WorkerFinished", {"worker_id": self.worker_id}
         )
@@ -224,19 +430,38 @@ class WorkerServer:
         c = self.controller
         wid = self.worker_id
         if isinstance(resp, CheckpointCompletedResp):
-            await c.call(
-                "ControllerGrpc", "TaskCheckpointCompleted",
-                {
-                    "worker_id": wid,
-                    "task_id": resp.task_id,
-                    "node_id": resp.node_id,
-                    "subtask": resp.subtask_index,
-                    "epoch": resp.epoch,
-                    "metadata": resp.subtask_metadata,
-                    "watermark": resp.watermark,
-                    "commit_data": resp.commit_data,
-                },
-            )
+            payload = {
+                "worker_id": wid,
+                "task_id": resp.task_id,
+                "node_id": resp.node_id,
+                "subtask": resp.subtask_index,
+                "epoch": resp.epoch,
+                "metadata": resp.subtask_metadata,
+                "watermark": resp.watermark,
+                "commit_data": resp.commit_data,
+            }
+            # worker-leader mode: checkpoint reports go to the job leader
+            # (who assembles the manifest), not the controller. If the
+            # leader resigned (its local work ended), fall back to the
+            # controller, which takes over the cadence. Known degradation:
+            # a TRANSIENT leader rpc failure also diverts this report, so
+            # that epoch waits out its deadline unpublished — the next
+            # cadence tick retries with a fresh epoch.
+            if self._is_leader:
+                self._leader_intake(payload)
+            elif self._leader_client is not None:
+                try:
+                    await self._leader_client.call(
+                        "WorkerGrpc", "TaskCheckpointCompleted", payload
+                    )
+                except Exception:  # noqa: BLE001
+                    await c.call(
+                        "ControllerGrpc", "TaskCheckpointCompleted", payload
+                    )
+            else:
+                await c.call(
+                    "ControllerGrpc", "TaskCheckpointCompleted", payload
+                )
         elif isinstance(resp, CheckpointEventResp):
             await c.call(
                 "ControllerGrpc", "TaskCheckpointEvent",
@@ -266,19 +491,26 @@ class WorkerServer:
         self._finished.set()
         for t in self.tasks:
             t.cancel()
-        for attr in ("_hb", "_pump_task"):
+        for attr in ("_hb", "_pump_task", "_lead_task"):
             t = getattr(self, attr, None)
             if t is not None:
                 t.cancel()
         await asyncio.gather(*self.tasks, return_exceptions=True)
         if self.controller is not None:
             await self.controller.close()
+        if self._leader_client is not None:
+            await self._leader_client.close()
+        for c in self._peer_clients.values():
+            await c.close()
         await self.rpc.stop(grace=0.1)
         await self.data.stop()
 
     async def run_until_finished(self):
         await self._finished.wait()
         await asyncio.gather(*self.tasks, return_exceptions=True)
+        # a leader must finish its in-flight checkpoint (peer reports are
+        # still arriving over this worker's rpc server) before teardown
+        await self._lead_idle.wait()
         self._hb.cancel()
         await asyncio.gather(self._hb, return_exceptions=True)
         await self.controller.close()

@@ -42,6 +42,19 @@ class LoadCompactedMsg:
     op_idx: Optional[int] = None
 
 
+class CheckpointReport:
+    """Adapts a checkpoint-report rpc dict to the CheckpointCompletedResp
+    shape the state backend expects (shared by the controller and the
+    worker-leader job controller)."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self.node_id = d["node_id"]
+        self.subtask_index = d["subtask"]
+        self.subtask_metadata = d.get("metadata") or {}
+        self.watermark = d.get("watermark")
+        self.commit_data = d.get("commit_data")
+
+
 ControlMessage = Any  # union of the above
 
 

@@ -228,3 +228,56 @@ def test_finish_racing_inflight_checkpoint(tmp_path):
     assert restarts == 0
     counts = read_counts(tmp_path / "out.json")
     assert sum(counts.values()) == 20000
+
+
+def test_worker_leader_mode(tmp_path):
+    """job_controller_mode=worker: the first worker runs the checkpoint
+    cadence and manifest publish (the controller's checkpoint collection
+    stays empty), checkpoint-stop is delegated to the leader, and a
+    restart resumes from the leader-published manifest with exact output."""
+    from arroyo_tpu.config import update
+
+    url = str(tmp_path / "ck")
+    # ~1.7s of realtime stream so the mid-run checkpoint-stop lands well
+    # before the source drains
+    sql = sql_pipeline(tmp_path, n=200000).replace(
+        "'1000000'", "'120000'"
+    ).replace("start_time = '0'", "start_time = '0', realtime = 'true'")
+
+    async def phase1():
+        c = await ControllerServer(EmbeddedScheduler()).start()
+        with update(controller={"job_controller_mode": "worker"},
+                    pipeline={"checkpointing": {"interval": 0.1}}):
+            await c.submit_job("wl", sql=sql, storage_url=url,
+                               n_workers=2, parallelism=2)
+            await c.wait_for_state("wl", JobState.RUNNING, timeout=30)
+            await asyncio.sleep(0.3)  # let leader checkpoints land
+            await c.stop_job("wl", "checkpoint")
+            state = await c.wait_for_state(
+                "wl", JobState.STOPPED, JobState.FAILED, timeout=60
+            )
+        job = c.jobs["wl"]
+        await c.stop()
+        return state, job.epoch, dict(job.checkpoints)
+
+    state, epoch, controller_ckpts = asyncio.run(phase1())
+    assert state == JobState.STOPPED
+    assert epoch >= 1  # leader published + reported at least one epoch
+    # reports went to the leader, not the controller
+    assert controller_ckpts == {}
+
+    async def phase2():
+        c = await ControllerServer(EmbeddedScheduler()).start()
+        with update(controller={"job_controller_mode": "worker"},
+                    pipeline={"checkpointing": {"interval": 0.1}}):
+            await c.submit_job("wl", sql=sql, storage_url=url,
+                               n_workers=2, parallelism=2)
+            state = await c.wait_for_state(
+                "wl", JobState.FINISHED, JobState.FAILED, timeout=60
+            )
+        await c.stop()
+        return state
+
+    assert asyncio.run(phase2()) == JobState.FINISHED
+    counts = read_counts(tmp_path / "out.json")
+    assert counts == {k: 25000 for k in range(8)}

@@ -111,7 +111,9 @@ class ApiConfig:
 
 @dataclasses.dataclass
 class AdminConfig:
-    http_port: int = 0
+    # -1 disables; 0 binds an ephemeral port; >0 a fixed port (the
+    # reference serves /status //metrics //debug on 8001 by default)
+    http_port: int = -1
     bind_address: str = "127.0.0.1"
 
 

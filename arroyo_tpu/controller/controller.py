@@ -111,6 +111,15 @@ class ControllerServer:
         )
         port = await self.rpc.start()
         self.addr = f"{self.bind}:{port}"
+        from ..utils.admin import serve_admin
+
+        self._admin, self.admin_port = await serve_admin(
+            "controller",
+            lambda: {
+                "workers": len(self.workers),
+                "jobs": {j.job_id: j.state.value for j in self.jobs.values()},
+            },
+        )
         logger.info("controller up at %s", self.addr)
         return self
 
@@ -124,6 +133,8 @@ class ControllerServer:
         for job in self.jobs.values():
             for w in job.workers:
                 await w.client.close()
+        if getattr(self, "_admin", None) is not None:
+            await self._admin.cleanup()
         await self.rpc.stop()
 
     # -- ControllerGrpc -----------------------------------------------------
@@ -396,6 +407,7 @@ class ControllerServer:
             if (
                 job.backend is not None
                 and (not leader_mode or job.leader_resigned)
+                and not job.finished_tasks
                 and time.monotonic() - last_checkpoint >= interval
             ):
                 last_checkpoint = time.monotonic()
@@ -405,10 +417,14 @@ class ControllerServer:
         job.epoch += 1
         epoch = job.epoch
         for w in job.workers:
-            await w.client.call(
-                "WorkerGrpc", "Checkpoint",
-                {"epoch": epoch, "then_stop": then_stop},
-            )
+            try:
+                await w.client.call(
+                    "WorkerGrpc", "Checkpoint",
+                    {"epoch": epoch, "then_stop": then_stop},
+                )
+            except Exception as e:  # noqa: BLE001 - resigned/dead worker
+                logger.warning("checkpoint fan-out to worker %s failed: %s",
+                               w.worker_id, e)
         deadline = time.monotonic() + 60
         while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
             if job.failure is not None or time.monotonic() > deadline:
@@ -493,7 +509,10 @@ class ControllerServer:
     def _heartbeat_expired(self, job: JobHandle) -> bool:
         timeout = config().controller.heartbeat_timeout
         return any(
-            time.monotonic() - w.last_heartbeat > timeout for w in job.workers
+            time.monotonic() - w.last_heartbeat > timeout
+            for w in job.workers
+            # a resigned leader shut down after finishing its local work
+            if not (job.leader_resigned and w is job.workers[0])
         )
 
 

@@ -109,6 +109,16 @@ class WorkerServer:
                 "slots": config().worker.task_slots,
             },
         )
+        from ..utils.admin import serve_admin
+
+        self._admin, self.admin_port = await serve_admin(
+            "worker",
+            lambda: {
+                "worker_id": self.worker_id,
+                "running_subtasks": self._n_running,
+                "is_leader": self._is_leader,
+            },
+        )
         self._hb = asyncio.ensure_future(self._heartbeat())
         logger.info(
             "worker %s up (rpc %s, data %s)", self.worker_id, self.rpc_addr,
@@ -344,7 +354,12 @@ class WorkerServer:
             else:
                 await self._peer(wid).call("WorkerGrpc", "Checkpoint", payload)
         deadline = time.monotonic() + 60
+        last_progress = time.monotonic()
+        seen = 0
         while len(self._leader_reports.get(epoch, {})) < self._n_total_subtasks:
+            n = len(self._leader_reports.get(epoch, {}))
+            if n > seen:
+                seen, last_progress = n, time.monotonic()
             if time.monotonic() > deadline:
                 logger.warning("leader: checkpoint %d incomplete", epoch)
                 self._evict_reports(epoch)
@@ -352,6 +367,16 @@ class WorkerServer:
             if self._n_running <= 0 and not then_stop:
                 logger.info("leader: checkpoint %d abandoned (job finished)",
                             epoch)
+                self._evict_reports(epoch)
+                return epoch
+            if (then_stop and self._finished.is_set()
+                    and time.monotonic() - last_progress > 5.0):
+                # leader's own tasks finished and can't report; remaining
+                # peers stalled too — don't hold the stop for 60s
+                logger.warning(
+                    "leader: stop checkpoint %d abandoned (no report "
+                    "progress after local finish)", epoch,
+                )
                 self._evict_reports(epoch)
                 return epoch
             await asyncio.sleep(0.02)
@@ -491,7 +516,7 @@ class WorkerServer:
         self._finished.set()
         for t in self.tasks:
             t.cancel()
-        for attr in ("_hb", "_pump_task", "_lead_task"):
+        for attr in ("_hb", "_pump_task", "_lead_task", "_current_ck"):
             t = getattr(self, attr, None)
             if t is not None:
                 t.cancel()
@@ -502,6 +527,8 @@ class WorkerServer:
             await self._leader_client.close()
         for c in self._peer_clients.values():
             await c.close()
+        if getattr(self, "_admin", None) is not None:
+            await self._admin.cleanup()
         await self.rpc.stop(grace=0.1)
         await self.data.stop()
 

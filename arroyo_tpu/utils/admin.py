@@ -1,0 +1,111 @@
+"""Per-process admin HTTP server: /status, /metrics, /debug/tasks.
+
+Capability parity with the reference's admin server
+(/root/reference/crates/arroyo-server-common/src/lib.rs start_admin_server:
+/status, /name, /metrics, /debug/pprof): every role (controller, worker,
+api) can expose liveness, Prometheus metrics, and a stack/task dump on a
+local port. The pprof heap/cpu endpoints map to Python equivalents — a
+live asyncio-task listing and a faulthandler thread-stack dump.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..config import config
+from ..utils.logging import get_logger
+
+logger = get_logger("admin")
+
+_STARTED = time.time()
+
+
+def build_admin_app(role: str, details_fn=None) -> web.Application:
+    """`details_fn() -> dict` supplies role-specific status fields."""
+
+    async def status(request: web.Request):
+        body = {
+            "service": f"arroyo-tpu-{role}",
+            "status": "ok",
+            "uptime_seconds": round(time.time() - _STARTED, 1),
+        }
+        if details_fn is not None:
+            try:
+                body.update(details_fn() or {})
+            except Exception as e:  # noqa: BLE001
+                body["details_error"] = repr(e)
+        return web.json_response(body)
+
+    async def name(request: web.Request):
+        return web.Response(text=f"arroyo-tpu-{role}\n")
+
+    async def metrics(request: web.Request):
+        from ..metrics import REGISTRY
+
+        return web.Response(
+            text=REGISTRY.expose(),
+            content_type="text/plain",
+        )
+
+    async def debug_tasks(request: web.Request):
+        lines = []
+        for t in asyncio.all_tasks():
+            coro = t.get_coro()
+            lines.append(
+                f"{'CANCELLED' if t.cancelled() else 'DONE' if t.done() else 'RUNNING'} "
+                f"{getattr(coro, '__qualname__', coro)}"
+            )
+        return web.Response(text="\n".join(sorted(lines)) + "\n",
+                            content_type="text/plain")
+
+    async def debug_stacks(request: web.Request):
+        import sys
+        import threading
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        buf = io.StringIO()
+        for tid, frame in sys._current_frames().items():
+            buf.write(f"Thread {names.get(tid, tid)}:\n")
+            buf.write("".join(traceback.format_stack(frame)))
+            buf.write("\n")
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/status", status)
+    app.router.add_get("/name", name)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/tasks", debug_tasks)
+    app.router.add_get("/debug/stacks", debug_stacks)
+    return app
+
+
+async def serve_admin(role: str, details_fn=None,
+                      port: Optional[int] = None):
+    """Start the admin server; returns (runner, bound port). Port 0 binds
+    an ephemeral port; admin.http_port < 0 disables (returns (None, 0))."""
+    cfg = config().admin
+    if port is None:
+        port = cfg.http_port
+    if port < 0:
+        return None, 0
+    app = build_admin_app(role, details_fn)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.bind_address, port)
+    try:
+        await site.start()
+    except OSError as e:
+        # a fixed port is already held by another role on this host; the
+        # admin surface is advisory, so log and continue without it
+        logger.warning("admin server bind failed on port %s: %s", port, e)
+        await runner.cleanup()
+        return None, 0
+    bound = site._server.sockets[0].getsockname()[1]
+    logger.info("admin server for %s on %s:%s", role, cfg.bind_address, bound)
+    return runner, bound

@@ -274,3 +274,36 @@ def test_operator_metric_groups_structured(tmp_path):
         sub = by_metric["messages_sent"]["subtasks"][0]
         assert sub["index"] == 0 and sub["metrics"][0]["value"] > 0
         assert "prometheus" in body
+
+
+def test_admin_server():
+    """Per-process admin endpoints: /status, /metrics, /debug/* (reference
+    arroyo-server-common start_admin_server)."""
+    import aiohttp
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+
+    async def go():
+        with update(admin={"http_port": 0}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+        port = c.admin_port
+        assert port > 0
+        async with aiohttp.ClientSession() as s:
+            st = await (await s.get(
+                f"http://127.0.0.1:{port}/status")).json()
+            metrics = await (await s.get(
+                f"http://127.0.0.1:{port}/metrics")).text()
+            tasks = await (await s.get(
+                f"http://127.0.0.1:{port}/debug/tasks")).text()
+            stacks = await (await s.get(
+                f"http://127.0.0.1:{port}/debug/stacks")).text()
+        await c.stop()
+        return st, metrics, tasks, stacks
+
+    st, metrics, tasks, stacks = asyncio.run(go())
+    assert st["service"] == "arroyo-tpu-controller" and st["status"] == "ok"
+    assert "jobs" in st and st["uptime_seconds"] >= 0
+    assert "# HELP" in metrics or metrics.strip() == ""
+    assert "RUNNING" in tasks
+    assert "File" in stacks or "Thread" in stacks

@@ -30,9 +30,15 @@ def main(argv=None):
     w_p = sub.add_parser("worker", help="start a worker")
     w_p.add_argument("--controller", required=True)
 
+    n_p = sub.add_parser("node", help="start a node daemon (offers "
+                         "worker slots to the controller)")
+    n_p.add_argument("--controller", required=True)
+    n_p.add_argument("--slots", type=int, default=None)
+
     c_p = sub.add_parser("controller", help="start a controller")
     c_p.add_argument("--scheduler", default=None,
-                     choices=["embedded", "process", "manual", "kubernetes"])
+                     choices=["embedded", "process", "manual", "node",
+                              "kubernetes"])
     c_p.add_argument("--port", type=int, default=None)
 
     api_p = sub.add_parser("api", help="start the REST API server")
@@ -52,6 +58,8 @@ def main(argv=None):
         return asyncio.run(_run(args))
     if args.cmd == "worker":
         return asyncio.run(_worker(args))
+    if args.cmd == "node":
+        return asyncio.run(_node(args))
     if args.cmd == "controller":
         return asyncio.run(_controller(args))
     if args.cmd == "api":
@@ -127,6 +135,19 @@ async def _run(args):
         return 0
     finally:
         await controller.stop()
+
+
+async def _node(args):
+    from .controller.node import NodeServer
+    from .utils import init_logging
+
+    init_logging()
+    node = await NodeServer(args.controller, slots=args.slots).start()
+    try:
+        await node.run_forever()
+    except KeyboardInterrupt:
+        await node.stop()
+    return 0
 
 
 async def _worker(args):

@@ -31,6 +31,17 @@ from .state_machine import JobState, check_transition
 logger = get_logger("controller")
 
 
+class NodeHandle:
+    """A registered node daemon offering worker slots."""
+
+    def __init__(self, node_id: str, addr: str, slots: int):
+        self.node_id = node_id
+        self.addr = addr
+        self.slots = slots
+        self.used = 0
+        self.client = RpcClient(addr)
+
+
 class WorkerHandle:
     def __init__(self, worker_id: int, rpc_addr: str, data_addr: str,
                  slots: int):
@@ -88,6 +99,7 @@ class ControllerServer:
         self.rpc = RpcServer(bind)
         self.bind = bind
         self.workers: Dict[int, WorkerHandle] = {}
+        self.nodes: Dict[str, "NodeHandle"] = {}
         self.jobs: Dict[str, JobHandle] = {}
         self.max_restarts = max_restarts
         self._job_tasks: Dict[str, asyncio.Task] = {}
@@ -107,10 +119,13 @@ class ControllerServer:
                 "WorkerFinished": self._worker_finished,
                 "LeaderCheckpointFinished": self._leader_checkpoint_finished,
                 "LeaderResigned": self._leader_resigned,
+                "RegisterNode": self._register_node,
             },
         )
         port = await self.rpc.start()
         self.addr = f"{self.bind}:{port}"
+        # schedulers that place onto registered resources need the registry
+        self.scheduler.controller = self
         from ..utils.admin import serve_admin
 
         self._admin, self.admin_port = await serve_admin(
@@ -133,11 +148,21 @@ class ControllerServer:
         for job in self.jobs.values():
             for w in job.workers:
                 await w.client.close()
+        for n in self.nodes.values():
+            await n.client.close()
         if getattr(self, "_admin", None) is not None:
             await self._admin.cleanup()
         await self.rpc.stop()
 
     # -- ControllerGrpc -----------------------------------------------------
+
+    async def _register_node(self, req: dict) -> dict:
+        """A node daemon offers worker slots (reference node scheduler)."""
+        n = NodeHandle(req["node_id"], req["addr"], req.get("slots", 1))
+        self.nodes[n.node_id] = n
+        logger.info("node %s registered (%s, %d slots)", n.node_id, n.addr,
+                    n.slots)
+        return {}
 
     async def _register_worker(self, req: dict) -> dict:
         w = WorkerHandle(req["worker_id"], req["rpc_addr"], req["data_addr"],

@@ -1,0 +1,101 @@
+"""Node daemon: a long-lived per-machine agent that spawns worker
+processes on demand.
+
+Capability parity with the reference's node scheduler
+(/root/reference/crates/arroyo-controller/src/schedulers/mod.rs node +
+crates/arroyo-node): `arroyo-tpu node` registers its slot capacity with
+the controller; the controller's NodeScheduler places workers on
+registered nodes (most-free-slots first) via StartWorkers/StopWorkers
+RPCs, and the node forks `arroyo-tpu worker` subprocesses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+from typing import Dict, List, Optional
+
+from ..config import config
+from ..engine.rpc import RpcClient, RpcServer
+from ..utils.logging import get_logger
+
+logger = get_logger("node")
+
+# worker ids must be unique ACROSS node daemons (the controller keys
+# workers by id): derive the base from this daemon's pid
+_next_node_worker_id = 3_000_000 + (os.getpid() % 100_000) * 100
+
+
+class NodeServer:
+    def __init__(self, controller_addr: str, node_id: Optional[str] = None,
+                 slots: Optional[int] = None, bind: str = "127.0.0.1",
+                 extra_env: Optional[dict] = None):
+        self.controller_addr = controller_addr
+        self.node_id = node_id or f"node-{os.getpid()}"
+        self.slots = slots or config().worker.task_slots
+        self.bind = bind
+        self.extra_env = extra_env or {}
+        self.rpc = RpcServer(bind)
+        self.controller: Optional[RpcClient] = None
+        # job_id -> worker subprocesses started for it
+        self.procs: Dict[str, List[subprocess.Popen]] = {}
+        self._stop = asyncio.Event()
+
+    async def start(self) -> "NodeServer":
+        self.rpc.add_service(
+            "NodeGrpc",
+            {
+                "StartWorkers": self.start_workers,
+                "StopWorkers": self.stop_workers,
+            },
+        )
+        port = await self.rpc.start()
+        self.addr = f"{self.bind}:{port}"
+        self.controller = RpcClient(self.controller_addr)
+        await self.controller.call(
+            "ControllerGrpc", "RegisterNode",
+            {"node_id": self.node_id, "addr": self.addr,
+             "slots": self.slots},
+        )
+        logger.info("node %s up at %s (%d slots)", self.node_id, self.addr,
+                    self.slots)
+        return self
+
+    async def start_workers(self, req: dict) -> dict:
+        global _next_node_worker_id
+
+        from .scheduler import spawn_worker
+
+        job_id = req["job_id"]
+        started = []
+        for _ in range(req.get("n", 1)):
+            wid = _next_node_worker_id
+            _next_node_worker_id += 1
+            p = spawn_worker(
+                req.get("controller_addr", self.controller_addr), wid,
+                extra_env=self.extra_env,
+            )
+            self.procs.setdefault(job_id, []).append(p)
+            started.append(wid)
+        logger.info("node %s started workers %s for job %s", self.node_id,
+                    started, job_id)
+        return {"worker_ids": started}
+
+    async def stop_workers(self, req: dict) -> dict:
+        from .scheduler import terminate_procs
+
+        procs = self.procs.pop(req["job_id"], [])
+        await terminate_procs(procs, req.get("force", False))
+        return {"stopped": len(procs)}
+
+    async def run_forever(self):
+        await self._stop.wait()
+
+    async def stop(self):
+        for job_id in list(self.procs):
+            await self.stop_workers({"job_id": job_id, "force": True})
+        if self.controller is not None:
+            await self.controller.close()
+        await self.rpc.stop()
+        self._stop.set()

@@ -17,7 +17,7 @@ import asyncio
 import os
 import subprocess
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..utils.logging import get_logger
 
@@ -73,6 +73,34 @@ class EmbeddedScheduler(Scheduler):
 _next_process_id = 2000
 
 
+def spawn_worker(controller_addr: str, worker_id: int,
+                 extra_env: Optional[dict] = None) -> subprocess.Popen:
+    """Fork one `arroyo-tpu worker` subprocess (shared by the process
+    scheduler and node daemons)."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env["ARROYO_WORKER_ID"] = str(worker_id)
+    return subprocess.Popen(
+        [sys.executable, "-m", "arroyo_tpu", "worker",
+         "--controller", controller_addr],
+        env=env,
+    )
+
+
+async def terminate_procs(procs, force: bool = False):
+    """Stop worker subprocesses without blocking the event loop."""
+    import asyncio
+
+    for p in procs:
+        if p.poll() is None:
+            p.kill() if force else p.terminate()
+    for p in procs:
+        try:
+            await asyncio.to_thread(p.wait, 5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 class ProcessScheduler(Scheduler):
     """Forks worker subprocesses (reference ProcessScheduler mod.rs:118)."""
 
@@ -83,26 +111,76 @@ class ProcessScheduler(Scheduler):
         global _next_process_id
 
         for _ in range(n_workers):
-            env = dict(os.environ)
-            env["ARROYO_WORKER_ID"] = str(_next_process_id)
+            p = spawn_worker(controller_addr, _next_process_id)
             _next_process_id += 1
-            p = subprocess.Popen(
-                [sys.executable, "-m", "arroyo_tpu", "worker",
-                 "--controller", controller_addr],
-                env=env,
-            )
             self.procs.setdefault(job_id, []).append(p)
 
     async def stop_workers(self, job_id, force=False):
-        procs = self.procs.pop(job_id, [])
-        for p in procs:
-            if p.poll() is None:
-                p.kill() if force else p.terminate()
-        for p in procs:
+        await terminate_procs(self.procs.pop(job_id, []), force)
+
+
+class NodeScheduler(Scheduler):
+    """Places workers on registered node daemons (reference node scheduler,
+    schedulers/mod.rs): most-free-slots first; the node forks the worker
+    processes. `controller` is attached by ControllerServer.start()."""
+
+    def __init__(self):
+        self.controller = None  # ControllerServer, set on attach
+        # job_id -> [node_handle] (one entry per worker placed on it)
+        self.placements: Dict[str, list] = {}
+
+    async def start_workers(self, controller_addr, n_workers, job_id):
+        try:
+            for _ in range(n_workers):
+                await self._place_one(controller_addr, job_id)
+        except Exception:
+            # partial scheduling failure: release what was started so the
+            # slots and orphan workers don't leak
+            await self.stop_workers(job_id, force=True)
+            raise
+
+    async def _place_one(self, controller_addr, job_id):
+        while True:
+            nodes = list(getattr(self.controller, "nodes", {}).values())
+            if not nodes:
+                raise RuntimeError(
+                    "node scheduler: no node daemons registered "
+                    "(start them with `arroyo-tpu node --controller ...`)"
+                )
+            node = max(nodes, key=lambda n: n.slots - n.used)
+            if node.slots - node.used <= 0:
+                raise RuntimeError("node scheduler: no free slots")
+            # reserve BEFORE awaiting: a concurrent job must not grab the
+            # same last slot while the rpc is in flight
+            node.used += 1
+            self.placements.setdefault(job_id, []).append(node)
             try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+                await node.client.call(
+                    "NodeGrpc", "StartWorkers",
+                    {"job_id": job_id, "n": 1,
+                     "controller_addr": controller_addr},
+                )
+                return
+            except Exception as e:  # noqa: BLE001 - dead node: drop + retry
+                logger.warning("node %s unreachable, dropping: %s",
+                               node.node_id, e)
+                node.used -= 1
+                self.placements[job_id].remove(node)
+                self.controller.nodes.pop(node.node_id, None)
+
+    async def stop_workers(self, job_id, force=False):
+        placed = self.placements.pop(job_id, [])
+        for node in {id(n): n for n in placed}.values():
+            try:
+                await node.client.call(
+                    "NodeGrpc", "StopWorkers",
+                    {"job_id": job_id, "force": force},
+                )
+            except Exception as e:  # noqa: BLE001 - node may be gone
+                logger.warning("StopWorkers on %s failed: %s",
+                               node.node_id, e)
+        for node in placed:
+            node.used = max(0, node.used - 1)
 
 
 class ManualScheduler(Scheduler):
@@ -202,5 +280,6 @@ def make_scheduler(kind: str) -> Scheduler:
         "embedded": EmbeddedScheduler,
         "process": ProcessScheduler,
         "manual": ManualScheduler,
+        "node": NodeScheduler,
         "kubernetes": KubernetesScheduler,
     }[kind]()

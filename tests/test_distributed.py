@@ -281,3 +281,43 @@ def test_worker_leader_mode(tmp_path):
     assert asyncio.run(phase2()) == JobState.FINISHED
     counts = read_counts(tmp_path / "out.json")
     assert counts == {k: 25000 for k in range(8)}
+
+
+def test_node_scheduler(tmp_path):
+    """A node daemon offers slots; the controller's node scheduler places
+    real worker subprocesses on it (reference arroyo-node + node
+    scheduler)."""
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.node import NodeServer
+    from arroyo_tpu.controller.scheduler import NodeScheduler
+
+    async def go():
+        c = await ControllerServer(NodeScheduler()).start()
+        node = await NodeServer(
+            c.addr, slots=4,
+            extra_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+                       "PALLAS_AXON_POOL_IPS": ""},
+        ).start()
+        with update(controller={"scheduler": "node"}):
+            await c.submit_job(
+                "nd1", sql=sql_pipeline(tmp_path, n=4000),
+                n_workers=2, parallelism=2,
+            )
+            state = await c.wait_for_state(
+                "nd1", JobState.FINISHED, JobState.FAILED, timeout=90
+            )
+        # stop_workers runs just after the FINISHED transition; let it land
+        for _ in range(100):
+            used = [n.used for n in c.nodes.values()]
+            if used == [0]:
+                break
+            await asyncio.sleep(0.05)
+        await node.stop()
+        await c.stop()
+        return state, used
+
+    state, used = asyncio.run(go())
+    assert state == JobState.FINISHED
+    assert used == [0]  # slots returned after the job
+    counts = read_counts(tmp_path / "out.json")
+    assert counts == {k: 500 for k in range(8)}

@@ -110,6 +110,12 @@ async def _run(args):
         if latest:
             print(f"resuming pipeline {job_id} from epoch "
                   f"{latest['epoch']}")
+        # pipeline metadata rides the state dir (reference MaybeLocalDb)
+        from .api.db import ApiDb
+
+        meta = ApiDb(remote_url=args.state_dir)
+        if not any(p["query"] == sql for p in meta.list_pipelines()):
+            meta.create_pipeline(job_id, sql, args.parallelism)
     else:
         job_id = "job_cli"
     controller = await ControllerServer(

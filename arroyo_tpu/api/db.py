@@ -5,6 +5,13 @@ Capability parity with the reference's database layer
 Postgres, parallel SQLite migrations for `arroyo run`): pipelines, jobs,
 udfs, connection profiles/tables. SQLite only in this build (the reference
 also speaks Postgres); the schema mirrors the reference's logical model.
+
+With `remote_url` set (reference MaybeLocalDb, crates/arroyo run.rs:
+remote state dirs sync the sqlite file through object storage), the db
+file downloads from the storage URL when no local copy exists yet and
+mirrors up after mutations (skipped when nothing changed). Single-writer
+semantics, like the reference's run path: one process owns the remote
+copy at a time; concurrent writers are last-writer-wins.
 """
 
 from __future__ import annotations
@@ -73,14 +80,60 @@ MIGRATIONS = [
 
 
 class ApiDb:
-    def __init__(self, path: str = ":memory:"):
+    REMOTE_KEY = "api/arroyo.db"
+
+    def __init__(self, path: str = ":memory:",
+                 remote_url: Optional[str] = None):
+        self.remote = None
+        self._synced_changes = 0
+        if remote_url:
+            import hashlib
+            import tempfile
+
+            from ..state.storage import StorageProvider
+
+            self.remote = StorageProvider(remote_url)
+            if path == ":memory:":
+                # deterministic per-remote local cache (reused, not leaked)
+                tag = hashlib.sha1(remote_url.encode()).hexdigest()[:10]
+                path = str(Path(tempfile.gettempdir())
+                           / f"arroyo-api-{tag}.db")
+            if not Path(path).exists():
+                # only seed from the remote when there is no local copy —
+                # never silently clobber a populated newer local db
+                blob = self.remote.get(self.REMOTE_KEY)
+                if blob is not None:
+                    Path(path).parent.mkdir(parents=True, exist_ok=True)
+                    Path(path).write_bytes(blob)
         if path != ":memory:":
             Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self.path = path
         self.conn = sqlite3.connect(path)
         self.conn.row_factory = sqlite3.Row
         for m in MIGRATIONS:
             self.conn.execute(m)
         self.conn.commit()
+
+    def _commit(self):
+        """Commit locally, then mirror the whole db file to the remote
+        (the file is small; the reference syncs it wholesale too). The
+        upload is skipped when no rows actually changed (polling callers
+        re-write identical state at 5Hz) and is best-effort: a transient
+        storage error must not fail a mutation that already committed."""
+        self.conn.commit()
+        if self.remote is None or self.path == ":memory:":
+            return
+        if self.conn.total_changes == self._synced_changes:
+            return
+        try:
+            self.remote.put(self.REMOTE_KEY, Path(self.path).read_bytes())
+            self._synced_changes = self.conn.total_changes
+        except Exception as e:  # noqa: BLE001
+            import logging
+
+            logging.getLogger("arroyo.api").warning(
+                "remote db sync failed (will retry on next change): %s", e
+            )
 
     # -- pipelines ----------------------------------------------------------
 
@@ -93,7 +146,7 @@ class ApiDb:
             (pid, name, query, parallelism, "Created",
              json.dumps(graph_json) if graph_json else None, time.time()),
         )
-        self.conn.commit()
+        self._commit()
         return self.get_pipeline(pid)
 
     def list_pipelines(self) -> List[dict]:
@@ -109,15 +162,18 @@ class ApiDb:
         return self._pipeline(r) if r else None
 
     def set_pipeline_state(self, pid: str, state: str):
+        # value-guarded: pollers re-write identical state at 5Hz, and a
+        # no-op UPDATE would still count as a change for the remote sync
         self.conn.execute(
-            "UPDATE pipelines SET state = ? WHERE id = ?", (state, pid)
+            "UPDATE pipelines SET state = ? WHERE id = ? AND state != ?",
+            (state, pid, state),
         )
-        self.conn.commit()
+        self._commit()
 
     def delete_pipeline(self, pid: str):
         self.conn.execute("DELETE FROM jobs WHERE pipeline_id = ?", (pid,))
         self.conn.execute("DELETE FROM pipelines WHERE id = ?", (pid,))
-        self.conn.commit()
+        self._commit()
 
     @staticmethod
     def _pipeline(r) -> dict:
@@ -139,7 +195,7 @@ class ApiDb:
             "VALUES (?,?,?,?)",
             (jid, pipeline_id, "Created", time.time()),
         )
-        self.conn.commit()
+        self._commit()
         return {"id": jid, "pipeline_id": pipeline_id, "state": "Created"}
 
     def update_job(self, jid: str, state: str,
@@ -149,12 +205,14 @@ class ApiDb:
             if state in ("Finished", "Failed", "Stopped")
             else None
         )
+        # value-guarded like set_pipeline_state (5Hz pollers)
         self.conn.execute(
             "UPDATE jobs SET state = ?, restarts = COALESCE(?, restarts), "
-            "finished_at = COALESCE(?, finished_at) WHERE id = ?",
-            (state, restarts, finished, jid),
+            "finished_at = COALESCE(?, finished_at) WHERE id = ? AND "
+            "(state != ? OR restarts != COALESCE(?, restarts))",
+            (state, restarts, finished, jid, state, restarts),
         )
-        self.conn.commit()
+        self._commit()
 
     def jobs_for_pipeline(self, pid: str) -> List[dict]:
         rows = self.conn.execute(
@@ -178,7 +236,7 @@ class ApiDb:
             "created_at) VALUES (?,?,?,?,?,?)",
             (uid, prefix, name, definition, language, time.time()),
         )
-        self.conn.commit()
+        self._commit()
         return {"id": uid, "name": name, "definition": definition,
                 "language": language}
 
@@ -189,7 +247,7 @@ class ApiDb:
 
     def delete_udf(self, uid: str):
         self.conn.execute("DELETE FROM udfs WHERE id = ?", (uid,))
-        self.conn.commit()
+        self._commit()
 
     # -- connections --------------------------------------------------------
 
@@ -201,7 +259,7 @@ class ApiDb:
             "created_at) VALUES (?,?,?,?,?)",
             (cid, name, connector, json.dumps(config), time.time()),
         )
-        self.conn.commit()
+        self._commit()
         return {"id": cid, "name": name, "connector": connector,
                 "config": config}
 
@@ -226,7 +284,7 @@ class ApiDb:
             (cid, name, connector, profile_id, json.dumps(config),
              json.dumps(schema) if schema else None, table_type, time.time()),
         )
-        self.conn.commit()
+        self._commit()
         return {"id": cid, "name": name, "connector": connector,
                 "config": config, "table_type": table_type}
 
@@ -245,4 +303,4 @@ class ApiDb:
 
     def delete_connection_table(self, cid: str):
         self.conn.execute("DELETE FROM connection_tables WHERE id = ?", (cid,))
-        self.conn.commit()
+        self._commit()

@@ -42,7 +42,10 @@ class ApiServer:
     def __init__(self, controller: Optional[ControllerServer] = None,
                  db_path: Optional[str] = None):
         self.controller = controller
-        self.db = ApiDb(db_path or config().database.path)
+        self.db = ApiDb(
+            db_path or config().database.path,
+            remote_url=config().database.remote_url or None,
+        )
         self.previews: dict = {}  # pipeline id -> preview rows list
 
     # -- pipelines ----------------------------------------------------------

@@ -121,6 +121,8 @@ class AdminConfig:
 class DatabaseConfig:
     backend: str = "sqlite"  # sqlite | postgres(stub)
     path: str = "/tmp/arroyo-tpu/arroyo.db"
+    # storage URL to sync the sqlite file through (reference MaybeLocalDb)
+    remote_url: str = ""
 
 
 @dataclasses.dataclass

@@ -307,3 +307,24 @@ def test_admin_server():
     assert "# HELP" in metrics or metrics.strip() == ""
     assert "RUNNING" in tasks
     assert "File" in stacks or "Thread" in stacks
+
+
+def test_api_db_remote_sync(tmp_path):
+    """MaybeLocalDb semantics: the sqlite file syncs through a storage URL
+    — a fresh ApiDb pointed at the same remote sees prior state."""
+    from arroyo_tpu.api.db import ApiDb
+
+    remote = str(tmp_path / "remote")
+    db1 = ApiDb(str(tmp_path / "local1.db"), remote_url=remote)
+    p = db1.create_pipeline("synced", "SELECT 1", 1)
+    udf = db1.create_udf("f", "def f(): pass")
+    # a second instance (different local path) restores from the remote
+    db2 = ApiDb(str(tmp_path / "local2.db"), remote_url=remote)
+    assert [x["name"] for x in db2.list_pipelines()] == ["synced"]
+    assert [x["name"] for x in db2.list_udfs()] == ["f"]
+    # mutations through db2 propagate onward
+    db2.delete_pipeline(p["id"])
+    db3 = ApiDb(str(tmp_path / "local3.db"), remote_url=remote)
+    assert db3.list_pipelines() == []
+    assert db3.get_pipeline(p["id"]) is None
+    assert [x["id"] for x in db3.list_udfs()] == [udf["id"]]

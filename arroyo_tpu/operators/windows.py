@@ -153,9 +153,13 @@ class WindowOperatorBase(Operator):
         start: int,
         end: int,
         ts_value: Optional[int] = None,
+        key_arrays: Optional[List[np.ndarray]] = None,
     ) -> pa.RecordBatch:
-        """Build an output batch for one window [start, end)."""
-        n = len(keys)
+        """Build an output batch for one window [start, end). `key_arrays`
+        (one int64 array per key column, raw directory bit-patterns) is the
+        vectorized fast path used by the native-directory emit — no python
+        tuple per key."""
+        n = len(key_arrays[0]) if key_arrays is not None else len(keys)
         window_field = getattr(self, "window_field", None)
         arrays = []
         for f in self.out_schema.schema:
@@ -187,8 +191,17 @@ class WindowOperatorBase(Operator):
                 )
             elif f.name in (self._key_names or []):
                 ki = self._key_names.index(f.name)
-                vals = [_to_py(k[ki]) for k in keys]
                 kt = self._key_types[ki]
+                if key_arrays is not None:
+                    arr = key_arrays[ki]
+                    if pa.types.is_unsigned_integer(kt):
+                        arrays.append(
+                            pa.array(arr.view(np.uint64), type=kt)
+                        )
+                    else:  # signed ints and timestamps cast directly
+                        arrays.append(pa.array(arr).cast(kt))
+                    continue
+                vals = [_to_py(k[ki]) for k in keys]
                 if pa.types.is_struct(kt):
                     tuples = [unintern_value(v) for v in vals]
                     children = [
@@ -535,12 +548,15 @@ class SlidingWindowOperator(WindowOperatorBase):
                 slot_chunks.append(slots_b)
         if slot_chunks:
             all_slots = np.concatenate(slot_chunks)
+            key_arrays = None
             if isinstance(key_chunks[0], np.ndarray):
-                # native path: vectorized key-union over int64 arrays
+                # native path: vectorized key-union over int64 arrays; keys
+                # stay numpy end-to-end (no python tuple per key)
                 all_keys = np.concatenate(key_chunks)
                 uniq, seg_ids = np.unique(all_keys, return_inverse=True)
                 if self.key_cols:
-                    out_keys = [(int(k),) for k in uniq]
+                    out_keys = []
+                    key_arrays = [uniq]
                 else:
                     out_keys = [() for _ in uniq]
                 n_keys = len(uniq)
@@ -560,7 +576,8 @@ class SlidingWindowOperator(WindowOperatorBase):
             )
             agg_cols = self.acc.finalize(combined)
             out_batch = self._build_output(
-                out_keys, agg_cols, end - self.width, end
+                out_keys, agg_cols, end - self.width, end,
+                key_arrays=key_arrays,
             )
             await collector.collect(out_batch)
         # the oldest bin exits the window range: free it

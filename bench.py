@@ -123,6 +123,60 @@ def child(events: int, backend: str, query: str = "q5") -> None:
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
+def latency_child(rate: int, seconds: float, backend: str) -> None:
+    """Run q5 against a REALTIME source and measure end-to-end latency:
+    wall-clock arrival at the sink minus the window-end event time each
+    result row became emittable. Prints 'LATENCY <p50_ms> <p99_ms> <rows>'."""
+    import asyncio
+    import time
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from arroyo_tpu.config import config
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.sql import plan_query
+
+    config().tpu.enabled = backend == "jax"
+    events = int(rate * seconds)
+    start_ns = time.time_ns()
+    sql = QUERIES["q5"].format(rate=rate, events=events)
+    assert "start_time = '0'" in sql, "latency bench: DDL shape changed"
+    sql = sql.replace(
+        "start_time = '0'",
+        f"start_time = '{start_ns}', realtime = 'true'",
+    )
+    lat_ms = []
+
+    class LatencySink(list):
+        # the vec sink delivers rows via extend()
+        def extend(self, rows):
+            now = time.time_ns()
+            for row in rows:
+                lat_ms.append((now - row["_timestamp"].value) / 1e6)
+
+    plan = plan_query(sql, preview_results=LatencySink())
+
+    async def go():
+        eng = Engine(plan.graph).start()
+        await eng.join(seconds * 3 + 120)
+
+    try:
+        asyncio.run(go())
+    finally:
+        # report whatever was measured even if the engine raised. The
+        # end-of-stream flush emits not-yet-complete windows whose end
+        # lies in the future (negative "latency"); only steady-state
+        # emissions count.
+        arr = np.asarray(lat_ms)
+        arr = arr[arr > 0]
+        if len(arr):
+            print(f"LATENCY {np.percentile(arr, 50):.1f} "
+                  f"{np.percentile(arr, 99):.1f} {len(arr)}", flush=True)
+        else:
+            print("LATENCY nan nan 0", flush=True)
+
+
 def run_child(events: int, backend: str, timeout: float, env=None,
               query: str = "q5"):
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
@@ -148,7 +202,14 @@ def main():
     ap.add_argument("--child", choices=["numpy", "jax"])
     ap.add_argument("--query", choices=sorted(QUERIES), default="q5")
     ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--latency-child", choices=["numpy", "jax"])
+    ap.add_argument("--latency-rate", type=int, default=50_000)
+    ap.add_argument("--latency-seconds", type=float, default=12.0)
     args = ap.parse_args()
+    if args.latency_child:
+        latency_child(args.latency_rate, args.latency_seconds,
+                      args.latency_child)
+        return
     if args.child:
         child(args.events, args.child, args.query)
         return
@@ -173,6 +234,28 @@ def main():
                       env=side_env, query=q)
         # 0 = that query failed/timed out (distinguishable from "not run")
         sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
+    # end-to-end latency (realtime q5; includes the source watermark delay)
+    lat_cmd = [sys.executable, os.path.abspath(__file__),
+               "--latency-child", side_backend,
+               "--latency-rate", str(args.latency_rate),
+               "--latency-seconds", str(args.latency_seconds)]
+    try:
+        # child's own join deadline is seconds*3+120; give startup slack
+        out = subprocess.run(lat_cmd, capture_output=True, text=True,
+                             timeout=args.latency_seconds * 3 + 240,
+                             env=side_env)
+        got = False
+        for line in out.stdout.splitlines():
+            if line.startswith("LATENCY "):
+                _, p50, p99, rows = line.split()
+                if rows != "0":
+                    sides["q5_p50_ms"] = float(p50)
+                    sides["q5_p99_ms"] = float(p99)
+                got = True
+        if not got:
+            sys.stderr.write(out.stderr[-2000:] + "\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("latency child timed out\n")
     if device is None:
         device = baseline
     if baseline is None:

@@ -7,7 +7,10 @@ zero-width bin (rows of the same emitted window share one _timestamp) and
 joins bin-by-bin when the watermark passes; the expiring join buffers both
 sides in time-key state with a TTL and emits matches symmetrically as rows
 arrive. The bin-local equi-join runs on Arrow's C++ hash join
-(pa.Table.join); residual predicates filter after the join.
+(pa.Table.join); residual predicates carry ON-clause semantics — a plain
+post-filter for inner joins, and for outer joins an inner+residual pass
+followed by an anti-join that re-emits unmatched preserved-side rows
+null-padded (see _join_tables).
 """
 
 from __future__ import annotations
@@ -65,19 +68,116 @@ class JoinBase(Operator):
     def _join_tables(
         self, left: pa.Table, right: pa.Table, ts_value: int
     ) -> Optional[pa.RecordBatch]:
-        """Bin-local equi-join + residual + output schema normalization."""
+        """Bin-local equi-join + residual + output schema normalization.
+
+        For outer joins the residual predicate is part of the ON condition,
+        not a post-filter: a preserved-side row whose matches all fail the
+        residual must still be emitted null-padded, and null-padded rows
+        must not be dropped by a null-valued residual. We join inner with
+        the residual, then anti-join to synthesize the null-padded rows
+        (reference behavior comes from DataFusion's join filters)."""
         lkeys = [f"__key{i}" for i in range(self.n_keys)]
         left_nt = _flatten_structs(left.drop_columns([TIMESTAMP_FIELD]))
         right_nt = _flatten_structs(right.drop_columns([TIMESTAMP_FIELD]))
-        joined = left_nt.join(
-            right_nt,
+        if self.residual is None or self.join_type == "inner":
+            joined = left_nt.join(
+                right_nt,
+                keys=lkeys,
+                right_keys=lkeys,
+                join_type=_JOIN_TYPE_MAP[self.join_type],
+                left_suffix="",
+                right_suffix="_right",
+                coalesce_keys=True,
+            )
+            batch = self._project(joined, ts_value)
+            if batch is None:
+                return None
+            if self.residual is not None:
+                batch = batch.filter(self.residual(batch))
+            return batch if batch.num_rows else None
+
+        import pyarrow.compute as pc
+
+        left_i = left_nt.append_column(
+            "__lidx", pa.array(np.arange(left_nt.num_rows, dtype=np.int64))
+        )
+        right_i = right_nt.append_column(
+            "__ridx", pa.array(np.arange(right_nt.num_rows, dtype=np.int64))
+        )
+        joined = left_i.join(
+            right_i,
             keys=lkeys,
             right_keys=lkeys,
-            join_type=_JOIN_TYPE_MAP[self.join_type],
+            join_type="inner",
             left_suffix="",
             right_suffix="_right",
             coalesce_keys=True,
         )
+        parts: List[pa.RecordBatch] = []
+        matched_l = np.empty(0, dtype=np.int64)
+        matched_r = np.empty(0, dtype=np.int64)
+        if joined.num_rows:
+            batch = self._project(joined, ts_value)
+            mask = pc.fill_null(self.residual(batch), False)
+            mask_np = np.asarray(mask)
+            if mask_np.any():
+                matched_l = np.unique(
+                    np.asarray(joined.column("__lidx").combine_chunks())[
+                        mask_np
+                    ]
+                )
+                matched_r = np.unique(
+                    np.asarray(joined.column("__ridx").combine_chunks())[
+                        mask_np
+                    ]
+                )
+                parts.append(batch.filter(mask))
+        if self.join_type in ("left", "full"):
+            unmatched = np.setdiff1d(
+                np.arange(left_nt.num_rows, dtype=np.int64), matched_l
+            )
+            if len(unmatched):
+                pad = left_nt.take(pa.array(unmatched)).join(
+                    right_nt.slice(0, 0),
+                    keys=lkeys,
+                    right_keys=lkeys,
+                    join_type="left outer",
+                    left_suffix="",
+                    right_suffix="_right",
+                    coalesce_keys=True,
+                )
+                part = self._project(pad, ts_value)
+                if part is not None:
+                    parts.append(part)
+        if self.join_type in ("right", "full"):
+            unmatched = np.setdiff1d(
+                np.arange(right_nt.num_rows, dtype=np.int64), matched_r
+            )
+            if len(unmatched):
+                pad = left_nt.slice(0, 0).join(
+                    right_nt.take(pa.array(unmatched)),
+                    keys=lkeys,
+                    right_keys=lkeys,
+                    join_type="right outer",
+                    left_suffix="",
+                    right_suffix="_right",
+                    coalesce_keys=True,
+                )
+                part = self._project(pad, ts_value)
+                if part is not None:
+                    parts.append(part)
+        parts = [p for p in parts if p is not None and p.num_rows]
+        if not parts:
+            return None
+        if len(parts) == 1:
+            return parts[0]
+        return (
+            pa.Table.from_batches(parts).combine_chunks().to_batches()[0]
+        )
+
+    def _project(
+        self, joined: pa.Table, ts_value: int
+    ) -> Optional[pa.RecordBatch]:
         if joined.num_rows == 0:
             return None
         arrays = []
@@ -90,13 +190,9 @@ class JoinBase(Operator):
                 )
                 continue
             arrays.append(_take_col(joined, f))
-        batch = pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
-        if self.residual is not None:
-            mask = self.residual(batch)
-            batch = batch.filter(mask)
-            if batch.num_rows == 0:
-                return None
-        return batch
+        return pa.RecordBatch.from_arrays(
+            arrays, schema=self.out_schema.schema
+        )
 
 
 _SEP = "\x01"  # struct-flattening separator (acero rejects struct columns)

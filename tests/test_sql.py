@@ -170,6 +170,62 @@ def test_windowed_join_with_residual():
     assert len(rows) % 4 == 0
 
 
+def test_windowed_left_join_residual_null_pads():
+    """LEFT JOIN residuals carry ON-clause semantics: a left row whose
+    matches all fail the residual emits null-padded instead of being
+    dropped, and null-padded rows survive a null-valued residual."""
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT A.k as k, B.num as bnum
+        FROM (
+          SELECT counter % 4 as k, count(*) as num,
+                 tumble(interval '10 millisecond') as w
+          FROM impulse GROUP BY 1, w
+        ) A
+        LEFT JOIN (
+          SELECT counter % 4 as k, count(*) as num,
+                 tumble(interval '10 millisecond') as w
+          FROM impulse GROUP BY 1, w
+        ) B
+        ON A.w = B.w AND A.k = B.k AND B.k < 2;
+        """
+    )
+    matched = sorted(r["k"] for r in rows if r["bnum"] is not None)
+    padded = sorted(r["k"] for r in rows if r["bnum"] is None)
+    assert matched and set(matched) == {0, 1}
+    assert padded and set(padded) == {2, 3}
+    assert len(matched) == len(padded)
+
+
+def test_windowed_full_join_residual_null_pads_both_sides():
+    """FULL JOIN with an always-false residual emits every row of both
+    sides null-padded (previously: emitted nothing)."""
+    rows = run_sql(
+        IMPULSE_DDL
+        + """
+        SELECT A.num as anum, B.num as bnum
+        FROM (
+          SELECT counter % 2 as k, count(*) as num,
+                 tumble(interval '10 millisecond') as w
+          FROM impulse GROUP BY 1, w
+        ) A
+        FULL JOIN (
+          SELECT counter % 4 as k, count(*) as num,
+                 tumble(interval '10 millisecond') as w
+          FROM impulse GROUP BY 1, w
+        ) B
+        ON A.w = B.w AND A.k = B.k AND A.num < 0;
+        """
+    )
+    assert rows
+    left_only = [r for r in rows if r["bnum"] is None and r["anum"] is not None]
+    right_only = [r for r in rows if r["anum"] is None and r["bnum"] is not None]
+    assert not [r for r in rows if r["anum"] is not None and r["bnum"] is not None]
+    # per window: A has 2 groups, B has 4 groups, all preserved unmatched
+    assert len(left_only) * 2 == len(right_only)
+
+
 def test_union_all():
     rows = run_sql(
         IMPULSE_DDL

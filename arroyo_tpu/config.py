@@ -80,6 +80,11 @@ class TpuConfig:
     shape_buckets: tuple = (256, 1024, 4096, 16384, 65536)
     max_keys_per_shard: int = 1 << 20  # device state capacity per subtask
     donate_state: bool = True
+    # >= 2: window operators keep accumulator state sharded across this
+    # many mesh devices and shuffle rows on-device with an in-step
+    # all_to_all instead of the host hash shuffle (parallel/sharded_state)
+    mesh_devices: int = 0
+    mesh_rows_per_shard: int = 1024  # all_to_all rows per (src, dst) cell
 
 
 @dataclasses.dataclass

@@ -58,21 +58,68 @@ class WindowOperatorBase(Operator):
         self.window_end_field: Optional[str] = config.get("window_end_field")
         self.window_field: Optional[str] = config.get("window_field")
         self.backend = config.get("backend")
-        self.acc = make_accumulator(self.specs, backend=self.backend)
-        self.dir = SlotDirectory()
+        mesh_n = self._mesh_devices(config)
+        if mesh_n >= 2:
+            from ..parallel import (
+                MeshSlotDirectory,
+                ShardedAccumulator,
+                key_mesh,
+            )
+
+            from ..config import config as config_fn
+
+            self.acc = ShardedAccumulator(
+                self.specs,
+                key_mesh(self._mesh_device_list(mesh_n)),
+                rows_per_shard=config_fn().tpu.mesh_rows_per_shard,
+            )
+            self.dir = MeshSlotDirectory(mesh_n)
+        else:
+            self.acc = make_accumulator(self.specs, backend=self.backend)
+            self.dir = SlotDirectory()
         self._key_types: Optional[List[pa.DataType]] = None
         self._key_names: Optional[List[str]] = None
 
     # operators that only use assign/take_bin/bin_entries/items can swap in
     # the C++ directory for single-integer keys (tumbling, sliding)
     _native_ok = False
+    # operators whose state protocol is slot-based end to end can run on
+    # the mesh-sharded accumulator (tumbling, sliding; session bookkeeping
+    # allocates slots imperatively and stays host-side)
+    _mesh_ok = False
+
+    def _mesh_devices(self, config: dict) -> int:
+        if not self._mesh_ok or self.backend == "numpy":
+            return 0
+        from ..config import config as config_fn
+
+        n = config.get("mesh_devices")
+        if n is None:
+            n = config_fn().tpu.mesh_devices
+        return int(n or 0) if config_fn().tpu.enabled else 0
+
+    @staticmethod
+    def _mesh_device_list(n: int):
+        import jax
+
+        devices = jax.devices()
+        if len(devices) < n:
+            raise ValueError(
+                f"tpu.mesh_devices={n} but only {len(devices)} devices "
+                "are visible"
+            )
+        return devices[:n]
 
     def _capture_key_meta(self, ctx):
         if self._key_types is None:
             in_schema = ctx.in_schemas[0].schema
             self._key_types = [in_schema.field(i).type for i in self.key_cols]
             self._key_names = [in_schema.field(i).name for i in self.key_cols]
-            if self._native_ok and self.dir.n_live == 0:
+            if (
+                self._native_ok
+                and isinstance(self.dir, SlotDirectory)
+                and self.dir.n_live == 0
+            ):
                 from ..ops.native import (
                     NativeSlotDirectory,
                     load_native,
@@ -370,6 +417,7 @@ def _ceil_div(a: int, b: int) -> int:
 
 class TumblingWindowOperator(WindowOperatorBase):
     _native_ok = True
+    _mesh_ok = True
 
     """Fixed-width windows: bin = ts // width; emit at watermark >= end
     (reference tumbling_aggregating_window.rs:66-321).
@@ -461,6 +509,7 @@ class SlidingWindowOperator(WindowOperatorBase):
     Requires width % slide == 0."""
 
     _native_ok = True
+    _mesh_ok = True
 
     def __init__(self, config: dict):
         super().__init__(config, "sliding_window")

@@ -132,18 +132,24 @@ class Accumulator:
     # -- capacity -----------------------------------------------------------
 
     def grow(self, min_capacity: int):
+        # 4x steps (not 2x): every growth re-specializes the jitted
+        # update/gather/reset programs for the new state shape, so fewer,
+        # larger jumps bound recompilation churn at high cardinality
         new_cap = self.capacity
         while new_cap < min_capacity:
-            new_cap *= 2
+            new_cap *= 4
         if new_cap == self.capacity:
             return
+        # the old scratch slot (capacity-1) absorbed padded-row scatters;
+        # it becomes an allocatable slot after growth and must restart
+        # from neutral
         if self.backend == "jax":
             jnp = _get_jax().numpy
             self.state = [
                 jnp.concatenate(
                     [s, jnp.full(new_cap - self.capacity,
                                  _neutral(op, dt), dtype=_np_dtype(dt))]
-                )
+                ).at[self.capacity - 1].set(_neutral(op, dt))
                 for s, (op, dt, _, _) in zip(self.state, self.phys)
             ]
         else:
@@ -154,6 +160,8 @@ class Accumulator:
                 )
                 for s, (op, dt, _, _) in zip(self.state, self.phys)
             ]
+            for (op, dt, _, _), s in zip(self.phys, self.state):
+                s[self.capacity - 1] = _neutral(op, dt)
         self.capacity = new_cap
 
     # -- update (hot path) --------------------------------------------------
@@ -168,26 +176,8 @@ class Accumulator:
         n = len(slots)
         if n == 0:
             return
-        if signs is not None and (
-            self.udaf_idx or any(op != "add" for op, _, _, _ in self.phys)
-        ):
-            raise ValueError(
-                "signed (retractable) update requires invertible aggregates "
-                "(count/sum/avg)"
-            )
-        if self.udaf_idx:
-            order = np.argsort(slots, kind="stable")
-            s_sorted = slots[order]
-            bounds = np.nonzero(np.diff(s_sorted))[0] + 1
-            starts = np.concatenate([[0], bounds])
-            ends = np.concatenate([bounds, [n]])
-            for si in self.udaf_idx:
-                vals = cols[self.specs[si].col][order]
-                store = self.udaf_store[si]
-                for lo, hi in zip(starts, ends):
-                    store.setdefault(int(s_sorted[lo]), []).append(
-                        vals[lo:hi]
-                    )
+        self._check_signed(signs)
+        self._buffer_udafs(slots, cols)
         if not self.phys:
             return
         if self.backend == "numpy":
@@ -214,6 +204,30 @@ class Accumulator:
                     vals[n:] = _neutral(op, dt)
             inputs.append(jnp.asarray(vals))
         self.state = self._update_fn(self.state, jnp.asarray(slots_p), *inputs)
+
+    def _check_signed(self, signs: Optional[np.ndarray]):
+        if signs is not None and (
+            self.udaf_idx or any(op != "add" for op, _, _, _ in self.phys)
+        ):
+            raise ValueError(
+                "signed (retractable) update requires invertible aggregates "
+                "(count/sum/avg)"
+            )
+
+    def _buffer_udafs(self, slots: np.ndarray, cols: Dict[int, np.ndarray]):
+        if not self.udaf_idx:
+            return
+        n = len(slots)
+        order = np.argsort(slots, kind="stable")
+        s_sorted = slots[order]
+        bounds = np.nonzero(np.diff(s_sorted))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [n]])
+        for si in self.udaf_idx:
+            vals = cols[self.specs[si].col][order]
+            store = self.udaf_store[si]
+            for lo, hi in zip(starts, ends):
+                store.setdefault(int(s_sorted[lo]), []).append(vals[lo:hi])
 
     def _make_update_fn(self):
         jax = _get_jax()
@@ -281,12 +295,15 @@ class Accumulator:
 
         return gather
 
-    def reset_slots(self, slots: np.ndarray):
-        """Return emitted slots to neutral so they can be reused."""
+    def _drop_udaf_slots(self, slots: np.ndarray):
         for si in self.udaf_idx:
             store = self.udaf_store[si]
             for s in slots:
                 store.pop(int(s), None)
+
+    def reset_slots(self, slots: np.ndarray):
+        """Return emitted slots to neutral so they can be reused."""
+        self._drop_udaf_slots(slots)
         if len(slots) == 0 or not self.phys:
             return
         if self.backend == "numpy":
@@ -403,19 +420,28 @@ class Accumulator:
             ))
         return out
 
+    def _restore_udaf_cols(
+        self, slots: np.ndarray, values: List[np.ndarray]
+    ) -> List[np.ndarray]:
+        """Consume trailing UDAF value-buffer columns; returns the physical
+        accumulator columns."""
+        if not self.udaf_idx:
+            return values
+        n_phys = len(self.phys)
+        udaf_cols = values[n_phys:]
+        values = values[:n_phys]
+        for si, col in zip(self.udaf_idx, udaf_cols):
+            store = self.udaf_store[si]
+            for s, vals in zip(slots, col):
+                arr = np.asarray(list(vals))
+                if len(arr):
+                    store.setdefault(int(s), []).append(arr)
+        return values
+
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
         """Write physical accumulator values back into `slots` (the tail
         columns are UDAF value buffers when UDAF specs exist)."""
-        if self.udaf_idx:
-            n_phys = len(self.phys)
-            udaf_cols = values[n_phys:]
-            values = values[:n_phys]
-            for si, col in zip(self.udaf_idx, udaf_cols):
-                store = self.udaf_store[si]
-                for s, vals in zip(slots, col):
-                    arr = np.asarray(list(vals))
-                    if len(arr):
-                        store.setdefault(int(s), []).append(arr)
+        values = self._restore_udaf_cols(slots, values)
         if len(slots) == 0 or not self.phys:
             return
         if self.backend == "numpy":
@@ -429,7 +455,7 @@ class Accumulator:
         ]
 
     def block_until_ready(self):
-        if self.backend == "jax":
+        if self.backend != "numpy":
             for s in self.state:
                 s.block_until_ready()
 

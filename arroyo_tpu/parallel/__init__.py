@@ -1,2 +1,5 @@
 from .mesh import key_mesh  # noqa: F401
-from .sharded_state import ShardedAccumulator  # noqa: F401
+from .sharded_state import (  # noqa: F401
+    MeshSlotDirectory,
+    ShardedAccumulator,
+)

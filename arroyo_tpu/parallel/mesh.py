@@ -12,6 +12,14 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 
+def _get_jnp():
+    """jax.numpy with x64 enabled (routes through ops.aggregates so the
+    enable-x64 flag is set exactly once, before any tracing)."""
+    from ..ops.aggregates import _get_jax
+
+    return _get_jax().numpy
+
+
 def key_mesh(devices: Optional[Sequence] = None, axis: str = "keys"):
     import jax
     from jax.sharding import Mesh

@@ -2,117 +2,307 @@
 
 The reference scales keyed aggregation by running parallel subtasks wired
 with a TCP shuffle (/root/reference/crates/arroyo-worker/src/
-network_manager.rs). The TPU-native equivalent keeps ALL key shards'
-accumulator state resident on a device mesh and replaces the network
-shuffle with one `jax.lax.all_to_all` over ICI inside the jitted step:
+network_manager.rs; engine.rs:209-365 is the subtask wiring). The
+TPU-native equivalent keeps ALL key shards' accumulator state resident on
+a device mesh and replaces the network shuffle with one
+`jax.lax.all_to_all` over ICI inside the jitted step:
 
-    host: rows -> (device_owner, local_slot) routing  [hash-range mapping]
+    host: rows -> global slots   [MeshSlotDirectory: hash keys to an
+                                  owning shard; per-shard directories
+                                  assign local slots]
     device (shard_map over 1-D "keys" mesh):
-        all_to_all route rows to their owning shard -> scatter-reduce into
-        the local accumulator shard
-    emission: gather per-shard slots (device->host once per watermark)
+        all_to_all routes rows to their owning shard -> scatter-reduce
+        into the local accumulator shard
+    emission: jitted (shard, slot) gather -> host, once per watermark
 
-One jitted step per batch; state never leaves HBM between batches. The
-same `server_for_hash` ranges used by the host shuffle assign keys to
-devices, so host-parallel and mesh-parallel run produce identical
-partitioning.
+One jitted step per batch; state never leaves HBM between batches. This is
+an *engine execution mode*, not a demo: window operators construct this
+pair when `tpu.mesh_devices >= 2` (operators/windows.py) and run their
+normal assign/update/gather/checkpoint protocol against it — global slots
+encode (shard, local slot) so every Accumulator API carries over.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..ops.aggregates import AggSpec, _neutral, _np_dtype
+from ..ops.aggregates import (
+    Accumulator,
+    AggSpec,
+    _bucket,
+    _neutral,
+    _np_dtype,
+)
 from ..ops.directory import SlotDirectory
-from ..types import server_for_hash_array
+from ..types import hash_arrays, hash_column, server_for_hash_array
+
+# global slot encoding: slot = shard * STRIDE + local. The stride is fixed
+# (not the current capacity) so capacity growth never re-numbers live slots.
+STRIDE = 1 << 32
 
 
-class ShardedAccumulator:
-    """Accumulator slots sharded across a 1-D device mesh; updates route
-    rows to their owning device with an in-step all_to_all."""
+class MeshSlotDirectory:
+    """SlotDirectory facade over per-shard directories: keys hash to an
+    owning shard (same splitmix64 hashing as the host shuffle), the shard's
+    directory assigns a local slot, and callers see global slots."""
 
-    def __init__(self, specs: List[AggSpec], mesh, capacity_per_shard: int = 4096,
-                 rows_per_shard: int = 1024):
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def __init__(self, n_shards: int):
+        self.n_shards = n_shards
+        self.dirs = [SlotDirectory() for _ in range(n_shards)]
 
-        jax.config.update("jax_enable_x64", True)
-        self.specs = specs
+    @property
+    def n_live(self) -> int:
+        return sum(d.n_live for d in self.dirs)
+
+    @property
+    def by_bin(self):
+        # truthiness/membership probe ("anything live?", "which bins?") —
+        # values are True like the native directory, not per-key maps, so
+        # the per-watermark check stays O(bins) not O(keys)
+        return {b: True for d in self.dirs for b in d.by_bin}
+
+    def required_capacity(self) -> int:
+        """Per-shard capacity needed (max across shards, + scratch)."""
+        return max(d.required_capacity() for d in self.dirs)
+
+    def owners_for(self, key_cols: List[np.ndarray], n_rows: int) -> np.ndarray:
+        if not key_cols:
+            return np.zeros(n_rows, dtype=np.int64)
+        return server_for_hash_array(
+            hash_arrays([hash_column(c) for c in key_cols]), self.n_shards
+        )
+
+    def assign(
+        self, bins: np.ndarray, key_cols: List[np.ndarray]
+    ) -> np.ndarray:
+        n = len(bins)
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        owners = self.owners_for(key_cols, n)
+        out = np.empty(n, dtype=np.int64)
+        for shard in range(self.n_shards):
+            sel = np.nonzero(owners == shard)[0]
+            if len(sel) == 0:
+                continue
+            local = self.dirs[shard].assign(
+                bins[sel], [c[sel] for c in key_cols]
+            )
+            out[sel] = shard * STRIDE + local
+        return out
+
+    def bins_up_to(self, bin_exclusive: int) -> List[int]:
+        bins = set()
+        for d in self.dirs:
+            bins.update(b for b in d.by_bin if b < bin_exclusive)
+        return sorted(bins)
+
+    def live_bins(self) -> List[int]:
+        bins = set()
+        for d in self.dirs:
+            bins.update(d.by_bin)
+        return sorted(bins)
+
+    def peek_bin(self, b: int) -> Optional[dict]:
+        out = {}
+        for shard, d in enumerate(self.dirs):
+            m = d.peek_bin(b)
+            if m:
+                for key, slot in m.items():
+                    out[key] = shard * STRIDE + slot
+        return out or None
+
+    def bin_entries(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+        keys: List[tuple] = []
+        slot_chunks: List[np.ndarray] = []
+        for shard, d in enumerate(self.dirs):
+            k, s = d.bin_entries(b)
+            keys.extend(k)
+            slot_chunks.append(s + shard * STRIDE)
+        return keys, (
+            np.concatenate(slot_chunks)
+            if slot_chunks
+            else np.empty(0, dtype=np.int64)
+        )
+
+    def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+        keys: List[tuple] = []
+        slot_chunks: List[np.ndarray] = []
+        for shard, d in enumerate(self.dirs):
+            k, s = d.take_bin(b)
+            keys.extend(k)
+            slot_chunks.append(s + shard * STRIDE)
+        return keys, (
+            np.concatenate(slot_chunks)
+            if slot_chunks
+            else np.empty(0, dtype=np.int64)
+        )
+
+    def items(self):
+        for shard, d in enumerate(self.dirs):
+            for b, key, slot in d.items():
+                yield b, key, shard * STRIDE + slot
+
+
+class ShardedAccumulator(Accumulator):
+    """Accumulator whose slot arrays live sharded across a 1-D device mesh;
+    updates route rows to their owning device with an in-step all_to_all.
+    Slots are MeshSlotDirectory global slots (shard * STRIDE + local)."""
+
+    def __init__(
+        self,
+        specs: List[AggSpec],
+        mesh,
+        capacity_per_shard: int = 4096,
+        rows_per_shard: int = 1024,
+    ):
+        # initialize host-side bookkeeping via the base class with backend
+        # 'numpy' (cheap), then replace the state with mesh-sharded arrays
+        super().__init__(specs, capacity=capacity_per_shard, backend="numpy")
+        self.backend = "jax-mesh"
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
-        self.capacity = capacity_per_shard  # last slot of each shard = scratch
         self.rows_per_shard = rows_per_shard
-        self.phys: List[Tuple[str, str, str, int]] = []
-        for si, spec in enumerate(specs):
-            for op, dtype, src in spec.phys():
-                self.phys.append((op, dtype, src, si))
-        sharding = NamedSharding(mesh, P(self.axis, None))
-        self.state = [
+        self._sharding = self._make_sharding()
+        self.state = self._fresh_state(capacity_per_shard)
+        self._step = self._make_step()
+        self._mesh_gather_fn = None
+        self._mesh_reset_fn = None
+
+    def _make_sharding(self):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.axis, None))
+
+    def _fresh_state(self, capacity: int):
+        import jax
+
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        return [
             jax.device_put(
-                jnp.full((self.n_shards, capacity_per_shard),
-                         _neutral(op, dt), dtype=_np_dtype(dt)),
-                sharding,
+                jnp.full(
+                    (self.n_shards, capacity),
+                    _neutral(op, dt),
+                    dtype=_np_dtype(dt),
+                ),
+                self._sharding,
             )
             for op, dt, _, _ in self.phys
         ]
-        # per-shard host directories (bin,key)->local slot
-        self.dirs = [SlotDirectory() for _ in range(self.n_shards)]
-        self._step = self._make_step()
 
-    # -- routing (host) -----------------------------------------------------
+    def _decompose(self, slots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        return slots // STRIDE, slots % STRIDE
 
-    def route(self, srcs: np.ndarray, owners: np.ndarray, bins: np.ndarray,
-              key_rows: List[np.ndarray],
-              cols: Dict[int, np.ndarray]) -> Tuple[np.ndarray, np.ndarray, list]:
-        """Pack rows into the [src_shard, dst_shard, rows] all_to_all
-        layout. Rows are attributed to source shards round-robin by the
-        caller (on real multi-host hardware each device's input partition
-        IS the source dimension); destination shards' host directories
-        assign the local slots."""
-        S, R = self.n_shards, self.rows_per_shard
-        slots = np.full((S, S, R), self.capacity - 1, dtype=np.int64)
-        valid = np.zeros((S, S, R), dtype=np.int64)
-        vals = {
-            c: np.zeros((S, S, R), dtype=v.dtype) for c, v in cols.items()
-        }
-        for dst in range(S):
-            rows_d = np.nonzero(owners == dst)[0]
-            if len(rows_d) == 0:
-                continue
-            local = self.dirs[dst].assign(
-                bins[rows_d], [k[rows_d] for k in key_rows]
+    # -- capacity -----------------------------------------------------------
+
+    def grow(self, min_capacity: int):
+        """Grow every shard's local capacity (4x steps). Global slot ids are
+        stride-encoded, so no live slot is re-numbered; the old per-shard
+        scratch slot is reset to neutral before it becomes allocatable."""
+        new_cap = self.capacity
+        while new_cap < min_capacity:
+            new_cap *= 4
+        if new_cap == self.capacity:
+            return
+        import jax
+
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        grown = []
+        for s, (op, dt, _, _) in zip(self.state, self.phys):
+            pad = jnp.full(
+                (self.n_shards, new_cap - self.capacity),
+                _neutral(op, dt),
+                dtype=_np_dtype(dt),
             )
-            if self.dirs[dst].required_capacity() > self.capacity - 1:
-                raise ValueError("shard accumulator capacity exceeded")
-            for s in range(S):
-                sel = srcs[rows_d] == s
-                cnt = int(sel.sum())
-                if cnt == 0:
-                    continue
-                if cnt > R:
-                    raise ValueError(
-                        f"route ({s}->{dst}) got {cnt} rows > "
-                        f"rows_per_shard={R}"
-                    )
-                slots[s, dst, :cnt] = local[sel]
-                valid[s, dst, :cnt] = 1
-                for c in vals:
-                    vals[c][s, dst, :cnt] = cols[c][rows_d][sel]
-        return slots, valid, vals
+            g = jnp.concatenate([s, pad], axis=1)
+            g = g.at[:, self.capacity - 1].set(_neutral(op, dt))
+            grown.append(jax.device_put(g, self._sharding))
+        self.state = grown
+        self.capacity = new_cap
 
-    # -- jitted sharded step ------------------------------------------------
+    # -- update (hot path) --------------------------------------------------
+
+    def update(
+        self,
+        slots: np.ndarray,
+        cols: Dict[int, np.ndarray],
+        signs: Optional[np.ndarray] = None,
+    ):
+        n = len(slots)
+        if n == 0:
+            return
+        self._check_signed(signs)
+        self._buffer_udafs(slots, cols)
+        if not self.phys:
+            return
+        S, R = self.n_shards, self.rows_per_shard
+        owners, locals_ = self._decompose(np.asarray(slots))
+        if int(locals_.max()) >= self.capacity - 1:
+            # jit scatters silently drop out-of-bounds updates — callers
+            # must grow() first (windows.py _ensure_capacity does)
+            raise ValueError(
+                f"shard accumulator capacity exceeded: local slot "
+                f"{int(locals_.max())} >= capacity-1={self.capacity - 1}"
+            )
+        srcs = np.arange(n, dtype=np.int64) % S
+        # pack rows into the [src, dst, row] all_to_all layout, splitting
+        # into multiple steps when any (src, dst) cell overflows R rows
+        bucket = srcs * S + owners
+        order = np.argsort(bucket, kind="stable")
+        sb = bucket[order]
+        starts = np.searchsorted(sb, sb, side="left")
+        pos = np.arange(n, dtype=np.int64) - starts
+        chunk = pos // R
+        for c in range(int(chunk.max()) + 1):
+            in_chunk = chunk == c
+            rows = order[in_chunk]
+            flat = sb[in_chunk] * R + pos[in_chunk] % R
+            self._update_once(rows, flat, locals_, cols, signs)
+
+    def _update_once(self, rows, flat, locals_, cols, signs):
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        S, R = self.n_shards, self.rows_per_shard
+        slots_l = np.full(S * S * R, self.capacity - 1, dtype=np.int64)
+        slots_l[flat] = locals_[rows]
+        valid = np.zeros(S * S * R, dtype=np.int64)
+        valid[flat] = 1 if signs is None else signs[rows]
+        inputs = []
+        for op, dt, src, si in self.phys:
+            if src == "one":
+                continue
+            v = np.full(
+                S * S * R,
+                0 if op == "add" else _neutral(op, dt),
+                dtype=_np_dtype(dt),
+            )
+            col = cols[self.specs[si].col]
+            # sign application happens in-kernel: add-sources multiply by
+            # valid (0 padding / ±1 append-retract)
+            v[flat] = col[rows]
+            inputs.append(jnp.asarray(v.reshape(S, S, R)))
+        self.state = self._step(
+            self.state,
+            jnp.asarray(slots_l.reshape(S, S, R)),
+            jnp.asarray(valid.reshape(S, S, R)),
+            *inputs,
+        )
 
     def _make_step(self):
         import jax
-        import jax.numpy as jnp
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
 
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
         phys = list(self.phys)
         axis = self.axis
 
@@ -124,25 +314,23 @@ class ShardedAccumulator:
             def exchange(x):
                 return jax.lax.all_to_all(x[0], axis, 0, 0, tiled=True)
 
-            slots_r = exchange(slots)
-            valid_r = exchange(valid)
-            vals_r = [exchange(v) for v in vals]
-            flat_slots = slots_r.reshape(-1)
+            valid_r = exchange(valid).reshape(-1)
+            flat_slots = exchange(slots).reshape(-1)
+            vals_r = [exchange(v).reshape(-1) for v in vals]
             out = []
             vi = 0
             for (op, dt, src, si), s in zip(phys, state_shards):
                 row = s[0]
                 if src == "one":
-                    v = valid_r.reshape(-1).astype(row.dtype)
+                    v = valid_r.astype(row.dtype)
                 else:
-                    v = vals_r[vi].reshape(-1)
+                    v = vals_r[vi]
                     vi += 1
                     if op == "add":
-                        v = v * valid_r.reshape(-1).astype(v.dtype)
+                        # valid is 0 (padding) or ±1 (append/retract)
+                        v = v * valid_r.astype(v.dtype)
                     else:
-                        v = jnp.where(
-                            valid_r.reshape(-1) > 0, v, _neutral(op, dt)
-                        )
+                        v = jnp.where(valid_r != 0, v, _neutral(op, dt))
                 if op == "add":
                     row = row.at[flat_slots].add(v.astype(row.dtype))
                 elif op == "min":
@@ -154,8 +342,11 @@ class ShardedAccumulator:
 
         n_state = len(self.phys)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=(0,), static_argnums=())
         def step(state, slots, valid, *vals):
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
             f = shard_map(
                 local_update,
                 mesh=self.mesh,
@@ -171,83 +362,79 @@ class ShardedAccumulator:
 
         return step
 
-    def update(self, hashes, bins, key_rows, cols):
-        # the all_to_all layout holds at most rows_per_shard rows per
-        # (src, dst) pair; skewed batches split into multiple steps, with
-        # chunk membership assigned per bucket so no chunk overflows
-        n = len(hashes)
-        owners = server_for_hash_array(hashes, self.n_shards)
-        srcs = np.arange(n) % self.n_shards
-        bucket = srcs * self.n_shards + owners
-        order = np.argsort(bucket, kind="stable")
-        sorted_bucket = bucket[order]
-        starts = np.searchsorted(sorted_bucket, sorted_bucket, side="left")
-        pos_in_bucket = np.arange(n) - starts  # position within each bucket
-        chunk_sorted = pos_in_bucket // self.rows_per_shard
-        chunk = np.empty(n, dtype=np.int64)
-        chunk[order] = chunk_sorted
-        for c in range(int(chunk.max()) + 1 if n else 0):
-            sel = chunk == c
-            self._update_one(
-                hashes[sel], srcs[sel], owners[sel], bins[sel],
-                [k[sel] for k in key_rows],
-                {col: v[sel] for col, v in cols.items()},
-            )
-
-    def _update_one(self, hashes, srcs, owners, bins, key_rows, cols):
-        import jax.numpy as jnp
-
-        slots, valid, vals = self.route(srcs, owners, bins, key_rows, cols)
-        # one value array per col-sourced physical accumulator, in phys order
-        ordered = [
-            jnp.asarray(vals[self.specs[si].col])
-            for op, dt, src, si in self.phys
-            if src == "col"
-        ]
-        self.state = self._step(
-            self.state, jnp.asarray(slots), jnp.asarray(valid), *ordered
-        )
-
     # -- drain --------------------------------------------------------------
 
-    def drain(self, bins: List[int]) -> Dict[int, Tuple[List[tuple], List[np.ndarray]]]:
-        """Emit a set of completed bins: ONE device->host state copy for the
-        whole emission cycle, then per-bin slicing; freed slots are reset on
-        device (one scatter) so their reuse starts from neutral."""
-        import jax.numpy as jnp
-
-        state_np = [np.asarray(s) for s in self.state]
-        out: Dict[int, Tuple[List[tuple], List[np.ndarray]]] = {}
-        freed_shards: List[np.ndarray] = []
-        freed_slots: List[np.ndarray] = []
-        for b in bins:
-            keys_out: List[tuple] = []
-            per_phys: List[List[np.ndarray]] = [[] for _ in self.phys]
-            for shard in range(self.n_shards):
-                if not self.dirs[shard].peek_bin(b):
-                    continue
-                keys, slots = self.dirs[shard].take_bin(b)
-                keys_out.extend(keys)
-                freed_shards.append(np.full(len(slots), shard, dtype=np.int64))
-                freed_slots.append(slots)
-                for pi, s in enumerate(state_np):
-                    per_phys[pi].append(s[shard, slots])
-            out[b] = (
-                keys_out,
-                [
-                    np.concatenate(chunks) if chunks else np.empty(0)
-                    for chunks in per_phys
-                ],
-            )
-        if freed_slots:
-            sh = jnp.asarray(np.concatenate(freed_shards))
-            sl = jnp.asarray(np.concatenate(freed_slots))
-            self.state = [
-                s.at[sh, sl].set(_neutral(op, dt))
-                for s, (op, dt, _, _) in zip(self.state, self.phys)
+    def gather(self, slots: np.ndarray) -> List[np.ndarray]:
+        self._gather_slots = np.asarray(slots)
+        self._segment_udaf = None
+        if len(slots) == 0:
+            return [
+                np.empty(0, dtype=_np_dtype(dt))
+                for _, dt, _, _ in self.phys
             ]
-        return out
+        import jax
 
-    def gather_bin(self, b: int) -> Tuple[List[tuple], List[np.ndarray]]:
-        """Single-bin convenience wrapper over drain()."""
-        return self.drain([b])[b]
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        if self._mesh_gather_fn is None:
+
+            @jax.jit
+            def gather_fn(state, sh, loc):
+                return [s[sh, loc] for s in state]
+
+            self._mesh_gather_fn = gather_fn
+        sh, loc = self._decompose(np.asarray(slots))
+        padded = _bucket(len(slots), self._buckets)
+        sh_p = np.zeros(padded, dtype=np.int64)
+        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        sh_p[: len(slots)] = sh
+        loc_p[: len(slots)] = loc
+        outs = self._mesh_gather_fn(
+            self.state, jnp.asarray(sh_p), jnp.asarray(loc_p)
+        )
+        return [np.asarray(o)[: len(slots)] for o in outs]
+
+    def reset_slots(self, slots: np.ndarray):
+        self._drop_udaf_slots(slots)
+        if len(slots) == 0 or not self.phys:
+            return
+        import jax
+
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        if self._mesh_reset_fn is None:
+            phys = list(self.phys)
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def reset_fn(state, sh, loc):
+                return [
+                    s.at[sh, loc].set(_neutral(op, dt))
+                    for s, (op, dt, _, _) in zip(state, phys)
+                ]
+
+            self._mesh_reset_fn = reset_fn
+        sh, loc = self._decompose(np.asarray(slots))
+        padded = _bucket(len(slots), self._buckets)
+        sh_p = np.zeros(padded, dtype=np.int64)
+        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        sh_p[: len(slots)] = sh
+        loc_p[: len(slots)] = loc
+        self.state = self._mesh_reset_fn(
+            self.state, jnp.asarray(sh_p), jnp.asarray(loc_p)
+        )
+
+    def restore(self, slots: np.ndarray, values: List[np.ndarray]):
+        values = self._restore_udaf_cols(slots, values)
+        if len(slots) == 0 or not self.phys:
+            return
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        sh, loc = self._decompose(np.asarray(slots))
+        shj, locj = jnp.asarray(sh), jnp.asarray(loc)
+        self.state = [
+            s.at[shj, locj].set(jnp.asarray(v))
+            for s, v in zip(self.state, values)
+        ]

@@ -232,10 +232,17 @@ class StorageProvider:
                 f"s3 conditional put of {key}: persistent 409 conflict"
             )
         if resp.status_code // 100 != 2:
-            raise IOError(
-                f"s3 conditional put of {key} failed: "
-                f"{resp.status_code} {resp.text[:200]}"
+            # e.g. 301/400 when the bucket lives in another region and no
+            # region env is set: degrade to check-then-create (with the
+            # loud warning) rather than crash the fencing path
+            logger.warning(
+                "s3 conditional put of %s failed (%s %s); falling back to "
+                "non-atomic check-then-create",
+                key,
+                resp.status_code,
+                resp.text[:200],
             )
+            return False
         return True
 
     def _gcs_conditional_put(self, key: str, data: bytes) -> bool:

@@ -6,7 +6,7 @@ import pandas as pd
 import pytest
 
 from arroyo_tpu.ops.aggregates import AggSpec
-from arroyo_tpu.types import hash_column
+from arroyo_tpu.types import hash_column, server_for_hash_array
 
 
 @pytest.fixture(scope="module")
@@ -22,7 +22,7 @@ def mesh():
 
 
 def test_sharded_accumulator_matches_pandas(mesh):
-    from arroyo_tpu.parallel import ShardedAccumulator
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
 
     specs = [
         AggSpec("count", None, "cnt"),
@@ -31,26 +31,25 @@ def test_sharded_accumulator_matches_pandas(mesh):
     ]
     acc = ShardedAccumulator(specs, mesh, capacity_per_shard=256,
                              rows_per_shard=512)
+    d = MeshSlotDirectory(acc.n_shards)
     rng = np.random.default_rng(3)
     n = 6000
     keys = rng.integers(0, 40, n)
     bins = rng.integers(0, 3, n)
     ints = rng.integers(-50, 50, n)
     floats = rng.random(n) * 10
-    hashes = hash_column(keys)
     for lo in range(0, n, 1500):
         hi = min(lo + 1500, n)
-        acc.update(
-            hashes[lo:hi], bins[lo:hi], [keys[lo:hi]],
-            {0: ints[lo:hi], 1: floats[lo:hi]},
-        )
+        slots = d.assign(bins[lo:hi], [keys[lo:hi]])
+        acc.update(slots, {0: ints[lo:hi], 1: floats[lo:hi]})
     df = pd.DataFrame({"b": bins, "k": keys, "i": ints, "f": floats})
     want = df.groupby(["b", "k"]).agg(
         cnt=("i", "size"), total=("i", "sum"), hi=("f", "max")
     )
     seen = 0
     for b in range(3):
-        keys_out, gathered = acc.gather_bin(b)
+        keys_out, slots = d.take_bin(b)
+        gathered = acc.gather(slots)
         assert len(keys_out) == len(want.loc[b])
         for key, cnt, total, hi_ in zip(
             keys_out, gathered[0], gathered[1], gathered[2]
@@ -60,24 +59,84 @@ def test_sharded_accumulator_matches_pandas(mesh):
             assert total == row["total"]
             assert hi_ == pytest.approx(row["hi"])
             seen += 1
+        acc.reset_slots(slots)
     assert seen == len(want)
 
 
 def test_sharded_routing_respects_hash_ranges(mesh):
     """Rows must land on the shard that owns their hash range — the same
     mapping the host shuffle and state restore use."""
-    from arroyo_tpu.parallel import ShardedAccumulator
-    from arroyo_tpu.types import server_for_hash_array
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
 
     specs = [AggSpec("count", None, "cnt")]
     acc = ShardedAccumulator(specs, mesh, capacity_per_shard=64,
                              rows_per_shard=256)
+    d = MeshSlotDirectory(acc.n_shards)
     keys = np.arange(100, dtype=np.int64)
-    hashes = hash_column(keys)
-    owners = server_for_hash_array(hashes, acc.n_shards)
-    acc.update(hashes, np.zeros(100, dtype=np.int64), [keys], {})
+    # canonical shuffle hash: per-column hashes combined with the seed
+    # (types.hash_arrays), matching schema.hash_keys and restore's
+    # _range_mask
+    from arroyo_tpu.types import hash_arrays
+
+    owners = server_for_hash_array(
+        hash_arrays([hash_column(keys)]), acc.n_shards
+    )
+    slots = d.assign(np.zeros(100, dtype=np.int64), [keys])
+    acc.update(slots, {})
     for shard in range(acc.n_shards):
         expect = set(keys[owners == shard].tolist())
-        got = {k[0] for _, k, _ in
-               [(b, key, s) for b, key, s in acc.dirs[shard].items()]}
+        got = {key[0] for _, key, _ in d.dirs[shard].items()}
         assert got == expect
+
+
+def test_sharded_capacity_growth(mesh):
+    """More keys than a shard's initial capacity: grow() must preserve all
+    live values (stride-encoded slots are stable across growth)."""
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
+
+    specs = [AggSpec("sum", 0, "total")]
+    acc = ShardedAccumulator(specs, mesh, capacity_per_shard=8,
+                             rows_per_shard=64)
+    d = MeshSlotDirectory(acc.n_shards)
+    rng = np.random.default_rng(11)
+    n = 4000
+    keys = rng.integers(0, 500, n)
+    vals = rng.integers(0, 100, n)
+    bins = np.zeros(n, dtype=np.int64)
+    for lo in range(0, n, 400):
+        hi = min(lo + 400, n)
+        slots = d.assign(bins[lo:hi], [keys[lo:hi]])
+        need = d.required_capacity()
+        if need > acc.capacity - 1:
+            acc.grow(need + 1)
+        acc.update(slots, {0: vals[lo:hi]})
+    assert acc.capacity > 8
+    keys_out, slots = d.take_bin(0)
+    gathered = acc.gather(slots)
+    want = pd.Series(vals).groupby(keys).sum()
+    assert len(keys_out) == len(want)
+    for key, total in zip(keys_out, gathered[0]):
+        assert total == want.loc[key[0]]
+
+
+def test_sharded_signed_updates(mesh):
+    """Retraction path: signed updates must be invertible on the mesh."""
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
+
+    specs = [AggSpec("count", None, "cnt"), AggSpec("sum", 0, "total")]
+    acc = ShardedAccumulator(specs, mesh, capacity_per_shard=64,
+                             rows_per_shard=64)
+    d = MeshSlotDirectory(acc.n_shards)
+    keys = np.array([1, 2, 3, 1, 2, 3], dtype=np.int64)
+    vals = np.array([10, 20, 30, 10, 20, 30], dtype=np.int64)
+    bins = np.zeros(6, dtype=np.int64)
+    slots = d.assign(bins, [keys])
+    acc.update(slots, {0: vals})  # two appends per key
+    signs = np.array([-1, -1, -1], dtype=np.int64)
+    slots_r = d.assign(bins[:3], [keys[:3]])
+    acc.update(slots_r, {0: vals[:3]}, signs=signs)  # retract one each
+    keys_out, slots_all = d.take_bin(0)
+    gathered = acc.gather(slots_all)
+    for key, cnt, total in zip(keys_out, gathered[0], gathered[1]):
+        assert cnt == 1
+        assert total == key[0] * 10

@@ -234,10 +234,6 @@ class _FakeS3Server(ThreadingHTTPServer):
         self.lock = threading.Lock()
 
 
-def _put_part(server):
-    """Part uploads arrive as PUT with partNumber — route in do_PUT."""
-
-
 @pytest.fixture()
 def fake_s3(monkeypatch):
     srv = _FakeS3Server()

@@ -261,11 +261,27 @@ class InstantJoinOperator(JoinBase):
         # bin_ts -> side -> list[RecordBatch]
         self.bins: Dict[int, Dict[int, List[pa.RecordBatch]]] = {}
         self.emitted_up_to: Optional[int] = None
+        # batches buffered since the last checkpoint, per side
+        self._dirty: Dict[int, List[pa.RecordBatch]] = {0: [], 1: []}
+
+    _SIDE_TABLES = ("ijl", "ijr")
 
     def tables(self):
-        from ..state.table_config import global_table
+        from ..state.table_config import global_table, time_key_table
 
-        return {"ij": global_table("ij")}
+        # retention -1: bins emit at wm >= ts, so restore keeps exactly
+        # ts > wm. Buffered input batches ARE the delta rows (incremental
+        # checkpoints write only batches buffered since the last epoch).
+        key_fields = tuple(f"__key{i}" for i in range(self.n_keys))
+        return {
+            "ij": global_table("ij"),
+            **{
+                name: time_key_table(
+                    name, retention_nanos=-1, key_fields=key_fields
+                )
+                for name in self._SIDE_TABLES
+            },
+        }
 
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
@@ -282,22 +298,63 @@ class InstantJoinOperator(JoinBase):
                             b = self._filter_to_range(_ipc_read(blob), ctx)
                             if b is not None and b.num_rows:
                                 tgt[side].append(b)
+            for side, name in enumerate(self._SIDE_TABLES):
+                t = await ctx.table(name)
+                for b in t.all_batches():
+                    self._rebuffer(b, side)
+                t.batches.clear()
+
+    def _rebuffer(self, batch: pa.RecordBatch, side: int):
+        """Restore one delta batch: split by timestamp into bins (emitted
+        bins were already pruned by retention at restore)."""
+        tnp = np.asarray(
+            batch.column(batch.schema.names.index(TIMESTAMP_FIELD)).cast(
+                pa.int64()
+            )
+        )
+        if self.emitted_up_to is not None:
+            live = tnp > self.emitted_up_to
+            if not live.any():
+                return
+            if not live.all():
+                batch = batch.filter(pa.array(live))
+                tnp = tnp[live]
+        order = np.argsort(tnp, kind="stable")
+        sorted_batch = batch.take(pa.array(order))
+        sorted_ts = tnp[order]
+        uniq = np.unique(sorted_ts)
+        bounds = np.searchsorted(sorted_ts, uniq, side="left").tolist()
+        bounds.append(len(sorted_ts))
+        for i, t in enumerate(uniq):
+            lo, hi = bounds[i], bounds[i + 1]
+            self.bins.setdefault(int(t), {0: [], 1: []})[side].append(
+                sorted_batch.slice(lo, hi - lo)
+            )
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("ij")
-            snap = {
-                "emitted_up_to": self.emitted_up_to,
-                "subtask": ctx.task_info.task_index,
-                "bins": {
-                    str(ts): {
-                        str(side): [_ipc_write(b) for b in batches]
-                        for side, batches in sides.items()
-                    }
-                    for ts, sides in self.bins.items()
+            table.put(
+                ctx.task_info.task_index,
+                {
+                    "emitted_up_to": self.emitted_up_to,
+                    "subtask": ctx.task_info.task_index,
+                    "bins": {},
                 },
-            }
-            table.put(ctx.task_info.task_index, snap)
+            )
+            for side, name in enumerate(self._SIDE_TABLES):
+                dirty = self._dirty[side]
+                self._dirty[side] = []
+                live = [
+                    b
+                    for b in dirty
+                    if self.emitted_up_to is None
+                    or _batch_max_ts(b) > self.emitted_up_to
+                ]
+                if live:
+                    t = await ctx.table(name)
+                    for b in live:
+                        t.write_delta(b)
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         tnp = np.asarray(
@@ -327,6 +384,7 @@ class InstantJoinOperator(JoinBase):
 
     def _buffer(self, ts: int, side: int, batch: pa.RecordBatch):
         self.bins.setdefault(ts, {0: [], 1: []})[side].append(batch)
+        self._dirty[side].append(batch)
 
     async def handle_watermark(self, watermark, ctx, collector):
         if watermark.kind != WatermarkKind.EVENT_TIME:
@@ -362,6 +420,15 @@ def _concat(batches: List[pa.RecordBatch]) -> Optional[pa.Table]:
     return pa.Table.from_batches(batches)
 
 
+def _batch_max_ts(batch: pa.RecordBatch) -> int:
+    ts = np.asarray(
+        batch.column(batch.schema.names.index(TIMESTAMP_FIELD)).cast(
+            pa.int64()
+        )
+    )
+    return int(ts.max()) if len(ts) else -(1 << 62)
+
+
 def _empty_from_schema(schema, opposite: pa.RecordBatch,
                        n_keys: int) -> pa.Table:
     """Empty table for a side with no rows in a bin (outer joins). Uses the
@@ -392,11 +459,25 @@ class JoinWithExpirationOperator(JoinBase):
                 "non-windowed outer joins require updating semantics"
             )
         self.buffers: Dict[int, List[pa.RecordBatch]] = {0: [], 1: []}
+        self._dirty: Dict[int, List[pa.RecordBatch]] = {0: [], 1: []}
+
+    _SIDE_TABLES = ("jbl", "jbr")
 
     def tables(self):
-        from ..state.table_config import global_table
+        from ..state.table_config import global_table, time_key_table
 
-        return {"jb": global_table("jb")}
+        # retention = TTL: the same cutoff the operator's own watermark
+        # eviction applies, so restored rows match live-buffer trimming
+        key_fields = tuple(f"__key{i}" for i in range(self.n_keys))
+        return {
+            "jb": global_table("jb"),
+            **{
+                name: time_key_table(
+                    name, retention_nanos=self.ttl, key_fields=key_fields
+                )
+                for name in self._SIDE_TABLES
+            },
+        }
 
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
@@ -407,20 +488,27 @@ class JoinWithExpirationOperator(JoinBase):
                         b = self._filter_to_range(_ipc_read(blob), ctx)
                         if b is not None and b.num_rows:
                             self.buffers[side].append(b)
+            for side, name in enumerate(self._SIDE_TABLES):
+                t = await ctx.table(name)
+                for b in t.all_batches():
+                    if b.num_rows:
+                        self.buffers[side].append(b)
+                t.batches.clear()
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("jb")
             table.put(
                 ctx.task_info.task_index,
-                {
-                    "subtask": ctx.task_info.task_index,
-                    **{
-                        str(side): [_ipc_write(b) for b in batches]
-                        for side, batches in self.buffers.items()
-                    },
-                },
+                {"subtask": ctx.task_info.task_index},
             )
+            for side, name in enumerate(self._SIDE_TABLES):
+                dirty = self._dirty[side]
+                self._dirty[side] = []
+                if dirty:
+                    t = await ctx.table(name)
+                    for b in dirty:
+                        t.write_delta(b)
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         other = self.buffers[1 - input_index]
@@ -433,6 +521,7 @@ class JoinWithExpirationOperator(JoinBase):
             if out is not None:
                 await collector.collect(out)
         self.buffers[input_index].append(batch)
+        self._dirty[input_index].append(batch)
 
     def _join_symmetric(self, lt: pa.Table, rt: pa.Table):
         """Inner join keeping _timestamp = max(left_ts, right_ts) per row."""

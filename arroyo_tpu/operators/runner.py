@@ -327,8 +327,13 @@ class SubtaskRunner:
         # unblocking + re-arming happens in the main loop
 
     async def _checkpoint_chain(self, barrier):
-        """Snapshot every chain op's state, flush tables, report, and
-        re-broadcast the barrier downstream."""
+        """Capture every chain op's state at the barrier, re-broadcast the
+        barrier downstream immediately, then flush (device->host
+        materialization + file I/O) in a background task that overlaps the
+        next epoch's processing. The completed-report is sent when the
+        flush lands; the next barrier awaits the previous flush so epoch
+        file lists stay ordered."""
+        await self._await_pending_flush()
         self.control_tx.put_nowait(
             CheckpointEventResp(
                 self.task_info.task_id,
@@ -338,18 +343,62 @@ class SubtaskRunner:
                 "started_checkpointing",
             )
         )
-        metadata: Dict[str, dict] = {}
+        captured = []
         commit_data = None
         for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
             await op.handle_checkpoint(barrier, ctx, self.collectors[idx])
             if ctx.table_manager is not None:
-                tm_meta = await ctx.table_manager.checkpoint(
-                    barrier.epoch, self.watermarks.current_nanos()
+                captured.append(
+                    (
+                        idx,
+                        ctx.table_manager.capture(
+                            barrier.epoch, self.watermarks.current_nanos()
+                        ),
+                    )
                 )
-                metadata[f"op{idx}"] = tm_meta
             if ctx.commit_data is not None:
                 commit_data = ctx.commit_data
                 ctx.commit_data = None
+        await self.tail.broadcast(SignalMessage.barrier_of(barrier))
+        flush = asyncio.ensure_future(
+            self._flush_and_report(barrier, captured, commit_data,
+                                   self.watermarks.current_nanos())
+        )
+        self._pending_flush = flush
+        if barrier.then_stop:
+            await self._await_pending_flush()
+
+    async def _await_pending_flush(self):
+        flush = getattr(self, "_pending_flush", None)
+        if flush is not None:
+            self._pending_flush = None
+            await flush
+
+    async def _flush_and_report(self, barrier, captured, commit_data,
+                                watermark):
+        try:
+            metadata: Dict[str, dict] = {}
+            for idx, staged in captured:
+                tm = self.ctxs[idx].table_manager
+                metadata[f"op{idx}"] = await asyncio.to_thread(
+                    tm.flush_captured, barrier.epoch, staged
+                )
+        except Exception:
+            # surface immediately: the controller sees the failure rather
+            # than a checkpoint-wait timeout, and nothing is silently lost
+            logger.exception(
+                "checkpoint flush failed for %s epoch %s",
+                self.task_info.task_id, barrier.epoch,
+            )
+            self.control_tx.put_nowait(
+                TaskFailedResp(
+                    self.task_info.task_id,
+                    self.task_info.node_id,
+                    self.task_info.task_index,
+                    traceback.format_exc(),
+                )
+            )
+            return
         self.control_tx.put_nowait(
             CheckpointCompletedResp(
                 self.task_info.task_id,
@@ -357,12 +406,11 @@ class SubtaskRunner:
                 self.task_info.task_index,
                 barrier.epoch,
                 subtask_metadata=metadata,
-                watermark=self.watermarks.current_nanos(),
+                watermark=watermark,
                 has_commit_data=commit_data is not None,
                 commit_data=commit_data,
             )
         )
-        await self.tail.broadcast(SignalMessage.barrier_of(barrier))
 
     # -------------------------------------------------------------- control
 
@@ -395,6 +443,8 @@ class SubtaskRunner:
     # ----------------------------------------------------------------- close
 
     async def _close_chain(self, is_eod: bool):
+        # a checkpoint flush may still be in flight; exceptions surface here
+        await self._await_pending_flush()
         for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
             wm = await op.on_close(ctx, self.collectors[idx], is_eod)
             if wm is not None:

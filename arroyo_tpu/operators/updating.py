@@ -60,11 +60,25 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         self.retractable: bool = bool(config.get("retractable"))
         self.meta_col: Optional[int] = config.get("meta_col")
         self.live: Dict[tuple, int] = {}
+        # keys changed / deleted since the last checkpoint (incremental)
+        self._ckpt_dirty: set = set()
+        self._ckpt_dead: set = set()
 
     def tables(self):
-        from ..state.table_config import global_table
+        from ..state.table_config import global_table, time_key_table
 
-        return {"u": global_table("u")}
+        # incremental per-key rows: __ts = key's last_seen (retention = the
+        # operator's own idle-key TTL), upserts + __dead tombstones; newest
+        # row per key wins on restore
+        return {
+            "u": global_table("u"),
+            "ui": time_key_table(
+                "ui",
+                retention_nanos=self.ttl_nanos,
+                timestamp_field="__ts",
+                key_fields=self._delta_key_fields(),
+            ),
+        }
 
     def tick_interval(self) -> Optional[float]:
         return self.flush_interval
@@ -106,6 +120,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                     if lv_mask is not None and not lv_mask[i]:
                         continue
                     self.live[self._intern_key(key_vals)] = cnt
+            await self._restore_updating_incremental(ctx)
         # everything restored must re-verify against emitted on next flush
         for _, key, _slot in self.dir.items():
             self.dirty.add(key)
@@ -114,24 +129,165 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         # flush before the barrier so checkpointed emitted-state matches
         # the snapshot (restores re-emit nothing)
         await self._flush(ctx, collector)
-        if ctx.table_manager is not None:
-            table = await ctx.table("u")
-            snap = self._snapshot_rows()
-            snap["subtask"] = ctx.task_info.task_index
-            snap["emitted"] = [
+        if ctx.table_manager is None:
+            return
+        table = await ctx.table("u")
+        if self._use_incremental():
+            delta = self._build_updating_delta()
+            if delta is not None:
+                (await ctx.table("ui")).write_delta(delta)
+            table.put(
+                ctx.task_info.task_index,
+                {
+                    "bins": [], "keys": [], "values": [],
+                    "emitted": [], "last_seen": [],
+                    "subtask": ctx.task_info.task_index,
+                },
+            )
+            return
+        snap = self._snapshot_rows()
+        snap["subtask"] = ctx.task_info.task_index
+        snap["emitted"] = [
+            [self._key_tuple_to_values(k), v]
+            for k, v in self.emitted.items()
+        ]
+        snap["last_seen"] = [
+            [self._key_tuple_to_values(k), v]
+            for k, v in self.last_seen.items()
+        ]
+        if self.retractable:
+            snap["live"] = [
                 [self._key_tuple_to_values(k), v]
-                for k, v in self.emitted.items()
+                for k, v in self.live.items()
             ]
-            snap["last_seen"] = [
-                [self._key_tuple_to_values(k), v]
-                for k, v in self.last_seen.items()
-            ]
-            if self.retractable:
-                snap["live"] = [
-                    [self._key_tuple_to_values(k), v]
-                    for k, v in self.live.items()
+        table.put(ctx.task_info.task_index, snap)
+
+    def _build_updating_delta(self) -> Optional[pa.RecordBatch]:
+        """Upsert rows for keys touched since the last epoch + __dead
+        tombstones for retract-deleted keys. __ts is the key's last_seen so
+        the TTL retention prunes idle keys from restore exactly like the
+        live eviction does."""
+        import msgpack
+
+        bin_map = self.dir.peek_bin(0) or {}
+        keys = [k for k in self._ckpt_dirty if k in bin_map]
+        dead = list(self._ckpt_dead)
+        self._ckpt_dirty = set()
+        self._ckpt_dead = set()
+        if not keys and not dead:
+            return None
+        n_phys = len(self.acc.phys)
+        if keys:
+            slots = np.asarray([bin_map[k] for k in keys], dtype=np.int64)
+            values = self.acc.snapshot(slots)
+        else:
+            values = [np.empty(0, dtype=s.dtype) for s in self.acc.state]
+        all_keys = keys + dead
+        ts = np.asarray(
+            [self.last_seen.get(k, self.max_ts) for k in keys]
+            + [self.max_ts] * len(dead),
+            dtype=np.int64,
+        )
+        arrays = [pa.array(ts)]
+        names = ["__ts"]
+        key_rows = [tuple(self._key_tuple_to_values(k)) for k in all_keys]
+        for i, arr in enumerate(self._key_delta_arrays(key_rows)):
+            arrays.append(arr)
+            names.append(f"__k{i}")
+        for j in range(n_phys):
+            vj = np.asarray(values[j])
+            col = np.concatenate([vj, np.zeros(len(dead), dtype=vj.dtype)])
+            arrays.append(pa.array(col))
+            names.append(f"__v{j}")
+        arrays.append(
+            pa.array(
+                [
+                    msgpack.packb(self.emitted[k])
+                    if self.emitted.get(k) is not None
+                    else None
+                    for k in keys
                 ]
-            table.put(ctx.task_info.task_index, snap)
+                + [None] * len(dead),
+                type=pa.binary(),
+            )
+        )
+        names.append("__emitted")
+        arrays.append(
+            pa.array(
+                np.asarray(
+                    [self.live.get(k, 0) for k in keys] + [0] * len(dead),
+                    dtype=np.int64,
+                )
+            )
+        )
+        names.append("__live")
+        arrays.append(
+            pa.array([False] * len(keys) + [True] * len(dead))
+        )
+        names.append("__dead")
+        return pa.RecordBatch.from_arrays(arrays, names=names)
+
+    async def _restore_updating_incremental(self, ctx):
+        import msgpack
+
+        if self._key_types is None:
+            return
+        table = await ctx.table("ui")
+        newest: Dict[tuple, Optional[tuple]] = {}
+        n_phys = len(self.acc.phys)
+        for b in table.all_batches():
+            names = b.schema.names
+            ts = np.asarray(b.column(names.index("__ts")))
+            key_cols = self._decode_delta_keys(b)
+            vals = [
+                np.asarray(b.column(names.index(f"__v{j}")))
+                for j in range(n_phys)
+            ]
+            emitted = b.column(names.index("__emitted")).to_pylist()
+            live = np.asarray(b.column(names.index("__live")))
+            dead = np.asarray(b.column(names.index("__dead")))
+            for r in range(b.num_rows):
+                kv = tuple(c[r] for c in key_cols)
+                newest[kv] = (
+                    None
+                    if dead[r]
+                    else (
+                        int(ts[r]),
+                        [v[r] for v in vals],
+                        emitted[r],
+                        int(live[r]),
+                    )
+                )
+        rows = [(kv, v) for kv, v in newest.items() if v is not None]
+        table.batches.clear()
+        if not rows:
+            return
+        mask = self._range_mask([list(kv) for kv, _ in rows], ctx)
+        if mask is not None:
+            rows = [rv for rv, m in zip(rows, mask) if m]
+            if not rows:
+                return
+        cols: List[list] = [[] for _ in range(n_phys)]
+        keys_l = []
+        for kv, (ts_, vv, _, _) in rows:
+            keys_l.append(list(kv))
+            for j, v in enumerate(vv):
+                cols[j].append(v)
+        self._restore_rows(
+            {
+                "bins": [0] * len(rows),
+                "keys": keys_l,
+                "values": cols,
+            },
+            ctx,
+        )
+        for kv, (ts_, _, em, lv) in rows:
+            key = self._intern_key(list(kv))
+            self.last_seen[key] = ts_
+            if em is not None:
+                self.emitted[key] = msgpack.unpackb(em, raw=False)
+            if self.retractable:
+                self.live[key] = lv
 
     def _intern_key(self, key_vals: list) -> tuple:
         from ..ops.directory import intern_value
@@ -171,6 +327,8 @@ class UpdatingAggregateOperator(WindowOperatorBase):
             if entry is not None:
                 _, key = entry
                 self.dirty.add(key)
+                self._ckpt_dirty.add(key)
+                self._ckpt_dead.discard(key)
                 self.last_seen[key] = now
                 if signs is not None:
                     self.live[key] = self.live.get(key, 0) + int(per_uniq[i])
@@ -218,6 +376,8 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                         retract_vals.append(old)
                     self.last_seen.pop(k, None)
                     self.live.pop(k, None)
+                    self._ckpt_dead.add(k)
+                    self._ckpt_dirty.discard(k)
                 freed = self.dir.remove(0, dead)
                 if len(freed):
                     self.acc.reset_slots(freed)
@@ -310,6 +470,9 @@ class UpdatingAggregateOperator(WindowOperatorBase):
             self.emitted.pop(k, None)
             self.live.pop(k, None)
             self.dirty.discard(k)
+            # retention alone ages these rows out of restore; no tombstone
+            # needed since eviction == the retention cutoff itself
+            self._ckpt_dirty.discard(k)
 
 
 @register_operator(OperatorName.UPDATING_AGGREGATE)

@@ -79,6 +79,10 @@ class WindowOperatorBase(Operator):
             self.dir = SlotDirectory()
         self._key_types: Optional[List[pa.DataType]] = None
         self._key_names: Optional[List[str]] = None
+        # slot -> (bin, portable key values) for slots touched since the
+        # last checkpoint; captured at assign time so delta building is
+        # O(dirty), not O(live keys)
+        self._dirty_slots: Dict[int, tuple] = {}
 
     # operators that only use assign/take_bin/bin_entries/items can swap in
     # the C++ directory for single-integer keys (tumbling, sliding)
@@ -135,6 +139,154 @@ class WindowOperatorBase(Operator):
         need = self.dir.required_capacity()
         if need > self.acc.capacity - 1:
             self.acc.grow(need + 1)
+
+    # -- incremental checkpoints --------------------------------------------
+    # Window state checkpoints write only the (bin, key) groups whose slots
+    # changed since the previous epoch into an expiring_time_key table; the
+    # cumulative file list rides in the manifest and retention (keyed to the
+    # row's window-end timestamp) prunes emitted windows on restore.
+    # Mirrors the reference's incremental ExpiringTimeKeyTable design
+    # (/root/reference/crates/arroyo-state/src/tables/
+    # expiring_time_key_map.rs:53, flush in table_manager.rs:368).
+
+    def _mark_dirty(self, slots: np.ndarray, bins: np.ndarray,
+                    key_cols: List[np.ndarray]):
+        """Record (bin, portable key) per touched slot. A stale mapping
+        (slot emitted+freed before the checkpoint) writes a row whose bin
+        is already behind the watermark — pruned by retention on restore —
+        so no directory scan is ever needed."""
+        if not len(slots):
+            return
+        uniq, first = np.unique(slots, return_index=True)
+        norm = []
+        for c in key_cols:
+            c = np.asarray(c)
+            if c.dtype == np.uint64:
+                c = c.view(np.int64)
+            elif c.dtype.kind == "M":
+                c = c.view("i8")
+            norm.append(c)
+        for s, i in zip(uniq.tolist(), first.tolist()):
+            self._dirty_slots[s] = (
+                int(bins[i]),
+                tuple(_to_py(c[i]) for c in norm),
+            )
+
+    def _key_delta_arrays(self, key_rows: List[tuple]) -> List[pa.Array]:
+        """Portable key tuples -> one arrow array per key column (interned
+        types keep their values/types; the rest are int64 codes whose hash
+        matches the shuffle's)."""
+        out = []
+        for i, kt in enumerate(self._key_types):
+            vals = [k[i] for k in key_rows]
+            if _is_interned_type(kt):
+                out.append(pa.array(vals, type=kt))
+            else:
+                out.append(
+                    pa.array(np.asarray(vals, dtype=np.int64))
+                )
+        return out
+
+    def _decode_delta_keys(self, batch: pa.RecordBatch) -> List[np.ndarray]:
+        """__k* columns -> numpy arrays in the form _restore_rows expects
+        (object arrays for interned types, int64 codes otherwise)."""
+        names = batch.schema.names
+        out = []
+        for i, kt in enumerate(self._key_types):
+            col = batch.column(names.index(f"__k{i}"))
+            if _is_interned_type(kt):
+                out.append(np.array(col.to_pylist(), dtype=object))
+            else:
+                out.append(np.asarray(col.cast(pa.int64())))
+        return out
+
+    def _use_incremental(self) -> bool:
+        """Struct keys (window structs) hash differently in the parquet
+        snapshot than on the shuffle, and UDAF buffers are variable-length
+        host state — both fall back to the full-snapshot global table."""
+        if self._key_types is None:
+            return False
+        if any(s.kind == "udaf" for s in self.specs):
+            return False
+        return not any(pa.types.is_struct(t) for t in self._key_types)
+
+    def _delta_key_fields(self) -> tuple:
+        return tuple(f"__k{i}" for i in range(len(self.key_cols)))
+
+    def _build_delta_batch(self, bin_ts):
+        """Delta thunk for dirty slots: keys/bins were captured at assign
+        time (O(dirty)), the accumulator gather is *dispatched* now against
+        the current device state, and the returned zero-arg callable
+        materializes the RecordBatch (__ts = bin_ts(bin), __bin, __k*,
+        __v*) on the flush path — so the device->host copy overlaps the
+        next epoch's processing."""
+        if not self._dirty_slots:
+            return None
+        dirty = self._dirty_slots
+        self._dirty_slots = {}
+        slots = np.fromiter(dirty.keys(), dtype=np.int64, count=len(dirty))
+        bins = np.asarray([bk[0] for bk in dirty.values()], dtype=np.int64)
+        key_rows = [bk[1] for bk in dirty.values()]
+        values = self.acc.snapshot(slots, materialize=False)
+
+        def build() -> pa.RecordBatch:
+            arrays = [pa.array(bin_ts(bins)), pa.array(bins)]
+            names = ["__ts", "__bin"]
+            for i, arr in enumerate(self._key_delta_arrays(key_rows)):
+                arrays.append(arr)
+                names.append(f"__k{i}")
+            for j, v in enumerate(values):
+                arrays.append(pa.array(np.asarray(v)))
+                names.append(f"__v{j}")
+            return pa.RecordBatch.from_arrays(arrays, names=names)
+
+        return build
+
+    async def _checkpoint_window_state(self, ctx, inc_table: str,
+                                       bin_ts) -> dict:
+        """Stage the incremental delta (or legacy full snapshot when not
+        eligible) and return the meta snap to extend + put."""
+        if self._use_incremental():
+            delta = self._build_delta_batch(bin_ts)
+            if delta is not None:
+                (await ctx.table(inc_table)).write_delta(delta)
+            return {"bins": [], "keys": [], "values": []}
+        return self._snapshot_rows()
+
+    async def _restore_incremental(self, ctx, inc_table: str):
+        """Rebuild directory+accumulator from incremental delta files.
+        Later rows supersede earlier ones per (bin, key); the table manager
+        already applied key-range and retention filters."""
+        table = await ctx.table(inc_table)
+        if self._key_types is None:
+            return
+        newest: Dict[tuple, list] = {}
+        n_phys = len(self.acc.phys)
+        for b in table.all_batches():
+            names = b.schema.names
+            bins = np.asarray(b.column(names.index("__bin")))
+            key_cols = self._decode_delta_keys(b)
+            vals = [
+                np.asarray(b.column(names.index(f"__v{j}")))
+                for j in range(n_phys)
+            ]
+            for r in range(b.num_rows):
+                k = (int(bins[r]), tuple(c[r] for c in key_cols))
+                newest[k] = [v[r] for v in vals]
+        if not newest:
+            return
+        bins_l, keys_l = [], []
+        cols: List[list] = [[] for _ in range(n_phys)]
+        for (b_, key_t), vv in newest.items():
+            bins_l.append(b_)
+            keys_l.append(list(key_t))
+            for j, v in enumerate(vv):
+                cols[j].append(v)
+        self._restore_rows(
+            {"bins": bins_l, "keys": keys_l, "values": cols}, ctx
+        )
+        # conduit table: in-memory source of truth is the accumulator
+        table.batches.clear()
 
     def _key_arrays(self, batch: pa.RecordBatch) -> List[np.ndarray]:
         out = []
@@ -432,9 +584,25 @@ class TumblingWindowOperator(WindowOperatorBase):
         self.emitted_up_to: Optional[int] = None  # last emitted bin END
 
     def tables(self):
-        from ..state.table_config import global_table
+        from ..state.table_config import global_table, time_key_table
 
-        return {"t": global_table("t")}
+        # retention ties the delta rows' __ts (= bin end - 1, or the raw
+        # instant timestamp) to the watermark: rows whose window already
+        # emitted at the checkpointed watermark are pruned on restore.
+        # Instant mode (width 0) emits at wm >= ts, hence retention -1
+        # keeps exactly ts > wm.
+        return {
+            "t": global_table("t"),
+            "ti": time_key_table(
+                "ti",
+                retention_nanos=0 if self.width else -1,
+                timestamp_field="__ts",
+                key_fields=self._delta_key_fields(),
+            ),
+        }
+
+    def _delta_ts(self, bins: np.ndarray) -> np.ndarray:
+        return (bins + 1) * self.width - 1 if self.width else bins
 
     async def on_start(self, ctx):
         self._capture_key_meta(ctx)
@@ -446,11 +614,14 @@ class TumblingWindowOperator(WindowOperatorBase):
                         self.emitted_up_to or 0, snap["emitted_up_to"]
                     )
                 self._restore_rows(snap, ctx)
+            await self._restore_incremental(ctx, "ti")
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("t")
-            snap = self._snapshot_rows()
+            snap = await self._checkpoint_window_state(
+                ctx, "ti", self._delta_ts
+            )
             snap["emitted_up_to"] = self.emitted_up_to
             snap["subtask"] = ctx.task_info.task_index
             table.put(ctx.task_info.task_index, snap)
@@ -478,6 +649,8 @@ class TumblingWindowOperator(WindowOperatorBase):
         keys = self._key_arrays(batch)
         slots = self.dir.assign(bins, keys)
         self._ensure_capacity()
+        if self._use_incremental():
+            self._mark_dirty(slots, bins, keys)
         self.acc.update(slots, self._agg_input_cols(batch))
 
     async def handle_watermark(self, watermark, ctx, collector):
@@ -523,9 +696,23 @@ class SlidingWindowOperator(WindowOperatorBase):
         self.last_freed_bin: Optional[int] = None
 
     def tables(self):
-        from ..state.table_config import global_table
+        from ..state.table_config import global_table, time_key_table
 
-        return {"s": global_table("s")}
+        # a slide-granularity bin stays live until it exits its last
+        # window: freed <=> bin_end <= wm - width + slide, so retention
+        # width - slide over __ts = bin_end - 1 prunes exactly freed bins
+        return {
+            "s": global_table("s"),
+            "si": time_key_table(
+                "si",
+                retention_nanos=self.width - self.slide,
+                timestamp_field="__ts",
+                key_fields=self._delta_key_fields(),
+            ),
+        }
+
+    def _delta_ts(self, bins: np.ndarray) -> np.ndarray:
+        return (bins + 1) * self.slide - 1
 
     async def on_start(self, ctx):
         self._capture_key_meta(ctx)
@@ -543,11 +730,14 @@ class SlidingWindowOperator(WindowOperatorBase):
                         else min(self.last_freed_bin, snap["last_freed_bin"])
                     )
                 self._restore_rows(snap, ctx)
+            await self._restore_incremental(ctx, "si")
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("s")
-            snap = self._snapshot_rows()
+            snap = await self._checkpoint_window_state(
+                ctx, "si", self._delta_ts
+            )
             snap["next_emit"] = self.next_emit
             snap["last_freed_bin"] = self.last_freed_bin
             snap["subtask"] = ctx.task_info.task_index
@@ -569,6 +759,8 @@ class SlidingWindowOperator(WindowOperatorBase):
         keys = self._key_arrays(batch)
         slots = self.dir.assign(bins, keys)
         self._ensure_capacity()
+        if self._use_incremental():
+            self._mark_dirty(slots, bins, keys)
         self.acc.update(slots, self._agg_input_cols(batch))
 
     async def handle_watermark(self, watermark, ctx, collector):

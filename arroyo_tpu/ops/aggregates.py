@@ -268,10 +268,14 @@ class Accumulator:
 
     # -- drain --------------------------------------------------------------
 
-    def gather(self, slots: np.ndarray) -> List[np.ndarray]:
+    def gather(self, slots: np.ndarray,
+               materialize: bool = True) -> List[np.ndarray]:
         """Read accumulator values for `slots` (emission); returns one numpy
         array per physical accumulator. The slots are remembered so
-        finalize() can resolve UDAF value buffers for the same emission."""
+        finalize() can resolve UDAF value buffers for the same emission.
+        With materialize=False the jax device->host copy is only
+        *dispatched*: the returned arrays are device arrays whose
+        np.asarray completes later (async snapshot overlap)."""
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
         if len(slots) == 0:
@@ -284,6 +288,8 @@ class Accumulator:
         slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
         slots_p[: len(slots)] = slots
         outs = self._gather_fn(self.state, jnp.asarray(slots_p))
+        if not materialize:
+            return [o[: len(slots)] for o in outs]
         return [np.asarray(o)[: len(slots)] for o in outs]
 
     def _make_gather_fn(self):
@@ -407,10 +413,11 @@ class Accumulator:
 
     # -- checkpoint ---------------------------------------------------------
 
-    def snapshot(self, slots: np.ndarray) -> List[np.ndarray]:
+    def snapshot(self, slots: np.ndarray,
+                 materialize: bool = True) -> List[np.ndarray]:
         """Device->host copy of live slots for checkpointing; UDAF value
         buffers ride along as one list-valued column per UDAF spec."""
-        out = self.gather(slots)
+        out = self.gather(slots, materialize=materialize)
         for si in self.udaf_idx:
             store = self.udaf_store[si]
             out.append(np.asarray(

@@ -364,7 +364,8 @@ class ShardedAccumulator(Accumulator):
 
     # -- drain --------------------------------------------------------------
 
-    def gather(self, slots: np.ndarray) -> List[np.ndarray]:
+    def gather(self, slots: np.ndarray,
+               materialize: bool = True) -> List[np.ndarray]:
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
         if len(slots) == 0:
@@ -393,6 +394,8 @@ class ShardedAccumulator(Accumulator):
         outs = self._mesh_gather_fn(
             self.state, jnp.asarray(sh_p), jnp.asarray(loc_p)
         )
+        if not materialize:
+            return [o[: len(slots)] for o in outs]
         return [np.asarray(o)[: len(slots)] for o in outs]
 
     def reset_slots(self, slots: np.ndarray):

@@ -61,12 +61,14 @@ class StateBackend:
 
     def write_time_key_file(self, epoch: int, node_id: int, op_idx: int,
                             table: str, subtask: int,
-                            data: pa.Table) -> Dict[str, Any]:
+                            data: pa.Table,
+                            timestamp_field: str = "_timestamp"
+                            ) -> Dict[str, Any]:
         path = self.paths.data_file(
             epoch, node_id, op_idx, table, subtask, "parquet"
         )
         size = self.storage.write_parquet(path, data)
-        ts_col = data.column("_timestamp").cast(pa.int64())
+        ts_col = data.column(timestamp_field).cast(pa.int64())
         import pyarrow.compute as pc
 
         return {
@@ -150,9 +152,22 @@ class StateBackend:
         return out
 
     def restore_watermark(self, task_id: str) -> Optional[int]:
+        """The watermark retention-pruning uses on restore. For a task id
+        that didn't exist pre-restart (rescale), fall back to the node's
+        minimum checkpointed watermark — the safe lower bound that still
+        prunes emitted/expired rows from the re-read key ranges."""
         if not self.restore_manifest:
             return None
-        return self.restore_manifest["watermarks"].get(task_id)
+        wms = self.restore_manifest["watermarks"]
+        wm = wms.get(task_id)
+        if wm is not None:
+            return wm
+        node = task_id.split("-")[0]
+        peers = [
+            w for t, w in wms.items()
+            if w is not None and t.split("-")[0] == node
+        ]
+        return min(peers) if peers else None
 
     # -- compaction ---------------------------------------------------------
 

@@ -231,10 +231,10 @@ class StorageProvider:
             raise IOError(
                 f"s3 conditional put of {key}: persistent 409 conflict"
             )
-        if resp.status_code // 100 != 2:
-            # e.g. 301/400 when the bucket lives in another region and no
-            # region env is set: degrade to check-then-create (with the
-            # loud warning) rather than crash the fencing path
+        if resp.status_code in (301, 307, 400):
+            # region mismatch / redirect (no region env set): degrade to
+            # check-then-create (with the loud warning) — these statuses
+            # mean the request never evaluated the condition
             logger.warning(
                 "s3 conditional put of %s failed (%s %s); falling back to "
                 "non-atomic check-then-create",
@@ -243,6 +243,13 @@ class StorageProvider:
                 resp.text[:200],
             )
             return False
+        if resp.status_code // 100 != 2:
+            # 403/5xx are ambiguous (the object may or may not exist now);
+            # degrading here could let two controllers both claim — raise
+            raise IOError(
+                f"s3 conditional put of {key} failed: "
+                f"{resp.status_code} {resp.text[:200]}"
+            )
         return True
 
     def _gcs_conditional_put(self, key: str, data: bytes) -> bool:

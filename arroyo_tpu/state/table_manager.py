@@ -84,28 +84,60 @@ class TableManager:
         return self.tables[name]
 
     async def checkpoint(self, epoch: int, watermark: Optional[int]) -> Dict:
-        """Flush dirty state; returns per-table metadata for the manifest."""
-        meta: Dict[str, dict] = {}
-        ti = self.task_info
+        """Flush dirty state; returns per-table metadata for the manifest.
+        One-shot form of capture() + flush_captured()."""
+        return self.flush_captured(epoch, self.capture(epoch, watermark))
+
+    def capture(self, epoch: int, watermark: Optional[int]) -> Dict:
+        """Synchronously stage this epoch's state at the barrier: global
+        blobs are serialized now (cheap — incremental operators keep only
+        meta here), time-key deltas are detached from the tables (possibly
+        as unresolved thunks whose device->host copy completes later).
+        After capture the operator may resume processing; flush_captured
+        does the I/O."""
+        staged: Dict[str, dict] = {}
         for name, table in self.tables.items():
             cfg = self.configs[name]
             if cfg.kind == "global":
-                blob = table.serialize()
+                staged[name] = {"kind": "global", "blob": table.serialize()}
+            else:
+                dirty = table.take_dirty_staged()
+                files = table.live_files(watermark)
+                table.expire(watermark)
+                staged[name] = {
+                    "kind": "time_key",
+                    "dirty": dirty,
+                    "files": files,
+                    "table": table,
+                }
+        return staged
+
+    def flush_captured(self, epoch: int, staged: Dict) -> Dict:
+        """Write captured state to storage; safe to run while the operator
+        processes the next epoch (captured data is immutable). Returns the
+        manifest metadata."""
+        meta: Dict[str, dict] = {}
+        ti = self.task_info
+        for name, st in staged.items():
+            cfg = self.configs[name]
+            if st["kind"] == "global":
+                blob = st["blob"]
                 path = self.backend.write_global_blob(
                     epoch, ti.node_id, self.op_idx, name, ti.task_index, blob
                 )
-                meta[name] = {"kind": "global", "path": path, "bytes": len(blob)}
+                meta[name] = {
+                    "kind": "global", "path": path, "bytes": len(blob)
+                }
             else:
-                dirty = table.take_dirty()
-                files = table.live_files(watermark)
+                dirty = TimeKeyTable.resolve_staged(st["dirty"])
+                files = st["files"]
                 if dirty is not None and dirty.num_rows:
                     f = self.backend.write_time_key_file(
                         epoch, ti.node_id, self.op_idx, name, ti.task_index,
-                        dirty,
+                        dirty, timestamp_field=cfg.timestamp_field,
                     )
                     files = files + [f]
-                table.files = files
-                table.expire(watermark)
+                st["table"].files = files
                 meta[name] = {"kind": "time_key", "files": files}
         return meta
 

@@ -99,6 +99,17 @@ class TimeKeyTable:
         self.batches.append(batch)
         self._dirty.append(batch)
 
+    def write_delta(self, batch):
+        """Conduit write: stage a delta for the next checkpoint WITHOUT
+        keeping it in the in-memory view. Operators whose in-memory source
+        of truth lives elsewhere (accumulator slots, join buffers) use this
+        so state isn't held twice. `batch` may be a RecordBatch or a
+        zero-arg callable returning one — a thunk defers materialization
+        (e.g. a dispatched device->host gather) to the flush phase."""
+        if not callable(batch) and self.schema is None:
+            self.schema = batch.schema
+        self._dirty.append(batch)
+
     def all_batches(self) -> List[pa.RecordBatch]:
         return list(self.batches)
 
@@ -136,11 +147,27 @@ class TimeKeyTable:
     # -- persistence --------------------------------------------------------
 
     def take_dirty(self) -> Optional[pa.Table]:
-        if not self._dirty:
-            return None
-        t = pa.Table.from_batches(self._dirty)
+        return self.resolve_staged(self.take_dirty_staged())
+
+    def take_dirty_staged(self) -> list:
+        """Detach the staged deltas without resolving thunks (capture
+        phase; resolution — e.g. a pending device->host copy — happens in
+        resolve_staged on the flush path)."""
+        staged = self._dirty
         self._dirty = []
-        return t
+        return staged
+
+    @staticmethod
+    def resolve_staged(staged: list) -> Optional[pa.Table]:
+        batches = []
+        for b in staged:
+            if callable(b):
+                b = b()
+            if b is not None and b.num_rows:
+                batches.append(b)
+        if not batches:
+            return None
+        return pa.Table.from_batches(batches)
 
     def live_files(self, watermark_nanos: Optional[int]) -> List[dict]:
         if watermark_nanos is None or self.config.retention_nanos is None:

@@ -1,0 +1,157 @@
+"""Incremental window checkpoints: bytes per epoch scale with the delta
+(slots touched since the last epoch), not total live state.
+
+VERDICT round-1 item 4. Reference design being mirrored:
+/root/reference/crates/arroyo-state/src/tables/expiring_time_key_map.rs:53
+(incremental files + carried live-file list), flush at table_manager.rs:368.
+"""
+
+import asyncio
+import glob
+import json
+import os
+
+import pyarrow.parquet as pq
+
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.sql import plan_query
+
+
+def test_window_checkpoint_bytes_scale_with_delta(tmp_path):
+    n = 3000
+    src = str(tmp_path / "in.json")
+    with open(src, "w") as f:
+        for i in range(n):
+            # all rows inside ONE 1-hour window; every counter is a new key
+            f.write(
+                json.dumps(
+                    {
+                        "counter": i,
+                        "timestamp": f"2023-03-01T00:00:{i % 50:02d}.000Z",
+                    }
+                )
+                + "\n"
+            )
+    sink = str(tmp_path / "out.json")
+    sql = f"""
+    CREATE TABLE src (
+      timestamp TIMESTAMP, counter BIGINT NOT NULL
+    ) WITH (connector = 'single_file', path = '{src}', format = 'json',
+            type = 'source', throttle_per_sec = '6000',
+            event_time_field = 'timestamp');
+    CREATE TABLE out (
+      k BIGINT NOT NULL, cnt BIGINT NOT NULL
+    ) WITH (connector = 'single_file', path = '{sink}', format = 'json',
+            type = 'sink');
+    INSERT INTO out
+    SELECT counter as k, count(*) as cnt
+    FROM src GROUP BY 1, tumble(interval '1 hour');
+    """
+    storage = str(tmp_path / "ckpt")
+
+    async def run():
+        plan = plan_query(sql, parallelism=1)
+        eng = Engine(plan.graph, job_id="inc", storage_url=storage).start()
+        for _ in range(3):
+            await asyncio.sleep(0.12)
+            await eng.checkpoint_and_wait()
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(120)
+
+    asyncio.run(run())
+
+    files = sorted(
+        glob.glob(os.path.join(storage, "**", "*.parquet"), recursive=True)
+    )
+    window_files = [f for f in files if "-ti-" in os.path.basename(f)]
+    assert len(window_files) >= 3, (
+        f"expected one delta file per epoch with new keys, got {files}"
+    )
+    rows_per_file = [pq.read_table(f).num_rows for f in window_files]
+    total_rows = sum(rows_per_file)
+    # each key is touched once, so the union of deltas covers each live key
+    # about once; a full-snapshot design would rewrite all keys seen so far
+    # at every epoch (sum >> n)
+    assert total_rows <= int(n * 1.5), (
+        f"deltas rewrote state: {rows_per_file} (n={n})"
+    )
+    # no single epoch rewrites (nearly) the whole key space
+    assert max(rows_per_file) < n, rows_per_file
+    # and later epochs don't grow with cumulative state: the biggest file
+    # must not dwarf the per-epoch arrival volume
+    assert min(rows_per_file) > 0
+
+
+def test_incremental_restore_supersedes_older_rows(tmp_path):
+    """A key updated across epochs appears in several delta files; restore
+    must keep the newest values (checkpoint -> stop -> restore -> final
+    output equals an uninterrupted run)."""
+    n = 2000
+    src = str(tmp_path / "in.json")
+    with open(src, "w") as f:
+        for i in range(n):
+            f.write(
+                json.dumps(
+                    {
+                        "counter": i % 7,  # every key updated every epoch
+                        "timestamp": f"2023-03-01T00:00:{i % 40:02d}.000Z",
+                    }
+                )
+                + "\n"
+            )
+
+    def sql_for(sink, throttled):
+        throttle = "throttle_per_sec = '4000'," if throttled else ""
+        return f"""
+        CREATE TABLE src (
+          timestamp TIMESTAMP, counter BIGINT NOT NULL
+        ) WITH (connector = 'single_file', path = '{src}', format = 'json',
+                type = 'source', {throttle}
+                event_time_field = 'timestamp');
+        CREATE TABLE out (
+          k BIGINT NOT NULL, cnt BIGINT NOT NULL, total BIGINT NOT NULL
+        ) WITH (connector = 'single_file', path = '{sink}', format = 'json',
+                type = 'sink');
+        INSERT INTO out
+        SELECT counter as k, count(*) as cnt, sum(counter) as total
+        FROM src GROUP BY 1, tumble(interval '1 hour');
+        """
+
+    # uninterrupted reference run
+    sink_full = str(tmp_path / "full.json")
+
+    async def run_full():
+        plan = plan_query(sql_for(sink_full, False), parallelism=1)
+        eng = Engine(plan.graph).start()
+        await eng.join(120)
+
+    asyncio.run(run_full())
+
+    # checkpointed run: stop mid-stream, restore, finish
+    sink_r = str(tmp_path / "restored.json")
+    storage = str(tmp_path / "ckpt")
+
+    async def phase1():
+        plan = plan_query(sql_for(sink_r, True), parallelism=1)
+        eng = Engine(plan.graph, job_id="sup", storage_url=storage).start()
+        for _ in range(2):
+            await asyncio.sleep(0.1)
+            await eng.checkpoint_and_wait()
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(120)
+
+    asyncio.run(phase1())
+
+    async def phase2():
+        plan = plan_query(sql_for(sink_r, False), parallelism=1)
+        eng = Engine(plan.graph, job_id="sup", storage_url=storage).start()
+        await eng.join(120)
+
+    asyncio.run(phase2())
+
+    read = lambda p: sorted(
+        json.dumps(json.loads(x), sort_keys=True)
+        for x in open(p)
+        if x.strip()
+    )
+    assert read(sink_r) == read(sink_full)

@@ -35,7 +35,7 @@ class JobFailed(Exception):
 
 
 class RunningEngine:
-    def __init__(self, program: Program):
+    def __init__(self, program: Program, prefinished: Optional[set] = None):
         self.program = program
         self.backend = program._state_backend
         self.tasks: List[asyncio.Task] = []
@@ -45,6 +45,9 @@ class RunningEngine:
         # epoch -> task_id -> CheckpointCompletedResp
         self.checkpoints: Dict[int, Dict[str, CheckpointCompletedResp]] = {}
         self._epoch = 0
+        # task_ids recorded finished in the restore manifest: their output
+        # is fully reflected in the restored state, so they must not re-run
+        self.prefinished: set = prefinished or set()
 
     @property
     def n_subtasks(self) -> int:
@@ -52,7 +55,12 @@ class RunningEngine:
 
     def start(self):
         for sub in self.program.subtasks:
-            self.tasks.append(asyncio.ensure_future(sub.runner.run()))
+            if sub.runner.task_info.task_id in self.prefinished:
+                self.tasks.append(
+                    asyncio.ensure_future(sub.runner.run_prefinished())
+                )
+            else:
+                self.tasks.append(asyncio.ensure_future(sub.runner.run()))
         return self
 
     # -- control ------------------------------------------------------------
@@ -74,13 +82,34 @@ class RunningEngine:
 
     async def wait_checkpoint(self, epoch: int, timeout: float = 60.0):
         """Wait until every subtask reported CheckpointCompleted for epoch,
-        then publish the manifest (durability point)."""
+        then publish the manifest (durability point).
+
+        A subtask that reaches end-of-stream before the barrier arrives
+        will never report it; counting finished subtasks as settled keeps a
+        checkpoint racing completion from hanging this wait. The epoch is
+        still a consistent cut: a finished task emitted everything before
+        its EOS, downstream aligned past the closed input, so the reported
+        state already reflects the finished task's full output. It is
+        published with those tasks recorded in `finished_tasks`; restore
+        re-creates them as pre-finished (EOS immediately, no re-run)."""
         deadline = time.monotonic() + timeout
-        while len(self.checkpoints.get(epoch, {})) < self.n_subtasks:
+        while (
+            len(self.checkpoints.get(epoch, {}) | {
+                t: None for t in self.finished
+            }) < self.n_subtasks
+        ):
             await self._pump(deadline)
-        reports = self.checkpoints[epoch]
+        reports = self.checkpoints.get(epoch, {})
+        finished_unreported = sorted(self.finished - set(reports))
+        if finished_unreported:
+            logger.info(
+                "checkpoint %s: %d finished task(s) carried as finished",
+                epoch, len(finished_unreported),
+            )
         if self.backend is not None:
-            manifest = self.backend.publish_checkpoint(epoch, reports)
+            manifest = self.backend.publish_checkpoint(
+                epoch, reports, finished_tasks=finished_unreported
+            )
             if manifest.get("committing"):
                 await self.commit_epoch(epoch, manifest["committing"])
             await self._compact(epoch, manifest)
@@ -192,7 +221,13 @@ class Engine:
 
     def start(self) -> RunningEngine:
         self.program.build()
-        eng = RunningEngine(self.program).start()
-        if self.program._state_backend is not None:
-            eng._epoch = self.program._state_backend.restore_epoch or 0
+        backend = self.program._state_backend
+        prefinished = set()
+        if backend is not None and backend.restore_manifest:
+            prefinished = set(
+                backend.restore_manifest.get("finished_tasks", [])
+            )
+        eng = RunningEngine(self.program, prefinished=prefinished).start()
+        if backend is not None:
+            eng._epoch = backend.restore_epoch or 0
         return eng

@@ -298,6 +298,9 @@ class InstantJoinOperator(JoinBase):
                             b = self._filter_to_range(_ipc_read(blob), ctx)
                             if b is not None and b.num_rows:
                                 tgt[side].append(b)
+                                # legacy full-snapshot rows have no delta
+                                # files; re-persist at the next checkpoint
+                                self._dirty[side].append(b)
             for side, name in enumerate(self._SIDE_TABLES):
                 t = await ctx.table(name)
                 for b in t.all_batches():
@@ -488,6 +491,9 @@ class JoinWithExpirationOperator(JoinBase):
                         b = self._filter_to_range(_ipc_read(blob), ctx)
                         if b is not None and b.num_rows:
                             self.buffers[side].append(b)
+                            # legacy full-snapshot rows have no delta
+                            # files; re-persist at the next checkpoint
+                            self._dirty[side].append(b)
             for side, name in enumerate(self._SIDE_TABLES):
                 t = await ctx.table(name)
                 for b in t.all_batches():

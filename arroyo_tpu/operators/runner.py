@@ -131,6 +131,33 @@ class SubtaskRunner:
                 )
             )
 
+    async def run_prefinished(self):
+        """Restored-as-finished (the restore manifest's `finished_tasks`):
+        every row this task ever produced is already reflected in the
+        restored downstream state, so re-running would duplicate it. Just
+        close the output streams and report finished."""
+        try:
+            await self.tail.broadcast(SignalMessage.end_of_data())
+            self.control_tx.put_nowait(
+                TaskFinishedResp(
+                    self.task_info.task_id,
+                    self.task_info.node_id,
+                    self.task_info.task_index,
+                )
+            )
+        except Exception:
+            logger.exception(
+                "prefinished task %s failed", self.task_info.task_id
+            )
+            self.control_tx.put_nowait(
+                TaskFailedResp(
+                    self.task_info.task_id,
+                    self.task_info.node_id,
+                    self.task_info.task_index,
+                    traceback.format_exc(),
+                )
+            )
+
     # --------------------------------------------------------------- source
 
     async def _run_source(self):

@@ -121,9 +121,12 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                         continue
                     self.live[self._intern_key(key_vals)] = cnt
             await self._restore_updating_incremental(ctx)
-        # everything restored must re-verify against emitted on next flush
+        # everything restored must re-verify against emitted on next flush;
+        # it is also checkpoint-dirty so a legacy full snapshot gets
+        # re-persisted as incremental rows at the first post-restore epoch
         for _, key, _slot in self.dir.items():
             self.dirty.add(key)
+            self._ckpt_dirty.add(key)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         # flush before the barrier so checkpointed emitted-state matches

@@ -492,13 +492,19 @@ class WindowOperatorBase(Operator):
                 key_cols.append(np.asarray(vals, dtype=object))
             else:
                 key_cols.append(np.asarray(vals, dtype=np.int64))
-        slots = self.dir.assign(np.asarray(bins, dtype=np.int64), key_cols)
+        bins_arr = np.asarray(bins, dtype=np.int64)
+        slots = self.dir.assign(bins_arr, key_cols)
         self._ensure_capacity()
         values = [np.asarray(v) for v in snap["values"]]
         if mask is not None:
             marr = np.asarray(mask)
             values = [v[marr] for v in values]
         self.acc.restore(slots, values)
+        # rows restored from a legacy full snapshot have no delta files;
+        # mark them dirty so the first incremental checkpoint after restore
+        # persists them (otherwise a later crash would lose every group not
+        # touched since the format upgrade)
+        self._mark_dirty(slots, bins_arr, key_cols)
 
     def _range_mask(self, keys: List[list], ctx) -> Optional[List[bool]]:
         """True per row iff the key hashes into this subtask's range."""

@@ -161,6 +161,7 @@ class Planner:
         self.graph = LogicalGraph()
         self.parallelism = parallelism
         self._source_cache: Dict[str, RelOutput] = {}
+        self._sink_nodes: Dict[str, dict] = {}
         self._cte_stack: List[Dict[str, Select]] = []
         self._counter = 0
 
@@ -1676,6 +1677,24 @@ class Planner:
                 exprs.append(be)
                 names.append(df.name)
             rel = self._add_value_node(out, exprs, names, None, "sink_cast")
+        # several INSERT INTO statements targeting one sink table merge
+        # into a single sink node with one in-edge per statement (the
+        # reference's test_merge_sink.sql shape; barrier alignment across
+        # the edges is the runner's normal multi-input path)
+        existing = self._sink_nodes.get(t.name)
+        if existing is not None:
+            prev_schema, sink_par = existing["schema"], existing["par"]
+            if not prev_schema.schema.equals(rel.schema.schema):
+                raise SqlError(
+                    f"INSERT statements into sink {t.name} produce "
+                    "different schemas (mixing updating and append streams "
+                    "into one sink is not supported)"
+                )
+            self.graph.add_edge(
+                rel.node_id, existing["node"],
+                self._edge(rel.node_id, sink_par), rel.schema,
+            )
+            return existing["node"]
         options = conn.validate_options(
             {k: v for k, v in t.options.items()
              if k not in ("connector", "type", "format")},
@@ -1704,6 +1723,9 @@ class Planner:
             rel.node_id, node.node_id,
             self._edge(rel.node_id, sink_par), rel.schema,
         )
+        self._sink_nodes[t.name] = {
+            "node": node.node_id, "schema": rel.schema, "par": sink_par,
+        }
         return node.node_id
 
 

@@ -91,6 +91,7 @@ class StateBackend:
         self,
         epoch: int,
         task_reports: Dict[str, Any],  # task_id -> CheckpointCompletedResp
+        finished_tasks: Any = (),  # task_ids finished before the barrier
     ) -> Dict[str, Any]:
         tasks = {}
         committing: Dict[str, Any] = {}
@@ -114,6 +115,7 @@ class StateBackend:
             "tasks": tasks,
             "watermarks": watermarks,
             "committing": committing,
+            "finished_tasks": sorted(finished_tasks),
             "created_at": time.time(),
         }
         protocol.publish_checkpoint(

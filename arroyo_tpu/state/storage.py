@@ -216,12 +216,13 @@ class StorageProvider:
         del headers["host"]  # requests sets it from the URL
         # 409 (ConditionalRequestConflict) means a concurrent conditional
         # write left the outcome unknown — retry: a real winner then shows
-        # as 412, otherwise our retry lands.
+        # as 412, otherwise our retry lands. Transient 5xx (SlowDown etc.)
+        # retries with backoff the same way before being treated as fatal.
         for attempt in range(5):
             resp = requests.put(url, data=data, headers=headers, timeout=30)
             if resp.status_code == 412:
                 raise CasConflict(key)
-            if resp.status_code == 409:
+            if resp.status_code == 409 or resp.status_code // 100 == 5:
                 import time as _time
 
                 _time.sleep(0.1 * (attempt + 1))

@@ -13,6 +13,28 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The experimental 'axon' TPU relay registers its PJRT plugin from
+# sitecustomize whenever PALLAS_AXON_POOL_IPS is set, and a wedged relay
+# then hangs the FIRST jax backend init in every process — even with
+# JAX_PLATFORMS=cpu. Two-level neutralisation:
+#  1. scrub the env so test-spawned subprocesses (workers, bench children)
+#     never register the plugin at startup;
+#  2. this process's sitecustomize already ran, so drop the registered
+#     axon backend factory before anything initialises a backend.
+for _var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+             "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+    os.environ.pop(_var, None)
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize's register() pins jax_platforms to 'axon' inside jax's
+    # already-imported config; env alone no longer wins
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass  # jax absent or internals moved; JAX_PLATFORMS=cpu still applies
+
 import pytest  # noqa: E402
 
 

@@ -61,12 +61,59 @@ def bids(n=2000):
     return rows
 
 
+def orders_debezium(n=120):
+    """Debezium change stream over an `orders` table: creates, then a
+    deterministic mix of updates and deletes (the reference's
+    aggregate_updates.json fixture shape)."""
+    products = ["laptop", "monitor", "keyboard", "headphones"]
+    names = ["ada", "grace", "alan", "edsger", "barbara", "donald"]
+    live = {}
+    rows = []
+
+    def envelope(op, before, after, i):
+        return {
+            "before": before,
+            "after": after,
+            "op": op,
+            "ts_ms": 1677628800000 + i * 250,
+        }
+
+    for i in range(n):
+        oid = 3000 + i
+        row = {
+            "id": oid,
+            "customer_name": names[(i * 7) % len(names)],
+            "product_name": products[(i * 11) % len(products)],
+            "quantity": 1 + (i * 13) % 5,
+            "price": round(50.0 + (i * 37) % 1900 + (i % 4) * 0.25, 2),
+            "status": ["Pending", "Shipped", "Delivered"][(i * 5) % 3],
+        }
+        live[oid] = row
+        rows.append(envelope("c", None, row, i))
+        # every third create is followed by an update of an earlier order,
+        # every seventh by a delete
+        if i % 3 == 2:
+            uid = 3000 + (i * 17) % (i + 1)
+            if uid in live:
+                before = live[uid]
+                after = dict(before, quantity=before["quantity"] + 1,
+                             status="Shipped")
+                live[uid] = after
+                rows.append(envelope("u", before, after, i))
+        if i % 7 == 6:
+            did = 3000 + (i * 23) % (i + 1)
+            if did in live:
+                rows.append(envelope("d", live.pop(did), None, i))
+    return rows
+
+
 def main():
     os.makedirs(INPUTS, exist_ok=True)
     for name, rows in [
         ("impulse.json", impulse()),
         ("cars.json", cars()),
         ("nexmark_bids.json", bids()),
+        ("aggregate_updates.json", orders_debezium()),
     ]:
         with open(os.path.join(INPUTS, name), "w") as f:
             for r in rows:

@@ -1,0 +1,26 @@
+--pk=drivers
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE active_drivers (
+  drivers BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO active_drivers
+SELECT count(*) FROM (
+  SELECT driver_id, count(*) FROM cars GROUP BY driver_id
+  HAVING count(*) > 50
+);

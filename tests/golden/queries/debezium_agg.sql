@@ -1,0 +1,28 @@
+--pk=id
+CREATE TABLE debezium_source (
+  id BIGINT PRIMARY KEY,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity BIGINT,
+  price DOUBLE,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+CREATE TABLE output (
+  id TEXT,
+  c BIGINT,
+  q BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT concat('p_', product_name), count(*), sum(quantity + 5) + 10
+FROM debezium_source
+GROUP BY concat('p_', product_name);

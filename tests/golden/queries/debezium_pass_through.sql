@@ -1,0 +1,30 @@
+--pk=id
+CREATE TABLE debezium_source (
+  id BIGINT PRIMARY KEY,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity BIGINT,
+  price DOUBLE,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/aggregate_updates.json',
+  format = 'debezium_json',
+  type = 'source'
+);
+CREATE TABLE output (
+  id BIGINT,
+  customer_name TEXT,
+  product_name TEXT,
+  quantity BIGINT,
+  price DOUBLE,
+  status TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT id, customer_name, product_name, quantity, price, status
+FROM debezium_source;

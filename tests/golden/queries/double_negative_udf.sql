@@ -1,0 +1,22 @@
+--udf=udfs.py
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE double_negative_udf (
+  counter BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO double_negative_udf
+SELECT double_negative(counter) FROM impulse_source;

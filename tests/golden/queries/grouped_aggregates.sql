@@ -1,0 +1,30 @@
+--pk=counter_mod
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE aggregates (
+  counter_mod BIGINT,
+  min BIGINT,
+  max BIGINT,
+  sum BIGINT,
+  count BIGINT,
+  avg DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO aggregates
+SELECT counter % 5, min(counter), max(counter), sum(counter), count(*),
+       avg(counter)
+FROM impulse_source
+GROUP BY 1;

@@ -1,0 +1,19 @@
+CREATE TABLE cars (
+  value JSON
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  unstructured = 'true'
+);
+CREATE TABLE sink WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO sink
+SELECT 'test' as a, value->'driver_id' as b, value->'event_type' as c,
+       value->'not_a_field' as d
+FROM cars;

@@ -1,0 +1,37 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE most_active_driver (
+  start TIMESTAMP,
+  end TIMESTAMP,
+  driver_id BIGINT,
+  count BIGINT,
+  row_number BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO most_active_driver
+SELECT window.start, window.end, driver_id, count, row_number FROM (
+  SELECT *, ROW_NUMBER() OVER (
+    PARTITION BY window
+    ORDER BY count DESC, driver_id DESC) as row_number
+  FROM (
+    SELECT driver_id,
+           hop(interval '1 minute', interval '2 minute') as window,
+           count(*) as count
+    FROM cars
+    GROUP BY 1, 2
+  )
+) WHERE row_number = 1;

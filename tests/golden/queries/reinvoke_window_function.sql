@@ -1,0 +1,35 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE output (
+  start TIMESTAMP,
+  end TIMESTAMP,
+  drivers BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO output
+SELECT window.start as start, window.end as end, drivers
+FROM (
+  SELECT tumble(interval '1 minute') as window,
+         count(DISTINCT driver_id) as drivers
+  FROM (
+    SELECT driver_id, tumble(interval '1 minute') as w,
+           count(*) as pickups
+    FROM cars WHERE event_type = 'pickup'
+    GROUP BY 1, 2
+  ) WHERE pickups > 2
+  GROUP BY 1
+);

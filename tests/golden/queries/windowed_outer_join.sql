@@ -1,0 +1,39 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE minute_aggregates (
+  minute TIMESTAMP,
+  dropoff_drivers BIGINT,
+  pickup_drivers BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO minute_aggregates
+SELECT window.start as minute, dropoff_drivers, pickup_drivers FROM (
+  SELECT dropoffs.window as window, dropoff_drivers, pickup_drivers
+  FROM (
+    SELECT tumble(interval '1 minute') as window,
+           count(DISTINCT driver_id) as dropoff_drivers
+    FROM cars WHERE event_type = 'dropoff'
+    GROUP BY 1
+  ) dropoffs
+  FULL OUTER JOIN (
+    SELECT tumble(interval '1 minute') as window,
+           count(DISTINCT driver_id) as pickup_drivers
+    FROM cars WHERE event_type = 'pickup'
+    GROUP BY 1
+  ) pickups
+  ON dropoffs.window = pickups.window
+);

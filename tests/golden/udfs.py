@@ -1,0 +1,32 @@
+# Golden-suite UDFs, mirroring the reference's smoke-test functions
+# (/root/reference/crates/arroyo-sql-testing/src/udfs.rs): a scalar UDF,
+# an ordered async UDF, and UDAFs over the grouped values vector.
+# Registered by the harness via `--udf=udfs.py` headers through
+# arroyo_tpu.udf.registry.register_from_source.
+
+
+@udf(pa.int64(), [pa.uint64()], name="double_negative")
+def double_negative(xs):
+    return -2 * xs.astype(np.int64)
+
+
+@udf(pa.int64(), [pa.uint64()], name="async_double_negative")
+async def async_double_negative(x):
+    import asyncio
+
+    await asyncio.sleep((int(x) % 20) / 1000.0)
+    return -2 * int(x)
+
+
+@udaf(pa.float64(), [pa.uint64()], name="my_median")
+def my_median(values):
+    vs = np.sort(values)
+    mid = len(vs) // 2
+    if len(vs) % 2 == 0:
+        return (float(vs[mid]) + float(vs[mid - 1])) / 2.0
+    return float(vs[mid])
+
+
+@udaf(pa.float64(), [pa.uint64()], name="none_udf")
+def none_udf(values):
+    return None

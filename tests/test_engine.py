@@ -161,3 +161,58 @@ def test_task_failure_propagates():
 
     with pytest.raises(JobFailed, match="kaboom"):
         run_graph(g)
+
+
+def test_checkpoint_continues_after_finite_source_finishes(tmp_path):
+    """Mixed finite/infinite job: once the finite source finishes,
+    checkpoints must keep publishing (finished tasks recorded in the
+    manifest as a consistent cut), and a restore must not re-run the
+    finished source (engine.py wait_checkpoint / run_prefinished)."""
+    import json
+
+    from arroyo_tpu.sql import plan_query
+
+    out = str(tmp_path / "out.json")
+    sql = f"""
+    CREATE TABLE fast WITH (connector = 'impulse', event_rate = '100000',
+      message_count = '20', start_time = '0');
+    CREATE TABLE slow WITH (connector = 'impulse', event_rate = '400',
+      message_count = '120', start_time = '0');
+    CREATE TABLE out (c BIGINT, src TEXT) WITH (
+      connector = 'single_file', path = '{out}', format = 'json',
+      type = 'sink');
+    INSERT INTO out SELECT counter, 'fast' as src FROM fast;
+    INSERT INTO out SELECT counter, 'slow' as src FROM slow;
+    """
+    storage = str(tmp_path / "ckpt")
+
+    async def phase1():
+        plan = plan_query(sql, parallelism=1)
+        eng = Engine(plan.graph, job_id="fin", storage_url=storage).start()
+        # wait for the fast source to finish (slow one keeps running)
+        while not eng.finished:
+            eng.drain_responses()
+            await asyncio.sleep(0.01)
+        await eng.checkpoint_and_wait()
+        manifest = eng.backend.latest_manifest()
+        assert manifest["finished_tasks"], (
+            "checkpoint after a source finished must record it as finished"
+        )
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(60)
+
+    asyncio.run(phase1())
+
+    async def phase2():
+        plan = plan_query(sql, parallelism=1)
+        eng = Engine(plan.graph, job_id="fin", storage_url=storage).start()
+        assert eng.prefinished, "restore must mark finished tasks"
+        await eng.join(60)
+
+    asyncio.run(phase2())
+
+    rows = [json.loads(l) for l in open(out) if l.strip()]
+    fast = sorted(r["c"] for r in rows if r["src"] == "fast")
+    slow = sorted(r["c"] for r in rows if r["src"] == "slow")
+    assert fast == list(range(20)), "finished source re-ran or lost rows"
+    assert slow == list(range(120))

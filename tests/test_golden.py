@@ -24,10 +24,34 @@ import pytest
 
 from arroyo_tpu.engine import Engine
 from arroyo_tpu.sql import plan_query
+from arroyo_tpu.sql.lexer import SqlError
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 GOLDEN = os.path.join(HERE, "golden")
 QUERIES = sorted(glob.glob(os.path.join(GOLDEN, "queries", "*.sql")))
+
+
+def query_headers(path):
+    """Leading `--key=value` comment lines (reference smoke_tests.rs
+    parses the same headers out of its .sql files)."""
+    headers = {}
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("--") or "=" not in line:
+            break
+        k, v = line[2:].split("=", 1)
+        headers[k.strip()] = v.strip()
+    return headers
+
+
+def register_query_udfs(headers):
+    """`--udf=<file>` registers UDFs from tests/golden/<file> before
+    planning (the reference links its smoke-test UDFs via udfs.rs)."""
+    if "udf" in headers:
+        from arroyo_tpu.udf import registry
+
+        src = open(os.path.join(GOLDEN, headers["udf"])).read()
+        registry.register_from_source(src)
 
 
 def load_query(path, output_path, throttle=None):
@@ -118,6 +142,20 @@ def run_with_restore(sql_throttled, sql_fast, storage_url, job_id):
 def test_golden_query(query_path, tmp_path):
     name = os.path.basename(query_path)[:-4]
     golden_path = os.path.join(GOLDEN, "golden_outputs", f"{name}.json")
+    headers = query_headers(query_path)
+    register_query_udfs(headers)
+
+    if "fail" in headers:
+        # error-message golden (reference smoke_tests.rs --fail= queries):
+        # planning must reject the query with the documented message
+        with pytest.raises(SqlError) as err:
+            plan_query(load_query(query_path, str(tmp_path / "never.json")),
+                       parallelism=2)
+        assert headers["fail"] in str(err.value), (
+            f"{name}: expected error containing {headers['fail']!r}, "
+            f"got {err.value}"
+        )
+        return
 
     # 1. uninterrupted run
     out1 = str(tmp_path / "full.json")

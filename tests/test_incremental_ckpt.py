@@ -52,8 +52,25 @@ def test_window_checkpoint_bytes_scale_with_delta(tmp_path):
     async def run():
         plan = plan_query(sql, parallelism=1)
         eng = Engine(plan.graph, job_id="inc", storage_url=storage).start()
+        # progress-gated (not sleep-gated): each mid-stream checkpoint waits
+        # until the window operator has received at least one new batch, so
+        # every epoch's delta is non-empty regardless of machine speed
+        win = next(
+            s for s in eng.program.subtasks
+            if not s.node.is_source and "window" in s.node.description
+        )
+        recv = win.runner._batches_recv
+        import time as _time
+
+        async def one_more_batch(last: float, timeout: float = 30.0):
+            t0 = _time.monotonic()
+            while recv.get() <= last and _time.monotonic() - t0 < timeout:
+                await asyncio.sleep(0.01)
+            return recv.get()
+
+        seen = 0.0
         for _ in range(3):
-            await asyncio.sleep(0.12)
+            seen = await one_more_batch(seen)
             await eng.checkpoint_and_wait()
         await eng.checkpoint_and_wait(then_stop=True)
         await eng.join(120)

@@ -202,11 +202,12 @@ class WindowOperatorBase(Operator):
 
     def _use_incremental(self) -> bool:
         """Struct keys (window structs) hash differently in the parquet
-        snapshot than on the shuffle, and UDAF buffers are variable-length
-        host state — both fall back to the full-snapshot global table."""
+        snapshot than on the shuffle, and host-state aggregates (UDAF
+        buffers, count_distinct multisets) are variable-length — both fall
+        back to the full-snapshot global table."""
         if self._key_types is None:
             return False
-        if any(s.kind == "udaf" for s in self.specs):
+        if any(s.host_state() is not None for s in self.specs):
             return False
         return not any(pa.types.is_struct(t) for t in self._key_types)
 
@@ -324,18 +325,23 @@ class WindowOperatorBase(Operator):
                 out.append(np.array(col.to_pylist(), dtype=object))
         return out
 
-    def _agg_input_cols(self, batch: pa.RecordBatch) -> Dict[int, np.ndarray]:
-        cols: Dict[int, np.ndarray] = {}
+    def _agg_input_cols(self, batch: pa.RecordBatch) -> Dict:
+        """Column arrays for the accumulator. Numeric (device-phys) specs
+        that actually read their column ('col'-sourced phys ops — count's
+        phys reads the constant 1, never the column) claim plain keys with
+        the cast the reduction needs; host-state specs (UDAF buffers,
+        count_distinct multisets) always get the raw uncast values under
+        ('raw', col) so strings survive and BIGINTs shared with a float
+        spec don't collapse above 2^53."""
+        cols: Dict = {}
         for spec in self.specs:
-            if spec.col is not None and spec.col not in cols:
+            if spec.col is None or spec.host_state() is not None:
+                continue
+            if not any(src == "col" for _, _, src in spec.phys()):
+                continue  # e.g. count(x): phys reads 'one', not the column
+            if spec.col not in cols:
                 arr = batch.column(spec.col)
-                if spec.kind == "udaf":
-                    # UDAFs receive raw values (no numeric cast): strings,
-                    # timestamps etc. buffer host-side untouched
-                    cols[spec.col] = np.asarray(
-                        arr.to_numpy(zero_copy_only=False)
-                    )
-                elif spec.is_float:
+                if spec.is_float:
                     cols[spec.col] = np.asarray(
                         arr.to_numpy(zero_copy_only=False), dtype=np.float64
                     )
@@ -343,6 +349,14 @@ class WindowOperatorBase(Operator):
                     cols[spec.col] = np.asarray(
                         arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
                     )
+        for spec in self.specs:
+            if spec.col is None or spec.host_state() is None:
+                continue
+            key = ("raw", spec.col)
+            if key not in cols:
+                cols[key] = np.asarray(
+                    batch.column(spec.col).to_numpy(zero_copy_only=False)
+                )
         return cols
 
     def _build_output(
@@ -495,7 +509,19 @@ class WindowOperatorBase(Operator):
         bins_arr = np.asarray(bins, dtype=np.int64)
         slots = self.dir.assign(bins_arr, key_cols)
         self._ensure_capacity()
-        values = [np.asarray(v) for v in snap["values"]]
+        # trailing host-state columns (UDAF buffers / count-distinct
+        # multisets) are per-slot variable-length lists: force 1-d object
+        # arrays — np.asarray on ragged nested lists raises, and on
+        # same-length lists it would silently build a 2-d numeric array
+        n_phys = len(self.acc.phys)
+        values = []
+        for j, v in enumerate(snap["values"]):
+            if j < n_phys:
+                values.append(np.asarray(v))
+            else:
+                arr = np.empty(len(v), dtype=object)
+                arr[:] = v
+                values.append(arr)
         if mask is not None:
             marr = np.asarray(mask)
             values = [v[marr] for v in values]
@@ -894,7 +920,17 @@ class SessionWindowOperator(WindowOperatorBase):
             )
 
         slot_pos = {s: i for i, s in enumerate(snap["slots"])}
-        values = [np.asarray(v) for v in snap["values"]]
+        # trailing host-state columns are ragged per-slot lists (same
+        # object-array discipline as _restore_rows)
+        n_phys = len(self.acc.phys)
+        values = []
+        for j, v in enumerate(snap["values"]):
+            if j < n_phys:
+                values.append(np.asarray(v))
+            else:
+                arr = np.empty(len(v), dtype=object)
+                arr[:] = v
+                values.append(arr)
         key_rows = [key_vals for key_vals, _ in snap["sessions"]]
         mask = self._range_mask(key_rows, ctx) if key_rows else None
         for si, (key_vals, sess_list) in enumerate(snap["sessions"]):

@@ -48,18 +48,30 @@ INT_MAX = np.iinfo(np.int64).max
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    kind: str  # count | sum | min | max | avg | udaf
+    kind: str  # count | sum | min | max | avg | count_distinct | udaf
     col: Optional[int]  # input column index (None for count(*))
     name: str  # output field name
     is_float: bool = False  # input/output numeric class
     udaf: Optional[str] = None  # registered UDAF name when kind == "udaf"
 
+    def host_state(self) -> Optional[str]:
+        """Host-resident per-slot state flavor, or None when the aggregate
+        decomposes fully onto device phys arrays. 'buffer' = raw value
+        chunks (UDAFs; order-insensitive, append-only). 'multiset' = value
+        -> signed count (count_distinct; retractable, mergeable)."""
+        if self.kind == "udaf":
+            return "buffer"
+        if self.kind == "count_distinct":
+            return "multiset"
+        return None
+
     def phys(self) -> List[Tuple[str, str, str]]:
         """[(op, dtype, source)]: op in add|min|max, dtype i8|f8,
         source col|one."""
-        if self.kind == "udaf":
-            # user-defined aggregates buffer raw values host-side (the
-            # reference hands all values to the UDAF too, udafs.rs)
+        if self.host_state() is not None:
+            # host-state aggregates keep raw values host-side (the
+            # reference hands all values to its UDAFs too, udafs.rs;
+            # count_distinct is a DataFusion grouped-distinct there)
             return []
         if self.kind == "count":
             return [("add", "i8", "one")]
@@ -73,6 +85,20 @@ class AggSpec:
         if self.kind == "avg":
             return [("add", "f8", "col"), ("add", "i8", "one")]
         raise ValueError(f"unknown aggregate {self.kind}")
+
+
+def _not_null_mask(vals: np.ndarray) -> np.ndarray:
+    """True per row where the value is non-null (None or NaN)."""
+    if vals.dtype == object:
+        return np.fromiter(
+            (v is not None and v == v for v in vals),
+            dtype=bool, count=len(vals),
+        )
+    if vals.dtype.kind == "f":
+        return ~np.isnan(vals)
+    if vals.dtype.kind == "M":
+        return ~np.isnat(vals)
+    return np.ones(len(vals), dtype=bool)
 
 
 def _neutral(op: str, dtype: str):
@@ -108,13 +134,27 @@ class Accumulator:
             for op, dtype, src in spec.phys():
                 self.phys.append((op, dtype, src, si))
         self._buckets = tuple(config().tpu.shape_buckets)
-        # host-side raw-value buffers for UDAF specs: spec idx -> slot -> chunks
-        self.udaf_idx = [i for i, s in enumerate(specs) if s.kind == "udaf"]
+        # host-side per-slot state: spec idx -> slot -> chunks ('buffer',
+        # UDAFs) or value->count dict ('multiset', count_distinct)
+        self.host_kinds: Dict[int, str] = {
+            i: s.host_state() for i, s in enumerate(specs)
+            if s.host_state() is not None
+        }
+        self.udaf_idx = [
+            i for i, k in self.host_kinds.items() if k == "buffer"
+        ]
+        self.multiset_idx = [
+            i for i, k in self.host_kinds.items() if k == "multiset"
+        ]
         self.udaf_store: Dict[int, Dict[int, list]] = {
             i: {} for i in self.udaf_idx
         }
+        self.multiset_store: Dict[int, Dict[int, dict]] = {
+            i: {} for i in self.multiset_idx
+        }
         self._gather_slots: Optional[np.ndarray] = None
         self._segment_udaf: Optional[Dict[int, list]] = None
+        self._segment_multiset: Optional[Dict[int, list]] = None
         if backend == "jax":
             jnp = _get_jax().numpy
             self.state = [
@@ -177,7 +217,7 @@ class Accumulator:
         if n == 0:
             return
         self._check_signed(signs)
-        self._buffer_udafs(slots, cols)
+        self._update_host(slots, cols, signs)
         if not self.phys:
             return
         if self.backend == "numpy":
@@ -211,11 +251,14 @@ class Accumulator:
         ):
             raise ValueError(
                 "signed (retractable) update requires invertible aggregates "
-                "(count/sum/avg)"
+                "(count/sum/avg/count_distinct)"
             )
 
-    def _buffer_udafs(self, slots: np.ndarray, cols: Dict[int, np.ndarray]):
-        if not self.udaf_idx:
+    def _update_host(self, slots: np.ndarray, cols: Dict[int, np.ndarray],
+                     signs: Optional[np.ndarray] = None):
+        """Fold a batch into the host-side per-slot states: value chunks
+        for 'buffer' specs, signed value counts for 'multiset' specs."""
+        if not self.host_kinds:
             return
         n = len(slots)
         order = np.argsort(slots, kind="stable")
@@ -223,11 +266,42 @@ class Accumulator:
         bounds = np.nonzero(np.diff(s_sorted))[0] + 1
         starts = np.concatenate([[0], bounds])
         ends = np.concatenate([bounds, [n]])
+        sg_sorted = signs[order] if signs is not None else None
         for si in self.udaf_idx:
-            vals = cols[self.specs[si].col][order]
+            vals = self._host_vals(si, cols)[order]
             store = self.udaf_store[si]
             for lo, hi in zip(starts, ends):
                 store.setdefault(int(s_sorted[lo]), []).append(vals[lo:hi])
+        for si in self.multiset_idx:
+            # SQL count(DISTINCT x) excludes NULLs; raw columns carry them
+            # as None (object dtype) or NaN (float)
+            vals = self._host_vals(si, cols)[order]
+            valid = _not_null_mask(vals)
+            store = self.multiset_store[si]
+            for lo, hi in zip(starts, ends):
+                d = store.setdefault(int(s_sorted[lo]), {})
+                gv = valid[lo:hi]
+                group = vals[lo:hi][gv]
+                if sg_sorted is None:
+                    uniq, counts = np.unique(group, return_counts=True)
+                    for v, c in zip(uniq.tolist(), counts.tolist()):
+                        d[v] = d.get(v, 0) + c
+                else:
+                    for v, sg in zip(group.tolist(),
+                                     sg_sorted[lo:hi][gv].tolist()):
+                        nc = d.get(v, 0) + int(sg)
+                        if nc <= 0:
+                            d.pop(v, None)
+                        else:
+                            d[v] = nc
+
+    def _host_vals(self, si: int, cols: Dict) -> np.ndarray:
+        """Host-state specs read the raw (uncast) representation when the
+        operator provided one under ('raw', col) — a column shared with a
+        float-cast numeric spec would otherwise lose integer precision
+        above 2^53 in the multiset keys."""
+        c = self.specs[si].col
+        return cols[("raw", c)] if ("raw", c) in cols else cols[c]
 
     def _make_update_fn(self):
         jax = _get_jax()
@@ -278,6 +352,7 @@ class Accumulator:
         np.asarray completes later (async snapshot overlap)."""
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
+        self._segment_multiset = None
         if len(slots) == 0:
             return [np.empty(0, dtype=s.dtype) for s in
                     (self.state if self.backend == "numpy" else self.state)]
@@ -304,6 +379,10 @@ class Accumulator:
     def _drop_udaf_slots(self, slots: np.ndarray):
         for si in self.udaf_idx:
             store = self.udaf_store[si]
+            for s in slots:
+                store.pop(int(s), None)
+        for si in self.multiset_idx:
+            store = self.multiset_store[si]
             for s in slots:
                 store.pop(int(s), None)
 
@@ -338,13 +417,16 @@ class Accumulator:
 
     def finalize(self, gathered: List[np.ndarray]) -> List[np.ndarray]:
         """Physical accumulator values -> one output column per spec.
-        UDAF specs evaluate their user function over the buffered values of
-        the slots from the preceding gather()/combine_for_segments()."""
+        Host-state specs resolve from the per-slot stores of the slots from
+        the preceding gather()/combine_for_segments()."""
         out = []
         pi = 0
         for si, spec in enumerate(self.specs):
             if spec.kind == "udaf":
                 out.append(self._finalize_udaf(si))
+                continue
+            if spec.kind == "count_distinct":
+                out.append(self._finalize_multiset(si))
                 continue
             n_phys = len(spec.phys())
             vals = gathered[pi: pi + n_phys]
@@ -355,6 +437,16 @@ class Accumulator:
             else:
                 out.append(vals[0])
         return out
+
+    def _finalize_multiset(self, si: int) -> np.ndarray:
+        if self._segment_multiset is not None:
+            sets = self._segment_multiset.get(si, [])
+            return np.asarray([len(s) for s in sets], dtype=np.int64)
+        store = self.multiset_store[si]
+        return np.asarray(
+            [len(store.get(int(s), ())) for s in self._gather_slots],
+            dtype=np.int64,
+        )
 
     def _finalize_udaf(self, si: int) -> np.ndarray:
         from ..udf.registry import get_udaf
@@ -401,22 +493,39 @@ class Accumulator:
                     np.concatenate(g) if g else np.empty(0) for g in groups
                 ]
             self._segment_udaf = seg_map
+        if self.multiset_idx:
+            mseg: Dict[int, list] = {}
+            for si in self.multiset_idx:
+                store = self.multiset_store[si]
+                sets: List[set] = [set() for _ in range(n_segments)]
+                for s, seg in zip(slots, seg_ids):
+                    sets[int(seg)].update(store.get(int(s), ()))
+                mseg[si] = sets
+            self._segment_multiset = mseg
         return combined
 
     def merge_slot_into(self, dst: int, src: int):
         """Fold slot src into dst (session merges): device phys via
-        gather/restore is handled by the caller; UDAF buffers move here."""
+        gather/restore is handled by the caller; host state moves here."""
         for si in self.udaf_idx:
             store = self.udaf_store[si]
             if src in store:
                 store.setdefault(dst, []).extend(store.pop(src))
+        for si in self.multiset_idx:
+            store = self.multiset_store[si]
+            if src in store:
+                d = store.setdefault(dst, {})
+                for v, c in store.pop(src).items():
+                    d[v] = d.get(v, 0) + c
 
     # -- checkpoint ---------------------------------------------------------
 
     def snapshot(self, slots: np.ndarray,
                  materialize: bool = True) -> List[np.ndarray]:
-        """Device->host copy of live slots for checkpointing; UDAF value
-        buffers ride along as one list-valued column per UDAF spec."""
+        """Device->host copy of live slots for checkpointing; host state
+        rides along as one list-valued column per host-state spec (value
+        chunks for buffers, [value, count] pairs for multisets), ordered
+        buffers-then-multisets by spec index."""
         out = self.gather(slots, materialize=materialize)
         for si in self.udaf_idx:
             store = self.udaf_store[si]
@@ -425,29 +534,44 @@ class Accumulator:
                  for s in slots],
                 dtype=object,
             ))
+        for si in self.multiset_idx:
+            store = self.multiset_store[si]
+            out.append(np.asarray(
+                [[[v, c] for v, c in store.get(int(s), {}).items()]
+                 for s in slots],
+                dtype=object,
+            ))
         return out
 
     def _restore_udaf_cols(
         self, slots: np.ndarray, values: List[np.ndarray]
     ) -> List[np.ndarray]:
-        """Consume trailing UDAF value-buffer columns; returns the physical
+        """Consume trailing host-state columns; returns the physical
         accumulator columns."""
-        if not self.udaf_idx:
+        if not self.host_kinds:
             return values
         n_phys = len(self.phys)
-        udaf_cols = values[n_phys:]
+        host_cols = values[n_phys:]
         values = values[:n_phys]
-        for si, col in zip(self.udaf_idx, udaf_cols):
+        n_buf = len(self.udaf_idx)
+        for si, col in zip(self.udaf_idx, host_cols[:n_buf]):
             store = self.udaf_store[si]
             for s, vals in zip(slots, col):
                 arr = np.asarray(list(vals))
                 if len(arr):
                     store.setdefault(int(s), []).append(arr)
+        for si, col in zip(self.multiset_idx, host_cols[n_buf:]):
+            store = self.multiset_store[si]
+            for s, pairs in zip(slots, col):
+                if len(pairs):
+                    d = store.setdefault(int(s), {})
+                    for v, c in pairs:
+                        d[v] = d.get(v, 0) + int(c)
         return values
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
         """Write physical accumulator values back into `slots` (the tail
-        columns are UDAF value buffers when UDAF specs exist)."""
+        columns are host-state buffers when such specs exist)."""
         values = self._restore_udaf_cols(slots, values)
         if len(slots) == 0 or not self.phys:
             return

@@ -240,7 +240,7 @@ class ShardedAccumulator(Accumulator):
         if n == 0:
             return
         self._check_signed(signs)
-        self._buffer_udafs(slots, cols)
+        self._update_host(slots, cols, signs)
         if not self.phys:
             return
         S, R = self.n_shards, self.rows_per_shard
@@ -368,6 +368,7 @@ class ShardedAccumulator(Accumulator):
                materialize: bool = True) -> List[np.ndarray]:
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
+        self._segment_multiset = None
         if len(slots) == 0:
             return [
                 np.empty(0, dtype=_np_dtype(dt))

@@ -543,28 +543,6 @@ class Planner:
         key_names = _dedup([_default_name(g, b) for g, b in
                             zip(group_exprs, key_bound)])
         agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
-        if any(c.distinct for c in agg_calls):
-            if instant or window_spec.kind == "session":
-                raise SqlError(
-                    "count(DISTINCT) is supported with tumble()/hop() "
-                    "windows (two-stage rewrite)"
-                )
-            if sum(c.distinct for c in agg_calls) > 1:
-                raise SqlError(
-                    "one count(DISTINCT) per query is supported"
-                )
-            if len(agg_calls) > 1:
-                # mixed with regular aggregates: distinct branch joined to
-                # the regular-aggregate branch on (window, keys)
-                return self._plan_mixed_distinct(
-                    sel, items, upstream, where, window_spec, window_alias,
-                    group_exprs, key_bound, key_names, agg_calls,
-                    agg_inputs,
-                )
-            return self._plan_count_distinct(
-                sel, items, upstream, where, window_spec, window_alias,
-                group_exprs, key_bound, key_names, agg_calls[0],
-            )
         wfield = None if instant else (window_alias or "window")
         agg_out, agg_out_names = self._windowed_agg_node(
             upstream, where, window_spec, key_bound, key_names,
@@ -1008,17 +986,15 @@ class Planner:
             [_default_name(g, b) for g, b in zip(group_exprs, key_bound)]
         )
         agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
-        if any(c.distinct for c in agg_calls):
-            raise SqlError(
-                "count(DISTINCT) in updating aggregates is not yet supported"
-            )
         if upstream.updating:
             # retraction-consuming aggregation: retract rows apply with
             # sign -1, so only invertible aggregates work (reference
-            # incremental_aggregator.rs supports the same add-reductions)
+            # incremental_aggregator.rs supports the same add-reductions;
+            # count(DISTINCT) inverts through its per-key multiset)
             bad = [
                 c.name for c in agg_calls
-                if ("avg" if c.name == "mean" else c.name)
+                if not c.distinct
+                and ("avg" if c.name == "mean" else c.name)
                 not in ("count", "sum", "avg")
             ]
             if bad:
@@ -1116,196 +1092,6 @@ class Planner:
         return self._add_value_node(
             agg_out, post_exprs, _dedup(post_names), having,
             _describe_items(post_names),
-        )
-
-    def _count_distinct_core(
-        self, upstream, where, window_spec, key_bound, key_names, call,
-    ) -> Tuple[RelOutput, str]:
-        """Two-stage distinct count (the reference evaluates it inside
-        DataFusion; here: windowed dedup on (keys, x) then an instant count
-        per (window, keys)). Returns (agg_out, count column name); agg_out's
-        schema leads with the join keys [__w, keys...]."""
-        x = bind(call.args[0], upstream.scope) if call.args else None
-        if x is None:
-            raise SqlError("count(DISTINCT *) is not valid")
-        # stage 1: dedup rows per (window, keys, x): window agg with no
-        # aggregate outputs
-        pre = self._add_value_node(
-            upstream, key_bound + [x], key_names + ["__dx"], where, "distinct_in"
-        )
-        s1_fields = [
-            pa.field(n, pre.schema.schema.field(i).type)
-            for i, n in enumerate(key_names + ["__dx"])
-        ]
-        s1_fields.append(pa.field("__w", WINDOW_TYPE))
-        s1_schema = StreamSchema(add_timestamp_field(pa.schema(s1_fields)))
-        op_name = (
-            OperatorName.TUMBLING_WINDOW_AGGREGATE
-            if window_spec.kind == "tumbling"
-            else OperatorName.SLIDING_WINDOW_AGGREGATE
-        )
-        cfg: Dict = {
-            "aggregates": [],
-            "key_cols": list(range(len(key_names) + 1)),
-            "schema": s1_schema,
-            "window_field": "__w",
-            "width_nanos": window_spec.width,
-        }
-        if window_spec.kind == "sliding":
-            cfg["slide_nanos"] = window_spec.slide
-        s1 = self.graph.add_node(
-            LogicalNode.single(
-                self._next_id(), op_name, cfg, "distinct_dedup",
-                parallelism=self.parallelism,
-            )
-        )
-        self.graph.add_edge(
-            pre.node_id, s1.node_id, EdgeType.SHUFFLE,
-            pre.schema.with_keys(key_names + ["__dx"]),
-        )
-        s1_out = RelOutput(
-            s1.node_id, s1_schema, Scope.from_schema(s1_schema.schema),
-            window=window_spec, window_field="__w",
-        )
-        # stage 2: instant count per (window, keys)
-        cname = self._fresh("agg_out")
-        s2_fields = [
-            pa.field("__w", WINDOW_TYPE)
-        ] + [
-            pa.field(n, s1_schema.schema.field(i).type)
-            for i, n in enumerate(key_names)
-        ] + [pa.field(cname, pa.int64())]
-        s2_schema = StreamSchema(add_timestamp_field(pa.schema(s2_fields)))
-        s2_keys = ["__w"] + key_names
-        cfg2: Dict = {
-            "aggregates": [
-                {"kind": "count", "col": None, "name": cname,
-                 "is_float": False}
-            ],
-            "key_cols": [s1_schema.schema.names.index(k) for k in s2_keys],
-            "schema": s2_schema,
-            "width_nanos": 0,
-        }
-        s2 = self.graph.add_node(
-            LogicalNode.single(
-                self._next_id(),
-                OperatorName.TUMBLING_WINDOW_AGGREGATE,
-                cfg2,
-                "distinct_count",
-                parallelism=self.parallelism,
-            )
-        )
-        self.graph.add_edge(
-            s1.node_id, s2.node_id, EdgeType.SHUFFLE,
-            s1_schema.with_keys(s2_keys),
-        )
-        agg_out = RelOutput(
-            s2.node_id, s2_schema, Scope.from_schema(s2_schema.schema),
-            window=window_spec, window_field="__w",
-        )
-        return agg_out, cname
-
-    def _plan_count_distinct(
-        self, sel, items, upstream, where, window_spec, window_alias,
-        group_exprs, key_bound, key_names, call,
-    ) -> RelOutput:
-        agg_out, cname = self._count_distinct_core(
-            upstream, where, window_spec, key_bound, key_names, call
-        )
-        wfield = window_alias or "window"
-        out, post_names = self._agg_post_projection(
-            sel, items, agg_out, key_names, group_exprs, [call], [cname],
-            "__w",
-        )
-        return dataclasses.replace(
-            out, window=window_spec,
-            window_field=wfield if wfield in post_names else
-            ("__w" if "__w" in post_names else None),
-        )
-
-    def _plan_mixed_distinct(
-        self, sel, items, upstream, where, window_spec, window_alias,
-        group_exprs, key_bound, key_names, agg_calls, agg_inputs,
-    ) -> RelOutput:
-        """count(DISTINCT x) mixed with regular aggregates in one SELECT:
-        the two-stage distinct branch and a regular windowed-aggregate
-        branch both consume the upstream, then an instant join on
-        (window, keys) re-unites them — the same shape a user would write
-        by hand (and the nexmark q5 join pattern)."""
-        distinct_call = next(c for c in agg_calls if c.distinct)
-        regular = [
-            (c, b) for c, b in zip(agg_calls, agg_inputs) if not c.distinct
-        ]
-        d_out, cname = self._count_distinct_core(
-            upstream, where, window_spec, key_bound, key_names,
-            distinct_call,
-        )
-        # regular branch: the plain windowed-aggregate builder with a fresh
-        # window column name (the distinct branch owns "__w")
-        rw = self._fresh("w")
-        r_out, reg_names = self._windowed_agg_node(
-            upstream, where, window_spec, key_bound, key_names,
-            [c for c, _ in regular], [b for _, b in regular], rw,
-            instant=False,
-        )
-        # instant join on (window, keys); _join_side_projection explodes
-        # the window struct into physical __keyN columns like plan_join
-        lkeys = [bind(Column("__w"), d_out.scope)] + [
-            bind(Column(k), d_out.scope) for k in key_names
-        ]
-        rkeys = [bind(Column(rw), r_out.scope)] + [
-            bind(Column(k), r_out.scope) for k in key_names
-        ]
-        lpre, nkeys = self._join_side_projection(d_out, lkeys, "mixed_jl")
-        rpre, _ = self._join_side_projection(r_out, rkeys, "mixed_jr")
-        fields, lnames, rnames = _join_output_fields(lpre, rpre, nkeys)
-        out_schema = StreamSchema(add_timestamp_field(pa.schema(fields)))
-        jconfig = {
-            "n_keys": nkeys,
-            "join_type": "inner",
-            "schema": out_schema,
-            "left_fields": lnames,
-            "right_fields": rnames,
-            "left_schema": lpre.schema,
-            "right_schema": rpre.schema,
-            "window": dataclasses.asdict(window_spec),
-        }
-        jnode = self.graph.add_node(
-            LogicalNode.single(
-                self._next_id(), OperatorName.INSTANT_JOIN, jconfig,
-                "mixed_distinct_join", parallelism=self.parallelism,
-            )
-        )
-        self.graph.add_edge(
-            lpre.node_id, jnode.node_id, EdgeType.LEFT_JOIN,
-            lpre.schema.with_keys(list(lpre.schema.schema.names[:nkeys])),
-        )
-        self.graph.add_edge(
-            rpre.node_id, jnode.node_id, EdgeType.RIGHT_JOIN,
-            rpre.schema.with_keys(list(rpre.schema.schema.names[:nkeys])),
-        )
-        joined = RelOutput(
-            jnode.node_id, out_schema, Scope.from_schema(out_schema.schema),
-            window=window_spec, window_field="__w",
-        )
-        # post-projection over the joined row
-        call_names: List[str] = []
-        ri = 0
-        for c in agg_calls:
-            if c.distinct:
-                call_names.append(cname)
-            else:
-                call_names.append(reg_names[ri])
-                ri += 1
-        wfield = window_alias or "window"
-        out, post_names = self._agg_post_projection(
-            sel, items, joined, key_names, group_exprs, agg_calls,
-            call_names, "__w",
-        )
-        return dataclasses.replace(
-            out, window=window_spec,
-            window_field=wfield if wfield in post_names else
-            ("__w" if "__w" in post_names else None),
         )
 
     def _resolve_group_ref(self, g: Expr, items: List[SelectItem]) -> Expr:

@@ -15,6 +15,7 @@ CREATE TABLE debezium_source (
 CREATE TABLE output (
   id TEXT,
   c BIGINT,
+  d BIGINT,
   q BIGINT
 ) WITH (
   connector = 'single_file',
@@ -23,6 +24,7 @@ CREATE TABLE output (
   type = 'sink'
 );
 INSERT INTO output
-SELECT concat('p_', product_name), count(*), sum(quantity + 5) + 10
+SELECT concat('p_', product_name), count(*), count(DISTINCT customer_name),
+       sum(quantity + 5) + 10
 FROM debezium_source
 GROUP BY concat('p_', product_name);

@@ -1,0 +1,32 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  minute TIMESTAMP,
+  drivers BIGINT,
+  locations BIGINT,
+  events BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT window.start, drivers, locations, events FROM (
+  SELECT tumble(interval '1 minute') as window,
+         count(DISTINCT driver_id) as drivers,
+         count(DISTINCT location) as locations,
+         count(*) as events
+  FROM cars
+  GROUP BY 1
+);

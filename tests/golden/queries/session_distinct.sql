@@ -1,0 +1,30 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  start TIMESTAMP,
+  end TIMESTAMP,
+  driver_id BIGINT,
+  locations BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT window.start, window.end, driver_id, locations FROM (
+  SELECT session(interval '20 second') as window, driver_id,
+         count(DISTINCT location) as locations
+  FROM cars
+  GROUP BY window, driver_id
+);

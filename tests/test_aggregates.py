@@ -117,3 +117,54 @@ def test_directory_growth_and_scratch():
     ks, sl = d.take_bin(0)
     assert len(ks) == 100
     assert all(c == 1 for c in acc.finalize(acc.gather(sl))[0])
+
+
+def test_count_distinct_excludes_nulls():
+    from arroyo_tpu.ops.aggregates import AggSpec, make_accumulator
+
+    acc = make_accumulator(
+        [AggSpec("count_distinct", 0, "d")], backend="numpy"
+    )
+    slots = np.zeros(5, dtype=np.int64)
+    vals = np.array(["a", None, "b", None, "a"], dtype=object)
+    acc.update(slots, {0: vals})
+    acc.gather(np.array([0]))
+    assert acc.finalize([])[0].tolist() == [2]  # NULLs excluded
+
+
+def test_count_distinct_raw_precision_beyond_2_53():
+    """A BIGINT column shared with a float-cast spec must reach the
+    multiset uncast: 2^53 and 2^53+1 are equal as float64."""
+    from arroyo_tpu.ops.aggregates import AggSpec, make_accumulator
+
+    acc = make_accumulator(
+        [AggSpec("avg", 0, "a", is_float=True),
+         AggSpec("count_distinct", 0, "d")],
+        backend="numpy",
+    )
+    big = np.array([2**53, 2**53 + 1], dtype=np.int64)
+    acc.update(np.zeros(2, dtype=np.int64),
+               {0: big.astype(np.float64), ("raw", 0): big})
+    acc.gather(np.array([0]))
+    out = acc.finalize(acc.gather(np.array([0])))
+    assert out[1].tolist() == [2], "distinct collapsed via float64 keys"
+
+
+def test_count_distinct_multiset_snapshot_roundtrip_ragged():
+    """Slots with different numbers of distinct values snapshot as ragged
+    object columns and must restore exactly."""
+    from arroyo_tpu.ops.aggregates import AggSpec, make_accumulator
+
+    acc = make_accumulator(
+        [AggSpec("count_distinct", 0, "d")], backend="numpy"
+    )
+    slots = np.array([0, 0, 1, 1, 1], dtype=np.int64)
+    vals = np.array(["x", "y", "p", "q", "r"], dtype=object)
+    acc.update(slots, {0: vals})
+    snap = acc.snapshot(np.array([0, 1]))
+    acc2 = make_accumulator(
+        [AggSpec("count_distinct", 0, "d")], backend="numpy"
+    )
+    acc2.restore(np.array([0, 1]), snap)
+    acc2.gather(np.array([0, 1]))
+    assert acc2.finalize([])[0].tolist() == [2, 3]

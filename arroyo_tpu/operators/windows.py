@@ -41,6 +41,8 @@ def _specs_from_config(config: dict) -> List[AggSpec]:
             name=a["name"],
             is_float=a.get("is_float", False),
             udaf=a.get("udaf"),
+            col2=a.get("col2"),
+            param=a.get("param"),
         )
         for a in config["aggregates"]
     ]
@@ -334,29 +336,39 @@ class WindowOperatorBase(Operator):
         ('raw', col) so strings survive and BIGINTs shared with a float
         spec don't collapse above 2^53."""
         cols: Dict = {}
+
+        def claim(c: int):
+            # cast by COLUMN type: float columns stay float64, everything
+            # else (ints, bools, timestamps) flattens to int64 bit-friendly
+            # values; derived sources (sq/prod) re-cast to float64 at use
+            if c in cols:
+                return
+            arr = batch.column(c)
+            if pa.types.is_floating(arr.type):
+                cols[c] = np.asarray(
+                    arr.to_numpy(zero_copy_only=False), dtype=np.float64
+                )
+            else:
+                cols[c] = np.asarray(
+                    arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+                )
+
         for spec in self.specs:
-            if spec.col is None or spec.host_state() is not None:
+            if spec.host_state() is not None:
                 continue
-            if not any(src == "col" for _, _, src in spec.phys()):
-                continue  # e.g. count(x): phys reads 'one', not the column
-            if spec.col not in cols:
-                arr = batch.column(spec.col)
-                if spec.is_float:
-                    cols[spec.col] = np.asarray(
-                        arr.to_numpy(zero_copy_only=False), dtype=np.float64
-                    )
-                else:
-                    cols[spec.col] = np.asarray(
-                        arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
-                    )
+            for _, _, src in spec.phys():
+                if src in ("col", "sq", "prod"):
+                    claim(spec.col)
+                if src in ("col2", "sq2", "prod"):
+                    claim(spec.col2)
         for spec in self.specs:
             if spec.col is None or spec.host_state() is None:
                 continue
-            key = ("raw", spec.col)
-            if key not in cols:
-                cols[key] = np.asarray(
-                    batch.column(spec.col).to_numpy(zero_copy_only=False)
-                )
+            for c in (spec.col, spec.col2):
+                if c is not None and ("raw", c) not in cols:
+                    cols[("raw", c)] = np.asarray(
+                        batch.column(c).to_numpy(zero_copy_only=False)
+                    )
         return cols
 
     def _build_output(
@@ -453,6 +465,12 @@ class WindowOperatorBase(Operator):
                 col = agg_cols[ai]
                 if pa.types.is_floating(f.type):
                     arrays.append(pa.array(col.astype(np.float64), type=f.type))
+                elif pa.types.is_boolean(f.type):
+                    arrays.append(pa.array(col.astype(bool)))
+                elif pa.types.is_list(f.type):
+                    arrays.append(pa.array(
+                        [[_to_py(x) for x in v] for v in col], type=f.type
+                    ))
                 else:
                     arrays.append(pa.array(col.astype(np.int64), type=f.type))
         return pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)

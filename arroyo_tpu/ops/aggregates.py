@@ -46,28 +46,45 @@ INT_MIN = np.iinfo(np.int64).min
 INT_MAX = np.iinfo(np.int64).max
 
 
+# one-argument variance family: decomposes to (Σx, Σx², n) — pure
+# add-reductions, so updates stay on-device AND invert under retraction
+VAR_KINDS = ("var", "var_samp", "var_pop", "stddev", "stddev_samp",
+             "stddev_pop")
+# two-argument regression family over (y, x): (Σy, Σx, Σxy, Σy², Σx², n)
+REGR_KINDS = ("covar_pop", "covar_samp", "corr", "regr_slope",
+              "regr_intercept", "regr_r2", "regr_avgx", "regr_avgy",
+              "regr_count", "regr_sxx", "regr_syy", "regr_sxy")
+# host-buffered builtins (raw values kept per slot; finalized at emission)
+BUFFER_KINDS = ("median", "approx_median", "approx_percentile_cont",
+                "approx_percentile_cont_with_weight", "bit_and", "bit_or",
+                "bit_xor", "array_agg")
+
+
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    kind: str  # count | sum | min | max | avg | count_distinct | udaf
+    kind: str  # count | sum | min | max | avg | count_distinct | udaf | ...
     col: Optional[int]  # input column index (None for count(*))
     name: str  # output field name
     is_float: bool = False  # input/output numeric class
     udaf: Optional[str] = None  # registered UDAF name when kind == "udaf"
+    col2: Optional[int] = None  # second argument (regr family, weights)
+    param: Optional[float] = None  # percentile fraction etc.
 
     def host_state(self) -> Optional[str]:
         """Host-resident per-slot state flavor, or None when the aggregate
         decomposes fully onto device phys arrays. 'buffer' = raw value
-        chunks (UDAFs; order-insensitive, append-only). 'multiset' = value
-        -> signed count (count_distinct; retractable, mergeable)."""
-        if self.kind == "udaf":
+        chunks (UDAFs, median/percentile/bit/array_agg; append-only).
+        'multiset' = value -> signed count (count_distinct and
+        approx_distinct; retractable, mergeable)."""
+        if self.kind == "udaf" or self.kind in BUFFER_KINDS:
             return "buffer"
-        if self.kind == "count_distinct":
+        if self.kind in ("count_distinct", "approx_distinct"):
             return "multiset"
         return None
 
     def phys(self) -> List[Tuple[str, str, str]]:
-        """[(op, dtype, source)]: op in add|min|max, dtype i8|f8,
-        source col|one."""
+        """[(op, dtype, source)]: op in add|min|max, dtype i8|f8, source
+        col|col2|one|sq (col²)|sq2 (col2²)|prod (col·col2)."""
         if self.host_state() is not None:
             # host-state aggregates keep raw values host-side (the
             # reference hands all values to its UDAFs too, udafs.rs;
@@ -75,6 +92,17 @@ class AggSpec:
             return []
         if self.kind == "count":
             return [("add", "i8", "one")]
+        if self.kind in VAR_KINDS:
+            return [("add", "f8", "col"), ("add", "f8", "sq"),
+                    ("add", "i8", "one")]
+        if self.kind in REGR_KINDS:
+            return [("add", "f8", "col"), ("add", "f8", "col2"),
+                    ("add", "f8", "prod"), ("add", "f8", "sq"),
+                    ("add", "f8", "sq2"), ("add", "i8", "one")]
+        if self.kind == "bool_and":
+            return [("min", "i8", "col")]
+        if self.kind == "bool_or":
+            return [("max", "i8", "col")]
         d = "f8" if self.is_float else "i8"
         if self.kind == "sum":
             return [("add", d, "col")]
@@ -85,6 +113,145 @@ class AggSpec:
         if self.kind == "avg":
             return [("add", "f8", "col"), ("add", "i8", "one")]
         raise ValueError(f"unknown aggregate {self.kind}")
+
+
+def _buffer_reducer(spec: "AggSpec"):
+    """Grouped-values reducer for one buffered aggregate: the registered
+    user function for UDAFs, a builtin for median/percentile/bit/array."""
+    kind = spec.kind
+    if kind == "udaf":
+        from ..udf.registry import get_udaf
+
+        u = get_udaf(spec.udaf)
+        if u is None:
+            raise ValueError(f"unknown UDAF {spec.udaf!r}")
+        if spec.col2 is not None:
+            return lambda g: u.fn(g[:, 0], g[:, 1])
+        return u.fn
+    if kind in ("median", "approx_median"):
+        def median_fn(g):
+            v = _not_null(g)
+            return float(np.median(v)) if len(v) else np.nan
+
+        return median_fn
+    if kind == "approx_percentile_cont":
+        p = float(spec.param) * 100.0
+
+        def pct_fn(g):
+            v = _not_null(g)
+            return float(np.percentile(v, p)) if len(v) else np.nan
+
+        return pct_fn
+    if kind == "approx_percentile_cont_with_weight":
+        p = float(spec.param)
+
+        def weighted(g):
+            if not len(g):
+                return np.nan
+            vals = g[:, 0].astype(np.float64)
+            w = g[:, 1].astype(np.float64)
+            order = np.argsort(vals, kind="stable")
+            vals, w = vals[order], w[order]
+            cum = np.cumsum(w)
+            total = cum[-1]
+            if total <= 0:
+                return np.nan
+            return float(vals[np.searchsorted(cum, p * total, "left")])
+
+        return weighted
+    if kind in ("bit_and", "bit_or", "bit_xor"):
+        op = {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or,
+              "bit_xor": np.bitwise_xor}[kind]
+
+        def bit_fn(g):
+            v = _not_null(g)
+            return int(op.reduce(v.astype(np.int64))) if len(v) else 0
+
+        return bit_fn
+    if kind == "array_agg":
+        return lambda g: list(g)
+    raise ValueError(f"unknown buffered aggregate {kind}")
+
+
+def _not_null(g: np.ndarray) -> np.ndarray:
+    return g[_not_null_mask(g)]
+
+
+def _finalize_variance(kind: str, vals: List[np.ndarray]) -> np.ndarray:
+    """(Σx, Σx², n) -> variance/stddev. Sample variants return NaN below
+    two rows (SQL NULL); population variants need one."""
+    s, ss, n = (v.astype(np.float64) for v in vals)
+    pop = kind.endswith("_pop")
+    denom = n if pop else n - 1
+    var = (ss - s * s / np.maximum(n, 1)) / denom
+    var = np.where(denom > 0, np.maximum(var, 0.0), np.nan)
+    if kind.startswith("stddev"):
+        return np.sqrt(var)
+    return var
+
+
+def _finalize_regression(kind: str, vals: List[np.ndarray]) -> np.ndarray:
+    """(Σy, Σx, Σxy, Σy², Σx², n) -> the SQL regression family over
+    (y, x) argument order (regr_slope(y, x) regresses y on x)."""
+    sy, sx, sxy, syy, sxx, n = (v.astype(np.float64) for v in vals)
+    nz = np.maximum(n, 1)
+    cxy = sxy - sx * sy / nz  # n·cov
+    cxx = sxx - sx * sx / nz
+    cyy = syy - sy * sy / nz
+    if kind == "covar_pop":
+        return np.where(n > 0, cxy / nz, np.nan)
+    if kind == "covar_samp":
+        return np.where(n > 1, cxy / (n - 1), np.nan)
+    if kind == "corr":
+        return np.where(
+            (n > 0) & (cxx > 0) & (cyy > 0),
+            cxy / np.sqrt(cxx * cyy), np.nan,
+        )
+    if kind == "regr_slope":
+        return np.where((n > 0) & (cxx != 0), cxy / cxx, np.nan)
+    if kind == "regr_intercept":
+        slope = np.where((n > 0) & (cxx != 0), cxy / cxx, np.nan)
+        return sy / nz - slope * sx / nz
+    if kind == "regr_r2":
+        r = np.where(
+            (n > 0) & (cxx > 0) & (cyy > 0),
+            cxy / np.sqrt(cxx * cyy), np.nan,
+        )
+        return r * r
+    if kind == "regr_avgx":
+        return np.where(n > 0, sx / nz, np.nan)
+    if kind == "regr_avgy":
+        return np.where(n > 0, sy / nz, np.nan)
+    if kind == "regr_count":
+        return n.astype(np.int64)
+    if kind == "regr_sxx":
+        return np.where(n > 0, cxx, np.nan)
+    if kind == "regr_syy":
+        return np.where(n > 0, cyy, np.nan)
+    if kind == "regr_sxy":
+        return np.where(n > 0, cxy, np.nan)
+    raise ValueError(f"unknown regression kind {kind}")
+
+
+def _src_values(spec: "AggSpec", src: str, cols: Dict) -> np.ndarray:
+    """Row values for one physical accumulator source. Derived sources
+    (sq/prod) compute in float64 so Σx² and Σxy never overflow int64."""
+    if src == "col":
+        return cols[spec.col]
+    if src == "col2":
+        return cols[spec.col2]
+    if src == "sq":
+        x = cols[spec.col].astype(np.float64, copy=False)
+        return x * x
+    if src == "sq2":
+        x = cols[spec.col2].astype(np.float64, copy=False)
+        return x * x
+    if src == "prod":
+        return (
+            cols[spec.col].astype(np.float64, copy=False)
+            * cols[spec.col2].astype(np.float64, copy=False)
+        )
+    raise ValueError(f"unknown phys source {src}")
 
 
 def _not_null_mask(vals: np.ndarray) -> np.ndarray:
@@ -236,10 +403,8 @@ class Accumulator:
                 vals = valid
             else:
                 vals = np.zeros(padded, dtype=_np_dtype(dt))
-                vals[:n] = (
-                    cols[spec.col] if signs is None
-                    else cols[spec.col] * signs
-                )
+                base = _src_values(spec, src, cols)
+                vals[:n] = base if signs is None else base * signs
                 if op != "add":
                     vals[n:] = _neutral(op, dt)
             inputs.append(jnp.asarray(vals))
@@ -269,6 +434,13 @@ class Accumulator:
         sg_sorted = signs[order] if signs is not None else None
         for si in self.udaf_idx:
             vals = self._host_vals(si, cols)[order]
+            spec = self.specs[si]
+            if spec.col2 is not None:
+                # two-argument buffers (weighted percentile, 2-arg UDAFs)
+                # stack to one (rows, 2) chunk so chunks concatenate
+                second = cols[("raw", spec.col2)] if (
+                    "raw", spec.col2) in cols else cols[spec.col2]
+                vals = np.column_stack([vals, second[order]])
             store = self.udaf_store[si]
             for lo, hi in zip(starts, ends):
                 store.setdefault(int(s_sorted[lo]), []).append(vals[lo:hi])
@@ -330,7 +502,9 @@ class Accumulator:
                     if signs is None else signs.astype(np.int64)
                 )
             else:
-                vals = cols[spec.col].astype(_np_dtype(dt), copy=False)
+                vals = _src_values(spec, src, cols).astype(
+                    _np_dtype(dt), copy=False
+                )
                 if signs is not None:
                     vals = vals * signs
             if op == "add":
@@ -422,20 +596,27 @@ class Accumulator:
         out = []
         pi = 0
         for si, spec in enumerate(self.specs):
-            if spec.kind == "udaf":
+            hs = spec.host_state()
+            if hs == "buffer":
                 out.append(self._finalize_udaf(si))
                 continue
-            if spec.kind == "count_distinct":
+            if hs == "multiset":
                 out.append(self._finalize_multiset(si))
                 continue
             n_phys = len(spec.phys())
             vals = gathered[pi: pi + n_phys]
             pi += n_phys
-            if spec.kind == "avg":
-                with np.errstate(invalid="ignore", divide="ignore"):
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if spec.kind == "avg":
                     out.append(vals[0] / np.maximum(vals[1], 1))
-            else:
-                out.append(vals[0])
+                elif spec.kind in VAR_KINDS:
+                    out.append(_finalize_variance(spec.kind, vals))
+                elif spec.kind in REGR_KINDS:
+                    out.append(_finalize_regression(spec.kind, vals))
+                elif spec.kind in ("bool_and", "bool_or"):
+                    out.append(vals[0] != 0)
+                else:
+                    out.append(vals[0])
         return out
 
     def _finalize_multiset(self, si: int) -> np.ndarray:
@@ -449,21 +630,27 @@ class Accumulator:
         )
 
     def _finalize_udaf(self, si: int) -> np.ndarray:
-        from ..udf.registry import get_udaf
-
+        """Evaluate a buffered aggregate (registered UDAF or builtin
+        median/percentile/bit/array_agg reducer) per emitted slot."""
         spec = self.specs[si]
-        u = get_udaf(spec.udaf)
-        if u is None:
-            raise ValueError(f"unknown UDAF {spec.udaf!r}")
         if self._segment_udaf is not None:
             groups = self._segment_udaf.get(si, [])
         else:
             store = self.udaf_store[si]
+            empty = (
+                np.empty((0, 2)) if spec.col2 is not None else np.empty(0)
+            )
             groups = [
-                np.concatenate(store.get(int(s), [np.empty(0)]))
+                np.concatenate(store.get(int(s), [empty]))
                 for s in self._gather_slots
             ]
-        return np.asarray([u.fn(g) for g in groups])
+        fn = _buffer_reducer(spec)
+        out = [fn(g) for g in groups]
+        if spec.kind == "array_agg":
+            arr = np.empty(len(out), dtype=object)
+            arr[:] = out
+            return arr
+        return np.asarray(out)
 
     def combine_for_segments(
         self, slots: np.ndarray, seg_ids: np.ndarray, n_segments: int
@@ -486,11 +673,15 @@ class Accumulator:
             seg_map: Dict[int, list] = {}
             for si in self.udaf_idx:
                 store = self.udaf_store[si]
+                empty = (
+                    np.empty((0, 2))
+                    if self.specs[si].col2 is not None else np.empty(0)
+                )
                 groups = [[] for _ in range(n_segments)]
                 for s, seg in zip(slots, seg_ids):
                     groups[int(seg)].extend(store.get(int(s), []))
                 seg_map[si] = [
-                    np.concatenate(g) if g else np.empty(0) for g in groups
+                    np.concatenate(g) if g else empty for g in groups
                 ]
             self._segment_udaf = seg_map
         if self.multiset_idx:

@@ -285,7 +285,9 @@ class ShardedAccumulator(Accumulator):
                 0 if op == "add" else _neutral(op, dt),
                 dtype=_np_dtype(dt),
             )
-            col = cols[self.specs[si].col]
+            from ..ops.aggregates import _src_values
+
+            col = _src_values(self.specs[si], src, cols)
             # sign application happens in-kernel: add-sources multiply by
             # valid (0 padding / ±1 append-retract)
             v[flat] = col[rows]

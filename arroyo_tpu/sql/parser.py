@@ -122,6 +122,7 @@ def _create(ts: TokenStream):
     name = _name(ts)
     columns: List[ColumnDef] = []
     pk: List[str] = []
+    watermark = None
     if ts.accept("punct", "("):
         while True:
             if ts.at_keyword("PRIMARY"):
@@ -133,6 +134,29 @@ def _create(ts: TokenStream):
                     if not ts.accept("punct", ","):
                         break
                 ts.expect("punct", ")")
+            elif ts.at_keyword("WATERMARK"):
+                # WATERMARK FOR ts [AS (ts - INTERVAL '...')] — DDL form of
+                # event_time_field + watermark_delay (bare form = delay 0)
+                ts.next()
+                ts.expect_keyword("FOR")
+                wm_col = _name(ts)
+                delay_nanos = 0
+                if ts.accept_keyword("AS"):
+                    paren = ts.accept("punct", "(")
+                    e = _expr(ts)
+                    if paren:
+                        ts.expect("punct", ")")
+                    if (
+                        isinstance(e, BinaryOp) and e.op == "-"
+                        and isinstance(e.right, Interval)
+                    ):
+                        delay_nanos = e.right.nanos
+                    else:
+                        raise ts.error(
+                            "WATERMARK expression must be "
+                            "<column> - INTERVAL '...'"
+                        )
+                watermark = (wm_col, delay_nanos)
             else:
                 columns.append(_column_def(ts))
             if not ts.accept("punct", ","):
@@ -158,6 +182,9 @@ def _create(ts: TokenStream):
         ts.expect("punct", ")")
     if pk:
         options["__pk__"] = ",".join(pk)
+    if watermark is not None:
+        options.setdefault("event_time_field", watermark[0])
+        options.setdefault("watermark_delay_nanos", str(watermark[1]))
     if ts.accept_keyword("AS"):
         # CREATE TABLE x AS SELECT -- an in-memory (virtual) table
         q = _select(ts)
@@ -583,6 +610,32 @@ def _primary(ts: TokenStream) -> Expr:
             while ts.accept("punct", ","):
                 args.append(_expr(ts))
         ts.expect("punct", ")")
+        # WITHIN GROUP (ORDER BY x): ordered-set aggregate syntax
+        # (approx_percentile_cont etc.) — normalized by prepending the
+        # ordering expression to the argument list
+        if ts.at_keyword("WITHIN"):
+            ts.next()
+            ts.expect_keyword("GROUP")
+            ts.expect("punct", "(")
+            ts.expect_keyword("ORDER")
+            ts.expect_keyword("BY")
+            order_e = _expr(ts)
+            desc = bool(ts.accept_keyword("DESC"))
+            ts.accept_keyword("ASC")
+            ts.expect("punct", ")")
+            # percentile over a DESC ordering is the (1-p) ascending
+            # quantile; rewrite the literal so the reducer stays ascending
+            if desc:
+                if args and isinstance(args[-1], Literal) and isinstance(
+                    args[-1].value, (int, float)
+                ):
+                    args = args[:-1] + [Literal(1.0 - float(args[-1].value))]
+                else:
+                    raise ts.error(
+                        "WITHIN GROUP (ORDER BY ... DESC) requires a "
+                        "literal percentile to invert"
+                    )
+            args = [order_e] + args
         over = None
         if ts.at_keyword("OVER"):
             ts.next()

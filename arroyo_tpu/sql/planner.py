@@ -52,7 +52,40 @@ from .lexer import SqlError
 from .parser import parse_statements
 from .types import WINDOW_TYPE, sql_type_to_arrow
 
-AGG_FUNCS = {"count", "sum", "min", "max", "avg", "mean"}
+AGG_FUNCS = {
+    "count", "sum", "min", "max", "avg", "mean",
+    # variance family (one argument)
+    "var", "var_samp", "var_pop", "variance", "stddev", "stddev_samp",
+    "stddev_pop",
+    # regression/covariance family: two arguments (y, x)
+    "covar", "covar_pop", "covar_samp", "corr", "regr_slope",
+    "regr_intercept", "regr_r2", "regr_avgx", "regr_avgy", "regr_count",
+    "regr_sxx", "regr_syy", "regr_sxy",
+    # boolean reductions
+    "bool_and", "bool_or",
+    # buffered builtins
+    "median", "approx_median", "approx_distinct", "approx_percentile_cont",
+    "approx_percentile_cont_with_weight", "bit_and", "bit_or", "bit_xor",
+    "array_agg",
+}
+# canonical kind per alias (the rest map to themselves)
+AGG_ALIASES = {"mean": "avg", "variance": "var", "covar": "covar_samp"}
+# the variance/regression families decompose to pure add-reductions
+# (Σx, Σx², Σxy, n), so they invert under retraction like count/sum/avg
+from ..ops.aggregates import (  # noqa: E402
+    REGR_KINDS as REGR_KINDS_SQL,
+    VAR_KINDS as VAR_KINDS_SQL,
+)
+# two-argument aggregates: (y, x) / (value, weight)
+TWO_ARG_AGGS = {
+    "covar", "covar_pop", "covar_samp", "corr", "regr_slope",
+    "regr_intercept", "regr_r2", "regr_avgx", "regr_avgy", "regr_count",
+    "regr_sxx", "regr_syy", "regr_sxy",
+    "approx_percentile_cont_with_weight",
+}
+# trailing literal parameters (not column inputs)
+PARAM_AGGS = {"approx_percentile_cont": 1,
+              "approx_percentile_cont_with_weight": 1}
 WINDOW_TVFS = {"tumble", "hop", "session"}
 DEFAULT_WATERMARK_DELAY = 1_000_000_000  # 1s, reference default
 
@@ -74,6 +107,13 @@ class TableDef:
         if not c:
             raise SqlError(f"table {self.name} has no connector option")
         return c
+
+    @property
+    def is_memory(self) -> bool:
+        """CREATE TABLE with no connector: an in-graph pass-through —
+        INSERT INTO it defines the stream, reading it consumes that
+        dataflow (reference memory/'virtual' tables, tables.rs)."""
+        return "connector" not in self.options
 
     @property
     def table_type(self) -> str:
@@ -162,6 +202,7 @@ class Planner:
         self.parallelism = parallelism
         self._source_cache: Dict[str, RelOutput] = {}
         self._sink_nodes: Dict[str, dict] = {}
+        self._memory_tables: Dict[str, RelOutput] = {}
         self._cte_stack: List[Dict[str, Select]] = []
         self._counter = 0
 
@@ -259,6 +300,21 @@ class Planner:
 
     def plan_source_table(self, t: TableDef, alias: Optional[str]) -> RelOutput:
         cache_key = t.name.lower()
+        if t.is_memory:
+            rel = self._memory_tables.get(cache_key)
+            if rel is None:
+                raise SqlError(
+                    f"memory table {t.name} is read before any INSERT INTO "
+                    "it (statements plan in script order)"
+                )
+            return RelOutput(
+                rel.node_id,
+                rel.schema,
+                Scope.from_schema(rel.schema.schema, alias or t.name),
+                rel.window,
+                rel.window_field,
+                rel.updating,
+            )
         if cache_key in self._source_cache:
             cached = self._source_cache[cache_key]
             return RelOutput(
@@ -284,6 +340,9 @@ class Planner:
 
             wd = parse_expr_text(f"interval '{t.options['watermark_delay']}'")
             watermark_delay = wd.nanos  # type: ignore[union-attr]
+        elif "watermark_delay_nanos" in t.options:
+            # set by the WATERMARK FOR column-DDL clause
+            watermark_delay = int(t.options["watermark_delay_nanos"])
 
         if t.fields:
             source_schema = StreamSchema(
@@ -563,15 +622,14 @@ class Planner:
         branch). Output schema: [keys..., agg outs..., wfield?]."""
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
-        agg_col_idx: List[Optional[int]] = []
-        for b in agg_inputs:
-            if b is None:
-                agg_col_idx.append(None)
-            else:
+        agg_col_idx: List[List[int]] = []
+        for bs in agg_inputs:
+            idxs = []
+            for b in bs:
                 pre_exprs.append(b)
-                idx = len(pre_exprs) - 1
+                idxs.append(len(pre_exprs) - 1)
                 pre_names.append(self._fresh("agg_in"))
-                agg_col_idx.append(idx)
+            agg_col_idx.append(idxs)
         pre = self._add_value_node(
             upstream, pre_exprs, pre_names, where, "agg_input"
         )
@@ -994,8 +1052,9 @@ class Planner:
             bad = [
                 c.name for c in agg_calls
                 if not c.distinct
-                and ("avg" if c.name == "mean" else c.name)
-                not in ("count", "sum", "avg")
+                and AGG_ALIASES.get(c.name, c.name)
+                not in ("count", "sum", "avg", *VAR_KINDS_SQL,
+                        *REGR_KINDS_SQL)
             ]
             if bad:
                 raise SqlError(
@@ -1006,14 +1065,14 @@ class Planner:
                 )
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
-        agg_col_idx: List[Optional[int]] = []
-        for b in agg_inputs:
-            if b is None:
-                agg_col_idx.append(None)
-            else:
+        agg_col_idx: List[List[int]] = []
+        for bs in agg_inputs:
+            idxs = []
+            for b in bs:
                 pre_exprs.append(b)
                 pre_names.append(self._fresh("agg_in"))
-                agg_col_idx.append(len(pre_exprs) - 1)
+                idxs.append(len(pre_exprs) - 1)
+            agg_col_idx.append(idxs)
         pre = self._add_value_node(
             upstream, pre_exprs, pre_names, where, "agg_input"
         )
@@ -1406,12 +1465,65 @@ class Planner:
 
     # -- sinks --------------------------------------------------------------
 
-    def plan_insert(self, ins: Insert) -> int:
+    def plan_insert(self, ins: Insert) -> Optional[int]:
         sink_table = self.provider.get_table(ins.table)
         if sink_table is None:
             raise SqlError(f"unknown sink table {ins.table}")
         out = self.plan_select(ins.query)
+        if sink_table.is_memory:
+            self._connect_memory(sink_table, out)
+            return None
         return self._connect_sink(sink_table, out)
+
+    def _connect_memory(self, t: TableDef, out: RelOutput):
+        """INSERT INTO a memory (connector-less) table: positional-cast the
+        select output to the declared columns and register the node as the
+        table's readable stream."""
+        if out.updating:
+            raise SqlError(
+                f"INSERT into memory table {t.name} from an updating "
+                "(retracting) stream is not supported"
+            )
+        if t.name.lower() in self._memory_tables:
+            raise SqlError(
+                f"memory table {t.name} already has an INSERT; a single "
+                "writer defines it"
+            )
+        declared = t.fields
+        if not declared:
+            raise SqlError(
+                f"memory table {t.name} must declare its columns"
+            )
+        data_cols = [
+            f for f in out.schema.schema if f.name != TIMESTAMP_FIELD
+        ]
+        if declared and len(declared) != len(data_cols):
+            raise SqlError(
+                f"memory table {t.name} declares {len(declared)} columns, "
+                f"query produces {len(data_cols)}"
+            )
+        exprs, names = [], []
+        for df, qf in zip(declared, data_cols):
+            idx = out.schema.schema.names.index(qf.name)
+            be = BoundExpr(
+                (lambda j: lambda b: b.column(j))(idx), qf.type, df.name
+            )
+            if not qf.type.equals(df.type):
+                from .expressions import _cast
+
+                be = BoundExpr(
+                    (lambda j, tt: lambda b: _cast(b.column(j), tt))(
+                        idx, df.type
+                    ),
+                    df.type,
+                    df.name,
+                )
+            exprs.append(be)
+            names.append(df.name)
+        rel = self._add_value_node(
+            out, exprs, names, None, f"memory_{t.name}"
+        )
+        self._memory_tables[t.name.lower()] = rel
 
     def _connect_sink(self, t: TableDef, out: RelOutput) -> int:
         from ..connectors import get_connector
@@ -1594,22 +1706,54 @@ def _find_aggregates(e: Expr) -> List[FuncCall]:
     return out
 
 
+def _agg_column_args(call: FuncCall) -> List[Expr]:
+    """The column-input arguments of an aggregate call (trailing literal
+    parameters like the percentile fraction excluded), arity-checked."""
+    n_params = PARAM_AGGS.get(call.name, 0)
+    col_args = call.args[: len(call.args) - n_params] if n_params else list(
+        call.args
+    )
+    if call.name in TWO_ARG_AGGS:
+        want = 2
+    elif call.name not in AGG_FUNCS:
+        from ..udf.registry import get_udaf
+
+        u = get_udaf(call.name)
+        want = min(len(u.arg_types), 2) if u is not None else 1
+    else:
+        want = 1
+    if len(col_args) != want:
+        raise SqlError(
+            f"{call.name}() takes {want} column argument(s)"
+            + (f" plus {n_params} literal parameter(s)" if n_params else "")
+        )
+    for p in call.args[len(col_args):]:
+        if not isinstance(p, Literal):
+            raise SqlError(
+                f"{call.name}(): the trailing parameter must be a literal"
+            )
+    return col_args
+
+
 def _collect_aggregates(items, scope):
-    """Unique aggregate calls across select items + their bound inputs
-    (one-argument arity enforced here for every aggregate path)."""
+    """Unique aggregate calls across select items + their bound column
+    inputs (a list per call: [] for count(*), one entry for most, two for
+    the regression family / weighted percentile)."""
     agg_calls: List[FuncCall] = []
     for it in items:
         for call in _find_aggregates(it.expr):
             if call not in agg_calls:
                 agg_calls.append(call)
-    agg_inputs: List[Optional[BoundExpr]] = []
+    agg_inputs: List[List[BoundExpr]] = []
     for call in agg_calls:
         if call.star or not call.args:
-            agg_inputs.append(None)
+            if call.name != "count":
+                raise SqlError(f"{call.name}() requires an argument")
+            agg_inputs.append([])
             continue
-        if len(call.args) != 1:
-            raise SqlError(f"{call.name}() takes one argument")
-        agg_inputs.append(bind(call.args[0], scope))
+        agg_inputs.append(
+            [bind(a, scope) for a in _agg_column_args(call)]
+        )
     return agg_calls, agg_inputs
 
 
@@ -1675,10 +1819,10 @@ def _rewrite_aggregates(
     return e
 
 
-def _make_spec(call: FuncCall, col_idx, pre_exprs, name: str) -> dict:
+def _make_spec(call: FuncCall, col_idx: list, pre_exprs, name: str) -> dict:
     from ..udf.registry import get_udaf
 
-    kind = "avg" if call.name == "mean" else call.name
+    kind = AGG_ALIASES.get(call.name, call.name)
     udaf = None
     if kind not in AGG_FUNCS and get_udaf(call.name) is not None:
         kind, udaf = "udaf", call.name
@@ -1688,25 +1832,49 @@ def _make_spec(call: FuncCall, col_idx, pre_exprs, name: str) -> dict:
                 f"DISTINCT is only supported with count(), not {kind}"
             )
         kind = "count_distinct"
+    col = col_idx[0] if col_idx else None
+    col2 = col_idx[1] if len(col_idx) > 1 else None
+    param = None
+    if call.name in PARAM_AGGS:
+        lit = call.args[-1]
+        param = float(lit.value)
+        if not 0.0 <= param <= 1.0:
+            raise SqlError(
+                f"{call.name}(): percentile must be between 0 and 1"
+            )
     is_float = (
-        col_idx is not None
-        and pa.types.is_floating(pre_exprs[col_idx].dtype)
+        col is not None
+        and pa.types.is_floating(pre_exprs[col].dtype)
     ) or kind == "avg"
-    return {"kind": kind, "col": col_idx, "name": name,
-            "is_float": is_float, "udaf": udaf}
+    return {"kind": kind, "col": col, "name": name,
+            "is_float": is_float, "udaf": udaf, "col2": col2,
+            "param": param}
 
 
 def _agg_output_type(spec: dict, call: FuncCall, pre_schema: pa.Schema):
+    from ..ops.aggregates import REGR_KINDS, VAR_KINDS
+
     kind = spec["kind"]
     if kind == "udaf":
         from ..udf.registry import get_udaf
 
         return get_udaf(spec["udaf"]).return_type
-    if kind in ("count", "count_distinct"):
+    if kind in ("count", "count_distinct", "approx_distinct",
+                "bit_and", "bit_or", "bit_xor", "regr_count"):
         return pa.int64()
-    if kind == "avg":
+    if (
+        kind == "avg"
+        or kind in VAR_KINDS
+        or kind in REGR_KINDS
+        or kind in ("median", "approx_median", "approx_percentile_cont",
+                    "approx_percentile_cont_with_weight")
+    ):
         return pa.float64()
+    if kind in ("bool_and", "bool_or"):
+        return pa.bool_()
     col_t = pre_schema.field(spec["col"]).type
+    if kind == "array_agg":
+        return pa.list_(col_t)
     if kind == "sum":
         if pa.types.is_floating(col_t):
             return pa.float64()
@@ -1967,7 +2135,9 @@ def plan_query(
         elif isinstance(st, Select):
             queries.append(st)
     for ins in inserts:
-        sinks.append(planner.plan_insert(ins))
+        sink_id = planner.plan_insert(ins)
+        if sink_id is not None:  # memory-table inserts have no sink node
+            sinks.append(sink_id)
     for q in queries:
         out = planner.plan_select(q)
         # bare SELECT: attach a preview sink

@@ -1,0 +1,28 @@
+CREATE TABLE cars (
+  timestamp TIMESTAMP,
+  driver_id BIGINT,
+  event_type TEXT,
+  location TEXT,
+  WATERMARK FOR timestamp AS (timestamp - INTERVAL '1 minute')
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/cars.json',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE group_by_aggregate (
+  month TIMESTAMP,
+  count BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO group_by_aggregate
+SELECT window.start as month, count
+FROM (
+  SELECT tumble(interval '30 day') as window, count(*) as count
+  FROM cars
+  GROUP BY 1
+);

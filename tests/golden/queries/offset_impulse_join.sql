@@ -1,0 +1,42 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL,
+  WATERMARK FOR timestamp
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE delayed_impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL,
+  WATERMARK FOR timestamp AS (timestamp - INTERVAL '10 minute')
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source'
+);
+CREATE TABLE offset_output (
+  start TIMESTAMP,
+  counter BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO offset_output
+SELECT a.window.start, a.counter as counter
+FROM (
+  SELECT tumble(interval '1 second') as window, counter, count(*)
+  FROM impulse_source GROUP BY 1, 2
+) a
+JOIN (
+  SELECT tumble(interval '1 second') as window, counter, count(*)
+  FROM delayed_impulse_source GROUP BY 1, 2
+) b
+ON a.counter = b.counter;

@@ -1,0 +1,31 @@
+--udf=udfs.py
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE udaf (
+  median DOUBLE,
+  none_value DOUBLE,
+  max_product BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO udaf
+SELECT median, none_value, max_product FROM (
+  SELECT tumble(interval '30 second') as window,
+         my_median(counter) as median,
+         none_udf(counter) as none_value,
+         max_product(counter, subtask_index) as max_product
+  FROM impulse_source
+  GROUP BY 1
+);

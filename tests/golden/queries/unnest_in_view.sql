@@ -1,0 +1,25 @@
+CREATE TABLE impulse_source (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE unnest_output (
+  counter BIGINT
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+CREATE VIEW unnest_view AS
+SELECT unnest(counters) as counter FROM (
+  SELECT array_agg(counter) as counters, tumble(interval '30 second') as w
+  FROM impulse_source GROUP BY w
+);
+INSERT INTO unnest_output SELECT counter FROM unnest_view;

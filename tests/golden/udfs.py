@@ -30,3 +30,8 @@ def my_median(values):
 @udaf(pa.float64(), [pa.uint64()], name="none_udf")
 def none_udf(values):
     return None
+
+
+@udaf(pa.uint64(), [pa.uint64(), pa.uint64()], name="max_product")
+def max_product(first_arg, second_arg):
+    return int(np.max(first_arg * second_arg))

@@ -23,6 +23,7 @@ from . import single_file  # noqa: F401,E402
 from . import nexmark  # noqa: F401,E402
 from . import filesystem  # noqa: F401,E402
 from . import delta  # noqa: F401,E402
+from . import iceberg  # noqa: F401,E402
 from . import sse  # noqa: F401,E402
 from . import websocket  # noqa: F401,E402
 from . import polling_http  # noqa: F401,E402

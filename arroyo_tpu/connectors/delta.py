@@ -213,7 +213,7 @@ class DeltaSink(FileSystemSink):
                 self._arrow_schema = pq.read_schema(orphans[0])
             self._append_log([self._add_action(f) for f in orphans])
 
-    async def _committed(self, files: List[str], ctx):
+    async def _committed(self, files: List[str], ctx, epoch=None):
         self._append_log(
             [self._add_action(f) for f in files if os.path.exists(f)]
         )

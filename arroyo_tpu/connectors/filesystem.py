@@ -4,19 +4,22 @@ Capability parity with the reference's filesystem connector
 (/root/reference/crates/arroyo-connectors/src/filesystem/, 12,086 LoC incl.
 Delta/Iceberg): this round implements the core — a source that reads
 json/parquet files under a path (positions checkpointed), and a sink that
-writes rolling files (rotated on row-count/size/checkpoint) through the
-two-phase pattern: data lands in `.tmp` files, files are finalized (renamed
-visible) on `handle_commit` after the checkpoint that contains them is
-durable. Delta Lake / Iceberg catalogs are future work tracked in
-SURVEY.md §2.9.
+writes rolling files (rotated on row-count/byte-size/age policies) through
+the two-phase pattern: data lands in `.tmp` files, files are finalized
+(renamed visible) on `handle_commit` after the checkpoint that contains
+them is durable. JSON files stream across epochs with checkpointed byte
+offsets (restores resume mid-file), and output can be partitioned by
+field values and/or an event-time strftime pattern. Delta Lake and
+Iceberg table formats build on this sink (delta.py, iceberg.py).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
 import uuid
-from typing import List
+from typing import List, Optional
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -118,20 +121,93 @@ class FileSystemSource(SourceOperator):
         return SourceFinishType.FINAL
 
 
-class FileSystemSink(Operator):
-    """Rolling file sink with two-phase commit: rows buffer into an open
-    .tmp file; at checkpoint the open file is rolled and its name stashed as
-    commit data; on commit the .tmp files are renamed visible (reference:
-    filesystem/sink two_phase_committer.rs:40)."""
+class _PartWriter:
+    """One in-progress output file for one partition. JSON files stream
+    row-by-row (byte offset checkpointed, so a restore truncates to the
+    offset and resumes mid-file — the reference v2 sink's checkpointed
+    multipart-upload state re-expressed for appendable media); parquet
+    buffers batches and serializes whole files."""
 
-    def __init__(self, path: str, format: str, rollover_rows: int = 100_000):
+    def __init__(self, tmp: str, fmt: str, resume_offset: int = 0):
+        self.tmp = tmp
+        self.fmt = fmt
+        self.rows: List[pa.RecordBatch] = []  # parquet buffering
+        self.n_rows = 0
+        self.n_bytes = 0
+        self.opened_at = time.monotonic()
+        self.f = None
+        if fmt != "parquet":
+            os.makedirs(os.path.dirname(tmp), exist_ok=True)
+            if resume_offset and os.path.exists(tmp):
+                with open(tmp, "r+b") as trunc:
+                    trunc.truncate(resume_offset)
+                self.f = open(tmp, "ab")
+            else:
+                self.f = open(tmp, "wb")
+            self.n_bytes = resume_offset
+
+    def write_json(self, records):
+        for rec in records:
+            self.f.write(rec + b"\n")
+            self.n_bytes += len(rec) + 1
+            self.n_rows += 1
+
+    def buffer(self, batch: pa.RecordBatch):
+        self.rows.append(batch)
+        self.n_rows += batch.num_rows
+        self.n_bytes += batch.nbytes
+
+    def flush(self):
+        if self.f is not None:
+            self.f.flush()
+            os.fsync(self.f.fileno())
+
+    def close(self, prepare_table):
+        if self.f is not None:
+            self.f.close()
+            self.f = None
+        elif self.rows:
+            os.makedirs(os.path.dirname(self.tmp), exist_ok=True)
+            pq.write_table(
+                prepare_table(pa.Table.from_batches(self.rows)), self.tmp
+            )
+            self.rows = []
+
+
+class FileSystemSink(Operator):
+    """Rolling file sink with two-phase commit: rows stream into open
+    `.tmp` files (one per active partition); files roll on row-count,
+    byte-size, or age policies; rolled files seal at the next barrier and
+    are renamed visible on `handle_commit` once that checkpoint is durable
+    (reference: filesystem/sink v2 mod.rs two-phase flow + rolling
+    policies). JSON files may span epochs — their byte offsets checkpoint
+    and restores resume mid-file; parquet rolls at every barrier so each
+    file serializes once."""
+
+    def __init__(self, path: str, format: str, rollover_rows: int = 100_000,
+                 rollover_bytes: int = 0, rollover_seconds: float = 0,
+                 partition_fields: Optional[List[str]] = None,
+                 time_partition_pattern: Optional[str] = None):
         super().__init__("filesystem_sink")
         self.path = path
         self.format = format or "json"
         self.rollover_rows = rollover_rows
-        self.serializer = Serializer(format="json") if self.format == "json" else None
-        self._rows: List[pa.RecordBatch] = []
-        self._n_rows = 0
+        self.rollover_bytes = rollover_bytes
+        # json files span epochs (offset-checkpointed), so without any
+        # explicit policy a default 30s age roll bounds how long output
+        # stays invisible (reference v2 rollover_seconds default)
+        if (
+            self.format != "parquet" and not rollover_bytes
+            and not rollover_seconds and rollover_rows >= 100_000
+        ):
+            rollover_seconds = 30.0
+        self.rollover_seconds = rollover_seconds
+        self.partition_fields = partition_fields or []
+        self.time_partition_pattern = time_partition_pattern
+        self.serializer = (
+            Serializer(format="json") if self.format == "json" else None
+        )
+        self._open: dict = {}  # partition -> _PartWriter
         self._pending_tmp: List[str] = []  # rolled since the last barrier
         self._committing: dict = {}  # epoch -> files sealed at that barrier
         self._file_seq = 0
@@ -140,6 +216,10 @@ class FileSystemSink(Operator):
         from ..state.table_config import global_table
 
         return {"fsk": global_table("fsk")}
+
+    def tick_interval(self):
+        return min(self.rollover_seconds, 1.0) if self.rollover_seconds \
+            else None
 
     async def on_start(self, ctx):
         os.makedirs(self.path, exist_ok=True)
@@ -153,37 +233,127 @@ class FileSystemSink(Operator):
                 for tmp in stored.get("pending", []):
                     if os.path.exists(tmp):
                         os.replace(tmp, tmp[: -len(".tmp")])
+                # resume in-progress json files at their checkpointed
+                # offsets (uncheckpointed tail bytes are truncated away)
+                for of in stored.get("open_files", []):
+                    if os.path.exists(of["tmp"]):
+                        w = _PartWriter(
+                            of["tmp"], self.format,
+                            resume_offset=of["offset"],
+                        )
+                        w.n_rows = of.get("rows", 0)
+                        self._open[of["partition"]] = w
+
+    # -- partitioning -----------------------------------------------------
+
+    def _partitions(self, batch: pa.RecordBatch) -> List[tuple]:
+        """[(partition string, row mask)] for one batch; [('', None)] when
+        unpartitioned (reference v2 partitioning.rs: field values +
+        strftime of the event time compose the directory)."""
+        if not self.partition_fields and not self.time_partition_pattern:
+            return [("", None)]
+        import numpy as np
+
+        n = batch.num_rows
+        parts = [[] for _ in range(n)]
+        if self.time_partition_pattern:
+            from datetime import datetime, timezone
+
+            from ..schema import TIMESTAMP_FIELD
+
+            ts = batch.column(
+                batch.schema.names.index(TIMESTAMP_FIELD)
+            ).cast(pa.int64()).to_pylist()
+            for i, t in enumerate(ts):
+                parts[i].append(datetime.fromtimestamp(
+                    (t or 0) / 1e9, tz=timezone.utc
+                ).strftime(self.time_partition_pattern))
+        for fname in self.partition_fields:
+            col = batch.column(batch.schema.names.index(fname)).to_pylist()
+            for i, v in enumerate(col):
+                parts[i].append(f"{fname}={v}")
+        keys = np.asarray(["/".join(p) for p in parts], dtype=object)
+        out = []
+        for k in sorted(set(keys.tolist())):
+            out.append((k, keys == k))
+        return out
+
+    def _writer(self, partition: str, ctx) -> _PartWriter:
+        w = self._open.get(partition)
+        if w is None:
+            ext = "parquet" if self.format == "parquet" else "json"
+            name = (
+                f"{ctx.task_info.task_index:03d}-{self._file_seq:05d}-"
+                f"{uuid.uuid4().hex[:8]}.{ext}"
+            )
+            self._file_seq += 1
+            d = os.path.join(self.path, partition) if partition else self.path
+            w = _PartWriter(os.path.join(d, name + ".tmp"), self.format)
+            self._open[partition] = w
+        return w
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
-        self._rows.append(batch)
-        self._n_rows += batch.num_rows
-        if self._n_rows >= self.rollover_rows:
-            self._roll(ctx)
+        for partition, mask in self._partitions(batch):
+            b = batch if mask is None else batch.filter(pa.array(mask))
+            if not b.num_rows:
+                continue
+            if self.format == "parquet":
+                w = self._writer(partition, ctx)
+                w.buffer(b)
+                if self._should_roll(w):
+                    self._roll_one(partition)
+            else:
+                # roll mid-batch so byte/row policies hold even when one
+                # arriving batch exceeds the target file size
+                for rec in self.serializer.serialize(b):
+                    w = self._writer(partition, ctx)
+                    w.write_json((rec,))
+                    if self._should_roll(w):
+                        self._roll_one(partition)
 
-    def _roll(self, ctx):
-        if not self._rows:
-            return
-        ext = "parquet" if self.format == "parquet" else "json"
-        name = (
-            f"{ctx.task_info.task_index:03d}-{self._file_seq:05d}-"
-            f"{uuid.uuid4().hex[:8]}.{ext}"
+    def _should_roll(self, w: _PartWriter) -> bool:
+        return (
+            w.n_rows >= self.rollover_rows
+            or (self.rollover_bytes and w.n_bytes >= self.rollover_bytes)
+            or (self.rollover_seconds
+                and time.monotonic() - w.opened_at >= self.rollover_seconds)
         )
-        self._file_seq += 1
-        tmp = os.path.join(self.path, name + ".tmp")
-        table = pa.Table.from_batches(self._rows)
-        if self.format == "parquet":
-            pq.write_table(table, tmp)
-        else:
-            with open(tmp, "wb") as f:
-                for b in self._rows:
-                    for rec in self.serializer.serialize(b):
-                        f.write(rec + b"\n")
-        self._rows = []
-        self._n_rows = 0
-        self._pending_tmp.append(tmp)
+
+    def _roll_one(self, partition: str):
+        w = self._open.pop(partition, None)
+        if w is None or (w.n_rows == 0 and not w.rows):
+            if w is not None:
+                w.close(self._prepare_table)
+                if os.path.exists(w.tmp):
+                    os.remove(w.tmp)
+            return
+        w.close(self._prepare_table)
+        self._pending_tmp.append(w.tmp)
+
+    def _roll(self, ctx, json_too: bool = True):
+        for partition in list(self._open):
+            w = self._open[partition]
+            if w.fmt == "parquet" or json_too:
+                self._roll_one(partition)
+
+    async def handle_tick(self, tick, ctx, collector):
+        for partition, w in list(self._open.items()):
+            if self.rollover_seconds and (
+                time.monotonic() - w.opened_at >= self.rollover_seconds
+            ):
+                self._roll_one(partition)
+
+    def _prepare_table(self, table: pa.Table) -> pa.Table:
+        """Hook: adjust the table before writing a file (IcebergSink drops
+        internal columns and stamps parquet field ids)."""
+        return table
 
     async def handle_checkpoint(self, barrier, ctx, collector):
-        self._roll(ctx)
+        # parquet files must serialize whole: roll them at the barrier.
+        # json writers survive the barrier — flush and checkpoint offsets
+        self._roll(ctx, json_too=False)
+        for w in self._open.values():
+            w.flush()
         # seal exactly the files rolled before this barrier; later rolls
         # belong to the next epoch and must not become visible on commit
         sealed, self._pending_tmp = self._pending_tmp, []
@@ -197,6 +367,12 @@ class FileSystemSink(Operator):
                     "file_seq": self._file_seq,
                     "pending": [
                         f for files in self._committing.values() for f in files
+                    ],
+                    "open_files": [
+                        {"tmp": w.tmp, "offset": w.n_bytes,
+                         "rows": w.n_rows, "partition": p}
+                        for p, w in self._open.items()
+                        if w.fmt != "parquet"
                     ],
                 },
             )
@@ -213,7 +389,7 @@ class FileSystemSink(Operator):
             else:
                 sealed = []
         finalized = self._finalize(sealed)
-        await self._committed(finalized, ctx)
+        await self._committed(finalized, ctx, epoch=epoch)
         return finalized
 
     @staticmethod
@@ -226,19 +402,23 @@ class FileSystemSink(Operator):
                 out.append(tmp[: -len(".tmp")])
         return out
 
-    async def _committed(self, files: List[str], ctx):
+    async def _committed(self, files: List[str], ctx, epoch=None):
         """Hook: files became visible under a durable commit (DeltaSink
-        appends them to the transaction log)."""
+        appends them to the transaction log; IcebergSink commits a
+        snapshot). `epoch` is None on the EOD/recovery paths."""
 
     async def on_close(self, ctx, collector, is_eod: bool):
         # EOD without a final checkpoint: finalize remaining data directly
         if is_eod:
-            self._roll(ctx)
+            self._roll(ctx, json_too=True)
             finalized = self._finalize(self._pending_tmp)
             self._pending_tmp = []
             await self._committed(finalized, ctx)
             for epoch in list(self._committing):
                 await self.handle_commit(epoch, {}, ctx)
+        else:
+            for w in self._open.values():
+                w.flush()
         return None
 
 
@@ -251,6 +431,10 @@ class FileSystemConnector(Connector):
     config_schema = {
         "path": {"type": "string", "required": True},
         "rollover_rows": {"type": "integer"},
+        "rollover_bytes": {"type": "integer"},
+        "rollover_seconds": {"type": "number"},
+        "partition_fields": {"type": "string"},  # comma-separated
+        "time_partition_pattern": {"type": "string"},  # strftime
     }
 
     def validate_options(self, options, schema):
@@ -259,6 +443,17 @@ class FileSystemConnector(Connector):
         out = {"path": options["path"]}
         if "rollover_rows" in options:
             out["rollover_rows"] = int(options["rollover_rows"])
+        if "rollover_bytes" in options:
+            out["rollover_bytes"] = int(options["rollover_bytes"])
+        if "rollover_seconds" in options:
+            out["rollover_seconds"] = float(options["rollover_seconds"])
+        if "partition_fields" in options:
+            out["partition_fields"] = [
+                f.strip() for f in options["partition_fields"].split(",")
+                if f.strip()
+            ]
+        if "time_partition_pattern" in options:
+            out["time_partition_pattern"] = options["time_partition_pattern"]
         return out
 
     def make_source(self, config, schema: ConnectionSchema):
@@ -271,4 +466,8 @@ class FileSystemConnector(Connector):
         return FileSystemSink(
             config["path"], config.get("format"),
             config.get("rollover_rows", 100_000),
+            config.get("rollover_bytes", 0),
+            config.get("rollover_seconds", 0),
+            config.get("partition_fields"),
+            config.get("time_partition_pattern"),
         )

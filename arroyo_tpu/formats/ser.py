@@ -19,10 +19,16 @@ from ..schema import TIMESTAMP_FIELD, UPDATING_META_FIELD
 class Serializer:
     def __init__(self, format: str = "json", include_timestamp: bool = False,
                  avro_schema: Optional[str] = None,
-                 proto_descriptor: Optional[dict] = None):
+                 proto_descriptor: Optional[dict] = None,
+                 schema_registry=None):
         self.format = format or "json"
         self.include_timestamp = include_timestamp
         self.avro_schema = avro_schema
+        # with a registry the sink registers its schema once and frames
+        # every record with magic 0 + the 4-byte schema id (Confluent
+        # wire format; reference ser.rs + schema_resolver.rs write_schema)
+        self.schema_registry = schema_registry
+        self._registered_id: Optional[int] = None
         self.proto = None
         if self.format in ("protobuf", "proto"):
             from .proto import ProtoEncoder
@@ -37,11 +43,20 @@ class Serializer:
             for v in col.to_pylist():
                 yield (v if isinstance(v, str) else str(v)).encode()
         elif self.format == "avro":
+            import struct
+
             from .avro import AvroEncoder
 
             enc = AvroEncoder(self.avro_schema, batch.schema)
+            framing = b""
+            if self.schema_registry is not None:
+                if self._registered_id is None:
+                    self._registered_id = self.schema_registry.write_schema(
+                        enc.schema
+                    )
+                framing = b"\x00" + struct.pack(">I", self._registered_id)
             for row in self._rows(batch):
-                yield enc.encode(row)
+                yield framing + enc.encode(row)
         elif self.format in ("protobuf", "proto"):
             for row in self._rows(batch):
                 yield self.proto.encode(row)

@@ -269,7 +269,18 @@ class SubtaskRunner:
                                     arm_input(j)
         for t in pending:
             t.cancel()
-        is_eod = all(k == SignalKind.END_OF_DATA for k in self._finish_kinds.values())
+        # end-of-data only when every input actually delivered EOS — an
+        # IMMEDIATE stop (crash-like teardown) leaves _finish_kinds empty
+        # and must NOT finalize uncommitted sink output (exactly-once:
+        # visibility belongs to the 2PC commit, not teardown)
+        is_eod = (
+            not self._stopping
+            and len(self._finish_kinds) == len(self.inputs)
+            and all(
+                k == SignalKind.END_OF_DATA
+                for k in self._finish_kinds.values()
+            )
+        )
         await self._close_chain(is_eod=is_eod)
         await self.tail.broadcast(
             SignalMessage.end_of_data() if is_eod else SignalMessage.stop()

@@ -184,23 +184,27 @@ class FileSystemSink(Operator):
     and restores resume mid-file; parquet rolls at every barrier so each
     file serializes once."""
 
-    def __init__(self, path: str, format: str, rollover_rows: int = 100_000,
+    def __init__(self, path: str, format: str,
+                 rollover_rows: Optional[int] = None,
                  rollover_bytes: int = 0, rollover_seconds: float = 0,
                  partition_fields: Optional[List[str]] = None,
                  time_partition_pattern: Optional[str] = None):
         super().__init__("filesystem_sink")
         self.path = path
         self.format = format or "json"
-        self.rollover_rows = rollover_rows
-        self.rollover_bytes = rollover_bytes
-        # json files span epochs (offset-checkpointed), so without any
-        # explicit policy a default 30s age roll bounds how long output
-        # stays invisible (reference v2 rollover_seconds default)
+        # json files span epochs (offset-checkpointed), so when NO policy
+        # is configured at all a default 30s age roll bounds how long
+        # output stays invisible (reference v2 rollover_seconds default);
+        # an explicitly configured policy is never overridden
         if (
-            self.format != "parquet" and not rollover_bytes
-            and not rollover_seconds and rollover_rows >= 100_000
+            self.format != "parquet" and rollover_rows is None
+            and not rollover_bytes and not rollover_seconds
         ):
             rollover_seconds = 30.0
+        self.rollover_rows = (
+            rollover_rows if rollover_rows is not None else 100_000
+        )
+        self.rollover_bytes = rollover_bytes
         self.rollover_seconds = rollover_seconds
         self.partition_fields = partition_fields or []
         self.time_partition_pattern = time_partition_pattern
@@ -465,7 +469,7 @@ class FileSystemConnector(Connector):
     def make_sink(self, config, schema: ConnectionSchema):
         return FileSystemSink(
             config["path"], config.get("format"),
-            config.get("rollover_rows", 100_000),
+            config.get("rollover_rows"),
             config.get("rollover_bytes", 0),
             config.get("rollover_seconds", 0),
             config.get("partition_fields"),

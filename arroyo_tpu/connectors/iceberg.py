@@ -227,11 +227,26 @@ class LocalCatalog:
     # -- io -------------------------------------------------------------
 
     def _version(self) -> int:
+        """Current metadata version: the hint file, self-healed by a scan
+        of existing vN files (a crash between writing vN and updating the
+        hint must not wedge the table on permanent CAS conflicts)."""
+        hint = 0
         try:
             with open(os.path.join(self.meta_dir, "version-hint.text")) as f:
-                return int(f.read().strip())
+                hint = int(f.read().strip())
         except (OSError, ValueError):
-            return 0
+            pass
+        scan = 0
+        try:
+            for n in os.listdir(self.meta_dir):
+                if n.startswith("v") and n.endswith(".metadata.json"):
+                    try:
+                        scan = max(scan, int(n[1: -len(".metadata.json")]))
+                    except ValueError:
+                        pass
+        except OSError:
+            pass
+        return max(hint, scan)
 
     def load(self) -> Optional[dict]:
         v = self._version()
@@ -488,6 +503,9 @@ class IcebergSink(FileSystemSink):
         ti = self._task_info
         h.update((ti.job_id if ti else "job").encode() + b"\x00")
         h.update(str(ti.node_id if ti else 0).encode() + b"\x00")
+        # subtasks commit independently: without the task index, parallel
+        # subtasks of one epoch would collide and the second would skip
+        h.update(str(ti.task_index if ti else 0).encode() + b"\x00")
         if epoch is not None:
             h.update(str(epoch).encode())
         else:  # EOD/recovery commits: identity from the file set

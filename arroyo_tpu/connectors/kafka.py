@@ -222,8 +222,19 @@ class KafkaSink(Operator):
         if self.semantics != "exactly_once":
             return
         p = self._pending_tx.pop(epoch, None)
-        if p is not None:
-            p.commit_transaction(30)
+        if p is None:
+            # same acknowledged limitation as the reference
+            # (sink/mod.rs:361): a commit replayed after a crash has no
+            # open producer to complete — restoring from the commit phase
+            # is not implemented
+            from ..utils.logging import get_logger
+
+            get_logger("kafka").warning(
+                "commit for epoch %s without a producer to complete; "
+                "restoring from the commit phase is not implemented", epoch,
+            )
+            return
+        p.commit_transaction(30)
 
 
 SASL_OPTIONS = (

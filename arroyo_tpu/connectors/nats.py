@@ -42,6 +42,8 @@ class NatsSource(SourceOperator):
             table.put(ctx.task_info.task_index, self.sequence)
 
     async def run(self, ctx, collector) -> SourceFinishType:
+        import asyncio
+
         nats = require_client("nats")
         deser = Deserializer(self.out_schema, format=self.format or "json",
                              bad_data=self.bad_data)
@@ -55,10 +57,20 @@ class NatsSource(SourceOperator):
                 sub = await js.subscribe(self.subject, **opts)
             else:
                 sub = await nc.subscribe(self.subject)
-            async for msg in sub.messages:
+            # poll with a timeout rather than `async for`: an idle subject
+            # must not starve control handling (checkpoint barriers, stops)
+            it = sub.messages.__aiter__()
+            while True:
                 finish = await ctx.check_control(collector)
                 if finish is not None:
                     return finish
+                try:
+                    msg = await asyncio.wait_for(it.__anext__(), 0.05)
+                except asyncio.TimeoutError:
+                    await self.flush_buffer(ctx, collector)
+                    continue
+                except StopAsyncIteration:
+                    break
                 for row in deser.deserialize_slice(
                     msg.data, error_reporter=ctx.error_reporter
                 ):
@@ -67,6 +79,7 @@ class NatsSource(SourceOperator):
                     self.sequence = msg.metadata.sequence.stream
                 if ctx.should_flush():
                     await self.flush_buffer(ctx, collector)
+            await self.flush_buffer(ctx, collector)
         finally:
             await nc.close()
         return SourceFinishType.FINAL

@@ -431,9 +431,15 @@ def _avro_type_from_arrow(at: pa.DataType):
         return {"type": "array", "items": _avro_type_from_arrow(
             at.value_type)}
     if pa.types.is_struct(at):
+        import hashlib
+
+        # deterministic record name: python hash() is salted per process,
+        # which would rename the record on every restart and trip registry
+        # compatibility checks
+        digest = hashlib.sha256(str(at).encode()).hexdigest()[:8]
         return {
             "type": "record",
-            "name": f"r{abs(hash(str(at))) % 10_000}",
+            "name": f"r_{digest}",
             "fields": [
                 {"name": f.name, "type": _avro_type_from_arrow(f.type)}
                 for f in at

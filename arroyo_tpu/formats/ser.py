@@ -47,7 +47,11 @@ class Serializer:
 
             from .avro import AvroEncoder
 
-            enc = AvroEncoder(self.avro_schema, batch.schema)
+            enc = getattr(self, "_avro_encoder", None)
+            if enc is None:
+                enc = self._avro_encoder = AvroEncoder(
+                    self.avro_schema, batch.schema
+                )
             framing = b""
             if self.schema_registry is not None:
                 if self._registered_id is None:

@@ -1,0 +1,415 @@
+"""In-memory fake broker clients for the gated connectors.
+
+The reference tests its kafka sink logic broker-less
+(/root/reference/crates/arroyo-connectors/src/kafka/sink/test.rs with a
+MockKafkaClient); these fakes go one step further and emulate enough of
+each client library's surface to drive the REAL connector operators
+end-to-end through the engine — produce/consume, partition assignment,
+transactions with read-committed isolation and transactional-id fencing
+(kafka), shard iterators (kinesis), and subject streams with durable
+consumers (NATS JetStream).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# Kafka (confluent_kafka surface)
+# ---------------------------------------------------------------------------
+
+
+class FakeKafkaBroker:
+    """Topic/partition logs with transactional visibility: messages from a
+    transactional producer stay invisible until commit_transaction; a new
+    producer initializing the same transactional.id fences (aborts) the
+    old one's open transaction."""
+
+    def __init__(self, partitions_per_topic: int = 2):
+        self.partitions_per_topic = partitions_per_topic
+        # topic -> partition -> [FakeMessage]
+        self.logs: Dict[str, Dict[int, List["FakeMessage"]]] = {}
+        # transactional.id -> list of uncommitted FakeMessage
+        self.open_tx: Dict[str, List["FakeMessage"]] = {}
+        self.aborted_tx: List[str] = []
+        self.lock = threading.Lock()
+
+    def topic(self, name: str) -> Dict[int, List["FakeMessage"]]:
+        with self.lock:
+            return self.logs.setdefault(
+                name, {p: [] for p in range(self.partitions_per_topic)}
+            )
+
+    def append(self, topic: str, partition: int, key, value,
+               committed: bool, tx_id: Optional[str]) -> "FakeMessage":
+        log = self.topic(topic)[partition]
+        m = FakeMessage(topic, partition, len(log), key, value,
+                        committed=committed)
+        log.append(m)
+        if not committed and tx_id is not None:
+            self.open_tx.setdefault(tx_id, []).append(m)
+        return m
+
+    def commit_tx(self, tx_id: str):
+        for m in self.open_tx.pop(tx_id, []):
+            m.committed = True
+
+    def fence(self, tx_id: str):
+        """init_transactions semantics: abort any open transaction for
+        this transactional.id (its messages stay invisible forever)."""
+        if self.open_tx.pop(tx_id, None) is not None:
+            self.aborted_tx.append(tx_id)
+
+    def visible(self, topic: str, partition: int) -> List["FakeMessage"]:
+        return self.topic(topic)[partition]
+
+    def make_module(self):
+        """An object quacking like the confluent_kafka module, bound to
+        this broker (patch connectors.kafka._load_client to return it)."""
+        broker = self
+
+        class _Module:
+            @staticmethod
+            def Consumer(conf):
+                return FakeConsumer(broker, conf)
+
+            @staticmethod
+            def Producer(conf):
+                return FakeProducer(broker, conf)
+
+            TopicPartition = FakeTopicPartition
+
+        return _Module
+
+
+class FakeMessage:
+    def __init__(self, topic, partition, offset, key, value,
+                 committed=True):
+        self._topic = topic
+        self._partition = partition
+        self._offset = offset
+        self._key = key
+        self._value = value
+        self.committed = committed
+        self._ts_ms = int(time.time() * 1000)
+
+    def error(self):
+        return None
+
+    def topic(self):
+        return self._topic
+
+    def partition(self):
+        return self._partition
+
+    def offset(self):
+        return self._offset
+
+    def key(self):
+        return self._key
+
+    def value(self):
+        return self._value
+
+    def timestamp(self):
+        return (1, self._ts_ms)  # (CREATE_TIME, ms)
+
+
+class FakeTopicPartition:
+    def __init__(self, topic, partition, offset=-1001):
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+
+class _TopicMeta:
+    def __init__(self, partitions: Dict[int, object]):
+        self.partitions = partitions
+
+
+class _ClusterMeta:
+    def __init__(self, topics):
+        self.topics = topics
+
+
+class FakeConsumer:
+    """read_committed consumer over assigned partitions."""
+
+    def __init__(self, broker: FakeKafkaBroker, conf: dict):
+        self.broker = broker
+        self.conf = conf
+        self.auto_reset = conf.get("auto.offset.reset", "earliest")
+        self.positions: Dict[tuple, int] = {}
+        self._assigned: List[FakeTopicPartition] = []
+        self.closed = False
+
+    def list_topics(self, topic=None, timeout=None):
+        parts = {p: object() for p in self.broker.topic(topic)}
+        return _ClusterMeta({topic: _TopicMeta(parts)})
+
+    def assign(self, tps: List[FakeTopicPartition]):
+        self._assigned = tps
+        for tp in tps:
+            key = (tp.topic, tp.partition)
+            if tp.offset >= 0:
+                self.positions[key] = tp.offset
+            elif self.auto_reset == "latest":
+                self.positions[key] = len(
+                    self.broker.visible(tp.topic, tp.partition)
+                )
+            else:
+                self.positions[key] = 0
+
+    def poll(self, timeout=0):
+        for tp in self._assigned:
+            key = (tp.topic, tp.partition)
+            log = self.broker.visible(tp.topic, tp.partition)
+            pos = self.positions[key]
+            # read_committed: stop at the first uncommitted message (LSO)
+            while pos < len(log) and log[pos].committed:
+                m = log[pos]
+                self.positions[key] = pos + 1
+                return m
+        return None
+
+    def close(self):
+        self.closed = True
+
+
+class FakeProducer:
+    def __init__(self, broker: FakeKafkaBroker, conf: dict):
+        self.broker = broker
+        self.conf = conf
+        self.tx_id = conf.get("transactional.id")
+        self.in_tx = False
+        self._n = 0
+
+    def init_transactions(self, timeout=None):
+        assert self.tx_id, "init_transactions without transactional.id"
+        self.broker.fence(self.tx_id)
+
+    def begin_transaction(self):
+        self.in_tx = True
+
+    def produce(self, topic, value=None, key=None):
+        partition = (
+            hash(key) % self.broker.partitions_per_topic
+            if key is not None else self._n % self.broker.partitions_per_topic
+        )
+        self._n += 1
+        self.broker.append(
+            topic, partition, key, value,
+            committed=not self.in_tx, tx_id=self.tx_id,
+        )
+
+    def poll(self, timeout=0):
+        return 0
+
+    def flush(self, timeout=None):
+        return 0
+
+    def commit_transaction(self, timeout=None):
+        assert self.in_tx, "commit without begin"
+        self.broker.commit_tx(self.tx_id)
+        self.in_tx = False
+
+    def abort_transaction(self, timeout=None):
+        self.broker.fence(self.tx_id)
+        self.in_tx = False
+
+
+# ---------------------------------------------------------------------------
+# Kinesis (boto3 module + kinesis client surface the source/sink use)
+# ---------------------------------------------------------------------------
+
+
+class FakeKinesisStream:
+    """Shard logs; install via sys.modules['boto3'] = stream.boto3()."""
+
+    def __init__(self, shards: int = 2):
+        self.shards = {
+            f"shardId-{i:012d}": [] for i in range(shards)
+        }
+        self.closed_shards: set = set()
+
+    def put(self, shard_id: str, data: bytes):
+        self.shards[shard_id].append(data)
+
+    def boto3(self):
+        stream = self
+
+        class _Boto3:
+            @staticmethod
+            def client(service, region_name=None):
+                assert service == "kinesis"
+                return _FakeKinesisClient(stream)
+
+        return _Boto3
+
+    def split_shard(self, shard_id: str, new_ids: List[str]):
+        """Resharding: the parent closes (get_records returns a null next
+        iterator at its end) and children appear in list_shards."""
+        self.closed_shards.add(shard_id)
+        for n in new_ids:
+            self.shards.setdefault(n, [])
+
+
+class _FakeKinesisClient:
+    def __init__(self, stream: FakeKinesisStream):
+        self.stream = stream
+
+    def list_shards(self, StreamName=None):
+        return {
+            "Shards": [{"ShardId": s} for s in sorted(self.stream.shards)]
+        }
+
+    def get_shard_iterator(self, StreamName=None, ShardId=None,
+                           ShardIteratorType="TRIM_HORIZON",
+                           StartingSequenceNumber=None):
+        if ShardIteratorType == "AFTER_SEQUENCE_NUMBER":
+            seq = int(StartingSequenceNumber) + 1
+        elif ShardIteratorType == "LATEST":
+            seq = len(self.stream.shards[ShardId])
+        else:  # TRIM_HORIZON
+            seq = 0
+        return {"ShardIterator": f"{ShardId}:{seq}"}
+
+    def get_records(self, ShardIterator=None, Limit=1000):
+        import datetime
+
+        shard, seq = ShardIterator.rsplit(":", 1)
+        seq = int(seq)
+        log = self.stream.shards[shard]
+        recs = [
+            {
+                "Data": d,
+                "SequenceNumber": str(i),
+                "ApproximateArrivalTimestamp": datetime.datetime.now(
+                    datetime.timezone.utc
+                ),
+            }
+            for i, d in enumerate(log[seq: seq + Limit], start=seq)
+        ]
+        nxt = seq + len(recs)
+        closed = (
+            shard in self.stream.closed_shards and nxt >= len(log)
+        )
+        return {
+            "Records": recs,
+            "NextShardIterator": None if closed else f"{shard}:{nxt}",
+            "MillisBehindLatest": 0,
+        }
+
+    def put_records(self, StreamName=None, Records=None):
+        for i, r in enumerate(Records):
+            sid = sorted(self.stream.shards)[
+                hash(r.get("PartitionKey", i)) % len(self.stream.shards)
+            ]
+            self.stream.shards[sid].append(r["Data"])
+        return {"FailedRecordCount": 0}
+
+
+# ---------------------------------------------------------------------------
+# NATS / JetStream (nats-py surface subset the source/sink use)
+# ---------------------------------------------------------------------------
+
+
+class FakeNatsServer:
+    """Subject log; install via sys.modules['nats'] = server.module().
+    JetStream subscriptions replay from opt_start_seq and tag messages
+    with stream sequence metadata; core subscriptions only see messages
+    published after subscribe."""
+
+    def __init__(self):
+        self.log: List[bytes] = []
+        self.stop_at: Optional[int] = None  # sub iterator end (for tests)
+
+    def publish(self, payload: bytes):
+        self.log.append(payload)
+
+    def module(self):
+        server = self
+
+        class _NatsModule:
+            @staticmethod
+            async def connect(servers):
+                return _FakeNatsConn(server)
+
+        return _NatsModule
+
+
+class _Seq:
+    def __init__(self, stream):
+        self.stream = stream
+
+
+class _Meta:
+    def __init__(self, seq):
+        self.sequence = _Seq(seq)
+
+
+class _FakeNatsMsg:
+    def __init__(self, data: bytes, seq: int):
+        self.data = data
+        self.metadata = _Meta(seq)
+
+
+class _FakeSub:
+    def __init__(self, server: FakeNatsServer, start: int):
+        self.server = server
+        self.pos = start
+
+    @property
+    def messages(self):
+        sub = self
+
+        class _Iter:
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                import asyncio
+
+                while True:
+                    if (
+                        sub.server.stop_at is not None
+                        and sub.pos >= sub.server.stop_at
+                    ):
+                        raise StopAsyncIteration
+                    if sub.pos < len(sub.server.log):
+                        m = _FakeNatsMsg(
+                            sub.server.log[sub.pos], sub.pos + 1
+                        )  # stream seqs are 1-based
+                        sub.pos += 1
+                        return m
+                    await asyncio.sleep(0.005)
+
+        return _Iter()
+
+
+class _FakeJetStream:
+    def __init__(self, server: FakeNatsServer):
+        self.server = server
+
+    async def subscribe(self, subject, opt_start_seq: int = 1, **kw):
+        return _FakeSub(self.server, max(0, opt_start_seq - 1))
+
+
+class _FakeNatsConn:
+    def __init__(self, server: FakeNatsServer):
+        self.server = server
+
+    def jetstream(self):
+        return _FakeJetStream(self.server)
+
+    async def subscribe(self, subject):
+        return _FakeSub(self.server, len(self.server.log))
+
+    async def publish(self, subject, payload: bytes):
+        self.server.publish(payload)
+
+    async def close(self):
+        pass

@@ -58,17 +58,27 @@ class NatsSource(SourceOperator):
             else:
                 sub = await nc.subscribe(self.subject)
             # poll with a timeout rather than `async for`: an idle subject
-            # must not starve control handling (checkpoint barriers, stops)
+            # must not starve control handling (checkpoint barriers, stops).
+            # The in-flight __anext__ task persists across idle ticks —
+            # cancelling it (as wait_for would) leaks nats-py's internal
+            # queue-getter task, which then steals and drops messages
             it = sub.messages.__aiter__()
+            pending = None
             while True:
                 finish = await ctx.check_control(collector)
                 if finish is not None:
+                    if pending is not None:
+                        pending.cancel()
                     return finish
-                try:
-                    msg = await asyncio.wait_for(it.__anext__(), 0.05)
-                except asyncio.TimeoutError:
+                if pending is None:
+                    pending = asyncio.ensure_future(it.__anext__())
+                done, _ = await asyncio.wait({pending}, timeout=0.05)
+                if not done:
                     await self.flush_buffer(ctx, collector)
                     continue
+                task, pending = pending, None
+                try:
+                    msg = task.result()
                 except StopAsyncIteration:
                     break
                 for row in deser.deserialize_slice(

@@ -13,8 +13,11 @@ Aggregation arithmetic runs on the shared device accumulator
 valid over append-only input (monotone). With `retractable` set (the input
 is itself an updating stream), retract rows apply with sign -1 and a
 per-key live-row count deletes keys whose rows have all been retracted
-(emitting a final retraction); the planner restricts this mode to the
-invertible aggregates (count/sum/avg).
+(emitting a final retraction). Invertible aggregates (count/sum/avg,
+variance/regression, multisets) consume retractions directly; the planner
+marks everything else (min/max/median/UDAF/...) with `replay`, which
+re-aggregates from a value -> signed-count multiset at emission
+(reference incremental_aggregator.rs raw-value replay).
 """
 
 from __future__ import annotations

@@ -43,6 +43,8 @@ def _specs_from_config(config: dict) -> List[AggSpec]:
             udaf=a.get("udaf"),
             col2=a.get("col2"),
             param=a.get("param"),
+            distinct=a.get("distinct", False),
+            replay=a.get("replay", False),
         )
         for a in config["aggregates"]
     ]

@@ -69,17 +69,28 @@ class AggSpec:
     udaf: Optional[str] = None  # registered UDAF name when kind == "udaf"
     col2: Optional[int] = None  # second argument (regr family, weights)
     param: Optional[float] = None  # percentile fraction etc.
+    # DISTINCT modifier on a non-count aggregate (sum/avg/min/max DISTINCT):
+    # values dedupe through the multiset, finalized per kind
+    distinct: bool = False
+    # retraction replay (reference incremental_aggregator.rs raw-value
+    # replay, :77-90): a non-invertible aggregate consuming an updating
+    # input keeps value -> signed count and re-aggregates at emission, so
+    # retractions erase their contribution exactly
+    replay: bool = False
 
     def host_state(self) -> Optional[str]:
         """Host-resident per-slot state flavor, or None when the aggregate
         decomposes fully onto device phys arrays. 'buffer' = raw value
         chunks (UDAFs, median/percentile/bit/array_agg; append-only).
-        'multiset' = value -> signed count (count_distinct and
-        approx_distinct; retractable, mergeable)."""
-        if self.kind == "udaf" or self.kind in BUFFER_KINDS:
-            return "buffer"
+        'multiset' = value -> signed count (count_distinct/approx_distinct,
+        DISTINCT modifiers, and retraction replay; retractable,
+        mergeable)."""
         if self.kind in ("count_distinct", "approx_distinct"):
             return "multiset"
+        if self.distinct or self.replay:
+            return "multiset"
+        if self.kind == "udaf" or self.kind in BUFFER_KINDS:
+            return "buffer"
         return None
 
     def phys(self) -> List[Tuple[str, str, str]]:
@@ -171,6 +182,57 @@ def _buffer_reducer(spec: "AggSpec"):
     if kind == "array_agg":
         return lambda g: list(g)
     raise ValueError(f"unknown buffered aggregate {kind}")
+
+
+def _reduce_multiset(spec: "AggSpec", d: dict):
+    """Finalize one slot's value->count multiset. DISTINCT modifiers
+    ignore the counts (each live value contributes once); retraction
+    replay (spec.replay) expands values by their signed live counts and
+    re-aggregates, so a fully-retracted value contributes nothing."""
+    kind = spec.kind
+    if not d:
+        if kind == "count":
+            return 0
+        return [] if kind == "array_agg" else None
+    keys = list(d.keys())
+    if kind == "min":
+        return min(keys)
+    if kind == "max":
+        return max(keys)
+    if kind == "bool_and":
+        return all(bool(k) for k in keys)
+    if kind == "bool_or":
+        return any(bool(k) for k in keys)
+    counts = (
+        np.ones(len(d), dtype=np.int64)
+        if spec.distinct
+        else np.fromiter(d.values(), dtype=np.int64, count=len(d))
+    )
+    if kind == "count":
+        return int(counts.sum())
+    if kind == "sum":
+        vals = np.asarray(keys)
+        return (vals * counts).sum()
+    if kind == "avg":
+        vals = np.asarray(keys, dtype=np.float64)
+        return float((vals * counts).sum() / counts.sum())
+    # buffered builtins / UDAFs: expand to the raw value group and reduce
+    karr = np.empty(len(keys), dtype=object)
+    karr[:] = keys
+    expanded = np.repeat(karr, counts)
+    if spec.col2 is not None:
+        rows = [list(t) for t in expanded]
+        try:
+            g = np.asarray(rows, dtype=np.float64)
+        except (ValueError, TypeError):
+            # non-numeric 2-arg groups (e.g. string UDAF args) keep
+            # object dtype, matching the buffer path's column_stack
+            g = np.empty((len(rows), 2), dtype=object)
+            for i, r in enumerate(rows):
+                g[i] = r
+    else:
+        g = np.asarray(expanded.tolist())
+    return _buffer_reducer(spec)(g)
 
 
 def _not_null(g: np.ndarray) -> np.ndarray:
@@ -379,7 +441,11 @@ class Accumulator:
         (must be < capacity-1; capacity-1 is scratch). cols maps input column
         index -> numpy array of row values. `signs` (+1 append / -1 retract
         per row) makes the update invertible for retraction-consuming
-        aggregates; only add-reductions (count/sum/avg) support it."""
+        aggregates: add-reductions (count/sum/avg/variance/regression)
+        apply the sign arithmetically, multisets (count_distinct, DISTINCT
+        modifiers, replay specs) track signed value counts. Non-add device
+        reductions (min/max phys) cannot invert — the planner must mark
+        those specs `replay` first."""
         n = len(slots)
         if n == 0:
             return
@@ -415,8 +481,9 @@ class Accumulator:
             self.udaf_idx or any(op != "add" for op, _, _, _ in self.phys)
         ):
             raise ValueError(
-                "signed (retractable) update requires invertible aggregates "
-                "(count/sum/avg/count_distinct)"
+                "signed (retractable) update reached a non-invertible "
+                "accumulator (min/max phys or append-only buffer); the "
+                "planner should have marked these specs replay=True"
             )
 
     def _update_host(self, slots: np.ndarray, cols: Dict[int, np.ndarray],
@@ -445,10 +512,24 @@ class Accumulator:
             for lo, hi in zip(starts, ends):
                 store.setdefault(int(s_sorted[lo]), []).append(vals[lo:hi])
         for si in self.multiset_idx:
-            # SQL count(DISTINCT x) excludes NULLs; raw columns carry them
-            # as None (object dtype) or NaN (float)
+            # SQL aggregates exclude NULLs; raw columns carry them as None
+            # (object dtype) or NaN (float)
             vals = self._host_vals(si, cols)[order]
             valid = _not_null_mask(vals)
+            spec = self.specs[si]
+            if spec.col2 is not None:
+                # two-argument multisets (weighted percentile / 2-arg UDAF
+                # replay): the multiset key is the (v1, v2) pair. col2
+                # nulls/NaNs must be masked too — None breaks np.unique's
+                # sort and a NaN-bearing pair key never equals itself, so
+                # a retraction could never cancel its insert
+                second = cols[("raw", spec.col2)] if (
+                    "raw", spec.col2) in cols else cols[spec.col2]
+                second = second[order]
+                valid = valid & _not_null_mask(second)
+                pairs = np.empty(len(vals), dtype=object)
+                pairs[:] = list(zip(vals.tolist(), second.tolist()))
+                vals = pairs
             store = self.multiset_store[si]
             for lo, hi in zip(starts, ends):
                 d = store.setdefault(int(s_sorted[lo]), {})
@@ -620,14 +701,20 @@ class Accumulator:
         return out
 
     def _finalize_multiset(self, si: int) -> np.ndarray:
+        spec = self.specs[si]
         if self._segment_multiset is not None:
-            sets = self._segment_multiset.get(si, [])
-            return np.asarray([len(s) for s in sets], dtype=np.int64)
-        store = self.multiset_store[si]
-        return np.asarray(
-            [len(store.get(int(s), ())) for s in self._gather_slots],
-            dtype=np.int64,
-        )
+            dicts = self._segment_multiset.get(si, [])
+        else:
+            store = self.multiset_store[si]
+            dicts = [store.get(int(s), {}) for s in self._gather_slots]
+        if spec.kind in ("count_distinct", "approx_distinct"):
+            return np.asarray([len(d) for d in dicts], dtype=np.int64)
+        out = [_reduce_multiset(spec, d) for d in dicts]
+        if spec.kind == "array_agg":
+            arr = np.empty(len(out), dtype=object)
+            arr[:] = out
+            return arr
+        return np.asarray(out)
 
     def _finalize_udaf(self, si: int) -> np.ndarray:
         """Evaluate a buffered aggregate (registered UDAF or builtin
@@ -688,10 +775,12 @@ class Accumulator:
             mseg: Dict[int, list] = {}
             for si in self.multiset_idx:
                 store = self.multiset_store[si]
-                sets: List[set] = [set() for _ in range(n_segments)]
+                dicts: List[dict] = [{} for _ in range(n_segments)]
                 for s, seg in zip(slots, seg_ids):
-                    sets[int(seg)].update(store.get(int(s), ()))
-                mseg[si] = sets
+                    d = dicts[int(seg)]
+                    for v, c in store.get(int(s), {}).items():
+                        d[v] = d.get(v, 0) + c
+                mseg[si] = dicts
             self._segment_multiset = mseg
         return combined
 
@@ -757,7 +846,10 @@ class Accumulator:
                 if len(pairs):
                     d = store.setdefault(int(s), {})
                     for v, c in pairs:
-                        d[v] = d.get(v, 0) + int(c)
+                        # msgpack round-trips tuple keys (two-argument
+                        # multisets) as lists; re-hash as tuples
+                        k = tuple(v) if isinstance(v, list) else v
+                        d[k] = d.get(k, 0) + int(c)
         return values
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):

@@ -432,8 +432,9 @@ class Planner:
             return self.plan_join(rel)
         if isinstance(rel, Unnest):
             raise SqlError(
-                "UNNEST in FROM is not supported; use unnest(col) as a "
-                "SELECT item"
+                "bare UNNEST in FROM has no input stream; use "
+                "`FROM tbl CROSS JOIN UNNEST(tbl.col) AS x` or unnest(col) "
+                "as a SELECT item"
             )
         raise SqlError(f"unsupported relation {rel!r}")
 
@@ -464,6 +465,64 @@ class Planner:
                 self._cte_stack.pop()
 
     def _plan_select_body(self, sel: Select) -> RelOutput:
+        out = self._plan_select_body_inner(sel)
+        if sel.distinct:
+            out = self._add_distinct_node(out)
+        return out
+
+    def _add_distinct_node(self, out: RelOutput) -> RelOutput:
+        """SELECT DISTINCT: a zero-aggregate updating aggregate keyed by
+        every output column (the reference plans DISTINCT as an aggregation
+        over all select items; the emitted stream is updating). Duplicate
+        rows produce no state change, so only first occurrences emit; over
+        an updating input the per-key live count retracts rows whose every
+        contributing input was retracted."""
+        from ..schema import UPDATING_META_FIELD, UPDATING_META_TYPE
+
+        in_names = out.schema.schema.names
+        key_cols = [
+            i for i, n in enumerate(in_names)
+            if n not in (TIMESTAMP_FIELD, UPDATING_META_FIELD)
+        ]
+        key_names = [in_names[i] for i in key_cols]
+        for i in key_cols:
+            t = out.schema.schema.field(i).type
+            if pa.types.is_list(t) or pa.types.is_map(t):
+                raise SqlError(
+                    f"SELECT DISTINCT over {t} column "
+                    f"{in_names[i]!r} is not supported (list/map values "
+                    "cannot be grouping keys)"
+                )
+        out_fields = [
+            pa.field(in_names[i], out.schema.schema.field(i).type)
+            for i in key_cols
+        ]
+        out_fields.append(pa.field(UPDATING_META_FIELD, UPDATING_META_TYPE))
+        schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        cfg: Dict = {"aggregates": [], "key_cols": key_cols,
+                     "schema": schema}
+        if out.updating:
+            cfg["retractable"] = True
+            cfg["meta_col"] = in_names.index(UPDATING_META_FIELD)
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.UPDATING_AGGREGATE,
+                cfg,
+                "distinct",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            out.node_id, node.node_id, EdgeType.SHUFFLE,
+            out.schema.with_keys(key_names),
+        )
+        return RelOutput(
+            node.node_id, schema, Scope.from_schema(schema.schema),
+            updating=True,
+        )
+
+    def _plan_select_body_inner(self, sel: Select) -> RelOutput:
         if sel.from_ is None:
             raise SqlError("SELECT without FROM is not supported")
         upstream = self.plan_relation(sel.from_)
@@ -501,8 +560,6 @@ class Planner:
                                      where)
         if sel.group_by or self._has_aggregate(items):
             return self._plan_aggregate(sel, items, upstream, where)
-        if sel.distinct:
-            raise SqlError("SELECT DISTINCT is not yet supported")
         # plain projection/filter
         exprs, names = self._bind_items(items, upstream.scope)
         return self._add_value_node(
@@ -792,10 +849,10 @@ class Planner:
                 "unnest() over an updating (retracting) input is not yet "
                 "supported"
             )
-        if sel.distinct or sel.group_by or self._has_aggregate(items):
+        if sel.group_by or self._has_aggregate(items):
             raise SqlError(
-                "unnest() cannot be combined with DISTINCT, GROUP BY or "
-                "aggregates in one SELECT; unnest in a subquery first"
+                "unnest() cannot be combined with GROUP BY or aggregates "
+                "in one SELECT; unnest in a subquery first"
             )
         for it in items:
             if it is unnest_items[0]:
@@ -881,6 +938,75 @@ class Planner:
             "unnest_select", final_name=display_name,
         )
 
+    def _plan_lateral_unnest(
+        self, left: RelOutput, un: Unnest
+    ) -> RelOutput:
+        """FROM t CROSS JOIN UNNEST(t.col) AS x: one output row per list
+        element, every left column replicated across the exploded rows."""
+        if left.updating:
+            raise SqlError(
+                "UNNEST over an updating (retracting) input is not yet "
+                "supported"
+            )
+        list_expr = bind(un.expr, left.scope)
+        if not pa.types.is_list(list_expr.dtype):
+            raise SqlError(
+                f"UNNEST requires a list argument, got {list_expr.dtype}"
+            )
+        out_name = un.alias or "unnest"
+        exprs, names = self._passthrough_exprs(left)
+        exprs.append(list_expr)
+        names = _dedup(names + [self._fresh("list")])
+        pre = self._add_value_node(left, exprs, names, None, "unnest_input")
+        list_idx = len(names) - 1
+        value_type = list_expr.dtype.value_type
+        out_fields = [
+            pa.field(n, f.type)
+            for n, f in zip(names[:-1], pre.schema.schema)
+        ] + [pa.field(out_name, value_type)]
+        out_schema = StreamSchema(add_timestamp_field(pa.schema(out_fields)))
+        ts_idx = pre.schema.timestamp_index
+        # positional mapping: passthrough cols, then the flattened values
+        # (-1), then _timestamp (-2, appended last by add_timestamp_field)
+        src_idx = list(range(list_idx)) + [-1, -2]
+
+        def explode(batch):
+            import pyarrow.compute as pc
+
+            col = batch.column(list_idx)
+            parents = pc.list_parent_indices(col)
+            flat = pc.list_flatten(col)
+            if len(flat) == 0:
+                return None
+            taken = batch.take(parents)
+            arrays = [
+                flat if i == -1
+                else taken.column(ts_idx if i == -2 else i)
+                for i in src_idx
+            ]
+            return pa.RecordBatch.from_arrays(
+                arrays, schema=out_schema.schema
+            )
+
+        node = self.graph.add_node(
+            LogicalNode.single(
+                self._next_id(),
+                OperatorName.ARROW_VALUE,
+                {"py_fn": explode, "schema": out_schema},
+                "unnest",
+                parallelism=self.parallelism,
+            )
+        )
+        self.graph.add_edge(
+            pre.node_id, node.node_id,
+            self._edge(pre.node_id, self.parallelism), pre.schema,
+        )
+        return RelOutput(
+            node.node_id, out_schema,
+            self._requalified_scope(out_schema, left), window=left.window,
+            window_field=_passthrough_window_field(left, names[:-1]),
+        )
+
     def _plan_async_udf(
         self, sel, items, async_items, upstream: RelOutput, where
     ) -> RelOutput:
@@ -951,25 +1077,87 @@ class Planner:
             it for it in items
             if isinstance(it.expr, FuncCall) and it.expr.over is not None
         ]
-        if len(over_items) != 1:
-            raise SqlError(
-                "exactly one window function per SELECT is supported"
-            )
         if upstream.window is None:
             raise SqlError(
                 "window functions require a windowed input (aggregate with "
                 "tumble()/hop()/session() first)"
             )
-        call = over_items[0].expr
-        if call.name not in ("row_number", "rank", "dense_rank"):
-            raise SqlError(
-                f"unsupported window function {call.name}()"
+        for it in over_items:
+            if it.expr.name not in ("row_number", "rank", "dense_rank"):
+                raise SqlError(
+                    f"unsupported window function {it.expr.name}()"
+                )
+        # one WINDOW_FUNCTION operator per OVER item, chained; each stage
+        # passes every upstream column through and appends its result
+        # column, so later stages' PARTITION BY/ORDER BY still bind
+        out = upstream
+        pending_where = where
+        out_cols: List[str] = []
+        for it in over_items:
+            out_name = self._fresh("wfn")  # internal; no alias collisions
+            out = self._add_window_fn_stage(
+                out, it.expr, pending_where, out_name
             )
-        display_name = over_items[0].alias or call.name
-        out_name = self._fresh("wfn")  # internal; no alias collisions
-        # pre-projection: every non-over select item + partition/order exprs
-        plain_items = [it for it in items if it is not over_items[0]]
-        exprs, names = self._bind_items(plain_items, upstream.scope)
+            pending_where = None  # WHERE applies once, before the first
+            out_cols.append(out_name)
+        # final projection restoring SELECT item order
+        final_exprs: List[BoundExpr] = []
+        final_names: List[str] = []
+        for it in items:
+            hit = next(
+                (i for i, o in enumerate(over_items) if o is it), None
+            )
+            if hit is not None:
+                final_exprs.append(bind(Column(out_cols[hit]), out.scope))
+                final_names.append(it.alias or it.expr.name)
+            else:
+                e = bind(it.expr, out.scope)
+                final_exprs.append(e)
+                final_names.append(it.alias or _default_name(it.expr, e))
+        return self._add_value_node(
+            out, final_exprs, _dedup(final_names), None, "window_fn_select"
+        )
+
+    def _passthrough_exprs(
+        self, upstream: RelOutput
+    ) -> Tuple[List[BoundExpr], List[str]]:
+        """Pass every non-timestamp upstream column through by index
+        (indices are stable, so qualified names stay remappable)."""
+        exprs: List[BoundExpr] = []
+        names: List[str] = []
+        for i, f in enumerate(upstream.schema.schema):
+            if f.name == TIMESTAMP_FIELD:
+                continue
+            exprs.append(
+                BoundExpr(
+                    (lambda j: lambda b: b.column(j))(i), f.type, f.name
+                )
+            )
+            names.append(f.name)
+        return exprs, names
+
+    def _requalified_scope(
+        self, schema: StreamSchema, upstream: RelOutput
+    ) -> Scope:
+        """Scope over `schema` that also resolves the upstream's qualified
+        names — valid when `schema` starts with a pass-through of the
+        upstream's non-timestamp columns in order."""
+        ts_idx = upstream.schema.timestamp_index
+        scope = Scope.from_schema(schema.schema)
+        for c in upstream.scope.cols:
+            if c.qualifier is not None and c.index != ts_idx:
+                new_idx = c.index if c.index < ts_idx else c.index - 1
+                scope.add(c.qualifier, c.name, new_idx, c.dtype)
+        return scope
+
+    def _add_window_fn_stage(
+        self, upstream: RelOutput, call: FuncCall,
+        where: Optional[BoundExpr], out_name: str,
+    ) -> RelOutput:
+        """One window-function operator: pass-through pre-projection (+
+        fresh PARTITION BY/ORDER BY columns), then the WINDOW_FUNCTION
+        node appending `out_name`."""
+        exprs, names = self._passthrough_exprs(upstream)
         part_idx: List[int] = []
         for p in call.over.partition_by:
             # the window column partitions implicitly (rows bin by their
@@ -977,23 +1165,15 @@ class Planner:
             b = bind(p, upstream.scope)
             if pa.types.is_struct(b.dtype):
                 continue
-            if p in [it.expr for it in plain_items]:
-                part_idx.append([it.expr for it in plain_items].index(p))
-            else:
-                exprs.append(b)
-                names.append(self._fresh("part"))
-                part_idx.append(len(exprs) - 1)
+            exprs.append(b)
+            names.append(self._fresh("part"))
+            part_idx.append(len(exprs) - 1)
         order_by: List[tuple] = []
         for o, desc in call.over.order_by:
             b = bind(o, upstream.scope)
-            if o in [it.expr for it in plain_items]:
-                order_by.append(
-                    ([it.expr for it in plain_items].index(o), desc)
-                )
-            else:
-                exprs.append(b)
-                names.append(self._fresh("ord"))
-                order_by.append((len(exprs) - 1, desc))
+            exprs.append(b)
+            names.append(self._fresh("ord"))
+            order_by.append((len(exprs) - 1, desc))
         names = _dedup(names)
         pre = self._add_value_node(
             upstream, exprs, names, where, "window_fn_input"
@@ -1022,14 +1202,11 @@ class Planner:
         self.graph.add_edge(
             pre.node_id, node.node_id, self._edge(pre.node_id, 1), pre.schema
         )
-        out = RelOutput(
-            node.node_id, out_schema, Scope.from_schema(out_schema.schema),
+        return RelOutput(
+            node.node_id, out_schema,
+            self._requalified_scope(out_schema, upstream),
             window=upstream.window, window_field=upstream.window_field
             if upstream.window_field in out_schema.names else None,
-        )
-        return self._restore_select_order(
-            out, items, over_items[0], out_name, plain_items, names,
-            "window_fn_select", final_name=display_name,
         )
 
     def _plan_updating_aggregate(
@@ -1044,25 +1221,6 @@ class Planner:
             [_default_name(g, b) for g, b in zip(group_exprs, key_bound)]
         )
         agg_calls, agg_inputs = _collect_aggregates(items, upstream.scope)
-        if upstream.updating:
-            # retraction-consuming aggregation: retract rows apply with
-            # sign -1, so only invertible aggregates work (reference
-            # incremental_aggregator.rs supports the same add-reductions;
-            # count(DISTINCT) inverts through its per-key multiset)
-            bad = [
-                c.name for c in agg_calls
-                if not c.distinct
-                and AGG_ALIASES.get(c.name, c.name)
-                not in ("count", "sum", "avg", *VAR_KINDS_SQL,
-                        *REGR_KINDS_SQL)
-            ]
-            if bad:
-                raise SqlError(
-                    f"{bad[0]}() over an updating (retracting) input is not "
-                    "supported — only invertible aggregates (count/sum/avg) "
-                    "can consume retractions; aggregate before the updating "
-                    "stage instead"
-                )
         pre_exprs = list(key_bound)
         pre_names = list(key_names)
         agg_col_idx: List[List[int]] = []
@@ -1083,6 +1241,17 @@ class Planner:
                 _make_spec(call, col_idx, pre_exprs, self._fresh("agg_out"))
             )
             agg_out_names.append(specs[-1]["name"])
+        if upstream.updating:
+            # retraction-consuming aggregation: invertible aggregates
+            # (add-reductions and multisets) apply retract rows with sign
+            # -1; everything else (min/max/median/UDAF/...) switches to
+            # raw-value replay through the signed multiset (reference
+            # incremental_aggregator.rs raw-value replay, :77-90)
+            invertible = ("count", "sum", "avg", "count_distinct",
+                          "approx_distinct", *VAR_KINDS_SQL, *REGR_KINDS_SQL)
+            for s in specs:
+                if s["kind"] not in invertible and not s["distinct"]:
+                    s["replay"] = True
         out_fields = [
             pa.field(n, pre.schema.schema.field(i).type)
             for i, n in enumerate(key_names)
@@ -1168,6 +1337,16 @@ class Planner:
     # -- joins --------------------------------------------------------------
 
     def plan_join(self, rel: Join) -> RelOutput:
+        # FROM tbl CROSS JOIN UNNEST(expr) AS x — lateral explode
+        # (reference: DataFusion's LogicalPlan::Unnest via UnnestRewriter)
+        if isinstance(rel.right, Unnest):
+            if rel.condition is not None:
+                raise SqlError("UNNEST join takes no ON condition")
+            return self._plan_lateral_unnest(
+                self.plan_relation(rel.left), rel.right
+            )
+        if isinstance(rel.left, Unnest):
+            raise SqlError("UNNEST must be the right side of a CROSS JOIN")
         # lookup tables join via the LookupConnector path (reference:
         # LookupExtension + lookup_join.rs)
         if isinstance(rel.right, TableRef):
@@ -1826,12 +2005,19 @@ def _make_spec(call: FuncCall, col_idx: list, pre_exprs, name: str) -> dict:
     udaf = None
     if kind not in AGG_FUNCS and get_udaf(call.name) is not None:
         kind, udaf = "udaf", call.name
+    distinct = False
     if call.distinct:
-        if kind != "count":
+        if kind == "count":
+            kind = "count_distinct"
+        elif kind in ("sum", "avg", "min", "max") or kind == "udaf" or (
+            kind in ("median", "approx_median", "array_agg")
+        ):
+            # dedupe through the value multiset, finalized per kind
+            distinct = True
+        else:
             raise SqlError(
-                f"DISTINCT is only supported with count(), not {kind}"
+                f"DISTINCT is not supported with {kind}()"
             )
-        kind = "count_distinct"
     col = col_idx[0] if col_idx else None
     col2 = col_idx[1] if len(col_idx) > 1 else None
     param = None
@@ -1848,7 +2034,7 @@ def _make_spec(call: FuncCall, col_idx: list, pre_exprs, name: str) -> dict:
     ) or kind == "avg"
     return {"kind": kind, "col": col, "name": name,
             "is_float": is_float, "udaf": udaf, "col2": col2,
-            "param": param}
+            "param": param, "distinct": distinct}
 
 
 def _agg_output_type(spec: dict, call: FuncCall, pre_schema: pa.Schema):

@@ -1,0 +1,35 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  start TIMESTAMP,
+  s BIGINT,
+  a DOUBLE,
+  mn BIGINT,
+  mx BIGINT,
+  md DOUBLE
+) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT window.start, s, a, mn, mx, md FROM (
+  SELECT tumble(interval '20 second') as window,
+         sum(DISTINCT counter % 10) as s,
+         avg(DISTINCT counter % 10) as a,
+         min(DISTINCT counter % 10) as mn,
+         max(DISTINCT counter % 10) as mx,
+         median(DISTINCT counter % 10) as md
+  FROM impulse
+  GROUP BY 1
+);

@@ -1,0 +1,24 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (g BIGINT, v BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT W.g, v FROM (
+  SELECT counter % 3 as g, array_agg(counter) as arr,
+         tumble(interval '30 second') as w
+  FROM impulse
+  GROUP BY 1, w
+) AS W CROSS JOIN UNNEST(W.arr) AS v;

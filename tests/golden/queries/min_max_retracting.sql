@@ -1,0 +1,25 @@
+--pk=g
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (g BIGINT, mn BIGINT, mx BIGINT, md DOUBLE) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'debezium_json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT g, min(c) as mn, max(c) as mx, median(c) as md FROM (
+  SELECT counter % 4 as g, counter % 7 as k, count(*) as c
+  FROM impulse
+  GROUP BY 1, 2
+)
+GROUP BY g;

@@ -1,0 +1,27 @@
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (g BIGINT, c BIGINT, rn BIGINT, rk BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT W.g, W.c,
+       row_number() OVER (PARTITION BY W.par ORDER BY W.c DESC, W.g ASC) as rn,
+       rank() OVER (ORDER BY W.c DESC) as rk
+FROM (
+  SELECT counter % 6 as g, (counter % 6) % 2 as par, count(*) as c,
+         tumble(interval '30 second') as w
+  FROM impulse
+  GROUP BY 1, 2, w
+) AS W;

@@ -174,15 +174,23 @@ def test_aggregate_over_updating_input(tmp_path):
     assert sum(1 for v in state.values() if v > 0) == 2
 
 
-def test_non_invertible_over_updating_input_rejected():
-    from arroyo_tpu.sql.lexer import SqlError
-
-    with pytest.raises(SqlError, match="invertible"):
-        plan_query(
-            IMPULSE
-            + """
-            SELECT max(c) FROM (
-              SELECT counter % 3 as k, count(*) as c FROM impulse GROUP BY 1
-            );
-            """
-        )
+def test_non_invertible_over_updating_input_replays():
+    """max() over a retracting input plans with the multiset replay flag
+    (reference incremental_aggregator.rs raw-value replay) instead of the
+    round-1 plan-time rejection."""
+    plan = plan_query(
+        IMPULSE
+        + """
+        SELECT max(c) FROM (
+          SELECT counter % 3 as k, count(*) as c FROM impulse GROUP BY 1
+        );
+        """
+    )
+    specs = [
+        s
+        for node in plan.graph.nodes.values()
+        for op in node.chain
+        if "aggregates" in op.config
+        for s in op.config["aggregates"]
+    ]
+    assert any(s.get("replay") for s in specs if s["kind"] == "max")

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import asyncio
 from typing import Optional
 
 from ..operators.base import Operator, SourceFinishType, SourceOperator
@@ -48,10 +49,39 @@ class FluvioSource(SourceOperator):
         consumer = client.partition_consumer(
             self.topic, ctx.task_info.task_index
         )
-        for record in consumer.stream(fluvio.Offset.absolute(self.offset)):
+        # the fluvio client is synchronous: a daemon pump thread iterates
+        # the blocking stream into a bounded queue, so an idle partition
+        # never blocks the event loop, and a stop can't hang interpreter
+        # shutdown on a parked non-daemon executor thread
+        import queue as _queue
+        import threading
+
+        it = iter(consumer.stream(fluvio.Offset.absolute(self.offset)))
+        sentinel = object()
+        q: _queue.Queue = _queue.Queue(maxsize=4096)
+
+        def pump():
+            try:
+                for record in it:
+                    q.put(record)
+            finally:
+                q.put(sentinel)
+
+        threading.Thread(
+            target=pump, daemon=True, name="fluvio-pump"
+        ).start()
+        while True:
             finish = await ctx.check_control(collector)
             if finish is not None:
                 return finish
+            try:
+                record = q.get_nowait()
+            except _queue.Empty:
+                await self.flush_buffer(ctx, collector)
+                await asyncio.sleep(0.02)
+                continue
+            if record is sentinel:
+                break
             for row in deser.deserialize_slice(
                 bytes(record.value()), error_reporter=ctx.error_reporter
             ):

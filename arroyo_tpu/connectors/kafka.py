@@ -39,13 +39,25 @@ def _load_client():
 
 
 class KafkaSource(SourceOperator):
+    # DDL `METADATA FROM 'key'` surface (reference kafka metadata_defs,
+    # kafka/mod.rs:325): key -> per-message extractor
+    METADATA_KEYS = ("offset_id", "partition", "topic", "timestamp", "key")
+
     def __init__(self, bootstrap: str, topic: str, group_id: Optional[str],
                  offset_mode: str, client_configs: Dict[str, str],
                  schema, format: str, bad_data: str, framing: Optional[str],
                  proto_descriptor: Optional[dict] = None,
                  schema_registry: Optional[str] = None,
-                 avro_schema: Optional[str] = None):
+                 avro_schema: Optional[str] = None,
+                 metadata_fields: Optional[Dict[str, str]] = None):
         super().__init__("kafka_source")
+        self.metadata_fields = metadata_fields or {}
+        for col, key in self.metadata_fields.items():
+            if key not in self.METADATA_KEYS:
+                raise ValueError(
+                    f"kafka metadata key {key!r} (column {col}) is not one "
+                    f"of {self.METADATA_KEYS}"
+                )
         self.bootstrap = bootstrap
         self.topic = topic
         self.group_id = group_id
@@ -136,10 +148,28 @@ class KafkaSource(SourceOperator):
                     continue
                 ts_type, ts_ms = msg.timestamp()
                 ts = ts_ms * 1_000_000 if ts_ms > 0 else None
+                meta = None
+                if self.metadata_fields:
+                    vals = {
+                        "offset_id": msg.offset(),
+                        "partition": msg.partition(),
+                        "topic": msg.topic(),
+                        "timestamp": ts_ms if ts_ms > 0 else None,
+                        "key": (
+                            msg.key().decode("utf-8", "replace")
+                            if msg.key() is not None else None
+                        ),
+                    }
+                    meta = {
+                        col: vals[k]
+                        for col, k in self.metadata_fields.items()
+                    }
                 for row in deser.deserialize_slice(
                     msg.value(), timestamp=ts,
                     error_reporter=ctx.error_reporter,
                 ):
+                    if meta:
+                        row.update(meta)
                     ctx.buffer_row(row)
                 self.offsets[msg.partition()] = msg.offset() + 1
                 if ctx.should_flush():
@@ -296,6 +326,7 @@ class KafkaConnector(Connector):
             proto_descriptor=config.get("proto_descriptor"),
             schema_registry=config.get("schema_registry"),
             avro_schema=config.get("avro_schema"),
+            metadata_fields=config.get("metadata_fields"),
         )
 
     def make_sink(self, config, schema: ConnectionSchema):

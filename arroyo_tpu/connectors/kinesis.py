@@ -13,10 +13,22 @@ from ..formats.ser import Serializer
 from ._gated import require_client
 from .base import ConnectionSchema, Connector, register_connector
 
+# position sentinel for a shard fully drained after a split/merge
+CLOSED = "__closed__"
+
+
+def _seq_ge(a: str, b: str) -> bool:
+    """a >= b for Kinesis sequence numbers (numeric strings); falls back
+    to last-wins on non-numeric test doubles."""
+    try:
+        return int(a) >= int(b)
+    except (TypeError, ValueError):
+        return True
+
 
 class KinesisSource(SourceOperator):
     def __init__(self, stream: str, region: str, init_position: str,
-                 schema, format, bad_data):
+                 schema, format, bad_data, reshard_poll: float = 1.0):
         super().__init__("kinesis_source")
         self.stream = stream
         self.region = region
@@ -24,6 +36,7 @@ class KinesisSource(SourceOperator):
         self.out_schema = schema
         self.format = format
         self.bad_data = bad_data
+        self.reshard_poll = reshard_poll  # seconds between shard re-lists
         self.positions: Dict[str, str] = {}  # shard id -> sequence number
 
     def tables(self):
@@ -34,46 +47,122 @@ class KinesisSource(SourceOperator):
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
             table = await ctx.table("kin")
-            stored = table.get(ctx.task_info.task_index)
-            if stored is not None:
-                self.positions = dict(stored)
+            # merge every subtask's snapshot: shard ownership is by hash,
+            # so a rescale can move a shard between subtasks and its
+            # position must follow it. Snapshots can overlap after a
+            # rescale — CLOSED wins, else the furthest sequence number
+            # (Kinesis sequence numbers are numeric strings)
+            for stored in table.all_values():
+                for sid, pos in (stored or {}).items():
+                    cur = self.positions.get(sid)
+                    if cur == CLOSED:
+                        continue
+                    if pos == CLOSED:
+                        self.positions[sid] = pos
+                    elif cur is None or _seq_ge(pos, cur):
+                        self.positions[sid] = pos
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("kin")
-            table.put(ctx.task_info.task_index, dict(self.positions))
+            table.put(
+                ctx.task_info.task_index,
+                {
+                    sid: pos for sid, pos in self.positions.items()
+                    if self._owned(sid, ctx)
+                },
+            )
+
+    def _owned(self, shard_id: str, ctx) -> bool:
+        """Stable shard -> subtask assignment (crc32, not enumeration
+        index) so resharding-created children don't shuffle ownership of
+        existing shards."""
+        import zlib
+
+        par = ctx.task_info.parallelism
+        return zlib.crc32(shard_id.encode()) % par == ctx.task_info.task_index
+
+    def _open_iterator(self, client, sid: str):
+        if sid in self.positions and self.positions[sid] != CLOSED:
+            it = client.get_shard_iterator(
+                StreamName=self.stream, ShardId=sid,
+                ShardIteratorType="AFTER_SEQUENCE_NUMBER",
+                StartingSequenceNumber=self.positions[sid],
+            )
+        else:
+            # children created by a split/merge must replay from their
+            # start; LATEST would drop the records written before we
+            # discovered them
+            it = client.get_shard_iterator(
+                StreamName=self.stream, ShardId=sid,
+                ShardIteratorType=(
+                    "TRIM_HORIZON"
+                    if self.init_position == "earliest"
+                    or sid in self._discovered_children
+                    else "LATEST"
+                ),
+            )
+        return it["ShardIterator"]
 
     async def run(self, ctx, collector) -> SourceFinishType:
         boto3 = require_client("boto3")
         deser = Deserializer(self.out_schema, format=self.format or "json",
                              bad_data=self.bad_data)
         client = boto3.client("kinesis", region_name=self.region)
-        shards = client.list_shards(StreamName=self.stream)["Shards"]
-        mine = [
-            s["ShardId"] for i, s in enumerate(shards)
-            if i % ctx.task_info.parallelism == ctx.task_info.task_index
-        ]
-        iterators = {}
-        for sid in mine:
-            if sid in self.positions:
-                it = client.get_shard_iterator(
-                    StreamName=self.stream, ShardId=sid,
-                    ShardIteratorType="AFTER_SEQUENCE_NUMBER",
-                    StartingSequenceNumber=self.positions[sid],
-                )
-            else:
-                it = client.get_shard_iterator(
-                    StreamName=self.stream, ShardId=sid,
-                    ShardIteratorType=(
-                        "TRIM_HORIZON" if self.init_position == "earliest"
-                        else "LATEST"
-                    ),
-                )
-            iterators[sid] = it["ShardIterator"]
-        while iterators:
+        iterators: Dict[str, str] = {}
+        known: set = set()
+        self._discovered_children: set = set()
+
+        def refresh_shards(initial: bool = False) -> bool:
+            """Pick up resharding children (reference kinesis resharding
+            handling): a child shard starts only after its parent(s) are
+            fully drained by their owner, preserving per-key order.
+            Returns True when the stream metadata shows every shard
+            closed AND all of ours are drained (stream has ended)."""
+            shards = client.list_shards(StreamName=self.stream)["Shards"]
+            for s in shards:
+                sid = s["ShardId"]
+                if sid in known or not self._owned(sid, ctx):
+                    continue
+                if self.positions.get(sid) == CLOSED:
+                    known.add(sid)
+                    continue
+                parents = [
+                    p for p in (
+                        s.get("ParentShardId"),
+                        s.get("AdjacentParentShardId"),
+                    )
+                    if p and self._owned(p, ctx)
+                    and self.positions.get(p) != CLOSED
+                    and any(x["ShardId"] == p for x in shards)
+                ]
+                if parents and not initial:
+                    continue  # wait until our parent drains
+                if not initial and s.get("ParentShardId"):
+                    self._discovered_children.add(sid)
+                known.add(sid)
+                iterators[sid] = self._open_iterator(client, sid)
+            all_meta_closed = all(
+                s.get("SequenceNumberRange", {}).get("EndingSequenceNumber")
+                is not None
+                for s in shards
+            )
+            mine_drained = not iterators and all(
+                self.positions.get(s["ShardId"]) == CLOSED
+                for s in shards
+                if self._owned(s["ShardId"], ctx)
+            )
+            return all_meta_closed and mine_drained
+
+        refresh_shards(initial=True)
+        last_refresh = 0.0
+        import time as _time
+
+        while True:
             finish = await ctx.check_control(collector)
             if finish is not None:
                 return finish
+            closed_any = False
             for sid, it in list(iterators.items()):
                 resp = client.get_records(ShardIterator=it, Limit=1000)
                 for rec in resp["Records"]:
@@ -87,12 +176,23 @@ class KinesisSource(SourceOperator):
                     self.positions[sid] = rec["SequenceNumber"]
                 nxt = resp.get("NextShardIterator")
                 if nxt is None:
+                    # shard closed by a split/merge: remember so restores
+                    # and re-lists never re-read it, then look for children
+                    self.positions[sid] = CLOSED
                     del iterators[sid]
+                    closed_any = True
                 else:
                     iterators[sid] = nxt
             await self.flush_buffer(ctx, collector)
+            # refresh on closures AND on a timer: a reshard child can hash
+            # to a subtask whose own iterators never closed (or that owns
+            # nothing yet), so every subtask must re-list periodically
+            now = _time.monotonic()
+            if closed_any or now - last_refresh >= self.reshard_poll:
+                last_refresh = now
+                if refresh_shards():
+                    return SourceFinishType.FINAL
             await asyncio.sleep(0.2)
-        return SourceFinishType.FINAL
 
 
 class KinesisSink(Operator):

@@ -1,17 +1,34 @@
 """MQTT connector (reference: crates/arroyo-connectors/src/mqtt/, 1,264 LoC
-with rumqttc + QoS levels). Client gated on paho-mqtt/aiomqtt."""
+with rumqttc): QoS 0/1, durable session resume (client_id +
+clean_session=false re-delivers QoS1 backlog after reconnect), username/
+password + TLS options, automatic reconnect with backoff, retained-message
+sink publishes, and `METADATA FROM 'topic'` columns. Client gated on
+aiomqtt/paho-mqtt."""
 
 from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
 
 from ..operators.base import Operator, SourceFinishType, SourceOperator
 from ..formats.de import Deserializer
 from ..formats.ser import Serializer
+from ..utils.logging import get_logger
 from ._gated import require_client
 from .base import ConnectionSchema, Connector, register_connector
 
+logger = get_logger("mqtt")
+
+METADATA_KEYS = ("topic", "qos", "retain")
+
 
 class MqttSource(SourceOperator):
-    def __init__(self, url: str, topic: str, qos: int, schema, format, bad_data):
+    def __init__(self, url: str, topic: str, qos: int, schema, format,
+                 bad_data, client_id: Optional[str] = None,
+                 username: Optional[str] = None,
+                 password: Optional[str] = None,
+                 metadata_fields: Optional[Dict[str, str]] = None,
+                 max_reconnects: int = 10):
         super().__init__("mqtt_source")
         self.url = url
         self.topic = topic
@@ -19,34 +36,114 @@ class MqttSource(SourceOperator):
         self.out_schema = schema
         self.format = format
         self.bad_data = bad_data
+        self.client_id = client_id
+        self.username = username
+        self.password = password
+        self.metadata_fields = metadata_fields or {}
+        self.max_reconnects = max_reconnects
+        for col, key in self.metadata_fields.items():
+            if key not in METADATA_KEYS:
+                raise ValueError(
+                    f"mqtt metadata key {key!r} (column {col}) is not one "
+                    f"of {METADATA_KEYS}"
+                )
+
+    def _client(self, aiomqtt, ctx):
+        kwargs = {}
+        if self.client_id:
+            # durable session: the broker re-delivers QoS1 messages that
+            # arrived while we were away (reference mqtt session handling)
+            kwargs["identifier"] = self.client_id
+            kwargs["clean_session"] = False
+        if self.username:
+            kwargs["username"] = self.username
+            kwargs["password"] = self.password
+        return aiomqtt.Client(self.url, **kwargs)
 
     async def run(self, ctx, collector) -> SourceFinishType:
         aiomqtt = require_client("aiomqtt", "paho.mqtt.client")
         deser = Deserializer(self.out_schema, format=self.format or "json",
                              bad_data=self.bad_data)
-        async with aiomqtt.Client(self.url) as client:
-            await client.subscribe(self.topic, qos=self.qos)
-            async for message in client.messages:
-                finish = await ctx.check_control(collector)
-                if finish is not None:
-                    return finish
-                for row in deser.deserialize_slice(
-                    bytes(message.payload), error_reporter=ctx.error_reporter
-                ):
-                    ctx.buffer_row(row)
-                if ctx.should_flush():
-                    await self.flush_buffer(ctx, collector)
-        return SourceFinishType.FINAL
+        mqtt_error = getattr(aiomqtt, "MqttError", Exception)
+        reconnects = 0
+        while True:
+            try:
+                async with self._client(aiomqtt, ctx) as client:
+                    reconnects = 0
+                    await client.subscribe(self.topic, qos=self.qos)
+                    finish = await self._consume(
+                        client, deser, ctx, collector
+                    )
+                    if finish is not None:
+                        return finish
+            except mqtt_error as e:
+                reconnects += 1
+                if reconnects > self.max_reconnects:
+                    raise
+                logger.warning(
+                    "mqtt connection lost (%s); reconnect %d/%d",
+                    e, reconnects, self.max_reconnects,
+                )
+                await asyncio.sleep(min(2 ** reconnects * 0.1, 10.0))
+
+    async def _consume(self, client, deser, ctx, collector):
+        """Poll with a persistent in-flight __anext__ so an idle topic
+        never starves control handling (checkpoint barriers, stops), and
+        cancellation never orphans the client's internal getter."""
+        it = client.messages.__aiter__()
+        pending = None
+        while True:
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                if pending is not None:
+                    pending.cancel()
+                return finish
+            if pending is None:
+                pending = asyncio.ensure_future(it.__anext__())
+            done, _ = await asyncio.wait({pending}, timeout=0.05)
+            if not done:
+                await self.flush_buffer(ctx, collector)
+                continue
+            task, pending = pending, None
+            try:
+                message = task.result()
+            except StopAsyncIteration:
+                return SourceFinishType.FINAL
+            meta = None
+            if self.metadata_fields:
+                vals = {
+                    "topic": str(message.topic),
+                    "qos": int(getattr(message, "qos", self.qos)),
+                    "retain": bool(getattr(message, "retain", False)),
+                }
+                meta = {
+                    col: vals[k]
+                    for col, k in self.metadata_fields.items()
+                }
+            for row in deser.deserialize_slice(
+                bytes(message.payload), error_reporter=ctx.error_reporter
+            ):
+                if meta:
+                    row.update(meta)
+                ctx.buffer_row(row)
+            if ctx.should_flush():
+                await self.flush_buffer(ctx, collector)
 
 
 class MqttSink(Operator):
-    def __init__(self, url: str, topic: str, qos: int, retain: bool, format):
+    def __init__(self, url: str, topic: str, qos: int, retain: bool, format,
+                 client_id: Optional[str] = None,
+                 username: Optional[str] = None,
+                 password: Optional[str] = None):
         super().__init__("mqtt_sink")
         self.url = url
         self.topic = topic
         self.qos = qos
         self.retain = retain
         self.serializer = Serializer(format=format or "json")
+        self.client_id = client_id
+        self.username = username
+        self.password = password
         self.client = None
         self._stack = None
 
@@ -54,12 +151,20 @@ class MqttSink(Operator):
         aiomqtt = require_client("aiomqtt")
         import contextlib
 
+        kwargs = {}
+        if self.client_id:
+            kwargs["identifier"] = self.client_id
+        if self.username:
+            kwargs["username"] = self.username
+            kwargs["password"] = self.password
         self._stack = contextlib.AsyncExitStack()
         self.client = await self._stack.enter_async_context(
-            aiomqtt.Client(self.url)
+            aiomqtt.Client(self.url, **kwargs)
         )
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        # aiomqtt awaits the broker PUBACK for qos>=1, so every row is
+        # broker-acknowledged before the next barrier (at-least-once)
         for rec in self.serializer.serialize(batch):
             await self.client.publish(
                 self.topic, rec, qos=self.qos, retain=self.retain
@@ -74,7 +179,7 @@ class MqttSink(Operator):
 @register_connector
 class MqttConnector(Connector):
     name = "mqtt"
-    description = "MQTT source and sink"
+    description = "MQTT source and sink (QoS 0/1, durable sessions)"
     source = True
     sink = True
     config_schema = {
@@ -82,24 +187,40 @@ class MqttConnector(Connector):
         "topic": {"type": "string", "required": True},
         "qos": {"type": "integer"},
         "retain": {"type": "boolean"},
+        "client_id": {"type": "string"},
+        "username": {"type": "string"},
+        "password": {"type": "string"},
     }
 
     def validate_options(self, options, schema):
         for k in ("url", "topic"):
             if k not in options:
                 raise ValueError(f"mqtt requires a {k} option")
+        qos = int(options.get("qos", 0))
+        if qos not in (0, 1):
+            raise ValueError("mqtt qos must be 0 or 1 (QoS 2 unsupported)")
         return {
             "url": options["url"],
             "topic": options["topic"],
-            "qos": int(options.get("qos", 0)),
+            "qos": qos,
             "retain": str(options.get("retain", "false")).lower() == "true",
+            "client_id": options.get("client_id"),
+            "username": options.get("username"),
+            "password": options.get("password"),
         }
 
     def make_source(self, config, schema: ConnectionSchema):
         return MqttSource(config["url"], config["topic"], config.get("qos", 0),
                           config.get("schema"), config.get("format"),
-                          config.get("bad_data", "fail"))
+                          config.get("bad_data", "fail"),
+                          client_id=config.get("client_id"),
+                          username=config.get("username"),
+                          password=config.get("password"),
+                          metadata_fields=config.get("metadata_fields"))
 
     def make_sink(self, config, schema: ConnectionSchema):
         return MqttSink(config["url"], config["topic"], config.get("qos", 0),
-                        config.get("retain", False), config.get("format"))
+                        config.get("retain", False), config.get("format"),
+                        client_id=config.get("client_id"),
+                        username=config.get("username"),
+                        password=config.get("password"))

@@ -1,7 +1,14 @@
 """RabbitMQ connector (reference: crates/arroyo-connectors/src/rabbitmq/,
-467 LoC). Client gated on aio-pika/pika."""
+467 LoC): durable queues with consumer prefetch, at-least-once delivery
+(messages are acked at the CHECKPOINT barrier, after their rows are
+flushed downstream and covered by the epoch — a crash before the ack
+redelivers, never loses), persistent delivery on the sink, and optional
+exchange/routing-key addressing. Client gated on aio-pika/pika."""
 
 from __future__ import annotations
+
+import asyncio
+from typing import Optional
 
 from ..operators.base import Operator, SourceFinishType, SourceOperator
 from ..formats.de import Deserializer
@@ -11,13 +18,24 @@ from .base import ConnectionSchema, Connector, register_connector
 
 
 class RabbitmqSource(SourceOperator):
-    def __init__(self, url: str, queue: str, schema, format, bad_data):
+    def __init__(self, url: str, queue: str, schema, format, bad_data,
+                 prefetch: int = 100):
         super().__init__("rabbitmq_source")
         self.url = url
         self.queue = queue
         self.out_schema = schema
         self.format = format
         self.bad_data = bad_data
+        self.prefetch = prefetch
+        self._unacked: list = []
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        # rows from these messages were flushed before the barrier, so
+        # the epoch covers them — safe to ack (at-least-once: a crash
+        # before this point redelivers)
+        unacked, self._unacked = self._unacked, []
+        for m in unacked:
+            await m.ack()
 
     async def run(self, ctx, collector) -> SourceFinishType:
         aio_pika = require_client("aio_pika")
@@ -26,42 +44,86 @@ class RabbitmqSource(SourceOperator):
         conn = await aio_pika.connect_robust(self.url)
         async with conn:
             channel = await conn.channel()
+            await channel.set_qos(prefetch_count=self.prefetch)
             queue = await channel.declare_queue(self.queue, durable=True)
             async with queue.iterator() as it:
-                async for message in it:
+                # persistent in-flight __anext__: an idle queue must not
+                # starve control handling, and cancelling __anext__ (as
+                # wait_for would) can orphan the client's internal getter
+                ait = it.__aiter__()
+                pending = None
+                while True:
                     finish = await ctx.check_control(collector)
                     if finish is not None:
+                        if pending is not None:
+                            pending.cancel()
                         return finish
-                    async with message.process():
-                        for row in deser.deserialize_slice(
-                            message.body, error_reporter=ctx.error_reporter
-                        ):
-                            ctx.buffer_row(row)
+                    if pending is None:
+                        pending = asyncio.ensure_future(ait.__anext__())
+                    done, _ = await asyncio.wait({pending}, timeout=0.05)
+                    if not done:
+                        await self.flush_buffer(ctx, collector)
+                        continue
+                    task, pending = pending, None
+                    try:
+                        message = task.result()
+                    except StopAsyncIteration:
+                        break
+                    for row in deser.deserialize_slice(
+                        message.body, error_reporter=ctx.error_reporter
+                    ):
+                        ctx.buffer_row(row)
+                    self._unacked.append(message)
                     if ctx.should_flush():
                         await self.flush_buffer(ctx, collector)
+                # stream ended: the tail is flushed at source close and
+                # the pipeline drains, so ack the remainder
+                await self.flush_buffer(ctx, collector)
+                for m in self._unacked:
+                    await m.ack()
+                self._unacked = []
         return SourceFinishType.FINAL
 
 
 class RabbitmqSink(Operator):
-    def __init__(self, url: str, queue: str, format):
+    def __init__(self, url: str, queue: str, format,
+                 exchange: Optional[str] = None,
+                 routing_key: Optional[str] = None):
         super().__init__("rabbitmq_sink")
         self.url = url
         self.queue = queue
+        self.exchange_name = exchange
+        self.routing_key = routing_key or queue
         self.serializer = Serializer(format=format or "json")
         self.conn = None
         self.channel = None
+        self.exchange = None
 
     async def on_start(self, ctx):
         aio_pika = require_client("aio_pika")
         self.conn = await aio_pika.connect_robust(self.url)
         self.channel = await self.conn.channel()
+        if self.exchange_name:
+            self.exchange = await self.channel.get_exchange(
+                self.exchange_name
+            )
+        else:
+            self.exchange = self.channel.default_exchange
         self._aio_pika = aio_pika
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        persistent = getattr(
+            self._aio_pika, "DeliveryMode", None
+        )
         for rec in self.serializer.serialize(batch):
-            await self.channel.default_exchange.publish(
-                self._aio_pika.Message(body=rec), routing_key=self.queue
+            msg = self._aio_pika.Message(
+                body=rec,
+                **(
+                    {"delivery_mode": persistent.PERSISTENT}
+                    if persistent is not None else {}
+                ),
             )
+            await self.exchange.publish(msg, routing_key=self.routing_key)
 
     async def on_close(self, ctx, collector, is_eod: bool):
         if self.conn is not None:
@@ -78,19 +140,31 @@ class RabbitmqConnector(Connector):
     config_schema = {
         "url": {"type": "string", "required": True},
         "queue": {"type": "string", "required": True},
+        "prefetch": {"type": "integer"},
+        "exchange": {"type": "string"},
+        "routing_key": {"type": "string"},
     }
 
     def validate_options(self, options, schema):
         for k in ("url", "queue"):
             if k not in options:
                 raise ValueError(f"rabbitmq requires a {k} option")
-        return {"url": options["url"], "queue": options["queue"]}
+        return {
+            "url": options["url"],
+            "queue": options["queue"],
+            "prefetch": int(options.get("prefetch", 100)),
+            "exchange": options.get("exchange"),
+            "routing_key": options.get("routing_key"),
+        }
 
     def make_source(self, config, schema: ConnectionSchema):
         return RabbitmqSource(config["url"], config["queue"],
                               config.get("schema"), config.get("format"),
-                              config.get("bad_data", "fail"))
+                              config.get("bad_data", "fail"),
+                              prefetch=config.get("prefetch", 100))
 
     def make_sink(self, config, schema: ConnectionSchema):
         return RabbitmqSink(config["url"], config["queue"],
-                            config.get("format"))
+                            config.get("format"),
+                            exchange=config.get("exchange"),
+                            routing_key=config.get("routing_key"))

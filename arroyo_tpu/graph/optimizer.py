@@ -30,6 +30,15 @@ class ChainingOptimizer:
                     continue
                 if len(graph.in_edges(dst.node_id)) != 1:
                     continue
+                # async UDFs need the select loop's operator-future polling
+                # (completions + held-watermark release); source-led chains
+                # run the source loop instead, so never fuse one into them
+                from .logical import OperatorName
+
+                if src.chain[0].operator == OperatorName.CONNECTOR_SOURCE and any(
+                    op.operator == OperatorName.ASYNC_UDF for op in dst.chain
+                ):
+                    continue
                 # don't chain across sinks-with-commit semantics; sinks may
                 # be chained as tail but never have outputs anyway.
                 self._fuse(graph, src, dst, edge)

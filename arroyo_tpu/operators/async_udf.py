@@ -4,16 +4,18 @@ Capability parity with the reference's async_udf.rs
 (/root/reference/crates/arroyo-worker/src/arrow/async_udf.rs): rows fan out
 to concurrent invocations of an async UDF with a bounded in-flight window
 and a timeout; `ordered` mode re-emits rows in input order, `unordered`
-emits as completions arrive. In-flight work drains at watermark/checkpoint
-boundaries so exactly-once state stays simple (the reference persists
-in-flight batches instead; drain-on-barrier trades a latency bubble for a
-much smaller state surface — noted gap).
+emits as completions arrive. In-flight rows persist across checkpoints
+(reference :495 region — state tables for buffered inputs): the barrier
+does NOT drain the operator; un-emitted rows are checkpointed as Arrow IPC
+and re-submitted on restore, so a slow UDF never turns barriers into
+latency spikes. Watermarks still drain (an emitted row must not trail a
+forwarded watermark past it).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -32,9 +34,25 @@ class AsyncUdfOperator(Operator):
         self.out_schema: StreamSchema = config["schema"]
         self.ordered: bool = config.get("ordered", True)
         self.max_concurrency: int = int(config.get("max_concurrency", 64))
+        self.max_in_flight: int = int(config.get("max_in_flight", 256))
         self.timeout: float = float(config.get("timeout", 10.0))
         self._sem: Optional[asyncio.Semaphore] = None
         self._fn = None
+        # seq -> (task, row_vals) for submitted-not-completed rows;
+        # seq -> (row_vals, result) for completed-not-emitted rows
+        self._inflight: Dict[int, Tuple[asyncio.Task, tuple]] = {}
+        self._completed: Dict[int, Tuple[tuple, object]] = {}
+        self._next_seq = 0
+        self._emit_seq = 0  # next seq to emit (ordered mode)
+        self._wake: Optional[asyncio.Event] = None
+        self._in_schema: Optional[pa.Schema] = None
+        self._out_src: Optional[List[Optional[int]]] = None
+        self._held_wm = None  # watermark held until prior rows emit
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"af": global_table("af")}
 
     async def on_start(self, ctx):
         from ..udf.registry import get
@@ -44,59 +62,219 @@ class AsyncUdfOperator(Operator):
             raise ValueError(f"{self.udf_name} is not a registered async UDF")
         self._fn = udf.fn
         self._sem = asyncio.Semaphore(self.max_concurrency)
+        self._wake = asyncio.Event()
+        self._in_schema = ctx.in_schemas[0].schema
+        # output field -> input column index (None = the UDF result)
+        self._out_src = [
+            None if f.name == self.out_field
+            else self._in_schema.names.index(f.name)
+            for f in self.out_schema.schema
+        ]
+        if ctx.table_manager is not None:
+            await self._restore(ctx)
+
+    # -- persistence --------------------------------------------------------
+
+    async def _restore(self, ctx):
+        """Re-submit rows that were in flight at the checkpoint. Rows are
+        deterministically partitioned across the current parallelism by
+        their stored (subtask, seq) identity, so rescales neither drop nor
+        duplicate a row."""
+        table = await ctx.table("af")
+        n = ctx.task_info.parallelism
+        me = ctx.task_info.task_index
+        snaps = list(table.items())
+        # consume-once: drop every snapshot read here (foreign keys
+        # included) so the next epoch's serialize doesn't carry stale
+        # copies that a later restore would re-submit as duplicates
+        for key, _ in snaps:
+            table.delete(key)
+        for _, snap in snaps:
+            if not snap or not snap.get("rows_ipc"):
+                continue
+            table = pa.ipc.open_stream(snap["rows_ipc"]).read_all()
+            cols = [c.to_pylist() for c in table.columns]
+            src = int(snap.get("subtask", 0))
+            for r, seq in enumerate(snap["seqs"]):
+                if hash((src, int(seq))) % n != me:
+                    continue
+                # no collector at on_start: bypass the in-flight cap (the
+                # restored set is itself bounded by the checkpoint cap)
+                await self._submit(
+                    tuple(c[r] for c in cols), enforce_cap=False
+                )
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is None:
+            return
+        rows = [
+            (seq, vals) for seq, (_t, vals) in self._inflight.items()
+        ] + [
+            (seq, vals) for seq, (vals, _r) in self._completed.items()
+        ]
+        rows.sort()
+        table = await ctx.table("af")
+        if not rows:
+            table.put(ctx.task_info.task_index, {})
+            return
+        arrays = [
+            pa.array([vals[i] for _, vals in rows], type=f.type)
+            for i, f in enumerate(self._in_schema)
+        ]
+        batch = pa.RecordBatch.from_arrays(arrays, schema=self._in_schema)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, self._in_schema) as w:
+            w.write_batch(batch)
+        table.put(
+            ctx.task_info.task_index,
+            {
+                "rows_ipc": sink.getvalue().to_pybytes(),
+                "seqs": [seq for seq, _ in rows],
+                "subtask": ctx.task_info.task_index,
+            },
+        )
+
+    # -- submission ---------------------------------------------------------
 
     async def _invoke(self, args):
         async with self._sem:
             return await asyncio.wait_for(self._fn(*args), self.timeout)
 
-    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
-        cols = [
-            batch.column(i).to_pylist() for i in self.arg_cols
-        ]
-        if cols:
-            arg_rows = zip(*cols)
-        else:
-            arg_rows = (() for _ in range(batch.num_rows))
-        tasks = [
-            asyncio.ensure_future(self._invoke(args)) for args in arg_rows
-        ]
-        try:
-            if self.ordered:
-                results = await asyncio.gather(*tasks)
-                await self._emit(batch, list(range(batch.num_rows)), results,
-                                 collector)
-            else:
-                # emit completion micro-batches as they arrive
-                pending = {t: i for i, t in enumerate(tasks)}
-                while pending:
-                    done, _ = await asyncio.wait(
-                        pending.keys(), return_when=asyncio.FIRST_COMPLETED
-                    )
-                    idxs = [pending.pop(t) for t in done]
-                    await self._emit(
-                        batch, idxs, [t.result() for t in done], collector
-                    )
-        except BaseException:
-            # one failed/timed-out call fails the task; reap its siblings
-            # so nothing runs detached past the operator
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-            raise
+    async def _submit(self, row_vals: tuple, collector=None,
+                      enforce_cap: bool = True):
+        while enforce_cap and (
+            len(self._inflight) + len(self._completed) >= self.max_in_flight
+        ):
+            self._reap()
+            if collector is not None:
+                await self._emit_ready(collector)
+            if (
+                len(self._inflight) + len(self._completed)
+                < self.max_in_flight
+            ):
+                break
+            # still full: an un-emittable ordered gap implies its seq is in
+            # flight, so a completion (-> wake) is guaranteed to come
+            await self._wake.wait()
+        seq = self._next_seq
+        self._next_seq += 1
+        args = tuple(row_vals[i] for i in self.arg_cols)
+        task = asyncio.ensure_future(self._invoke(args))
+        task.add_done_callback(lambda _t: self._wake.set())
+        self._inflight[seq] = (task, row_vals)
 
-    async def _emit(self, batch, row_idxs, results, collector):
-        if not row_idxs:
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        cols = [c.to_pylist() for c in batch.columns]
+        for r in range(batch.num_rows):
+            await self._submit(tuple(c[r] for c in cols), collector)
+        # opportunistic reap so source-chained deployments (no select-loop
+        # future polling) still emit between watermarks
+        self._reap()
+        await self._emit_ready(collector)
+        await self._maybe_release_watermark(ctx, collector)
+
+    # -- completion ---------------------------------------------------------
+
+    def _reap(self):
+        """Move finished tasks to the completed buffer; a failed/timed-out
+        call raises here and fails the task."""
+        done = [
+            (seq, t, vals)
+            for seq, (t, vals) in self._inflight.items()
+            if t.done()
+        ]
+        for seq, t, vals in done:
+            del self._inflight[seq]
+            self._completed[seq] = (vals, t.result())
+        if not any(t.done() for t, _ in self._inflight.values()):
+            self._wake.clear()
+
+    def future_to_poll(self):
+        if self._inflight or self._completed:
+            return self._wake.wait()
+        return None
+
+    async def handle_future_result(self, ctx, collector):
+        self._reap()
+        await self._emit_ready(collector)
+        await self._maybe_release_watermark(ctx, collector)
+
+    async def _emit_ready(self, collector):
+        if self.ordered:
+            ready: List[int] = []
+            while self._emit_seq in self._completed:
+                ready.append(self._emit_seq)
+                self._emit_seq += 1
+        else:
+            ready = sorted(self._completed)
+            self._emit_seq = self._next_seq
+        if not ready:
             return
-        sel = batch.take(pa.array(row_idxs))
+        rows = [self._completed.pop(s) for s in ready]
         arrays = []
-        for f in self.out_schema.schema:
-            if f.name == self.out_field:
-                arrays.append(pa.array(results, type=f.type))
+        for f, src in zip(self.out_schema.schema, self._out_src):
+            if src is None:
+                arrays.append(
+                    pa.array([r for _, r in rows], type=f.type)
+                )
             else:
-                arrays.append(sel.column(sel.schema.names.index(f.name)))
+                arrays.append(
+                    pa.array([vals[src] for vals, _ in rows], type=f.type)
+                )
         await collector.collect(
             pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
         )
+
+    async def _drain(self, collector):
+        while self._inflight:
+            await self._wake.wait()
+            self._reap()
+            await self._emit_ready(collector)
+        await self._emit_ready(collector)
+
+    # -- boundaries ---------------------------------------------------------
+
+    async def handle_watermark(self, watermark, ctx, collector):
+        # an async result must not arrive after its watermark passed
+        # downstream. Instead of draining (which serializes the pipeline
+        # at every watermark), HOLD the watermark with the current seq
+        # frontier and release it from the completion path once every row
+        # submitted before it has emitted (improves on the reference's
+        # drain in async_udf.rs). Under continuous input only rows BEFORE
+        # the frontier gate the release, so the watermark still advances.
+        if not self._inflight and not self._completed:
+            return watermark
+        # overwriting an un-released earlier watermark is fine: watermarks
+        # are monotone lower bounds, skipping intermediates is legal
+        self._held_wm = (watermark, self._next_seq)
+        return None
+
+    def _frontier_clear(self, frontier: int) -> bool:
+        return not any(
+            seq < frontier for seq in self._inflight
+        ) and not any(seq < frontier for seq in self._completed)
+
+    async def _maybe_release_watermark(self, ctx, collector):
+        held = self._held_wm
+        if held is None or not self._frontier_clear(held[1]):
+            return
+        self._held_wm = None
+        runner = getattr(ctx, "_runner", None)
+        if runner is not None and self in runner.ops:
+            await runner._chain_watermark(runner.ops.index(self) + 1, held[0])
+
+    async def on_close(self, ctx, collector, is_eod: bool):
+        if is_eod:
+            await self._drain(collector)
+            held, self._held_wm = self._held_wm, None
+            return held[0] if held else None
+        for t, _ in self._inflight.values():
+            t.cancel()
+        await asyncio.gather(
+            *(t for t, _ in self._inflight.values()),
+            return_exceptions=True,
+        )
+        return None
 
 
 @register_operator(OperatorName.ASYNC_UDF)

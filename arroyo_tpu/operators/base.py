@@ -70,6 +70,16 @@ class Operator:
     def tick_interval(self) -> Optional[float]:
         return None
 
+    def future_to_poll(self):
+        """Operator-owned async work (reference operator.rs future_to_poll):
+        return an awaitable the runner selects on alongside the inputs, or
+        None when idle. When it resolves, the runner calls
+        handle_future_result and re-queries."""
+        return None
+
+    async def handle_future_result(self, ctx: OperatorContext, collector):
+        """Called when the awaitable from future_to_poll resolved."""
+
     async def on_close(
         self, ctx: OperatorContext, collector, is_eod: bool
     ) -> Optional[Watermark]:

@@ -226,10 +226,25 @@ class SubtaskRunner:
                 t = asyncio.ensure_future(asyncio.sleep(tick_interval))
                 pending[t] = "tick"
 
+        # operator-owned futures (async UDF completions etc., reference
+        # operator.rs future_to_poll): re-queried whenever un-armed, since
+        # processing a batch may create new pollable work
+        op_futs: Dict[int, asyncio.Task] = {}
+
+        def arm_op_futures():
+            for idx, op in enumerate(self.ops):
+                if idx not in op_futs:
+                    f = op.future_to_poll()
+                    if f is not None:
+                        t = asyncio.ensure_future(f)
+                        op_futs[idx] = t
+                        pending[t] = ("opfut", idx)
+
         for i in range(len(self.inputs)):
             arm_input(i)
         arm_control()
         arm_tick()
+        arm_op_futures()
 
         while not self._all_inputs_finished() and not self._stopping:
             done, _ = await asyncio.wait(
@@ -246,6 +261,12 @@ class SubtaskRunner:
                         if op.tick_interval():
                             await op.handle_tick(tick_count, ctx, coll)
                     arm_tick()
+                elif isinstance(tag, tuple) and tag[0] == "opfut":
+                    idx = tag[1]
+                    op_futs.pop(idx, None)
+                    await self.ops[idx].handle_future_result(
+                        self.ctxs[idx], self.collectors[idx]
+                    )
                 else:
                     i: int = tag  # input index
                     try:
@@ -267,6 +288,7 @@ class SubtaskRunner:
                                 iq.blocked = False
                                 if not iq.finished:
                                     arm_input(j)
+            arm_op_futures()
         for t in pending:
             t.cancel()
         # end-of-data only when every input actually delivered EOS — an

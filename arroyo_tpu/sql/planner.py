@@ -100,6 +100,11 @@ class TableDef:
     name: str
     fields: List[pa.Field]
     options: Dict[str, str]
+    # col name -> connector metadata key (DDL `METADATA FROM 'key'`,
+    # reference MetadataDef / SourceMetadataVisitor)
+    metadata_fields: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # col name -> virtual-column expression (`GENERATED ALWAYS AS (expr)`)
+    generated: Dict[str, Expr] = dataclasses.field(default_factory=dict)
 
     @property
     def connector(self) -> str:
@@ -367,7 +372,53 @@ class Planner:
             "proto_descriptor": _proto_descriptor(t),
             **options,
         }
+        if t.metadata_fields:
+            config["metadata_fields"] = dict(t.metadata_fields)
         chain = [ChainedOp(OperatorName.CONNECTOR_SOURCE, config, t.name)]
+        # virtual columns (GENERATED ALWAYS AS): computed right after
+        # deserialization so event-time/watermark can reference them
+        if t.generated:
+            for col, gexpr in t.generated.items():
+                for other in t.generated:
+                    if other != col and _expr_references(gexpr, other):
+                        raise SqlError(
+                            f"generated column {col} references generated "
+                            f"column {other}; generated columns may only "
+                            "reference payload columns"
+                        )
+            scope = Scope.from_schema(source_schema.schema)
+            gen_exprs: List[BoundExpr] = []
+            for i, f in enumerate(source_schema.schema):
+                if f.name == TIMESTAMP_FIELD:
+                    continue
+                if f.name in t.generated:
+                    gen_exprs.append(bind(t.generated[f.name], scope))
+                else:
+                    gen_exprs.append(
+                        BoundExpr(
+                            (lambda j: lambda b: b.column(j))(i),
+                            f.type, f.name,
+                        )
+                    )
+            ts_i = source_schema.timestamp_index
+            gen_exprs.append(
+                BoundExpr(
+                    (lambda j: lambda b: b.column(j))(ts_i),
+                    pa.timestamp("ns"), TIMESTAMP_FIELD,
+                )
+            )
+            chain.append(
+                ChainedOp(
+                    OperatorName.PROJECTION,
+                    {
+                        "py_fn": CompiledProjection(
+                            gen_exprs, source_schema.schema, None
+                        ),
+                        "schema": source_schema,
+                    },
+                    "generated_columns",
+                )
+            )
         # event-time rewrite: _timestamp = event_time_field (reference
         # SourceRewriter, rewriters.rs)
         if event_time_field:
@@ -2228,6 +2279,12 @@ def _contains_unnest(e: Expr) -> bool:
     return any(_contains_unnest(c) for c in _expr_children(e))
 
 
+def _expr_references(e: Expr, col_name: str) -> bool:
+    if isinstance(e, Column) and e.name.lower() == col_name.lower():
+        return True
+    return any(_expr_references(c, col_name) for c in _expr_children(e))
+
+
 def _find_item_by_alias(items: List[SelectItem], name: str):
     for it in items:
         if it.alias == name:
@@ -2313,7 +2370,17 @@ def plan_query(
                 pa.field(c.name, sql_type_to_arrow(c.type_name), c.nullable)
                 for c in st.columns
             ]
-            provider.add_table(TableDef(st.name, fields, st.options))
+            provider.add_table(TableDef(
+                st.name, fields, st.options,
+                metadata_fields={
+                    c.name: c.metadata_key for c in st.columns
+                    if c.metadata_key
+                },
+                generated={
+                    c.name: c.generated for c in st.columns
+                    if c.generated is not None
+                },
+            ))
         elif isinstance(st, CreateView):
             provider.add_view(st.name, st.query)
         elif isinstance(st, Insert):

@@ -234,6 +234,7 @@ class FakeKinesisStream:
             f"shardId-{i:012d}": [] for i in range(shards)
         }
         self.closed_shards: set = set()
+        self.parents: Dict[str, str] = {}  # child -> parent shard id
 
     def put(self, shard_id: str, data: bytes):
         self.shards[shard_id].append(data)
@@ -255,6 +256,7 @@ class FakeKinesisStream:
         self.closed_shards.add(shard_id)
         for n in new_ids:
             self.shards.setdefault(n, [])
+            self.parents[n] = shard_id
 
 
 class _FakeKinesisClient:
@@ -262,9 +264,17 @@ class _FakeKinesisClient:
         self.stream = stream
 
     def list_shards(self, StreamName=None):
-        return {
-            "Shards": [{"ShardId": s} for s in sorted(self.stream.shards)]
-        }
+        out = []
+        for s in sorted(self.stream.shards):
+            d = {"ShardId": s, "SequenceNumberRange": {}}
+            if s in self.stream.parents:
+                d["ParentShardId"] = self.stream.parents[s]
+            if s in self.stream.closed_shards:
+                d["SequenceNumberRange"]["EndingSequenceNumber"] = str(
+                    len(self.stream.shards[s])
+                )
+            out.append(d)
+        return {"Shards": out}
 
     def get_shard_iterator(self, StreamName=None, ShardId=None,
                            ShardIteratorType="TRIM_HORIZON",
@@ -413,3 +423,250 @@ class _FakeNatsConn:
 
     async def close(self):
         pass
+
+
+class FakeMqttBroker:
+    """In-memory broker emulating the aiomqtt surface the connector uses:
+    async-context Client, subscribe, a messages iterator, publish capture,
+    MqttError-driven disconnects, and durable-session resume (delivery
+    position kept per client_id when clean_session=False)."""
+
+    def __init__(self):
+        self.queue: List[tuple] = []  # (topic, payload, qos) to deliver
+        self.published: List[tuple] = []  # sink capture: (topic, payload, qos, retain)
+        self.sessions: Dict[str, int] = {}  # client_id -> delivered pos
+        self.drop_after: Optional[int] = None  # raise MqttError after N deliveries
+        self.stop_at: Optional[int] = None  # StopAsyncIteration bound (tests)
+        self.connects = 0
+
+    def preload(self, topic: str, payloads: List[bytes], qos: int = 1):
+        for p in payloads:
+            self.queue.append((topic, p, qos))
+
+    def module(self):
+        broker = self
+
+        class MqttError(Exception):
+            pass
+
+        class _Module:
+            pass
+
+        def Client(url, identifier=None, clean_session=True, username=None,
+                   password=None):
+            return _FakeMqttClient(broker, identifier, clean_session,
+                                   MqttError)
+
+        _Module.MqttError = MqttError
+        _Module.Client = staticmethod(Client)
+        return _Module
+
+
+class _FakeMqttTopic:
+    def __init__(self, value):
+        self.value = value
+
+    def __str__(self):
+        return self.value
+
+
+class _FakeMqttMessage:
+    def __init__(self, topic, payload, qos):
+        self.topic = _FakeMqttTopic(topic)
+        self.payload = payload
+        self.qos = qos
+        self.retain = False
+
+
+class _FakeMqttClient:
+    def __init__(self, broker, client_id, clean_session, err_cls):
+        self.broker = broker
+        self.client_id = client_id
+        self.clean_session = clean_session
+        self.err_cls = err_cls
+        self.delivered = 0
+
+    async def __aenter__(self):
+        self.broker.connects += 1
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    async def subscribe(self, topic, qos=0):
+        self.topic = topic
+        if self.client_id and not self.clean_session:
+            self.pos = self.broker.sessions.get(self.client_id, 0)
+        else:
+            self.pos = 0
+
+    async def publish(self, topic, payload, qos=0, retain=False):
+        self.broker.published.append((topic, payload, qos, retain))
+
+    @property
+    def messages(self):
+        client = self
+
+        class _Iter:
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                import asyncio
+
+                b = client.broker
+                while True:
+                    if (
+                        b.drop_after is not None
+                        and client.delivered >= b.drop_after
+                    ):
+                        b.drop_after = None
+                        raise client.err_cls("connection lost")
+                    if b.stop_at is not None and client.pos >= b.stop_at:
+                        raise StopAsyncIteration
+                    if client.pos < len(b.queue):
+                        topic, payload, qos = b.queue[client.pos]
+                        client.pos += 1
+                        client.delivered += 1
+                        if client.client_id and not client.clean_session:
+                            b.sessions[client.client_id] = client.pos
+                        return _FakeMqttMessage(topic, payload, qos)
+                    await asyncio.sleep(0.005)
+
+        return _Iter()
+
+
+class FakeRabbit:
+    """aio-pika surface subset: robust connection, channel with qos,
+    durable queue with an async iterator, default/named exchange publish
+    capture, message.process() ack tracking."""
+
+    def __init__(self):
+        self.queue_msgs: List[bytes] = []
+        self.published: List[tuple] = []  # (exchange, routing_key, body)
+        self.acked = 0
+        self.prefetch = None
+        self.stop_at: Optional[int] = None
+
+    def module(self):
+        rabbit = self
+
+        class _Msg:
+            def __init__(self, body, delivery_mode=None):
+                self.body = body
+                self.delivery_mode = delivery_mode
+
+        class _DeliveryMode:
+            PERSISTENT = 2
+
+        class _Module:
+            Message = _Msg
+            DeliveryMode = _DeliveryMode
+
+            @staticmethod
+            async def connect_robust(url):
+                return _FakeRabbitConn(rabbit, _Msg)
+
+        return _Module
+
+
+class _FakeRabbitConn:
+    def __init__(self, rabbit, msg_cls):
+        self.rabbit = rabbit
+        self.msg_cls = msg_cls
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    async def close(self):
+        pass
+
+    async def channel(self):
+        return _FakeRabbitChannel(self.rabbit)
+
+
+class _FakeRabbitChannel:
+    def __init__(self, rabbit):
+        self.rabbit = rabbit
+        self.default_exchange = _FakeExchange(rabbit, "")
+
+    async def set_qos(self, prefetch_count=None):
+        self.rabbit.prefetch = prefetch_count
+
+    async def get_exchange(self, name):
+        return _FakeExchange(self.rabbit, name)
+
+    async def declare_queue(self, name, durable=False):
+        return _FakeRabbitQueue(self.rabbit)
+
+
+class _FakeExchange:
+    def __init__(self, rabbit, name):
+        self.rabbit = rabbit
+        self.name = name
+
+    async def publish(self, msg, routing_key=None):
+        self.rabbit.published.append((self.name, routing_key, msg.body))
+
+
+class _FakeIncoming:
+    def __init__(self, rabbit, body):
+        self.rabbit = rabbit
+        self.body = body
+
+    async def ack(self):
+        self.rabbit.acked += 1
+
+    def process(self):
+        incoming = self
+
+        class _Ctx:
+            async def __aenter__(self):
+                return incoming
+
+            async def __aexit__(self, *exc):
+                incoming.rabbit.acked += 1
+                return False
+
+        return _Ctx()
+
+
+class _FakeRabbitQueue:
+    def __init__(self, rabbit):
+        self.rabbit = rabbit
+
+    def iterator(self):
+        rabbit = self.rabbit
+
+        class _It:
+            def __init__(self):
+                self.pos = 0
+
+            async def __aenter__(self):
+                return self
+
+            async def __aexit__(self, *exc):
+                return False
+
+            def __aiter__(self):
+                return self
+
+            async def __anext__(self):
+                import asyncio
+
+                while True:
+                    if (
+                        rabbit.stop_at is not None
+                        and self.pos >= rabbit.stop_at
+                    ):
+                        raise StopAsyncIteration
+                    if self.pos < len(rabbit.queue_msgs):
+                        body = rabbit.queue_msgs[self.pos]
+                        self.pos += 1
+                        return _FakeIncoming(rabbit, body)
+                    await asyncio.sleep(0.005)
+
+        return _It()

@@ -1,0 +1,25 @@
+--udf=udfs.py
+CREATE TABLE impulse (
+  timestamp TIMESTAMP,
+  counter BIGINT UNSIGNED NOT NULL,
+  subtask_index BIGINT UNSIGNED NOT NULL
+) WITH (
+  connector = 'single_file',
+  path = '$input_dir/impulse.json',
+  format = 'json',
+  type = 'source',
+  event_time_field = 'timestamp'
+);
+CREATE TABLE out (start TIMESTAMP, total BIGINT, n BIGINT) WITH (
+  connector = 'single_file',
+  path = '$output_path',
+  format = 'json',
+  type = 'sink'
+);
+INSERT INTO out
+SELECT window.start, total, n FROM (
+  SELECT tumble(interval '20 second') as window,
+         sum(d) as total, count(*) as n
+  FROM (SELECT async_double_negative(counter) as d FROM impulse)
+  GROUP BY 1
+);

@@ -260,3 +260,200 @@ def test_nats_jetstream_durable_resume(tmp_path, monkeypatch):
         for l in open(tmp_path / "out.json") if l.strip()
     )
     assert rows == list(range(40)), f"{len(rows)} rows after resume"
+
+
+def test_kafka_metadata_and_generated_columns(kafka_broker):
+    """DDL `METADATA FROM 'key'` columns populate from the consumer
+    (reference kafka metadata_defs, kafka/mod.rs:325) and GENERATED
+    ALWAYS AS virtual columns compute after deserialization."""
+    _preload(kafka_broker, "in", [{"n": i} for i in range(10)])
+    sql = """
+    CREATE TABLE src (
+      n BIGINT,
+      off BIGINT METADATA FROM 'offset_id',
+      part INT METADATA FROM 'partition',
+      top TEXT METADATA FROM 'topic',
+      n2 BIGINT GENERATED ALWAYS AS (n * 2 + 1)
+    ) WITH (
+      connector = 'kafka', bootstrap_servers = 'fake:9092', topic = 'in',
+      type = 'source', format = 'json', source.offset = 'earliest'
+    );
+    SELECT n, off, part, top, n2 FROM src;
+    """
+    rows = []
+
+    async def go():
+        plan = plan_query(sql, parallelism=1, preview_results=rows)
+        eng = Engine(plan.graph).start()
+        for _ in range(400):
+            await asyncio.sleep(0.01)
+            if len(rows) >= 10:
+                break
+        await eng.stop()
+        await eng.join(30)
+
+    asyncio.run(go())
+    assert len(rows) == 10
+    by_n = {r["n"]: r for r in rows}
+    # rows preloaded round-robin over 2 partitions: n's partition = n % 2,
+    # its offset within the partition = n // 2
+    for n, r in by_n.items():
+        assert r["part"] == n % 2
+        assert r["off"] == n // 2
+        assert r["top"] == "in"
+        assert r["n2"] == n * 2 + 1
+
+
+@pytest.fixture()
+def mqtt_broker(monkeypatch):
+    from fake_clients import FakeMqttBroker
+
+    broker = FakeMqttBroker()
+    import arroyo_tpu.connectors.mqtt as mmod
+
+    monkeypatch.setattr(
+        mmod, "require_client", lambda *names: broker.module()
+    )
+    return broker
+
+
+def test_mqtt_session_resume_and_metadata(mqtt_broker):
+    """A dropped connection reconnects with backoff; a durable session
+    (client_id + clean_session=false) resumes delivery where it left off;
+    METADATA FROM 'topic' columns populate."""
+    mqtt_broker.preload("sensors/a", [
+        json.dumps({"n": i}).encode() for i in range(6)
+    ])
+    mqtt_broker.drop_after = 3  # connection dies after 3 deliveries
+    mqtt_broker.stop_at = 6
+    sql = """
+    CREATE TABLE src (
+      n BIGINT,
+      top TEXT METADATA FROM 'topic'
+    ) WITH (
+      connector = 'mqtt', url = 'mqtt://fake', topic = 'sensors/#',
+      qos = '1', client_id = 'arroyo-test', type = 'source',
+      format = 'json'
+    );
+    SELECT n, top FROM src;
+    """
+    rows = []
+
+    async def go():
+        plan = plan_query(sql, parallelism=1, preview_results=rows)
+        eng = Engine(plan.graph).start()
+        await eng.join(30)
+
+    asyncio.run(go())
+    assert sorted(r["n"] for r in rows) == list(range(6))
+    assert all(r["top"] == "sensors/a" for r in rows)
+    assert mqtt_broker.connects == 2  # one reconnect after the drop
+
+
+def test_mqtt_sink_publishes_with_qos_and_retain(mqtt_broker):
+    sql = """
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '1000', message_count = '5',
+      start_time = '0'
+    );
+    CREATE TABLE out (counter BIGINT) WITH (
+      connector = 'mqtt', url = 'mqtt://fake', topic = 'out/t',
+      qos = '1', retain = 'true', type = 'sink', format = 'json'
+    );
+    INSERT INTO out SELECT counter FROM impulse;
+    """
+
+    async def go():
+        plan = plan_query(sql, parallelism=1)
+        eng = Engine(plan.graph).start()
+        await eng.join(30)
+
+    asyncio.run(go())
+    assert len(mqtt_broker.published) == 5
+    assert all(
+        t == "out/t" and qos == 1 and retain
+        for t, _p, qos, retain in mqtt_broker.published
+    )
+    vals = sorted(
+        json.loads(p)["counter"] for _t, p, _q, _r in mqtt_broker.published
+    )
+    assert vals == list(range(5))
+
+
+def test_kinesis_resharding_children_resume(tmp_path, monkeypatch):
+    """A split closes the parent shard; its children are discovered on
+    re-list, replay from TRIM_HORIZON, and drain to completion — no
+    records lost across the reshard."""
+    stream = FakeKinesisStream(shards=1)
+    monkeypatch.setitem(sys.modules, "boto3", stream.boto3())
+    parent = "shardId-000000000000"
+    for i in range(20):
+        stream.put(parent, json.dumps({"n": i}).encode())
+    # reshard: parent -> two children, each with post-split records
+    stream.split_shard(parent, ["shardId-000000000100",
+                                "shardId-000000000101"])
+    for i in range(20, 30):
+        stream.put(f"shardId-0000000001{i % 2:02d}",
+                   json.dumps({"n": i}).encode())
+    # close the children too so the source finishes
+    stream.split_shard("shardId-000000000100", [])
+    stream.split_shard("shardId-000000000101", [])
+    sql = """
+    CREATE TABLE src (n BIGINT) WITH (
+      connector = 'kinesis', stream_name = 'in',
+      source.init_position = 'earliest', type = 'source', format = 'json'
+    );
+    SELECT n FROM src;
+    """
+    rows = []
+
+    async def go():
+        plan = plan_query(sql, parallelism=1, preview_results=rows)
+        eng = Engine(plan.graph).start()
+        await eng.join(60)
+
+    asyncio.run(go())
+    assert sorted(r["n"] for r in rows) == list(range(30))
+
+
+@pytest.fixture()
+def rabbit(monkeypatch):
+    from fake_clients import FakeRabbit
+
+    r = FakeRabbit()
+    import arroyo_tpu.connectors.rabbitmq as rmod
+
+    monkeypatch.setattr(rmod, "require_client", lambda *n: r.module())
+    return r
+
+
+def test_rabbitmq_source_acks_and_sink_publishes(rabbit):
+    """The source sets consumer prefetch, acks each message after its
+    rows are buffered, and the sink publishes persistent messages with
+    the configured routing key."""
+    rabbit.queue_msgs = [json.dumps({"n": i}).encode() for i in range(8)]
+    rabbit.stop_at = 8
+    sql = """
+    CREATE TABLE src (n BIGINT) WITH (
+      connector = 'rabbitmq', url = 'amqp://fake', queue = 'in',
+      prefetch = '17', type = 'source', format = 'json'
+    );
+    CREATE TABLE dst (n BIGINT) WITH (
+      connector = 'rabbitmq', url = 'amqp://fake', queue = 'out',
+      routing_key = 'out.rk', type = 'sink', format = 'json'
+    );
+    INSERT INTO dst SELECT n * 3 as n FROM src;
+    """
+
+    async def go():
+        plan = plan_query(sql, parallelism=1)
+        eng = Engine(plan.graph).start()
+        await eng.join(30)
+
+    asyncio.run(go())
+    assert rabbit.prefetch == 17
+    assert rabbit.acked == 8
+    assert len(rabbit.published) == 8
+    assert all(rk == "out.rk" for _e, rk, _b in rabbit.published)
+    vals = sorted(json.loads(b)["n"] for _e, _rk, b in rabbit.published)
+    assert vals == [i * 3 for i in range(8)]

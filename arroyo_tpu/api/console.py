@@ -1,148 +1,46 @@
-"""Minimal web console served at /console.
+"""Web console served at /console.
 
-A single-page stand-in for the reference's React webui
-(/root/reference/webui): pipeline list with states, SQL editor with
-validate/submit/preview, and the plan graph. Talks to the same /api/v1
-the full UI would.
-"""
+A hash-routed single-page app mirroring the reference's React webui
+(/root/reference/webui, router.tsx routes): pipelines list/detail with
+DAG visualization, live per-operator metric graphs, checkpoint inspector
+and error tail, a SQL editor with validate/preview/create, a connections
+wizard generated from connector config_schema metadata, and a UDF
+editor. Static assets live in arroyo_tpu/api/static/ and are served by
+the API process — no build step, no framework."""
 
-PAGE = """<!doctype html>
-<html>
-<head>
-<meta charset="utf-8">
-<title>arroyo-tpu console</title>
-<style>
-  body { font-family: ui-monospace, Menlo, monospace; margin: 0;
-         background: #0d1117; color: #e6edf3; }
-  header { padding: 12px 20px; background: #161b22;
-           border-bottom: 1px solid #30363d; font-weight: bold; }
-  main { display: grid; grid-template-columns: 1fr 1fr; gap: 16px;
-         padding: 16px; }
-  section { background: #161b22; border: 1px solid #30363d;
-            border-radius: 6px; padding: 12px; }
-  h2 { font-size: 13px; text-transform: uppercase; color: #7d8590;
-       margin: 0 0 8px; }
-  textarea { width: 100%; height: 220px; background: #0d1117;
-             color: #e6edf3; border: 1px solid #30363d; border-radius: 4px;
-             font-family: inherit; font-size: 12px; padding: 8px;
-             box-sizing: border-box; }
-  button { background: #238636; color: white; border: 0; border-radius: 4px;
-           padding: 6px 14px; margin: 6px 6px 0 0; cursor: pointer; }
-  button.alt { background: #1f6feb; }
-  table { width: 100%; border-collapse: collapse; font-size: 12px; }
-  td, th { text-align: left; padding: 4px 8px;
-           border-bottom: 1px solid #21262d; }
-  pre { background: #0d1117; border: 1px solid #30363d; border-radius: 4px;
-        padding: 8px; font-size: 11px; overflow: auto; max-height: 260px; }
-  .state-Running { color: #3fb950; } .state-Finished { color: #58a6ff; }
-  .state-Failed { color: #f85149; } .state-Stopped { color: #d29922; }
-</style>
-</head>
-<body>
-<header>arroyo-tpu &mdash; streaming SQL on TPUs</header>
-<main>
-  <section>
-    <h2>New pipeline</h2>
-    <textarea id="sql">CREATE TABLE impulse WITH (
-  connector = 'impulse', event_rate = '100000',
-  message_count = '100000', start_time = '0'
-);
-SELECT counter % 10 as k, tumble(interval '100 millisecond') as w,
-       count(*) as cnt
-FROM impulse GROUP BY 1, 2;</textarea>
-    <div>
-      <button onclick="validateQ()">Validate</button>
-      <button class="alt" onclick="preview()">Preview</button>
-      <button onclick="submit()">Create pipeline</button>
-    </div>
-    <pre id="result">&nbsp;</pre>
-  </section>
-  <section>
-    <h2>Pipelines</h2>
-    <table id="pipelines"><tr><th>id</th><th>name</th><th>state</th>
-      <th>actions</th></tr></table>
-    <h2 style="margin-top:14px">Plan</h2>
-    <pre id="plan">&nbsp;</pre>
-  </section>
-</main>
-<script>
-const api = p => '/api/v1' + p;
-const esc = s => String(s).replace(/[&<>"']/g,
-    c => '&#' + c.charCodeAt(0) + ';');
-const out = (id, v) => document.getElementById(id).textContent =
-    typeof v === 'string' ? v : JSON.stringify(v, null, 2);
-async function post(p, body) {
-  const r = await fetch(api(p), {method: 'POST',
-    headers: {'Content-Type': 'application/json'},
-    body: JSON.stringify(body)});
-  return r.json();
+import os
+
+STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "static")
+
+_CONTENT_TYPES = {
+    ".html": "text/html",
+    ".css": "text/css",
+    ".js": "application/javascript",
+    ".svg": "image/svg+xml",
 }
-async function validateQ() {
-  const v = await post('/pipelines/validate_query',
-                       {query: document.getElementById('sql').value});
-  out('result', v.errors && v.errors.length ? v.errors : 'valid');
-  if (v.graph) out('plan', v.graph.nodes.map(n =>
-      `#${n.node_id} ${n.operator} (p=${n.parallelism})`).join('\\n'));
-}
-async function preview() {
-  out('result', 'previewing...');
-  const p = await post('/pipelines/preview',
-                       {query: document.getElementById('sql').value});
-  if (p.error) { out('result', p.error); return; }
-  for (let i = 0; i < 120; i++) {
-    const o = await (await fetch(
-        api(`/pipelines/preview/${p.id}/output`))).json();
-    out('result', o.rows.slice(-40));
-    if (o.done) { if (o.error) out('result', o.error); break; }
-    await new Promise(r => setTimeout(r, 500));
-  }
-  refresh();
-}
-async function submit() {
-  const p = await post('/pipelines',
-                       {name: 'console', query:
-                        document.getElementById('sql').value});
-  out('result', p);
-  refresh();
-}
-async function stop(id) {
-  await fetch(api(`/pipelines/${id}`), {method: 'PATCH',
-    headers: {'Content-Type': 'application/json'},
-    body: JSON.stringify({stop: 'checkpoint'})});
-  refresh();
-}
-async function del(id) {
-  await fetch(api(`/pipelines/${id}`), {method: 'DELETE'});
-  refresh();
-}
-async function refresh() {
-  const d = await (await fetch(api('/pipelines'))).json();
-  const t = document.getElementById('pipelines');
-  t.innerHTML = '<tr><th>id</th><th>name</th><th>state</th>' +
-                '<th>actions</th></tr>';
-  for (const p of d.data) {
-    const tr = document.createElement('tr');
-    const id = esc(p.id);
-    tr.innerHTML = `<td>${id}</td><td>${esc(p.name)}</td>` +
-      `<td class="state-${esc(p.state)}">${esc(p.state)}</td>` +
-      `<td><a href="#" onclick="stop('${id}')">stop</a> ` +
-      `<a href="#" onclick="del('${id}')">delete</a></td>`;
-    t.appendChild(tr);
-  }
-}
-refresh();
-setInterval(refresh, 3000);
-</script>
-</body>
-</html>
-"""
 
 
 def add_console_routes(app):
     from aiohttp import web
 
-    async def console(request):
-        return web.Response(text=PAGE, content_type="text/html")
+    def serve(filename):
+        path = os.path.join(STATIC_DIR, filename)
+        ext = os.path.splitext(filename)[1]
 
-    app.router.add_get("/console", console)
-    app.router.add_get("/", console)
+        async def handler(request):
+            with open(path, "r", encoding="utf-8") as f:
+                return web.Response(
+                    text=f.read(),
+                    content_type=_CONTENT_TYPES.get(ext, "text/plain"),
+                )
+
+        return handler
+
+    index = serve("index.html")
+    app.router.add_get("/", index)
+    app.router.add_get("/console", index)
+    app.router.add_get("/console/", index)
+    for name in os.listdir(STATIC_DIR):
+        if name != "index.html":
+            app.router.add_get(f"/console/{name}", serve(name))

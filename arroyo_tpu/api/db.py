@@ -1,10 +1,13 @@
-"""SQLite persistence for the REST API.
+"""SQLite/Postgres persistence for the REST API.
 
 Capability parity with the reference's database layer
 (/root/reference/crates/arroyo-api: cornucopia-generated queries over
 Postgres, parallel SQLite migrations for `arroyo run`): pipelines, jobs,
-udfs, connection profiles/tables. SQLite only in this build (the reference
-also speaks Postgres); the schema mirrors the reference's logical model.
+udfs, connection profiles/tables. Backend selection mirrors the
+reference (`database.backend: sqlite | postgres`): SQLite is the
+embedded/`run` path; Postgres (via psycopg 3 or psycopg2, whichever is
+installed) is the shared-cluster path — one DDL, one query set, a thin
+placeholder/row adapter bridging the two DBAPI dialects.
 
 With `remote_url` set (reference MaybeLocalDb, crates/arroyo run.rs:
 remote state dirs sync the sqlite file through object storage), the db
@@ -79,11 +82,101 @@ MIGRATIONS = [
 ]
 
 
+class _PgCursor:
+    """Cursor facade: dict rows regardless of driver flavor."""
+
+    def __init__(self, cur):
+        self._cur = cur
+
+    def _row(self, r):
+        if r is None or isinstance(r, dict):
+            return r
+        # psycopg2 without RealDictCursor: zip against the description
+        return {
+            d[0]: v for d, v in zip(self._cur.description, r)
+        }
+
+    def fetchone(self):
+        return self._row(self._cur.fetchone())
+
+    def fetchall(self):
+        return [self._row(r) for r in self._cur.fetchall()]
+
+
+class _PgConn:
+    """Adapter giving a Postgres DBAPI connection the sqlite3 surface
+    ApiDb uses: `?` placeholders, dict rows, total_changes."""
+
+    def __init__(self, raw):
+        self.raw = raw
+        self.total_changes = 0
+
+    def execute(self, sql, params=()):
+        cur = self.raw.cursor()
+        try:
+            cur.execute(sql.replace("?", "%s"), tuple(params))
+        except Exception:
+            # a failed statement aborts the postgres transaction; without
+            # a rollback every later query raises InFailedSqlTransaction
+            # and one bad request wedges the whole API
+            self.raw.rollback()
+            raise
+        if not sql.lstrip().upper().startswith(("SELECT", "CREATE")):
+            self.total_changes += max(cur.rowcount, 0)
+        return _PgCursor(cur)
+
+    def commit(self):
+        self.raw.commit()
+
+
+def connect_postgres(dsn: str) -> _PgConn:
+    """psycopg (3) preferred, psycopg2 fallback; loud gated error when
+    neither is installed (parity note: the reference links tokio-postgres
+    unconditionally; this build treats the driver as optional)."""
+    try:
+        import psycopg
+        from psycopg.rows import dict_row
+
+        return _PgConn(psycopg.connect(dsn, row_factory=dict_row))
+    except ImportError:
+        pass
+    try:
+        import psycopg2
+        import psycopg2.extras
+
+        return _PgConn(
+            psycopg2.connect(
+                dsn, cursor_factory=psycopg2.extras.RealDictCursor
+            )
+        )
+    except ImportError:
+        raise RuntimeError(
+            "database.backend = postgres requires psycopg (3) or "
+            "psycopg2, neither of which is installed; use the sqlite "
+            "backend or install a driver"
+        )
+
+
 class ApiDb:
     REMOTE_KEY = "api/arroyo.db"
 
     def __init__(self, path: str = ":memory:",
-                 remote_url: Optional[str] = None):
+                 remote_url: Optional[str] = None,
+                 backend: str = "sqlite",
+                 dsn: str = "",
+                 _pg_conn=None):
+        self.backend = backend
+        if backend == "postgres" or _pg_conn is not None:
+            self.backend = "postgres"
+            self.remote = None
+            self.path = None
+            self.conn = _pg_conn if _pg_conn is not None else (
+                connect_postgres(dsn or path)
+            )
+            for m in MIGRATIONS:
+                self.conn.execute(m)
+            self.conn.commit()
+            return
         self.remote = None
         self._synced_changes = 0
         if remote_url:

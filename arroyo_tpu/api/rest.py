@@ -45,6 +45,10 @@ class ApiServer:
         self.db = ApiDb(
             db_path or config().database.path,
             remote_url=config().database.remote_url or None,
+            backend=(
+                "sqlite" if db_path else config().database.backend
+            ),
+            dsn=config().database.dsn,
         )
         self.previews: dict = {}  # pipeline id -> preview rows list
 

@@ -1,0 +1,673 @@
+/* arroyo-tpu console — hash-routed SPA over /api/v1.
+ *
+ * Capability mirror of the reference webui (/root/reference/webui
+ * router.tsx routes): pipelines list/detail with DAG visualization,
+ * per-operator metric graphs, checkpoint inspector and error tail; a SQL
+ * editor with validation + live preview; a connections wizard generated
+ * from connector config_schema metadata; and a UDF editor. Vanilla JS —
+ * served by the API process itself, no build step.
+ */
+"use strict";
+
+const api = (p) => "/api/v1" + p;
+const $ = (sel) => document.querySelector(sel);
+
+const esc = (s) =>
+  String(s ?? "").replace(/[&<>"']/g, (c) => "&#" + c.charCodeAt(0) + ";");
+
+function toast(msg, isErr) {
+  const el = document.createElement("div");
+  el.className = "toast-msg" + (isErr ? " err" : "");
+  el.textContent = typeof msg === "string" ? msg : JSON.stringify(msg);
+  $("#toast").appendChild(el);
+  setTimeout(() => el.remove(), isErr ? 7000 : 3500);
+}
+
+async function http(method, path, body) {
+  const r = await fetch(api(path), {
+    method,
+    headers: body !== undefined ? { "Content-Type": "application/json" } : {},
+    body: body !== undefined ? JSON.stringify(body) : undefined,
+  });
+  let data = {};
+  try {
+    data = await r.json();
+  } catch (e) {
+    /* non-json response */
+  }
+  if (!r.ok) {
+    const msg = data.error || (data.errors || []).join("; ") || r.statusText;
+    throw new Error(msg);
+  }
+  return data;
+}
+const GET = (p) => http("GET", p);
+const POST = (p, b) => http("POST", p, b);
+const PATCH = (p, b) => http("PATCH", p, b);
+const DEL = (p) => http("DELETE", p);
+
+/* ------------------------------------------------------------------ DAG */
+
+function layoutDag(graph) {
+  // longest-path layering, one column per layer
+  const nodes = graph.nodes, edges = graph.edges;
+  const byId = Object.fromEntries(nodes.map((n) => [n.node_id, n]));
+  const layer = {};
+  const indeg = {};
+  nodes.forEach((n) => (indeg[n.node_id] = 0));
+  edges.forEach((e) => indeg[e.dst]++);
+  const queue = nodes.filter((n) => !indeg[n.node_id]).map((n) => n.node_id);
+  queue.forEach((id) => (layer[id] = 0));
+  const pending = { ...indeg };
+  while (queue.length) {
+    const id = queue.shift();
+    for (const e of edges.filter((e) => e.src === id)) {
+      layer[e.dst] = Math.max(layer[e.dst] || 0, layer[id] + 1);
+      if (--pending[e.dst] === 0) queue.push(e.dst);
+    }
+  }
+  const cols = {};
+  nodes.forEach((n) => {
+    const l = layer[n.node_id] || 0;
+    (cols[l] = cols[l] || []).push(n);
+  });
+  const W = 210, H = 54, GX = 70, GY = 18;
+  const pos = {};
+  Object.entries(cols).forEach(([l, colNodes]) => {
+    colNodes.forEach((n, i) => {
+      pos[n.node_id] = { x: l * (W + GX) + 10, y: i * (H + GY) + 10 };
+    });
+  });
+  const width =
+    (Math.max(...Object.values(layer), 0) + 1) * (W + GX) + 20;
+  const height =
+    Math.max(...Object.values(cols).map((c) => c.length)) * (H + GY) + 20;
+  return { pos, byId, W, H, width, height };
+}
+
+function dagSvg(graph) {
+  const { pos, W, H, width, height } = layoutDag(graph);
+  let svg =
+    `<svg width="${width}" height="${height}" ` +
+    `xmlns="http://www.w3.org/2000/svg">` +
+    `<defs><marker id="arrow" viewBox="0 0 10 10" refX="9" refY="5" ` +
+    `markerWidth="7" markerHeight="7" orient="auto-start-reverse">` +
+    `<path d="M 0 0 L 10 5 L 0 10 z" fill="#4d5666"/></marker></defs>`;
+  for (const e of graph.edges) {
+    const a = pos[e.src], b = pos[e.dst];
+    if (!a || !b) continue;
+    const x1 = a.x + W, y1 = a.y + H / 2, x2 = b.x, y2 = b.y + H / 2;
+    const mx = (x1 + x2) / 2;
+    svg +=
+      `<path class="dag-edge ${esc(e.edge_type)}" ` +
+      `d="M${x1},${y1} C${mx},${y1} ${mx},${y2} ${x2},${y2}"/>`;
+  }
+  for (const n of graph.nodes) {
+    const p = pos[n.node_id];
+    const ops = esc(n.operator).slice(0, 34);
+    svg +=
+      `<g class="dag-node" transform="translate(${p.x},${p.y})">` +
+      `<rect width="${W}" height="${H}" rx="6"/>` +
+      `<text x="10" y="20">#${n.node_id} ${esc(n.description).slice(0, 24)}` +
+      `</text>` +
+      `<text class="op" x="10" y="35">${ops}</text>` +
+      `<text class="op" x="10" y="48">parallelism ${n.parallelism}</text>` +
+      `</g>`;
+  }
+  return svg + "</svg>";
+}
+
+/* -------------------------------------------------------------- metrics */
+
+// job -> operator -> metric -> [{t, v}] accumulated across polls
+const metricHistory = {};
+
+function recordMetrics(jobId, data) {
+  const hist = (metricHistory[jobId] = metricHistory[jobId] || {});
+  for (const op of data) {
+    const oh = (hist[op.operatorId] = hist[op.operatorId] || {});
+    for (const g of op.metricGroups) {
+      const total = g.subtasks.reduce(
+        (acc, s) => acc + s.metrics.reduce((a, m) => a + m.value, 0),
+        0
+      );
+      const t = Math.max(
+        ...g.subtasks.flatMap((s) => s.metrics.map((m) => m.time)),
+        Date.now()
+      );
+      const series = (oh[g.name] = oh[g.name] || []);
+      series.push({ t, v: total });
+      if (series.length > 120) series.shift();
+    }
+  }
+  return hist;
+}
+
+function rateSeries(series) {
+  // counters -> per-second rates between consecutive polls
+  const out = [];
+  for (let i = 1; i < series.length; i++) {
+    const dt = (series[i].t - series[i - 1].t) / 1000;
+    if (dt > 0)
+      out.push({
+        t: series[i].t,
+        v: Math.max(0, (series[i].v - series[i - 1].v) / dt),
+      });
+  }
+  return out;
+}
+
+function sparkline(points, w, h) {
+  if (!points.length) return `<svg class="spark" width="${w}" height="${h}"></svg>`;
+  const vs = points.map((p) => p.v);
+  const max = Math.max(...vs, 1e-9);
+  const step = w / Math.max(points.length - 1, 1);
+  const path = points
+    .map(
+      (p, i) =>
+        `${i ? "L" : "M"}${(i * step).toFixed(1)},` +
+        `${(h - 3 - (p.v / max) * (h - 8)).toFixed(1)}`
+    )
+    .join(" ");
+  return (
+    `<svg class="spark" width="${w}" height="${h}">` +
+    `<path d="${path}" stroke="#58a6ff" fill="none" stroke-width="1.5"/>` +
+    `</svg>`
+  );
+}
+
+function fmt(v) {
+  if (v >= 1e9) return (v / 1e9).toFixed(1) + "G";
+  if (v >= 1e6) return (v / 1e6).toFixed(1) + "M";
+  if (v >= 1e3) return (v / 1e3).toFixed(1) + "k";
+  return v >= 100 ? v.toFixed(0) : v.toFixed(1);
+}
+
+/* ---------------------------------------------------------------- views */
+
+let pollTimer = null;
+
+function setView(html, nav) {
+  clearInterval(pollTimer);
+  pollTimer = null;
+  $("#view").innerHTML = html;
+  document
+    .querySelectorAll("nav a")
+    .forEach((a) => a.classList.toggle("active", a.dataset.nav === nav));
+}
+
+/* pipelines list */
+
+async function viewPipelines() {
+  setView(
+    `<section><h2>Pipelines</h2><table id="plist">
+     <tr><th>id</th><th>name</th><th>state</th><th>created</th>
+     <th>actions</th></tr></table></section>
+     <section><h2>Jobs</h2><table id="jlist">
+     <tr><th>job</th><th>pipeline</th><th>state</th></tr></table></section>`,
+    "pipelines"
+  );
+  async function refresh() {
+    try {
+      const [ps, js] = await Promise.all([
+        GET("/pipelines"),
+        GET("/jobs"),
+      ]);
+      const t = $("#plist");
+      if (!t) return;
+      t.innerHTML =
+        "<tr><th>id</th><th>name</th><th>state</th><th>created</th>" +
+        "<th>actions</th></tr>";
+      for (const p of ps.data) {
+        const tr = document.createElement("tr");
+        tr.className = "clickable";
+        tr.innerHTML =
+          `<td>${esc(p.id)}</td><td>${esc(p.name)}</td>` +
+          `<td class="state-${esc(p.state)}">${esc(p.state)}</td>` +
+          `<td class="muted">${esc(p.created_at || "")}</td>` +
+          `<td class="actions">` +
+          `<a data-act="stop">stop</a>` +
+          `<a data-act="restart">restart</a>` +
+          `<a data-act="delete" class="danger">delete</a></td>`;
+        tr.addEventListener("click", (ev) => {
+          const act = ev.target.dataset && ev.target.dataset.act;
+          if (act === "stop")
+            PATCH(`/pipelines/${p.id}`, { stop: "checkpoint" })
+              .then(refresh)
+              .catch((e) => toast(e.message, true));
+          else if (act === "restart")
+            POST(`/pipelines/${p.id}/restart`, {})
+              .then(refresh)
+              .catch((e) => toast(e.message, true));
+          else if (act === "delete")
+            DEL(`/pipelines/${p.id}`)
+              .then(refresh)
+              .catch((e) => toast(e.message, true));
+          else location.hash = `#/pipelines/${p.id}`;
+          ev.stopPropagation();
+        });
+        t.appendChild(tr);
+      }
+      const jt = $("#jlist");
+      jt.innerHTML =
+        "<tr><th>job</th><th>pipeline</th><th>state</th></tr>";
+      for (const j of js.data) {
+        jt.innerHTML +=
+          `<tr><td>${esc(j.id)}</td><td>${esc(j.pipeline_id)}</td>` +
+          `<td class="state-${esc(j.state)}">${esc(j.state)}</td></tr>`;
+      }
+    } catch (e) {
+      toast(e.message, true);
+    }
+  }
+  await refresh();
+  pollTimer = setInterval(refresh, 3000);
+}
+
+/* pipeline detail */
+
+async function viewPipelineDetail(id) {
+  setView(
+    `<div class="crumbs"><a href="#/pipelines">pipelines</a> / ${esc(id)}</div>
+     <section><h2>Definition</h2><div class="kv" id="pmeta"></div>
+       <pre id="pquery"></pre></section>
+     <section><h2>Dataflow graph</h2>
+       <div class="dag-box" id="dag" class="muted">loading…</div></section>
+     <div class="grid2">
+       <section><h2>Checkpoints</h2><table id="ckpts"></table></section>
+       <section><h2>Errors</h2><div id="errs" class="muted">none</div>
+       </section>
+     </div>
+     <section><h2>Operator metrics <span class="muted">(events/s, polled
+       live)</span></h2><div id="metrics" class="muted">waiting for
+       samples…</div></section>`,
+    "pipelines"
+  );
+  let p;
+  try {
+    p = await GET(`/pipelines/${id}`);
+  } catch (e) {
+    toast(e.message, true);
+    return;
+  }
+  $("#pmeta").innerHTML =
+    `<span class="k">name</span><span>${esc(p.name)}</span>` +
+    `<span class="k">state</span>` +
+    `<span class="state-${esc(p.state)}">${esc(p.state)}</span>` +
+    `<span class="k">parallelism</span><span>${esc(p.parallelism || 1)}` +
+    `</span>`;
+  $("#pquery").textContent = p.query || "";
+  try {
+    const v = await POST("/pipelines/validate_query", {
+      query: p.query,
+      parallelism: p.parallelism || 1,
+    });
+    $("#dag").innerHTML = dagSvg(v.graph);
+  } catch (e) {
+    $("#dag").textContent = "graph unavailable: " + e.message;
+  }
+  const jobs = (await GET(`/pipelines/${id}/jobs`)).data;
+  const jobId = jobs.length ? jobs[jobs.length - 1].id : null;
+  async function refresh() {
+    if (!jobId) return;
+    try {
+      const cks = (await GET(`/jobs/${jobId}/checkpoints`)).data;
+      const ct = $("#ckpts");
+      if (!ct) return;
+      ct.innerHTML = "<tr><th>epoch</th><th>tasks</th><th>path</th></tr>";
+      for (const c of cks.slice(-12).reverse())
+        ct.innerHTML +=
+          `<tr><td>${c.epoch}</td><td>${c.tasks}</td>` +
+          `<td class="muted">${esc(c.backend)}</td></tr>`;
+      const errs = (await GET(`/jobs/${jobId}/errors`)).data;
+      $("#errs").innerHTML = errs.length
+        ? `<pre class="err">${esc(errs.map((e) => e.message).join("\n"))}</pre>`
+        : '<span class="muted">none</span>';
+      const m = (await GET(`/jobs/${jobId}/operator_metric_groups`)).data;
+      const hist = recordMetrics(jobId, m);
+      renderMetrics(hist);
+    } catch (e) {
+      /* job may be gone between polls */
+    }
+  }
+  function renderMetrics(hist) {
+    const box = $("#metrics");
+    if (!box) return;
+    let html = "";
+    for (const [op, groups] of Object.entries(hist)) {
+      html += `<h3>operator ${esc(op)}</h3><div>`;
+      for (const [name, series] of Object.entries(groups)) {
+        const rates = name.includes("bytes") || name.includes("messages")
+          || name.includes("batches") || name.includes("errors")
+          ? rateSeries(series)
+          : series;
+        const last = rates.length ? rates[rates.length - 1].v : 0;
+        html +=
+          `<div class="metric-cell"><div class="label">${esc(name)}</div>` +
+          `<div class="value">${fmt(last)}/s</div>` +
+          sparkline(rates, 160, 36) + `</div>`;
+      }
+      html += "</div>";
+    }
+    if (html) box.innerHTML = html;
+  }
+  await refresh();
+  pollTimer = setInterval(refresh, 2000);
+}
+
+/* new pipeline */
+
+const DEFAULT_SQL = `CREATE TABLE impulse WITH (
+  connector = 'impulse', event_rate = '100000',
+  message_count = '100000', start_time = '0'
+);
+SELECT counter % 10 as k, tumble(interval '100 millisecond') as w,
+       count(*) as cnt
+FROM impulse GROUP BY 1, 2;`;
+
+async function viewNewPipeline() {
+  setView(
+    `<div class="grid2">
+     <section><h2>SQL</h2>
+       <textarea id="sql" class="sql" spellcheck="false"></textarea>
+       <div class="row"><label>name</label>
+         <input id="pname" value="console-pipeline">
+         <label>parallelism</label>
+         <input id="ppar" type="number" value="1" min="1" style="width:70px">
+       </div>
+       <div>
+         <button id="btn-validate" class="ghost">Validate</button>
+         <button id="btn-preview" class="alt">Preview</button>
+         <button id="btn-create">Create pipeline</button>
+       </div>
+       <pre id="result">&nbsp;</pre></section>
+     <section><h2>Plan / preview output</h2>
+       <div class="dag-box" id="plan"></div>
+       <table id="ptable" class="preview-table"></table></section>
+     </div>`,
+    "new"
+  );
+  $("#sql").value = sessionStorage.getItem("sql") || DEFAULT_SQL;
+  $("#sql").addEventListener("input", () =>
+    sessionStorage.setItem("sql", $("#sql").value)
+  );
+  $("#btn-validate").onclick = async () => {
+    try {
+      const v = await POST("/pipelines/validate_query", {
+        query: $("#sql").value,
+        parallelism: parseInt($("#ppar").value) || 1,
+      });
+      $("#result").textContent = "valid";
+      $("#plan").innerHTML = dagSvg(v.graph);
+    } catch (e) {
+      $("#result").textContent = e.message;
+    }
+  };
+  $("#btn-preview").onclick = async () => {
+    $("#result").textContent = "previewing…";
+    $("#ptable").innerHTML = "";
+    let p;
+    try {
+      p = await POST("/pipelines/preview", { query: $("#sql").value });
+    } catch (e) {
+      $("#result").textContent = e.message;
+      return;
+    }
+    for (let i = 0; i < 240; i++) {
+      const o = await GET(`/pipelines/preview/${p.id}/output`);
+      renderPreview(o.rows.slice(-60));
+      $("#result").textContent = `preview: ${o.rows.length} rows` +
+        (o.done ? " (done)" : "…");
+      if (o.done) {
+        if (o.error) $("#result").textContent = o.error;
+        break;
+      }
+      await new Promise((r) => setTimeout(r, 400));
+    }
+  };
+  function renderPreview(rows) {
+    const t = $("#ptable");
+    if (!t || !rows.length) return;
+    const cols = Object.keys(rows[0]).filter((c) => !c.startsWith("_"));
+    let html =
+      "<tr>" + cols.map((c) => `<th>${esc(c)}</th>`).join("") + "</tr>";
+    for (const r of rows)
+      html +=
+        "<tr>" +
+        cols.map((c) => `<td>${esc(JSON.stringify(r[c]))}</td>`).join("") +
+        "</tr>";
+    t.innerHTML = html;
+  }
+  $("#btn-create").onclick = async () => {
+    try {
+      const p = await POST("/pipelines", {
+        name: $("#pname").value,
+        query: $("#sql").value,
+        parallelism: parseInt($("#ppar").value) || 1,
+      });
+      toast(`pipeline ${p.id} created`);
+      location.hash = `#/pipelines/${p.id}`;
+    } catch (e) {
+      $("#result").textContent = e.message;
+    }
+  };
+}
+
+/* connections */
+
+async function viewConnections() {
+  setView(
+    `<section><h2>Create a connection
+       <span class="muted">(pick a connector)</span></h2>
+       <div class="grid3" id="cards"></div></section>
+     <section id="wizard" style="display:none"></section>
+     <section><h2>Connection tables</h2><table id="ctables"></table>
+     </section>`,
+    "connections"
+  );
+  let connectors;
+  try {
+    connectors = (await GET("/connectors")).data;
+  } catch (e) {
+    toast(e.message, true);
+    return;
+  }
+  const cards = $("#cards");
+  for (const c of connectors) {
+    const div = document.createElement("div");
+    div.className = "card conn-card";
+    div.innerHTML =
+      `<h3>${esc(c.name)}</h3>` +
+      `<div class="muted">${esc(c.description)}</div>` +
+      `<div style="margin-top:6px">` +
+      (c.source ? '<span class="pill">source</span>' : "") +
+      (c.sink ? '<span class="pill">sink</span>' : "") +
+      `</div>`;
+    div.onclick = () => wizard(c);
+    cards.appendChild(div);
+  }
+  function wizard(c) {
+    const w = $("#wizard");
+    w.style.display = "";
+    const fields = Object.entries(c.config_schema || {});
+    w.innerHTML =
+      `<h2>New ${esc(c.name)} connection</h2>
+       <div class="row"><label>table name</label><input id="w-name"></div>
+       <div class="row"><label>type</label><select id="w-type">
+         ${c.source ? '<option value="source">source</option>' : ""}
+         ${c.sink ? '<option value="sink">sink</option>' : ""}
+       </select>
+       <label>format</label><select id="w-format">
+         <option>json</option><option>debezium_json</option>
+         <option>avro</option><option>protobuf</option>
+         <option>raw_string</option></select></div>` +
+      fields
+        .map(
+          ([k, spec]) =>
+            `<div class="row"><label>${esc(k)}${
+              spec.required ? " *" : ""
+            }</label>` +
+            (spec.enum
+              ? `<select data-opt="${esc(k)}"><option value=""></option>` +
+                spec.enum
+                  .map((v) => `<option>${esc(v)}</option>`)
+                  .join("") +
+                `</select>`
+              : `<input data-opt="${esc(k)}" placeholder="${esc(
+                  spec.type || "string"
+                )}">`) +
+            `</div>`
+        )
+        .join("") +
+      `<div style="margin-top:10px">
+         <button id="w-test" class="ghost">Test</button>
+         <button id="w-create">Create</button>
+         <button id="w-cancel" class="ghost">Cancel</button></div>
+       <pre id="w-out">&nbsp;</pre>`;
+    const gather = () => {
+      const opts = { format: $("#w-format").value };
+      w.querySelectorAll("[data-opt]").forEach((el) => {
+        if (el.value) opts[el.dataset.opt] = el.value;
+      });
+      return {
+        name: $("#w-name").value,
+        connector: c.name,
+        table_type: $("#w-type").value,
+        config: opts,
+      };
+    };
+    $("#w-test").onclick = async () => {
+      try {
+        const r = await POST("/connection_tables/test", gather());
+        $("#w-out").textContent = r.ok
+          ? "ok: " + (r.message || "reachable")
+          : "failed: " + (r.message || "unreachable");
+      } catch (e) {
+        $("#w-out").textContent = e.message;
+      }
+    };
+    $("#w-create").onclick = async () => {
+      try {
+        await POST("/connection_tables", gather());
+        toast("connection table created");
+        w.style.display = "none";
+        refreshTables();
+      } catch (e) {
+        $("#w-out").textContent = e.message;
+      }
+    };
+    $("#w-cancel").onclick = () => (w.style.display = "none");
+  }
+  async function refreshTables() {
+    const t = $("#ctables");
+    if (!t) return;
+    const tables = (await GET("/connection_tables")).data;
+    t.innerHTML =
+      "<tr><th>name</th><th>connector</th><th>type</th><th>format</th>" +
+      "<th></th></tr>";
+    for (const ct of tables) {
+      const tr = document.createElement("tr");
+      tr.innerHTML =
+        `<td>${esc(ct.name)}</td><td>${esc(ct.connector)}</td>` +
+        `<td>${esc(ct.table_type)}</td>` +
+        `<td>${esc((ct.config && ct.config.format) || "")}</td>` +
+        `<td class="actions"><a class="danger">delete</a></td>`;
+      tr.querySelector("a").onclick = () =>
+        DEL(`/connection_tables/${ct.id}`)
+          .then(refreshTables)
+          .catch((e) => toast(e.message, true));
+      t.appendChild(tr);
+    }
+  }
+  await refreshTables();
+}
+
+/* UDFs */
+
+const DEFAULT_UDF = `@udf(pa.int64(), [pa.int64()], name="add_one")
+def add_one(xs):
+    return xs + 1`;
+
+async function viewUdfs() {
+  setView(
+    `<div class="grid2">
+     <section><h2>UDF editor
+       <span class="muted">(@udf / @udaf over pyarrow types)</span></h2>
+       <textarea id="udf" class="udf" spellcheck="false"></textarea>
+       <div style="margin-top:8px">
+         <button id="u-validate" class="ghost">Validate</button>
+         <button id="u-create">Register</button></div>
+       <pre id="u-out">&nbsp;</pre></section>
+     <section><h2>Registered UDFs</h2><table id="ulist"></table></section>
+     </div>`,
+    "udfs"
+  );
+  $("#udf").value = sessionStorage.getItem("udf") || DEFAULT_UDF;
+  $("#udf").addEventListener("input", () =>
+    sessionStorage.setItem("udf", $("#udf").value)
+  );
+  $("#u-validate").onclick = async () => {
+    try {
+      const r = await POST("/udfs/validate", {
+        definition: $("#udf").value,
+      });
+      $("#u-out").textContent = r.errors && r.errors.length
+        ? r.errors.join("\n")
+        : "valid: registers " + (r.udfs || []).join(", ");
+    } catch (e) {
+      $("#u-out").textContent = e.message;
+    }
+  };
+  $("#u-create").onclick = async () => {
+    try {
+      await POST("/udfs", { definition: $("#udf").value });
+      toast("UDF registered");
+      refresh();
+    } catch (e) {
+      $("#u-out").textContent = e.message;
+    }
+  };
+  async function refresh() {
+    const t = $("#ulist");
+    if (!t) return;
+    const udfs = (await GET("/udfs")).data;
+    t.innerHTML = "<tr><th>name</th><th></th></tr>";
+    for (const u of udfs) {
+      const tr = document.createElement("tr");
+      tr.innerHTML =
+        `<td>${esc(u.name)}</td>` +
+        `<td class="actions"><a class="danger">delete</a></td>`;
+      tr.querySelector("a").onclick = () =>
+        DEL(`/udfs/${u.id || u.name}`)
+          .then(refresh)
+          .catch((e) => toast(e.message, true));
+      t.appendChild(tr);
+    }
+  }
+  await refresh();
+}
+
+/* --------------------------------------------------------------- router */
+
+function route() {
+  const h = location.hash || "#/pipelines";
+  const parts = h.slice(2).split("/");
+  if (parts[0] === "pipelines" && parts[1]) viewPipelineDetail(parts[1]);
+  else if (parts[0] === "new") viewNewPipeline();
+  else if (parts[0] === "connections") viewConnections();
+  else if (parts[0] === "udfs") viewUdfs();
+  else viewPipelines();
+}
+window.addEventListener("hashchange", route);
+
+async function clusterStatus() {
+  try {
+    await GET("/ping");
+    $("#cluster-status").textContent = "api: connected";
+  } catch (e) {
+    $("#cluster-status").textContent = "api: unreachable";
+  }
+}
+clusterStatus();
+setInterval(clusterStatus, 10000);
+route();

@@ -60,6 +60,10 @@ class Connector:
         nexmark); None when CREATE TABLE must declare columns."""
         return None
 
+    # DDL `METADATA FROM 'key'` keys this connector's source can populate
+    # (reference Connector::metadata_defs, operator/src/connector.rs:62)
+    metadata_keys: tuple = ()
+
     def metadata(self) -> Dict[str, Any]:
         return {
             "id": self.name,
@@ -68,6 +72,7 @@ class Connector:
             "source": self.source,
             "sink": self.sink,
             "config_schema": self.config_schema,
+            "metadata_keys": list(self.metadata_keys),
         }
 
 

@@ -59,11 +59,17 @@ class FluvioSource(SourceOperator):
         it = iter(consumer.stream(fluvio.Offset.absolute(self.offset)))
         sentinel = object()
         q: _queue.Queue = _queue.Queue(maxsize=4096)
+        pump_error: list = []
 
         def pump():
             try:
                 for record in it:
                     q.put(record)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                # surface broker failures on the consumer side — a
+                # swallowed exception would end the stream "cleanly" and
+                # mark the job Finished with silent data loss
+                pump_error.append(e)
             finally:
                 q.put(sentinel)
 
@@ -81,6 +87,8 @@ class FluvioSource(SourceOperator):
                 await asyncio.sleep(0.02)
                 continue
             if record is sentinel:
+                if pump_error:
+                    raise pump_error[0]
                 break
             for row in deser.deserialize_slice(
                 bytes(record.value()), error_reporter=ctx.error_reporter

@@ -275,6 +275,7 @@ SASL_OPTIONS = (
 @register_connector
 class KafkaConnector(Connector):
     name = "kafka"
+    metadata_keys = ("offset_id", "partition", "topic", "timestamp", "key")
     description = "Kafka source and sink (exactly-once via transactions)"
     source = True
     sink = True

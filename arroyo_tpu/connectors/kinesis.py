@@ -74,13 +74,22 @@ class KinesisSource(SourceOperator):
             )
 
     def _owned(self, shard_id: str, ctx) -> bool:
-        """Stable shard -> subtask assignment (crc32, not enumeration
-        index) so resharding-created children don't shuffle ownership of
-        existing shards."""
+        """Stable shard -> subtask assignment: crc32 of the shard's ROOT
+        ancestor (ownership follows reshard lineage), so a child always
+        lands on the subtask that drained its parent — the parent-drain
+        gate can then be enforced locally and per-key order holds across
+        splits. Falls back to the shard's own id when lineage is unknown
+        (pre-refresh checkpoint filtering)."""
         import zlib
 
+        root = shard_id
+        lineage = getattr(self, "_parent_of", {})
+        seen = set()
+        while root in lineage and root not in seen:
+            seen.add(root)
+            root = lineage[root]
         par = ctx.task_info.parallelism
-        return zlib.crc32(shard_id.encode()) % par == ctx.task_info.task_index
+        return zlib.crc32(root.encode()) % par == ctx.task_info.task_index
 
     def _open_iterator(self, client, sid: str):
         if sid in self.positions and self.positions[sid] != CLOSED:
@@ -112,6 +121,7 @@ class KinesisSource(SourceOperator):
         iterators: Dict[str, str] = {}
         known: set = set()
         self._discovered_children: set = set()
+        self._parent_of: Dict[str, str] = {}
 
         def refresh_shards(initial: bool = False) -> bool:
             """Pick up resharding children (reference kinesis resharding
@@ -120,6 +130,10 @@ class KinesisSource(SourceOperator):
             Returns True when the stream metadata shows every shard
             closed AND all of ours are drained (stream has ended)."""
             shards = client.list_shards(StreamName=self.stream)["Shards"]
+            # lineage map first: ownership derives from the root ancestor
+            for s in shards:
+                if s.get("ParentShardId"):
+                    self._parent_of[s["ShardId"]] = s["ParentShardId"]
             for s in shards:
                 sid = s["ShardId"]
                 if sid in known or not self._owned(sid, ctx):
@@ -138,7 +152,14 @@ class KinesisSource(SourceOperator):
                 ]
                 if parents and not initial:
                     continue  # wait until our parent drains
-                if not initial and s.get("ParentShardId"):
+                if s.get("ParentShardId") and (
+                    not initial
+                    or s["ParentShardId"] in self.positions
+                ):
+                    # a reshard child replays from its start even under
+                    # init_position=latest: continuity from the drained
+                    # parent (incl. restore-time discovery, where the
+                    # stored parent position proves prior consumption)
                     self._discovered_children.add(sid)
                 known.add(sid)
                 iterators[sid] = self._open_iterator(client, sid)

@@ -87,28 +87,7 @@ class MqttSource(SourceOperator):
                 await asyncio.sleep(min(2 ** reconnects * 0.1, 10.0))
 
     async def _consume(self, client, deser, ctx, collector):
-        """Poll with a persistent in-flight __anext__ so an idle topic
-        never starves control handling (checkpoint barriers, stops), and
-        cancellation never orphans the client's internal getter."""
-        it = client.messages.__aiter__()
-        pending = None
-        while True:
-            finish = await ctx.check_control(collector)
-            if finish is not None:
-                if pending is not None:
-                    pending.cancel()
-                return finish
-            if pending is None:
-                pending = asyncio.ensure_future(it.__anext__())
-            done, _ = await asyncio.wait({pending}, timeout=0.05)
-            if not done:
-                await self.flush_buffer(ctx, collector)
-                continue
-            task, pending = pending, None
-            try:
-                message = task.result()
-            except StopAsyncIteration:
-                return SourceFinishType.FINAL
+        async def on_message(message):
             meta = None
             if self.metadata_fields:
                 vals = {
@@ -126,8 +105,11 @@ class MqttSource(SourceOperator):
                 if meta:
                     row.update(meta)
                 ctx.buffer_row(row)
-            if ctx.should_flush():
-                await self.flush_buffer(ctx, collector)
+
+        finish = await self.poll_async_iter(
+            client.messages.__aiter__(), ctx, collector, on_message
+        )
+        return SourceFinishType.FINAL if finish is None else finish
 
 
 class MqttSink(Operator):
@@ -179,6 +161,7 @@ class MqttSink(Operator):
 @register_connector
 class MqttConnector(Connector):
     name = "mqtt"
+    metadata_keys = METADATA_KEYS
     description = "MQTT source and sink (QoS 0/1, durable sessions)"
     source = True
     sink = True

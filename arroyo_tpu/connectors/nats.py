@@ -57,38 +57,19 @@ class NatsSource(SourceOperator):
                 sub = await js.subscribe(self.subject, **opts)
             else:
                 sub = await nc.subscribe(self.subject)
-            # poll with a timeout rather than `async for`: an idle subject
-            # must not starve control handling (checkpoint barriers, stops).
-            # The in-flight __anext__ task persists across idle ticks —
-            # cancelling it (as wait_for would) leaks nats-py's internal
-            # queue-getter task, which then steals and drops messages
-            it = sub.messages.__aiter__()
-            pending = None
-            while True:
-                finish = await ctx.check_control(collector)
-                if finish is not None:
-                    if pending is not None:
-                        pending.cancel()
-                    return finish
-                if pending is None:
-                    pending = asyncio.ensure_future(it.__anext__())
-                done, _ = await asyncio.wait({pending}, timeout=0.05)
-                if not done:
-                    await self.flush_buffer(ctx, collector)
-                    continue
-                task, pending = pending, None
-                try:
-                    msg = task.result()
-                except StopAsyncIteration:
-                    break
+            async def on_message(msg):
                 for row in deser.deserialize_slice(
                     msg.data, error_reporter=ctx.error_reporter
                 ):
                     ctx.buffer_row(row)
                 if self.jetstream and msg.metadata:
                     self.sequence = msg.metadata.sequence.stream
-                if ctx.should_flush():
-                    await self.flush_buffer(ctx, collector)
+
+            finish = await self.poll_async_iter(
+                sub.messages.__aiter__(), ctx, collector, on_message
+            )
+            if finish is not None:
+                return finish
             await self.flush_buffer(ctx, collector)
         finally:
             await nc.close()

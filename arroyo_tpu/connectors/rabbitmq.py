@@ -28,13 +28,21 @@ class RabbitmqSource(SourceOperator):
         self.bad_data = bad_data
         self.prefetch = prefetch
         self._unacked: list = []
+        self._pending_acks: dict = {}  # epoch -> messages awaiting commit
 
     async def handle_checkpoint(self, barrier, ctx, collector):
-        # rows from these messages were flushed before the barrier, so
-        # the epoch covers them — safe to ack (at-least-once: a crash
-        # before this point redelivers)
-        unacked, self._unacked = self._unacked, []
-        for m in unacked:
+        # stage this epoch's messages for the COMMIT phase: the ack must
+        # wait until the checkpoint manifest is durably published (a
+        # barrier-time ack would lose data if the epoch's flush later
+        # failed and the job restored to the previous epoch). Registering
+        # commit_data makes the job controller run 2PC for this epoch.
+        if self._unacked:
+            self._pending_acks[barrier.epoch] = self._unacked
+            self._unacked = []
+            ctx.commit_data = b"rabbitmq-acks"
+
+    async def handle_commit(self, epoch, commit_data, ctx):
+        for m in self._pending_acks.pop(epoch, []):
             await m.ack()
 
     async def run(self, ctx, collector) -> SourceFinishType:
@@ -47,35 +55,18 @@ class RabbitmqSource(SourceOperator):
             await channel.set_qos(prefetch_count=self.prefetch)
             queue = await channel.declare_queue(self.queue, durable=True)
             async with queue.iterator() as it:
-                # persistent in-flight __anext__: an idle queue must not
-                # starve control handling, and cancelling __anext__ (as
-                # wait_for would) can orphan the client's internal getter
-                ait = it.__aiter__()
-                pending = None
-                while True:
-                    finish = await ctx.check_control(collector)
-                    if finish is not None:
-                        if pending is not None:
-                            pending.cancel()
-                        return finish
-                    if pending is None:
-                        pending = asyncio.ensure_future(ait.__anext__())
-                    done, _ = await asyncio.wait({pending}, timeout=0.05)
-                    if not done:
-                        await self.flush_buffer(ctx, collector)
-                        continue
-                    task, pending = pending, None
-                    try:
-                        message = task.result()
-                    except StopAsyncIteration:
-                        break
+                async def on_message(message):
                     for row in deser.deserialize_slice(
                         message.body, error_reporter=ctx.error_reporter
                     ):
                         ctx.buffer_row(row)
                     self._unacked.append(message)
-                    if ctx.should_flush():
-                        await self.flush_buffer(ctx, collector)
+
+                finish = await self.poll_async_iter(
+                    it.__aiter__(), ctx, collector, on_message
+                )
+                if finish is not None:
+                    return finish
                 # stream ended: the tail is flushed at source close and
                 # the pipeline drains, so ack the remainder
                 await self.flush_buffer(ctx, collector)

@@ -112,3 +112,37 @@ class SourceOperator(Operator):
         batch = ctx.take_buffer()
         if batch is not None:
             await collector.collect(batch)
+
+    async def poll_async_iter(
+        self, ait, ctx, collector, on_message, idle: float = 0.05
+    ) -> Optional[SourceFinishType]:
+        """Shared client-poll loop for push-style sources (MQTT, RabbitMQ,
+        NATS): keeps ONE in-flight `__anext__` across idle ticks — an idle
+        subject must not starve control handling (checkpoint barriers,
+        stops), and cancelling `__anext__` per tick (as wait_for would)
+        orphans many clients' internal queue getters, which then steal
+        and drop messages. `on_message(msg)` is awaited per message;
+        returns a finish type from control, or None at end-of-stream."""
+        import asyncio
+
+        pending = None
+        while True:
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                if pending is not None:
+                    pending.cancel()
+                return finish
+            if pending is None:
+                pending = asyncio.ensure_future(ait.__anext__())
+            done, _ = await asyncio.wait({pending}, timeout=idle)
+            if not done:
+                await self.flush_buffer(ctx, collector)
+                continue
+            task, pending = pending, None
+            try:
+                msg = task.result()
+            except StopAsyncIteration:
+                return None
+            await on_message(msg)
+            if ctx.should_flush():
+                await self.flush_buffer(ctx, collector)

@@ -373,6 +373,14 @@ class Planner:
             **options,
         }
         if t.metadata_fields:
+            allowed = getattr(conn, "metadata_keys", ())
+            for col, key in t.metadata_fields.items():
+                if key not in allowed:
+                    raise SqlError(
+                        f"connector {t.connector} has no metadata key "
+                        f"{key!r} (column {col}); available: "
+                        f"{list(allowed) or 'none'}"
+                    )
             config["metadata_fields"] = dict(t.metadata_fields)
         chain = [ChainedOp(OperatorName.CONNECTOR_SOURCE, config, t.name)]
         # virtual columns (GENERATED ALWAYS AS): computed right after
@@ -380,11 +388,15 @@ class Planner:
         if t.generated:
             for col, gexpr in t.generated.items():
                 for other in t.generated:
-                    if other != col and _expr_references(gexpr, other):
+                    if _expr_references(gexpr, other):
+                        what = (
+                            "itself" if other == col
+                            else f"generated column {other}"
+                        )
                         raise SqlError(
-                            f"generated column {col} references generated "
-                            f"column {other}; generated columns may only "
-                            "reference payload columns"
+                            f"generated column {col} references {what}; "
+                            "generated columns may only reference payload "
+                            "columns"
                         )
             scope = Scope.from_schema(source_schema.schema)
             gen_exprs: List[BoundExpr] = []

@@ -328,3 +328,70 @@ def test_api_db_remote_sync(tmp_path):
     assert db3.list_pipelines() == []
     assert db3.get_pipeline(p["id"]) is None
     assert [x["id"] for x in db3.list_udfs()] == [udf["id"]]
+
+
+def test_postgres_backend_dialect():
+    """The postgres path drives the same query set through the `%s`
+    placeholder dialect and dict rows. A fake DBAPI connection asserts
+    every statement arrived in Postgres form (no '?' placeholders) and
+    executes it against an in-memory store to prove the round trip."""
+    import sqlite3
+
+    from arroyo_tpu.api.db import ApiDb, _PgConn
+
+    executed = []
+
+    class FakePgRaw:
+        """Quacks like a psycopg connection; backed by sqlite but only
+        accepts %s-style statements (as a real PG server would)."""
+
+        def __init__(self):
+            self._db = sqlite3.connect(":memory:")
+            self._db.row_factory = sqlite3.Row
+
+        def cursor(self):
+            db = self._db
+
+            class Cur:
+                description = None
+                rowcount = 0
+
+                def execute(self, sql, params=()):
+                    assert "?" not in sql, f"sqlite placeholder leaked: {sql}"
+                    executed.append(sql)
+                    self._c = db.execute(sql.replace("%s", "?"), params)
+                    self.rowcount = self._c.rowcount
+                    self.description = self._c.description
+
+                def fetchone(self):
+                    r = self._c.fetchone()
+                    return dict(r) if r is not None else None
+
+                def fetchall(self):
+                    return [dict(r) for r in self._c.fetchall()]
+
+            return Cur()
+
+        def commit(self):
+            self._db.commit()
+
+    db = ApiDb(_pg_conn=_PgConn(FakePgRaw()))
+    assert db.backend == "postgres"
+    p = db.create_pipeline("pg-test", "SELECT 1;", 2)
+    assert db.get_pipeline(p["id"])["name"] == "pg-test"
+    db.set_pipeline_state(p["id"], "Running")
+    assert db.get_pipeline(p["id"])["state"] == "Running"
+    assert len(db.list_pipelines()) == 1
+    j = db.create_job(p["id"])
+    db.update_job(j["id"], "Running")
+    assert db.all_jobs()[0]["state"] == "Running"
+    u = db.create_udf("f", "def f(): pass")
+    assert db.list_udfs()[0]["name"] == "f"
+    db.delete_udf(u["id"])
+    assert db.list_udfs() == []
+    ct = db.create_connection_table("t", "kafka", {"topic": "x"}, None,
+                                    "source", None)
+    assert db.list_connection_tables()[0]["config"] == {"topic": "x"}
+    db.delete_connection_table(ct["id"])
+    db.delete_pipeline(p["id"])
+    assert any("%s" in s for s in executed)

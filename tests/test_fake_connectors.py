@@ -427,12 +427,12 @@ def rabbit(monkeypatch):
     return r
 
 
-def test_rabbitmq_source_acks_and_sink_publishes(rabbit):
-    """The source sets consumer prefetch, acks each message after its
-    rows are buffered, and the sink publishes persistent messages with
-    the configured routing key."""
+def test_rabbitmq_source_acks_and_sink_publishes(rabbit, tmp_path):
+    """The source sets consumer prefetch and acks its messages only at
+    the checkpoint COMMIT phase (after the manifest is durable) or at
+    end-of-stream; the sink publishes persistent messages with the
+    configured routing key."""
     rabbit.queue_msgs = [json.dumps({"n": i}).encode() for i in range(8)]
-    rabbit.stop_at = 8
     sql = """
     CREATE TABLE src (n BIGINT) WITH (
       connector = 'rabbitmq', url = 'amqp://fake', queue = 'in',
@@ -447,11 +447,28 @@ def test_rabbitmq_source_acks_and_sink_publishes(rabbit):
 
     async def go():
         plan = plan_query(sql, parallelism=1)
-        eng = Engine(plan.graph).start()
+        eng = Engine(plan.graph, job_id="rmq",
+                     storage_url=str(tmp_path / "ck")).start()
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if len(rabbit.published) >= 8:
+                break
+        assert rabbit.acked == 0, "acked before any checkpoint committed"
+        # checkpoint: acks ride the 2PC commit phase (dispatched async
+        # after the manifest publish — poll briefly)
+        await eng.checkpoint_and_wait()
+        for _ in range(100):
+            if rabbit.acked >= 8:
+                break
+            await asyncio.sleep(0.02)
+        acked_mid = rabbit.acked
+        rabbit.stop_at = 8
         await eng.join(30)
+        return acked_mid
 
-    asyncio.run(go())
+    acked_mid = asyncio.run(go())
     assert rabbit.prefetch == 17
+    assert acked_mid == 8, "commit phase should have acked the epoch"
     assert rabbit.acked == 8
     assert len(rabbit.published) == 8
     assert all(rk == "out.rk" for _e, rk, _b in rabbit.published)

@@ -13,34 +13,16 @@ import os
 STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "static")
 
-_CONTENT_TYPES = {
-    ".html": "text/html",
-    ".css": "text/css",
-    ".js": "application/javascript",
-    ".svg": "image/svg+xml",
-}
-
 
 def add_console_routes(app):
     from aiohttp import web
 
-    def serve(filename):
-        path = os.path.join(STATIC_DIR, filename)
-        ext = os.path.splitext(filename)[1]
+    async def index(request):
+        return web.FileResponse(os.path.join(STATIC_DIR, "index.html"))
 
-        async def handler(request):
-            with open(path, "r", encoding="utf-8") as f:
-                return web.Response(
-                    text=f.read(),
-                    content_type=_CONTENT_TYPES.get(ext, "text/plain"),
-                )
-
-        return handler
-
-    index = serve("index.html")
     app.router.add_get("/", index)
     app.router.add_get("/console", index)
     app.router.add_get("/console/", index)
-    for name in os.listdir(STATIC_DIR):
-        if name != "index.html":
-            app.router.add_get(f"/console/{name}", serve(name))
+    # FileResponse handles content types and binary assets; new files in
+    # static/ are served without a restart
+    app.router.add_static("/console/", STATIC_DIR, show_index=False)

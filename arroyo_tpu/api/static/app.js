@@ -186,20 +186,37 @@ function fmt(v) {
 /* ---------------------------------------------------------------- views */
 
 let pollTimer = null;
+// navigation generation: async view code checks its token after awaits
+// so a stale view can neither write into the new DOM nor leak its timer
+let navGen = 0;
 
 function setView(html, nav) {
   clearInterval(pollTimer);
   pollTimer = null;
+  navGen++;
   $("#view").innerHTML = html;
   document
     .querySelectorAll("nav a")
     .forEach((a) => a.classList.toggle("active", a.dataset.nav === nav));
+  return navGen;
+}
+
+function setPoll(gen, fn, ms) {
+  if (gen !== navGen) return;
+  clearInterval(pollTimer);
+  pollTimer = setInterval(() => {
+    if (gen !== navGen) {
+      clearInterval(pollTimer);
+      return;
+    }
+    fn();
+  }, ms);
 }
 
 /* pipelines list */
 
 async function viewPipelines() {
-  setView(
+  const gen = setView(
     `<section><h2>Pipelines</h2><table id="plist">
      <tr><th>id</th><th>name</th><th>state</th><th>created</th>
      <th>actions</th></tr></table></section>
@@ -214,7 +231,7 @@ async function viewPipelines() {
         GET("/jobs"),
       ]);
       const t = $("#plist");
-      if (!t) return;
+      if (!t || gen !== navGen) return;
       t.innerHTML =
         "<tr><th>id</th><th>name</th><th>state</th><th>created</th>" +
         "<th>actions</th></tr>";
@@ -261,13 +278,13 @@ async function viewPipelines() {
     }
   }
   await refresh();
-  pollTimer = setInterval(refresh, 3000);
+  setPoll(gen, refresh, 3000);
 }
 
 /* pipeline detail */
 
 async function viewPipelineDetail(id) {
-  setView(
+  const gen = setView(
     `<div class="crumbs"><a href="#/pipelines">pipelines</a> / ${esc(id)}</div>
      <section><h2>Definition</h2><div class="kv" id="pmeta"></div>
        <pre id="pquery"></pre></section>
@@ -290,6 +307,7 @@ async function viewPipelineDetail(id) {
     toast(e.message, true);
     return;
   }
+  if (gen !== navGen) return;
   $("#pmeta").innerHTML =
     `<span class="k">name</span><span>${esc(p.name)}</span>` +
     `<span class="k">state</span>` +
@@ -302,18 +320,21 @@ async function viewPipelineDetail(id) {
       query: p.query,
       parallelism: p.parallelism || 1,
     });
+    if (gen !== navGen) return;
     $("#dag").innerHTML = dagSvg(v.graph);
   } catch (e) {
+    if (gen !== navGen) return;
     $("#dag").textContent = "graph unavailable: " + e.message;
   }
   const jobs = (await GET(`/pipelines/${id}/jobs`)).data;
+  if (gen !== navGen) return;
   const jobId = jobs.length ? jobs[jobs.length - 1].id : null;
   async function refresh() {
     if (!jobId) return;
     try {
       const cks = (await GET(`/jobs/${jobId}/checkpoints`)).data;
       const ct = $("#ckpts");
-      if (!ct) return;
+      if (!ct || gen !== navGen) return;
       ct.innerHTML = "<tr><th>epoch</th><th>tasks</th><th>path</th></tr>";
       for (const c of cks.slice(-12).reverse())
         ct.innerHTML +=
@@ -332,7 +353,7 @@ async function viewPipelineDetail(id) {
   }
   function renderMetrics(hist) {
     const box = $("#metrics");
-    if (!box) return;
+    if (!box || gen !== navGen) return;
     let html = "";
     for (const [op, groups] of Object.entries(hist)) {
       html += `<h3>operator ${esc(op)}</h3><div>`;
@@ -352,7 +373,7 @@ async function viewPipelineDetail(id) {
     if (html) box.innerHTML = html;
   }
   await refresh();
-  pollTimer = setInterval(refresh, 2000);
+  setPoll(gen, refresh, 2000);
 }
 
 /* new pipeline */
@@ -456,7 +477,7 @@ async function viewNewPipeline() {
 /* connections */
 
 async function viewConnections() {
-  setView(
+  const gen = setView(
     `<section><h2>Create a connection
        <span class="muted">(pick a connector)</span></h2>
        <div class="grid3" id="cards"></div></section>
@@ -472,6 +493,7 @@ async function viewConnections() {
     toast(e.message, true);
     return;
   }
+  if (gen !== navGen) return;
   const cards = $("#cards");
   for (const c of connectors) {
     const div = document.createElement("div");
@@ -560,8 +582,9 @@ async function viewConnections() {
   }
   async function refreshTables() {
     const t = $("#ctables");
-    if (!t) return;
+    if (!t || gen !== navGen) return;
     const tables = (await GET("/connection_tables")).data;
+    if (gen !== navGen) return;
     t.innerHTML =
       "<tr><th>name</th><th>connector</th><th>type</th><th>format</th>" +
       "<th></th></tr>";
@@ -589,7 +612,7 @@ def add_one(xs):
     return xs + 1`;
 
 async function viewUdfs() {
-  setView(
+  const gen = setView(
     `<div class="grid2">
      <section><h2>UDF editor
        <span class="muted">(@udf / @udaf over pyarrow types)</span></h2>
@@ -629,8 +652,9 @@ async function viewUdfs() {
   };
   async function refresh() {
     const t = $("#ulist");
-    if (!t) return;
+    if (!t || gen !== navGen) return;
     const udfs = (await GET("/udfs")).data;
+    if (gen !== navGen) return;
     t.innerHTML = "<tr><th>name</th><th></th></tr>";
     for (const u of udfs) {
       const tr = document.createElement("tr");

@@ -131,6 +131,12 @@ class KinesisSource(SourceOperator):
             closed AND all of ours are drained (stream has ended)."""
             shards = client.list_shards(StreamName=self.stream)["Shards"]
             # lineage map first: ownership derives from the root ancestor
+            # (the PRIMARY parent; a merge child therefore lands on its
+            # primary parent's subtask and the drain gate below covers
+            # that side locally — the adjacent parent may drain on a
+            # different subtask, so strict per-key order across a MERGE
+            # with cross-subtask parents is best-effort, like most
+            # non-coordinated Kinesis consumers)
             for s in shards:
                 if s.get("ParentShardId"):
                     self._parent_of[s["ShardId"]] = s["ParentShardId"]

@@ -206,6 +206,7 @@ class Planner:
         self.graph = LogicalGraph()
         self.parallelism = parallelism
         self._source_cache: Dict[str, RelOutput] = {}
+        self._select_plan_cache: Dict[tuple, RelOutput] = {}
         self._sink_nodes: Dict[str, dict] = {}
         self._memory_tables: Dict[str, RelOutput] = {}
         self._cte_stack: List[Dict[str, Select]] = []
@@ -482,14 +483,14 @@ class Planner:
         if isinstance(rel, TableRef):
             view = self._resolve_view(rel.name)
             if view is not None:
-                out = self.plan_select(view)
+                out = self._plan_select_shared(view)
                 return _requalify(out, rel.alias or rel.name)
             t = self.provider.get_table(rel.name)
             if t is None:
                 raise SqlError(f"unknown table {rel.name}")
             return self.plan_source_table(t, rel.alias)
         if isinstance(rel, SubqueryRef):
-            out = self.plan_select(rel.query)
+            out = self._plan_select_shared(rel.query)
             return _requalify(out, rel.alias)
         if isinstance(rel, Join):
             return self.plan_join(rel)
@@ -500,6 +501,32 @@ class Planner:
                 "as a SELECT item"
             )
         raise SqlError(f"unsupported relation {rel!r}")
+
+    def _plan_select_shared(self, sel: Select) -> RelOutput:
+        """Common-subplan elimination: structurally identical subqueries,
+        views and CTE bodies plan ONCE and fan out (nexmark q5's two hop
+        branches share one aggregation instead of maintaining duplicate
+        window state; the reference gets the same effect from DataFusion's
+        CSE + its SourceRewriter source cache). AST dataclasses repr
+        structurally, so the repr is the cache key; the CTE stack rides
+        along since the same text can resolve differently per scope."""
+        # the key must capture WHAT names resolve to, not just nesting
+        # depth: same-text subqueries under different same-depth CTE
+        # scopes (or across statements redefining a CTE) are different
+        # plans
+        key = (
+            repr(sel),
+            tuple(
+                tuple(sorted((n, repr(q)) for n, q in scope.items()))
+                for scope in self._cte_stack
+            ),
+        )
+        hit = self._select_plan_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self.plan_select(sel)
+        self._select_plan_cache[key] = out
+        return out
 
     def _resolve_view(self, name: str) -> Optional[Select]:
         for scope in reversed(self._cte_stack):

@@ -78,6 +78,16 @@ class TpuConfig:
     enabled: bool = True  # use device kernels when a TPU/accelerator exists
     # pad batch key-cardinality to these bucket sizes to bound recompilation
     shape_buckets: tuple = (256, 1024, 4096, 16384, 65536)
+    # starting accumulator slots: each 4x growth re-specializes the jitted
+    # update/gather/reset programs, which costs ~20-40s PER PROGRAM when
+    # compiles route through a remote TPU relay — pre-size for the
+    # expected cardinality to keep the program count flat
+    initial_capacity: int = 4096
+    # TPU v5e emulates int64/float64 (no native wide types): this opt-in
+    # keeps device accumulators int32/float32. Counts and min/max of
+    # 32-bit-bounded values stay exact; large sums can overflow, so off
+    # by default
+    use_32bit_accumulators: bool = False
     max_keys_per_shard: int = 1 << 20  # device state capacity per subtask
     donate_state: bool = True
     # >= 2: window operators keep accumulator state sharded across this

@@ -330,15 +330,20 @@ def _not_null_mask(vals: np.ndarray) -> np.ndarray:
     return np.ones(len(vals), dtype=bool)
 
 
-def _neutral(op: str, dtype: str):
+def _neutral(op: str, dtype: str, use32: bool = False):
     if op == "add":
         return 0
-    if op == "min":
-        return np.inf if dtype == "f8" else INT_MAX
-    return -np.inf if dtype == "f8" else INT_MIN
+    if dtype == "f8":
+        return np.inf if op == "min" else -np.inf
+    if use32:
+        info = np.iinfo(np.int32)
+        return info.max if op == "min" else info.min
+    return INT_MAX if op == "min" else INT_MIN
 
 
-def _np_dtype(d: str):
+def _np_dtype(d: str, use32: bool = False):
+    if use32:
+        return np.float32 if d == "f8" else np.int32
     return np.float64 if d == "f8" else np.int64
 
 
@@ -357,6 +362,14 @@ class Accumulator:
                  backend: str = "jax"):
         self.specs = specs
         self.backend = backend
+        # TPU v5e has no native int64/float64 (emulated, slow); the
+        # opt-in 32-bit mode keeps device accumulators in int32/float32.
+        # Counts/mins/maxes of bounded values are exact; large sums can
+        # overflow — hence opt-in (config tpu.use_32bit_accumulators)
+        self.use32 = bool(
+            backend == "jax"
+            and getattr(config().tpu, "use_32bit_accumulators", False)
+        )
         self.capacity = capacity  # last slot is scratch for padded rows
         self.phys: List[Tuple[str, str, str, int]] = []  # op,dtype,src,spec_idx
         for si, spec in enumerate(specs):
@@ -387,16 +400,22 @@ class Accumulator:
         if backend == "jax":
             jnp = _get_jax().numpy
             self.state = [
-                jnp.full(capacity, _neutral(op, dt), dtype=_np_dtype(dt))
+                jnp.full(capacity, self._neutral(op, dt), dtype=self._dt(dt))
                 for op, dt, _, _ in self.phys
             ]
             self._update_fn = self._make_update_fn()
             self._gather_fn = self._make_gather_fn()
         else:
             self.state = [
-                np.full(capacity, _neutral(op, dt), dtype=_np_dtype(dt))
+                np.full(capacity, self._neutral(op, dt), dtype=self._dt(dt))
                 for op, dt, _, _ in self.phys
             ]
+
+    def _dt(self, d: str):
+        return _np_dtype(d, self.use32)
+
+    def _neutral(self, op: str, dt: str):
+        return _neutral(op, dt, self.use32)
 
     # -- capacity -----------------------------------------------------------
 
@@ -417,20 +436,22 @@ class Accumulator:
             self.state = [
                 jnp.concatenate(
                     [s, jnp.full(new_cap - self.capacity,
-                                 _neutral(op, dt), dtype=_np_dtype(dt))]
-                ).at[self.capacity - 1].set(_neutral(op, dt))
+                                 self._neutral(op, dt),
+                                 dtype=self._dt(dt))]
+                ).at[self.capacity - 1].set(self._neutral(op, dt))
                 for s, (op, dt, _, _) in zip(self.state, self.phys)
             ]
         else:
             self.state = [
                 np.concatenate(
                     [s, np.full(new_cap - self.capacity,
-                                _neutral(op, dt), dtype=_np_dtype(dt))]
+                                self._neutral(op, dt),
+                                dtype=self._dt(dt))]
                 )
                 for s, (op, dt, _, _) in zip(self.state, self.phys)
             ]
             for (op, dt, _, _), s in zip(self.phys, self.state):
-                s[self.capacity - 1] = _neutral(op, dt)
+                s[self.capacity - 1] = self._neutral(op, dt)
         self.capacity = new_cap
 
     # -- update (hot path) --------------------------------------------------
@@ -468,11 +489,11 @@ class Accumulator:
             if src == "one":
                 vals = valid
             else:
-                vals = np.zeros(padded, dtype=_np_dtype(dt))
+                vals = np.zeros(padded, dtype=self._dt(dt))
                 base = _src_values(spec, src, cols)
                 vals[:n] = base if signs is None else base * signs
                 if op != "add":
-                    vals[n:] = _neutral(op, dt)
+                    vals[n:] = self._neutral(op, dt)
             inputs.append(jnp.asarray(vals))
         self.state = self._update_fn(self.state, jnp.asarray(slots_p), *inputs)
 
@@ -584,7 +605,7 @@ class Accumulator:
                 )
             else:
                 vals = _src_values(spec, src, cols).astype(
-                    _np_dtype(dt), copy=False
+                    self._dt(dt), copy=False
                 )
                 if signs is not None:
                     vals = vals * signs
@@ -648,7 +669,7 @@ class Accumulator:
             return
         if self.backend == "numpy":
             for (op, dt, _, _), s in zip(self.phys, self.state):
-                s[slots] = _neutral(op, dt)
+                s[slots] = self._neutral(op, dt)
             return
         jnp = _get_jax().numpy
         padded = _bucket(len(slots), self._buckets)
@@ -656,14 +677,15 @@ class Accumulator:
         slots_p[: len(slots)] = slots
         if not hasattr(self, "_reset_fn"):
             jax = _get_jax()
-            phys = list(self.phys)
+            neutrals = [
+                self._neutral(op, dt) for op, dt, _, _ in self.phys
+            ]
 
             @partial(jax.jit, donate_argnums=(0,))
             def reset(state, s_idx):
-                out = []
-                for (op, dt, _, _), s in zip(phys, state):
-                    out.append(s.at[s_idx].set(_neutral(op, dt)))
-                return out
+                return [
+                    s.at[s_idx].set(nv) for s, nv in zip(state, neutrals)
+                ]
 
             self._reset_fn = reset
         self.state = self._reset_fn(self.state, jnp.asarray(slots_p))
@@ -748,7 +770,7 @@ class Accumulator:
         gathered = self.gather(slots)
         combined = []
         for (op, dt, _, _), vals in zip(self.phys, gathered):
-            outv = np.full(n_segments, _neutral(op, dt), dtype=_np_dtype(dt))
+            outv = np.full(n_segments, self._neutral(op, dt), dtype=self._dt(dt))
             if op == "add":
                 np.add.at(outv, seg_ids, vals)
             elif op == "min":
@@ -874,8 +896,10 @@ class Accumulator:
                 s.block_until_ready()
 
 
-def make_accumulator(specs: List[AggSpec], capacity: int = 4096,
+def make_accumulator(specs: List[AggSpec], capacity: Optional[int] = None,
                      backend: Optional[str] = None) -> Accumulator:
     if backend is None:
         backend = "jax" if config().tpu.enabled else "numpy"
+    if capacity is None:
+        capacity = int(config().tpu.initial_capacity)
     return Accumulator(specs, capacity, backend)

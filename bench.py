@@ -101,6 +101,17 @@ def child(events: int, backend: str, query: str = "q5") -> None:
 
     config().tpu.enabled = backend == "jax"
     config().pipeline.source_batch_size = 8192
+    if backend == "jax":
+        # keep the XLA program count flat: every (bucket, capacity) pair
+        # specializes update/gather/reset, and compiles through the TPU
+        # relay cost ~20-40s EACH (the round-1 device bench timed out on
+        # compile count alone). One batch bucket + one emission bucket +
+        # pre-sized capacity => ~6-8 programs total.
+        config().tpu.shape_buckets = (8192, 65536)
+        config().tpu.initial_capacity = 1 << 18
+        # v5e-native narrow accumulators (counts stay exact; q5 is
+        # count/max-shaped so no overflow risk at bench scales)
+        config().tpu.use_32bit_accumulators = True
     # ~60s of event time so hop windows fire repeatedly mid-run
     rate = max(events // 60, 1)
     results = []

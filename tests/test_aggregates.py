@@ -168,3 +168,34 @@ def test_count_distinct_multiset_snapshot_roundtrip_ragged():
     acc2.restore(np.array([0, 1]), snap)
     acc2.gather(np.array([0, 1]))
     assert acc2.finalize([])[0].tolist() == [2, 3]
+
+
+def test_32bit_device_accumulators_exact():
+    """The opt-in 32-bit device mode (TPU v5e has no native int64)
+    produces identical results for count/min/max/avg at 32-bit-safe
+    magnitudes."""
+    import numpy as np
+
+    from arroyo_tpu.config import config
+    from arroyo_tpu.ops.aggregates import AggSpec, make_accumulator
+
+    specs = [
+        AggSpec("count", None, "c"),
+        AggSpec("min", 0, "mn"),
+        AggSpec("max", 0, "mx"),
+        AggSpec("avg", 0, "a", is_float=True),
+    ]
+    config().tpu.use_32bit_accumulators = True
+    try:
+        acc = make_accumulator(specs, capacity=64, backend="jax")
+        assert acc.use32
+        vals = np.array([5, -3, 1000000, 7, -3], dtype=np.int64)
+        slots = np.array([1, 1, 2, 2, 1], dtype=np.int64)
+        acc.update(slots, {0: vals.astype(np.float64)})
+        out = acc.finalize(acc.gather(np.array([1, 2])))
+        assert list(out[0]) == [3, 2]              # counts
+        assert list(out[1]) == [-3, 7]             # mins
+        assert list(out[2]) == [5, 1000000]        # maxes
+        assert np.allclose(out[3], [(5 - 3 - 3) / 3, 1000007 / 2])
+    finally:
+        config().tpu.use_32bit_accumulators = False

@@ -12,8 +12,12 @@ ride along as extra fields in the SAME single json line
 {"metric", "value", "unit", "vs_baseline", "q1_eps", "q7_eps", "q8_eps"}.
 
 Each measurement runs in a subprocess so a wedged accelerator tunnel can
-never hang the bench; on device-path failure the CPU number is reported
-with vs_baseline 1.0.
+never hang the bench. On device-path failure: if the round's probe
+daemon (tools/tpu_probe_daemon.py) captured a grant earlier, that real
+device measurement is substituted (with device_source/device_events
+fields and a like-for-like CPU baseline re-measured at the grant's
+event count); otherwise the CPU number is reported with vs_baseline
+1.0. vs_baseline is null when no CPU baseline could be measured at all.
 """
 
 import argparse
@@ -229,15 +233,64 @@ def main():
     cpu_env["JAX_PLATFORMS"] = "cpu"
     baseline = run_child(args.events, "numpy", args.timeout, env=cpu_env)
     device = run_child(args.events, "jax", args.timeout)
+    # The axon relay is intermittently wedged; tools/tpu_probe_daemon.py
+    # probes it all round and converts the first grant into an in-process
+    # device bench recorded in TPU_GRANT.json. If the live device child
+    # failed (relay wedged right now) but a grant was captured earlier in
+    # the round, report that real device measurement instead of silently
+    # falling back to the CPU number.
+    grant_extra = {}
+    live_device = device is not None
+    if device is None:
+        gp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "TPU_GRANT.json")
+        grant = {}
+        try:
+            with open(gp) as f:
+                grant = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass  # absent or mid-write: fall back to CPU number
+        # a grant from a previous round would report a number measured
+        # against older engine code — only trust a fresh capture
+        fresh = False
+        try:
+            import datetime
+            cap = datetime.datetime.strptime(
+                grant.get("captured_at", ""), "%Y-%m-%dT%H:%M:%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+            age = datetime.datetime.now(datetime.timezone.utc) - cap
+            fresh = datetime.timedelta(0) <= age <= datetime.timedelta(hours=24)
+        except ValueError:
+            pass
+        if "q5_eps" in grant and fresh:
+            device = {"eps": grant["q5_eps"],
+                      "rows": grant.get("q5_rows", -1)}
+            grant_extra["device_source"] = (
+                f"probe_daemon_capture@{grant.get('captured_at')}")
+            g_events = grant.get("events", {}).get("q5")
+            for q in ("q1", "q7", "q8"):
+                if f"{q}_eps" in grant:
+                    grant_extra[f"{q}_eps_tpu"] = grant[f"{q}_eps"]
+            if g_events:
+                # the headline value was measured at the grant's event
+                # count, not --events; report that size and re-measure
+                # the CPU baseline at the same count so vs_baseline is
+                # like-for-like
+                grant_extra["device_events"] = g_events
+                if g_events != args.events:
+                    b2 = run_child(g_events, "numpy", args.timeout,
+                                   env=cpu_env)
+                    if b2 is not None:
+                        baseline = b2
     if device is None and baseline is None:
         print(json.dumps({
             "metric": "nexmark_q5_events_per_sec", "value": 0,
-            "unit": "events/s", "vs_baseline": 0.0,
+            "unit": "events/s", "vs_baseline": None,
             "error": "both paths failed",
         }))
         return
-    side_env = cpu_env if device is None else None
-    side_backend = "numpy" if device is None else "jax"
+    side_env = None if live_device else cpu_env
+    side_backend = "jax" if live_device else "numpy"
     sides = {}
     for q in ("q1", "q7", "q8"):
         # half the events: side metrics, not the headline measurement
@@ -267,19 +320,28 @@ def main():
             sys.stderr.write(out.stderr[-2000:] + "\n")
     except subprocess.TimeoutExpired:
         sys.stderr.write("latency child timed out\n")
+    baseline_real = baseline is not None
     if device is None:
         device = baseline
     if baseline is None:
         baseline = device
+    # headline events: a grant-substituted device number was measured at
+    # the grant's own event count, not --events
+    events = grant_extra.get("device_events") or args.events
     print(json.dumps({
         "metric": "nexmark_q5_events_per_sec",
         "value": round(device["eps"], 1),
         "unit": "events/s",
-        "vs_baseline": round(device["eps"] / baseline["eps"], 3),
-        "baseline_cpu_eps": round(baseline["eps"], 1),
-        "events": args.events,
+        # vs_baseline is only meaningful against a real CPU measurement;
+        # null (not 1.0) when the numpy child failed
+        "vs_baseline": round(device["eps"] / baseline["eps"], 3)
+        if baseline_real else None,
+        "baseline_cpu_eps": round(baseline["eps"], 1)
+        if baseline_real else None,
+        "events": events,
         "result_rows": device["rows"],
         **sides,
+        **grant_extra,
     }))
 
 

@@ -83,10 +83,17 @@ class WindowOperatorBase(Operator):
             self.dir = SlotDirectory()
         self._key_types: Optional[List[pa.DataType]] = None
         self._key_names: Optional[List[str]] = None
-        # slot -> (bin, portable key values) for slots touched since the
+        # columnar chunks of (slots, bins, key columns) touched since the
         # last checkpoint; captured at assign time so delta building is
-        # O(dirty), not O(live keys)
-        self._dirty_slots: Dict[int, tuple] = {}
+        # O(dirty), not O(live keys). Kept columnar (numpy) — building a
+        # python tuple per touched slot dominated high-cardinality
+        # workloads. Deduped by slot (keep-last) at delta-build time.
+        self._dirty_chunks: List[tuple] = []
+        # native flat-key layout: when a struct key is flattened into its
+        # int64 child words for the native directory, _flat_widths[i] is
+        # the word count of key column i and _flat_offsets the prefix sums
+        self._flat_widths: Optional[List[int]] = None
+        self._flat_offsets: Optional[List[int]] = None
 
     # operators that only use assign/take_bin/bin_entries/items can swap in
     # the C++ directory for single-integer keys (tumbling, sliding)
@@ -130,13 +137,23 @@ class WindowOperatorBase(Operator):
             ):
                 from ..ops.native import (
                     NativeSlotDirectory,
+                    flat_key_widths,
                     load_native,
-                    supports_native,
                 )
 
-                if supports_native(self._key_types):
+                widths = flat_key_widths(self._key_types)
+                if widths is not None:
+                    # struct keys (window structs) flatten into their int64
+                    # child words; everything rides the native N-key table
+                    if any(pa.types.is_struct(t) for t in self._key_types):
+                        self._flat_widths = widths
+                        self._flat_offsets = [0]
+                        for w in widths:
+                            self._flat_offsets.append(
+                                self._flat_offsets[-1] + w
+                            )
                     self.dir = NativeSlotDirectory(
-                        load_native(), n_keys=len(self._key_types)
+                        load_native(), n_keys=sum(widths)
                     )
 
     def _ensure_capacity(self):
@@ -169,12 +186,24 @@ class WindowOperatorBase(Operator):
                 c = c.view(np.int64)
             elif c.dtype.kind == "M":
                 c = c.view("i8")
-            norm.append(c)
-        for s, i in zip(uniq.tolist(), first.tolist()):
-            self._dirty_slots[s] = (
-                int(bins[i]),
-                tuple(_to_py(c[i]) for c in norm),
-            )
+            norm.append(c[first])
+        self._dirty_chunks.append(
+            (uniq, np.asarray(bins)[first].astype(np.int64, copy=False),
+             norm)
+        )
+
+    def _key_delta_cols(self, key_cols: List[np.ndarray]) -> List[pa.Array]:
+        """Columnar variant of _key_delta_arrays: key columns arrive as the
+        normalized numpy arrays _mark_dirty captured (object arrays for
+        interned types, int64-viewable otherwise)."""
+        out = []
+        for i, kt in enumerate(self._key_types):
+            c = key_cols[i]
+            if _is_interned_type(kt):
+                out.append(pa.array(c.tolist(), type=kt))
+            else:
+                out.append(pa.array(c.astype(np.int64, copy=False)))
+        return out
 
     def _key_delta_arrays(self, key_rows: List[tuple]) -> List[pa.Array]:
         """Portable key tuples -> one arrow array per key column (interned
@@ -225,19 +254,29 @@ class WindowOperatorBase(Operator):
         materializes the RecordBatch (__ts = bin_ts(bin), __bin, __k*,
         __v*) on the flush path — so the device->host copy overlaps the
         next epoch's processing."""
-        if not self._dirty_slots:
+        if not self._dirty_chunks:
             return None
-        dirty = self._dirty_slots
-        self._dirty_slots = {}
-        slots = np.fromiter(dirty.keys(), dtype=np.int64, count=len(dirty))
-        bins = np.asarray([bk[0] for bk in dirty.values()], dtype=np.int64)
-        key_rows = [bk[1] for bk in dirty.values()]
+        chunks = self._dirty_chunks
+        self._dirty_chunks = []
+        slots = np.concatenate([c[0] for c in chunks])
+        bins = np.concatenate([c[1] for c in chunks])
+        n_kc = len(chunks[0][2])
+        key_cols = [
+            np.concatenate([c[2][i] for c in chunks]) for i in range(n_kc)
+        ]
+        # keep the LAST mark per slot: a slot freed and reassigned within
+        # the epoch must write its newest (bin, key)
+        _, idx_rev = np.unique(slots[::-1], return_index=True)
+        keep = len(slots) - 1 - idx_rev
+        slots = slots[keep]
+        bins = bins[keep]
+        key_cols = [c[keep] for c in key_cols]
         values = self.acc.snapshot(slots, materialize=False)
 
         def build() -> pa.RecordBatch:
             arrays = [pa.array(bin_ts(bins)), pa.array(bins)]
             names = ["__ts", "__bin"]
-            for i, arr in enumerate(self._key_delta_arrays(key_rows)):
+            for i, arr in enumerate(self._key_delta_cols(key_cols)):
                 arrays.append(arr)
                 names.append(f"__k{i}")
             for j, v in enumerate(values):
@@ -297,6 +336,14 @@ class WindowOperatorBase(Operator):
         out = []
         for i in self.key_cols:
             col = batch.column(i)
+            if pa.types.is_struct(col.type) and self._flat_widths is not None:
+                # native flat layout: struct children ride as separate
+                # int64 key words — no python tuple per row
+                for j in range(col.type.num_fields):
+                    out.append(
+                        np.asarray(col.field(j).cast(pa.int64()))
+                    )
+                continue
             if pa.types.is_struct(col.type):
                 # struct keys (window structs) become tuples of child values;
                 # tuples are built per UNIQUE row (batches share few windows)
@@ -420,13 +467,30 @@ class WindowOperatorBase(Operator):
                 ki = self._key_names.index(f.name)
                 kt = self._key_types[ki]
                 if key_arrays is not None:
-                    arr = key_arrays[ki]
-                    if pa.types.is_unsigned_integer(kt):
+                    off = (self._flat_offsets[ki]
+                           if self._flat_offsets is not None else ki)
+                    if pa.types.is_struct(kt):
+                        # flat layout: regroup the struct's child words
+                        children = [
+                            pa.array(key_arrays[off + j]).cast(
+                                kt.field(j).type
+                            )
+                            for j in range(kt.num_fields)
+                        ]
                         arrays.append(
-                            pa.array(arr.view(np.uint64), type=kt)
+                            pa.StructArray.from_arrays(
+                                children,
+                                names=[kt.field(j).name
+                                       for j in range(kt.num_fields)],
+                            )
+                        )
+                    elif pa.types.is_unsigned_integer(kt):
+                        arrays.append(
+                            pa.array(key_arrays[off].view(np.uint64),
+                                     type=kt)
                         )
                     else:  # signed ints and timestamps cast directly
-                        arrays.append(pa.array(arr).cast(kt))
+                        arrays.append(pa.array(key_arrays[off]).cast(kt))
                     continue
                 vals = [_to_py(k[ki]) for k in keys]
                 if pa.types.is_struct(kt):
@@ -481,6 +545,18 @@ class WindowOperatorBase(Operator):
 
     def _key_tuple_to_values(self, key: tuple) -> list:
         """Directory key tuple (codes) -> portable key values."""
+        if self._flat_widths is not None:
+            # native flat layout: struct child words regroup into the
+            # portable tuple form (plain ints — nothing is interned here)
+            out = []
+            off = 0
+            for ki, w in enumerate(self._flat_widths):
+                if pa.types.is_struct(self._key_types[ki]):
+                    out.append(tuple(int(x) for x in key[off:off + w]))
+                else:
+                    out.append(_to_py(key[off]))
+                off += w
+            return out
         out = []
         for ki, k in enumerate(key):
             if _is_interned_type(self._key_types[ki]):
@@ -521,7 +597,14 @@ class WindowOperatorBase(Operator):
         key_cols = []
         for i in range(n_keycols):
             vals = [k[i] for k in keys]
-            if _is_interned_type(self._key_types[i]):
+            kt = self._key_types[i]
+            if self._flat_widths is not None and pa.types.is_struct(kt):
+                # flat native layout: portable struct tuples -> child words
+                mat = np.asarray([list(v) for v in vals], dtype=np.int64)
+                key_cols.extend(
+                    mat[:, j] for j in range(self._flat_widths[i])
+                )
+            elif _is_interned_type(kt):
                 # dtype=object routes through the interning path in assign()
                 key_cols.append(np.asarray(vals, dtype=object))
             else:
@@ -549,8 +632,11 @@ class WindowOperatorBase(Operator):
         # rows restored from a legacy full snapshot have no delta files;
         # mark them dirty so the first incremental checkpoint after restore
         # persists them (otherwise a later crash would lose every group not
-        # touched since the format upgrade)
-        self._mark_dirty(slots, bins_arr, key_cols)
+        # touched since the format upgrade). Non-incremental operators
+        # snapshot the whole directory anyway — marking would only grow
+        # chunks nothing ever drains.
+        if self._use_incremental():
+            self._mark_dirty(slots, bins_arr, key_cols)
 
     def _range_mask(self, keys: List[list], ctx) -> Optional[List[bool]]:
         """True per row iff the key hashes into this subtask's range."""
@@ -566,8 +652,15 @@ class WindowOperatorBase(Operator):
             kt = self._key_types[i]
             # dtype must match what the shuffle hashed (schema.hash_keys)
             if pa.types.is_struct(kt):
-                # shuffle hashes struct children in order
-                tuples = [unintern_value(_to_py(v)) for v in vals]
+                # shuffle hashes struct children in order. Portable
+                # snapshot values are the tuples themselves (msgpack may
+                # hand them back as lists); in-process session bookkeeping
+                # passes interned codes
+                tuples = [
+                    unintern_value(v) if isinstance(v, (int, np.integer))
+                    else tuple(v)
+                    for v in (_to_py(v) for v in vals)
+                ]
                 for j in range(kt.num_fields):
                     cols.append(hash_column(
                         np.asarray([t[j] for t in tuples], dtype=np.int64)
@@ -701,7 +794,7 @@ class TumblingWindowOperator(WindowOperatorBase):
         keys = self._key_arrays(batch)
         slots = self.dir.assign(bins, keys)
         self._ensure_capacity()
-        if self._use_incremental():
+        if ctx.table_manager is not None and self._use_incremental():
             self._mark_dirty(slots, bins, keys)
         self.acc.update(slots, self._agg_input_cols(batch))
 
@@ -710,19 +803,28 @@ class TumblingWindowOperator(WindowOperatorBase):
             return watermark
         t = watermark.timestamp
         limit = _ceil_div(t, self.width) if self.width else t + 1
+        take_arrays = getattr(self.dir, "take_bin_arrays", None)
         for b in self.dir.bins_up_to(limit):
             end = self._bin_end(b)
             if end > t:
                 continue
-            keys, slots = self.dir.take_bin(b)
+            if take_arrays is not None:
+                # native fast path: key columns stay numpy end-to-end
+                key_arrays, slots = take_arrays(b)
+                keys: List[tuple] = []
+            else:
+                keys, slots = self.dir.take_bin(b)
+                key_arrays = None
             gathered = self.acc.gather(slots)
             agg_cols = self.acc.finalize(gathered)
             self.acc.reset_slots(slots)
             if self.width:
-                out = self._build_output(keys, agg_cols, b * self.width, end)
+                out = self._build_output(keys, agg_cols, b * self.width, end,
+                                         key_arrays=key_arrays)
             else:
                 # instant mode: preserve the window's timestamp exactly
-                out = self._build_output(keys, agg_cols, b, b, ts_value=b)
+                out = self._build_output(keys, agg_cols, b, b, ts_value=b,
+                                         key_arrays=key_arrays)
             await collector.collect(out)
             self.emitted_up_to = max(self.emitted_up_to or 0, end)
         return watermark
@@ -811,7 +913,7 @@ class SlidingWindowOperator(WindowOperatorBase):
         keys = self._key_arrays(batch)
         slots = self.dir.assign(bins, keys)
         self._ensure_capacity()
-        if self._use_incremental():
+        if ctx.table_manager is not None and self._use_incremental():
             self._mark_dirty(slots, bins, keys)
         self.acc.update(slots, self._agg_input_cols(batch))
 
@@ -843,15 +945,30 @@ class SlidingWindowOperator(WindowOperatorBase):
             all_slots = np.concatenate(slot_chunks)
             key_arrays = None
             if isinstance(key_chunks[0], np.ndarray):
-                # native path: vectorized key-union over int64 arrays; keys
-                # stay numpy end-to-end (no python tuple per key)
+                # native path: vectorized key-union over int64 key matrices
+                # (count, n_keycols); keys stay numpy end-to-end (no python
+                # tuple per key)
                 all_keys = np.concatenate(key_chunks)
-                uniq, seg_ids = np.unique(all_keys, return_inverse=True)
+                if all_keys.shape[1] == 1:
+                    # 1-D unique is markedly faster than axis=0
+                    u1, seg_ids = np.unique(
+                        all_keys[:, 0], return_inverse=True
+                    )
+                    uniq = u1[:, None]
+                else:
+                    uniq, seg_ids = np.unique(
+                        all_keys, axis=0, return_inverse=True
+                    )
+                seg_ids = np.asarray(seg_ids).ravel()
                 if self.key_cols:
                     out_keys = []
-                    key_arrays = [uniq]
+                    # one column per flat key word (struct children ride
+                    # as separate words under the flat layout)
+                    key_arrays = [
+                        uniq[:, j] for j in range(uniq.shape[1])
+                    ]
                 else:
-                    out_keys = [() for _ in uniq]
+                    out_keys = [() for _ in range(len(uniq))]
                 n_keys = len(uniq)
             else:
                 index: Dict[tuple, int] = {}
@@ -873,8 +990,13 @@ class SlidingWindowOperator(WindowOperatorBase):
                 key_arrays=key_arrays,
             )
             await collector.collect(out_batch)
-        # the oldest bin exits the window range: free it
-        _, freed = self.dir.take_bin(lo_bin)
+        # the oldest bin exits the window range: free it (vectorized take
+        # on the native directory — the keys are discarded anyway)
+        take_arrays = getattr(self.dir, "take_bin_arrays", None)
+        if take_arrays is not None:
+            _, freed = take_arrays(lo_bin)
+        else:
+            _, freed = self.dir.take_bin(lo_bin)
         if len(freed):
             self.acc.reset_slots(freed)
         self.last_freed_bin = max(self.last_freed_bin or lo_bin, lo_bin)

@@ -32,20 +32,20 @@ def load_native():
         native_dir = os.path.join(repo_root, "native")
         sys.path.insert(0, native_dir)
         try:
-            try:
-                import arroyo_native  # noqa: F401
-            except ImportError:
-                from importlib import invalidate_caches
+            # always run the (mtime-cached) build first: importing an
+            # existing .so without the check would silently use a stale
+            # binary after slotdir.cpp changes
+            from importlib import invalidate_caches
 
-                build_py = os.path.join(native_dir, "build.py")
-                import importlib.util
+            build_py = os.path.join(native_dir, "build.py")
+            import importlib.util
 
-                spec = importlib.util.spec_from_file_location("_anb", build_py)
-                mod = importlib.util.module_from_spec(spec)
-                spec.loader.exec_module(mod)
-                mod.build()
-                invalidate_caches()
-                import arroyo_native  # noqa: F401
+            spec = importlib.util.spec_from_file_location("_anb", build_py)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.build()
+            invalidate_caches()
+            import arroyo_native  # noqa: F401
         finally:
             # the extension stays imported; nothing else should resolve
             # through native/ (it contains a generic build.py)
@@ -59,14 +59,28 @@ def load_native():
     return _native
 
 
+def _i64_view(c: np.ndarray) -> np.ndarray:
+    c = np.asarray(c)
+    if c.dtype == np.uint64:
+        return c.view(np.int64)
+    if c.dtype.kind == "M":
+        return c.view("i8")
+    return c
+
+
 class NativeSlotDirectory:
-    """Single-int64-key directory over the C++ open-addressing table,
+    """N-int64-key directory over the C++ open-addressing table,
     API-compatible with ops.directory.SlotDirectory for the paths the
-    window operators use. Keys surface as 1-tuples like the python impl."""
+    window operators use (assign/take_bin/bin_entries/items/peek_bin).
+    Keys surface as n-tuples like the python impl; `take_bin_arrays`
+    and the 2-D `bin_entries` matrix are the vectorized emission paths
+    (no python tuple per key)."""
 
     def __init__(self, native_mod, n_keys: int = 1):
-        self._d = native_mod.SlotDir()
-        self.n_keys = n_keys  # 0 = unkeyed (synthetic zero key, empty tuples)
+        # n_keys 0 = unkeyed: one synthetic zero key word, empty tuples out
+        self.n_keys = n_keys
+        self._stride = max(1, n_keys)
+        self._d = native_mod.SlotDir(self._stride)
         self.free: list = []  # parity attribute; slot reuse lives natively
 
     @property
@@ -77,28 +91,51 @@ class NativeSlotDirectory:
         return self._d.required_capacity()
 
     def assign(self, bins: np.ndarray, key_cols: List[np.ndarray]) -> np.ndarray:
-        key = key_cols[0] if key_cols else np.zeros(len(bins), dtype=np.int64)
-        if key.dtype == np.uint64:
-            key = key.view(np.int64)
+        n = len(bins)
+        if not key_cols:
+            flat = np.zeros(n, dtype=np.int64)
+        elif self._stride == 1:
+            flat = np.ascontiguousarray(_i64_view(key_cols[0]),
+                                        dtype=np.int64)
+        else:
+            mat = np.empty((n, self._stride), dtype=np.int64)
+            for j, c in enumerate(key_cols):
+                mat[:, j] = _i64_view(c)
+            flat = mat.reshape(-1)
         out = self._d.assign(
-            np.ascontiguousarray(bins, dtype=np.int64),
-            np.ascontiguousarray(key, dtype=np.int64),
+            np.ascontiguousarray(bins, dtype=np.int64), flat
         )
         return np.frombuffer(out, dtype=np.int64)
 
+    def _keys_matrix(self, keys_raw: bytes) -> np.ndarray:
+        return np.frombuffer(keys_raw, dtype=np.int64).reshape(
+            -1, self._stride
+        )
+
     def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
         keys_raw, slots_raw = self._d.take_bin(int(b))
-        keys = np.frombuffer(keys_raw, dtype=np.int64)
+        keys = self._keys_matrix(keys_raw)
         slots = np.frombuffer(slots_raw, dtype=np.int64).copy()
         if self.n_keys == 0:
-            return [() for _ in keys], slots
-        return [(int(k),) for k in keys], slots
+            return [() for _ in range(len(slots))], slots
+        return [tuple(int(x) for x in row) for row in keys], slots
+
+    def take_bin_arrays(
+        self, b: int
+    ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """Vectorized take_bin: key columns as int64 arrays (the synthetic
+        zero column when unkeyed — callers use it only for row count)."""
+        keys_raw, slots_raw = self._d.take_bin(int(b))
+        keys = self._keys_matrix(keys_raw)
+        slots = np.frombuffer(slots_raw, dtype=np.int64).copy()
+        return [keys[:, j] for j in range(self._stride)], slots
 
     def bin_entries(self, b: int) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys int64, slots int64) of a live bin, without removal."""
+        """(keys int64 matrix (count, stride), slots int64) of a live bin,
+        without removal."""
         keys_raw, slots_raw = self._d.get_bin(int(b))
         return (
-            np.frombuffer(keys_raw, dtype=np.int64),
+            self._keys_matrix(keys_raw),
             np.frombuffer(slots_raw, dtype=np.int64),
         )
 
@@ -113,7 +150,7 @@ class NativeSlotDirectory:
             return None
         if self.n_keys == 0:
             return {(): None}
-        return {(int(k),): None for k in keys}
+        return {tuple(int(x) for x in row): None for row in keys}
 
     def live_bins(self) -> List[int]:
         return sorted(self._d.live_bins())
@@ -124,23 +161,41 @@ class NativeSlotDirectory:
     def items(self):
         bins_raw, keys_raw, slots_raw = self._d.entries()
         bins = np.frombuffer(bins_raw, dtype=np.int64)
-        keys = np.frombuffer(keys_raw, dtype=np.int64)
+        keys = self._keys_matrix(keys_raw)
         slots = np.frombuffer(slots_raw, dtype=np.int64)
-        for b, k, s in zip(bins, keys, slots):
-            yield int(b), (() if self.n_keys == 0 else (int(k),)), int(s)
+        for i in range(len(bins)):
+            k = () if self.n_keys == 0 else tuple(
+                int(x) for x in keys[i]
+            )
+            yield int(bins[i]), k, int(slots[i])
 
 
-def supports_native(key_types) -> bool:
-    """Native fast path: zero or one key column of integer/timestamp type."""
-    if load_native() is None:
-        return False
-    if len(key_types) > 1:
-        return False
-    if not key_types:
-        return True
+def _i64able(t) -> bool:
     import pyarrow as pa
 
-    t = key_types[0]
     # bool keys stay on the python path: native returns python ints and
     # pa.array(ints, type=bool_) is rejected at emission
     return pa.types.is_integer(t) or pa.types.is_timestamp(t)
+
+
+def flat_key_widths(key_types):
+    """Per-key-column int64 word counts for the native directory, or None
+    when any column can't ride it. Struct columns (window structs) flatten
+    into their child words when every child is integer/timestamp."""
+    if load_native() is None:
+        return None
+    import pyarrow as pa
+
+    widths = []
+    for t in key_types:
+        if pa.types.is_struct(t):
+            if t.num_fields == 0 or not all(
+                _i64able(t.field(j).type) for j in range(t.num_fields)
+            ):
+                return None
+            widths.append(t.num_fields)
+        elif _i64able(t):
+            widths.append(1)
+        else:
+            return None
+    return widths if sum(widths) <= 16 else None

@@ -23,10 +23,11 @@ namespace {
 
 struct Entry {
     int64_t bin;
-    int64_t key;
     int64_t slot;
     int32_t next_in_bin;  // index of next entry of the same bin, -1 = end
     uint8_t live;
+    // key words live in SlotDir::keypool at [idx*stride, (idx+1)*stride):
+    // entry indices are recycled, so the pool space recycles with them
 };
 
 struct BinHead {
@@ -43,15 +44,19 @@ static inline uint64_t splitmix64(uint64_t x) {
     return x ^ (x >> 31);
 }
 
-static inline uint64_t hash_pair(int64_t bin, int64_t key) {
-    return splitmix64(splitmix64((uint64_t)bin) ^ (uint64_t)key);
+static inline uint64_t hash_row(int64_t bin, const int64_t* keys,
+                                int stride) {
+    uint64_t h = splitmix64((uint64_t)bin);
+    for (int j = 0; j < stride; j++) h = splitmix64(h ^ (uint64_t)keys[j]);
+    return h;
 }
 
 struct SlotDir {
     PyObject_HEAD
-    // open-addressing index: maps hash(bin,key) -> entry idx (+1, 0=empty)
+    // open-addressing index: maps hash(bin,keys) -> entry idx (+1, 0=empty)
     std::vector<int32_t>* index;
     std::vector<Entry>* entries;
+    std::vector<int64_t>* keypool;       // stride words per entry index
     std::vector<int32_t>* free_entries;  // recycled entry indices
     std::vector<int64_t>* free_slots;
     std::vector<BinHead>* bin_index;  // open addressing over bins
@@ -61,7 +66,12 @@ struct SlotDir {
     int64_t n_bins_used; // bin heads marked used (live or emptied)
     size_t mask;
     size_t bin_mask;
+    int stride;          // int64 key words per entry (>= 1)
 };
+
+static inline const int64_t* entry_keys(const SlotDir* self, size_t idx) {
+    return self->keypool->data() + idx * self->stride;
+}
 
 static void rehash(SlotDir* self, size_t new_size) {
     std::vector<int32_t> fresh(new_size, 0);
@@ -69,7 +79,7 @@ static void rehash(SlotDir* self, size_t new_size) {
     for (size_t i = 0; i < self->entries->size(); i++) {
         const Entry& e = (*self->entries)[i];
         if (!e.live) continue;
-        size_t h = hash_pair(e.bin, e.key) & mask;
+        size_t h = hash_row(e.bin, entry_keys(self, i), self->stride) & mask;
         while (fresh[h] != 0) h = (h + 1) & mask;
         fresh[h] = (int32_t)i + 1;
     }
@@ -123,11 +133,14 @@ static BinHead* bin_lookup(SlotDir* self, int64_t bin, bool create) {
     }
 }
 
-static PyObject* SlotDir_new(PyTypeObject* type, PyObject*, PyObject*) {
+static PyObject* SlotDir_new(PyTypeObject* type, PyObject* args, PyObject*) {
+    int n_keys = 1;
+    if (args && !PyArg_ParseTuple(args, "|i", &n_keys)) return nullptr;
     SlotDir* self = (SlotDir*)type->tp_alloc(type, 0);
     if (!self) return nullptr;
     self->index = new std::vector<int32_t>(4096, 0);
     self->entries = new std::vector<Entry>();
+    self->keypool = new std::vector<int64_t>();
     self->free_entries = new std::vector<int32_t>();
     self->free_slots = new std::vector<int64_t>();
     self->bin_index = new std::vector<BinHead>(1024);
@@ -137,12 +150,14 @@ static PyObject* SlotDir_new(PyTypeObject* type, PyObject*, PyObject*) {
     self->n_bins_used = 0;
     self->mask = 4095;
     self->bin_mask = 1023;
+    self->stride = n_keys < 1 ? 1 : n_keys;
     return (PyObject*)self;
 }
 
 static void SlotDir_dealloc(SlotDir* self) {
     delete self->index;
     delete self->entries;
+    delete self->keypool;
     delete self->free_entries;
     delete self->free_slots;
     delete self->bin_index;
@@ -160,7 +175,8 @@ static int get_i64_buffer(PyObject* obj, Py_buffer* view) {
     return 0;
 }
 
-// assign(bins, keys) -> bytes holding int64 slots
+// assign(bins, keys) -> bytes holding int64 slots. keys is row-major
+// int64 with `stride` words per row (n_rows * stride total).
 static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
     PyObject *bins_obj, *keys_obj;
     if (!PyArg_ParseTuple(args, "OO", &bins_obj, &keys_obj)) return nullptr;
@@ -171,6 +187,14 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
         return nullptr;
     }
     Py_ssize_t n = bins.len / 8;
+    const int stride = self->stride;
+    if (keys.len / 8 != n * stride) {
+        PyBuffer_Release(&bins);
+        PyBuffer_Release(&keys);
+        PyErr_SetString(PyExc_ValueError,
+                        "keys length != n_rows * stride");
+        return nullptr;
+    }
     PyObject* out = PyBytes_FromStringAndSize(nullptr, n * 8);
     if (!out) {
         PyBuffer_Release(&bins);
@@ -181,6 +205,7 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
     const int64_t* b = (const int64_t*)bins.buf;
     const int64_t* k = (const int64_t*)keys.buf;
     for (Py_ssize_t i = 0; i < n; i++) {
+        const int64_t* krow = k + i * stride;
         // occupancy (live + tombstoned refs) drives the load factor; a
         // rehash drops tombstones, growing only when live entries need it
         if ((self->n_used + 1) * 4 > (int64_t)self->index->size() * 3) {
@@ -188,14 +213,16 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
             if ((self->n_live + 1) * 4 > (int64_t)size * 3) size *= 2;
             rehash(self, size);
         }
-        size_t h = hash_pair(b[i], k[i]) & self->mask;
+        size_t h = hash_row(b[i], krow, stride) & self->mask;
         int32_t entry_idx = -1;
         int64_t first_dead = -1;
         for (;;) {
             int32_t slot_ref = (*self->index)[h];
             if (slot_ref == 0) break;
             Entry& e = (*self->entries)[slot_ref - 1];
-            if (e.live && e.bin == b[i] && e.key == k[i]) {
+            if (e.live && e.bin == b[i] &&
+                memcmp(entry_keys(self, slot_ref - 1), krow,
+                       stride * sizeof(int64_t)) == 0) {
                 entry_idx = slot_ref - 1;
                 break;
             }
@@ -224,11 +251,13 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
         } else {
             idx = (int32_t)self->entries->size();
             self->entries->push_back(Entry());
+            self->keypool->resize(self->entries->size() * stride);
         }
         BinHead* bh = bin_lookup(self, b[i], true);
         Entry& e = (*self->entries)[idx];
         e.bin = b[i];
-        e.key = k[i];
+        memcpy(self->keypool->data() + (size_t)idx * stride, krow,
+               stride * sizeof(int64_t));
         e.slot = slot;
         e.live = 1;
         e.next_in_bin = bh->head;
@@ -244,14 +273,17 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
     return out;
 }
 
-// take_bin(bin) -> (keys_bytes, slots_bytes); removes the bin
+// take_bin(bin) -> (keys_bytes, slots_bytes); removes the bin. keys carry
+// stride int64 words per entry, row-major.
 static PyObject* SlotDir_take_bin(SlotDir* self, PyObject* args) {
     int64_t bin;
     if (!PyArg_ParseTuple(args, "L", &bin)) return nullptr;
     BinHead* bh = bin_lookup(self, bin, false);
     int32_t count = bh ? bh->count : 0;
-    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
-    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    const int stride = self->stride;
+    PyObject* keys = PyBytes_FromStringAndSize(
+        nullptr, (Py_ssize_t)count * 8 * stride);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)count * 8);
     if (!keys || !slots) return nullptr;
     int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
     int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
@@ -260,7 +292,8 @@ static PyObject* SlotDir_take_bin(SlotDir* self, PyObject* args) {
         int32_t i = 0;
         while (idx >= 0) {
             Entry& e = (*self->entries)[idx];
-            kout[i] = e.key;
+            memcpy(kout + (size_t)i * stride, entry_keys(self, idx),
+                   stride * sizeof(int64_t));
             sout[i] = e.slot;
             i++;
             // remove from the open-addressing index lazily: mark dead and
@@ -286,8 +319,10 @@ static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
     if (!PyArg_ParseTuple(args, "L", &bin)) return nullptr;
     BinHead* bh = bin_lookup(self, bin, false);
     int32_t count = bh ? bh->count : 0;
-    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
-    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    const int stride = self->stride;
+    PyObject* keys = PyBytes_FromStringAndSize(
+        nullptr, (Py_ssize_t)count * 8 * stride);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)count * 8);
     if (!keys || !slots) return nullptr;
     int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
     int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
@@ -296,7 +331,8 @@ static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
         int32_t i = 0;
         while (idx >= 0) {
             const Entry& e = (*self->entries)[idx];
-            kout[i] = e.key;
+            memcpy(kout + (size_t)i * stride, entry_keys(self, idx),
+                   stride * sizeof(int64_t));
             sout[i] = e.slot;
             i++;
             idx = e.next_in_bin;
@@ -308,18 +344,22 @@ static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
 // entries() -> (bins_bytes, keys_bytes, slots_bytes) over all live entries
 static PyObject* SlotDir_entries(SlotDir* self, PyObject*) {
     int64_t count = self->n_live;
+    const int stride = self->stride;
     PyObject* bins = PyBytes_FromStringAndSize(nullptr, count * 8);
-    PyObject* keys = PyBytes_FromStringAndSize(nullptr, count * 8);
-    PyObject* slots = PyBytes_FromStringAndSize(nullptr, count * 8);
+    PyObject* keys = PyBytes_FromStringAndSize(
+        nullptr, (Py_ssize_t)count * 8 * stride);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)count * 8);
     if (!bins || !keys || !slots) return nullptr;
     int64_t* bout = (int64_t*)PyBytes_AS_STRING(bins);
     int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
     int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
     int64_t i = 0;
-    for (const Entry& e : *self->entries) {
+    for (size_t idx = 0; idx < self->entries->size(); idx++) {
+        const Entry& e = (*self->entries)[idx];
         if (!e.live) continue;
         bout[i] = e.bin;
-        kout[i] = e.key;
+        memcpy(kout + (size_t)i * stride, entry_keys(self, idx),
+               stride * sizeof(int64_t));
         sout[i] = e.slot;
         i++;
     }

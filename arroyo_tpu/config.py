@@ -95,6 +95,11 @@ class TpuConfig:
     # all_to_all instead of the host hash shuffle (parallel/sharded_state)
     mesh_devices: int = 0
     mesh_rows_per_shard: int = 1024  # all_to_all rows per (src, dst) cell
+    # run the bin-local equi-join probe as jitted XLA programs
+    # (ops/device_join.py); joins below the row threshold stay on the
+    # host arrow join, where the device round-trip isn't worth it
+    device_join: bool = True
+    device_join_min_rows: int = 4096
 
 
 @dataclasses.dataclass

@@ -65,6 +65,66 @@ class JoinBase(Operator):
             return None
         return batch.filter(pa.array(mask))
 
+    def _device_inner_join(
+        self, left_nt: pa.Table, right_nt: pa.Table
+    ) -> Optional[pa.Table]:
+        """Bin-local inner equi-join via the jitted device probe
+        (ops/device_join.py), producing the same column layout as
+        pa.Table.join(..., coalesce_keys=True, right_suffix='_right').
+        Returns None when the device path doesn't apply (disabled, too
+        small, non-integer or nullable keys) — caller falls back to the
+        arrow host join."""
+        from ..config import config
+
+        cfg = config().tpu
+        if not (cfg.enabled and cfg.device_join):
+            return None
+        if left_nt.num_rows + right_nt.num_rows < cfg.device_join_min_rows:
+            return None
+        from ..ops import device_join
+
+        if not device_join.available():
+            return None
+        lkeys = [f"__key{i}" for i in range(self.n_keys)]
+        lcols = device_join.key_cols_i64(left_nt, lkeys)
+        rcols = device_join.key_cols_i64(right_nt, lkeys)
+        if lcols is None or rcols is None:
+            return None
+        li, ri = device_join.probe(lcols, rcols)
+        l_take = pa.array(li)
+        r_take = pa.array(ri)
+        arrays, names = [], []
+        lset = set(left_nt.column_names)
+        for name in left_nt.column_names:
+            arrays.append(left_nt.column(name).take(l_take))
+            names.append(name)
+        for name in right_nt.column_names:
+            if name in lkeys:
+                continue  # coalesced join keys
+            out = name + "_right" if name in lset else name
+            arrays.append(right_nt.column(name).take(r_take))
+            names.append(out)
+        # from_arrays, not a dict: duplicate output names must survive
+        # exactly like the arrow join's suffix behavior
+        return pa.Table.from_arrays(arrays, names=names)
+
+    def _inner_join(self, left_nt: pa.Table, right_nt: pa.Table) -> pa.Table:
+        """Inner equi-join on the __key columns: device probe when
+        eligible, arrow C++ hash join otherwise."""
+        joined = self._device_inner_join(left_nt, right_nt)
+        if joined is not None:
+            return joined
+        lkeys = [f"__key{i}" for i in range(self.n_keys)]
+        return left_nt.join(
+            right_nt,
+            keys=lkeys,
+            right_keys=lkeys,
+            join_type="inner",
+            left_suffix="",
+            right_suffix="_right",
+            coalesce_keys=True,
+        )
+
     def _join_tables(
         self, left: pa.Table, right: pa.Table, ts_value: int
     ) -> Optional[pa.RecordBatch]:
@@ -80,15 +140,18 @@ class JoinBase(Operator):
         left_nt = _flatten_structs(left.drop_columns([TIMESTAMP_FIELD]))
         right_nt = _flatten_structs(right.drop_columns([TIMESTAMP_FIELD]))
         if self.residual is None or self.join_type == "inner":
-            joined = left_nt.join(
-                right_nt,
-                keys=lkeys,
-                right_keys=lkeys,
-                join_type=_JOIN_TYPE_MAP[self.join_type],
-                left_suffix="",
-                right_suffix="_right",
-                coalesce_keys=True,
-            )
+            if self.join_type == "inner":
+                joined = self._inner_join(left_nt, right_nt)
+            else:
+                joined = left_nt.join(
+                    right_nt,
+                    keys=lkeys,
+                    right_keys=lkeys,
+                    join_type=_JOIN_TYPE_MAP[self.join_type],
+                    left_suffix="",
+                    right_suffix="_right",
+                    coalesce_keys=True,
+                )
             batch = self._project(joined, ts_value)
             if batch is None:
                 return None
@@ -104,15 +167,7 @@ class JoinBase(Operator):
         right_i = right_nt.append_column(
             "__ridx", pa.array(np.arange(right_nt.num_rows, dtype=np.int64))
         )
-        joined = left_i.join(
-            right_i,
-            keys=lkeys,
-            right_keys=lkeys,
-            join_type="inner",
-            left_suffix="",
-            right_suffix="_right",
-            coalesce_keys=True,
-        )
+        joined = self._inner_join(left_i, right_i)
         parts: List[pa.RecordBatch] = []
         matched_l = np.empty(0, dtype=np.int64)
         matched_r = np.empty(0, dtype=np.int64)
@@ -539,11 +594,7 @@ class JoinWithExpirationOperator(JoinBase):
         rt2 = _flatten_structs(rt.rename_columns(
             [c if c != TIMESTAMP_FIELD else "__rts" for c in rt.column_names]
         ))
-        lkeys = [f"__key{i}" for i in range(self.n_keys)]
-        joined = lt2.join(
-            rt2, keys=lkeys, right_keys=lkeys, join_type="inner",
-            left_suffix="", right_suffix="_right", coalesce_keys=True,
-        )
+        joined = self._inner_join(lt2, rt2)
         if joined.num_rows == 0:
             return None
         ts = pc.max_element_wise(

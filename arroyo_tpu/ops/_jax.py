@@ -1,0 +1,20 @@
+"""Shared deferred-jax bootstrap.
+
+jax is imported lazily so host-only deployments can import the module
+tree without pulling in the accelerator stack; every device-path module
+must see the same config (x64 enabled — the engine's timestamps, keys
+and integer accumulators are 64-bit)."""
+
+from __future__ import annotations
+
+_jax = None
+
+
+def get_jax():
+    global _jax
+    if _jax is None:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _jax = jax
+    return _jax

@@ -29,17 +29,7 @@ import numpy as np
 from ..config import config
 
 # jax import deferred so host-only deployments can import the module tree
-_jax = None
-
-
-def _get_jax():
-    global _jax
-    if _jax is None:
-        import jax
-
-        jax.config.update("jax_enable_x64", True)
-        _jax = jax
-    return _jax
+from ._jax import get_jax as _get_jax
 
 
 INT_MIN = np.iinfo(np.int64).min

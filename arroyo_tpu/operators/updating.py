@@ -37,6 +37,13 @@ from .windows import WindowOperatorBase, _is_interned_type, _to_py
 
 
 class UpdatingAggregateOperator(WindowOperatorBase):
+    # slot-based state protocol end-to-end (single bin 0): the accumulator
+    # shards across the device mesh like tumbling/sliding; key->shard
+    # routing happens in MeshSlotDirectory.assign and updates ride the
+    # in-step all_to_all (reference incremental_aggregator.rs:77-90 treats
+    # the updating aggregate like any keyed operator)
+    _mesh_ok = True
+
     def __init__(self, config: dict):
         super().__init__(config, "updating_aggregate")
         from ..config import config as get_config

@@ -106,6 +106,10 @@ class WindowOperatorBase(Operator):
     def _mesh_devices(self, config: dict) -> int:
         if not self._mesh_ok or self.backend == "numpy":
             return 0
+        return self._cfg_mesh_devices(config)
+
+    @staticmethod
+    def _cfg_mesh_devices(config: dict) -> int:
         from ..config import config as config_fn
 
         n = config.get("mesh_devices")
@@ -432,23 +436,26 @@ class WindowOperatorBase(Operator):
         """Build an output batch for one window [start, end). `key_arrays`
         (one int64 array per key column, raw directory bit-patterns) is the
         vectorized fast path used by the native-directory emit — no python
-        tuple per key."""
+        tuple per key. start/end/ts_value may be scalars (one window) or
+        per-row arrays (batched session emission)."""
         n = len(key_arrays[0]) if key_arrays is not None else len(keys)
+
+        def const_or_arr(v):
+            if isinstance(v, np.ndarray):
+                return v.astype(np.int64, copy=False)
+            return np.full(n, v, dtype=np.int64)
+
         window_field = getattr(self, "window_field", None)
         arrays = []
         for f in self.out_schema.schema:
             if f.name == TIMESTAMP_FIELD:
                 ts = ts_value if ts_value is not None else end - 1
                 arrays.append(
-                    pa.array(np.full(n, ts, dtype=np.int64)).cast(f.type)
+                    pa.array(const_or_arr(ts)).cast(f.type)
                 )
             elif f.name == window_field and pa.types.is_struct(f.type):
-                s = pa.array(np.full(n, start, dtype=np.int64)).cast(
-                    f.type.field(0).type
-                )
-                e = pa.array(np.full(n, end, dtype=np.int64)).cast(
-                    f.type.field(1).type
-                )
+                s = pa.array(const_or_arr(start)).cast(f.type.field(0).type)
+                e = pa.array(const_or_arr(end)).cast(f.type.field(1).type)
                 arrays.append(
                     pa.StructArray.from_arrays(
                         [s, e], names=[f.type.field(0).name,
@@ -457,11 +464,11 @@ class WindowOperatorBase(Operator):
                 )
             elif f.name == self.window_start_field:
                 arrays.append(
-                    pa.array(np.full(n, start, dtype=np.int64)).cast(f.type)
+                    pa.array(const_or_arr(start)).cast(f.type)
                 )
             elif f.name == self.window_end_field:
                 arrays.append(
-                    pa.array(np.full(n, end, dtype=np.int64)).cast(f.type)
+                    pa.array(const_or_arr(end)).cast(f.type)
                 )
             elif f.name in (self._key_names or []):
                 ki = self._key_names.index(f.name)
@@ -1005,17 +1012,34 @@ class SlidingWindowOperator(WindowOperatorBase):
 class SessionWindowOperator(WindowOperatorBase):
     """Per-key gap-merged sessions
     (reference session_aggregating_window.rs:51-942). Session bookkeeping is
-    inherently scalar, so this operator runs on the host numpy backend (a
-    pallas sorted-segment kernel can replace it later)."""
+    inherently scalar and stays host-side; the accumulator arithmetic runs
+    on the numpy backend single-device (a lone jax device wins nothing over
+    the bookkeeping) but shards across the device mesh in mesh mode —
+    slots are allocated round-robin across shards and every accumulator
+    update/gather rides the sharded all_to_all path like tumbling/sliding
+    (reference treats all window types uniformly)."""
+
+    _mesh_ok = True
 
     def __init__(self, config: dict):
         config = dict(config)
-        config["backend"] = "numpy"
+        if self._cfg_mesh_devices(config) < 2:
+            config["backend"] = "numpy"
         super().__init__(config, "session_window")
         self.gap = int(config["gap_nanos"])
         assert self.gap > 0
         # key -> list of [start, last_ts, slot], sorted by start
         self.sessions: Dict[tuple, List[List]] = {}
+        self._next_shard = 0
+
+    def _alloc_slot(self) -> int:
+        # round-robin shard hint: load-balances mesh placement, ignored
+        # by the plain directory
+        self._next_shard += 1
+        return self.dir.alloc_slot(self._next_shard)
+
+    def _free_slot(self, slot: int):
+        self.dir.free_slot(int(slot))
 
     def tables(self):
         from ..state.table_config import global_table
@@ -1075,23 +1099,27 @@ class SessionWindowOperator(WindowOperatorBase):
                 values.append(arr)
         key_rows = [key_vals for key_vals, _ in snap["sessions"]]
         mask = self._range_mask(key_rows, ctx) if key_rows else None
+        new_slots: List[int] = []
+        positions: List[int] = []
         for si, (key_vals, sess_list) in enumerate(snap["sessions"]):
             if mask is not None and not mask[si]:
                 continue
             key = to_key(key_vals)
             cur = self.sessions.setdefault(key, [])
             for s in sess_list:
-                new_slot = (
-                    self.dir.free.pop() if self.dir.free else self.dir._alloc()
-                )
-                self._ensure_capacity()
-                pos = slot_pos[s[2]]
-                self.acc.restore(
-                    np.asarray([new_slot], dtype=np.int64),
-                    [v[pos: pos + 1] for v in values],
-                )
+                new_slot = self._alloc_slot()
+                new_slots.append(new_slot)
+                positions.append(slot_pos[s[2]])
                 cur.append([s[0], s[1], new_slot])
             cur.sort(key=lambda x: x[0])
+        if new_slots:
+            # one batched restore (a single scatter dispatch in mesh mode)
+            self._ensure_capacity()
+            pos = np.asarray(positions, dtype=np.int64)
+            self.acc.restore(
+                np.asarray(new_slots, dtype=np.int64),
+                [v[pos] for v in values],
+            )
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         self._capture_key_meta(ctx)
@@ -1124,7 +1152,7 @@ class SessionWindowOperator(WindowOperatorBase):
                 hit = s
                 break
         if hit is None:
-            slot = self.dir.free.pop() if self.dir.free else self.dir._alloc()
+            slot = self._alloc_slot()
             self._ensure_capacity()
             sess.append([t, t, slot])
             sess.sort(key=lambda s: s[0])
@@ -1157,7 +1185,7 @@ class SessionWindowOperator(WindowOperatorBase):
                 combined.append(np.asarray([max(vals[0], vals[1])]))
         self.acc.restore(np.asarray([a[2]], dtype=np.int64), combined)
         self.acc.reset_slots(np.asarray([b[2]], dtype=np.int64))
-        self.dir.free.append(int(b[2]))
+        self._free_slot(b[2])
         a[0] = min(a[0], b[0])
         a[1] = max(a[1], b[1])
 
@@ -1165,29 +1193,40 @@ class SessionWindowOperator(WindowOperatorBase):
         if watermark.kind != WatermarkKind.EVENT_TIME:
             return watermark
         t = watermark.timestamp
+        # collect every expired session first: one batched gather +
+        # finalize + reset per watermark (2 device dispatches in mesh
+        # mode), one output batch with per-row window bounds
+        exp_keys: List[tuple] = []
+        exp_starts: List[int] = []
+        exp_ends: List[int] = []
+        exp_slots: List[int] = []
         for key in list(self.sessions):
             remaining = []
             for s in self.sessions[key]:
                 if s[1] + self.gap <= t:
-                    slot_arr = np.asarray([s[2]], dtype=np.int64)
-                    gathered = self.acc.gather(slot_arr)
-                    agg_cols = self.acc.finalize(gathered)
-                    self.acc.reset_slots(slot_arr)
-                    self.dir.free.append(int(s[2]))
-                    out = self._build_output([key], agg_cols, s[0], s[1] + self.gap)
-                    await collector.collect(out)
+                    exp_keys.append(key)
+                    exp_starts.append(s[0])
+                    exp_ends.append(s[1] + self.gap)
+                    exp_slots.append(s[2])
                 else:
                     remaining.append(s)
             if remaining:
                 self.sessions[key] = remaining
             else:
                 del self.sessions[key]
+        if exp_slots:
+            slot_arr = np.asarray(exp_slots, dtype=np.int64)
+            agg_cols = self.acc.finalize(self.acc.gather(slot_arr))
+            self.acc.reset_slots(slot_arr)
+            for s in exp_slots:
+                self._free_slot(s)
+            out = self._build_output(
+                exp_keys, agg_cols,
+                np.asarray(exp_starts, dtype=np.int64),
+                np.asarray(exp_ends, dtype=np.int64),
+            )
+            await collector.collect(out)
         return watermark
-
-    def _ensure_capacity(self):
-        need = self.dir.next_slot + 1
-        if need > self.acc.capacity - 1:
-            self.acc.grow(need + 1)
 
 
 @register_operator(OperatorName.TUMBLING_WINDOW_AGGREGATE)

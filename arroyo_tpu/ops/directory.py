@@ -55,6 +55,14 @@ class SlotDirectory:
         self.next_slot += 1
         return s
 
+    # imperative allocation (session windows bypass assign()); the shard
+    # hint only matters to the mesh facade, which load-balances with it
+    def alloc_slot(self, shard_hint: int = 0) -> int:
+        return self.free.pop() if self.free else self._alloc()
+
+    def free_slot(self, slot: int):
+        self.free.append(int(slot))
+
     def bins_up_to(self, bin_exclusive: int) -> List[int]:
         return sorted(b for b in self.by_bin if b < bin_exclusive)
 

@@ -145,6 +145,41 @@ class MeshSlotDirectory:
             for b, key, slot in d.items():
                 yield b, key, shard * STRIDE + slot
 
+    def keys_for_slots(self, slots: np.ndarray):
+        """(bin, key) per global slot via the shard directories' reverse
+        maps (updating-aggregate dirty tracking)."""
+        out = []
+        for s in np.asarray(slots):
+            shard, local = int(s) // STRIDE, int(s) % STRIDE
+            out.append(self.dirs[shard].key_of.get(local))
+        return out
+
+    def remove(self, b: int, keys: List[tuple]) -> np.ndarray:
+        """Remove keys from a bin across shards; each key lives in exactly
+        one shard, so per-shard removal of the full list is safe. Returns
+        freed GLOBAL slots."""
+        freed = []
+        for shard, d in enumerate(self.dirs):
+            f = d.remove(b, keys)
+            if len(f):
+                freed.append(f + shard * STRIDE)
+        return (
+            np.concatenate(freed) if freed else np.empty(0, dtype=np.int64)
+        )
+
+    # -- imperative slot allocation (session windows) -----------------------
+
+    def alloc_slot(self, shard_hint: int) -> int:
+        """Allocate one slot on a shard (round-robin hint from the caller);
+        session bookkeeping assigns slots imperatively rather than through
+        assign()."""
+        d = self.dirs[shard_hint % self.n_shards]
+        local = d.free.pop() if d.free else d._alloc()
+        return (shard_hint % self.n_shards) * STRIDE + local
+
+    def free_slot(self, slot: int):
+        self.dirs[int(slot) // STRIDE].free.append(int(slot) % STRIDE)
+
 
 class ShardedAccumulator(Accumulator):
     """Accumulator whose slot arrays live sharded across a 1-D device mesh;

@@ -18,12 +18,15 @@ CLOSED = "__closed__"
 
 
 def _seq_ge(a: str, b: str) -> bool:
-    """a >= b for Kinesis sequence numbers (numeric strings); falls back
-    to last-wins on non-numeric test doubles."""
+    """a >= b for Kinesis sequence numbers (numeric strings). Non-numeric
+    ids (test doubles) compare by (length, lexicographic) — the same
+    total order as numeric for digit strings — so the restore merge still
+    prefers the furthest position instead of last-wins."""
     try:
         return int(a) >= int(b)
     except (TypeError, ValueError):
-        return True
+        sa, sb = str(a), str(b)
+        return (len(sa), sa) >= (len(sb), sb)
 
 
 class KinesisSource(SourceOperator):
@@ -155,9 +158,24 @@ class KinesisSource(SourceOperator):
                     if p and self._owned(p, ctx)
                     and self.positions.get(p) != CLOSED
                     and any(x["ShardId"] == p for x in shards)
+                    # the gate only matters when the parent's records will
+                    # actually be consumed: an 'earliest' scan, or a
+                    # stored/live position proving prior consumption. A
+                    # fresh 'latest' start tails both generations — no
+                    # ordering to preserve, no deferral (deferring would
+                    # TRIM_HORIZON-replay the child after the parent
+                    # insta-drains).
+                    and (self.init_position == "earliest"
+                         or p in self.positions)
                 ]
-                if parents and not initial:
-                    continue  # wait until our parent drains
+                if parents:
+                    # wait until our parent drains — on the INITIAL refresh
+                    # too (startup and restore): the parent is opened in
+                    # this same pass, and the closed_any-triggered re-list
+                    # picks the child up once it drains. Opening both at
+                    # once would interleave parent and child reads and
+                    # break per-key ordering across the reshard.
+                    continue
                 if s.get("ParentShardId") and (
                     not initial
                     or s["ParentShardId"] in self.positions

@@ -3,7 +3,13 @@
 (messages are acked at the CHECKPOINT barrier, after their rows are
 flushed downstream and covered by the epoch — a crash before the ack
 redelivers, never loses), persistent delivery on the sink, and optional
-exchange/routing-key addressing. Client gated on aio-pika/pika."""
+exchange/routing-key addressing. Client gated on aio-pika/pika.
+
+Throughput note: because acks are deferred to the checkpoint COMMIT
+phase, the broker stops delivering once `prefetch` messages are
+unacked — prefetch bounds the per-checkpoint-interval volume. The
+default is sized accordingly (10k); size `prefetch` to at least the
+expected per-epoch message count."""
 
 from __future__ import annotations
 
@@ -16,10 +22,14 @@ from ..formats.ser import Serializer
 from ._gated import require_client
 from .base import ConnectionSchema, Connector, register_connector
 
+# acks defer to the checkpoint COMMIT phase, so prefetch bounds the
+# per-checkpoint-interval volume (see module docstring)
+DEFAULT_PREFETCH = 10000
+
 
 class RabbitmqSource(SourceOperator):
     def __init__(self, url: str, queue: str, schema, format, bad_data,
-                 prefetch: int = 100):
+                 prefetch: int = DEFAULT_PREFETCH):
         super().__init__("rabbitmq_source")
         self.url = url
         self.queue = queue
@@ -143,7 +153,7 @@ class RabbitmqConnector(Connector):
         return {
             "url": options["url"],
             "queue": options["queue"],
-            "prefetch": int(options.get("prefetch", 100)),
+            "prefetch": int(options.get("prefetch", DEFAULT_PREFETCH)),
             "exchange": options.get("exchange"),
             "routing_key": options.get("routing_key"),
         }
@@ -152,7 +162,7 @@ class RabbitmqConnector(Connector):
         return RabbitmqSource(config["url"], config["queue"],
                               config.get("schema"), config.get("format"),
                               config.get("bad_data", "fail"),
-                              prefetch=config.get("prefetch", 100))
+                              prefetch=config.get("prefetch", DEFAULT_PREFETCH))
 
     def make_sink(self, config, schema: ConnectionSchema):
         return RabbitmqSink(config["url"], config["queue"],

@@ -98,8 +98,17 @@ class AsyncUdfOperator(Operator):
             for r, seq in enumerate(snap["seqs"]):
                 if hash((src, int(seq))) % n != me:
                     continue
-                # no collector at on_start: bypass the in-flight cap (the
-                # restored set is itself bounded by the checkpoint cap)
+                # a scale-down merges several subtasks' snapshots, so the
+                # restored set can exceed max_in_flight — bound the LIVE
+                # task count by reaping/awaiting completions between
+                # submissions (no collector exists at on_start; completed
+                # rows buffer for the first post-start emit, which is the
+                # same memory the snapshot already held)
+                while len(self._inflight) >= self.max_in_flight:
+                    self._reap()
+                    if len(self._inflight) < self.max_in_flight:
+                        break
+                    await self._wake.wait()
                 await self._submit(
                     tuple(c[r] for c in cols), enforce_cap=False
                 )

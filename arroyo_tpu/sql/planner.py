@@ -135,12 +135,18 @@ class SchemaProvider:
     def __init__(self):
         self.tables: Dict[str, TableDef] = {}
         self.views: Dict[str, Select] = {}
+        # bumped on every catalog mutation: cached subplans are keyed on
+        # it so a multi-statement script redefining a table/view name
+        # never reuses a plan bound to the old definition
+        self.epoch = 0
 
     def add_table(self, t: TableDef):
         self.tables[t.name.lower()] = t
+        self.epoch += 1
 
     def add_view(self, name: str, q: Select):
         self.views[name.lower()] = q
+        self.epoch += 1
 
     def get_table(self, name: str) -> Optional[TableDef]:
         return self.tables.get(name.lower())
@@ -207,6 +213,7 @@ class Planner:
         self.parallelism = parallelism
         self._source_cache: Dict[str, RelOutput] = {}
         self._select_plan_cache: Dict[tuple, RelOutput] = {}
+        self._cache_epoch = getattr(provider, "epoch", 0)
         self._sink_nodes: Dict[str, dict] = {}
         self._memory_tables: Dict[str, RelOutput] = {}
         self._cte_stack: List[Dict[str, Select]] = []
@@ -514,6 +521,13 @@ class Planner:
         # depth: same-text subqueries under different same-depth CTE
         # scopes (or across statements redefining a CTE) are different
         # plans
+        # catalog epoch: a later statement redefining a table/view must
+        # not reuse a plan bound to the old definition. Clearing (rather
+        # than keying on epoch) also drops the now-unreachable entries.
+        ep = getattr(self.provider, "epoch", 0)
+        if ep != self._cache_epoch:
+            self._select_plan_cache.clear()
+            self._cache_epoch = ep
         key = (
             repr(sel),
             tuple(

@@ -328,3 +328,34 @@ def test_unknown_column_error():
 def test_unknown_table_error():
     with pytest.raises(SqlError, match="unknown table ghost"):
         plan_query("SELECT x FROM ghost;")
+
+
+def test_subplan_cache_invalidated_on_catalog_change():
+    """Common-subplan cache must not survive a catalog mutation: a later
+    statement redefining a table name would otherwise reuse a plan bound
+    to the old definition (advisor round-2 finding)."""
+    from types import SimpleNamespace
+
+    from arroyo_tpu.sql.planner import Planner, SchemaProvider
+
+    p = Planner(SchemaProvider())
+    calls = []
+
+    def fake_plan_select(sel):
+        calls.append(sel)
+        return object()
+
+    p.plan_select = fake_plan_select
+
+    class Sel:
+        def __repr__(self):
+            return "SELECT x FROM t"
+
+    sel = Sel()
+    out1 = p._plan_select_shared(sel)
+    assert p._plan_select_shared(sel) is out1 and len(calls) == 1
+    p.provider.add_table(SimpleNamespace(name="t"))
+    out2 = p._plan_select_shared(sel)
+    assert out2 is not out1 and len(calls) == 2
+    p.provider.add_view("v", sel)
+    assert p._plan_select_shared(sel) is not out2 and len(calls) == 3

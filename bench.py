@@ -262,11 +262,32 @@ def main():
             fresh = datetime.timedelta(0) <= age <= datetime.timedelta(hours=24)
         except ValueError:
             pass
-        if "q5_eps" in grant and fresh:
+        # the daemon records the HEAD it measured against; a capture
+        # from older code must not be reported as HEAD's number. It is
+        # still disclosed (stale_grant_* fields) so the evidence trail
+        # survives, just not substituted into the headline.
+        head = None
+        try:
+            head = subprocess.run(
+                ["git", "rev-parse", "HEAD"], capture_output=True,
+                text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or None
+        except Exception:
+            pass
+        g_commit = grant.get("git_commit")
+        commit_ok = g_commit is None or head is None or g_commit == head
+        if "q5_eps" in grant and fresh and not commit_ok:
+            grant_extra["stale_grant_q5_eps"] = grant["q5_eps"]
+            grant_extra["stale_grant_commit"] = g_commit
+            grant_extra["stale_grant_captured_at"] = grant.get("captured_at")
+        if "q5_eps" in grant and fresh and commit_ok:
             device = {"eps": grant["q5_eps"],
                       "rows": grant.get("q5_rows", -1)}
             grant_extra["device_source"] = (
                 f"probe_daemon_capture@{grant.get('captured_at')}")
+            if g_commit:
+                grant_extra["device_git_commit"] = g_commit
             g_events = grant.get("events", {}).get("q5")
             for q in ("q1", "q7", "q8"):
                 if f"{q}_eps" in grant:
@@ -332,6 +353,11 @@ def main():
         "metric": "nexmark_q5_events_per_sec",
         "value": round(device["eps"], 1),
         "unit": "events/s",
+        # which backend produced the q1/q7/q8/latency side metrics —
+        # "jax" only when the live device child succeeded; on the
+        # grant-substitution path these are CPU re-measurements while
+        # the device values carry the *_eps_tpu suffix
+        "side_backend": side_backend,
         # vs_baseline is only meaningful against a real CPU measurement;
         # null (not 1.0) when the numpy child failed
         "vs_baseline": round(device["eps"] / baseline["eps"], 3)

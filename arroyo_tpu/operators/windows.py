@@ -89,6 +89,13 @@ class WindowOperatorBase(Operator):
         # python tuple per touched slot dominated high-cardinality
         # workloads. Deduped by slot (keep-last) at delta-build time.
         self._dirty_chunks: List[tuple] = []
+        # rows across _dirty_chunks and the size right after the last
+        # coalesce: chunks are squashed (keep-last per slot) whenever the
+        # row count doubles past the floor, bounding memory between
+        # checkpoints at O(distinct dirty slots) even when a hot key is
+        # touched every batch over a long checkpoint interval
+        self._dirty_rows = 0
+        self._dirty_base = 0
         # native flat-key layout: when a struct key is flattened into its
         # int64 child words for the native directory, _flat_widths[i] is
         # the word count of key column i and _flat_offsets the prefix sums
@@ -195,6 +202,28 @@ class WindowOperatorBase(Operator):
             (uniq, np.asarray(bins)[first].astype(np.int64, copy=False),
              norm)
         )
+        self._dirty_rows += len(uniq)
+        # amortized O(1) per row: squash only once the count doubles
+        # since the last squash (floor 64k rows)
+        if self._dirty_rows > max(65536, 2 * self._dirty_base):
+            self._dirty_chunks = [self._coalesce_dirty()]
+            self._dirty_rows = self._dirty_base = len(
+                self._dirty_chunks[0][0]
+            )
+
+    def _coalesce_dirty(self) -> tuple:
+        """Concatenate all dirty chunks and keep the LAST mark per slot
+        (a slot freed and reassigned must report its newest (bin, key))."""
+        chunks = self._dirty_chunks
+        slots = np.concatenate([c[0] for c in chunks])
+        bins = np.concatenate([c[1] for c in chunks])
+        n_kc = len(chunks[0][2])
+        key_cols = [
+            np.concatenate([c[2][i] for c in chunks]) for i in range(n_kc)
+        ]
+        _, idx_rev = np.unique(slots[::-1], return_index=True)
+        keep = len(slots) - 1 - idx_rev
+        return slots[keep], bins[keep], [c[keep] for c in key_cols]
 
     def _key_delta_cols(self, key_cols: List[np.ndarray]) -> List[pa.Array]:
         """Columnar variant of _key_delta_arrays: key columns arrive as the
@@ -260,21 +289,9 @@ class WindowOperatorBase(Operator):
         next epoch's processing."""
         if not self._dirty_chunks:
             return None
-        chunks = self._dirty_chunks
+        slots, bins, key_cols = self._coalesce_dirty()
         self._dirty_chunks = []
-        slots = np.concatenate([c[0] for c in chunks])
-        bins = np.concatenate([c[1] for c in chunks])
-        n_kc = len(chunks[0][2])
-        key_cols = [
-            np.concatenate([c[2][i] for c in chunks]) for i in range(n_kc)
-        ]
-        # keep the LAST mark per slot: a slot freed and reassigned within
-        # the epoch must write its newest (bin, key)
-        _, idx_rev = np.unique(slots[::-1], return_index=True)
-        keep = len(slots) - 1 - idx_rev
-        slots = slots[keep]
-        bins = bins[keep]
-        key_cols = [c[keep] for c in key_cols]
+        self._dirty_rows = self._dirty_base = 0
         values = self.acc.snapshot(slots, materialize=False)
 
         def build() -> pa.RecordBatch:

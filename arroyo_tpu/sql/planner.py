@@ -527,6 +527,13 @@ class Planner:
         ep = getattr(self.provider, "epoch", 0)
         if ep != self._cache_epoch:
             self._select_plan_cache.clear()
+            # source plans are keyed by bare table name: a redefined
+            # table must re-plan, not reuse the stale source. Memory
+            # tables stay — they are plan-local entities (INSERT INTO
+            # targets created by earlier statements of THIS plan), not
+            # catalog-backed, and dropping them would orphan references
+            # from statements planned after a DDL epoch bump.
+            self._source_cache.clear()
             self._cache_epoch = ep
         key = (
             repr(sel),

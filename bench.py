@@ -93,6 +93,18 @@ SELECT P.id, P.name FROM (
 QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8}
 
 
+def force_backend(plan, backend: str) -> None:
+    """Route every backend-capable operator in the plan onto `backend`:
+    anything already carrying a backend knob plus the window/updating
+    aggregates. Single source of truth — the probe daemon's device
+    golden runner uses the same selection."""
+    for node in plan.graph.nodes.values():
+        for op in node.chain:
+            if "backend" in op.config or op.operator.value.endswith(
+                    "aggregate"):
+                op.config["backend"] = backend
+
+
 def child(events: int, backend: str, query: str = "q5") -> None:
     """Run one nexmark query; print 'RESULT <events/sec> <rows>'."""
     import asyncio
@@ -123,10 +135,7 @@ def child(events: int, backend: str, query: str = "q5") -> None:
         QUERIES[query].format(rate=rate, events=events),
         preview_results=results,
     )
-    for node in plan.graph.nodes.values():
-        for op in node.chain:
-            if "backend" in op.config or op.operator.value.endswith("aggregate"):
-                op.config["backend"] = backend
+    force_backend(plan, backend)
 
     async def go():
         eng = Engine(plan.graph).start()
@@ -275,8 +284,12 @@ def main():
             ).stdout.strip() or None
         except Exception:
             pass
+        # strict: unknown provenance (no recorded commit, or git
+        # unavailable to resolve HEAD) takes the stale branch — only a
+        # verified match may substitute into the headline
         g_commit = grant.get("git_commit")
-        commit_ok = g_commit is None or head is None or g_commit == head
+        commit_ok = (g_commit is not None and head is not None
+                     and g_commit == head)
         if "q5_eps" in grant and fresh and not commit_ok:
             grant_extra["stale_grant_q5_eps"] = grant["q5_eps"]
             grant_extra["stale_grant_commit"] = g_commit

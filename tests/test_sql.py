@@ -359,3 +359,27 @@ def test_subplan_cache_invalidated_on_catalog_change():
     assert out2 is not out1 and len(calls) == 2
     p.provider.add_view("v", sel)
     assert p._plan_select_shared(sel) is not out2 and len(calls) == 3
+
+
+def test_source_cache_invalidated_on_catalog_change():
+    """The bare-table-name source cache must also drop on a catalog epoch
+    bump: a Planner driven statement-by-statement across an add_table
+    redefining a name would otherwise reuse the stale source plan
+    (advisor round-3 finding)."""
+    from types import SimpleNamespace
+
+    from arroyo_tpu.sql.planner import Planner, SchemaProvider
+
+    p = Planner(SchemaProvider())
+    p._source_cache["t"] = object()
+
+    class Sel:
+        def __repr__(self):
+            return "SELECT 1"
+
+    p.plan_select = lambda sel: object()
+    p._plan_select_shared(Sel())          # same epoch: cache survives
+    assert "t" in p._source_cache
+    p.provider.add_table(SimpleNamespace(name="t"))
+    p._plan_select_shared(Sel())          # epoch bump: cache dropped
+    assert "t" not in p._source_cache

@@ -105,8 +105,13 @@ def force_backend(plan, backend: str) -> None:
                 op.config["backend"] = backend
 
 
-def child(events: int, backend: str, query: str = "q5") -> None:
-    """Run one nexmark query; print 'RESULT <events/sec> <rows>'."""
+def child(events: int, backend: str, query: str = "q5",
+          mesh_devices: int = 0) -> None:
+    """Run one nexmark query; print 'RESULT <events/sec> <rows>'. With
+    mesh_devices=N the window aggregates run on the N-device mesh
+    execution path (ShardedAccumulator + in-step all_to_all) and a
+    'MESHSTATS <rows_sent> <rows_padded>' line reports the exchange's
+    padding overhead."""
     import asyncio
     import time
 
@@ -117,6 +122,8 @@ def child(events: int, backend: str, query: str = "q5") -> None:
 
     config().tpu.enabled = backend == "jax"
     config().pipeline.source_batch_size = 8192
+    if mesh_devices:
+        config().tpu.mesh_devices = mesh_devices
     if backend == "jax":
         # keep the XLA program count flat: every (bucket, capacity) pair
         # specializes update/gather/reset, and compiles through the TPU
@@ -144,6 +151,11 @@ def child(events: int, backend: str, query: str = "q5") -> None:
     t0 = time.monotonic()
     asyncio.run(go())
     dt = time.monotonic() - t0
+    if mesh_devices:
+        from arroyo_tpu.parallel.sharded_state import MESH_STATS
+
+        print(f"MESHSTATS {MESH_STATS['rows_sent']} "
+              f"{MESH_STATS['rows_padded']}", flush=True)
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
@@ -202,22 +214,33 @@ def latency_child(rate: int, seconds: float, backend: str) -> None:
 
 
 def run_child(events: int, backend: str, timeout: float, env=None,
-              query: str = "q5"):
+              query: str = "q5", mesh_devices: int = 0):
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
            "--events", str(events), "--query", query]
+    if mesh_devices:
+        cmd += ["--mesh-devices", str(mesh_devices)]
     try:
         out = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout, env=env
         )
     except subprocess.TimeoutExpired:
         return None
+    result = None
+    stats = None
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):
             parts = line.split()
-            return {"eps": float(parts[1]), "rows": int(parts[2]),
-                    "secs": float(parts[3])}
-    sys.stderr.write(out.stderr[-2000:] + "\n")
-    return None
+            result = {"eps": float(parts[1]), "rows": int(parts[2]),
+                      "secs": float(parts[3])}
+        elif line.startswith("MESHSTATS "):
+            parts = line.split()
+            stats = (int(parts[1]), int(parts[2]))
+    if result is None:
+        sys.stderr.write(out.stderr[-2000:] + "\n")
+        return None
+    if stats is not None:
+        result["rows_sent"], result["rows_padded"] = stats
+    return result
 
 
 def main():
@@ -226,6 +249,11 @@ def main():
     ap.add_argument("--child", choices=["numpy", "jax"])
     ap.add_argument("--query", choices=sorted(QUERIES), default="q5")
     ap.add_argument("--timeout", type=float, default=420.0)
+    # mesh side-measurement: q5 on an N-virtual-device CPU mesh so the
+    # all_to_all execution path has a throughput number every round
+    # (VERDICT r3 item 2). 0 disables.
+    ap.add_argument("--mesh", type=int, default=8)
+    ap.add_argument("--mesh-devices", type=int, default=0)
     ap.add_argument("--latency-child", choices=["numpy", "jax"])
     ap.add_argument("--latency-rate", type=int, default=50_000)
     ap.add_argument("--latency-seconds", type=float, default=12.0)
@@ -235,7 +263,7 @@ def main():
                       args.latency_child)
         return
     if args.child:
-        child(args.events, args.child, args.query)
+        child(args.events, args.child, args.query, args.mesh_devices)
         return
 
     cpu_env = dict(os.environ)
@@ -332,6 +360,38 @@ def main():
                       env=side_env, query=q)
         # 0 = that query failed/timed out (distinguishable from "not run")
         sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
+    # mesh execution path: q5 on an N-virtual-device CPU mesh (the
+    # all_to_all + ShardedAccumulator path the dryrun only
+    # correctness-checks). Quarter events: side metric, and the CPU
+    # mesh emulation carries per-device dispatch overhead.
+    if args.mesh >= 2:
+        mesh_env = dict(cpu_env)
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+            mesh_env.pop(var, None)
+        # force the virtual device count to --mesh even when the caller's
+        # XLA_FLAGS already pins one (a stale smaller count would make
+        # the child raise and the metric read 0)
+        import re
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            mesh_env.get("XLA_FLAGS", ""),
+        ).strip()
+        mesh_env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.mesh}"
+        ).strip()
+        r = run_child(args.events // 4, "jax", args.timeout, env=mesh_env,
+                      mesh_devices=args.mesh)
+        sides[f"q5_mesh{args.mesh}_eps"] = (
+            round(r["eps"], 1) if r is not None else 0
+        )
+        if r is not None and "rows_sent" in r:
+            shipped = r["rows_sent"] + r["rows_padded"]
+            sides["mesh_rows_sent"] = r["rows_sent"]
+            sides["mesh_rows_padded"] = r["rows_padded"]
+            sides["mesh_padding_ratio"] = round(
+                r["rows_padded"] / max(1, shipped), 3
+            )
     # end-to-end latency (realtime q5; includes the source watermark delay)
     lat_cmd = [sys.executable, os.path.abspath(__file__),
                "--latency-child", side_backend,

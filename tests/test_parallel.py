@@ -140,3 +140,79 @@ def test_sharded_signed_updates(mesh):
     for key, cnt, total in zip(keys_out, gathered[0], gathered[1]):
         assert cnt == 1
         assert total == key[0] * 10
+
+
+def test_packed_exchange_sized_to_batch(mesh):
+    """The all_to_all buffer must be bucketed to the batch, not the
+    configured rows_per_shard ceiling: a small uniform batch on 8 shards
+    ships far fewer padding rows than the old dense S*S*rows_per_shard
+    layout, while a skewed batch still lands every row (VERDICT r3
+    item 2)."""
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
+
+    specs = [AggSpec("count", None, "cnt"), AggSpec("sum", 0, "total")]
+    acc = ShardedAccumulator(specs, mesh, capacity_per_shard=4096,
+                             rows_per_shard=1024)
+    d = MeshSlotDirectory(acc.n_shards)
+    S = acc.n_shards
+
+    # uniform batch: per-owner counts ~n/S, per-cell ~n/S^2 -> R buckets
+    # near n/S^2, padding bounded by one bucket step (4x), not the 87%
+    # of the dense layout
+    n = 8192
+    keys = np.arange(n) % 1000
+    bins = np.zeros(n, dtype=np.int64)
+    slots = d.assign(bins, [keys])
+    acc.update(slots, {0: np.ones(n, dtype=np.int64)})
+    dense = S * S * 1024
+    assert acc.rows_sent == n
+    total_shipped = acc.rows_sent + acc.rows_padded
+    assert total_shipped < dense / 2, (
+        f"shipped {total_shipped} rows, dense layout would ship {dense}"
+    )
+
+    # skewed batch: every row hits one owner shard; still exact
+    acc2 = ShardedAccumulator(specs, mesh, capacity_per_shard=4096,
+                              rows_per_shard=1024)
+    d2 = MeshSlotDirectory(acc2.n_shards)
+    hot = np.full(4096, 7, dtype=np.int64)
+    bins2 = np.zeros(4096, dtype=np.int64)
+    s2 = d2.assign(bins2, [hot])
+    acc2.update(s2, {0: np.ones(4096, dtype=np.int64)})
+    _, slots_out = d2.take_bin(0)
+    g = acc2.gather(slots_out)
+    assert g[0][0] == 4096 and g[1][0] == 4096
+
+
+def test_all_to_all_path_matches_direct(mesh):
+    """host_fed=False keeps the [S, S, R] src-major packing + in-step
+    all_to_all (the multi-host / device-resident-producer shuffle); it
+    must produce identical state to the host-fed direct layout."""
+    from arroyo_tpu.parallel import MeshSlotDirectory, ShardedAccumulator
+
+    specs = [AggSpec("count", None, "cnt"), AggSpec("sum", 0, "total"),
+             AggSpec("min", 1, "lo")]
+    rng = np.random.default_rng(11)
+    n = 5000
+    keys = rng.integers(0, 300, n)
+    bins = rng.integers(0, 2, n)
+    ints = rng.integers(-100, 100, n)
+    ints2 = rng.integers(0, 1000, n)
+
+    outs = []
+    for host_fed in (True, False):
+        acc = ShardedAccumulator(specs, mesh, capacity_per_shard=1024,
+                                 rows_per_shard=256, host_fed=host_fed)
+        d = MeshSlotDirectory(acc.n_shards)
+        for lo in range(0, n, 1700):
+            hi = min(lo + 1700, n)
+            slots = d.assign(bins[lo:hi], [keys[lo:hi]])
+            acc.update(slots, {0: ints[lo:hi], 1: ints2[lo:hi]})
+        rows = {}
+        for b in (0, 1):
+            ks, ss = d.take_bin(b)
+            g = acc.gather(ss)
+            for k, c, t, m in zip(ks, g[0], g[1], g[2]):
+                rows[(b, k[0])] = (int(c), int(t), int(m))
+        outs.append(rows)
+    assert outs[0] == outs[1]

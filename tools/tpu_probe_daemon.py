@@ -90,10 +90,19 @@ def log_line(msg: str) -> None:
 
 
 def git_head() -> str:
+    """HEAD sha, with a '-dirty' suffix when the working tree has
+    uncommitted changes: a capture of never-committed code must not pass
+    the round-end strict provenance gate (bench.py compares this value
+    to a clean `git rev-parse HEAD`, so '-dirty' can never match —
+    conservative and honest)."""
     try:
-        return subprocess.run(
+        sha = subprocess.run(
             ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
             text=True, timeout=10).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        return f"{sha}-dirty" if dirty else sha
     except Exception:
         return "unknown"
 
@@ -240,19 +249,10 @@ def publish_capture(results: dict, goldens: dict, commit: str) -> None:
                 "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
         cpu_env.pop(var, None)
     g_events = dict(BENCH_PLAN)["q5"]
-    baseline = None
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--child",
-             "numpy", "--events", str(g_events), "--query", "q5"],
-            capture_output=True, text=True, timeout=CPU_BASELINE_TIMEOUT,
-            env=cpu_env, cwd=REPO)
-        for line in out.stdout.splitlines():
-            if line.startswith("RESULT "):
-                parts = line.split()
-                baseline = {"eps": float(parts[1]), "rows": int(parts[2])}
-    except subprocess.TimeoutExpired:
-        pass
+    sys.path.insert(0, REPO)
+    import bench
+    baseline = bench.run_child(g_events, "numpy", CPU_BASELINE_TIMEOUT,
+                               env=cpu_env)
     if baseline is None:
         log_line("capture: CPU baseline re-measure failed; "
                  "BENCH json will carry vs_baseline=null")

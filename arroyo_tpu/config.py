@@ -100,6 +100,13 @@ class TpuConfig:
     # host arrow join, where the device round-trip isn't worth it
     device_join: bool = True
     device_join_min_rows: int = 4096
+    # device-resident (bin, key) -> slot group index (sorted hash table +
+    # jitted searchsorted, ops/device_directory.py): slot assignment
+    # stops round-tripping each batch's unique keys through a host hash
+    # table. Prototype tier — groups are identified by 64-bit hash
+    # (collision odds ~n^2/2^65), so off by default; host python/native
+    # C++ directories remain the exact fallbacks.
+    device_directory: bool = False
 
 
 @dataclasses.dataclass

@@ -146,16 +146,23 @@ class WindowOperatorBase(Operator):
                 and isinstance(self.dir, SlotDirectory)
                 and self.dir.n_live == 0
             ):
+                from ..config import config as config_fn
                 from ..ops.native import (
                     NativeSlotDirectory,
                     flat_key_widths,
+                    key_word_widths,
                     load_native,
                 )
 
-                widths = flat_key_widths(self._key_types)
+                cfg = config_fn().tpu
+                use_device = cfg.enabled and cfg.device_directory
+                widths = (
+                    key_word_widths(self._key_types) if use_device
+                    else flat_key_widths(self._key_types)
+                )
                 if widths is not None:
                     # struct keys (window structs) flatten into their int64
-                    # child words; everything rides the native N-key table
+                    # child words; everything rides the flat N-key table
                     if any(pa.types.is_struct(t) for t in self._key_types):
                         self._flat_widths = widths
                         self._flat_offsets = [0]
@@ -163,9 +170,16 @@ class WindowOperatorBase(Operator):
                             self._flat_offsets.append(
                                 self._flat_offsets[-1] + w
                             )
-                    self.dir = NativeSlotDirectory(
-                        load_native(), n_keys=sum(widths)
-                    )
+                    if use_device:
+                        from ..ops.device_directory import (
+                            DeviceSlotDirectory,
+                        )
+
+                        self.dir = DeviceSlotDirectory(n_keys=sum(widths))
+                    else:
+                        self.dir = NativeSlotDirectory(
+                            load_native(), n_keys=sum(widths)
+                        )
 
     def _ensure_capacity(self):
         need = self.dir.required_capacity()

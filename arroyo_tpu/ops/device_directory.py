@@ -1,0 +1,331 @@
+"""Device-resident slot directory: the (bin, key) -> slot group index on
+the accelerator.
+
+SURVEY.md §7 flags "hash tables on TPU" as a hard part and prescribes
+sorted-key segment ops + binary search over device arrays rather than true
+hash maps. This module implements that design as the third directory tier
+(config flag `tpu.device_directory`; host python dict and native C++
+open-addressing remain the fallbacks — reference analog: the in-engine
+hash-aggregation state of
+/root/reference/crates/arroyo-worker/src/arrow/tumbling_aggregating_window.rs:66-110):
+
+  device state:  tab_hash [C] int64, sorted ascending with SENT (int64
+                 max) padding; tab_slot [C] the slot of each entry.
+  assign():      h = splitmix64(bin, key words)      [host numpy, O(n)]
+                 jitted lookup: searchsorted(tab_hash, h) -> found, slot
+                 NEW groups only (steady state: none) fall back to the
+                 host: allocate slots from the free list, record (bin,
+                 key, slot, hash) in O(new) bookkeeping, and dispatch a
+                 jitted merge that splices the new sorted hashes into the
+                 table by scatter (searchsorted positions — no sort).
+  take_bin():    bins/keys/slots come from the host bookkeeping (built
+                 incrementally, O(new groups) per batch); a jitted
+                 remove compacts the emitted hashes out of the table
+                 (cumsum positions + scatter — no sort).
+
+Per-batch work therefore no longer round-trips the batch's UNIQUE keys
+through a host hash table (the structural cap the round-3 verdict names):
+after a window's first batches, every key is a device searchsorted hit and
+the host does O(0) dictionary work.
+
+Exactness: groups are identified by their 64-bit mixed hash. Two distinct
+(bin, key) groups colliding on all 64 bits would silently merge; with
+splitmix64 that is ~n^2/2^65 (≈3e-8 at one million live groups) and is
+accepted for this tier (the python/native tiers are exact); the flag
+defaults off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..types import hash_arrays, hash_column
+from .aggregates import _bucket
+
+SENT = np.int64(np.iinfo(np.int64).max)
+
+_FNS: Dict[str, object] = {}
+
+
+def _fns():
+    """Lazily-built jitted table ops (shape-specialized by jax's cache)."""
+    if _FNS:
+        return _FNS
+    import jax
+
+    from ..parallel.mesh import _get_jnp
+
+    jnp = _get_jnp()
+
+    @jax.jit
+    def lookup(tab_hash, tab_slot, q):
+        idx = jnp.searchsorted(tab_hash, q)
+        idx = jnp.clip(idx, 0, tab_hash.shape[0] - 1)
+        found = tab_hash[idx] == q
+        return found, tab_slot[idx]
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def merge(tab_hash, tab_slot, add_h, add_slot):
+        # splice sorted add_h (SENT-padded) into sorted tab_hash by
+        # computing every element's merged position and scattering; SENT
+        # padding from either side lands past the end and is dropped.
+        C = tab_hash.shape[0]
+        real_add = add_h != SENT
+        n_add = real_add.sum()
+        pos_old = jnp.arange(C) + jnp.searchsorted(add_h, tab_hash,
+                                                   side="left")
+        pos_old = jnp.where(tab_hash == SENT, C, pos_old)
+        pos_new = jnp.arange(add_h.shape[0]) + jnp.searchsorted(
+            tab_hash, add_h, side="left"
+        )
+        pos_new = jnp.where(real_add, pos_new, C)
+        out_h = jnp.full((C,), SENT, dtype=tab_hash.dtype)
+        out_s = jnp.zeros((C,), dtype=tab_slot.dtype)
+        out_h = out_h.at[pos_old].set(tab_hash, mode="drop")
+        out_s = out_s.at[pos_old].set(tab_slot, mode="drop")
+        out_h = out_h.at[pos_new].set(add_h, mode="drop")
+        out_s = out_s.at[pos_new].set(add_slot, mode="drop")
+        return out_h, out_s, n_add
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def remove(tab_hash, tab_slot, del_h):
+        # drop entries whose hash appears in sorted del_h (SENT-padded),
+        # then compact left to restore the sorted-real/SENT-tail layout
+        C = tab_hash.shape[0]
+        idx = jnp.clip(jnp.searchsorted(del_h, tab_hash), 0,
+                       del_h.shape[0] - 1)
+        drop = (del_h[idx] == tab_hash) | (tab_hash == SENT)
+        keep = ~drop
+        pos = jnp.cumsum(keep) - 1
+        pos = jnp.where(keep, pos, C)
+        out_h = jnp.full((C,), SENT, dtype=tab_hash.dtype)
+        out_s = jnp.zeros((C,), dtype=tab_slot.dtype)
+        out_h = out_h.at[pos].set(tab_hash, mode="drop")
+        out_s = out_s.at[pos].set(tab_slot, mode="drop")
+        return out_h, out_s
+
+    _FNS.update(lookup=lookup, merge=merge, remove=remove)
+    return _FNS
+
+
+def _i64_view(c: np.ndarray) -> np.ndarray:
+    c = np.asarray(c)
+    if c.dtype == np.uint64:
+        return c.view(np.int64)
+    if c.dtype.kind == "M":
+        return c.view("i8")
+    return c.astype(np.int64, copy=False)
+
+
+class _BinData:
+    """Per-bin host bookkeeping: column chunks appended O(new groups) per
+    batch, coalesced on first read."""
+
+    __slots__ = ("keys", "slots", "hashes")
+
+    def __init__(self):
+        self.keys: List[np.ndarray] = []   # chunks [k, W]
+        self.slots: List[np.ndarray] = []
+        self.hashes: List[np.ndarray] = []
+
+    def coalesce(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if len(self.slots) > 1:
+            self.keys = [np.concatenate(self.keys, axis=0)]
+            self.slots = [np.concatenate(self.slots)]
+            self.hashes = [np.concatenate(self.hashes)]
+        return self.keys[0], self.slots[0], self.hashes[0]
+
+    def __len__(self):
+        return sum(len(s) for s in self.slots)
+
+
+class DeviceSlotDirectory:
+    """N-int64-key directory over the device-resident sorted hash table,
+    API-compatible with ops.native.NativeSlotDirectory (assign /
+    take_bin / take_bin_arrays / bin_entries / peek_bin / by_bin /
+    items). Keys surface as n-tuples; take_bin_arrays is the vectorized
+    emission path."""
+
+    def __init__(self, n_keys: int = 1, table_capacity: int = 1 << 16):
+        import jax
+
+        from ..parallel.mesh import _get_jnp
+
+        jnp = _get_jnp()
+        self.n_keys = n_keys
+        self._stride = max(1, n_keys)
+        self._cap = int(table_capacity)
+        self.tab_hash = jnp.full((self._cap,), SENT, dtype=jnp.int64)
+        self.tab_slot = jnp.zeros((self._cap,), dtype=jnp.int64)
+        self._n_entries = 0
+        self._bins: Dict[int, _BinData] = {}
+        self.free: List[int] = []
+        self.next_slot = 0
+        self._q_buckets = (1024, 8192, 65536)
+        self._jnp = jnp
+        self._jax = jax
+
+    # -- host bookkeeping ----------------------------------------------------
+
+    @property
+    def n_live(self) -> int:
+        return self._n_entries
+
+    def required_capacity(self) -> int:
+        return self.next_slot + 1
+
+    def _hash(self, bins: np.ndarray, key_cols: List[np.ndarray]) -> np.ndarray:
+        h = hash_arrays(
+            [hash_column(np.asarray(bins))]
+            + [hash_column(_i64_view(c)) for c in key_cols]
+        ).view(np.int64)
+        # SENT is the table's empty sentinel; remap the 1-in-2^64 hash
+        return np.where(h == SENT, SENT - 1, h)
+
+    def _pad_sorted(self, v: np.ndarray, slots: Optional[np.ndarray] = None):
+        p = _bucket(len(v), self._q_buckets)
+        out = np.full(p, SENT, dtype=np.int64)
+        out[: len(v)] = v
+        if slots is None:
+            return out
+        s = np.zeros(p, dtype=np.int64)
+        s[: len(v)] = slots
+        return out, s
+
+    def _grow_table(self, need: int):
+        while self._cap < need:
+            self._cap *= 2
+        jnp = self._jnp
+        h = np.asarray(self.tab_hash)
+        s = np.asarray(self.tab_slot)
+        nh = np.full(self._cap, SENT, dtype=np.int64)
+        ns = np.zeros(self._cap, dtype=np.int64)
+        nh[: len(h)] = h
+        ns[: len(s)] = s
+        self.tab_hash = jnp.asarray(nh)
+        self.tab_slot = jnp.asarray(ns)
+
+    # -- hot path ------------------------------------------------------------
+
+    def assign(self, bins: np.ndarray, key_cols: List[np.ndarray]) -> np.ndarray:
+        n = len(bins)
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        bins = np.asarray(bins)
+        kc = [_i64_view(c) for c in key_cols] if key_cols else [
+            np.zeros(n, dtype=np.int64)
+        ]
+        h = self._hash(bins, kc)
+        q = self._pad_sorted_queries(h)
+        found_d, slot_d = _fns()["lookup"](self.tab_hash, self.tab_slot, q)
+        found_d, slot_d = self._jax.device_get((found_d, slot_d))
+        found = found_d[:n]
+        out = slot_d[:n].copy()
+        if not found.all():
+            new_rows = np.nonzero(~found)[0]
+            nh = h[new_rows]
+            uniq_h, first = np.unique(nh, return_index=True)
+            k = len(uniq_h)
+            # slot allocation: free list first, then fresh
+            reuse = min(k, len(self.free))
+            slots_new = np.empty(k, dtype=np.int64)
+            if reuse:
+                slots_new[:reuse] = self.free[-reuse:]
+                del self.free[-reuse:]
+            if k > reuse:
+                slots_new[reuse:] = np.arange(
+                    self.next_slot, self.next_slot + (k - reuse)
+                )
+                self.next_slot += k - reuse
+            first_abs = new_rows[first]
+            kmat = np.stack([c[first_abs] for c in kc], axis=1)
+            gbins = bins[first_abs]
+            # per-bin bookkeeping, columnar: one append per touched bin
+            border = np.argsort(gbins, kind="stable")
+            gb = gbins[border]
+            cut = np.nonzero(np.diff(gb))[0] + 1
+            for seg in np.split(border, cut):
+                bd = self._bins.setdefault(int(gbins[seg[0]]), _BinData())
+                bd.keys.append(kmat[seg])
+                bd.slots.append(slots_new[seg])
+                bd.hashes.append(uniq_h[seg])
+            # splice into the device table
+            if self._n_entries + k > self._cap - 1:
+                self._grow_table(2 * (self._n_entries + k))
+            add_h, add_s = self._pad_sorted(uniq_h, slots_new)
+            self.tab_hash, self.tab_slot, _ = _fns()["merge"](
+                self.tab_hash, self.tab_slot,
+                self._jnp.asarray(add_h), self._jnp.asarray(add_s),
+            )
+            self._n_entries += k
+            out[new_rows] = slots_new[np.searchsorted(uniq_h, nh)]
+        return out
+
+    def _pad_sorted_queries(self, h: np.ndarray):
+        return self._jnp.asarray(self._pad_sorted(h))
+
+    # -- emission ------------------------------------------------------------
+
+    def _drop_hashes(self, hashes: np.ndarray):
+        if not len(hashes):
+            return
+        del_h = self._pad_sorted(np.sort(hashes))
+        self.tab_hash, self.tab_slot = _fns()["remove"](
+            self.tab_hash, self.tab_slot, self._jnp.asarray(del_h)
+        )
+        self._n_entries -= len(hashes)
+
+    def take_bin(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+        kcols, slots = self.take_bin_arrays(b)
+        if self.n_keys == 0:
+            return [() for _ in range(len(slots))], slots
+        keys = [tuple(int(c[i]) for c in kcols) for i in range(len(slots))]
+        return keys, slots
+
+    def take_bin_arrays(self, b: int) -> Tuple[List[np.ndarray], np.ndarray]:
+        bd = self._bins.pop(int(b), None)
+        if bd is None:
+            z = np.empty(0, dtype=np.int64)
+            return [z for _ in range(self._stride)], z
+        kmat, slots, hashes = bd.coalesce()
+        self._drop_hashes(hashes)
+        self.free.extend(slots.tolist())
+        return [kmat[:, j] for j in range(self._stride)], slots
+
+    def bin_entries(self, b: int) -> Tuple[np.ndarray, np.ndarray]:
+        bd = self._bins.get(int(b))
+        if bd is None:
+            z = np.empty(0, dtype=np.int64)
+            return np.empty((0, self._stride), dtype=np.int64), z
+        kmat, slots, _ = bd.coalesce()
+        return kmat, slots
+
+    @property
+    def by_bin(self):
+        return {b: True for b in self._bins}
+
+    def peek_bin(self, b: int):
+        kmat, _ = self.bin_entries(b)
+        if not len(kmat):
+            return None
+        if self.n_keys == 0:
+            return {(): None}
+        return {tuple(int(x) for x in row): None for row in kmat}
+
+    def live_bins(self) -> List[int]:
+        return sorted(self._bins)
+
+    def bins_up_to(self, limit: int) -> List[int]:
+        return sorted(b for b in self._bins if b < limit)
+
+    def items(self):
+        for b in sorted(self._bins):
+            kmat, slots = self.bin_entries(b)
+            for i in range(len(slots)):
+                k = () if self.n_keys == 0 else tuple(
+                    int(x) for x in kmat[i]
+                )
+                yield int(b), k, int(slots[i])

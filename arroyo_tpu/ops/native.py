@@ -180,10 +180,17 @@ def _i64able(t) -> bool:
 
 def flat_key_widths(key_types):
     """Per-key-column int64 word counts for the native directory, or None
-    when any column can't ride it. Struct columns (window structs) flatten
-    into their child words when every child is integer/timestamp."""
+    when any column can't ride it (or the native module is absent)."""
     if load_native() is None:
         return None
+    return key_word_widths(key_types)
+
+
+def key_word_widths(key_types):
+    """Per-key-column int64 word counts for flat-word directories (native
+    C++ and device), or None when any column can't be int64-flattened.
+    Struct columns (window structs) flatten into their child words when
+    every child is integer/timestamp."""
     import pyarrow as pa
 
     widths = []

@@ -1,0 +1,133 @@
+"""Device-resident slot directory (sorted hash table + searchsorted
+lookup on the accelerator) must agree with the host SlotDirectory on
+assignment identity, emission contents, slot reuse, and growth."""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.ops.device_directory import DeviceSlotDirectory
+from arroyo_tpu.ops.directory import SlotDirectory
+
+
+def groups_of(d, bins, keys):
+    """slot per row -> canonical group labeling for comparison."""
+    slots = d.assign(bins, [keys])
+    return slots
+
+
+def test_assignment_matches_host_directory():
+    rng = np.random.default_rng(5)
+    dev = DeviceSlotDirectory(n_keys=1, table_capacity=256)
+    host = SlotDirectory()
+    for _ in range(6):
+        n = 700
+        bins = rng.integers(0, 4, n)
+        keys = rng.integers(0, 150, n)
+        s_dev = dev.assign(bins, [keys])
+        s_host = host.assign(bins, [keys])
+        # same rows must land in the same group under both directories
+        # (slot numbering may differ): compare group partition ids
+        _, inv_dev = np.unique(s_dev, return_inverse=True)
+        _, inv_host = np.unique(s_host, return_inverse=True)
+        # mapping dev-group -> host-group must be a bijection on rows
+        pairs = set(zip(inv_dev.tolist(), inv_host.tolist()))
+        assert len(pairs) == len(set(p[0] for p in pairs))
+        assert len(pairs) == len(set(p[1] for p in pairs))
+    assert dev.n_live == host.n_live
+
+
+def test_same_group_same_slot_across_batches():
+    dev = DeviceSlotDirectory(n_keys=1)
+    s1 = dev.assign(np.array([1, 1]), [np.array([7, 8])])
+    s2 = dev.assign(np.array([1, 1, 1]), [np.array([8, 7, 9])])
+    assert s1[0] == s2[1] and s1[1] == s2[0]
+    assert s2[2] not in (s1[0], s1[1])
+
+
+def test_take_bin_frees_and_reuses_slots():
+    dev = DeviceSlotDirectory(n_keys=1)
+    bins = np.zeros(5, dtype=np.int64)
+    keys = np.arange(5)
+    slots = dev.assign(bins, [keys])
+    got_keys, got_slots = dev.take_bin(0)
+    assert sorted(k[0] for k in got_keys) == list(range(5))
+    assert sorted(got_slots.tolist()) == sorted(slots.tolist())
+    assert dev.n_live == 0
+    # emitted groups are gone from the device table: re-assigning the
+    # same (bin, key) allocates fresh slots drawn from the free list
+    s2 = dev.assign(bins, [keys])
+    assert set(s2.tolist()) == set(slots.tolist())
+    assert dev.n_live == 5
+
+
+def test_multi_word_keys_and_bin_isolation():
+    dev = DeviceSlotDirectory(n_keys=2)
+    k1 = np.array([1, 1, 2])
+    k2 = np.array([10, 11, 10])
+    bins = np.array([0, 0, 0])
+    s = dev.assign(bins, [k1, k2])
+    assert len(set(s.tolist())) == 3
+    # same keys, different bin -> different groups
+    s_other = dev.assign(np.array([1, 1, 1]), [k1, k2])
+    assert not (set(s.tolist()) & set(s_other.tolist()))
+    keys0, slots0 = dev.take_bin_arrays(0)
+    assert sorted(zip(keys0[0].tolist(), keys0[1].tolist())) == [
+        (1, 10), (1, 11), (2, 10)
+    ]
+    assert dev.n_live == 3
+
+
+def test_table_growth_preserves_entries():
+    dev = DeviceSlotDirectory(n_keys=1, table_capacity=64)
+    bins = np.zeros(500, dtype=np.int64)
+    keys = np.arange(500)
+    s1 = dev.assign(bins, [keys])
+    assert dev.n_live == 500 and dev._cap >= 512
+    # every group still found after growth
+    s2 = dev.assign(bins, [keys])
+    assert np.array_equal(s1, s2)
+
+
+def test_bin_entries_nondestructive():
+    dev = DeviceSlotDirectory(n_keys=1)
+    dev.assign(np.array([3, 3]), [np.array([1, 2])])
+    kmat, slots = dev.bin_entries(3)
+    assert len(slots) == 2 and kmat.shape == (2, 1)
+    assert dev.n_live == 2
+    assert dev.by_bin == {3: True}
+    assert dev.live_bins() == [3]
+    assert dev.bins_up_to(4) == [3] and dev.bins_up_to(3) == []
+
+
+@pytest.mark.parametrize("golden", ["hourly_by_event_type",
+                                    "sliding_window_end", "nexmark_q5"])
+def test_golden_queries_with_device_directory(golden, tmp_path):
+    """Window pipelines with tpu.device_directory=True must reproduce the
+    committed golden outputs (tumbling, sliding, and the q5 hop+join
+    shape), checkpoint cycle included implicitly by slot reuse."""
+    import asyncio
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    import test_golden as tg
+
+    from arroyo_tpu.config import update
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.sql import plan_query
+
+    qpath = os.path.join(tg.GOLDEN, "queries", f"{golden}.sql")
+    gpath = os.path.join(tg.GOLDEN, "golden_outputs", f"{golden}.json")
+    out = str(tmp_path / "out.json")
+    sql = tg.load_query(qpath, out)
+    with update(tpu={"enabled": True, "device_directory": True}):
+        plan = plan_query(sql, parallelism=2)
+
+        async def go():
+            eng = Engine(plan.graph).start()
+            await eng.join(120)
+
+        asyncio.run(go())
+    got = tg.canonicalize_output(out, sql)
+    want = [line.strip() for line in open(gpath)]
+    assert got == want

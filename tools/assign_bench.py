@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Per-batch slot-assignment cost across the three directory tiers:
+
+  python  — host dict over batch-unique (bin, key) pairs (ops/directory.py)
+  native  — C++ open-addressing table (native/slotdir.cpp)
+  device  — device-resident sorted hash table, jitted searchsorted
+            (ops/device_directory.py, tpu.device_directory flag)
+
+Scenario mirrors a window operator in steady state: a fixed key universe
+cycling through bins — after a bin's first batch every key is a repeat
+hit, which is where the device tier's "no host hash-table work" pays.
+Run under JAX_PLATFORMS=cpu for the CPU number; the probe daemon's grant
+workload gives the TPU number.
+
+Usage: python tools/assign_bench.py [--rows 8192] [--keys 20000] [--iters 60]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_dir(kind):
+    if kind == "python":
+        from arroyo_tpu.ops.directory import SlotDirectory
+
+        return SlotDirectory()
+    if kind == "native":
+        from arroyo_tpu.ops.native import NativeSlotDirectory, load_native
+
+        mod = load_native()
+        if mod is None:
+            return None
+        return NativeSlotDirectory(mod, n_keys=1)
+    from arroyo_tpu.ops.device_directory import DeviceSlotDirectory
+
+    return DeviceSlotDirectory(n_keys=1)
+
+
+def bench(kind, rows, keys, iters):
+    d = make_dir(kind)
+    if d is None:
+        return None
+    rng = np.random.default_rng(7)
+    batches = [
+        (np.full(rows, i // 8, dtype=np.int64),
+         rng.integers(0, keys, rows))
+        for i in range(iters)
+    ]
+    # drain the way the window operators do: the vectorized array path
+    # when the directory offers it, tuples otherwise
+    drain = getattr(d, "take_bin_arrays", d.take_bin)
+    # warmup: populate a bin, roll it over, drain it — compiles the
+    # device lookup/merge/remove programs before the timed region
+    d.assign(np.full(rows, -2, dtype=np.int64), [batches[0][1]])
+    d.assign(np.full(rows, -1, dtype=np.int64), [batches[0][1]])
+    drain(-2)
+    drain(-1)
+    t0 = time.perf_counter()
+    cur_bin = None
+    for bins, kc in batches:
+        d.assign(bins, [kc])
+        # watermark-style emission: a bin that rolled over is drained,
+        # freeing its slots (keeps every tier's live set bounded, like
+        # the window operators do)
+        if cur_bin is not None and bins[0] != cur_bin:
+            drain(cur_bin)
+        cur_bin = int(bins[0])
+    dt = time.perf_counter() - t0
+    per_batch_us = dt / iters * 1e6
+    return per_batch_us, rows * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--keys", type=int, default=20000)
+    ap.add_argument("--iters", type=int, default=60)
+    args = ap.parse_args()
+    for kind in ("python", "native", "device"):
+        r = bench(kind, args.rows, args.keys, args.iters)
+        if r is None:
+            print(f"{kind:7s}  unavailable")
+            continue
+        us, rps = r
+        print(f"{kind:7s}  {us:9.0f} us/batch   {rps / 1e6:6.2f} M rows/s")
+
+
+if __name__ == "__main__":
+    main()

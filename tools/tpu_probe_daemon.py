@@ -219,6 +219,21 @@ def probe_child() -> None:
         run_device_goldens()
     except BaseException as e:
         print(f"GOLDENSUITEFAIL {type(e).__name__}: {e}", flush=True)
+    # per-batch slot-assignment cost on the real chip (python host dict
+    # vs native C++ vs the device-resident sorted hash table); each tier
+    # fails independently — the device number is the one this bench
+    # exists to collect and a host-tier error must not skip it
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    for kind in ("python", "native", "device"):
+        try:
+            import assign_bench
+            r = assign_bench.bench(kind, rows=8192, keys=20000, iters=40)
+            if r is not None:
+                print(f"ASSIGNBENCH {kind} {r[0]:.0f}us/batch "
+                      f"{r[1] / 1e6:.2f}Mrows/s", flush=True)
+        except BaseException as e:
+            print(f"ASSIGNBENCHFAIL {kind} {type(e).__name__}: {e}",
+                  flush=True)
     print("DONE", flush=True)
     os._exit(0)
 
@@ -366,6 +381,8 @@ def run_one_probe() -> bool:
             elif line.startswith("GOLDEN "):
                 parts = line.split()
                 goldens[parts[1]] = parts[2]
+                log_line(f"probe: {line}")
+            elif line.startswith("ASSIGNBENCH"):
                 log_line(f"probe: {line}")
             elif line.startswith(("WEDGED", "NOTTPU", "BENCHFAIL",
                                   "GOLDENSUITEFAIL")):

@@ -100,6 +100,9 @@ class TpuConfig:
     # host arrow join, where the device round-trip isn't worth it
     device_join: bool = True
     device_join_min_rows: int = 4096
+    # run the join probe even without tpu.enabled (jax on CPU): lets the
+    # bench measure the probe's cost model off-TPU
+    device_join_force: bool = False
     # device-resident (bin, key) -> slot group index (sorted hash table +
     # jitted searchsorted, ops/device_directory.py): slot assignment
     # stops round-tripping each batch's unique keys through a host hash

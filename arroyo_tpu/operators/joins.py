@@ -77,7 +77,9 @@ class JoinBase(Operator):
         from ..config import config
 
         cfg = config().tpu
-        if not (cfg.enabled and cfg.device_join):
+        # device_join_force runs the probe without tpu.enabled (jax-CPU):
+        # the bench uses it to measure the probe's cost model off-TPU
+        if not ((cfg.enabled or cfg.device_join_force) and cfg.device_join):
             return None
         if left_nt.num_rows + right_nt.num_rows < cfg.device_join_min_rows:
             return None
@@ -86,11 +88,15 @@ class JoinBase(Operator):
         if not device_join.available():
             return None
         lkeys = [f"__key{i}" for i in range(self.n_keys)]
-        lcols = device_join.key_cols_i64(left_nt, lkeys)
-        rcols = device_join.key_cols_i64(right_nt, lkeys)
-        if lcols is None or rcols is None:
+        prep = device_join.prepare_join_keys(left_nt, right_nt, lkeys)
+        if prep is None:
             return None
+        lcols, rcols, lsel, rsel = prep
         li, ri = device_join.probe(lcols, rcols)
+        if lsel is not None:
+            li = lsel[li]
+        if rsel is not None:
+            ri = rsel[ri]
         l_take = pa.array(li)
         r_take = pa.array(ri)
         arrays, names = [], []

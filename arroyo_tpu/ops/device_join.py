@@ -160,27 +160,81 @@ def available() -> bool:
         return False
 
 
-def key_cols_i64(
-    table, key_names: List[str]
-) -> Optional[List[np.ndarray]]:
-    """Key columns as int64 numpy arrays, or None when any column can't
-    ride the device probe (non-integer types, nulls)."""
+def _codable(t) -> bool:
     import pyarrow as pa
 
-    out = []
+    return (
+        pa.types.is_integer(t)
+        or pa.types.is_timestamp(t)
+        or pa.types.is_boolean(t)
+        or pa.types.is_string(t)
+        or pa.types.is_large_string(t)
+        or pa.types.is_binary(t)
+    )
+
+
+def prepare_join_keys(
+    left, right, key_names: List[str]
+) -> Optional[Tuple[List[np.ndarray], List[np.ndarray],
+                    Optional[np.ndarray], Optional[np.ndarray]]]:
+    """Two-sided key preparation for the device probe.
+
+    Returns (lcols, rcols, lsel, rsel) — int64 key word columns per side
+    plus the original-row indices they correspond to (None = identity),
+    or None when some key type can't ride the probe.
+
+    * String/binary keys are dictionary-encoded against a JOINT
+      dictionary (both sides concatenated) so equal strings get equal
+      int64 codes — the probe then stays exact, no hashing of values.
+    * Nullable keys: SQL equi-joins never match on NULL, so rows with
+      any null key word are pre-filtered and the selection mapping is
+      returned for the caller to translate pair indices back.
+    """
+    import pyarrow as pa
+
+    n_l, n_r = left.num_rows, right.num_rows
+    lcols: List[np.ndarray] = []
+    rcols: List[np.ndarray] = []
+    l_valid = np.ones(n_l, dtype=bool)
+    r_valid = np.ones(n_r, dtype=bool)
+    any_null = False
     for name in key_names:
-        col = table.column(name)
-        t = col.type
-        if not (
-            pa.types.is_integer(t)
-            or pa.types.is_timestamp(t)
-            or pa.types.is_boolean(t)
-        ):
+        lc = left.column(name).combine_chunks()
+        rc = right.column(name).combine_chunks()
+        if not (_codable(lc.type) and _codable(rc.type)):
             return None
-        col = col.combine_chunks()
-        if col.null_count:
-            return None  # SQL equi-join: nulls never match — host path
-        out.append(
-            np.asarray(col.cast(pa.int64(), safe=False))
-        )
-    return out
+        if lc.null_count or rc.null_count:
+            any_null = True
+            lm = np.asarray(lc.is_valid())
+            rm = np.asarray(rc.is_valid())
+            l_valid &= lm
+            r_valid &= rm
+        if pa.types.is_string(lc.type) or pa.types.is_large_string(
+            lc.type
+        ) or pa.types.is_binary(lc.type):
+            # joint dictionary: codes are comparable across sides.
+            # large_binary, not large_string: binary keys may hold
+            # non-UTF8 bytes a string cast would reject
+            both = pa.chunked_array([lc.cast(pa.large_binary()),
+                                     rc.cast(pa.large_binary())])
+            codes = both.combine_chunks().dictionary_encode().indices
+            c = np.asarray(codes.fill_null(-1).cast(pa.int64()))
+            lcols.append(c[:n_l])
+            rcols.append(c[n_l:])
+        else:
+            lcols.append(
+                np.asarray(lc.fill_null(0).cast(pa.int64(), safe=False))
+            )
+            rcols.append(
+                np.asarray(rc.fill_null(0).cast(pa.int64(), safe=False))
+            )
+    if not any_null:
+        return lcols, rcols, None, None
+    lsel = np.nonzero(l_valid)[0]
+    rsel = np.nonzero(r_valid)[0]
+    return (
+        [c[lsel] for c in lcols],
+        [c[rsel] for c in rcols],
+        lsel,
+        rsel,
+    )

@@ -106,7 +106,7 @@ def force_backend(plan, backend: str) -> None:
 
 
 def child(events: int, backend: str, query: str = "q5",
-          mesh_devices: int = 0) -> None:
+          mesh_devices: int = 0, force_device_join: bool = False) -> None:
     """Run one nexmark query; print 'RESULT <events/sec> <rows>'. With
     mesh_devices=N the window aggregates run on the N-device mesh
     execution path (ShardedAccumulator + in-step all_to_all) and a
@@ -124,6 +124,10 @@ def child(events: int, backend: str, query: str = "q5",
     config().pipeline.source_batch_size = 8192
     if mesh_devices:
         config().tpu.mesh_devices = mesh_devices
+    if force_device_join:
+        # measure the jitted join probe's cost model without tpu.enabled
+        # (jax-CPU): VERDICT r3 item 4
+        config().tpu.device_join_force = True
     if backend == "jax":
         # keep the XLA program count flat: every (bucket, capacity) pair
         # specializes update/gather/reset, and compiles through the TPU
@@ -254,6 +258,7 @@ def main():
     # (VERDICT r3 item 2). 0 disables.
     ap.add_argument("--mesh", type=int, default=8)
     ap.add_argument("--mesh-devices", type=int, default=0)
+    ap.add_argument("--force-device-join", action="store_true")
     ap.add_argument("--latency-child", choices=["numpy", "jax"])
     ap.add_argument("--latency-rate", type=int, default=50_000)
     ap.add_argument("--latency-seconds", type=float, default=12.0)
@@ -263,7 +268,8 @@ def main():
                       args.latency_child)
         return
     if args.child:
-        child(args.events, args.child, args.query, args.mesh_devices)
+        child(args.events, args.child, args.query, args.mesh_devices,
+              args.force_device_join)
         return
 
     cpu_env = dict(os.environ)

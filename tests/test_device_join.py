@@ -128,3 +128,70 @@ def test_instant_join_device_path_matches_host(monkeypatch):
 
     assert norm(dev) == norm(host)
     assert dev.num_rows == host.num_rows
+
+
+def _pairs_via_arrow_tables(lt, rt, keys):
+    lt2 = lt.append_column("__li", pa.array(
+        np.arange(lt.num_rows, dtype=np.int64)))
+    rt2 = rt.append_column("__ri", pa.array(
+        np.arange(rt.num_rows, dtype=np.int64)))
+    j = lt2.join(rt2, keys=keys, right_keys=keys, join_type="inner")
+    return set(zip(
+        np.asarray(j.column("__li").combine_chunks()).tolist(),
+        np.asarray(j.column("__ri").combine_chunks()).tolist(),
+    ))
+
+
+def _probe_pairs(lt, rt, keys):
+    prep = device_join.prepare_join_keys(lt, rt, keys)
+    assert prep is not None
+    lcols, rcols, lsel, rsel = prep
+    li, ri = device_join.probe(lcols, rcols)
+    if lsel is not None:
+        li = lsel[li]
+    if rsel is not None:
+        ri = rsel[ri]
+    return set(zip(li.tolist(), ri.tolist()))
+
+
+def test_prepare_join_keys_strings():
+    """String keys ride the probe via a joint dictionary (exact codes,
+    not hashes)."""
+    rng = np.random.RandomState(3)
+    words = np.array([f"w{i}" for i in range(50)])
+    lt = pa.table({"k": words[rng.randint(0, 50, 4000)]})
+    rt = pa.table({"k": words[rng.randint(0, 50, 250)]})
+    assert _probe_pairs(lt, rt, ["k"]) == _pairs_via_arrow_tables(
+        lt, rt, ["k"]
+    )
+
+
+def test_prepare_join_keys_nullable():
+    """Null keys never match (SQL equi-join): rows with nulls are
+    pre-filtered and pair indices map back to original rows."""
+    lt = pa.table({"k": pa.array([1, None, 2, 3, None, 2], type=pa.int64())})
+    rt = pa.table({"k": pa.array([None, 2, 1, 2], type=pa.int64())})
+    assert _probe_pairs(lt, rt, ["k"]) == _pairs_via_arrow_tables(
+        lt, rt, ["k"]
+    )
+
+
+def test_prepare_join_keys_string_nullable_multi():
+    """Mixed string + int keys with nulls on both sides."""
+    rng = np.random.RandomState(9)
+    words = np.array([f"s{i}" for i in range(20)])
+    lk = words[rng.randint(0, 20, 1500)].astype(object)
+    rk = words[rng.randint(0, 20, 400)].astype(object)
+    lk[::17] = None
+    rk[::11] = None
+    lt = pa.table({
+        "a": pa.array(lk, type=pa.string()),
+        "b": pa.array(rng.randint(0, 5, 1500), type=pa.int64()),
+    })
+    rt = pa.table({
+        "a": pa.array(rk, type=pa.string()),
+        "b": pa.array(rng.randint(0, 5, 400), type=pa.int64()),
+    })
+    assert _probe_pairs(lt, rt, ["a", "b"]) == _pairs_via_arrow_tables(
+        lt, rt, ["a", "b"]
+    )

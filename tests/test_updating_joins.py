@@ -213,3 +213,111 @@ def test_updating_join_checkpoint_restore(tmp_path):
     assert sorted(r["l"] for r in final if r["r"] is None) == list(
         range(0, 4000, 2)
     )
+
+
+def _count_bulk_hits(monkeypatch):
+    """Patch UpdatingJoinOperator._inner_bulk to count engagements so a
+    silent fallback to the per-row path can't pass the bulk tests
+    vacuously."""
+    import arroyo_tpu.operators.updating_join as uj
+
+    hits = {"bulk": 0, "slow": 0}
+    orig = uj.UpdatingJoinOperator._inner_bulk
+
+    def spy(self, batch, side, ts):
+        r = orig(self, batch, side, ts)
+        hits["bulk" if r is not None else "slow"] += 1
+        return r
+
+    monkeypatch.setattr(uj.UpdatingJoinOperator, "_inner_bulk", spy)
+    return hits
+
+
+def test_updating_inner_join_bulk_probe_path(tmp_path, monkeypatch):
+    """The device-probe bulk path (inner, append-only batches) must
+    produce the same net debezium state as the per-row path (VERDICT r3
+    item 4: updating join inner core rides the merge-join probe)."""
+    from arroyo_tpu.config import update
+
+    hits = _count_bulk_hits(monkeypatch)
+    sql = (
+        IMPULSE
+        + """
+        CREATE TABLE output (left_count BIGINT, right_count BIGINT) WITH (
+          connector = 'single_file', path = '$out',
+          format = 'debezium_json', type = 'sink'
+        );
+        INSERT INTO output
+        SELECT A.counter, B.counter
+        FROM impulse A
+        JOIN impulse_odd B ON A.counter = B.counter;
+        """
+    )
+    with update(tpu={"device_join_force": True, "device_join_min_rows": 0}):
+        final, ops = run_to_debezium(sql, tmp_path)
+    got = sorted(r["left_count"] for r in final)
+    assert got == list(range(1, 40, 2))
+    assert all(r["left_count"] == r["right_count"] for r in final)
+    assert hits["bulk"] > 0 and hits["slow"] == 0
+
+
+def test_updating_join_bulk_falls_back_on_retracts(tmp_path, monkeypatch):
+    """A retract-carrying input (updating aggregate upstream, so batches
+    carry __updating_meta) must take the per-row path and still produce
+    the correct net state with the force flag on."""
+    from arroyo_tpu.config import update
+
+    hits = _count_bulk_hits(monkeypatch)
+    sql = (
+        IMPULSE
+        + """
+        CREATE VIEW agg AS (
+          SELECT counter % 4 AS g, count(*) AS c FROM impulse GROUP BY 1
+        );
+        CREATE TABLE output (g BIGINT, c BIGINT, counter BIGINT) WITH (
+          connector = 'single_file', path = '$out',
+          format = 'debezium_json', type = 'sink'
+        );
+        INSERT INTO output
+        SELECT A.g, A.c, B.counter
+        FROM agg A
+        JOIN impulse B ON A.g = B.counter;
+        """
+    )
+    baseline, _ = run_to_debezium(sql, tmp_path / "base")
+    with update(tpu={"device_join_force": True, "device_join_min_rows": 0}):
+        final, _ = run_to_debezium(sql, tmp_path / "bulk")
+    key = lambda rows: sorted(json.dumps(r, sort_keys=True) for r in rows)
+    assert key(final) == key(baseline)
+    assert len(final) > 0
+
+
+def test_updating_inner_join_bulk_probe_strings(tmp_path, monkeypatch):
+    """Bulk path with string join keys (joint-dictionary probe) against
+    larger per-key fan-out; net state must match the per-row run."""
+    from arroyo_tpu.config import update
+
+    hits = _count_bulk_hits(monkeypatch)
+    sql = (
+        IMPULSE
+        + """
+        CREATE VIEW lab AS (
+          SELECT counter, concat('k', counter % 5) AS tag FROM impulse
+        );
+        CREATE TABLE output (lc BIGINT, rc BIGINT) WITH (
+          connector = 'single_file', path = '$out',
+          format = 'debezium_json', type = 'sink'
+        );
+        INSERT INTO output
+        SELECT A.counter, B.counter
+        FROM lab A
+        JOIN lab B ON A.tag = B.tag;
+        """
+    )
+    baseline, _ = run_to_debezium(sql, tmp_path / "base")
+    with update(tpu={"device_join_force": True, "device_join_min_rows": 0}):
+        bulk, _ = run_to_debezium(sql, tmp_path / "bulk")
+    key = lambda rows: sorted(json.dumps(r, sort_keys=True) for r in rows)
+    assert key(bulk) == key(baseline)
+    assert len(baseline) == 40 * 8  # 5 tags x 8 rows each -> 8x8 pairs x 5
+    assert hits["bulk"] > 0

@@ -142,3 +142,64 @@ class PreviewConnector(Connector):
         # rows land in the shared session list that the API tails over its
         # websocket (in-process path); cross-process preview goes over gRPC
         return VecSink(config.setdefault("results", []))
+
+
+class LatencyFileSink(Operator):
+    """Appends one 'arrival_ns event_ts_ns' line per row, flushed per
+    batch: end-to-end latency measurement in DISTRIBUTED runs, where the
+    sink lives in a worker process and an in-memory capture can't cross
+    the process boundary (bench.py --latency-distributed reads the
+    file). Arrival time is taken once per batch — rows of a batch arrive
+    together."""
+
+    def __init__(self, path: str):
+        super().__init__("latency_file_sink")
+        self.path = path
+        self._fh = None
+
+    async def on_start(self, ctx):
+        import os
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "ab")
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        import time
+
+        import numpy as np
+        import pyarrow as pa
+
+        from ..schema import TIMESTAMP_FIELD
+
+        now = time.time_ns()
+        names = batch.schema.names
+        if TIMESTAMP_FIELD not in names:
+            return
+        ts = np.asarray(
+            batch.column(names.index(TIMESTAMP_FIELD)).cast(pa.int64())
+        )
+        self._fh.write(
+            b"".join(b"%d %d\n" % (now, t) for t in ts.tolist())
+        )
+        self._fh.flush()
+
+    async def on_close(self, ctx, collector, is_eod):
+        if self._fh is not None:
+            self._fh.close()
+        return None
+
+
+@register_connector
+class LatencyFileConnector(Connector):
+    name = "latency_file"
+    description = "per-row arrival/event-time log for latency benchmarks"
+    sink = True
+    config_schema = {"path": {"type": "string", "required": True}}
+
+    def validate_options(self, options, schema):
+        if "path" not in options:
+            raise ValueError("latency_file requires a path option")
+        return {"path": options["path"]}
+
+    def make_sink(self, config, schema):
+        return LatencyFileSink(config["path"])

@@ -106,6 +106,22 @@ async def read_frame(reader: asyncio.StreamReader):
     return (sn, ss, dn, ds), item
 
 
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on data-plane sockets: frames are latency-sensitive
+    and often tiny (watermarks, per-window join batches) — Nagle plus
+    delayed ACK costs 40-200 ms PER HOP, which stacks across the
+    multi-edge paths of a split pipeline. Throughput is unaffected: the
+    pump already writes whole frames and drains."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        import socket as _socket
+
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # e.g. TLS-wrapped transport without raw socket access
+
+
 class DataPlaneServer:
     """Accepts peer connections and routes frames into local input queues
     (reference `Senders`)."""
@@ -131,6 +147,7 @@ class DataPlaneServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter):
+        _set_nodelay(writer)
         peer = writer.get_extra_info("peername")
         try:
             while True:
@@ -179,6 +196,7 @@ class RemoteEdgeSender:
             host, int(port), ssl=ctx,
             server_hostname=server_name if ctx is not None else None,
         )
+        _set_nodelay(self.writer)
         self.task = asyncio.ensure_future(self._pump())
 
     async def _pump(self):

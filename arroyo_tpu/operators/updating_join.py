@@ -62,6 +62,10 @@ class UpdatingJoinOperator(Operator):
         # path: (key pa arrays, payload python column lists); rebuilt
         # lazily when that side's state has mutated
         self._col_cache: List[Optional[tuple]] = [None, None]
+        # sticky per-side flag: a null join key ever stored disables the
+        # bulk path (per-row null semantics are authoritative) without
+        # paying a store scan per batch; conservatively never cleared
+        self._store_has_null_key: List[bool] = [False, False]
         self._lmap = {f: i for i, f in enumerate(self.left_out)}
         self._rmap = {f: i for i, f in enumerate(self.right_out)}
         self._kmap = {f"__key{i}": i for i in range(self.n_keys)}
@@ -82,6 +86,8 @@ class UpdatingJoinOperator(Operator):
                             self.state[side].setdefault(key, []).extend(
                                 tuple(r) for r in rows
                             )
+                            if any(k is None for k in key):
+                                self._store_has_null_key[side] = True
         self._col_cache = [None, None]
 
     def _owns(self, key: tuple, ctx) -> bool:
@@ -180,14 +186,10 @@ class UpdatingJoinOperator(Operator):
         cfg = get_config().tpu
         if not (cfg.device_join and (cfg.enabled or cfg.device_join_force)):
             return None
-        other_rows = sum(
-            len(v) for v in self.state[1 - side].values()
-        )
-        if batch.num_rows + other_rows < cfg.device_join_min_rows:
-            return None
-        # cheap disqualifiers BEFORE the O(store) mirror build: jax
-        # availability and key-type codability — a permanently-ineligible
-        # pipeline must not pay the mirror rebuild every batch
+        # cheap per-batch disqualifiers BEFORE any O(store) work (key
+        # scan, mirror rebuild): jax availability, key-type codability,
+        # null keys anywhere (per-row dict-equality semantics are
+        # authoritative for nulls), retracts in the batch
         from ..ops import device_join
 
         if not device_join.available():
@@ -201,6 +203,10 @@ class UpdatingJoinOperator(Operator):
             for k in kcols
         ):
             return None
+        if any(
+            batch.column(names.index(k)).null_count for k in kcols
+        ) or self._store_has_null_key[0] or self._store_has_null_key[1]:
+            return None
         if UPDATING_META_FIELD in names:
             retracts = batch.column(
                 names.index(UPDATING_META_FIELD)
@@ -209,6 +215,11 @@ class UpdatingJoinOperator(Operator):
 
             if pc.any(retracts).as_py():
                 return None
+        other_rows = sum(
+            len(v) for v in self.state[1 - side].values()
+        )
+        if batch.num_rows + other_rows < cfg.device_join_min_rows:
+            return None
         try:
             other_tab, other_payload_cols = self._other_side_cache(
                 1 - side, batch
@@ -368,6 +379,8 @@ class UpdatingJoinOperator(Operator):
             out_append.append(self._null_padded(side, key, payload))
         mine.append(payload)
         self._col_cache[side] = None
+        if any(k is None for k in key):
+            self._store_has_null_key[side] = True
 
     def _retract_row(self, side, key, payload, deltas):
         out_append = _DeltaSink(deltas, False)

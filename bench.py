@@ -217,12 +217,89 @@ def latency_child(rate: int, seconds: float, backend: str) -> None:
             print("LATENCY nan nan 0", flush=True)
 
 
+def latency_distributed(rate: int, seconds: float,
+                        workers: int = 2, parallelism: int = 2):
+    """Realtime q5 with source and sink in SEPARATE worker processes over
+    the TCP data plane (`python -m arroyo_tpu run --scheduler process`):
+    the deployment the reference's network_manager actually serves. The
+    sink is the latency_file connector (per-row arrival vs window-end
+    event time, flushed per batch); returns (p50_ms, p99_ms, rows) or
+    None. VERDICT r3 item 6."""
+    import tempfile
+    import time
+
+    events = int(rate * seconds)
+    with tempfile.TemporaryDirectory() as td:
+        lat_path = os.path.join(td, "lat.txt")
+        sql = QUERIES["q5"].format(rate=rate, events=events)
+        # no explicit start_time: the source anchors event time at its
+        # OWN start, so multi-second distributed startup (process spawn,
+        # plan compile) doesn't masquerade as window latency
+        assert "start_time = '0'" in sql, "latency bench: DDL shape changed"
+        sql = sql.replace("start_time = '0'", "realtime = 'true'")
+        sink_ddl = (
+            "CREATE TABLE latsink (auction BIGINT, num BIGINT) WITH ("
+            f"connector = 'latency_file', path = '{lat_path}', "
+            "type = 'sink');\n"
+        )
+        assert "SELECT AuctionBids.auction" in sql
+        sql = sql.replace(
+            "SELECT AuctionBids.auction",
+            sink_ddl + "INSERT INTO latsink SELECT AuctionBids.auction",
+            1,
+        )
+        qfile = os.path.join(td, "q.sql")
+        with open(qfile, "w") as f:
+            f.write(sql)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PYTHONPATH", None)
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+            env.pop(var, None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "arroyo_tpu", "run", qfile,
+                 "--parallelism", str(parallelism),
+                 "--workers", str(workers), "--scheduler", "process"],
+                cwd=here, env=env, capture_output=True, text=True,
+                timeout=seconds * 3 + 240,
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write("distributed latency run timed out\n")
+            return None
+        if "job finished" not in out.stdout:
+            sys.stderr.write(out.stdout[-1000:] + out.stderr[-2000:] + "\n")
+            return None
+        import numpy as np
+
+        lats = []
+        try:
+            with open(lat_path) as f:
+                for line in f:
+                    arrival, ts = line.split()
+                    ms = (int(arrival) - int(ts)) / 1e6
+                    if ms > 0:  # end-of-stream flush emits future windows
+                        lats.append(ms)
+        except OSError:
+            return None
+        if not lats:
+            return None
+        arr = np.asarray(lats)
+        return (float(np.percentile(arr, 50)),
+                float(np.percentile(arr, 99)), len(arr))
+
+
 def run_child(events: int, backend: str, timeout: float, env=None,
-              query: str = "q5", mesh_devices: int = 0):
+              query: str = "q5", mesh_devices: int = 0,
+              force_device_join: bool = False):
     cmd = [sys.executable, os.path.abspath(__file__), "--child", backend,
            "--events", str(events), "--query", query]
     if mesh_devices:
         cmd += ["--mesh-devices", str(mesh_devices)]
+    if force_device_join:
+        cmd += ["--force-device-join"]
     try:
         out = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout, env=env
@@ -274,8 +351,10 @@ def main():
 
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
-    baseline = run_child(args.events, "numpy", args.timeout, env=cpu_env)
-    device = run_child(args.events, "jax", args.timeout)
+    baseline = run_child(args.events, "numpy", args.timeout, env=cpu_env,
+                         force_device_join=args.force_device_join)
+    device = run_child(args.events, "jax", args.timeout,
+                       force_device_join=args.force_device_join)
     # The axon relay is intermittently wedged; tools/tpu_probe_daemon.py
     # probes it all round and converts the first grant into an in-process
     # device bench recorded in TPU_GRANT.json. If the live device child
@@ -363,7 +442,8 @@ def main():
     for q in ("q1", "q7", "q8"):
         # half the events: side metrics, not the headline measurement
         r = run_child(args.events // 2, side_backend, args.timeout,
-                      env=side_env, query=q)
+                      env=side_env, query=q,
+                      force_device_join=args.force_device_join)
         # 0 = that query failed/timed out (distinguishable from "not run")
         sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
     # mesh execution path: q5 on an N-virtual-device CPU mesh (the
@@ -420,6 +500,12 @@ def main():
             sys.stderr.write(out.stderr[-2000:] + "\n")
     except subprocess.TimeoutExpired:
         sys.stderr.write("latency child timed out\n")
+    # distributed-mode latency: same realtime q5, but source and sink in
+    # separate worker processes over the TCP data plane
+    dist = latency_distributed(args.latency_rate, args.latency_seconds)
+    if dist is not None:
+        sides["q5_p50_ms_dist"] = round(dist[0], 1)
+        sides["q5_p99_ms_dist"] = round(dist[1], 1)
     baseline_real = baseline is not None
     if device is None:
         device = baseline

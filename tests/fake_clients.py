@@ -670,3 +670,160 @@ class _FakeRabbitQueue:
                     await asyncio.sleep(0.005)
 
         return _It()
+
+
+class FakeRedisServer:
+    """Dict-backed redis: string/list/hash targets + GET with a call
+    counter (the lookup-join cache test asserts on it)."""
+
+    def __init__(self):
+        self.strings: Dict[str, bytes] = {}
+        self.lists: Dict[str, List[bytes]] = {}
+        self.hashes: Dict[str, Dict[str, bytes]] = {}
+        self.get_calls = 0
+        self.lock = threading.Lock()
+
+    def make_module(self):
+        server = self
+
+        class _Pipe:
+            def __init__(self):
+                self.ops = []
+
+            def set(self, k, v):
+                self.ops.append(("set", k, v))
+
+            def rpush(self, k, v):
+                self.ops.append(("rpush", k, v))
+
+            def hset(self, k, f, v):
+                self.ops.append(("hset", k, f, v))
+
+            def execute(self):
+                with server.lock:
+                    for op in self.ops:
+                        if op[0] == "set":
+                            server.strings[op[1]] = _b(op[2])
+                        elif op[0] == "rpush":
+                            server.lists.setdefault(op[1], []).append(
+                                _b(op[2])
+                            )
+                        else:
+                            server.hashes.setdefault(op[1], {})[
+                                op[2]
+                            ] = _b(op[3])
+                self.ops = []
+
+        def _b(v):
+            return v if isinstance(v, bytes) else str(v).encode()
+
+        class _Client:
+            def pipeline(self):
+                return _Pipe()
+
+            def set(self, k, v):
+                with server.lock:
+                    server.strings[k] = _b(v)
+
+            def get(self, k):
+                with server.lock:
+                    server.get_calls += 1
+                    return server.strings.get(k)
+
+        class Redis:
+            @classmethod
+            def from_url(cls, url):
+                return _Client()
+
+        class _Module:
+            pass
+
+        _Module.Redis = Redis
+        return _Module
+
+
+class FakeFluvioCluster:
+    """Partitioned topic logs with a BLOCKING consumer stream (like the
+    real client): the iterator waits for new records instead of ending,
+    so sources stop via engine control, and resume is offset-driven."""
+
+    def __init__(self, partitions: int = 1):
+        self.partitions = partitions
+        self.logs: Dict[tuple, List[bytes]] = {}
+        self.cond = threading.Condition()
+
+    def append(self, topic: str, partition: int, value: bytes):
+        with self.cond:
+            self.logs.setdefault((topic, partition), []).append(value)
+            self.cond.notify_all()
+
+    def records(self, topic: str, partition: int) -> List[bytes]:
+        with self.cond:
+            return list(self.logs.get((topic, partition), []))
+
+    def make_module(self):
+        cluster = self
+
+        class _Record:
+            def __init__(self, off, val):
+                self._off = off
+                self._val = val
+
+            def value(self):
+                return self._val
+
+            def offset(self):
+                return self._off
+
+        class Offset:
+            @staticmethod
+            def absolute(n):
+                return int(n)
+
+        class _Consumer:
+            def __init__(self, topic, partition):
+                self.topic = topic
+                self.partition = partition
+
+            def stream(self, offset):
+                i = int(offset)
+                while True:
+                    with cluster.cond:
+                        log = cluster.logs.get(
+                            (self.topic, self.partition), []
+                        )
+                        if i >= len(log):
+                            cluster.cond.wait(timeout=0.05)
+                            continue
+                        val = log[i]
+                    yield _Record(i, val)
+                    i += 1
+
+        class _Producer:
+            def __init__(self, topic):
+                self.topic = topic
+
+            def send(self, key, value):
+                cluster.append(
+                    self.topic, 0,
+                    value if isinstance(value, bytes) else value.encode(),
+                )
+
+        class _Conn:
+            def partition_consumer(self, topic, partition):
+                return _Consumer(topic, partition)
+
+            def topic_producer(self, topic):
+                return _Producer(topic)
+
+        class Fluvio:
+            @staticmethod
+            def connect():
+                return _Conn()
+
+        class _Module:
+            pass
+
+        _Module.Fluvio = Fluvio
+        _Module.Offset = Offset
+        return _Module

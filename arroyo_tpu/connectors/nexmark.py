@@ -139,14 +139,21 @@ def _auction_fields(ns):
     return seller, initial, reserve, expires_s, category
 
 
+def _last_auction_ids(ns: np.ndarray) -> np.ndarray:
+    """Vectorized inclusive last-auction-id per sequence number — the ONE
+    definition of the formula (scalar last_auction_id and both generation
+    paths derive from it, keeping them bit-identical)."""
+    ns = np.asarray(ns, dtype=np.int64)
+    epoch, offset = np.divmod(ns, PROPORTION_DENOMINATOR)
+    done = np.clip(offset - PERSON_PROPORTION + 1, 0, AUCTION_PROPORTION)
+    return FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + done - 1
+
+
 def _bid_fields(ns):
     """Vectorized bid field generation shared by event() and gen_batch()."""
     ns = np.asarray(ns, dtype=np.int64)
     epoch = ns // PROPORTION_DENOMINATOR
-    offset = ns % PROPORTION_DENOMINATOR
-    done = np.minimum(np.maximum(offset - PERSON_PROPORTION + 1, 0),
-                      AUCTION_PROPORTION)
-    last_auction = FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + done - 1
+    last_auction = _last_auction_ids(ns)
     last_person = FIRST_PERSON_ID + epoch
     hot = _u01(ns, 0xA1) < (HOT_AUCTION_RATIO - 1) / HOT_AUCTION_RATIO
     cold = FIRST_AUCTION_ID + (
@@ -231,9 +238,7 @@ class NexmarkGenerator:
 
     @staticmethod
     def last_auction_id(n: int) -> int:
-        epoch, offset = divmod(n, PROPORTION_DENOMINATOR)
-        done = min(max(offset - PERSON_PROPORTION + 1, 0), AUCTION_PROPORTION)
-        return FIRST_AUCTION_ID + epoch * AUCTION_PROPORTION + done - 1
+        return int(_last_auction_ids(np.asarray([n]))[0])
 
     def event(self, n: int, ts: int) -> dict:
         kind = self.kind_of(n)
@@ -276,39 +281,111 @@ class NexmarkGenerator:
 
 
 def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
-    """Vectorized batch generation for a range of sequence numbers: bids
-    (92% of events) are produced with numpy array ops; the rare person/
-    auction events go through the scalar generator. Deterministic in the
-    sequence-number range. Used by the source hot loop and benchmarks."""
-    g = NexmarkGenerator()
+    """Vectorized batch generation for a range of sequence numbers: all
+    three event kinds build their struct children as flat arrays with
+    validity masks (no python dict per row); strings ride arrow C
+    kernels. Deterministic in the sequence-number range and bit-identical
+    to the scalar event() path (pinned by
+    test_nexmark_gen_batch_matches_scalar_generator). Used by the source
+    hot loop and benchmarks."""
     offs = ns % PROPORTION_DENOMINATOR
     is_bid = offs >= PERSON_PROPORTION + AUCTION_PROPORTION
     is_person = offs < PERSON_PROPORTION
     n = len(ns)
-    person_col = [None] * n
-    auction_col = [None] * n
-    bid_col = [None] * n
+
+    def _scat_i(idx, vals):
+        out = np.zeros(n, dtype=np.int64)
+        out[idx] = vals
+        return out
+
+    def _scat_s(idx, vals):
+        out = np.full(n, "", dtype=object)
+        out[idx] = vals
+        return out
+
     # persons/auctions share the vectorized field helpers with event()
-    # (bit-identical), evaluated ONCE per batch over the index arrays
+    # (bit-identical) and, like bids, build their struct children as flat
+    # arrays with a validity mask — no python dict per row
     pi = np.nonzero(is_person)[0]
+    person_arr = pa.nulls(n, type=PERSON_T)
     if len(pi):
         pns = ns[pi]
-        pfields = _person_fields(pns)
-        epoch = pns // PROPORTION_DENOMINATOR
-        for j, i in enumerate(pi):
-            person_col[i] = _person_row(
-                pfields, j, FIRST_PERSON_ID + int(epoch[j]), int(ts[i])
-            )
+        first, last, city, state, cc = _person_fields(pns)
+        ids = FIRST_PERSON_ID + pns // PROPORTION_DENOMINATOR
+        names = [
+            f"{_FIRST[f]} {_LAST[l]}"
+            for f, l in zip(first.tolist(), last.tolist())
+        ]
+        emails = [
+            f"{nm.replace(' ', '.').lower()}@example.com" for nm in names
+        ]
+        ccs = [
+            f"{a:04d} {b:04d} {c:04d} {d:04d}"
+            for a, b, c, d in zip(*(x.tolist() for x in cc))
+        ]
+        p_valid = np.zeros(n, dtype=bool)
+        p_valid[pi] = True
+        person_arr = pa.StructArray.from_arrays(
+            [
+                pa.array(_scat_i(pi, ids)),
+                pa.array(_scat_s(pi, names), type=pa.string()),
+                pa.array(_scat_s(pi, emails), type=pa.string()),
+                pa.array(_scat_s(pi, ccs), type=pa.string()),
+                pa.array(
+                    _scat_s(pi, [_CITIES[i] for i in city.tolist()]),
+                    type=pa.string(),
+                ),
+                pa.array(
+                    _scat_s(pi, [_STATES[i] for i in state.tolist()]),
+                    type=pa.string(),
+                ),
+                pa.array(np.where(p_valid, ts, 0)).cast(pa.timestamp("ns")),
+                pa.array([""] * n, type=pa.string()),
+            ],
+            fields=list(PERSON_T),
+            mask=pa.array(~p_valid),
+        )
     ai = np.nonzero(~is_bid & ~is_person)[0]
+    auction_arr = pa.nulls(n, type=AUCTION_T)
     if len(ai):
         ans = ns[ai]
-        afields = _auction_fields(ans)
-        for j, i in enumerate(ai):
-            auction_col[i] = _auction_row(
-                afields, j, g.last_auction_id(int(ans[j])), int(ts[i])
-            )
+        seller, initial, reserve, expires_s, category = _auction_fields(ans)
+        aids = _last_auction_ids(ans)
+        a_valid = np.zeros(n, dtype=bool)
+        a_valid[ai] = True
+        aid_list = aids.tolist()
+        auction_arr = pa.StructArray.from_arrays(
+            [
+                pa.array(_scat_i(ai, aids)),
+                pa.array(
+                    _scat_s(ai, [f"item-{a}" for a in aid_list]),
+                    type=pa.string(),
+                ),
+                pa.array(
+                    _scat_s(
+                        ai,
+                        [f"description of item {a}" for a in aid_list],
+                    ),
+                    type=pa.string(),
+                ),
+                pa.array(_scat_i(ai, initial)),
+                pa.array(_scat_i(ai, reserve)),
+                pa.array(np.where(a_valid, ts, 0)).cast(pa.timestamp("ns")),
+                pa.array(
+                    _scat_i(
+                        ai,
+                        ts[ai] + expires_s * 1_000_000_000,
+                    )
+                ).cast(pa.timestamp("ns")),
+                pa.array(_scat_i(ai, seller)),
+                pa.array(_scat_i(ai, category)),
+                pa.array([""] * n, type=pa.string()),
+            ],
+            fields=list(AUCTION_T),
+            mask=pa.array(~a_valid),
+        )
     bi = np.nonzero(is_bid)[0]
-    bid_arr = pa.array(bid_col, type=BID_T)
+    bid_arr = pa.nulls(n, type=BID_T)
     if len(bi):
         # vectorized struct construction: children built as flat arrays with
         # a validity mask (no python dict per bid)
@@ -322,20 +399,28 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
             out[bi] = vals
             return out
 
-        urls = np.full(n, "", dtype=object)
-        urls[bi] = [
-            f"https://auction.example.com/item/{int(a)}" for a in auction
-        ]
-        chans = np.full(n, "", dtype=object)
-        chans[bi] = [_CHANNELS[int(c)] for c in channel]
+        import pyarrow.compute as pc
+
+        # url/channel built in arrow C kernels (int->string cast + concat,
+        # dictionary take): ~46% of events are bids, and a python f-string
+        # per bid dominated the generator's profile
+        urls = pc.binary_join_element_wise(
+            pa.scalar("https://auction.example.com/item/"),
+            pc.cast(pa.array(scatter(auction)), pa.string()),
+            "",
+        )
+        chans = pc.take(
+            pa.array(_CHANNELS, type=pa.string()),
+            pa.array(scatter(channel)),
+        )
         mask = pa.array(~valid)
         bid_arr = pa.StructArray.from_arrays(
             [
                 pa.array(scatter(auction)),
                 pa.array(scatter(bidder)),
                 pa.array(scatter(price)),
-                pa.array(chans, type=pa.string()),
-                pa.array(urls, type=pa.string()),
+                chans,
+                urls,
                 pa.array(np.where(valid, ts, 0)).cast(pa.timestamp("ns")),
                 pa.array([""] * n, type=pa.string()),
             ],
@@ -345,8 +430,8 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
     schema = NEXMARK_SCHEMA.schema
     return pa.RecordBatch.from_arrays(
         [
-            pa.array(person_col, type=PERSON_T),
-            pa.array(auction_col, type=AUCTION_T),
+            person_arr,
+            auction_arr,
             bid_arr,
             pa.array(ts, type=pa.int64()).cast(pa.timestamp("ns")),
         ],

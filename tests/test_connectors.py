@@ -882,3 +882,32 @@ def test_iceberg_recovery_commits_orphaned_files(tmp_path):
     asyncio.run(sink.on_start(_Ctx()))
     meta2, _, _, _ = _iceberg_read_table(table_dir)
     assert len(meta2["snapshots"]) == len(meta["snapshots"])
+
+
+def test_nexmark_gen_batch_matches_scalar_generator():
+    """The vectorized struct construction (persons/auctions/bids) must be
+    row-identical to the scalar event() path for the same sequence
+    numbers — the guard that keeps the two generation paths bit-equal."""
+    import numpy as np
+
+    from arroyo_tpu.connectors.nexmark import NexmarkGenerator, gen_batch
+
+    g = NexmarkGenerator()
+    ns = np.arange(0, 211, dtype=np.int64)  # covers several epochs
+    ts = (1_000_000 + ns * 7919).astype(np.int64)
+    batch = gen_batch(ns, ts)
+    rows = batch.to_pylist()
+    for i, n in enumerate(ns.tolist()):
+        want = g.event(n, int(ts[i]))
+        got = rows[i]
+        for side in ("person", "auction", "bid"):
+            w = want[side]
+            gv = got[side]
+            if w is None:
+                assert gv is None, (side, n)
+                continue
+            for k, v in w.items():
+                gvv = gv[k]
+                if hasattr(gvv, "value"):  # pandas/pa timestamp -> ns
+                    gvv = gvv.value
+                assert gvv == v, (side, n, k, gvv, v)

@@ -98,7 +98,7 @@ class FileSystemSource(SourceOperator):
                                 await self.flush_buffer(ctx, collector)
                         row_idx += 1
             else:
-                with open(fpath, "rb") as f:
+                with _open_decompressed(fpath) as f:
                     for line in f:
                         line = line.strip()
                         if not line:
@@ -119,6 +119,25 @@ class FileSystemSource(SourceOperator):
             self.position = [fi + 1, 0]
         await self.flush_buffer(ctx, collector)
         return SourceFinishType.FINAL
+
+
+def _open_decompressed(fpath: str):
+    """Open a line-format source file, transparently decompressing by
+    extension — the reference's source reads gzip/zstd the same way
+    (/root/reference/crates/arroyo-connectors/src/filesystem/source.rs,
+    CompressionFormat none|gzip|zstd)."""
+    if fpath.endswith(".gz"):
+        import gzip
+
+        return gzip.open(fpath, "rb")
+    if fpath.endswith((".zst", ".zstd")):
+        import io
+
+        import zstandard
+
+        # the raw ZstdDecompressionReader has no line iteration
+        return io.BufferedReader(zstandard.open(fpath, "rb"))
+    return open(fpath, "rb")
 
 
 class _PartWriter:

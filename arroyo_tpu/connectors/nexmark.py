@@ -390,14 +390,11 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
         # vectorized struct construction: children built as flat arrays with
         # a validity mask (no python dict per bid)
         auction, bidder, price, channel = _bid_fields(ns[bi])
-        full = np.zeros(n, dtype=np.int64)
         valid = np.zeros(n, dtype=bool)
         valid[bi] = True
 
         def scatter(vals):
-            out = full.copy()
-            out[bi] = vals
-            return out
+            return _scat_i(bi, vals)
 
         import pyarrow.compute as pc
 

@@ -395,3 +395,64 @@ def test_postgres_backend_dialect():
     db.delete_connection_table(ct["id"])
     db.delete_pipeline(p["id"])
     assert any("%s" in s for s in executed)
+
+
+def test_console_smoke_and_ui_api_contract():
+    """Serve /console and pin the UI-API contract: the SPA loads, and
+    every /api/v1 path referenced in app.js resolves to a registered
+    route (catches the reference-webui drift class where the UI polls
+    endpoints the server renamed)."""
+    @with_client
+    async def _(client, api, controller):
+        import re
+
+        resp = await client.get("/console")
+        assert resp.status == 200
+        html = await resp.text()
+        assert "<html" in html.lower() and "app.js" in html
+        resp = await client.get("/console/app.js")
+        assert resp.status == 200
+        js = await resp.text()
+        # the SPA routes every call through api(path) with relative
+        # paths: extract the literal arguments of its HTTP helpers
+        raw = re.findall(
+            r"""(?:GET|POST|PATCH|DELETE|DEL)\(["'`](/[^"'`?]*)""", js
+        )
+        called = sorted(
+            "/api/v1" + re.sub(r"\$\{[^}]*\}", "${p}", p)
+            for p in set(raw)
+        )
+        assert called, "app.js references no API endpoints?"
+        # aiohttp canonicals: /api/v1/jobs/{job_id}/checkpoints
+        canonicals = set()
+        for r in client.app.router.routes():
+            info = r.resource.get_info() if r.resource else {}
+            canon = info.get("path") or info.get("formatter")
+            if canon:
+                canonicals.add(canon)
+
+        def matches(js_path: str) -> bool:
+            want = js_path.split("/")
+            for canon in canonicals:
+                have = canon.split("/")
+                if len(have) != len(want):
+                    continue
+                ok = True
+                for w, h in zip(want, have):
+                    if h.startswith("{") and h.endswith("}"):
+                        continue  # path param matches anything non-empty
+                    if w.startswith("${"):
+                        ok = False  # JS param against static segment
+                        break
+                    if w != h:
+                        ok = False
+                        break
+                if ok:
+                    return True
+            return False
+
+        missing = [p for p in called if not matches(p)]
+        assert not missing, f"SPA calls unregistered endpoints: {missing}"
+        # the SPA must poll the structured metrics endpoint whose shape
+        # test_operator_metric_groups_structured pins
+        assert any("operator_metric_groups" in p for p in called)

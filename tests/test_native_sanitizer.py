@@ -1,0 +1,40 @@
+"""ASan/UBSan run over the native slot directory (SURVEY §5.2: host C++
+gets sanitizers where the reference relies on Rust ownership). Builds
+slotdir.cpp with -fsanitize=address,undefined and drives random
+assign/take/get cycles against the pure-python directory under
+LD_PRELOAD=libasan — see tools/sanitize_native.py."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _libasan() -> str:
+    try:
+        return subprocess.run(
+            ["g++", "-print-file-name=libasan.so"], capture_output=True,
+            text=True, timeout=30,
+        ).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        return ""
+
+
+@pytest.mark.skipif(
+    not os.path.exists(_libasan() or "/nonexistent"),
+    reason="libasan not available",
+)
+def test_native_slotdir_sanitized():
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "sanitize_native.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=400,
+    )
+    assert proc.returncode == 0, (
+        f"sanitizer run failed:\n{proc.stdout[-2000:]}\n"
+        f"{proc.stderr[-4000:]}"
+    )

@@ -1040,6 +1040,32 @@ class SlidingWindowOperator(WindowOperatorBase):
         self.last_freed_bin = max(self.last_freed_bin or lo_bin, lo_bin)
 
 
+def _batch_group_codes(key_cols: List[np.ndarray], n: int) -> np.ndarray:
+    """Per-row group code over the key columns, local to ONE batch:
+    non-integer columns factorize via pandas (no entry in the process-
+    wide intern table — session keys expire, interning them would leak)."""
+    if not key_cols:
+        return np.zeros(n, dtype=np.int64)
+    import pandas as pd
+
+    norm = []
+    for c in key_cols:
+        c = np.asarray(c)
+        if c.dtype.kind == "M":
+            c = c.view("i8")
+        elif c.dtype == np.uint64:
+            c = c.view(np.int64)
+        if c.dtype.kind not in "iub":
+            c = pd.factorize(c)[0].astype(np.int64)
+        norm.append(c.astype(np.int64, copy=False))
+    if len(norm) == 1:
+        _, inverse = np.unique(norm[0], return_inverse=True)
+        return inverse.ravel()
+    _, inverse = np.unique(np.stack(norm, axis=1), axis=0,
+                           return_inverse=True)
+    return inverse.ravel()
+
+
 class SessionWindowOperator(WindowOperatorBase):
     """Per-key gap-merged sessions
     (reference session_aggregating_window.rs:51-942). Session bookkeeping is
@@ -1158,15 +1184,41 @@ class SessionWindowOperator(WindowOperatorBase):
         wm = ctx.watermarks.current_nanos()
         keys = self._key_arrays(batch)
         cols = self._agg_input_cols(batch)
-        order = np.argsort(ts, kind="stable")
-        row_slots = np.empty(len(ts), dtype=np.int64)
-        for ri in order:
-            t = int(ts[ri])
-            if wm is not None and t + self.gap <= wm:
-                row_slots[ri] = -1  # fully late: its session already emitted
-                continue
-            key = tuple(_to_py(k[ri]) for k in keys)
-            row_slots[ri] = self._place(key, t)
+        n = len(ts)
+        row_slots = np.full(n, -1, dtype=np.int64)
+        live = (
+            np.ones(n, dtype=bool) if wm is None
+            else ts + self.gap > wm  # else fully late: already emitted
+        )
+        li = np.nonzero(live)[0]
+        if len(li):
+            # vectorized segmentation: group rows by key, split each
+            # key's time-sorted rows where the gap is exceeded, then do
+            # the scalar bookkeeping ONCE PER SEGMENT (high-rate session
+            # streams have many rows per segment; the old per-row
+            # _place loop was the session operator's host ceiling)
+            lts = ts[li]
+            lk = [np.asarray(k)[li] for k in keys]
+            inverse = _batch_group_codes(lk, len(li))
+            order = np.lexsort((lts, inverse))
+            so_key = inverse[order]
+            so_ts = lts[order]
+            new_seg = np.ones(len(order), dtype=bool)
+            if len(order) > 1:
+                new_seg[1:] = (so_key[1:] != so_key[:-1]) | (
+                    so_ts[1:] - so_ts[:-1] >= self.gap
+                )
+            seg_id = np.cumsum(new_seg) - 1
+            starts = np.nonzero(new_seg)[0]
+            ends = np.r_[starts[1:], len(order)] - 1
+            seg_slots = np.empty(len(starts), dtype=np.int64)
+            for g in range(len(starts)):
+                first = int(order[starts[g]])
+                key = tuple(_to_py(c[first]) for c in lk)
+                seg_slots[g] = self._place_segment(
+                    key, int(so_ts[starts[g]]), int(so_ts[ends[g]])
+                )
+            row_slots[li[order]] = seg_slots[seg_id]
         keep = row_slots >= 0
         if keep.any():
             self._ensure_capacity()
@@ -1174,23 +1226,30 @@ class SessionWindowOperator(WindowOperatorBase):
                 row_slots[keep], {c: v[keep] for c, v in cols.items()}
             )
 
-    def _place(self, key: tuple, t: int) -> int:
-        """Find/extend/merge the session containing t; returns its slot."""
+    def _place_segment(self, key: tuple, lo: int, hi: int) -> int:
+        """Find/extend/merge the session covering [lo, hi] (all rows of
+        one batch segment share it); returns its slot. Interval union
+        with gap is order-independent, so segment-level placement yields
+        the same final sessions as the old per-row placement."""
         sess = self.sessions.setdefault(key, [])
         hit = None
         for s in sess:
-            if s[0] - self.gap < t < s[1] + self.gap or s[0] <= t <= s[1]:
+            if s[0] - self.gap < hi and lo < s[1] + self.gap:
                 hit = s
                 break
         if hit is None:
             slot = self._alloc_slot()
             self._ensure_capacity()
-            sess.append([t, t, slot])
+            sess.append([lo, hi, slot])
             sess.sort(key=lambda s: s[0])
             return slot
-        hit[0] = min(hit[0], t)
-        hit[1] = max(hit[1], t)
-        # the extension may bridge adjacent sessions: merge while overlapping
+        hit[0] = min(hit[0], lo)
+        hit[1] = max(hit[1], hi)
+        # the extension may bridge adjacent sessions: merge while
+        # overlapping. When the HIT side is the one folded away (it
+        # bridged backwards into an earlier session), the survivor
+        # becomes the hit — returning the folded slot would scatter the
+        # segment's rows into a freed (reusable) slot.
         sess.sort(key=lambda s: s[0])
         i = 0
         while i < len(sess) - 1:
@@ -1198,6 +1257,8 @@ class SessionWindowOperator(WindowOperatorBase):
             if b[0] < a[1] + self.gap:
                 self._merge_slots(a, b)
                 sess.pop(i + 1)
+                if b is hit:
+                    hit = a
             else:
                 i += 1
         return hit[2]

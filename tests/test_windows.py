@@ -261,3 +261,80 @@ def test_dirty_chunk_coalescing_bounds_memory():
     assert len(s) == 1000
     assert set(b.tolist()) == {199}
     assert np.array_equal(np.sort(kc[0]), keys)
+
+
+def test_session_bridge_row_merges_two_existing_sessions():
+    """A later row landing between two established sessions (within gap
+    of both) must fold them into ONE surviving session whose slot
+    receives the row — exercises the cross-batch merge chain the
+    per-segment placement rework must preserve."""
+    import numpy as np
+
+    results = []
+
+    def mkbatch(ts_ms):
+        ts = np.asarray(ts_ms, dtype=np.int64) * MS
+        return pa.RecordBatch.from_arrays(
+            [
+                pa.array(np.arange(len(ts), dtype=np.uint64)),
+                pa.array(np.zeros(len(ts), dtype=np.uint64)),
+                pa.array(ts).cast(pa.timestamp("ns")),
+            ],
+            schema=IMPULSE_SCHEMA.schema,
+        )
+
+    # gap 6ms: [0..5] and [12..14] coexist (7ms apart); the row at 8
+    # bridges both (8 < 5+6 and 12 < 8+6)
+    b1 = mkbatch(list(range(0, 6)) + [12, 13, 14])
+    b2 = mkbatch([8])
+    g = LogicalGraph()
+    g.add_node(
+        LogicalNode(
+            1,
+            "vec",
+            [
+                ChainedOp(
+                    OperatorName.CONNECTOR_SOURCE,
+                    {"connector": "vec", "batches": [b1, b2],
+                     "schema": IMPULSE_SCHEMA},
+                ),
+                # hold the watermark back so neither session emits before
+                # the bridging row in b2 arrives (end-of-data flushes)
+                ChainedOp(OperatorName.EXPRESSION_WATERMARK,
+                          {"interval_nanos": 25 * MS}),
+            ],
+            1,
+        )
+    )
+    out_schema = StreamSchema.from_fields(
+        [("ws", pa.int64()), ("we", pa.int64()),
+         ("subtask_index", pa.uint64()), ("cnt", pa.int64())]
+    )
+    g.add_node(
+        LogicalNode.single(
+            2,
+            OperatorName.SESSION_WINDOW_AGGREGATE,
+            {
+                "gap_nanos": 6 * MS,
+                "window_start_field": "ws",
+                "window_end_field": "we",
+                "aggregates": [{"kind": "count", "name": "cnt"}],
+                "key_cols": [1],
+                "schema": out_schema,
+                "backend": "numpy",
+            },
+        )
+    )
+    g.add_node(
+        LogicalNode.single(
+            3, OperatorName.CONNECTOR_SINK,
+            {"connector": "vec", "results": results},
+        )
+    )
+    g.add_edge(1, 2, EdgeType.SHUFFLE,
+               IMPULSE_SCHEMA.with_keys(["subtask_index"]))
+    g.add_edge(2, 3, EdgeType.FORWARD, out_schema)
+    run(g)
+    assert len(results) == 1, results
+    assert results[0]["cnt"] == 10
+    assert results[0]["ws"] == 0 and results[0]["we"] == 14 * MS + 6 * MS

@@ -76,6 +76,11 @@ class PipelineConfig:
 @dataclasses.dataclass
 class TpuConfig:
     enabled: bool = True  # use device kernels when a TPU/accelerator exists
+    # device tiers additionally require jax's default backend to BE an
+    # accelerator (ops/_jax.py device_tier_active): jitted kernels on
+    # CPU-jax lose to the numpy/arrow host paths. False = engage on any
+    # jax backend (tests; CPU-jax cost-model measurement runs)
+    require_accelerator: bool = True
     # pad batch key-cardinality to these bucket sizes to bound recompilation
     shape_buckets: tuple = (256, 1024, 4096, 16384, 65536)
     # starting accumulator slots: each 4x growth re-specializes the jitted

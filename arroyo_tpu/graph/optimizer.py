@@ -39,8 +39,16 @@ class ChainingOptimizer:
                     op.operator == OperatorName.ASYNC_UDF for op in dst.chain
                 ):
                     continue
-                # don't chain across sinks-with-commit semantics; sinks may
-                # be chained as tail but never have outputs anyway.
+                # never fuse sinks: checkpoint/commit control (2PC
+                # prepare/commit, offset truncation) targets sink TASKS —
+                # a sink folded into an upstream chain breaks that
+                # routing. The valuable fusion is the stateless
+                # source->watermark->projection prefix anyway.
+                if any(
+                    op.operator == OperatorName.CONNECTOR_SINK
+                    for op in dst.chain
+                ):
+                    continue
                 self._fuse(graph, src, dst, edge)
                 changed = True
                 break

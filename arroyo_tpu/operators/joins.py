@@ -77,9 +77,9 @@ class JoinBase(Operator):
         from ..config import config
 
         cfg = config().tpu
-        # device_join_force runs the probe without tpu.enabled (jax-CPU):
-        # the bench uses it to measure the probe's cost model off-TPU
-        if not ((cfg.enabled or cfg.device_join_force) and cfg.device_join):
+        from ..ops._jax import device_join_active
+
+        if not device_join_active():
             return None
         if left_nt.num_rows + right_nt.num_rows < cfg.device_join_min_rows:
             return None

@@ -184,7 +184,9 @@ class UpdatingJoinOperator(Operator):
         from ..config import config as get_config
 
         cfg = get_config().tpu
-        if not (cfg.device_join and (cfg.enabled or cfg.device_join_force)):
+        from ..ops._jax import device_join_active
+
+        if not device_join_active():
             return None
         # cheap per-batch disqualifiers BEFORE any O(store) work (key
         # scan, mirror rebuild): jax availability, key-type codability,

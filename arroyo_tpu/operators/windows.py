@@ -122,6 +122,11 @@ class WindowOperatorBase(Operator):
         n = config.get("mesh_devices")
         if n is None:
             n = config_fn().tpu.mesh_devices
+        # deliberately NOT gated on require_accelerator/device_tier_active:
+        # mesh mode only engages on an explicit mesh_devices >= 2, and
+        # running it over a virtual CPU mesh is a supported deployment
+        # (the multichip dryrun and the mesh tests validate sharding
+        # compilation without accelerator hardware)
         return int(n or 0) if config_fn().tpu.enabled else 0
 
     @staticmethod
@@ -154,8 +159,10 @@ class WindowOperatorBase(Operator):
                     load_native,
                 )
 
+                from ..ops._jax import device_tier_active
+
                 cfg = config_fn().tpu
-                use_device = cfg.enabled and cfg.device_directory
+                use_device = device_tier_active() and cfg.device_directory
                 widths = (
                     key_word_widths(self._key_types) if use_device
                     else flat_key_widths(self._key_types)

@@ -8,6 +8,7 @@ and integer accumulators are 64-bit)."""
 from __future__ import annotations
 
 _jax = None
+_accel: bool | None = None
 
 
 def get_jax():
@@ -18,3 +19,41 @@ def get_jax():
         jax.config.update("jax_enable_x64", True)
         _jax = jax
     return _jax
+
+
+def accelerator_present() -> bool:
+    """True when jax's default backend is a real accelerator (TPU/GPU).
+    The device execution tiers engage on this by default: jitted kernels
+    on CPU-jax LOSE to the numpy/arrow host paths (measured: forced
+    device join q7 322k -> 92k ev/s; assign bench device tier 15ms vs
+    native C++ 0.24ms per batch), so a production run on a host without
+    an accelerator must not pay XLA compiles for negative throughput."""
+    global _accel
+    if _accel is None:
+        try:
+            _accel = get_jax().default_backend() not in ("cpu",)
+        except Exception:  # jax absent/broken: host paths only
+            _accel = False
+    return _accel
+
+
+def device_tier_active() -> bool:
+    """tpu.enabled AND (an accelerator exists OR the config explicitly
+    waives the requirement — tests and CPU-jax measurement runs)."""
+    from ..config import config
+
+    cfg = config().tpu
+    if not cfg.enabled:
+        return False
+    return accelerator_present() if cfg.require_accelerator else True
+
+
+def device_join_active() -> bool:
+    """Gate for the merge-join probe, shared by the instant/expiring and
+    updating join operators: the device tier (or the force flag for
+    off-TPU cost-model measurement) plus the join-specific switch."""
+    from ..config import config
+
+    cfg = config().tpu
+    return cfg.device_join and (device_tier_active()
+                                or cfg.device_join_force)

@@ -889,7 +889,9 @@ class Accumulator:
 def make_accumulator(specs: List[AggSpec], capacity: Optional[int] = None,
                      backend: Optional[str] = None) -> Accumulator:
     if backend is None:
-        backend = "jax" if config().tpu.enabled else "numpy"
+        from ._jax import device_tier_active
+
+        backend = "jax" if device_tier_active() else "numpy"
     if capacity is None:
         capacity = int(config().tpu.initial_capacity)
     return Accumulator(specs, capacity, backend)

@@ -2474,4 +2474,16 @@ def plan_query(
         sinks.append(node.node_id)
     if not sinks:
         raise SqlError("query contains no INSERT or SELECT statement")
+    # operator chaining at compile time, like the reference
+    # (arroyo-planner/src/lib.rs:935-937 behind pipeline.chaining.enabled):
+    # fused Forward chains execute in ONE subtask with direct calls, which
+    # also guarantees they can never be scheduled onto different workers —
+    # unchained, a forward edge crossing workers ships full pre-projection
+    # rows (e.g. nexmark structs) over the TCP data plane
+    from ..config import config as _config
+
+    if _config().pipeline.chaining_enabled:
+        from ..graph import ChainingOptimizer
+
+        ChainingOptimizer().optimize(planner.graph)
     return PlanResult(planner.graph, provider, sinks)

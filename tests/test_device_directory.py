@@ -120,7 +120,8 @@ def test_golden_queries_with_device_directory(golden, tmp_path):
     gpath = os.path.join(tg.GOLDEN, "golden_outputs", f"{golden}.json")
     out = str(tmp_path / "out.json")
     sql = tg.load_query(qpath, out)
-    with update(tpu={"enabled": True, "device_directory": True}):
+    with update(tpu={"enabled": True, "device_directory": True,
+                     "require_accelerator": False}):
         plan = plan_query(sql, parallelism=2)
 
         async def go():

@@ -108,6 +108,9 @@ def test_instant_join_device_path_matches_host(monkeypatch):
     op = InstantJoinOperator(cfg)
 
     monkeypatch.setattr(config().tpu, "enabled", True)
+    # the CPU test host is no accelerator; waive the requirement so the
+    # probe engages on jax-CPU
+    monkeypatch.setattr(config().tpu, "require_accelerator", False)
     monkeypatch.setattr(config().tpu, "device_join", True)
     monkeypatch.setattr(config().tpu, "device_join_min_rows", 0)
     dev = op._join_tables(left, right, ts_value=ts)

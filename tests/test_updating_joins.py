@@ -72,17 +72,30 @@ def test_updating_inner_join(tmp_path):
 
 
 def test_updating_left_join(tmp_path):
+    # separate sources: the left table lands instantly, the right side is
+    # realtime-paced, so the left side's null-padded rows DETERMINISTICALLY
+    # precede their matches (a shared fanned-out source makes side arrival
+    # order scheduler-dependent, and either order is legal join semantics)
     final, ops = run_to_debezium(
-        IMPULSE
-        + """
+        """
+        CREATE TABLE lsrc WITH (
+          connector = 'impulse', event_rate = '100000', realtime = 'true',
+          message_count = '40'
+        );
+        CREATE TABLE rsrc WITH (
+          connector = 'impulse', event_rate = '150', realtime = 'true',
+          message_count = '40'
+        );
         CREATE TABLE output (l BIGINT, r BIGINT) WITH (
           connector = 'single_file', path = '$out',
           format = 'debezium_json', type = 'sink'
         );
         INSERT INTO output
         SELECT A.counter, B.counter
-        FROM impulse A
-        LEFT JOIN impulse_odd B ON A.counter = B.counter;
+        FROM lsrc A
+        LEFT JOIN (
+          SELECT counter FROM rsrc WHERE counter % 2 == 1
+        ) B ON A.counter = B.counter;
         """,
         tmp_path,
     )
@@ -90,7 +103,7 @@ def test_updating_left_join(tmp_path):
     assert sorted(r["l"] for r in final) == list(range(40))
     nulls = [r for r in final if r["r"] is None]
     assert sorted(r["l"] for r in nulls) == list(range(0, 40, 2))
-    # the odd rows' null-padded versions were retracted
+    # the odd rows' null-padded versions were retracted as matches arrived
     assert ops["d"] >= 1
 
 

@@ -67,17 +67,30 @@ class WindowOperatorBase(Operator):
             from ..parallel import (
                 MeshSlotDirectory,
                 ShardedAccumulator,
+                SharedMeshSlotDirectory,
                 key_mesh,
             )
 
             from ..config import config as config_fn
 
+            # planner marks aggregates whose every grouping key is the
+            # window itself (one group per bin): hash ownership would
+            # starve most shards, so those run SALTED — rows spread
+            # round-robin across all shards, folded at gather. Needs
+            # fold-able state (no host-state aggregates).
+            salted = bool(config.get("mesh_salted")) and not any(
+                s.host_state() is not None for s in self.specs
+            )
             self.acc = ShardedAccumulator(
                 self.specs,
                 key_mesh(self._mesh_device_list(mesh_n)),
                 rows_per_shard=config_fn().tpu.mesh_rows_per_shard,
+                salted=salted,
             )
-            self.dir = MeshSlotDirectory(mesh_n)
+            self.dir = (
+                SharedMeshSlotDirectory(mesh_n) if salted
+                else MeshSlotDirectory(mesh_n)
+            )
         else:
             self.acc = make_accumulator(self.specs, backend=self.backend)
             self.dir = SlotDirectory()

@@ -30,6 +30,14 @@ def accelerator_present() -> bool:
     an accelerator must not pay XLA compiles for negative throughput."""
     global _accel
     if _accel is None:
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # explicit CPU pin: answer without importing jax at all (a
+            # default-config host-only deployment shouldn't pay jax
+            # import + backend discovery just to learn "use numpy")
+            _accel = False
+            return _accel
         try:
             _accel = get_jax().default_backend() not in ("cpu",)
         except Exception:  # jax absent/broken: host paths only

@@ -2,4 +2,5 @@ from .mesh import key_mesh  # noqa: F401
 from .sharded_state import (  # noqa: F401
     MeshSlotDirectory,
     ShardedAccumulator,
+    SharedMeshSlotDirectory,
 )

@@ -830,6 +830,15 @@ class Planner:
             "key_cols": list(range(len(key_names))),
             "schema": agg_out_schema,
         }
+        # one group per bin: every grouping key IS a window struct (q5's
+        # MAX-per-window stage) or there are none. Mesh hash ownership
+        # would land each window's rows on one shard, so the window
+        # operators run these salted (rows spread across shards, folded
+        # at gather — parallel/sharded_state.SharedMeshSlotDirectory)
+        if not key_bound or all(
+            b.dtype == WINDOW_TYPE for b in key_bound
+        ):
+            window_config["mesh_salted"] = True
         if instant:
             op_name = OperatorName.TUMBLING_WINDOW_AGGREGATE
             window_config["width_nanos"] = 0

@@ -258,8 +258,6 @@ def hash_arrays(columns: list[np.ndarray]) -> np.ndarray:
 
 def hash_column(values) -> np.ndarray:
     """Hash one column (numpy array or list) to uint64."""
-    import pandas.util  # local import: pandas is heavy
-
     arr = np.asarray(values)
     if arr.dtype.kind in ("i", "u", "b"):
         return _splitmix64(arr.astype(np.uint64, copy=False))
@@ -270,6 +268,10 @@ def hash_column(values) -> np.ndarray:
                            else arr.astype(np.float64).view(np.uint64))
     if arr.dtype.kind == "M":  # datetime64
         return _splitmix64(arr.view("i8").astype(np.uint64))
+    # only object/string columns need pandas; importing it eagerly cost
+    # ~0.3s INSIDE the first shuffle of integer-keyed pipelines
+    import pandas.util  # local import: pandas is heavy
+
     return pandas.util.hash_array(
         arr.astype(object), hash_key=_PANDAS_HASH_KEY, categorize=False
     ).astype(np.uint64)

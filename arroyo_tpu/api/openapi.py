@@ -48,6 +48,11 @@ ROUTES: List[Route] = [
      None, "JobCollection"),
     ("get", "/jobs/{job_id}/checkpoints", "job_checkpoints",
      "Checkpoints of a job", "jobs", None, "CheckpointCollection"),
+    ("get", "/jobs/{job_id}/checkpoints/{epoch}/operator_checkpoint_groups",
+     "operator_checkpoint_groups",
+     "Per-operator detail of one checkpoint: per-subtask state sizes, "
+     "file/row counts and watermarks", "jobs", None,
+     "OperatorCheckpointGroupCollection"),
     ("get", "/jobs/{job_id}/errors", "job_errors",
      "Operator error reports of a job", "jobs", None,
      "JobLogMessageCollection"),
@@ -182,6 +187,22 @@ def _schemas() -> Dict[str, Any]:
              "metricGroups": {"type": "array",
                               "items": _ref("MetricGroup")}},
         ),
+        "CheckpointTableDetail": _obj(
+            {"table": _str(), "kind": _str(), "bytes": _int(),
+             "files": _int(), "rows": {**_int(), "nullable": True}},
+        ),
+        "CheckpointTaskDetail": _obj(
+            {"subtask": _int(), "task_id": _str(),
+             "watermark": {**_int(), "nullable": True},
+             "bytes": _int(), "rows": _int(),
+             "tables": {"type": "array",
+                        "items": _ref("CheckpointTableDetail")}},
+        ),
+        "OperatorCheckpointGroup": _obj(
+            {"node_id": _int(), "bytes": _int(),
+             "tasks": {"type": "array",
+                       "items": _ref("CheckpointTaskDetail")}},
+        ),
         "Connector": _obj(
             {"id": _str(), "name": _str(), "description": _str(),
              "source": {"type": "boolean"}, "sink": {"type": "boolean"},
@@ -258,6 +279,7 @@ def _schemas() -> Dict[str, Any]:
         ("Checkpoint", "CheckpointCollection"),
         ("JobLogMessage", "JobLogMessageCollection"),
         ("OperatorMetricGroup", "OperatorMetricGroupCollection"),
+        ("OperatorCheckpointGroup", "OperatorCheckpointGroupCollection"),
         ("Connector", "ConnectorCollection"),
         ("ConnectionProfile", "ConnectionProfileCollection"),
         ("ConnectionTable", "ConnectionTableCollection"),

@@ -212,6 +212,64 @@ class ApiServer:
                 )
         return json_response({"data": out})
 
+    async def operator_checkpoint_groups(self, request: web.Request):
+        """Per-operator drill-down of one checkpoint (reference
+        webui CheckpointDetails + api checkpoint details route): groups
+        the tasks' completion reports by operator node with per-subtask
+        state sizes, file/row counts and watermarks."""
+        jid = request.match_info["job_id"]
+        try:
+            epoch = int(request.match_info["epoch"])
+        except ValueError:
+            return json_response({"data": []})
+        job = self.controller.jobs.get(jid) if self.controller else None
+        if job is None or epoch not in job.checkpoints:
+            return json_response({"data": []})
+        by_node: dict = {}
+        for task_id, rep in sorted(job.checkpoints[epoch].items()):
+            tables = []
+            total_bytes = 0
+            total_rows = 0
+            # metadata nests per chained operator: {op{idx}: {table: meta}}
+            for op_key, op_tables in (rep.get("metadata") or {}).items():
+                for tname, meta in (op_tables or {}).items():
+                    label = f"{op_key}/{tname}"
+                    if meta.get("kind") == "global":
+                        b = int(meta.get("bytes", 0))
+                        tables.append({"table": label, "kind": "global",
+                                       "bytes": b, "files": 1,
+                                       "rows": None})
+                        total_bytes += b
+                    else:
+                        files = meta.get("files") or []
+                        b = sum(int(f.get("bytes", 0)) for f in files
+                                if isinstance(f, dict))
+                        r = sum(int(f.get("rows", 0)) for f in files
+                                if isinstance(f, dict))
+                        tables.append({"table": label, "kind": "time_key",
+                                       "bytes": b, "files": len(files),
+                                       "rows": r})
+                        total_bytes += b
+                        total_rows += r
+            by_node.setdefault(rep.get("node_id"), []).append({
+                "subtask": rep.get("subtask"),
+                "task_id": task_id,
+                "watermark": rep.get("watermark"),
+                "bytes": total_bytes,
+                "rows": total_rows,
+                "tables": tables,
+            })
+        data = [
+            {
+                "node_id": nid,
+                "bytes": sum(t["bytes"] for t in tasks),
+                "tasks": sorted(tasks, key=lambda t: t["subtask"] or 0),
+            }
+            for nid, tasks in sorted(by_node.items(),
+                                     key=lambda kv: kv[0] or 0)
+        ]
+        return json_response({"data": data, "epoch": epoch})
+
     async def job_errors(self, request: web.Request):
         jid = request.match_info["job_id"]
         job = self.controller.jobs.get(jid) if self.controller else None

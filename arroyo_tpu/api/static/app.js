@@ -291,7 +291,8 @@ async function viewPipelineDetail(id) {
      <section><h2>Dataflow graph</h2>
        <div class="dag-box" id="dag" class="muted">loading…</div></section>
      <div class="grid2">
-       <section><h2>Checkpoints</h2><table id="ckpts"></table></section>
+       <section><h2>Checkpoints</h2><table id="ckpts"></table>
+         <div id="ckdetail"></div></section>
        <section><h2>Errors</h2><div id="errs" class="muted">none</div>
        </section>
      </div>
@@ -335,11 +336,16 @@ async function viewPipelineDetail(id) {
       const cks = (await GET(`/jobs/${jobId}/checkpoints`)).data;
       const ct = $("#ckpts");
       if (!ct || gen !== navGen) return;
-      ct.innerHTML = "<tr><th>epoch</th><th>tasks</th><th>path</th></tr>";
+      ct.innerHTML =
+        "<tr><th>epoch</th><th>tasks</th><th>path</th></tr>";
       for (const c of cks.slice(-12).reverse())
         ct.innerHTML +=
-          `<tr><td>${c.epoch}</td><td>${c.tasks}</td>` +
+          `<tr class="clickable ck-row" data-epoch="${c.epoch}" ` +
+          `title="click for per-operator detail">` +
+          `<td>${c.epoch}</td><td>${c.tasks}</td>` +
           `<td class="muted">${esc(c.backend)}</td></tr>`;
+      for (const row of ct.querySelectorAll(".ck-row"))
+        row.onclick = () => showCheckpointDetail(jobId, row.dataset.epoch);
       const errs = (await GET(`/jobs/${jobId}/errors`)).data;
       $("#errs").innerHTML = errs.length
         ? `<pre class="err">${esc(errs.map((e) => e.message).join("\n"))}</pre>`
@@ -374,6 +380,57 @@ async function viewPipelineDetail(id) {
   }
   await refresh();
   setPoll(gen, refresh, 2000);
+}
+
+async function showCheckpointDetail(jobId, epoch) {
+  /* per-operator checkpoint drill-down (reference CheckpointDetails):
+     per-subtask state sizes, file/row counts and watermarks */
+  const box = $("#ckdetail");
+  if (!box) return;
+  box.innerHTML = '<div class="muted">loading…</div>';
+  let d;
+  try {
+    d = await GET(
+      `/jobs/${jobId}/checkpoints/${epoch}/operator_checkpoint_groups`
+    );
+  } catch (e) {
+    box.innerHTML = `<div class="muted">${esc(e.message)}</div>`;
+    return;
+  }
+  if (!d.data.length) {
+    box.innerHTML =
+      `<div class="muted">no detail for epoch ${esc(epoch)}</div>`;
+    return;
+  }
+  let html = `<h3>checkpoint ${esc(epoch)} — per-operator state</h3>`;
+  for (const g of d.data) {
+    html +=
+      `<div class="ck-op"><b>node ${esc(g.node_id)}</b>` +
+      ` <span class="muted">${fmtBytes(g.bytes)}</span>` +
+      `<table><tr><th>subtask</th><th>bytes</th><th>rows</th>` +
+      `<th>watermark</th><th>tables</th></tr>`;
+    for (const t of g.tasks) {
+      const tbl = t.tables
+        .map((x) => `${esc(x.table)}(${x.kind} ${fmtBytes(x.bytes)}` +
+          `${x.files > 1 ? ", " + x.files + " files" : ""})`)
+        .join(", ");
+      html +=
+        `<tr><td>${esc(t.subtask)}</td><td>${fmtBytes(t.bytes)}</td>` +
+        `<td>${t.rows ?? ""}</td>` +
+        `<td class="muted">${t.watermark == null ? "" :
+          new Date(t.watermark / 1e6).toISOString().slice(11, 23)}</td>` +
+        `<td class="muted">${tbl}</td></tr>`;
+    }
+    html += "</table></div>";
+  }
+  box.innerHTML = html;
+}
+
+function fmtBytes(b) {
+  if (b == null) return "";
+  if (b < 1024) return b + " B";
+  if (b < 1048576) return (b / 1024).toFixed(1) + " KB";
+  return (b / 1048576).toFixed(1) + " MB";
 }
 
 /* new pipeline */

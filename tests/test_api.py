@@ -422,7 +422,7 @@ def test_console_smoke_and_ui_api_contract():
         # the SPA routes every call through api(path) with relative
         # paths: extract the literal arguments of its HTTP helpers
         raw = re.findall(
-            r"""(?:GET|POST|PATCH|DELETE|DEL)\(["'`](/[^"'`?]*)""", js
+            r"""(?:GET|POST|PATCH|DELETE|DEL)\(\s*["'`](/[^"'`?]*)""", js
         )
         called = sorted(
             "/api/v1" + re.sub(r"\$\{[^}]*\}", "${p}", p)
@@ -462,3 +462,83 @@ def test_console_smoke_and_ui_api_contract():
         # the SPA must poll the structured metrics endpoint whose shape
         # test_operator_metric_groups_structured pins
         assert any("operator_metric_groups" in p for p in called)
+
+
+def test_operator_checkpoint_groups_detail(tmp_path):
+    """Per-operator checkpoint drill-down (reference CheckpointDetails):
+    per-subtask state sizes, file counts and watermarks for one epoch."""
+    sink = tmp_path / "out.json"
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '20000', realtime = 'true',
+      message_count = '8000'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{sink}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % 4 AS k, tumble(interval '100 millisecond') AS w,
+             count(*) AS cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+    async def body(client, api, controller):
+        from arroyo_tpu.config import update
+
+        with update(pipeline={
+            "checkpointing": {"storage_url": str(tmp_path / "ck"),
+                              "interval": 0.1},
+        }):
+            r = await client.post(
+                "/api/v1/pipelines", json={"name": "ckd", "query": sql}
+            )
+            assert r.status == 200
+            # wait until at least one checkpoint is listed
+            groups = None
+            for _ in range(300):
+                jobs = (await (await client.get("/api/v1/jobs")).json())[
+                    "data"
+                ]
+                if jobs:
+                    jid = jobs[0]["id"]
+                    cks = (await (await client.get(
+                        f"/api/v1/jobs/{jid}/checkpoints"
+                    )).json())["data"]
+                    if cks:
+                        epoch = cks[-1]["epoch"]
+                        d = await (await client.get(
+                            f"/api/v1/jobs/{jid}/checkpoints/{epoch}"
+                            "/operator_checkpoint_groups"
+                        )).json()
+                        # early epochs may precede any flushed state;
+                        # wait for one that carries bytes
+                        if d["data"] and any(
+                            t["bytes"] > 0 for g in d["data"]
+                            for task in g["tasks"] for t in task["tables"]
+                        ):
+                            groups = d
+                            break
+                await asyncio.sleep(0.05)
+            assert groups is not None, "no checkpoint detail appeared"
+            assert groups["epoch"] == epoch
+            # shape: operators -> tasks -> tables, with byte accounting
+            g0 = groups["data"][0]
+            assert {"node_id", "bytes", "tasks"} <= set(g0)
+            t0 = g0["tasks"][0]
+            assert {"subtask", "task_id", "watermark", "bytes", "rows",
+                    "tables"} <= set(t0)
+            # the window operator's state table must appear with bytes
+            all_tables = [
+                t["table"] for g in groups["data"]
+                for task in g["tasks"] for t in task["tables"]
+            ]
+            assert all_tables, "no state tables in checkpoint detail"
+            assert any(
+                t["bytes"] > 0 for g in groups["data"]
+                for task in g["tasks"] for t in task["tables"]
+            )
+
+    with_client(body)

@@ -364,14 +364,16 @@ async function viewPipelineDetail(id) {
     for (const [op, groups] of Object.entries(hist)) {
       html += `<h3>operator ${esc(op)}</h3><div>`;
       for (const [name, series] of Object.entries(groups)) {
-        const rates = name.includes("bytes") || name.includes("messages")
-          || name.includes("batches") || name.includes("errors")
-          ? rateSeries(series)
-          : series;
+        const isRate = name.includes("bytes") || name.includes("messages")
+          || name.includes("batches") || name.includes("errors");
+        const rates = isRate ? rateSeries(series) : series;
         const last = rates.length ? rates[rates.length - 1].v : 0;
+        const shown = name === "backpressure"
+          ? (last * 100).toFixed(0) + "%"
+          : fmt(last) + (isRate ? "/s" : "");
         html +=
           `<div class="metric-cell"><div class="label">${esc(name)}</div>` +
-          `<div class="value">${fmt(last)}/s</div>` +
+          `<div class="value">${shown}</div>` +
           sparkline(rates, 160, 36) + `</div>`;
       }
       html += "</div>";
@@ -430,7 +432,8 @@ function fmtBytes(b) {
   if (b == null) return "";
   if (b < 1024) return b + " B";
   if (b < 1048576) return (b / 1024).toFixed(1) + " KB";
-  return (b / 1048576).toFixed(1) + " MB";
+  if (b < 1073741824) return (b / 1048576).toFixed(1) + " MB";
+  return (b / 1073741824).toFixed(2) + " GB";
 }
 
 /* new pipeline */

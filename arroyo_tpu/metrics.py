@@ -120,6 +120,11 @@ BYTES_SENT = REGISTRY.counter(
     "arroyo_worker_bytes_sent", "bytes sent by a subtask")
 ERRORS = REGISTRY.counter(
     "arroyo_worker_errors", "deserialization/user errors in a subtask")
+BACKPRESSURE = REGISTRY.gauge(
+    "arroyo_worker_backpressure",
+    "fullness (0..1) of a subtask's most-loaded output queue — the "
+    "reference derives its backpressure gauge from tx queue occupancy "
+    "the same way (job_metrics.rs)")
 QUEUE_SIZE = REGISTRY.gauge(
     "arroyo_worker_queue_size", "occupancy of an edge queue (batches)")
 QUEUE_BYTES = REGISTRY.gauge(

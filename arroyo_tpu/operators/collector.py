@@ -15,7 +15,7 @@ from typing import List, Optional
 
 import pyarrow as pa
 
-from ..metrics import BATCHES_SENT, BYTES_SENT, MESSAGES_SENT
+from ..metrics import BACKPRESSURE, BATCHES_SENT, BYTES_SENT, MESSAGES_SENT
 from ..schema import StreamSchema
 from ..types import SignalMessage
 from .queues import BatchQueue, batch_bytes
@@ -72,6 +72,7 @@ class Collector:
         self._batch_counter = BATCHES_SENT.labels(job=job_id, task=task_id)
         self._msg_counter = MESSAGES_SENT.labels(job=job_id, task=task_id)
         self._bytes_counter = BYTES_SENT.labels(job=job_id, task=task_id)
+        self._bp_gauge = BACKPRESSURE.labels(job=job_id, task=task_id)
         # sink-side hook: engine-level capture of terminal output (preview)
         self.collected: Optional[list] = None
 
@@ -83,6 +84,12 @@ class Collector:
         self._bytes_counter.inc(batch_bytes(batch))
         for edge in self.edges:
             await edge.send_batch(batch)
+        # post-send occupancy of the most-loaded out queue: 1.0 means the
+        # next send blocks (downstream is the bottleneck)
+        self._bp_gauge.set(max(
+            (q.fullness() for e in self.edges for q in e.queues),
+            default=0.0,
+        ))
 
     async def broadcast(self, signal: SignalMessage):
         for edge in self.edges:

@@ -48,6 +48,14 @@ class BatchQueue:
     def qsize(self) -> int:
         return len(self._items)
 
+    def fullness(self) -> float:
+        """0..1 occupancy against whichever bound (count or bytes) is
+        closer to blocking the sender — the backpressure signal. Clamped:
+        signals bypass capacity checks and one oversized batch may exceed
+        the byte bound, so raw occupancy can pass the limit."""
+        return min(1.0, max(len(self._items) / self.max_batches,
+                            self._bytes / self.max_bytes))
+
     def _has_capacity(self) -> bool:
         return len(self._items) < self.max_batches and self._bytes < self.max_bytes
 

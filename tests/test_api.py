@@ -279,6 +279,11 @@ def test_operator_metric_groups_structured(tmp_path):
         assert "messages_sent" in by_metric
         sub = by_metric["messages_sent"]["subtasks"][0]
         assert sub["index"] == 0 and sub["metrics"][0]["value"] > 0
+        # tx-queue backpressure gauge (reference job_metrics.rs): present
+        # per subtask, in [0, 1]
+        assert "backpressure" in by_metric
+        bp = by_metric["backpressure"]["subtasks"][0]["metrics"][0]["value"]
+        assert 0.0 <= bp <= 1.0
         assert "prometheus" in body
 
 

@@ -254,6 +254,13 @@ class ApiDb:
         ).fetchone()
         return self._pipeline(r) if r else None
 
+    def set_pipeline_parallelism(self, pid: str, parallelism: int):
+        self.conn.execute(
+            "UPDATE pipelines SET parallelism = ? WHERE id = ?",
+            (parallelism, pid),
+        )
+        self._commit()
+
     def set_pipeline_state(self, pid: str, state: str):
         # value-guarded: pollers re-write identical state at 5Hz, and a
         # no-op UPDATE would still count as a change for the remote sync

@@ -99,15 +99,36 @@ class ApiServer:
             return error(400, str(e))
         pipeline = self.db.create_pipeline(name, query, parallelism)
         if self.controller is not None:
-            job = self.db.create_job(pipeline["id"])
-            storage = config().pipeline.checkpointing.storage_url
-            await self.controller.submit_job(
-                job["id"], sql=query,
-                storage_url=f"{storage}/{job['id']}" if storage else None,
-                parallelism=parallelism,
+            await self._submit_pipeline_job(
+                pipeline["id"], query, parallelism
             )
-            asyncio.ensure_future(self._track_job(pipeline["id"], job["id"]))
         return json_response(pipeline)
+
+    async def _submit_pipeline_job(self, pid: str, query: str,
+                                   parallelism: int) -> dict:
+        """Create + submit + track one job of a pipeline. Checkpoint
+        storage is keyed by PIPELINE id, so a restart or rescale restores
+        the pipeline's latest durable checkpoint (state, source
+        positions) instead of starting blank — the generation protocol
+        fences any zombie writer from the previous job."""
+        job = self.db.create_job(pid)
+        storage = config().pipeline.checkpointing.storage_url
+        await self.controller.submit_job(
+            job["id"], sql=query,
+            storage_url=f"{storage}/{pid}" if storage else None,
+            parallelism=parallelism,
+        )
+        asyncio.ensure_future(self._track_job(pid, job["id"]))
+        return job
+
+    def _live_jobs(self, pid: str) -> list:
+        if self.controller is None:
+            return []
+        return [
+            j for j in self.db.jobs_for_pipeline(pid)
+            if j["id"] in self.controller.jobs
+            and not self.controller.jobs[j["id"]].state.is_terminal()
+        ]
 
     async def _track_job(self, pid: str, jid: str):
         job = self.controller.jobs.get(jid)
@@ -138,7 +159,10 @@ class ApiServer:
         return json_response({"deleted": pid})
 
     async def patch_pipeline(self, request: web.Request):
-        """stop modes (reference: PATCH /pipelines/{id} with stop field)."""
+        """stop modes and rescale (reference: PATCH /pipelines/{id} with
+        stop / parallelism fields; parallelism change on a running
+        pipeline stops with a checkpoint and resubmits at the new
+        parallelism, like the reference's Rescaling transition)."""
         pid = request.match_info["id"]
         if self.db.get_pipeline(pid) is None:
             return error(404, "pipeline not found")
@@ -148,6 +172,28 @@ class ApiServer:
             return error(400, f"invalid stop mode {stop}")
         if stop and stop != "none":
             await self._stop_pipeline_jobs(pid, stop)
+        if "parallelism" in body:
+            try:
+                par = int(body["parallelism"])
+            except (TypeError, ValueError):
+                return error(400, "parallelism must be an integer")
+            if par < 1 or par > 128:
+                return error(400, "parallelism must be in [1, 128]")
+            p = self.db.get_pipeline(pid)
+            self.db.set_pipeline_parallelism(pid, par)
+            if (stop in (None, "none") and self._live_jobs(pid)
+                    and par != p["parallelism"]):
+                # rescale: checkpoint-stop the running job, then resubmit
+                # at the new parallelism (restores the pipeline's latest
+                # checkpoint — key-range state sharding re-reads)
+                await self._stop_pipeline_jobs(pid, "checkpoint")
+                if self._live_jobs(pid):
+                    # the stop timed out: running a second job against
+                    # the same sources would double-process
+                    return error(
+                        409, "running job did not stop; rescale aborted"
+                    )
+                await self._submit_pipeline_job(pid, p["query"], par)
         return json_response(self.db.get_pipeline(pid))
 
     async def restart_pipeline(self, request: web.Request):
@@ -158,14 +204,11 @@ class ApiServer:
         if self.controller is None:
             return error(400, "no controller attached")
         await self._stop_pipeline_jobs(pid, "checkpoint")
-        job = self.db.create_job(pid)
-        storage = config().pipeline.checkpointing.storage_url
-        await self.controller.submit_job(
-            job["id"], sql=p["query"],
-            storage_url=f"{storage}/{job['id']}" if storage else None,
-            parallelism=p["parallelism"],
+        if self._live_jobs(pid):
+            return error(409, "running job did not stop; restart aborted")
+        job = await self._submit_pipeline_job(
+            pid, p["query"], p["parallelism"]
         )
-        asyncio.ensure_future(self._track_job(pid, job["id"]))
         return json_response(job)
 
     async def _stop_pipeline_jobs(self, pid: str, mode: str):

@@ -287,6 +287,13 @@ async function viewPipelineDetail(id) {
   const gen = setView(
     `<div class="crumbs"><a href="#/pipelines">pipelines</a> / ${esc(id)}</div>
      <section><h2>Definition</h2><div class="kv" id="pmeta"></div>
+       <div class="row" id="pctl">
+         <button id="pstop">stop (checkpoint)</button>
+         <button id="prestart">restart</button>
+         <label>parallelism <input id="ppar" type="number" min="1"
+           max="128" style="width:4em"></label>
+         <button id="prescale">rescale</button>
+       </div>
        <pre id="pquery"></pre></section>
      <section><h2>Dataflow graph</h2>
        <div class="dag-box" id="dag" class="muted">loading…</div></section>
@@ -316,6 +323,26 @@ async function viewPipelineDetail(id) {
     `<span class="k">parallelism</span><span>${esc(p.parallelism || 1)}` +
     `</span>`;
   $("#pquery").textContent = p.query || "";
+  $("#ppar").value = p.parallelism || 1;
+  $("#pstop").onclick = async () => {
+    try {
+      await PATCH(`/pipelines/${id}`, { stop: "checkpoint" });
+      toast("stop requested");
+    } catch (e) { toast(e.message, true); }
+  };
+  $("#prestart").onclick = async () => {
+    try {
+      await POST(`/pipelines/${id}/restart`, {});
+      toast("restarted");
+    } catch (e) { toast(e.message, true); }
+  };
+  $("#prescale").onclick = async () => {
+    try {
+      const par = parseInt($("#ppar").value, 10);
+      await PATCH(`/pipelines/${id}`, { parallelism: par });
+      toast(`rescaled to parallelism ${par} (checkpoint-stop + resubmit)`);
+    } catch (e) { toast(e.message, true); }
+  };
   try {
     const v = await POST("/pipelines/validate_query", {
       query: p.query,

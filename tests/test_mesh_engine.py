@@ -364,3 +364,46 @@ def test_mesh_updating_checkpoint_restore(tmp_path):
     final, _ = merge_debezium(l for l in open(out) if l.strip())
     got = {r["k"]: r["cnt"] for r in final}
     assert got == {k: 800 for k in range(5)}
+
+
+def test_global_session_window_salted_mesh():
+    """A keyless (global) session window in mesh mode takes the SALTED
+    path (planner marks window-only/keyless groupings mesh_salted):
+    imperative slot allocation via SharedMeshSlotDirectory plus
+    cross-shard folds at gather/merge must reproduce the single-device
+    result."""
+    import asyncio
+
+    from arroyo_tpu.config import update
+    from arroyo_tpu.engine import Engine
+    from arroyo_tpu.sql import plan_query
+
+    sql = """
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '1000000',
+      message_count = '3000', start_time = '0'
+    );
+    SELECT session(interval '1 millisecond') AS w, count(*) AS cnt,
+           sum(counter) AS total
+    FROM impulse GROUP BY w;
+    """
+    results = []
+    with update(tpu={"mesh_devices": 4, "mesh_rows_per_shard": 128}):
+        plan = plan_query(sql, preview_results=results)
+        # the session aggregate must actually be marked salted
+        assert any(
+            op.config.get("mesh_salted")
+            for node in plan.graph.nodes.values()
+            for op in node.chain
+            if "aggregates" in op.config
+        )
+
+        async def go():
+            eng = Engine(plan.graph).start()
+            await eng.join(120)
+
+        asyncio.run(go())
+    # 3000 events at 1/us with a 1ms gap: one continuous session
+    assert len(results) == 1
+    assert results[0]["cnt"] == 3000
+    assert results[0]["total"] == sum(range(3000))

@@ -53,37 +53,45 @@ class SSESource(SourceOperator):
             headers["Last-Event-ID"] = self.last_id
         async with aiohttp.ClientSession() as session:
             async with session.get(self.endpoint, headers=headers) as resp:
-                event_type, data_lines, event_id = "message", [], None
-                async for raw in resp.content:
-                    finish = await ctx.check_control(collector)
-                    if finish is not None:
-                        return finish
+                # SSE framing state, mutated by the per-line callback
+                st = {"event": "message", "data": [], "id": None}
+
+                async def on_line(raw: bytes):
                     line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
                     if line.startswith(":"):
-                        continue
+                        return
                     if not line:
-                        if data_lines and (
-                            self.events is None or event_type in self.events
+                        if st["data"] and (
+                            self.events is None
+                            or st["event"] in self.events
                         ):
-                            payload = "\n".join(data_lines).encode()
+                            payload = "\n".join(st["data"]).encode()
                             for row in self.deserializer.deserialize_slice(
                                 payload, error_reporter=ctx.error_reporter
                             ):
                                 ctx.buffer_row(row)
-                            if event_id is not None:
-                                self.last_id = event_id
-                            if ctx.should_flush():
-                                await self.flush_buffer(ctx, collector)
-                        event_type, data_lines, event_id = "message", [], None
-                        continue
+                            if st["id"] is not None:
+                                self.last_id = st["id"]
+                        st["event"], st["data"], st["id"] = (
+                            "message", [], None,
+                        )
+                        return
                     field, _, value = line.partition(":")
                     value = value.lstrip(" ")
                     if field == "event":
-                        event_type = value
+                        st["event"] = value
                     elif field == "data":
-                        data_lines.append(value)
+                        st["data"].append(value)
                     elif field == "id":
-                        event_id = value
+                        st["id"] = value
+
+                # shared select-over-control poll loop: a QUIET stream
+                # must not block checkpoint barriers or stop
+                finish = await self.poll_async_iter(
+                    resp.content.__aiter__(), ctx, collector, on_line
+                )
+                if finish is not None:
+                    return finish
         return SourceFinishType.FINAL
 
 

@@ -33,17 +33,25 @@ class WebSocketSource(SourceOperator):
         async with websockets.connect(self.endpoint) as ws:
             for msg in self.subscription_messages:
                 await ws.send(msg)
-            async for frame in ws:
-                finish = await ctx.check_control(collector)
-                if finish is not None:
-                    return finish
-                payload = frame.encode() if isinstance(frame, str) else frame
+
+            async def on_frame(frame):
+                payload = (
+                    frame.encode() if isinstance(frame, str) else frame
+                )
                 for row in self.deserializer.deserialize_slice(
                     payload, error_reporter=ctx.error_reporter
                 ):
                     ctx.buffer_row(row)
-                if ctx.should_flush():
-                    await self.flush_buffer(ctx, collector)
+
+            # shared select-over-control poll loop: a QUIET stream must
+            # not block checkpoint barriers or stop. Iteration ends
+            # cleanly only on a normal close (the iterator raises on
+            # abnormal closure, surfacing a task failure).
+            finish = await self.poll_async_iter(
+                ws.__aiter__(), ctx, collector, on_frame
+            )
+            if finish is not None:
+                return finish
         return SourceFinishType.FINAL
 
 

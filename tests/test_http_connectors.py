@@ -1,0 +1,231 @@
+"""The HTTP-family connectors driven END TO END against local loopback
+servers — real sockets, the real operator code, through the real engine
+(sse / websocket / polling_http sources, webhook sink). The reference
+covers these connectors with unit + integ tests
+(/root/reference/crates/arroyo-connectors/src/{sse,websocket,
+polling_http,webhook}); here a local aiohttp/websockets server stands in
+for the external service so the tests run hermetically."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from arroyo_tpu.engine import Engine
+from arroyo_tpu.sql import plan_query
+
+
+async def _start_site(app):
+    # shutdown_timeout=0.1: handlers deliberately hold streams open (like
+    # real SSE/long-poll endpoints); cleanup must not wait a minute
+    runner = web.AppRunner(app, shutdown_timeout=0.1)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port
+
+
+def test_sse_source_resumes_from_last_event_id(tmp_path):
+    """SSE source: streams data events, checkpoint-stops mid-stream,
+    and on restart replays from the checkpointed Last-Event-ID header —
+    every event exactly once across the two runs."""
+    url = str(tmp_path / "ck")
+    out = tmp_path / "out.json"
+    requests = []
+
+    async def sse_handler(request):
+        last = int(request.headers.get("Last-Event-ID", -1))
+        requests.append(last)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+        for i in range(last + 1, 200):
+            await resp.write(
+                f"id: {i}\ndata: {json.dumps({'n': i})}\n\n".encode()
+            )
+            await asyncio.sleep(0.01)
+        # keep the stream open like a real SSE endpoint: the engine
+        # stops the source via control, not via EOF
+        await asyncio.sleep(60)
+        return resp
+
+    async def phase():
+        app = web.Application()
+        app.router.add_get("/events", sse_handler)
+        runner, port = await _start_site(app)
+        try:
+            sql = f"""
+            CREATE TABLE src (n BIGINT) WITH (
+              connector = 'sse',
+              endpoint = 'http://127.0.0.1:{port}/events',
+              type = 'source', format = 'json'
+            );
+            CREATE TABLE dst (n BIGINT) WITH (
+              connector = 'single_file', path = '{out}',
+              format = 'json', type = 'sink'
+            );
+            INSERT INTO dst SELECT n FROM src;
+            """
+            plan = plan_query(sql, parallelism=1)
+            eng = Engine(plan.graph, job_id="sse1", storage_url=url).start()
+            await asyncio.sleep(0.35)
+            await eng.checkpoint_and_wait(then_stop=True)
+            await eng.join(60)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(phase())
+    first = [json.loads(l)["n"] for l in open(out) if l.strip()]
+    assert first and first == list(range(len(first))), first
+    assert len(first) < 200, "stream finished before the stop: too fast"
+
+    asyncio.run(phase())
+    rows = [json.loads(l)["n"] for l in open(out) if l.strip()]
+    assert sorted(rows) == list(range(max(rows) + 1)), (
+        "resume lost or duplicated events"
+    )
+    assert len(rows) == len(set(rows))
+    # the second connection presented the checkpointed Last-Event-ID
+    assert len(requests) >= 2 and requests[1] == first[-1]
+
+
+def test_websocket_source_streams(tmp_path):
+    """WebSocket source: subscription message then streamed json frames
+    through the engine to a sink."""
+    import websockets
+
+    out = tmp_path / "out.json"
+    got_subs = []
+
+    async def handler(ws):
+        sub = await ws.recv()
+        got_subs.append(sub)
+        for i in range(25):
+            await ws.send(json.dumps({"n": i}))
+        # hold open until the client disconnects (engine stops via
+        # control); serve() waits for handlers at shutdown, so an
+        # unconditional sleep would stall the test teardown
+        await ws.wait_closed()
+
+    async def go():
+        async with websockets.serve(handler, "127.0.0.1", 0,
+                                    close_timeout=0.1) as server:
+            port = server.sockets[0].getsockname()[1]
+            sql = f"""
+            CREATE TABLE src (n BIGINT) WITH (
+              connector = 'websocket',
+              endpoint = 'ws://127.0.0.1:{port}',
+              subscription_message = '{{"subscribe": "all"}}',
+              type = 'source', format = 'json'
+            );
+            CREATE TABLE dst (n BIGINT) WITH (
+              connector = 'single_file', path = '{out}',
+              format = 'json', type = 'sink'
+            );
+            INSERT INTO dst SELECT n * 2 AS n FROM src;
+            """
+            plan = plan_query(sql, parallelism=1)
+            eng = Engine(plan.graph).start()
+            await asyncio.sleep(0.6)
+            from arroyo_tpu.types import StopMode
+
+            await eng.stop(StopMode.GRACEFUL)
+            await eng.join(60)
+
+    asyncio.run(go())
+    rows = sorted(json.loads(l)["n"] for l in open(out) if l.strip())
+    assert rows == [i * 2 for i in range(25)]
+    assert got_subs == ['{"subscribe": "all"}']
+
+
+def test_polling_http_emit_on_change(tmp_path):
+    """polling_http source: polls on an interval and, with
+    emit_behavior=changed, emits only when the payload changes."""
+    out = tmp_path / "out.json"
+    polls = []
+
+    async def poll_handler(request):
+        polls.append(1)
+        # payload advances every 3 polls: several polls see an
+        # unchanged body and must not re-emit
+        v = (len(polls) - 1) // 3
+        return web.json_response({"v": v})
+
+    async def go():
+        app = web.Application()
+        app.router.add_get("/data", poll_handler)
+        runner, port = await _start_site(app)
+        try:
+            sql = f"""
+            CREATE TABLE src (v BIGINT) WITH (
+              connector = 'polling_http',
+              endpoint = 'http://127.0.0.1:{port}/data',
+              poll_interval = '0.03',
+              emit_behavior = 'changed',
+              type = 'source', format = 'json'
+            );
+            CREATE TABLE dst (v BIGINT) WITH (
+              connector = 'single_file', path = '{out}',
+              format = 'json', type = 'sink'
+            );
+            INSERT INTO dst SELECT v FROM src;
+            """
+            plan = plan_query(sql, parallelism=1)
+            eng = Engine(plan.graph).start()
+            await asyncio.sleep(0.7)
+            from arroyo_tpu.types import StopMode
+
+            await eng.stop(StopMode.GRACEFUL)
+            await eng.join(60)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(go())
+    rows = [json.loads(l)["v"] for l in open(out) if l.strip()]
+    assert len(polls) > len(rows), "emit-on-change did not dedupe polls"
+    assert rows == sorted(set(rows)), f"duplicate emissions: {rows}"
+    assert rows[0] == 0 and len(rows) >= 2
+
+
+def test_webhook_sink_retries_then_delivers(tmp_path):
+    """Webhook sink: POST per record; transient 500s are retried with
+    backoff and every record is delivered."""
+    received = []
+    fail_first = {"n": 2}
+
+    async def hook(request):
+        if fail_first["n"] > 0:
+            fail_first["n"] -= 1
+            return web.Response(status=500)
+        received.append(await request.json())
+        return web.Response(status=200)
+
+    async def go():
+        app = web.Application()
+        app.router.add_post("/hook", hook)
+        runner, port = await _start_site(app)
+        try:
+            sql = f"""
+            CREATE TABLE impulse WITH (
+              connector = 'impulse', event_rate = '100000',
+              message_count = '10', start_time = '0'
+            );
+            CREATE TABLE dst (counter BIGINT UNSIGNED) WITH (
+              connector = 'webhook',
+              endpoint = 'http://127.0.0.1:{port}/hook',
+              type = 'sink', format = 'json'
+            );
+            INSERT INTO dst SELECT counter FROM impulse;
+            """
+            plan = plan_query(sql, parallelism=1)
+            eng = Engine(plan.graph).start()
+            await eng.join(60)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(go())
+    assert sorted(r["counter"] for r in received) == list(range(10))
+    assert fail_first["n"] == 0, "retry path never exercised"

@@ -43,6 +43,11 @@ class UpdatingAggregateOperator(WindowOperatorBase):
     # in-step all_to_all (reference incremental_aggregator.rs:77-90 treats
     # the updating aggregate like any keyed operator)
     _mesh_ok = True
+    # the C++ directory now serves every API this operator needs
+    # (assign, slot-valued peek_bin, keys_for_slots via the native
+    # reverse index, items): ~3x cheaper per-batch assignment than the
+    # python np.unique path for int64-able keys
+    _native_ok = True
 
     def __init__(self, config: dict):
         super().__init__(config, "updating_aggregate")
@@ -182,8 +187,8 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         live eviction does."""
         import msgpack
 
-        bin_map = self.dir.peek_bin(0) or {}
-        keys = [k for k in self._ckpt_dirty if k in bin_map]
+        slot_map = self._dirty_slot_map(self._ckpt_dirty)
+        keys = list(slot_map)
         dead = list(self._ckpt_dead)
         self._ckpt_dirty = set()
         self._ckpt_dead = set()
@@ -191,7 +196,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
             return None
         n_phys = len(self.acc.phys)
         if keys:
-            slots = np.asarray([bin_map[k] for k in keys], dtype=np.int64)
+            slots = np.asarray([slot_map[k] for k in keys], dtype=np.int64)
             values = self.acc.snapshot(slots)
         else:
             values = [np.empty(0, dtype=s.dtype) for s in self.acc.state]
@@ -346,6 +351,17 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                 if signs is not None:
                     self.live[key] = self.live.get(key, 0) + int(per_uniq[i])
 
+    def _dirty_slot_map(self, key_set) -> dict:
+        """slot per live key for the (usually small) dirty set — point
+        lookups when the directory supports them (python dict / native
+        C++ probe, O(dirty)); mesh directories fall back to a peek_bin
+        scan, acceptable at dryrun scale."""
+        lookup = getattr(self.dir, "slots_for_keys", None)
+        if lookup is not None:
+            return lookup(0, list(key_set))
+        bin_map = self.dir.peek_bin(0) or {}
+        return {k: bin_map[k] for k in key_set if k in bin_map}
+
     async def handle_tick(self, tick, ctx, collector):
         await self._flush(ctx, collector)
         self._evict(ctx)
@@ -367,8 +383,8 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         (reference handle_tick :994 + set_retract_metadata :1026)."""
         if not self.dirty:
             return
-        bin_map = self.dir.peek_bin(0) or {}
-        keys = [k for k in self.dirty if k in bin_map]
+        slot_map = self._dirty_slot_map(self.dirty)
+        keys = list(slot_map)
         self.dirty.clear()
         if not keys:
             return
@@ -395,7 +411,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                 if len(freed):
                     self.acc.reset_slots(freed)
         if keys:
-            slots = np.asarray([bin_map[k] for k in keys], dtype=np.int64)
+            slots = np.asarray([slot_map[k] for k in keys], dtype=np.int64)
             agg_cols = self.acc.finalize(self.acc.gather(slots))
             for i, key in enumerate(keys):
                 new_vals = [_to_py(c[i]) for c in agg_cols]

@@ -116,8 +116,14 @@ class WindowOperatorBase(Operator):
         self._flat_offsets: Optional[List[int]] = None
 
     # operators that only use assign/take_bin/bin_entries/items can swap in
-    # the C++ directory for single-integer keys (tumbling, sliding)
+    # the C++ directory for single-integer keys (tumbling, sliding, and —
+    # with the slot-valued peek_bin / keys_for_slots / remove surface —
+    # updating aggregates)
     _native_ok = False
+    # the DEVICE directory serves a narrower API (no remove /
+    # keys_for_slots; peek_bin without slot values), so its swap is
+    # gated separately
+    _device_ok = False
     # operators whose state protocol is slot-based end to end can run on
     # the mesh-sharded accumulator (tumbling, sliding; session bookkeeping
     # allocates slots imperatively and stays host-side)
@@ -175,7 +181,8 @@ class WindowOperatorBase(Operator):
                 from ..ops._jax import device_tier_active
 
                 cfg = config_fn().tpu
-                use_device = device_tier_active() and cfg.device_directory
+                use_device = (self._device_ok and device_tier_active()
+                              and cfg.device_directory)
                 widths = (
                     key_word_widths(self._key_types) if use_device
                     else flat_key_widths(self._key_types)
@@ -772,6 +779,7 @@ def _ceil_div(a: int, b: int) -> int:
 
 class TumblingWindowOperator(WindowOperatorBase):
     _native_ok = True
+    _device_ok = True
     _mesh_ok = True
 
     """Fixed-width windows: bin = ts // width; emit at watermark >= end
@@ -894,6 +902,7 @@ class SlidingWindowOperator(WindowOperatorBase):
     Requires width % slide == 0."""
 
     _native_ok = True
+    _device_ok = True
     _mesh_ok = True
 
     def __init__(self, config: dict):

@@ -72,6 +72,14 @@ class SlotDirectory:
     def peek_bin(self, b: int) -> Optional[Dict[tuple, int]]:
         return self.by_bin.get(b)
 
+    def slots_for_keys(self, b: int, keys) -> Dict[tuple, int]:
+        """{key: slot} for the subset of `keys` live in bin b (point
+        lookups, O(len(keys)))."""
+        bin_map = self.by_bin.get(b)
+        if not bin_map:
+            return {}
+        return {k: bin_map[k] for k in keys if k in bin_map}
+
     def bin_entries(self, b: int):
         """(keys, slots) of a live bin without removal; keys as a list of
         tuples (the native directory returns int64 arrays instead)."""

@@ -145,12 +145,69 @@ class NativeSlotDirectory:
         return {b: True for b in self._d.live_bins()}
 
     def peek_bin(self, b: int):
-        keys, _ = self.bin_entries(b)
+        keys, slots = self.bin_entries(b)
         if not len(keys):
             return None
         if self.n_keys == 0:
-            return {(): None}
-        return {tuple(int(x) for x in row): None for row in keys}
+            return {(): int(slots[0])}
+        return {
+            tuple(int(x) for x in row): int(s)
+            for row, s in zip(keys, slots)
+        }
+
+    def slots_for_keys(self, b: int, keys) -> dict:
+        """{key: slot} for the subset of `keys` live in bin b — point
+        lookups (O(len(keys))), not a whole-bin materialization."""
+        if not keys:
+            return {}
+        mat = self._keys_to_matrix(keys)
+        present, slots_raw = self._d.lookup(
+            int(b), np.ascontiguousarray(mat.reshape(-1))
+        )
+        slots = np.frombuffer(slots_raw, dtype=np.int64)
+        return {
+            key: int(slots[i])
+            for i, key in enumerate(keys) if present[i]
+        }
+
+    def _keys_to_matrix(self, keys) -> np.ndarray:
+        mat = np.empty((len(keys), self._stride), dtype=np.int64)
+        for i, key in enumerate(keys):
+            if self.n_keys == 0:
+                mat[i, 0] = 0
+            else:
+                for j in range(self._stride):
+                    mat[i, j] = key[j]
+        return mat
+
+    def remove(self, b: int, keys) -> np.ndarray:
+        """Remove specific keys from a bin (TTL eviction / retracted
+        keys); returns the freed slots."""
+        if not keys:
+            return np.empty(0, dtype=np.int64)
+        mat = self._keys_to_matrix(keys)
+        freed = self._d.remove(int(b), np.ascontiguousarray(mat.reshape(-1)))
+        return np.frombuffer(freed, dtype=np.int64).copy()
+
+    def keys_for_slots(self, slots: np.ndarray):
+        """Resolve slots back to their live (bin, key) via the native
+        reverse index — O(len(slots)), like the python directory's
+        key_of map (updating-aggregate dirty tracking)."""
+        arr = np.ascontiguousarray(np.asarray(slots, dtype=np.int64))
+        present, bins_raw, keys_raw = self._d.keys_for_slots(arr)
+        bins = np.frombuffer(bins_raw, dtype=np.int64)
+        keys = self._keys_matrix(keys_raw)
+        out = []
+        for i, ok in enumerate(present):
+            if not ok:
+                out.append(None)
+            elif self.n_keys == 0:
+                out.append((int(bins[i]), ()))
+            else:
+                out.append(
+                    (int(bins[i]), tuple(int(x) for x in keys[i]))
+                )
+        return out
 
     def live_bins(self) -> List[int]:
         return sorted(self._d.live_bins())

@@ -59,6 +59,10 @@ struct SlotDir {
     std::vector<int64_t>* keypool;       // stride words per entry index
     std::vector<int32_t>* free_entries;  // recycled entry indices
     std::vector<int64_t>* free_slots;
+    // slot id -> entry idx + 1 (0 = slot free): slots are dense
+    // (counter + free list), so a flat vector serves the reverse
+    // lookups the updating aggregate's dirty tracking needs
+    std::vector<int32_t>* slot_owner;
     std::vector<BinHead>* bin_index;  // open addressing over bins
     int64_t next_slot;
     int64_t n_live;
@@ -143,6 +147,7 @@ static PyObject* SlotDir_new(PyTypeObject* type, PyObject* args, PyObject*) {
     self->keypool = new std::vector<int64_t>();
     self->free_entries = new std::vector<int32_t>();
     self->free_slots = new std::vector<int64_t>();
+    self->slot_owner = new std::vector<int32_t>();
     self->bin_index = new std::vector<BinHead>(1024);
     self->next_slot = 0;
     self->n_live = 0;
@@ -160,6 +165,7 @@ static void SlotDir_dealloc(SlotDir* self) {
     delete self->keypool;
     delete self->free_entries;
     delete self->free_slots;
+    delete self->slot_owner;
     delete self->bin_index;
     Py_TYPE(self)->tp_free((PyObject*)self);
 }
@@ -264,6 +270,9 @@ static PyObject* SlotDir_assign(SlotDir* self, PyObject* args) {
         bh->head = idx;
         bh->count += 1;
         (*self->index)[h] = idx + 1;
+        if ((size_t)slot >= self->slot_owner->size())
+            self->slot_owner->resize((size_t)slot + 1, 0);
+        (*self->slot_owner)[(size_t)slot] = idx + 1;
         self->n_live += 1;
         self->n_used += 1;
         slots[i] = slot;
@@ -301,6 +310,7 @@ static PyObject* SlotDir_take_bin(SlotDir* self, PyObject* args) {
             e.live = 0;
             self->free_entries->push_back(idx);
             self->free_slots->push_back(e.slot);
+            (*self->slot_owner)[(size_t)e.slot] = 0;
             idx = e.next_in_bin;
         }
         self->n_live -= bh->count;
@@ -339,6 +349,155 @@ static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
         }
     }
     return Py_BuildValue("(NN)", keys, slots);
+}
+
+// keys_for_slots(slots_bytes) -> (present_bytes u8, bins_bytes, keys_bytes):
+// resolve slots back to their live (bin, key) via the reverse index —
+// O(len(slots)), the updating aggregate's per-batch dirty tracking.
+static PyObject* SlotDir_keys_for_slots(SlotDir* self, PyObject* args) {
+    PyObject* slots_obj;
+    if (!PyArg_ParseTuple(args, "O", &slots_obj)) return nullptr;
+    Py_buffer slots;
+    if (get_i64_buffer(slots_obj, &slots) != 0) return nullptr;
+    Py_ssize_t n = slots.len / 8;
+    const int stride = self->stride;
+    PyObject* present = PyBytes_FromStringAndSize(nullptr, n);
+    PyObject* bins = PyBytes_FromStringAndSize(nullptr, n * 8);
+    PyObject* keys = PyBytes_FromStringAndSize(
+        nullptr, (Py_ssize_t)n * 8 * stride);
+    if (!present || !bins || !keys) {
+        PyBuffer_Release(&slots);
+        Py_XDECREF(present);
+        Py_XDECREF(bins);
+        Py_XDECREF(keys);
+        return nullptr;
+    }
+    uint8_t* pout = (uint8_t*)PyBytes_AS_STRING(present);
+    int64_t* bout = (int64_t*)PyBytes_AS_STRING(bins);
+    int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
+    const int64_t* s = (const int64_t*)slots.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t ref = 0;
+        if (s[i] >= 0 && (size_t)s[i] < self->slot_owner->size())
+            ref = (*self->slot_owner)[(size_t)s[i]];
+        if (ref == 0) {
+            pout[i] = 0;
+            bout[i] = 0;
+            memset(kout + (size_t)i * stride, 0,
+                   stride * sizeof(int64_t));
+            continue;
+        }
+        const Entry& e = (*self->entries)[ref - 1];
+        pout[i] = 1;
+        bout[i] = e.bin;
+        memcpy(kout + (size_t)i * stride, entry_keys(self, ref - 1),
+               stride * sizeof(int64_t));
+    }
+    PyBuffer_Release(&slots);
+    return Py_BuildValue("(NNN)", present, bins, keys);
+}
+
+// lookup(bin, keys) -> (present u8 bytes, slots bytes): point lookups
+// for a small key set (the updating aggregate's dirty keys) without
+// materializing the whole bin.
+static PyObject* SlotDir_lookup(SlotDir* self, PyObject* args) {
+    int64_t bin;
+    PyObject* keys_obj;
+    if (!PyArg_ParseTuple(args, "LO", &bin, &keys_obj)) return nullptr;
+    Py_buffer keys;
+    if (get_i64_buffer(keys_obj, &keys) != 0) return nullptr;
+    const int stride = self->stride;
+    Py_ssize_t n = keys.len / 8 / stride;
+    const int64_t* k = (const int64_t*)keys.buf;
+    PyObject* present = PyBytes_FromStringAndSize(nullptr, n);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, n * 8);
+    if (!present || !slots) {
+        PyBuffer_Release(&keys);
+        Py_XDECREF(present);
+        Py_XDECREF(slots);
+        return nullptr;
+    }
+    uint8_t* pout = (uint8_t*)PyBytes_AS_STRING(present);
+    int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        const int64_t* krow = k + i * stride;
+        pout[i] = 0;
+        sout[i] = -1;
+        size_t h = hash_row(bin, krow, stride) & self->mask;
+        for (;;) {
+            int32_t ref = (*self->index)[h];
+            if (ref == 0) break;
+            const Entry& e = (*self->entries)[ref - 1];
+            if (e.live && e.bin == bin &&
+                memcmp(entry_keys(self, ref - 1), krow,
+                       stride * sizeof(int64_t)) == 0) {
+                pout[i] = 1;
+                sout[i] = e.slot;
+                break;
+            }
+            h = (h + 1) & self->mask;
+        }
+    }
+    PyBuffer_Release(&keys);
+    return Py_BuildValue("(NN)", present, slots);
+}
+
+// remove(bin, keys) -> freed slots bytes: remove specific keys from one
+// bin (TTL eviction, retract-deleted keys). Marks entries dead via the
+// index probe, then unlinks every dead entry in ONE chain sweep.
+static PyObject* SlotDir_remove(SlotDir* self, PyObject* args) {
+    int64_t bin;
+    PyObject* keys_obj;
+    if (!PyArg_ParseTuple(args, "LO", &bin, &keys_obj)) return nullptr;
+    Py_buffer keys;
+    if (get_i64_buffer(keys_obj, &keys) != 0) return nullptr;
+    const int stride = self->stride;
+    Py_ssize_t n = keys.len / 8 / stride;
+    const int64_t* k = (const int64_t*)keys.buf;
+    BinHead* bh = bin_lookup(self, bin, false);
+    std::vector<int64_t> freed;
+    if (bh) {
+        for (Py_ssize_t i = 0; i < n; i++) {
+            const int64_t* krow = k + i * stride;
+            size_t h = hash_row(bin, krow, stride) & self->mask;
+            for (;;) {
+                int32_t ref = (*self->index)[h];
+                if (ref == 0) break;
+                Entry& e = (*self->entries)[ref - 1];
+                if (e.live && e.bin == bin &&
+                    memcmp(entry_keys(self, ref - 1), krow,
+                           stride * sizeof(int64_t)) == 0) {
+                    e.live = 0;  // unlinked in the sweep below
+                    freed.push_back(e.slot);
+                    break;
+                }
+                h = (h + 1) & self->mask;
+            }
+        }
+        if (!freed.empty()) {
+            int32_t idx = bh->head;
+            int32_t* link = &bh->head;
+            while (idx >= 0) {
+                Entry& e = (*self->entries)[idx];
+                int32_t nxt = e.next_in_bin;
+                if (!e.live) {
+                    *link = nxt;
+                    self->free_entries->push_back(idx);
+                    self->free_slots->push_back(e.slot);
+                    (*self->slot_owner)[(size_t)e.slot] = 0;
+                } else {
+                    link = &e.next_in_bin;
+                }
+                idx = nxt;
+            }
+            bh->count -= (int32_t)freed.size();
+            self->n_live -= (int64_t)freed.size();
+        }
+    }
+    PyBuffer_Release(&keys);
+    PyObject* out = PyBytes_FromStringAndSize(
+        (const char*)freed.data(), (Py_ssize_t)freed.size() * 8);
+    return out;
 }
 
 // entries() -> (bins_bytes, keys_bytes, slots_bytes) over all live entries
@@ -393,6 +552,12 @@ static PyMethodDef SlotDir_methods[] = {
      "take_bin(bin) -> (keys bytes, slots bytes)"},
     {"get_bin", (PyCFunction)SlotDir_get_bin, METH_VARARGS,
      "get_bin(bin) -> (keys bytes, slots bytes) without removing"},
+    {"lookup", (PyCFunction)SlotDir_lookup, METH_VARARGS,
+     "lookup(bin, keys_i64) -> (present u8, slots) bytes"},
+    {"remove", (PyCFunction)SlotDir_remove, METH_VARARGS,
+     "remove(bin, keys_i64) -> freed slots bytes"},
+    {"keys_for_slots", (PyCFunction)SlotDir_keys_for_slots, METH_VARARGS,
+     "keys_for_slots(slots_i64) -> (present u8, bins, keys) bytes"},
     {"entries", (PyCFunction)SlotDir_entries, METH_NOARGS,
      "entries() -> (bins bytes, keys bytes, slots bytes)"},
     {"live_bins", (PyCFunction)SlotDir_live_bins, METH_NOARGS, ""},

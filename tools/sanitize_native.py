@@ -53,6 +53,34 @@ for n_keys in (1, 3):
         assert len(pairs) == len({a for a, _ in pairs}) == len(
             {b for _, b in pairs}
         ), f"grouping diverged at step {step}"
+        if step % 13 == 7:
+            # reverse index resolves to the EXACT (bin, key) of the
+            # input rows (a stale slot_owner after entry recycling is
+            # the bug class this structure can have)
+            kk = nat.keys_for_slots(s_nat[:50])
+            for i, entry in enumerate(kk):
+                assert entry is not None, f"live slot unresolved at {step}"
+                got_bin, got_key = entry
+                assert got_bin == int(bins[i]), f"wrong bin at {step}"
+                assert got_key == tuple(
+                    int(c[i]) for c in keys
+                ), f"wrong key at {step}"
+            # targeted removal; freed slots must then resolve to None
+            b = int(rng.integers(0, 6))
+            pk = ref.peek_bin(b) or {}
+            victims = list(pk.keys())[:20]
+            nat_map = nat.slots_for_keys(b, victims)
+            assert set(nat_map) == set(victims), f"lookup at {step}"
+            f_nat = nat.remove(b, victims)
+            f_ref = ref.remove(b, victims)
+            assert len(f_nat) == len(f_ref), f"remove at {step}"
+            assert sorted(int(s) for s in f_nat) == sorted(
+                nat_map.values()
+            ), f"freed slots disagree with lookup at {step}"
+            gone = nat.keys_for_slots(np.asarray(f_nat))
+            assert all(g is None for g in gone), (
+                f"freed slot still resolves at {step}"
+            )
         if step % 7 == 3:
             b = int(rng.integers(0, 6))
             ka, sa = nat.take_bin(b)

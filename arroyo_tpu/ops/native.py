@@ -171,14 +171,11 @@ class NativeSlotDirectory:
         }
 
     def _keys_to_matrix(self, keys) -> np.ndarray:
-        mat = np.empty((len(keys), self._stride), dtype=np.int64)
-        for i, key in enumerate(keys):
-            if self.n_keys == 0:
-                mat[i, 0] = 0
-            else:
-                for j in range(self._stride):
-                    mat[i, j] = key[j]
-        return mat
+        if self.n_keys == 0:
+            return np.zeros((len(keys), 1), dtype=np.int64)
+        return np.asarray(keys, dtype=np.int64).reshape(
+            len(keys), self._stride
+        )
 
     def remove(self, b: int, keys) -> np.ndarray:
         """Remove specific keys from a bin (TTL eviction / retracted

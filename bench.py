@@ -90,7 +90,17 @@ SELECT P.id, P.name FROM (
 ) AS A ON P.id = A.seller AND P.w = A.w;
 """
 
-QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8}
+# updating (non-windowed) aggregate with retraction emission: the
+# engine's debezium-style path, measured per round since round 4
+QU = DDL + """
+CREATE TABLE sink (a BIGINT, c BIGINT, s BIGINT)
+WITH (connector = 'blackhole', type = 'sink');
+INSERT INTO sink
+SELECT bid.auction % 1000 AS a, count(*) AS c, sum(bid.price) AS s
+FROM nexmark WHERE bid IS NOT NULL GROUP BY 1;
+"""
+
+QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8, "qu": QU}
 
 
 def force_backend(plan, backend: str) -> None:
@@ -420,7 +430,7 @@ def main():
             if g_commit:
                 grant_extra["device_git_commit"] = g_commit
             g_events = grant.get("events", {}).get("q5")
-            for q in ("q1", "q7", "q8"):
+            for q in ("q1", "q7", "q8", "qu"):
                 if f"{q}_eps" in grant:
                     grant_extra[f"{q}_eps_tpu"] = grant[f"{q}_eps"]
             if g_events:
@@ -445,7 +455,7 @@ def main():
     side_env = None if live_device else cpu_env
     side_backend = "jax" if live_device else "numpy"
     sides = {}
-    for q in ("q1", "q7", "q8"):
+    for q in ("q1", "q7", "q8", "qu"):
         # half the events: side metrics, not the headline measurement
         r = run_child(args.events // 2, side_backend, args.timeout,
                       env=side_env, query=q,

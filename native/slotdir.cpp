@@ -407,6 +407,12 @@ static PyObject* SlotDir_lookup(SlotDir* self, PyObject* args) {
     Py_buffer keys;
     if (get_i64_buffer(keys_obj, &keys) != 0) return nullptr;
     const int stride = self->stride;
+    if ((keys.len / 8) % stride != 0) {
+        PyBuffer_Release(&keys);
+        PyErr_SetString(PyExc_ValueError,
+                        "keys length != n_rows * stride");
+        return nullptr;
+    }
     Py_ssize_t n = keys.len / 8 / stride;
     const int64_t* k = (const int64_t*)keys.buf;
     PyObject* present = PyBytes_FromStringAndSize(nullptr, n);
@@ -452,6 +458,12 @@ static PyObject* SlotDir_remove(SlotDir* self, PyObject* args) {
     Py_buffer keys;
     if (get_i64_buffer(keys_obj, &keys) != 0) return nullptr;
     const int stride = self->stride;
+    if ((keys.len / 8) % stride != 0) {
+        PyBuffer_Release(&keys);
+        PyErr_SetString(PyExc_ValueError,
+                        "keys length != n_rows * stride");
+        return nullptr;
+    }
     Py_ssize_t n = keys.len / 8 / stride;
     const int64_t* k = (const int64_t*)keys.buf;
     BinHead* bh = bin_lookup(self, bin, false);

@@ -68,7 +68,7 @@ CPU_BASELINE_TIMEOUT = 600.0
 # (query, events) — q5 is the headline; sizes keep post-compile runtime
 # in seconds while being large enough for a credible rate.
 BENCH_PLAN = [("q5", 500_000), ("q1", 200_000), ("q7", 200_000),
-              ("q8", 200_000)]
+              ("q8", 200_000), ("qu", 200_000)]
 
 # Golden queries to re-verify on the device backend while holding the
 # grant. Small on purpose: each distinct XLA program compiles through
@@ -283,8 +283,8 @@ def publish_capture(results: dict, goldens: dict, commit: str) -> None:
         "events": g_events,
         "result_rows": payload["q5_rows"],
         "side_backend": "jax",
-        **{f"{q}_eps": payload[f"{q}_eps"] for q in ("q1", "q7", "q8")
-           if f"{q}_eps" in payload},
+        **{f"{q}_eps": payload[f"{q}_eps"]
+           for q in ("q1", "q7", "q8", "qu") if f"{q}_eps" in payload},
         "device_source": f"probe_daemon_capture@{payload['captured_at']}",
         "git_commit": commit,
         "goldens": goldens,
@@ -309,7 +309,7 @@ def publish_capture(results: dict, goldens: dict, commit: str) -> None:
         f"|---|---|---|",
     ]
     ev = dict(BENCH_PLAN)
-    for q in ("q5", "q1", "q7", "q8"):
+    for q in ("q5", "q1", "q7", "q8", "qu"):
         if f"{q}_eps" in payload:
             lines.append(f"| {q} | {payload[f'{q}_eps']:,} | {ev[q]:,} |")
     if baseline:

@@ -1,15 +1,18 @@
 #!/usr/bin/env python
-"""Benchmark: Nexmark q1/q5/q7/q8 events/sec through the full engine.
+"""Benchmark: Nexmark q1/q5/q7/q8 (+ the qu updating aggregate)
+events/sec through the full engine.
 
 The headline metric is q5 (hop-window COUNT per auction joined with the
 per-window MAX — the reference's CI-covered nexmark_q5.sql shape), run
 twice:
   * CPU baseline: window aggregation on the numpy host backend
   * device path:  window aggregation on the JAX backend (TPU when present)
-q1 (stateless currency projection), q7 (per-window highest bid join) and
-q8 (person x auction same-window join) run once on the device path and
-ride along as extra fields in the SAME single json line
-{"metric", "value", "unit", "vs_baseline", "q1_eps", "q7_eps", "q8_eps"}.
+q1 (stateless currency projection), q7 (per-window highest bid join),
+q8 (person x auction same-window join) and qu (non-windowed GROUP BY,
+the retraction-emitting updating path) run once as side metrics in the
+SAME single json line, along with the mesh-path measurement
+(q5_mesh{N}_eps + padding stats) and single-process + distributed
+realtime latency percentiles.
 
 Each measurement runs in a subprocess so a wedged accelerator tunnel can
 never hang the bench. On device-path failure: if the round's probe

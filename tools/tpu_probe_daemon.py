@@ -158,7 +158,7 @@ def run_device_goldens() -> None:
     # golden fixtures are small (hundreds of rows): drop the row floor so
     # the windowed-join golden actually exercises the device join probe
     config().tpu.device_join_min_rows = 0
-    for name in GOLDEN_PLAN:
+    def run_one(name: str, label: str):
         qpath = os.path.join(tg.GOLDEN, "queries", f"{name}.sql")
         gpath = os.path.join(tg.GOLDEN, "golden_outputs", f"{name}.json")
         try:
@@ -176,12 +176,24 @@ def run_device_goldens() -> None:
                 got = tg.canonicalize_output(out, sql)
                 want = [ln.strip() for ln in open(gpath)]
                 if got == want:
-                    print(f"GOLDEN {name} PASS rows={len(got)}", flush=True)
+                    print(f"GOLDEN {label} PASS rows={len(got)}",
+                          flush=True)
                 else:
-                    print(f"GOLDEN {name} FAIL got={len(got)} "
+                    print(f"GOLDEN {label} FAIL got={len(got)} "
                           f"want={len(want)}", flush=True)
         except BaseException as e:
-            print(f"GOLDEN {name} FAIL {type(e).__name__}: {e}", flush=True)
+            print(f"GOLDEN {label} FAIL {type(e).__name__}: {e}",
+                  flush=True)
+
+    for name in GOLDEN_PLAN:
+        run_one(name, name)
+    # one more pass attesting the device-resident slot directory
+    # (tpu.device_directory prototype) on the real chip
+    config().tpu.device_directory = True
+    try:
+        run_one("nexmark_q5", "nexmark_q5_device_dir")
+    finally:
+        config().tpu.device_directory = False
 
 
 def probe_child() -> None:

@@ -188,12 +188,29 @@ def run_device_goldens() -> None:
     for name in GOLDEN_PLAN:
         run_one(name, name)
     # one more pass attesting the device-resident slot directory
-    # (tpu.device_directory prototype) on the real chip
+    # (tpu.device_directory prototype) on the real chip. The verdict is
+    # only meaningful if the directory actually engaged — the swap has
+    # its own gates (_device_ok, accelerator, key widths), so count
+    # instantiations and fail the attestation when none happened.
+    import arroyo_tpu.ops.device_directory as dd
+
+    engaged = {"n": 0}
+    orig_init = dd.DeviceSlotDirectory.__init__
+
+    def _spy(self, *a, **k):
+        engaged["n"] += 1
+        return orig_init(self, *a, **k)
+
     config().tpu.device_directory = True
+    dd.DeviceSlotDirectory.__init__ = _spy
     try:
         run_one("nexmark_q5", "nexmark_q5_device_dir")
     finally:
+        dd.DeviceSlotDirectory.__init__ = orig_init
         config().tpu.device_directory = False
+    if engaged["n"] == 0:
+        print("GOLDEN nexmark_q5_device_dir FAIL "
+              "device directory never engaged", flush=True)
 
 
 def probe_child() -> None:

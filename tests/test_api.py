@@ -609,3 +609,53 @@ def test_rescale_via_patch_exactly_once(tmp_path):
     assert rows == list(range(4000)), (
         f"rescale lost/duplicated rows: {len(rows)} rows"
     )
+
+
+def test_restart_resumes_from_checkpoint_lineage(tmp_path):
+    """POST /pipelines/{id}/restart checkpoint-stops the running job and
+    the new job RESUMES the pipeline's checkpoint lineage — every source
+    row reaches the sink exactly once across both jobs."""
+    sink = tmp_path / "out.json"
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '4000', realtime = 'true',
+      message_count = '4000', start_time = '0'
+    );
+    CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+      connector = 'single_file', path = '{sink}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out SELECT counter FROM impulse;
+    """
+
+    async def body(client, api, controller):
+        from arroyo_tpu.config import update
+
+        with update(pipeline={"checkpointing": {
+            "storage_url": str(tmp_path / "ck"), "interval": 0.1,
+        }}):
+            r = await client.post(
+                "/api/v1/pipelines", json={"name": "rr", "query": sql}
+            )
+            pid = (await r.json())["id"]
+            await asyncio.sleep(0.4)
+            r = await client.post(f"/api/v1/pipelines/{pid}/restart")
+            assert r.status == 200
+            for _ in range(600):
+                jobs = (await (await client.get(
+                    f"/api/v1/pipelines/{pid}/jobs"
+                )).json())["data"]
+                if len(jobs) == 2 and all(
+                    controller.jobs.get(j["id"]) is not None
+                    and controller.jobs[j["id"]].state.is_terminal()
+                    for j in jobs
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert len(jobs) == 2
+
+    with_client(body)
+    rows = sorted(json.loads(l)["counter"] for l in open(sink) if l.strip())
+    assert rows == list(range(4000)), (
+        f"restart lost/duplicated rows: {len(rows)} rows"
+    )

@@ -413,8 +413,15 @@ class UpdatingAggregateOperator(WindowOperatorBase):
         if keys:
             slots = np.asarray([slot_map[k] for k in keys], dtype=np.int64)
             agg_cols = self.acc.finalize(self.acc.gather(slots))
+            # one C-level tolist per column instead of a numpy-scalar
+            # .item() per cell (object columns pass through unchanged)
+            col_lists = [
+                c.tolist() if isinstance(c, np.ndarray)
+                and c.dtype.kind != "O" else c
+                for c in agg_cols
+            ]
             for i, key in enumerate(keys):
-                new_vals = [_to_py(c[i]) for c in agg_cols]
+                new_vals = [_to_py(c[i]) for c in col_lists]
                 old = self.emitted.get(key)
                 if old == new_vals:
                     continue

@@ -107,6 +107,16 @@ class NativeSlotDirectory:
         )
         return np.frombuffer(out, dtype=np.int64)
 
+    def _rows_to_tuples(self, kmat: np.ndarray) -> list:
+        """Key matrix -> list of python-int tuples in C-level passes
+        (a per-row genexpr over numpy scalars is ~10x slower)."""
+        if self.n_keys == 0:
+            return [()] * len(kmat)
+        if self._stride == 1:
+            return [(k,) for k in kmat[:, 0].tolist()]
+        return list(zip(*(kmat[:, j].tolist()
+                          for j in range(self._stride))))
+
     def _keys_matrix(self, keys_raw: bytes) -> np.ndarray:
         return np.frombuffer(keys_raw, dtype=np.int64).reshape(
             -1, self._stride
@@ -116,9 +126,7 @@ class NativeSlotDirectory:
         keys_raw, slots_raw = self._d.take_bin(int(b))
         keys = self._keys_matrix(keys_raw)
         slots = np.frombuffer(slots_raw, dtype=np.int64).copy()
-        if self.n_keys == 0:
-            return [() for _ in range(len(slots))], slots
-        return [tuple(int(x) for x in row) for row in keys], slots
+        return self._rows_to_tuples(keys), slots
 
     def take_bin_arrays(
         self, b: int
@@ -148,12 +156,7 @@ class NativeSlotDirectory:
         keys, slots = self.bin_entries(b)
         if not len(keys):
             return None
-        if self.n_keys == 0:
-            return {(): int(slots[0])}
-        return {
-            tuple(int(x) for x in row): int(s)
-            for row, s in zip(keys, slots)
-        }
+        return dict(zip(self._rows_to_tuples(keys), slots.tolist()))
 
     def slots_for_keys(self, b: int, keys) -> dict:
         """{key: slot} for the subset of `keys` live in bin b — point
@@ -192,19 +195,14 @@ class NativeSlotDirectory:
         key_of map (updating-aggregate dirty tracking)."""
         arr = np.ascontiguousarray(np.asarray(slots, dtype=np.int64))
         present, bins_raw, keys_raw = self._d.keys_for_slots(arr)
-        bins = np.frombuffer(bins_raw, dtype=np.int64)
-        keys = self._keys_matrix(keys_raw)
-        out = []
-        for i, ok in enumerate(present):
-            if not ok:
-                out.append(None)
-            elif self.n_keys == 0:
-                out.append((int(bins[i]), ()))
-            else:
-                out.append(
-                    (int(bins[i]), tuple(int(x) for x in keys[i]))
-                )
-        return out
+        # tolist() yields plain python ints in one C pass — a per-row
+        # genexpr over numpy scalars dominated the updating flush
+        bins = np.frombuffer(bins_raw, dtype=np.int64).tolist()
+        keys = self._rows_to_tuples(self._keys_matrix(keys_raw))
+        return [
+            (bins[i], keys[i]) if ok else None
+            for i, ok in enumerate(present)
+        ]
 
     def live_bins(self) -> List[int]:
         return sorted(self._d.live_bins())

@@ -106,6 +106,17 @@ FROM nexmark WHERE bid IS NOT NULL GROUP BY 1;
 QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8, "qu": QU}
 
 
+def grant_q5_key(grant: dict):
+    """Which grant field carries the headline q5 number: the full-tier
+    'q5' when present, else the staged small tier (short grant windows
+    may only reach tier q5small — see tools/tpu_probe_daemon.py)."""
+    if "q5_eps" in grant:
+        return "q5"
+    if "q5small_eps" in grant:
+        return "q5small"
+    return None
+
+
 def force_backend(plan, backend: str) -> None:
     """Route every backend-capable operator in the plan onto `backend`:
     anything already carrying a backend knob plus the window/updating
@@ -421,18 +432,23 @@ def main():
         g_commit = grant.get("git_commit")
         commit_ok = (g_commit is not None and head is not None
                      and g_commit == head)
-        if "q5_eps" in grant and fresh and not commit_ok:
-            grant_extra["stale_grant_q5_eps"] = grant["q5_eps"]
+        g_q5_key = grant_q5_key(grant)
+        if g_q5_key and fresh and not commit_ok:
+            grant_extra["stale_grant_q5_eps"] = grant[f"{g_q5_key}_eps"]
+            grant_extra["stale_grant_tier"] = g_q5_key
             grant_extra["stale_grant_commit"] = g_commit
             grant_extra["stale_grant_captured_at"] = grant.get("captured_at")
-        if "q5_eps" in grant and fresh and commit_ok:
-            device = {"eps": grant["q5_eps"],
+        if g_q5_key and fresh and commit_ok:
+            device = {"eps": grant[f"{g_q5_key}_eps"],
                       "rows": grant.get("q5_rows", -1)}
             grant_extra["device_source"] = (
                 f"probe_daemon_capture@{grant.get('captured_at')}")
+            if grant.get("partial"):
+                grant_extra["device_partial_tiers"] = grant.get(
+                    "tiers_complete", [])
             if g_commit:
                 grant_extra["device_git_commit"] = g_commit
-            g_events = grant.get("events", {}).get("q5")
+            g_events = grant.get("events", {}).get(g_q5_key)
             for q in ("q1", "q7", "q8", "qu"):
                 if f"{q}_eps" in grant:
                     grant_extra[f"{q}_eps_tpu"] = grant[f"{q}_eps"]

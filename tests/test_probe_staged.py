@@ -1,0 +1,50 @@
+"""Staged TPU grant-capture machinery (tools/tpu_probe_daemon.py).
+
+The relay has been wedged for rounds 3-4 (zero grants), so the staged
+capture path would otherwise first execute on the next real grant. The
+daemon's --selftest runs one full parent cycle on the CPU backend with
+a simulated short grant window (child killed right after the q5small
+tier) and asserts the partial artifacts carry real numbers — this test
+wires that demonstration into the suite.
+
+Reference analog: arroyo ships its benches as CI-run harnesses; here
+the capture harness itself is under test because the hardware window is
+the scarce resource.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DAEMON = os.path.join(REPO, "tools", "tpu_probe_daemon.py")
+
+
+def test_staged_capture_selftest():
+    """The daemon's --selftest simulates a short grant window (child
+    killed right after the q5small tier) on the CPU backend and asserts
+    the partial artifacts carry real numbers. Delegate to it — ONE
+    check suite, no drift between the test and the demo."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY",
+                "TPU_PROBE_OUT_DIR", "TPU_PROBE_KILL_AFTER_TIER"):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, DAEMON, "--selftest"], env=env,
+        capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-1000:]
+    assert "SELFTEST OK" in out.stdout
+
+
+def test_grant_substitution_accepts_partial():
+    """bench.py must recognize a staged partial grant that only carries
+    the q5small tier, and prefer the full q5 when both exist."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    assert bench.grant_q5_key({"q5small_eps": 1.0}) == "q5small"
+    assert bench.grant_q5_key({"q5_eps": 2.0, "q5small_eps": 1.0}) == "q5"
+    assert bench.grant_q5_key({"kernels": {"matmul": {}}}) is None

@@ -1,46 +1,54 @@
 #!/usr/bin/env python
-"""TPU relay grant-capture daemon.
+"""TPU relay grant-capture daemon with STAGED escalating capture.
 
 The axon relay that fronts the single real TPU chip is intermittently
 wedged: most `jax.devices()` calls hang forever inside the PJRT claim
-path, but occasionally a grant lands (round 2: exactly once, 13:49 UTC;
-round 3: zero grants across ~11 probes). Round-2 evidence shows the
-fatal pattern: the probe that captured the grant exited, and the *next*
-process (the bench) wedged re-claiming.
+path, but occasionally a grant lands (round 2: exactly once, ~20 s of
+life; rounds 3-4: zero grants across ~70 probes). Round-2 evidence
+shows grants can be SHORT — roughly one command — so the child must
+convert a grant into evidence in escalating tiers, cheapest first, and
+the parent must flush every tier's results to disk AS IT LANDS:
 
-Therefore this daemon's probe child converts a grant into benchmark
-numbers AND device-backend golden verdicts IN-PROCESS, while it still
-holds the claim:
+  tier kernel   (~1 XLA compile):  one tiny jitted bf16 matmul timed
+                post-compile (MXU evidence in seconds), then the
+                device-tier slot-assignment bench.
+  tier q5small  (~6-8 compiles):   one small-event q5 through the full
+                engine — the first REAL pipeline number on device.
+  tier full     (reuses q5's programs where bucketed): the five-query
+                bench plan at credible event counts.
+  tier goldens  (correctness):     device-backend golden subset + the
+                host-side assign-bench tiers for comparison.
 
-  parent loop (this file, no jax import):
-    spawn child --probe
-      child: watchdog thread hard-exits (os._exit) if jax.devices()
-             hasn't returned within PROBE_GRACE seconds
-      child: on grant, prints GRANTED, runs the nexmark device benches
-             (q5/q1/q7/q8) via bench.child(), then a device-backend
-             golden subset (correctness evidence on the real chip).
-    parent: 150 s deadline to see GRANTED, else kill -> log "wedged";
-            after GRANTED, generous deadline for compiles through the
-            relay (~20-40 s per XLA program).
-    on success, fully automatic publication — no human involvement:
-      1. TPU_GRANT.json (incl. git_commit of HEAD at capture so the
-         round-end bench can refuse a stale substitution),
-      2. a like-for-like CPU baseline re-measured at the grant's event
-         count (subprocess pinned to JAX_PLATFORMS=cpu — never touches
-         the relay),
-      3. BENCH_r{N}.json with the real vs_baseline,
-      4. a "TPU grant capture" section appended to BASELINE.md.
-    sleep ~15 min (+/- jitter), repeat for the whole round; after a
-    capture keep probing hourly and RE-capture (HEAD moves as the round
-    progresses; a fresh capture re-binds the numbers to current code).
+The parent republishes TPU_GRANT.json (and BENCH_r{N}.json once any q5
+number exists) after every tier completion and every RESULT line, so a
+grant that dies after 30 seconds still leaves a real device number with
+a truthful `partial`/`tiers_complete` record. The final publication
+(child exits or deadline) adds the like-for-like CPU baseline and the
+BASELINE.md appendix.
+
+Selftest (the relay has been wedged for three straight rounds; the
+staging machinery must not be dead code that first runs on the next
+grant): `python tools/tpu_probe_daemon.py --selftest` runs one full
+parent cycle against the CPU backend in a sandbox directory, with the
+parent killing the child right after the `q5small` tier — simulating a
+short grant window — then asserts the partial artifacts contain the
+kernel + small-q5 numbers. tests/test_probe_staged.py wires this into
+the suite.
+
+Env knobs (all optional, used by --selftest):
+  TPU_PROBE_ALLOW_PLATFORM  accept this platform besides tpu (e.g. cpu)
+  TPU_PROBE_OUT_DIR         redirect ALL artifacts (grant/bench/log/
+                            BASELINE appendix) into this directory
+  TPU_PROBE_KILL_AFTER_TIER parent kills the child when this tier's
+                            TIERDONE arrives (simulated grant loss)
+  TPU_PROBE_SMALL           shrink event counts for a fast selftest
 
 Run:  python tools/tpu_probe_daemon.py            # daemon
       python tools/tpu_probe_daemon.py --probe    # one probe child
       python tools/tpu_probe_daemon.py --once     # single parent cycle
+      python tools/tpu_probe_daemon.py --selftest # staged-capture demo
 
 Log:  tools/tpu_probe.log   (one line per probe: ts outcome detail)
-Out:  TPU_GRANT.json + BENCH_r{N}.json + BASELINE.md appendix on first
-      successful device bench.
 """
 
 import json
@@ -55,8 +63,11 @@ import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "tools", "tpu_probe.log")
-GRANT_JSON = os.path.join(REPO, "TPU_GRANT.json")
+OUT_DIR = os.environ.get("TPU_PROBE_OUT_DIR") or REPO
+LOG = (os.path.join(OUT_DIR, "tpu_probe.log")
+       if os.environ.get("TPU_PROBE_OUT_DIR")
+       else os.path.join(REPO, "tools", "tpu_probe.log"))
+GRANT_JSON = os.path.join(OUT_DIR, "TPU_GRANT.json")
 PROBE_GRACE = 100.0     # child self-kill if no grant within this
 PARENT_PROBE_DEADLINE = 150.0   # parent kills child if no GRANTED line
 BENCH_DEADLINE = 3600.0         # after GRANTED: compiles are slow
@@ -65,20 +76,30 @@ SLEEP_AFTER_GRANT = 3600.0      # once numbers exist, probe hourly
 MAX_RUNTIME = 11.5 * 3600
 CPU_BASELINE_TIMEOUT = 600.0
 
-# (query, events) — q5 is the headline; sizes keep post-compile runtime
-# in seconds while being large enough for a credible rate.
-BENCH_PLAN = [("q5", 500_000), ("q1", 200_000), ("q7", 200_000),
-              ("q8", 200_000), ("qu", 200_000)]
+SMALL = bool(os.environ.get("TPU_PROBE_SMALL"))
 
-# Golden queries to re-verify on the device backend while holding the
+# Tier q5small: the first full-engine device number. Small on purpose —
+# after the ~6-8 XLA compiles it runs in seconds, and a grant that dies
+# right after still produced a real pipeline measurement.
+Q5_SMALL_EVENTS = 20_000 if SMALL else 50_000
+
+# Tier full: (query, events) — q5 is the headline; sizes keep
+# post-compile runtime in seconds while being large enough for a
+# credible rate.
+BENCH_PLAN = ([("q5", 40_000), ("q1", 20_000)] if SMALL else
+              [("q5", 500_000), ("q1", 200_000), ("q7", 200_000),
+               ("q8", 200_000), ("qu", 200_000)])
+
+# Tier goldens: re-verify on the device backend while holding the
 # grant. Small on purpose: each distinct XLA program compiles through
 # the relay at ~20-40 s. These four cover hop/sliding/tumbling windows,
 # a windowed join (device probe forced on via device_join_min_rows=0),
 # and retracting updating aggregates. session_window is deliberately
 # absent: SessionWindowOperator forces the numpy backend on a single
 # device, so its "device" verdict would attest the CPU path.
-GOLDEN_PLAN = ["nexmark_q5", "sliding_window_end", "windowed_inner_join",
-               "updating_aggregate"]
+GOLDEN_PLAN = (["nexmark_q5"] if SMALL else
+               ["nexmark_q5", "sliding_window_end", "windowed_inner_join",
+                "updating_aggregate"])
 
 
 def log_line(msg: str) -> None:
@@ -136,6 +157,49 @@ def next_bench_round() -> int:
 ROUND = next_bench_round()
 
 
+# ---------------------------------------------------------------- child
+
+def run_kernel_tier() -> None:
+    """Seconds-scale device evidence: ONE tiny jitted program (bf16
+    matmul — the MXU's native shape), timed post-compile, then the
+    device-tier slot-assignment bench. This is the cheapest possible
+    proof-of-device; it must land before anything that takes minutes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    n = 256 if SMALL else 1024
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)),
+                    dtype=jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    t0 = time.monotonic()
+    f(a).block_until_ready()
+    compile_s = time.monotonic() - t0
+    iters = 50
+    t0 = time.monotonic()
+    out = None
+    for _ in range(iters):
+        out = f(a)
+    out.block_until_ready()
+    dt = time.monotonic() - t0
+    us = dt / iters * 1e6
+    tflops = 2 * n ** 3 * iters / dt / 1e12
+    print(f"KERNEL matmul_bf16_{n} compile_s={compile_s:.1f} "
+          f"us_per_iter={us:.0f} tflops={tflops:.2f}", flush=True)
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import assign_bench
+        r = assign_bench.bench("device", rows=8192, keys=20000,
+                               iters=10 if SMALL else 40)
+        if r is not None:
+            print(f"ASSIGNBENCH device {r[0]:.0f}us/batch "
+                  f"{r[1] / 1e6:.2f}Mrows/s", flush=True)
+    except BaseException as e:
+        print(f"ASSIGNBENCHFAIL device {type(e).__name__}: {e}",
+              flush=True)
+
+
 def run_device_goldens() -> None:
     """Run GOLDEN_PLAN queries with the jax backend on the held device,
     comparing against the committed golden outputs. Prints one
@@ -158,6 +222,7 @@ def run_device_goldens() -> None:
     # golden fixtures are small (hundreds of rows): drop the row floor so
     # the windowed-join golden actually exercises the device join probe
     config().tpu.device_join_min_rows = 0
+
     def run_one(name: str, label: str):
         qpath = os.path.join(tg.GOLDEN, "queries", f"{name}.sql")
         gpath = os.path.join(tg.GOLDEN, "golden_outputs", f"{name}.json")
@@ -214,7 +279,10 @@ def run_device_goldens() -> None:
 
 
 def probe_child() -> None:
-    """Claim the device; on grant run benches + goldens while holding it."""
+    """Claim the device; on grant run the escalating capture tiers while
+    holding it. Every tier ends with a TIERDONE marker the parent uses
+    to flush artifacts — order is strictly cheapest-first so a short
+    grant still produces real device evidence."""
     granted = threading.Event()
 
     def watchdog():
@@ -231,134 +299,240 @@ def probe_child() -> None:
     devs = jax.devices()
     granted.set()
     kinds = ",".join(sorted({d.platform for d in devs}))
-    if not any(d.platform == "tpu" for d in devs):
+    allowed = {"tpu", os.environ.get("TPU_PROBE_ALLOW_PLATFORM", "tpu")}
+    if not any(d.platform in allowed for d in devs):
         print(f"NOTTPU {kinds}", flush=True)
         os._exit(4)
     print(f"GRANTED {kinds} in {time.monotonic() - t0:.1f}s", flush=True)
 
     sys.path.insert(0, REPO)
     import bench
+
+    # tier 1: seconds-scale kernel evidence
+    ok = True
+    try:
+        run_kernel_tier()
+    except BaseException as e:
+        ok = False
+        print(f"KERNELFAIL {type(e).__name__}: {e}", flush=True)
+    print(f"TIERDONE kernel ok={ok}", flush=True)
+
+    # tier 2: one small full-engine q5 — the first real pipeline number
+    print(f"BENCHQ q5small {Q5_SMALL_EVENTS}", flush=True)
+    ok = True
+    try:
+        bench.child(Q5_SMALL_EVENTS, "jax", "q5")
+    except BaseException as e:
+        ok = False
+        print(f"BENCHFAIL q5small {type(e).__name__}: {e}", flush=True)
+    print(f"TIERDONE q5small ok={ok}", flush=True)
+
+    # tier 3: the full bench plan (ok when at least one query completed)
+    n_ok = 0
     for query, events in BENCH_PLAN:
         print(f"BENCHQ {query} {events}", flush=True)
         try:
-            bench.child(events, "jax", query)   # prints RESULT eps rows dt
+            bench.child(events, "jax", query)  # prints RESULT eps rows dt
+            n_ok += 1
         except BaseException as e:  # keep going; later queries may pass
             print(f"BENCHFAIL {query} {type(e).__name__}: {e}", flush=True)
+    print(f"TIERDONE full ok={n_ok > 0}", flush=True)
+
+    # tier 4: correctness goldens + host-side assign tiers for comparison
+    ok = True
     try:
         run_device_goldens()
     except BaseException as e:
+        ok = False
         print(f"GOLDENSUITEFAIL {type(e).__name__}: {e}", flush=True)
-    # per-batch slot-assignment cost on the real chip (python host dict
-    # vs native C++ vs the device-resident sorted hash table); each tier
-    # fails independently — the device number is the one this bench
-    # exists to collect and a host-tier error must not skip it
     sys.path.insert(0, os.path.join(REPO, "tools"))
-    for kind in ("python", "native", "device"):
+    for kind in ("python", "native"):
         try:
             import assign_bench
-            r = assign_bench.bench(kind, rows=8192, keys=20000, iters=40)
+            r = assign_bench.bench(kind, rows=8192, keys=20000,
+                                   iters=10 if SMALL else 40)
             if r is not None:
                 print(f"ASSIGNBENCH {kind} {r[0]:.0f}us/batch "
                       f"{r[1] / 1e6:.2f}Mrows/s", flush=True)
         except BaseException as e:
             print(f"ASSIGNBENCHFAIL {kind} {type(e).__name__}: {e}",
                   flush=True)
+    print(f"TIERDONE goldens ok={ok}", flush=True)
     print("DONE", flush=True)
     os._exit(0)
 
 
-def publish_capture(results: dict, goldens: dict, commit: str) -> None:
-    """Fully automatic publication of a captured grant: TPU_GRANT.json,
-    CPU baseline re-measure, BENCH_r{N}.json, BASELINE.md appendix."""
+# --------------------------------------------------------- publication
+
+class CaptureState:
+    """Everything the parent has parsed from a granted child so far."""
+
+    def __init__(self, commit: str):
+        self.commit = commit
+        self.platform = ""
+        self.results = {}      # query -> {eps, rows, secs}
+        self.events = {}       # query -> event count (from BENCHQ lines)
+        self.goldens = {}
+        self.kernels = {}      # name -> metrics dict
+        self.assigns = {}      # tier -> raw line detail
+        self.tiers_complete = []   # tiers that ran to success
+        self.tiers_attempted = []  # every tier that reached its marker
+        self.publishes = 0
+
+    def best_q5(self):
+        """(q5_eps_record, events) — the full q5 when present, else the
+        small-tier q5; None when neither landed."""
+        if "q5" in self.results:
+            return self.results["q5"], self.events.get("q5")
+        if "q5small" in self.results:
+            return self.results["q5small"], self.events.get("q5small")
+        return None, None
+
+
+def publish(state: CaptureState, final: bool) -> None:
+    """Flush the capture state to disk. Called after EVERY tier
+    completion and result line (cheap: two small json writes), then once
+    with final=True when the child exits or the deadline fires — the
+    final pass adds the like-for-like CPU baseline re-measure and the
+    BASELINE.md appendix."""
+    state.publishes += 1
     payload = {
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "git_commit": commit,
-        "source": "tools/tpu_probe_daemon.py in-process capture",
-        "events": dict(BENCH_PLAN),
-        **{f"{q}_eps": round(r["eps"], 1) for q, r in results.items()},
-        "q5_rows": results["q5"]["rows"],
-        "goldens": goldens,
+        "git_commit": state.commit,
+        "source": "tools/tpu_probe_daemon.py staged in-process capture",
+        "platform": state.platform,
+        "partial": not final or "goldens" not in state.tiers_complete,
+        "tiers_complete": list(state.tiers_complete),
+        "tiers_attempted": list(state.tiers_attempted),
+        "publishes": state.publishes,
+        "events": dict(state.events),
+        **{f"{q}_eps": round(r["eps"], 1)
+           for q, r in state.results.items()},
+        "kernels": state.kernels,
+        "assign_bench": state.assigns,
+        "goldens": state.goldens,
     }
+    if "q5" in state.results:
+        payload["q5_rows"] = state.results["q5"]["rows"]
     tmp = GRANT_JSON + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1)
     os.replace(tmp, GRANT_JSON)  # atomic: bench.py may read anytime
-    log_line(f"GRANT CAPTURED -> TPU_GRANT.json {payload}")
 
-    # like-for-like CPU baseline at the grant's q5 event count; pinned
-    # to the CPU platform so it can never touch (or wedge on) the relay
-    cpu_env = dict(os.environ)
-    cpu_env["JAX_PLATFORMS"] = "cpu"
-    for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
-                "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
-        cpu_env.pop(var, None)
-    g_events = dict(BENCH_PLAN)["q5"]
-    sys.path.insert(0, REPO)
-    import bench
-    baseline = bench.run_child(g_events, "numpy", CPU_BASELINE_TIMEOUT,
-                               env=cpu_env)
-    if baseline is None:
-        log_line("capture: CPU baseline re-measure failed; "
-                 "BENCH json will carry vs_baseline=null")
+    q5, g_events = state.best_q5()
+    if q5 is None:
+        if final:
+            log_line(f"GRANT partial capture (no q5 tier) -> "
+                     f"TPU_GRANT.json {payload}")
+        return
 
-    rnd = ROUND
+    baseline = None
+    if final:
+        log_line(f"GRANT CAPTURED -> TPU_GRANT.json {payload}")
+        # like-for-like CPU baseline at the captured q5 event count;
+        # pinned to the CPU platform so it can never touch (or wedge on)
+        # the relay
+        cpu_env = dict(os.environ)
+        cpu_env["JAX_PLATFORMS"] = "cpu"
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+            cpu_env.pop(var, None)
+        sys.path.insert(0, REPO)
+        import bench
+        baseline = bench.run_child(g_events, "numpy", CPU_BASELINE_TIMEOUT,
+                                   env=cpu_env)
+        if baseline is None:
+            log_line("capture: CPU baseline re-measure failed; "
+                     "BENCH json will carry vs_baseline=null")
+
     bench_json = {
         "metric": "nexmark_q5_events_per_sec",
-        "value": payload["q5_eps"],
+        "value": round(q5["eps"], 1),
         "unit": "events/s",
-        "vs_baseline": round(payload["q5_eps"] / baseline["eps"], 3)
+        "vs_baseline": round(q5["eps"] / baseline["eps"], 3)
         if baseline else None,
         "baseline_cpu_eps": round(baseline["eps"], 1) if baseline else None,
         "events": g_events,
-        "result_rows": payload["q5_rows"],
+        "result_rows": q5.get("rows", -1),
         "side_backend": "jax",
-        **{f"{q}_eps": payload[f"{q}_eps"]
-           for q in ("q1", "q7", "q8", "qu") if f"{q}_eps" in payload},
+        "partial": payload["partial"],
+        "tiers_complete": payload["tiers_complete"],
+        **{f"{q}_eps": round(state.results[q]["eps"], 1)
+           for q in ("q1", "q7", "q8", "qu") if q in state.results},
         "device_source": f"probe_daemon_capture@{payload['captured_at']}",
-        "git_commit": commit,
-        "goldens": goldens,
+        "git_commit": state.commit,
+        "goldens": state.goldens,
+        "kernels": state.kernels,
     }
-    bp = os.path.join(REPO, f"BENCH_r{rnd:02d}.json")
-    with open(bp, "w") as f:
-        json.dump(bench_json, f, indent=1)
-    log_line(f"capture: wrote {os.path.basename(bp)} "
-             f"vs_baseline={bench_json['vs_baseline']}")
+    bp = os.path.join(OUT_DIR, f"BENCH_r{ROUND:02d}.json")
+    # never degrade: a COMPLETE capture already published this round must
+    # not be overwritten by a partial flush (e.g. an hourly re-capture
+    # whose grant dies early, or the daemon crashing mid-recapture)
+    degrade = False
+    if bench_json["partial"]:
+        try:
+            with open(bp) as f:
+                degrade = json.load(f).get("partial") is False
+        except (OSError, json.JSONDecodeError):
+            pass
+    if not degrade:
+        tmp = bp + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bench_json, f, indent=1)
+        os.replace(tmp, bp)
+    if final:
+        log_line(f"capture: "
+                 + ("kept earlier complete "
+                    if degrade else "wrote ")
+                 + f"{os.path.basename(bp)} "
+                 f"vs_baseline={bench_json['vs_baseline']}")
+        _append_baseline_md(state, bench_json, baseline, g_events)
 
-    gsum = ", ".join(f"{k}={v}" for k, v in sorted(goldens.items())) or "none"
+
+def _append_baseline_md(state, bench_json, baseline, g_events):
+    gsum = ", ".join(f"{k}={v}"
+                     for k, v in sorted(state.goldens.items())) or "none"
+    ksum = ", ".join(f"{k}: {v}"
+                     for k, v in sorted(state.kernels.items())) or "none"
     lines = [
         "",
-        f"## TPU grant capture ({payload['captured_at']}, "
-        f"commit {commit[:12]})",
+        f"## TPU grant capture ({bench_json['device_source']}, "
+        f"commit {state.commit[:12]})",
         "",
-        "Captured automatically by `tools/tpu_probe_daemon.py` while the",
-        "probe child held the device claim (relay grants do not survive",
-        "process exit — see round-2 evidence).",
+        "Captured automatically by `tools/tpu_probe_daemon.py` in staged",
+        "tiers while the probe child held the device claim (relay grants",
+        "do not survive process exit — see round-2 evidence).",
+        f"Tiers completed: {', '.join(state.tiers_complete) or 'none'}.",
         "",
-        f"| query | device ev/s | events |",
-        f"|---|---|---|",
+        "| query | device ev/s | events |",
+        "|---|---|---|",
     ]
-    ev = dict(BENCH_PLAN)
-    for q in ("q5", "q1", "q7", "q8", "qu"):
-        if f"{q}_eps" in payload:
-            lines.append(f"| {q} | {payload[f'{q}_eps']:,} | {ev[q]:,} |")
+    for q in ("q5", "q5small", "q1", "q7", "q8", "qu"):
+        if q in state.results:
+            lines.append(f"| {q} | {state.results[q]['eps']:,.1f} "
+                         f"| {state.events.get(q, 0):,} |")
     if baseline:
         lines += ["",
                   f"CPU baseline (same commit, {g_events:,} events): "
                   f"q5 {baseline['eps']:,.1f} ev/s → "
                   f"**vs_baseline {bench_json['vs_baseline']}**."]
-    lines += ["", f"Device-backend goldens: {gsum}.", ""]
-    with open(os.path.join(REPO, "BASELINE.md"), "a") as f:
+    lines += ["", f"Kernel tier: {ksum}.",
+              f"Device-backend goldens: {gsum}.", ""]
+    with open(os.path.join(OUT_DIR, "BASELINE.md"), "a") as f:
         f.write("\n".join(lines))
     log_line("capture: appended section to BASELINE.md")
 
 
-def run_one_probe() -> bool:
+# -------------------------------------------------------------- parent
+
+def run_one_probe(child_env=None) -> bool:
     """One parent cycle. Returns True if a grant produced numbers."""
     import queue
 
     cmd = [sys.executable, os.path.abspath(__file__), "--probe"]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
-                            stderr=subprocess.STDOUT, cwd=REPO)
+                            stderr=subprocess.STDOUT, cwd=REPO,
+                            env=child_env)
     q: "queue.Queue" = queue.Queue()
 
     def reader():
@@ -369,11 +543,10 @@ def run_one_probe() -> bool:
     threading.Thread(target=reader, daemon=True).start()
     deadline = time.monotonic() + PARENT_PROBE_DEADLINE
     granted = False
-    results = {}
-    goldens = {}
+    state = CaptureState(git_head())
+    kill_after = os.environ.get("TPU_PROBE_KILL_AFTER_TIER")
     cur_q = None
     lines = []
-    commit = git_head()
     try:
         while True:
             remaining = deadline - time.monotonic()
@@ -398,23 +571,53 @@ def run_one_probe() -> bool:
             lines.append(line)
             if line.startswith("GRANTED"):
                 granted = True
+                state.platform = line.split()[1]
                 deadline = time.monotonic() + BENCH_DEADLINE
                 log_line(f"probe GRANTED ({line})")
             elif line.startswith("BENCHQ"):
-                cur_q = line.split()[1]
+                parts = line.split()
+                cur_q = parts[1]
+                state.events[cur_q] = int(parts[2])
             elif line.startswith("RESULT") and cur_q:
                 parts = line.split()
-                results[cur_q] = {"eps": float(parts[1]),
-                                  "rows": int(parts[2]),
-                                  "secs": float(parts[3])}
+                state.results[cur_q] = {"eps": float(parts[1]),
+                                        "rows": int(parts[2]),
+                                        "secs": float(parts[3])}
+                publish(state, final=False)   # flush as it lands
+            elif line.startswith("KERNEL "):
+                parts = line.split()
+                state.kernels[parts[1]] = dict(
+                    p.split("=") for p in parts[2:] if "=" in p)
+                log_line(f"probe: {line}")
+                publish(state, final=False)
             elif line.startswith("GOLDEN "):
                 parts = line.split()
-                goldens[parts[1]] = parts[2]
+                state.goldens[parts[1]] = parts[2]
                 log_line(f"probe: {line}")
-            elif line.startswith("ASSIGNBENCH"):
+            elif line.startswith("ASSIGNBENCH "):
+                parts = line.split()
+                state.assigns[parts[1]] = " ".join(parts[2:])
                 log_line(f"probe: {line}")
+                publish(state, final=False)
+            elif line.startswith("TIERDONE"):
+                parts = line.split()
+                tier = parts[1]
+                ok = not any(p == "ok=False" for p in parts[2:])
+                state.tiers_attempted.append(tier)
+                if ok:
+                    state.tiers_complete.append(tier)
+                log_line(f"probe: tier {tier} "
+                         f"{'complete' if ok else 'FAILED'} "
+                         f"(results={sorted(state.results)})")
+                publish(state, final=False)
+                if kill_after == tier:
+                    log_line(f"probe: simulated grant loss after "
+                             f"tier {tier} (selftest)")
+                    _kill(proc)
+                    break
             elif line.startswith(("WEDGED", "NOTTPU", "BENCHFAIL",
-                                  "GOLDENSUITEFAIL")):
+                                  "KERNELFAIL", "GOLDENSUITEFAIL",
+                                  "ASSIGNBENCHFAIL")):
                 log_line(f"probe: {line}")
             elif line.startswith("DONE"):
                 break
@@ -422,21 +625,26 @@ def run_one_probe() -> bool:
         _kill(proc)
         tail = "; ".join(lines[-3:])
         if granted:
-            log_line(f"probe granted but bench DEADLINED; partial={list(results)} tail=[{tail}]")
+            log_line(f"probe granted but bench DEADLINED; "
+                     f"partial={sorted(state.results)} tail=[{tail}]")
         else:
             log_line("probe wedged (no grant within "
                      f"{PARENT_PROBE_DEADLINE:.0f}s)")
     finally:
         _kill(proc)
 
-    if granted and "q5" in results:
+    captured = bool(state.results or state.kernels or state.goldens)
+    if granted and captured:
         try:
-            publish_capture(results, goldens, commit)
+            publish(state, final=True)
         except Exception as e:
             log_line(f"capture publication error {type(e).__name__}: {e}")
-        return True
-    if granted and results:
-        log_line(f"grant produced partial results (no q5): {results}")
+        # only a pipeline (q5) number relaxes the probe cadence: a
+        # kernel-only capture is evidence but the headline is still
+        # missing, so keep hunting at the fast interval
+        return state.best_q5()[0] is not None
+    if granted:
+        log_line("grant produced no capturable results")
     return False
 
 
@@ -449,14 +657,85 @@ def _kill(proc):
             pass
 
 
+def selftest() -> int:
+    """Demonstrate the staged capture machinery on the CPU backend: one
+    parent cycle in a sandbox with a simulated short grant window (child
+    killed right after the q5small tier), then assert the partial
+    artifacts carry real numbers. Exit code 0 = staging works."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env.update({
+            "TPU_PROBE_OUT_DIR": td,
+            "TPU_PROBE_ALLOW_PLATFORM": "cpu",
+            "TPU_PROBE_KILL_AFTER_TIER": "q5small",
+            "TPU_PROBE_SMALL": "1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("PYTHONPATH", None)
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_POOL_SVC_OVERRIDE", "AXON_LOOPBACK_RELAY"):
+            env.pop(var, None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--once"],
+            env=env, capture_output=True, text=True, timeout=900)
+        sys.stdout.write(out.stdout)
+        grant_path = os.path.join(td, "TPU_GRANT.json")
+        ok = True
+        try:
+            with open(grant_path) as f:
+                grant = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"SELFTEST FAIL no grant artifact: {e}")
+            return 1
+        checks = [
+            ("partial flag set", grant.get("partial") is True),
+            ("kernel tier complete",
+             "kernel" in grant.get("tiers_complete", [])),
+            ("q5small tier complete",
+             "q5small" in grant.get("tiers_complete", [])),
+            ("kernel number captured", bool(grant.get("kernels"))),
+            ("small q5 eps captured", grant.get("q5small_eps", 0) > 0),
+            ("multiple staged publishes", grant.get("publishes", 0) >= 3),
+            ("platform recorded", grant.get("platform") == "cpu"),
+        ]
+        benches = glob.glob(os.path.join(td, "BENCH_r*.json"))
+        checks.append(("bench json written from partial grant",
+                       len(benches) == 1))
+        if benches:
+            with open(benches[0]) as f:
+                bj = json.load(f)
+            checks.append(("bench json flags partial",
+                           bj.get("partial") is True))
+            checks.append(("bench json has q5 value",
+                           bj.get("value", 0) > 0))
+            checks.append(("bench json has CPU baseline",
+                           bj.get("vs_baseline") is not None))
+        for name, passed in checks:
+            print(f"SELFTEST {'PASS' if passed else 'FAIL'} {name}")
+            ok = ok and passed
+        print(f"SELFTEST {'OK' if ok else 'FAILED'}")
+        # evidence in the real probe log: staged capture is demonstrated
+        # even while the relay stays wedged
+        log_line(f"SELFTEST staged-capture "
+                 f"{'OK' if ok else 'FAILED'}: simulated grant loss "
+                 f"after q5small; tiers={grant.get('tiers_complete')} "
+                 f"q5small_eps={grant.get('q5small_eps')} "
+                 f"publishes={grant.get('publishes')}")
+        return 0 if ok else 1
+
+
 def main():
     if "--probe" in sys.argv:
         probe_child()
         return
+    if "--selftest" in sys.argv:
+        sys.exit(selftest())
     once = "--once" in sys.argv
     start = time.monotonic()
     log_line(f"daemon start pid={os.getpid()} commit={git_head()[:12]} "
-             f"publishing BENCH_r{ROUND:02d}")
+             f"publishing BENCH_r{ROUND:02d} (staged capture)")
     have_grant = os.path.exists(GRANT_JSON)
     while True:
         try:

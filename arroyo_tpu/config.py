@@ -100,6 +100,14 @@ class TpuConfig:
     # all_to_all instead of the host hash shuffle (parallel/sharded_state)
     mesh_devices: int = 0
     mesh_rows_per_shard: int = 1024  # all_to_all rows per (src, dst) cell
+    # multi-host mesh (jax.distributed): a v5e pod slice spans processes,
+    # each addressing its local chips; the controller assigns
+    # (coordinator, process count, process id) at scheduling time and
+    # workers initialize before building any mesh
+    # (parallel/multihost.py). 0/1 processes = single-host, no init.
+    mesh_coordinator: str = ""   # host:port of process 0's coordinator
+    mesh_processes: int = 0      # total mesh processes in the job
+    mesh_process_id: int = -1    # this process's rank (assigned)
     # run the bin-local equi-join probe as jitted XLA programs
     # (ops/device_join.py); joins below the row threshold stay on the
     # host arrow join, where the device round-trip isn't worth it

@@ -101,6 +101,37 @@ async def terminate_procs(procs, force: bool = False):
             p.kill()
 
 
+def mesh_env_for_worker(index: int, n_workers: int,
+                        coordinator: Optional[str]) -> dict:
+    """Multi-host mesh assignment for one spawned worker: when the job
+    is configured for a multi-process mesh (tpu.mesh_processes >= 2),
+    the scheduler hands each worker its rank and the shared coordinator
+    so the worker's `multihost.ensure_initialized()` joins the global
+    mesh before any jax init. Empty dict in single-host deployments."""
+    from ..config import config
+    from ..parallel.multihost import env_overrides
+
+    n_proc = int(config().tpu.mesh_processes or 0)
+    if n_proc < 2:
+        return {}
+    if n_proc != n_workers:
+        raise ValueError(
+            f"tpu.mesh_processes={n_proc} but the job schedules "
+            f"{n_workers} workers; the mesh spans every worker"
+        )
+    return env_overrides(coordinator, n_proc, index)
+
+
+def pick_coordinator() -> str:
+    """Coordinator address for a new job's mesh: a free port on this
+    (controller) host — process 0's jax coordinator service binds it."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
 class ProcessScheduler(Scheduler):
     """Forks worker subprocesses (reference ProcessScheduler mod.rs:118)."""
 
@@ -110,8 +141,15 @@ class ProcessScheduler(Scheduler):
     async def start_workers(self, controller_addr, n_workers, job_id):
         global _next_process_id
 
-        for _ in range(n_workers):
-            p = spawn_worker(controller_addr, _next_process_id)
+        from ..config import config
+
+        coord = (pick_coordinator()
+                 if int(config().tpu.mesh_processes or 0) >= 2 else None)
+        for i in range(n_workers):
+            p = spawn_worker(
+                controller_addr, _next_process_id,
+                extra_env=mesh_env_for_worker(i, n_workers, coord),
+            )
             _next_process_id += 1
             self.procs.setdefault(job_id, []).append(p)
 

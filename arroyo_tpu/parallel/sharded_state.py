@@ -360,12 +360,21 @@ class ShardedAccumulator(Accumulator):
         # neutral filler rows shipped alongside them
         self.rows_sent = 0
         self.rows_padded = 0
+        # multi-host: the mesh may span devices owned by several
+        # processes (jax.distributed — parallel/multihost.py). All host
+        # buffers then enter the device as GLOBAL arrays (each process
+        # materializes only its addressable shards) and every mesh
+        # process runs the same steps in lockstep.
+        from .multihost import is_multiprocess_mesh
+
+        self._multiproc = is_multiprocess_mesh(mesh)
         self._sharding = self._make_sharding()
         self.state = self._fresh_state(capacity_per_shard)
         self._step = self._make_step()
         self._direct_step = self._make_direct_step()
         self._mesh_gather_fn = None
         self._mesh_reset_fn = None
+        self._mesh_restore_fn = None
 
     def _make_sharding(self):
         from jax.sharding import NamedSharding
@@ -374,22 +383,41 @@ class ShardedAccumulator(Accumulator):
         return NamedSharding(self.mesh, P(self.axis, None))
 
     def _fresh_state(self, capacity: int):
-        import jax
+        from jax.sharding import PartitionSpec as P
 
         from .mesh import _get_jnp
+        from .multihost import put_global
 
-        jnp = _get_jnp()
+        _get_jnp()  # enable x64 before any placement
         return [
-            jax.device_put(
-                jnp.full(
+            put_global(
+                np.full(
                     (self.n_shards, capacity),
                     _neutral(op, dt),
                     dtype=_np_dtype(dt),
                 ),
-                self._sharding,
+                self.mesh,
+                P(self.axis, None),
             )
             for op, dt, _, _ in self.phys
         ]
+
+    def _to_dev(self, arr: np.ndarray, shard_dim0: bool):
+        """Host buffer -> device array for step/gather inputs: sharded on
+        dim 0 over the mesh axis (packed row buffers) or replicated
+        (index vectors). Single-process fast path: plain jnp.asarray —
+        jit re-shards as needed."""
+        from .mesh import _get_jnp
+
+        jnp = _get_jnp()
+        if not self._multiproc:
+            return jnp.asarray(arr)
+        from jax.sharding import PartitionSpec as P
+
+        from .multihost import put_global
+
+        return put_global(arr, self.mesh,
+                          P(self.axis) if shard_dim0 else P())
 
     def _decompose(self, slots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         return slots // STRIDE, slots % STRIDE
@@ -410,17 +438,28 @@ class ShardedAccumulator(Accumulator):
         from .mesh import _get_jnp
 
         jnp = _get_jnp()
-        grown = []
-        for s, (op, dt, _, _) in zip(self.state, self.phys):
-            pad = jnp.full(
-                (self.n_shards, new_cap - self.capacity),
-                _neutral(op, dt),
-                dtype=_np_dtype(dt),
-            )
-            g = jnp.concatenate([s, pad], axis=1)
-            g = g.at[:, self.capacity - 1].set(_neutral(op, dt))
-            grown.append(jax.device_put(g, self._sharding))
-        self.state = grown
+        old_cap = self.capacity
+        phys = list(self.phys)
+        n_shards = self.n_shards
+
+        # one jitted program for ALL columns, with explicit out_shardings:
+        # valid in both single- and multi-process mode (eager concatenate
+        # of a global sharded array with a process-local pad is not).
+        # grow() is rare (4x capacity steps), so a compile per call is
+        # acceptable; a single program per grow beats one per column.
+        @partial(jax.jit, donate_argnums=(0,), out_shardings=self._sharding)
+        def grow_fn(state):
+            out = []
+            for (op, dt, _, _), x in zip(phys, state):
+                pad = jnp.full(
+                    (n_shards, new_cap - old_cap), _neutral(op, dt),
+                    dtype=_np_dtype(dt),
+                )
+                g = jnp.concatenate([x, pad], axis=1)
+                out.append(g.at[:, old_cap - 1].set(_neutral(op, dt)))
+            return out
+
+        self.state = grow_fn(list(self.state))
         self.capacity = new_cap
 
     # -- update (hot path) --------------------------------------------------
@@ -500,10 +539,8 @@ class ShardedAccumulator(Accumulator):
 
     def _dispatch(self, step, shape, rows, flat, locals_, cols, signs):
         """Pack (slots, valid, per-source values) buffers of `shape` and
-        run one jitted step."""
-        from .mesh import _get_jnp
-
-        jnp = _get_jnp()
+        run one jitted step. Buffers enter the device sharded on dim 0
+        (the destination-shard dimension in both layouts)."""
         total = int(np.prod(shape))
         slots_l = np.full(total, self.capacity - 1, dtype=np.int64)
         slots_l[flat] = locals_[rows]
@@ -524,11 +561,11 @@ class ShardedAccumulator(Accumulator):
             # sign application happens in-kernel: add-sources multiply by
             # valid (0 padding / ±1 append-retract)
             v[flat] = col[rows]
-            inputs.append(jnp.asarray(v.reshape(shape)))
+            inputs.append(self._to_dev(v.reshape(shape), True))
         self.state = step(
             self.state,
-            jnp.asarray(slots_l.reshape(shape)),
-            jnp.asarray(valid.reshape(shape)),
+            self._to_dev(slots_l.reshape(shape), True),
+            self._to_dev(valid.reshape(shape), True),
             *inputs,
         )
 
@@ -634,14 +671,12 @@ class ShardedAccumulator(Accumulator):
             ]
         import jax
 
-        from .mesh import _get_jnp
+        from .multihost import to_host
 
-        jnp = _get_jnp()
         if self._mesh_gather_fn is None:
             if self.salted:
                 phys = list(self.phys)
 
-                @jax.jit
                 def gather_fn(state, sh, loc):
                     # fold across the shard axis; padding rows point at
                     # the scratch slot, neutral on every shard
@@ -657,10 +692,22 @@ class ShardedAccumulator(Accumulator):
                     return out
             else:
 
-                @jax.jit
                 def gather_fn(state, sh, loc):
                     return [s[sh, loc] for s in state]
 
+            if self._multiproc:
+                # emission values must be readable on EVERY process:
+                # pin the outputs replicated so each host reads its
+                # local copy (multihost.to_host)
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                gather_fn = jax.jit(
+                    gather_fn,
+                    out_shardings=NamedSharding(self.mesh, P()),
+                )
+            else:
+                gather_fn = jax.jit(gather_fn)
             self._mesh_gather_fn = gather_fn
         sh, loc = self._decompose(np.asarray(slots))
         padded = _bucket(len(slots), self._buckets)
@@ -669,11 +716,15 @@ class ShardedAccumulator(Accumulator):
         sh_p[: len(slots)] = sh
         loc_p[: len(slots)] = loc
         outs = self._mesh_gather_fn(
-            self.state, jnp.asarray(sh_p), jnp.asarray(loc_p)
+            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
         )
         if not materialize:
+            if self._multiproc:
+                # replicated outputs span remote devices; hand back this
+                # process's local copy so later slicing / np.asarray work
+                outs = [o.addressable_data(0) for o in outs]
             return [o[: len(slots)] for o in outs]
-        return [np.asarray(o)[: len(slots)] for o in outs]
+        return [to_host(o)[: len(slots)] for o in outs]
 
     def reset_slots(self, slots: np.ndarray):
         self._drop_udaf_slots(slots)
@@ -681,14 +732,12 @@ class ShardedAccumulator(Accumulator):
             return
         import jax
 
-        from .mesh import _get_jnp
-
-        jnp = _get_jnp()
         if self._mesh_reset_fn is None:
             phys = list(self.phys)
             salted = self.salted
 
-            @partial(jax.jit, donate_argnums=(0,))
+            @partial(jax.jit, donate_argnums=(0,),
+                     out_shardings=self._sharding)
             def reset_fn(state, sh, loc):
                 if salted:
                     # a salted slot's state lives on EVERY shard
@@ -709,29 +758,54 @@ class ShardedAccumulator(Accumulator):
         sh_p[: len(slots)] = sh
         loc_p[: len(slots)] = loc
         self.state = self._mesh_reset_fn(
-            self.state, jnp.asarray(sh_p), jnp.asarray(loc_p)
+            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
         )
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
         values = self._restore_udaf_cols(slots, values)
         if len(slots) == 0 or not self.phys:
             return
-        from .mesh import _get_jnp
+        import jax
 
-        jnp = _get_jnp()
+        if self._mesh_restore_fn is None:
+            phys = list(self.phys)
+            salted = self.salted
+
+            @partial(jax.jit, donate_argnums=(0,),
+                     out_shardings=self._sharding)
+            def restore_fn(state, sh, loc, *vals):
+                if salted:
+                    # restored value lands whole on the nominal shard;
+                    # the other shards go neutral so the cross-shard
+                    # fold reproduces it
+                    return [
+                        s.at[:, loc].set(_neutral(op, dt))
+                        .at[sh, loc].set(v)
+                        for (op, dt, _, _), s, v in zip(phys, state, vals)
+                    ]
+                return [
+                    s.at[sh, loc].set(v) for s, v in zip(state, vals)
+                ]
+
+            self._mesh_restore_fn = restore_fn
         sh, loc = self._decompose(np.asarray(slots))
-        shj, locj = jnp.asarray(sh), jnp.asarray(loc)
-        if self.salted:
-            # restored value lands whole on the nominal shard; the other
-            # shards go neutral so the cross-shard fold reproduces it
-            self.state = [
-                s.at[:, locj].set(_neutral(op, dt))
-                .at[shj, locj].set(jnp.asarray(v))
-                for (op, dt, _, _), s, v in zip(self.phys, self.state,
-                                                values)
-            ]
-            return
-        self.state = [
-            s.at[shj, locj].set(jnp.asarray(v))
-            for s, v in zip(self.state, values)
-        ]
+        # bucket-pad like gather/reset so restore chunk sizes don't each
+        # specialize the jitted scatter; padding rows write the neutral
+        # value into the scratch slot
+        n = len(slots)
+        padded = _bucket(n, self._buckets)
+        sh_p = np.zeros(padded, dtype=np.int64)
+        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        sh_p[:n] = sh
+        loc_p[:n] = loc
+        vals_p = []
+        for (op, dt, _, _), v in zip(self.phys, values):
+            vp = np.full(padded, _neutral(op, dt), dtype=_np_dtype(dt))
+            vp[:n] = np.asarray(v)
+            vals_p.append(vp)
+        self.state = self._mesh_restore_fn(
+            self.state,
+            self._to_dev(sh_p, False),
+            self._to_dev(loc_p, False),
+            *[self._to_dev(v, False) for v in vals_p],
+        )

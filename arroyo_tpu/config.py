@@ -100,6 +100,17 @@ class TpuConfig:
     # all_to_all instead of the host hash shuffle (parallel/sharded_state)
     mesh_devices: int = 0
     mesh_rows_per_shard: int = 1024  # all_to_all rows per (src, dst) cell
+    # micro-batching on the mesh path: buffer update rows host-side and
+    # ship them in one packed exchange + scatter once this many rows (or
+    # any state read) arrive — amortizes per-dispatch overhead (packing,
+    # transfer, program launch) across engine batches. 0 = dispatch
+    # every engine batch immediately.
+    mesh_flush_rows: int = 32768
+    # persistent XLA compilation cache directory (ops/_jax.get_jax):
+    # compiled programs survive process exit, so repeat runs skip XLA
+    # compilation (critical through the TPU relay at ~20-40s/program).
+    # Empty string disables.
+    compilation_cache_dir: str = "~/.cache/arroyo_tpu_xla"
     # multi-host mesh (jax.distributed): a v5e pod slice spans processes,
     # each addressing its local chips; the controller assigns
     # (coordinator, process count, process id) at scheduling time and

@@ -72,9 +72,14 @@ class NodeServer:
         for _ in range(req.get("n", 1)):
             wid = _next_node_worker_id
             _next_node_worker_id += 1
+            # multi-host mesh: the scheduler rides the per-worker rank
+            # assignment (ARROYO__TPU__MESH_*) in the RPC so the worker's
+            # ensure_initialized() joins the job's global mesh
+            env = dict(self.extra_env or {})
+            env.update(req.get("extra_env") or {})
             p = spawn_worker(
                 req.get("controller_addr", self.controller_addr), wid,
-                extra_env=self.extra_env,
+                extra_env=env,
             )
             self.procs.setdefault(job_id, []).append(p)
             started.append(wid)

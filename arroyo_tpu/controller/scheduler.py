@@ -124,7 +124,13 @@ def mesh_env_for_worker(index: int, n_workers: int,
 
 def pick_coordinator() -> str:
     """Coordinator address for a new job's mesh: a free port on this
-    (controller) host — process 0's jax coordinator service binds it."""
+    (controller) host — process 0's jax coordinator service binds it.
+
+    Bind-then-close is inherently racy: the port stays unbound until
+    worker rank 0 reaches jax.distributed.initialize (process fork +
+    jax import later). The window is accepted for the process scheduler
+    (single host, ephemeral-range port, job startup is seconds); an
+    operator can pin tpu.mesh_coordinator explicitly to avoid it."""
     import socket
 
     with socket.socket() as s:
@@ -143,8 +149,9 @@ class ProcessScheduler(Scheduler):
 
         from ..config import config
 
-        coord = (pick_coordinator()
-                 if int(config().tpu.mesh_processes or 0) >= 2 else None)
+        coord = None
+        if int(config().tpu.mesh_processes or 0) >= 2:
+            coord = config().tpu.mesh_coordinator or pick_coordinator()
         for i in range(n_workers):
             p = spawn_worker(
                 controller_addr, _next_process_id,
@@ -168,16 +175,34 @@ class NodeScheduler(Scheduler):
         self.placements: Dict[str, list] = {}
 
     async def start_workers(self, controller_addr, n_workers, job_id):
+        from ..config import config
+
+        # multi-host mesh across node daemons: rank assignment works the
+        # same as the process scheduler, but the coordinator must be an
+        # operator-provided address reachable from EVERY node (rank 0
+        # binds it; a controller-local free port would be meaningless on
+        # another machine)
+        n_proc = int(config().tpu.mesh_processes or 0)
+        coord = config().tpu.mesh_coordinator or None
+        if n_proc >= 2 and not coord:
+            raise RuntimeError(
+                "node scheduler: tpu.mesh_processes >= 2 requires an "
+                "operator-provided tpu.mesh_coordinator (host:port "
+                "reachable from every node; rank 0's worker binds it)"
+            )
         try:
-            for _ in range(n_workers):
-                await self._place_one(controller_addr, job_id)
+            for i in range(n_workers):
+                await self._place_one(
+                    controller_addr, job_id,
+                    mesh_env_for_worker(i, n_workers, coord),
+                )
         except Exception:
             # partial scheduling failure: release what was started so the
             # slots and orphan workers don't leak
             await self.stop_workers(job_id, force=True)
             raise
 
-    async def _place_one(self, controller_addr, job_id):
+    async def _place_one(self, controller_addr, job_id, extra_env=None):
         while True:
             nodes = list(getattr(self.controller, "nodes", {}).values())
             if not nodes:
@@ -196,7 +221,8 @@ class NodeScheduler(Scheduler):
                 await node.client.call(
                     "NodeGrpc", "StartWorkers",
                     {"job_id": job_id, "n": 1,
-                     "controller_addr": controller_addr},
+                     "controller_addr": controller_addr,
+                     "extra_env": extra_env or {}},
                 )
                 return
             except Exception as e:  # noqa: BLE001 - dead node: drop + retry

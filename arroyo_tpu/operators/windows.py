@@ -76,16 +76,17 @@ class WindowOperatorBase(Operator):
             # planner marks aggregates whose every grouping key is the
             # window itself (one group per bin): hash ownership would
             # starve most shards, so those run SALTED — rows spread
-            # round-robin across all shards, folded at gather. Needs
-            # fold-able state (no host-state aggregates).
-            salted = bool(config.get("mesh_salted")) and not any(
-                s.host_state() is not None for s in self.specs
-            )
+            # round-robin across all shards, folded at gather. Device
+            # phys ops are all fold-able (add/min/max); host-state
+            # aggregates (UDAF buffers / multisets) are keyed by GLOBAL
+            # slot and folded host-side, so they ride along unchanged.
+            salted = bool(config.get("mesh_salted"))
             self.acc = ShardedAccumulator(
                 self.specs,
                 key_mesh(self._mesh_device_list(mesh_n)),
                 rows_per_shard=config_fn().tpu.mesh_rows_per_shard,
                 salted=salted,
+                flush_rows=config_fn().tpu.mesh_flush_rows,
             )
             self.dir = (
                 SharedMeshSlotDirectory(mesh_n) if salted
@@ -1117,12 +1118,22 @@ class SessionWindowOperator(WindowOperatorBase):
         # key -> list of [start, last_ts, slot], sorted by start
         self.sessions: Dict[tuple, List[List]] = {}
         self._next_shard = 0
+        # block-refilled slot pool: one vectorized alloc_slots call per
+        # _POOL_BLOCK sessions instead of one Python directory call per
+        # session (the mesh facade deals the block round-robin across
+        # shards, preserving placement balance)
+        self._slot_pool: List[int] = []
+
+    _POOL_BLOCK = 64
 
     def _alloc_slot(self) -> int:
-        # round-robin shard hint: load-balances mesh placement, ignored
-        # by the plain directory
-        self._next_shard += 1
-        return self.dir.alloc_slot(self._next_shard)
+        if not self._slot_pool:
+            self._slot_pool = [
+                int(s) for s in
+                self.dir.alloc_slots(self._POOL_BLOCK, self._next_shard)
+            ]
+            self._next_shard += self._POOL_BLOCK
+        return self._slot_pool.pop()
 
     def _free_slot(self, slot: int):
         self.dir.free_slot(int(slot))

@@ -60,6 +60,24 @@ class SlotDirectory:
     def alloc_slot(self, shard_hint: int = 0) -> int:
         return self.free.pop() if self.free else self._alloc()
 
+    def alloc_block(self, k: int) -> List[int]:
+        """Bulk-allocate k slots in one call (session slot pool): drains
+        the free list first, then extends the high-water mark once."""
+        nf = min(k, len(self.free))
+        out = self.free[len(self.free) - nf:]
+        del self.free[len(self.free) - nf:]
+        rem = k - nf
+        if rem:
+            start = self.next_slot
+            self.next_slot += rem
+            out.extend(range(start, start + rem))
+        return out
+
+    def alloc_slots(self, n: int, shard_hint: int = 0) -> np.ndarray:
+        """Vectorized imperative allocation (mesh facade load-balances
+        across shards; here it is just a block)."""
+        return np.asarray(self.alloc_block(n), dtype=np.int64)
+
     def free_slot(self, slot: int):
         self.free.append(int(slot))
 

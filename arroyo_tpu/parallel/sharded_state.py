@@ -49,8 +49,10 @@ STRIDE = 1 << 32
 # process-wide packed-exchange traffic diagnostics (direct [S, R] or
 # all_to_all [S, S, R] layout, whichever each update used), aggregated
 # across every ShardedAccumulator instance; bench --mesh reads these to
-# report the padding overhead of the host->device/ICI row shipment
-MESH_STATS = {"rows_sent": 0, "rows_padded": 0}
+# report the padding overhead of the host->device/ICI row shipment and
+# the dispatch amortization (device steps per engine update call)
+MESH_STATS = {"rows_sent": 0, "rows_padded": 0,
+              "dispatches": 0, "updates": 0}
 
 
 class MeshSlotDirectory:
@@ -186,18 +188,42 @@ class MeshSlotDirectory:
         local = d.free.pop() if d.free else d._alloc()
         return (shard_hint % self.n_shards) * STRIDE + local
 
+    def alloc_slots(self, n: int, shard_hint: int = 0) -> np.ndarray:
+        """Vectorized round-robin block allocation: one call allocates n
+        slots dealt evenly across shards (the session operator's slot
+        pool refill — replaces one Python alloc_slot call per session)."""
+        shards = (np.arange(n, dtype=np.int64) + shard_hint) % self.n_shards
+        out = np.empty(n, dtype=np.int64)
+        for shard in range(self.n_shards):
+            idx = np.nonzero(shards == shard)[0]
+            if not len(idx):
+                continue
+            block = self.dirs[shard].alloc_block(len(idx))
+            out[idx] = np.asarray(block, dtype=np.int64) + shard * STRIDE
+        return out
+
     def free_slot(self, slot: int):
         self.dirs[int(slot) // STRIDE].free.append(int(slot) % STRIDE)
 
 
 def _pow2_ladder(cap: int, floor: int = 16) -> tuple:
-    """Power-of-2 bucket rungs from `floor` up to and including `cap`."""
+    """Bucket rungs from `floor` up to and including `cap`: power-of-2
+    below 1024, quarter steps (x1.25/x1.5/x1.75 between octaves) above.
+    Above 1024 the packed buffers are large enough that pow2 overshoot
+    (~33% average, 50% worst) dominates the mesh padding ratio; quarter
+    rungs bound it at 25% worst / ~11% average. The extra rungs cost one
+    XLA program each only when actually hit, and compiled programs
+    persist across processes (tpu.compilation_cache_dir)."""
     rb, b = [], floor
     while b < cap:
         rb.append(b)
+        if b >= 1024:
+            rb.extend(
+                x for x in (b * 5 // 4, b * 3 // 2, b * 7 // 4) if x < cap
+            )
         b *= 2
     rb.append(cap)
-    return tuple(rb)
+    return tuple(sorted(set(x for x in rb if x <= cap)))
 
 
 def _scatter_body(phys, jnp):
@@ -303,6 +329,9 @@ class SharedMeshSlotDirectory:
     def alloc_slot(self, shard_hint: int = 0) -> int:
         return self._g1(self._flat.alloc_slot())
 
+    def alloc_slots(self, n: int, shard_hint: int = 0) -> np.ndarray:
+        return self._g(self._flat.alloc_slots(n))
+
     def free_slot(self, slot: int):
         self._flat.free_slot(int(slot) % STRIDE)
 
@@ -320,6 +349,7 @@ class ShardedAccumulator(Accumulator):
         rows_per_shard: int = 1024,
         host_fed: bool = True,
         salted: bool = False,
+        flush_rows: int = 0,
     ):
         # initialize host-side bookkeeping via the base class with backend
         # 'numpy' (cheap), then replace the state with mesh-sharded arrays
@@ -360,6 +390,13 @@ class ShardedAccumulator(Accumulator):
         # neutral filler rows shipped alongside them
         self.rows_sent = 0
         self.rows_padded = 0
+        # micro-batching: update() buffers rows host-side and ships one
+        # packed exchange + scatter per `flush_rows` rows instead of per
+        # engine batch; every state read (gather/reset/restore) flushes
+        # first, so observers never see stale state. 0 = immediate.
+        self.flush_rows = int(flush_rows)
+        self._pending: List[tuple] = []   # (slots, vals_list, signs)
+        self._pending_rows = 0
         # multi-host: the mesh may span devices owned by several
         # processes (jax.distributed — parallel/multihost.py). All host
         # buffers then enter the device as GLOBAL arrays (each process
@@ -477,19 +514,68 @@ class ShardedAccumulator(Accumulator):
         self._update_host(slots, cols, signs)
         if not self.phys:
             return
+        MESH_STATS["updates"] += 1
+        slots = np.asarray(slots)
+        max_local = int((slots % STRIDE).max())
+        if max_local >= self.capacity - 1:
+            # jit scatters silently drop out-of-bounds updates — callers
+            # must grow() first (windows.py _ensure_capacity does);
+            # checked at update() time (capacity only ever grows before a
+            # deferred flush, so the buffered check stays valid)
+            raise ValueError(
+                f"shard accumulator capacity exceeded: local slot "
+                f"{max_local} >= capacity-1={self.capacity - 1}"
+            )
+        from ..ops.aggregates import _src_values
+
+        vals = [
+            np.asarray(_src_values(self.specs[si], src, cols))
+            for op, dt, src, si in self.phys if src != "one"
+        ]
+        if self.flush_rows <= n and not self._pending:
+            self._dispatch_rows(slots, vals, signs)
+            return
+        self._pending.append(
+            (slots, vals, None if signs is None else np.asarray(signs))
+        )
+        self._pending_rows += n
+        if self._pending_rows >= self.flush_rows:
+            self.flush()
+
+    def flush(self):
+        """Ship any buffered update rows to the device (one packed
+        exchange covering every pending engine batch)."""
+        if not self._pending:
+            return
+        if len(self._pending) == 1:
+            slots, vals, signs = self._pending[0]
+        else:
+            slots = np.concatenate([p[0] for p in self._pending])
+            vals = [
+                np.concatenate([p[1][i] for p in self._pending])
+                for i in range(len(self._pending[0][1]))
+            ]
+            if any(p[2] is not None for p in self._pending):
+                signs = np.concatenate([
+                    p[2] if p[2] is not None
+                    else np.ones(len(p[0]), dtype=np.int64)
+                    for p in self._pending
+                ])
+            else:
+                signs = None
+        self._pending = []
+        self._pending_rows = 0
+        self._dispatch_rows(slots, vals, signs)
+
+    def _dispatch_rows(self, slots: np.ndarray, vals: List[np.ndarray],
+                       signs: Optional[np.ndarray]):
+        n = len(slots)
         S, R = self.n_shards, self.rows_per_shard
-        owners, locals_ = self._decompose(np.asarray(slots))
+        owners, locals_ = self._decompose(slots)
         if self.salted:
             # balanced spread: every shard takes ~n/S rows of each group;
             # the cross-shard fold happens at gather
             owners = np.arange(n, dtype=np.int64) % S
-        if int(locals_.max()) >= self.capacity - 1:
-            # jit scatters silently drop out-of-bounds updates — callers
-            # must grow() first (windows.py _ensure_capacity does)
-            raise ValueError(
-                f"shard accumulator capacity exceeded: local slot "
-                f"{int(locals_.max())} >= capacity-1={self.capacity - 1}"
-            )
         order = np.argsort(owners, kind="stable")
         so = owners[order]
         starts = np.searchsorted(so, so, side="left")
@@ -508,7 +594,7 @@ class ShardedAccumulator(Accumulator):
                 flat = so[in_chunk] * r_c + pm
                 self._note_traffic(len(rows), S * r_c)
                 self._dispatch(self._direct_step, (S, r_c), rows, flat,
-                               locals_, cols, signs)
+                               locals_, vals, signs)
             return
         # Balanced packing into the [src, dst, row] all_to_all layout:
         # each destination shard's rows are dealt round-robin across the
@@ -529,7 +615,7 @@ class ShardedAccumulator(Accumulator):
             flat = (srcs[in_chunk] * S + so[in_chunk]) * r_c + cm
             self._note_traffic(len(rows), S * S * r_c)
             self._dispatch(self._step, (S, S, r_c), rows, flat, locals_,
-                           cols, signs)
+                           vals, signs)
 
     def _note_traffic(self, sent: int, shipped: int):
         self.rows_sent += sent
@@ -537,16 +623,20 @@ class ShardedAccumulator(Accumulator):
         MESH_STATS["rows_sent"] += sent
         MESH_STATS["rows_padded"] += shipped - sent
 
-    def _dispatch(self, step, shape, rows, flat, locals_, cols, signs):
+    def _dispatch(self, step, shape, rows, flat, locals_, vals, signs):
         """Pack (slots, valid, per-source values) buffers of `shape` and
         run one jitted step. Buffers enter the device sharded on dim 0
-        (the destination-shard dimension in both layouts)."""
+        (the destination-shard dimension in both layouts). `vals` holds
+        one value array per non-count physical accumulator, pre-extracted
+        at update() time so buffered flushes just concatenate."""
+        MESH_STATS["dispatches"] += 1
         total = int(np.prod(shape))
         slots_l = np.full(total, self.capacity - 1, dtype=np.int64)
         slots_l[flat] = locals_[rows]
         valid = np.zeros(total, dtype=np.int64)
         valid[flat] = 1 if signs is None else signs[rows]
         inputs = []
+        vi = 0
         for op, dt, src, si in self.phys:
             if src == "one":
                 continue
@@ -555,12 +645,10 @@ class ShardedAccumulator(Accumulator):
                 0 if op == "add" else _neutral(op, dt),
                 dtype=_np_dtype(dt),
             )
-            from ..ops.aggregates import _src_values
-
-            col = _src_values(self.specs[si], src, cols)
             # sign application happens in-kernel: add-sources multiply by
             # valid (0 padding / ±1 append-retract)
-            v[flat] = col[rows]
+            v[flat] = vals[vi][rows]
+            vi += 1
             inputs.append(self._to_dev(v.reshape(shape), True))
         self.state = step(
             self.state,
@@ -661,6 +749,7 @@ class ShardedAccumulator(Accumulator):
 
     def gather(self, slots: np.ndarray,
                materialize: bool = True) -> List[np.ndarray]:
+        self.flush()
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
         self._segment_multiset = None
@@ -727,6 +816,7 @@ class ShardedAccumulator(Accumulator):
         return [to_host(o)[: len(slots)] for o in outs]
 
     def reset_slots(self, slots: np.ndarray):
+        self.flush()
         self._drop_udaf_slots(slots)
         if len(slots) == 0 or not self.phys:
             return
@@ -762,6 +852,7 @@ class ShardedAccumulator(Accumulator):
         )
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
+        self.flush()
         values = self._restore_udaf_cols(slots, values)
         if len(slots) == 0 or not self.phys:
             return

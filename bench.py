@@ -134,8 +134,9 @@ def child(events: int, backend: str, query: str = "q5",
     """Run one nexmark query; print 'RESULT <events/sec> <rows>'. With
     mesh_devices=N the window aggregates run on the N-device mesh
     execution path (ShardedAccumulator + in-step all_to_all) and a
-    'MESHSTATS <rows_sent> <rows_padded>' line reports the exchange's
-    padding overhead."""
+    'MESHSTATS <rows_sent> <rows_padded> <dispatches> <updates>' line
+    reports the exchange's padding overhead and the micro-batching
+    amortization (device steps per engine update call)."""
     import asyncio
     import time
 
@@ -183,7 +184,9 @@ def child(events: int, backend: str, query: str = "q5",
         from arroyo_tpu.parallel.sharded_state import MESH_STATS
 
         print(f"MESHSTATS {MESH_STATS['rows_sent']} "
-              f"{MESH_STATS['rows_padded']}", flush=True)
+              f"{MESH_STATS['rows_padded']} "
+              f"{MESH_STATS['dispatches']} "
+              f"{MESH_STATS['updates']}", flush=True)
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
@@ -344,12 +347,14 @@ def run_child(events: int, backend: str, timeout: float, env=None,
                       "secs": float(parts[3])}
         elif line.startswith("MESHSTATS "):
             parts = line.split()
-            stats = (int(parts[1]), int(parts[2]))
+            stats = tuple(int(p) for p in parts[1:])
     if result is None:
         sys.stderr.write(out.stderr[-2000:] + "\n")
         return None
     if stats is not None:
-        result["rows_sent"], result["rows_padded"] = stats
+        result["rows_sent"], result["rows_padded"] = stats[0], stats[1]
+        if len(stats) >= 4:
+            result["dispatches"], result["updates"] = stats[2], stats[3]
     return result
 
 
@@ -513,6 +518,11 @@ def main():
             sides["mesh_padding_ratio"] = round(
                 r["rows_padded"] / max(1, shipped), 3
             )
+            if "dispatches" in r:
+                # device steps per engine update call: the micro-batching
+                # amortization (tpu.mesh_flush_rows)
+                sides["mesh_dispatches"] = r["dispatches"]
+                sides["mesh_updates"] = r["updates"]
     # end-to-end latency (realtime q5; includes the source watermark delay)
     lat_cmd = [sys.executable, os.path.abspath(__file__),
                "--latency-child", side_backend,

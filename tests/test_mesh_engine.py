@@ -407,3 +407,61 @@ def test_global_session_window_salted_mesh():
     assert len(results) == 1
     assert results[0]["cnt"] == 3000
     assert results[0]["total"] == sum(range(3000))
+
+
+SALTED_HOST_STATE = (
+    IMPULSE_DDL
+    + """
+    SELECT tumble(interval '2 millisecond') as w,
+           count(*) as cnt,
+           count(DISTINCT counter % 50) as dcnt,
+           median(counter) as med,
+           max(counter) as hi
+    FROM impulse
+    GROUP BY 1;
+    """
+)
+
+
+def test_mesh_salted_host_state_aggregates():
+    """Salted mesh aggregation with HOST-STATE specs (count DISTINCT
+    multiset, median buffer): the window itself is the only group key,
+    so the planner marks mesh_salted; host stores are keyed by global
+    slot and must produce the same answer as the host run (round-4
+    verdict: salting excluded host-state aggregates)."""
+    _require_devices(4)
+    host = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=0)
+    mesh = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=4)
+    assert host and mesh == host
+
+
+def test_mesh_microbatch_flush_boundaries():
+    """Micro-batched mesh updates (tpu.mesh_flush_rows) must flush at
+    every state read: tiny flush threshold vs giant threshold produce
+    identical output (the giant one only ever flushes via gather)."""
+    _require_devices(4)
+    with update(tpu={"mesh_flush_rows": 0}):
+        immediate = run_rows(TUMBLE_AGG, parallelism=1, mesh_devices=4)
+    with update(tpu={"mesh_flush_rows": 1 << 30}):
+        deferred = run_rows(TUMBLE_AGG, parallelism=1, mesh_devices=4)
+    assert immediate and deferred == immediate
+
+
+def test_mesh_session_slot_pool_balance():
+    """The session operator's block-refilled slot pool must keep mesh
+    placement balanced: allocations from MeshSlotDirectory.alloc_slots
+    land round-robin across shards."""
+    import numpy as np
+
+    from arroyo_tpu.parallel.sharded_state import STRIDE, MeshSlotDirectory
+
+    d = MeshSlotDirectory(4)
+    slots = d.alloc_slots(64, shard_hint=3)
+    shards = np.asarray(slots) // STRIDE
+    counts = np.bincount(shards, minlength=4)
+    assert counts.tolist() == [16, 16, 16, 16]
+    # freed slots recycle within their shard
+    for s in slots[:8]:
+        d.free_slot(int(s))
+    again = d.alloc_slots(8, shard_hint=0)
+    assert sorted(np.asarray(again) // STRIDE) == sorted(shards[:8])

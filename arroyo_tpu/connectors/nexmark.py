@@ -97,24 +97,32 @@ def _u01(ns, salt: int) -> np.ndarray:
     """Deterministic per-sequence-number uniform [0,1): counter-based via
     splitmix64, so scalar and vectorized paths produce IDENTICAL events for
     the same n regardless of batching."""
+    return _u01_multi(ns, (salt,))[0]
+
+
+def _u01_multi(ns, salts) -> np.ndarray:
+    """All of a row-builder's uniforms in ONE broadcasted splitmix64 pass
+    ((k, n) output, bit-identical to per-salt _u01 calls): the per-field
+    hash was ~20 numpy dispatch chains per generated batch — the largest
+    remaining generator cost in the round-4 profile."""
     from ..types import _splitmix64
 
     arr = np.asarray(ns, dtype=np.uint64)
+    s = np.asarray(salts, dtype=np.uint64)[:, None]
     with np.errstate(over="ignore"):
-        h = _splitmix64(arr ^ np.uint64(salt))
+        h = _splitmix64(arr[None, :] ^ s)
     return h.astype(np.float64) / float(1 << 64)
 
 
 def _person_fields(ns):
     """Vectorized person field generation (counter-based, deterministic)."""
     ns = np.asarray(ns, dtype=np.int64)
-    first = (_u01(ns, 0xE1) * len(_FIRST)).astype(np.int64)
-    last = (_u01(ns, 0xE2) * len(_LAST)).astype(np.int64)
-    city = (_u01(ns, 0xE3) * len(_CITIES)).astype(np.int64)
-    state = (_u01(ns, 0xE4) * len(_STATES)).astype(np.int64)
-    cc = [
-        (_u01(ns, 0xE5 + j) * 10000).astype(np.int64) for j in range(4)
-    ]
+    u = _u01_multi(ns, (0xE1, 0xE2, 0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8))
+    first = (u[0] * len(_FIRST)).astype(np.int64)
+    last = (u[1] * len(_LAST)).astype(np.int64)
+    city = (u[2] * len(_CITIES)).astype(np.int64)
+    state = (u[3] * len(_STATES)).astype(np.int64)
+    cc = [(u[4 + j] * 10000).astype(np.int64) for j in range(4)]
     return first, last, city, state, cc
 
 
@@ -123,20 +131,19 @@ def _auction_fields(ns):
     ns = np.asarray(ns, dtype=np.int64)
     epoch = ns // PROPORTION_DENOMINATOR
     last_person = FIRST_PERSON_ID + epoch
-    hot = _u01(ns, 0xF1) < (HOT_SELLER_RATIO - 1) / HOT_SELLER_RATIO
+    u = _u01_multi(ns, (0xF1, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6))
+    hot = u[0] < (HOT_SELLER_RATIO - 1) / HOT_SELLER_RATIO
     cold = FIRST_PERSON_ID + (
-        _u01(ns, 0xF2) * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
+        u[1] * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
     ).astype(np.int64)
     seller = np.where(
         hot, (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO, cold
     )
     seller = np.maximum(seller, FIRST_PERSON_ID)
-    initial = 1 + (_u01(ns, 0xF3) * 100).astype(np.int64)
-    reserve = initial + (_u01(ns, 0xF4) * 100).astype(np.int64)
-    expires_s = 1 + (_u01(ns, 0xF5) * 9).astype(np.int64)
-    category = FIRST_CATEGORY_ID + (
-        _u01(ns, 0xF6) * NUM_CATEGORIES
-    ).astype(np.int64)
+    initial = 1 + (u[2] * 100).astype(np.int64)
+    reserve = initial + (u[3] * 100).astype(np.int64)
+    expires_s = 1 + (u[4] * 9).astype(np.int64)
+    category = FIRST_CATEGORY_ID + (u[5] * NUM_CATEGORIES).astype(np.int64)
     return seller, initial, reserve, expires_s, category
 
 
@@ -164,17 +171,18 @@ def _bid_fields(ns):
     epoch = ns // PROPORTION_DENOMINATOR
     last_auction = _last_auction_ids(ns)
     last_person = FIRST_PERSON_ID + epoch
-    hot = _u01(ns, 0xA1) < (HOT_AUCTION_RATIO - 1) / HOT_AUCTION_RATIO
+    u = _u01_multi(ns, (0xA1, 0xA2, 0xB1, 0xB2, 0xC1, 0xD1))
+    hot = u[0] < (HOT_AUCTION_RATIO - 1) / HOT_AUCTION_RATIO
     cold = FIRST_AUCTION_ID + (
-        _u01(ns, 0xA2) * np.maximum(last_auction - FIRST_AUCTION_ID + 1, 1)
+        u[1] * np.maximum(last_auction - FIRST_AUCTION_ID + 1, 1)
     ).astype(np.int64)
     auction = np.where(
         hot, (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO, cold
     )
     auction = np.maximum(auction, FIRST_AUCTION_ID)
-    hot_b = _u01(ns, 0xB1) < (HOT_BIDDER_RATIO - 1) / HOT_BIDDER_RATIO
+    hot_b = u[2] < (HOT_BIDDER_RATIO - 1) / HOT_BIDDER_RATIO
     cold_b = FIRST_PERSON_ID + (
-        _u01(ns, 0xB2) * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
+        u[3] * np.maximum(last_person - FIRST_PERSON_ID + 1, 1)
     ).astype(np.int64)
     bidder = np.where(
         hot_b, (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1,
@@ -182,8 +190,8 @@ def _bid_fields(ns):
     )
     bidder = np.maximum(bidder, FIRST_PERSON_ID)
     # canonical Nexmark price distribution: 10^(r*6) * 100
-    price = (100.0 * 10.0 ** (_u01(ns, 0xC1) * 6.0)).astype(np.int64)
-    channel = (_u01(ns, 0xD1) * len(_CHANNELS)).astype(np.int64)
+    price = (100.0 * 10.0 ** (u[4] * 6.0)).astype(np.int64)
+    channel = (u[5] * len(_CHANNELS)).astype(np.int64)
     return auction, bidder, price, channel
 
 
@@ -307,14 +315,20 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
         out[idx] = vals
         return out
 
-    def _scat_s(idx, vals):
-        out = np.full(n, "", dtype=object)
-        out[idx] = vals
-        return out
+    def _expand(small: "pa.StructArray", idx: np.ndarray) -> "pa.Array":
+        """Expand a subset-size struct to full batch width with one take:
+        null indices become null rows — replaces per-field full-width
+        scatters (persons/auctions are ~4% of events but paid full-n
+        object-array scatters per string field)."""
+        pos = np.zeros(n, dtype=np.int64)
+        pos[idx] = np.arange(len(idx))
+        keep = np.zeros(n, dtype=bool)
+        keep[idx] = True
+        return small.take(pa.array(pos, mask=~keep))
 
     # persons/auctions share the vectorized field helpers with event()
-    # (bit-identical) and, like bids, build their struct children as flat
-    # arrays with a validity mask — no python dict per row
+    # (bit-identical); struct children are built at SUBSET size and
+    # expanded to batch width by one take with null indices
     pi = np.nonzero(is_person)[0]
     person_arr = pa.nulls(n, type=PERSON_T)
     if len(pi):
@@ -332,27 +346,23 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
             f"{a:04d} {b:04d} {c:04d} {d:04d}"
             for a, b, c, d in zip(*(x.tolist() for x in cc))
         ]
-        p_valid = np.zeros(n, dtype=bool)
-        p_valid[pi] = True
-        person_arr = pa.StructArray.from_arrays(
-            [
-                pa.array(_scat_i(pi, ids)),
-                pa.array(_scat_s(pi, names), type=pa.string()),
-                pa.array(_scat_s(pi, emails), type=pa.string()),
-                pa.array(_scat_s(pi, ccs), type=pa.string()),
-                pa.array(
-                    _scat_s(pi, [_CITIES[i] for i in city.tolist()]),
-                    type=pa.string(),
-                ),
-                pa.array(
-                    _scat_s(pi, [_STATES[i] for i in state.tolist()]),
-                    type=pa.string(),
-                ),
-                pa.array(np.where(p_valid, ts, 0)).cast(pa.timestamp("ns")),
-                _empty_str_col(n),
-            ],
-            fields=list(PERSON_T),
-            mask=pa.array(~p_valid),
+        person_arr = _expand(
+            pa.StructArray.from_arrays(
+                [
+                    pa.array(ids),
+                    pa.array(names, type=pa.string()),
+                    pa.array(emails, type=pa.string()),
+                    pa.array(ccs, type=pa.string()),
+                    pa.array([_CITIES[i] for i in city.tolist()],
+                             type=pa.string()),
+                    pa.array([_STATES[i] for i in state.tolist()],
+                             type=pa.string()),
+                    pa.array(ts[pi]).cast(pa.timestamp("ns")),
+                    _empty_str_col(len(pi)),
+                ],
+                fields=list(PERSON_T),
+            ),
+            pi,
         )
     ai = np.nonzero(~is_bid & ~is_person)[0]
     auction_arr = pa.nulls(n, type=AUCTION_T)
@@ -360,38 +370,29 @@ def gen_batch(ns: np.ndarray, ts: np.ndarray) -> "pa.RecordBatch":
         ans = ns[ai]
         seller, initial, reserve, expires_s, category = _auction_fields(ans)
         aids = _last_auction_ids(ans)
-        a_valid = np.zeros(n, dtype=bool)
-        a_valid[ai] = True
         aid_list = aids.tolist()
-        auction_arr = pa.StructArray.from_arrays(
-            [
-                pa.array(_scat_i(ai, aids)),
-                pa.array(
-                    _scat_s(ai, [f"item-{a}" for a in aid_list]),
-                    type=pa.string(),
-                ),
-                pa.array(
-                    _scat_s(
-                        ai,
+        auction_arr = _expand(
+            pa.StructArray.from_arrays(
+                [
+                    pa.array(aids),
+                    pa.array([f"item-{a}" for a in aid_list],
+                             type=pa.string()),
+                    pa.array(
                         [f"description of item {a}" for a in aid_list],
+                        type=pa.string(),
                     ),
-                    type=pa.string(),
-                ),
-                pa.array(_scat_i(ai, initial)),
-                pa.array(_scat_i(ai, reserve)),
-                pa.array(np.where(a_valid, ts, 0)).cast(pa.timestamp("ns")),
-                pa.array(
-                    _scat_i(
-                        ai,
-                        ts[ai] + expires_s * 1_000_000_000,
-                    )
-                ).cast(pa.timestamp("ns")),
-                pa.array(_scat_i(ai, seller)),
-                pa.array(_scat_i(ai, category)),
-                _empty_str_col(n),
-            ],
-            fields=list(AUCTION_T),
-            mask=pa.array(~a_valid),
+                    pa.array(initial),
+                    pa.array(reserve),
+                    pa.array(ts[ai]).cast(pa.timestamp("ns")),
+                    pa.array(ts[ai] + expires_s * 1_000_000_000).cast(
+                        pa.timestamp("ns")),
+                    pa.array(seller),
+                    pa.array(category),
+                    _empty_str_col(len(ai)),
+                ],
+                fields=list(AUCTION_T),
+            ),
+            ai,
         )
     bi = np.nonzero(is_bid)[0]
     bid_arr = pa.nulls(n, type=BID_T)

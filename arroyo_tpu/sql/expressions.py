@@ -667,6 +667,33 @@ def bind_scalar_function(expr: FuncCall, scope: Scope) -> BoundExpr:
 # ---------------------------------------------------------------------------
 
 
+class _LazyFilteredBatch:
+    """Duck-typed RecordBatch view whose columns are filtered ON DEMAND.
+
+    CompiledProjection predicates used to filter the whole batch before
+    projecting — paying the filter kernel for every column, including
+    wide struct columns the projection never reads (nexmark batches
+    carry person+auction+bid structs; q5/q1 read only `bid`). This view
+    exposes just the surface bound expressions use (column(i)/num_rows/
+    schema) and filters each accessed column once, lazily."""
+
+    __slots__ = ("_batch", "_mask", "_cols", "num_rows", "schema")
+
+    def __init__(self, batch: pa.RecordBatch, mask, num_rows: int):
+        self._batch = batch
+        self._mask = mask
+        self._cols = {}
+        self.num_rows = num_rows
+        self.schema = batch.schema
+
+    def column(self, i: int):
+        c = self._cols.get(i)
+        if c is None:
+            c = self._batch.column(i).filter(self._mask)
+            self._cols[i] = c
+        return c
+
+
 class CompiledProjection:
     """Projection (+ optional pre-filter): the runtime form handed to
     ARROW_VALUE operators."""
@@ -679,10 +706,12 @@ class CompiledProjection:
 
     def __call__(self, batch: pa.RecordBatch) -> Optional[pa.RecordBatch]:
         if self.predicate is not None:
-            mask = self.predicate.eval(batch)
-            batch = batch.filter(mask)
-            if batch.num_rows == 0:
+            mask = pc.fill_null(self.predicate.eval(batch), False)
+            kept = pc.sum(mask).as_py() or 0
+            if kept == 0:
                 return None
+            if kept < batch.num_rows:
+                batch = _LazyFilteredBatch(batch, mask, kept)
         arrays = []
         for e, f in zip(self.exprs, self.out_schema):
             arr = e.eval(batch)

@@ -180,12 +180,15 @@ class ApiServer:
             if par < 1 or par > 128:
                 return error(400, "parallelism must be in [1, 128]")
             p = self.db.get_pipeline(pid)
-            self.db.set_pipeline_parallelism(pid, par)
             if (stop in (None, "none") and self._live_jobs(pid)
                     and par != p["parallelism"]):
                 # rescale: checkpoint-stop the running job, then resubmit
                 # at the new parallelism (restores the pipeline's latest
-                # checkpoint — key-range state sharding re-reads)
+                # checkpoint — key-range state sharding re-reads). The DB
+                # records the new parallelism only AFTER the stop
+                # succeeds: on the 409 path the job keeps running at the
+                # old parallelism and the record must keep saying so
+                # (ADVICE r4).
                 await self._stop_pipeline_jobs(pid, "checkpoint")
                 if self._live_jobs(pid):
                     # the stop timed out: running a second job against
@@ -193,7 +196,10 @@ class ApiServer:
                     return error(
                         409, "running job did not stop; rescale aborted"
                     )
+                self.db.set_pipeline_parallelism(pid, par)
                 await self._submit_pipeline_job(pid, p["query"], par)
+            else:
+                self.db.set_pipeline_parallelism(pid, par)
         return json_response(self.db.get_pipeline(pid))
 
     async def restart_pipeline(self, request: web.Request):

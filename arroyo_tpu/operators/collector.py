@@ -73,8 +73,14 @@ class Collector:
         self._msg_counter = MESSAGES_SENT.labels(job=job_id, task=task_id)
         self._bytes_counter = BYTES_SENT.labels(job=job_id, task=task_id)
         self._bp_gauge = BACKPRESSURE.labels(job=job_id, task=task_id)
+        self._bp_tick = 0
         # sink-side hook: engine-level capture of terminal output (preview)
         self.collected: Optional[list] = None
+
+    # backpressure needs sampling granularity, not per-batch accuracy:
+    # recomputing the max over every out-queue on every collect() added a
+    # python generator walk to the hottest path (ADVICE r4)
+    _BP_SAMPLE_EVERY = 16
 
     async def collect(self, batch: pa.RecordBatch):
         if batch.num_rows == 0:
@@ -84,12 +90,14 @@ class Collector:
         self._bytes_counter.inc(batch_bytes(batch))
         for edge in self.edges:
             await edge.send_batch(batch)
-        # post-send occupancy of the most-loaded out queue: 1.0 means the
-        # next send blocks (downstream is the bottleneck)
-        self._bp_gauge.set(max(
-            (q.fullness() for e in self.edges for q in e.queues),
-            default=0.0,
-        ))
+        self._bp_tick += 1
+        if self._bp_tick == 1 or self._bp_tick % self._BP_SAMPLE_EVERY == 0:
+            # post-send occupancy of the most-loaded out queue: 1.0 means
+            # the next send blocks (downstream is the bottleneck)
+            self._bp_gauge.set(max(
+                (q.fullness() for e in self.edges for q in e.queues),
+                default=0.0,
+            ))
 
     async def broadcast(self, signal: SignalMessage):
         for edge in self.edges:

@@ -62,6 +62,10 @@ class UpdatingJoinOperator(Operator):
         # path: (key pa arrays, payload python column lists); rebuilt
         # lazily when that side's state has mutated
         self._col_cache: List[Optional[tuple]] = [None, None]
+        # per side: list (per key col) of arrow chunks mirroring the
+        # python key lists, plus the types they were built with
+        self._key_arr_cache: List[Optional[list]] = [None, None]
+        self._key_arr_types: List[Optional[list]] = [None, None]
         # sticky per-side flag: a null join key ever stored disables the
         # bulk path (per-row null semantics are authoritative) without
         # paying a store scan per batch; conservatively never cleared
@@ -248,8 +252,10 @@ class UpdatingJoinOperator(Operator):
         The mirror is plain python column lists: rebuilt with one
         O(store) pass after per-row mutations, EXTENDED in place by the
         bulk path's own appends (the common all-append stream never
-        rebuilds). Arrow key arrays are materialized per call — C-speed
-        conversion, no python loop."""
+        rebuilds). Arrow key arrays are cached as CHUNKS alongside the
+        lists — the steady all-append state appends one chunk per batch
+        instead of reconverting the whole store every call (ADVICE r4:
+        the O(store) pa.array conversion dominated large stores)."""
         if self._col_cache[other] is None:
             store = self.state[other]
             n_fields = len(
@@ -264,16 +270,29 @@ class UpdatingJoinOperator(Operator):
                     for j in range(n_fields):
                         pay_cols[j].append(r[j])
             self._col_cache[other] = (key_cols, pay_cols)
+            self._key_arr_cache[other] = None  # chunks rebuild below
         key_cols, pay_cols = self._col_cache[other]
         # key column types from the batch's key columns so the probe
         # compares like with like (ints stay ints, strings strings)
         names = batch.schema.names
-        arrays = {}
+        types = []
         for i in range(self.n_keys):
             t = batch.schema.field(names.index(f"__key{i}")).type
             if pa.types.is_timestamp(t):
                 t = pa.int64()  # _norm stores int nanos
-            arrays[f"__key{i}"] = pa.array(key_cols[i], type=t)
+            types.append(t)
+        if (self._key_arr_cache[other] is None
+                or self._key_arr_types[other] != types):
+            self._key_arr_cache[other] = [
+                [pa.array(key_cols[i], type=types[i])]
+                for i in range(self.n_keys)
+            ]
+            self._key_arr_types[other] = types
+        arrays = {
+            f"__key{i}": pa.chunked_array(self._key_arr_cache[other][i],
+                                          type=types[i])
+            for i in range(self.n_keys)
+        }
         return pa.table(arrays), pay_cols
 
     def _assemble_bulk(self, batch, side, bi, si, other_payload_cols, ts):
@@ -347,6 +366,25 @@ class UpdatingJoinOperator(Operator):
                 ck[i].extend(key_lists[i])
             for j in range(len(pay_lists)):
                 cp[j].extend(pay_lists[j])
+            kac = self._key_arr_cache[side]
+            if kac is not None:
+                # one appended arrow chunk per batch keeps the chunked
+                # key arrays in lockstep with the python lists; a
+                # cross-side type mismatch (no key coercion between
+                # sides) must degrade to a rebuild, not kill the task
+                try:
+                    for i in range(self.n_keys):
+                        kac[i].append(pa.array(
+                            key_lists[i], type=self._key_arr_types[side][i]
+                        ))
+                        if len(kac[i]) > 64:
+                            # bound chunk count (and the per-probe concat
+                            # cost) on long all-append streams
+                            kac[i] = [
+                                pa.chunked_array(kac[i]).combine_chunks()
+                            ]
+                except (pa.ArrowInvalid, pa.ArrowTypeError):
+                    self._key_arr_cache[side] = None
 
     # join-delta helpers: rows are (key, left_payload|None, right_payload|None)
 

@@ -208,7 +208,8 @@ def latency_child(rate: int, seconds: float, backend: str) -> None:
     events = int(rate * seconds)
     start_ns = time.time_ns()
     sql = QUERIES["q5"].format(rate=rate, events=events)
-    assert "start_time = '0'" in sql, "latency bench: DDL shape changed"
+    if "start_time = '0'" not in sql:  # not assert: stripped under -O
+        raise ValueError("latency bench: DDL shape changed")
     sql = sql.replace(
         "start_time = '0'",
         f"start_time = '{start_ns}', realtime = 'true'",
@@ -262,14 +263,16 @@ def latency_distributed(rate: int, seconds: float,
         # no explicit start_time: the source anchors event time at its
         # OWN start, so multi-second distributed startup (process spawn,
         # plan compile) doesn't masquerade as window latency
-        assert "start_time = '0'" in sql, "latency bench: DDL shape changed"
+        if "start_time = '0'" not in sql:  # not assert: stripped under -O
+            raise ValueError("latency bench: DDL shape changed")
         sql = sql.replace("start_time = '0'", "realtime = 'true'")
         sink_ddl = (
             "CREATE TABLE latsink (auction BIGINT, num BIGINT) WITH ("
             f"connector = 'latency_file', path = '{lat_path}', "
             "type = 'sink');\n"
         )
-        assert "SELECT AuctionBids.auction" in sql
+        if "SELECT AuctionBids.auction" not in sql:
+            raise ValueError("latency bench: q5 SELECT shape changed")
         sql = sql.replace(
             "SELECT AuctionBids.auction",
             sink_ddl + "INSERT INTO latsink SELECT AuctionBids.auction",
@@ -323,6 +326,35 @@ def latency_distributed(rate: int, seconds: float,
                 float(np.percentile(arr, 99)), len(arr))
 
 
+def run_median(events: int, backend: str, timeout: float, env=None,
+               query: str = "q5", mesh_devices: int = 0,
+               force_device_join: bool = False, n: int = 3):
+    """Median-of-n child runs with dispersion (VERDICT r4 item 5: the
+    single-core bench host shows ±15%+ run-to-run variance, so a single
+    shot can't support round-over-round deltas). Returns the median
+    run's dict with eps_runs (sorted) and eps_spread_pct added; None if
+    every run failed."""
+    runs = []
+    for _ in range(max(1, n)):
+        r = run_child(events, backend, timeout, env=env, query=query,
+                      mesh_devices=mesh_devices,
+                      force_device_join=force_device_join)
+        if r is not None:
+            runs.append(r)
+    if not runs:
+        return None
+    runs.sort(key=lambda r: r["eps"])
+    # lower median: with an even survivor count (a child run failed),
+    # the upper-middle pick would report the BEST case exactly in the
+    # flaky scenarios this dispersion machinery guards against
+    med = runs[(len(runs) - 1) // 2]
+    med["eps_runs"] = [round(r["eps"], 1) for r in runs]
+    med["eps_spread_pct"] = round(
+        100.0 * (runs[-1]["eps"] - runs[0]["eps"]) / max(med["eps"], 1e-9), 1
+    )
+    return med
+
+
 def run_child(events: int, backend: str, timeout: float, env=None,
               query: str = "q5", mesh_devices: int = 0,
               force_device_join: bool = False):
@@ -372,7 +404,12 @@ def main():
     ap.add_argument("--force-device-join", action="store_true")
     ap.add_argument("--latency-child", choices=["numpy", "jax"])
     ap.add_argument("--latency-rate", type=int, default=50_000)
-    ap.add_argument("--latency-seconds", type=float, default=12.0)
+    # 24s realtime: ~12 hop-window closings per run so the latency
+    # percentiles rest on >= 20 samples (VERDICT r4 item 7)
+    ap.add_argument("--latency-seconds", type=float, default=24.0)
+    # median-of-n for every CPU measurement (single-shot numbers on the
+    # 1-core bench host swing ±15%+; VERDICT r4 item 5)
+    ap.add_argument("--repeats", type=int, default=3)
     args = ap.parse_args()
     if args.latency_child:
         latency_child(args.latency_rate, args.latency_seconds,
@@ -385,8 +422,11 @@ def main():
 
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
-    baseline = run_child(args.events, "numpy", args.timeout, env=cpu_env,
-                         force_device_join=args.force_device_join)
+    baseline = run_median(args.events, "numpy", args.timeout, env=cpu_env,
+                          force_device_join=args.force_device_join,
+                          n=args.repeats)
+    # the live device path stays single-shot: through the TPU relay each
+    # child pays ~20-40s/program compiles and grants are scarce
     device = run_child(args.events, "jax", args.timeout,
                        force_device_join=args.force_device_join)
     # The axon relay is intermittently wedged; tools/tpu_probe_daemon.py
@@ -481,11 +521,14 @@ def main():
     sides = {}
     for q in ("q1", "q7", "q8", "qu"):
         # half the events: side metrics, not the headline measurement
-        r = run_child(args.events // 2, side_backend, args.timeout,
-                      env=side_env, query=q,
-                      force_device_join=args.force_device_join)
+        r = run_median(args.events // 2, side_backend, args.timeout,
+                       env=side_env, query=q,
+                       force_device_join=args.force_device_join,
+                       n=args.repeats if side_backend == "numpy" else 1)
         # 0 = that query failed/timed out (distinguishable from "not run")
         sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
+        if r is not None and "eps_runs" in r:
+            sides[f"{q}_eps_runs"] = r["eps_runs"]
     # mesh execution path: q5 on an N-virtual-device CPU mesh (the
     # all_to_all + ShardedAccumulator path the dryrun only
     # correctness-checks). Quarter events: side metric, and the CPU
@@ -506,11 +549,15 @@ def main():
         mesh_env["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={args.mesh}"
         ).strip()
-        r = run_child(args.events // 4, "jax", args.timeout, env=mesh_env,
-                      mesh_devices=args.mesh)
+        # median-of-n; the persistent XLA cache makes runs 2..n warm, so
+        # the median reflects steady-state rather than compile time
+        r = run_median(args.events // 4, "jax", args.timeout, env=mesh_env,
+                       mesh_devices=args.mesh, n=args.repeats)
         sides[f"q5_mesh{args.mesh}_eps"] = (
             round(r["eps"], 1) if r is not None else 0
         )
+        if r is not None and "eps_runs" in r:
+            sides[f"q5_mesh{args.mesh}_eps_runs"] = r["eps_runs"]
         if r is not None and "rows_sent" in r:
             shipped = r["rows_sent"] + r["rows_padded"]
             sides["mesh_rows_sent"] = r["rows_sent"]
@@ -540,6 +587,7 @@ def main():
                 if rows != "0":
                     sides["q5_p50_ms"] = float(p50)
                     sides["q5_p99_ms"] = float(p99)
+                    sides["q5_lat_samples"] = int(rows)
                 got = True
         if not got:
             sys.stderr.write(out.stderr[-2000:] + "\n")
@@ -559,6 +607,7 @@ def main():
     if dist is not None:
         sides["q5_p50_ms_dist"] = round(dist[0], 1)
         sides["q5_p99_ms_dist"] = round(dist[1], 1)
+        sides["q5_lat_samples_dist"] = dist[2]
     baseline_real = baseline is not None
     if device is None:
         device = baseline
@@ -582,6 +631,12 @@ def main():
         if baseline_real else None,
         "baseline_cpu_eps": round(baseline["eps"], 1)
         if baseline_real else None,
+        # dispersion of the headline measurement (median-of-n runs,
+        # sorted) — present whenever the reported value came from the
+        # median path (CPU fallback reports the baseline median)
+        **({"value_runs": device.get("eps_runs"),
+            "value_spread_pct": device.get("eps_spread_pct")}
+           if isinstance(device, dict) and "eps_runs" in device else {}),
         "events": events,
         "result_rows": device["rows"],
         **sides,

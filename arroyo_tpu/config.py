@@ -134,6 +134,11 @@ class TpuConfig:
     # (collision odds ~n^2/2^65), so off by default; host python/native
     # C++ directories remain the exact fallbacks.
     device_directory: bool = False
+    # runtime collision evidence for the device directory: sample found
+    # rows each assign and verify their key against the host bookkeeping
+    # (a detected 64-bit merge raises instead of corrupting aggregates);
+    # <=64 host tuple compares per batch
+    device_directory_audit: bool = False
 
 
 @dataclasses.dataclass

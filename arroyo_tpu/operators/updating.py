@@ -48,6 +48,11 @@ class UpdatingAggregateOperator(WindowOperatorBase):
     # reverse index, items): ~3x cheaper per-batch assignment than the
     # python np.unique path for int64-able keys
     _native_ok = True
+    # the DEVICE directory grew the same surface in round 5 (slot-valued
+    # peek_bin, keys_for_slots, slots_for_keys, targeted remove) via its
+    # lazy host reverse index — steady-state assign stays a device
+    # searchsorted hit with zero host dict work
+    _device_ok = True
 
     def __init__(self, config: dict):
         super().__init__(config, "updating_aggregate")

@@ -121,9 +121,11 @@ class WindowOperatorBase(Operator):
     # with the slot-valued peek_bin / keys_for_slots / remove surface —
     # updating aggregates)
     _native_ok = False
-    # the DEVICE directory serves a narrower API (no remove /
-    # keys_for_slots; peek_bin without slot values), so its swap is
-    # gated separately
+    # the DEVICE directory now serves the full native surface (round 5:
+    # keys_for_slots, slots_for_keys, targeted remove, slot-valued
+    # peek_bin); the gate remains per-operator because the swap is only
+    # worthwhile where assignment is the hot path — session windows
+    # allocate slots imperatively and never call assign()
     _device_ok = False
     # operators whose state protocol is slot-based end to end can run on
     # the mesh-sharded accumulator (tumbling, sliding; session bookkeeping

@@ -100,11 +100,16 @@ def test_bin_entries_nondestructive():
 
 
 @pytest.mark.parametrize("golden", ["hourly_by_event_type",
-                                    "sliding_window_end", "nexmark_q5"])
+                                    "sliding_window_end", "nexmark_q5",
+                                    "updating_aggregate",
+                                    "filter_updating_aggregates",
+                                    "min_max_retracting"])
 def test_golden_queries_with_device_directory(golden, tmp_path):
     """Window pipelines with tpu.device_directory=True must reproduce the
-    committed golden outputs (tumbling, sliding, and the q5 hop+join
-    shape), checkpoint cycle included implicitly by slot reuse."""
+    committed golden outputs (tumbling, sliding, the q5 hop+join shape,
+    and — round 5 — the UPDATING aggregate subset riding the widened
+    directory surface: keys_for_slots, slot-valued peek_bin, targeted
+    remove), with the collision audit sampling every assign."""
     import asyncio
     import os
     import sys
@@ -121,6 +126,7 @@ def test_golden_queries_with_device_directory(golden, tmp_path):
     out = str(tmp_path / "out.json")
     sql = tg.load_query(qpath, out)
     with update(tpu={"enabled": True, "device_directory": True,
+                     "device_directory_audit": True,
                      "require_accelerator": False}):
         plan = plan_query(sql, parallelism=2)
 
@@ -132,3 +138,64 @@ def test_golden_queries_with_device_directory(golden, tmp_path):
     got = tg.canonicalize_output(out, sql)
     want = [line.strip() for line in open(gpath)]
     assert got == want
+
+
+def test_updating_surface_keys_for_slots_and_point_lookup():
+    """Round-5 widening: the device directory serves the updating
+    aggregate's surface — keys_for_slots, slots_for_keys, slot-valued
+    peek_bin — identically to the host directory."""
+    dev = DeviceSlotDirectory(n_keys=1)
+    bins = np.zeros(6, dtype=np.int64)
+    keys = np.array([10, 20, 30, 10, 20, 40])
+    slots = dev.assign(bins, [keys])
+    # reverse index: every slot maps back to its (bin, key)
+    entries = dev.keys_for_slots(np.unique(slots))
+    assert all(e is not None and e[0] == 0 for e in entries)
+    assert sorted(e[1][0] for e in entries) == [10, 20, 30, 40]
+    # unknown slot -> None
+    assert dev.keys_for_slots(np.array([99999]))[0] is None
+    # slot-valued peek: key -> slot agrees with assign
+    peek = dev.peek_bin(0)
+    assert peek[(10,)] == int(slots[0]) and peek[(40,)] == int(slots[5])
+    # point lookups resolve only present keys
+    got = dev.slots_for_keys(0, [(20,), (77,)])
+    assert got == {(20,): int(slots[1])}
+
+
+def test_updating_surface_targeted_remove():
+    """remove(bin, keys) drops exactly those groups (TTL eviction),
+    frees their slots for reuse, and keeps lookups for survivors."""
+    dev = DeviceSlotDirectory(n_keys=1)
+    bins = np.zeros(4, dtype=np.int64)
+    keys = np.array([1, 2, 3, 4])
+    slots = dev.assign(bins, [keys])
+    freed = dev.remove(0, [(2,), (4,)])
+    assert sorted(freed.tolist()) == sorted([int(slots[1]), int(slots[3])])
+    assert dev.n_live == 2
+    # removed keys re-assign into FRESH slots (reused ids allowed),
+    # survivors keep theirs
+    s2 = dev.assign(bins, [keys])
+    assert s2[0] == slots[0] and s2[2] == slots[2]
+    assert dev.n_live == 4
+    # removing every remaining key empties the bin
+    dev.remove(0, [(1,), (2,), (3,), (4,)])
+    assert dev.n_live == 0 and dev.peek_bin(0) is None
+
+
+def test_collision_audit_detects_merged_groups():
+    """tpu.device_directory_audit: a 64-bit hash collision (simulated by
+    corrupting the reverse hash index) must raise instead of silently
+    merging two groups' aggregates."""
+    from arroyo_tpu.config import update as cfg_update
+
+    with cfg_update(tpu={"device_directory_audit": True}):
+        dev = DeviceSlotDirectory(n_keys=1)
+    dev._audit = True
+    dev.assign(np.zeros(2, dtype=np.int64), [np.array([5, 6])])
+    # force the index to claim hash(bin0, key5) belongs to key 999 —
+    # exactly what a colliding group would observe on its lookup hit
+    dev._build_indexes()
+    h5 = dev._hash(np.zeros(1, dtype=np.int64), [np.array([5])])[0]
+    dev._hash_index[int(h5)] = (999,)
+    with pytest.raises(RuntimeError, match="collision"):
+        dev.assign(np.zeros(1, dtype=np.int64), [np.array([5])])

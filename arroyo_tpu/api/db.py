@@ -26,7 +26,7 @@ import uuid
 from pathlib import Path
 from typing import List, Optional
 
-MIGRATIONS = [
+_V1_TABLES = [
     """
     CREATE TABLE IF NOT EXISTS pipelines (
         id TEXT PRIMARY KEY,
@@ -80,6 +80,47 @@ MIGRATIONS = [
     )
     """,
 ]
+
+# Versioned, append-only migrations: each entry is (version,
+# [statements]). The applied version persists in schema_version; on open
+# only entries above the stored version run, in order — the reference
+# ships 32 numbered Postgres migrations + a parallel SQLite set
+# (arroyo-api/migrations/), and round 4 flagged the bare
+# CREATE-IF-NOT-EXISTS approach as breaking at the first schema change.
+# NEVER edit a shipped version; append a new one.
+MIGRATIONS = [
+    (1, _V1_TABLES),
+    (2, [
+        "CREATE INDEX IF NOT EXISTS idx_jobs_pipeline "
+        "ON jobs(pipeline_id)",
+    ]),
+]
+
+
+def apply_migrations(conn) -> int:
+    """Apply every migration above the stored schema version, in order;
+    returns the resulting version. Works over both the sqlite3 and the
+    postgres adapter connection (dict-like rows either way)."""
+    conn.execute(
+        "CREATE TABLE IF NOT EXISTS schema_version ("
+        "version INTEGER NOT NULL, applied_at REAL NOT NULL)"
+    )
+    row = conn.execute(
+        "SELECT MAX(version) AS v FROM schema_version"
+    ).fetchone()
+    current = (row["v"] if row is not None else None) or 0
+    for version, stmts in MIGRATIONS:
+        if version <= current:
+            continue
+        for s in stmts:
+            conn.execute(s)
+        conn.execute(
+            "INSERT INTO schema_version (version, applied_at) "
+            "VALUES (?, ?)",
+            (version, time.time()),
+        )
+        current = version
+    return current
 
 
 class _PgCursor:
@@ -173,8 +214,7 @@ class ApiDb:
             self.conn = _pg_conn if _pg_conn is not None else (
                 connect_postgres(dsn or path)
             )
-            for m in MIGRATIONS:
-                self.conn.execute(m)
+            apply_migrations(self.conn)
             self.conn.commit()
             return
         self.remote = None
@@ -203,8 +243,7 @@ class ApiDb:
         self.path = path
         self.conn = sqlite3.connect(path)
         self.conn.row_factory = sqlite3.Row
-        for m in MIGRATIONS:
-            self.conn.execute(m)
+        apply_migrations(self.conn)
         self.conn.commit()
 
     def _commit(self):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -393,7 +394,11 @@ class ApiServer:
         from ..engine import Engine
 
         pid = self.db.create_pipeline(body.get("name", "preview"), query, 1)
-        self.previews[pid["id"]] = {"rows": results, "done": False}
+        # mark in the DB so the TTL sweep can find preview rows whose
+        # registry entry is gone (cap eviction, process restart)
+        self.db.set_pipeline_state(pid["id"], "Preview")
+        self.previews[pid["id"]] = {"rows": results, "done": False,
+                                    "created": time.time()}
 
         async def run():
             eng = None
@@ -422,6 +427,55 @@ class ApiServer:
 
         asyncio.ensure_future(run())
         return json_response(pid)
+
+    def cleanup_previews(self, now: Optional[float] = None) -> int:
+        """TTL sweep over stale previews (reference: the controller
+        update loop cleans stale preview pipelines, arroyo-controller
+        lib.rs:600-706). Two sources: FINISHED registry entries past the
+        TTL, and DB rows in state 'Preview' past the TTL with no live
+        registry entry — those cover cap-evicted previews and previews
+        from a previous process (the registry is in-memory). Returns the
+        number removed."""
+        from ..config import config as config_fn
+
+        ttl = float(config_fn().api.preview_ttl or 0)
+        if ttl <= 0:
+            return 0
+        now = time.time() if now is None else now
+        stale = [
+            pid for pid, pv in self.previews.items()
+            if pv.get("done") and now - pv.get("created", now) > ttl
+        ]
+        try:
+            stale += [
+                p["id"] for p in self.db.list_pipelines()
+                if p.get("state") == "Preview"
+                and now - p.get("created_at", now) > ttl
+                # a LIVE registry entry means the preview may still be
+                # running; only its own done+TTL path may remove it
+                and p["id"] not in self.previews
+            ]
+        except Exception as e:  # noqa: BLE001 - sweep must not die
+            logger.warning("preview ttl: db scan failed: %s", e)
+        n = 0
+        for pid in dict.fromkeys(stale):
+            self.previews.pop(pid, None)
+            try:
+                self.db.delete_pipeline(pid)
+                n += 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("preview ttl: delete %s failed: %s", pid, e)
+        return n
+
+    async def preview_ttl_loop(self):
+        while True:
+            await asyncio.sleep(30.0)
+            try:
+                n = self.cleanup_previews()
+                if n:
+                    logger.info("preview ttl: removed %d stale previews", n)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("preview ttl sweep failed: %s", e)
 
     async def preview_output(self, request: web.Request):
         pv = self.previews.get(request.match_info["id"])
@@ -581,6 +635,14 @@ def build_app(controller: Optional[ControllerServer] = None,
 
     add_console_routes(app)
     app["api"] = api
+
+    async def _preview_ttl_ctx(app_):
+        task = asyncio.ensure_future(api.preview_ttl_loop())
+        yield
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    app.cleanup_ctx.append(_preview_ttl_ctx)
     return app
 
 

@@ -521,8 +521,47 @@ async function viewNewPipeline() {
       $("#result").textContent = e.message;
       return;
     }
-    for (let i = 0; i < 240; i++) {
+    // LIVE preview: tail rows over the output websocket as the engine
+    // emits them; polling remains the fallback when ws setup fails
+    // onerror AND onclose both fire on a failed socket: `settled`
+    // guarantees exactly one continuation (live finish OR poll fallback)
+    let settled = false;
+    const finish = async () => {
+      if (settled) return;
+      settled = true;
       const o = await GET(`/pipelines/preview/${p.id}/output`);
+      if (!o.done) return pollPreview(p.id); // ws dropped mid-preview
+      renderPreview(o.rows.slice(-60));
+      $("#result").textContent = o.error
+        ? o.error
+        : `preview: ${o.rows.length} rows (done)`;
+    };
+    const fallback = () => {
+      if (settled) return;
+      settled = true;
+      pollPreview(p.id);
+    };
+    try {
+      const proto = location.protocol === "https:" ? "wss" : "ws";
+      const ws = new WebSocket(
+        `${proto}://${location.host}` +
+          api(`/pipelines/preview/${p.id}/output/ws`)
+      );
+      const rows = [];
+      ws.onmessage = (ev) => {
+        rows.push(JSON.parse(ev.data));
+        renderPreview(rows.slice(-60));
+        $("#result").textContent = `preview: ${rows.length} rows (live)…`;
+      };
+      ws.onclose = () => finish();
+      ws.onerror = () => fallback();
+    } catch (e) {
+      fallback();
+    }
+  };
+  async function pollPreview(id) {
+    for (let i = 0; i < 240; i++) {
+      const o = await GET(`/pipelines/preview/${id}/output`);
       renderPreview(o.rows.slice(-60));
       $("#result").textContent = `preview: ${o.rows.length} rows` +
         (o.done ? " (done)" : "…");
@@ -532,7 +571,7 @@ async function viewNewPipeline() {
       }
       await new Promise((r) => setTimeout(r, 400));
     }
-  };
+  }
   function renderPreview(rows) {
     const t = $("#ptable");
     if (!t || !rows.length) return;

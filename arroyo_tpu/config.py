@@ -166,6 +166,11 @@ class ApiConfig:
     http_port: int = 8000
     bind_address: str = "127.0.0.1"
     run_http_port: int = 0
+    # finished preview pipelines (POST /pipelines/preview) are deleted —
+    # registry entry AND db row — once this old (reference: the
+    # controller update loop cleans stale previews, arroyo-controller
+    # lib.rs:600-706). 0 disables the sweep.
+    preview_ttl: float = 600.0
 
 
 @dataclasses.dataclass

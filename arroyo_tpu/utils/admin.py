@@ -1,11 +1,12 @@
-"""Per-process admin HTTP server: /status, /metrics, /debug/tasks.
+"""Per-process admin HTTP server: /status, /metrics, /debug/*.
 
 Capability parity with the reference's admin server
 (/root/reference/crates/arroyo-server-common/src/lib.rs start_admin_server:
 /status, /name, /metrics, /debug/pprof): every role (controller, worker,
-api) can expose liveness, Prometheus metrics, and a stack/task dump on a
-local port. The pprof heap/cpu endpoints map to Python equivalents — a
-live asyncio-task listing and a faulthandler thread-stack dump.
+api) can expose liveness, Prometheus metrics, a stack/task dump, and a
+windowed CPU profile capture (/debug/profile — the Python analog of the
+reference's /debug/pprof/profile flamegraph endpoint,
+arroyo-server-common/src/profile.rs:12-51) on a local port.
 """
 
 from __future__ import annotations
@@ -76,12 +77,46 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
             buf.write("\n")
         return web.Response(text=buf.getvalue(), content_type="text/plain")
 
+    profile_lock = asyncio.Lock()
+
+    async def debug_profile(request: web.Request):
+        """CPU profile capture over a sampling window (reference:
+        /debug/pprof/profile flamegraphs, arroyo-server-common
+        profile.rs:12-51). cProfile wraps the event-loop thread for
+        ?seconds=N (default 5, max 60) and returns the pstats table
+        sorted by ?sort= (tottime default) — round-4's perf work leaned
+        on ad-hoc cProfile runs; this standardizes the capture."""
+        import cProfile
+        import pstats
+
+        try:
+            seconds = min(float(request.query.get("seconds", 5)), 60.0)
+        except ValueError:
+            return web.Response(status=400, text="bad seconds\n")
+        sort = request.query.get("sort", "tottime")
+        if sort not in ("tottime", "cumulative", "ncalls"):
+            return web.Response(status=400, text="bad sort\n")
+        if profile_lock.locked():
+            return web.Response(status=409,
+                                text="profile already in progress\n")
+        async with profile_lock:
+            pr = cProfile.Profile()
+            pr.enable()
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                pr.disable()
+        buf = io.StringIO()
+        pstats.Stats(pr, stream=buf).sort_stats(sort).print_stats(60)
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
+
     app = web.Application()
     app.router.add_get("/status", status)
     app.router.add_get("/name", name)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)
+    app.router.add_get("/debug/profile", debug_profile)
     return app
 
 

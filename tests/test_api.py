@@ -659,3 +659,113 @@ def test_restart_resumes_from_checkpoint_lineage(tmp_path):
     assert rows == list(range(4000)), (
         f"restart lost/duplicated rows: {len(rows)} rows"
     )
+
+
+def test_preview_ttl_cleanup():
+    """Finished previews older than api.preview_ttl are swept — registry
+    entry AND pipeline/job rows (reference: controller update loop
+    preview cleanup, arroyo-controller lib.rs:600-706)."""
+    async def body(client, api, controller):
+        import time as _time
+
+        r = await client.post("/api/v1/pipelines/preview", json={
+            "query": (
+                "CREATE TABLE impulse (counter BIGINT UNSIGNED NOT NULL, "
+                "subtask_index BIGINT UNSIGNED NOT NULL) WITH ("
+                "connector='impulse', event_rate='1000', "
+                "message_count='50', start_time='0');"
+                "SELECT counter FROM impulse;"
+            ),
+            "timeout": 30,
+        })
+        assert r.status == 200
+        pid = (await r.json())["id"]
+        for _ in range(200):
+            if api.previews[pid]["done"]:
+                break
+            await asyncio.sleep(0.05)
+        assert api.previews[pid]["done"]
+        # young + finished: not swept
+        assert api.cleanup_previews() == 0
+        assert api.db.get_pipeline(pid) is not None
+        # stale + finished: swept from registry and db
+        from arroyo_tpu.config import config as config_fn
+
+        future = _time.time() + config_fn().api.preview_ttl + 1
+        assert api.cleanup_previews(now=future) == 1
+        assert pid not in api.previews
+        assert api.db.get_pipeline(pid) is None
+        # orphaned DB row (registry lost to cap-eviction or restart):
+        # the sweep finds it via its 'Preview' state
+        orphan = api.db.create_pipeline("preview", "SELECT 1", 1)
+        api.db.set_pipeline_state(orphan["id"], "Preview")
+        assert api.cleanup_previews(now=future) == 1
+        assert api.db.get_pipeline(orphan["id"]) is None
+        # a non-preview pipeline is never touched
+        keeper = api.db.create_pipeline("real", "SELECT 1", 1)
+        assert api.cleanup_previews(now=future) == 0
+        assert api.db.get_pipeline(keeper["id"]) is not None
+
+    with_client(body)
+
+
+def test_versioned_migrations():
+    """schema_version gates ordered DDL: fresh dbs land on the newest
+    version; a pre-versioning db (tables, no schema_version) upgrades in
+    place; reopening is a no-op."""
+    import sqlite3
+    import tempfile
+
+    from arroyo_tpu.api.db import MIGRATIONS, ApiDb, apply_migrations
+
+    latest = MIGRATIONS[-1][0]
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/api.db"
+        db = ApiDb(path)
+        row = db.conn.execute(
+            "SELECT MAX(version) AS v FROM schema_version").fetchone()
+        assert row["v"] == latest
+        db.create_pipeline("p", "SELECT 1", 1)
+        # reopen: no re-application, data intact
+        db2 = ApiDb(path)
+        assert len(db2.list_pipelines()) == 1
+        assert apply_migrations(db2.conn) == latest
+
+        # legacy db: v1 tables only, no schema_version — upgrade applies
+        # every version exactly once and the v2 index exists after
+        legacy = f"{td}/legacy.db"
+        conn = sqlite3.connect(legacy)
+        for _, stmts in MIGRATIONS[:1]:
+            for s in stmts:
+                conn.execute(s)
+        conn.commit()
+        conn.close()
+        db3 = ApiDb(legacy)
+        row = db3.conn.execute(
+            "SELECT COUNT(*) AS c FROM sqlite_master "
+            "WHERE name = 'idx_jobs_pipeline'").fetchone()
+        assert row["c"] == 1
+
+
+def test_admin_debug_profile():
+    """/debug/profile captures a windowed CPU profile (reference
+    /debug/pprof/profile, arroyo-server-common profile.rs:12-51)."""
+    from arroyo_tpu.utils.admin import build_admin_app
+
+    async def run():
+        app = build_admin_app("test")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/debug/profile?seconds=0.2")
+            assert r.status == 200
+            text = await r.text()
+            assert "function calls" in text and "tottime" in text
+            r = await client.get("/debug/profile?seconds=abc")
+            assert r.status == 400
+            r = await client.get("/debug/profile?sort=nope")
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(run())

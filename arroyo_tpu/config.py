@@ -64,6 +64,14 @@ class CheckpointConfig:
 class PipelineConfig:
     source_batch_size: int = 512
     source_batch_linger: float = 0.1  # seconds
+    # realtime sources pace generation in chunks of this many seconds;
+    # each chunk is a batch (and a watermark advance). Finer pacing only
+    # helps latency when the source runs OFF the shared event loop
+    # (distributed mode): single-process, 5 ms chunks measured WORSE
+    # p50/p99 than the 20 ms default because the extra wakeups contend
+    # with emission work — see BASELINE.md "Latency budget" before
+    # tuning this down.
+    realtime_chunk_seconds: float = 0.02
     queue_size: int = 64  # batches per edge queue
     queue_bytes: int = 32 * 2**20  # byte bound per edge queue
     chaining_enabled: bool = True

@@ -491,14 +491,18 @@ class NexmarkSource(SourceOperator):
         # vectorized chunked generation for BOTH modes (a scalar per-event
         # loop caps out around 50k events/s and falls seconds behind its own
         # event times, showing up as phantom end-to-end latency). Realtime
-        # paces ~20ms chunks against a schedule origin shifted by the
-        # restored index, so a checkpoint restore resumes at "now" instead
-        # of stalling for the entire pre-checkpoint runtime.
+        # paces pipeline.realtime_chunk_seconds chunks (default 20 ms)
+        # against a schedule origin shifted by the restored index, so a
+        # checkpoint restore resumes at "now" instead of stalling for the
+        # entire pre-checkpoint runtime.
         import numpy as np
 
         if self.realtime:
+            from ..config import config as config_fn
+
+            chunk_s = config_fn().pipeline.realtime_chunk_seconds
             chunk = max(1, min(ctx.batch_size,
-                               int(self.event_rate * 0.02 / p) or 1))
+                               int(self.event_rate * chunk_s / p) or 1))
             wall_start = (
                 time.monotonic() - (self.index * p) * nanos_per_event / 1e9
             )

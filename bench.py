@@ -404,9 +404,10 @@ def main():
     ap.add_argument("--force-device-join", action="store_true")
     ap.add_argument("--latency-child", choices=["numpy", "jax"])
     ap.add_argument("--latency-rate", type=int, default=50_000)
-    # 24s realtime: ~12 hop-window closings per run so the latency
-    # percentiles rest on >= 20 samples (VERDICT r4 item 7)
-    ap.add_argument("--latency-seconds", type=float, default=24.0)
+    # 36s realtime: ~17 hop-window closings x ~1.6 qualifying rows per
+    # window, so the latency percentiles rest on >= 20 samples (measured:
+    # 24s yields 18-19; VERDICT r4 item 7)
+    ap.add_argument("--latency-seconds", type=float, default=36.0)
     # median-of-n for every CPU measurement (single-shot numbers on the
     # 1-core bench host swing ±15%+; VERDICT r4 item 5)
     ap.add_argument("--repeats", type=int, default=3)

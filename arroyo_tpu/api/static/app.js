@@ -157,30 +157,65 @@ function rateSeries(series) {
   return out;
 }
 
-function sparkline(points, w, h) {
-  if (!points.length) return `<svg class="spark" width="${w}" height="${h}"></svg>`;
-  const vs = points.map((p) => p.v);
-  const max = Math.max(...vs, 1e-9);
-  const step = w / Math.max(points.length - 1, 1);
-  const path = points
-    .map(
-      (p, i) =>
-        `${i ? "L" : "M"}${(i * step).toFixed(1)},` +
-        `${(h - 3 - (p.v / max) * (h - 8)).toFixed(1)}`
-    )
-    .join(" ");
-  return (
-    `<svg class="spark" width="${w}" height="${h}">` +
-    `<path d="${path}" stroke="#58a6ff" fill="none" stroke-width="1.5"/>` +
-    `</svg>`
-  );
-}
-
 function fmt(v) {
   if (v >= 1e9) return (v / 1e9).toFixed(1) + "G";
   if (v >= 1e6) return (v / 1e6).toFixed(1) + "M";
   if (v >= 1e3) return (v / 1e3).toFixed(1) + "k";
   return v >= 100 ? v.toFixed(0) : v.toFixed(1);
+}
+
+/* full line chart with axes (reference webui: per-operator metric
+ * graphs, not sparklines): y gridlines + tick labels, start/end time
+ * labels, area fill, and per-sample hover titles. */
+function lineChart(points, w, h, unit) {
+  if (points.length < 2)
+    return (
+      `<svg class="chart" width="${w}" height="${h}">` +
+      `<text x="${w / 2}" y="${h / 2}" class="ax" text-anchor="middle">` +
+      `collecting…</text></svg>`
+    );
+  const padL = 42, padR = 8, padT = 6, padB = 16;
+  const iw = w - padL - padR, ih = h - padT - padB;
+  const vs = points.map((p) => p.v);
+  const max = Math.max(...vs, 1e-9);
+  const t0 = points[0].t, t1 = points[points.length - 1].t;
+  const X = (t) => padL + ((t - t0) / Math.max(t1 - t0, 1)) * iw;
+  const Y = (v) => padT + ih - (v / max) * ih;
+  let grid = "";
+  for (const frac of [0, 0.5, 1]) {
+    const y = (padT + ih - frac * ih).toFixed(1);
+    grid +=
+      `<line x1="${padL}" y1="${y}" x2="${w - padR}" y2="${y}" ` +
+      `class="grid"/>` +
+      `<text x="${padL - 4}" y="${+y + 3}" class="ax" ` +
+      `text-anchor="end">${fmt(max * frac)}${frac ? unit || "" : ""}</text>`;
+  }
+  const hhmmss = (t) => new Date(t).toISOString().slice(11, 19);
+  grid +=
+    `<text x="${padL}" y="${h - 3}" class="ax">${hhmmss(t0)}</text>` +
+    `<text x="${w - padR}" y="${h - 3}" class="ax" text-anchor="end">` +
+    `${hhmmss(t1)}</text>`;
+  const path = points
+    .map((p, i) => `${i ? "L" : "M"}${X(p.t).toFixed(1)},${Y(p.v).toFixed(1)}`)
+    .join(" ");
+  const area =
+    path +
+    ` L${X(t1).toFixed(1)},${(padT + ih).toFixed(1)}` +
+    ` L${X(t0).toFixed(1)},${(padT + ih).toFixed(1)} Z`;
+  let dots = "";
+  for (const p of points)
+    dots +=
+      `<circle cx="${X(p.t).toFixed(1)}" cy="${Y(p.v).toFixed(1)}" r="5" ` +
+      `class="pt"><title>${hhmmss(p.t)} — ${fmt(p.v)}${unit || ""}` +
+      `</title></circle>`;
+  return (
+    `<svg class="chart" width="${w}" height="${h}">` +
+    grid +
+    `<path d="${area}" class="area"/>` +
+    `<path d="${path}" class="line"/>` +
+    dots +
+    `</svg>`
+  );
 }
 
 /* ---------------------------------------------------------------- views */
@@ -393,15 +428,20 @@ async function viewPipelineDetail(id) {
       for (const [name, series] of Object.entries(groups)) {
         const isRate = name.includes("bytes") || name.includes("messages")
           || name.includes("batches") || name.includes("errors");
-        const rates = isRate ? rateSeries(series) : series;
+        const isPct = name === "backpressure";
+        let rates = isRate ? rateSeries(series) : series;
+        // one scale per cell: the gauge tile shows percent, so the
+        // chart's y axis must too
+        if (isPct) rates = rates.map((p) => ({ t: p.t, v: p.v * 100 }));
         const last = rates.length ? rates[rates.length - 1].v : 0;
-        const shown = name === "backpressure"
-          ? (last * 100).toFixed(0) + "%"
+        const shown = isPct
+          ? last.toFixed(0) + "%"
           : fmt(last) + (isRate ? "/s" : "");
+        const unit = isPct ? "%" : isRate ? "/s" : "";
         html +=
           `<div class="metric-cell"><div class="label">${esc(name)}</div>` +
           `<div class="value">${shown}</div>` +
-          sparkline(rates, 160, 36) + `</div>`;
+          lineChart(rates, 320, 96, unit) + `</div>`;
       }
       html += "</div>";
     }

@@ -195,3 +195,14 @@ def test_live_preview_contract():
     # renderPreview is fed from the ws message handler
     assert re.search(r"onmessage\s*=[^;]*renderPreview",
                      src, re.S | re.M) or "ws.onmessage" in src
+
+
+def test_metric_graph_contract():
+    """Round-5 UI depth: operator metrics render as axis-labeled line
+    charts (reference webui graphs), not bare sparklines."""
+    src = open(APP_JS).read()
+    assert "function lineChart" in src
+    assert "lineChart(rates" in src
+    assert "function sparkline" not in src  # dead path removed
+    css = open(os.path.join(os.path.dirname(APP_JS), "style.css")).read()
+    assert ".chart .grid" in css and ".chart .ax" in css

@@ -358,9 +358,10 @@ class UpdatingAggregateOperator(WindowOperatorBase):
 
     def _dirty_slot_map(self, key_set) -> dict:
         """slot per live key for the (usually small) dirty set — point
-        lookups when the directory supports them (python dict / native
-        C++ probe, O(dirty)); mesh directories fall back to a peek_bin
-        scan, acceptable at dryrun scale."""
+        lookups, O(dirty), on every directory tier (python dict / native
+        C++ probe / device bin index / mesh per-shard dispatch); the
+        peek_bin fallback remains for any directory without the
+        point-lookup surface."""
         lookup = getattr(self.dir, "slots_for_keys", None)
         if lookup is not None:
             return lookup(0, list(key_set))

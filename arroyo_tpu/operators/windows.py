@@ -168,6 +168,7 @@ class WindowOperatorBase(Operator):
             in_schema = ctx.in_schemas[0].schema
             self._key_types = [in_schema.field(i).type for i in self.key_cols]
             self._key_names = [in_schema.field(i).name for i in self.key_cols]
+            self._maybe_swap_mesh_native()
             if (
                 self._native_ok
                 and isinstance(self.dir, SlotDirectory)
@@ -193,13 +194,7 @@ class WindowOperatorBase(Operator):
                 if widths is not None:
                     # struct keys (window structs) flatten into their int64
                     # child words; everything rides the flat N-key table
-                    if any(pa.types.is_struct(t) for t in self._key_types):
-                        self._flat_widths = widths
-                        self._flat_offsets = [0]
-                        for w in widths:
-                            self._flat_offsets.append(
-                                self._flat_offsets[-1] + w
-                            )
+                    self._set_flat_layout(widths)
                     if use_device:
                         from ..ops.device_directory import (
                             DeviceSlotDirectory,
@@ -210,6 +205,36 @@ class WindowOperatorBase(Operator):
                         self.dir = NativeSlotDirectory(
                             load_native(), n_keys=sum(widths)
                         )
+
+    def _set_flat_layout(self, widths: List[int]):
+        """Record the flat native key layout when struct keys flatten
+        into int64 child words (shared by the single-process swap and
+        the mesh per-shard swap — one definition, no drift)."""
+        if any(pa.types.is_struct(t) for t in self._key_types):
+            self._flat_widths = widths
+            self._flat_offsets = [0]
+            for w in widths:
+                self._flat_offsets.append(self._flat_offsets[-1] + w)
+
+    def _maybe_swap_mesh_native(self):
+        """Mesh mode: swap the facade's per-shard PYTHON directories to
+        the native C++ table when the operator's keys flatten to int64
+        words — the round-5 mesh profile's largest host cost was the
+        per-shard python assigns plus tuple-per-key emission. Same
+        eligibility gate as the single-process native swap."""
+        from ..parallel.sharded_state import MeshSlotDirectory
+
+        if not (self._native_ok and isinstance(self.dir, MeshSlotDirectory)
+                and self.dir.n_live == 0):
+            return
+        from ..ops.native import flat_key_widths, load_native
+
+        widths = flat_key_widths(self._key_types)
+        if widths is None:
+            return
+        if not self.dir.swap_to_native(load_native(), sum(widths)):
+            return
+        self._set_flat_layout(widths)
 
     def _ensure_capacity(self):
         need = self.dir.required_capacity()

@@ -58,11 +58,36 @@ MESH_STATS = {"rows_sent": 0, "rows_padded": 0,
 class MeshSlotDirectory:
     """SlotDirectory facade over per-shard directories: keys hash to an
     owning shard (same splitmix64 hashing as the host shuffle), the shard's
-    directory assigns a local slot, and callers see global slots."""
+    directory assigns a local slot, and callers see global slots.
+
+    Per-shard directories default to the python SlotDirectory; operators
+    whose keys flatten to int64 words swap them to the native C++ table
+    (`swap_to_native`) — round-5 mesh profile showed the python per-shard
+    assigns + tuple-per-key emission as the largest host cost on the
+    mesh path. Session windows keep python shards (imperative
+    alloc_slot/free lists live there)."""
 
     def __init__(self, n_shards: int):
         self.n_shards = n_shards
         self.dirs = [SlotDirectory() for _ in range(n_shards)]
+        self._native = False
+
+    def swap_to_native(self, native_mod, n_keys: int) -> bool:
+        """Replace the per-shard python directories with C++ tables
+        (callable only while empty). Returns True on swap."""
+        if native_mod is None or any(d.n_live for d in self.dirs):
+            return False
+        from ..ops.native import NativeSlotDirectory
+
+        self.dirs = [
+            NativeSlotDirectory(native_mod, n_keys=n_keys)
+            for _ in range(self.n_shards)
+        ]
+        self._native = True
+        # bound as an instance attribute so the window operators' array
+        # fast path (attribute probe) engages exactly when arrays exist
+        self.take_bin_arrays = self._take_bin_arrays
+        return True
 
     @property
     def n_live(self) -> int:
@@ -125,9 +150,24 @@ class MeshSlotDirectory:
                     out[key] = shard * STRIDE + slot
         return out or None
 
-    def bin_entries(self, b: int) -> Tuple[List[tuple], np.ndarray]:
+    def bin_entries(self, b: int):
+        if self._native:
+            # native shards return int64 key MATRICES — concatenating
+            # them keeps the emission path vectorized end to end (the
+            # sliding merge branches on ndarray keys)
+            mats: List[np.ndarray] = []
+            slot_chunks = []
+            for shard, d in enumerate(self.dirs):
+                kmat, s = d.bin_entries(b)
+                if len(s):
+                    mats.append(kmat)
+                    slot_chunks.append(s + shard * STRIDE)
+            if not slot_chunks:
+                return (np.empty((0, self.dirs[0]._stride), dtype=np.int64),
+                        np.empty(0, dtype=np.int64))
+            return np.concatenate(mats), np.concatenate(slot_chunks)
         keys: List[tuple] = []
-        slot_chunks: List[np.ndarray] = []
+        slot_chunks = []
         for shard, d in enumerate(self.dirs):
             k, s = d.bin_entries(b)
             keys.extend(k)
@@ -151,6 +191,27 @@ class MeshSlotDirectory:
             else np.empty(0, dtype=np.int64)
         )
 
+    def _take_bin_arrays(self, b: int):
+        """Vectorized take (native shards only — bound as
+        `take_bin_arrays` by swap_to_native so the attribute probe in
+        the window watermark path engages exactly when arrays exist)."""
+        kcols: Optional[List[List[np.ndarray]]] = None
+        slot_chunks = []
+        for shard, d in enumerate(self.dirs):
+            cols, s = d.take_bin_arrays(b)
+            if not len(s):
+                continue
+            if kcols is None:
+                kcols = [[] for _ in cols]
+            for j, c in enumerate(cols):
+                kcols[j].append(c)
+            slot_chunks.append(s + shard * STRIDE)
+        if not slot_chunks:
+            z = np.empty(0, dtype=np.int64)
+            return [z for _ in range(self.dirs[0]._stride)], z
+        return ([np.concatenate(c) for c in kcols],
+                np.concatenate(slot_chunks))
+
     def items(self):
         for shard, d in enumerate(self.dirs):
             for b, key, slot in d.items():
@@ -158,11 +219,29 @@ class MeshSlotDirectory:
 
     def keys_for_slots(self, slots: np.ndarray):
         """(bin, key) per global slot via the shard directories' reverse
-        maps (updating-aggregate dirty tracking)."""
-        out = []
-        for s in np.asarray(slots):
-            shard, local = int(s) // STRIDE, int(s) % STRIDE
-            out.append(self.dirs[shard].key_of.get(local))
+        maps (updating-aggregate dirty tracking); dispatched per shard so
+        native shards answer in one C call."""
+        slots = np.asarray(slots, dtype=np.int64)
+        out: List[Optional[tuple]] = [None] * len(slots)
+        shards = slots // STRIDE
+        locs = slots % STRIDE
+        for shard in range(self.n_shards):
+            idx = np.nonzero(shards == shard)[0]
+            if not len(idx):
+                continue
+            res = self.dirs[shard].keys_for_slots(locs[idx])
+            for i, r in zip(idx, res):
+                out[int(i)] = r
+        return out
+
+    def slots_for_keys(self, b: int, keys: List[tuple]) -> Dict[tuple, int]:
+        """Point lookups across shards: each key lives on exactly one
+        shard, so probe all shards with the full list and merge (native
+        shards answer in one C lookup each)."""
+        out: Dict[tuple, int] = {}
+        for shard, d in enumerate(self.dirs):
+            for k, local in d.slots_for_keys(b, keys).items():
+                out[k] = shard * STRIDE + int(local)
         return out
 
     def remove(self, b: int, keys: List[tuple]) -> np.ndarray:
@@ -183,7 +262,12 @@ class MeshSlotDirectory:
     def alloc_slot(self, shard_hint: int) -> int:
         """Allocate one slot on a shard (round-robin hint from the caller);
         session bookkeeping assigns slots imperatively rather than through
-        assign()."""
+        assign(). Python shards only (sessions never swap to native —
+        the imperative free lists live in the python directory)."""
+        if self._native:
+            raise RuntimeError(
+                "imperative slot allocation requires python shards"
+            )
         d = self.dirs[shard_hint % self.n_shards]
         local = d.free.pop() if d.free else d._alloc()
         return (shard_hint % self.n_shards) * STRIDE + local

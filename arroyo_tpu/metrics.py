@@ -26,15 +26,39 @@ class _Metric:
         self.help = help_
         self.kind = kind
         self.values: Dict[LabelSet, float] = defaultdict(float)
+        # scrape-time refreshers: key -> zero-arg callable returning the
+        # current value (or None to keep the stored sample). Gauges whose
+        # producer only updates on its own hot path (e.g. backpressure,
+        # sampled every N collect() calls) register one so a quiesced
+        # stream can't pin a stale value into every future scrape.
+        self.refreshers: Dict[LabelSet, object] = {}
         self.lock = threading.Lock()
 
     def labels(self, **labels: str) -> "_Handle":
         key = tuple(sorted(labels.items()))
         return _Handle(self, key)
 
+    def _refresh(self):
+        """Run registered refreshers (lock held), dropping dead ones."""
+        if not self.refreshers:
+            return
+        dead = []
+        for key, fn in self.refreshers.items():
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 - producer gone mid-scrape
+                v = None
+            if v is None:
+                dead.append(key)
+            else:
+                self.values[key] = v
+        for key in dead:
+            del self.refreshers[key]
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self.lock:
+            self._refresh()
             for key, val in self.values.items():
                 if key:
                     label_s = ",".join(
@@ -59,6 +83,13 @@ class _Handle:
     def set(self, value: float):
         with self.metric.lock:
             self.metric.values[self.key] = value
+
+    def set_refresher(self, fn):
+        """Register a scrape-time refresher: `fn()` is called under the
+        metric lock at expose/snapshot and must return the current value,
+        or None to unregister itself (producer gone)."""
+        with self.metric.lock:
+            self.metric.refreshers[self.key] = fn
 
     def get(self) -> float:
         with self.metric.lock:
@@ -95,6 +126,7 @@ class Registry:
         out: Dict[str, list] = {}
         for name, m in metrics:
             with m.lock:
+                m._refresh()
                 out[name] = [(dict(k), v) for k, v in m.values.items()]
         return out
 

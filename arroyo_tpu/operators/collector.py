@@ -11,6 +11,7 @@ forward edges are 1-1. Signals broadcast to every destination queue.
 
 from __future__ import annotations
 
+import weakref
 from typing import List, Optional
 
 import pyarrow as pa
@@ -74,6 +75,23 @@ class Collector:
         self._bytes_counter = BYTES_SENT.labels(job=job_id, task=task_id)
         self._bp_gauge = BACKPRESSURE.labels(job=job_id, task=task_id)
         self._bp_tick = 0
+        # the sampled update in collect() goes stale the moment a stream
+        # quiesces (no more collect() calls ever re-sample it — ADVICE
+        # r5), so the gauge also refreshes at scrape time: a weakly-bound
+        # refresher recomputes occupancy on expose/snapshot and
+        # unregisters itself once this collector is garbage-collected
+        ref = weakref.ref(self)
+
+        def _bp_now():
+            c = ref()
+            if c is None:
+                return None
+            return max(
+                (q.fullness() for e in c.edges for q in e.queues),
+                default=0.0,
+            )
+
+        self._bp_gauge.set_refresher(_bp_now)
         # sink-side hook: engine-level capture of terminal output (preview)
         self.collected: Optional[list] = None
 

@@ -217,14 +217,21 @@ class WindowOperatorBase(Operator):
                 self._flat_offsets.append(self._flat_offsets[-1] + w)
 
     def _maybe_swap_mesh_native(self):
-        """Mesh mode: swap the facade's per-shard PYTHON directories to
-        the native C++ table when the operator's keys flatten to int64
-        words — the round-5 mesh profile's largest host cost was the
-        per-shard python assigns plus tuple-per-key emission. Same
-        eligibility gate as the single-process native swap."""
-        from ..parallel.sharded_state import MeshSlotDirectory
+        """Mesh mode: swap the facade's PYTHON directories (per-shard,
+        or the salted flat directory) to the native C++ table when the
+        operator's keys flatten to int64 words — the round-5 mesh
+        profile's largest host cost was the per-shard python assigns
+        plus tuple-per-key emission; the round-6 profile's was the
+        salted stage's per-row window-struct interning. Same eligibility
+        gate as the single-process native swap."""
+        from ..parallel.sharded_state import (
+            MeshSlotDirectory,
+            SharedMeshSlotDirectory,
+        )
 
-        if not (self._native_ok and isinstance(self.dir, MeshSlotDirectory)
+        if not (self._native_ok
+                and isinstance(self.dir, (MeshSlotDirectory,
+                                          SharedMeshSlotDirectory))
                 and self.dir.n_live == 0):
             return
         from ..ops.native import flat_key_widths, load_native
@@ -898,6 +905,10 @@ class TumblingWindowOperator(WindowOperatorBase):
         t = watermark.timestamp
         limit = _ceil_div(t, self.width) if self.width else t + 1
         take_arrays = getattr(self.dir, "take_bin_arrays", None)
+        # mesh accumulators fuse gather+reset into one device program
+        # (halves the per-wave emission dispatches); host-state drops
+        # then happen after finalize has read the stores
+        fused = getattr(self.acc, "gather_and_reset", None)
         for b in self.dir.bins_up_to(limit):
             end = self._bin_end(b)
             if end > t:
@@ -909,9 +920,15 @@ class TumblingWindowOperator(WindowOperatorBase):
             else:
                 keys, slots = self.dir.take_bin(b)
                 key_arrays = None
-            gathered = self.acc.gather(slots)
+            gathered = (
+                fused(slots) if fused is not None
+                else self.acc.gather(slots)
+            )
             agg_cols = self.acc.finalize(gathered)
-            self.acc.reset_slots(slots)
+            if fused is not None:
+                self.acc.drop_host_state(slots)
+            else:
+                self.acc.reset_slots(slots)
             if self.width:
                 out = self._build_output(keys, agg_cols, b * self.width, end,
                                          key_arrays=key_arrays)
@@ -1031,11 +1048,24 @@ class SlidingWindowOperator(WindowOperatorBase):
         # slide period; the per-event scatter stays on device)
         key_chunks = []
         slot_chunks = []
-        for b in range(lo_bin, end_bin):
-            keys_b, slots_b = self.dir.bin_entries(b)
-            if len(slots_b):
-                key_chunks.append(keys_b)
-                slot_chunks.append(slots_b)
+        multi = getattr(self.dir, "bin_entries_multi", None)
+        if multi is not None:
+            # native directories: ONE batched crossing covering every
+            # participating bin (the merge unions keys across bins, so
+            # per-bin identity is irrelevant) instead of k get_bin calls
+            # — k x shards calls on the mesh facade
+            kmat, slots_m = multi(
+                np.arange(lo_bin, end_bin, dtype=np.int64)
+            )
+            if len(slots_m):
+                key_chunks.append(kmat)
+                slot_chunks.append(slots_m)
+        else:
+            for b in range(lo_bin, end_bin):
+                keys_b, slots_b = self.dir.bin_entries(b)
+                if len(slots_b):
+                    key_chunks.append(keys_b)
+                    slot_chunks.append(slots_b)
         if slot_chunks:
             all_slots = np.concatenate(slot_chunks)
             key_arrays = None
@@ -1155,15 +1185,26 @@ class SessionWindowOperator(WindowOperatorBase):
 
     def _alloc_slot(self) -> int:
         if not self._slot_pool:
-            self._slot_pool = [
-                int(s) for s in
-                self.dir.alloc_slots(self._POOL_BLOCK, self._next_shard)
-            ]
+            self._slot_pool = self.dir.alloc_slots(
+                self._POOL_BLOCK, self._next_shard
+            ).tolist()
             self._next_shard += self._POOL_BLOCK
         return self._slot_pool.pop()
 
     def _free_slot(self, slot: int):
         self.dir.free_slot(int(slot))
+
+    def _return_pool(self):
+        """Return unused pooled slots to the directory free lists. Left
+        in the pool across a checkpoint they are allocated-but-unused:
+        required_capacity (and the accumulator grow threshold) carries
+        up to _POOL_BLOCK-1 idle slots, and a restore from that
+        checkpoint strands them entirely (ADVICE round 5)."""
+        if self._slot_pool:
+            self.dir.free_slots(
+                np.asarray(self._slot_pool, dtype=np.int64)
+            )
+            self._slot_pool = []
 
     def tables(self):
         from ..state.table_config import global_table
@@ -1178,6 +1219,7 @@ class SessionWindowOperator(WindowOperatorBase):
                 self._restore_sessions(snap, ctx)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
+        self._return_pool()
         if ctx.table_manager is not None:
             table = await ctx.table("sess")
             snap = self._snapshot_sessions()
@@ -1375,10 +1417,15 @@ class SessionWindowOperator(WindowOperatorBase):
                 del self.sessions[key]
         if exp_slots:
             slot_arr = np.asarray(exp_slots, dtype=np.int64)
-            agg_cols = self.acc.finalize(self.acc.gather(slot_arr))
-            self.acc.reset_slots(slot_arr)
-            for s in exp_slots:
-                self._free_slot(s)
+            fused = getattr(self.acc, "gather_and_reset", None)
+            if fused is not None:
+                # mesh: one fused device program per expiry wave
+                agg_cols = self.acc.finalize(fused(slot_arr))
+                self.acc.drop_host_state(slot_arr)
+            else:
+                agg_cols = self.acc.finalize(self.acc.gather(slot_arr))
+                self.acc.reset_slots(slot_arr)
+            self.dir.free_slots(slot_arr)  # batch: one extend per shard
             out = self._build_output(
                 exp_keys, agg_cols,
                 np.asarray(exp_starts, dtype=np.int64),

@@ -87,3 +87,18 @@ def device_join_active() -> bool:
     cfg = config().tpu
     return cfg.device_join and (device_tier_active()
                                 or cfg.device_join_force)
+
+
+def safe_donate(*argnums) -> tuple:
+    """donate_argnums gated on the jax generation: on the 0.4.x line
+    (shard_map still experimental) consuming donated buffers across
+    repeated runs intermittently corrupts the allocator (observed as
+    glibc "corrupted double-linked list"/segfaults on 0.4.37-cpu, both
+    for mesh-sharded state and the single-device accumulators);
+    donation re-engages where shard_map has moved into core jax."""
+    try:
+        from jax import shard_map  # noqa: F401
+
+        return tuple(argnums)
+    except ImportError:
+        return ()

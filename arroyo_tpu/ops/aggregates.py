@@ -30,6 +30,7 @@ from ..config import config
 
 # jax import deferred so host-only deployments can import the module tree
 from ._jax import get_jax as _get_jax
+from ._jax import safe_donate
 
 
 INT_MIN = np.iinfo(np.int64).min
@@ -571,7 +572,7 @@ class Accumulator:
         jax = _get_jax()
         phys = list(self.phys)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        @partial(jax.jit, donate_argnums=safe_donate(0))
         def update(state, slots, *vals):
             out = []
             for (op, dt, src, si), s, v in zip(phys, state, vals):
@@ -642,6 +643,12 @@ class Accumulator:
 
         return gather
 
+    def drop_host_state(self, slots: np.ndarray):
+        """Forget host-side per-slot state (UDAF buffers / multisets) for
+        freed slots — the host half of reset_slots, for callers that
+        fused the device half into the gather (gather_and_reset)."""
+        self._drop_udaf_slots(slots)
+
     def _drop_udaf_slots(self, slots: np.ndarray):
         for si in self.udaf_idx:
             store = self.udaf_store[si]
@@ -671,7 +678,7 @@ class Accumulator:
                 self._neutral(op, dt) for op, dt, _, _ in self.phys
             ]
 
-            @partial(jax.jit, donate_argnums=(0,))
+            @partial(jax.jit, donate_argnums=safe_donate(0))
             def reset(state, s_idx):
                 return [
                     s.at[s_idx].set(nv) for s, nv in zip(state, neutrals)

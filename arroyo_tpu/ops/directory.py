@@ -81,6 +81,11 @@ class SlotDirectory:
     def free_slot(self, slot: int):
         self.free.append(int(slot))
 
+    def free_slots(self, slots):
+        """Batch free (session expiry waves / slot-pool returns): one
+        C-level extend instead of a python call per slot."""
+        self.free.extend(np.asarray(slots, dtype=np.int64).tolist())
+
     def bins_up_to(self, bin_exclusive: int) -> List[int]:
         return sorted(b for b in self.by_bin if b < bin_exclusive)
 

@@ -147,6 +147,18 @@ class NativeSlotDirectory:
             np.frombuffer(slots_raw, dtype=np.int64),
         )
 
+    def bin_entries_multi(self, bins) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenated (keys matrix, slots) over SEVERAL live bins in one
+        C call (the sliding merge reads width/slide bins per emission and
+        only ever concatenates them; per-bin identity is not needed)."""
+        keys_raw, slots_raw = self._d.get_bins(
+            np.ascontiguousarray(np.asarray(bins, dtype=np.int64))
+        )
+        return (
+            self._keys_matrix(keys_raw),
+            np.frombuffer(slots_raw, dtype=np.int64),
+        )
+
     @property
     def by_bin(self):
         # truthiness probe used by the sliding operator ("anything live?")
@@ -210,16 +222,24 @@ class NativeSlotDirectory:
     def bins_up_to(self, limit: int) -> List[int]:
         return sorted(b for b in self._d.live_bins() if b < limit)
 
-    def items(self):
+    def entries_arrays(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """All live entries as (bins, keys matrix, slots) arrays — one C
+        call, no python tuple per key (checkpoint snapshots and the mesh
+        facade's per-shard items() ride this)."""
         bins_raw, keys_raw, slots_raw = self._d.entries()
-        bins = np.frombuffer(bins_raw, dtype=np.int64)
-        keys = self._keys_matrix(keys_raw)
-        slots = np.frombuffer(slots_raw, dtype=np.int64)
-        for i in range(len(bins)):
-            k = () if self.n_keys == 0 else tuple(
-                int(x) for x in keys[i]
-            )
-            yield int(bins[i]), k, int(slots[i])
+        return (
+            np.frombuffer(bins_raw, dtype=np.int64),
+            self._keys_matrix(keys_raw),
+            np.frombuffer(slots_raw, dtype=np.int64),
+        )
+
+    def items(self):
+        bins, keys, slots = self.entries_arrays()
+        # C-level passes end to end: tolist()/zip instead of a python
+        # int()+tuple() per row (the round-5 snapshot profile's cost)
+        yield from zip(
+            bins.tolist(), self._rows_to_tuples(keys), slots.tolist()
+        )
 
 
 def _i64able(t) -> bool:

@@ -50,9 +50,12 @@ STRIDE = 1 << 32
 # all_to_all [S, S, R] layout, whichever each update used), aggregated
 # across every ShardedAccumulator instance; bench --mesh reads these to
 # report the padding overhead of the host->device/ICI row shipment and
-# the dispatch amortization (device steps per engine update call)
+# the dispatch amortization (device steps per engine update call).
+# flushes_elided counts state reads that skipped the pre-read flush
+# because no pending update row touched the slots being read.
 MESH_STATS = {"rows_sent": 0, "rows_padded": 0,
-              "dispatches": 0, "updates": 0}
+              "dispatches": 0, "updates": 0, "flushes_elided": 0,
+              "rows_combined": 0}
 
 
 class MeshSlotDirectory:
@@ -84,9 +87,10 @@ class MeshSlotDirectory:
             for _ in range(self.n_shards)
         ]
         self._native = True
-        # bound as an instance attribute so the window operators' array
-        # fast path (attribute probe) engages exactly when arrays exist
+        # bound as instance attributes so the window operators' array
+        # fast paths (attribute probes) engage exactly when arrays exist
         self.take_bin_arrays = self._take_bin_arrays
+        self.bin_entries_multi = self._bin_entries_multi
         return True
 
     @property
@@ -194,35 +198,68 @@ class MeshSlotDirectory:
     def _take_bin_arrays(self, b: int):
         """Vectorized take (native shards only — bound as
         `take_bin_arrays` by swap_to_native so the attribute probe in
-        the window watermark path engages exactly when arrays exist)."""
-        kcols: Optional[List[List[np.ndarray]]] = None
-        slot_chunks = []
+        the window watermark path engages exactly when arrays exist).
+        One C call per shard; outputs fill preallocated buffers."""
+        per_shard: List[tuple] = []  # (shard, key cols, local slots)
+        total = 0
         for shard, d in enumerate(self.dirs):
             cols, s = d.take_bin_arrays(b)
-            if not len(s):
-                continue
-            if kcols is None:
-                kcols = [[] for _ in cols]
-            for j, c in enumerate(cols):
-                kcols[j].append(c)
-            slot_chunks.append(s + shard * STRIDE)
-        if not slot_chunks:
+            if len(s):
+                per_shard.append((shard, cols, s))
+                total += len(s)
+        stride = self.dirs[0]._stride
+        if not per_shard:
             z = np.empty(0, dtype=np.int64)
-            return [z for _ in range(self.dirs[0]._stride)], z
-        return ([np.concatenate(c) for c in kcols],
-                np.concatenate(slot_chunks))
+            return [z for _ in range(stride)], z
+        out_cols = [np.empty(total, dtype=np.int64) for _ in range(stride)]
+        out_slots = np.empty(total, dtype=np.int64)
+        off = 0
+        for shard, cols, s in per_shard:
+            n = len(s)
+            for j, c in enumerate(cols):
+                out_cols[j][off:off + n] = c
+            np.add(s, shard * STRIDE, out=out_slots[off:off + n])
+            off += n
+        return out_cols, out_slots
+
+    def _bin_entries_multi(self, bins) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenated (key matrix, global slots) over SEVERAL bins in
+        one native C call per shard (the sliding merge reads width/slide
+        bins per emission; per-bin calls cost S x k crossings). Native
+        shards only — bound by swap_to_native like take_bin_arrays."""
+        bins_arr = np.ascontiguousarray(np.asarray(bins, dtype=np.int64))
+        mats: List[np.ndarray] = []
+        slot_chunks: List[np.ndarray] = []
+        for shard, d in enumerate(self.dirs):
+            kmat, s = d.bin_entries_multi(bins_arr)
+            if len(s):
+                mats.append(kmat)
+                slot_chunks.append(s + shard * STRIDE)
+        if not slot_chunks:
+            return (np.empty((0, self.dirs[0]._stride), dtype=np.int64),
+                    np.empty(0, dtype=np.int64))
+        return np.concatenate(mats), np.concatenate(slot_chunks)
 
     def items(self):
         for shard, d in enumerate(self.dirs):
-            for b, key, slot in d.items():
-                yield b, key, shard * STRIDE + slot
+            base = shard * STRIDE
+            if self._native:
+                # one C call per shard; tuple building and iteration
+                # stay in C-level passes (_rows_to_tuples + zip)
+                bins, kmat, slots = d.entries_arrays()
+                yield from zip(bins.tolist(), d._rows_to_tuples(kmat),
+                               (slots + base).tolist())
+            else:
+                for b, key, slot in d.items():
+                    yield b, key, base + slot
 
     def keys_for_slots(self, slots: np.ndarray):
         """(bin, key) per global slot via the shard directories' reverse
         maps (updating-aggregate dirty tracking); dispatched per shard so
-        native shards answer in one C call."""
+        native shards answer in one C call, results scattered back with
+        one object-array assignment per shard."""
         slots = np.asarray(slots, dtype=np.int64)
-        out: List[Optional[tuple]] = [None] * len(slots)
+        out = np.empty(len(slots), dtype=object)
         shards = slots // STRIDE
         locs = slots % STRIDE
         for shard in range(self.n_shards):
@@ -230,29 +267,65 @@ class MeshSlotDirectory:
             if not len(idx):
                 continue
             res = self.dirs[shard].keys_for_slots(locs[idx])
-            for i, r in zip(idx, res):
-                out[int(i)] = r
-        return out
+            # element-wise object fill (a bare out[idx] = res would let
+            # numpy reshape the (bin, key) 2-tuples into a 2-D array)
+            tmp = np.empty(len(res), dtype=object)
+            tmp[:] = res
+            out[idx] = tmp
+        return out.tolist()
 
     def slots_for_keys(self, b: int, keys: List[tuple]) -> Dict[tuple, int]:
         """Point lookups across shards: each key lives on exactly one
         shard, so probe all shards with the full list and merge (native
-        shards answer in one C lookup each)."""
+        shards share ONE key matrix and answer in one C lookup each; the
+        merge is a zip over the hit indices, no per-key method calls)."""
+        if not keys:
+            return {}
         out: Dict[tuple, int] = {}
+        if self._native:
+            flat = np.ascontiguousarray(
+                self.dirs[0]._keys_to_matrix(keys).reshape(-1)
+            )
+            for shard, d in enumerate(self.dirs):
+                present, slots_raw = d._d.lookup(int(b), flat)
+                pres = np.frombuffer(present, dtype=np.uint8)
+                hit = np.nonzero(pres)[0]
+                if not len(hit):
+                    continue
+                gslots = np.frombuffer(slots_raw, dtype=np.int64)[hit]
+                out.update(zip(
+                    (keys[i] for i in hit.tolist()),
+                    (gslots + shard * STRIDE).tolist(),
+                ))
+            return out
         for shard, d in enumerate(self.dirs):
-            for k, local in d.slots_for_keys(b, keys).items():
-                out[k] = shard * STRIDE + int(local)
+            sub = d.slots_for_keys(b, keys)
+            if sub:
+                base = shard * STRIDE
+                out.update((k, base + int(v)) for k, v in sub.items())
         return out
 
     def remove(self, b: int, keys: List[tuple]) -> np.ndarray:
         """Remove keys from a bin across shards; each key lives in exactly
-        one shard, so per-shard removal of the full list is safe. Returns
-        freed GLOBAL slots."""
+        one shard, so per-shard removal of the full list is safe. Native
+        shards share one key matrix (built once, one C call per shard).
+        Returns freed GLOBAL slots."""
+        if not keys:
+            return np.empty(0, dtype=np.int64)
         freed = []
-        for shard, d in enumerate(self.dirs):
-            f = d.remove(b, keys)
-            if len(f):
-                freed.append(f + shard * STRIDE)
+        if self._native:
+            flat = np.ascontiguousarray(
+                self.dirs[0]._keys_to_matrix(keys).reshape(-1)
+            )
+            for shard, d in enumerate(self.dirs):
+                f = np.frombuffer(d._d.remove(int(b), flat), dtype=np.int64)
+                if len(f):
+                    freed.append(f + shard * STRIDE)
+        else:
+            for shard, d in enumerate(self.dirs):
+                f = d.remove(b, keys)
+                if len(f):
+                    freed.append(f + shard * STRIDE)
         return (
             np.concatenate(freed) if freed else np.empty(0, dtype=np.int64)
         )
@@ -289,32 +362,80 @@ class MeshSlotDirectory:
     def free_slot(self, slot: int):
         self.dirs[int(slot) // STRIDE].free.append(int(slot) % STRIDE)
 
+    def free_slots(self, slots: np.ndarray):
+        """Batch free: one list-extend per shard (session expiry waves
+        and the session operator's slot-pool return at checkpoint)."""
+        slots = np.asarray(slots, dtype=np.int64)
+        if not len(slots):
+            return
+        shards = slots // STRIDE
+        locs = slots % STRIDE
+        for shard in range(self.n_shards):
+            sel = np.nonzero(shards == shard)[0]
+            if len(sel):
+                self.dirs[shard].free.extend(locs[sel].tolist())
+
 
 def _pow2_ladder(cap: int, floor: int = 16) -> tuple:
-    """Bucket rungs from `floor` up to and including `cap`: power-of-2
-    below 1024, quarter steps (x1.25/x1.5/x1.75 between octaves) above.
-    Above 1024 the packed buffers are large enough that pow2 overshoot
-    (~33% average, 50% worst) dominates the mesh padding ratio; quarter
-    rungs bound it at 25% worst / ~11% average. The extra rungs cost one
-    XLA program each only when actually hit, and compiled programs
-    persist across processes (tpu.compilation_cache_dir)."""
+    """Bucket rungs from `floor` up to and including `cap`: power-of-2 at
+    the very bottom, then progressively finer fractional steps as the
+    octaves grow — quarter rungs (x1.25/x1.5/x1.75) from 32, eighth rungs
+    from 128, sixteenth rungs from 512. Worst-case bucket overshoot is
+    bounded by the rung spacing: 100% below 32, 25% to 128, 12.5% to 512,
+    6.25% above — so the large packed buffers, where padded rows actually
+    cost host->device/ICI bytes, average ~3% padding while the tiny
+    buffers near the floor keep the compiled-program count low. The extra
+    rungs cost one XLA program each only when actually hit, and compiled
+    programs persist across processes (tpu.compilation_cache_dir)."""
     rb, b = [], floor
     while b < cap:
         rb.append(b)
-        if b >= 1024:
-            rb.extend(
-                x for x in (b * 5 // 4, b * 3 // 2, b * 7 // 4) if x < cap
-            )
+        if b >= 512:
+            num, denom = range(17, 32), 16
+        elif b >= 128:
+            num, denom = range(9, 16), 8
+        elif b >= 32:
+            num, denom = range(5, 8), 4
+        else:
+            num, denom = (), 1
+        rb.extend(x for x in (b * s // denom for s in num) if x < cap)
         b *= 2
     rb.append(cap)
     return tuple(sorted(set(x for x in rb if x <= cap)))
 
 
+def _get_shard_map():
+    """jax.shard_map moved out of experimental in newer jax; support
+    both homes (the 0.4.x line only ships jax.experimental.shard_map)."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def _donate_state() -> tuple:
+    """donate_argnums for the state-consuming jitted programs. On the
+    jax 0.4.x line (shard_map still experimental) donating sharded
+    int64 state buffers corrupts the allocator across repeated engine
+    runs (glibc "corrupted double-linked list", observed on 0.4.37-cpu
+    whenever a mesh run shares a process with another engine run), so
+    donation only engages where shard_map has moved into core jax."""
+    try:
+        from jax import shard_map  # noqa: F401
+
+        return (0,)
+    except ImportError:
+        return ()
+
+
 def _scatter_body(phys, jnp):
     """Shared per-shard scatter-reduce: applies (flat_slots, valid, vals)
-    rows into each physical accumulator row. `valid` is 0 for padding and
-    ±1 for append/retract; add-sources multiply by it in-kernel, min/max
-    sources replace padding with the op's neutral."""
+    rows into each physical accumulator row. Rows arrive PRE-REDUCED by
+    the host combiner (one row per slot per flush): `valid` carries the
+    segment's summed signs (row count for append-only streams, 0 for
+    padding), add-source values arrive sign-folded (0 for padding), and
+    min/max sources replace padding with the op's neutral."""
 
     def scatter(state_shards, flat_slots, valid_r, vals_r):
         out = []
@@ -326,9 +447,7 @@ def _scatter_body(phys, jnp):
             else:
                 v = vals_r[vi]
                 vi += 1
-                if op == "add":
-                    v = v * valid_r.astype(v.dtype)
-                else:
+                if op != "add":
                     v = jnp.where(valid_r != 0, v, _neutral(op, dt))
             if op == "add":
                 row = row.at[flat_slots].add(v.astype(row.dtype))
@@ -356,6 +475,32 @@ class SharedMeshSlotDirectory:
     def __init__(self, n_shards: int):
         self.n_shards = n_shards
         self._flat = SlotDirectory()
+
+    def swap_to_native(self, native_mod, n_keys: int) -> bool:
+        """Swap the flat python directory for the C++ table (callable
+        only while empty): the salted window-only groupings flatten
+        their window struct to int64 words, and the python per-row
+        interning + dict assign showed up as the salted stage's largest
+        host cost in the mesh profile. Session operators never swap —
+        their imperative alloc_slot/free lists live python-side."""
+        if native_mod is None or self._flat.n_live:
+            return False
+        from ..ops.native import NativeSlotDirectory
+
+        self._flat = NativeSlotDirectory(native_mod, n_keys=n_keys)
+        # bound as instance attributes so the window operators' array
+        # fast paths (attribute probes) engage exactly when arrays exist
+        self.take_bin_arrays = self._take_bin_arrays
+        self.bin_entries_multi = self._bin_entries_multi
+        return True
+
+    def _take_bin_arrays(self, b: int):
+        cols, slots = self._flat.take_bin_arrays(b)
+        return cols, self._g(slots)
+
+    def _bin_entries_multi(self, bins) -> Tuple[np.ndarray, np.ndarray]:
+        kmat, slots = self._flat.bin_entries_multi(bins)
+        return kmat, self._g(slots)
 
     def _g(self, locals_: np.ndarray) -> np.ndarray:
         locals_ = np.asarray(locals_, dtype=np.int64)
@@ -419,6 +564,9 @@ class SharedMeshSlotDirectory:
     def free_slot(self, slot: int):
         self._flat.free_slot(int(slot) % STRIDE)
 
+    def free_slots(self, slots: np.ndarray):
+        self._flat.free_slots(np.asarray(slots, dtype=np.int64) % STRIDE)
+
 
 class ShardedAccumulator(Accumulator):
     """Accumulator whose slot arrays live sharded across a 1-D device mesh;
@@ -451,7 +599,11 @@ class ShardedAccumulator(Accumulator):
         # compiled step programs at log2(rows_per_shard/16) + 1 per
         # accumulator layout; in steady state only the rungs matching the
         # pipeline's characteristic batch sizes ever compile.
-        self._r_buckets = _pow2_ladder(rows_per_shard)
+        # floor 2: post-combiner flushes can be a handful of rows (the
+        # salted low-cardinality stage combines a whole flush down to
+        # its few windows), and the old floor of 16 made such dispatches
+        # ship 8x-64x filler
+        self._r_buckets = _pow2_ladder(rows_per_shard, floor=2)
         # batches that arrive from the HOST are already globally visible,
         # so the hash-shuffle can happen in numpy at packing time: rows
         # are laid out dst-major [S, R] and the sharded transfer routes
@@ -461,7 +613,21 @@ class ShardedAccumulator(Accumulator):
         # (chained device operators, multi-host ICI shuffle) where rows
         # are born sharded by SOURCE and must route by KEY on-device.
         self.host_fed = host_fed
-        self._r_buckets_direct = _pow2_ladder(rows_per_shard * self.n_shards)
+        self._r_buckets_direct = _pow2_ladder(
+            rows_per_shard * self.n_shards, floor=2
+        )
+        # emission/reset/restore padding uses the accumulator's OWN
+        # power-of-2 ladder rather than the coarse global
+        # tpu.shape_buckets (whose big rungs exist for the TPU-relay
+        # compile budget of the single-device path): a ~2k-slot
+        # watermark gather padded to an 8192 bucket wastes 4x gather
+        # work + device->host bytes per emission. Plain pow2 (not the
+        # fine fractional rungs of the packing ladders): gather padding
+        # is cheap index work, while every distinct shape costs a
+        # python-side trace per process — emission sizes vary per wave,
+        # so coarse rungs keep the program count (and per-run fixed
+        # tracing cost) low where fine rungs buy nothing.
+        self._buckets = tuple(1 << i for i in range(4, 21))
         # salted mode (SharedMeshSlotDirectory): update rows spread
         # row-position round-robin across ALL shards at the slot's local
         # index — perfectly balanced regardless of key skew — and gather
@@ -476,11 +642,18 @@ class ShardedAccumulator(Accumulator):
         self.rows_padded = 0
         # micro-batching: update() buffers rows host-side and ships one
         # packed exchange + scatter per `flush_rows` rows instead of per
-        # engine batch; every state read (gather/reset/restore) flushes
-        # first, so observers never see stale state. 0 = immediate.
+        # engine batch; every state read (gather/reset/restore) that
+        # touches a pending slot flushes first, so observers never see
+        # stale state — reads of untouched slots keep buffering (the
+        # watermark-emission gathers otherwise force a flush per engine
+        # batch and pin dispatches/updates near 1). 0 = immediate.
         self.flush_rows = int(flush_rows)
         self._pending: List[tuple] = []   # (slots, vals_list, signs)
         self._pending_rows = 0
+        # observed engine-batch row EWMA: the effective flush threshold
+        # auto-tunes to >= 4 batches so a configured threshold below the
+        # pipeline's natural batch size still coalesces dispatches
+        self._ewma_rows = 0
         # multi-host: the mesh may span devices owned by several
         # processes (jax.distributed — parallel/multihost.py). All host
         # buffers then enter the device as GLOBAL arrays (each process
@@ -494,6 +667,7 @@ class ShardedAccumulator(Accumulator):
         self._step = self._make_step()
         self._direct_step = self._make_direct_step()
         self._mesh_gather_fn = None
+        self._mesh_take_fn = None
         self._mesh_reset_fn = None
         self._mesh_restore_fn = None
 
@@ -568,7 +742,7 @@ class ShardedAccumulator(Accumulator):
         # of a global sharded array with a process-local pad is not).
         # grow() is rare (4x capacity steps), so a compile per call is
         # acceptable; a single program per grow beats one per column.
-        @partial(jax.jit, donate_argnums=(0,), out_shardings=self._sharding)
+        @partial(jax.jit, donate_argnums=_donate_state(), out_shardings=self._sharding)
         def grow_fn(state):
             out = []
             for (op, dt, _, _), x in zip(phys, state):
@@ -616,15 +790,46 @@ class ShardedAccumulator(Accumulator):
             np.asarray(_src_values(self.specs[si], src, cols))
             for op, dt, src, si in self.phys if src != "one"
         ]
-        if self.flush_rows <= n and not self._pending:
+        self._ewma_rows = (
+            n if not self._ewma_rows else (self._ewma_rows * 7 + n) // 8
+        )
+        thr = self._flush_threshold()
+        if thr <= n and not self._pending:
             self._dispatch_rows(slots, vals, signs)
             return
         self._pending.append(
             (slots, vals, None if signs is None else np.asarray(signs))
         )
         self._pending_rows += n
-        if self._pending_rows >= self.flush_rows:
+        if self._pending_rows >= thr:
             self.flush()
+
+    def _flush_threshold(self) -> int:
+        """Effective micro-batch threshold: the configured
+        tpu.mesh_flush_rows, auto-raised to ~4 observed engine batches
+        (bounded) so a threshold tuned for one workload still coalesces
+        dispatches when the pipeline feeds bigger batches. 0 disables
+        buffering entirely (immediate dispatch)."""
+        if self.flush_rows <= 0:
+            return 0
+        return max(self.flush_rows, min(4 * self._ewma_rows, 1 << 20))
+
+    def _flush_if_touches(self, slots: np.ndarray):
+        """Flush pending update rows only when one could affect `slots`.
+        State reads (gather/reset/restore) of slots no pending row
+        touches keep buffering — correctness holds because every read
+        path comes through here first, and the eventual flush applies
+        the buffered scatters in their original order relative to any
+        elided read (disjoint slot sets commute)."""
+        if not self._pending:
+            return
+        slots = np.asarray(slots)
+        if len(slots):
+            for p_slots, _, _ in self._pending:
+                if np.isin(p_slots, slots, assume_unique=False).any():
+                    self.flush()
+                    return
+        MESH_STATS["flushes_elided"] += 1
 
     def flush(self):
         """Ship any buffered update rows to the device (one packed
@@ -651,8 +856,77 @@ class ShardedAccumulator(Accumulator):
         self._pending_rows = 0
         self._dispatch_rows(slots, vals, signs)
 
+    def _prereduce(self, slots: np.ndarray, vals: List[np.ndarray],
+                   signs: Optional[np.ndarray]):
+        """Host-side combiner: rows sharing a slot within one flush
+        collapse into a single packed row — add sources sum (sign-
+        weighted), min/max take their extremum, and the valid word
+        carries the segment's summed signs (= row count on append-only
+        streams). The packed exchange then ships O(unique slots) rows:
+        hot keys no longer skew the per-destination counts that size the
+        padded [S, R] buffer (the dominant residual padding source), and
+        shipped bytes drop with the dedup ratio. Integer accumulators
+        are exact under the reassociation; float sums see the same
+        reordering class as XLA's scatter reduction."""
+        n = len(slots)
+        if n == 0:
+            return slots, vals, signs
+        # one argsort does all the segmenting work (np.unique would sort
+        # a second time and build an inverse nothing needs): sorted-run
+        # boundaries give the unique slots and the reduceat bounds
+        order = np.argsort(slots, kind="stable")
+        s_sorted = slots[order]
+        new_seg = np.empty(n, dtype=bool)
+        new_seg[0] = True
+        np.not_equal(s_sorted[1:], s_sorted[:-1], out=new_seg[1:])
+        bounds = np.nonzero(new_seg)[0]
+        uniq = s_sorted[bounds]
+        MESH_STATS["rows_combined"] += n - len(uniq)
+        if len(uniq) == n:
+            # no duplicates: only fold signs into add-source values so
+            # the kernel's uniform pre-reduced semantics hold
+            if signs is not None:
+                out_vals = []
+                vi = 0
+                for op, dt, src, si in self.phys:
+                    if src == "one":
+                        continue
+                    v = vals[vi]
+                    vi += 1
+                    out_vals.append(
+                        v * signs.astype(v.dtype) if op == "add" else v
+                    )
+                vals = out_vals
+            return slots, vals, signs
+        sgn = signs[order] if signs is not None else None
+        out_vals = []
+        vi = 0
+        for op, dt, src, si in self.phys:
+            if src == "one":
+                continue
+            v = vals[vi][order]
+            vi += 1
+            if op == "add":
+                if sgn is not None:
+                    v = v * sgn.astype(v.dtype)
+                out_vals.append(np.add.reduceat(v, bounds))
+            elif op == "min":
+                out_vals.append(np.minimum.reduceat(v, bounds))
+            else:
+                out_vals.append(np.maximum.reduceat(v, bounds))
+        # per-slot summed signs (plain row count when unsigned): the
+        # count word and the padding discriminator. Signed streams only
+        # carry add phys (non-invertible aggregates replay host-side),
+        # so a zero sum contributes zero everywhere — still correct.
+        if sgn is not None:
+            counts = np.add.reduceat(sgn, bounds)
+        else:
+            counts = np.diff(np.append(bounds, n))
+        return uniq, out_vals, counts.astype(np.int64, copy=False)
+
     def _dispatch_rows(self, slots: np.ndarray, vals: List[np.ndarray],
                        signs: Optional[np.ndarray]):
+        slots, vals, signs = self._prereduce(slots, vals, signs)
         n = len(slots)
         S, R = self.n_shards, self.rows_per_shard
         owners, locals_ = self._decompose(slots)
@@ -767,12 +1041,11 @@ class ShardedAccumulator(Accumulator):
 
         n_state = len(self.phys)
 
-        @partial(jax.jit, donate_argnums=(0,), static_argnums=())
+        @partial(jax.jit, donate_argnums=_donate_state(), static_argnums=())
         def step(state, slots, valid, *vals):
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            f = shard_map(
+            f = _get_shard_map()(
                 local_update,
                 mesh=self.mesh,
                 in_specs=(
@@ -809,12 +1082,11 @@ class ShardedAccumulator(Accumulator):
 
         n_state = len(self.phys)
 
-        @partial(jax.jit, donate_argnums=(0,), static_argnums=())
+        @partial(jax.jit, donate_argnums=_donate_state(), static_argnums=())
         def step(state, slots, valid, *vals):
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            f = shard_map(
+            f = _get_shard_map()(
                 local_update,
                 mesh=self.mesh,
                 in_specs=(
@@ -833,7 +1105,7 @@ class ShardedAccumulator(Accumulator):
 
     def gather(self, slots: np.ndarray,
                materialize: bool = True) -> List[np.ndarray]:
-        self.flush()
+        self._flush_if_touches(slots)
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
         self._segment_multiset = None
@@ -899,8 +1171,80 @@ class ShardedAccumulator(Accumulator):
             return [o[: len(slots)] for o in outs]
         return [to_host(o)[: len(slots)] for o in outs]
 
+    def gather_and_reset(self, slots: np.ndarray,
+                         materialize: bool = True) -> List[np.ndarray]:
+        """Fused drain: ONE jitted program gathers the slots' values and
+        writes them back to neutral — the tumbling/session emission path
+        otherwise pays two device dispatches per watermark wave, and on
+        the CPU mesh every dispatch costs milliseconds of XLA launch.
+        Host-side per-slot state is NOT dropped here: the caller
+        finalizes first (finalize reads the stores), then calls
+        drop_host_state."""
+        self._flush_if_touches(slots)
+        self._gather_slots = np.asarray(slots)
+        self._segment_udaf = None
+        self._segment_multiset = None
+        if len(slots) == 0 or not self.phys:
+            return [
+                np.empty(0, dtype=_np_dtype(dt))
+                for _, dt, _, _ in self.phys
+            ]
+        import jax
+
+        from .multihost import to_host
+
+        if self._mesh_take_fn is None:
+            phys = list(self.phys)
+            salted = self.salted
+
+            def take_fn(state, sh, loc):
+                outs, new = [], []
+                for (op, dt, _, _), s in zip(phys, state):
+                    if salted:
+                        cols = s[:, loc]
+                        if op == "add":
+                            outs.append(cols.sum(axis=0))
+                        elif op == "min":
+                            outs.append(cols.min(axis=0))
+                        else:
+                            outs.append(cols.max(axis=0))
+                        # a salted slot's state lives on EVERY shard
+                        new.append(s.at[:, loc].set(_neutral(op, dt)))
+                    else:
+                        outs.append(s[sh, loc])
+                        new.append(s.at[sh, loc].set(_neutral(op, dt)))
+                return outs, new
+
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            self._mesh_take_fn = jax.jit(
+                take_fn,
+                donate_argnums=_donate_state(),
+                # outs replicated (each process reads its local copy),
+                # state stays row-sharded
+                out_shardings=(
+                    [NamedSharding(self.mesh, P())] * len(self.phys),
+                    [self._sharding] * len(self.phys),
+                ),
+            )
+        sh, loc = self._decompose(np.asarray(slots))
+        padded = _bucket(len(slots), self._buckets)
+        sh_p = np.zeros(padded, dtype=np.int64)
+        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
+        sh_p[: len(slots)] = sh
+        loc_p[: len(slots)] = loc
+        outs, self.state = self._mesh_take_fn(
+            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
+        )
+        if not materialize:
+            if self._multiproc:
+                outs = [o.addressable_data(0) for o in outs]
+            return [o[: len(slots)] for o in outs]
+        return [to_host(o)[: len(slots)] for o in outs]
+
     def reset_slots(self, slots: np.ndarray):
-        self.flush()
+        self._flush_if_touches(slots)
         self._drop_udaf_slots(slots)
         if len(slots) == 0 or not self.phys:
             return
@@ -910,7 +1254,7 @@ class ShardedAccumulator(Accumulator):
             phys = list(self.phys)
             salted = self.salted
 
-            @partial(jax.jit, donate_argnums=(0,),
+            @partial(jax.jit, donate_argnums=_donate_state(),
                      out_shardings=self._sharding)
             def reset_fn(state, sh, loc):
                 if salted:
@@ -936,7 +1280,7 @@ class ShardedAccumulator(Accumulator):
         )
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
-        self.flush()
+        self._flush_if_touches(slots)
         values = self._restore_udaf_cols(slots, values)
         if len(slots) == 0 or not self.phys:
             return
@@ -946,7 +1290,7 @@ class ShardedAccumulator(Accumulator):
             phys = list(self.phys)
             salted = self.salted
 
-            @partial(jax.jit, donate_argnums=(0,),
+            @partial(jax.jit, donate_argnums=_donate_state(),
                      out_shardings=self._sharding)
             def restore_fn(state, sh, loc, *vals):
                 if salted:

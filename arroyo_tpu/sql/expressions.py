@@ -693,6 +693,18 @@ class _LazyFilteredBatch:
             self._cols[i] = c
         return c
 
+    def __getattr__(self, name):
+        # duck-typing guard: a BoundExpr reaching for any other
+        # RecordBatch attribute would otherwise fail only on the
+        # partially-filtered path with an anonymous error (zero-pass /
+        # all-pass predicates never build this view)
+        raise AttributeError(
+            f"_LazyFilteredBatch (the lazy predicate-filtered RecordBatch "
+            f"view) exposes only column()/num_rows/schema, not {name!r}; "
+            f"teach the view that attribute or filter eagerly in "
+            f"CompiledProjection"
+        )
+
 
 class CompiledProjection:
     """Projection (+ optional pre-filter): the runtime form handed to

@@ -91,8 +91,11 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
 
         try:
             seconds = min(float(request.query.get("seconds", 5)), 60.0)
+            # row budget for the pstats table: stage-budget consumers
+            # (tools/mesh_profile.py) need the long tail, humans don't
+            limit = min(int(request.query.get("limit", 60)), 1000)
         except ValueError:
-            return web.Response(status=400, text="bad seconds\n")
+            return web.Response(status=400, text="bad seconds/limit\n")
         sort = request.query.get("sort", "tottime")
         if sort not in ("tottime", "cumulative", "ncalls"):
             return web.Response(status=400, text="bad sort\n")
@@ -107,7 +110,7 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
             finally:
                 pr.disable()
         buf = io.StringIO()
-        pstats.Stats(pr, stream=buf).sort_stats(sort).print_stats(60)
+        pstats.Stats(pr, stream=buf).sort_stats(sort).print_stats(limit)
         return web.Response(text=buf.getvalue(), content_type="text/plain")
 
     app = web.Application()

@@ -351,6 +351,53 @@ static PyObject* SlotDir_get_bin(SlotDir* self, PyObject* args) {
     return Py_BuildValue("(NN)", keys, slots);
 }
 
+// get_bins(bins_i64) -> (keys_bytes, slots_bytes) concatenated over the
+// requested bins, WITHOUT removing — the sliding-window merge reads
+// width/slide bins per emission and only ever concatenates them, so one
+// batched crossing replaces k get_bin calls (and k python-side concats).
+static PyObject* SlotDir_get_bins(SlotDir* self, PyObject* args) {
+    PyObject* bins_obj;
+    if (!PyArg_ParseTuple(args, "O", &bins_obj)) return nullptr;
+    Py_buffer bins;
+    if (get_i64_buffer(bins_obj, &bins) != 0) return nullptr;
+    Py_ssize_t nb = bins.len / 8;
+    const int64_t* bq = (const int64_t*)bins.buf;
+    const int stride = self->stride;
+    // size pass: total live entries across the requested bins
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < nb; i++) {
+        BinHead* bh = bin_lookup(self, bq[i], false);
+        if (bh) total += bh->count;
+    }
+    PyObject* keys = PyBytes_FromStringAndSize(
+        nullptr, total * 8 * stride);
+    PyObject* slots = PyBytes_FromStringAndSize(nullptr, total * 8);
+    if (!keys || !slots) {
+        PyBuffer_Release(&bins);
+        Py_XDECREF(keys);
+        Py_XDECREF(slots);
+        return nullptr;
+    }
+    int64_t* kout = (int64_t*)PyBytes_AS_STRING(keys);
+    int64_t* sout = (int64_t*)PyBytes_AS_STRING(slots);
+    Py_ssize_t i_out = 0;
+    for (Py_ssize_t i = 0; i < nb; i++) {
+        BinHead* bh = bin_lookup(self, bq[i], false);
+        if (!bh) continue;
+        int32_t idx = bh->head;
+        while (idx >= 0) {
+            const Entry& e = (*self->entries)[idx];
+            memcpy(kout + (size_t)i_out * stride, entry_keys(self, idx),
+                   stride * sizeof(int64_t));
+            sout[i_out] = e.slot;
+            i_out++;
+            idx = e.next_in_bin;
+        }
+    }
+    PyBuffer_Release(&bins);
+    return Py_BuildValue("(NN)", keys, slots);
+}
+
 // keys_for_slots(slots_bytes) -> (present_bytes u8, bins_bytes, keys_bytes):
 // resolve slots back to their live (bin, key) via the reverse index —
 // O(len(slots)), the updating aggregate's per-batch dirty tracking.
@@ -564,6 +611,8 @@ static PyMethodDef SlotDir_methods[] = {
      "take_bin(bin) -> (keys bytes, slots bytes)"},
     {"get_bin", (PyCFunction)SlotDir_get_bin, METH_VARARGS,
      "get_bin(bin) -> (keys bytes, slots bytes) without removing"},
+    {"get_bins", (PyCFunction)SlotDir_get_bins, METH_VARARGS,
+     "get_bins(bins_i64) -> concatenated (keys, slots) bytes, no removal"},
     {"lookup", (PyCFunction)SlotDir_lookup, METH_VARARGS,
      "lookup(bin, keys_i64) -> (present u8, slots) bytes"},
     {"remove", (PyCFunction)SlotDir_remove, METH_VARARGS,

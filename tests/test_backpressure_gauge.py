@@ -1,0 +1,61 @@
+"""Backpressure gauge freshness (ADVICE round 5): the sampled gauge must
+not hold a stale value once a stream quiesces — expose/snapshot refresh
+it through the registered scrape-time refresher, and the refresher
+unregisters itself when its collector is garbage-collected."""
+
+import gc
+
+from arroyo_tpu import metrics
+from arroyo_tpu.operators.collector import Collector
+
+
+class _StubQueue:
+    def __init__(self):
+        self.value = 0.0
+
+    def fullness(self):
+        return self.value
+
+
+class _StubEdge:
+    def __init__(self, queues):
+        self.queues = queues
+
+
+def _gauge_value(job, task):
+    snap = metrics.REGISTRY.snapshot()["arroyo_worker_backpressure"]
+    for labels, v in snap:
+        if labels == {"job": job, "task": task}:
+            return v
+    return None
+
+
+def test_gauge_refreshes_at_scrape_without_collect():
+    q = _StubQueue()
+    c = Collector([_StubEdge([q])], task_id="t-bp", job_id="j-bp")
+    # no collect() ever ran; occupancy changes while the stream is idle
+    q.value = 0.75
+    assert _gauge_value("j-bp", "t-bp") == 0.75
+    q.value = 0.0
+    assert _gauge_value("j-bp", "t-bp") == 0.0
+    # expose() path refreshes too
+    q.value = 0.5
+    assert 'task="t-bp"} 0.5' in metrics.REGISTRY.expose()
+    del c
+
+
+def test_refresher_unregisters_when_collector_collected():
+    q = _StubQueue()
+    c = Collector([_StubEdge([q])], task_id="t-bp2", job_id="j-bp2")
+    q.value = 0.25
+    assert _gauge_value("j-bp2", "t-bp2") == 0.25
+    del c
+    gc.collect()
+    q.value = 0.9
+    # refresher dropped: the last refreshed value persists, the dead
+    # collector's queues are no longer consulted (and not leaked)
+    assert _gauge_value("j-bp2", "t-bp2") == 0.25
+    assert not any(
+        k == (("job", "j-bp2"), ("task", "t-bp2"))
+        for k in metrics.BACKPRESSURE.refreshers
+    )

@@ -525,14 +525,15 @@ def _append_baseline_md(state, bench_json, baseline, g_events):
 
 # -------------------------------------------------------------- parent
 
-def run_one_probe(child_env=None) -> bool:
-    """One parent cycle. Returns True if a grant produced numbers."""
+def run_one_probe() -> bool:
+    """One parent cycle. Returns True if a grant produced numbers.
+    The probe child inherits this process's environment (the selftest
+    configures overrides on the whole --once process env)."""
     import queue
 
     cmd = [sys.executable, os.path.abspath(__file__), "--probe"]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
-                            stderr=subprocess.STDOUT, cwd=REPO,
-                            env=child_env)
+                            stderr=subprocess.STDOUT, cwd=REPO)
     q: "queue.Queue" = queue.Queue()
 
     def reader():

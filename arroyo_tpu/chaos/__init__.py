@@ -1,0 +1,86 @@
+"""Deterministic fault injection ("chaos") for exactly-once drills.
+
+The engine's riskiest protocols — barrier alignment under data-plane loss,
+manifest CAS publication, generation fencing, 2PC sink commits — are
+exercised by injecting faults at named points threaded through the
+existing seams (SURVEY §2.8/§5.3; ISSUE 2). Usage:
+
+    from arroyo_tpu import chaos
+    chaos.install(chaos.FaultPlan.seeded(1234, ["network.drop_connection"]))
+    ... run the job ...
+    log = chaos.installed().comparable_log()
+    chaos.clear()
+
+Every fault point is a no-op unless a plan is installed: the production
+hot path pays exactly one `is None` branch per pass (`fire()` below).
+Plans can also be installed from config (`chaos.plan` — inline JSON or a
+file path — and `chaos.seed`), which `WorkerServer.start` and
+`ControllerServer.start` honor, so multi-process clusters pick plans up
+through `ARROYO__CHAOS__*` env overrides.
+
+`chaos/drill.py` runs golden queries through the real embedded cluster
+under a plan and asserts the sink output is byte-identical to the
+fault-free run; `tools/chaos_drill.py` is the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .plan import (  # noqa: F401 - public surface
+    FAULT_POINTS,
+    FaultPlan,
+    FaultSpec,
+    UnknownFaultPoint,
+    check_point,
+)
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Install `plan` process-wide (replacing any current plan)."""
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def installed() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def fire(point: str, **ctx) -> Optional[FaultSpec]:
+    """The injector seams' entry point: None (fast path, no plan) or the
+    FaultSpec that fires on this hit. The seam decides what the fault
+    means; `FAULT_POINTS` documents each point's effect."""
+    if _PLAN is None:
+        return None
+    return _PLAN.fire(point, **ctx)
+
+
+def install_from_config() -> Optional[FaultPlan]:
+    """Install a plan from `chaos.plan` config (inline JSON or a JSON file
+    path) if one is configured and none is installed yet. Idempotent;
+    returns the installed plan (or the existing one)."""
+    global _PLAN
+    if _PLAN is not None:
+        return _PLAN
+    from ..config import config
+
+    raw = (config().chaos.plan or "").strip()
+    if not raw:
+        return None
+    if raw.lstrip().startswith("{"):
+        text = raw
+    else:
+        with open(raw) as f:
+            text = f.read()
+    plan = FaultPlan.from_json(text)
+    if not plan.seed:
+        plan.seed = int(config().chaos.seed or 0)
+    return install(plan)

@@ -1,0 +1,467 @@
+"""Exactly-once verification drills: golden queries under fault plans.
+
+A drill runs one committed golden query (tests/golden/queries/*.sql)
+twice through the REAL embedded cluster — controller + N workers over the
+gRPC control plane and TCP data plane:
+
+  1. fault-free, to establish the reference output (also cross-checked
+     against the committed golden file when one exists), then
+  2. under an installed `FaultPlan` with a throttled source and a fast
+     checkpoint cadence, so worker kills, data-plane drops, and storage
+     faults land mid-stream and force recovery from durable checkpoints.
+
+The drill passes iff the faulted run's canonicalized sink output is
+identical to the fault-free run's AND every scheduled fault actually
+fired (an unfired fault means the protocol wasn't exercised — that's a
+coverage failure, not a pass). The fired-fault log's comparable view is
+a pure function of the plan's seed, which is the reproducibility the
+acceptance criteria pin.
+
+Debezium outputs are compared by merged net state keyed by the query's
+`--pk=` header — the retract/append interleaving is timing-dependent,
+the net state is not (same canonicalization as tests/test_golden.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import random
+from typing import Callable, Dict, List, Optional
+
+from .. import chaos
+from ..utils.logging import get_logger
+from .plan import FaultPlan
+
+logger = get_logger("chaos.drill")
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_GOLDEN_DIR = os.path.join(REPO_ROOT, "tests", "golden")
+
+# acceptance set: one windowed aggregate, one join, one updating query
+DEFAULT_DRILL_QUERIES = (
+    "hourly_by_event_type",   # tumbling windowed aggregate
+    "offset_impulse_join",    # windowed join across two sources
+    "updating_aggregate",     # updating aggregate with retractions
+)
+
+
+# -- golden-query plumbing (mirrors tests/test_golden.py) --------------------
+
+
+def query_headers(path: str) -> Dict[str, str]:
+    headers = {}
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("--") or "=" not in line:
+            break
+        k, v = line[2:].split("=", 1)
+        headers[k.strip()] = v.strip()
+    return headers
+
+
+def register_query_udfs(headers: Dict[str, str], golden_dir: str) -> None:
+    if "udf" in headers:
+        from ..udf import registry
+
+        src = open(os.path.join(golden_dir, headers["udf"])).read()
+        registry.register_from_source(src)
+
+
+def load_query(path: str, output_path: str, golden_dir: str,
+               throttle: Optional[float] = None) -> str:
+    sql = open(path).read()
+    sql = sql.replace("$input_dir", os.path.join(golden_dir, "inputs"))
+    sql = sql.replace("$output_path", output_path)
+    if throttle:
+        sql = sql.replace(
+            "type = 'source'",
+            f"type = 'source',\n  throttle_per_sec = '{throttle}'",
+        )
+    return sql
+
+
+def read_rows(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def canonical(rows: List[dict]) -> List[str]:
+    return sorted(json.dumps(r, sort_keys=True, default=str) for r in rows)
+
+
+def merge_debezium(rows: List[dict], pk: List[str]) -> List[dict]:
+    state = {}
+    for env in rows:
+        if env["op"] == "d":
+            key = tuple(env["before"][c] for c in pk)
+            state.pop(key, None)
+        else:
+            row = env["after"]
+            state[tuple(row[c] for c in pk)] = row
+    return [state[k] for k in sorted(state)]
+
+
+def canonicalize_output(path: str, sql: str,
+                        headers: Dict[str, str]) -> List[str]:
+    rows = read_rows(path)
+    if "debezium_json" in sql:
+        pk = headers.get("pk", "").split(",") if headers.get("pk") else None
+        assert pk, "debezium drill queries need a --pk= header"
+        return canonical(merge_debezium(rows, pk))
+    return canonical(rows)
+
+
+# -- fault plans -------------------------------------------------------------
+
+
+def standard_plan(seed: int) -> FaultPlan:
+    """The acceptance plan: SIGKILL a worker mid-window, drop a data-plane
+    connection, and fail a manifest CAS write — each at a seed-chosen hit
+    index. Hit windows are small enough that every fault is reachable in
+    a throttled multi-second run, so the full schedule always fires and
+    the comparable fired log equals `plan.expected_log()`."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    # heartbeat ticks arrive every worker.heartbeat_interval across all
+    # in-process workers (2 workers at 0.1s ≈ 20 hits/s): hits 8-16 land
+    # the kill 0.4-0.8s in — after the job is Running, well before the
+    # throttled source drains
+    plan.add("worker.kill", at_hits=(rng.randint(8, 16),))
+    plan.add("network.drop_connection", at_hits=(rng.randint(4, 16),))
+    plan.add(
+        "storage.cas_conflict",
+        at_hits=(rng.randint(1, 2),),
+        match={"key": "checkpoint-manifest"},
+    )
+    return plan
+
+
+def fast_plan(seed: int) -> FaultPlan:
+    """Smoke plan for the default (tier-1) suite: two quickly-detected
+    faults, no heartbeat-timeout wait."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    plan.add("network.drop_connection", at_hits=(rng.randint(3, 10),))
+    plan.add(
+        "storage.cas_conflict",
+        at_hits=(1,),
+        match={"key": "checkpoint-manifest"},
+    )
+    return plan
+
+
+# -- drill execution ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DrillResult:
+    query: str
+    seed: int
+    passed: bool
+    rows: int
+    restarts: int
+    fired: List[dict]          # full fired-fault log (wall-clock + ctx)
+    comparable_log: List[dict]  # the reproducible view
+    expected_log: List[dict]
+    unfired: List[dict]
+    error: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _run_embedded(sql: str, job_id: str, storage_url: Optional[str],
+                  n_workers: int, parallelism: int, max_restarts: int,
+                  heartbeat_interval: float, heartbeat_timeout: float,
+                  checkpoint_interval: float, timeout: float) -> int:
+    """One job through controller + embedded workers; returns restarts.
+    Raises on FAILED."""
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    async def go():
+        with update(
+            worker={"heartbeat_interval": heartbeat_interval},
+            controller={"heartbeat_timeout": heartbeat_timeout},
+            pipeline={"checkpointing": {"interval": checkpoint_interval}},
+        ):
+            c = await ControllerServer(
+                EmbeddedScheduler(), max_restarts=max_restarts
+            ).start()
+            try:
+                await c.submit_job(
+                    job_id, sql=sql, storage_url=storage_url,
+                    n_workers=n_workers, parallelism=parallelism,
+                )
+                state = await c.wait_for_state(
+                    job_id, JobState.FINISHED, JobState.FAILED,
+                    timeout=timeout,
+                )
+                job = c.jobs[job_id]
+                if state != JobState.FINISHED:
+                    raise RuntimeError(
+                        f"drill job {job_id} failed: {job.failure}"
+                    )
+                return job.restarts
+            finally:
+                await c.stop()
+
+    return asyncio.run(go())
+
+
+def run_drill(
+    query_name: str,
+    seed: int,
+    workdir: str,
+    plan_factory: Callable[[int], FaultPlan] = standard_plan,
+    golden_dir: str = DEFAULT_GOLDEN_DIR,
+    n_workers: int = 2,
+    parallelism: int = 2,
+    throttle: float = 150.0,
+    heartbeat_interval: float = 0.1,
+    heartbeat_timeout: float = 1.5,
+    checkpoint_interval: float = 0.15,
+    timeout: float = 120.0,
+) -> DrillResult:
+    """Run one golden query fault-free, then under `plan_factory(seed)`,
+    and verify byte-identical canonical sink output."""
+    query_path = os.path.join(golden_dir, "queries", f"{query_name}.sql")
+    headers = query_headers(query_path)
+    register_query_udfs(headers, golden_dir)
+    os.makedirs(workdir, exist_ok=True)
+
+    # 1. fault-free reference through the same embedded cluster
+    clean_out = os.path.join(workdir, f"{query_name}-clean.json")
+    clean_sql = load_query(query_path, clean_out, golden_dir)
+    assert chaos.installed() is None, "a fault plan is already installed"
+    _run_embedded(
+        clean_sql, f"drill-{query_name}-clean", None, n_workers, parallelism,
+        max_restarts=0, heartbeat_interval=heartbeat_interval,
+        heartbeat_timeout=30.0, checkpoint_interval=60.0, timeout=timeout,
+    )
+    want = canonicalize_output(clean_out, clean_sql, headers)
+    if not want:
+        raise RuntimeError(f"{query_name}: fault-free run produced no output")
+    golden_file = os.path.join(golden_dir, "golden_outputs",
+                               f"{query_name}.json")
+    if os.path.exists(golden_file):
+        committed = [line.strip() for line in open(golden_file)]
+        if want != committed:
+            raise RuntimeError(
+                f"{query_name}: fault-free embedded-cluster output "
+                "diverges from the committed golden — fix that before "
+                "trusting any drill"
+            )
+
+    # 2. faulted run: throttled source + fast checkpoint cadence so the
+    # scheduled faults land mid-stream
+    fault_out = os.path.join(workdir, f"{query_name}-faulted.json")
+    fault_sql = load_query(query_path, fault_out, golden_dir,
+                           throttle=throttle)
+    plan = chaos.install(plan_factory(seed))
+    error = None
+    restarts = 0
+    try:
+        restarts = _run_embedded(
+            fault_sql, f"drill-{query_name}-faulted",
+            os.path.join(workdir, f"{query_name}-ck"), n_workers,
+            parallelism, max_restarts=8,
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=heartbeat_timeout,
+            checkpoint_interval=checkpoint_interval, timeout=timeout,
+        )
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+    finally:
+        chaos.clear()
+
+    got = canonicalize_output(fault_out, fault_sql, headers)
+    passed = error is None and got == want and not plan.unfired()
+    if error is None and got != want:
+        error = (
+            f"output diverged: {len(got)} rows vs {len(want)} fault-free"
+        )
+    if error is None and plan.unfired():
+        error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    return DrillResult(
+        query=query_name,
+        seed=seed,
+        passed=passed,
+        rows=len(got),
+        restarts=restarts,
+        fired=plan.fired_events,
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+    )
+
+
+def run_drills(query_names, seed: int, workdir: str,
+               plan_factory: Callable[[int], FaultPlan] = standard_plan,
+               **kw) -> List[DrillResult]:
+    out = []
+    for i, name in enumerate(query_names):
+        logger.info("drill %d/%d: %s (seed %s)", i + 1, len(query_names),
+                    name, seed)
+        out.append(run_drill(name, seed, os.path.join(workdir, name),
+                             plan_factory=plan_factory, **kw))
+    return out
+
+
+# -- kafka drill (in-memory fake broker, real connector operators) -----------
+
+
+KAFKA_DRILL_SQL = """
+CREATE TABLE src (
+  n BIGINT
+) WITH (
+  connector = 'kafka', bootstrap_servers = 'fake:9092', topic = 'in',
+  type = 'source', format = 'json', source.offset = 'earliest'
+);
+CREATE TABLE dst (
+  n BIGINT
+) WITH (
+  connector = 'kafka', bootstrap_servers = 'fake:9092', topic = 'out',
+  type = 'sink', format = 'json', sink.commit_mode = 'exactly_once'
+);
+INSERT INTO dst SELECT n * 10 as n FROM src;
+"""
+
+
+def kafka_plan(seed: int) -> FaultPlan:
+    """Kill a worker mid-transaction and lose a manifest CAS: the fenced
+    producer epochs + 2PC commit records must still deliver each row
+    exactly once through the transactional sink."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    plan.add("worker.kill", at_hits=(rng.randint(8, 14),))
+    plan.add(
+        "storage.cas_conflict",
+        at_hits=(rng.randint(1, 2),),
+        match={"key": "checkpoint-manifest"},
+    )
+    return plan
+
+
+def run_kafka_drill(seed: int, workdir: str, n_rows: int = 120,
+                    timeout: float = 90.0) -> DrillResult:
+    """Drive the REAL kafka connector operators over the in-memory fake
+    broker through the embedded cluster under a fault plan; assert the
+    transactional sink's visible (read-committed) output is exactly-once."""
+    import sys
+
+    import arroyo_tpu.connectors.kafka as kmod
+
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+    try:
+        from fake_clients import FakeKafkaBroker
+    finally:
+        sys.path.remove(os.path.join(REPO_ROOT, "tests"))
+
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    broker = FakeKafkaBroker(partitions_per_topic=2)
+    for i in range(n_rows):
+        broker.append("in", i % 2, None, json.dumps({"n": i}).encode(),
+                      committed=True, tx_id=None)
+
+    def visible():
+        out = []
+        for p in sorted(broker.topic("out")):
+            for m in broker.visible("out", p):
+                if m.committed:
+                    out.append(json.loads(m.value())["n"])
+        return sorted(out)
+
+    plan = chaos.install(kafka_plan(seed))
+    orig = kmod._load_client
+    kmod._load_client = lambda: broker.make_module()
+    error = None
+    restarts = 0
+
+    async def go():
+        with update(
+            worker={"heartbeat_interval": 0.1},
+            # generous timeout: a loaded CI host must not misread an
+            # event-loop stall as the injected kill
+            controller={"heartbeat_timeout": 2.0},
+            pipeline={"checkpointing": {"interval": 0.15}},
+        ):
+            c = await ControllerServer(
+                EmbeddedScheduler(), max_restarts=8
+            ).start()
+            try:
+                await c.submit_job(
+                    "kafka-drill", sql=KAFKA_DRILL_SQL,
+                    storage_url=os.path.join(workdir, "ck"), n_workers=2,
+                    parallelism=1,
+                )
+                await c.wait_for_state("kafka-drill", JobState.RUNNING,
+                                       timeout=30)
+                # wait for the transactional sink to commit every row
+                import time
+
+                deadline = time.monotonic() + timeout
+                while len(visible()) < n_rows:
+                    if time.monotonic() > deadline:
+                        break
+                    if c.jobs["kafka-drill"].state == JobState.FAILED:
+                        raise RuntimeError(
+                            f"kafka drill failed: "
+                            f"{c.jobs['kafka-drill'].failure}"
+                        )
+                    await asyncio.sleep(0.05)
+                await c.stop_job("kafka-drill", "checkpoint")
+                await c.wait_for_state(
+                    "kafka-drill", JobState.STOPPED, JobState.FAILED,
+                    timeout=60,
+                )
+                return c.jobs["kafka-drill"].restarts
+            finally:
+                await c.stop()
+
+    try:
+        os.makedirs(workdir, exist_ok=True)
+        restarts = asyncio.run(go())
+    except Exception as e:  # noqa: BLE001
+        error = repr(e)
+    finally:
+        kmod._load_client = orig
+        chaos.clear()
+
+    got = visible()
+    want = sorted(i * 10 for i in range(n_rows))
+    passed = error is None and got == want and not plan.unfired()
+    if error is None and got != want:
+        dupes = len(got) - len(set(got))
+        error = (
+            f"kafka output not exactly-once: {len(got)} visible rows "
+            f"({dupes} duplicates) vs {n_rows} produced"
+        )
+    if error is None and plan.unfired():
+        error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    return DrillResult(
+        query="kafka_exactly_once",
+        seed=seed,
+        passed=passed,
+        rows=len(got),
+        restarts=restarts,
+        fired=plan.fired_events,
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+    )

@@ -1,0 +1,249 @@
+"""Deterministic fault plans for the chaos subsystem.
+
+A `FaultPlan` is a seeded schedule of named fault points. Seams in the
+engine call `chaos.fire("<point>")` on every pass through an injectable
+operation; the plan counts hits per spec (after context filtering) and
+answers "does this fault fire on this hit". Firing decisions are pure
+functions of the plan's specs — `at_hits` indices chosen when the plan is
+built (optionally from a seed) — so the same seed over the same workload
+produces the same fired-fault log, which is the reproducibility contract
+the exactly-once drills assert (ISSUE 2 acceptance; SURVEY §5.3).
+
+The registry below is the single source of truth for fault-point names:
+`plan.add()` and `chaos.fire()` both reject unknown names, and
+`tests/test_chaos.py` cross-checks every `chaos.fire(...)` call site in
+the codebase against it, so a new seam cannot silently go unlisted in
+`tools/chaos_drill.py --list`.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+# -- fault-point registry ----------------------------------------------------
+
+# name -> (seam, effect description). Keep in sync with the chaos.fire()
+# call sites; tests/test_chaos.py enforces the bijection.
+FAULT_POINTS: Dict[str, str] = {
+    # TCP data plane (engine/network.py)
+    "network.connect_delay": (
+        "engine/network.py RemoteEdgeSender.start — delay the outgoing "
+        "data-plane connect by params.delay seconds (reconnect latency)"
+    ),
+    "network.drop_connection": (
+        "engine/network.py RemoteEdgeSender._pump — close the socket and "
+        "fail the pump mid-stream (surfaces as a data-plane task failure; "
+        "the controller recovers from the latest checkpoint)"
+    ),
+    "network.partial_frame": (
+        "engine/network.py RemoteEdgeSender._pump — write a truncated "
+        "Arrow-IPC frame, then drop the connection (receiver must discard "
+        "the torn frame, never deliver it)"
+    ),
+    # worker lifecycle (engine/worker.py)
+    "worker.kill": (
+        "engine/worker.py WorkerServer._heartbeat — SIGKILL-equivalent "
+        "abrupt teardown of the worker (runners cancelled, servers closed, "
+        "heartbeats stop; detected via heartbeat timeout)"
+    ),
+    "worker.heartbeat_blackout": (
+        "engine/worker.py WorkerServer._heartbeat — stop heartbeating for "
+        "params.duration seconds while subtasks keep running (tests the "
+        "controller's liveness view vs a wedged-but-alive worker)"
+    ),
+    "worker.slow_barrier_ack": (
+        "engine/worker.py WorkerServer.checkpoint — delay the barrier "
+        "fan-out to sources by params.delay seconds (stretches barrier "
+        "alignment windows)"
+    ),
+    # object storage (state/storage.py)
+    "storage.write_fail": (
+        "state/storage.py StorageProvider.put — raise a transient IOError "
+        "instead of writing (checkpoint data-file write failure)"
+    ),
+    "storage.cas_conflict": (
+        "state/storage.py StorageProvider.put_if_not_exists — raise "
+        "CasConflict WITHOUT creating the key (lost CAS race; scope with "
+        "match={'key': 'checkpoint-manifest'} for manifest publishes)"
+    ),
+    "storage.latency": (
+        "state/storage.py StorageProvider.put/get — sleep params.delay "
+        "seconds before the operation (slow object store)"
+    ),
+    # checkpoint protocol (state/protocol.py)
+    "protocol.fenced_zombie": (
+        "state/protocol.py check_current — treat the caller's generation "
+        "as superseded and raise Fenced (zombie writer resurrect: a "
+        "fenced controller must not publish; recovery claims a fresh "
+        "generation)"
+    ),
+}
+
+
+class UnknownFaultPoint(KeyError):
+    pass
+
+
+def check_point(name: str) -> str:
+    if name not in FAULT_POINTS:
+        raise UnknownFaultPoint(
+            f"unknown fault point {name!r}; known: {sorted(FAULT_POINTS)}"
+        )
+    return name
+
+
+# -- specs and plans ---------------------------------------------------------
+
+
+class FaultSpec:
+    """One scheduled fault: fire at the given (1-based) hit indices of a
+    fault point, optionally only for hits whose context matches (substring
+    match per key), at most `max_fires` times."""
+
+    def __init__(self, point: str, at_hits: Sequence[int] = (1,),
+                 match: Optional[Dict[str, str]] = None,
+                 params: Optional[Dict[str, Any]] = None,
+                 max_fires: int = 1):
+        self.point = check_point(point)
+        self.at_hits = tuple(sorted(int(h) for h in at_hits))
+        if not self.at_hits or self.at_hits[0] < 1:
+            raise ValueError(f"at_hits must be 1-based positive: {at_hits}")
+        self.match = dict(match or {})
+        self.params = dict(params or {})
+        self.max_fires = max_fires
+        self.hits = 0      # matching hits observed
+        self.fired = 0     # times this spec fired
+
+    def matches(self, ctx: Dict[str, Any]) -> bool:
+        return all(
+            str(want) in str(ctx.get(key, "")) for key, want in self.match.items()
+        )
+
+    def param(self, key: str, default: Any = None) -> Any:
+        return self.params.get(key, default)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "point": self.point,
+            "at_hits": list(self.at_hits),
+            "match": self.match,
+            "params": self.params,
+            "max_fires": self.max_fires,
+        }
+
+    def __repr__(self):
+        return f"FaultSpec({self.point!r}, at_hits={self.at_hits})"
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults plus the log of what
+    actually fired. Thread-safe: storage seams run under to_thread."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = []
+        self.fired_events: List[Dict[str, Any]] = []
+        self._lock = threading.RLock()
+
+    # -- construction -------------------------------------------------------
+
+    def add(self, point: str, at_hits: Sequence[int] = (1,),
+            match: Optional[Dict[str, str]] = None,
+            params: Optional[Dict[str, Any]] = None,
+            max_fires: int = 1) -> "FaultPlan":
+        self.specs.append(FaultSpec(point, at_hits, match, params, max_fires))
+        return self
+
+    @classmethod
+    def seeded(cls, seed: int, points: Sequence[str],
+               hit_range: tuple = (1, 6)) -> "FaultPlan":
+        """One fault per named point, each at a seed-chosen hit index.
+        Points are processed in the given order so the same (seed, points)
+        always builds the identical plan."""
+        rng = random.Random(int(seed))
+        plan = cls(seed)
+        for p in points:
+            plan.add(p, at_hits=(rng.randint(*hit_range),))
+        return plan
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        obj = json.loads(text)
+        plan = cls(obj.get("seed", 0))
+        for f in obj.get("faults", []):
+            plan.add(
+                f["point"],
+                at_hits=f.get("at_hits", (1,)),
+                match=f.get("match"),
+                params=f.get("params"),
+                max_fires=f.get("max_fires", 1),
+            )
+        return plan
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "faults": [s.describe() for s in self.specs]}
+        )
+
+    # -- runtime ------------------------------------------------------------
+
+    def fire(self, point: str, **ctx) -> Optional[FaultSpec]:
+        """Count a hit of `point` against every matching spec; return the
+        first spec that fires on this hit (None otherwise)."""
+        check_point(point)
+        with self._lock:
+            for spec in self.specs:
+                if spec.point != point or not spec.matches(ctx):
+                    continue
+                spec.hits += 1
+                if spec.hits in spec.at_hits and spec.fired < spec.max_fires:
+                    spec.fired += 1
+                    self.fired_events.append({
+                        "seq": len(self.fired_events),
+                        "time": time.time(),
+                        "point": point,
+                        "hit": spec.hits,
+                        "match": spec.match,
+                        "params": spec.params,
+                        "ctx": {k: str(v)[:120] for k, v in ctx.items()},
+                    })
+                    return spec
+        return None
+
+    # -- logs ---------------------------------------------------------------
+
+    def comparable_log(self) -> List[Dict[str, Any]]:
+        """The reproducible view of the fired-fault log: which specs fired,
+        at which configured hit, with which parameters — sorted so
+        concurrency can't reorder it. Excludes wall-clock and runtime
+        context, which legitimately vary between identical-seed runs."""
+        return sorted(
+            (
+                {"point": e["point"], "hit": e["hit"], "match": e["match"],
+                 "params": e["params"]}
+                for e in self.fired_events
+            ),
+            key=lambda e: (e["point"], e["hit"], json.dumps(e["match"],
+                                                            sort_keys=True)),
+        )
+
+    def expected_log(self) -> List[Dict[str, Any]]:
+        """What comparable_log() must equal when every spec fires to its
+        max_fires: the deterministic schedule implied by (seed, specs)."""
+        out = []
+        for s in self.specs:
+            for hit in s.at_hits[: s.max_fires]:
+                out.append({"point": s.point, "hit": hit, "match": s.match,
+                            "params": s.params})
+        return sorted(
+            out,
+            key=lambda e: (e["point"], e["hit"], json.dumps(e["match"],
+                                                            sort_keys=True)),
+        )
+
+    def unfired(self) -> List[FaultSpec]:
+        return [s for s in self.specs if s.fired < s.max_fires]

@@ -150,6 +150,17 @@ class TpuConfig:
 
 
 @dataclasses.dataclass
+class ChaosConfig:
+    """Deterministic fault injection (arroyo_tpu/chaos). `plan` is inline
+    JSON or a path to a JSON plan file ({"seed": ..., "faults": [...]});
+    empty = chaos fully disabled (every fault point is a single-branch
+    no-op). `seed` backfills a plan that doesn't carry its own."""
+
+    plan: str = ""
+    seed: int = 0
+
+
+@dataclasses.dataclass
 class ControllerConfig:
     rpc_port: int = 9190
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
@@ -167,6 +178,10 @@ class WorkerConfig:
     data_port: int = 0
     task_slots: int = 4
     bind_address: str = "127.0.0.1"
+    # seconds between worker -> controller heartbeats; the controller's
+    # controller.heartbeat_timeout must exceed this or liveness checks
+    # fire spuriously (chaos drills shrink both to speed kill detection)
+    heartbeat_interval: float = 2.0
 
 
 @dataclasses.dataclass
@@ -227,6 +242,7 @@ class TlsConfig:
 class Config:
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)

@@ -248,6 +248,22 @@ class KafkaSink(Operator):
             self.producer = self._make_producer(ctx, self.epoch)
             ctx.commit_data = json.dumps({"epoch": barrier.epoch}).encode()
 
+    async def on_close(self, ctx, collector, is_eod: bool):
+        """Abort the current open transaction on teardown: it holds only
+        post-barrier rows no checkpoint covers, so exactly-once semantics
+        require them re-emitted by a restore, never half-exposed. (A real
+        broker would do this via transaction timeout / fencing; doing it
+        eagerly keeps the broker's open-transaction table clean.)"""
+        if self.semantics == "exactly_once" and self.producer is not None:
+            try:
+                self.producer.flush(5)
+                self.producer.abort_transaction(5)
+            except Exception:  # noqa: BLE001 - already fenced/closed is fine
+                pass
+        elif self.producer is not None:
+            self.producer.flush(30)
+        return None
+
     async def handle_commit(self, epoch, commit_data, ctx):
         if self.semantics != "exactly_once":
             return

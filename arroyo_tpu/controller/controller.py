@@ -18,6 +18,7 @@ import asyncio
 import time
 from typing import Dict, List, Optional
 
+from .. import chaos
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..state.backend import StateBackend
@@ -107,6 +108,7 @@ class ControllerServer:
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> "ControllerServer":
+        chaos.install_from_config()
         self.rpc.add_service(
             "ControllerGrpc",
             {
@@ -285,7 +287,19 @@ class ControllerServer:
                 job.state = JobState.FAILED
 
     async def _schedule(self, job: JobHandle, n_workers: int):
-        """reference scheduling.rs:65-100."""
+        """reference scheduling.rs:65-100. Worker-facing failures (a
+        worker dying between registration and StartExecution, a
+        registration timeout) are retryable: they route through
+        Recovering — bounded by max_restarts — instead of crashing the
+        job driver into FAILED."""
+        try:
+            await self._schedule_inner(job, n_workers)
+        except Exception as e:  # noqa: BLE001 - scheduling is retryable
+            logger.warning("job %s scheduling failed: %r", job.job_id, e)
+            job.failure = f"scheduling failed: {e!r}"
+            job.transition(JobState.RECOVERING)
+
+    async def _schedule_inner(self, job: JobHandle, n_workers: int):
         if job.storage_url and job.backend is None:
             job.backend = StateBackend(job.storage_url, job.job_id).initialize()
         await self.scheduler.start_workers(self.addr, n_workers, job.job_id)
@@ -415,6 +429,13 @@ class ControllerServer:
                                         pass
                     else:
                         await self._checkpoint(job, then_stop=True)
+                    if job.failure is not None:
+                        # the stopping checkpoint could not publish
+                        # (storage fault / fencing): don't pretend the
+                        # state is durable — recover and retry the stop
+                        job.stop_requested = mode
+                        job.transition(JobState.RECOVERING)
+                        return
                     await self._await_all_finished(job)
                     job.transition(JobState.STOPPED)
                 else:
@@ -455,6 +476,16 @@ class ControllerServer:
             if job.failure is not None or time.monotonic() > deadline:
                 logger.warning("checkpoint %d incomplete", epoch)
                 return
+            if self._heartbeat_expired(job):
+                # a worker died mid-barrier: its subtasks can never report,
+                # so don't sit out the full checkpoint deadline — surface
+                # the liveness failure now and let _run recover
+                logger.warning(
+                    "checkpoint %d abandoned: worker heartbeat timeout",
+                    epoch,
+                )
+                job.failure = "worker heartbeat timeout"
+                return
             if len(job.finished_tasks) >= job.n_subtasks:
                 # the job completed while the barrier was in flight; a
                 # finished task can never report, so stop waiting and let
@@ -463,33 +494,66 @@ class ControllerServer:
                 return
             await asyncio.sleep(0.02)
         reports = job.checkpoints[epoch]
-        manifest = job.backend.publish_checkpoint(
-            epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
-        )
-        if manifest.get("committing") and job.backend.claim_commit(epoch):
-            for w in job.workers:
-                await w.client.call(
-                    "WorkerGrpc", "Commit",
-                    {"epoch": epoch, "committing": manifest["committing"]},
-                )
+        try:
+            manifest = job.backend.publish_checkpoint(
+                epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
+            )
+        except Exception as e:  # noqa: BLE001 - storage/protocol boundary
+            # transient write failures, lost CAS races, and zombie fencing
+            # must not crash the job driver into FAILED: the epoch is
+            # abandoned and the failure routes through Recovering, which
+            # claims a fresh generation and restores the latest durable
+            # manifest — exactly-once is preserved by the restore, not by
+            # this epoch
+            logger.warning("checkpoint %d publish failed: %r", epoch, e)
+            job.failure = f"checkpoint {epoch} publish failed: {e!r}"
+            return
+        try:
+            committing = manifest.get("committing")
+            if committing and job.backend.claim_commit(epoch):
+                # target only workers hosting committing subtasks: a
+                # source-only worker legitimately finishes and closes its
+                # rpc server right after a then_stop barrier, and a
+                # refused no-op commit must not fail the epoch (sink
+                # workers stay up in committing state until this lands)
+                commit_workers = {
+                    wid for (node_id, _sub), wid in job.assignments.items()
+                    if str(node_id) in committing
+                }
+                for w in job.workers:
+                    if w.worker_id not in commit_workers:
+                        continue
+                    await w.client.call(
+                        "WorkerGrpc", "Commit",
+                        {"epoch": epoch, "committing": committing},
+                    )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("checkpoint %d commit phase failed: %r", epoch, e)
+            job.failure = f"checkpoint {epoch} commit phase failed: {e!r}"
+            return
         # compaction cadence: merge small carried-forward files (off the
         # event loop — merges are data-proportional), tell the owning
         # subtasks to swap references, GC unreferenced epochs. Advisory:
-        # a failed swap delivery must not fail the job (old files stay
-        # referenced until the swap lands in a later manifest).
-        swaps = await asyncio.to_thread(
-            job.backend.compact_epoch, epoch, manifest
-        )
-        for swap in swaps:
-            for w in job.workers:
-                try:
-                    await w.client.call("WorkerGrpc", "LoadCompacted", swap)
-                except Exception as e:  # noqa: BLE001
-                    logger.warning(
-                        "LoadCompacted to worker %s failed: %s",
-                        w.worker_id, e,
-                    )
-        await asyncio.to_thread(job.backend.retire_unreferenced)
+        # a failed swap delivery, merge, or GC pass must not fail the job
+        # (old files stay referenced until a later cadence retries).
+        try:
+            swaps = await asyncio.to_thread(
+                job.backend.compact_epoch, epoch, manifest
+            )
+            for swap in swaps:
+                for w in job.workers:
+                    try:
+                        await w.client.call(
+                            "WorkerGrpc", "LoadCompacted", swap
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "LoadCompacted to worker %s failed: %s",
+                            w.worker_id, e,
+                        )
+            await asyncio.to_thread(job.backend.retire_unreferenced)
+        except Exception:  # noqa: BLE001
+            logger.exception("checkpoint %d compaction/GC failed", epoch)
 
     async def _await_all_finished(self, job: JobHandle, timeout: float = 60.0):
         deadline = time.monotonic() + timeout

@@ -130,7 +130,10 @@ def pick_coordinator() -> str:
     worker rank 0 reaches jax.distributed.initialize (process fork +
     jax import later). The window is accepted for the process scheduler
     (single host, ephemeral-range port, job startup is seconds); an
-    operator can pin tpu.mesh_coordinator explicitly to avoid it."""
+    operator can pin tpu.mesh_coordinator explicitly to avoid it. When
+    the race IS lost, workers don't surface jax's bare connect error:
+    parallel/multihost.ensure_initialized raises a RuntimeError naming
+    this coordinator address and pointing at tpu.mesh_coordinator."""
     import socket
 
     with socket.socket() as s:

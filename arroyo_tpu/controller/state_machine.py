@@ -39,7 +39,13 @@ class JobState(enum.Enum):
 TRANSITIONS = {
     JobState.CREATED: {JobState.COMPILING, JobState.SCHEDULING, JobState.FAILED},
     JobState.COMPILING: {JobState.SCHEDULING, JobState.FAILED},
-    JobState.SCHEDULING: {JobState.RUNNING, JobState.FAILED, JobState.STOPPED},
+    # scheduling is retryable (reference states/mod.rs:559 bounded
+    # backoff): a worker dying between registration and StartExecution
+    # recovers instead of crashing the driver
+    JobState.SCHEDULING: {
+        JobState.RUNNING, JobState.FAILED, JobState.STOPPED,
+        JobState.RECOVERING,
+    },
     JobState.RUNNING: {
         JobState.RECOVERING,
         JobState.RESCALING,
@@ -54,7 +60,11 @@ TRANSITIONS = {
     JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},
     JobState.RESTARTING: {JobState.SCHEDULING, JobState.FAILED},
     JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
-    JobState.CHECKPOINT_STOPPING: {JobState.STOPPED, JobState.FAILED},
+    # a stop checkpoint whose publish fails (storage fault, fencing) must
+    # not drop state silently: it recovers and retries the stop
+    JobState.CHECKPOINT_STOPPING: {
+        JobState.STOPPED, JobState.FAILED, JobState.RECOVERING,
+    },
     JobState.FINISHING: {JobState.FINISHED, JobState.FAILED},
     JobState.FAILING: {JobState.FAILED},
 }

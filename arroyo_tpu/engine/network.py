@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 
 import pyarrow as pa
 
+from .. import chaos
 from ..types import (
     CheckpointBarrier,
     SignalKind,
@@ -190,6 +191,10 @@ class RemoteEdgeSender:
     async def start(self):
         from ..utils.tls import data_client_context
 
+        spec = chaos.fire("network.connect_delay", quad=self.quad,
+                          address=self.address)
+        if spec is not None:
+            await asyncio.sleep(float(spec.param("delay", 0.2)))
         host, port = self.address.rsplit(":", 1)
         ctx, server_name = data_client_context()
         _, self.writer = await asyncio.open_connection(
@@ -208,6 +213,30 @@ class RemoteEdgeSender:
                     item = await self.queue.recv()
                 except QueueClosed:
                     return
+                if chaos.fire("network.drop_connection", quad=self.quad):
+                    self.writer.close()
+                    raise ConnectionResetError(
+                        "chaos[network.drop_connection]: injected "
+                        f"data-plane drop on edge {self.quad}"
+                    )
+                spec = chaos.fire("network.partial_frame", quad=self.quad)
+                if spec is not None:
+                    # emit a torn frame: full header, half the payload. The
+                    # receiver's readexactly must fail (never deliver it).
+                    if isinstance(item, SignalMessage):
+                        kind, payload = 1, encode_signal(item)
+                    else:
+                        kind, payload = 0, encode_batch(item)
+                    self.writer.write(
+                        _HEADER.pack(MAGIC, kind, *self.quad, len(payload))
+                    )
+                    self.writer.write(payload[: max(1, len(payload) // 2)])
+                    await self.writer.drain()
+                    self.writer.close()
+                    raise ConnectionResetError(
+                        "chaos[network.partial_frame]: injected torn frame "
+                        f"on edge {self.quad}"
+                    )
                 write_frame(self.writer, self.quad, item)
                 await self.writer.drain()
                 if isinstance(item, SignalMessage) and item.kind in (

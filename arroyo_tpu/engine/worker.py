@@ -15,6 +15,7 @@ import os
 import time
 from typing import Dict, Optional
 
+from .. import chaos
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..operators.control import (
@@ -59,6 +60,7 @@ class WorkerServer:
         self._leader_client: Optional[RpcClient] = None
         self._peer_clients: Dict[int, RpcClient] = {}
         self._worker_rpc_addrs: Dict[int, str] = {}
+        self._assignments: Dict[tuple, int] = {}
         self._leader_reports: Dict[int, Dict[str, dict]] = {}
         self._leader_epoch = 0
         self._lead_interval: Optional[float] = None
@@ -80,6 +82,9 @@ class WorkerServer:
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self):
+        # honor a config-installed fault plan (ARROYO__CHAOS__PLAN reaches
+        # spawned worker subprocesses through the config env layer)
+        chaos.install_from_config()
         self.rpc.add_service(
             "WorkerGrpc",
             {
@@ -128,6 +133,24 @@ class WorkerServer:
 
     async def _heartbeat(self):
         while not self._finished.is_set():
+            if chaos.fire("worker.kill", worker_id=self.worker_id):
+                # SIGKILL-equivalent: tear everything down abruptly, no
+                # goodbye to the controller — it must detect the death via
+                # heartbeat timeout and recover from the last checkpoint
+                logger.warning(
+                    "chaos[worker.kill]: abrupt teardown of worker %s",
+                    self.worker_id,
+                )
+                asyncio.ensure_future(self.shutdown())
+                return
+            spec = chaos.fire("worker.heartbeat_blackout",
+                              worker_id=self.worker_id)
+            if spec is not None:
+                logger.warning(
+                    "chaos[worker.heartbeat_blackout]: worker %s silent "
+                    "for %.1fs", self.worker_id, spec.param("duration", 3.0),
+                )
+                await asyncio.sleep(float(spec.param("duration", 3.0)))
             try:
                 await self.controller.call(
                     "ControllerGrpc", "Heartbeat",
@@ -135,7 +158,7 @@ class WorkerServer:
                 )
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(config().worker.heartbeat_interval)
 
     # -- WorkerGrpc ---------------------------------------------------------
 
@@ -152,6 +175,7 @@ class WorkerServer:
             (a["node_id"], a["subtask"]): a["worker_id"]
             for a in req["assignments"]
         }
+        self._assignments = assignments
         worker_addrs = {
             int(w): addr for w, addr in req["worker_data_addrs"].items()
         }
@@ -218,6 +242,12 @@ class WorkerServer:
         return {}
 
     async def checkpoint(self, req: dict) -> dict:
+        spec = chaos.fire("worker.slow_barrier_ack",
+                          worker_id=self.worker_id, epoch=req.get("epoch"))
+        if spec is not None:
+            # stretch barrier alignment: peers' barriers race ahead while
+            # this worker's sources delay injecting theirs
+            await asyncio.sleep(float(spec.param("delay", 0.5)))
         barrier = CheckpointBarrier(
             epoch=req["epoch"], min_epoch=req.get("min_epoch", 0),
             timestamp=now_nanos(), then_stop=req.get("then_stop", False),
@@ -386,10 +416,18 @@ class WorkerServer:
             epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
         )
         self._leader_durable = epoch
-        if manifest.get("committing") and backend.claim_commit(epoch):
+        committing = manifest.get("committing")
+        if committing and backend.claim_commit(epoch):
+            # same worker targeting as the controller path: only peers
+            # hosting committing subtasks get the phase-2 fan-out
+            commit_workers = {
+                wid for (nid, _sub), wid in self._assignments.items()
+                if str(nid) in committing
+            }
             for wid in self._worker_rpc_addrs:
-                payload = {"epoch": epoch,
-                           "committing": manifest["committing"]}
+                if wid not in commit_workers:
+                    continue
+                payload = {"epoch": epoch, "committing": committing}
                 if wid == self.worker_id:
                     await self.commit(payload)
                 else:
@@ -512,7 +550,11 @@ class WorkerServer:
     async def shutdown(self):
         """Force teardown: cancel every task and close servers/clients so a
         force-stopped embedded worker leaves no heartbeats or runners
-        behind."""
+        behind. Idempotent: a chaos-killed worker is shut down again by
+        the recovery teardown."""
+        if getattr(self, "_shutdown_started", False):
+            return
+        self._shutdown_started = True
         self._finished.set()
         for t in self.tasks:
             t.cancel()

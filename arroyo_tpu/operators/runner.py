@@ -91,6 +91,11 @@ class SubtaskRunner:
         self._barrier_inputs: set[int] = set()
         self._current_barrier = None
         self._stopping = False
+        # committing state (reference states/committing): set to the epoch
+        # of the latest checkpoint that reported commit data; the runner
+        # must not tear down until the phase-2 CommitMsg for it arrives,
+        # or the sealed sink transaction would be stranded uncommitted
+        self._await_commit_epoch: Optional[int] = None
         tid = self.task_info.task_id
         jid = self.task_info.job_id
         self._batches_recv = BATCHES_RECV.labels(job=jid, task=tid)
@@ -289,8 +294,18 @@ class SubtaskRunner:
                                 if not iq.finished:
                                     arm_input(j)
             arm_op_futures()
+        # keep the armed control-queue getter: it may already hold a
+        # retrieved message (e.g. the phase-2 CommitMsg) that cancelling
+        # would silently drop
+        control_task = next(
+            (t for t, tag in pending.items() if tag == "control"), None
+        )
         for t in pending:
-            t.cancel()
+            if t is not control_task:
+                t.cancel()
+        control_task = await self._await_commit(control_task)
+        if control_task is not None:
+            control_task.cancel()
         # end-of-data only when every input actually delivered EOS — an
         # IMMEDIATE stop (crash-like teardown) leaves _finish_kinds empty
         # and must NOT finalize uncommitted sink output (exactly-once:
@@ -307,6 +322,40 @@ class SubtaskRunner:
         await self.tail.broadcast(
             SignalMessage.end_of_data() if is_eod else SignalMessage.stop()
         )
+
+    async def _await_commit(self, control_task, timeout: float = 10.0):
+        """Committing state (reference states/committing.rs): the inputs
+        closed, but the last checkpoint reported commit data whose phase-2
+        CommitMsg hasn't arrived yet — closing now would strand a sealed
+        sink transaction. Keep consuming control messages (bounded) until
+        the commit lands. Skipped on IMMEDIATE stop: crash-like teardown
+        must not finalize anything (recovery replays the epoch)."""
+        import time
+
+        if self._await_commit_epoch is None or self._stopping:
+            return control_task
+        deadline = time.monotonic() + timeout
+        while self._await_commit_epoch is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                logger.warning(
+                    "%s: no commit received for epoch %s within %.0fs; "
+                    "closing with the transaction sealed but uncommitted",
+                    self.task_info.task_id, self._await_commit_epoch,
+                    timeout,
+                )
+                break
+            if control_task is None:
+                control_task = asyncio.ensure_future(self.control_rx.get())
+            try:
+                msg = await asyncio.wait_for(
+                    asyncio.shield(control_task), remaining
+                )
+            except asyncio.TimeoutError:
+                continue  # deadline check above breaks the loop
+            control_task = None
+            await self._handle_control(msg)
+        return control_task
 
     def _all_inputs_finished(self) -> bool:
         return all(iq.finished for iq in self.inputs)
@@ -419,6 +468,8 @@ class SubtaskRunner:
             if ctx.commit_data is not None:
                 commit_data = ctx.commit_data
                 ctx.commit_data = None
+        if commit_data is not None:
+            self._await_commit_epoch = barrier.epoch
         await self.tail.broadcast(SignalMessage.barrier_of(barrier))
         flush = asyncio.ensure_future(
             self._flush_and_report(barrier, captured, commit_data,
@@ -492,6 +543,11 @@ class SubtaskRunner:
         node_data = msg.committing_data.get(self.task_info.node_id, {})
         for op, ctx in zip(self.ops, self.ctxs):
             await op.handle_commit(msg.epoch, node_data, ctx)
+        if (
+            self._await_commit_epoch is not None
+            and msg.epoch >= self._await_commit_epoch
+        ):
+            self._await_commit_epoch = None
 
     async def _load_compacted(self, msg: LoadCompactedMsg):
         for idx, ctx in enumerate(self.ctxs):

@@ -69,9 +69,26 @@ def ensure_initialized() -> Tuple[int, int]:
             "joining %d-process mesh as rank %d (coordinator %s)",
             n_proc, pid, coord,
         )
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=n_proc, process_id=pid
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=n_proc,
+                process_id=pid,
+            )
+        except Exception as e:
+            # the most common cause: the controller auto-picked the
+            # coordinator port (bind-then-close in controller/scheduler.py
+            # pick_coordinator) and something else bound it before rank 0's
+            # jax coordinator service came up — name the address and the
+            # fix instead of surfacing jax's bare connect error
+            raise RuntimeError(
+                f"worker rank {pid}/{n_proc} failed to join the "
+                f"jax.distributed mesh at coordinator {coord!r}: {e!r}. "
+                "If the coordinator address was auto-picked by the "
+                "controller, the bind-then-close port reservation may have "
+                "been lost to a race; pin a stable address with "
+                "tpu.mesh_coordinator (env ARROYO__TPU__MESH_COORDINATOR), "
+                "reachable from every worker — rank 0 binds it."
+            ) from e
         _initialized = (n_proc, pid)
         return _initialized
 

@@ -19,6 +19,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from .. import chaos
 from .storage import CasConflict, StorageProvider
 
 
@@ -93,6 +94,14 @@ def initialize_generation(storage: StorageProvider, paths: ProtocolPaths) -> int
 
 
 def check_current(storage: StorageProvider, paths: ProtocolPaths, gen: int):
+    if chaos.fire("protocol.fenced_zombie", generation=gen,
+                  job_id=paths.job_id):
+        # zombie-writer resurrect: behave exactly as if another controller
+        # claimed a newer generation while this caller was paused
+        raise Fenced(
+            f"chaos[protocol.fenced_zombie]: generation {gen} treated as "
+            "superseded (injected zombie fencing)"
+        )
     cur = read_json(storage, paths.current_generation)
     if cur is None or cur["generation"] != gen:
         raise Fenced(f"generation {gen} superseded by {cur}")

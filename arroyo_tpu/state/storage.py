@@ -21,9 +21,18 @@ from pathlib import Path
 from typing import List, Optional, Tuple
 from urllib.parse import quote, urlparse
 
+from .. import chaos
 from ..utils.logging import get_logger
 
 logger = get_logger("storage")
+
+
+def _chaos_latency(op: str, key: str) -> None:
+    spec = chaos.fire("storage.latency", op=op, key=key)
+    if spec is not None:
+        import time
+
+        time.sleep(float(spec.param("delay", 0.05)))
 
 
 class CasConflict(Exception):
@@ -74,6 +83,12 @@ class StorageProvider:
         return str(self.root / key)
 
     def put(self, key: str, data: bytes):
+        _chaos_latency("put", key)
+        if chaos.fire("storage.write_fail", key=key):
+            raise IOError(
+                f"chaos[storage.write_fail]: injected transient write "
+                f"failure for {key}"
+            )
         if self.fs is None:
             p = Path(self._full(key))
             p.parent.mkdir(parents=True, exist_ok=True)
@@ -86,6 +101,10 @@ class StorageProvider:
 
     def put_if_not_exists(self, key: str, data: bytes):
         """CAS create: raises CasConflict if the key exists."""
+        if chaos.fire("storage.cas_conflict", key=key):
+            # a lost CAS race: the conflict surfaces but the key does NOT
+            # exist afterwards — the hardest shape for callers to handle
+            raise CasConflict(key)
         if self.fs is None:
             p = Path(self._full(key))
             p.parent.mkdir(parents=True, exist_ok=True)
@@ -276,6 +295,7 @@ class StorageProvider:
         return True
 
     def get(self, key: str) -> Optional[bytes]:
+        _chaos_latency("get", key)
         if self.fs is None:
             p = Path(self._full(key))
             if not p.exists():

@@ -22,11 +22,24 @@ from typing import Dict, List, Optional
 # ---------------------------------------------------------------------------
 
 
+class FakeKafkaException(Exception):
+    """Stands in for confluent_kafka.KafkaException (fencing/state errors)."""
+
+
 class FakeKafkaBroker:
-    """Topic/partition logs with transactional visibility: messages from a
-    transactional producer stay invisible until commit_transaction; a new
-    producer initializing the same transactional.id fences (aborts) the
-    old one's open transaction."""
+    """Topic/partition logs with protocol-shaped transactional semantics:
+
+    - messages from a transactional producer stay invisible until
+      commit_transaction (read-committed consumers stop at the LSO);
+    - init_transactions bumps the transactional.id's PRODUCER EPOCH and
+      fences (aborts) the previous epoch's open transaction — any further
+      call through a stale-epoch producer raises FakeKafkaException
+      ("fenced"), including commit-after-fence;
+    - abort_transaction discards the in-flight transaction's messages
+      (they stay invisible forever);
+    - a replayed commit for an already-committed transaction is
+      idempotent at the broker (no duplicate visibility, no error) — the
+      2PC recovery path replays commits."""
 
     def __init__(self, partitions_per_topic: int = 2):
         self.partitions_per_topic = partitions_per_topic
@@ -35,6 +48,10 @@ class FakeKafkaBroker:
         # transactional.id -> list of uncommitted FakeMessage
         self.open_tx: Dict[str, List["FakeMessage"]] = {}
         self.aborted_tx: List[str] = []
+        # transactional.id -> current producer epoch (init_transactions)
+        self.tx_epochs: Dict[str, int] = {}
+        # transactional.id -> epochs whose transaction committed
+        self.committed_tx: Dict[str, set] = {}
         self.lock = threading.Lock()
 
     def topic(self, name: str) -> Dict[int, List["FakeMessage"]]:
@@ -53,15 +70,63 @@ class FakeKafkaBroker:
             self.open_tx.setdefault(tx_id, []).append(m)
         return m
 
-    def commit_tx(self, tx_id: str):
-        for m in self.open_tx.pop(tx_id, []):
+    def begin_tx(self, tx_id: str):
+        """Open a (possibly empty) transaction: committing an epoch that
+        produced no messages is legal and must not read as 'no such
+        transaction'."""
+        with self.lock:
+            self.open_tx.setdefault(tx_id, [])
+
+    def register_producer(self, tx_id: str) -> int:
+        """init_transactions: bump the epoch, fence the previous one."""
+        with self.lock:
+            epoch = self.tx_epochs.get(tx_id, 0) + 1
+            self.tx_epochs[tx_id] = epoch
+        self.fence(tx_id)
+        return epoch
+
+    def check_epoch(self, tx_id: str, epoch: int):
+        cur = self.tx_epochs.get(tx_id)
+        if cur != epoch:
+            raise FakeKafkaException(
+                f"transactional.id {tx_id!r} epoch {epoch} fenced by "
+                f"newer producer epoch {cur}"
+            )
+
+    def commit_tx(self, tx_id: str, epoch: Optional[int] = None):
+        msgs = self.open_tx.pop(tx_id, None)
+        if msgs is None:
+            # duplicate/replayed commit: already-committed transactions
+            # commit idempotently, never re-expose or error
+            if epoch is not None and epoch in self.committed_tx.get(
+                tx_id, ()
+            ):
+                return
+            if epoch is None:
+                return
+            raise FakeKafkaException(
+                f"commit for {tx_id!r} epoch {epoch}: no open or "
+                "committed transaction"
+            )
+        for m in msgs:
             m.committed = True
+        if epoch is not None:
+            self.committed_tx.setdefault(tx_id, set()).add(epoch)
+
+    def abort_tx(self, tx_id: str):
+        """Explicit abort: the in-flight transaction's messages stay
+        invisible forever (read-committed consumers skip past them, like
+        abort markers let real consumers do)."""
+        msgs = self.open_tx.pop(tx_id, None)
+        if msgs is not None:
+            for m in msgs:
+                m.aborted = True
+            self.aborted_tx.append(tx_id)
 
     def fence(self, tx_id: str):
-        """init_transactions semantics: abort any open transaction for
-        this transactional.id (its messages stay invisible forever)."""
-        if self.open_tx.pop(tx_id, None) is not None:
-            self.aborted_tx.append(tx_id)
+        """Abort any open transaction for this transactional.id (its
+        messages stay invisible forever)."""
+        self.abort_tx(tx_id)
 
     def visible(self, topic: str, partition: int) -> List["FakeMessage"]:
         return self.topic(topic)[partition]
@@ -81,6 +146,7 @@ class FakeKafkaBroker:
                 return FakeProducer(broker, conf)
 
             TopicPartition = FakeTopicPartition
+            KafkaException = FakeKafkaException
 
         return _Module
 
@@ -94,6 +160,7 @@ class FakeMessage:
         self._key = key
         self._value = value
         self.committed = committed
+        self.aborted = False
         self._ts_ms = int(time.time() * 1000)
 
     def error(self):
@@ -168,9 +235,16 @@ class FakeConsumer:
             key = (tp.topic, tp.partition)
             log = self.broker.visible(tp.topic, tp.partition)
             pos = self.positions[key]
-            # read_committed: stop at the first uncommitted message (LSO)
-            while pos < len(log) and log[pos].committed:
+            # read_committed: skip aborted messages (abort markers), stop
+            # at the first open-transaction message (LSO)
+            while pos < len(log):
                 m = log[pos]
+                if m.aborted:
+                    pos += 1
+                    self.positions[key] = pos
+                    continue
+                if not m.committed:
+                    break
                 self.positions[key] = pos + 1
                 return m
         return None
@@ -184,17 +258,34 @@ class FakeProducer:
         self.broker = broker
         self.conf = conf
         self.tx_id = conf.get("transactional.id")
+        self.epoch: Optional[int] = None  # assigned by init_transactions
         self.in_tx = False
+        self._committed = False
         self._n = 0
+
+    def _check_fenced(self):
+        if self.tx_id is not None and self.epoch is not None:
+            self.broker.check_epoch(self.tx_id, self.epoch)
 
     def init_transactions(self, timeout=None):
         assert self.tx_id, "init_transactions without transactional.id"
-        self.broker.fence(self.tx_id)
+        self.epoch = self.broker.register_producer(self.tx_id)
 
     def begin_transaction(self):
+        if self.tx_id and self.epoch is None:
+            raise FakeKafkaException(
+                "begin_transaction before init_transactions"
+            )
+        self._check_fenced()
+        if self.in_tx:
+            raise FakeKafkaException("begin_transaction while in transaction")
+        if self.tx_id:
+            self.broker.begin_tx(self.tx_id)
         self.in_tx = True
+        self._committed = False
 
     def produce(self, topic, value=None, key=None):
+        self._check_fenced()
         partition = (
             hash(key) % self.broker.partitions_per_topic
             if key is not None else self._n % self.broker.partitions_per_topic
@@ -212,12 +303,18 @@ class FakeProducer:
         return 0
 
     def commit_transaction(self, timeout=None):
-        assert self.in_tx, "commit without begin"
-        self.broker.commit_tx(self.tx_id)
+        self._check_fenced()  # commit-after-fence is an error
+        if not self.in_tx:
+            if self._committed:
+                return  # replayed commit: idempotent
+            raise FakeKafkaException("commit without an open transaction")
+        self.broker.commit_tx(self.tx_id, self.epoch)
         self.in_tx = False
+        self._committed = True
 
     def abort_transaction(self, timeout=None):
-        self.broker.fence(self.tx_id)
+        self._check_fenced()
+        self.broker.abort_tx(self.tx_id)
         self.in_tx = False
 
 

@@ -1,0 +1,259 @@
+"""Chaos subsystem: deterministic fault plans, injector seams, registry
+coverage, and the fast exactly-once smoke drill (the full acceptance
+drill — worker SIGKILL across 3 goldens — is in test_chaos_drill.py,
+marked slow)."""
+
+import asyncio
+import json
+import os
+import re
+
+import pytest
+
+from arroyo_tpu import chaos
+from arroyo_tpu.chaos import FAULT_POINTS, FaultPlan, UnknownFaultPoint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PKG = os.path.join(os.path.dirname(HERE), "arroyo_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# -- plan determinism --------------------------------------------------------
+
+
+def test_plan_seeded_is_deterministic():
+    points = ["network.drop_connection", "worker.kill", "storage.cas_conflict"]
+    a = FaultPlan.seeded(77, points)
+    b = FaultPlan.seeded(77, points)
+    assert a.to_json() == b.to_json()
+    assert FaultPlan.seeded(78, points).to_json() != a.to_json()
+
+
+def test_plan_fires_at_hit_and_only_max_fires():
+    plan = FaultPlan(1).add("storage.write_fail", at_hits=(3,))
+    chaos.install(plan)
+    fires = [bool(chaos.fire("storage.write_fail", key="k")) for _ in range(6)]
+    assert fires == [False, False, True, False, False, False]
+    assert plan.comparable_log() == plan.expected_log()
+    assert not plan.unfired()
+
+
+def test_plan_match_filters_hit_counting():
+    plan = FaultPlan(1).add(
+        "storage.cas_conflict", at_hits=(2,), match={"key": "manifest"}
+    )
+    chaos.install(plan)
+    # non-matching hits don't advance the spec's counter
+    assert not chaos.fire("storage.cas_conflict", key="gen-00001.json")
+    assert not chaos.fire("storage.cas_conflict", key="a/manifest.json")
+    assert not chaos.fire("storage.cas_conflict", key="gen-00002.json")
+    assert chaos.fire("storage.cas_conflict", key="b/manifest.json")
+
+
+def test_plan_json_roundtrip_and_unknown_point():
+    plan = FaultPlan(5).add("worker.kill", at_hits=(4,), params={"x": 1})
+    assert FaultPlan.from_json(plan.to_json()).to_json() == plan.to_json()
+    with pytest.raises(UnknownFaultPoint):
+        FaultPlan(0).add("worker.explode")
+    with pytest.raises(UnknownFaultPoint):
+        chaos.install(FaultPlan(0))
+        chaos.fire("not.a.point")
+
+
+def test_fire_is_noop_without_plan():
+    assert chaos.installed() is None
+    assert chaos.fire("worker.kill") is None
+
+
+def test_install_from_config(tmp_path):
+    from arroyo_tpu.config import update
+
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(
+        json.dumps({"faults": [{"point": "worker.kill", "at_hits": [2]}]})
+    )
+    with update(chaos={"plan": str(plan_file), "seed": 9}):
+        plan = chaos.install_from_config()
+    assert plan is not None and plan.seed == 9
+    assert plan.specs[0].point == "worker.kill"
+    chaos.clear()
+    # inline JSON form
+    with update(chaos={"plan": plan.to_json()}):
+        plan2 = chaos.install_from_config()
+    assert plan2.specs[0].at_hits == (2,)
+    chaos.clear()
+    # unset -> no plan
+    assert chaos.install_from_config() is None
+
+
+# -- registry coverage: every seam is listed, every listing has a seam ------
+
+
+def test_fault_point_registry_matches_call_sites():
+    """`tools/chaos_drill.py --list` (FAULT_POINTS) must enumerate exactly
+    the fault points the code injects: a new chaos.fire() seam without a
+    registry entry — or a registry entry whose seam was deleted — fails
+    here, so coverage can't silently rot."""
+    called = set()
+    for root, _dirs, files in os.walk(PKG):
+        if os.path.basename(root) == "chaos":
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(root, fn)).read()
+            called.update(re.findall(r'chaos\.fire\(\s*"([^"]+)"', src))
+    assert called == set(FAULT_POINTS), (
+        f"registry drift: seams without registry entry: "
+        f"{sorted(called - set(FAULT_POINTS))}; registry entries without "
+        f"a seam: {sorted(set(FAULT_POINTS) - called)}"
+    )
+
+
+def test_drill_tool_lists_fault_points():
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(PKG), "tools",
+                                      "chaos_drill.py"), "--list"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    for name in FAULT_POINTS:
+        assert name in out.stdout
+
+
+# -- injector seams (unit level) --------------------------------------------
+
+
+def test_storage_injectors(tmp_path):
+    from arroyo_tpu.state.storage import CasConflict, StorageProvider
+
+    sp = StorageProvider(str(tmp_path / "s"))
+    chaos.install(
+        FaultPlan(0)
+        .add("storage.write_fail", at_hits=(1,))
+        .add("storage.cas_conflict", at_hits=(1,))
+    )
+    with pytest.raises(IOError, match="chaos\\[storage.write_fail\\]"):
+        sp.put("a", b"x")
+    sp.put("a", b"x")  # transient: second attempt succeeds
+    assert sp.get("a") == b"x"
+    with pytest.raises(CasConflict):
+        sp.put_if_not_exists("b", b"y")
+    # the injected conflict must NOT have created the key
+    assert not sp.exists("b")
+    sp.put_if_not_exists("b", b"y")
+    assert sp.get("b") == b"y"
+
+
+def test_protocol_zombie_fencing(tmp_path):
+    from arroyo_tpu.state import protocol
+    from arroyo_tpu.state.protocol import Fenced, ProtocolPaths
+    from arroyo_tpu.state.storage import StorageProvider
+
+    storage = StorageProvider(str(tmp_path / "s"))
+    paths = ProtocolPaths("job")
+    gen = protocol.initialize_generation(storage, paths)
+    chaos.install(FaultPlan(0).add("protocol.fenced_zombie", at_hits=(1,)))
+    with pytest.raises(Fenced, match="zombie"):
+        protocol.publish_checkpoint(storage, paths, gen, 1, {"tasks": {}})
+    # the fenced publish must not have produced a manifest or moved latest
+    assert protocol.load_manifest(storage, paths, 1) is None
+    assert protocol.resolve_latest(storage, paths) is None
+    # next attempt (fault exhausted) publishes fine
+    protocol.publish_checkpoint(storage, paths, gen, 1, {"tasks": {}})
+    assert protocol.resolve_latest(storage, paths)["epoch"] == 1
+
+
+def test_network_partial_frame_never_delivers():
+    """A torn frame injected at the sender must surface as a pump failure
+    and the receiver must deliver nothing."""
+    from arroyo_tpu.engine.network import DataPlaneServer, RemoteEdgeSender
+    from arroyo_tpu.operators.queues import BatchQueue
+
+    import pyarrow as pa
+
+    async def go():
+        server = DataPlaneServer()
+        port = await server.start()
+        inbox = BatchQueue(8, 1 << 20)
+        quad = (1, 0, 2, 0)
+        server.register(quad, inbox)
+        outbox = BatchQueue(8, 1 << 20)
+        errors = []
+        sender = RemoteEdgeSender(
+            f"127.0.0.1:{port}", quad, outbox,
+            on_error=lambda q, e: errors.append((q, e)),
+        )
+        chaos.install(
+            FaultPlan(0).add("network.partial_frame", at_hits=(2,))
+        )
+        await sender.start()
+        batch = pa.record_batch([pa.array([1, 2, 3])], names=["n"])
+        await outbox.send(batch)   # frame 1: delivered
+        await outbox.send(batch)   # frame 2: torn, connection dropped
+        await asyncio.gather(sender.task, return_exceptions=True)
+        await asyncio.sleep(0.1)
+        got = [await inbox.recv() for _ in range(inbox.qsize())]
+        await server.stop()
+        return got, errors
+
+    got, errors = asyncio.run(go())
+    assert len(got) == 1  # the torn frame was never delivered
+    assert len(errors) == 1 and isinstance(errors[0][1], ConnectionResetError)
+
+
+def test_multihost_init_failure_names_coordinator(monkeypatch):
+    """ADVICE r5: a lost pick_coordinator bind-then-close race must raise
+    an error naming the coordinator address and the tpu.mesh_coordinator
+    pin, not jax's bare connect failure."""
+    from arroyo_tpu import parallel
+    from arroyo_tpu.config import update
+    from arroyo_tpu.parallel import multihost
+
+    import jax
+
+    monkeypatch.setattr(multihost, "_initialized", None)
+
+    def boom(**kw):
+        raise RuntimeError("DEADLINE_EXCEEDED: connect failed")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with update(tpu={"mesh_coordinator": "10.0.0.7:4612",
+                     "mesh_processes": 2, "mesh_process_id": 1}):
+        with pytest.raises(RuntimeError) as err:
+            multihost.ensure_initialized()
+    msg = str(err.value)
+    assert "10.0.0.7:4612" in msg
+    assert "tpu.mesh_coordinator" in msg
+    assert "ARROYO__TPU__MESH_COORDINATOR" in msg
+
+
+# -- the fast smoke drill (default suite) -----------------------------------
+
+
+def test_fast_smoke_drill(tmp_path):
+    """1 golden, 2 faults (data-plane drop + manifest CAS loss) through
+    the real embedded cluster: output identical to the fault-free run,
+    and the fired-fault log equals the seed's deterministic schedule."""
+    from arroyo_tpu.chaos import drill
+
+    res = drill.run_drill(
+        drill.DEFAULT_DRILL_QUERIES[0], seed=1234, workdir=str(tmp_path),
+        plan_factory=drill.fast_plan, throttle=400.0,
+    )
+    assert res.passed, res.error
+    assert res.restarts >= 1  # at least one fault forced a recovery
+    assert res.comparable_log == res.expected_log
+    # reproducibility: the schedule is a pure function of the seed
+    assert res.expected_log == drill.fast_plan(1234).expected_log()
+    assert res.expected_log != drill.fast_plan(4321).expected_log()

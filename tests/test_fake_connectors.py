@@ -146,7 +146,11 @@ def test_kafka_uncommitted_transaction_invisible(kafka_broker, tmp_path):
     assert _visible_rows(kafka_broker, "out") == [], (
         "uncommitted transaction leaked into read-committed visibility"
     )
-    assert kafka_broker.open_tx, "expected an in-flight transaction"
+    # the in-flight transaction ended without a commit: either aborted at
+    # teardown (sink on_close) or left open for init_transactions to fence
+    assert kafka_broker.aborted_tx or kafka_broker.open_tx, (
+        "expected an uncommitted in-flight transaction"
+    )
 
     async def phase2():
         plan = plan_query(KAFKA_SQL, parallelism=1)
@@ -158,6 +162,102 @@ def test_kafka_uncommitted_transaction_invisible(kafka_broker, tmp_path):
     asyncio.run(phase2())
     final = sorted(r["n"] for r in _visible_rows(kafka_broker, "out"))
     assert final == [i * 10 for i in range(30)]
+
+
+def test_kafka_zombie_producer_fenced(kafka_broker):
+    """Protocol-shaped fencing: a new producer initializing the same
+    transactional.id bumps the producer epoch; the zombie's in-flight
+    transaction aborts, and every further call through it — produce,
+    commit-after-fence, abort — raises."""
+    mod = kafka_broker.make_module()
+    a = mod.Producer({"transactional.id": "t1"})
+    a.init_transactions()
+    a.begin_transaction()
+    a.produce("out", value=b"zombie")
+    # resurrection: a replacement initializes the same transactional.id
+    b = mod.Producer({"transactional.id": "t1"})
+    b.init_transactions()
+    with pytest.raises(mod.KafkaException, match="fenced"):
+        a.produce("out", value=b"late")
+    with pytest.raises(mod.KafkaException, match="fenced"):
+        a.commit_transaction()
+    with pytest.raises(mod.KafkaException, match="fenced"):
+        a.abort_transaction()
+    b.begin_transaction()
+    b.produce("out", value=b"fresh")
+    b.commit_transaction()
+    vals = [m.value() for p in sorted(kafka_broker.topic("out"))
+            for m in kafka_broker.visible("out", p) if m.committed]
+    assert vals == [b"fresh"]
+    assert "t1" in kafka_broker.aborted_tx
+
+
+def test_kafka_duplicate_commit_idempotent(kafka_broker):
+    """A replayed commit (2PC recovery) must neither error nor re-expose:
+    the broker treats a commit for an already-committed transaction as a
+    no-op; a commit with NO transaction history is an error."""
+    mod = kafka_broker.make_module()
+    p = mod.Producer({"transactional.id": "t2"})
+    p.init_transactions()
+    p.begin_transaction()
+    p.produce("out", value=b"once")
+    p.commit_transaction()
+    p.commit_transaction()  # replay: idempotent, no error
+    kafka_broker.commit_tx("t2", epoch=p.epoch)  # broker-level replay too
+    vals = [m.value() for pt in sorted(kafka_broker.topic("out"))
+            for m in kafka_broker.visible("out", pt) if m.committed]
+    assert vals == [b"once"]
+    q = mod.Producer({"transactional.id": "t3"})
+    q.init_transactions()
+    with pytest.raises(mod.KafkaException, match="open transaction"):
+        q.commit_transaction()
+
+
+def test_kafka_aborted_messages_skipped_by_read_committed(kafka_broker):
+    """Read-committed consumers skip aborted-transaction messages (abort
+    markers) instead of stalling at them, and still stop at the LSO of an
+    OPEN transaction."""
+    mod = kafka_broker.make_module()
+    a = mod.Producer({"transactional.id": "t4"})
+    a.init_transactions()
+    a.begin_transaction()
+    a.produce("t", value=b"aborted")  # partition 0
+    a.abort_transaction()
+    b = mod.Producer({})
+    b.produce("t", value=b"plain")  # partition 0, after the aborted msg
+    c = mod.Consumer({"auto.offset.reset": "earliest"})
+    c.assign([mod.TopicPartition("t", 0)])
+    msg = c.poll(0)
+    assert msg is not None and msg.value() == b"plain"
+    assert c.poll(0) is None
+
+
+def test_kafka_recovery_replays_commit(kafka_broker, tmp_path):
+    """Engine-level commit replay: after the 2PC commit lands, a
+    controller failover re-delivering CommitMsg for the same epoch must
+    be harmless — the sink has no pending producer for it and the
+    visible output stays exactly-once."""
+    _preload(kafka_broker, "in", [{"n": i} for i in range(20)])
+
+    async def go():
+        plan = plan_query(KAFKA_SQL, parallelism=1)
+        eng = Engine(plan.graph, job_id="kfk4",
+                     storage_url=str(tmp_path / "ck")).start()
+        await asyncio.sleep(0.3)
+        await eng.checkpoint_and_wait()  # epoch 1: tx sealed + committed
+        before = sorted(r["n"] for r in _visible_rows(kafka_broker, "out"))
+        await eng.commit(1)  # failover replay of the commit fan-out
+        await asyncio.sleep(0.2)
+        after = sorted(r["n"] for r in _visible_rows(kafka_broker, "out"))
+        await eng.checkpoint_and_wait(then_stop=True)
+        await eng.join(60)
+        return before, after
+
+    before, after = asyncio.run(go())
+    assert before == after, "replayed commit changed visibility"
+    final = sorted(r["n"] for r in _visible_rows(kafka_broker, "out"))
+    assert final == [i * 10 for i in range(20)]
+    assert not kafka_broker.open_tx
 
 
 def test_kinesis_source_resume_and_sink(tmp_path, monkeypatch):

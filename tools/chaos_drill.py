@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Run seeded exactly-once chaos drills against the embedded cluster.
+
+    python tools/chaos_drill.py --list
+        Enumerate every registered fault point (name, seam, effect).
+        New injection seams MUST register here (arroyo_tpu/chaos/plan.py
+        FAULT_POINTS); tests/test_chaos.py fails if a chaos.fire() call
+        site and the registry ever disagree.
+
+    python tools/chaos_drill.py --seed 20260804 --out CHAOS_DRILL.json
+        The acceptance drill: for each golden query (default: one
+        windowed aggregate, one join, one updating query) run fault-free,
+        then under a seeded plan that SIGKILLs a worker mid-window, drops
+        a data-plane connection, and fails a manifest CAS write; require
+        byte-identical canonical sink output. Writes the results AND the
+        fired-fault log to --out (commit it alongside the change).
+
+    python tools/chaos_drill.py --fast
+        The smoke drill the default test suite runs: 1 golden, 2 faults.
+
+    python tools/chaos_drill.py --kafka
+        Exactly-once through the transactional kafka sink (in-memory
+        protocol-shaped fake broker) under worker kill + manifest CAS
+        loss.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# keep drills off any real accelerator and off the axon relay
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+for _var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+    os.environ.pop(_var, None)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list", action="store_true",
+                    help="enumerate registered fault points and exit")
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--queries", type=str, default="",
+                    help="comma-separated golden query names")
+    ap.add_argument("--fast", action="store_true",
+                    help="smoke drill: 1 golden, 2 quickly-detected faults")
+    ap.add_argument("--kafka", action="store_true",
+                    help="also run the transactional-kafka exactly-once drill")
+    ap.add_argument("--out", type=str, default="",
+                    help="write results + fired-fault log to this JSON file")
+    ap.add_argument("--workdir", type=str, default="")
+    args = ap.parse_args()
+
+    from arroyo_tpu.chaos import FAULT_POINTS
+    from arroyo_tpu.chaos import drill as d
+
+    if args.list:
+        width = max(len(n) for n in FAULT_POINTS)
+        for name in sorted(FAULT_POINTS):
+            print(f"{name:<{width}}  {FAULT_POINTS[name]}")
+        return 0
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos-drill-")
+    if args.fast:
+        queries = [d.DEFAULT_DRILL_QUERIES[0]]
+        plan_factory = d.fast_plan
+    else:
+        queries = (
+            [q for q in args.queries.split(",") if q.strip()]
+            or list(d.DEFAULT_DRILL_QUERIES)
+        )
+        plan_factory = d.standard_plan
+
+    results = d.run_drills(queries, args.seed, workdir,
+                           plan_factory=plan_factory)
+    if args.kafka:
+        results.append(
+            d.run_kafka_drill(args.seed, os.path.join(workdir, "kafka"))
+        )
+
+    ok = all(r.passed for r in results)
+    for r in results:
+        status = "PASS" if r.passed else f"FAIL ({r.error})"
+        fired = ", ".join(
+            f"{e['point']}@{e['hit']}" for e in r.comparable_log
+        )
+        print(f"{r.query:<24} {status:<10} rows={r.rows} "
+              f"restarts={r.restarts} fired=[{fired}]")
+
+    payload = {
+        "seed": args.seed,
+        "mode": "fast" if args.fast else "standard",
+        "passed": ok,
+        "results": [r.to_json() for r in results],
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    rc = main()
+    # skip interpreter-exit finalizers: leaked grpc-aio servers from the
+    # embedded clusters can deadlock atexit (same reason
+    # tools/tpu_probe_daemon.py hard-exits); all results are flushed
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -1,0 +1,40 @@
+"""arroyolint — project-specific static analysis for the arroyo_tpu tree.
+
+The reference engine leans on rustc + clippy to keep its concurrency-heavy
+exactly-once protocol honest; this package is the Python reproduction's
+equivalent guardrail: a self-contained AST rule engine with project-aware
+rules spanning three hazard layers (SURVEY §2.8; ISSUE 3):
+
+  asyncio   — dangling ``create_task`` results, blocking calls inside
+              ``async def``, ``await`` under a held sync lock, swallowed
+              ``CancelledError`` on barrier/commit paths
+  protocol  — exhaustive ControlMsg handling in the runner select loop,
+              state-machine transitions declared legal, chaos fault-point
+              registry/call-site bijection
+  jax+config— host syncs inside jitted bodies, jit-captured mutable Python
+              state, dotted config keys resolving to declared defaults
+
+Run it via ``python tools/lint.py`` (``--strict`` is the CI/tier-1 mode);
+``tests/test_lint.py`` executes the full tree inside the tier-1 suite.
+Inline suppressions: ``# arroyolint: disable=RULE`` on the offending line,
+``# arroyolint: disable-file=RULE`` near the top of a file. Grandfathered
+findings live in ``LINT_BASELINE.json`` (each entry must carry a
+justification; the committed baseline is empty — fix, don't baseline).
+"""
+
+from .core import (  # noqa: F401 - public surface
+    Finding,
+    FileContext,
+    Project,
+    Rule,
+    all_rules,
+    get_rule,
+    register,
+)
+from .baseline import Baseline  # noqa: F401
+from .engine import LintResult, collect_files, run_lint  # noqa: F401
+
+# importing the rule modules registers every rule
+from . import rules_asyncio  # noqa: F401,E402
+from . import rules_protocol  # noqa: F401,E402
+from . import rules_jax_config  # noqa: F401,E402

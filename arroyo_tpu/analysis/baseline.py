@@ -1,0 +1,87 @@
+"""Committed lint baseline: grandfathered findings with justifications.
+
+The baseline exists so a new rule can land while its pre-existing findings
+are being burned down — but the project policy (ISSUE 3) is that real
+findings get FIXED, so the committed file stays empty. Entries match on
+(rule, path, message) — not line numbers — so code motion doesn't churn
+them, and every entry must carry a human-written `justification` for
+`--strict` to accept it.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = "LINT_BASELINE.json"
+
+
+class Baseline:
+    def __init__(self, entries: List[dict] = None):
+        self.entries = list(entries or [])
+
+    @staticmethod
+    def _key(rule: str, path: str, message: str) -> Tuple[str, str, str]:
+        return (rule, path.replace("\\", "/"), message)
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls()
+        data = json.loads(p.read_text() or "{}")
+        return cls(data.get("findings", []))
+
+    def save(self, path) -> None:
+        Path(path).write_text(
+            json.dumps(
+                {"version": 1, "findings": self.entries},
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n"
+        )
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      justification: str = "TODO: justify") -> "Baseline":
+        entries = [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "message": f.message,
+                "justification": justification,
+            }
+            for f in findings
+        ]
+        return cls(entries)
+
+    def split(self, findings: Sequence[Finding]):
+        """Partition findings into (new, grandfathered) and report stale
+        baseline entries that no longer match anything."""
+        index: Dict[Tuple[str, str, str], dict] = {
+            self._key(e["rule"], e["path"], e["message"]): e
+            for e in self.entries
+        }
+        matched = set()
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            k = self._key(f.rule, f.path, f.message)
+            if k in index:
+                matched.add(k)
+                old.append(f)
+            else:
+                new.append(f)
+        stale = [e for k, e in index.items() if k not in matched]
+        return new, old, stale
+
+    def unjustified(self) -> List[dict]:
+        return [
+            e for e in self.entries
+            if not str(e.get("justification", "")).strip()
+            or str(e.get("justification", "")).startswith("TODO")
+        ]

@@ -1,0 +1,225 @@
+"""Core of the arroyolint rule engine: findings, parsed files, the project
+view handed to project-scope rules, and the rule registry.
+
+Design notes: every source file is parsed once into a `FileContext`
+(tree + parent links + suppression comments); rules are stateless
+singletons registered by id. File-scope rules see one `FileContext` at a
+time; project-scope rules (protocol conformance, config drift) see the
+whole `Project` and locate their anchor files by path suffix so the same
+rule runs unchanged against the real tree and against the miniature trees
+under `tests/lint_fixtures/`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix path relative to the lint root
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: deliberately excludes
+        line/col so pure code motion doesn't churn the baseline."""
+        raw = f"{self.rule}|{self.path}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+_LINE_RE = re.compile(r"#\s*arroyolint:\s*disable=([A-Za-z0-9_,\s]+)")
+_FILE_RE = re.compile(r"#\s*arroyolint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+# file-level suppressions must sit near the top, before any real code
+_FILE_SUPPRESS_WINDOW = 10
+
+
+class FileContext:
+    """One parsed source file plus the comment-level metadata rules need."""
+
+    def __init__(self, root: Path, relpath: str, source: str):
+        self.root = Path(root)
+        self.path = relpath.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.line_suppressions: Dict[int, set] = {}
+        self.file_suppressions: set = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            if "arroyolint" not in text:
+                continue
+            m = _LINE_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.line_suppressions.setdefault(lineno, set()).update(rules)
+            m = _FILE_RE.search(text)
+            if m and lineno <= _FILE_SUPPRESS_WINDOW:
+                self.file_suppressions.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        on_line = self.line_suppressions.get(line, ())
+        return rule in on_line or "all" in on_line
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing (Async)FunctionDef, or None at module scope."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule.id,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+class Project:
+    """The set of parsed files a lint run covers, rooted at one directory."""
+
+    def __init__(self, root: Path, files: Dict[str, FileContext],
+                 errors: Optional[List[Finding]] = None):
+        self.root = Path(root)
+        self.files = files  # relpath -> FileContext
+        self.errors = errors or []
+
+    def get(self, relpath: str) -> Optional[FileContext]:
+        return self.files.get(relpath.replace("\\", "/"))
+
+    def find(self, suffix: str) -> Optional[FileContext]:
+        """Locate a file by path suffix ("operators/control.py" matches both
+        the real tree and a fixture mini-tree)."""
+        suffix = suffix.replace("\\", "/")
+        for path, ctx in sorted(self.files.items()):
+            if path == suffix or path.endswith("/" + suffix):
+                return ctx
+        return None
+
+    def __iter__(self):
+        return iter(self.files.values())
+
+
+class Rule:
+    """Base class. Subclasses set `id`/`name`/`description` and override one
+    of the check hooks. `scope` is "file" or "project"."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    scope: str = "file"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register the rule by id."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [r for _, r in sorted(_RULES.items())]
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _RULES[rule_id]
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_attr(node: ast.AST) -> Optional[str]:
+    """Final component of a Name/Attribute chain ('c' for a.b.c)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_scope(fn: ast.AST, into_nested: bool = False) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested function/class
+    definitions (unless `into_nested`), so scope-sensitive rules don't
+    attribute an inner def's statements to the outer function."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not into_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_functions(tree: ast.AST) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def sorted_findings(findings: Sequence[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,53 @@
+"""Finding reporters: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import IO
+
+from .core import get_rule
+from .engine import LintResult
+
+
+def report_text(result: LintResult, out: IO, verbose: bool = False) -> None:
+    for f in result.errors:
+        out.write(f"{f.path}:{f.line}: [LINT000] {f.message}\n")
+    for f in result.findings:
+        out.write(f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.message}\n")
+        if verbose:
+            try:
+                out.write(f"    rule: {get_rule(f.rule).description}\n")
+            except KeyError:
+                pass
+    for e in result.stale_baseline:
+        out.write(
+            f"LINT_BASELINE: stale entry [{e['rule']}] {e['path']}: "
+            f"{e['message']} (fixed or moved — remove it)\n"
+        )
+    if result.grandfathered:
+        out.write(f"{len(result.grandfathered)} grandfathered finding(s) "
+                  "suppressed by baseline\n")
+    status = "clean" if result.clean else f"{len(result.findings)} finding(s)"
+    out.write(
+        f"arroyolint: {status} — {result.n_files} files, "
+        f"{result.n_rules} rules\n"
+    )
+
+
+def report_json(result: LintResult, out: IO) -> None:
+    json.dump(
+        {
+            "findings": [f.to_dict() for f in result.findings],
+            "grandfathered": [f.to_dict() for f in result.grandfathered],
+            "stale_baseline": result.stale_baseline,
+            "errors": [f.to_dict() for f in result.errors],
+            "summary": {
+                "files": result.n_files,
+                "rules": result.n_rules,
+                "clean": result.clean,
+            },
+        },
+        out,
+        indent=2,
+    )
+    out.write("\n")

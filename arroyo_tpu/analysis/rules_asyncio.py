@@ -1,0 +1,223 @@
+"""asyncio hazard rules.
+
+These encode the failure modes the PR-2 chaos drills hit for real: a
+fire-and-forget task garbage-collected mid-flight, an event loop stalled by
+a blocking call, a sync lock held across a suspension point, and a
+cancellation (or the phase-2 CommitMsg riding on it) silently swallowed on
+a barrier/commit path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    iter_functions,
+    last_attr,
+    register,
+    walk_scope,
+)
+
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+# TaskGroup.create_task retains its tasks; discarding that result is fine.
+_TASK_GROUP_BASES = {"tg", "task_group", "taskgroup", "group"}
+
+
+@register
+class DanglingTaskRule(Rule):
+    id = "ASY001"
+    name = "asyncio-dangling-task"
+    description = (
+        "the result of asyncio.create_task()/ensure_future() is discarded; "
+        "the event loop holds only a weak reference, so the task can be "
+        "garbage-collected mid-flight — retain it (named attribute, task "
+        "set with done-callback discard) or await it"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Expr) or not isinstance(node.value, ast.Call):
+                continue
+            func = node.value.func
+            attr = last_attr(func)
+            if attr not in _TASK_SPAWNERS:
+                continue
+            if isinstance(func, ast.Attribute):
+                base = last_attr(func.value)
+                if base is not None and base.lower() in _TASK_GROUP_BASES:
+                    continue
+            out.append(
+                ctx.finding(
+                    self, node,
+                    f"result of {attr}() discarded — task may be GC'd "
+                    "mid-flight; retain or await it",
+                )
+            )
+        return out
+
+
+# dotted call names that block the event loop when made from a coroutine
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec` or `asyncio.to_thread`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "socket.getaddrinfo": "use `loop.getaddrinfo`",
+    "urllib.request.urlopen": "use an async client or `asyncio.to_thread`",
+    "requests.get": "use an async client or `asyncio.to_thread`",
+    "requests.post": "use an async client or `asyncio.to_thread`",
+    "requests.put": "use an async client or `asyncio.to_thread`",
+    "requests.delete": "use an async client or `asyncio.to_thread`",
+    "requests.head": "use an async client or `asyncio.to_thread`",
+    "requests.request": "use an async client or `asyncio.to_thread`",
+    "os.system": "use `asyncio.create_subprocess_shell`",
+}
+
+
+@register
+class BlockingCallInAsyncRule(Rule):
+    id = "ASY002"
+    name = "asyncio-blocking-call"
+    description = (
+        "a blocking call (time.sleep, sync subprocess/socket/HTTP IO) inside "
+        "an `async def` stalls the whole event loop — every subtask sharing "
+        "it, including barrier alignment and heartbeats"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_scope(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name in _BLOCKING_CALLS:
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"blocking call {name}() inside async def "
+                            f"{fn.name}() — {_BLOCKING_CALLS[name]}",
+                        )
+                    )
+        return out
+
+
+@register
+class AwaitHoldingLockRule(Rule):
+    id = "ASY003"
+    name = "asyncio-await-holding-lock"
+    description = (
+        "`await` inside a sync `with <lock>` block: the coroutine suspends "
+        "while holding a threading lock, so any other coroutine (or thread) "
+        "touching the lock deadlocks the loop — use asyncio.Lock with "
+        "`async with`, or keep the critical section await-free"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_scope(fn, into_nested=False):
+                if not isinstance(node, ast.With):
+                    continue
+                lock_name = None
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                    name = last_attr(expr)
+                    if name is not None and "lock" in name.lower():
+                        lock_name = dotted_name(expr) or name
+                        break
+                if lock_name is None:
+                    continue
+                for inner in ast.walk(node):
+                    if isinstance(inner, ast.Await):
+                        out.append(
+                            ctx.finding(
+                                self, inner,
+                                f"await while holding sync lock {lock_name} "
+                                f"in async def {fn.name}()",
+                            )
+                        )
+                        break
+        return out
+
+
+def _catches_cancellation(handler: ast.ExceptHandler) -> bool:
+    """Bare except, BaseException, or (asyncio.)CancelledError."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = last_attr(node)
+        if name in ("BaseException", "CancelledError"):
+            return True
+    return False
+
+
+def _is_benign_terminal(handler: ast.ExceptHandler, try_node: ast.Try,
+                        fn: ast.AST) -> bool:
+    """A handler that only ends the task is idiomatic teardown, not a
+    swallow: the try must be the final statement of the enclosing function
+    and the handler body must only pass/return/log (no further work can run
+    under the swallowed cancellation)."""
+    if fn is None or not getattr(fn, "body", None) or fn.body[-1] is not try_node:
+        return False
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Return, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            continue  # logging / metrics / cleanup-callback call
+        return False
+    return True
+
+
+@register
+class SwallowedCancellationRule(Rule):
+    id = "ASY004"
+    name = "asyncio-swallowed-cancellation"
+    description = (
+        "an exception handler catches cancellation (bare except, "
+        "BaseException, or CancelledError) without re-raising while more "
+        "work follows — on barrier/commit/checkpoint paths this converts a "
+        "cancelled coroutine into one that keeps running, which is exactly "
+        "how sealed sink transactions get stranded"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            fn = ctx.enclosing_function(node)
+            for handler in node.handlers:
+                if not _catches_cancellation(handler):
+                    continue
+                if any(isinstance(n, ast.Raise) for n in ast.walk(handler)):
+                    continue
+                if _is_benign_terminal(handler, node, fn):
+                    continue
+                what = "bare except" if handler.type is None else (
+                    f"except {ast.unparse(handler.type)}"
+                )
+                out.append(
+                    ctx.finding(
+                        self, handler,
+                        f"{what} swallows cancellation without re-raising "
+                        "(add `raise`, or narrow the catch to Exception)",
+                    )
+                )
+        return out

@@ -1,0 +1,443 @@
+"""JAX hazard and config drift rules.
+
+JAX001/JAX002 police the jitted hot paths in ops/ and parallel/: a host
+sync (`.item()`, `np.asarray`, `block_until_ready`) inside a traced body
+forces a device round-trip per dispatch (or a tracer error), and mutating
+captured Python state from inside a jit is silently frozen at trace time —
+both are bugs that only surface as performance cliffs or stale state.
+
+CFG001/CFG002 keep the layered config honest: every dotted key read
+anywhere in the tree must resolve to a field declared in config.py (typos
+read defaults forever without erroring at the call site), and every
+declared field must be documented where it is declared.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (
+    FileContext,
+    Finding,
+    Project,
+    Rule,
+    dotted_name,
+    iter_functions,
+    last_attr,
+    register,
+    str_const,
+)
+
+CONFIG_PATH = "config.py"
+
+# -- jit detection -----------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit | partial(jax.jit, ...) | jax.jit(...) used as decorator."""
+    name = dotted_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("partial", "functools.partial"):
+            return bool(node.args) and _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def jitted_functions(ctx: FileContext) -> List[ast.AST]:
+    """Functions whose bodies are traced by jax.jit: decorated defs plus
+    defs whose NAME is passed directly to a jax.jit(...) call in this file."""
+    out = []
+    wrapped: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    wrapped.add(arg.id)
+    for fn in iter_functions(ctx.tree):
+        if any(_is_jit_expr(dec) for dec in fn.decorator_list):
+            out.append(fn)
+        elif fn.name in wrapped:
+            out.append(fn)
+    return out
+
+
+_HOST_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+_HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+
+
+@register
+class HostSyncInJitRule(Rule):
+    id = "JAX001"
+    name = "jax-host-sync-in-jit"
+    description = (
+        "host synchronization (`.item()`, `.tolist()`, `block_until_ready`, "
+        "`np.asarray`/`np.array`, `jax.device_get`) inside a jitted body "
+        "forces a device->host round-trip per dispatch or fails on tracers "
+        "— hoist it out of the traced function"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in jitted_functions(ctx):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name in _HOST_SYNC_CALLS:
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"host sync {name}() inside jitted "
+                            f"{fn.name}()",
+                        )
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_SYNC_ATTRS
+                ):
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f".{node.func.attr}() inside jitted "
+                            f"{fn.name}() synchronizes with the host",
+                        )
+                    )
+        return out
+
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "update",
+    "setdefault", "add", "discard", "popitem",
+}
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    names = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+@register
+class JitMutableCaptureRule(Rule):
+    id = "JAX002"
+    name = "jax-mutable-capture"
+    description = (
+        "a jitted body mutating captured Python state (global/nonlocal "
+        "writes, .append()/.update() on closed-over containers, subscript "
+        "stores into them) runs the mutation only at TRACE time — later "
+        "dispatches silently reuse the first trace's snapshot"
+    )
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in jitted_functions(ctx):
+            locals_ = _local_names(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"`{kind} {', '.join(node.names)}` write inside "
+                            f"jitted {fn.name}() happens only at trace time",
+                        )
+                    )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id not in locals_
+                ):
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"{node.func.value.id}.{node.func.attr}() mutates "
+                            f"captured state inside jitted {fn.name}() — "
+                            "trace-time only",
+                        )
+                    )
+                elif (
+                    isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id not in locals_
+                        for t in node.targets
+                    )
+                ):
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            "subscript store into captured container inside "
+                            f"jitted {fn.name}() — trace-time only",
+                        )
+                    )
+        return out
+
+
+# -- config tree -------------------------------------------------------------
+
+
+def _dataclass_classes(ctx: FileContext) -> Dict[str, ast.ClassDef]:
+    out = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef) and any(
+            last_attr(d) == "dataclass" for d in node.decorator_list
+        ):
+            out[node.name] = node
+    return out
+
+
+def _field_type_name(node: ast.AnnAssign) -> Optional[str]:
+    """For nested sections: the class named by the annotation or by a
+    field(default_factory=X)."""
+    ann = node.annotation
+    name = last_attr(ann) if not isinstance(ann, ast.Subscript) else None
+    if (
+        isinstance(node.value, ast.Call)
+        and last_attr(node.value.func) == "field"
+    ):
+        for kw in node.value.keywords:
+            if kw.arg == "default_factory":
+                factory = last_attr(kw.value)
+                if factory:
+                    return factory
+    return name
+
+
+class ConfigTree:
+    """section path -> fields, parsed from config.py's dataclass AST."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.classes = _dataclass_classes(ctx)
+        self.root = self.classes.get("Config")
+
+    def ok(self) -> bool:
+        return self.root is not None
+
+    def fields_of(self, cls: ast.ClassDef) -> Dict[str, ast.AnnAssign]:
+        return {
+            stmt.target.id: stmt
+            for stmt in cls.body
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name)
+        }
+
+    def child_class(self, field: ast.AnnAssign) -> Optional[ast.ClassDef]:
+        tname = _field_type_name(field)
+        return self.classes.get(tname) if tname else None
+
+    def resolve(self, parts: List[str]) -> Tuple[bool, str]:
+        """Walk a dotted path from the root Config. Returns (ok, detail);
+        extra components past a leaf field are attribute access on the
+        VALUE (e.g. "".strip) and are fine."""
+        cls = self.root
+        consumed = []
+        for part in parts:
+            if cls is None:  # walked past a leaf: value-level attr access
+                return True, ".".join(consumed)
+            fields = self.fields_of(cls)
+            if part not in fields:
+                where = ".".join(consumed) or "config root"
+                return False, f"{part!r} is not declared on {where}"
+            consumed.append(part)
+            cls = self.child_class(fields[part])
+        return True, ".".join(consumed)
+
+    def declared_keys(self) -> List[Tuple[str, str]]:
+        """Flat [(dotted.key, default-source)] table over the whole tree."""
+        out: List[Tuple[str, str]] = []
+
+        def walk(cls: ast.ClassDef, prefix: str):
+            for name, field in self.fields_of(cls).items():
+                child = self.child_class(field)
+                key = f"{prefix}{name}"
+                if child is not None:
+                    walk(child, key + ".")
+                else:
+                    default = (
+                        ast.unparse(field.value) if field.value is not None
+                        else "<required>"
+                    )
+                    out.append((key, default))
+
+        if self.root is not None:
+            walk(self.root, "")
+        return sorted(out)
+
+
+def _config_chain(ctx: FileContext, call: ast.Call) -> Optional[List[str]]:
+    """For a `config()` call, the attribute chain read off its result:
+    config().tpu.mesh_devices -> ["tpu", "mesh_devices"]."""
+    if dotted_name(call.func) not in ("config", "config.config"):
+        return None
+    if call.args or call.keywords:
+        return None
+    parts: List[str] = []
+    node: ast.AST = call
+    while True:
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            parts.append(parent.attr)
+            node = parent
+        else:
+            break
+    return parts or None
+
+
+def build_config_tree(project: Project) -> Optional[ConfigTree]:
+    ctx = project.find(CONFIG_PATH)
+    if ctx is None:
+        return None
+    tree = ConfigTree(ctx)
+    return tree if tree.ok() else None
+
+
+def config_key_table(project: Project) -> List[Tuple[str, str]]:
+    """The resolved key table (`tools/lint.py --config-table`)."""
+    tree = build_config_tree(project)
+    return tree.declared_keys() if tree else []
+
+
+@register
+class ConfigKeyDeclaredRule(Rule):
+    id = "CFG001"
+    name = "config-key-declared"
+    description = (
+        "every dotted config read — `config().a.b` chains, "
+        "`update(section={'key': ...})` overrides, and `ARROYO__A__B` env "
+        "literals — must resolve to a field declared in config.py; a typo'd "
+        "key silently reads defaults forever"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        tree = build_config_tree(project)
+        if tree is None:
+            return ()
+        out: List[Finding] = []
+        for ctx in project:
+            if ctx is tree.ctx:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    chain = _config_chain(ctx, node)
+                    if chain is not None:
+                        ok, detail = tree.resolve(chain)
+                        if not ok:
+                            out.append(
+                                ctx.finding(
+                                    self, node,
+                                    f"config().{'.'.join(chain)}: {detail}",
+                                )
+                            )
+                    self._check_update(tree, ctx, node, out)
+                elif isinstance(node, ast.Constant):
+                    env = str_const(node)
+                    if env and env.startswith("ARROYO__"):
+                        parts = [
+                            p.lower() for p in env[len("ARROYO__"):].split("__") if p
+                        ]
+                        if not parts:
+                            continue
+                        ok, detail = tree.resolve(parts)
+                        if not ok:
+                            out.append(
+                                ctx.finding(
+                                    self, node, f"env override {env}: {detail}"
+                                )
+                            )
+        return out
+
+    def _check_update(self, tree: ConfigTree, ctx: FileContext,
+                      node: ast.Call, out: List[Finding]) -> None:
+        name = dotted_name(node.func)
+        if name is None or name.split(".")[-1] != "update":
+            return
+        # only the config update() helper: bare name or config.update —
+        # dict.update()/set.update() etc. are attribute calls on values
+        if name not in ("update", "config.update"):
+            return
+        if not node.keywords or any(kw.arg is None for kw in node.keywords):
+            return
+        for kw in node.keywords:
+            self._check_override(tree, ctx, node, [kw.arg], kw.value, out)
+
+    def _check_override(self, tree, ctx, node, path, value, out) -> None:
+        ok, detail = tree.resolve(path)
+        if not ok:
+            out.append(
+                ctx.finding(
+                    self, node, f"config update {'.'.join(path)}: {detail}"
+                )
+            )
+            return
+        if isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                key = str_const(k)
+                if key is not None:
+                    self._check_override(
+                        tree, ctx, node, path + [key], v, out
+                    )
+
+
+@register
+class ConfigKeyDocumentedRule(Rule):
+    id = "CFG002"
+    name = "config-key-documented"
+    description = (
+        "every field declared in config.py must be documented at its "
+        "declaration: an inline `#` comment, a comment line directly above "
+        "it, or a mention in the owning dataclass's docstring"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        tree = build_config_tree(project)
+        if tree is None:
+            return ()
+        ctx = tree.ctx
+        out: List[Finding] = []
+        for cls in tree.classes.values():
+            doc = ast.get_docstring(cls) or ""
+            for name, field in tree.fields_of(cls).items():
+                if name in doc:
+                    continue
+                # inline comment after the declaration (end_col_offset is
+                # past the statement, so a '#' there can't be in a literal)
+                end_line = ctx.lines[field.end_lineno - 1]
+                if "#" in end_line[field.end_col_offset:]:
+                    continue
+                above = ctx.lines[field.lineno - 2].strip() if field.lineno >= 2 else ""
+                if above.startswith("#"):
+                    continue
+                out.append(
+                    ctx.finding(
+                        self, field,
+                        f"config field {cls.name}.{name} is undocumented — "
+                        "add an inline/preceding comment or mention it in "
+                        "the class docstring",
+                    )
+                )
+        return out

@@ -1,0 +1,365 @@
+"""Protocol conformance rules.
+
+Project-scope rules that cross-check the three protocol registries against
+their users: the ControlMsg variants vs the runner select loop, the job
+state machine's declared transitions vs the controller's actual moves, and
+the chaos fault-point registry vs its call sites (generalizing the
+bijection test in tests/test_chaos.py into an always-on lint).
+
+Anchor files are located by path suffix so the same rules run against the
+real tree and the miniature trees under tests/lint_fixtures/.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import (
+    FileContext,
+    Finding,
+    Project,
+    Rule,
+    dotted_name,
+    iter_functions,
+    last_attr,
+    register,
+    str_const,
+)
+
+CONTROL_PATH = "operators/control.py"
+RUNNER_PATH = "operators/runner.py"
+STATE_MACHINE_PATH = "controller/state_machine.py"
+CHAOS_PLAN_PATH = "chaos/plan.py"
+
+# the runner functions that must dispatch every control-message variant
+_HANDLER_FUNCS = ("_handle_control", "source_handle_control")
+
+
+def _control_variants(ctx: FileContext) -> List[ast.ClassDef]:
+    """Request-direction control messages: dataclasses named *Msg (the
+    *Resp classes flow subtask -> controller and are dispatched there)."""
+    return [
+        node for node in ctx.tree.body
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Msg")
+    ]
+
+
+def _isinstance_targets(fn: ast.AST) -> Set[str]:
+    """Class names tested via isinstance(_, X) anywhere in `fn`."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "isinstance"
+            and len(node.args) == 2
+        ):
+            t = node.args[1]
+            for el in t.elts if isinstance(t, ast.Tuple) else [t]:
+                name = last_attr(el)
+                if name:
+                    out.add(name)
+    return out
+
+
+@register
+class ControlMsgExhaustiveRule(Rule):
+    id = "PRO001"
+    name = "protocol-control-exhaustive"
+    description = (
+        "every ControlMsg variant declared in operators/control.py must be "
+        "isinstance-dispatched in BOTH runner control handlers "
+        "(_handle_control and source_handle_control) — an unhandled variant "
+        "is silently dropped by the select loop"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        control = project.find(CONTROL_PATH)
+        runner = project.find(RUNNER_PATH)
+        if control is None or runner is None:
+            return ()
+        variants = _control_variants(control)
+        if not variants:
+            return ()
+        out: List[Finding] = []
+        handlers = {
+            fn.name: fn
+            for fn in iter_functions(runner.tree)
+            if fn.name in _HANDLER_FUNCS
+        }
+        for name in _HANDLER_FUNCS:
+            if name not in handlers:
+                out.append(
+                    runner.finding(
+                        self, runner.tree,
+                        f"control handler {name}() not found in "
+                        f"{runner.path} — the exhaustiveness contract has "
+                        "no anchor",
+                    )
+                )
+        for fn_name, fn in handlers.items():
+            handled = _isinstance_targets(fn)
+            for variant in variants:
+                if variant.name not in handled:
+                    out.append(
+                        runner.finding(
+                            self, fn,
+                            f"{fn_name}() does not handle control message "
+                            f"{variant.name} (declared at "
+                            f"{control.path}:{variant.lineno})",
+                        )
+                    )
+        return out
+
+
+def _jobstate_members(ctx: FileContext) -> Dict[str, int]:
+    """JobState enum member -> lineno."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "JobState":
+            return {
+                t.id: stmt.lineno
+                for stmt in node.body
+                if isinstance(stmt, ast.Assign)
+                for t in stmt.targets
+                if isinstance(t, ast.Name)
+            }
+    return {}
+
+
+def _state_ref(node: ast.AST) -> Optional[str]:
+    """'X' for a JobState.X attribute reference."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "JobState"
+    ):
+        return node.attr
+    return None
+
+
+def _transitions_table(ctx: FileContext):
+    """Parse TRANSITIONS = {JobState.A: {JobState.B, ...}, ...}."""
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "TRANSITIONS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        table: Dict[str, Set[str]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            key = _state_ref(k)
+            if key is None:
+                continue
+            vals: Set[str] = set()
+            if isinstance(v, (ast.Set, ast.Tuple, ast.List)):
+                for el in v.elts:
+                    ref = _state_ref(el)
+                    if ref:
+                        vals.add(ref)
+            table[key] = vals
+        return node, table
+    return None
+
+
+def _terminal_states(ctx: FileContext) -> Set[str]:
+    """States named inside JobState.is_terminal()'s body."""
+    for fn in iter_functions(ctx.tree):
+        if fn.name == "is_terminal":
+            return {
+                ref for node in ast.walk(fn)
+                if (ref := _state_ref(node)) is not None
+            }
+    return set()
+
+
+@register
+class StateTransitionRule(Rule):
+    id = "PRO002"
+    name = "protocol-state-transitions"
+    description = (
+        "job state moves must conform to controller/state_machine.py: every "
+        "`.transition(JobState.X)` target must be declared reachable in "
+        "TRANSITIONS, every non-terminal state needs an outgoing entry, and "
+        "`.state = JobState.X` assignments outside the state machine bypass "
+        "check_transition entirely"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        sm = project.find(STATE_MACHINE_PATH)
+        if sm is None:
+            return ()
+        members = _jobstate_members(sm)
+        parsed = _transitions_table(sm)
+        if not members or parsed is None:
+            return ()
+        table_node, table = parsed
+        terminals = _terminal_states(sm)
+        # a legal transition TARGET is one that appears in some value set;
+        # being a key (having outgoing moves) does not make a state enterable
+        reachable = {s for vals in table.values() for s in vals}
+        out: List[Finding] = []
+
+        # registry self-consistency
+        for state in sorted(members):
+            if state not in terminals and state not in table:
+                out.append(
+                    sm.finding(
+                        self, table_node,
+                        f"non-terminal state {state} has no outgoing "
+                        "TRANSITIONS entry (unreachable-from or stuck state)",
+                    )
+                )
+        for state in sorted(set(table) | reachable):
+            if state not in members:
+                out.append(
+                    sm.finding(
+                        self, table_node,
+                        f"TRANSITIONS names {state}, which is not a "
+                        "declared JobState member",
+                    )
+                )
+
+        # users: transition() targets + direct .state assignments
+        for ctx in project:
+            if ctx is sm:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    if last_attr(node.func) != "transition" or not node.args:
+                        continue
+                    target = _state_ref(node.args[0])
+                    if target is None:
+                        continue
+                    if target not in members:
+                        out.append(
+                            ctx.finding(
+                                self, node,
+                                f"transition target JobState.{target} is "
+                                "not a declared member",
+                            )
+                        )
+                    elif target not in reachable:
+                        out.append(
+                            ctx.finding(
+                                self, node,
+                                f"transition to JobState.{target} is not "
+                                "declared legal anywhere in TRANSITIONS",
+                            )
+                        )
+                elif isinstance(node, ast.Assign):
+                    if _state_ref(node.value) is None:
+                        continue
+                    if not any(
+                        isinstance(t, ast.Attribute) and t.attr == "state"
+                        for t in node.targets
+                    ):
+                        continue
+                    fn = ctx.enclosing_function(node)
+                    # the state machine owner itself: __init__ seeds the
+                    # initial state; transition() is the checked setter
+                    if fn is not None and fn.name in ("__init__", "transition"):
+                        continue
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            "direct `.state = JobState.…` assignment "
+                            "bypasses check_transition — use .transition()",
+                        )
+                    )
+        return out
+
+
+def _fault_points(ctx: FileContext):
+    """Parse FAULT_POINTS = {"name": ..., ...} -> {name: lineno}."""
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                for t in targets
+            ):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Dict):
+                return None
+            return node, {
+                s: k.lineno
+                for k in value.keys
+                if (s := str_const(k)) is not None
+            }
+    return None
+
+
+@register
+class ChaosRegistryRule(Rule):
+    id = "PRO003"
+    name = "protocol-chaos-registry"
+    description = (
+        "chaos.fire() call sites and the FAULT_POINTS registry must stay a "
+        "bijection: every fired point literal registered, every registered "
+        "point fired somewhere, and fault-point names passed as literals so "
+        "the mapping is statically checkable"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        plan = project.find(CHAOS_PLAN_PATH)
+        if plan is None:
+            return ()
+        parsed = _fault_points(plan)
+        if parsed is None:
+            return ()
+        reg_node, points = parsed
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        plan_dir = plan.path.rsplit("/", 1)[0] if "/" in plan.path else ""
+        for ctx in project:
+            # the chaos package itself (plan/drill/__init__) manipulates
+            # points generically; the bijection is about engine seams
+            if plan_dir and ctx.path.startswith(plan_dir + "/"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None or (
+                    name != "chaos.fire" and not name.endswith(".chaos.fire")
+                ):
+                    continue
+                point = str_const(node.args[0]) if node.args else None
+                if point is None:
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            "chaos.fire() point must be a string literal "
+                            "(static registry check is impossible otherwise)",
+                        )
+                    )
+                    continue
+                seen.add(point)
+                if point not in points:
+                    out.append(
+                        ctx.finding(
+                            self, node,
+                            f"chaos.fire({point!r}) is not registered in "
+                            f"FAULT_POINTS ({plan.path})",
+                        )
+                    )
+        for point in sorted(set(points) - seen):
+            out.append(
+                plan.finding(
+                    self, reg_node,
+                    f"fault point {point!r} is registered but has no "
+                    "chaos.fire() call site — dead registry entry",
+                )
+            )
+        return out

@@ -52,6 +52,16 @@ class ApiServer:
             dsn=config().database.dsn,
         )
         self.previews: dict = {}  # pipeline id -> preview rows list
+        # background tasks (job trackers, preview runs): the loop only
+        # weak-refs tasks, so fire-and-forget work must be retained here
+        # or it can be garbage-collected mid-flight
+        self._bg_tasks: set = set()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     # -- pipelines ----------------------------------------------------------
 
@@ -119,7 +129,7 @@ class ApiServer:
             storage_url=f"{storage}/{pid}" if storage else None,
             parallelism=parallelism,
         )
-        asyncio.ensure_future(self._track_job(pid, job["id"]))
+        self._spawn(self._track_job(pid, job["id"]))
         return job
 
     def _live_jobs(self, pid: str) -> list:
@@ -425,7 +435,7 @@ class ApiServer:
                     # cleanup still needs its entry
                     self.previews.pop(done_ids.pop(0), None)
 
-        asyncio.ensure_future(run())
+        self._spawn(run())
         return json_response(pid)
 
     def cleanup_previews(self, now: Optional[float] = None) -> int:

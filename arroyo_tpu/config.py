@@ -54,7 +54,9 @@ def parse_size(v) -> int:
 @dataclasses.dataclass
 class CheckpointConfig:
     interval: float = 10.0  # seconds between checkpoints
+    # checkpoint root: local path or s3://bucket/prefix object-store URL
     storage_url: str = "/tmp/arroyo-tpu/checkpoints"
+    # background-compact small per-epoch state files into larger ones
     compaction_enabled: bool = True
     # compact an operator once it has this many epochs of small files
     compaction_epoch_threshold: int = 4
@@ -62,6 +64,7 @@ class CheckpointConfig:
 
 @dataclasses.dataclass
 class PipelineConfig:
+    # max rows a source buffers before emitting a batch
     source_batch_size: int = 512
     source_batch_linger: float = 0.1  # seconds
     # realtime sources pace generation in chunks of this many seconds;
@@ -74,10 +77,14 @@ class PipelineConfig:
     realtime_chunk_seconds: float = 0.02
     queue_size: int = 64  # batches per edge queue
     queue_bytes: int = 32 * 2**20  # byte bound per edge queue
+    # fuse compatible adjacent operators into one subtask (no edge queue)
     chaining_enabled: bool = True
+    # seconds between emitted deltas from updating aggregates
     update_aggregate_flush_interval: float = 1.0
     update_aggregate_ttl: float = 86400.0  # idle-key eviction (1 day)
+    # seconds events may arrive behind the watermark before being dropped
     allowed_lateness: float = 0.0
+    # nested checkpointing section (interval, storage_url, compaction)
     checkpointing: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
 
 
@@ -102,6 +109,8 @@ class TpuConfig:
     # by default
     use_32bit_accumulators: bool = False
     max_keys_per_shard: int = 1 << 20  # device state capacity per subtask
+    # donate accumulator buffers to jitted updates (in-place XLA aliasing);
+    # auto-disabled where donation is unsafe (see ops/_jax.py safe_donate)
     donate_state: bool = True
     # >= 2: window operators keep accumulator state sharded across this
     # many mesh devices and shuffle rows on-device with an in-step
@@ -131,6 +140,7 @@ class TpuConfig:
     # (ops/device_join.py); joins below the row threshold stay on the
     # host arrow join, where the device round-trip isn't worth it
     device_join: bool = True
+    # joins below this probe-side row count stay on the host arrow join
     device_join_min_rows: int = 4096
     # run the join probe even without tpu.enabled (jax on CPU): lets the
     # bench measure the probe's cost model off-TPU
@@ -162,10 +172,12 @@ class ChaosConfig:
 
 @dataclasses.dataclass
 class ControllerConfig:
-    rpc_port: int = 9190
+    rpc_port: int = 9190  # controller gRPC port workers register against
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
+    # seconds without a worker heartbeat before it is declared dead;
+    # must exceed worker.heartbeat_interval
     heartbeat_timeout: float = 30.0
-    update_interval: float = 0.5
+    update_interval: float = 0.5  # seconds between controller update-loop ticks
     # where the per-job control loop (checkpoint cadence, manifest
     # assembly, 2PC) runs: "controller" (central) or "worker"
     # (worker-leader mode — the first worker of each job leads it)
@@ -175,9 +187,9 @@ class ControllerConfig:
 @dataclasses.dataclass
 class WorkerConfig:
     rpc_port: int = 0  # 0 = ephemeral
-    data_port: int = 0
-    task_slots: int = 4
-    bind_address: str = "127.0.0.1"
+    data_port: int = 0  # Arrow-IPC data-plane TCP port (0 = ephemeral)
+    task_slots: int = 4  # subtask slots this worker offers the scheduler
+    bind_address: str = "127.0.0.1"  # address both worker servers bind
     # seconds between worker -> controller heartbeats; the controller's
     # controller.heartbeat_timeout must exceed this or liveness checks
     # fire spuriously (chaos drills shrink both to speed kill detection)
@@ -186,8 +198,9 @@ class WorkerConfig:
 
 @dataclasses.dataclass
 class ApiConfig:
-    http_port: int = 8000
-    bind_address: str = "127.0.0.1"
+    http_port: int = 8000  # REST API + console port
+    bind_address: str = "127.0.0.1"  # address the REST server binds
+    # `arroyo run` single-pipeline mode API port (0 = ephemeral)
     run_http_port: int = 0
     # finished preview pipelines (POST /pipelines/preview) are deleted —
     # registry entry AND db row — once this old (reference: the
@@ -201,13 +214,13 @@ class AdminConfig:
     # -1 disables; 0 binds an ephemeral port; >0 a fixed port (the
     # reference serves /status //metrics //debug on 8001 by default)
     http_port: int = -1
-    bind_address: str = "127.0.0.1"
+    bind_address: str = "127.0.0.1"  # address the admin server binds
 
 
 @dataclasses.dataclass
 class DatabaseConfig:
     backend: str = "sqlite"  # sqlite | postgres
-    path: str = "/tmp/arroyo-tpu/arroyo.db"
+    path: str = "/tmp/arroyo-tpu/arroyo.db"  # sqlite file path
     # storage URL to sync the sqlite file through (reference MaybeLocalDb)
     remote_url: str = ""
     # postgres DSN (database.backend = postgres), e.g.
@@ -218,8 +231,8 @@ class DatabaseConfig:
 @dataclasses.dataclass
 class LoggingConfig:
     format: str = "console"  # console | json | logfmt
-    level: str = "INFO"
-    file: Optional[str] = None
+    level: str = "INFO"  # root log level (DEBUG/INFO/WARNING/ERROR)
+    file: Optional[str] = None  # log file path (None = stderr)
 
 
 @dataclasses.dataclass
@@ -240,6 +253,12 @@ class TlsConfig:
 
 @dataclasses.dataclass
 class Config:
+    """Root of the layered config tree. Sections: pipeline (batching,
+    queues, checkpointing), tls, chaos (fault injection), tpu (device
+    kernels + mesh), controller, worker, api, admin, database, logging.
+    `tools/lint.py --config-table` prints the full resolved key/default
+    table; arroyolint CFG001 rejects reads of undeclared keys."""
+
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)

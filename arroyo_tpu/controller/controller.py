@@ -284,7 +284,7 @@ class ControllerServer:
             logger.exception("job %s driver crashed", job.job_id)
             job.failure = job.failure or "driver crashed"
             if not job.state.is_terminal():
-                job.state = JobState.FAILED
+                job.transition(JobState.FAILED)
 
     async def _schedule(self, job: JobHandle, n_workers: int):
         """reference scheduling.rs:65-100. Worker-facing failures (a

@@ -322,8 +322,11 @@ class KubernetesScheduler(Scheduler):
                   manifest)
         manifest.close()
         if shutil.which("kubectl"):
-            subprocess.run(["kubectl", "apply", "-f", manifest.name],
-                           check=True)
+            # kubectl blocks on the API server; keep the control loop live
+            await asyncio.to_thread(
+                subprocess.run, ["kubectl", "apply", "-f", manifest.name],
+                check=True,
+            )
         else:
             raise RuntimeError(
                 f"kubectl not available; worker pod manifest written to "
@@ -334,7 +337,8 @@ class KubernetesScheduler(Scheduler):
         import shutil
 
         if shutil.which("kubectl"):
-            subprocess.run(
+            await asyncio.to_thread(
+                subprocess.run,
                 ["kubectl", "delete", "pod", "-n", self.namespace,
                  "-l", f"arroyo/job_id={job_id}",
                  "--wait=false" if not force else "--force"],

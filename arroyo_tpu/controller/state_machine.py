@@ -55,6 +55,10 @@ TRANSITIONS = {
         JobState.FINISHING,
         JobState.FAILING,
         JobState.FINISHED,
+        # direct error sink: a crashed job driver fails the job from
+        # wherever it was (every other non-terminal state already declares
+        # FAILED; the graceful path remains FAILING -> FAILED)
+        JobState.FAILED,
     },
     JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
     JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},

@@ -65,6 +65,7 @@ class WorkerServer:
         self._leader_epoch = 0
         self._lead_interval: Optional[float] = None
         self._lead_task = None
+        self._shutdown_task = None  # retained chaos-kill teardown task
         self._n_total_subtasks = 0
         # set while no leader checkpoint is in flight: teardown must not
         # close the rpc server under an active leadership duty (peers are
@@ -141,7 +142,9 @@ class WorkerServer:
                     "chaos[worker.kill]: abrupt teardown of worker %s",
                     self.worker_id,
                 )
-                asyncio.ensure_future(self.shutdown())
+                # retained on self: the loop holds only a weak reference,
+                # and a GC'd shutdown task would leave the worker half-dead
+                self._shutdown_task = asyncio.ensure_future(self.shutdown())
                 return
             spec = chaos.fire("worker.heartbeat_blackout",
                               worker_id=self.worker_id)

@@ -99,5 +99,11 @@ class Shutdown:
             if not t.done():
                 try:
                     await t
-                except (asyncio.CancelledError, Exception):
+                except asyncio.CancelledError:
+                    # the CHILD task being cancelled is normal teardown;
+                    # _drain itself being cancelled must propagate or the
+                    # drain becomes uncancellable
+                    if not t.cancelled():
+                        raise
+                except Exception:
                     pass

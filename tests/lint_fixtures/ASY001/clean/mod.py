@@ -1,0 +1,18 @@
+"""Must NOT fire ASY001: every spawned task is retained or awaited."""
+import asyncio
+
+TASKS = set()
+
+
+async def work():
+    pass
+
+
+async def go(tg):
+    t = asyncio.create_task(work())
+    TASKS.add(t)
+    t.add_done_callback(TASKS.discard)
+    await t
+    kept = asyncio.ensure_future(work())
+    await kept
+    tg.create_task(work())  # TaskGroup retains its children

@@ -1,0 +1,11 @@
+"""MUST fire ASY001: spawned task result discarded."""
+import asyncio
+
+
+async def work():
+    pass
+
+
+async def go():
+    asyncio.create_task(work())
+    asyncio.ensure_future(work())

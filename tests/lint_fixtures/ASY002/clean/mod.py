@@ -1,0 +1,14 @@
+"""Must NOT fire ASY002: sleeps are awaited, sync work goes to a thread."""
+import asyncio
+import subprocess
+import time
+
+
+def sync_helper():
+    time.sleep(0.5)  # fine: not inside async def
+    subprocess.run(["true"], check=True)
+
+
+async def go():
+    await asyncio.sleep(0.5)
+    await asyncio.to_thread(sync_helper)

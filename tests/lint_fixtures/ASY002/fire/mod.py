@@ -1,0 +1,8 @@
+"""MUST fire ASY002: blocking calls stall the event loop."""
+import subprocess
+import time
+
+
+async def go():
+    time.sleep(0.5)
+    subprocess.run(["true"], check=True)

@@ -1,0 +1,14 @@
+"""Must NOT fire ASY003: async lock, or await-free critical section."""
+import asyncio
+import threading
+
+ALOCK = asyncio.Lock()
+LOCK = threading.Lock()
+
+
+async def go(q):
+    async with ALOCK:
+        await q.get()
+    with LOCK:
+        n = 1 + 1  # no suspension point under the sync lock
+    return n

@@ -1,0 +1,18 @@
+"""MUST fire ASY003: await while holding a sync threading lock."""
+import threading
+
+LOCK = threading.Lock()
+
+
+class Thing:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def go(self, q):
+        with self._lock:
+            await q.get()
+
+
+async def module_level(q):
+    with LOCK:
+        await q.get()

@@ -1,0 +1,20 @@
+"""Must NOT fire ASY004: cancellation re-raised, or terminal teardown."""
+import asyncio
+
+
+async def commit(task):
+    try:
+        await task
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass
+    await task
+
+
+async def loop_body():
+    try:
+        while True:
+            await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        pass  # terminal: the task ends here, nothing runs after

@@ -1,0 +1,19 @@
+"""MUST fire ASY004: cancellation swallowed while more work follows."""
+import asyncio
+
+
+async def drain(tasks):
+    for t in tasks:
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):
+            pass
+    return len(tasks)
+
+
+async def commit(task):
+    try:
+        await task
+    except BaseException:
+        pass
+    await task  # more work runs under the swallowed cancellation

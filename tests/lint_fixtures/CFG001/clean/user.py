@@ -1,0 +1,13 @@
+"""Must NOT fire CFG001: every read resolves to a declared field."""
+from .config import config, update
+
+ENV_OK = "ARROYO__PIPELINE__BATCH_SIZE"
+ENV_NESTED = "ARROYO__PIPELINE__CHECKPOINTING__INTERVAL"
+
+
+def go():
+    ok = config().pipeline.batch_size
+    nested = config().pipeline.checkpointing.interval
+    with update(pipeline={"batch_size": 64, "checkpointing": {"interval": 1}}):
+        pass
+    return ok, nested

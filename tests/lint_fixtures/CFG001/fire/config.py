@@ -1,0 +1,31 @@
+"""Mini layered config tree for the key-resolution fixtures."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    interval: float = 10.0  # seconds between checkpoints
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    batch_size: int = 512  # rows per source batch
+    # nested checkpointing section
+    checkpointing: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+
+
+@dataclasses.dataclass
+class Config:
+    """Sections: pipeline."""
+
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+
+
+def config() -> Config:
+    return Config()
+
+
+def update(**sections):
+    pass

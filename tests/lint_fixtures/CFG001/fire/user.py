@@ -1,0 +1,16 @@
+"""MUST fire CFG001: typo'd section, typo'd key, bad update() override,
+bad env-var literal."""
+from .config import config, update
+
+ENV_OK = "ARROYO__PIPELINE__BATCH_SIZE"
+ENV_BAD = "ARROYO__PIPELINE__BATCH_SZ"
+
+
+def go():
+    ok = config().pipeline.batch_size
+    nested_ok = config().pipeline.checkpointing.interval
+    typo_key = config().pipeline.batch_sz
+    typo_section = config().pipelines.batch_size
+    with update(pipeline={"batch_sz": 1}):
+        pass
+    return ok, nested_ok, typo_key, typo_section

@@ -1,0 +1,17 @@
+"""Must NOT fire CFG002: every field carries a comment or docstring
+mention."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    batch_size: int = 512  # rows per source batch
+    # seconds a partial batch may linger before flushing
+    linger: float = 0.1
+
+
+@dataclasses.dataclass
+class Config:
+    """Sections: pipeline."""
+
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)

@@ -1,0 +1,14 @@
+"""MUST fire CFG002: batch_size is declared with no documentation."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    batch_size: int = 512
+
+
+@dataclasses.dataclass
+class Config:
+    """Sections: pipeline."""
+
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)

@@ -1,0 +1,16 @@
+"""Must NOT fire JAX001: host syncs happen outside the traced bodies."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x * 2)
+
+
+def host_loop(batch):
+    x = np.asarray(batch)  # host conversion before dispatch: fine
+    out = step(x)
+    out.block_until_ready()  # sync outside the jit: fine
+    return out.item()

@@ -1,0 +1,23 @@
+"""MUST fire JAX001: host syncs inside jitted bodies."""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return x + np.asarray(x)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def scalarize(x):
+    return x.sum().item()
+
+
+def gather(state):
+    state.block_until_ready()
+    return state
+
+
+gather_fn = jax.jit(gather)

@@ -1,0 +1,20 @@
+"""Must NOT fire JAX002: only local state inside the jit; captured
+containers are mutated by the host caller."""
+import jax
+
+CALL_LOG = []
+
+
+@jax.jit
+def step(x):
+    parts = []  # local: rebuilt every trace, never stale
+    parts.append(x)
+    parts.append(x * 2)
+    acc = {}
+    acc["sum"] = parts[0] + parts[1]
+    return acc["sum"]
+
+
+def host(x):
+    CALL_LOG.append("dispatch")  # outside the jit: runs every call
+    return step(x)

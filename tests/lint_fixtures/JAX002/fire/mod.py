@@ -1,0 +1,20 @@
+"""MUST fire JAX002: jitted bodies mutating captured Python state."""
+import jax
+
+CACHE = {}
+TRACE_LOG = []
+COUNT = 0
+
+
+@jax.jit
+def step(x):
+    TRACE_LOG.append("traced")  # runs once, at trace time
+    CACHE["last"] = x  # ditto
+    return x * 2
+
+
+@jax.jit
+def bump(x):
+    global COUNT
+    COUNT += 1
+    return x

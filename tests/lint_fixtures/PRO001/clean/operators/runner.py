@@ -1,0 +1,16 @@
+"""Must NOT fire PRO001: every variant dispatched in both handlers."""
+from .control import CheckpointMsg, CommitMsg, StopMsg
+
+
+class Runner:
+    async def _handle_control(self, msg):
+        if isinstance(msg, CommitMsg):
+            return "commit"
+        elif isinstance(msg, StopMsg):
+            return "stop"
+        elif isinstance(msg, CheckpointMsg):
+            return "checkpoint"
+
+    async def source_handle_control(self, msg):
+        if isinstance(msg, (CheckpointMsg, StopMsg, CommitMsg)):
+            return "ok"

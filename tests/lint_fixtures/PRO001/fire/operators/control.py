@@ -1,0 +1,22 @@
+"""Mini control-message registry: three request variants."""
+import dataclasses
+
+
+@dataclasses.dataclass
+class CheckpointMsg:
+    epoch: int
+
+
+@dataclasses.dataclass
+class StopMsg:
+    mode: str = "graceful"
+
+
+@dataclasses.dataclass
+class CommitMsg:
+    epoch: int
+
+
+@dataclasses.dataclass
+class TaskFailedResp:  # response direction: not part of the contract
+    error: str

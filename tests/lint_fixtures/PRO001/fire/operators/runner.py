@@ -1,0 +1,15 @@
+"""MUST fire PRO001: CheckpointMsg is not dispatched in _handle_control."""
+from .control import CheckpointMsg, CommitMsg, StopMsg
+
+
+class Runner:
+    async def _handle_control(self, msg):
+        if isinstance(msg, CommitMsg):
+            return "commit"
+        elif isinstance(msg, StopMsg):
+            return "stop"
+        # CheckpointMsg silently dropped
+
+    async def source_handle_control(self, msg):
+        if isinstance(msg, (CheckpointMsg, StopMsg, CommitMsg)):
+            return "ok"

@@ -1,0 +1,15 @@
+"""Must NOT fire PRO002: only declared transitions, no direct sets."""
+from .state_machine import JobState, TRANSITIONS  # noqa: F401
+
+
+class Job:
+    def __init__(self):
+        self.state = JobState.CREATED
+
+    def transition(self, nxt):
+        self.state = nxt
+
+
+def drive(job):
+    job.transition(JobState.RUNNING)
+    job.transition(JobState.STOPPED)

@@ -1,0 +1,18 @@
+"""Mini job state machine: every non-terminal state has outgoing moves."""
+import enum
+
+
+class JobState(enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    STOPPED = "Stopped"
+    FAILED = "Failed"
+
+    def is_terminal(self):
+        return self in (JobState.STOPPED, JobState.FAILED)
+
+
+TRANSITIONS = {
+    JobState.CREATED: {JobState.RUNNING, JobState.FAILED},
+    JobState.RUNNING: {JobState.STOPPED, JobState.FAILED},
+}

@@ -1,0 +1,16 @@
+"""MUST fire PRO002: illegal transition target + direct .state assignment
+(plus the STALLED state in state_machine.py with no outgoing entry)."""
+from .state_machine import JobState, TRANSITIONS  # noqa: F401
+
+
+class Job:
+    def __init__(self):
+        self.state = JobState.CREATED  # allowed: state-machine owner init
+
+    def transition(self, nxt):
+        self.state = nxt  # allowed: the checked setter itself
+
+
+def drive(job):
+    job.transition(JobState.CREATED)  # CREATED is never a declared target
+    job.state = JobState.FAILED  # bypasses check_transition

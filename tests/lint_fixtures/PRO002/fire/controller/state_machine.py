@@ -1,0 +1,19 @@
+"""Mini job state machine for the transition-conformance fixtures."""
+import enum
+
+
+class JobState(enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    STALLED = "Stalled"  # non-terminal, deliberately missing from TRANSITIONS
+    STOPPED = "Stopped"
+    FAILED = "Failed"
+
+    def is_terminal(self):
+        return self in (JobState.STOPPED, JobState.FAILED)
+
+
+TRANSITIONS = {
+    JobState.CREATED: {JobState.RUNNING, JobState.FAILED},
+    JobState.RUNNING: {JobState.STOPPED, JobState.FAILED},
+}

@@ -1,0 +1,5 @@
+"""Mini fault-point registry: every entry has a live call site."""
+
+FAULT_POINTS = {
+    "network.drop": "drop the data-plane connection",
+}

@@ -1,0 +1,7 @@
+"""Must NOT fire PRO003: only registered literals fired."""
+from .. import chaos
+
+
+def pump():
+    if chaos.fire("network.drop"):
+        raise ConnectionError("injected")

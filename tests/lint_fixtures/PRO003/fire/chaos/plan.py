@@ -1,0 +1,6 @@
+"""Mini fault-point registry with one dead entry."""
+
+FAULT_POINTS = {
+    "network.drop": "drop the data-plane connection",
+    "storage.dead_point": "registered but never fired anywhere",
+}

@@ -1,0 +1,12 @@
+"""MUST fire PRO003: unregistered point, non-literal point, dead registry
+entry (storage.dead_point in chaos/plan.py)."""
+from .. import chaos
+
+
+def pump():
+    chaos.fire("network.drop")
+    chaos.fire("network.not_registered")
+
+
+def dynamic(point):
+    chaos.fire(point)  # non-literal: statically uncheckable

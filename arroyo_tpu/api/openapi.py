@@ -56,6 +56,10 @@ ROUTES: List[Route] = [
     ("get", "/jobs/{job_id}/errors", "job_errors",
      "Operator error reports of a job", "jobs", None,
      "JobLogMessageCollection"),
+    ("get", "/jobs/{job_id}/traces", "job_traces",
+     "Flight-recorder spans of a job (checkpoint epochs, lifecycle "
+     "events) as Perfetto-loadable Chrome trace-event JSON", "jobs",
+     None, "TraceDump"),
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
@@ -264,6 +268,12 @@ def _schemas() -> Dict[str, Any]:
              "description": {**_str(), "nullable": True},
              "createdAt": _int()},
             ["id", "name", "definition"],
+        ),
+        "TraceDump": _obj(
+            {"traceEvents": {"type": "array", "items": {"type": "object"}},
+             "displayTimeUnit": _str(),
+             "spanCount": _int()},
+            ["traceEvents"],
         ),
         "OutputData": _obj(
             {"rows": {"type": "array", "items": {"type": "object"}},

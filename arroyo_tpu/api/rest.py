@@ -330,6 +330,23 @@ class ApiServer:
         ]
         return json_response({"data": data, "epoch": epoch})
 
+    async def job_traces(self, request: web.Request):
+        """Flight-recorder export: this process's recorded spans for the
+        job (trace ids are prefixed `{job_id}/`) as Chrome trace-event
+        JSON — Perfetto-loadable directly, or merged across worker
+        processes with tools/trace_report.py. `?trace=<id>` narrows to a
+        single checkpoint epoch / lifecycle event."""
+        from .. import obs
+
+        jid = request.match_info["job_id"]
+        spans = obs.recorder().snapshot(
+            trace_prefix=f"{jid}/",
+            trace_id=request.query.get("trace"),
+        )
+        body = obs.chrome_trace(spans)
+        body["spanCount"] = len(spans)
+        return json_response(body)
+
     async def job_errors(self, request: web.Request):
         jid = request.match_info["job_id"]
         job = self.controller.jobs.get(jid) if self.controller else None
@@ -353,6 +370,15 @@ class ApiServer:
         for name, entries in REGISTRY.snapshot().items():
             short = name.removeprefix("arroyo_worker_")
             for labels, value in entries:
+                if isinstance(value, dict):
+                    # histogram snapshot ({sum, count, buckets}): the UI
+                    # plots scalar series — chart the running mean
+                    value = (value["sum"] / value["count"]
+                             if value.get("count") else 0.0)
+                # split per-phase families (checkpoint_phase_seconds) into
+                # one scalar series per phase
+                metric = (f"{short}:{labels['phase']}"
+                          if "phase" in labels else short)
                 task = labels.get("task")
                 if task is None or "-" not in task:
                     continue
@@ -363,7 +389,7 @@ class ApiServer:
                     sub_i = int(sub)
                 except ValueError:
                     continue
-                ops.setdefault(node_id, {}).setdefault(short, {})[
+                ops.setdefault(node_id, {}).setdefault(metric, {})[
                     sub_i
                 ] = value
         data = [

@@ -211,8 +211,25 @@ class FaultPlan:
                         "params": spec.params,
                         "ctx": {k: str(v)[:120] for k, v in ctx.items()},
                     })
+                    self._record_span_event(point, spec, ctx)
                     return spec
         return None
+
+    @staticmethod
+    def _record_span_event(point: str, spec: FaultSpec, ctx: dict) -> None:
+        """Flight recorder: every fired fault lands as an instant span
+        event — attached to the active trace when one is live (e.g. a CAS
+        conflict inside a manifest publish), standalone otherwise — so
+        drill timelines read fault -> detection -> recovery causally."""
+        try:
+            from .. import obs
+
+            obs.event(
+                f"chaos.fire:{point}", cat="chaos", hit=spec.hits,
+                **{k: str(v)[:120] for k, v in ctx.items()},
+            )
+        except Exception:  # noqa: BLE001 - tracing must never fail a drill
+            pass
 
     # -- logs ---------------------------------------------------------------
 

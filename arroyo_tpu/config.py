@@ -171,6 +171,23 @@ class ChaosConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Flight recorder (arroyo_tpu/obs): cross-process trace spans,
+    latency histograms, Chrome-trace export (/debug/trace admin endpoint,
+    /api/v1/jobs/{id}/traces, tools/trace_report.py)."""
+
+    # master switch: off = the span API hands out inert spans and nothing
+    # is recorded (latency histograms stay on — they are plain metrics)
+    enabled: bool = True
+    # per-process span ring-buffer capacity; oldest spans drop first
+    trace_buffer_spans: int = 4096
+    # trace-sample every Nth data-plane frame per edge (exchange spans in
+    # the dump); 0 disables frame span sampling. The exchange latency
+    # histogram sees EVERY frame regardless via the header send timestamp.
+    frame_sample_every: int = 64
+
+
+@dataclasses.dataclass
 class ControllerConfig:
     rpc_port: int = 9190  # controller gRPC port workers register against
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
@@ -254,12 +271,14 @@ class TlsConfig:
 @dataclasses.dataclass
 class Config:
     """Root of the layered config tree. Sections: pipeline (batching,
-    queues, checkpointing), tls, chaos (fault injection), tpu (device
-    kernels + mesh), controller, worker, api, admin, database, logging.
-    `tools/lint.py --config-table` prints the full resolved key/default
-    table; arroyolint CFG001 rejects reads of undeclared keys."""
+    queues, checkpointing), tls, chaos (fault injection), obs (flight
+    recorder), tpu (device kernels + mesh), controller, worker, api,
+    admin, database, logging. `tools/lint.py --config-table` prints the
+    full resolved key/default table; arroyolint CFG001 rejects reads of
+    undeclared keys."""
 
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)

@@ -18,7 +18,7 @@ import asyncio
 import time
 from typing import Dict, List, Optional
 
-from .. import chaos
+from .. import chaos, obs
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..state.backend import StateBackend
@@ -109,6 +109,7 @@ class ControllerServer:
 
     async def start(self) -> "ControllerServer":
         chaos.install_from_config()
+        obs.set_role("controller")
         self.rpc.add_service(
             "ControllerGrpc",
             {
@@ -293,7 +294,15 @@ class ControllerServer:
         Recovering — bounded by max_restarts — instead of crashing the
         job driver into FAILED."""
         try:
-            await self._schedule_inner(job, n_workers)
+            # one lifecycle trace per (re)schedule: StartExecution rpc
+            # spans, worker build + state-restore spans nest under it, so
+            # a failed restore pinpoints its stage in the flight recording
+            with obs.span(
+                "job.schedule",
+                trace=obs.new_trace(job.job_id, f"schedule-{job.restarts}"),
+                cat="controller", job=job.job_id, restarts=job.restarts,
+            ):
+                await self._schedule_inner(job, n_workers)
         except Exception as e:  # noqa: BLE001 - scheduling is retryable
             logger.warning("job %s scheduling failed: %r", job.job_id, e)
             job.failure = f"scheduling failed: {e!r}"
@@ -462,42 +471,66 @@ class ControllerServer:
     async def _checkpoint(self, job: JobHandle, then_stop: bool = False):
         job.epoch += 1
         epoch = job.epoch
-        for w in job.workers:
-            try:
-                await w.client.call(
-                    "WorkerGrpc", "Checkpoint",
-                    {"epoch": epoch, "then_stop": then_stop},
-                )
-            except Exception as e:  # noqa: BLE001 - resigned/dead worker
-                logger.warning("checkpoint fan-out to worker %s failed: %s",
-                               w.worker_id, e)
+        # flight recorder: one trace per checkpoint epoch, minted here.
+        # The barrier fan-out rpcs carry the context to workers; barriers
+        # carry it in-band through the dataflow; completion reports and
+        # storage writes stitch back into this tree.
+        with obs.span(
+            "checkpoint", trace=obs.new_trace(job.job_id, f"ck-{epoch}"),
+            cat="controller", job=job.job_id, epoch=epoch,
+            then_stop=then_stop,
+        ):
+            await self._checkpoint_inner(job, epoch, then_stop)
+
+    async def _checkpoint_inner(self, job: JobHandle, epoch: int,
+                                then_stop: bool):
+        with obs.span("barrier_fanout", cat="controller"):
+            for w in job.workers:
+                try:
+                    await w.client.call(
+                        "WorkerGrpc", "Checkpoint",
+                        {"epoch": epoch, "then_stop": then_stop},
+                    )
+                except Exception as e:  # noqa: BLE001 - resigned/dead worker
+                    logger.warning(
+                        "checkpoint fan-out to worker %s failed: %s",
+                        w.worker_id, e,
+                    )
         deadline = time.monotonic() + 60
-        while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
-            if job.failure is not None or time.monotonic() > deadline:
-                logger.warning("checkpoint %d incomplete", epoch)
-                return
-            if self._heartbeat_expired(job):
-                # a worker died mid-barrier: its subtasks can never report,
-                # so don't sit out the full checkpoint deadline — surface
-                # the liveness failure now and let _run recover
-                logger.warning(
-                    "checkpoint %d abandoned: worker heartbeat timeout",
-                    epoch,
-                )
-                job.failure = "worker heartbeat timeout"
-                return
-            if len(job.finished_tasks) >= job.n_subtasks:
-                # the job completed while the barrier was in flight; a
-                # finished task can never report, so stop waiting and let
-                # _run see the finish
-                logger.info("checkpoint %d abandoned: job finished", epoch)
-                return
-            await asyncio.sleep(0.02)
+        with obs.span("await_reports", cat="controller") as wait_span:
+            while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
+                if job.failure is not None or time.monotonic() > deadline:
+                    logger.warning("checkpoint %d incomplete", epoch)
+                    wait_span.set(outcome="incomplete")
+                    return
+                if self._heartbeat_expired(job):
+                    # a worker died mid-barrier: its subtasks can never
+                    # report, so don't sit out the full checkpoint deadline
+                    # — surface the liveness failure now and let _run
+                    # recover
+                    logger.warning(
+                        "checkpoint %d abandoned: worker heartbeat timeout",
+                        epoch,
+                    )
+                    job.failure = "worker heartbeat timeout"
+                    wait_span.set(outcome="heartbeat_timeout")
+                    return
+                if len(job.finished_tasks) >= job.n_subtasks:
+                    # the job completed while the barrier was in flight; a
+                    # finished task can never report, so stop waiting and
+                    # let _run see the finish
+                    logger.info("checkpoint %d abandoned: job finished",
+                                epoch)
+                    wait_span.set(outcome="job_finished")
+                    return
+                await asyncio.sleep(0.02)
         reports = job.checkpoints[epoch]
         try:
-            manifest = job.backend.publish_checkpoint(
-                epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
-            )
+            with obs.span("publish_manifest", cat="controller"):
+                manifest = job.backend.publish_checkpoint(
+                    epoch,
+                    {tid: CheckpointReport(r) for tid, r in reports.items()},
+                )
         except Exception as e:  # noqa: BLE001 - storage/protocol boundary
             # transient write failures, lost CAS races, and zombie fencing
             # must not crash the job driver into FAILED: the epoch is
@@ -520,13 +553,14 @@ class ControllerServer:
                     wid for (node_id, _sub), wid in job.assignments.items()
                     if str(node_id) in committing
                 }
-                for w in job.workers:
-                    if w.worker_id not in commit_workers:
-                        continue
-                    await w.client.call(
-                        "WorkerGrpc", "Commit",
-                        {"epoch": epoch, "committing": committing},
-                    )
+                with obs.span("commit_phase", cat="controller"):
+                    for w in job.workers:
+                        if w.worker_id not in commit_workers:
+                            continue
+                        await w.client.call(
+                            "WorkerGrpc", "Commit",
+                            {"epoch": epoch, "committing": committing},
+                        )
         except Exception as e:  # noqa: BLE001
             logger.warning("checkpoint %d commit phase failed: %r", epoch, e)
             job.failure = f"checkpoint {epoch} commit phase failed: {e!r}"
@@ -537,21 +571,22 @@ class ControllerServer:
         # a failed swap delivery, merge, or GC pass must not fail the job
         # (old files stay referenced until a later cadence retries).
         try:
-            swaps = await asyncio.to_thread(
-                job.backend.compact_epoch, epoch, manifest
-            )
-            for swap in swaps:
-                for w in job.workers:
-                    try:
-                        await w.client.call(
-                            "WorkerGrpc", "LoadCompacted", swap
-                        )
-                    except Exception as e:  # noqa: BLE001
-                        logger.warning(
-                            "LoadCompacted to worker %s failed: %s",
-                            w.worker_id, e,
-                        )
-            await asyncio.to_thread(job.backend.retire_unreferenced)
+            with obs.span("compaction", cat="controller"):
+                swaps = await asyncio.to_thread(
+                    job.backend.compact_epoch, epoch, manifest
+                )
+                for swap in swaps:
+                    for w in job.workers:
+                        try:
+                            await w.client.call(
+                                "WorkerGrpc", "LoadCompacted", swap
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning(
+                                "LoadCompacted to worker %s failed: %s",
+                                w.worker_id, e,
+                            )
+                await asyncio.to_thread(job.backend.retire_unreferenced)
         except Exception:  # noqa: BLE001
             logger.exception("checkpoint %d compaction/GC failed", epoch)
 
@@ -573,21 +608,30 @@ class ControllerServer:
             await self.scheduler.stop_workers(job.job_id, force=True)
             return
         logger.warning("job %s recovering (%s)", job.job_id, job.failure)
-        for w in job.workers:
-            try:
-                await w.client.call(
-                    "WorkerGrpc", "StopExecution", {"mode": "immediate"},
-                    timeout=2.0,
-                )
-            except Exception:  # noqa: BLE001 - worker may be dead
-                pass
-            self.workers.pop(w.worker_id, None)
-        await self.scheduler.stop_workers(job.job_id, force=True)
-        # new generation fences the old one; restore from latest manifest
-        if job.backend is not None:
-            job.backend = StateBackend(
-                job.storage_url, job.job_id
-            ).initialize()
+        # flight recorder: each recovery is its own lifecycle trace; the
+        # fault that triggered it rides as an attribute so drill timelines
+        # read fault -> detection -> recovery causally
+        with obs.span(
+            "job.recover",
+            trace=obs.new_trace(job.job_id, f"recover-{job.restarts}"),
+            cat="controller", job=job.job_id, restarts=job.restarts,
+            failure=str(job.failure)[:300],
+        ):
+            for w in job.workers:
+                try:
+                    await w.client.call(
+                        "WorkerGrpc", "StopExecution", {"mode": "immediate"},
+                        timeout=2.0,
+                    )
+                except Exception:  # noqa: BLE001 - worker may be dead
+                    pass
+                self.workers.pop(w.worker_id, None)
+            await self.scheduler.stop_workers(job.job_id, force=True)
+            # new generation fences the old; restore from latest manifest
+            if job.backend is not None:
+                job.backend = StateBackend(
+                    job.storage_url, job.job_id
+                ).initialize()
         job.transition(JobState.SCHEDULING)
 
     # -- helpers ------------------------------------------------------------

@@ -14,6 +14,7 @@ import asyncio
 import time
 from typing import Dict, List, Optional
 
+from .. import obs
 from ..types import CheckpointBarrier, StopMode, now_nanos
 from ..utils.logging import get_logger
 from ..operators.control import (
@@ -45,6 +46,8 @@ class RunningEngine:
         # epoch -> task_id -> CheckpointCompletedResp
         self.checkpoints: Dict[int, Dict[str, CheckpointCompletedResp]] = {}
         self._epoch = 0
+        # epoch -> (trace_id, span_id) of the minted checkpoint trace
+        self._ck_trace: Dict[int, tuple] = {}
         # task_ids recorded finished in the restore manifest: their output
         # is fully reflected in the restored state, so they must not re-run
         self.prefinished: set = prefinished or set()
@@ -73,11 +76,22 @@ class RunningEngine:
             epoch = self._epoch
         else:
             self._epoch = max(self._epoch, epoch)
-        barrier = CheckpointBarrier(
-            epoch=epoch, min_epoch=0, timestamp=now_nanos(), then_stop=then_stop
-        )
-        for sub in self.program.source_subtasks():
-            sub.control_rx.put_nowait(CheckpointMsg(barrier))
+        # in-process engine mints the epoch trace itself (no controller
+        # hop); wait_checkpoint re-uses it for the publish leg
+        with obs.span(
+            "checkpoint",
+            trace=obs.new_trace(self.program.job_id, f"ck-{epoch}"),
+            cat="controller", job=self.program.job_id, epoch=epoch,
+            then_stop=then_stop,
+        ) as sp:
+            self._ck_trace[epoch] = (sp.trace_id, sp.span_id)
+            barrier = CheckpointBarrier(
+                epoch=epoch, min_epoch=0, timestamp=now_nanos(),
+                then_stop=then_stop,
+                trace_id=sp.trace_id, span_id=sp.span_id,
+            )
+            for sub in self.program.source_subtasks():
+                sub.control_rx.put_nowait(CheckpointMsg(barrier))
         return epoch
 
     async def wait_checkpoint(self, epoch: int, timeout: float = 60.0):
@@ -107,12 +121,16 @@ class RunningEngine:
                 epoch, len(finished_unreported),
             )
         if self.backend is not None:
-            manifest = self.backend.publish_checkpoint(
-                epoch, reports, finished_tasks=finished_unreported
-            )
-            if manifest.get("committing"):
-                await self.commit_epoch(epoch, manifest["committing"])
-            await self._compact(epoch, manifest)
+            tid, sid = self._ck_trace.get(epoch, (None, None))
+            with obs.span("checkpoint.publish", trace=tid, parent=sid,
+                          cat="controller", epoch=epoch):
+                manifest = self.backend.publish_checkpoint(
+                    epoch, reports, finished_tasks=finished_unreported
+                )
+                if manifest.get("committing"):
+                    await self.commit_epoch(epoch, manifest["committing"])
+                await self._compact(epoch, manifest)
+            self._ck_trace.pop(epoch, None)
         return reports
 
     async def _compact(self, epoch: int, manifest: dict):
@@ -137,8 +155,12 @@ class RunningEngine:
             data[int(node_id)] = {
                 "data": {int(s): v for s, v in subs.items()}
             }
+        msg = CommitMsg(epoch, data)
+        ctx = obs.current()
+        if ctx is not None:
+            msg.trace_id, msg.span_id = ctx
         for sub in self.program.subtasks:
-            sub.control_rx.put_nowait(CommitMsg(epoch, data))
+            sub.control_rx.put_nowait(msg)
 
     async def checkpoint_and_wait(self, then_stop: bool = False) -> Dict[str, CheckpointCompletedResp]:
         epoch = await self.checkpoint(then_stop=then_stop)

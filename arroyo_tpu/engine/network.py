@@ -12,9 +12,16 @@ the bounded in-process queues through per-connection flow control
 drains). Signals ride the same framing msgpack-encoded.
 
 Frame layout (little-endian):
-  magic u32 = 0xA77050  | kind u8 (0=data,1=signal)
+  magic u32 = 0xA77051  | kind u8 (0=data,1=signal)
   src_node u32 | src_subtask u32 | dst_node u32 | dst_subtask u32
-  payload_len u64 | payload bytes
+  payload_len u64 | sent_ns u64 | trace_len u16
+  trace bytes (msgpack {"t": trace_id, "s": span_id}, flight recorder)
+  payload bytes
+
+Every frame header carries the sender's wall-clock send timestamp, which
+the receiver folds into the `arroyo_exchange_frame_seconds` histogram;
+the trace preamble attaches to signal frames carrying barrier context and
+to every obs.frame_sample_every'th data frame (sampled exchange spans).
 """
 
 from __future__ import annotations
@@ -22,11 +29,14 @@ from __future__ import annotations
 import asyncio
 import io
 import struct
+import time
 from typing import Dict, Optional, Tuple
 
+import msgpack
 import pyarrow as pa
 
-from .. import chaos
+from .. import chaos, obs
+from ..metrics import EXCHANGE_FRAME_SECONDS
 from ..types import (
     CheckpointBarrier,
     SignalKind,
@@ -39,15 +49,13 @@ from ..operators.queues import BatchQueue
 
 logger = get_logger("network")
 
-MAGIC = 0xA77050
-_HEADER = struct.Struct("<IBIIIIQ")
+MAGIC = 0xA77051
+_HEADER = struct.Struct("<IBIIIIQQH")
 
 Quad = Tuple[int, int, int, int]  # src_node, src_sub, dst_node, dst_sub
 
 
 def encode_signal(sig: SignalMessage) -> bytes:
-    import msgpack
-
     out = {"kind": sig.kind.value}
     if sig.watermark is not None:
         out["wm_kind"] = sig.watermark.kind.value
@@ -55,12 +63,13 @@ def encode_signal(sig: SignalMessage) -> bytes:
     if sig.barrier is not None:
         b = sig.barrier
         out["barrier"] = [b.epoch, b.min_epoch, b.timestamp, b.then_stop]
+        if b.trace_id:
+            # flight-recorder context rides the barrier across workers
+            out["barrier"] += [b.trace_id, b.span_id]
     return msgpack.packb(out)
 
 
 def decode_signal(data: bytes) -> SignalMessage:
-    import msgpack
-
     obj = msgpack.unpackb(data, raw=False)
     kind = SignalKind(obj["kind"])
     wm = None
@@ -68,8 +77,13 @@ def decode_signal(data: bytes) -> SignalMessage:
     if "wm_kind" in obj:
         wm = Watermark(WatermarkKind(obj["wm_kind"]), obj.get("wm_ts"))
     if "barrier" in obj:
-        e, m, t, s = obj["barrier"]
-        barrier = CheckpointBarrier(e, m, t, s)
+        e, m, t, s = obj["barrier"][:4]
+        extra = obj["barrier"][4:]
+        barrier = CheckpointBarrier(
+            e, m, t, s,
+            trace_id=extra[0] if extra else "",
+            span_id=extra[1] if len(extra) > 1 else "",
+        )
     return SignalMessage(kind, wm, barrier)
 
 
@@ -88,23 +102,34 @@ def decode_batch(data: bytes) -> pa.RecordBatch:
     return pa.Table.from_batches(batches).combine_chunks().to_batches()[0]
 
 
-def write_frame(writer: asyncio.StreamWriter, quad: Quad, item) -> None:
+def write_frame(writer: asyncio.StreamWriter, quad: Quad, item,
+                trace: Optional[dict] = None) -> None:
     if isinstance(item, SignalMessage):
         kind, payload = 1, encode_signal(item)
     else:
         kind, payload = 0, encode_batch(item)
-    writer.write(_HEADER.pack(MAGIC, kind, *quad, len(payload)))
+    tbytes = msgpack.packb(trace) if trace else b""
+    writer.write(
+        _HEADER.pack(MAGIC, kind, *quad, len(payload), time.time_ns(),
+                     len(tbytes))
+    )
+    if tbytes:
+        writer.write(tbytes)
     writer.write(payload)
 
 
 async def read_frame(reader: asyncio.StreamReader):
+    """Returns (quad, item, sent_ns, trace-dict-or-None)."""
     header = await reader.readexactly(_HEADER.size)
-    magic, kind, sn, ss, dn, ds, plen = _HEADER.unpack(header)
+    magic, kind, sn, ss, dn, ds, plen, sent_ns, tlen = _HEADER.unpack(header)
     if magic != MAGIC:
         raise ValueError(f"bad frame magic {magic:#x}")
+    trace = None
+    if tlen:
+        trace = msgpack.unpackb(await reader.readexactly(tlen), raw=False)
     payload = await reader.readexactly(plen)
     item = decode_signal(payload) if kind == 1 else decode_batch(payload)
-    return (sn, ss, dn, ds), item
+    return (sn, ss, dn, ds), item, sent_ns, trace
 
 
 def _set_nodelay(writer: asyncio.StreamWriter) -> None:
@@ -150,9 +175,33 @@ class DataPlaneServer:
                       writer: asyncio.StreamWriter):
         _set_nodelay(writer)
         peer = writer.get_extra_info("peername")
+        lat_handles: Dict[Quad, object] = {}
         try:
             while True:
-                quad, item = await read_frame(reader)
+                quad, item, sent_ns, trace = await read_frame(reader)
+                latency = max(0, time.time_ns() - sent_ns) / 1e9
+                h = lat_handles.get(quad)
+                if h is None:
+                    h = lat_handles[quad] = EXCHANGE_FRAME_SECONDS.labels(
+                        task=f"{quad[2]}-{quad[3]}"
+                    )
+                h.observe(latency)
+                if trace and "t" in trace and obs.enabled():
+                    # sampled frame span: spans the wire time, parented to
+                    # the sender's span so hops line up in trace dumps
+                    import os as _os
+
+                    obs.recorder().record({
+                        "trace_id": trace["t"], "span_id": obs.new_span_id(),
+                        "parent_id": trace.get("s"), "name": "exchange.frame",
+                        "cat": "network", "ts": sent_ns / 1e3,
+                        "dur": latency * 1e6,
+                        "attrs": {
+                            "edge": f"{quad[0]}-{quad[1]}->"
+                                    f"{quad[2]}-{quad[3]}",
+                        },
+                        "events": [], "pid": _os.getpid(), "tid": 0,
+                    })
                 queue = self.routes.get(quad)
                 if queue is None:
                     logger.warning("no route for %s from %s", quad, peer)
@@ -207,6 +256,8 @@ class RemoteEdgeSender:
     async def _pump(self):
         from ..operators.queues import QueueClosed
 
+        sample_every = obs.frame_sample_every()
+        n_frames = 0
         try:
             while True:
                 try:
@@ -228,7 +279,8 @@ class RemoteEdgeSender:
                     else:
                         kind, payload = 0, encode_batch(item)
                     self.writer.write(
-                        _HEADER.pack(MAGIC, kind, *self.quad, len(payload))
+                        _HEADER.pack(MAGIC, kind, *self.quad, len(payload),
+                                     time.time_ns(), 0)
                     )
                     self.writer.write(payload[: max(1, len(payload) // 2)])
                     await self.writer.drain()
@@ -237,7 +289,15 @@ class RemoteEdgeSender:
                         "chaos[network.partial_frame]: injected torn frame "
                         f"on edge {self.quad}"
                     )
-                write_frame(self.writer, self.quad, item)
+                trace = None
+                n_frames += 1
+                if (sample_every and not isinstance(item, SignalMessage)
+                        and n_frames % sample_every == 1 and obs.enabled()):
+                    # sampled data-frame trace header: one exchange span
+                    # per edge track in the dump, grouped by edge
+                    sn, ss, dn, ds = self.quad
+                    trace = {"t": f"exchange/{sn}-{ss}_{dn}-{ds}"}
+                write_frame(self.writer, self.quad, item, trace)
                 await self.writer.drain()
                 if isinstance(item, SignalMessage) and item.kind in (
                     SignalKind.END_OF_DATA, SignalKind.STOP

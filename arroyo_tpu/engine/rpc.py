@@ -16,6 +16,8 @@ from typing import Awaitable, Callable, Dict
 import grpc
 import msgpack
 
+from .. import obs
+
 
 # grpc.aio servers/channels have __del__ finalizers that can join internal
 # threads; if the GC runs them from an unrelated context (observed: inside a
@@ -50,9 +52,24 @@ class RpcServer:
     ):
         handlers = {}
         for name, fn in methods.items():
-            async def handler(request, context, _fn=fn):
+            async def handler(request, context, _fn=fn, _name=name,
+                              _service=service_name):
                 try:
-                    resp = await _fn(_unpack(request))
+                    req = _unpack(request)
+                    trace = (
+                        req.pop("__trace__", None)
+                        if isinstance(req, dict) else None
+                    )
+                    if trace:
+                        # flight recorder: the caller's span context rode
+                        # the message; this server-side span stitches the
+                        # cross-process tree
+                        with obs.span(f"rpc.{_service}.{_name}", cat="rpc",
+                                      trace=trace.get("t"),
+                                      parent=trace.get("s")):
+                            resp = await _fn(req)
+                    else:
+                        resp = await _fn(req)
                     return _pack({"ok": True, "data": resp})
                 except Exception as e:  # noqa: BLE001 - rpc boundary
                     return _pack({"ok": False, "error": repr(e)})
@@ -106,7 +123,17 @@ class RpcClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
-        raw = await rpc(_pack(message), timeout=timeout)
+        # flight recorder: propagate the ambient trace context (and time
+        # the call) when one is active; untraced calls pay one ctx read
+        hdr = obs.headers()
+        if hdr is not None and obs.enabled():
+            with obs.span(f"call.{service}.{method}", cat="rpc",
+                          addr=self.address) as sp:
+                message = {**message,
+                           "__trace__": {"t": sp.trace_id, "s": sp.span_id}}
+                raw = await rpc(_pack(message), timeout=timeout)
+        else:
+            raw = await rpc(_pack(message), timeout=timeout)
         resp = _unpack(raw)
         if not resp.get("ok"):
             raise RpcError(f"{service}.{method}: {resp.get('error')}")

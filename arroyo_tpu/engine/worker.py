@@ -15,7 +15,7 @@ import os
 import time
 from typing import Dict, Optional
 
-from .. import chaos
+from .. import chaos, obs
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..operators.control import (
@@ -86,6 +86,7 @@ class WorkerServer:
         # honor a config-installed fault plan (ARROYO__CHAOS__PLAN reaches
         # spawned worker subprocesses through the config env layer)
         chaos.install_from_config()
+        obs.set_role(f"worker-{self.worker_id}")
         self.rpc.add_service(
             "WorkerGrpc",
             {
@@ -166,6 +167,14 @@ class WorkerServer:
     # -- WorkerGrpc ---------------------------------------------------------
 
     async def start_execution(self, req: dict) -> dict:
+        # nested under the rpc span of the controller's job.schedule trace
+        # (when tracing is active): plan/build/restore stages become
+        # visible, and a restore failure pinpoints its stage in the dump
+        with obs.span("worker.start_execution", cat="worker",
+                      worker=self.worker_id):
+            return await self._start_execution_inner(req)
+
+    async def _start_execution_inner(self, req: dict) -> dict:
         if req.get("sql"):
             from ..sql import plan_query
 
@@ -251,20 +260,31 @@ class WorkerServer:
             # stretch barrier alignment: peers' barriers race ahead while
             # this worker's sources delay injecting theirs
             await asyncio.sleep(float(spec.param("delay", 0.5)))
-        barrier = CheckpointBarrier(
-            epoch=req["epoch"], min_epoch=req.get("min_epoch", 0),
-            timestamp=now_nanos(), then_stop=req.get("then_stop", False),
-        )
-        for sub in self.program.source_subtasks():
-            sub.control_rx.put_nowait(CheckpointMsg(barrier))
+        # flight recorder: the barrier inherits the epoch trace from the
+        # controller's rpc (ambient context) and carries it in-band
+        with obs.span("worker.checkpoint", cat="worker",
+                      worker=self.worker_id, epoch=req["epoch"]) as sp:
+            barrier = CheckpointBarrier(
+                epoch=req["epoch"], min_epoch=req.get("min_epoch", 0),
+                timestamp=now_nanos(), then_stop=req.get("then_stop", False),
+                trace_id=sp.trace_id, span_id=sp.span_id,
+            )
+            for sub in self.program.source_subtasks():
+                sub.control_rx.put_nowait(CheckpointMsg(barrier))
         return {}
 
     async def commit(self, req: dict) -> dict:
         data: Dict[int, dict] = {}
         for node_id, subs in (req.get("committing") or {}).items():
             data[int(node_id)] = {"data": {int(s): v for s, v in subs.items()}}
+        ctx = obs.current()
+        msg = CommitMsg(req["epoch"], data)
+        if ctx is not None:
+            # phase-2 commits ride the control queue; attach the rpc's
+            # trace so sink commit spans join the epoch tree
+            msg.trace_id, msg.span_id = ctx
         for sub in self.program.subtasks:
-            sub.control_rx.put_nowait(CommitMsg(req["epoch"], data))
+            sub.control_rx.put_nowait(msg)
         return {}
 
     async def load_compacted(self, req: dict) -> dict:
@@ -380,6 +400,17 @@ class WorkerServer:
     async def _lead_checkpoint_inner(self, then_stop: bool, backend) -> int:
         self._leader_epoch += 1
         epoch = self._leader_epoch
+        # worker-leader mode mints the epoch trace here — same tree shape
+        # as the controller-driven cadence, rooted in the leader's process
+        with obs.span(
+            "checkpoint", trace=obs.new_trace(self.job_id, f"ck-{epoch}"),
+            cat="controller", job=self.job_id, epoch=epoch,
+            leader=self.worker_id, then_stop=then_stop,
+        ):
+            return await self._lead_checkpoint_run(epoch, then_stop, backend)
+
+    async def _lead_checkpoint_run(self, epoch: int, then_stop: bool,
+                                   backend) -> int:
         for wid in self._worker_rpc_addrs:
             payload = {"epoch": epoch, "then_stop": then_stop}
             if wid == self.worker_id:

@@ -4,28 +4,71 @@ Capability parity with the reference's `arroyo-metrics` crate +
 TaskCounters (/root/reference/crates/arroyo-operator/src/context.rs):
 per-task messages/batches/bytes rx-tx counters, per-queue occupancy gauges,
 and UI-facing 5-minute rate windows (computed in engine.job_metrics).
+
+The flight-recorder layer (arroyo_tpu/obs) adds a histogram kind
+(`Registry.histogram` → `.labels(...).observe(v)`) with standard
+`_bucket`/`_sum`/`_count` exposition, feeding per-subtask batch-processing
+latency, data-plane exchange latency, storage op latency and checkpoint
+phase durations, plus watermark-lag and barrier-alignment gauges.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
-from collections import defaultdict
-from typing import Dict, Tuple
+from collections import defaultdict, deque
+from typing import Dict, Optional, Tuple
 
 LabelSet = Tuple[Tuple[str, str], ...]
+
+# latency buckets (seconds): 1ms .. 10s, roughly log-spaced — covers the
+# data plane (sub-ms frames) through checkpoint flushes (seconds)
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
 
 
 def _escape_label(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+class _Hist:
+    """Per-labelset histogram state: bucket counts + running sum/count."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float, buckets: Tuple[float, ...]):
+        i = bisect.bisect_left(buckets, value)
+        if i < len(self.counts):
+            self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+    def cumulative(self) -> list:
+        out = []
+        cum = 0
+        for c in self.counts:
+            cum += c
+            out.append(cum)
+        return out
+
+
 class _Metric:
-    def __init__(self, name: str, help_: str, kind: str):
+    def __init__(self, name: str, help_: str, kind: str,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
         self.name = name
         self.help = help_
         self.kind = kind
+        self.buckets = tuple(buckets)
         self.values: Dict[LabelSet, float] = defaultdict(float)
+        self.hists: Dict[LabelSet, _Hist] = {}
         # scrape-time refreshers: key -> zero-arg callable returning the
         # current value (or None to keep the stored sample). Gauges whose
         # producer only updates on its own hot path (e.g. backpressure,
@@ -37,6 +80,13 @@ class _Metric:
     def labels(self, **labels: str) -> "_Handle":
         key = tuple(sorted(labels.items()))
         return _Handle(self, key)
+
+    def observe(self, key: LabelSet, value: float):
+        with self.lock:
+            h = self.hists.get(key)
+            if h is None:
+                h = self.hists[key] = _Hist(len(self.buckets))
+            h.observe(value, self.buckets)
 
     def _refresh(self):
         """Run registered refreshers (lock held), dropping dead ones."""
@@ -55,9 +105,33 @@ class _Metric:
         for key in dead:
             del self.refreshers[key]
 
+    @staticmethod
+    def _label_str(key: LabelSet, extra: str = "") -> str:
+        parts = [f'{k}="{_escape_label(v)}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self.lock:
+            if self.kind == "histogram":
+                for key, h in self.hists.items():
+                    cum = h.cumulative()
+                    for le, c in zip(self.buckets, cum):
+                        le_label = f'le="{le}"'
+                        lines.append(
+                            f"{self.name}_bucket"
+                            f"{self._label_str(key, le_label)} {c}"
+                        )
+                    inf_label = 'le="+Inf"'
+                    lines.append(
+                        f"{self.name}_bucket"
+                        f"{self._label_str(key, inf_label)} {h.count}"
+                    )
+                    lines.append(f"{self.name}_sum{self._label_str(key)} {h.sum}")
+                    lines.append(f"{self.name}_count{self._label_str(key)} {h.count}")
+                return "\n".join(lines)
             self._refresh()
             for key, val in self.values.items():
                 if key:
@@ -84,6 +158,10 @@ class _Handle:
         with self.metric.lock:
             self.metric.values[self.key] = value
 
+    def observe(self, value: float):
+        """Histogram observation (seconds for the latency families)."""
+        self.metric.observe(self.key, value)
+
     def set_refresher(self, fn):
         """Register a scrape-time refresher: `fn()` is called under the
         metric lock at expose/snapshot and must return the current value,
@@ -94,6 +172,20 @@ class _Handle:
     def get(self) -> float:
         with self.metric.lock:
             return self.metric.values[self.key]
+
+    def get_hist(self) -> Optional[dict]:
+        """Structured view of this labelset's histogram state."""
+        with self.metric.lock:
+            h = self.metric.hists.get(self.key)
+            if h is None:
+                return None
+            return _hist_dict(h, self.metric.buckets)
+
+
+def _hist_dict(h: _Hist, buckets: Tuple[float, ...]) -> dict:
+    out = {str(le): c for le, c in zip(buckets, h.cumulative())}
+    out["+Inf"] = h.count
+    return {"sum": h.sum, "count": h.count, "buckets": out}
 
 
 class Registry:
@@ -107,10 +199,15 @@ class Registry:
     def gauge(self, name: str, help_: str = "") -> _Metric:
         return self._get(name, help_, "gauge")
 
-    def _get(self, name: str, help_: str, kind: str) -> _Metric:
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> _Metric:
+        return self._get(name, help_, "histogram", buckets)
+
+    def _get(self, name: str, help_: str, kind: str,
+             buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> _Metric:
         with self.lock:
             if name not in self.metrics:
-                self.metrics[name] = _Metric(name, help_, kind)
+                self.metrics[name] = _Metric(name, help_, kind, buckets)
             return self.metrics[name]
 
     def expose(self) -> str:
@@ -120,19 +217,35 @@ class Registry:
 
     def snapshot(self) -> Dict[str, list]:
         """{metric name: [(labels dict, value)]} for structured consumers
-        (the API's operator metric groups)."""
+        (the API's operator metric groups). Histogram entries carry a
+        {"sum", "count", "buckets": {le: cumulative}} dict as the value."""
         with self.lock:
             metrics = list(self.metrics.items())
         out: Dict[str, list] = {}
         for name, m in metrics:
             with m.lock:
+                if m.kind == "histogram":
+                    out[name] = [
+                        (dict(k), _hist_dict(h, m.buckets))
+                        for k, h in m.hists.items()
+                    ]
+                    continue
                 m._refresh()
                 out[name] = [(dict(k), v) for k, v in m.values.items()]
         return out
 
     def reset(self):
+        """Clear every metric's samples IN PLACE. The _Metric objects stay
+        registered: module-level families (MESSAGES_RECV etc.) hand out
+        handles bound to those objects, and dropping them from the registry
+        would orphan the handles — increments would land in objects no
+        longer visible to expose()/snapshot() and silently vanish."""
         with self.lock:
-            self.metrics.clear()
+            for m in self.metrics.values():
+                with m.lock:
+                    m.values.clear()
+                    m.hists.clear()
+                    m.refreshers.clear()
 
 
 REGISTRY = Registry()
@@ -164,22 +277,52 @@ QUEUE_BYTES = REGISTRY.gauge(
 TPU_KERNEL_MILLIS = REGISTRY.counter(
     "arroyo_tpu_kernel_millis", "wall millis spent inside device kernels")
 
+# Flight-recorder latency families (ISSUE 4): histograms in seconds.
+BATCH_PROCESSING_SECONDS = REGISTRY.histogram(
+    "arroyo_worker_batch_processing_seconds",
+    "per-subtask wall time processing one input batch through the "
+    "operator chain")
+EXCHANGE_FRAME_SECONDS = REGISTRY.histogram(
+    "arroyo_exchange_frame_seconds",
+    "data-plane frame latency: send-timestamp (frame header) to receive "
+    "on the destination worker, per destination subtask")
+STORAGE_OP_SECONDS = REGISTRY.histogram(
+    "arroyo_storage_op_seconds",
+    "object-storage operation latency by op (put/get/cas)")
+CHECKPOINT_PHASE_SECONDS = REGISTRY.histogram(
+    "arroyo_checkpoint_phase_seconds",
+    "checkpoint phase durations per subtask (phase=align|capture|flush)")
+WATERMARK_LAG_SECONDS = REGISTRY.gauge(
+    "arroyo_worker_watermark_lag_seconds",
+    "wall-clock seconds the subtask's effective watermark trails now "
+    "(refreshed at scrape time)")
+BARRIER_ALIGNMENT_SECONDS = REGISTRY.gauge(
+    "arroyo_worker_barrier_alignment_seconds",
+    "seconds the subtask's last checkpoint barrier spent aligning "
+    "(first barrier arrival to all live inputs barriered)")
+
 
 class RateWindow:
-    """Fixed 5-minute circular buffer of (t, value) samples for UI rates
-    (reference: job_metrics.rs:188-265)."""
+    """Fixed 5-minute window of (t, value) samples for UI rates
+    (reference: job_metrics.rs:188-265). Backed by a deque — the old
+    list + pop(0) trim was O(n) per add on long-running jobs — and
+    hard-capped at MAX_SAMPLES so a hot producer can't grow it without
+    bound inside the time window."""
 
     WINDOW = 300.0
+    MAX_SAMPLES = 4096
 
     def __init__(self):
-        self.samples: list[tuple[float, float]] = []
+        self.samples: deque[tuple[float, float]] = deque()
 
     def add(self, value: float, now: float | None = None):
         now = time.monotonic() if now is None else now
         self.samples.append((now, value))
         cutoff = now - self.WINDOW
         while self.samples and self.samples[0][0] < cutoff:
-            self.samples.pop(0)
+            self.samples.popleft()
+        while len(self.samples) > self.MAX_SAMPLES:
+            self.samples.popleft()
 
     def rate(self) -> float:
         if len(self.samples) < 2:

@@ -1,0 +1,152 @@
+"""Observability ("flight recorder") public surface.
+
+Usage — sync extent (context manager attaches the trace context):
+
+    with obs.span("checkpoint", trace=obs.new_trace(job_id, "ck-3"),
+                  cat="controller", epoch=3) as sp:
+        ...                      # nested obs.span(...) calls become children
+
+Async hop (explicit start/finish across awaits or threads):
+
+    sp = obs.start_span("checkpoint.flush", trace=tid, parent=pid,
+                        cat="runner")
+    tok = sp.attach()            # storage spans nest under it
+    try: ...
+    finally:
+        sp.detach(tok); sp.finish()
+
+`obs.span(...)` with neither an explicit trace nor an ambient context
+returns an inert NULL span, so instrumentation never needs None checks.
+Config: `obs.enabled` gates everything; `obs.trace_buffer_spans` sizes
+the per-process ring buffer; `obs.frame_sample_every` rates data-plane
+frame tracing. Export: `/debug/trace` on the admin server,
+`/api/v1/jobs/{id}/traces` on the REST API, `tools/trace_report.py` for
+multi-process merges.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .trace import (  # noqa: F401 - public surface
+    NULL_SPAN,
+    Span,
+    TraceRecorder,
+    attach,
+    chrome_trace,
+    current,
+    detach,
+    new_span_id,
+    new_trace,
+)
+
+_RECORDER: Optional[TraceRecorder] = None
+_ROLE: str = ""
+
+
+def set_role(role: str) -> None:
+    """Name this process's track in trace exports ('controller',
+    'worker-2000', ...). Takes effect for spans recorded afterwards."""
+    global _ROLE
+    _ROLE = role
+    if _RECORDER is not None:
+        _RECORDER.role = role
+
+
+def enabled() -> bool:
+    from ..config import config
+
+    return bool(config().obs.enabled)
+
+
+def frame_sample_every() -> int:
+    from ..config import config
+
+    return int(config().obs.frame_sample_every)
+
+
+def recorder() -> TraceRecorder:
+    """The process-wide ring buffer (lazily sized from
+    obs.trace_buffer_spans)."""
+    global _RECORDER
+    if _RECORDER is None:
+        from ..config import config
+
+        _RECORDER = TraceRecorder(
+            config().obs.trace_buffer_spans,
+            role=_ROLE or f"proc-{os.getpid()}",
+        )
+    return _RECORDER
+
+
+def reset(capacity: Optional[int] = None) -> TraceRecorder:
+    """Drop the recorder and rebuild (tests; capacity override)."""
+    global _RECORDER
+    if capacity is None:
+        _RECORDER = None
+        return recorder()
+    _RECORDER = TraceRecorder(capacity, role=_ROLE or f"proc-{os.getpid()}")
+    return _RECORDER
+
+
+def span(name: str, *, trace: Optional[str] = None,
+         parent: Optional[str] = None, cat: str = "obs", **attrs):
+    """Create a span. With `trace` (+ optional `parent`) it anchors
+    explicitly; without, it becomes a child of the ambient context — or a
+    NULL span when there is none (un-traced code paths stay silent)."""
+    if not enabled():
+        return NULL_SPAN
+    if trace is None:
+        ctx = current()
+        if ctx is None:
+            return NULL_SPAN
+        trace = ctx[0]
+        if parent is None:
+            parent = ctx[1]
+    elif parent is None:
+        ctx = current()
+        if ctx is not None and ctx[0] == trace:
+            parent = ctx[1]
+    return Span(trace, new_span_id(), parent, name, cat, attrs)
+
+
+def start_span(name: str, *, trace: Optional[str] = None,
+               parent: Optional[str] = None, cat: str = "obs", **attrs):
+    """Alias of span() for call sites that finish() explicitly (async
+    hops); reads as intent."""
+    return span(name, trace=trace, parent=parent, cat=cat, **attrs)
+
+
+def event(name: str, *, cat: str = "event", **attrs) -> None:
+    """Record an instant event. Attaches to the ambient span when one is
+    active; otherwise lands as a standalone instant under a per-process
+    trace so it still shows up in dumps (chaos fires use this)."""
+    if not enabled():
+        return
+    import time
+
+    ctx = current()
+    recorder().record({
+        "trace_id": ctx[0] if ctx else f"proc/{os.getpid()}",
+        "span_id": new_span_id(),
+        "parent_id": ctx[1] if ctx else None,
+        "name": name,
+        "cat": cat,
+        "ts": time.time() * 1e6,
+        "dur": 0.0,
+        "instant": True,
+        "attrs": dict(attrs),
+        "events": [],
+        "pid": os.getpid(),
+        "tid": 0,
+    })
+
+
+def headers() -> Optional[dict]:
+    """The ambient context as a wire header ({'t': trace, 's': span}), or
+    None — RPC clients attach this under the '__trace__' message key."""
+    ctx = current()
+    if ctx is None:
+        return None
+    return {"t": ctx[0], "s": ctx[1]}

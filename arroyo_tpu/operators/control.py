@@ -30,6 +30,10 @@ class CommitMsg:
     committing_data: Dict[int, Dict[str, Dict[int, List[bytes]]]] = dataclasses.field(
         default_factory=dict
     )
+    # flight-recorder context of the phase-2 fan-out (obs): sink commit
+    # spans parent here so the 2PC leg joins the epoch's trace tree
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclasses.dataclass

@@ -18,13 +18,30 @@ not re-armed — no polling).
 from __future__ import annotations
 
 import asyncio
+import time
 import traceback
+import weakref
 from typing import Dict, List, Optional
 
 import pyarrow as pa
 
-from ..metrics import BATCHES_RECV, BYTES_RECV, MESSAGES_RECV
-from ..types import SignalKind, SignalMessage, StopMode, Watermark
+from .. import obs
+from ..metrics import (
+    BARRIER_ALIGNMENT_SECONDS,
+    BATCH_PROCESSING_SECONDS,
+    BATCHES_RECV,
+    BYTES_RECV,
+    CHECKPOINT_PHASE_SECONDS,
+    MESSAGES_RECV,
+    WATERMARK_LAG_SECONDS,
+)
+from ..types import (
+    SignalKind,
+    SignalMessage,
+    StopMode,
+    Watermark,
+    WatermarkKind,
+)
 from ..utils.logging import get_logger
 from .base import Operator, SourceFinishType, SourceOperator
 from .collector import Collector
@@ -101,6 +118,18 @@ class SubtaskRunner:
         self._batches_recv = BATCHES_RECV.labels(job=jid, task=tid)
         self._msgs_recv = MESSAGES_RECV.labels(job=jid, task=tid)
         self._bytes_recv = BYTES_RECV.labels(job=jid, task=tid)
+        # flight recorder: per-subtask latency/lag instruments
+        self._batch_seconds = BATCH_PROCESSING_SECONDS.labels(
+            job=jid, task=tid)
+        self._align_gauge = BARRIER_ALIGNMENT_SECONDS.labels(
+            job=jid, task=tid)
+        self._phase_obs = {
+            p: CHECKPOINT_PHASE_SECONDS.labels(job=jid, task=tid, phase=p)
+            for p in ("align", "capture", "flush")
+        }
+        self._wm_lag = None  # registered lazily on the first watermark
+        self._align_span = obs.NULL_SPAN
+        self._align_started: Optional[float] = None
 
     @property
     def is_source(self) -> bool:
@@ -110,10 +139,16 @@ class SubtaskRunner:
 
     async def run(self):
         try:
-            for op, ctx in zip(self.ops, self.ctxs):
-                if ctx.table_manager is not None:
-                    await ctx.table_manager.open(op.tables())
-                await op.on_start(ctx)
+            # under the job.schedule trace (context inherited at task
+            # spawn): table restore + operator on_start become visible
+            # stages of a (re)start in the flight recording
+            with obs.span("task.start", cat="runner",
+                          task=self.task_info.task_id) as sp:
+                for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
+                    if ctx.table_manager is not None:
+                        await ctx.table_manager.open(op.tables())
+                    sp.event("on_start", op=type(op).__name__, op_idx=idx)
+                    await op.on_start(ctx)
             if self.is_source:
                 await self._run_source()
             else:
@@ -367,6 +402,7 @@ class SubtaskRunner:
             if item.kind == SignalKind.WATERMARK:
                 changed = self.watermarks.set(i, item.watermark)
                 if changed is not None:
+                    self._track_watermark_lag(changed)
                     await self._chain_watermark(0, changed)
                 return True
             if item.kind == SignalKind.BARRIER:
@@ -383,10 +419,36 @@ class SubtaskRunner:
         self._batches_recv.inc()
         self._msgs_recv.inc(item.num_rows)
         self._bytes_recv.inc(batch_bytes(item))
+        t0 = time.perf_counter()
         await self.ops[0].process_batch(
             item, self.ctxs[0], self.collectors[0], iq.logical_input
         )
+        self._batch_seconds.observe(time.perf_counter() - t0)
         return True
+
+    def _track_watermark_lag(self, wm: Watermark):
+        """Per-subtask watermark-lag gauge: wall clock minus the effective
+        watermark, refreshed at scrape time so a quiesced stream shows its
+        lag GROWING instead of pinning the last computed value."""
+        if wm.kind != WatermarkKind.EVENT_TIME or wm.timestamp is None:
+            return
+        if self._wm_lag is None:
+            self._wm_lag = WATERMARK_LAG_SECONDS.labels(
+                job=self.task_info.job_id, task=self.task_info.task_id
+            )
+            holder_ref = weakref.ref(self.watermarks)
+
+            def _lag_now():
+                holder = holder_ref()
+                if holder is None:
+                    return None  # runner gone: unregister
+                ts = holder.current_nanos()
+                if ts is None:
+                    return 0.0
+                return max(0.0, (time.time_ns() - ts) / 1e9)
+
+            self._wm_lag.set_refresher(_lag_now)
+        self._wm_lag.set(max(0.0, (time.time_ns() - wm.timestamp) / 1e9))
 
     # ------------------------------------------------------------ watermark
 
@@ -404,11 +466,24 @@ class SubtaskRunner:
 
     # ------------------------------------------------------------- barriers
 
+    def _barrier_span(self, name: str, barrier, parent: Optional[str] = None):
+        """A span anchored to the barrier's epoch trace (NULL when the
+        barrier is untraced, so nothing anchors to unrelated contexts)."""
+        if not barrier.trace_id:
+            return obs.NULL_SPAN
+        return obs.start_span(
+            name, trace=barrier.trace_id,
+            parent=parent or (barrier.span_id or None), cat="runner",
+            task=self.task_info.task_id, epoch=barrier.epoch,
+        )
+
     async def _handle_barrier(self, i: int, barrier) -> bool:
         """Align: block input i until all live inputs delivered the barrier
         (reference operator.rs:673-708, 1036-1046)."""
         if self._current_barrier is None:
             self._current_barrier = barrier
+            self._align_started = time.perf_counter()
+            self._align_span = self._barrier_span("barrier.align", barrier)
             self.control_tx.put_nowait(
                 CheckpointEventResp(
                     self.task_info.task_id,
@@ -430,6 +505,14 @@ class SubtaskRunner:
         if not live.issubset(self._barrier_inputs):
             return
         barrier = self._current_barrier
+        if self._align_started is not None:
+            align_secs = time.perf_counter() - self._align_started
+            self._align_started = None
+            self._align_gauge.set(align_secs)
+            self._phase_obs["align"].observe(align_secs)
+        self._align_span.set(inputs=len(self.inputs))
+        self._align_span.finish()
+        self._align_span = obs.NULL_SPAN
         await self._checkpoint_chain(barrier)
         self._current_barrier = None
         self._barrier_inputs.clear()
@@ -452,28 +535,43 @@ class SubtaskRunner:
                 "started_checkpointing",
             )
         )
-        captured = []
-        commit_data = None
-        for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
-            await op.handle_checkpoint(barrier, ctx, self.collectors[idx])
-            if ctx.table_manager is not None:
-                captured.append(
-                    (
-                        idx,
-                        ctx.table_manager.capture(
-                            barrier.epoch, self.watermarks.current_nanos()
-                        ),
+        t0 = time.perf_counter()
+        cap_span = self._barrier_span("checkpoint.capture", barrier)
+        with cap_span:
+            captured = []
+            commit_data = None
+            for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
+                await op.handle_checkpoint(barrier, ctx, self.collectors[idx])
+                if ctx.table_manager is not None:
+                    captured.append(
+                        (
+                            idx,
+                            ctx.table_manager.capture(
+                                barrier.epoch, self.watermarks.current_nanos()
+                            ),
+                        )
                     )
-                )
-            if ctx.commit_data is not None:
-                commit_data = ctx.commit_data
-                ctx.commit_data = None
-        if commit_data is not None:
-            self._await_commit_epoch = barrier.epoch
-        await self.tail.broadcast(SignalMessage.barrier_of(barrier))
+                if ctx.commit_data is not None:
+                    commit_data = ctx.commit_data
+                    ctx.commit_data = None
+            if commit_data is not None:
+                self._await_commit_epoch = barrier.epoch
+            # downstream barriers parent to THIS hop's capture span, so the
+            # epoch trace follows the operator graph across the data plane
+            out_barrier = (
+                barrier.with_span(cap_span.span_id)
+                if cap_span.recording else barrier
+            )
+            await self.tail.broadcast(SignalMessage.barrier_of(out_barrier))
+        self._phase_obs["capture"].observe(time.perf_counter() - t0)
+        flush_span = self._barrier_span(
+            "checkpoint.flush", barrier,
+            parent=cap_span.span_id or None,
+        )
         flush = asyncio.ensure_future(
             self._flush_and_report(barrier, captured, commit_data,
-                                   self.watermarks.current_nanos())
+                                   self.watermarks.current_nanos(),
+                                   flush_span)
         )
         self._pending_flush = flush
         if barrier.then_stop:
@@ -486,11 +584,15 @@ class SubtaskRunner:
             await flush
 
     async def _flush_and_report(self, barrier, captured, commit_data,
-                                watermark):
+                                watermark, flush_span=obs.NULL_SPAN):
+        t0 = time.perf_counter()
+        tok = flush_span.attach() if flush_span.recording else None
         try:
             metadata: Dict[str, dict] = {}
             for idx, staged in captured:
                 tm = self.ctxs[idx].table_manager
+                # the storage-commit leg of the epoch tree: to_thread
+                # copies the attached context, so storage.put spans nest
                 metadata[f"op{idx}"] = await asyncio.to_thread(
                     tm.flush_captured, barrier.epoch, staged
                 )
@@ -501,6 +603,7 @@ class SubtaskRunner:
                 "checkpoint flush failed for %s epoch %s",
                 self.task_info.task_id, barrier.epoch,
             )
+            flush_span.set(error=traceback.format_exc(limit=3)[:300])
             self.control_tx.put_nowait(
                 TaskFailedResp(
                     self.task_info.task_id,
@@ -510,6 +613,11 @@ class SubtaskRunner:
                 )
             )
             return
+        finally:
+            if tok is not None:
+                flush_span.detach(tok)
+            flush_span.finish()
+            self._phase_obs["flush"].observe(time.perf_counter() - t0)
         self.control_tx.put_nowait(
             CheckpointCompletedResp(
                 self.task_info.task_id,
@@ -540,9 +648,17 @@ class SubtaskRunner:
             )
 
     async def _handle_commit(self, msg: CommitMsg):
-        node_data = msg.committing_data.get(self.task_info.node_id, {})
-        for op, ctx in zip(self.ops, self.ctxs):
-            await op.handle_commit(msg.epoch, node_data, ctx)
+        span = obs.NULL_SPAN
+        if msg.trace_id:
+            span = obs.start_span(
+                "commit.apply", trace=msg.trace_id,
+                parent=msg.span_id or None, cat="runner",
+                task=self.task_info.task_id, epoch=msg.epoch,
+            )
+        with span:
+            node_data = msg.committing_data.get(self.task_info.node_id, {})
+            for op, ctx in zip(self.ops, self.ctxs):
+                await op.handle_commit(msg.epoch, node_data, ctx)
         if (
             self._await_commit_epoch is not None
             and msg.epoch >= self._await_commit_epoch

@@ -17,11 +17,13 @@ fencing is weakened (reference: conditional-put support in
 from __future__ import annotations
 
 import os
+import time as _time
 from pathlib import Path
 from typing import List, Optional, Tuple
 from urllib.parse import quote, urlparse
 
-from .. import chaos
+from .. import chaos, obs
+from ..metrics import STORAGE_OP_SECONDS
 from ..utils.logging import get_logger
 
 logger = get_logger("storage")
@@ -33,6 +35,31 @@ def _chaos_latency(op: str, key: str) -> None:
         import time
 
         time.sleep(float(spec.param("delay", 0.05)))
+
+
+class _OpTimer:
+    """Times one storage operation into the arroyo_storage_op_seconds
+    histogram and — when a trace context is active (checkpoint flush,
+    manifest publish, restore) — a `storage.<op>` span. Deliberately
+    includes injected chaos latency/failures: the flight recorder should
+    SHOW the fault, not hide it."""
+
+    __slots__ = ("op", "span", "t0")
+
+    def __init__(self, op: str, key: str):
+        self.op = op
+        self.span = obs.span(f"storage.{op}", cat="storage", key=key)
+
+    def __enter__(self):
+        self.t0 = _time.perf_counter()
+        self.span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.span.__exit__(exc_type, exc, tb)
+        STORAGE_OP_SECONDS.labels(op=self.op).observe(
+            _time.perf_counter() - self.t0
+        )
 
 
 class CasConflict(Exception):
@@ -83,24 +110,29 @@ class StorageProvider:
         return str(self.root / key)
 
     def put(self, key: str, data: bytes):
-        _chaos_latency("put", key)
-        if chaos.fire("storage.write_fail", key=key):
-            raise IOError(
-                f"chaos[storage.write_fail]: injected transient write "
-                f"failure for {key}"
-            )
-        if self.fs is None:
-            p = Path(self._full(key))
-            p.parent.mkdir(parents=True, exist_ok=True)
-            tmp = p.with_suffix(p.suffix + f".tmp{os.getpid()}")
-            tmp.write_bytes(data)
-            os.replace(tmp, p)
-        else:
-            with self.fs.open_output_stream(self._full(key)) as f:
-                f.write(data)
+        with _OpTimer("put", key):
+            _chaos_latency("put", key)
+            if chaos.fire("storage.write_fail", key=key):
+                raise IOError(
+                    f"chaos[storage.write_fail]: injected transient write "
+                    f"failure for {key}"
+                )
+            if self.fs is None:
+                p = Path(self._full(key))
+                p.parent.mkdir(parents=True, exist_ok=True)
+                tmp = p.with_suffix(p.suffix + f".tmp{os.getpid()}")
+                tmp.write_bytes(data)
+                os.replace(tmp, p)
+            else:
+                with self.fs.open_output_stream(self._full(key)) as f:
+                    f.write(data)
 
     def put_if_not_exists(self, key: str, data: bytes):
         """CAS create: raises CasConflict if the key exists."""
+        with _OpTimer("cas", key):
+            self._put_if_not_exists_inner(key, data)
+
+    def _put_if_not_exists_inner(self, key: str, data: bytes):
         if chaos.fire("storage.cas_conflict", key=key):
             # a lost CAS race: the conflict surfaces but the key does NOT
             # exist afterwards — the hardest shape for callers to handle
@@ -295,19 +327,20 @@ class StorageProvider:
         return True
 
     def get(self, key: str) -> Optional[bytes]:
-        _chaos_latency("get", key)
-        if self.fs is None:
-            p = Path(self._full(key))
-            if not p.exists():
-                return None
-            return p.read_bytes()
-        import pyarrow.fs as pafs
+        with _OpTimer("get", key):
+            _chaos_latency("get", key)
+            if self.fs is None:
+                p = Path(self._full(key))
+                if not p.exists():
+                    return None
+                return p.read_bytes()
+            import pyarrow.fs as pafs
 
-        try:
-            with self.fs.open_input_stream(self._full(key)) as f:
-                return f.read()
-        except (FileNotFoundError, OSError):
-            return None
+            try:
+                with self.fs.open_input_stream(self._full(key)) as f:
+                    return f.read()
+            except (FileNotFoundError, OSError):
+                return None
 
     def exists(self, key: str) -> bool:
         if self.fs is None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from .. import obs
 from ..types import TaskInfo
 from ..utils.logging import get_logger
 from .backend import StateBackend
@@ -50,35 +51,49 @@ class TableManager:
         restore_wm = self.backend.restore_watermark(self.task_info.task_id)
         for name, table in self.tables.items():
             cfg = self.configs[name]
-            if cfg.kind == "global":
-                blobs = []
-                for entry in per_subtask:
-                    meta = entry["tables"].get(name)
-                    if meta and meta.get("path"):
-                        blob = self.backend.read_blob(meta["path"])
-                        if blob is not None:
-                            blobs.append(blob)
-                table.load(blobs)
-            else:
-                seen = set()
-                batches = []
-                for entry in per_subtask:
-                    meta = entry["tables"].get(name)
-                    for f in (meta or {}).get("files", []):
-                        if f["path"] in seen:
-                            continue
-                        seen.add(f["path"])
-                        t = self.backend.read_parquet(f["path"])
-                        if t is not None:
-                            batches.extend(t.to_batches())
-                        table.files.append(dict(f))
-                table.load_batches(
-                    batches,
-                    key_indices=None,
-                    parallelism=self.task_info.parallelism,
-                    task_index=self.task_info.task_index,
-                )
-                table.filter_expired(restore_wm)
+            # flight recorder: one span per restored table, staged events
+            # per file — a restore failure (e.g. the process-scheduler
+            # IndexError in ROADMAP open items) names its table, file and
+            # stage in the trace dump instead of just a stack
+            with obs.span(
+                "state.restore_table", cat="storage", table=name,
+                kind=cfg.kind, task=self.task_info.task_id,
+                op_idx=self.op_idx,
+            ) as sp:
+                if cfg.kind == "global":
+                    blobs = []
+                    for entry in per_subtask:
+                        meta = entry["tables"].get(name)
+                        if meta and meta.get("path"):
+                            blob = self.backend.read_blob(meta["path"])
+                            if blob is not None:
+                                blobs.append(blob)
+                    table.load(blobs)
+                    sp.set(blobs=len(blobs))
+                else:
+                    seen = set()
+                    batches = []
+                    for entry in per_subtask:
+                        meta = entry["tables"].get(name)
+                        for f in (meta or {}).get("files", []):
+                            if f["path"] in seen:
+                                continue
+                            seen.add(f["path"])
+                            sp.event("read_file", path=f["path"])
+                            t = self.backend.read_parquet(f["path"])
+                            if t is not None:
+                                batches.extend(t.to_batches())
+                            table.files.append(dict(f))
+                    sp.set(files=len(seen), batches=len(batches))
+                    sp.event("load_batches")
+                    table.load_batches(
+                        batches,
+                        key_indices=None,
+                        parallelism=self.task_info.parallelism,
+                        task_index=self.task_info.task_index,
+                    )
+                    sp.event("filter_expired", watermark=restore_wm)
+                    table.filter_expired(restore_wm)
 
     async def get_table(self, name: str):
         return self.tables[name]

@@ -111,6 +111,18 @@ class CheckpointBarrier:
     min_epoch: int
     timestamp: int  # nanos when initiated
     then_stop: bool = False
+    # flight-recorder trace context (obs): the controller mints one trace
+    # per epoch; span_id is rewritten at each hop (worker fan-out, subtask
+    # re-broadcast) so downstream alignment spans parent to their causal
+    # predecessor. Empty strings = untraced barrier (obs disabled).
+    trace_id: str = ""
+    span_id: str = ""
+
+    def with_span(self, span_id: str) -> "CheckpointBarrier":
+        """The barrier re-broadcast downstream, parented to this hop."""
+        if not self.trace_id:
+            return self
+        return dataclasses.replace(self, span_id=span_id)
 
 
 class SignalKind(enum.Enum):

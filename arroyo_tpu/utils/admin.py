@@ -113,6 +113,26 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
         pstats.Stats(pr, stream=buf).sort_stats(sort).print_stats(limit)
         return web.Response(text=buf.getvalue(), content_type="text/plain")
 
+    async def debug_trace(request: web.Request):
+        """Flight-recorder dump: the process's span ring buffer as Chrome
+        trace-event JSON (load in Perfetto / chrome://tracing; merge
+        multi-process dumps with tools/trace_report.py). Query params:
+        ?trace=<id> filters one trace, ?prefix=<job_id>/ one job,
+        ?clear=1 empties the buffer after the dump."""
+        from .. import obs
+
+        rec = obs.recorder()
+        spans = rec.snapshot(
+            trace_prefix=request.query.get("prefix"),
+            trace_id=request.query.get("trace"),
+        )
+        body = obs.chrome_trace(spans)
+        body["spanCount"] = len(spans)
+        body["dropped"] = rec.dropped
+        if request.query.get("clear"):
+            rec.clear()
+        return web.json_response(body)
+
     app = web.Application()
     app.router.add_get("/status", status)
     app.router.add_get("/name", name)
@@ -120,6 +140,7 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)
     app.router.add_get("/debug/profile", debug_profile)
+    app.router.add_get("/debug/trace", debug_trace)
     return app
 
 

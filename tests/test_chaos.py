@@ -244,9 +244,13 @@ def test_multihost_init_failure_names_coordinator(monkeypatch):
 def test_fast_smoke_drill(tmp_path):
     """1 golden, 2 faults (data-plane drop + manifest CAS loss) through
     the real embedded cluster: output identical to the fault-free run,
-    and the fired-fault log equals the seed's deterministic schedule."""
+    the fired-fault log equals the seed's deterministic schedule, and
+    every fired fault lands in the flight recorder as a span event
+    (ISSUE 4: drill timelines show fault -> detection -> recovery)."""
+    from arroyo_tpu import obs
     from arroyo_tpu.chaos import drill
 
+    obs.reset()
     res = drill.run_drill(
         drill.DEFAULT_DRILL_QUERIES[0], seed=1234, workdir=str(tmp_path),
         plan_factory=drill.fast_plan, throttle=400.0,
@@ -257,3 +261,19 @@ def test_fast_smoke_drill(tmp_path):
     # reproducibility: the schedule is a pure function of the seed
     assert res.expected_log == drill.fast_plan(1234).expected_log()
     assert res.expected_log != drill.fast_plan(4321).expected_log()
+    # every fired fault is a chaos.fire:<point> instant in the recorder
+    fired_points = {e["point"] for e in res.fired}
+    recorded = {
+        s["name"].removeprefix("chaos.fire:")
+        for s in obs.recorder().snapshot()
+        if s["name"].startswith("chaos.fire:")
+    }
+    assert fired_points <= recorded, (fired_points, recorded)
+    # the CAS-conflict fire happens INSIDE the manifest publish: it must
+    # attach to the live checkpoint trace, not float free
+    cas_events = [
+        s for s in obs.recorder().snapshot()
+        if s["name"] == "chaos.fire:storage.cas_conflict"
+    ]
+    assert any("/ck-" in s["trace_id"] for s in cas_events), cas_events
+    obs.reset()

@@ -1,0 +1,417 @@
+"""Flight recorder (ISSUE 4): histogram metric kind, span API + ring
+buffer, cross-process trace propagation through a real embedded-cluster
+checkpoint, and the /metrics + trace export surfaces."""
+
+import asyncio
+import json
+
+import pytest
+
+from arroyo_tpu import obs
+from arroyo_tpu.metrics import (
+    BATCHES_RECV,
+    DEFAULT_BUCKETS,
+    RateWindow,
+    Registry,
+    REGISTRY,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- histogram metric kind ---------------------------------------------------
+
+
+def test_histogram_buckets_and_exposition():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "test latency", buckets=(0.01, 0.1, 1.0))
+    hd = h.labels(op="x")
+    for v in (0.005, 0.05, 0.5, 5.0):
+        hd.observe(v)
+    text = reg.expose()
+    assert 'lat_seconds_bucket{op="x",le="0.01"} 1' in text
+    assert 'lat_seconds_bucket{op="x",le="0.1"} 2' in text
+    assert 'lat_seconds_bucket{op="x",le="1.0"} 3' in text
+    assert 'lat_seconds_bucket{op="x",le="+Inf"} 4' in text
+    assert 'lat_seconds_count{op="x"} 4' in text
+    assert 'lat_seconds_sum{op="x"} 5.555' in text
+    assert "# TYPE lat_seconds histogram" in text
+
+
+def test_histogram_snapshot_and_handle_view():
+    reg = Registry()
+    h = reg.histogram("s", "", buckets=(1.0,))
+    h.labels(a="1").observe(0.5)
+    h.labels(a="1").observe(2.0)
+    snap = reg.snapshot()["s"]
+    assert snap == [({"a": "1"}, {"sum": 2.5, "count": 2,
+                                  "buckets": {"1.0": 1, "+Inf": 2}})]
+    assert h.labels(a="1").get_hist()["count"] == 2
+    assert h.labels(a="other").get_hist() is None
+
+
+def test_histogram_boundary_lands_in_its_bucket():
+    # Prometheus buckets are <= le: an observation exactly on a boundary
+    # counts in that bucket
+    reg = Registry()
+    h = reg.histogram("b", "", buckets=(0.1, 1.0))
+    h.labels().observe(0.1)
+    assert h.labels().get_hist()["buckets"]["0.1"] == 1
+
+
+def test_default_buckets_are_sorted_and_latency_shaped():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+    assert DEFAULT_BUCKETS[0] <= 0.001 and DEFAULT_BUCKETS[-1] >= 10
+
+
+# -- Registry.reset regression (satellite) -----------------------------------
+
+
+def test_reset_keeps_module_level_handles_visible():
+    """Registry.reset() used to drop the _Metric objects from the
+    registry while module-level families kept handles to them: increments
+    after reset() silently vanished from expose()/snapshot(). reset()
+    now clears values in place."""
+    handle = BATCHES_RECV.labels(job="rj", task="0-0")
+    handle.inc()
+    REGISTRY.reset()
+    assert handle.get() == 0  # cleared in place
+    handle.inc(3)
+    assert 'arroyo_worker_batches_recv{job="rj",task="0-0"} 3' in (
+        REGISTRY.expose()
+    )
+    snap = REGISTRY.snapshot()["arroyo_worker_batches_recv"]
+    assert ({"job": "rj", "task": "0-0"}, 3.0) in snap
+    REGISTRY.reset()
+
+
+def test_reset_clears_histograms_and_refreshers():
+    reg = Registry()
+    h = reg.histogram("hh", "")
+    h.labels(x="1").observe(1.0)
+    g = reg.gauge("gg", "")
+    g.labels(x="1").set_refresher(lambda: 42.0)
+    reg.reset()
+    assert h.labels(x="1").get_hist() is None
+    assert "gg 42" not in reg.expose()
+
+
+# -- RateWindow (satellite) --------------------------------------------------
+
+
+def test_rate_window_deque_trims_time_and_caps_samples():
+    w = RateWindow()
+    from collections import deque
+
+    assert isinstance(w.samples, deque)
+    w.add(0.0, now=0.0)
+    w.add(100.0, now=100.0)
+    w.add(400.0, now=400.0)  # pushes the t=0 sample out of the window
+    assert w.samples[0][0] == 100.0
+    assert w.rate() == pytest.approx(1.0)
+    # hard cap regardless of window
+    w2 = RateWindow()
+    for i in range(RateWindow.MAX_SAMPLES + 50):
+        w2.add(float(i), now=100.0 + i * 0.001)
+    assert len(w2.samples) == RateWindow.MAX_SAMPLES
+
+
+# -- span API + ring buffer --------------------------------------------------
+
+
+def test_span_nesting_parents_and_events():
+    with obs.span("root", trace="t/1", cat="a", k=1) as root:
+        assert obs.current() == ("t/1", root.span_id)
+        with obs.span("child", cat="b") as child:
+            assert child.trace_id == "t/1"
+            assert child.parent_id == root.span_id
+            child.event("marker", n=2)
+    spans = obs.recorder().snapshot(trace_id="t/1")
+    assert [s["name"] for s in spans] == ["child", "root"]  # finish order
+    assert spans[0]["events"][0]["name"] == "marker"
+    assert spans[1]["parent_id"] is None
+
+
+def test_span_without_context_is_null():
+    sp = obs.span("floating")
+    assert sp is obs.NULL_SPAN
+    with sp:
+        sp.event("x")
+        sp.set(a=1)
+    assert len(obs.recorder()) == 0
+
+
+def test_span_disabled_by_config():
+    from arroyo_tpu.config import update
+
+    with update(obs={"enabled": False}):
+        assert obs.span("x", trace="t/1") is obs.NULL_SPAN
+        obs.event("e")
+    assert len(obs.recorder()) == 0
+
+
+def test_ring_buffer_overflow_drops_oldest():
+    rec = obs.reset(capacity=10)
+    for i in range(25):
+        with obs.span(f"s{i}", trace="t/ring"):
+            pass
+    assert len(rec) == 10
+    assert rec.dropped == 15
+    names = [s["name"] for s in rec.snapshot()]
+    assert names == [f"s{i}" for i in range(15, 25)]  # oldest dropped
+
+
+def test_error_in_span_recorded():
+    with pytest.raises(ValueError):
+        with obs.span("boom", trace="t/err"):
+            raise ValueError("nope")
+    (sp,) = obs.recorder().snapshot(trace_id="t/err")
+    assert "ValueError" in sp["attrs"]["error"]
+
+
+def test_chrome_trace_export_shape():
+    with obs.span("root", trace="t/x", cat="c") as sp:
+        sp.event("inst")
+    obs.event("lone", cat="chaos")
+    doc = obs.chrome_trace(obs.recorder().snapshot())
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert {"X", "i", "M"} <= phases
+    x = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+    assert x["args"]["trace_id"] == "t/x"
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_attach_detach_for_async_hops():
+    sp = obs.start_span("hop", trace="t/hop")
+    tok = sp.attach()
+    try:
+        child = obs.span("inner")
+        assert child.parent_id == sp.span_id
+        child.finish()
+    finally:
+        sp.detach(tok)
+        sp.finish()
+    assert obs.current() is None
+    assert len(obs.recorder()) == 2
+
+
+# -- cross-process propagation through a real embedded cluster ---------------
+
+
+CLUSTER_SQL = """
+CREATE TABLE impulse WITH (
+  connector = 'impulse', event_rate = '150000',
+  message_count = '100000', start_time = '0', realtime = 'true'
+);
+CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+  connector = 'single_file', path = '{out}',
+  format = 'json', type = 'sink'
+);
+INSERT INTO out
+SELECT k, cnt FROM (
+  SELECT counter % 8 as k, tumble(interval '1 millisecond') as w,
+         count(*) as cnt
+  FROM impulse GROUP BY 1, 2
+);
+"""
+
+
+def _connected_tree(spans):
+    """(single_root, orphans): parent links resolve within the trace."""
+    by_id = {s["span_id"]: s for s in spans}
+    roots = [s for s in spans if s["parent_id"] is None]
+    orphans = [
+        s for s in spans
+        if s["parent_id"] is not None and s["parent_id"] not in by_id
+    ]
+    return len(roots) == 1, orphans
+
+
+def test_checkpoint_trace_tree_spans_cluster(tmp_path):
+    """The golden acceptance: a windowed-agg run on the embedded cluster
+    (controller + 2 workers over real gRPC + TCP) produces, per
+    checkpoint epoch, ONE connected span tree covering controller →
+    worker → operator barrier → storage commit — and /metrics exposes
+    the new histogram families and watermark-lag gauges."""
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    async def go():
+        c = await ControllerServer(EmbeddedScheduler()).start()
+        with update(pipeline={"checkpointing": {"interval": 0.1}}):
+            await c.submit_job(
+                "obs1", sql=CLUSTER_SQL.format(out=tmp_path / "out.json"),
+                storage_url=str(tmp_path / "ck"), n_workers=2, parallelism=2,
+            )
+            state = await c.wait_for_state(
+                "obs1", JobState.FINISHED, JobState.FAILED, timeout=60
+            )
+        await c.stop()
+        return state
+
+    state = asyncio.run(go())
+    assert state == JobState.FINISHED
+
+    spans = obs.recorder().snapshot(trace_prefix="obs1/")
+    ck_traces = sorted({
+        s["trace_id"] for s in spans if "/ck-" in s["trace_id"]
+    })
+    assert ck_traces, "no checkpoint trace recorded"
+    checked = 0
+    for tid in ck_traces:
+        tr = [s for s in spans if s["trace_id"] == tid]
+        cats = {s["cat"] for s in tr}
+        names = {s["name"] for s in tr}
+        if "storage" not in cats:
+            continue  # a barely-started epoch racing job finish
+        single_root, orphans = _connected_tree(tr)
+        assert single_root, f"{tid}: multiple roots"
+        assert not orphans, f"{tid}: orphans {[s['name'] for s in orphans]}"
+        # the acceptance chain: controller → worker → runner → storage
+        assert {"controller", "rpc", "worker", "runner", "storage"} <= cats
+        assert "checkpoint" in names            # controller root
+        assert "worker.checkpoint" in names     # worker fan-out hop
+        assert "checkpoint.capture" in names    # operator barrier hop
+        assert any(n.startswith("storage.") for n in names)  # state commit
+        checked += 1
+    assert checked >= 1
+
+    # metric surface: >= 3 histogram families with _bucket/_sum/_count
+    # plus the watermark-lag gauge, all live from this run
+    text = REGISTRY.expose()
+    for fam in ("arroyo_worker_batch_processing_seconds",
+                "arroyo_exchange_frame_seconds",
+                "arroyo_storage_op_seconds",
+                "arroyo_checkpoint_phase_seconds"):
+        assert f"{fam}_bucket" in text, fam
+        assert f"{fam}_sum" in text, fam
+        assert f"{fam}_count" in text, fam
+    assert 'arroyo_worker_watermark_lag_seconds{job="obs1"' in text
+    assert 'arroyo_worker_barrier_alignment_seconds{job="obs1"' in text
+    assert 'phase="capture"' in text and 'phase="flush"' in text
+
+
+def test_rpc_trace_header_round_trip():
+    """The gRPC-analog layer forwards the __trace__ header into a server
+    span that parents to the client's call span."""
+    from arroyo_tpu.engine.rpc import RpcClient, RpcServer
+
+    seen = {}
+
+    async def go():
+        server = RpcServer("127.0.0.1")
+
+        async def method(req):
+            seen["ctx"] = obs.current()
+            return {"ok": 1}
+
+        server.add_service("TestSvc", {"Do": method})
+        port = await server.start()
+        client = RpcClient(f"127.0.0.1:{port}")
+        with obs.span("origin", trace="t/rpc") as sp:
+            await client.call("TestSvc", "Do", {"x": 1})
+            origin_id = sp.span_id
+        await client.close()
+        await server.stop()
+        return origin_id
+
+    origin_id = asyncio.run(go())
+    assert seen["ctx"][0] == "t/rpc"
+    spans = obs.recorder().snapshot(trace_id="t/rpc")
+    names = {s["name"]: s for s in spans}
+    assert "call.TestSvc.Do" in names
+    assert "rpc.TestSvc.Do" in names
+    assert names["call.TestSvc.Do"]["parent_id"] == origin_id
+    assert names["rpc.TestSvc.Do"]["parent_id"] == (
+        names["call.TestSvc.Do"]["span_id"]
+    )
+
+
+def test_trace_report_merge_and_stats(tmp_path):
+    import sys
+
+    sys.path.insert(0, "/root/repo/tools")
+    try:
+        import trace_report
+    finally:
+        sys.path.remove("/root/repo/tools")
+
+    with obs.span("root", trace="t/m", cat="a"):
+        with obs.span("kid", cat="b"):
+            pass
+    doc = obs.chrome_trace(obs.recorder().snapshot())
+    p1 = tmp_path / "d1.json"
+    p1.write_text(json.dumps(doc))
+    p2 = tmp_path / "d2.json"
+    p2.write_text(json.dumps(doc))  # duplicate dump: spans dedupe
+    merged = trace_report.merge([str(p1), str(p2)])
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2  # deduped
+    traces = trace_report.group_traces(merged["traceEvents"])
+    st = trace_report.tree_stats(traces["t/m"])
+    assert st["connected"] and st["spans"] == 2
+    assert st["roots"] == ["root"]
+
+
+def test_admin_debug_trace_endpoint():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from arroyo_tpu.utils.admin import build_admin_app
+
+    with obs.span("adm", trace="t/adm"):
+        pass
+
+    async def go():
+        app = build_admin_app("test")
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get("/debug/trace")
+            doc = await resp.json()
+            resp2 = await client.get("/debug/trace",
+                                     params={"trace": "t/none"})
+            doc2 = await resp2.json()
+            return doc, doc2
+
+    doc, doc2 = asyncio.run(go())
+    assert doc["spanCount"] >= 1
+    assert any(e.get("args", {}).get("trace_id") == "t/adm"
+               for e in doc["traceEvents"])
+    assert doc2["spanCount"] == 0
+
+
+def test_rest_job_traces_endpoint(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from arroyo_tpu.api.rest import build_app
+
+    with obs.span("ck", trace="jobx/ck-1", cat="controller"):
+        pass
+    with obs.span("other", trace="joby/ck-1", cat="controller"):
+        pass
+
+    async def go():
+        app = build_app(db_path=str(tmp_path / "api.db"))
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get("/api/v1/jobs/jobx/traces")
+            assert resp.status == 200
+            return await resp.json()
+
+    doc = asyncio.run(go())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1
+    assert xs[0]["args"]["trace_id"] == "jobx/ck-1"
+    assert doc["spanCount"] == 1
+
+
+def test_openapi_lists_traces_route(tmp_path):
+    from arroyo_tpu.api.openapi import build_spec
+
+    spec = build_spec()
+    assert "/api/v1/jobs/{job_id}/traces" in spec["paths"]
+    assert "TraceDump" in spec["components"]["schemas"]

@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Flight-recorder trace merger / summarizer (ISSUE 4).
+
+Merge Chrome trace-event JSON dumps from multiple processes (each
+worker's and the controller's `/debug/trace`, or the REST
+`/api/v1/jobs/{id}/traces`) into one Perfetto-loadable file, and print a
+per-trace tree summary (span counts, phase durations, orphaned spans,
+chaos fire events).
+
+Usage:
+  python tools/trace_report.py dump1.json dump2.json --out merged.json
+  python tools/trace_report.py merged.json --summarize
+  python tools/trace_report.py --golden-ft --out golden-ft-trace.json
+
+--golden-ft runs the golden windowed-aggregate fault-tolerance cycle
+(embedded cluster, seeded chaos faults, recovery from checkpoints) and
+writes its flight recording — CI uploads this on red runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def load_events(paths: List[str]) -> List[dict]:
+    events: List[dict] = []
+    seen = set()
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            # dedupe spans that appear in several dumps (same span_id);
+            # metadata and instant events without ids always pass through
+            sid = (ev.get("args") or {}).get("span_id")
+            key = (sid, ev.get("ts")) if sid else None
+            if key is not None:
+                if key in seen:
+                    continue
+                seen.add(key)
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    return events
+
+
+def merge(paths: List[str]) -> dict:
+    return {"traceEvents": load_events(paths), "displayTimeUnit": "ms"}
+
+
+def group_traces(events: List[dict]) -> Dict[str, List[dict]]:
+    """trace_id -> complete spans (ph == 'X')."""
+    out: Dict[str, List[dict]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid:
+            out[tid].append(ev)
+    return out
+
+
+def tree_stats(spans: List[dict]) -> dict:
+    """Connectivity + duration stats for one trace's spans."""
+    by_id = {(s.get("args") or {}).get("span_id"): s for s in spans}
+    roots, orphans = [], []
+    for s in spans:
+        parent = (s.get("args") or {}).get("parent_id")
+        if parent is None:
+            roots.append(s)
+        elif parent not in by_id:
+            orphans.append(s)
+    by_cat: Dict[str, float] = defaultdict(float)
+    for s in spans:
+        by_cat[s.get("cat", "?")] += s.get("dur", 0.0)
+    slowest = sorted(spans, key=lambda s: -s.get("dur", 0.0))[:5]
+    return {
+        "spans": len(spans),
+        "roots": [s["name"] for s in roots],
+        "orphans": len(orphans),
+        "connected": len(roots) == 1 and not orphans,
+        "duration_ms": round(
+            max(s.get("dur", 0.0) for s in roots) / 1e3, 3
+        ) if roots else None,
+        "by_cat_ms": {k: round(v / 1e3, 3) for k, v in sorted(by_cat.items())},
+        "slowest": [
+            {"name": s["name"], "dur_ms": round(s.get("dur", 0.0) / 1e3, 3)}
+            for s in slowest
+        ],
+    }
+
+
+def summarize(events: List[dict], out=sys.stdout) -> None:
+    chaos_fires = [
+        ev for ev in events
+        if ev.get("ph") == "i" and ev.get("name", "").startswith("chaos.fire")
+    ]
+    traces = group_traces(events)
+    print(f"{len(events)} events, {len(traces)} traces, "
+          f"{len(chaos_fires)} chaos fires", file=out)
+    for tid in sorted(traces):
+        st = tree_stats(traces[tid])
+        flag = "tree" if st["connected"] else (
+            f"{len(st['roots'])} roots, {st['orphans']} orphans"
+        )
+        print(f"\n== {tid} [{flag}] {st['spans']} spans, "
+              f"{st['duration_ms']} ms", file=out)
+        print(f"   by cat: {st['by_cat_ms']}", file=out)
+        for s in st["slowest"]:
+            print(f"   slow: {s['name']} {s['dur_ms']} ms", file=out)
+    for ev in chaos_fires:
+        print(f"\nchaos: {ev['name']} @ {ev.get('ts')} "
+              f"{ev.get('args')}", file=out)
+
+
+def run_golden_ft(out_path: str) -> int:
+    """Run the golden windowed-agg fault-tolerance cycle (embedded
+    cluster + seeded faults + recovery) and write its flight recording.
+    Returns 0 when the drill passed AND the checkpoint traces recorded."""
+    from arroyo_tpu import obs
+    from arroyo_tpu.chaos import drill
+
+    import tempfile
+
+    obs.reset()
+    with tempfile.TemporaryDirectory() as tmp:
+        res = drill.run_drill(
+            drill.DEFAULT_DRILL_QUERIES[0], seed=20260804, workdir=tmp,
+            plan_factory=drill.fast_plan, throttle=400.0,
+        )
+    spans = obs.recorder().snapshot()
+    doc = obs.chrome_trace(spans)
+    doc["drill"] = {"passed": res.passed, "error": res.error,
+                    "restarts": res.restarts,
+                    "fired": res.comparable_log}
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    print(f"golden FT cycle: passed={res.passed} restarts={res.restarts} "
+          f"spans={len(spans)} -> {out_path}")
+    summarize(doc["traceEvents"])
+    return 0 if res.passed and spans else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("inputs", nargs="*", help="Chrome trace JSON dumps")
+    ap.add_argument("--out", help="write the merged trace JSON here")
+    ap.add_argument("--summarize", action="store_true",
+                    help="print per-trace tree summaries")
+    ap.add_argument("--golden-ft", action="store_true",
+                    help="run the golden fault-tolerance cycle and dump "
+                         "its flight recording (requires --out)")
+    args = ap.parse_args(argv)
+    if args.golden_ft:
+        if not args.out:
+            ap.error("--golden-ft requires --out")
+        return run_golden_ft(args.out)
+    if not args.inputs:
+        ap.error("no input dumps given")
+    doc = merge(args.inputs)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"merged {len(args.inputs)} dumps "
+              f"({len(doc['traceEvents'])} events) -> {args.out}")
+    if args.summarize or not args.out:
+        summarize(doc["traceEvents"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,12 @@ def main(argv=None):
     run_p.add_argument("--workers", type=int, default=1)
     run_p.add_argument("--scheduler", default="embedded",
                        choices=["embedded", "process"])
+    run_p.add_argument("--autoscale", action="store_true",
+                       help="enable the closed-loop autoscaler (requires "
+                       "--state-dir: rescales restore from checkpoints)")
+    run_p.add_argument("--max-parallelism", type=int, default=None,
+                       help="autoscaler parallelism ceiling "
+                       "(autoscale.max_parallelism)")
 
     w_p = sub.add_parser("worker", help="start a worker")
     w_p.add_argument("--controller", required=True)
@@ -47,6 +53,9 @@ def main(argv=None):
     cl_p = sub.add_parser("cluster", help="start api + controller")
     cl_p.add_argument("--port", type=int, default=None)
     cl_p.add_argument("--scheduler", default="process")
+    cl_p.add_argument("--autoscale", action="store_true",
+                      help="enable the closed-loop autoscaler for jobs "
+                      "with durable state")
 
     v_p = sub.add_parser("visualize", help="print a query's dataflow DAG")
     v_p.add_argument("query")
@@ -118,29 +127,45 @@ async def _run(args):
             meta.create_pipeline(job_id, sql, args.parallelism)
     else:
         job_id = "job_cli"
-    controller = await ControllerServer(
-        make_scheduler(args.scheduler)
-    ).start()
-    await controller.submit_job(
-        job_id, sql=sql, storage_url=args.state_dir,
-        n_workers=args.workers, parallelism=args.parallelism,
-    )
-    try:
-        state = await controller.wait_for_state(
-            job_id, JobState.FINISHED, JobState.FAILED, JobState.STOPPED,
-            timeout=86400,
+    import contextlib
+
+    from .config import update
+
+    cfg_ctx = contextlib.nullcontext()
+    if args.autoscale:
+        if not args.state_dir:
+            print("--autoscale requires --state-dir: automatic rescales "
+                  "stop with a checkpoint and restore from it",
+                  file=sys.stderr)
+            return 2
+        autoscale = {"enabled": True}
+        if args.max_parallelism:
+            autoscale["max_parallelism"] = args.max_parallelism
+        cfg_ctx = update(autoscale=autoscale)
+    with cfg_ctx:
+        controller = await ControllerServer(
+            make_scheduler(args.scheduler)
+        ).start()
+        await controller.submit_job(
+            job_id, sql=sql, storage_url=args.state_dir,
+            n_workers=args.workers, parallelism=args.parallelism,
         )
-        print(f"job {state.value.lower()}")
-        return 0 if state != JobState.FAILED else 1
-    except KeyboardInterrupt:
-        await controller.stop_job(job_id, "checkpoint"
-                                  if args.state_dir else "graceful")
-        await controller.wait_for_state(
-            job_id, JobState.STOPPED, JobState.FAILED, timeout=60
-        )
-        return 0
-    finally:
-        await controller.stop()
+        try:
+            state = await controller.wait_for_state(
+                job_id, JobState.FINISHED, JobState.FAILED,
+                JobState.STOPPED, timeout=86400,
+            )
+            print(f"job {state.value.lower()}")
+            return 0 if state != JobState.FAILED else 1
+        except KeyboardInterrupt:
+            await controller.stop_job(job_id, "checkpoint"
+                                      if args.state_dir else "graceful")
+            await controller.wait_for_state(
+                job_id, JobState.STOPPED, JobState.FAILED, timeout=60
+            )
+            return 0
+        finally:
+            await controller.stop()
 
 
 async def _node(args):
@@ -189,17 +214,22 @@ async def _api(args):
 
 
 async def _cluster(args):
+    import contextlib
+
     from .api.rest import serve_api
-    from .config import config
+    from .config import config, update
     from .controller.controller import ControllerServer
     from .controller.scheduler import make_scheduler
     from .utils import init_logging
 
     init_logging()
-    c = ControllerServer(make_scheduler(args.scheduler))
-    await c.start()
-    print(f"controller at {c.addr}")
-    await serve_api(port=args.port, controller=c)
+    cfg_ctx = (update(autoscale={"enabled": True}) if args.autoscale
+               else contextlib.nullcontext())
+    with cfg_ctx:
+        c = ControllerServer(make_scheduler(args.scheduler))
+        await c.start()
+        print(f"controller at {c.addr}")
+        await serve_api(port=args.port, controller=c)
 
 
 def _visualize(args):

@@ -63,6 +63,12 @@ ROUTES: List[Route] = [
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
+    ("get", "/jobs/{job_id}/autoscale", "job_autoscale",
+     "Autoscaler decision audit log, pin state and current per-operator "
+     "parallelism of a job", "jobs", None, "AutoscaleStatus"),
+    ("patch", "/jobs/{job_id}/autoscale", "patch_job_autoscale",
+     "Pin or unpin a job against automatic rescaling", "jobs",
+     "AutoscalePatch", "AutoscaleStatus"),
     ("get", "/connectors", "list_connectors",
      "Available connector types with config schemas", "connectors",
      None, "ConnectorCollection"),
@@ -268,6 +274,30 @@ def _schemas() -> Dict[str, Any]:
              "description": {**_str(), "nullable": True},
              "createdAt": _int()},
             ["id", "name", "definition"],
+        ),
+        "AutoscaleDecision": _obj(
+            {"time": {"type": "number"}, "seq": _int(),
+             "action": {"type": "string",
+                        "enum": ["baseline", "warmup", "cooldown", "hold",
+                                 "pinned", "unactuatable", "rescale"]},
+             "restarts": _int(), "rescales": _int(),
+             "pinned": {"type": "boolean"},
+             "current": {"type": "object"},
+             "targets": {"type": "object"},
+             "reasons": {"type": "object"},
+             "signals": {"type": "object"}},
+            ["action"],
+        ),
+        "AutoscaleStatus": _obj(
+            {"enabled": {"type": "boolean"}, "policy": _str(),
+             "pinned": {"type": "boolean"}, "rescales": _int(),
+             "parallelism": {"type": "object"},
+             "decisions": {"type": "array",
+                           "items": _ref("AutoscaleDecision")}},
+            ["enabled", "pinned", "decisions"],
+        ),
+        "AutoscalePatch": _obj(
+            {"pinned": {"type": "boolean"}}, ["pinned"],
         ),
         "TraceDump": _obj(
             {"traceEvents": {"type": "array", "items": {"type": "object"}},

@@ -347,6 +347,42 @@ class ApiServer:
         body["spanCount"] = len(spans)
         return json_response(body)
 
+    def _autoscale_status(self, job) -> dict:
+        return {
+            "enabled": bool(config().autoscale.enabled),
+            "policy": config().autoscale.policy,
+            "pinned": job.autoscale_pinned,
+            "rescales": job.rescales,
+            "parallelism": {
+                str(n.node_id): n.parallelism
+                for n in job.graph.nodes.values()
+            },
+            "decisions": list(job.autoscale_decisions),
+        }
+
+    async def job_autoscale(self, request: web.Request):
+        """Autoscaler surface: the job's decision audit log (one entry per
+        control period: action, per-node targets, the signals they were
+        decided from) plus pin state and current parallelism."""
+        jid = request.match_info["job_id"]
+        job = self.controller.jobs.get(jid) if self.controller else None
+        if job is None:
+            return error(404, "job not found")
+        return json_response(self._autoscale_status(job))
+
+    async def patch_job_autoscale(self, request: web.Request):
+        """Pin (freeze automatic rescaling — decisions keep recording) or
+        unpin a job: {"pinned": true|false}."""
+        jid = request.match_info["job_id"]
+        job = self.controller.jobs.get(jid) if self.controller else None
+        if job is None:
+            return error(404, "job not found")
+        body = await request.json()
+        if not isinstance(body.get("pinned"), bool):
+            return error(400, "body must carry a boolean 'pinned'")
+        job.autoscale_pinned = body["pinned"]
+        return json_response(self._autoscale_status(job))
+
     async def job_errors(self, request: web.Request):
         jid = request.match_info["job_id"]
         job = self.controller.jobs.get(jid) if self.controller else None
@@ -361,7 +397,7 @@ class ApiServer:
         accumulates). The raw Prometheus text rides along for debugging."""
         import time as _time
 
-        from ..metrics import REGISTRY
+        from ..metrics import REGISTRY, hist_quantiles
 
         now = int(_time.time() * 1000)
         job_id = request.match_info["job_id"]
@@ -370,11 +406,6 @@ class ApiServer:
         for name, entries in REGISTRY.snapshot().items():
             short = name.removeprefix("arroyo_worker_")
             for labels, value in entries:
-                if isinstance(value, dict):
-                    # histogram snapshot ({sum, count, buckets}): the UI
-                    # plots scalar series — chart the running mean
-                    value = (value["sum"] / value["count"]
-                             if value.get("count") else 0.0)
                 # split per-phase families (checkpoint_phase_seconds) into
                 # one scalar series per phase
                 metric = (f"{short}:{labels['phase']}"
@@ -389,9 +420,27 @@ class ApiServer:
                     sub_i = int(sub)
                 except ValueError:
                     continue
-                ops.setdefault(node_id, {}).setdefault(metric, {})[
-                    sub_i
-                ] = value
+                if isinstance(value, dict):
+                    # histogram snapshot ({sum, count, buckets}): one
+                    # scalar series for the running mean plus tail
+                    # quantiles estimated from the cumulative buckets —
+                    # the autoscaler's audit log and the UI sparklines
+                    # both need p95/p99, not just the mean
+                    series = [(
+                        metric,
+                        value["sum"] / value["count"]
+                        if value.get("count") else 0.0,
+                    )]
+                    series += [
+                        (f"{metric}:{q}", v)
+                        for q, v in sorted(hist_quantiles(value).items())
+                    ]
+                else:
+                    series = [(metric, value)]
+                for mname, v in series:
+                    ops.setdefault(node_id, {}).setdefault(mname, {})[
+                        sub_i
+                    ] = v
         data = [
             {
                 "operatorId": op,

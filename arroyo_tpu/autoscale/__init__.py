@@ -1,0 +1,31 @@
+"""Closed-loop autoscaler (ISSUE 5): rate-based parallelism control with
+exactly-once automatic rescale.
+
+Three-step loop over the controller's jobs, DS2-shaped (Kalavri et al.,
+OSDI '18) with Dhalion-style policy/diagnosis separation (Floratou et al.,
+VLDB '17):
+
+  signals.py   observe — registry snapshots -> per-operator true rates,
+               busy ratios, backpressure, watermark lag
+  policy.py    decide — pluggable Policy protocol; built-in DS2 rate-ratio
+               policy with guardrails, hysteresis, clamps
+  manager.py   actuate — controller-resident loop driving the proven
+               stop-with-checkpoint -> parallelism override -> restore
+               path through JobState.RESCALING, fully flight-recorded
+               ({job}/rescale-N traces) with a decision audit log
+  sim.py       deterministic offline harness: replay load traces through
+               the same policy + actuation gate (tools/autoscale_report.py)
+"""
+
+from .manager import Autoscaler  # noqa: F401
+from .policy import (  # noqa: F401
+    ActuationGate,
+    DS2Policy,
+    Policy,
+    PolicyDecision,
+    Topology,
+    make_policy,
+    register_policy,
+)
+from .signals import OperatorSignals, SignalSampler, merge_snapshots  # noqa: F401
+from .sim import SimJob, SimOp, converged_within, run_scenario  # noqa: F401

@@ -1,0 +1,207 @@
+"""The controller-resident autoscaler: observe -> decide -> actuate.
+
+One `Autoscaler` lives in each ControllerServer (started when
+`autoscale.enabled`). Every `autoscale.period` seconds it ticks each
+RUNNING job that has durable state:
+
+  observe   merge registry snapshots from the job's workers (GetMetrics
+            rpc; embedded workers share a registry and union to one) and
+            diff them into per-operator signals (signals.SignalSampler);
+  decide    run the configured policy (policy.make_policy) over the job's
+            topology, then gate through warmup/cooldown/pin
+            (policy.ActuationGate);
+  actuate   mint the `{job}/rescale-N` flight-recorder trace with the
+            decision as its root span and hand the parallelism overrides
+            to the controller's state-machine driver, which runs the
+            proven stop-with-checkpoint -> override -> restore path
+            (controller._rescale, JobState.RESCALING).
+
+Every period appends one entry to the job's decision audit log
+(JobHandle.autoscale_decisions), surfaced via
+GET /api/v1/jobs/{id}/autoscale and /debug/autoscale. Jobs WITHOUT a
+storage_url are observed but never actuated: rescaling them would drop
+state, so exactly-once wins over elasticity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from .. import obs
+from ..config import config
+from ..utils.logging import get_logger
+from .policy import ActuationGate, Topology, make_policy
+from .signals import SignalSampler, merge_snapshots
+
+logger = get_logger("autoscale")
+
+
+class _JobScaleState:
+    def __init__(self, job_id: str, cfg):
+        self.sampler = SignalSampler(job_id)
+        self.gate = ActuationGate(cfg)
+        self.gen: Optional[tuple] = None
+        self.seq = 0
+
+
+class Autoscaler:
+    def __init__(self, controller):
+        self.controller = controller
+        self._jobs: Dict[str, _JobScaleState] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.policy = make_policy(config().autoscale.policy)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def maybe_start(self) -> bool:
+        if not config().autoscale.enabled or self._task is not None:
+            return False
+        self._task = asyncio.ensure_future(self._loop())
+        logger.info(
+            "autoscaler on: policy=%s period=%.1fs parallelism=[%d, %d]",
+            config().autoscale.policy, config().autoscale.period,
+            config().autoscale.min_parallelism,
+            config().autoscale.max_parallelism,
+        )
+        return True
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+
+    async def _loop(self):
+        while True:
+            await asyncio.sleep(config().autoscale.period)
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("autoscale tick failed")
+
+    # -- control loop -------------------------------------------------------
+
+    async def tick(self):
+        """One control period over every running job."""
+        from ..controller.state_machine import JobState
+
+        for job in list(self.controller.jobs.values()):
+            if job.state != JobState.RUNNING:
+                continue
+            try:
+                await self._tick_job(job)
+            except Exception:  # noqa: BLE001 - one job must not stall others
+                logger.exception("autoscale tick for job %s failed",
+                                 job.job_id)
+
+    async def _tick_job(self, job):
+        cfg = config().autoscale
+        st = self._jobs.get(job.job_id)
+        if st is None:
+            st = self._jobs[job.job_id] = _JobScaleState(job.job_id, cfg)
+        gen = (job.restarts, job.rescales)
+        if st.gen != gen:
+            # fresh topology (schedule, recovery, or our own rescale):
+            # rate history is stale and counters may have restarted
+            st.gen = gen
+            st.sampler.reset()
+            st.gate.reset(cfg.warmup_periods)
+        merged = await self._job_snapshot(job)
+        topo = Topology.from_graph(job.graph)
+        signals = st.sampler.sample(merged, topo.current)
+        st.seq += 1
+        if signals is None:
+            self._record(job, st, "baseline", {}, {}, {})
+            return
+        decision = self.policy.decide(topo, signals, cfg)
+        changed = decision.changed(topo.current)
+        if changed and (job.backend is None or job.rescale_requested):
+            # observed-only job (no durable state) or an actuation already
+            # in flight: report the demand, never actuate
+            self._record(job, st, "unactuatable", changed,
+                         decision.reasons, signals)
+            return
+        action = st.gate.check(changed, pinned=job.autoscale_pinned)
+        if action != "rescale":
+            self._record(job, st, action, changed, decision.reasons,
+                         signals)
+            return
+        # actuate: mint the rescale trace with the decision as its root
+        # span; controller._rescale (stop-checkpoint -> override ->
+        # restore) and the subsequent schedule parent under it, so the
+        # whole rescale reads as ONE connected tree in the flight recorder
+        with obs.span(
+            "autoscale.decide",
+            trace=obs.new_trace(job.job_id, f"rescale-{job.rescales + 1}"),
+            cat="autoscale", job=job.job_id,
+            targets=str(changed), reasons=str(decision.reasons)[:300],
+        ) as sp:
+            job.rescale_trace = (sp.trace_id, sp.span_id)
+        self._record(job, st, "rescale", changed, decision.reasons, signals)
+        logger.info("autoscale: job %s rescaling %s (%s)", job.job_id,
+                    changed, decision.reasons)
+        job.rescale_requested = dict(changed)
+
+    async def _job_snapshot(self, job) -> Dict[str, Dict[tuple, object]]:
+        """Union of the workers' registry snapshots; falls back to this
+        process's registry when no worker answers (pure-embedded runs)."""
+        snaps = []
+        for w in list(job.workers):
+            try:
+                resp = await asyncio.wait_for(
+                    w.client.call("WorkerGrpc", "GetMetrics", {}), 5.0
+                )
+                snaps.append(resp.get("snapshot") or {})
+            except Exception as e:  # noqa: BLE001 - dead/slow worker
+                logger.debug("autoscale: GetMetrics from worker %s "
+                             "failed: %s", w.worker_id, e)
+        if not snaps:
+            from ..metrics import REGISTRY
+
+            snaps = [REGISTRY.snapshot()]
+        return merge_snapshots(snaps)
+
+    def _record(self, job, st: _JobScaleState, action: str,
+                changed: Dict[int, int], reasons: Dict[int, str],
+                signals: dict) -> None:
+        cfg = config().autoscale
+        entry = {
+            "time": time.time(),
+            "seq": st.seq,
+            "action": action,
+            "restarts": job.restarts,
+            "rescales": job.rescales,
+            "pinned": job.autoscale_pinned,
+            "current": {
+                n.node_id: n.parallelism for n in job.graph.nodes.values()
+            },
+            "targets": dict(changed),
+            "reasons": dict(reasons),
+            "signals": {
+                nid: s.summary() for nid, s in (signals or {}).items()
+            },
+        }
+        job.autoscale_decisions.append(entry)
+        del job.autoscale_decisions[:-cfg.decision_history]
+
+    def status(self) -> dict:
+        """/debug/autoscale payload: per-job decision history."""
+        return {
+            "enabled": bool(config().autoscale.enabled
+                            and self._task is not None),
+            "policy": config().autoscale.policy,
+            "period": config().autoscale.period,
+            "jobs": {
+                job.job_id: {
+                    "state": job.state.value,
+                    "pinned": job.autoscale_pinned,
+                    "rescales": job.rescales,
+                    "decisions": list(job.autoscale_decisions),
+                }
+                for job in self.controller.jobs.values()
+            },
+        }

@@ -1,0 +1,227 @@
+"""Parallelism policies: signals in, per-operator targets out.
+
+Dhalion-style separation (Floratou et al., VLDB '17): the POLICY is a pure
+function from observed signals to target parallelism — it holds no clock,
+no actuation state, no job handles — while the manager (manager.py) owns
+sampling, warmup/cooldown gating, and the stop-checkpoint actuation. That
+split is what makes policies pluggable (the `Policy` protocol + registry
+below) and offline-testable (sim.py replays rate traces through the same
+decide() the live controller calls).
+
+The built-in `ds2` policy is the DS2 rate-ratio algorithm (Kalavri et al.,
+OSDI '18): propagate demanded rates along the DAG from the sources, size
+each operator to ceil(demand / true_rate_per_instance), with guardrails:
+
+  * utilization band: scale up only above `busy_high` (or under upstream
+    backpressure), scale down only below `busy_low`;
+  * saturation fallback: under sustained backpressure the measured rates
+    are throttled lower bounds, so when the rate ratio alone says "hold",
+    grow geometrically by `saturation_step` instead (Dhalion's
+    symptom-driven diagnosis);
+  * hysteresis dead band, per-step scale-factor cap, unconditional
+    min/max clamps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Protocol
+
+from .signals import OperatorSignals
+
+
+@dataclasses.dataclass
+class Topology:
+    """The policy's view of the job DAG: node ids in topological order,
+    upstream adjacency, current parallelism, and which nodes the actuator
+    may scale (sources and sinks keep their planned parallelism — source
+    splits and sink fan-in are externally constrained, matching
+    LogicalGraph.set_parallelism(internal_only=True))."""
+
+    order: List[int]
+    upstream: Dict[int, List[int]]
+    current: Dict[int, int]
+    scalable: Dict[int, bool]
+
+    @classmethod
+    def from_graph(cls, graph) -> "Topology":
+        nodes = graph.topo_order()
+
+        def _scalable(n) -> bool:
+            # only nodes whose every input is KEY-partitioned are safe to
+            # rescale: their state re-reads by key range on restore and
+            # their shuffle re-partitions by the same hash. Unkeyed inputs
+            # mean either a round-robin map (harmless but unobservable
+            # benefit) or a global accumulator that MUST stay at its
+            # planned parallelism — the planner encodes that constraint
+            # only through the edge keys, so respect it
+            if n.is_source or n.is_sink:
+                return False
+            in_edges = graph.in_edges(n.node_id)
+            return bool(in_edges) and all(
+                getattr(e.schema, "key_indices", None) for e in in_edges
+            )
+
+        return cls(
+            order=[n.node_id for n in nodes],
+            upstream={
+                n.node_id: [e.src for e in graph.in_edges(n.node_id)]
+                for n in nodes
+            },
+            current={n.node_id: n.parallelism for n in nodes},
+            scalable={n.node_id: _scalable(n) for n in nodes},
+        )
+
+
+@dataclasses.dataclass
+class PolicyDecision:
+    """targets covers every node (unchanged ones at current parallelism);
+    reasons explains each node that differs from current."""
+
+    targets: Dict[int, int]
+    reasons: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+    def changed(self, current: Dict[int, int]) -> Dict[int, int]:
+        return {
+            nid: p for nid, p in self.targets.items()
+            if p != current.get(nid, p)
+        }
+
+
+class Policy(Protocol):
+    """The pluggable decide step. Implementations must be pure: same
+    (topology, signals, cfg) in, same decision out — the simulation
+    harness and the convergence tests rely on it."""
+
+    def decide(self, topo: Topology,
+               signals: Dict[int, OperatorSignals],
+               cfg) -> PolicyDecision:
+        ...
+
+
+class DS2Policy:
+    """Rate-ratio propagation from the sources (module docstring)."""
+
+    def decide(self, topo: Topology,
+               signals: Dict[int, OperatorSignals],
+               cfg) -> PolicyDecision:
+        demand_out: Dict[int, float] = {}
+        targets: Dict[int, int] = {}
+        reasons: Dict[int, str] = {}
+        for nid in topo.order:
+            sig = signals.get(nid)
+            cur = topo.current.get(nid, 1)
+            if sig is None or not topo.scalable.get(nid, False) or not topo.upstream.get(nid):
+                # sources (no upstream) seed the demand with their observed
+                # output; unscalable/unobserved nodes pass demand through
+                targets[nid] = cur
+                demand_out[nid] = sig.output_rate if sig else 0.0
+                continue
+            demand_in = sum(demand_out.get(u, 0.0) for u in topo.upstream[nid])
+            bp_in = max(
+                (signals[u].backpressure for u in topo.upstream[nid]
+                 if u in signals),
+                default=0.0,
+            )
+            busy = sig.busy_ratio if sig.busy_ratio is not None else 0.0
+            cap = sig.true_rate_per_instance
+            rate_target = (
+                max(1, math.ceil(demand_in / cap)) if cap and cap > 0 else cur
+            )
+            if bp_in > cfg.backpressure_high and rate_target <= cur:
+                # saturated: measured demand is throttled by the very
+                # backpressure we're reacting to — grow geometrically
+                target = math.ceil(cur * cfg.saturation_step)
+                reason = (
+                    f"backpressure {bp_in:.2f} with throttled rates: "
+                    f"saturation step {cur} -> {target}"
+                )
+            elif rate_target > cur and (busy >= cfg.busy_high
+                                        or bp_in > cfg.backpressure_high):
+                target = rate_target
+                reason = (
+                    f"demand {demand_in:.0f}/s over capacity "
+                    f"{(cap or 0) * cur:.0f}/s: {cur} -> {target}"
+                )
+            elif rate_target < cur and busy <= cfg.busy_low:
+                target = rate_target
+                reason = (
+                    f"busy {busy:.2f} under {cfg.busy_low}: "
+                    f"{cur} -> {target}"
+                )
+            else:
+                target, reason = cur, ""
+            # hysteresis dead band, then per-step cap, then hard clamps
+            # (clamps last and unconditional: min_parallelism must win)
+            if target != cur and cur > 0 and (
+                abs(target - cur) / cur <= cfg.hysteresis
+            ):
+                target, reason = cur, ""
+            if target > cur:
+                target = min(target, math.ceil(cur * cfg.scale_factor_cap))
+            elif target < cur:
+                target = max(target, max(1, math.floor(
+                    cur / cfg.scale_factor_cap)))
+            clamped = min(max(target, cfg.min_parallelism),
+                          cfg.max_parallelism)
+            if clamped != cur and not reason:
+                reason = (
+                    f"clamped to [{cfg.min_parallelism}, "
+                    f"{cfg.max_parallelism}]: {cur} -> {clamped}"
+                )
+            target = clamped
+            targets[nid] = target
+            if target != cur and reason:
+                reasons[nid] = reason
+            # demand the downstream sees if this operator were scaled to
+            # keep up: its full input demand times its selectivity
+            demand_out[nid] = demand_in * sig.selectivity
+        return PolicyDecision(targets=targets, reasons=reasons)
+
+
+class ActuationGate:
+    """Warmup/cooldown/pin gating between decide and actuate — shared by
+    the live manager and the simulation so convergence tests exercise the
+    exact actuation cadence the controller runs."""
+
+    def __init__(self, cfg):
+        self.warmup_left = cfg.warmup_periods
+        self.cooldown_left = 0
+        self.cooldown_periods = cfg.cooldown_periods
+
+    def check(self, changed: Dict[int, int], pinned: bool = False) -> str:
+        """Returns the action for this period: 'rescale' means actuate
+        `changed` now (and starts the cooldown)."""
+        if self.warmup_left > 0:
+            self.warmup_left -= 1
+            return "warmup"
+        if pinned:
+            return "pinned"
+        if self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            return "cooldown"
+        if not changed:
+            return "hold"
+        self.cooldown_left = self.cooldown_periods
+        return "rescale"
+
+    def reset(self, warmup_periods: int) -> None:
+        """A (re)schedule invalidates rate history: warm up again."""
+        self.warmup_left = warmup_periods
+        self.cooldown_left = 0
+
+
+_POLICIES: Dict[str, Callable[[], Policy]] = {"ds2": DS2Policy}
+
+
+def register_policy(name: str, factory: Callable[[], Policy]) -> None:
+    _POLICIES[name] = factory
+
+
+def make_policy(name: str) -> Policy:
+    if name not in _POLICIES:
+        raise ValueError(
+            f"unknown autoscale policy {name!r}; known: {sorted(_POLICIES)}"
+        )
+    return _POLICIES[name]()

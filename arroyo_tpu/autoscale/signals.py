@@ -1,0 +1,178 @@
+"""Signal sampling for the autoscaler: metrics registry -> per-operator rates.
+
+The observe step of the control loop (DS2, Kalavri et al. OSDI '18 §3:
+"three steps is all you need" — observe true rates, decide by rate ratios,
+actuate). Each control period the sampler takes a registry snapshot
+(merged across the job's workers over the GetMetrics rpc — identical
+snapshots from embedded same-process workers union to one), diffs the
+task-labeled counters against the previous period, and aggregates the
+deltas into one `OperatorSignals` per logical node:
+
+  observed_rate            rows/s actually processed (recv counters)
+  output_rate              rows/s emitted (sent counters)
+  busy_ratio               useful-work seconds / (period * parallelism)
+  true_rate_per_instance   rows per busy-second — the DS2 true processing
+                           rate, independent of how idle/backpressured the
+                           operator currently is
+  selectivity              output rows per input row (demand propagation)
+  backpressure             fullness of the operator's own output queues
+                           (an op is the bottleneck when its UPSTREAMs'
+                           backpressure is high)
+  watermark_lag            seconds the subtask watermark trails wall clock
+
+Counters restart from zero when a worker process is replaced (recovery,
+process scheduler); deltas clamp at the observed value so a restart reads
+as a small sample, not a negative rate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+# metric families the sampler consumes (names, not handles: snapshots may
+# come over the wire from another process's registry)
+_RECV = "arroyo_worker_messages_recv"
+_SENT = "arroyo_worker_messages_sent"
+_BUSY = "arroyo_worker_busy_seconds"
+_BACKPRESSURE = "arroyo_worker_backpressure"
+_WM_LAG = "arroyo_worker_watermark_lag_seconds"
+_BATCH_HIST = "arroyo_worker_batch_processing_seconds"
+
+
+@dataclasses.dataclass
+class OperatorSignals:
+    """One control period's aggregated view of a logical operator."""
+
+    node_id: int
+    parallelism: int
+    observed_rate: float = 0.0
+    output_rate: float = 0.0
+    busy_ratio: Optional[float] = None
+    true_rate_per_instance: Optional[float] = None
+    selectivity: float = 1.0
+    backpressure: float = 0.0
+    watermark_lag: float = 0.0
+    # tail latency of batch processing (estimated from cumulative buckets;
+    # metrics.hist_quantiles) — audit-log context, not a decision input
+    batch_p95: Optional[float] = None
+
+    def summary(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in out.items() if v is not None
+        }
+
+
+def merge_snapshots(snapshots: List[dict]) -> Dict[str, Dict[tuple, object]]:
+    """Union registry snapshots keyed by (metric, sorted label items).
+    Embedded workers share one process registry and return identical
+    snapshots — the union collapses them instead of double counting."""
+    merged: Dict[str, Dict[tuple, object]] = {}
+    for snap in snapshots:
+        for name, entries in (snap or {}).items():
+            dst = merged.setdefault(name, {})
+            for labels, value in entries:
+                dst[tuple(sorted(dict(labels).items()))] = value
+    return merged
+
+
+def _task_values(merged: Dict[str, Dict[tuple, object]], metric: str,
+                 job_id: str) -> Dict[Tuple[int, int], object]:
+    """{(node_id, subtask): value} for a job's task-labeled family."""
+    out: Dict[Tuple[int, int], object] = {}
+    for labels, value in merged.get(metric, {}).items():
+        d = dict(labels)
+        if d.get("job") != job_id:
+            continue
+        task = d.get("task") or ""
+        node, _, sub = task.rpartition("-")
+        try:
+            out[(int(node), int(sub))] = value
+        except ValueError:
+            continue
+    return out
+
+
+class SignalSampler:
+    """Stateful per-job sampler: keeps the previous period's counter sums
+    per node and turns the current snapshot into OperatorSignals."""
+
+    def __init__(self, job_id: str):
+        self.job_id = job_id
+        # node_id -> (recv_rows, sent_rows, busy_seconds)
+        self._prev: Dict[int, Tuple[float, float, float]] = {}
+        self._prev_time: Optional[float] = None
+
+    def reset(self) -> None:
+        """Forget history (after a reschedule/rescale the topology and the
+        worker set changed; the next sample only re-seeds the baseline)."""
+        self._prev.clear()
+        self._prev_time = None
+
+    def sample(self, merged: Dict[str, Dict[tuple, object]],
+               node_parallelism: Dict[int, int],
+               now: Optional[float] = None) -> Optional[Dict[int, OperatorSignals]]:
+        """Diff the merged snapshot against the previous period. Returns
+        None on the first call (baseline only — rates need two points)."""
+        from ..metrics import hist_quantiles
+
+        now = time.monotonic() if now is None else now
+        recv = _task_values(merged, _RECV, self.job_id)
+        sent = _task_values(merged, _SENT, self.job_id)
+        busy = _task_values(merged, _BUSY, self.job_id)
+        bp = _task_values(merged, _BACKPRESSURE, self.job_id)
+        lag = _task_values(merged, _WM_LAG, self.job_id)
+        hist = _task_values(merged, _BATCH_HIST, self.job_id)
+
+        sums: Dict[int, Tuple[float, float, float]] = {}
+        nodes = {n for n, _ in (*recv, *sent, *busy)} | set(node_parallelism)
+        for nid in nodes:
+            sums[nid] = (
+                sum(v for (n, _s), v in recv.items() if n == nid),
+                sum(v for (n, _s), v in sent.items() if n == nid),
+                sum(v for (n, _s), v in busy.items() if n == nid),
+            )
+        prev, prev_time = self._prev, self._prev_time
+        self._prev, self._prev_time = sums, now
+        if prev_time is None:
+            return None
+        dt = max(1e-6, now - prev_time)
+
+        out: Dict[int, OperatorSignals] = {}
+        for nid, (r, s, b) in sums.items():
+            pr, ps, pb = prev.get(nid, (0.0, 0.0, 0.0))
+            # counter restarts (replaced worker process) read as the raw
+            # value, never a negative delta
+            dr = r - pr if r >= pr else r
+            ds = s - ps if s >= ps else s
+            db = b - pb if b >= pb else b
+            par = max(1, node_parallelism.get(nid, 1))
+            sig = OperatorSignals(node_id=nid, parallelism=par)
+            sig.observed_rate = dr / dt
+            sig.output_rate = ds / dt
+            if db > 0:
+                sig.busy_ratio = min(1.0, db / (dt * par))
+                if dr > 0:
+                    sig.true_rate_per_instance = dr / db
+            sig.selectivity = (ds / dr) if dr > 0 else 1.0
+            sig.backpressure = max(
+                (float(v) for (n, _s), v in bp.items() if n == nid),
+                default=0.0,
+            )
+            sig.watermark_lag = max(
+                (float(v) for (n, _s), v in lag.items() if n == nid),
+                default=0.0,
+            )
+            node_hists = [v for (n, _s), v in hist.items()
+                          if n == nid and isinstance(v, dict)]
+            if node_hists:
+                p95s = [hist_quantiles(h, (0.95,)).get("p95")
+                        for h in node_hists]
+                p95s = [p for p in p95s if p is not None]
+                if p95s:
+                    sig.batch_p95 = max(p95s)
+            out[nid] = sig
+        return out

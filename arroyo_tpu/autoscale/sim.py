@@ -1,0 +1,192 @@
+"""Deterministic simulation harness: replay load traces through a policy.
+
+Convergence properties ("reaches the right parallelism within N control
+periods, then stops moving") are miserable to assert against wall-clock
+cluster runs. This harness makes them unit-testable: a `SimJob` models
+each operator as a fluid server with a known per-instance true rate, each
+`step()` computes the steady-state signals for one control period from the
+offered source rate (saturated operators throttle what flows downstream,
+and their upstreams read as backpressured), and `run_scenario` drives the
+REAL policy + actuation gate (policy.ActuationGate — the same cadence the
+live manager runs) over a piecewise-constant load trace, applying each
+rescale decision for the next period.
+
+Everything is pure arithmetic: no clock, no randomness, no asyncio — the
+same trace always produces the same decision log, which is what the
+load-step acceptance test pins. `tools/autoscale_report.py` wraps this for
+offline what-if runs against recorded rate traces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .policy import ActuationGate, Policy, PolicyDecision, Topology
+from .signals import OperatorSignals
+
+
+@dataclasses.dataclass
+class SimOp:
+    """One modeled operator. `rate_per_instance` is the true processing
+    rate (rows per busy-second) of a single parallel instance; sources
+    have no processing model — they emit the offered rate."""
+
+    node_id: int
+    rate_per_instance: float = 0.0
+    parallelism: int = 1
+    selectivity: float = 1.0
+    source: bool = False
+    sink: bool = False
+
+
+class SimJob:
+    """A DAG of SimOps. `edges` are (src, dst) node-id pairs."""
+
+    def __init__(self, ops: Sequence[SimOp],
+                 edges: Sequence[Tuple[int, int]]):
+        self.ops = {op.node_id: op for op in ops}
+        self.edges = list(edges)
+        self._order = self._topo()
+
+    def _topo(self) -> List[int]:
+        indeg = {nid: 0 for nid in self.ops}
+        for _s, d in self.edges:
+            indeg[d] += 1
+        order, ready = [], sorted(n for n, d in indeg.items() if d == 0)
+        while ready:
+            nid = ready.pop(0)
+            order.append(nid)
+            for s, d in self.edges:
+                if s == nid:
+                    indeg[d] -= 1
+                    if indeg[d] == 0:
+                        ready.append(d)
+            ready.sort()
+        assert len(order) == len(self.ops), "cycle in sim DAG"
+        return order
+
+    def topology(self) -> Topology:
+        return Topology(
+            order=list(self._order),
+            upstream={
+                nid: [s for s, d in self.edges if d == nid]
+                for nid in self._order
+            },
+            current={nid: op.parallelism for nid, op in self.ops.items()},
+            scalable={
+                nid: not (op.source or op.sink)
+                for nid, op in self.ops.items()
+            },
+        )
+
+    def apply(self, targets: Dict[int, int]) -> None:
+        for nid, p in targets.items():
+            self.ops[nid].parallelism = max(1, p)
+
+    def step(self, offered_rate: float) -> Dict[int, OperatorSignals]:
+        """Steady-state signals for one control period at the given
+        offered source rate. A saturated operator processes at capacity
+        and throttles its downstream flow; its upstreams read full output
+        queues (backpressure 1.0)."""
+        flow: Dict[int, float] = {}       # actual emitted rate per op
+        sigs: Dict[int, OperatorSignals] = {}
+        saturated: set = set()
+        for nid in self._order:
+            op = self.ops[nid]
+            ups = [s for s, d in self.edges if d == nid]
+            if op.source or not ups:
+                flow[nid] = offered_rate * op.selectivity
+                sigs[nid] = OperatorSignals(
+                    node_id=nid, parallelism=op.parallelism,
+                    observed_rate=offered_rate,
+                    output_rate=flow[nid],
+                    selectivity=op.selectivity,
+                )
+                continue
+            arriving = sum(flow[u] for u in ups)
+            capacity = op.rate_per_instance * op.parallelism
+            processed = min(arriving, capacity) if capacity > 0 else arriving
+            busy = min(1.0, arriving / capacity) if capacity > 0 else 0.0
+            if capacity > 0 and arriving > capacity:
+                saturated.add(nid)
+            flow[nid] = processed * op.selectivity
+            sigs[nid] = OperatorSignals(
+                node_id=nid, parallelism=op.parallelism,
+                observed_rate=processed,
+                output_rate=flow[nid],
+                busy_ratio=busy,
+                true_rate_per_instance=(
+                    op.rate_per_instance if op.rate_per_instance > 0
+                    else None
+                ),
+                selectivity=op.selectivity,
+            )
+        # an op whose downstream is saturated sees its output queue full
+        for s, d in self.edges:
+            if d in saturated:
+                sigs[s].backpressure = 1.0
+        return sigs
+
+
+@dataclasses.dataclass
+class SimRecord:
+    period: int
+    offered_rate: float
+    action: str
+    parallelism: Dict[int, int]          # AFTER this period's actuation
+    targets: Dict[int, int]
+    reasons: Dict[int, str]
+    signals: Dict[int, dict]
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_scenario(job: SimJob, policy: Policy, cfg,
+                 load_steps: Sequence[Tuple[int, float]],
+                 gate: Optional[ActuationGate] = None) -> List[SimRecord]:
+    """Drive `policy` over a piecewise-constant load trace:
+    load_steps = [(n_periods, offered_rate), ...]. Each period: compute
+    signals at the current parallelism, decide, gate, actuate. Returns the
+    decision audit log (one record per control period)."""
+    gate = gate or ActuationGate(cfg)
+    log: List[SimRecord] = []
+    period = 0
+    for n_periods, rate in load_steps:
+        for _ in range(n_periods):
+            sigs = job.step(rate)
+            decision: PolicyDecision = policy.decide(
+                job.topology(), sigs, cfg
+            )
+            current = {nid: op.parallelism for nid, op in job.ops.items()}
+            changed = decision.changed(current)
+            action = gate.check(changed)
+            if action == "rescale":
+                job.apply(changed)
+            log.append(SimRecord(
+                period=period,
+                offered_rate=rate,
+                action=action,
+                parallelism={
+                    nid: op.parallelism for nid, op in job.ops.items()
+                },
+                targets=dict(decision.targets),
+                reasons=dict(decision.reasons),
+                signals={nid: s.summary() for nid, s in sigs.items()},
+            ))
+            period += 1
+    return log
+
+
+def converged_within(log: List[SimRecord], start: int,
+                     periods: int) -> bool:
+    """True when parallelism stops changing within `periods` periods of
+    `start` and never moves again before the next load step (callers
+    slice the log per step)."""
+    window = log[start:start + periods]
+    tail = log[start + periods:]
+    if not window:
+        return False
+    settled = window[-1].parallelism
+    return all(r.parallelism == settled for r in tail)

@@ -318,6 +318,146 @@ def run_drills(query_names, seed: int, workdir: str,
     return out
 
 
+# -- rescale drill (autoscaler-triggered, faulted mid-rescale) ---------------
+
+
+def rescale_plan(seed: int) -> FaultPlan:
+    """Faults aimed at the autoscaler's actuation path: stretch the
+    decide->stop window, SIGKILL a worker inside it (the stop checkpoint
+    fails, the job recovers, the autoscaler re-decides), then fail the
+    job between a LATER rescale's durable stop checkpoint and its
+    reschedule (recovery must come back at the new parallelism). Every
+    rescale.* fault implies a rescale actually triggered."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    plan.add("rescale.stop_delay", at_hits=(1,),
+             params={"delay": 0.8}, max_fires=1)
+    # heartbeats tick every 0.1s across 2 workers (~20 hits/s): land the
+    # kill around the first rescale decision (~0.9s in) so it interrupts
+    # the decide/stop window the delay above holds open
+    plan.add("worker.kill", at_hits=(rng.randint(16, 26),))
+    # always the FIRST reschedule attempt: a rescale that survives the
+    # kill may be the only one (min==max converges in a single step)
+    plan.add("rescale.reschedule_fail", at_hits=(1,))
+    return plan
+
+
+def run_rescale_drill(seed: int, workdir: str,
+                      query_name: str = "hourly_by_event_type",
+                      golden_dir: str = DEFAULT_GOLDEN_DIR,
+                      throttle: float = 120.0,
+                      timeout: float = 180.0) -> DrillResult:
+    """Exactly-once through an AUTOSCALER-triggered rescale under faults.
+
+    The reference run executes the golden fault-free. The drill run
+    starts the same query at parallelism 1 with the autoscaler on and
+    `autoscale.min_parallelism = 2`: the unconditional clamp makes the
+    first post-warmup decision a deterministic scale-up, so a real
+    automatic rescale happens mid-stream without depending on load
+    timing. The fault plan kills a worker mid-rescale and fails a later
+    rescale between its durable stop checkpoint and the reschedule; the
+    canonical sink output must still be byte-identical to the fault-free
+    run. The decision audit log is written to
+    {workdir}/autoscale_decisions.json (CI uploads it on failure)."""
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    query_path = os.path.join(golden_dir, "queries", f"{query_name}.sql")
+    headers = query_headers(query_path)
+    register_query_udfs(headers, golden_dir)
+    os.makedirs(workdir, exist_ok=True)
+
+    clean_out = os.path.join(workdir, f"{query_name}-clean.json")
+    clean_sql = load_query(query_path, clean_out, golden_dir)
+    assert chaos.installed() is None, "a fault plan is already installed"
+    _run_embedded(
+        clean_sql, "drill-rescale-clean", None, 2, 1, max_restarts=0,
+        heartbeat_interval=0.1, heartbeat_timeout=30.0,
+        checkpoint_interval=60.0, timeout=timeout,
+    )
+    want = canonicalize_output(clean_out, clean_sql, headers)
+    if not want:
+        raise RuntimeError(f"{query_name}: fault-free run produced no output")
+
+    fault_out = os.path.join(workdir, f"{query_name}-rescale.json")
+    fault_sql = load_query(query_path, fault_out, golden_dir,
+                           throttle=throttle)
+    plan = chaos.install(rescale_plan(seed))
+    error = None
+    restarts = rescales = 0
+    decisions: List[dict] = []
+
+    async def go():
+        nonlocal restarts, rescales
+        with update(
+            worker={"heartbeat_interval": 0.1},
+            controller={"heartbeat_timeout": 1.5},
+            pipeline={"checkpointing": {"interval": 0.15}},
+            autoscale={
+                "enabled": True, "period": 0.3, "warmup_periods": 1,
+                "cooldown_periods": 2, "min_parallelism": 2,
+                "max_parallelism": 2,
+            },
+        ):
+            c = await ControllerServer(
+                EmbeddedScheduler(), max_restarts=8
+            ).start()
+            try:
+                await c.submit_job(
+                    "drill-rescale-faulted", sql=fault_sql,
+                    storage_url=os.path.join(workdir, "rescale-ck"),
+                    n_workers=2, parallelism=1,
+                )
+                state = await c.wait_for_state(
+                    "drill-rescale-faulted", JobState.FINISHED,
+                    JobState.FAILED, timeout=timeout,
+                )
+                job = c.jobs["drill-rescale-faulted"]
+                restarts, rescales = job.restarts, job.rescales
+                decisions.extend(job.autoscale_decisions)
+                if state != JobState.FINISHED:
+                    raise RuntimeError(
+                        f"rescale drill failed: {job.failure}"
+                    )
+            finally:
+                await c.stop()
+
+    try:
+        asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+    finally:
+        chaos.clear()
+    with open(os.path.join(workdir, "autoscale_decisions.json"), "w") as f:
+        json.dump(decisions, f, indent=1, default=str)
+
+    got = canonicalize_output(fault_out, fault_sql, headers)
+    passed = (error is None and got == want and not plan.unfired()
+              and rescales >= 1)
+    if error is None and got != want:
+        error = (
+            f"output diverged: {len(got)} rows vs {len(want)} fault-free"
+        )
+    if error is None and plan.unfired():
+        error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    if error is None and rescales < 1:
+        error = "the autoscaler never triggered a rescale"
+    return DrillResult(
+        query=f"rescale_{query_name}",
+        seed=seed,
+        passed=passed,
+        rows=len(got),
+        restarts=restarts,
+        fired=plan.fired_events,
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+    )
+
+
 # -- kafka drill (in-memory fake broker, real connector operators) -----------
 
 

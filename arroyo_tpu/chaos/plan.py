@@ -74,6 +74,19 @@ FAULT_POINTS: Dict[str, str] = {
         "state/storage.py StorageProvider.put/get — sleep params.delay "
         "seconds before the operation (slow object store)"
     ),
+    # autoscaler-triggered rescale (controller/controller.py _rescale)
+    "rescale.stop_delay": (
+        "controller/controller.py _rescale — hold params.delay seconds "
+        "between the rescale decision and the stop-with-checkpoint "
+        "(widens the window in which a worker kill lands mid-rescale)"
+    ),
+    "rescale.reschedule_fail": (
+        "controller/controller.py _rescale — fail the job after the "
+        "rescale's stop checkpoint published and the parallelism "
+        "overrides were applied, but before rescheduling (recovery must "
+        "come back at the NEW parallelism from that checkpoint, "
+        "exactly once)"
+    ),
     # checkpoint protocol (state/protocol.py)
     "protocol.fenced_zombie": (
         "state/protocol.py check_current — treat the caller's generation "

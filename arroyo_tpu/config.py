@@ -188,6 +188,56 @@ class ObsConfig:
 
 
 @dataclasses.dataclass
+class AutoscaleConfig:
+    """Closed-loop autoscaler (arroyo_tpu/autoscale): a controller-resident
+    control loop samples per-operator rates/busy-ratio/backpressure each
+    `period`, runs the configured policy (DS2-style rate-ratio propagation
+    with Dhalion-style symptom fallback), and actuates parallelism changes
+    through the proven stop-with-checkpoint -> override -> restore path.
+    Only jobs with durable state (a storage_url) are ever rescaled."""
+
+    # master switch: off = no control loop runs (decisions can still be
+    # simulated offline via autoscale/sim.py + tools/autoscale_report.py)
+    enabled: bool = False
+    # seconds between control periods (sample -> decide -> maybe actuate)
+    period: float = 5.0
+    # decision policy name; "ds2" is the built-in rate-based policy
+    # (autoscale/policy.py registers alternatives under the Policy protocol)
+    policy: str = "ds2"
+    # hard floor on any operator's target parallelism; the clamp is
+    # unconditional, so min_parallelism > current forces a scale-up even
+    # with no load signal (useful to pre-provision)
+    min_parallelism: int = 1
+    # hard ceiling on any operator's target parallelism (resource budget)
+    max_parallelism: int = 16
+    # max multiplicative change per rescale step (up or down): a target
+    # beyond current*cap (or below current/cap) is clamped to the cap
+    scale_factor_cap: float = 4.0
+    # relative dead band: |target - current| / current <= hysteresis is
+    # treated as "already converged" and not actuated (anti-oscillation)
+    hysteresis: float = 0.2
+    # control periods to hold after an actuated rescale before deciding
+    # again (lets rates re-stabilize on the new topology)
+    cooldown_periods: int = 3
+    # control periods to ignore after a (re)schedule while counters warm up
+    warmup_periods: int = 2
+    # utilization guardrail: scale down only below this busy ratio
+    busy_low: float = 0.3
+    # utilization guardrail: a rate-based scale-up is only actuated above
+    # this busy ratio (or under upstream backpressure)
+    busy_high: float = 0.8
+    # upstream output-queue fullness (0..1) treated as sustained
+    # backpressure: triggers the saturation fallback when the measured
+    # (throttled) rates alone would not justify a scale-up
+    backpressure_high: float = 0.5
+    # multiplicative step used by the saturation fallback (measured demand
+    # is untrustworthy under backpressure, so grow geometrically)
+    saturation_step: float = 2.0
+    # per-job decision audit entries kept in memory (REST + /debug surface)
+    decision_history: int = 256
+
+
+@dataclasses.dataclass
 class ControllerConfig:
     rpc_port: int = 9190  # controller gRPC port workers register against
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
@@ -271,13 +321,15 @@ class TlsConfig:
 @dataclasses.dataclass
 class Config:
     """Root of the layered config tree. Sections: pipeline (batching,
-    queues, checkpointing), tls, chaos (fault injection), obs (flight
-    recorder), tpu (device kernels + mesh), controller, worker, api,
+    queues, checkpointing), autoscale (closed-loop parallelism control),
+    tls, chaos (fault injection), obs (flight recorder), tpu (device
+    kernels + mesh), controller, worker, api,
     admin, database, logging. `tools/lint.py --config-table` prints the
     full resolved key/default table; arroyolint CFG001 rejects reads of
     undeclared keys."""
 
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    autoscale: AutoscaleConfig = dataclasses.field(default_factory=AutoscaleConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)

@@ -15,6 +15,7 @@ reschedules from the latest durable checkpoint — states/recovering.rs).
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 from typing import Dict, List, Optional
 
@@ -70,6 +71,17 @@ class JobHandle:
         self.assignments: Dict[tuple, int] = {}
         self.epoch = 0
         self.n_subtasks = sum(n.parallelism for n in graph.nodes.values())
+        # autoscale/rescale state: per-node parallelism overrides applied
+        # on top of the base plan (shipped to workers so their SQL re-plan
+        # matches this graph), a pending rescale request ({node: target},
+        # actuated by the state-machine driver), the decision audit log,
+        # and the pin that freezes automatic actuation
+        self.parallelism_overrides: Dict[int, int] = {}
+        self.rescale_requested: Optional[Dict[int, int]] = None
+        self.rescale_trace: Optional[tuple] = None
+        self.rescales = 0
+        self.autoscale_pinned = False
+        self.autoscale_decisions: List[dict] = []
         # epoch -> {task_id: report}
         self.checkpoints: Dict[int, Dict[str, dict]] = {}
         self.finished_tasks: set = set()
@@ -80,6 +92,17 @@ class JobHandle:
         # worker-leader mode: the leader finished its local work and handed
         # the checkpoint cadence back to the controller
         self.leader_resigned = False
+
+    def apply_parallelism_overrides(self, overrides: Dict[int, int]) -> None:
+        """Fold per-node targets into the job's graph and bookkeeping.
+        The overrides accumulate (a second rescale layers on the first)
+        and ride the StartExecution request, so workers re-planning from
+        canonical SQL reach the identical physical graph."""
+        self.parallelism_overrides.update(overrides)
+        self.graph.update_parallelism(overrides)
+        self.n_subtasks = sum(
+            n.parallelism for n in self.graph.nodes.values()
+        )
 
     def transition(self, nxt: JobState):
         check_transition(self.state, nxt)
@@ -129,6 +152,12 @@ class ControllerServer:
         self.addr = f"{self.bind}:{port}"
         # schedulers that place onto registered resources need the registry
         self.scheduler.controller = self
+        # closed-loop autoscaler (autoscale.enabled gates the loop; the
+        # object always exists so REST/debug surfaces can report status)
+        from ..autoscale import Autoscaler
+
+        self.autoscaler = Autoscaler(self)
+        self.autoscaler.maybe_start()
         from ..utils.admin import serve_admin
 
         self._admin, self.admin_port = await serve_admin(
@@ -137,15 +166,39 @@ class ControllerServer:
                 "workers": len(self.workers),
                 "jobs": {j.job_id: j.state.value for j in self.jobs.values()},
             },
+            extra_routes={
+                "/debug/autoscale": self._debug_autoscale,
+            },
         )
         logger.info("controller up at %s", self.addr)
         return self
 
+    async def _debug_autoscale(self, request):
+        """Admin surface: the autoscaler's per-job decision audit log."""
+        from aiohttp import web
+
+        return web.json_response(
+            self.autoscaler.status(),
+            dumps=lambda d: json.dumps(d, default=str),
+        )
+
     async def stop(self):
+        if getattr(self, "autoscaler", None) is not None:
+            await self.autoscaler.stop()
         for t in self._job_tasks.values():
             t.cancel()
         await asyncio.gather(*self._job_tasks.values(),
                              return_exceptions=True)
+        # tear down workers of any job still live: a controller stopping
+        # over a running job must not strand worker servers (an
+        # un-shut-down grpc server hangs interpreter exit joining its
+        # poller thread from the completion queue's finalizer)
+        for job_id in list(self.jobs):
+            try:
+                await self.scheduler.stop_workers(job_id, force=True)
+            except Exception as e:  # noqa: BLE001 - teardown best effort
+                logger.debug("stop_workers(%s) at controller stop: %s",
+                             job_id, e)
         for w in self.workers.values():
             await w.client.close()
         for job in self.jobs.values():
@@ -254,6 +307,26 @@ class ControllerServer:
     async def stop_job(self, job_id: str, mode: str = "checkpoint"):
         self.jobs[job_id].stop_requested = mode
 
+    async def rescale_job(self, job_id: str, overrides: Dict[int, int]):
+        """Request an exactly-once rescale of a running durable job to the
+        given per-node parallelism targets (the autoscaler's actuation
+        entry; also usable directly). The state-machine driver picks the
+        request up: stop-with-checkpoint, apply overrides, reschedule,
+        restore with key-range re-read."""
+        job = self.jobs[job_id]
+        if job.backend is None:
+            raise ValueError(
+                f"job {job_id} has no durable state; rescaling would drop "
+                "its progress"
+            )
+        overrides = {int(n): int(p) for n, p in overrides.items()}
+        for nid, p in overrides.items():
+            if nid not in job.graph.nodes:
+                raise ValueError(f"unknown node {nid} in rescale request")
+            if p < 1:
+                raise ValueError(f"parallelism must be >= 1 (node {nid})")
+        job.rescale_requested = overrides
+
     async def wait_for_state(self, job_id: str, *states: JobState,
                              timeout: float = 120.0):
         deadline = time.monotonic() + timeout
@@ -277,6 +350,8 @@ class ControllerServer:
                     await self._schedule(job, n_workers)
                 elif job.state == JobState.RUNNING:
                     await self._run(job)
+                elif job.state == JobState.RESCALING:
+                    await self._rescale(job)
                 elif job.state == JobState.RECOVERING:
                     await self._recover(job, n_workers)
                 else:
@@ -293,13 +368,19 @@ class ControllerServer:
         registration timeout) are retryable: they route through
         Recovering — bounded by max_restarts — instead of crashing the
         job driver into FAILED."""
+        # one lifecycle trace per (re)schedule: StartExecution rpc
+        # spans, worker build + state-restore spans nest under it, so
+        # a failed restore pinpoints its stage in the flight recording.
+        # A rescale-triggered schedule parents into the {job}/rescale-N
+        # trace instead, completing its decide -> stop-checkpoint ->
+        # reschedule -> restore tree.
+        trace = obs.new_trace(job.job_id, f"schedule-{job.restarts}")
+        parent = None
+        if job.rescale_trace is not None:
+            trace, parent = job.rescale_trace
         try:
-            # one lifecycle trace per (re)schedule: StartExecution rpc
-            # spans, worker build + state-restore spans nest under it, so
-            # a failed restore pinpoints its stage in the flight recording
             with obs.span(
-                "job.schedule",
-                trace=obs.new_trace(job.job_id, f"schedule-{job.restarts}"),
+                "job.schedule", trace=trace, parent=parent,
                 cat="controller", job=job.job_id, restarts=job.restarts,
             ):
                 await self._schedule_inner(job, n_workers)
@@ -307,6 +388,8 @@ class ControllerServer:
             logger.warning("job %s scheduling failed: %r", job.job_id, e)
             job.failure = f"scheduling failed: {e!r}"
             job.transition(JobState.RECOVERING)
+        finally:
+            job.rescale_trace = None
 
     async def _schedule_inner(self, job: JobHandle, n_workers: int):
         if job.storage_url and job.backend is None:
@@ -337,6 +420,12 @@ class ControllerServer:
             "job_id": job.job_id,
             "sql": job.sql,
             "parallelism": job.parallelism,
+            # rescale overrides layered on the base plan: workers re-plan
+            # canonical SQL at `parallelism`, then apply these, landing on
+            # this controller's exact graph (assignments must agree)
+            "parallelism_overrides": {
+                str(n): p for n, p in job.parallelism_overrides.items()
+            },
             "graph": None if job.sql else job.graph.to_json(),
             "assignments": [
                 {"node_id": n, "subtask": s, "worker_id": w}
@@ -400,6 +489,9 @@ class ControllerServer:
             if self._heartbeat_expired(job):
                 job.failure = "worker heartbeat timeout"
                 job.transition(JobState.RECOVERING)
+                return
+            if job.rescale_requested and not job.stop_requested:
+                job.transition(JobState.RESCALING)
                 return
             if job.stop_requested:
                 mode = job.stop_requested
@@ -468,13 +560,95 @@ class ControllerServer:
                 last_checkpoint = time.monotonic()
                 await self._checkpoint(job)
 
-    async def _checkpoint(self, job: JobHandle, then_stop: bool = False):
+    async def _rescale(self, job: JobHandle):
+        """Exactly-once automatic rescale (reference states/rescaling.rs;
+        the autoscaler's actuation path): stop with a checkpoint, fold the
+        per-node parallelism overrides into the graph, tear the workers
+        down, and reschedule — the restore re-reads key-range-sharded
+        state at the new parallelism. Failures anywhere before the
+        reschedule route through Recovering: either nothing durable
+        changed yet (stop checkpoint failed — recover at the old
+        parallelism) or the stop checkpoint IS durable (overrides applied
+        — recovery reschedules at the new one). Fully flight-recorded as
+        the `{job}/rescale-N` trace."""
+        overrides = job.rescale_requested or {}
+        job.rescale_requested = None
+        job.rescales += 1
+        trace, parent = job.rescale_trace or (
+            obs.new_trace(job.job_id, f"rescale-{job.rescales}"), None
+        )
+        with obs.span(
+            "job.rescale", trace=trace, parent=parent, cat="controller",
+            job=job.job_id, rescale=job.rescales, overrides=str(overrides),
+        ) as sp:
+            job.rescale_trace = (
+                (sp.trace_id, sp.span_id) if sp.recording else None
+            )
+            spec = chaos.fire("rescale.stop_delay", job=job.job_id)
+            if spec is not None:
+                logger.warning(
+                    "chaos[rescale.stop_delay]: job %s holding %.1fs "
+                    "before the rescale stop", job.job_id,
+                    spec.param("delay", 0.5),
+                )
+                await asyncio.sleep(float(spec.param("delay", 0.5)))
+            if self._heartbeat_expired(job):
+                # a worker died in the decide->stop window: recover first,
+                # rescale once the job is stable again
+                job.failure = "worker heartbeat timeout"
+                job.rescale_trace = None
+                job.transition(JobState.RECOVERING)
+                return
+            with obs.span("rescale.stop_checkpoint", cat="controller"):
+                await self._checkpoint(job, then_stop=True, nested=True)
+            if job.failure is not None:
+                # the stopping checkpoint did not publish (worker killed
+                # mid-rescale, storage fault): nothing changed durably, so
+                # recover at the CURRENT parallelism — the autoscaler
+                # re-decides once rates stabilize
+                job.rescale_trace = None
+                job.transition(JobState.RECOVERING)
+                return
+            await self._await_all_finished(job)
+            job.apply_parallelism_overrides(overrides)
+            if chaos.fire("rescale.reschedule_fail", job=job.job_id):
+                # crash window between the durable stop checkpoint and the
+                # reschedule: recovery must come back AT the new
+                # parallelism from that checkpoint, exactly once
+                logger.warning(
+                    "chaos[rescale.reschedule_fail]: job %s failing before "
+                    "the post-rescale schedule", job.job_id,
+                )
+                job.failure = "chaos: rescale reschedule failure"
+                job.transition(JobState.RECOVERING)
+                return
+            for w in job.workers:
+                self.workers.pop(w.worker_id, None)
+            await self.scheduler.stop_workers(job.job_id)
+            # fresh generation fences any straggler; the restore epoch is
+            # the stop checkpoint just published
+            job.backend = StateBackend(
+                job.storage_url, job.job_id
+            ).initialize()
+        job.transition(JobState.SCHEDULING)
+
+    async def _checkpoint(self, job: JobHandle, then_stop: bool = False,
+                          nested: bool = False):
         job.epoch += 1
         epoch = job.epoch
         # flight recorder: one trace per checkpoint epoch, minted here.
         # The barrier fan-out rpcs carry the context to workers; barriers
         # carry it in-band through the dataflow; completion reports and
-        # storage writes stitch back into this tree.
+        # storage writes stitch back into this tree. `nested` checkpoints
+        # (the rescale stop) join the AMBIENT trace instead, so the whole
+        # rescale reads as one connected tree.
+        if nested:
+            with obs.span(
+                "checkpoint", cat="controller", job=job.job_id,
+                epoch=epoch, then_stop=then_stop,
+            ):
+                await self._checkpoint_inner(job, epoch, then_stop)
+            return
         with obs.span(
             "checkpoint", trace=obs.new_trace(job.job_id, f"ck-{epoch}"),
             cat="controller", job=job.job_id, epoch=epoch,

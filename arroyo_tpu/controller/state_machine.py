@@ -61,7 +61,12 @@ TRANSITIONS = {
         JobState.FAILED,
     },
     JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
-    JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},
+    # a rescale whose stop checkpoint fails (worker killed mid-rescale,
+    # storage fault) recovers from the latest durable manifest instead of
+    # failing — the autoscaler retries once rates re-stabilize
+    JobState.RESCALING: {
+        JobState.SCHEDULING, JobState.FAILED, JobState.RECOVERING,
+    },
     JobState.RESTARTING: {JobState.SCHEDULING, JobState.FAILED},
     JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
     # a stop checkpoint whose publish fails (storage fault, fencing) must

@@ -181,6 +181,14 @@ class WorkerServer:
             graph = plan_query(
                 req["sql"], parallelism=req.get("parallelism", 1)
             ).graph
+            # rescale overrides: the controller's graph carries per-node
+            # parallelism on top of the base plan; apply the same ones or
+            # the shipped assignments won't match this worker's expansion
+            overrides = req.get("parallelism_overrides") or {}
+            if overrides:
+                graph.update_parallelism(
+                    {int(n): int(p) for n, p in overrides.items()}
+                )
         else:
             graph = LogicalGraph.from_json(req["graph"])
         assignments = {
@@ -308,7 +316,13 @@ class WorkerServer:
     async def get_metrics(self, req: dict) -> dict:
         from ..metrics import REGISTRY
 
-        return {"prometheus": REGISTRY.expose()}
+        # `snapshot` is the structured view the autoscaler samples each
+        # control period (msgpack-clean: dicts/lists/numbers); the
+        # prometheus text stays for scrapers and debugging
+        return {
+            "prometheus": REGISTRY.expose(),
+            "snapshot": REGISTRY.snapshot(),
+        }
 
     # -- worker-leader job control ------------------------------------------
 

@@ -163,9 +163,24 @@ class LogicalGraph:
         return out
 
     def update_parallelism(self, overrides: Dict[int, int]) -> None:
-        """Rescale support (reference: logical.rs:317)."""
+        """Rescale support (reference: logical.rs:317).
+
+        The planner picks FORWARD for an edge exactly when both endpoints
+        had equal parallelism at plan time AND round-robin delivery was
+        acceptable there (planner._edge: forward OR unkeyed shuffle;
+        key-affine operators always get keyed SHUFFLE edges). An override
+        can break that equality, and the physical build asserts it — so
+        any forward edge left unbalanced degrades to the unkeyed shuffle
+        the planner would have chosen for the same parallelism pair."""
         for nid, p in overrides.items():
             self.nodes[nid].parallelism = p
+        for e in self.edges:
+            if (
+                e.edge_type == EdgeType.FORWARD
+                and self.nodes[e.src].parallelism
+                != self.nodes[e.dst].parallelism
+            ):
+                e.edge_type = EdgeType.SHUFFLE
 
     def set_parallelism(self, p: int, internal_only: bool = False) -> None:
         for n in self.nodes.values():

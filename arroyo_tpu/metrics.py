@@ -188,6 +188,41 @@ def _hist_dict(h: _Hist, buckets: Tuple[float, ...]) -> dict:
     return {"sum": h.sum, "count": h.count, "buckets": out}
 
 
+def hist_quantiles(snapshot: Optional[dict],
+                   qs: Tuple[float, ...] = (0.5, 0.95, 0.99)) -> dict:
+    """Estimate quantiles from a histogram snapshot's cumulative buckets
+    (the {"sum", "count", "buckets": {le: cumulative}} shape _hist_dict /
+    Registry.snapshot produce) by linear interpolation within the bucket
+    that crosses the target rank — the same estimator Prometheus's
+    histogram_quantile() applies server-side. The +Inf bucket has no upper
+    edge, so ranks landing there report the highest finite edge (a floor,
+    like Prometheus). Returns {"p50": v, ...}; empty dict for a missing or
+    empty snapshot."""
+    if not snapshot or not snapshot.get("count"):
+        return {}
+    edges = sorted(
+        (float(le), c) for le, c in snapshot["buckets"].items()
+        if le != "+Inf"
+    )
+    total = snapshot["count"]
+    out = {}
+    for q in qs:
+        rank = q * total
+        val = edges[-1][0] if edges else 0.0
+        prev_edge, prev_cum = 0.0, 0
+        for edge, cum in edges:
+            if cum >= rank:
+                if cum > prev_cum:
+                    frac = (rank - prev_cum) / (cum - prev_cum)
+                    val = prev_edge + (edge - prev_edge) * frac
+                else:
+                    val = edge
+                break
+            prev_edge, prev_cum = edge, cum
+        out[f"p{int(q * 100)}"] = val
+    return out
+
+
 class Registry:
     def __init__(self):
         self.metrics: Dict[str, _Metric] = {}
@@ -276,6 +311,12 @@ QUEUE_BYTES = REGISTRY.gauge(
     "arroyo_worker_queue_bytes", "occupancy of an edge queue (bytes)")
 TPU_KERNEL_MILLIS = REGISTRY.counter(
     "arroyo_tpu_kernel_millis", "wall millis spent inside device kernels")
+BUSY_SECONDS = REGISTRY.counter(
+    "arroyo_worker_busy_seconds",
+    "wall seconds a subtask spent doing useful work (processing input "
+    "batches, watermark-driven emission, ticks) — excludes time idle on "
+    "queue reads or blocked on backpressure. The autoscaler's DS2-style "
+    "true-rate estimate is rows / busy-seconds (Kalavri et al., OSDI '18)")
 
 # Flight-recorder latency families (ISSUE 4): histograms in seconds.
 BATCH_PROCESSING_SECONDS = REGISTRY.histogram(

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import weakref
 from collections import deque
 from typing import Optional
 
@@ -44,6 +45,26 @@ class BatchQueue:
         self._writable.set()
         self._size_gauge = QUEUE_SIZE.labels(queue=name) if name else None
         self._bytes_gauge = QUEUE_BYTES.labels(queue=name) if name else None
+        if name:
+            # the push/pop updates only run on the producer/consumer hot
+            # paths, so a scrape between events (or after the last event —
+            # a quiesced or torn-down edge) would report whatever occupancy
+            # happened to be stored last. Same staleness class as the
+            # backpressure gauge (PR 1): refresh at scrape time through a
+            # weak reference, unregistering once the queue is collected so
+            # autoscaler samples never read a dead edge as live depth.
+            ref = weakref.ref(self)
+
+            def _size_now():
+                q = ref()
+                return None if q is None else float(len(q._items))
+
+            def _bytes_now():
+                q = ref()
+                return None if q is None else float(q._bytes)
+
+            self._size_gauge.set_refresher(_size_now)
+            self._bytes_gauge.set_refresher(_bytes_now)
 
     def qsize(self) -> int:
         return len(self._items)

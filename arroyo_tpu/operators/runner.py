@@ -30,6 +30,7 @@ from ..metrics import (
     BARRIER_ALIGNMENT_SECONDS,
     BATCH_PROCESSING_SECONDS,
     BATCHES_RECV,
+    BUSY_SECONDS,
     BYTES_RECV,
     CHECKPOINT_PHASE_SECONDS,
     MESSAGES_RECV,
@@ -121,6 +122,9 @@ class SubtaskRunner:
         # flight recorder: per-subtask latency/lag instruments
         self._batch_seconds = BATCH_PROCESSING_SECONDS.labels(
             job=jid, task=tid)
+        # DS2 true-rate denominator: seconds of useful work (vs idle on
+        # queue reads / blocked on backpressure) — see metrics.BUSY_SECONDS
+        self._busy_secs = BUSY_SECONDS.labels(job=jid, task=tid)
         self._align_gauge = BARRIER_ALIGNMENT_SECONDS.labels(
             job=jid, task=tid)
         self._phase_obs = {
@@ -297,9 +301,11 @@ class SubtaskRunner:
                     arm_control()
                 elif tag == "tick":
                     tick_count += 1
+                    t0 = time.perf_counter()
                     for op, ctx, coll in zip(self.ops, self.ctxs, self.collectors):
                         if op.tick_interval():
                             await op.handle_tick(tick_count, ctx, coll)
+                    self._busy_secs.inc(time.perf_counter() - t0)
                     arm_tick()
                 elif isinstance(tag, tuple) and tag[0] == "opfut":
                     idx = tag[1]
@@ -403,7 +409,12 @@ class SubtaskRunner:
                 changed = self.watermarks.set(i, item.watermark)
                 if changed is not None:
                     self._track_watermark_lag(changed)
+                    # window emission happens here: count it as busy time
+                    # or watermark-driven operators look idle to the
+                    # autoscaler no matter how hard they work
+                    t0 = time.perf_counter()
                     await self._chain_watermark(0, changed)
+                    self._busy_secs.inc(time.perf_counter() - t0)
                 return True
             if item.kind == SignalKind.BARRIER:
                 return await self._handle_barrier(i, item.barrier)
@@ -423,7 +434,9 @@ class SubtaskRunner:
         await self.ops[0].process_batch(
             item, self.ctxs[0], self.collectors[0], iq.logical_input
         )
-        self._batch_seconds.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._batch_seconds.observe(dt)
+        self._busy_secs.inc(dt)
         return True
 
     def _track_watermark_lag(self, wm: Watermark):

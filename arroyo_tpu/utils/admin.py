@@ -26,8 +26,11 @@ logger = get_logger("admin")
 _STARTED = time.time()
 
 
-def build_admin_app(role: str, details_fn=None) -> web.Application:
-    """`details_fn() -> dict` supplies role-specific status fields."""
+def build_admin_app(role: str, details_fn=None,
+                    extra_routes: Optional[dict] = None) -> web.Application:
+    """`details_fn() -> dict` supplies role-specific status fields;
+    `extra_routes` maps paths to aiohttp GET handlers for role-specific
+    debug surfaces (the controller mounts /debug/autoscale this way)."""
 
     async def status(request: web.Request):
         body = {
@@ -141,11 +144,14 @@ def build_admin_app(role: str, details_fn=None) -> web.Application:
     app.router.add_get("/debug/stacks", debug_stacks)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/trace", debug_trace)
+    for path, handler in (extra_routes or {}).items():
+        app.router.add_get(path, handler)
     return app
 
 
 async def serve_admin(role: str, details_fn=None,
-                      port: Optional[int] = None):
+                      port: Optional[int] = None,
+                      extra_routes: Optional[dict] = None):
     """Start the admin server; returns (runner, bound port). Port 0 binds
     an ephemeral port; admin.http_port < 0 disables (returns (None, 0))."""
     cfg = config().admin
@@ -153,7 +159,7 @@ async def serve_admin(role: str, details_fn=None,
         port = cfg.http_port
     if port < 0:
         return None, 0
-    app = build_admin_app(role, details_fn)
+    app = build_admin_app(role, details_fn, extra_routes=extra_routes)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, cfg.bind_address, port)

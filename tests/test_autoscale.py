@@ -1,0 +1,586 @@
+"""Closed-loop autoscaler (ISSUE 5): policy units, the deterministic
+load-step convergence acceptance scenario, signal sampling, histogram tail
+quantiles, the queue-gauge staleness regression, and the end-to-end
+embedded-cluster automatic rescale with exactly-once output."""
+
+import asyncio
+import gc
+import json
+
+import pytest
+
+from arroyo_tpu.autoscale import (
+    ActuationGate,
+    DS2Policy,
+    SimJob,
+    SimOp,
+    Topology,
+    make_policy,
+    run_scenario,
+)
+from arroyo_tpu.autoscale.signals import OperatorSignals, SignalSampler
+from arroyo_tpu.config import config, update
+
+
+def chain_job(rate=1000.0, parallelism=1):
+    """source(1) -> keyed op(2) -> sink(3)."""
+    return SimJob(
+        [
+            SimOp(1, source=True),
+            SimOp(2, rate_per_instance=rate, parallelism=parallelism),
+            SimOp(3, sink=True, rate_per_instance=1e9),
+        ],
+        [(1, 2), (2, 3)],
+    )
+
+
+# -- acceptance: load-step convergence ---------------------------------------
+
+
+def test_load_step_convergence():
+    """Offered rate steps 1x -> 4x -> 1x. The policy must converge to a
+    stable parallelism within 5 control periods of each step and never
+    oscillate after convergence (decision audit log asserted)."""
+    job = chain_job()
+    policy = make_policy("ds2")
+    steps = [(6, 700.0), (8, 2800.0), (8, 700.0)]
+    log = run_scenario(job, policy, config().autoscale, steps)
+
+    # step 1 (1x): stays at 1, no rescale ever decided
+    step1 = log[:6]
+    assert all(r.parallelism[2] == 1 for r in step1)
+    assert all(r.action != "rescale" for r in step1)
+
+    # step 2 (4x, starts at period 6): converges to 3 (ceil(2800/1000))
+    # within 5 periods, then holds — no further parallelism changes
+    step2 = log[6:14]
+    within = step2[:5]
+    assert any(r.action == "rescale" for r in within)
+    assert within[-1].parallelism[2] == 3
+    settled = [r for r in step2 if r.parallelism[2] == 3]
+    assert len(settled) >= 4
+    first_scaled = next(i for i, r in enumerate(step2)
+                        if r.parallelism[2] == 3)
+    assert all(r.parallelism[2] == 3 for r in step2[first_scaled:]), \
+        "oscillation after convergence in the 4x step"
+
+    # step 3 (back to 1x, starts at period 14): back to 1 within 5
+    step3 = log[14:]
+    assert step3[4].parallelism[2] == 1
+    first_down = next(i for i, r in enumerate(step3)
+                      if r.parallelism[2] == 1)
+    assert all(r.parallelism[2] == 1 for r in step3[first_down:]), \
+        "oscillation after convergence in the scale-down step"
+
+    # audit log: exactly two actuations over the whole trace, with
+    # rate-based reasons, and cooldown follows each
+    rescales = [r for r in log if r.action == "rescale"]
+    assert len(rescales) == 2
+    assert "demand" in list(rescales[0].reasons.values())[0]
+    assert "busy" in list(rescales[1].reasons.values())[0]
+    assert log[rescales[0].period + 1].action == "cooldown"
+    # every record carries the signals it was decided from
+    assert all(r.signals[2].get("parallelism") for r in log)
+
+
+def test_convergence_respects_scale_factor_cap():
+    """A 16x step cannot be closed in one move with a 4x per-step cap;
+    successive decisions (with cooldown between) stair-step up."""
+    job = chain_job()
+    with update(autoscale={"cooldown_periods": 1, "warmup_periods": 0,
+                           "max_parallelism": 32}):
+        log = run_scenario(job, make_policy("ds2"), config().autoscale,
+                           [(12, 16000.0)])
+    pars = [r.parallelism[2] for r in log]
+    assert 4 in pars and pars[-1] == 16  # 1 -> 4 -> 16 under the cap
+    assert max(pars) == 16
+
+
+# -- policy units ------------------------------------------------------------
+
+
+def _topo(current=1):
+    return Topology(
+        order=[1, 2, 3],
+        upstream={1: [], 2: [1], 3: [2]},
+        current={1: 1, 2: current, 3: 1},
+        scalable={1: False, 2: True, 3: False},
+    )
+
+
+def test_saturation_fallback_under_backpressure():
+    """Backpressured upstream + throttled rates (rate ratio says 'hold'):
+    the policy must still scale up, geometrically."""
+    signals = {
+        1: OperatorSignals(node_id=1, parallelism=1, output_rate=2000.0,
+                           backpressure=1.0),
+        2: OperatorSignals(node_id=2, parallelism=2, observed_rate=2000.0,
+                           output_rate=40.0, busy_ratio=1.0,
+                           true_rate_per_instance=1000.0),
+    }
+    d = DS2Policy().decide(_topo(current=2), signals, config().autoscale)
+    assert d.targets[2] == 4  # 2 * saturation_step
+    assert "saturation" in d.reasons[2]
+
+
+def test_hysteresis_holds_small_deltas():
+    """A rate-based target within the hysteresis band is not actuated."""
+    signals = {
+        1: OperatorSignals(node_id=1, parallelism=1, output_rate=5300.0),
+        2: OperatorSignals(node_id=2, parallelism=5, observed_rate=5300.0,
+                           output_rate=5300.0, busy_ratio=0.25,
+                           true_rate_per_instance=1000.0),
+    }
+    # rate target = ceil(5300/1000) = 6, |6-5|/5 = 0.2 <= hysteresis
+    d = DS2Policy().decide(_topo(current=5), signals, config().autoscale)
+    assert d.targets[2] == 5 and 2 not in d.reasons
+
+
+def test_min_parallelism_clamp_is_unconditional():
+    """min_parallelism above current forces a scale-up with no load
+    signal at all — the deterministic trigger the rescale drill uses."""
+    signals = {
+        1: OperatorSignals(node_id=1, parallelism=1, output_rate=10.0),
+        2: OperatorSignals(node_id=2, parallelism=1, observed_rate=10.0,
+                           output_rate=10.0, busy_ratio=0.01,
+                           true_rate_per_instance=1000.0),
+    }
+    with update(autoscale={"min_parallelism": 2, "max_parallelism": 2}):
+        d = DS2Policy().decide(_topo(), signals, config().autoscale)
+    assert d.targets[2] == 2
+    assert "clamped" in d.reasons[2]
+
+
+def test_unscalable_nodes_never_move():
+    signals = {
+        1: OperatorSignals(node_id=1, parallelism=1, output_rate=9000.0,
+                           backpressure=1.0),
+        2: OperatorSignals(node_id=2, parallelism=1, observed_rate=9000.0,
+                           output_rate=9000.0, busy_ratio=1.0,
+                           true_rate_per_instance=100.0),
+    }
+    topo = _topo()
+    topo.scalable[2] = False
+    d = DS2Policy().decide(topo, signals, config().autoscale)
+    assert d.targets == {1: 1, 2: 1, 3: 1}
+
+
+def test_actuation_gate_cadence():
+    cfg = config().autoscale
+    gate = ActuationGate(cfg)
+    changed = {2: 4}
+    assert gate.check(changed) == "warmup"
+    assert gate.check(changed) == "warmup"
+    assert gate.check(changed, pinned=True) == "pinned"
+    assert gate.check(changed) == "rescale"
+    assert gate.check(changed) == "cooldown"
+    assert gate.check({}) == "cooldown"
+    assert gate.check({}) == "cooldown"
+    assert gate.check({}) == "hold"
+    assert gate.check(changed) == "rescale"
+
+
+def test_topology_scalability_from_graph():
+    """Only keyed-input internal nodes are scalable: sources, sinks, and
+    nodes fed by unkeyed edges (round-robin maps, global accumulators)
+    keep their planned parallelism."""
+    from arroyo_tpu.sql import plan_query
+
+    g = plan_query(
+        """
+        CREATE TABLE impulse WITH (
+          connector = 'impulse', event_rate = '1000',
+          message_count = '10', start_time = '0'
+        );
+        CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+          connector = 'single_file', path = '/tmp/x.json',
+          format = 'json', type = 'sink'
+        );
+        INSERT INTO out
+        SELECT k, cnt FROM (
+          SELECT counter % 8 as k, tumble(interval '1 millisecond') as w,
+                 count(*) as cnt
+          FROM impulse GROUP BY 1, 2
+        );
+        """,
+        parallelism=1,
+    ).graph
+    topo = Topology.from_graph(g)
+    scalable = [nid for nid, ok in topo.scalable.items() if ok]
+    assert len(scalable) == 1  # exactly the keyed windowed-agg node
+    assert all(
+        e.schema.key_indices for e in g.in_edges(scalable[0])
+    )
+
+
+# -- forward-edge degradation on override ------------------------------------
+
+
+def test_update_parallelism_flips_unbalanced_forward_edges():
+    from arroyo_tpu.sql import plan_query
+    from arroyo_tpu.graph.logical import EdgeType
+
+    g = plan_query(
+        """
+        CREATE TABLE impulse WITH (
+          connector = 'impulse', event_rate = '1000',
+          message_count = '10', start_time = '0'
+        );
+        CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+          connector = 'single_file', path = '/tmp/x.json',
+          format = 'json', type = 'sink'
+        );
+        INSERT INTO out
+        SELECT k, cnt FROM (
+          SELECT counter % 8 as k, tumble(interval '1 millisecond') as w,
+                 count(*) as cnt
+          FROM impulse GROUP BY 1, 2
+        );
+        """,
+        parallelism=1,
+    ).graph
+    agg = [nid for nid, ok in
+           Topology.from_graph(g).scalable.items() if ok][0]
+    had_forward = any(e.edge_type == EdgeType.FORWARD
+                      for e in g.out_edges(agg))
+    g.update_parallelism({agg: 3})
+    assert g.nodes[agg].parallelism == 3
+    for e in g.edges:
+        if e.edge_type == EdgeType.FORWARD:
+            assert (g.nodes[e.src].parallelism
+                    == g.nodes[e.dst].parallelism), \
+                "unbalanced forward edge survived update_parallelism"
+    if had_forward:
+        assert any(e.edge_type == EdgeType.SHUFFLE
+                   for e in g.out_edges(agg))
+
+
+# -- signal sampling ---------------------------------------------------------
+
+
+def _snap(recv, sent, busy, job="j1"):
+    def entries(vals):
+        return [({"job": job, "task": f"2-{i}"}, v)
+                for i, v in enumerate(vals)]
+
+    return {
+        "arroyo_worker_messages_recv": entries(recv),
+        "arroyo_worker_messages_sent": entries(sent),
+        "arroyo_worker_busy_seconds": entries(busy),
+        "arroyo_worker_backpressure": entries([0.75] * len(recv)),
+    }
+
+
+def test_signal_sampler_rates_and_true_rate():
+    from arroyo_tpu.autoscale.signals import merge_snapshots
+
+    s = SignalSampler("j1")
+    assert s.sample(merge_snapshots([_snap([0, 0], [0, 0], [0, 0])]),
+                    {2: 2}, now=100.0) is None  # baseline
+    sigs = s.sample(
+        merge_snapshots([_snap([1000, 1000], [200, 200], [0.5, 0.5])]),
+        {2: 2}, now=101.0,
+    )
+    sig = sigs[2]
+    assert sig.observed_rate == pytest.approx(2000.0)
+    assert sig.output_rate == pytest.approx(400.0)
+    assert sig.busy_ratio == pytest.approx(0.5)  # 1 busy-sec / (1s * 2)
+    assert sig.true_rate_per_instance == pytest.approx(2000.0)
+    assert sig.selectivity == pytest.approx(0.2)
+    assert sig.backpressure == pytest.approx(0.75)
+
+
+def test_signal_sampler_counter_restart_clamps():
+    """A replaced worker restarts counters at zero; the delta must clamp
+    to the observed value, never go negative."""
+    from arroyo_tpu.autoscale.signals import merge_snapshots
+
+    s = SignalSampler("j1")
+    s.sample(merge_snapshots([_snap([5000], [5000], [2.0])]), {2: 1},
+             now=10.0)
+    sigs = s.sample(merge_snapshots([_snap([300], [300], [0.1])]), {2: 1},
+                    now=11.0)
+    assert sigs[2].observed_rate == pytest.approx(300.0)
+    assert sigs[2].busy_ratio == pytest.approx(0.1)
+
+
+def test_merge_snapshots_unions_identical_embedded_workers():
+    from arroyo_tpu.autoscale.signals import merge_snapshots
+
+    snap = _snap([100], [100], [0.5])
+    merged = merge_snapshots([snap, snap, snap])  # same-process workers
+    assert len(merged["arroyo_worker_messages_recv"]) == 1
+    (_, v), = merged["arroyo_worker_messages_recv"].items()
+    assert v == 100
+
+
+# -- histogram tail quantiles (satellite) ------------------------------------
+
+
+def test_hist_quantiles_interpolation():
+    from arroyo_tpu.metrics import REGISTRY, hist_quantiles
+
+    h = REGISTRY.histogram("t_autoscale_q", "t", buckets=(0.1, 0.2, 0.4))
+    handle = h.labels(x="1")
+    for _ in range(50):
+        handle.observe(0.15)  # lands in the (0.1, 0.2] bucket
+    for _ in range(50):
+        handle.observe(0.35)  # lands in the (0.2, 0.4] bucket
+    qs = hist_quantiles(handle.get_hist(), (0.5, 0.95, 0.99))
+    # p50 sits at the edge of the second bucket; p95/p99 interpolate
+    # inside the third
+    assert 0.1 <= qs["p50"] <= 0.2
+    assert 0.2 < qs["p95"] <= 0.4
+    assert qs["p99"] > qs["p95"] - 1e-9
+    assert hist_quantiles(None) == {}
+    assert hist_quantiles({"sum": 0, "count": 0, "buckets": {}}) == {}
+
+
+def test_operator_metric_groups_expose_quantiles():
+    """REST flattening emits :p50/:p95/:p99 series beside the mean for
+    histogram families (the UI and the autoscaler need tails)."""
+    from arroyo_tpu.metrics import BATCH_PROCESSING_SECONDS, hist_quantiles
+
+    handle = BATCH_PROCESSING_SECONDS.labels(job="qjob", task="7-0")
+    for v in (0.002, 0.004, 0.008, 0.3):
+        handle.observe(v)
+
+    from arroyo_tpu.api.rest import ApiServer
+
+    class FakeReq:
+        match_info = {"job_id": "qjob"}
+
+    api = ApiServer.__new__(ApiServer)  # no db needed for this route
+    api.controller = None
+    resp = asyncio.run(api.operator_metric_groups(FakeReq()))
+    data = json.loads(resp.body.decode())["data"]
+    groups = {g["name"] for op in data for g in op["metricGroups"]
+              if op["operatorId"] == "7"}
+    assert "batch_processing_seconds" in groups
+    assert {"batch_processing_seconds:p50",
+            "batch_processing_seconds:p95",
+            "batch_processing_seconds:p99"} <= groups
+    want = hist_quantiles(handle.get_hist())
+    series = {
+        g["name"]: g["subtasks"][0]["metrics"][0]["value"]
+        for op in data for g in op["metricGroups"]
+        if op["operatorId"] == "7"
+    }
+    assert series["batch_processing_seconds:p95"] == pytest.approx(
+        want["p95"])
+
+
+# -- queue gauge staleness regression (satellite) ----------------------------
+
+
+def test_queue_gauges_refresh_at_scrape_time():
+    """QUEUE_SIZE/QUEUE_BYTES only updated on the push/pop hot paths; a
+    scrape between events must still see live occupancy, and a collected
+    queue must unregister its refresher (weakref-holder pattern, same
+    class as the PR 1 backpressure fix)."""
+    import pyarrow as pa
+
+    from arroyo_tpu import metrics
+    from arroyo_tpu.operators.queues import BatchQueue
+
+    name = "t-refresh-q"
+    q = BatchQueue(8, 1 << 20, name)
+    batch = pa.RecordBatch.from_arrays([pa.array([1, 2, 3])], names=["v"])
+
+    async def fill():
+        await q.send(batch)
+        await q.send(batch)
+        # sabotage the stored sample to prove the scrape recomputes it
+        with metrics.QUEUE_SIZE.lock:
+            metrics.QUEUE_SIZE.values[(("queue", name),)] = 999.0
+
+    asyncio.run(fill())
+    got = {
+        tuple(sorted(labels.items())): v
+        for labels, v in metrics.REGISTRY.snapshot()[
+            "arroyo_worker_queue_size"]
+    }
+    key = (("queue", name),)
+    assert got[key] == 2.0
+    assert key in metrics.QUEUE_SIZE.refreshers
+    del q, fill
+    gc.collect()
+    metrics.REGISTRY.snapshot()  # dead refresher drops itself
+    assert key not in metrics.QUEUE_SIZE.refreshers
+
+
+# -- end-to-end: automatic rescale on sustained backpressure -----------------
+
+
+def test_autoscaler_e2e_backpressure_rescale(tmp_path):
+    """Acceptance (ISSUE 5): a windowed-agg job whose aggregation chain
+    cannot keep up builds sustained backpressure; the autoscaler detects
+    it, triggers an automatic exactly-once rescale through
+    stop-with-checkpoint -> override -> restore, the job finishes with
+    complete output, and the `{job}/rescale-1` trace is ONE connected
+    span tree: decide -> stop-checkpoint -> reschedule -> restore."""
+    import pyarrow as pa
+
+    from arroyo_tpu import obs
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+    from arroyo_tpu.udf import udf
+
+    @udf(pa.int64(), [pa.int64()], name="slow_cnt")
+    def slow_cnt(xs):
+        import time as _t
+
+        _t.sleep(0.03)  # per emitted window batch: saturates the chain
+        return xs
+
+    n = 9000
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '3000',
+      message_count = '{n}', start_time = '0', realtime = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{tmp_path}/out.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, slow_cnt(cnt) as cnt FROM (
+      SELECT counter % 8 as k, tumble(interval '25 millisecond') as w,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+    async def go():
+        with update(
+            pipeline={"checkpointing": {"interval": 0.2}},
+            obs={"trace_buffer_spans": 32768},
+            autoscale={
+                "enabled": True, "period": 0.25, "warmup_periods": 1,
+                "cooldown_periods": 2, "max_parallelism": 2,
+            },
+        ):
+            obs.reset()
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    "au1", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=1, parallelism=1,
+                )
+                state = await c.wait_for_state(
+                    "au1", JobState.FINISHED, JobState.FAILED, timeout=90
+                )
+                job = c.jobs["au1"]
+                return (state, job.rescales, list(job.autoscale_decisions),
+                        {nid: nd.parallelism
+                         for nid, nd in job.graph.nodes.items()})
+            finally:
+                await c.stop()
+
+    state, rescales, decisions, parallelism = asyncio.run(go())
+    assert state == JobState.FINISHED
+    assert rescales >= 1, (
+        f"autoscaler never actuated; decisions: {decisions[-8:]}"
+    )
+    # some node runs at the scaled-up parallelism now
+    assert max(parallelism.values()) == 2
+
+    # decision audit log: a rescale decision driven by backpressure
+    acted = [d for d in decisions if d["action"] == "rescale"]
+    assert acted, decisions
+    reason = " ".join(acted[0]["reasons"].values())
+    assert "saturation" in reason or "demand" in reason
+    assert acted[0]["signals"], "rescale decision recorded without signals"
+
+    # exactly-once output across the automatic rescale
+    counts = {}
+    with open(tmp_path / "out.json") as f:
+        for line in f:
+            if line.strip():
+                r = json.loads(line)
+                counts[r["k"]] = counts.get(r["k"], 0) + r["cnt"]
+    assert sum(counts.values()) == n, counts
+    assert counts == {k: n // 8 for k in range(8)}
+
+    # flight recorder: {job}/rescale-1 forms one connected tree with the
+    # full decide -> stop-checkpoint -> reschedule -> restore path
+    spans = obs.recorder().snapshot(trace_prefix="au1/rescale-1")
+    assert spans, "no spans recorded for the rescale trace"
+    by_id = {s["span_id"]: s for s in spans}
+    roots = [s for s in spans if not s.get("parent_id")]
+    assert len(roots) == 1, [s["name"] for s in roots]
+    assert roots[0]["name"] == "autoscale.decide"
+    # transitive reach from the root
+    children = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    reached = set()
+    stack = [roots[0]["span_id"]]
+    while stack:
+        sid = stack.pop()
+        if sid in reached:
+            continue
+        reached.add(sid)
+        stack += [c["span_id"] for c in children.get(sid, [])]
+    reached_names = {by_id[sid]["name"] for sid in reached if sid in by_id}
+    for required in ("autoscale.decide", "job.rescale",
+                     "rescale.stop_checkpoint", "checkpoint",
+                     "job.schedule", "task.start"):
+        assert required in reached_names, (
+            f"{required} not connected to the rescale root; "
+            f"reached={sorted(reached_names)}"
+        )
+
+
+def test_autoscale_rest_surface(tmp_path):
+    """GET /api/v1/jobs/{id}/autoscale returns the decision history and
+    pin state; PATCH pins/unpins; 404 on unknown jobs."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '100000',
+      message_count = '2000', start_time = '0'
+    );
+    CREATE TABLE out (counter BIGINT UNSIGNED) WITH (
+      connector = 'single_file', path = '{tmp_path}/out.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out SELECT counter FROM impulse;
+    """
+
+    async def go():
+        controller = await ControllerServer(EmbeddedScheduler()).start()
+        app = build_app(controller, db_path=":memory:")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await controller.submit_job("aj1", sql=sql)
+            r = await client.get("/api/v1/jobs/nope/autoscale")
+            assert r.status == 404
+            r = await client.get("/api/v1/jobs/aj1/autoscale")
+            assert r.status == 200
+            body = await r.json()
+            assert body["pinned"] is False and body["rescales"] == 0
+            assert "decisions" in body and "parallelism" in body
+            r = await client.patch("/api/v1/jobs/aj1/autoscale",
+                                   json={"pinned": True})
+            assert (await r.json())["pinned"] is True
+            assert controller.jobs["aj1"].autoscale_pinned is True
+            r = await client.patch("/api/v1/jobs/aj1/autoscale",
+                                   json={"pinned": "yes"})
+            assert r.status == 400
+            await controller.wait_for_state(
+                "aj1", JobState.FINISHED, JobState.FAILED, timeout=30
+            )
+        finally:
+            await client.close()
+            await controller.stop()
+
+    asyncio.run(go())

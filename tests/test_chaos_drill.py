@@ -52,6 +52,22 @@ def test_same_seed_reproduces_fired_log(tmp_path):
     )
 
 
+def test_rescale_drill_exactly_once(tmp_path):
+    """ISSUE 5 satellite: a worker SIGKILL lands mid-autoscaler-triggered
+    rescale (the stop checkpoint fails, the job recovers, the autoscaler
+    re-decides) and a later rescale fails between its durable stop
+    checkpoint and the reschedule (recovery must come back at the NEW
+    parallelism) — canonical output byte-identical to the fault-free run,
+    every scheduled rescale.* fault fired, decision audit log written."""
+    res = drill.run_rescale_drill(seed=20260804, workdir=str(tmp_path))
+    assert res.passed, f"{res.error}\nfired: {res.fired}"
+    assert res.restarts >= 1  # the mid-rescale kill forced a recovery
+    fired_points = {f["point"] for f in res.fired}
+    assert {"rescale.stop_delay", "rescale.reschedule_fail",
+            "worker.kill"} <= fired_points
+    assert (tmp_path / "autoscale_decisions.json").exists()
+
+
 def test_kafka_exactly_once_drill(tmp_path):
     """VERDICT r5 item 8 wiring: the protocol-shaped kafka fake (fenced
     producer epochs, abortable transactions) driven through the embedded

@@ -83,7 +83,7 @@ def test_time_key_table_retention_and_restore(tmp_storage):
 # -- engine-level fault tolerance -------------------------------------------
 
 
-def agg_pipeline(results, storage_seed=0, parallelism=1):
+def agg_pipeline(results, storage_seed=0, parallelism=1, throttle=0.0):
     g = LogicalGraph()
     g.add_node(
         LogicalNode(
@@ -107,8 +107,15 @@ def agg_pipeline(results, storage_seed=0, parallelism=1):
     )
 
     def with_key(batch):
+        import time as _time
+
         import pyarrow.compute as pc
 
+        if throttle:
+            # wall-clock drag per batch (event time untouched): keeps
+            # windows live long enough for a mid-stream checkpoint to
+            # capture keyed state deterministically
+            _time.sleep(throttle)
         k = pc.bit_wise_and(batch.column(0), 7)
         return pa.RecordBatch.from_arrays(
             [k, batch.column(1), batch.column(2)],
@@ -213,6 +220,110 @@ def test_checkpoint_restore_with_rescale(tmp_storage):
         want = golden_run()
         got = checkpoint_restore_run(tmp_storage, restart_parallelism=2)
     assert got == want
+
+
+def _assert_agg_key_ownership(eng, node_id=2) -> int:
+    """Every key currently held by an agg subtask's slot directory must
+    hash into that subtask's range — a restore that failed to re-filter
+    by key range leaves foreign keys behind. Returns subtasks checked."""
+    from arroyo_tpu.types import (
+        hash_arrays,
+        hash_column,
+        server_for_hash_array,
+    )
+
+    checked = 0
+    for sub in eng.program.subtasks:
+        ti = sub.runner.task_info
+        if ti.node_id != node_id or ti.parallelism <= 1:
+            continue
+        for op in sub.runner.ops:
+            d = getattr(op, "dir", None)
+            if d is None:
+                continue
+            keys = [key for _b, key, _s in d.items()]
+            if not keys:
+                continue
+            col = hash_column(np.asarray(
+                [k[0] if isinstance(k, tuple) else k for k in keys],
+                dtype=np.int64,
+            ))
+            owners = server_for_hash_array(hash_arrays([col]), ti.parallelism)
+            assert (owners == ti.task_index).all(), (
+                f"subtask {ti.task_id} holds keys outside its range: "
+                f"{sorted(set(k[0] for k in keys))}"
+            )
+            checked += 1
+    return checked
+
+
+def test_rescale_round_trip_1_4_2(tmp_storage):
+    """ISSUE 5 satellite: windowed agg at parallelism 1 -> checkpoint ->
+    restore at 4 -> checkpoint -> restore at 2 — exactly-once canonical
+    output across all three phases, and at each restored parallelism the
+    live slot directories hold only keys in their own hash range."""
+    url = f"{tmp_storage}/rt"
+
+    import time as _time
+
+    # per-batch wall-clock throttle (event time untouched, so the golden
+    # output is identical): guarantees each phase's stop checkpoint lands
+    # while windows are still live, making the key-ownership checks and
+    # the phase hand-offs deterministic instead of racing the final flush
+    throttle = 0.003
+
+    def run_phase(results, parallelism, stop_after_output):
+        """Start (or restore) at `parallelism`, wait for the first new
+        output while checking key ownership on every scheduler step, then
+        either checkpoint-stop or run to completion. Returns the max
+        subtasks seen holding keyed state."""
+        g = agg_pipeline(results, parallelism=parallelism,
+                         throttle=throttle)
+        checked = 0
+
+        async def go():
+            nonlocal checked
+            eng = Engine(g, job_id="rt", storage_url=url).start()
+            seen = len(results)
+            deadline = _time.monotonic() + 30
+            while len(results) <= seen:
+                checked = max(checked, _assert_agg_key_ownership(eng))
+                assert _time.monotonic() < deadline, (
+                    f"parallelism-{parallelism} phase produced no output"
+                )
+                await asyncio.sleep(0)
+                eng.drain_responses()
+            checked = max(checked, _assert_agg_key_ownership(eng))
+            if stop_after_output:
+                await eng.checkpoint_and_wait(then_stop=True)
+            await eng.join(60)
+
+        asyncio.run(go())
+        return checked
+
+    with update(pipeline={"source_batch_size": 128}):
+        want = golden_run()
+
+        part1 = []
+        run_phase(part1, 1, stop_after_output=True)
+        assert part1, "phase 1 produced no output before its stop"
+
+        part2 = []
+        checked4 = run_phase(part2, 4, stop_after_output=True)
+        assert checked4 >= 2, "parallelism-4 phase never held keyed state"
+
+        part3 = []
+        checked2 = run_phase(part3, 2, stop_after_output=False)
+        assert checked2 >= 1
+
+    got = sorted(
+        (r["counter"], r["cnt"], r["total"], r["_timestamp"])
+        for r in part1 + part2 + part3
+    )
+    assert got == want, (
+        f"rescale round-trip lost or duplicated rows: "
+        f"{len(got)} vs {len(want)}"
+    )
 
 
 def test_backend_manifest_roundtrip(tmp_storage):

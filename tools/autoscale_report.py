@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Replay load traces through the autoscale policy, offline.
+
+    python tools/autoscale_report.py
+        Built-in load-step scenario (1x -> 4x -> 1x offered rate through
+        a source -> operator -> sink chain): prints one line per control
+        period — offered rate, action, per-node parallelism — plus a
+        convergence summary. This is the acceptance scenario the tier-1
+        test pins (tests/test_autoscale.py).
+
+    python tools/autoscale_report.py --trace trace.json
+        Replay a recorded trace. The file is
+        {"ops": [{"node_id", "rate_per_instance", "parallelism",
+                  "selectivity"?, "source"?, "sink"?}],
+         "edges": [[src, dst]],
+         "steps": [[n_periods, offered_rate], ...]} — the shape
+        `SimJob`/`run_scenario` consume; record one from a live run's
+        /api/v1/jobs/{id}/autoscale decision log.
+
+    python tools/autoscale_report.py --json out.json
+        Also write the full decision log as JSON.
+
+Policy knobs come from the normal config tree (ARROYO__AUTOSCALE__* env
+vars work), so "what would the controller have done with hysteresis 0.3"
+is a one-env-var experiment, no cluster needed.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_scenario():
+    from arroyo_tpu.autoscale import SimJob, SimOp
+
+    job = SimJob(
+        [
+            SimOp(1, source=True),
+            SimOp(2, rate_per_instance=1000.0, parallelism=1),
+            SimOp(3, sink=True, rate_per_instance=1e9),
+        ],
+        [(1, 2), (2, 3)],
+    )
+    steps = [(8, 700.0), (8, 2800.0), (8, 700.0)]
+    return job, steps
+
+
+def load_trace(path):
+    from arroyo_tpu.autoscale import SimJob, SimOp
+
+    with open(path) as f:
+        obj = json.load(f)
+    ops = [SimOp(**op) for op in obj["ops"]]
+    edges = [tuple(e) for e in obj["edges"]]
+    steps = [tuple(s) for s in obj["steps"]]
+    return SimJob(ops, edges), steps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", type=str, default="",
+                    help="recorded trace JSON to replay (default: the "
+                    "built-in 1x->4x->1x load-step scenario)")
+    ap.add_argument("--policy", type=str, default="",
+                    help="policy name (default: config autoscale.policy)")
+    ap.add_argument("--json", type=str, default="",
+                    help="write the full decision log to this file")
+    args = ap.parse_args()
+
+    from arroyo_tpu.autoscale import make_policy, run_scenario
+    from arroyo_tpu.config import config
+
+    cfg = config().autoscale
+    policy = make_policy(args.policy or cfg.policy)
+    job, steps = load_trace(args.trace) if args.trace else default_scenario()
+
+    log = run_scenario(job, policy, cfg, steps)
+    print(f"policy={args.policy or cfg.policy} "
+          f"busy=[{cfg.busy_low}, {cfg.busy_high}] "
+          f"hysteresis={cfg.hysteresis} cooldown={cfg.cooldown_periods} "
+          f"clamp=[{cfg.min_parallelism}, {cfg.max_parallelism}]")
+    print(f"{'period':>6}  {'offered/s':>10}  {'action':<12} parallelism")
+    rescales = 0
+    for rec in log:
+        par = " ".join(f"{n}:{p}" for n, p in sorted(rec.parallelism.items()))
+        mark = ""
+        if rec.action == "rescale":
+            rescales += 1
+            mark = "  <- " + "; ".join(rec.reasons.values())
+        print(f"{rec.period:>6}  {rec.offered_rate:>10.0f}  "
+              f"{rec.action:<12} {par}{mark}")
+    print(f"\n{rescales} rescale(s) over {len(log)} control periods; "
+          f"final parallelism "
+          f"{ {n: p for n, p in sorted(log[-1].parallelism.items())} }")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([r.to_json() for r in log], f, indent=1)
+        print(f"decision log written to {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,13 @@
         Exactly-once through the transactional kafka sink (in-memory
         protocol-shaped fake broker) under worker kill + manifest CAS
         loss.
+
+    python tools/chaos_drill.py --rescale
+        Exactly-once through an AUTOSCALER-triggered rescale: a worker
+        SIGKILL lands mid-rescale and a later rescale fails between its
+        durable stop checkpoint and the reschedule; output must be
+        byte-identical and the decision audit log is written next to
+        the results.
 """
 
 import argparse
@@ -49,6 +56,10 @@ def main() -> int:
                     help="smoke drill: 1 golden, 2 quickly-detected faults")
     ap.add_argument("--kafka", action="store_true",
                     help="also run the transactional-kafka exactly-once drill")
+    ap.add_argument("--rescale", action="store_true",
+                    help="also run the autoscaler-rescale drill: worker "
+                    "kill mid-automatic-rescale + reschedule failure, "
+                    "byte-identical output required")
     ap.add_argument("--out", type=str, default="",
                     help="write results + fired-fault log to this JSON file")
     ap.add_argument("--workdir", type=str, default="")
@@ -79,6 +90,10 @@ def main() -> int:
     if args.kafka:
         results.append(
             d.run_kafka_drill(args.seed, os.path.join(workdir, "kafka"))
+        )
+    if args.rescale:
+        results.append(
+            d.run_rescale_drill(args.seed, os.path.join(workdir, "rescale"))
         )
 
     ok = all(r.passed for r in results)

@@ -60,6 +60,10 @@ ROUTES: List[Route] = [
      "Flight-recorder spans of a job (checkpoint epochs, lifecycle "
      "events) as Perfetto-loadable Chrome trace-event JSON", "jobs",
      None, "TraceDump"),
+    ("get", "/jobs/{job_id}/latency", "job_latency",
+     "Latency-marker histograms (per-operator transit + end-to-end at "
+     "the sinks) and XLA compile/dispatch telemetry of a job", "jobs",
+     None, "LatencyReport"),
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
@@ -304,6 +308,21 @@ def _schemas() -> Dict[str, Any]:
              "displayTimeUnit": _str(),
              "spanCount": _int()},
             ["traceEvents"],
+        ),
+        "LatencySeries": _obj(
+            {"job": _str(), "task": _str(), "samples": _int(),
+             "mean_ms": {"type": "number"},
+             "p50_ms": {"type": "number"},
+             "p95_ms": {"type": "number"},
+             "p99_ms": {"type": "number"}},
+            ["task", "samples"],
+        ),
+        "LatencyReport": _obj(
+            {"operators": {"type": "array", "items": _ref("LatencySeries")},
+             "end_to_end": {"type": "array",
+                            "items": _ref("LatencySeries")},
+             "device": {"type": "object"}},
+            ["operators", "end_to_end", "device"],
         ),
         "OutputData": _obj(
             {"rows": {"type": "array", "items": {"type": "object"}},

@@ -347,6 +347,20 @@ class ApiServer:
         body["spanCount"] = len(spans)
         return json_response(body)
 
+    async def job_latency(self, request: web.Request):
+        """Device-tier observatory surface: the job's latency-marker
+        histograms (per-operator transit + end-to-end at the sinks, p50/
+        p95/p99 in ms) and the XLA compile/dispatch telemetry summary
+        (compiles, cache hit/miss, dispatch quantiles, padding waste,
+        recompile-cause log). Reads this process's registry — merge
+        worker dumps with tools/trace_report.py --latency for
+        multi-process deployments."""
+        from .. import obs
+
+        return json_response(
+            obs.latency_report(request.match_info["job_id"])
+        )
+
     def _autoscale_status(self, job) -> dict:
         return {
             "enabled": bool(config().autoscale.enabled),

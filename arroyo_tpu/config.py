@@ -185,6 +185,20 @@ class ObsConfig:
     # the dump); 0 disables frame span sampling. The exchange latency
     # histogram sees EVERY frame regardless via the header send timestamp.
     frame_sample_every: int = 64
+    # seconds between Flink-style latency markers stamped at each source
+    # subtask; markers flow through queues and the TCP exchange like
+    # watermarks (never blocking alignment, never touching event time)
+    # and feed the per-operator + end-to-end latency histograms.
+    # 0 disables marker stamping.
+    latency_marker_interval: float = 1.0
+    # device-tier telemetry (obs/device.py): per-program XLA compile
+    # counters/histograms, recompile-cause records, compile-cache
+    # hit/miss, dispatch-time histograms, padding-waste gauges. Off =
+    # jitted programs run unwrapped (zero overhead).
+    device_telemetry: bool = True
+    # bounded in-memory recompile-cause log entries (oldest dropped);
+    # each names the program, shape signature and packing rung
+    recompile_log_entries: int = 256
 
 
 @dataclasses.dataclass

@@ -39,6 +39,7 @@ from .. import chaos, obs
 from ..metrics import EXCHANGE_FRAME_SECONDS
 from ..types import (
     CheckpointBarrier,
+    LatencyMarker,
     SignalKind,
     SignalMessage,
     Watermark,
@@ -66,6 +67,9 @@ def encode_signal(sig: SignalMessage) -> bytes:
         if b.trace_id:
             # flight-recorder context rides the barrier across workers
             out["barrier"] += [b.trace_id, b.span_id]
+    if sig.marker is not None:
+        m = sig.marker
+        out["marker"] = [m.source_task, m.seq, m.stamp_ns]
     return msgpack.packb(out)
 
 
@@ -74,6 +78,7 @@ def decode_signal(data: bytes) -> SignalMessage:
     kind = SignalKind(obj["kind"])
     wm = None
     barrier = None
+    marker = None
     if "wm_kind" in obj:
         wm = Watermark(WatermarkKind(obj["wm_kind"]), obj.get("wm_ts"))
     if "barrier" in obj:
@@ -84,7 +89,9 @@ def decode_signal(data: bytes) -> SignalMessage:
             trace_id=extra[0] if extra else "",
             span_id=extra[1] if len(extra) > 1 else "",
         )
-    return SignalMessage(kind, wm, barrier)
+    if "marker" in obj:
+        marker = LatencyMarker(*obj["marker"][:3])
+    return SignalMessage(kind, wm, barrier, marker)
 
 
 def encode_batch(batch: pa.RecordBatch) -> bytes:

@@ -333,6 +333,42 @@ STORAGE_OP_SECONDS = REGISTRY.histogram(
 CHECKPOINT_PHASE_SECONDS = REGISTRY.histogram(
     "arroyo_checkpoint_phase_seconds",
     "checkpoint phase durations per subtask (phase=align|capture|flush)")
+# Device-tier observatory (ISSUE 6): end-to-end latency markers +
+# XLA compile/dispatch telemetry.
+LATENCY_MARKER_SECONDS = REGISTRY.histogram(
+    "arroyo_worker_latency_marker_seconds",
+    "latency-marker transit time source->this subtask (Flink-style "
+    "markers stamped at the sources; per-operator record latency)")
+E2E_LATENCY_SECONDS = REGISTRY.histogram(
+    "arroyo_worker_e2e_latency_seconds",
+    "latency-marker transit time source->sink: the pipeline's "
+    "end-to-end record latency, recorded at terminal subtasks")
+# XLA compiles run tens of ms (CPU) to tens of seconds (TPU relay):
+# latency-shaped DEFAULT_BUCKETS top out at 10s, so compile histograms
+# get their own ladder
+COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0)
+XLA_COMPILES = REGISTRY.counter(
+    "arroyo_xla_compiles_total",
+    "XLA compilations per jitted program (a new shape signature "
+    "specializes a fresh executable)")
+XLA_COMPILE_CACHE = REGISTRY.counter(
+    "arroyo_xla_compile_cache_total",
+    "per-program compile-cache outcomes by result=hit|miss (hit = this "
+    "process already traced the call's shape signature)")
+XLA_COMPILE_SECONDS = REGISTRY.histogram(
+    "arroyo_xla_compile_seconds",
+    "wall time of calls that triggered an XLA compilation, per program "
+    "(includes the compiled executable's first dispatch)",
+    buckets=COMPILE_BUCKETS)
+DEVICE_DISPATCH_SECONDS = REGISTRY.histogram(
+    "arroyo_device_dispatch_seconds",
+    "steady-state dispatch wall time of already-compiled jitted "
+    "programs, per program")
+DEVICE_PADDING_WASTE = REGISTRY.gauge(
+    "arroyo_device_padding_waste",
+    "fraction (0..1) of rows shipped to the device that were neutral "
+    "padding filler, per program and packing rung (shape bucket)")
 WATERMARK_LAG_SECONDS = REGISTRY.gauge(
     "arroyo_worker_watermark_lag_seconds",
     "wall-clock seconds the subtask's effective watermark trails now "

@@ -66,6 +66,12 @@ def frame_sample_every() -> int:
     return int(config().obs.frame_sample_every)
 
 
+def latency_marker_interval() -> float:
+    from ..config import config
+
+    return float(config().obs.latency_marker_interval)
+
+
 def recorder() -> TraceRecorder:
     """The process-wide ring buffer (lazily sized from
     obs.trace_buffer_spans)."""
@@ -150,3 +156,46 @@ def headers() -> Optional[dict]:
     if ctx is None:
         return None
     return {"t": ctx[0], "s": ctx[1]}
+
+
+def latency_report(job_id: Optional[str] = None) -> dict:
+    """The device-tier observatory's structured latency surface: per-task
+    latency-marker quantiles (transit source→operator), end-to-end
+    quantiles at terminal subtasks, and the XLA compile/dispatch summary.
+    Shared by `GET /api/v1/jobs/{id}/latency`, the admin server's
+    `/debug/latency`, and tools/trace_report.py."""
+    from ..metrics import REGISTRY, hist_quantiles
+
+    snap = REGISTRY.snapshot()
+
+    def series(name: str) -> list:
+        out = []
+        for labels, h in snap.get(name, []):
+            if job_id is not None and labels.get("job") != job_id:
+                continue
+            count = h.get("count", 0)
+            entry = {
+                "job": labels.get("job"),
+                "task": labels.get("task"),
+                "samples": int(count),
+                "mean_ms": round(1e3 * h.get("sum", 0.0) / count, 3)
+                if count else 0.0,
+            }
+            entry.update({
+                f"{q}_ms": round(v * 1e3, 3)
+                for q, v in hist_quantiles(h).items()
+            })
+            out.append(entry)
+        out.sort(key=lambda e: (e["job"] or "", e["task"] or ""))
+        return out
+
+    return {
+        "operators": series("arroyo_worker_latency_marker_seconds"),
+        "end_to_end": series("arroyo_worker_e2e_latency_seconds"),
+        "device": device.summary(),
+    }
+
+
+# device-tier observatory (XLA compile/dispatch telemetry) — imported
+# last: device.py pulls in the metric families and the trace primitives
+from . import device  # noqa: F401,E402 - public surface

@@ -1,0 +1,334 @@
+"""Device-tier observatory: XLA compile & dispatch telemetry.
+
+The JAX tier compiles one executable per (program, shape signature):
+every new padding rung, accumulator capacity or dtype layout traces and
+compiles a fresh program — ~ms on CPU-jax, 20-40s through the TPU relay
+— and until now those cycles were invisible (ROADMAP item 1: the 8-way
+mesh path loses to one process and nobody can say how much of the gap is
+compile storms vs padding vs dispatch).
+
+`InstrumentedJit` wraps a jitted callable and, per call, classifies it
+as a compile (first time this process sees the call's shape signature)
+or a steady-state dispatch:
+
+* compiles feed `arroyo_xla_compiles_total`, the
+  `arroyo_xla_compile_seconds` histogram, a compile-cache miss, a
+  bounded recompile-cause log naming the program, the offending shape
+  signature and the packing rung that produced it, and — when a trace
+  context is ambient — a `jax.compile:<program>` span inside whatever
+  batch/checkpoint trace triggered the compile;
+* dispatches feed `arroyo_device_dispatch_seconds` and a cache hit.
+
+`note_padding` records the per-(program, rung) padding-waste gauge from
+the packing paths (aggregates + the mesh exchange in parallel/).
+
+Everything is gated on `obs.device_telemetry`; when off, the wrapper
+forwards straight to the jitted callable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..metrics import (
+    DEVICE_PADDING_WASTE,
+    DEVICE_DISPATCH_SECONDS,
+    XLA_COMPILE_CACHE,
+    XLA_COMPILE_SECONDS,
+    XLA_COMPILES,
+)
+from . import trace
+
+logger = logging.getLogger("arroyo.obs.device")
+
+_LOCK = threading.Lock()
+_RECOMPILE_LOG: deque = deque(maxlen=256)
+# bumped whenever a jax.compile span lands in the recorder: the runner's
+# lazy batch anchors use it to decide whether to materialize themselves
+_SPAN_EPOCH = 0
+# per-(program, rung) cached gauge handles for the padding-waste path
+_PAD_HANDLES: Dict[Tuple[str, str], Any] = {}
+
+
+def enabled() -> bool:
+    from ..config import config
+
+    return bool(config().obs.device_telemetry)
+
+
+def span_epoch() -> int:
+    return _SPAN_EPOCH
+
+
+def recompile_log() -> List[dict]:
+    """The bounded recompile-cause log, oldest first. Each entry names
+    the program, the full shape signature that forced the compile, the
+    packing rung the call site padded to, and the call's wall time."""
+    with _LOCK:
+        return list(_RECOMPILE_LOG)
+
+
+def reset() -> None:
+    """Clear telemetry state (tests)."""
+    global _SPAN_EPOCH
+    with _LOCK:
+        _RECOMPILE_LOG.clear()
+        _PAD_HANDLES.clear()
+        _SPAN_EPOCH = 0
+
+
+def _resize_log() -> None:
+    from ..config import config
+
+    global _RECOMPILE_LOG
+    cap = int(config().obs.recompile_log_entries)
+    if cap > 0 and _RECOMPILE_LOG.maxlen != cap:
+        _RECOMPILE_LOG = deque(_RECOMPILE_LOG, maxlen=cap)
+
+
+def _sig_part(a: Any, parts: List[str]) -> None:
+    if isinstance(a, (list, tuple)):
+        for x in a:
+            _sig_part(x, parts)
+        return
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None:
+        parts.append(
+            f"{dtype}[{'x'.join(str(d) for d in shape)}]"
+        )
+    else:
+        parts.append(type(a).__name__)
+
+
+def signature_of(args: tuple) -> str:
+    """The call's shape signature — the key XLA specializes on: dtype and
+    dimensions of every array argument, pytree-flattened in order."""
+    parts: List[str] = []
+    _sig_part(args, parts)
+    return "(" + ", ".join(parts) + ")"
+
+
+def _record_compile(program: str, sig: str, rung: Optional[int],
+                    nth: int, secs: float, start_us: float) -> None:
+    global _SPAN_EPOCH
+    cause = "first-compile" if nth == 1 else "shape-change"
+    entry = {
+        "ts": time.time(),
+        "program": program,
+        "signature": sig,
+        "rung": rung,
+        "nth_compile": nth,
+        "compile_s": round(secs, 4),
+        "cause": cause,
+    }
+    with _LOCK:
+        _resize_log()
+        _RECOMPILE_LOG.append(entry)
+    logger.info(
+        "xla compile #%d for %s (%s): signature=%s rung=%s %.3fs",
+        nth, program, cause, sig, rung, secs,
+    )
+    ctx = trace.current()
+    if ctx is None:
+        return
+    # retroactive span over the compiling call, parented into whatever
+    # batch/checkpoint trace was ambient when the compile fired
+    import os
+
+    trace_id, parent_id = ctx
+    from . import recorder
+
+    recorder().record({
+        "trace_id": trace_id,
+        "span_id": trace.new_span_id(),
+        "parent_id": parent_id,
+        "name": f"jax.compile:{program}",
+        "cat": "device",
+        "ts": start_us,
+        "dur": secs * 1e6,
+        "attrs": {"signature": sig, "rung": rung, "nth_compile": nth,
+                  "cause": cause},
+        "events": [],
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    })
+    with _LOCK:
+        _SPAN_EPOCH += 1
+
+
+class InstrumentedJit:
+    """Wrap one jitted program with compile/dispatch telemetry. The
+    in-process signature set classifies each call: an unseen signature
+    means jax traces + XLA compiles inside this call (cache miss), a seen
+    one is a pure dispatch (cache hit). The persistent on-disk XLA cache
+    (tpu.compilation_cache_dir) can make a "miss" cheap — the compile
+    histogram will show it — but it still costs a python-side trace."""
+
+    __slots__ = ("program", "fn", "seen", "_compiles", "_hit", "_miss",
+                 "_compile_h", "_dispatch_h")
+
+    def __init__(self, program: str, fn):
+        self.program = program
+        self.fn = fn
+        self.seen: set = set()
+        self._compiles = XLA_COMPILES.labels(program=program)
+        self._hit = XLA_COMPILE_CACHE.labels(program=program, result="hit")
+        self._miss = XLA_COMPILE_CACHE.labels(program=program, result="miss")
+        self._compile_h = XLA_COMPILE_SECONDS.labels(program=program)
+        self._dispatch_h = DEVICE_DISPATCH_SECONDS.labels(program=program)
+
+    def __call__(self, *args, rung: Optional[int] = None):
+        if not enabled():
+            return self.fn(*args)
+        sig = signature_of(args)
+        fresh = sig not in self.seen
+        start_us = time.time() * 1e6
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        dt = time.perf_counter() - t0
+        if fresh:
+            self.seen.add(sig)
+            self._compiles.inc()
+            self._miss.inc()
+            self._compile_h.observe(dt)
+            _record_compile(self.program, sig, rung, len(self.seen), dt,
+                            start_us)
+        else:
+            self._hit.inc()
+            self._dispatch_h.observe(dt)
+        return out
+
+
+def note_padding(program: str, rung: int, rows: int, shipped: int) -> None:
+    """Record the padding waste of one packed dispatch: `rows` real rows
+    shipped in a `shipped`-row buffer padded to `rung`. Gauge semantics
+    (last dispatch wins) per (program, rung): the steady-state waste of
+    each rung the pipeline actually hits, not a lifetime average — the
+    lifetime totals stay in MESH_STATS / rows_padded."""
+    if shipped <= 0 or not enabled():
+        return
+    key = (program, str(rung))
+    h = _PAD_HANDLES.get(key)
+    if h is None:
+        with _LOCK:
+            h = _PAD_HANDLES.setdefault(
+                key,
+                DEVICE_PADDING_WASTE.labels(program=program, rung=str(rung)),
+            )
+    h.set(round((shipped - rows) / shipped, 4))
+
+
+# -- lazy trace anchors -------------------------------------------------------
+
+
+class _NullAnchor:
+    __slots__ = ()
+
+    def close(self) -> None:
+        pass
+
+
+NULL_ANCHOR = _NullAnchor()
+
+
+class _Anchor:
+    """A deferred span: attaches a fresh trace context for the extent of
+    one batch (or watermark advance), but only materializes the span in
+    the recorder if a jax.compile span landed during the extent — so the
+    hot loop pays a contextvar set/reset per batch, not a recorded span
+    per batch (which would churn the ring buffer)."""
+
+    __slots__ = ("trace_id", "span_id", "name", "attrs", "start_us",
+                 "_tok", "_epoch0")
+
+    def __init__(self, trace_id: str, name: str, attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = trace.new_span_id()
+        self.name = name
+        self.attrs = attrs
+        self.start_us = time.time() * 1e6
+        self._epoch0 = _SPAN_EPOCH
+        self._tok = trace.attach(trace_id, self.span_id)
+
+    def close(self) -> None:
+        trace.detach(self._tok)
+        if _SPAN_EPOCH == self._epoch0:
+            return
+        import os
+
+        from . import recorder
+
+        recorder().record({
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": None,
+            "name": self.name,
+            "cat": "runner",
+            "ts": self.start_us,
+            "dur": time.time() * 1e6 - self.start_us,
+            "attrs": dict(self.attrs),
+            "events": [],
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        })
+
+
+def anchor(trace_id: str, name: str, **attrs):
+    """A lazy compile-trace anchor for the runner's batch/watermark hot
+    paths. Inert when telemetry is off or a real trace context is already
+    ambient (checkpoint captures: compiles parent there instead)."""
+    from . import enabled as obs_enabled
+
+    if not obs_enabled() or not enabled() or trace.current() is not None:
+        return NULL_ANCHOR
+    return _Anchor(trace_id, name, attrs)
+
+
+# -- summary ------------------------------------------------------------------
+
+
+def summary() -> dict:
+    """Structured device-telemetry summary for /debug/latency and
+    tools/trace_report.py: per-program compile/dispatch stats, padding
+    gauges, and the recompile-cause log."""
+    from ..metrics import REGISTRY, hist_quantiles
+
+    snap = REGISTRY.snapshot()
+
+    def by_program(name: str) -> Dict[str, Any]:
+        return {
+            labels.get("program", "?"): value
+            for labels, value in snap.get(name, [])
+        }
+
+    programs: Dict[str, dict] = {}
+    for prog, v in by_program("arroyo_xla_compiles_total").items():
+        programs.setdefault(prog, {})["compiles"] = int(v)
+    for prog, h in by_program("arroyo_xla_compile_seconds").items():
+        programs.setdefault(prog, {})["compile_s_total"] = round(
+            h.get("sum", 0.0), 4)
+    for prog, h in by_program("arroyo_device_dispatch_seconds").items():
+        p = programs.setdefault(prog, {})
+        p["dispatches"] = int(h.get("count", 0))
+        p["dispatch_quantiles"] = {
+            q: round(v, 6) for q, v in hist_quantiles(h).items()
+        }
+    for labels, v in snap.get("arroyo_xla_compile_cache_total", []):
+        p = programs.setdefault(labels.get("program", "?"), {})
+        p[f"cache_{labels.get('result', '?')}"] = int(v)
+    padding = [
+        {"program": labels.get("program"), "rung": labels.get("rung"),
+         "waste": v}
+        for labels, v in snap.get("arroyo_device_padding_waste", [])
+    ]
+    padding.sort(key=lambda e: (e["program"], int(e["rung"] or 0)))
+    return {
+        "programs": programs,
+        "padding_waste": padding,
+        "recompiles": recompile_log(),
+    }

@@ -112,6 +112,16 @@ class SourceOperator(Operator):
         batch = ctx.take_buffer()
         if batch is not None:
             await collector.collect(batch)
+        # latency markers stamp at flush cadence (throttled by
+        # obs.latency_marker_interval): they leave through the subtask's
+        # tail so they traverse real edges, not the in-chain fast path
+        marker = ctx.next_latency_marker()
+        if marker is not None and ctx._runner is not None:
+            from ..types import SignalMessage
+
+            await ctx._runner.tail.forward_marker(
+                SignalMessage.marker_of(marker)
+            )
 
     async def poll_async_iter(
         self, ait, ctx, collector, on_message, idle: float = 0.05

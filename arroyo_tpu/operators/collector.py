@@ -37,6 +37,7 @@ class EdgeSender:
         self.queues = queues
         self.src_subtask = src_subtask
         self._rr = src_subtask  # round-robin cursor for unkeyed shuffles
+        self._marker_rr = src_subtask  # separate cursor for latency markers
         self._is_forward = edge_type == EdgeType.FORWARD
 
     async def send_batch(self, batch: pa.RecordBatch):
@@ -60,6 +61,21 @@ class EdgeSender:
         else:
             for q in self.queues:
                 await q.send(signal)
+
+    async def send_marker(self, signal: SignalMessage):
+        """Forward a latency marker to exactly ONE destination (Flink's
+        latency-marker rule: broadcasting across every shuffle hop would
+        multiply markers combinatorially along the depth of the graph).
+        Rotates a dedicated cursor so all destination subtasks get
+        sampled over time — deliberately separate from the unkeyed-data
+        round-robin cursor, which must keep routing the exact same
+        batches to the exact same queues (chaos drills compare output
+        byte-identically with obs on and off)."""
+        if self._is_forward:
+            await self.queues[self.src_subtask % len(self.queues)].send(signal)
+            return
+        self._marker_rr = (self._marker_rr + 1) % len(self.queues)
+        await self.queues[self._marker_rr].send(signal)
 
 
 class Collector:
@@ -120,3 +136,15 @@ class Collector:
     async def broadcast(self, signal: SignalMessage):
         for edge in self.edges:
             await edge.broadcast(signal)
+
+    @property
+    def is_terminal(self) -> bool:
+        """No out edges: this subtask ends the pipeline (sink / preview
+        tail) — latency markers arriving here measure end-to-end."""
+        return not self.edges
+
+    async def forward_marker(self, signal: SignalMessage):
+        """Latency markers go to one destination per out edge (see
+        EdgeSender.send_marker)."""
+        for edge in self.edges:
+            await edge.send_marker(signal)

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, TYPE_CHECKING
 import pyarrow as pa
 
 from ..metrics import ERRORS
-from ..types import TaskInfo, Watermark, WatermarkKind
+from ..types import LatencyMarker, TaskInfo, Watermark, WatermarkKind
 from ..schema import StreamSchema
 
 if TYPE_CHECKING:
@@ -125,6 +125,14 @@ class SourceContext(OperatorContext):
         self._buffer: List[Dict[str, Any]] = []
         self._buffer_started: Optional[float] = None
         self._runner = None  # set by SubtaskRunner before run()
+        # latency-marker stamping cadence (obs.latency_marker_interval,
+        # captured at build time — contexts are constructed under the
+        # config scope the job runs with); 0 disables
+        from ..config import config
+
+        self._marker_interval = float(config().obs.latency_marker_interval)
+        self._marker_last: Optional[float] = None
+        self._marker_seq = 0
 
     async def check_control(self, collector):
         """Drain pending control messages (checkpoint barriers, stop); call
@@ -144,6 +152,22 @@ class SourceContext(OperatorContext):
         if len(self._buffer) >= self.batch_size:
             return True
         return (time.monotonic() - (self._buffer_started or 0)) >= self.linger
+
+    def next_latency_marker(self) -> Optional[LatencyMarker]:
+        """A fresh wall-clock-stamped marker when the configured stamping
+        interval elapsed (the first call always stamps, so even bounded
+        test pipelines ship at least one marker per source), else None."""
+        if self._marker_interval <= 0:
+            return None
+        now = time.monotonic()
+        if (self._marker_last is not None
+                and now - self._marker_last < self._marker_interval):
+            return None
+        self._marker_last = now
+        self._marker_seq += 1
+        return LatencyMarker(
+            self.task_info.task_id, self._marker_seq, time.time_ns()
+        )
 
     def take_buffer(self) -> Optional[pa.RecordBatch]:
         if not self._buffer:

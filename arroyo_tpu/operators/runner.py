@@ -33,6 +33,8 @@ from ..metrics import (
     BUSY_SECONDS,
     BYTES_RECV,
     CHECKPOINT_PHASE_SECONDS,
+    E2E_LATENCY_SECONDS,
+    LATENCY_MARKER_SECONDS,
     MESSAGES_RECV,
     WATERMARK_LAG_SECONDS,
 )
@@ -134,6 +136,12 @@ class SubtaskRunner:
         self._wm_lag = None  # registered lazily on the first watermark
         self._align_span = obs.NULL_SPAN
         self._align_started: Optional[float] = None
+        # device-tier observatory: latency-marker transit up to this
+        # subtask (and end-to-end when terminal), plus the trace id that
+        # batch/watermark-triggered jax.compile spans anchor under
+        self._marker_secs = LATENCY_MARKER_SECONDS.labels(job=jid, task=tid)
+        self._e2e_secs = E2E_LATENCY_SECONDS.labels(job=jid, task=tid)
+        self._compile_trace = obs.new_trace(jid, f"batch-{tid}")
 
     @property
     def is_source(self) -> bool:
@@ -413,8 +421,18 @@ class SubtaskRunner:
                     # or watermark-driven operators look idle to the
                     # autoscaler no matter how hard they work
                     t0 = time.perf_counter()
-                    await self._chain_watermark(0, changed)
+                    anchor = obs.device.anchor(
+                        self._compile_trace, "watermark.advance",
+                        task=self.task_info.task_id,
+                    )
+                    try:
+                        await self._chain_watermark(0, changed)
+                    finally:
+                        anchor.close()
                     self._busy_secs.inc(time.perf_counter() - t0)
+                return True
+            if item.kind == SignalKind.LATENCY_MARKER:
+                await self._handle_marker(item)
                 return True
             if item.kind == SignalKind.BARRIER:
                 return await self._handle_barrier(i, item.barrier)
@@ -431,13 +449,34 @@ class SubtaskRunner:
         self._msgs_recv.inc(item.num_rows)
         self._bytes_recv.inc(batch_bytes(item))
         t0 = time.perf_counter()
-        await self.ops[0].process_batch(
-            item, self.ctxs[0], self.collectors[0], iq.logical_input
+        anchor = obs.device.anchor(
+            self._compile_trace, "batch.process",
+            task=self.task_info.task_id,
         )
+        try:
+            await self.ops[0].process_batch(
+                item, self.ctxs[0], self.collectors[0], iq.logical_input
+            )
+        finally:
+            anchor.close()
         dt = time.perf_counter() - t0
         self._batch_seconds.observe(dt)
         self._busy_secs.inc(dt)
         return True
+
+    async def _handle_marker(self, item: SignalMessage):
+        """Latency marker (types.LatencyMarker): record transit since the
+        source stamp, then forward to one destination per out edge — or,
+        at a terminal subtask (sink), record end-to-end latency. Markers
+        never block alignment and never touch event time; a marker that
+        queued behind a blocked input simply carries the alignment delay
+        in its transit, which is exactly the latency a record would see."""
+        transit = max(0.0, (time.time_ns() - item.marker.stamp_ns) / 1e9)
+        self._marker_secs.observe(transit)
+        if self.tail.is_terminal:
+            self._e2e_secs.observe(transit)
+        else:
+            await self.tail.forward_marker(item)
 
     def _track_watermark_lag(self, wm: Watermark):
         """Per-subtask watermark-lag gauge: wall clock minus the effective

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import config
+from ..obs import device as obs_device
 
 # jax import deferred so host-only deployments can import the module tree
 from ._jax import get_jax as _get_jax
@@ -486,7 +487,10 @@ class Accumulator:
                 if op != "add":
                     vals[n:] = self._neutral(op, dt)
             inputs.append(jnp.asarray(vals))
-        self.state = self._update_fn(self.state, jnp.asarray(slots_p), *inputs)
+        obs_device.note_padding("agg.update", padded, n, padded)
+        self.state = self._update_fn(
+            self.state, jnp.asarray(slots_p), *inputs, rung=padded
+        )
 
     def _check_signed(self, signs: Optional[np.ndarray]):
         if signs is not None and (
@@ -584,7 +588,7 @@ class Accumulator:
                     out.append(s.at[slots].max(v))
             return out
 
-        return update
+        return obs_device.InstrumentedJit("agg.update", update)
 
     def _np_update(self, slots, cols, signs=None):
         for (op, dt, src, si), s in zip(self.phys, self.state):
@@ -629,7 +633,10 @@ class Accumulator:
         padded = _bucket(len(slots), self._buckets)
         slots_p = np.full(padded, self.capacity - 1, dtype=np.int64)
         slots_p[: len(slots)] = slots
-        outs = self._gather_fn(self.state, jnp.asarray(slots_p))
+        obs_device.note_padding("agg.gather", padded, len(slots), padded)
+        outs = self._gather_fn(
+            self.state, jnp.asarray(slots_p), rung=padded
+        )
         if not materialize:
             return [o[: len(slots)] for o in outs]
         return [np.asarray(o)[: len(slots)] for o in outs]
@@ -641,7 +648,7 @@ class Accumulator:
         def gather(state, slots):
             return [s[slots] for s in state]
 
-        return gather
+        return obs_device.InstrumentedJit("agg.gather", gather)
 
     def drop_host_state(self, slots: np.ndarray):
         """Forget host-side per-slot state (UDAF buffers / multisets) for
@@ -684,8 +691,10 @@ class Accumulator:
                     s.at[s_idx].set(nv) for s, nv in zip(state, neutrals)
                 ]
 
-            self._reset_fn = reset
-        self.state = self._reset_fn(self.state, jnp.asarray(slots_p))
+            self._reset_fn = obs_device.InstrumentedJit("agg.reset", reset)
+        self.state = self._reset_fn(
+            self.state, jnp.asarray(slots_p), rung=padded
+        )
 
     # -- finalize -----------------------------------------------------------
 

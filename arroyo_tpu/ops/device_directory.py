@@ -122,7 +122,13 @@ def _fns():
         out_s = out_s.at[pos].set(tab_slot, mode="drop")
         return out_h, out_s
 
-    _FNS.update(lookup=lookup, merge=merge, remove=remove)
+    from ..obs import device as obs_device
+
+    _FNS.update(
+        lookup=obs_device.InstrumentedJit("dir.lookup", lookup),
+        merge=obs_device.InstrumentedJit("dir.merge", merge),
+        remove=obs_device.InstrumentedJit("dir.remove", remove),
+    )
     return _FNS
 
 

@@ -94,11 +94,17 @@ def _build_fns():
                 valid = pos < offs[-1]
                 return li_c, ri, valid
 
-            fn = jax.jit(impl)
-            phase2_cache[size] = fn
-        return fn(order, lo, offs)
+            from ..obs import device as obs_device
 
-    _fns = (phase1, phase2_at)
+            fn = obs_device.InstrumentedJit(
+                "join.phase2", jax.jit(impl)
+            )
+            phase2_cache[size] = fn
+        return fn(order, lo, offs, rung=size)
+
+    from ..obs import device as obs_device
+
+    _fns = (obs_device.InstrumentedJit("join.phase1", phase1), phase2_at)
     return _fns
 
 
@@ -129,10 +135,14 @@ def probe(
         e = np.empty(0, dtype=np.int64)
         return e, e
     phase1, phase2_at = _build_fns()
-    l_mat = _pad_matrix(lcols, _bucket(n_l))
-    r_mat = _pad_matrix(rcols, _bucket(n_r))
+    from ..obs import device as obs_device
+
+    lb, rb = _bucket(n_l), _bucket(n_r)
+    obs_device.note_padding("join.phase1", rb, n_l + n_r, lb + rb)
+    l_mat = _pad_matrix(lcols, lb)
+    r_mat = _pad_matrix(rcols, rb)
     order, lo, offs = phase1(
-        l_mat, r_mat, np.int64(n_l), np.int64(n_r)
+        l_mat, r_mat, np.int64(n_l), np.int64(n_r), rung=rb
     )
     total = int(offs[-1])
     if total == 0:

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import device as obs_device
 from ..ops.aggregates import (
     Accumulator,
     AggSpec,
@@ -950,7 +951,8 @@ class ShardedAccumulator(Accumulator):
                 pm = pos[in_chunk] - c * r_cap
                 r_c = _bucket(int(pm.max()) + 1, self._r_buckets_direct)
                 flat = so[in_chunk] * r_c + pm
-                self._note_traffic(len(rows), S * r_c)
+                self._note_traffic(len(rows), S * r_c,
+                                   "mesh.step_direct", r_c)
                 self._dispatch(self._direct_step, (S, r_c), rows, flat,
                                locals_, vals, signs)
             return
@@ -971,15 +973,19 @@ class ShardedAccumulator(Accumulator):
             cm = cell[in_chunk] - c * R
             r_c = _bucket(int(cm.max()) + 1, self._r_buckets)
             flat = (srcs[in_chunk] * S + so[in_chunk]) * r_c + cm
-            self._note_traffic(len(rows), S * S * r_c)
+            self._note_traffic(len(rows), S * S * r_c, "mesh.step", r_c)
             self._dispatch(self._step, (S, S, r_c), rows, flat, locals_,
                            vals, signs)
 
-    def _note_traffic(self, sent: int, shipped: int):
+    def _note_traffic(self, sent: int, shipped: int,
+                      program: str = "mesh.step", rung: int = 0):
         self.rows_sent += sent
         self.rows_padded += shipped - sent
         MESH_STATS["rows_sent"] += sent
         MESH_STATS["rows_padded"] += shipped - sent
+        # per-(program, rung) waste gauge: which packing rungs the
+        # exchange actually hits and how much filler each ships
+        obs_device.note_padding(program, rung, sent, shipped)
 
     def _dispatch(self, step, shape, rows, flat, locals_, vals, signs):
         """Pack (slots, valid, per-source values) buffers of `shape` and
@@ -1013,6 +1019,7 @@ class ShardedAccumulator(Accumulator):
             self._to_dev(slots_l.reshape(shape), True),
             self._to_dev(valid.reshape(shape), True),
             *inputs,
+            rung=shape[-1],
         )
 
     def _make_step(self):
@@ -1058,7 +1065,7 @@ class ShardedAccumulator(Accumulator):
             )
             return list(f(tuple(state), slots, valid, *vals))
 
-        return step
+        return obs_device.InstrumentedJit("mesh.step", step)
 
     def _make_direct_step(self):
         """Step for host-fed dst-major [S, R] batches: rows were routed to
@@ -1099,7 +1106,7 @@ class ShardedAccumulator(Accumulator):
             )
             return list(f(tuple(state), slots, valid, *vals))
 
-        return step
+        return obs_device.InstrumentedJit("mesh.step_direct", step)
 
     # -- drain --------------------------------------------------------------
 
@@ -1153,15 +1160,19 @@ class ShardedAccumulator(Accumulator):
                 )
             else:
                 gather_fn = jax.jit(gather_fn)
-            self._mesh_gather_fn = gather_fn
+            self._mesh_gather_fn = obs_device.InstrumentedJit(
+                "mesh.gather", gather_fn
+            )
         sh, loc = self._decompose(np.asarray(slots))
         padded = _bucket(len(slots), self._buckets)
         sh_p = np.zeros(padded, dtype=np.int64)
         loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
         sh_p[: len(slots)] = sh
         loc_p[: len(slots)] = loc
+        obs_device.note_padding("mesh.gather", padded, len(slots), padded)
         outs = self._mesh_gather_fn(
-            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
+            self.state, self._to_dev(sh_p, False),
+            self._to_dev(loc_p, False), rung=padded,
         )
         if not materialize:
             if self._multiproc:
@@ -1218,14 +1229,17 @@ class ShardedAccumulator(Accumulator):
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            self._mesh_take_fn = jax.jit(
-                take_fn,
-                donate_argnums=_donate_state(),
-                # outs replicated (each process reads its local copy),
-                # state stays row-sharded
-                out_shardings=(
-                    [NamedSharding(self.mesh, P())] * len(self.phys),
-                    [self._sharding] * len(self.phys),
+            self._mesh_take_fn = obs_device.InstrumentedJit(
+                "mesh.take",
+                jax.jit(
+                    take_fn,
+                    donate_argnums=_donate_state(),
+                    # outs replicated (each process reads its local
+                    # copy), state stays row-sharded
+                    out_shardings=(
+                        [NamedSharding(self.mesh, P())] * len(self.phys),
+                        [self._sharding] * len(self.phys),
+                    ),
                 ),
             )
         sh, loc = self._decompose(np.asarray(slots))
@@ -1234,8 +1248,10 @@ class ShardedAccumulator(Accumulator):
         loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
         sh_p[: len(slots)] = sh
         loc_p[: len(slots)] = loc
+        obs_device.note_padding("mesh.take", padded, len(slots), padded)
         outs, self.state = self._mesh_take_fn(
-            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
+            self.state, self._to_dev(sh_p, False),
+            self._to_dev(loc_p, False), rung=padded,
         )
         if not materialize:
             if self._multiproc:
@@ -1268,7 +1284,9 @@ class ShardedAccumulator(Accumulator):
                     for s, (op, dt, _, _) in zip(state, phys)
                 ]
 
-            self._mesh_reset_fn = reset_fn
+            self._mesh_reset_fn = obs_device.InstrumentedJit(
+                "mesh.reset", reset_fn
+            )
         sh, loc = self._decompose(np.asarray(slots))
         padded = _bucket(len(slots), self._buckets)
         sh_p = np.zeros(padded, dtype=np.int64)
@@ -1276,7 +1294,8 @@ class ShardedAccumulator(Accumulator):
         sh_p[: len(slots)] = sh
         loc_p[: len(slots)] = loc
         self.state = self._mesh_reset_fn(
-            self.state, self._to_dev(sh_p, False), self._to_dev(loc_p, False)
+            self.state, self._to_dev(sh_p, False),
+            self._to_dev(loc_p, False), rung=padded,
         )
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
@@ -1306,7 +1325,9 @@ class ShardedAccumulator(Accumulator):
                     s.at[sh, loc].set(v) for s, v in zip(state, vals)
                 ]
 
-            self._mesh_restore_fn = restore_fn
+            self._mesh_restore_fn = obs_device.InstrumentedJit(
+                "mesh.restore", restore_fn
+            )
         sh, loc = self._decompose(np.asarray(slots))
         # bucket-pad like gather/reset so restore chunk sizes don't each
         # specialize the jitted scatter; padding rows write the neutral
@@ -1327,4 +1348,5 @@ class ShardedAccumulator(Accumulator):
             self._to_dev(sh_p, False),
             self._to_dev(loc_p, False),
             *[self._to_dev(v, False) for v in vals_p],
+            rung=padded,
         )

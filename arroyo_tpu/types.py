@@ -130,6 +130,21 @@ class SignalKind(enum.Enum):
     WATERMARK = "watermark"
     STOP = "stop"
     END_OF_DATA = "end_of_data"
+    LATENCY_MARKER = "latency_marker"
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyMarker:
+    """Flink-style latency marker (flink FLIP-27 LatencyMarker): sources
+    stamp one periodically with their wall clock; it flows through queues
+    and the TCP exchange like a watermark but never blocks barrier
+    alignment and never touches event time. Every operator (and the sink)
+    records `now - stamp_ns` into its latency histogram, so the marker's
+    transit time IS the end-to-end record latency up to that operator."""
+
+    source_task: str  # task_id of the stamping source subtask
+    seq: int
+    stamp_ns: int  # wall-clock nanos at the stamping source
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +155,7 @@ class SignalMessage:
     kind: SignalKind
     watermark: Optional[Watermark] = None
     barrier: Optional[CheckpointBarrier] = None
+    marker: Optional[LatencyMarker] = None
 
     @staticmethod
     def barrier_of(b: CheckpointBarrier) -> "SignalMessage":
@@ -148,6 +164,10 @@ class SignalMessage:
     @staticmethod
     def watermark_of(w: Watermark) -> "SignalMessage":
         return SignalMessage(SignalKind.WATERMARK, watermark=w)
+
+    @staticmethod
+    def marker_of(m: LatencyMarker) -> "SignalMessage":
+        return SignalMessage(SignalKind.LATENCY_MARKER, marker=m)
 
     @staticmethod
     def stop() -> "SignalMessage":

@@ -136,6 +136,17 @@ def build_admin_app(role: str, details_fn=None,
             rec.clear()
         return web.json_response(body)
 
+    async def debug_latency(request: web.Request):
+        """Device-tier observatory dump: this process's latency-marker
+        quantiles (per-operator + end-to-end) and XLA compile/dispatch
+        telemetry, including the recompile-cause log. ?job=<id> narrows
+        to one job's subtasks."""
+        from .. import obs
+
+        return web.json_response(
+            obs.latency_report(request.query.get("job"))
+        )
+
     app = web.Application()
     app.router.add_get("/status", status)
     app.router.add_get("/name", name)
@@ -144,6 +155,7 @@ def build_admin_app(role: str, details_fn=None,
     app.router.add_get("/debug/stacks", debug_stacks)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_get("/debug/latency", debug_latency)
     for path, handler in (extra_routes or {}).items():
         app.router.add_get(path, handler)
     return app

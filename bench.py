@@ -202,6 +202,15 @@ def child(events: int, backend: str, query: str = "q5",
               f"{MESH_STATS['updates']} "
               f"{MESH_STATS['flushes_elided']} "
               f"{MESH_STATS['rows_combined']}", flush=True)
+    # device-tier observatory: in-process XLA compile count + wall time,
+    # so the parent can report compile cost separately from steady-state
+    # throughput (a numpy child legitimately reports 0 0)
+    from arroyo_tpu.obs import device as obs_device
+
+    progs = obs_device.summary()["programs"]
+    print(f"COMPILES {sum(p.get('compiles', 0) for p in progs.values())} "
+          f"{sum(p.get('compile_s_total', 0.0) for p in progs.values()):.3f}",
+          flush=True)
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
@@ -384,16 +393,32 @@ def run_median(events: int, backend: str, timeout: float, env=None,
     shouldn't define the round's headline; every raw run value is still
     published in eps_runs). Returns the median run's dict with eps_runs
     (sorted, all runs) and eps_spread_pct added; None if every run
-    failed."""
+    failed.
+
+    An explicit WARMUP run precedes the measured runs and is excluded
+    from eps_runs/median: the first child pays XLA compiles (persistent
+    cache cold), import costs and OS cache warming — BENCH_r05 measured
+    a 21.4% value_spread_pct with q7's first run at 373k vs 611k steady,
+    pure warmup pollution. The warmup's throughput and its in-process
+    compile seconds are reported separately (warmup_eps / compile_s) so
+    the compile cost stays visible instead of polluting the spread."""
 
     def shot():
         return run_child(events, backend, timeout, env=env, query=query,
                          mesh_devices=mesh_devices,
                          force_device_join=force_device_join)
 
+    warmup = shot() if n > 1 else None
     runs = [r for r in (shot() for _ in range(max(1, n))) if r is not None]
     if not runs:
-        return None
+        if warmup is None:
+            return None
+        # every steady run failed but the warmup succeeded: report it
+        # (marked) rather than voiding the metric
+        warmup["eps_runs"] = [round(warmup["eps"], 1)]
+        warmup["eps_spread_pct"] = 0.0
+        warmup["warmup_only"] = True
+        return warmup
 
     def window(rs):
         # tightest contiguous window of up to n sorted runs; lower
@@ -421,6 +446,14 @@ def run_median(events: int, backend: str, timeout: float, env=None,
             med, spread = window(runs)
     med["eps_runs"] = [round(r["eps"], 1) for r in runs]
     med["eps_spread_pct"] = round(spread, 1)
+    if warmup is not None:
+        med["warmup_eps"] = round(warmup["eps"], 1)
+        # compile cost of the cold path (the warmup child's in-process
+        # XLA compile seconds); steady children re-trace against the
+        # warmed persistent cache
+        if "compile_s" in warmup:
+            med["compile_s"] = warmup["compile_s"]
+            med["compiles"] = warmup.get("compiles", 0)
     return med
 
 
@@ -441,6 +474,7 @@ def run_child(events: int, backend: str, timeout: float, env=None,
         return None
     result = None
     stats = None
+    compiles = None
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):
             parts = line.split()
@@ -449,6 +483,9 @@ def run_child(events: int, backend: str, timeout: float, env=None,
         elif line.startswith("MESHSTATS "):
             parts = line.split()
             stats = tuple(int(p) for p in parts[1:])
+        elif line.startswith("COMPILES "):
+            parts = line.split()
+            compiles = (int(parts[1]), float(parts[2]))
     if result is None:
         sys.stderr.write(out.stderr[-2000:] + "\n")
         return None
@@ -460,6 +497,8 @@ def run_child(events: int, backend: str, timeout: float, env=None,
             result["flushes_elided"] = stats[4]
         if len(stats) >= 6:
             result["rows_combined"] = stats[5]
+    if compiles is not None:
+        result["compiles"], result["compile_s"] = compiles
     return result
 
 
@@ -615,6 +654,10 @@ def main():
         sides[f"{q}_eps"] = round(r["eps"], 1) if r is not None else 0
         if r is not None and "eps_runs" in r:
             sides[f"{q}_eps_runs"] = r["eps_runs"]
+        if r is not None and "warmup_eps" in r:
+            sides[f"{q}_warmup_eps"] = r["warmup_eps"]
+        if r is not None and "compile_s" in r:
+            sides[f"{q}_compile_s"] = r["compile_s"]
     # mesh execution path: q5 on an N-virtual-device CPU mesh (the
     # all_to_all + ShardedAccumulator path the dryrun only
     # correctness-checks). FULL headline event count: the mesh number
@@ -653,6 +696,10 @@ def main():
         sides["mesh_backend"] = "cpu-virtual"
         if r is not None and "eps_runs" in r:
             sides[f"q5_mesh{args.mesh}_eps_runs"] = r["eps_runs"]
+        if r is not None and "warmup_eps" in r:
+            sides[f"q5_mesh{args.mesh}_warmup_eps"] = r["warmup_eps"]
+        if r is not None and "compile_s" in r:
+            sides[f"q5_mesh{args.mesh}_compile_s"] = r["compile_s"]
         if r is not None and "rows_sent" in r:
             shipped = r["rows_sent"] + r["rows_padded"]
             sides["mesh_rows_sent"] = r["rows_sent"]
@@ -738,6 +785,12 @@ def main():
         **({"value_runs": device.get("eps_runs"),
             "value_spread_pct": device.get("eps_spread_pct")}
            if isinstance(device, dict) and "eps_runs" in device else {}),
+        # warmup/compile separation (ISSUE 6): the warmup run is excluded
+        # from *_runs so spread reflects steady state only
+        **({"value_warmup_eps": device["warmup_eps"]}
+           if isinstance(device, dict) and "warmup_eps" in device else {}),
+        **({"value_compile_s": device["compile_s"]}
+           if isinstance(device, dict) and "compile_s" in device else {}),
         "events": events,
         "result_rows": device["rows"],
         # host contention state the measurements ran under (calibration

@@ -1,0 +1,521 @@
+"""Device-tier observatory (ISSUE 6): XLA compile/dispatch telemetry,
+end-to-end latency markers, Prometheus exposition conformance, and the
+noise-aware bench regression gate."""
+
+import asyncio
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from arroyo_tpu import obs
+from arroyo_tpu.config import update
+from arroyo_tpu.metrics import (
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Registry,
+    hist_quantiles,
+)
+from arroyo_tpu.obs import device as obs_device
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    obs.reset()
+    obs_device.reset()
+    yield
+    obs.reset()
+    obs_device.reset()
+
+
+# -- Prometheus text-exposition conformance (satellite) ----------------------
+
+
+def test_exposition_bucket_ordering_and_inf():
+    """Histogram exposition: _bucket lines in ascending le order with
+    non-decreasing cumulative counts, the +Inf bucket equal to _count,
+    then _sum and _count — the shape Prometheus's text parser requires."""
+    reg = Registry()
+    h = reg.histogram("conf_seconds", "t", buckets=(0.1, 0.5, 1.0, 5.0))
+    hd = h.labels(task="0-0")
+    for v in (0.05, 0.3, 0.7, 2.0, 9.0):
+        hd.observe(v)
+    lines = reg.expose().splitlines()
+    bucket_lines = [l for l in lines if l.startswith("conf_seconds_bucket")]
+    les, counts = [], []
+    for l in bucket_lines:
+        le = l.split('le="')[1].split('"')[0]
+        les.append(float("inf") if le == "+Inf" else float(le))
+        counts.append(float(l.rsplit(" ", 1)[1]))
+    assert les == sorted(les) and les[-1] == float("inf")
+    assert counts == sorted(counts), "cumulative counts must not decrease"
+    assert counts[-1] == 5.0  # +Inf == observation count
+    sum_idx = next(i for i, l in enumerate(lines)
+                   if l.startswith("conf_seconds_sum"))
+    count_idx = next(i for i, l in enumerate(lines)
+                     if l.startswith("conf_seconds_count"))
+    last_bucket_idx = max(i for i, l in enumerate(lines)
+                          if l.startswith("conf_seconds_bucket"))
+    assert last_bucket_idx < sum_idx < count_idx
+    assert lines[count_idx].endswith(" 5")
+
+
+def test_exposition_label_escaping():
+    reg = Registry()
+    g = reg.gauge("esc", "t")
+    g.labels(path='a"b\\c\nend').set(1.0)
+    text = reg.expose()
+    assert 'path="a\\"b\\\\c\\nend"' in text
+    # the raw control characters must not leak into the exposition
+    assert not any('a"b' in l and "\n" not in repr(l)
+                   for l in text.splitlines() if "esc{" in l)
+
+
+def test_counter_monotonic_and_reset_semantics():
+    """Counters only move up between resets; Registry.reset() behaves
+    like a process restart (values restart from 0 through the SAME
+    handles — Prometheus consumers treat a counter drop as a restart)."""
+    reg = Registry()
+    c = reg.counter("mono_total", "t")
+    hd = c.labels(task="0-0")
+    seen = []
+    for _ in range(5):
+        hd.inc(2)
+        seen.append(hd.get())
+    assert seen == sorted(seen)
+    reg.reset()
+    assert hd.get() == 0.0
+    hd.inc()
+    assert "mono_total" in reg.expose()
+    assert hd.get() == 1.0
+
+
+# -- InstrumentedJit: compile vs dispatch classification ---------------------
+
+
+def test_instrumented_jit_classifies_and_logs_recompiles():
+    calls = []
+    fn = obs_device.InstrumentedJit("test.prog", lambda *a: calls.append(a))
+    a4, a8 = np.zeros(4), np.zeros(8)
+    fn(a4, rung=4)      # compile 1 (first shape signature)
+    fn(a4, rung=4)      # dispatch (cache hit)
+    fn(a8, rung=8)      # compile 2 (shape change)
+    fn(a8, rung=8)      # dispatch
+    log = obs_device.recompile_log()
+    assert [e["cause"] for e in log] == ["first-compile", "shape-change"]
+    assert log[0]["rung"] == 4 and log[1]["rung"] == 8
+    assert "float64[8]" in log[1]["signature"]
+    assert log[1]["program"] == "test.prog"
+    s = obs_device.summary()["programs"]["test.prog"]
+    assert s["compiles"] == 2
+    assert s["cache_miss"] == 2 and s["cache_hit"] == 2
+    assert s["dispatches"] == 2
+    assert len(calls) == 4
+
+
+def test_instrumented_jit_disabled_is_passthrough():
+    with update(obs={"device_telemetry": False}):
+        fn = obs_device.InstrumentedJit("off.prog", lambda x: x + 1)
+        assert fn(1) == 2
+    assert obs_device.recompile_log() == []
+    assert "off.prog" not in obs_device.summary()["programs"]
+
+
+def test_compile_span_parents_into_ambient_trace():
+    fn = obs_device.InstrumentedJit("span.prog", lambda x: x)
+    with obs.span("checkpoint.capture", trace="j/ck-1", cat="runner") as sp:
+        fn(np.zeros(3))
+    spans = obs.recorder().snapshot(trace_id="j/ck-1")
+    names = {s["name"]: s for s in spans}
+    assert "jax.compile:span.prog" in names
+    compile_span = names["jax.compile:span.prog"]
+    assert compile_span["parent_id"] == names["checkpoint.capture"]["span_id"]
+    assert compile_span["attrs"]["cause"] == "first-compile"
+
+
+def test_batch_anchor_materializes_only_on_compile():
+    # no compile during the extent -> no spans recorded at all
+    a = obs_device.anchor("j/batch-1-0", "batch.process", task="1-0")
+    a.close()
+    assert len(obs.recorder()) == 0
+    # a compile during the extent -> anchor + jax.compile child, linked
+    fn = obs_device.InstrumentedJit("anchor.prog", lambda x: x)
+    a = obs_device.anchor("j/batch-1-0", "batch.process", task="1-0")
+    try:
+        fn(np.zeros(2))
+    finally:
+        a.close()
+    spans = obs.recorder().snapshot(trace_id="j/batch-1-0")
+    names = {s["name"]: s for s in spans}
+    assert set(names) == {"batch.process", "jax.compile:anchor.prog"}
+    assert (names["jax.compile:anchor.prog"]["parent_id"]
+            == names["batch.process"]["span_id"])
+
+
+def test_padding_waste_gauge_per_rung():
+    obs_device.note_padding("mesh.step", 128, 96, 512)
+    obs_device.note_padding("mesh.step", 256, 250, 1024)
+    text = REGISTRY.expose()
+    assert ('arroyo_device_padding_waste{program="mesh.step",rung="128"} '
+            '0.8125') in text
+    waste = obs_device.summary()["padding_waste"]
+    assert {w["rung"] for w in waste if w["program"] == "mesh.step"} == {
+        "128", "256"}
+
+
+# -- forced shape change on a real jax accumulator ---------------------------
+
+
+def test_forced_shape_change_names_signature_and_rung():
+    """The acceptance probe: growing a batch past the current packing
+    rung forces a recompile whose cause record names the new shape
+    signature and the rung that produced it."""
+    from arroyo_tpu.ops.aggregates import AggSpec, Accumulator
+
+    # the compile/dispatch counters are process-global (other tests in
+    # the session may already have driven agg.update): assert deltas
+    before = obs_device.summary()["programs"].get(
+        "agg.update", {"compiles": 0, "dispatches": 0})
+    with update(tpu={"shape_buckets": (64, 256)}):
+        acc = Accumulator(
+            [AggSpec("count", None, "c")], capacity=1024, backend="jax"
+        )
+        acc.update(np.arange(8, dtype=np.int64), {})     # rung 64: compile
+        acc.update(np.arange(16, dtype=np.int64), {})    # rung 64: dispatch
+        acc.update(np.arange(100, dtype=np.int64), {})   # rung 256: recompile
+    recs = [e for e in obs_device.recompile_log()
+            if e["program"] == "agg.update"]
+    assert [e["cause"] for e in recs] == ["first-compile", "shape-change"]
+    assert recs[0]["rung"] == 64 and recs[1]["rung"] == 256
+    assert "[256]" in recs[1]["signature"]
+    stats = obs_device.summary()["programs"]["agg.update"]
+    assert stats["compiles"] - before.get("compiles", 0) == 2
+    assert stats["dispatches"] - before.get("dispatches", 0) == 1
+
+
+# -- latency markers ----------------------------------------------------------
+
+
+def test_marker_signal_wire_round_trip():
+    from arroyo_tpu.engine.network import decode_signal, encode_signal
+    from arroyo_tpu.types import LatencyMarker, SignalMessage
+
+    sig = SignalMessage.marker_of(LatencyMarker("2-1", 7, 123456789))
+    assert decode_signal(encode_signal(sig)) == sig
+
+
+def test_marker_interval_throttles_stamps():
+    from arroyo_tpu.operators.context import SourceContext
+    from arroyo_tpu.operators.context import WatermarkHolder
+    from arroyo_tpu.types import TaskInfo
+
+    with update(obs={"latency_marker_interval": 3600.0}):
+        ctx = SourceContext(
+            TaskInfo("j", 0, "src", 0, 1), [], None, WatermarkHolder(0)
+        )
+        assert ctx.next_latency_marker() is not None  # first always stamps
+        assert ctx.next_latency_marker() is None      # throttled
+    with update(obs={"latency_marker_interval": 0}):
+        ctx = SourceContext(
+            TaskInfo("j", 0, "src", 0, 1), [], None, WatermarkHolder(0)
+        )
+        assert ctx.next_latency_marker() is None      # disabled
+
+
+def _job_series(name, job_id):
+    return {
+        labels["task"]: h
+        for labels, h in REGISTRY.snapshot().get(name, [])
+        if labels.get("job") == job_id
+    }
+
+
+# -- the embedded-cluster q5 acceptance test ---------------------------------
+
+
+Q5_CLUSTER = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '200000',
+  message_count = '60000', start_time = '0'
+);
+CREATE TABLE top_auctions (auction BIGINT, num BIGINT) WITH (
+  connector = 'single_file', path = '{out}', format = 'json', type = 'sink'
+);
+INSERT INTO top_auctions
+SELECT AuctionBids.auction, AuctionBids.num
+FROM (
+  SELECT bid.auction as auction, count(*) AS num,
+         hop(interval '2 second', interval '10 second') as window
+  FROM nexmark WHERE bid IS NOT NULL
+  GROUP BY 1, window
+) AS AuctionBids
+JOIN (
+  SELECT max(CountBids.num) AS maxn, CountBids.window
+  FROM (
+    SELECT bid.auction as auction, count(*) AS num,
+           hop(interval '2 second', interval '10 second') as window
+    FROM nexmark WHERE bid IS NOT NULL
+    GROUP BY 1, window
+  ) AS CountBids
+  GROUP BY CountBids.window
+) AS MaxBids
+ON AuctionBids.window = MaxBids.window
+   AND AuctionBids.num >= MaxBids.maxn;
+"""
+
+
+def test_q5_cluster_markers_and_compile_spans(tmp_path):
+    """ISSUE 6 acceptance: q5 on the embedded cluster (2 workers, real
+    gRPC + TCP exchange) with the window aggregates on the jax backend.
+    Latency markers traverse source -> shuffle -> window -> join -> sink
+    with a nonzero end-to-end p99 at the sink; at least one
+    `jax.compile:<program>` span is parented inside a batch/checkpoint
+    trace; the job still produces q5 output rows."""
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    REGISTRY.reset()
+    out = tmp_path / "out.json"
+
+    async def go():
+        c = await ControllerServer(EmbeddedScheduler()).start()
+        with update(
+            pipeline={"checkpointing": {"interval": 0.2}},
+            obs={"latency_marker_interval": 0.05},
+            # engage the device (jax-CPU) window tier so q5's aggregate
+            # programs compile inside the run
+            tpu={"require_accelerator": False,
+                 "shape_buckets": (1024, 8192)},
+        ):
+            await c.submit_job(
+                "dobs1", sql=Q5_CLUSTER.format(out=out),
+                storage_url=str(tmp_path / "ck"), n_workers=2,
+                parallelism=2,
+            )
+            state = await c.wait_for_state(
+                "dobs1", JobState.FINISHED, JobState.FAILED, timeout=120
+            )
+        await c.stop()
+        return state
+
+    state = asyncio.run(go())
+    assert state == JobState.FINISHED
+
+    # canonical output still produced (markers never become rows)
+    rows = [json.loads(l) for l in open(out) if l.strip()]
+    assert rows and all("auction" in r for r in rows)
+
+    # (1) markers traversed the graph: transit recorded at intermediate
+    # operators AND end-to-end at the sink with nonzero p99
+    per_op = _job_series("arroyo_worker_latency_marker_seconds", "dobs1")
+    e2e = _job_series("arroyo_worker_e2e_latency_seconds", "dobs1")
+    assert len(per_op) >= 2, f"markers seen at {sorted(per_op)}"
+    assert e2e, "no end-to-end latency recorded at any sink subtask"
+    sink_hist = next(iter(e2e.values()))
+    assert sink_hist["count"] >= 1
+    assert hist_quantiles(sink_hist)["p99"] > 0.0
+    # the sink's transit must ride through the shuffle/window tier, so
+    # some NON-sink subtask saw the marker too
+    assert set(per_op) - set(e2e), "markers skipped intermediate operators"
+
+    # (2) at least one jax.compile span inside a batch/checkpoint trace
+    spans = obs.recorder().snapshot(trace_prefix="dobs1/")
+    compiles = [s for s in spans if s["name"].startswith("jax.compile:")]
+    assert compiles, "no jax.compile spans recorded in job traces"
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], {})[s["span_id"]] = s
+    parented = [
+        s for s in compiles
+        if s["parent_id"] in by_trace.get(s["trace_id"], {})
+    ]
+    assert parented, "compile spans not parented into their traces"
+    anchors = {
+        by_trace[s["trace_id"]][s["parent_id"]]["name"] for s in parented
+    }
+    assert anchors & {"batch.process", "watermark.advance",
+                      "checkpoint.capture"}, anchors
+
+    # (3) the recompile log names program + signature + rung for the
+    # compiles the run actually paid
+    log = obs_device.recompile_log()
+    assert any(e["program"].startswith("agg.") and e["rung"]
+               and "[" in e["signature"] for e in log)
+
+
+# -- surfaces -----------------------------------------------------------------
+
+
+def test_latency_report_and_debug_route():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from arroyo_tpu.metrics import E2E_LATENCY_SECONDS, LATENCY_MARKER_SECONDS
+    from arroyo_tpu.utils.admin import build_admin_app
+
+    REGISTRY.reset()
+    LATENCY_MARKER_SECONDS.labels(job="lr", task="1-0").observe(0.01)
+    E2E_LATENCY_SECONDS.labels(job="lr", task="2-0").observe(0.02)
+    obs_device.note_padding("agg.update", 256, 200, 256)
+
+    report = obs.latency_report("lr")
+    assert report["operators"][0]["task"] == "1-0"
+    assert report["end_to_end"][0]["p99_ms"] > 0
+    assert report["device"]["padding_waste"]
+
+    async def go():
+        app = build_admin_app("test")
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get("/debug/latency", params={"job": "lr"})
+            assert resp.status == 200
+            return await resp.json()
+
+    doc = asyncio.run(go())
+    assert doc["operators"] and doc["end_to_end"]
+    assert "recompiles" in doc["device"]
+
+
+def test_rest_job_latency_endpoint(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.metrics import E2E_LATENCY_SECONDS
+
+    REGISTRY.reset()
+    E2E_LATENCY_SECONDS.labels(job="restlat", task="9-0").observe(0.5)
+
+    async def go():
+        app = build_app(db_path=str(tmp_path / "api.db"))
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get("/api/v1/jobs/restlat/latency")
+            assert resp.status == 200
+            doc = await resp.json()
+            other = await (
+                await client.get("/api/v1/jobs/other/latency")
+            ).json()
+            return doc, other
+
+    doc, other = asyncio.run(go())
+    assert doc["end_to_end"][0]["task"] == "9-0"
+    assert other["end_to_end"] == []  # job-scoped
+
+
+def test_openapi_lists_latency_route():
+    from arroyo_tpu.api.openapi import build_spec
+
+    spec = build_spec()
+    assert "/api/v1/jobs/{job_id}/latency" in spec["paths"]
+    assert "LatencyReport" in spec["components"]["schemas"]
+
+
+def test_trace_report_latency_summary(capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import trace_report
+    finally:
+        sys.path.remove(TOOLS)
+    from arroyo_tpu.metrics import E2E_LATENCY_SECONDS
+
+    REGISTRY.reset()
+    E2E_LATENCY_SECONDS.labels(job="tr", task="3-0").observe(0.1)
+    obs_device.note_padding("mesh.step", 64, 32, 128)
+    trace_report.latency_summary(obs.latency_report())
+    out = capsys.readouterr().out
+    assert "end-to-end latency" in out
+    assert "tr/3-0" in out
+    assert "mesh.step rung=64" in out
+
+
+# -- the noise-aware bench regression gate -----------------------------------
+
+
+def _bench_compare():
+    sys.path.insert(0, TOOLS)
+    try:
+        import bench_compare
+    finally:
+        sys.path.remove(TOOLS)
+    return bench_compare
+
+
+def test_gate_flags_2x_regression_and_ignores_wobble():
+    bc = _bench_compare()
+    baseline = {
+        "value": 100_000.0, "value_runs": [96_000.0, 100_000.0, 104_000.0],
+        "q1_eps": 50_000.0, "q1_eps_runs": [48_000.0, 50_000.0, 52_000.0],
+        "q5_p99_ms": 1000.0,
+        "contended": False,
+    }
+    # in-spread wobble: every metric moves but within allowed deltas
+    wobble = {"value": 92_000.0, "q1_eps": 47_000.0, "q5_p99_ms": 1150.0,
+              "contended": False}
+    doc = bc.compare(baseline, wobble)
+    assert doc["status"] == "ok", doc
+    # injected 2x steady-state regression on the headline
+    bad = dict(wobble, value=50_000.0)
+    doc = bc.compare(baseline, bad)
+    assert doc["status"] == "regression"
+    assert doc["regressions"] == ["value"]
+    assert doc["metrics"]["value"]["status"] == "regression"
+    # latency regressions gate in the OTHER direction
+    slow = dict(wobble, q5_p99_ms=3000.0)
+    doc = bc.compare(baseline, slow)
+    assert "q5_p99_ms" in doc["regressions"]
+    # an improvement is never a regression
+    fast = dict(wobble, value=220_000.0)
+    assert bc.compare(baseline, fast)["status"] == "ok"
+    assert bc.compare(baseline, fast)["metrics"]["value"]["status"] == (
+        "improved")
+
+
+def test_gate_measured_spread_widens_threshold():
+    bc = _bench_compare()
+    # 30% measured spread: a 25% drop must NOT gate (inside noise),
+    # where the default 10% floor alone would have flagged it
+    noisy = {"value": 100_000.0,
+             "value_runs": [85_000.0, 100_000.0, 115_000.0],
+             "contended": False}
+    doc = bc.compare(noisy, {"value": 75_000.0, "contended": False})
+    assert doc["status"] == "ok"
+    # a quiet baseline DOES gate the same 25% drop
+    quiet = {"value": 100_000.0,
+             "value_runs": [99_000.0, 100_000.0, 101_000.0],
+             "contended": False}
+    doc = bc.compare(quiet, {"value": 75_000.0, "contended": False})
+    assert doc["status"] == "regression"
+
+
+def test_gate_against_pinned_baseline(tmp_path):
+    """The committed BENCH_BASELINE.json gates correctly: an unmodified
+    tree (baseline vs in-spread copy of itself) passes; an injected 2x
+    steady-state regression fails — pinned by this test, not by hand."""
+    bc = _bench_compare()
+    pinned = os.path.join(os.path.dirname(TOOLS), "BENCH_BASELINE.json")
+    with open(pinned) as f:
+        baseline = json.load(f)
+    assert baseline["metric"] == "nexmark_q5_events_per_sec"
+    assert baseline["value"] > 0
+    # unmodified tree: the same measurements, jittered inside the noise
+    same = copy.deepcopy(baseline)
+    for k, v in list(same.items()):
+        if bc.classify(k) and isinstance(v, (int, float)) and v:
+            same[k] = v * 1.03
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(same))
+    assert bc.main([pinned, str(cur)]) == 0
+    # injected regression: headline halves, tail latency doubles
+    bad = copy.deepcopy(baseline)
+    bad["value"] = baseline["value"] / 2
+    bad["q5_p99_ms"] = baseline.get("q5_p99_ms", 1000.0) * 2
+    badp = tmp_path / "bad.json"
+    badp.write_text(json.dumps(bad))
+    out_json = tmp_path / "cmp.json"
+    assert bc.main([pinned, str(badp), "--json", str(out_json)]) == 1
+    doc = json.loads(out_json.read_text())
+    assert "value" in doc["regressions"]

@@ -95,7 +95,9 @@ def test_sse_source_resumes_from_last_event_id(tmp_path):
 def test_websocket_source_streams(tmp_path):
     """WebSocket source: subscription message then streamed json frames
     through the engine to a sink."""
-    import websockets
+    websockets = pytest.importorskip(
+        "websockets", reason="websockets package not installed"
+    )
 
     out = tmp_path / "out.json"
     got_subs = []

@@ -43,7 +43,24 @@ def run_sql(sql, parallelism=1, timeout=60.0):
 
 
 def test_parse_nexmark_q5():
-    sql = open("/root/reference/crates/arroyo-sql-testing/src/test/queries/nexmark_q5.sql").read()
+    # the committed fixture mirrors the reference's
+    # arroyo-sql-testing/src/test/queries/nexmark_q5.sql; prefer the
+    # reference checkout when present, else resolve our own copy so the
+    # test doesn't depend on a path outside the repo
+    import os
+
+    candidates = [
+        "/root/reference/crates/arroyo-sql-testing/src/test/queries/"
+        "nexmark_q5.sql",
+        os.path.join(os.path.dirname(__file__), "golden", "queries",
+                     "nexmark_q5.sql"),
+    ]
+    path = next((p for p in candidates if os.path.exists(p)), None)
+    if path is None:
+        import pytest
+
+        pytest.skip("nexmark_q5.sql fixture not found")
+    sql = open(path).read()
     stmts = parse_statements(sql)
     assert len(stmts) == 3
     assert isinstance(stmts[0], CreateTable)

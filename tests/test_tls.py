@@ -15,6 +15,9 @@ from arroyo_tpu.controller.scheduler import EmbeddedScheduler
 def make_certs(tmp_path):
     """Self-signed CA + one leaf cert (server+client auth, DNS SAN
     arroyo-tpu) written as PEM files."""
+    pytest.importorskip(
+        "cryptography", reason="cryptography package not installed"
+    )
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Noise-aware bench regression gate (ISSUE 6).
+
+Compare a bench.py JSON line against a pinned baseline and decide, per
+metric, whether the delta is a real regression or in-spread wobble. The
+threshold is derived from the MEASURED run spread, not a fixed
+percentage: BENCH_r01-r05 show ±15%+ run-to-run variance on the shared
+bench host, so any fixed gate either cries wolf or sleeps through 2x
+losses.
+
+Per metric the allowed relative delta is
+
+    allowed = max(baseline_spread, current_spread, floor) * margin
+
+where spread comes from the metric's own `*_runs` array (max-min over
+median, the same dispersion bench.py publishes as `*_spread_pct`) when
+present, and `floor` is the class floor otherwise (throughput metrics
+default 10%, latency metrics 25% — latency percentiles rest on tens of
+samples). Throughput metrics (`value`, `*_eps`) regress downward;
+latency metrics (`*_ms`) regress upward. Count/diagnostic fields
+(rows, events, spreads, compile seconds, calibration) are reported but
+never gated.
+
+A baseline or current measured on a CONTENDED host (bench.py's
+calibration probe) widens every floor by the contention factor — the
+numbers were taken under interference and say less.
+
+Exit status: 0 = no regression, 1 = at least one metric regressed,
+2 = usage/IO error. `--json` writes the full comparison for CI upload.
+
+Usage:
+  python tools/bench_compare.py BENCH_BASELINE.json current.json \
+      [--json comparison.json] [--margin 1.5] [--floor-pct 10] \
+      [--latency-floor-pct 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional
+
+
+def _spread_pct(doc: dict, metric: str) -> Optional[float]:
+    """The metric's own measured dispersion: (max - min) / median over
+    its published runs array, in percent."""
+    runs_key = {
+        "value": "value_runs",
+    }.get(metric, f"{metric}_runs")
+    runs = doc.get(runs_key)
+    if not isinstance(runs, list) or len(runs) < 2:
+        if metric == "value":
+            v = doc.get("value_spread_pct")
+            return float(v) if isinstance(v, (int, float)) else None
+        return None
+    rs = sorted(float(r) for r in runs)
+    med = rs[(len(rs) - 1) // 2]
+    if med <= 0:
+        return None
+    return 100.0 * (rs[-1] - rs[0]) / med
+
+
+def classify(metric: str) -> Optional[str]:
+    """'higher' (throughput), 'lower' (latency), or None (not gated)."""
+    if metric == "value" or metric.endswith("_eps"):
+        return "higher"
+    if metric.endswith("_ms"):
+        return "lower"
+    return None
+
+
+def compare(baseline: dict, current: dict, margin: float = 1.5,
+            floor_pct: float = 10.0,
+            latency_floor_pct: float = 25.0) -> dict:
+    """Full comparison document: per-metric verdicts + overall status."""
+    contended = bool(baseline.get("contended")) or bool(
+        current.get("contended"))
+    results: Dict[str, dict] = {}
+    regressions = []
+    for metric in sorted(set(baseline) & set(current)):
+        direction = classify(metric)
+        if direction is None:
+            continue
+        b, c = baseline[metric], current[metric]
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if not b or not c:
+            # 0 means "that query failed that round" — a wedge, not a
+            # perf number; flag a current-side 0 against a real baseline
+            status = "regression" if b and not c else "missing"
+            results[metric] = {"baseline": b, "current": c,
+                               "status": status}
+            if status == "regression":
+                regressions.append(metric)
+            continue
+        floor = latency_floor_pct if direction == "lower" else floor_pct
+        spreads = [s for s in (_spread_pct(baseline, metric),
+                               _spread_pct(current, metric)) if s]
+        allowed = max(spreads + [floor]) * margin
+        if contended:
+            allowed *= 1.5
+        delta_pct = 100.0 * (c - b) / b
+        bad = (-delta_pct if direction == "higher" else delta_pct)
+        if bad > allowed:
+            status = "regression"
+            regressions.append(metric)
+        elif bad < -allowed:
+            status = "improved"
+        else:
+            status = "ok"
+        results[metric] = {
+            "baseline": b, "current": c,
+            "delta_pct": round(delta_pct, 1),
+            "allowed_pct": round(allowed, 1),
+            "spread_pcts": [round(s, 1) for s in spreads],
+            "direction": direction,
+            "status": status,
+        }
+    return {
+        "status": "regression" if regressions else "ok",
+        "regressions": regressions,
+        "contended": contended,
+        "margin": margin,
+        "metrics": results,
+    }
+
+
+def render(doc: dict, out=sys.stdout) -> None:
+    width = max([len(m) for m in doc["metrics"]] + [6])
+    for metric, r in doc["metrics"].items():
+        if r["status"] == "missing":
+            print(f"  {metric:<{width}}  MISSING "
+                  f"(baseline={r['baseline']} current={r['current']})",
+                  file=out)
+            continue
+        flag = {"ok": " ", "improved": "+", "regression": "!"}[r["status"]]
+        print(f"{flag} {metric:<{width}}  {r['baseline']:>12} -> "
+              f"{r['current']:>12}  {r['delta_pct']:+6.1f}% "
+              f"(allowed ±{r['allowed_pct']}%)", file=out)
+    print(f"\nverdict: {doc['status'].upper()}"
+          + (f" — {', '.join(doc['regressions'])}"
+             if doc["regressions"] else "")
+          + (" [contended host: thresholds widened]"
+             if doc["contended"] else ""), file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("baseline", help="pinned baseline bench JSON")
+    ap.add_argument("current", help="fresh bench JSON to gate")
+    ap.add_argument("--json", help="write the comparison document here")
+    ap.add_argument("--margin", type=float, default=1.5,
+                    help="multiplier over the measured spread")
+    ap.add_argument("--floor-pct", type=float, default=10.0,
+                    help="minimum allowed delta for throughput metrics")
+    ap.add_argument("--latency-floor-pct", type=float, default=25.0,
+                    help="minimum allowed delta for latency metrics")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        with open(args.current) as f:
+            current = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    doc = compare(baseline, current, margin=args.margin,
+                  floor_pct=args.floor_pct,
+                  latency_floor_pct=args.latency_floor_pct)
+    render(doc)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+    return 1 if doc["status"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,15 @@ def child(events: int, mesh: int, linger: float) -> None:
               f"{MESH_STATS['updates']} "
               f"{MESH_STATS['flushes_elided']} "
               f"{MESH_STATS['rows_combined']}", flush=True)
+        # device-tier observatory (ISSUE 6): per-program dispatch-time
+        # quantiles + per-rung padding waste, folded into the stage
+        # budget so the mesh refactor has a before/after ledger
+        from arroyo_tpu.obs import device as obs_device
+
+        print("DEVICE " + json.dumps({
+            "programs": obs_device.summary()["programs"],
+            "padding_waste": obs_device.summary()["padding_waste"],
+        }), flush=True)
         print(f"RESULT {events / dt:.1f} 0 {dt:.2f}", flush=True)
         if linger > 0:
             # keep the loop (and the in-flight /debug/profile capture)
@@ -231,6 +240,7 @@ def main() -> int:
     t = None
     result = None
     stats = None
+    device = None
     assert proc.stdout is not None
     for line in proc.stdout:
         line = line.strip()
@@ -242,6 +252,11 @@ def main() -> int:
         elif line.startswith("RESULT "):
             parts = line.split()
             result = {"eps": float(parts[1]), "secs": float(parts[3])}
+        elif line.startswith("DEVICE "):
+            try:
+                device = json.loads(line[len("DEVICE "):])
+            except json.JSONDecodeError:
+                device = None
         elif line.startswith("MESHSTATS "):
             parts = [int(x) for x in line.split()[1:]]
             shipped = parts[0] + parts[1]
@@ -271,6 +286,7 @@ def main() -> int:
         **({"q5_mesh_eps": round(result["eps"], 1),
             "run_seconds": result["secs"]} if result else {}),
         **({"mesh_stats": stats} if stats else {}),
+        **({"device_telemetry": device} if device else {}),
         **budget,
     }
     print(json.dumps(out))
@@ -284,6 +300,28 @@ def main() -> int:
               f"{args.seconds}s window (idle {budget['idle_seconds']}s); "
               f"q5_mesh{args.mesh} "
               f"{out.get('q5_mesh_eps', 'n/a')} ev/s.")
+        if device:
+            # the observatory's per-program ledger: dispatch floor +
+            # padding waste per rung beside the host-stage budget
+            print("\n| program | compiles | compile s | dispatches "
+                  "| dispatch p50/p95 | cache h/m |")
+            print("|---|---|---|---|---|---|")
+            for name, p in sorted(device.get("programs", {}).items()):
+                dq = p.get("dispatch_quantiles", {})
+                print(f"| {name} | {p.get('compiles', 0)} "
+                      f"| {p.get('compile_s_total', 0)} "
+                      f"| {p.get('dispatches', 0)} "
+                      f"| {dq.get('p50', 'n/a')}/{dq.get('p95', 'n/a')} s "
+                      f"| {p.get('cache_hit', 0)}/"
+                      f"{p.get('cache_miss', 0)} |")
+            waste = [w for w in device.get("padding_waste", [])
+                     if w.get("waste")]
+            if waste:
+                print("\n| program | rung | padding waste |")
+                print("|---|---|---|")
+                for w in waste:
+                    print(f"| {w['program']} | {w['rung']} "
+                          f"| {100.0 * w['waste']:.1f}% |")
     return 0
 
 

@@ -119,6 +119,56 @@ def summarize(events: List[dict], out=sys.stdout) -> None:
               f"{ev.get('args')}", file=out)
 
 
+def latency_summary(report: dict, out=sys.stdout) -> None:
+    """Pretty-print one /debug/latency (or REST /jobs/{id}/latency) dump:
+    per-operator + end-to-end marker quantiles, per-program XLA compile/
+    dispatch stats, padding waste per rung, and the recompile-cause log."""
+
+    def series(title, rows):
+        print(f"\n== {title}", file=out)
+        if not rows:
+            print("   (no samples)", file=out)
+            return
+        for r in rows:
+            qs = " ".join(
+                f"{q}={r[f'{q}_ms']}ms" for q in ("p50", "p95", "p99")
+                if f"{q}_ms" in r
+            )
+            print(f"   {r.get('job')}/{r.get('task')}: "
+                  f"n={r['samples']} mean={r['mean_ms']}ms {qs}", file=out)
+
+    series("operator latency (marker transit source->operator)",
+           report.get("operators", []))
+    series("end-to-end latency (marker transit source->sink)",
+           report.get("end_to_end", []))
+    dev = report.get("device", {})
+    progs = dev.get("programs", {})
+    if progs:
+        print("\n== device programs", file=out)
+        for name, p in sorted(progs.items()):
+            dq = p.get("dispatch_quantiles", {})
+            print(f"   {name}: compiles={p.get('compiles', 0)} "
+                  f"compile_s={p.get('compile_s_total', 0)} "
+                  f"dispatches={p.get('dispatches', 0)} "
+                  f"dispatch_p95={dq.get('p95', 'n/a')}s "
+                  f"cache={p.get('cache_hit', 0)}h/"
+                  f"{p.get('cache_miss', 0)}m", file=out)
+    waste = [w for w in dev.get("padding_waste", []) if w.get("waste")]
+    if waste:
+        print("\n== padding waste (last dispatch per program/rung)",
+              file=out)
+        for w in waste:
+            print(f"   {w['program']} rung={w['rung']}: "
+                  f"{100.0 * w['waste']:.1f}%", file=out)
+    recompiles = dev.get("recompiles", [])
+    if recompiles:
+        print(f"\n== recompile causes ({len(recompiles)})", file=out)
+        for r in recompiles[-20:]:
+            print(f"   {r['program']} #{r['nth_compile']} [{r['cause']}] "
+                  f"rung={r['rung']} {r['compile_s']}s sig={r['signature']}",
+                  file=out)
+
+
 def run_golden_ft(out_path: str) -> int:
     """Run the golden windowed-agg fault-tolerance cycle (embedded
     cluster + seeded faults + recovery) and write its flight recording.
@@ -156,11 +206,23 @@ def main(argv=None) -> int:
     ap.add_argument("--golden-ft", action="store_true",
                     help="run the golden fault-tolerance cycle and dump "
                          "its flight recording (requires --out)")
+    ap.add_argument("--latency", action="store_true",
+                    help="treat inputs as /debug/latency dumps and print "
+                         "the device-tier observatory summary")
     args = ap.parse_args(argv)
     if args.golden_ft:
         if not args.out:
             ap.error("--golden-ft requires --out")
         return run_golden_ft(args.out)
+    if args.latency:
+        if not args.inputs:
+            ap.error("no latency dumps given")
+        for p in args.inputs:
+            with open(p) as f:
+                report = json.load(f)
+            print(f"--- {p}")
+            latency_summary(report)
+        return 0
     if not args.inputs:
         ap.error("no input dumps given")
     doc = merge(args.inputs)

@@ -441,3 +441,77 @@ class ConfigKeyDocumentedRule(Rule):
                     )
                 )
         return out
+
+
+# -- JAX003: exchange hot path host sync -------------------------------------
+
+# functions that legitimately materialize device values: emission reads,
+# checkpoint capture/restore, debug accessors. Matched by substring so
+# helper variants (_sliced_read, take_bin_arrays, gather_and_reset...)
+# stay covered without enumerating every name.
+_EMISSION_CAPTURE_NAMES = (
+    "gather", "snapshot", "restore", "reset", "to_host", "read", "take",
+    "block_until_ready", "finalize", "peek", "emit", "items",
+)
+
+_DEVICE_STATE_NAMES = {"state", "outs", "new_state", "state_shards"}
+
+
+def _touches_device_state(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _DEVICE_STATE_NAMES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _DEVICE_STATE_NAMES:
+            return True
+    return False
+
+
+@register
+class ExchangeHotPathSyncRule(Rule):
+    id = "JAX003"
+    name = "exchange-hot-path-host-sync"
+    description = (
+        "host-device synchronization on the mesh exchange hot path: "
+        "`.block_until_ready()`, or `np.asarray`/`np.array`/"
+        "`jax.device_get`/`float`/`int` over device state (implicit "
+        "`__array__`), inside parallel// ops/ code outside the "
+        "emission/checkpoint-capture functions. The keyed exchange is "
+        "built to stay device-resident between micro-batches — a sync "
+        "per flush serializes every dispatch against the host"
+    )
+
+    _SYNC_CALLS = {
+        "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+        "jax.device_get", "float", "int",
+    }
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if not ("parallel/" in path or "ops/" in path):
+            return ()
+        out: List[Finding] = []
+        for fn in iter_functions(ctx.tree):
+            name = fn.name.lower()
+            if any(tok in name for tok in _EMISSION_CAPTURE_NAMES):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"):
+                    out.append(ctx.finding(
+                        self, node,
+                        f".block_until_ready() in {fn.name}() — the "
+                        "exchange hot path must not block on the device",
+                    ))
+                    continue
+                cname = dotted_name(node.func)
+                if cname in self._SYNC_CALLS and node.args and \
+                        _touches_device_state(node.args[0]):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"{cname}() over device state in {fn.name}() "
+                        "materializes (implicit __array__) on the host "
+                        "per dispatch — keep the exchange device-resident",
+                    ))
+        return out

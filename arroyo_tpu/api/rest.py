@@ -455,6 +455,41 @@ class ApiServer:
                     ops.setdefault(node_id, {}).setdefault(mname, {})[
                         sub_i
                     ] = v
+        # device-tier families carry a `program` label instead of a task:
+        # surface them under a synthetic "__device__" operator (one
+        # series per program — the exchange/dispatch cost of the mesh
+        # tier belongs beside the per-operator groups, not orphaned in
+        # the raw prometheus text)
+        for name, entries in REGISTRY.snapshot().items():
+            if not (name.startswith("arroyo_device_")
+                    or name.startswith("arroyo_xla_")):
+                continue
+            short = name.removeprefix("arroyo_")
+            for labels, value in entries:
+                program = labels.get("program")
+                if program is None:
+                    continue
+                suffix = "".join(
+                    f":{labels[k]}" for k in sorted(labels)
+                    if k != "program"
+                )
+                metric = f"{short}:{program}{suffix}"
+                if isinstance(value, dict):
+                    series = [(
+                        metric,
+                        value["sum"] / value["count"]
+                        if value.get("count") else 0.0,
+                    )]
+                    series += [
+                        (f"{metric}:{q}", v)
+                        for q, v in sorted(hist_quantiles(value).items())
+                    ]
+                else:
+                    series = [(metric, value)]
+                for mname, v in series:
+                    ops.setdefault("__device__", {}).setdefault(
+                        mname, {}
+                    )[0] = v
         data = [
             {
                 "operatorId": op,

@@ -123,6 +123,27 @@ class TpuConfig:
     # transfer, program launch) across engine batches. 0 = dispatch
     # every engine batch immediately.
     mesh_flush_rows: int = 32768
+    # mesh exchange tier (parallel/sharded_state.py): 'device' = the
+    # GSPMD device-resident keyed exchange (one fused route+scatter+
+    # reduce jitted program; XLA compiles the all_to_all into the step;
+    # no host combiner), 'host_fed' = combiner + dst-major packed
+    # transfer (the multi-process / virtual-mesh fallback), 'a2a' =
+    # host-packed src-major layout + in-step all_to_all. 'auto' picks
+    # 'device' on real chip meshes and 'host_fed' on virtual (forced
+    # host-platform) or multi-process CPU meshes.
+    mesh_exchange: str = "auto"
+    # emission-side reads/writes (gather/take/reset/restore) on the mesh
+    # are chunked at this many slots per dispatch: big drain waves reuse
+    # the full-chunk compiled program instead of specializing one XLA
+    # program per wave size (sized to cover a typical sliding-merge
+    # union — ~k bins x per-bin cardinality — in one dispatch)
+    mesh_emission_chunk: int = 16384
+    # where window-global (salted) aggregates run in mesh mode: 'mesh'
+    # spreads their rows across the key mesh (right on real chip meshes
+    # — S-way scatter bandwidth), 'single' keeps them on one jax device
+    # (right on virtual CPU meshes where the spread costs S x serial
+    # work for a handful of groups), 'auto' picks by mesh platform
+    mesh_salted_tier: str = "auto"
     # persistent XLA compilation cache directory (ops/_jax.get_jax):
     # compiled programs survive process exit, so repeat runs skip XLA
     # compilation (critical through the TPU relay at ~20-40s/program).

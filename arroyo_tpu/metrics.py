@@ -365,6 +365,12 @@ DEVICE_DISPATCH_SECONDS = REGISTRY.histogram(
     "arroyo_device_dispatch_seconds",
     "steady-state dispatch wall time of already-compiled jitted "
     "programs, per program")
+DEVICE_EXCHANGE_SECONDS = REGISTRY.histogram(
+    "arroyo_device_exchange_seconds",
+    "per-dispatch wall time of the mesh EXCHANGE programs only (the "
+    "keyed shuffle: device-routed all_to_all route+scatter steps and "
+    "the host-fed packed-transfer steps), excluding emission/reset — "
+    "the collective cost the mesh tier pays per micro-batch flush")
 DEVICE_PADDING_WASTE = REGISTRY.gauge(
     "arroyo_device_padding_waste",
     "fraction (0..1) of rows shipped to the device that were neutral "

@@ -35,6 +35,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..metrics import (
+    DEVICE_EXCHANGE_SECONDS,
     DEVICE_PADDING_WASTE,
     DEVICE_DISPATCH_SECONDS,
     XLA_COMPILE_CACHE,
@@ -113,6 +114,29 @@ def signature_of(args: tuple) -> str:
     return "(" + ", ".join(parts) + ")"
 
 
+def _sig_key_part(a: Any, parts: List) -> None:
+    if isinstance(a, (list, tuple)):
+        for x in a:
+            _sig_key_part(x, parts)
+        return
+    shape = getattr(a, "shape", None)
+    if shape is not None:
+        parts.append((getattr(a, "dtype", None), shape))
+    else:
+        parts.append(type(a))
+
+
+def signature_key(args: tuple) -> tuple:
+    """Hashable fast form of signature_of: (dtype, shape) tuples instead
+    of built strings. The hot dispatch path classifies every call — at
+    hundreds of dispatches per second the string rendering itself showed
+    up in the mesh profile — so the string form is only materialized
+    when a call is actually fresh (compiles are rare)."""
+    parts: List = []
+    _sig_key_part(args, parts)
+    return tuple(parts)
+
+
 def _record_compile(program: str, sig: str, rung: Optional[int],
                     nth: int, secs: float, start_us: float) -> None:
     global _SPAN_EPOCH
@@ -170,9 +194,9 @@ class InstrumentedJit:
     histogram will show it — but it still costs a python-side trace."""
 
     __slots__ = ("program", "fn", "seen", "_compiles", "_hit", "_miss",
-                 "_compile_h", "_dispatch_h")
+                 "_compile_h", "_dispatch_h", "_exchange_h")
 
-    def __init__(self, program: str, fn):
+    def __init__(self, program: str, fn, exchange: bool = False):
         self.program = program
         self.fn = fn
         self.seen: set = set()
@@ -181,26 +205,35 @@ class InstrumentedJit:
         self._miss = XLA_COMPILE_CACHE.labels(program=program, result="miss")
         self._compile_h = XLA_COMPILE_SECONDS.labels(program=program)
         self._dispatch_h = DEVICE_DISPATCH_SECONDS.labels(program=program)
+        # exchange programs (the mesh keyed shuffle: route/step kernels)
+        # additionally feed arroyo_device_exchange_seconds so the
+        # collective's per-flush cost is separable from emission reads
+        self._exchange_h = (
+            DEVICE_EXCHANGE_SECONDS.labels(program=program)
+            if exchange else None
+        )
 
     def __call__(self, *args, rung: Optional[int] = None):
         if not enabled():
             return self.fn(*args)
-        sig = signature_of(args)
-        fresh = sig not in self.seen
+        key = signature_key(args)
+        fresh = key not in self.seen
         start_us = time.time() * 1e6
         t0 = time.perf_counter()
         out = self.fn(*args)
         dt = time.perf_counter() - t0
         if fresh:
-            self.seen.add(sig)
+            self.seen.add(key)
             self._compiles.inc()
             self._miss.inc()
             self._compile_h.observe(dt)
-            _record_compile(self.program, sig, rung, len(self.seen), dt,
-                            start_us)
+            _record_compile(self.program, signature_of(args), rung,
+                            len(self.seen), dt, start_us)
         else:
             self._hit.inc()
             self._dispatch_h.observe(dt)
+            if self._exchange_h is not None:
+                self._exchange_h.observe(dt)
         return out
 
 
@@ -316,6 +349,13 @@ def summary() -> dict:
         p = programs.setdefault(prog, {})
         p["dispatches"] = int(h.get("count", 0))
         p["dispatch_quantiles"] = {
+            q: round(v, 6) for q, v in hist_quantiles(h).items()
+        }
+    for prog, h in by_program("arroyo_device_exchange_seconds").items():
+        p = programs.setdefault(prog, {})
+        p["exchange_dispatches"] = int(h.get("count", 0))
+        p["exchange_s_total"] = round(h.get("sum", 0.0), 4)
+        p["exchange_quantiles"] = {
             q: round(v, 6) for q, v in hist_quantiles(h).items()
         }
     for labels, v in snap.get("arroyo_xla_compile_cache_total", []):

@@ -63,6 +63,27 @@ class WindowOperatorBase(Operator):
         self.window_field: Optional[str] = config.get("window_field")
         self.backend = config.get("backend")
         mesh_n = self._mesh_devices(config)
+        # planner marks aggregates whose every grouping key is the
+        # window itself (one group per bin): hash ownership would
+        # starve most shards, so those run SALTED — rows spread
+        # round-robin across all shards, folded at gather. Device
+        # phys ops are all fold-able (add/min/max); host-state
+        # aggregates (UDAF buffers / multisets) are keyed by GLOBAL
+        # slot and folded host-side, so they ride along unchanged.
+        salted = bool(config.get("mesh_salted"))
+        if mesh_n >= 2 and salted and not self._salted_on_mesh(mesh_n):
+            # window-global groupings have no key axis to shard: on a
+            # VIRTUAL (forced host-platform) mesh the salted spread
+            # costs S x serial scatter work for a handful of groups, so
+            # the stage runs on the standard single-device tier instead
+            # (state stays device-resident; the keyed stages around it
+            # keep the mesh exchange). Real chip meshes keep salting —
+            # there the spread buys S x scatter bandwidth.
+            mesh_n = 0
+            if self._offmesh_backend is not None:
+                # session windows: imperative host bookkeeping dominates;
+                # off the mesh they keep their numpy accumulator
+                self.backend = self._offmesh_backend
         if mesh_n >= 2:
             from ..parallel import (
                 MeshSlotDirectory,
@@ -73,14 +94,6 @@ class WindowOperatorBase(Operator):
 
             from ..config import config as config_fn
 
-            # planner marks aggregates whose every grouping key is the
-            # window itself (one group per bin): hash ownership would
-            # starve most shards, so those run SALTED — rows spread
-            # round-robin across all shards, folded at gather. Device
-            # phys ops are all fold-able (add/min/max); host-state
-            # aggregates (UDAF buffers / multisets) are keyed by GLOBAL
-            # slot and folded host-side, so they ride along unchanged.
-            salted = bool(config.get("mesh_salted"))
             self.acc = ShardedAccumulator(
                 self.specs,
                 key_mesh(self._mesh_device_list(mesh_n)),
@@ -131,6 +144,9 @@ class WindowOperatorBase(Operator):
     # the mesh-sharded accumulator (tumbling, sliding; session bookkeeping
     # allocates slots imperatively and stays host-side)
     _mesh_ok = False
+    # backend to fall back to when a salted stage is tiered OFF the mesh
+    # (None = keep the configured backend; sessions force numpy)
+    _offmesh_backend: Optional[str] = None
 
     def _mesh_devices(self, config: dict) -> int:
         if not self._mesh_ok or self.backend == "numpy":
@@ -162,6 +178,25 @@ class WindowOperatorBase(Operator):
                 "are visible"
             )
         return devices[:n]
+
+    def _salted_on_mesh(self, mesh_n: int) -> bool:
+        """Should a SALTED (window-global) aggregate shard across the
+        mesh? tpu.mesh_salted_tier: 'mesh' / 'single' force it; 'auto'
+        salts only real chip meshes (parallel/mesh.mesh_is_virtual)."""
+        from ..config import config as config_fn
+        from ..parallel import key_mesh
+        from ..parallel.mesh import mesh_is_virtual
+
+        tier = str(getattr(config_fn().tpu, "mesh_salted_tier", "auto")
+                   or "auto")
+        if tier not in ("auto", "mesh", "single"):
+            raise ValueError(
+                f"tpu.mesh_salted_tier must be auto|mesh|single, "
+                f"got {tier!r}"
+            )
+        if tier != "auto":
+            return tier == "mesh"
+        return not mesh_is_virtual(key_mesh(self._mesh_device_list(mesh_n)))
 
     def _capture_key_meta(self, ctx):
         if self._key_types is None:
@@ -909,6 +944,12 @@ class TumblingWindowOperator(WindowOperatorBase):
         # (halves the per-wave emission dispatches); host-state drops
         # then happen after finalize has read the stores
         fused = getattr(self.acc, "gather_and_reset", None)
+        # ONE device drain for the whole wave: per-bin slot sets of the
+        # same watermark advance concatenate into a single gather/take
+        # dispatch (the old per-bin loop launched one device program per
+        # bin — ~30 near-empty mesh.take dispatches per wave on the q5
+        # per-window-max stage), then outputs slice back out per bin
+        wave = []  # (bin, end, keys, key_arrays, slots)
         for b in self.dir.bins_up_to(limit):
             end = self._bin_end(b)
             if end > t:
@@ -920,21 +961,33 @@ class TumblingWindowOperator(WindowOperatorBase):
             else:
                 keys, slots = self.dir.take_bin(b)
                 key_arrays = None
-            gathered = (
-                fused(slots) if fused is not None
-                else self.acc.gather(slots)
-            )
-            agg_cols = self.acc.finalize(gathered)
-            if fused is not None:
-                self.acc.drop_host_state(slots)
-            else:
-                self.acc.reset_slots(slots)
+            wave.append((b, end, keys, key_arrays, slots))
+        if not wave:
+            return watermark
+        all_slots = (
+            wave[0][4] if len(wave) == 1
+            else np.concatenate([w[4] for w in wave])
+        )
+        gathered = (
+            fused(all_slots) if fused is not None
+            else self.acc.gather(all_slots)
+        )
+        agg_cols = self.acc.finalize(gathered)
+        if fused is not None:
+            self.acc.drop_host_state(all_slots)
+        else:
+            self.acc.reset_slots(all_slots)
+        off = 0
+        for b, end, keys, key_arrays, slots in wave:
+            n = len(slots)
+            cols_b = [c[off:off + n] for c in agg_cols]
+            off += n
             if self.width:
-                out = self._build_output(keys, agg_cols, b * self.width, end,
+                out = self._build_output(keys, cols_b, b * self.width, end,
                                          key_arrays=key_arrays)
             else:
                 # instant mode: preserve the window's timestamp exactly
-                out = self._build_output(keys, agg_cols, b, b, ts_value=b,
+                out = self._build_output(keys, cols_b, b, b, ts_value=b,
                                          key_arrays=key_arrays)
             await collector.collect(out)
             self.emitted_up_to = max(self.emitted_up_to or 0, end)
@@ -1045,9 +1098,25 @@ class SlidingWindowOperator(WindowOperatorBase):
         end_bin = end // self.slide  # window covers bins [end_bin-k, end_bin)
         lo_bin = end_bin - self.k
         # merge per-key across participating bins (host merge: runs once per
-        # slide period; the per-event scatter stays on device)
+        # slide period; the per-event scatter stays on device).
+        # The bin exiting the window (lo_bin) is TAKEN from the directory
+        # up front so its entries lead the union: the accumulator can then
+        # gather the union and reset the freed bin in ONE fused device
+        # dispatch (combine_for_segments_and_free) instead of a gather
+        # followed by a separate reset program launch per wave.
         key_chunks = []
         slot_chunks = []
+        take_arrays = getattr(self.dir, "take_bin_arrays", None)
+        if take_arrays is not None:
+            fk_cols, freed = take_arrays(lo_bin)
+            if len(freed):
+                key_chunks.append(np.stack(fk_cols, axis=1))
+                slot_chunks.append(freed)
+        else:
+            fk, freed = self.dir.take_bin(lo_bin)
+            if len(freed):
+                key_chunks.append(fk)
+                slot_chunks.append(freed)
         multi = getattr(self.dir, "bin_entries_multi", None)
         if multi is not None:
             # native directories: ONE batched crossing covering every
@@ -1055,13 +1124,13 @@ class SlidingWindowOperator(WindowOperatorBase):
             # per-bin identity is irrelevant) instead of k get_bin calls
             # — k x shards calls on the mesh facade
             kmat, slots_m = multi(
-                np.arange(lo_bin, end_bin, dtype=np.int64)
+                np.arange(lo_bin + 1, end_bin, dtype=np.int64)
             )
             if len(slots_m):
                 key_chunks.append(kmat)
                 slot_chunks.append(slots_m)
         else:
-            for b in range(lo_bin, end_bin):
+            for b in range(lo_bin + 1, end_bin):
                 keys_b, slots_b = self.dir.bin_entries(b)
                 if len(slots_b):
                     key_chunks.append(keys_b)
@@ -1106,8 +1175,8 @@ class SlidingWindowOperator(WindowOperatorBase):
                 seg_ids = seg
                 out_keys = list(index.keys())
                 n_keys = len(index)
-            combined = self.acc.combine_for_segments(
-                all_slots, seg_ids, n_keys
+            combined = self.acc.combine_for_segments_and_free(
+                all_slots, seg_ids, n_keys, free_n=len(freed)
             )
             agg_cols = self.acc.finalize(combined)
             out_batch = self._build_output(
@@ -1115,15 +1184,6 @@ class SlidingWindowOperator(WindowOperatorBase):
                 key_arrays=key_arrays,
             )
             await collector.collect(out_batch)
-        # the oldest bin exits the window range: free it (vectorized take
-        # on the native directory — the keys are discarded anyway)
-        take_arrays = getattr(self.dir, "take_bin_arrays", None)
-        if take_arrays is not None:
-            _, freed = take_arrays(lo_bin)
-        else:
-            _, freed = self.dir.take_bin(lo_bin)
-        if len(freed):
-            self.acc.reset_slots(freed)
         self.last_freed_bin = max(self.last_freed_bin or lo_bin, lo_bin)
 
 
@@ -1164,6 +1224,7 @@ class SessionWindowOperator(WindowOperatorBase):
     (reference treats all window types uniformly)."""
 
     _mesh_ok = True
+    _offmesh_backend = "numpy"
 
     def __init__(self, config: dict):
         config = dict(config)

@@ -773,7 +773,28 @@ class Accumulator:
         """Merge per-slot accumulators into per-segment values (sliding
         window emission): device phys arrays segment-reduce on host; UDAF
         buffers concatenate per segment for the subsequent finalize()."""
-        gathered = self.gather(slots)
+        return self._combine_gathered(
+            self.gather(slots), slots, seg_ids, n_segments
+        )
+
+    def combine_for_segments_and_free(
+        self, slots: np.ndarray, seg_ids: np.ndarray, n_segments: int,
+        free_n: int = 0,
+    ) -> List[np.ndarray]:
+        """combine_for_segments, additionally freeing the device state of
+        the FIRST free_n slots — the sliding merge frees the bin exiting
+        the window in the same wave it last reads it, so the union is
+        ordered freed-bin-first. The mesh accumulator overrides this with
+        ONE fused gather+reset dispatch; here the reset is a second pass."""
+        combined = self.combine_for_segments(slots, seg_ids, n_segments)
+        if free_n:
+            self.reset_slots(np.asarray(slots)[:free_n])
+        return combined
+
+    def _combine_gathered(
+        self, gathered: List[np.ndarray], slots: np.ndarray,
+        seg_ids: np.ndarray, n_segments: int,
+    ) -> List[np.ndarray]:
         combined = []
         for (op, dt, _, _), vals in zip(self.phys, gathered):
             outv = np.full(n_segments, self._neutral(op, dt), dtype=self._dt(dt))

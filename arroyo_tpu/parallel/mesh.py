@@ -9,7 +9,9 @@ across slices and to connectors.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
+
+_MESH_CACHE: Dict[Tuple, object] = {}
 
 
 def _get_jnp():
@@ -21,11 +23,35 @@ def _get_jnp():
 
 
 def key_mesh(devices: Optional[Sequence] = None, axis: str = "keys"):
+    """The 1-D key mesh over `devices`. Cached per (device ids, axis):
+    every operator over the same device set shares ONE Mesh instance, so
+    the process-level jitted-program cache in sharded_state.py (keyed by
+    mesh identity among other things) actually hits across operators —
+    distinct Mesh objects would re-trace identical programs per stage."""
     import jax
-    from jax.sharding import Mesh
 
     if devices is None:
         devices = jax.devices()
-    import numpy as np
+    key = (tuple(d.id for d in devices), axis)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        from jax.sharding import Mesh
 
-    return Mesh(np.array(devices), (axis,))
+        import numpy as np
+
+        mesh = _MESH_CACHE.setdefault(key, Mesh(np.array(devices), (axis,)))
+    return mesh
+
+
+def mesh_is_virtual(mesh) -> bool:
+    """True when the mesh's "devices" are host-platform (CPU) devices of
+    ONE process — the `--xla_force_host_platform_device_count` dryrun/CI
+    configuration. There is no ICI underneath such a mesh: collectives
+    are memcpys between buffers of the same host and every shard's
+    compute shares the same cores, which inverts the cost model the
+    device-routed exchange is built for (sharded_state.py picks the
+    host-fed exchange and the single-device salted tier here)."""
+    devs = list(mesh.devices.flat)
+    return all(d.platform == "cpu" for d in devs) and len(
+        {d.process_index for d in devs}
+    ) == 1

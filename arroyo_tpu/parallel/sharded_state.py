@@ -4,25 +4,68 @@ The reference scales keyed aggregation by running parallel subtasks wired
 with a TCP shuffle (/root/reference/crates/arroyo-worker/src/
 network_manager.rs; engine.rs:209-365 is the subtask wiring). The
 TPU-native equivalent keeps ALL key shards' accumulator state resident on
-a device mesh and replaces the network shuffle with one
-`jax.lax.all_to_all` over ICI inside the jitted step:
+a device mesh — window/join accumulator state never leaves HBM between
+micro-batches — and replaces the network shuffle with an exchange tier
+chosen per deployment (`tpu.mesh_exchange`, default `auto`):
 
     host: rows -> global slots   [MeshSlotDirectory: hash keys to an
-                                  owning shard; per-shard directories
-                                  assign local slots]
-    device (shard_map over 1-D "keys" mesh):
-        scatter-reduce into the local accumulator shard, rows arriving
-        either pre-routed (host-fed dst-major [S, R] packing — the
-        sharded host->device transfer IS the shuffle) or via an in-step
-        all_to_all over ICI ([S, S, R] src-major packing, for
-        device-resident producers and the multi-host shuffle)
-    emission: jitted (shard, slot) gather -> host, once per watermark
+                                  owning shard (splitmix64, the same
+                                  routing contract as the host shuffle
+                                  and `device_owners_for` below);
+                                  per-shard directories assign locals]
 
-One jitted step per batch; state never leaves HBM between batches. This is
-an *engine execution mode*, not a demo: window operators construct this
-pair when `tpu.mesh_devices >= 2` (operators/windows.py) and run their
-normal assign/update/gather/checkpoint protocol against it — global slots
-encode (shard, local slot) so every Accumulator API carries over.
+  * `device` — the GSPMD device-resident keyed exchange (real chip
+    meshes). ONE fused route+scatter+reduce jitted program takes the
+    src-major packed buffer ([S, C]: rows chopped positionally across
+    source shards, `NamedSharding` over the 1-D "keys" mesh), derives
+    each row's owner shard from its global slot ON DEVICE, positions
+    rows into the [S, R] all_to_all cells with a one-hot rank cumsum,
+    exchanges them over ICI (`jax.lax.all_to_all` — the collective XLA
+    compiles into the step), and scatter-reduces into the local state
+    shard. Duplicate slots reduce IN the scatter, so the steady-state
+    path has NO host combiner: host work per flush is a concatenate,
+    a pad and a bincount (cell-rung sizing).
+
+  * `host_fed` — the fallback exchange (multi-process meshes without
+    ICI collectives, and single-process VIRTUAL meshes — see below).
+    Rows are pre-reduced by the host combiner (one row per touched
+    slot per flush) and hash-routed at packing time into a dst-major
+    [S, R] buffer: the sharded host->device transfer IS the shuffle
+    and the step has no collective at all.
+
+  * `a2a` — the legacy host-packed [S, S, R] src-major layout with the
+    in-step all_to_all (kept for device-resident producers that are
+    already sharded by source, and as the shard_map exchange tier the
+    multihost tests drive).
+
+    emission: jitted (shard, slot) gather -> host, once per watermark
+    wave, chunked at `tpu.mesh_emission_chunk` and padded on the
+    sticky emission rung ladder (see _StickyRung).
+
+Why `auto` resolves to `host_fed` on a virtual mesh: under
+`--xla_force_host_platform_device_count=N` every "device" is the same
+host CPU — the all_to_all is a memcpy between buffers of one process,
+XLA-CPU scatters execute serially, and S shards' route work shares one
+core, so on-device routing costs strictly more than routing in the
+packing pass while buying zero parallelism. On a real chip mesh the
+same routing is S-way parallel and the collective rides ICI, which is
+where the `device` tier wins (and why it is the default there).
+
+Shape discipline (the round-11 ledger's lesson — 52 XLA compiles cost
+1.7s of a 2.4s mesh run): jitted programs are cached PROCESS-WIDE and
+shared by every accumulator with the same physical layout (the two
+identical hop-count stages of nexmark q5 trace one program set, not
+two), and all padding rungs are chosen by sticky hysteresis ladders
+(_StickyRung) so steady state locks onto one shape per program instead
+of re-specializing on every flush's row-count wander.
+
+This is an *engine execution mode*, not a demo: window operators
+construct this pair when `tpu.mesh_devices >= 2` (operators/windows.py)
+and run their normal assign/update/gather/checkpoint protocol against
+it — global slots encode (shard, local slot) so every Accumulator API
+carries over, and checkpoint capture (snapshot/gather) flushes pending
+micro-batched rows before reading, which keeps chaos drills
+byte-identical across exchange tiers.
 """
 
 from __future__ import annotations
@@ -38,7 +81,6 @@ from ..ops.aggregates import (
     AggSpec,
     _bucket,
     _neutral,
-    _np_dtype,
 )
 from ..ops.directory import SlotDirectory
 from ..types import hash_arrays, hash_column, server_for_hash_array
@@ -377,25 +419,23 @@ class MeshSlotDirectory:
                 self.dirs[shard].free.extend(locs[sel].tolist())
 
 
-def _pow2_ladder(cap: int, floor: int = 16) -> tuple:
+def _pow2_ladder(cap: int, floor: int = 16, fine_from: int = 512) -> tuple:
     """Bucket rungs from `floor` up to and including `cap`: power-of-2 at
-    the very bottom, then progressively finer fractional steps as the
-    octaves grow — quarter rungs (x1.25/x1.5/x1.75) from 32, eighth rungs
-    from 128, sixteenth rungs from 512. Worst-case bucket overshoot is
-    bounded by the rung spacing: 100% below 32, 25% to 128, 12.5% to 512,
-    6.25% above — so the large packed buffers, where padded rows actually
-    cost host->device/ICI bytes, average ~3% padding while the tiny
-    buffers near the floor keep the compiled-program count low. The extra
-    rungs cost one XLA program each only when actually hit, and compiled
-    programs persist across processes (tpu.compilation_cache_dir)."""
+    the bottom, then eighth rungs (x1.125 steps) from `fine_from` so the
+    large packed buffers — where padded rows actually cost
+    host->device/ICI bytes — overshoot by at most 12.5%. Coarser than
+    the round-5 sixteenth ladder on purpose: every DISTINCT rung a run
+    hits costs a python-side trace + XLA compile per process (~15-45ms
+    each — the round-11 ledger's dominant mesh cost), and rung WANDER is
+    now absorbed by _StickyRung hysteresis rather than by ladder
+    density. Compiled programs persist across processes
+    (tpu.compilation_cache_dir); the python trace does not."""
     rb, b = [], floor
     while b < cap:
         rb.append(b)
-        if b >= 512:
-            num, denom = range(17, 32), 16
-        elif b >= 128:
+        if b >= fine_from:
             num, denom = range(9, 16), 8
-        elif b >= 32:
+        elif b >= max(32, fine_from // 8):
             num, denom = range(5, 8), 4
         else:
             num, denom = (), 1
@@ -403,6 +443,141 @@ def _pow2_ladder(cap: int, floor: int = 16) -> tuple:
         b *= 2
     rb.append(cap)
     return tuple(sorted(set(x for x in rb if x <= cap)))
+
+
+def _arith_ladder(cap: int, quantum: int, floor: int = 16) -> tuple:
+    """Emission-side ladder: power-of-2 below `quantum`, then arithmetic
+    multiples of `quantum` up to `cap`. Big watermark waves (the
+    sliding-merge unions, where padded slots cost real gather work +
+    device->host bytes) overshoot by at most `quantum` rows — under 5%
+    average for waves a few quanta deep — while the signature count
+    stays hard-bounded at cap/quantum + log2(quantum/floor)."""
+    rb = []
+    b = floor
+    while b < quantum:
+        rb.append(b)
+        b *= 2
+    rb.extend(range(quantum, cap + 1, quantum))
+    if rb[-1] != cap:
+        rb.append(cap)
+    return tuple(sorted(set(x for x in rb if x <= cap)))
+
+
+class _StickyRung:
+    """Quantize a stream of buffer sizes onto a ladder with hysteresis.
+
+    A fresh shape signature re-traces and re-compiles its jitted program
+    (~15-45ms on CPU-jax, 20-40s through the TPU relay) — worth ~20+
+    steady-state dispatches — so the rung must not follow every flush's
+    row-count wander (the round-11 ledger shows mesh.step_direct
+    specializing 14 ways in ONE bench child exactly that way). fit(n)
+    reuses the current rung while n fits; on overflow it climbs straight
+    to bucket(n); after `decay_after` consecutive fits below half the
+    rung it steps down one rung, so a permanently shrunken workload
+    stops shipping 2x filler but a single small flush changes nothing."""
+
+    __slots__ = ("ladder", "rung", "_low", "decay_after", "headroom")
+
+    def __init__(self, ladder: tuple, decay_after: int = 8,
+                 headroom: float = 1.25):
+        self.ladder = ladder
+        self.rung = 0
+        self._low = 0
+        self.decay_after = decay_after
+        self.headroom = headroom
+
+    def fit(self, n: int) -> int:
+        if n > self.rung:
+            # climb with headroom: a ramping workload (window cardinality
+            # growing through the run) would otherwise walk EVERY ladder
+            # rung on its way up, tracing each once — the exact signature
+            # storm the ladder coarsening fights. Successive climbs are
+            # geometric in the headroom factor, so a KxX ramp costs
+            # ~log(K)/log(headroom*step) climbs; the overshoot decays
+            # back one rung at a time once sizes settle.
+            self.rung = _bucket(
+                n if self.rung == 0 else int(n * self.headroom),
+                self.ladder,
+            )
+            self._low = 0
+            return self.rung
+        if n <= self.rung // 2:
+            self._low += 1
+            if self._low >= self.decay_after:
+                i = self.ladder.index(self.rung) if self.rung in \
+                    self.ladder else 0
+                if i > 0:
+                    self.rung = self.ladder[i - 1]
+                self._low = 0
+        else:
+            self._low = 0
+        return self.rung
+
+
+# -- device-side owner hashing ------------------------------------------------
+#
+# The routing contract (PAPER §2.9-2.11): a row's owning shard is
+# server_for_hash(splitmix64-combine(per-column splitmix64), n_shards).
+# MeshSlotDirectory.owners_for computes it host-side (numpy) at assign
+# time; device_owners_for is the jax mirror used ON DEVICE wherever rows
+# carry raw key words instead of pre-assigned slots (device-resident
+# producers feeding the route step, multi-host shuffles). The two MUST
+# agree bit-for-bit — tests/test_parallel.py property-tests them against
+# each other across shard counts.
+
+_U64_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _jax_splitmix64(jnp, x):
+    """splitmix64 finalizer over uint64 lanes (types._splitmix64)."""
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15)) & jnp.uint64(_U64_MASK)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def device_owners_for(key_cols, n_shards: int):
+    """Owner shard per row from int64/uint64 key-word columns, computed
+    with jax ops (traceable inside jitted route steps). Mirrors
+    MeshSlotDirectory.owners_for = server_for_hash_array(hash_arrays(
+    [hash_column(c) for c in cols])) exactly: per-column splitmix64,
+    seeded xor-mix combine, then the contiguous hash-range map."""
+    from ..types import HASH_SEED, _range_size
+
+    from .mesh import _get_jnp
+
+    jnp = _get_jnp()
+    if not key_cols:
+        return jnp.zeros(0, dtype=jnp.int64)
+    cols = [jnp.asarray(c).astype(jnp.uint64) for c in key_cols]
+    out = jnp.full(cols[0].shape, jnp.uint64(int(HASH_SEED)),
+                   dtype=jnp.uint64)
+    for col in cols:
+        out = _jax_splitmix64(jnp, out ^ _jax_splitmix64(jnp, col))
+    if n_shards == 1:
+        return jnp.zeros(cols[0].shape, dtype=jnp.int64)
+    owners = (out // jnp.uint64(_range_size(n_shards))).astype(jnp.int64)
+    return jnp.minimum(owners, n_shards - 1)
+
+
+# -- process-wide jitted program cache ----------------------------------------
+#
+# Jitted mesh programs are pure functions of (mesh, physical layout,
+# capacity, mode flags): two accumulators with the same key — e.g. the
+# two identical hop-count stages of nexmark q5 — must share ONE traced
+# program set, not trace it twice (q5's per-child compile bill halves).
+# key_mesh() caches Mesh instances so `id(mesh)` is a stable cache
+# component; entries hold the InstrumentedJit wrapper so compile/dispatch
+# telemetry is shared too.
+
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _shared_program(key: tuple, build):
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = _PROGRAMS.setdefault(key, build())
+    return prog
 
 
 def _get_shard_map():
@@ -430,7 +605,7 @@ def _donate_state() -> tuple:
         return ()
 
 
-def _scatter_body(phys, jnp):
+def _scatter_body(phys, jnp, neutral=_neutral):
     """Shared per-shard scatter-reduce: applies (flat_slots, valid, vals)
     rows into each physical accumulator row. Rows arrive PRE-REDUCED by
     the host combiner (one row per slot per flush): `valid` carries the
@@ -449,7 +624,7 @@ def _scatter_body(phys, jnp):
                 v = vals_r[vi]
                 vi += 1
                 if op != "add":
-                    v = jnp.where(valid_r != 0, v, _neutral(op, dt))
+                    v = jnp.where(valid_r != 0, v, neutral(op, dt))
             if op == "add":
                 row = row.at[flat_slots].add(v.astype(row.dtype))
             elif op == "min":
@@ -583,52 +758,81 @@ class ShardedAccumulator(Accumulator):
         host_fed: bool = True,
         salted: bool = False,
         flush_rows: int = 0,
+        exchange: Optional[str] = None,
     ):
         # initialize host-side bookkeeping via the base class with backend
         # 'numpy' (cheap), then replace the state with mesh-sharded arrays
         super().__init__(specs, capacity=capacity_per_shard, backend="numpy")
+        from ..config import config as config_fn
+
         self.backend = "jax-mesh"
+        # honor tpu.use_32bit_accumulators exactly like the single-device
+        # jax backend (the base ctor only engages it for backend "jax"):
+        # halves state bytes, transfer bytes and scatter width on v5e
+        self.use32 = bool(
+            getattr(config_fn().tpu, "use_32bit_accumulators", False)
+        )
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = mesh.devices.size
         self.rows_per_shard = rows_per_shard
-        # per-cell row counts are bucketed so the packed [S, S, R] buffer
-        # is sized to the BATCH, not to the configured maximum: a 8192-row
-        # batch on 8 shards packs into R=128 (8192 rows total) instead of
-        # the old fixed R=1024 (65536 rows, 87% padding). Power-of-2 rungs
-        # cap padding at 50% past the floor and bound the distinct
-        # compiled step programs at log2(rows_per_shard/16) + 1 per
-        # accumulator layout; in steady state only the rungs matching the
-        # pipeline's characteristic batch sizes ever compile.
-        # floor 2: post-combiner flushes can be a handful of rows (the
-        # salted low-cardinality stage combines a whole flush down to
-        # its few windows), and the old floor of 16 made such dispatches
-        # ship 8x-64x filler
-        self._r_buckets = _pow2_ladder(rows_per_shard, floor=2)
-        # batches that arrive from the HOST are already globally visible,
-        # so the hash-shuffle can happen in numpy at packing time: rows
-        # are laid out dst-major [S, R] and the sharded transfer routes
-        # each shard's block straight to its device — no all_to_all, and
-        # the buffer is S x smaller than the [S, S, R] exchange layout.
-        # The all_to_all path remains for device-resident producers
-        # (chained device operators, multi-host ICI shuffle) where rows
-        # are born sharded by SOURCE and must route by KEY on-device.
-        self.host_fed = host_fed
-        self._r_buckets_direct = _pow2_ladder(
-            rows_per_shard * self.n_shards, floor=2
+        # packing-rung ladders (eighth rungs up top, ≤12.5% overshoot)
+        # with sticky hysteresis per layout: steady state locks onto one
+        # shape per program instead of re-specializing per flush
+        self._rung_direct = _StickyRung(
+            _pow2_ladder(rows_per_shard * self.n_shards, floor=16)
         )
-        # emission/reset/restore padding uses the accumulator's OWN
-        # power-of-2 ladder rather than the coarse global
-        # tpu.shape_buckets (whose big rungs exist for the TPU-relay
-        # compile budget of the single-device path): a ~2k-slot
-        # watermark gather padded to an 8192 bucket wastes 4x gather
-        # work + device->host bytes per emission. Plain pow2 (not the
-        # fine fractional rungs of the packing ladders): gather padding
-        # is cheap index work, while every distinct shape costs a
-        # python-side trace per process — emission sizes vary per wave,
-        # so coarse rungs keep the program count (and per-run fixed
-        # tracing cost) low where fine rungs buy nothing.
-        self._buckets = tuple(1 << i for i in range(4, 21))
+        self._rung_a2a = _StickyRung(_pow2_ladder(rows_per_shard, floor=2))
+        # device-routed exchange rungs: C = src-major rows per source
+        # shard, R = all_to_all cell rows (sized from the host bincount)
+        self._rung_chunk = _StickyRung(
+            _pow2_ladder(max(rows_per_shard, 16), floor=16, fine_from=1 << 30)
+        )
+        self._rung_cell = _StickyRung(
+            _pow2_ladder(max(rows_per_shard, 16), floor=16)
+        )
+        # multi-host: the mesh may span devices owned by several
+        # processes (jax.distributed — parallel/multihost.py). All host
+        # buffers then enter the device as GLOBAL arrays (each process
+        # materializes only its addressable shards) and every mesh
+        # process runs the same steps in lockstep.
+        from .multihost import is_multiprocess_mesh
+
+        self._multiproc = is_multiprocess_mesh(mesh)
+        # exchange tier: 'device' (fused GSPMD route+scatter+reduce, no
+        # host combiner), 'host_fed' (combiner + dst-major [S, R] packed
+        # transfer — the multi-process / virtual-mesh fallback), 'a2a'
+        # (host-packed [S, S, R] + in-step all_to_all). See module
+        # docstring for the auto-resolution rationale.
+        self._exchange = self._resolve_exchange(exchange, host_fed)
+        self.host_fed = self._exchange == "host_fed"
+        # emission/reset/restore reads are chunked at
+        # tpu.mesh_emission_chunk and padded on their own sticky ladder:
+        # big drain waves re-use the full-chunk program instead of
+        # specializing a fresh XLA program per wave size, and steady
+        # waves ride one rung with ≤12.5% filler (quarter/eighth rungs
+        # from 256) — the round-11 ledger's "emission-rung padding"
+        self._emission_chunk = int(
+            getattr(config_fn().tpu, "mesh_emission_chunk", 16384) or 16384
+        )
+        from .mesh import mesh_is_virtual
+
+        if mesh_is_virtual(mesh):
+            # virtual mesh: the bottleneck is the per-process python
+            # trace each distinct rung costs, not padded bytes (padded
+            # slots gather at ~50ns each on the shared host core) — two
+            # rungs bound the emission program count at 2 per kind
+            self._buckets = (max(self._emission_chunk // 8, 16),
+                             self._emission_chunk)
+        else:
+            # real chip mesh: device->host bytes and 20-40s TPU-relay
+            # compiles both matter; quantum rungs keep steady waves
+            # under ~5% padding at a hard-bounded signature count
+            self._buckets = _arith_ladder(
+                self._emission_chunk, max(self._emission_chunk // 16, 64)
+            )
+        # owner-sliced emission rung: per-shard slice length (~wave/S)
+        self._rung_slice = _StickyRung(_pow2_ladder(1 << 20, floor=16))
         # salted mode (SharedMeshSlotDirectory): update rows spread
         # row-position round-robin across ALL shards at the slot's local
         # index — perfectly balanced regardless of key skew — and gather
@@ -655,22 +859,43 @@ class ShardedAccumulator(Accumulator):
         # auto-tunes to >= 4 batches so a configured threshold below the
         # pipeline's natural batch size still coalesces dispatches
         self._ewma_rows = 0
-        # multi-host: the mesh may span devices owned by several
-        # processes (jax.distributed — parallel/multihost.py). All host
-        # buffers then enter the device as GLOBAL arrays (each process
-        # materializes only its addressable shards) and every mesh
-        # process runs the same steps in lockstep.
-        from .multihost import is_multiprocess_mesh
-
-        self._multiproc = is_multiprocess_mesh(mesh)
         self._sharding = self._make_sharding()
         self.state = self._fresh_state(capacity_per_shard)
-        self._step = self._make_step()
-        self._direct_step = self._make_direct_step()
-        self._mesh_gather_fn = None
-        self._mesh_take_fn = None
-        self._mesh_reset_fn = None
-        self._mesh_restore_fn = None
+
+    def _resolve_exchange(self, exchange: Optional[str],
+                          host_fed: bool) -> str:
+        """Pick the exchange tier. Explicit ctor/config choices win; auto
+        keeps the host-fed combiner path wherever the device-routed
+        exchange cannot pay for itself: multi-process meshes (no ICI
+        collectives under the CPU backend) and single-process VIRTUAL
+        meshes (every "device" is the same host core — see module
+        docstring). Real chip meshes default to the device route."""
+        from ..config import config as config_fn
+        from .mesh import mesh_is_virtual
+
+        mode = exchange or str(
+            getattr(config_fn().tpu, "mesh_exchange", "auto") or "auto"
+        )
+        if mode not in ("auto", "device", "host_fed", "a2a"):
+            raise ValueError(
+                f"tpu.mesh_exchange must be auto|device|host_fed|a2a, "
+                f"got {mode!r}"
+            )
+        if mode != "auto":
+            return mode
+        if not host_fed:
+            return "a2a"  # ctor opt-in to the src-major packed layout
+        if self._multiproc or mesh_is_virtual(self.mesh):
+            return "host_fed"
+        return "device"
+
+    def _program(self, kind: str, build, *extra):
+        """Process-wide shared jitted program for this accumulator's
+        layout: identical stages (same mesh, phys ops/dtypes, capacity,
+        salted/multiproc mode) resolve to ONE traced program set."""
+        key = (kind, id(self.mesh), self.capacity, tuple(self.phys),
+               self.salted, self._multiproc, self.use32, *extra)
+        return _shared_program(key, build)
 
     def _make_sharding(self):
         from jax.sharding import NamedSharding
@@ -689,8 +914,8 @@ class ShardedAccumulator(Accumulator):
             put_global(
                 np.full(
                     (self.n_shards, capacity),
-                    _neutral(op, dt),
-                    dtype=_np_dtype(dt),
+                    self._neutral(op, dt),
+                    dtype=self._dt(dt),
                 ),
                 self.mesh,
                 P(self.axis, None),
@@ -743,16 +968,18 @@ class ShardedAccumulator(Accumulator):
         # of a global sharded array with a process-local pad is not).
         # grow() is rare (4x capacity steps), so a compile per call is
         # acceptable; a single program per grow beats one per column.
+        neutral, dtype = self._neutral, self._dt
+
         @partial(jax.jit, donate_argnums=_donate_state(), out_shardings=self._sharding)
         def grow_fn(state):
             out = []
             for (op, dt, _, _), x in zip(phys, state):
                 pad = jnp.full(
-                    (n_shards, new_cap - old_cap), _neutral(op, dt),
-                    dtype=_np_dtype(dt),
+                    (n_shards, new_cap - old_cap), neutral(op, dt),
+                    dtype=dtype(dt),
                 )
                 g = jnp.concatenate([x, pad], axis=1)
-                out.append(g.at[:, old_cap - 1].set(_neutral(op, dt)))
+                out.append(g.at[:, old_cap - 1].set(neutral(op, dt)))
             return out
 
         self.state = grow_fn(list(self.state))
@@ -807,13 +1034,14 @@ class ShardedAccumulator(Accumulator):
 
     def _flush_threshold(self) -> int:
         """Effective micro-batch threshold: the configured
-        tpu.mesh_flush_rows, auto-raised to ~4 observed engine batches
+        tpu.mesh_flush_rows, auto-raised to ~8 observed engine batches
         (bounded) so a threshold tuned for one workload still coalesces
-        dispatches when the pipeline feeds bigger batches. 0 disables
-        buffering entirely (immediate dispatch)."""
+        dispatches when the pipeline feeds bigger batches — watermark
+        waves force a flush anyway, so between waves bigger is cheaper.
+        0 disables buffering entirely (immediate dispatch)."""
         if self.flush_rows <= 0:
             return 0
-        return max(self.flush_rows, min(4 * self._ewma_rows, 1 << 20))
+        return max(self.flush_rows, min(8 * self._ewma_rows, 1 << 20))
 
     def _flush_if_touches(self, slots: np.ndarray):
         """Flush pending update rows only when one could affect `slots`.
@@ -826,6 +1054,14 @@ class ShardedAccumulator(Accumulator):
             return
         slots = np.asarray(slots)
         if len(slots):
+            # the isin probe sorts both sides — against a large raw
+            # pending buffer it costs more than the dispatch it might
+            # save, and emission reads of hot bins nearly always overlap
+            # pending rows anyway. Probe only when it is cheap AND has a
+            # real chance of eliding; otherwise just flush.
+            if self._pending_rows * len(slots) > (1 << 22):
+                self.flush()
+                return
             for p_slots, _, _ in self._pending:
                 if np.isin(p_slots, slots, assume_unique=False).any():
                     self.flush()
@@ -927,6 +1163,12 @@ class ShardedAccumulator(Accumulator):
 
     def _dispatch_rows(self, slots: np.ndarray, vals: List[np.ndarray],
                        signs: Optional[np.ndarray]):
+        if self._exchange == "device":
+            # GSPMD device-routed exchange: raw rows ship src-major, the
+            # fused route+scatter+reduce program owns routing AND the
+            # duplicate-slot reduction — no host combiner on this path
+            self._dispatch_rows_device(slots, vals, signs)
+            return
         slots, vals, signs = self._prereduce(slots, vals, signs)
         n = len(slots)
         S, R = self.n_shards, self.rows_per_shard
@@ -949,21 +1191,21 @@ class ShardedAccumulator(Accumulator):
                 in_chunk = chunk == c
                 rows = order[in_chunk]
                 pm = pos[in_chunk] - c * r_cap
-                r_c = _bucket(int(pm.max()) + 1, self._r_buckets_direct)
+                r_c = self._rung_direct.fit(int(pm.max()) + 1)
                 flat = so[in_chunk] * r_c + pm
                 self._note_traffic(len(rows), S * r_c,
                                    "mesh.step_direct", r_c)
-                self._dispatch(self._direct_step, (S, r_c), rows, flat,
+                self._dispatch(self._direct_step(), (S, r_c), rows, flat,
                                locals_, vals, signs)
             return
         # Balanced packing into the [src, dst, row] all_to_all layout:
         # each destination shard's rows are dealt round-robin across the
         # S source positions, so every (src, dst) cell carries
         # ceil(count_dst / S) rows and the per-cell row budget R shrinks
-        # to the bucketed max — the buffer is sized to the batch (plus
-        # skew), not to the configured ceiling. Splits into multiple
-        # steps only when the hottest destination overflows S *
-        # rows_per_shard rows.
+        # to the sticky-bucketed max — the buffer is sized to the batch
+        # (plus skew), not to the configured ceiling. Splits into
+        # multiple steps only when the hottest destination overflows
+        # S * rows_per_shard rows.
         srcs = pos % S
         cell = pos // S                               # row within cell
         chunk = cell // R
@@ -971,11 +1213,68 @@ class ShardedAccumulator(Accumulator):
             in_chunk = chunk == c
             rows = order[in_chunk]
             cm = cell[in_chunk] - c * R
-            r_c = _bucket(int(cm.max()) + 1, self._r_buckets)
+            r_c = self._rung_a2a.fit(int(cm.max()) + 1)
             flat = (srcs[in_chunk] * S + so[in_chunk]) * r_c + cm
             self._note_traffic(len(rows), S * S * r_c, "mesh.step", r_c)
-            self._dispatch(self._step, (S, S, r_c), rows, flat, locals_,
+            self._dispatch(self._step(), (S, S, r_c), rows, flat, locals_,
                            vals, signs)
+
+    def _dispatch_rows_device(self, slots: np.ndarray,
+                              vals: List[np.ndarray],
+                              signs: Optional[np.ndarray]):
+        """Device-routed exchange: pack RAW rows src-major (a positional
+        [S, C] chop — no argsort, no combiner, no per-owner layout) and
+        let the fused route+scatter+reduce program derive owners, build
+        the all_to_all cells and reduce duplicates on device. Host work
+        per flush: one pad + one bincount (cell-rung sizing)."""
+        n = len(slots)
+        S = self.n_shards
+        cap = self.capacity
+        C = self._rung_chunk.fit(-(-n // S))      # rows per source shard
+        N = C * S
+        # padding rows: owner spread evenly, local = scratch, valid 0
+        enc = np.empty(N, dtype=np.int64)
+        enc[:n] = slots
+        pad_pos = np.arange(n, N, dtype=np.int64)
+        enc[n:] = (pad_pos % S) * STRIDE + (cap - 1)
+        valid = np.zeros(N, dtype=np.int64)
+        valid[:n] = 1 if signs is None else signs
+        if self.salted:
+            # positional round-robin spread: every (src, dst) cell holds
+            # exactly ceil(C / S) rows — no skew, no bincount
+            R = -(-C // S)
+        else:
+            owners = enc // STRIDE
+            srcs = np.arange(N, dtype=np.int64) // C
+            R = self._rung_cell.fit(
+                int(np.bincount(srcs * S + owners,
+                                minlength=S * S).max())
+            )
+            R = min(R, C)
+        inputs = []
+        vi = 0
+        for op, dt, src, si in self.phys:
+            if src == "one":
+                continue
+            v = np.full(
+                N,
+                0 if op == "add" else self._neutral(op, dt),
+                dtype=self._dt(dt),
+            )
+            v[:n] = vals[vi]
+            vi += 1
+            inputs.append(self._to_dev(v.reshape(S, C), True))
+        MESH_STATS["dispatches"] += 1
+        # exchange-layer filler: rung padding (N - n) plus all_to_all
+        # cell padding (S*S*R - N); both ride the collective
+        self._note_traffic(n, max(S * S * R, N), "mesh.route", R)
+        self.state = self._route_step(C, R)(
+            self.state,
+            self._to_dev(enc.reshape(S, C), True),
+            self._to_dev(valid.reshape(S, C), True),
+            *inputs,
+            rung=R,
+        )
 
     def _note_traffic(self, sent: int, shipped: int,
                       program: str = "mesh.step", rung: int = 0):
@@ -1006,8 +1305,8 @@ class ShardedAccumulator(Accumulator):
                 continue
             v = np.full(
                 total,
-                0 if op == "add" else _neutral(op, dt),
-                dtype=_np_dtype(dt),
+                0 if op == "add" else self._neutral(op, dt),
+                dtype=self._dt(dt),
             )
             # sign application happens in-kernel: add-sources multiply by
             # valid (0 padding / ±1 append-retract)
@@ -1022,6 +1321,16 @@ class ShardedAccumulator(Accumulator):
             rung=shape[-1],
         )
 
+    def _step(self):
+        return self._program("step", self._make_step)
+
+    def _direct_step(self):
+        return self._program("step_direct", self._make_direct_step)
+
+    def _route_step(self, C: int, R: int):
+        return self._program("route", lambda: self._make_route_step(C, R),
+                             C, R)
+
     def _make_step(self):
         import jax
 
@@ -1031,7 +1340,7 @@ class ShardedAccumulator(Accumulator):
         phys = list(self.phys)
         axis = self.axis
 
-        scatter = _scatter_body(phys, jnp)
+        scatter = _scatter_body(phys, jnp, self._neutral)
 
         def local_update(state_shards, slots, valid, *vals):
             # local views: state [1, cap]; slots/valid/vals [1, S, R] where
@@ -1065,7 +1374,7 @@ class ShardedAccumulator(Accumulator):
             )
             return list(f(tuple(state), slots, valid, *vals))
 
-        return obs_device.InstrumentedJit("mesh.step", step)
+        return obs_device.InstrumentedJit("mesh.step", step, exchange=True)
 
     def _make_direct_step(self):
         """Step for host-fed dst-major [S, R] batches: rows were routed to
@@ -1078,7 +1387,7 @@ class ShardedAccumulator(Accumulator):
         jnp = _get_jnp()
         phys = list(self.phys)
         axis = self.axis
-        scatter = _scatter_body(phys, jnp)
+        scatter = _scatter_body(phys, jnp, self._neutral)
 
         def local_update(state_shards, slots, valid, *vals):
             # local views: state [1, cap]; slots/valid/vals [1, R] — this
@@ -1106,28 +1415,397 @@ class ShardedAccumulator(Accumulator):
             )
             return list(f(tuple(state), slots, valid, *vals))
 
-        return obs_device.InstrumentedJit("mesh.step_direct", step)
+        return obs_device.InstrumentedJit("mesh.step_direct", step,
+                                          exchange=True)
 
-    # -- drain --------------------------------------------------------------
+    def _make_route_step(self, C: int, R: int):
+        """The fused route+scatter+reduce program of the device-resident
+        keyed exchange. Input rows arrive RAW and src-major ([S, C]: a
+        positional chop of the flush, NamedSharding over the key mesh);
+        per shard the program
 
-    def gather(self, slots: np.ndarray,
-               materialize: bool = True) -> List[np.ndarray]:
-        self._flush_if_touches(slots)
-        self._gather_slots = np.asarray(slots)
-        self._segment_udaf = None
-        self._segment_multiset = None
-        if len(slots) == 0:
-            return [
-                np.empty(0, dtype=_np_dtype(dt))
-                for _, dt, _, _ in self.phys
-            ]
+          1. routes: derives each row's owner from its global slot
+             (shard = slot // STRIDE — the splitmix64 hash assigned at
+             directory time; device_owners_for is the equivalent for
+             raw key words) — salted layouts spread positionally,
+          2. positions: ranks rows within their (src, owner) cell via a
+             one-hot running count and scatters them into the [S, R]
+             send cells (padding cells carry scratch-slot/neutral rows),
+          3. exchanges: `jax.lax.all_to_all` over the mesh axis — the
+             collective XLA compiles into the step, riding ICI on real
+             chip meshes,
+          4. scatter-reduces the received rows into the local state
+             shard; duplicate slots reduce IN the scatter (.add/.min/
+             .max), which is what replaces the host combiner.
+
+        Signs apply in-kernel (add-sources multiply by the valid word;
+        min/max sources replace invalid rows with the op's neutral), so
+        raw retraction rows need no host preprocessing either."""
         import jax
 
-        from .multihost import to_host
+        from .mesh import _get_jnp
 
-        if self._mesh_gather_fn is None:
+        jnp = _get_jnp()
+        phys = list(self.phys)
+        axis = self.axis
+        S = self.n_shards
+        cap = self.capacity
+        salted = self.salted
+        neutral, dtype = self._neutral, self._dt
+
+        def local_route(state_shards, enc, valid, *vals):
+            enc, valid = enc[0], valid[0]
+            vals = [v[0] for v in vals]
+            if salted:
+                pos = jnp.arange(C, dtype=jnp.int64)
+                owner = (pos % S).astype(jnp.int64)
+                rank = pos // S
+            else:
+                owner = enc // STRIDE
+                # rank within (this src chunk, owner): one-hot running
+                # count — dense [C, S] work that vectorizes, where a
+                # per-row scatter-count would serialize
+                oh = owner[:, None] == jnp.arange(S, dtype=enc.dtype)[None, :]
+                rank = jnp.take_along_axis(
+                    jnp.cumsum(oh.astype(jnp.int32), axis=0) - 1,
+                    owner[:, None].astype(jnp.int32), axis=1,
+                )[:, 0].astype(jnp.int64)
+            loc = enc % STRIDE
+            sidx = (owner * R + rank).astype(jnp.int32)
+
+            def exchange(send):
+                return jax.lax.all_to_all(
+                    send.reshape(S, R), axis, 0, 0, tiled=True
+                ).reshape(-1)
+
+            # send cells: padding rows target the scratch slot with
+            # valid 0 / neutral values, so they reduce to no-ops
+            recv_loc = exchange(
+                jnp.full(S * R, cap - 1, dtype=enc.dtype).at[sidx].set(loc)
+            )
+            recv_valid = exchange(
+                jnp.zeros(S * R, dtype=valid.dtype).at[sidx].set(valid)
+            )
+            recv_vals = []
+            vi = 0
+            for op, dt, src, si in phys:
+                if src == "one":
+                    continue
+                fill = 0 if op == "add" else neutral(op, dt)
+                recv_vals.append(exchange(
+                    jnp.full(S * R, fill, dtype=dtype(dt)).at[sidx].set(
+                        vals[vi]
+                    )
+                ))
+                vi += 1
+            # scatter-reduce; duplicate slots fold here (the device-side
+            # combiner): .add sums sign-weighted rows, .min/.max take
+            # extremes over neutral-masked rows
+            out = []
+            vi = 0
+            for (op, dt, src, si), s in zip(phys, state_shards):
+                row = s[0]
+                if src == "one":
+                    v = recv_valid.astype(row.dtype)
+                else:
+                    v = recv_vals[vi]
+                    vi += 1
+                    if op == "add":
+                        v = (v * recv_valid.astype(v.dtype)).astype(
+                            row.dtype
+                        )
+                    else:
+                        v = jnp.where(
+                            recv_valid != 0, v, neutral(op, dt)
+                        ).astype(row.dtype)
+                if op == "add":
+                    row = row.at[recv_loc].add(v)
+                elif op == "min":
+                    row = row.at[recv_loc].min(v)
+                else:
+                    row = row.at[recv_loc].max(v)
+                out.append(row[None, :])
+            return tuple(out)
+
+        n_state = len(self.phys)
+
+        @partial(jax.jit, donate_argnums=_donate_state(), static_argnums=())
+        def step(state, enc, valid, *vals):
+            from jax.sharding import PartitionSpec as P
+
+            f = _get_shard_map()(
+                local_route,
+                mesh=self.mesh,
+                in_specs=(
+                    tuple(P(axis, None) for _ in range(n_state)),
+                    P(axis, None),
+                    P(axis, None),
+                )
+                + tuple(P(axis, None) for _ in vals),
+                out_specs=tuple(P(axis, None) for _ in range(n_state)),
+            )
+            return list(f(tuple(state), enc, valid, *vals))
+
+        return obs_device.InstrumentedJit("mesh.route", step, exchange=True)
+
+    # -- drain --------------------------------------------------------------
+    #
+    # Emission-side programs (gather / fused gather+reset / reset /
+    # restore) are shared process-wide like the steps, their slot
+    # buffers are padded on sticky emission rungs, and every read is
+    # CHUNKED at tpu.mesh_emission_chunk: a 30k-slot end-of-stream
+    # drain re-dispatches the full-chunk program eight times instead of
+    # specializing a fresh XLA program for one 32768-wide wave (the
+    # round-11 ledger counted 16 gather signatures in a single child,
+    # almost all hit exactly once by ramp/drain waves).
+
+    def _emit_rung(self, n: int) -> int:
+        # plain arithmetic-ladder bucket (no hysteresis): emission waves
+        # are the big stable reads, so quantum rungs keep their padding
+        # under ~5% while the signature count stays hard-bounded
+        return min(_bucket(n, self._buckets), self._emission_chunk)
+
+    def _chunk_bounds(self, n: int):
+        step = self._emission_chunk
+        return [(lo, min(lo + step, n)) for lo in range(0, max(n, 1), step)]
+
+    def _pad_slots(self, sh, loc, lo, hi, rung):
+        sh_p = np.zeros(rung, dtype=np.int64)
+        loc_p = np.full(rung, self.capacity - 1, dtype=np.int64)
+        sh_p[: hi - lo] = sh[lo:hi]
+        loc_p[: hi - lo] = loc[lo:hi]
+        return sh_p, loc_p
+
+    # -- owner-sliced emission ------------------------------------------------
+    #
+    # The replicated-index emission programs (plain jit, state sharded,
+    # indices replicated) make EVERY shard scan EVERY index — the SPMD
+    # partitioner's scatter/gather strategy — so a 16k-slot wave costs
+    # S x 16k serial index ops on a virtual mesh (measured: 7ms per
+    # gather_free dispatch, the single largest mesh cost at 1M events).
+    # The owner-sliced path sorts the wave's slots by owner shard ON THE
+    # HOST (one argsort per wave — the routing information is free in
+    # the slot encoding) and hands each shard ONLY its own [1, L] slice
+    # through shard_map, cutting device work back to ~n + padding. Host
+    # reorders the gathered block back to union order with one fancy
+    # index. Salted accumulators keep the replicated programs (the
+    # cross-shard fold genuinely needs every shard per slot), as do
+    # multi-process meshes (outputs must land replicated on every host).
+
+    def _sliced_ok(self) -> bool:
+        return not self.salted and not self._multiproc
+
+    def _slice_rung(self, n_max: int) -> int:
+        return self._rung_slice.fit(max(n_max, 1))
+
+    def _slice_pack(self, slots: np.ndarray, extras=(), fills=()):
+        """Sort the wave by owner shard and pack per-shard [S, L] index
+        buffers (padding rows target the scratch slot). `extras` are
+        row-aligned companion arrays (masks, restore values) packed the
+        same way with their `fills`. Returns (loc_sl, extra_sls,
+        flat_pos, L) where flat_pos[i] is row i's position in the
+        flattened [S*L] device output."""
+        S = self.n_shards
+        slots = np.asarray(slots)
+        sh, loc = self._decompose(slots)
+        order = np.argsort(sh, kind="stable")
+        sh_s = sh[order]
+        counts = np.bincount(sh_s, minlength=S)
+        L = self._slice_rung(int(counts.max()))
+        starts = np.zeros(S, dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        rank = np.arange(len(slots), dtype=np.int64) - starts[sh_s]
+        flat_sorted = sh_s * L + rank
+        loc_sl = np.full(S * L, self.capacity - 1, dtype=np.int64)
+        loc_sl[flat_sorted] = loc[order]
+        extra_sls = []
+        for arr, fill in zip(extras, fills):
+            arr = np.asarray(arr)
+            e = np.full(S * L, fill, dtype=arr.dtype)
+            e[flat_sorted] = arr[order]
+            extra_sls.append(e.reshape(S, L))
+        flat_pos = np.empty(len(slots), dtype=np.int64)
+        flat_pos[order] = flat_sorted
+        return loc_sl.reshape(S, L), extra_sls, flat_pos, L
+
+    def _sliced_gather_program(self):
+        def build():
+            import jax
+
+            axis = self.axis
+            n_state = len(self.phys)
+
+            def local(state_shards, loc):
+                return tuple(s[0][loc[0]][None, :] for s in state_shards)
+
+            @jax.jit
+            def fn(state, loc):
+                from jax.sharding import PartitionSpec as P
+
+                f = _get_shard_map()(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(
+                        tuple(P(axis, None) for _ in range(n_state)),
+                        P(axis),
+                    ),
+                    out_specs=tuple(P(axis) for _ in range(n_state)),
+                )
+                return list(f(tuple(state), loc))
+
+            return obs_device.InstrumentedJit("mesh.sgather", fn)
+
+        return self._program("sgather", build)
+
+    def _sliced_take_program(self):
+        """Fused sliced gather + masked reset: serves gather_and_reset
+        (mask all-ones) and the sliding drain's gather+free (mask =
+        freed-bin rows) with ONE program per slice rung."""
+        def build():
+            import jax
+
+            axis = self.axis
+            phys = list(self.phys)
+            neutral = self._neutral
+            cap = self.capacity
+            n_state = len(self.phys)
+
+            def local(state_shards, loc, free):
+                outs, new = [], []
+                loc_r = None
+                for (op, dt, _, _), s in zip(phys, state_shards):
+                    row = s[0]
+                    outs.append(row[loc[0]][None, :])
+                    if loc_r is None:
+                        from .mesh import _get_jnp
+
+                        jnp = _get_jnp()
+                        loc_r = jnp.where(free[0] != 0, loc[0], cap - 1)
+                    new.append(row.at[loc_r].set(neutral(op, dt))[None, :])
+                return tuple(outs), tuple(new)
+
+            @partial(jax.jit, donate_argnums=_donate_state())
+            def fn(state, loc, free):
+                from jax.sharding import PartitionSpec as P
+
+                f = _get_shard_map()(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(
+                        tuple(P(axis, None) for _ in range(n_state)),
+                        P(axis),
+                        P(axis),
+                    ),
+                    out_specs=(
+                        tuple(P(axis) for _ in range(n_state)),
+                        tuple(P(axis, None) for _ in range(n_state)),
+                    ),
+                )
+                outs, new = f(tuple(state), loc, free)
+                return list(outs), list(new)
+
+            return obs_device.InstrumentedJit("mesh.stake", fn)
+
+        return self._program("stake", build)
+
+    def _sliced_reset_program(self):
+        def build():
+            import jax
+
+            axis = self.axis
+            phys = list(self.phys)
+            neutral = self._neutral
+            n_state = len(self.phys)
+
+            def local(state_shards, loc):
+                return tuple(
+                    s[0].at[loc[0]].set(neutral(op, dt))[None, :]
+                    for (op, dt, _, _), s in zip(phys, state_shards)
+                )
+
+            @partial(jax.jit, donate_argnums=_donate_state())
+            def fn(state, loc):
+                from jax.sharding import PartitionSpec as P
+
+                f = _get_shard_map()(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(
+                        tuple(P(axis, None) for _ in range(n_state)),
+                        P(axis),
+                    ),
+                    out_specs=tuple(P(axis, None) for _ in range(n_state)),
+                )
+                return list(f(tuple(state), loc))
+
+            return obs_device.InstrumentedJit("mesh.sreset", fn)
+
+        return self._program("sreset", build)
+
+    def _sliced_restore_program(self):
+        def build():
+            import jax
+
+            axis = self.axis
+            n_state = len(self.phys)
+
+            def local(state_shards, loc, *vals):
+                return tuple(
+                    s[0].at[loc[0]].set(v[0])[None, :]
+                    for s, v in zip(state_shards, vals)
+                )
+
+            @partial(jax.jit, donate_argnums=_donate_state())
+            def fn(state, loc, *vals):
+                from jax.sharding import PartitionSpec as P
+
+                f = _get_shard_map()(
+                    local,
+                    mesh=self.mesh,
+                    in_specs=(
+                        tuple(P(axis, None) for _ in range(n_state)),
+                        P(axis),
+                    )
+                    + tuple(P(axis) for _ in vals),
+                    out_specs=tuple(P(axis, None) for _ in range(n_state)),
+                )
+                return list(f(tuple(state), loc, *vals))
+
+            return obs_device.InstrumentedJit("mesh.srestore", fn)
+
+        return self._program("srestore", build)
+
+    def _sliced_read(self, slots: np.ndarray,
+                     free: Optional[np.ndarray]) -> List[np.ndarray]:
+        """Owner-sliced gather (free=None) or fused gather+masked-reset,
+        returning host arrays in the wave's original order."""
+        n = len(slots)
+        if free is None:
+            loc_sl, _, flat_pos, L = self._slice_pack(slots)
+            obs_device.note_padding("mesh.sgather", L, n,
+                                    self.n_shards * L)
+            outs = self._sliced_gather_program()(
+                self.state, self._to_dev(loc_sl, True), rung=L,
+            )
+        else:
+            loc_sl, (free_sl,), flat_pos, L = self._slice_pack(
+                slots, (np.asarray(free, dtype=np.int64),), (0,)
+            )
+            obs_device.note_padding("mesh.stake", L, n,
+                                    self.n_shards * L)
+            outs, self.state = self._sliced_take_program()(
+                self.state, self._to_dev(loc_sl, True),
+                self._to_dev(free_sl, True), rung=L,
+            )
+        return [np.asarray(o).reshape(-1)[flat_pos] for o in outs]
+
+    def _gather_program(self):
+        def build():
+            import jax
+
+            phys = list(self.phys)
+
             if self.salted:
-                phys = list(self.phys)
 
                 def gather_fn(state, sh, loc):
                     # fold across the shard axis; padding rows point at
@@ -1160,27 +1838,151 @@ class ShardedAccumulator(Accumulator):
                 )
             else:
                 gather_fn = jax.jit(gather_fn)
-            self._mesh_gather_fn = obs_device.InstrumentedJit(
-                "mesh.gather", gather_fn
+            return obs_device.InstrumentedJit("mesh.gather", gather_fn)
+
+        return self._program("gather", build)
+
+    def _take_program(self):
+        def build():
+            import jax
+
+            phys = list(self.phys)
+            salted = self.salted
+            neutral = self._neutral
+
+            def take_fn(state, sh, loc):
+                outs, new = [], []
+                for (op, dt, _, _), s in zip(phys, state):
+                    if salted:
+                        cols = s[:, loc]
+                        if op == "add":
+                            outs.append(cols.sum(axis=0))
+                        elif op == "min":
+                            outs.append(cols.min(axis=0))
+                        else:
+                            outs.append(cols.max(axis=0))
+                        # a salted slot's state lives on EVERY shard
+                        new.append(s.at[:, loc].set(neutral(op, dt)))
+                    else:
+                        outs.append(s[sh, loc])
+                        new.append(s.at[sh, loc].set(neutral(op, dt)))
+                return outs, new
+
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            return obs_device.InstrumentedJit(
+                "mesh.take",
+                jax.jit(
+                    take_fn,
+                    donate_argnums=_donate_state(),
+                    # outs replicated (each process reads its local
+                    # copy), state stays row-sharded
+                    out_shardings=(
+                        [NamedSharding(self.mesh, P())] * len(self.phys),
+                        [self._sharding] * len(self.phys),
+                    ),
+                ),
             )
+
+        return self._program("take", build)
+
+    def _reset_program(self):
+        def build():
+            import jax
+
+            phys = list(self.phys)
+            salted = self.salted
+            neutral = self._neutral
+
+            @partial(jax.jit, donate_argnums=_donate_state(),
+                     out_shardings=self._sharding)
+            def reset_fn(state, sh, loc):
+                if salted:
+                    # a salted slot's state lives on EVERY shard
+                    return [
+                        s.at[:, loc].set(neutral(op, dt))
+                        for s, (op, dt, _, _) in zip(state, phys)
+                    ]
+                return [
+                    s.at[sh, loc].set(neutral(op, dt))
+                    for s, (op, dt, _, _) in zip(state, phys)
+                ]
+
+            return obs_device.InstrumentedJit("mesh.reset", reset_fn)
+
+        return self._program("reset", build)
+
+    def _restore_program(self):
+        def build():
+            import jax
+
+            phys = list(self.phys)
+            salted = self.salted
+            neutral = self._neutral
+
+            @partial(jax.jit, donate_argnums=_donate_state(),
+                     out_shardings=self._sharding)
+            def restore_fn(state, sh, loc, *vals):
+                if salted:
+                    # restored value lands whole on the nominal shard;
+                    # the other shards go neutral so the cross-shard
+                    # fold reproduces it
+                    return [
+                        s.at[:, loc].set(neutral(op, dt))
+                        .at[sh, loc].set(v)
+                        for (op, dt, _, _), s, v in zip(phys, state, vals)
+                    ]
+                return [
+                    s.at[sh, loc].set(v) for s, v in zip(state, vals)
+                ]
+
+            return obs_device.InstrumentedJit("mesh.restore", restore_fn)
+
+        return self._program("restore", build)
+
+    def gather(self, slots: np.ndarray,
+               materialize: bool = True) -> List[np.ndarray]:
+        self._flush_if_touches(slots)
+        self._gather_slots = np.asarray(slots)
+        self._segment_udaf = None
+        self._segment_multiset = None
+        n = len(slots)
+        if n == 0:
+            return [
+                np.empty(0, dtype=self._dt(dt))
+                for _, dt, _, _ in self.phys
+            ]
+        if self._sliced_ok():
+            return self._sliced_read(np.asarray(slots), None)
+        from .multihost import to_host
+
+        prog = self._gather_program()
         sh, loc = self._decompose(np.asarray(slots))
-        padded = _bucket(len(slots), self._buckets)
-        sh_p = np.zeros(padded, dtype=np.int64)
-        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
-        sh_p[: len(slots)] = sh
-        loc_p[: len(slots)] = loc
-        obs_device.note_padding("mesh.gather", padded, len(slots), padded)
-        outs = self._mesh_gather_fn(
-            self.state, self._to_dev(sh_p, False),
-            self._to_dev(loc_p, False), rung=padded,
-        )
-        if not materialize:
-            if self._multiproc:
-                # replicated outputs span remote devices; hand back this
-                # process's local copy so later slicing / np.asarray work
-                outs = [o.addressable_data(0) for o in outs]
-            return [o[: len(slots)] for o in outs]
-        return [to_host(o)[: len(slots)] for o in outs]
+        chunks = self._chunk_bounds(n)
+        pieces = []
+        for lo, hi in chunks:
+            rung = self._emit_rung(hi - lo)
+            sh_p, loc_p = self._pad_slots(sh, loc, lo, hi, rung)
+            obs_device.note_padding("mesh.gather", rung, hi - lo, rung)
+            outs = prog(
+                self.state, self._to_dev(sh_p, False),
+                self._to_dev(loc_p, False), rung=rung,
+            )
+            if len(chunks) == 1 and not materialize:
+                if self._multiproc:
+                    # replicated outputs span remote devices; hand back
+                    # this process's local copy so later slicing /
+                    # np.asarray work
+                    outs = [o.addressable_data(0) for o in outs]
+                return [o[:n] for o in outs]
+            pieces.append([to_host(o)[: hi - lo] for o in outs])
+        if len(pieces) == 1:
+            return pieces[0]
+        return [
+            np.concatenate([p[i] for p in pieces])
+            for i in range(len(self.phys))
+        ]
 
     def gather_and_reset(self, slots: np.ndarray,
                          materialize: bool = True) -> List[np.ndarray]:
@@ -1195,21 +1997,63 @@ class ShardedAccumulator(Accumulator):
         self._gather_slots = np.asarray(slots)
         self._segment_udaf = None
         self._segment_multiset = None
-        if len(slots) == 0 or not self.phys:
+        n = len(slots)
+        if n == 0 or not self.phys:
             return [
-                np.empty(0, dtype=_np_dtype(dt))
+                np.empty(0, dtype=self._dt(dt))
                 for _, dt, _, _ in self.phys
             ]
-        import jax
-
+        if self._sliced_ok():
+            return self._sliced_read(
+                np.asarray(slots), np.ones(n, dtype=np.int64)
+            )
         from .multihost import to_host
 
-        if self._mesh_take_fn is None:
+        prog = self._take_program()
+        sh, loc = self._decompose(np.asarray(slots))
+        chunks = self._chunk_bounds(n)
+        pieces = []
+        for lo, hi in chunks:
+            rung = self._emit_rung(hi - lo)
+            sh_p, loc_p = self._pad_slots(sh, loc, lo, hi, rung)
+            obs_device.note_padding("mesh.take", rung, hi - lo, rung)
+            outs, self.state = prog(
+                self.state, self._to_dev(sh_p, False),
+                self._to_dev(loc_p, False), rung=rung,
+            )
+            if len(chunks) == 1 and not materialize:
+                if self._multiproc:
+                    outs = [o.addressable_data(0) for o in outs]
+                return [o[:n] for o in outs]
+            pieces.append([to_host(o)[: hi - lo] for o in outs])
+        if len(pieces) == 1:
+            return pieces[0]
+        return [
+            np.concatenate([p[i] for p in pieces])
+            for i in range(len(self.phys))
+        ]
+
+    def _gather_free_program(self):
+        """Fused sliding drain: gather the window union AND reset the
+        freed-bin subset (a 0/1 mask over the same padded slot buffer)
+        in ONE jitted dispatch — the per-wave gather + reset pair
+        otherwise costs two sharded-program launches, and the mask rides
+        the gather's rung so the fusion adds NO shape signatures."""
+        def build():
+            import jax
+
             phys = list(self.phys)
             salted = self.salted
+            neutral = self._neutral
 
-            def take_fn(state, sh, loc):
+            def gf_fn(state, sh, loc, free):
                 outs, new = [], []
+                # masked-out rows redirect their reset to the scratch
+                # slot (already neutral), so one program serves every
+                # (gather rung, freed count) combination
+                loc_r = jax.numpy.where(free != 0, loc,
+                                        state[0].shape[1] - 1)
+                sh_r = jax.numpy.where(free != 0, sh, 0)
                 for (op, dt, _, _), s in zip(phys, state):
                     if salted:
                         cols = s[:, loc]
@@ -1220,133 +2064,142 @@ class ShardedAccumulator(Accumulator):
                         else:
                             outs.append(cols.max(axis=0))
                         # a salted slot's state lives on EVERY shard
-                        new.append(s.at[:, loc].set(_neutral(op, dt)))
+                        new.append(s.at[:, loc_r].set(neutral(op, dt)))
                     else:
                         outs.append(s[sh, loc])
-                        new.append(s.at[sh, loc].set(_neutral(op, dt)))
+                        new.append(s.at[sh_r, loc_r].set(neutral(op, dt)))
                 return outs, new
 
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            self._mesh_take_fn = obs_device.InstrumentedJit(
-                "mesh.take",
+            return obs_device.InstrumentedJit(
+                "mesh.gather_free",
                 jax.jit(
-                    take_fn,
+                    gf_fn,
                     donate_argnums=_donate_state(),
-                    # outs replicated (each process reads its local
-                    # copy), state stays row-sharded
                     out_shardings=(
                         [NamedSharding(self.mesh, P())] * len(self.phys),
                         [self._sharding] * len(self.phys),
                     ),
                 ),
             )
-        sh, loc = self._decompose(np.asarray(slots))
-        padded = _bucket(len(slots), self._buckets)
-        sh_p = np.zeros(padded, dtype=np.int64)
-        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
-        sh_p[: len(slots)] = sh
-        loc_p[: len(slots)] = loc
-        obs_device.note_padding("mesh.take", padded, len(slots), padded)
-        outs, self.state = self._mesh_take_fn(
-            self.state, self._to_dev(sh_p, False),
-            self._to_dev(loc_p, False), rung=padded,
-        )
-        if not materialize:
-            if self._multiproc:
-                outs = [o.addressable_data(0) for o in outs]
-            return [o[: len(slots)] for o in outs]
-        return [to_host(o)[: len(slots)] for o in outs]
+
+        return self._program("gather_free", build)
+
+    def combine_for_segments_and_free(
+        self, slots: np.ndarray, seg_ids: np.ndarray, n_segments: int,
+        free_n: int = 0,
+    ) -> List[np.ndarray]:
+        if free_n == 0 or not self.phys:
+            return super().combine_for_segments_and_free(
+                slots, seg_ids, n_segments, free_n
+            )
+        slots = np.asarray(slots)
+        n = len(slots)
+        self._flush_if_touches(slots)
+        self._gather_slots = slots
+        self._segment_udaf = None
+        self._segment_multiset = None
+        free = np.zeros(n, dtype=np.int64)
+        free[:free_n] = 1
+        if self._sliced_ok():
+            gathered = self._sliced_read(slots, free)
+        else:
+            from .multihost import to_host
+
+            prog = self._gather_free_program()
+            sh, loc = self._decompose(slots)
+            pieces = []
+            for lo, hi in self._chunk_bounds(n):
+                rung = self._emit_rung(hi - lo)
+                sh_p, loc_p = self._pad_slots(sh, loc, lo, hi, rung)
+                free_p = np.zeros(rung, dtype=np.int64)
+                free_p[: hi - lo] = free[lo:hi]
+                obs_device.note_padding("mesh.gather_free", rung,
+                                        hi - lo, rung)
+                outs, self.state = prog(
+                    self.state, self._to_dev(sh_p, False),
+                    self._to_dev(loc_p, False),
+                    self._to_dev(free_p, False),
+                    rung=rung,
+                )
+                pieces.append([to_host(o)[: hi - lo] for o in outs])
+            gathered = (
+                pieces[0] if len(pieces) == 1
+                else [
+                    np.concatenate([p[i] for p in pieces])
+                    for i in range(len(self.phys))
+                ]
+            )
+        combined = self._combine_gathered(gathered, slots, seg_ids,
+                                          n_segments)
+        # host-side per-slot state of the freed bin drops AFTER the
+        # segment maps above captured it (reset_slots would do the same)
+        self._drop_udaf_slots(slots[:free_n])
+        return combined
 
     def reset_slots(self, slots: np.ndarray):
         self._flush_if_touches(slots)
         self._drop_udaf_slots(slots)
-        if len(slots) == 0 or not self.phys:
+        n = len(slots)
+        if n == 0 or not self.phys:
             return
-        import jax
-
-        if self._mesh_reset_fn is None:
-            phys = list(self.phys)
-            salted = self.salted
-
-            @partial(jax.jit, donate_argnums=_donate_state(),
-                     out_shardings=self._sharding)
-            def reset_fn(state, sh, loc):
-                if salted:
-                    # a salted slot's state lives on EVERY shard
-                    return [
-                        s.at[:, loc].set(_neutral(op, dt))
-                        for s, (op, dt, _, _) in zip(state, phys)
-                    ]
-                return [
-                    s.at[sh, loc].set(_neutral(op, dt))
-                    for s, (op, dt, _, _) in zip(state, phys)
-                ]
-
-            self._mesh_reset_fn = obs_device.InstrumentedJit(
-                "mesh.reset", reset_fn
+        if self._sliced_ok():
+            loc_sl, _, _, L = self._slice_pack(np.asarray(slots))
+            self.state = self._sliced_reset_program()(
+                self.state, self._to_dev(loc_sl, True), rung=L,
             )
+            return
+        prog = self._reset_program()
         sh, loc = self._decompose(np.asarray(slots))
-        padded = _bucket(len(slots), self._buckets)
-        sh_p = np.zeros(padded, dtype=np.int64)
-        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
-        sh_p[: len(slots)] = sh
-        loc_p[: len(slots)] = loc
-        self.state = self._mesh_reset_fn(
-            self.state, self._to_dev(sh_p, False),
-            self._to_dev(loc_p, False), rung=padded,
-        )
+        for lo, hi in self._chunk_bounds(n):
+            rung = self._emit_rung(hi - lo)
+            sh_p, loc_p = self._pad_slots(sh, loc, lo, hi, rung)
+            self.state = prog(
+                self.state, self._to_dev(sh_p, False),
+                self._to_dev(loc_p, False), rung=rung,
+            )
 
     def restore(self, slots: np.ndarray, values: List[np.ndarray]):
         self._flush_if_touches(slots)
         values = self._restore_udaf_cols(slots, values)
-        if len(slots) == 0 or not self.phys:
-            return
-        import jax
-
-        if self._mesh_restore_fn is None:
-            phys = list(self.phys)
-            salted = self.salted
-
-            @partial(jax.jit, donate_argnums=_donate_state(),
-                     out_shardings=self._sharding)
-            def restore_fn(state, sh, loc, *vals):
-                if salted:
-                    # restored value lands whole on the nominal shard;
-                    # the other shards go neutral so the cross-shard
-                    # fold reproduces it
-                    return [
-                        s.at[:, loc].set(_neutral(op, dt))
-                        .at[sh, loc].set(v)
-                        for (op, dt, _, _), s, v in zip(phys, state, vals)
-                    ]
-                return [
-                    s.at[sh, loc].set(v) for s, v in zip(state, vals)
-                ]
-
-            self._mesh_restore_fn = obs_device.InstrumentedJit(
-                "mesh.restore", restore_fn
-            )
-        sh, loc = self._decompose(np.asarray(slots))
-        # bucket-pad like gather/reset so restore chunk sizes don't each
-        # specialize the jitted scatter; padding rows write the neutral
-        # value into the scratch slot
         n = len(slots)
-        padded = _bucket(n, self._buckets)
-        sh_p = np.zeros(padded, dtype=np.int64)
-        loc_p = np.full(padded, self.capacity - 1, dtype=np.int64)
-        sh_p[:n] = sh
-        loc_p[:n] = loc
-        vals_p = []
-        for (op, dt, _, _), v in zip(self.phys, values):
-            vp = np.full(padded, _neutral(op, dt), dtype=_np_dtype(dt))
-            vp[:n] = np.asarray(v)
-            vals_p.append(vp)
-        self.state = self._mesh_restore_fn(
-            self.state,
-            self._to_dev(sh_p, False),
-            self._to_dev(loc_p, False),
-            *[self._to_dev(v, False) for v in vals_p],
-            rung=padded,
-        )
+        if n == 0 or not self.phys:
+            return
+        if self._sliced_ok():
+            vals = [
+                np.asarray(v).astype(self._dt(dt), copy=False)
+                for (op, dt, _, _), v in zip(self.phys, values)
+            ]
+            loc_sl, val_sls, _, L = self._slice_pack(
+                np.asarray(slots), tuple(vals),
+                tuple(self._neutral(op, dt)
+                      for op, dt, _, _ in self.phys),
+            )
+            self.state = self._sliced_restore_program()(
+                self.state, self._to_dev(loc_sl, True),
+                *[self._to_dev(v, True) for v in val_sls], rung=L,
+            )
+            return
+        prog = self._restore_program()
+        sh, loc = self._decompose(np.asarray(slots))
+        # pad on the emission rungs like gather/reset so restore chunk
+        # sizes don't each specialize the jitted scatter; padding rows
+        # write the neutral value into the scratch slot
+        for lo, hi in self._chunk_bounds(n):
+            rung = self._emit_rung(hi - lo)
+            sh_p, loc_p = self._pad_slots(sh, loc, lo, hi, rung)
+            vals_p = []
+            for (op, dt, _, _), v in zip(self.phys, values):
+                vp = np.full(rung, self._neutral(op, dt),
+                             dtype=self._dt(dt))
+                vp[: hi - lo] = np.asarray(v)[lo:hi]
+                vals_p.append(vp)
+            self.state = prog(
+                self.state,
+                self._to_dev(sh_p, False),
+                self._to_dev(loc_p, False),
+                *[self._to_dev(v, False) for v in vals_p],
+                rung=rung,
+            )

@@ -1,0 +1,18 @@
+"""MUST fire JAX003: host sync inside the exchange hot path."""
+import numpy as np
+
+
+class Acc:
+    def update(self, slots, vals):
+        # blocking the device per update serializes every dispatch
+        self.state[0].block_until_ready()
+        self._dispatch(slots, vals)
+
+    def _dispatch_rows(self, rows):
+        # implicit __array__ over device state on the flush path
+        host_copy = np.asarray(self.state[0])
+        return host_copy[rows]
+
+    def flush(self):
+        total = float(self.state[1])
+        return total

@@ -119,6 +119,32 @@ def test_instrumented_jit_classifies_and_logs_recompiles():
     assert len(calls) == 4
 
 
+def test_exchange_histogram_tracks_exchange_programs_only():
+    """arroyo_device_exchange_seconds (ISSUE 7): exchange-flagged
+    programs (the mesh keyed-shuffle steps) record their steady-state
+    dispatches into the collective-time histogram; plain programs do
+    not, and compiles never count as exchange time."""
+    ex = obs_device.InstrumentedJit("mesh.route", lambda *a: None,
+                                    exchange=True)
+    plain = obs_device.InstrumentedJit("mesh.sgather", lambda *a: None)
+    a = np.zeros(16)
+    ex(a, rung=16)     # compile — must NOT land in the exchange hist
+    ex(a, rung=16)     # dispatch — must land
+    ex(a, rung=16)
+    plain(a, rung=16)
+    plain(a, rung=16)
+    from arroyo_tpu.metrics import REGISTRY
+
+    snap = dict(REGISTRY.snapshot()).get("arroyo_device_exchange_seconds",
+                                         [])
+    by_prog = {labels["program"]: h for labels, h in snap}
+    assert by_prog["mesh.route"]["count"] == 2
+    assert "mesh.sgather" not in by_prog
+    s = obs_device.summary()["programs"]["mesh.route"]
+    assert s["exchange_dispatches"] == 2
+    assert "exchange_quantiles" in s
+
+
 def test_instrumented_jit_disabled_is_passthrough():
     with update(obs={"device_telemetry": False}):
         fn = obs_device.InstrumentedJit("off.prog", lambda x: x + 1)

@@ -72,10 +72,11 @@ def _require_devices(n):
         pytest.skip(f"needs {n} devices")
 
 
-def run_rows(sql, parallelism=1, mesh_devices=0):
+def run_rows(sql, parallelism=1, mesh_devices=0, **tpu_overrides):
     results = []
     overrides = {
-        "tpu": {"mesh_devices": mesh_devices, "mesh_rows_per_shard": 128}
+        "tpu": {"mesh_devices": mesh_devices, "mesh_rows_per_shard": 128,
+                **tpu_overrides}
     }
     with update(**overrides):
         plan = plan_query(sql, parallelism=parallelism,
@@ -105,6 +106,29 @@ def test_mesh_q5_matches_host():
     host = run_rows(Q5, parallelism=2, mesh_devices=0)
     mesh = run_rows(Q5, parallelism=1, mesh_devices=4)
     assert host and mesh == host
+
+
+def test_mesh_device_exchange_golden_q5():
+    """Mesh-tier golden for the DEVICE-ROUTED keyed exchange: the fused
+    route+scatter+reduce program (no host combiner, owner routing and
+    all_to_all on device) must produce output identical to the host-fed
+    exchange on the same input — the routing contract is the same
+    splitmix64 hash, so the two tiers differ only in WHERE the shuffle
+    runs."""
+    _require_devices(4)
+    host_fed = run_rows(Q5, mesh_devices=4, mesh_exchange="host_fed")
+    device = run_rows(Q5, mesh_devices=4, mesh_exchange="device")
+    assert host_fed and device == host_fed
+
+
+def test_mesh_device_exchange_golden_tumbling():
+    """Device-routed exchange golden over multi-phys aggregates
+    (count/sum/max share one exchange buffer) incl. capacity growth."""
+    _require_devices(4)
+    host_fed = run_rows(TUMBLE_AGG, mesh_devices=4,
+                        mesh_exchange="host_fed")
+    device = run_rows(TUMBLE_AGG, mesh_devices=4, mesh_exchange="device")
+    assert host_fed and device == host_fed
 
 
 def test_mesh_under_host_parallelism():
@@ -428,11 +452,53 @@ def test_mesh_salted_host_state_aggregates():
     multiset, median buffer): the window itself is the only group key,
     so the planner marks mesh_salted; host stores are keyed by global
     slot and must produce the same answer as the host run (round-4
-    verdict: salting excluded host-state aggregates)."""
+    verdict: salting excluded host-state aggregates).
+
+    mesh_salted_tier='mesh' pins the salted SHARDED path explicitly —
+    on this virtual CPU mesh 'auto' would tier the window-global stage
+    onto a single device (tested separately below)."""
     _require_devices(4)
     host = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=0)
-    mesh = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=4)
+    mesh = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=4,
+                    mesh_salted_tier="mesh")
     assert host and mesh == host
+
+
+def test_mesh_salted_tier_auto_on_virtual_mesh():
+    """On a VIRTUAL (forced host-platform) mesh, 'auto' runs salted
+    window-global aggregates on the single-device tier: there is no key
+    axis to shard and the salted spread costs S x serial work for a
+    handful of groups. Output must be identical either way, and the
+    stage must actually leave the mesh accumulator."""
+    _require_devices(4)
+    single = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=4)
+    mesh = run_rows(SALTED_HOST_STATE, parallelism=1, mesh_devices=4,
+                    mesh_salted_tier="mesh")
+    assert single and single == mesh
+    # construction-level assert: auto => standard accumulator, not the
+    # sharded one (the engine run above only proves output equality)
+    from arroyo_tpu.operators.windows import TumblingWindowOperator
+    from arroyo_tpu.parallel.sharded_state import ShardedAccumulator
+
+    cfg = {
+        "aggregates": [{"kind": "count", "name": "cnt"}],
+        "key_cols": [],
+        "schema": None,
+        "width_nanos": 1000,
+        "mesh_salted": True,
+        "mesh_devices": 4,
+        "backend": "jax",
+    }
+    with update(tpu={"mesh_devices": 4}):
+        op = TumblingWindowOperator.__new__(TumblingWindowOperator)
+        from arroyo_tpu.operators.windows import WindowOperatorBase
+
+        WindowOperatorBase.__init__(op, cfg, "tumbling_window")
+        assert not isinstance(op.acc, ShardedAccumulator)
+    with update(tpu={"mesh_devices": 4, "mesh_salted_tier": "mesh"}):
+        op = TumblingWindowOperator.__new__(TumblingWindowOperator)
+        WindowOperatorBase.__init__(op, cfg, "tumbling_window")
+        assert isinstance(op.acc, ShardedAccumulator) and op.acc.salted
 
 
 def test_mesh_microbatch_flush_boundaries():

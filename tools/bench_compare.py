@@ -38,8 +38,32 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 from typing import Dict, Optional
+
+
+def baseline_provenance(path: str) -> dict:
+    """Which baseline the gate compared against: the file path plus the
+    commit that last touched it (BENCH_r05 kept pre-PR-1 mesh numbers
+    next to post-PR-1 prose for five rounds because nothing ever printed
+    what was actually pinned — the report now names it)."""
+    prov = {"file": os.path.abspath(path)}
+    try:
+        out = subprocess.run(
+            ["git", "log", "-1", "--format=%h %cs %s", "--", path],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(path)) or ".",
+        ).stdout.strip()
+        if out:
+            prov["commit"] = out.split()[0]
+            prov["committed"] = out.split()[1]
+            prov["subject"] = out.split(" ", 2)[2] if len(
+                out.split(" ", 2)) > 2 else ""
+    except Exception:  # noqa: BLE001 - provenance is best-effort
+        pass
+    return prov
 
 
 def _spread_pct(doc: dict, metric: str) -> Optional[float]:
@@ -127,6 +151,15 @@ def compare(baseline: dict, current: dict, margin: float = 1.5,
 
 
 def render(doc: dict, out=sys.stdout) -> None:
+    prov = doc.get("baseline_provenance")
+    if prov:
+        line = f"gating against baseline {prov['file']}"
+        if prov.get("commit"):
+            line += (f" (pinned at commit {prov['commit']}"
+                     + (f", {prov['committed']}" if prov.get("committed")
+                        else "")
+                     + ")")
+        print(line, file=out)
     width = max([len(m) for m in doc["metrics"]] + [6])
     for metric, r in doc["metrics"].items():
         if r["status"] == "missing":
@@ -168,6 +201,7 @@ def main(argv=None) -> int:
     doc = compare(baseline, current, margin=args.margin,
                   floor_pct=args.floor_pct,
                   latency_floor_pct=args.latency_floor_pct)
+    doc["baseline_provenance"] = baseline_provenance(args.baseline)
     render(doc)
     if args.json:
         with open(args.json, "w") as f:

@@ -302,16 +302,24 @@ def main() -> int:
               f"{out.get('q5_mesh_eps', 'n/a')} ev/s.")
         if device:
             # the observatory's per-program ledger: dispatch floor +
-            # padding waste per rung beside the host-stage budget
+            # padding waste per rung beside the host-stage budget. The
+            # exchange column is arroyo_device_exchange_seconds — the
+            # keyed-shuffle collective's own time, which REPLACES the
+            # old host-exchange stage rows of earlier BASELINE rounds
+            # (those costs now live in the route/step programs)
             print("\n| program | compiles | compile s | dispatches "
-                  "| dispatch p50/p95 | cache h/m |")
-            print("|---|---|---|---|---|---|")
+                  "| dispatch p50/p95 | exchange s (n) | cache h/m |")
+            print("|---|---|---|---|---|---|---|")
             for name, p in sorted(device.get("programs", {}).items()):
                 dq = p.get("dispatch_quantiles", {})
+                ex = (f"{p.get('exchange_s_total', 0)} "
+                      f"({p.get('exchange_dispatches', 0)})"
+                      if p.get("exchange_dispatches") else "-")
                 print(f"| {name} | {p.get('compiles', 0)} "
                       f"| {p.get('compile_s_total', 0)} "
                       f"| {p.get('dispatches', 0)} "
                       f"| {dq.get('p50', 'n/a')}/{dq.get('p95', 'n/a')} s "
+                      f"| {ex} "
                       f"| {p.get('cache_hit', 0)}/"
                       f"{p.get('cache_miss', 0)} |")
             waste = [w for w in device.get("padding_waste", [])

@@ -421,9 +421,12 @@ class ApiServer:
             short = name.removeprefix("arroyo_worker_")
             for labels, value in entries:
                 # split per-phase families (checkpoint_phase_seconds) into
-                # one scalar series per phase
+                # one scalar series per phase; state families split per
+                # table the same way (arroyo_state_bytes:sess, ...)
                 metric = (f"{short}:{labels['phase']}"
                           if "phase" in labels else short)
+                if "table" in labels:
+                    metric = f"{metric}:{labels['table']}"
                 task = labels.get("task")
                 if task is None or "-" not in task:
                     continue

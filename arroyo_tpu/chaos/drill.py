@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import glob
 import json
 import os
 import random
@@ -172,6 +173,8 @@ class DrillResult:
     expected_log: List[dict]
     unfired: List[dict]
     error: Optional[str] = None
+    # drill-specific measurements (e.g. the state-bloat flatness stats)
+    extras: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -455,6 +458,208 @@ def run_rescale_drill(seed: int, workdir: str,
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
         error=error,
+    )
+
+
+# -- state-bloat drill (ROADMAP item 4 acceptance) ---------------------------
+
+
+STATE_BLOAT_SQL = """
+CREATE TABLE src (
+  timestamp TIMESTAMP, k BIGINT NOT NULL
+) WITH (
+  connector = 'single_file', path = '$src', format = 'json',
+  type = 'source'{throttle}, event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  k BIGINT NOT NULL, c BIGINT NOT NULL
+) WITH (
+  connector = 'single_file', path = '$out', format = 'json', type = 'sink'
+);
+INSERT INTO out
+SELECT k, count(*) as c FROM src
+GROUP BY k, session(interval '1 hour');
+"""
+
+
+def state_bloat_plan(seed: int) -> FaultPlan:
+    """SIGKILL a worker mid-run with storage latency widening the upload
+    windows, so the kill lands while checkpoint flushes are in flight —
+    recovery must come back from the last *published* epoch with the
+    blob chain intact."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    plan.add("storage.latency", at_hits=tuple(range(2, 40, 3)),
+             match={"key": "/data/"}, params={"delay": 0.08},
+             max_fires=13)
+    # heartbeats tick ~20/s across 2 workers: land the kill ~1.5-2.5s in,
+    # after state has grown but with plenty of run left to re-grow it
+    plan.add("worker.kill", at_hits=(rng.randint(30, 50),))
+    return plan
+
+
+def run_state_bloat_drill(seed: int, workdir: str, n_rows: int = 6000,
+                          timeout: float = 180.0) -> DrillResult:
+    """ROADMAP item 4 acceptance: session state grows ~10x during the
+    run (every other row opens a NEW session key; the 1-hour gap keeps
+    them all open until end-of-stream), a worker is SIGKILLed mid-upload,
+    and the drill asserts (a) byte-identical exactly-once output, (b)
+    checkpoint CAPTURE cost stays ~flat late-run vs early-run (median of
+    per-epoch max checkpoint.capture span durations, <= 2x + a small
+    absolute floor), and (c) per-epoch uploaded DELTA bytes for the
+    session table stay ~flat (median late <= 2x median early; base blobs
+    are the amortized rebase cost and reported separately). A
+    full-snapshot design shows ~10x growth on both."""
+    import time as _time
+
+    from .. import obs
+    from ..config import update
+
+    os.makedirs(workdir, exist_ok=True)
+    src = os.path.join(workdir, "bloat-in.json")
+    with open(src, "w") as f:
+        for i in range(n_rows):
+            # monotonic event time, one NEW session key per two rows:
+            # live state grows linearly all run (~10x early -> late)
+            mins, secs = (i // 120) % 60, (i // 2) % 60
+            f.write(json.dumps({
+                "k": i // 2,
+                "timestamp": f"2023-03-01T00:{mins:02d}:{secs:02d}.000Z",
+            }) + "\n")
+
+    clean_out = os.path.join(workdir, "bloat-clean.json")
+    clean_sql = STATE_BLOAT_SQL.replace("$src", src).replace(
+        "$out", clean_out).format(throttle="")
+    assert chaos.installed() is None, "a fault plan is already installed"
+    _run_embedded(
+        clean_sql, "drill-bloat-clean", None, 2, 1, max_restarts=0,
+        heartbeat_interval=0.1, heartbeat_timeout=30.0,
+        checkpoint_interval=60.0, timeout=timeout,
+    )
+    want = canonicalize_output(clean_out, clean_sql, {})
+    if not want:
+        raise RuntimeError("state-bloat: fault-free run produced no output")
+
+    fault_out = os.path.join(workdir, "bloat-faulted.json")
+    fault_sql = STATE_BLOAT_SQL.replace("$src", src).replace(
+        "$out", fault_out).format(
+        throttle=",\n  throttle_per_sec = '1500'")
+    plan = chaos.install(state_bloat_plan(seed))
+    obs.recorder().clear()
+    error = None
+    restarts = 0
+    storage = os.path.join(workdir, "bloat-ck")
+    try:
+        # rebase pushed out so the full delta chain survives GC — the
+        # drill measures per-epoch delta flatness from the chain files
+        with update(state={"rebase_epochs": 500,
+                           "max_inflight_flushes": 2}):
+            restarts = _run_embedded(
+                fault_sql, "drill-bloat-faulted", storage, 2, 1,
+                max_restarts=8, heartbeat_interval=0.1,
+                heartbeat_timeout=1.5, checkpoint_interval=0.15,
+                timeout=timeout,
+            )
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+    finally:
+        chaos.clear()
+
+    got = canonicalize_output(fault_out, fault_sql, {})
+
+    # (b) capture flatness from the flight recorder: per-epoch max of
+    # checkpoint.capture span durations (ms), early vs late median
+    spans = [
+        s for s in obs.recorder().snapshot()
+        if s.get("name") == "checkpoint.capture"
+    ]
+    by_epoch: Dict[tuple, float] = {}
+    for s in spans:
+        ep = s.get("attrs", {}).get("epoch")
+        if ep is None:
+            continue
+        key = (ep, int(s["ts"] // 10_000_000))  # epoch reuse post-restore
+        by_epoch[key] = max(by_epoch.get(key, 0.0), s["dur"] / 1000.0)
+    ordered = [v for _k, v in sorted(by_epoch.items())]
+
+    def _median(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    third = max(1, len(ordered) // 3)
+    early_ms, late_ms = _median(ordered[:third]), _median(ordered[-third:])
+    capture_flat = late_ms <= 2.0 * early_ms + 2.0
+
+    # (c) delta-bytes flatness from storage.put spans in the flight
+    # recording (disk listings lose epochs GC'd after the post-restore
+    # rebase). Bases are exact to identify: a chain restarts per
+    # generation (the -gNNNNN path component), so each generation's
+    # lowest sess epoch is its base; everything else is a delta.
+    per_epoch_bytes: Dict[tuple, int] = {}
+    for s in obs.recorder().snapshot():
+        if s.get("name") != "storage.put":
+            continue
+        key = s.get("attrs", {}).get("key", "")
+        if "-sess-" not in key or not key.endswith(".bin"):
+            continue
+        try:
+            epoch = int(key.split("checkpoint-")[1].split("/")[0])
+            gen = key.rsplit("-g", 1)[1].split(".")[0]
+        except (IndexError, ValueError):
+            continue
+        per_epoch_bytes[(gen, epoch)] = (
+            per_epoch_bytes.get((gen, epoch), 0)
+            + int(s["attrs"].get("bytes", 0))
+        )
+    bases = {
+        (g, min(e for g2, e in per_epoch_bytes if g2 == g))
+        for g, _e in per_epoch_bytes
+    }
+    base_bytes = sum(
+        v for k, v in per_epoch_bytes.items() if k in bases
+    )
+    byte_series = [
+        v for k, v in sorted(per_epoch_bytes.items()) if k not in bases
+    ]
+    bthird = max(1, len(byte_series) // 3)
+    early_b = _median(byte_series[:bthird])
+    late_b = _median(byte_series[-bthird:])
+    bytes_flat = len(byte_series) >= 6 and late_b <= 2.0 * early_b + 4096
+
+    passed = (error is None and got == want and not plan.unfired()
+              and restarts >= 1 and capture_flat and bytes_flat)
+    if error is None and got != want:
+        error = f"output diverged: {len(got)} rows vs {len(want)}"
+    if error is None and plan.unfired():
+        error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    if error is None and restarts < 1:
+        error = "the SIGKILL never forced a recovery"
+    if error is None and not capture_flat:
+        error = (f"capture p99 grew with state: early {early_ms:.2f}ms "
+                 f"-> late {late_ms:.2f}ms")
+    if error is None and not bytes_flat:
+        error = (f"per-epoch delta bytes grew with state: "
+                 f"early {early_b} -> late {late_b} "
+                 f"({len(byte_series)} epochs)")
+    return DrillResult(
+        query="state_bloat_session",
+        seed=seed,
+        passed=passed,
+        rows=len(got),
+        restarts=restarts,
+        fired=plan.fired_events,
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+        extras={
+            "capture_ms_early_median": round(early_ms, 3),
+            "capture_ms_late_median": round(late_ms, 3),
+            "delta_bytes_early_median": early_b,
+            "delta_bytes_late_median": late_b,
+            "rebase_base_bytes": base_bytes,
+            "epochs_measured": len(byte_series),
+        },
     )
 
 

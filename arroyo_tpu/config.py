@@ -181,6 +181,44 @@ class TpuConfig:
 
 
 @dataclasses.dataclass
+class StateConfig:
+    """State-at-scale knobs (arroyo_tpu/state): incremental global-table
+    snapshots (blob chains + rebase policy), fully off-barrier checkpoint
+    uploads, and the larger-than-RAM time-key spill tier."""
+
+    # checkpoint flushes (device->host materialization + storage writes)
+    # a subtask may have in flight at once. 1 = legacy behavior (the next
+    # barrier awaits the previous flush); >1 decouples barrier cadence
+    # from upload time — flushes stay strictly epoch-ordered per subtask
+    # via the runner's flush queue, and zombie writers are fenced by the
+    # generation-stamped data-file paths + manifest CAS.
+    max_inflight_flushes: int = 2
+    # rebase policy for incremental global tables: write a fresh base
+    # blob (and truncate the delta chain) once the chain carries this
+    # many delta epochs...
+    rebase_epochs: int = 16
+    # ...or earlier, once cumulative delta-chain bytes exceed this
+    # multiple of the base blob's size (restore replays base + chain, so
+    # an unbounded chain trades upload bytes for restore time)
+    rebase_bytes_factor: float = 2.0
+    # in-memory budget per TimeKeyTable instance: batches beyond it are
+    # spooled coldest-first (lowest max event time) to local Arrow-IPC
+    # spill files and memory-mapped back only when expiry/restore/
+    # emission needs them. 0 disables the spill tier.
+    memory_budget_bytes: int = 0
+    # directory for spill files; empty = a per-process directory under
+    # the system temp dir (spill files are local scratch, NOT durable
+    # state — checkpoints already persisted the rows they hold)
+    spill_dir: str = ""
+    # row-level expiry compaction: a batch whose max timestamp is still
+    # live survives expire() whole, so long-retention skew keeps dead
+    # rows in RAM; once a batch's expired-row fraction exceeds this,
+    # expire() filters it row-level (reusing the restore-path mask).
+    # >1.0 disables.
+    expire_compact_fraction: float = 0.5
+
+
+@dataclasses.dataclass
 class ChaosConfig:
     """Deterministic fault injection (arroyo_tpu/chaos). `plan` is inline
     JSON or a path to a JSON plan file ({"seed": ..., "faults": [...]});
@@ -356,7 +394,8 @@ class TlsConfig:
 @dataclasses.dataclass
 class Config:
     """Root of the layered config tree. Sections: pipeline (batching,
-    queues, checkpointing), autoscale (closed-loop parallelism control),
+    queues, checkpointing), state (incremental snapshots, off-barrier
+    flushes, spill tier), autoscale (closed-loop parallelism control),
     tls, chaos (fault injection), obs (flight recorder), tpu (device
     kernels + mesh), controller, worker, api,
     admin, database, logging. `tools/lint.py --config-table` prints the
@@ -364,6 +403,7 @@ class Config:
     undeclared keys."""
 
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    state: StateConfig = dataclasses.field(default_factory=StateConfig)
     autoscale: AutoscaleConfig = dataclasses.field(default_factory=AutoscaleConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)

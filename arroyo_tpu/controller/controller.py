@@ -84,6 +84,12 @@ class JobHandle:
         self.autoscale_decisions: List[dict] = []
         # epoch -> {task_id: report}
         self.checkpoints: Dict[int, Dict[str, dict]] = {}
+        # pipelined checkpoint accounting (ROADMAP item 4): epochs whose
+        # barrier is fanned out but whose manifest isn't published yet —
+        # {epoch: {"deadline", "trace"}}. Completions may arrive >1
+        # epoch late (workers keep state.max_inflight_flushes uploads in
+        # flight); manifests still publish strictly in epoch order.
+        self.pending_epochs: Dict[int, dict] = {}
         self.finished_tasks: set = set()
         self.failure: Optional[str] = None
         self.stop_requested: Optional[str] = None
@@ -413,6 +419,7 @@ class ControllerServer:
                 )
                 wi += 1
         job.checkpoints.clear()
+        job.pending_epochs.clear()
         job.finished_tasks.clear()
         job.failure = None
         job.leader_resigned = False
@@ -493,11 +500,23 @@ class ControllerServer:
             if job.rescale_requested and not job.stop_requested:
                 job.transition(JobState.RESCALING)
                 return
+            # reap pipelined epochs: publish (in epoch order) any whose
+            # report set completed since the last tick — completions can
+            # arrive >1 epoch late with multi-inflight worker flushes
+            if job.backend is not None and job.pending_epochs:
+                await self._checkpoint_reap(job)
+                if job.failure is not None:
+                    continue
             if job.stop_requested:
                 mode = job.stop_requested
                 job.stop_requested = None
                 if mode == "checkpoint" and job.backend:
                     job.transition(JobState.CHECKPOINT_STOPPING)
+                    await self._drain_pending_epochs(job)
+                    if job.failure is not None:
+                        job.stop_requested = mode
+                        job.transition(JobState.RECOVERING)
+                        return
                     if leader_mode and not job.leader_resigned:
                         # the leader runs the stopping checkpoint itself
                         try:
@@ -556,9 +575,11 @@ class ControllerServer:
                 and (not leader_mode or job.leader_resigned)
                 and not job.finished_tasks
                 and time.monotonic() - last_checkpoint >= interval
+                and len(job.pending_epochs)
+                < max(1, config().state.max_inflight_flushes)
             ):
                 last_checkpoint = time.monotonic()
-                await self._checkpoint(job)
+                await self._checkpoint_start(job)
 
     async def _rescale(self, job: JobHandle):
         """Exactly-once automatic rescale (reference states/rescaling.rs;
@@ -599,6 +620,11 @@ class ControllerServer:
                 job.rescale_trace = None
                 job.transition(JobState.RECOVERING)
                 return
+            await self._drain_pending_epochs(job)
+            if job.failure is not None:
+                job.rescale_trace = None
+                job.transition(JobState.RECOVERING)
+                return
             with obs.span("rescale.stop_checkpoint", cat="controller"):
                 await self._checkpoint(job, then_stop=True, nested=True)
             if job.failure is not None:
@@ -632,6 +658,81 @@ class ControllerServer:
             ).initialize()
         job.transition(JobState.SCHEDULING)
 
+    async def _checkpoint_start(self, job: JobHandle):
+        """Pipelined cadence: fan the barrier out and return — the epoch
+        joins `pending_epochs` and publishes from _checkpoint_reap once
+        its report set completes (possibly several epochs later)."""
+        job.epoch += 1
+        epoch = job.epoch
+        trace = obs.new_trace(job.job_id, f"ck-{epoch}")
+        with obs.span(
+            "checkpoint", trace=trace, cat="controller", job=job.job_id,
+            epoch=epoch, then_stop=False,
+        ) as sp:
+            ck_trace = (sp.trace_id, sp.span_id) if sp.recording else (None, None)
+            with obs.span("barrier_fanout", cat="controller"):
+                await self._fanout_barrier(job, epoch, then_stop=False)
+        job.pending_epochs[epoch] = {
+            "deadline": time.monotonic() + 60,
+            "trace": ck_trace,
+        }
+
+    async def _checkpoint_reap(self, job: JobHandle):
+        """Publish every pending epoch whose reports completed, strictly
+        in epoch order (manifest N+1 references chain blobs first
+        recorded in N). An epoch that misses its deadline is abandoned —
+        a LATER epoch may still publish: per-subtask flushes are epoch-
+        ordered, so a subtask reporting N+1 has durably flushed N."""
+        for epoch in sorted(job.pending_epochs):
+            info = job.pending_epochs[epoch]
+            reports = job.checkpoints.get(epoch, {})
+            if len(reports) < job.n_subtasks:
+                if len(job.finished_tasks) >= job.n_subtasks:
+                    job.pending_epochs.clear()
+                    return
+                if time.monotonic() > info["deadline"]:
+                    logger.warning("checkpoint %d incomplete (abandoned)",
+                                   epoch)
+                    del job.pending_epochs[epoch]
+                    continue
+                return  # strict order: later epochs wait for this one
+            del job.pending_epochs[epoch]
+            tid, sid = info["trace"]
+            with obs.span("checkpoint.finish", trace=tid, parent=sid,
+                          cat="controller", epoch=epoch):
+                await self._publish_epoch(job, epoch, reports)
+            if job.failure is not None:
+                return
+
+    async def _drain_pending_epochs(self, job: JobHandle):
+        """Settle every pending epoch (publish or abandon) — stop,
+        rescale and recovery paths stay strictly drained, exactly as the
+        single-inflight design behaved."""
+        while job.pending_epochs and job.failure is None:
+            if self._heartbeat_expired(job):
+                job.failure = "worker heartbeat timeout"
+                return
+            if len(job.finished_tasks) >= job.n_subtasks:
+                job.pending_epochs.clear()
+                return
+            await self._checkpoint_reap(job)
+            if job.pending_epochs:
+                await asyncio.sleep(0.02)
+
+    async def _fanout_barrier(self, job: JobHandle, epoch: int,
+                              then_stop: bool):
+        for w in job.workers:
+            try:
+                await w.client.call(
+                    "WorkerGrpc", "Checkpoint",
+                    {"epoch": epoch, "then_stop": then_stop},
+                )
+            except Exception as e:  # noqa: BLE001 - resigned/dead worker
+                logger.warning(
+                    "checkpoint fan-out to worker %s failed: %s",
+                    w.worker_id, e,
+                )
+
     async def _checkpoint(self, job: JobHandle, then_stop: bool = False,
                           nested: bool = False):
         job.epoch += 1
@@ -659,17 +760,7 @@ class ControllerServer:
     async def _checkpoint_inner(self, job: JobHandle, epoch: int,
                                 then_stop: bool):
         with obs.span("barrier_fanout", cat="controller"):
-            for w in job.workers:
-                try:
-                    await w.client.call(
-                        "WorkerGrpc", "Checkpoint",
-                        {"epoch": epoch, "then_stop": then_stop},
-                    )
-                except Exception as e:  # noqa: BLE001 - resigned/dead worker
-                    logger.warning(
-                        "checkpoint fan-out to worker %s failed: %s",
-                        w.worker_id, e,
-                    )
+            await self._fanout_barrier(job, epoch, then_stop)
         deadline = time.monotonic() + 60
         with obs.span("await_reports", cat="controller") as wait_span:
             while len(job.checkpoints.get(epoch, {})) < job.n_subtasks:
@@ -698,7 +789,13 @@ class ControllerServer:
                     wait_span.set(outcome="job_finished")
                     return
                 await asyncio.sleep(0.02)
-        reports = job.checkpoints[epoch]
+        await self._publish_epoch(job, epoch, job.checkpoints[epoch])
+
+    async def _publish_epoch(self, job: JobHandle, epoch: int,
+                             reports: Dict[str, dict]):
+        """Manifest publish + 2PC commit + compaction/GC for one epoch
+        whose full report set arrived (shared by the synchronous stop
+        path and the pipelined reap)."""
         try:
             with obs.span("publish_manifest", cat="controller"):
                 manifest = job.backend.publish_checkpoint(
@@ -782,6 +879,7 @@ class ControllerServer:
             await self.scheduler.stop_workers(job.job_id, force=True)
             return
         logger.warning("job %s recovering (%s)", job.job_id, job.failure)
+        job.pending_epochs.clear()  # unpublished epochs die with the gen
         # flight recorder: each recovery is its own lifecycle trace; the
         # fault that triggered it rides as an attribute so drill timelines
         # read fault -> detection -> recovery causally

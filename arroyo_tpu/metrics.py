@@ -383,6 +383,27 @@ BARRIER_ALIGNMENT_SECONDS = REGISTRY.gauge(
     "arroyo_worker_barrier_alignment_seconds",
     "seconds the subtask's last checkpoint barrier spent aligning "
     "(first barrier arrival to all live inputs barriered)")
+# State-at-scale observability (ROADMAP item 4): per-(table, kind) sizes
+# refreshed at scrape time via weakref refreshers registered by each
+# subtask's TableManager — the rebase/spill knobs are tuned from these.
+STATE_BYTES = REGISTRY.gauge(
+    "arroyo_state_bytes",
+    "approximate bytes held by a state table per (task, table, kind): "
+    "global tables report their last serialized size, time-key tables "
+    "in-memory + spilled batch bytes (refreshed at scrape time)")
+STATE_ROWS = REGISTRY.gauge(
+    "arroyo_state_rows",
+    "live entries per state table: KV entries for global tables, "
+    "buffered rows for time-key tables (refreshed at scrape time)")
+STATE_SPILLED_BYTES = REGISTRY.gauge(
+    "arroyo_state_spilled_bytes",
+    "bytes a time-key table currently holds in local Arrow-IPC spill "
+    "files (cold batches beyond state.memory_budget_bytes)")
+STATE_CHAIN_LEN = REGISTRY.gauge(
+    "arroyo_state_delta_chain_len",
+    "incremental global-table blob-chain length (base + deltas) per "
+    "(task, table); the rebase policy (state.rebase_epochs / "
+    "state.rebase_bytes_factor) bounds it")
 
 
 class RateWindow:

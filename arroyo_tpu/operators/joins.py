@@ -315,15 +315,22 @@ def _take_col(joined: pa.Table, f: pa.Field) -> pa.Array:
 
 class InstantJoinOperator(JoinBase):
     """Windowed join: rows arrive already windowed (one _timestamp per
-    window); buffer per bin and join when the watermark passes the bin."""
+    window); buffer per bin and join when the watermark passes the bin.
+
+    The buffers LIVE in the side time-key tables (ijl/ijr) rather than an
+    operator-local dict: the tables stage checkpoint deltas automatically
+    and give cold bins the disk spill tier (state.memory_budget_bytes) —
+    a join holding many windows in flight is bounded by disk, not RAM,
+    and spilled bins are memory-mapped back exactly when the watermark
+    drains them."""
 
     def __init__(self, config: dict):
         super().__init__(config, "instant_join")
-        # bin_ts -> side -> list[RecordBatch]
-        self.bins: Dict[int, Dict[int, List[pa.RecordBatch]]] = {}
         self.emitted_up_to: Optional[int] = None
-        # batches buffered since the last checkpoint, per side
-        self._dirty: Dict[int, List[pa.RecordBatch]] = {0: [], 1: []}
+        # side tables (durable via the table manager, or operator-local
+        # spill-only instances when the job has no state backend)
+        self._tables: Optional[List] = None
+        self._durable = False
 
     _SIDE_TABLES = ("ijl", "ijr")
 
@@ -346,6 +353,10 @@ class InstantJoinOperator(JoinBase):
 
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
+            self._durable = True
+            self._tables = [
+                await ctx.table(name) for name in self._SIDE_TABLES
+            ]
             table = await ctx.table("ij")
             for snap in table.all_values():
                 if snap.get("emitted_up_to") is not None:
@@ -353,47 +364,22 @@ class InstantJoinOperator(JoinBase):
                         self.emitted_up_to or 0, snap["emitted_up_to"]
                     )
                 for ts_s, sides in snap.get("bins", {}).items():
-                    tgt = self.bins.setdefault(int(ts_s), {0: [], 1: []})
                     for side in (0, 1):
                         for blob in sides[str(side)]:
                             b = self._filter_to_range(_ipc_read(blob), ctx)
                             if b is not None and b.num_rows:
-                                tgt[side].append(b)
                                 # legacy full-snapshot rows have no delta
                                 # files; re-persist at the next checkpoint
-                                self._dirty[side].append(b)
-            for side, name in enumerate(self._SIDE_TABLES):
-                t = await ctx.table(name)
-                for b in t.all_batches():
-                    self._rebuffer(b, side)
-                t.batches.clear()
+                                self._tables[side].insert(b)
+        else:
+            # stateless run: same buffer + spill semantics, no durability
+            from ..state.table_config import time_key_table
+            from ..state.tables import TimeKeyTable
 
-    def _rebuffer(self, batch: pa.RecordBatch, side: int):
-        """Restore one delta batch: split by timestamp into bins (emitted
-        bins were already pruned by retention at restore)."""
-        tnp = np.asarray(
-            batch.column(batch.schema.names.index(TIMESTAMP_FIELD)).cast(
-                pa.int64()
-            )
-        )
-        if self.emitted_up_to is not None:
-            live = tnp > self.emitted_up_to
-            if not live.any():
-                return
-            if not live.all():
-                batch = batch.filter(pa.array(live))
-                tnp = tnp[live]
-        order = np.argsort(tnp, kind="stable")
-        sorted_batch = batch.take(pa.array(order))
-        sorted_ts = tnp[order]
-        uniq = np.unique(sorted_ts)
-        bounds = np.searchsorted(sorted_ts, uniq, side="left").tolist()
-        bounds.append(len(sorted_ts))
-        for i, t in enumerate(uniq):
-            lo, hi = bounds[i], bounds[i + 1]
-            self.bins.setdefault(int(t), {0: [], 1: []})[side].append(
-                sorted_batch.slice(lo, hi - lo)
-            )
+            self._tables = [
+                TimeKeyTable(time_key_table(name, retention_nanos=-1))
+                for name in self._SIDE_TABLES
+            ]
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
@@ -406,19 +392,12 @@ class InstantJoinOperator(JoinBase):
                     "bins": {},
                 },
             )
-            for side, name in enumerate(self._SIDE_TABLES):
-                dirty = self._dirty[side]
-                self._dirty[side] = []
-                live = [
-                    b
-                    for b in dirty
-                    if self.emitted_up_to is None
-                    or _batch_max_ts(b) > self.emitted_up_to
-                ]
-                if live:
-                    t = await ctx.table(name)
-                    for b in live:
-                        t.write_delta(b)
+            # skip persisting rows whose bin already emitted this epoch
+            if self.emitted_up_to is not None:
+                for t in self._tables:
+                    t.prune_dirty(
+                        lambda b: _batch_max_ts(b) > self.emitted_up_to
+                    )
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         tnp = np.asarray(
@@ -432,30 +411,21 @@ class InstantJoinOperator(JoinBase):
                 if not live.any():
                     return
                 batch = batch.filter(pa.array(live))
-                tnp = tnp[live]
-        uniq = np.unique(tnp)
-        if len(uniq) == 1:
-            self._buffer(int(uniq[0]), input_index, batch)
-            return
-        order = np.argsort(tnp, kind="stable")
-        sorted_batch = batch.take(pa.array(order))
-        sorted_ts = tnp[order]
-        bounds = np.searchsorted(sorted_ts, uniq, side="left").tolist()
-        bounds.append(len(sorted_ts))
-        for i, t in enumerate(uniq):
-            lo, hi = bounds[i], bounds[i + 1]
-            self._buffer(int(t), input_index, sorted_batch.slice(lo, hi - lo))
-
-    def _buffer(self, ts: int, side: int, batch: pa.RecordBatch):
-        self.bins.setdefault(ts, {0: [], 1: []})[side].append(batch)
-        self._dirty[side].append(batch)
+        if batch.num_rows:
+            self._tables[input_index].insert(
+                batch, stage_dirty=self._durable
+            )
 
     async def handle_watermark(self, watermark, ctx, collector):
         if watermark.kind != WatermarkKind.EVENT_TIME:
             return watermark
         t = watermark.timestamp
-        for ts in sorted(b for b in self.bins if b <= t):
-            sides = self.bins.pop(ts)
+        bins: Dict[int, Dict[int, List[pa.RecordBatch]]] = {}
+        for side in (0, 1):
+            for ts, b in self._tables[side].take_bins_upto(t):
+                bins.setdefault(ts, {0: [], 1: []})[side].append(b)
+        for ts in sorted(bins):
+            sides = bins[ts]
             left, right = sides[0], sides[1]
             if not left and not right:
                 continue
@@ -560,7 +530,7 @@ class JoinWithExpirationOperator(JoinBase):
                 for b in t.all_batches():
                     if b.num_rows:
                         self.buffers[side].append(b)
-                t.batches.clear()
+                t.clear_batches()
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:

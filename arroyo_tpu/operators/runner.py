@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 import pyarrow as pa
 
 from .. import obs
+from ..config import config
 from ..metrics import (
     BARRIER_ALIGNMENT_SECONDS,
     BATCH_PROCESSING_SECONDS,
@@ -136,6 +137,15 @@ class SubtaskRunner:
         self._wm_lag = None  # registered lazily on the first watermark
         self._align_span = obs.NULL_SPAN
         self._align_started: Optional[float] = None
+        # off-barrier checkpoint flush queue (ROADMAP item 4): up to
+        # state.max_inflight_flushes epochs' flushes run concurrently
+        # with later epochs' processing, strictly epoch-ordered per
+        # subtask (each flush awaits its predecessor before doing I/O)
+        self._inflight_flushes: List[asyncio.Task] = []
+        self._last_flush: Optional[asyncio.Task] = None
+        self._flush_failed = False
+        self._max_inflight = max(1, int(config().state.max_inflight_flushes))
+        self._flush_hwm = 0  # high-water mark of concurrent flushes (tests)
         # device-tier observatory: latency-marker transit up to this
         # subtask (and end-to-end when terminal), plus the trace id that
         # batch/watermark-triggered jax.compile spans anchor under
@@ -573,11 +583,14 @@ class SubtaskRunner:
     async def _checkpoint_chain(self, barrier):
         """Capture every chain op's state at the barrier, re-broadcast the
         barrier downstream immediately, then flush (device->host
-        materialization + file I/O) in a background task that overlaps the
-        next epoch's processing. The completed-report is sent when the
-        flush lands; the next barrier awaits the previous flush so epoch
-        file lists stay ordered."""
-        await self._await_pending_flush()
+        materialization + file I/O) in a background task that overlaps
+        later epochs' processing. The completed-report is sent when the
+        flush lands. Up to state.max_inflight_flushes epochs' flushes may
+        be in flight; they run strictly epoch-ordered per subtask (each
+        awaits its predecessor), so file-list bookkeeping and completion
+        reports stay ordered while barrier cadence is fully decoupled
+        from upload time. `then_stop` and commit paths drain completely."""
+        await self._admit_flush()
         self.control_tx.put_nowait(
             CheckpointEventResp(
                 self.task_info.task_id,
@@ -623,20 +636,48 @@ class SubtaskRunner:
         flush = asyncio.ensure_future(
             self._flush_and_report(barrier, captured, commit_data,
                                    self.watermarks.current_nanos(),
-                                   flush_span)
+                                   flush_span, prev=self._last_flush)
         )
-        self._pending_flush = flush
+        self._last_flush = flush
+        self._inflight_flushes.append(flush)
+        self._flush_hwm = max(
+            self._flush_hwm,
+            sum(1 for t in self._inflight_flushes if not t.done()),
+        )
         if barrier.then_stop:
             await self._await_pending_flush()
 
+    async def _admit_flush(self):
+        """Block until a flush slot is free (bounds capture-ahead: the
+        barrier path stalls only once max_inflight epochs are uploading)."""
+        self._inflight_flushes = [
+            t for t in self._inflight_flushes if not t.done()
+        ]
+        while len(self._inflight_flushes) >= self._max_inflight:
+            await self._inflight_flushes[0]
+            self._inflight_flushes = [
+                t for t in self._inflight_flushes if not t.done()
+            ]
+
     async def _await_pending_flush(self):
-        flush = getattr(self, "_pending_flush", None)
-        if flush is not None:
-            self._pending_flush = None
+        """Drain EVERY in-flight flush (stop/commit/close paths stay
+        strictly drained — teardown must never strand an upload)."""
+        flushes, self._inflight_flushes = self._inflight_flushes, []
+        for flush in flushes:
             await flush
+        self._last_flush = None
 
     async def _flush_and_report(self, barrier, captured, commit_data,
-                                watermark, flush_span=obs.NULL_SPAN):
+                                watermark, flush_span=obs.NULL_SPAN,
+                                prev: Optional[asyncio.Task] = None):
+        if prev is not None and not prev.done():
+            await asyncio.wait({prev})
+        if self._flush_failed:
+            # an earlier epoch's flush already failed the task: reporting
+            # (or flushing) later epochs would publish state past a hole
+            flush_span.set(skipped="predecessor_failed")
+            flush_span.finish()
+            return
         t0 = time.perf_counter()
         tok = flush_span.attach() if flush_span.recording else None
         try:
@@ -655,6 +696,7 @@ class SubtaskRunner:
                 "checkpoint flush failed for %s epoch %s",
                 self.task_info.task_id, barrier.epoch,
             )
+            self._flush_failed = True
             flush_span.set(error=traceback.format_exc(limit=3)[:300])
             self.control_tx.put_nowait(
                 TaskFailedResp(

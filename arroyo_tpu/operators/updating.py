@@ -282,7 +282,7 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                     )
                 )
         rows = [(kv, v) for kv, v in newest.items() if v is not None]
-        table.batches.clear()
+        table.clear_batches()
         if not rows:
             return
         mask = self._range_mask([list(kv) for kv, _ in rows], ctx)

@@ -462,7 +462,7 @@ class WindowOperatorBase(Operator):
             {"bins": bins_l, "keys": keys_l, "values": cols}, ctx
         )
         # conduit table: in-memory source of truth is the accumulator
-        table.batches.clear()
+        table.clear_batches()
 
     def _key_arrays(self, batch: pa.RecordBatch) -> List[np.ndarray]:
         out = []
@@ -1187,6 +1187,14 @@ class SlidingWindowOperator(WindowOperatorBase):
         self.last_freed_bin = max(self.last_freed_bin or lo_bin, lo_bin)
 
 
+def _tolist(col) -> list:
+    """Portable list view of one snapshot column slice (numpy scalar
+    arrays or ragged host-state object arrays)."""
+    if isinstance(col, np.ndarray):
+        return col.tolist()
+    return list(col)
+
+
 def _batch_group_codes(key_cols: List[np.ndarray], n: int) -> np.ndarray:
     """Per-row group code over the key columns, local to ONE batch:
     non-integer columns factorize via pandas (no entry in the process-
@@ -1235,6 +1243,12 @@ class SessionWindowOperator(WindowOperatorBase):
         assert self.gap > 0
         # key -> list of [start, last_ts, slot], sorted by start
         self.sessions: Dict[tuple, List[List]] = {}
+        # incremental checkpointing (ROADMAP item 4): keys whose sessions
+        # or accumulators changed since the last epoch, and keys whose
+        # last session closed (tombstoned in the sess table) — capture
+        # cost is O(touched sessions), not O(live sessions)
+        self._ckpt_dirty: set = set()
+        self._ckpt_dead: set = set()
         self._next_shard = 0
         # block-refilled slot pool: one vectorized alloc_slots call per
         # _POOL_BLOCK sessions instead of one Python directory call per
@@ -1274,18 +1288,102 @@ class SessionWindowOperator(WindowOperatorBase):
 
     async def on_start(self, ctx):
         self._capture_key_meta(ctx)
-        if ctx.table_manager is not None:
-            table = await ctx.table("sess")
-            for snap in _snaps_for_me(table, ctx, bool(self.key_cols)):
+        if ctx.table_manager is None:
+            return
+        table = await ctx.table("sess")
+        if not self.key_cols:
+            # unkeyed (window-global) sessions keep the legacy
+            # per-subtask snapshot — there is no key to partition by
+            for snap in _snaps_for_me(table, ctx, False):
                 self._restore_sessions(snap, ctx)
+            return
+        legacy, per_key = [], []
+        for k, v in table.items():
+            if isinstance(k, tuple) and k and k[0] == "sk":
+                per_key.append((k, v))
+            elif isinstance(v, dict) and "sessions" in v:
+                legacy.append(v)
+        for snap in legacy:
+            self._restore_sessions(snap, ctx)
+        kept = self._restore_per_key(per_key, ctx)
+        # each subtask's chain carries ONLY its own keys from here on:
+        # out-of-range entries (and replayed legacy snaps) are owned and
+        # re-persisted by their own subtasks this same epoch, so they are
+        # pruned without tombstones — which keeps the cross-subtask union
+        # free of stale replicated copies and lets rebase drop tombstones
+        table.retain(lambda k: isinstance(k, tuple) and k and k[0] == "sk"
+                     and k in kept)
+        # everything restored re-persists at the first post-restore epoch
+        # (covers legacy-format upgrades and the pruned replicas)
+        self._ckpt_dirty.update(self.sessions)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         self._return_pool()
-        if ctx.table_manager is not None:
-            table = await ctx.table("sess")
+        if ctx.table_manager is None:
+            return
+        table = await ctx.table("sess")
+        if not self.key_cols:
             snap = self._snapshot_sessions()
             snap["subtask"] = ctx.task_info.task_index
             table.put(ctx.task_info.task_index, snap)
+            return
+        for key in self._ckpt_dead:
+            table.delete(self._sess_key(key))
+        self._ckpt_dead.clear()
+        dirty = [k for k in self._ckpt_dirty if k in self.sessions]
+        self._ckpt_dirty.clear()
+        if dirty:
+            # one batched accumulator gather for every dirty session
+            slots = [s[2] for k in dirty for s in self.sessions[k]]
+            values = self.acc.snapshot(
+                np.asarray(slots, dtype=np.int64)
+            ) if slots else []
+            idx = 0
+            for k in dirty:
+                sess = self.sessions[k]
+                n = len(sess)
+                table.put(self._sess_key(k), {
+                    "s": [[int(x) for x in s[:2]] + [int(s[2])]
+                          for s in sess],
+                    "v": [_tolist(col[idx:idx + n]) for col in values],
+                })
+                idx += n
+
+    def _sess_key(self, key: tuple) -> tuple:
+        """Portable per-session-key table key ("sk", *values) — msgpack
+        round-trips it as a list, GlobalTable re-tuples on load."""
+        return ("sk", *self._key_tuple_to_values(key))
+
+    def _restore_per_key(self, items: list, ctx) -> set:
+        """Replay per-key entries owned by this subtask; returns the set
+        of table keys kept (for the retain() prune)."""
+        if not items:
+            return set()
+        key_rows = [list(k[1:]) for k, _v in items]
+        mask = self._range_mask(key_rows, ctx)
+        kept = set()
+        sessions, slots, cols = [], [], None
+        idx = 0
+        for i, (k, v) in enumerate(items):
+            if mask is not None and not mask[i]:
+                continue
+            kept.add(k)
+            sess_list = []
+            for s in v["s"]:
+                sess_list.append([s[0], s[1], idx])
+                slots.append(idx)
+                idx += 1
+            sessions.append([list(k[1:]), sess_list])
+            if cols is None:
+                cols = [[] for _ in v["v"]]
+            for c, col in zip(cols, v["v"]):
+                c.extend(col)
+        if sessions:
+            self._restore_sessions(
+                {"sessions": sessions, "slots": slots, "values": cols or []},
+                ctx,
+            )
+        return kept
 
     def _snapshot_sessions(self) -> dict:
         slots = [s[2] for v in self.sessions.values() for s in v]
@@ -1388,6 +1486,8 @@ class SessionWindowOperator(WindowOperatorBase):
                 seg_slots[g] = self._place_segment(
                     key, int(so_ts[starts[g]]), int(so_ts[ends[g]])
                 )
+                self._ckpt_dirty.add(key)
+                self._ckpt_dead.discard(key)
             row_slots[li[order]] = seg_slots[seg_id]
         keep = row_slots >= 0
         if keep.any():
@@ -1464,8 +1564,10 @@ class SessionWindowOperator(WindowOperatorBase):
         exp_slots: List[int] = []
         for key in list(self.sessions):
             remaining = []
+            expired_any = False
             for s in self.sessions[key]:
                 if s[1] + self.gap <= t:
+                    expired_any = True
                     exp_keys.append(key)
                     exp_starts.append(s[0])
                     exp_ends.append(s[1] + self.gap)
@@ -1474,8 +1576,13 @@ class SessionWindowOperator(WindowOperatorBase):
                     remaining.append(s)
             if remaining:
                 self.sessions[key] = remaining
+                if expired_any:
+                    self._ckpt_dirty.add(key)
             else:
                 del self.sessions[key]
+                if expired_any:
+                    self._ckpt_dead.add(key)
+                    self._ckpt_dirty.discard(key)
         if exp_slots:
             slot_arr = np.asarray(exp_slots, dtype=np.int64)
             fused = getattr(self.acc, "gather_and_reset", None)

@@ -53,9 +53,23 @@ class StateBackend:
 
     # -- data files ---------------------------------------------------------
 
+    def global_blob_path(self, epoch: int, node_id: int, op_idx: int,
+                         table: str, subtask: int) -> str:
+        """Deterministic (and generation-fenced) path for an epoch's
+        global-table blob — computable at CAPTURE time so the manifest
+        chain can be extended before the flush lands."""
+        return self.paths.data_file(
+            epoch, node_id, op_idx, table, subtask, "bin",
+            gen=self.generation,
+        )
+
+    def write_blob(self, path: str, blob: bytes) -> str:
+        self.storage.put(path, blob)
+        return path
+
     def write_global_blob(self, epoch: int, node_id: int, op_idx: int,
                           table: str, subtask: int, blob: bytes) -> str:
-        path = self.paths.data_file(epoch, node_id, op_idx, table, subtask, "bin")
+        path = self.global_blob_path(epoch, node_id, op_idx, table, subtask)
         self.storage.put(path, blob)
         return path
 
@@ -65,7 +79,8 @@ class StateBackend:
                             timestamp_field: str = "_timestamp"
                             ) -> Dict[str, Any]:
         path = self.paths.data_file(
-            epoch, node_id, op_idx, table, subtask, "parquet"
+            epoch, node_id, op_idx, table, subtask, "parquet",
+            gen=self.generation,
         )
         size = self.storage.write_parquet(path, data)
         ts_col = data.column(timestamp_field).cast(pa.int64())
@@ -258,6 +273,11 @@ class StateBackend:
                 for meta in tables.values():
                     if meta.get("path"):
                         referenced.add(meta["path"])
+                    # incremental global tables: the whole blob chain
+                    # (base + deltas across epochs) stays live until a
+                    # rebase truncates it
+                    for f in meta.get("chain", []):
+                        referenced.add(f["path"])
                     for f in meta.get("files", []):
                         referenced.add(f["path"])
         latest_epoch = manifest.get("epoch")

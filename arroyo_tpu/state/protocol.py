@@ -46,11 +46,18 @@ class ProtocolPaths:
 
     def data_file(
         self, epoch: int, node_id: int, op_idx: int, table: str,
-        subtask: int, ext: str,
+        subtask: int, ext: str, gen: Optional[int] = None,
     ) -> str:
+        # the generation component fences zombie writers at the DATA
+        # level: with multiple checkpoint flushes in flight, a paused
+        # old-generation worker's late upload must not overwrite the new
+        # incarnation's file for the same (epoch, table, subtask) — a
+        # fenced writer's bytes land at a path no live manifest will
+        # ever reference, and GC sweeps them
+        g = f"-g{gen:05d}" if gen is not None else ""
         return (
             f"{self.checkpoint_dir(epoch)}/data/"
-            f"{node_id:03d}-{op_idx}-{table}-{subtask:03d}.{ext}"
+            f"{node_id:03d}-{op_idx}-{table}-{subtask:03d}{g}.{ext}"
         )
 
     def compacted_file(self, epoch: int, node_id: int, op_idx: int,

@@ -46,9 +46,15 @@ class _OpTimer:
 
     __slots__ = ("op", "span", "t0")
 
-    def __init__(self, op: str, key: str):
+    def __init__(self, op: str, key: str, nbytes: Optional[int] = None):
         self.op = op
-        self.span = obs.span(f"storage.{op}", cat="storage", key=key)
+        attrs = {"key": key}
+        if nbytes is not None:
+            # payload size rides on write spans: the state-bloat drill
+            # reads per-epoch upload bytes from the flight recording
+            # (disk listings lose GC'd epochs)
+            attrs["bytes"] = nbytes
+        self.span = obs.span(f"storage.{op}", cat="storage", **attrs)
 
     def __enter__(self):
         self.t0 = _time.perf_counter()
@@ -110,7 +116,7 @@ class StorageProvider:
         return str(self.root / key)
 
     def put(self, key: str, data: bytes):
-        with _OpTimer("put", key):
+        with _OpTimer("put", key, nbytes=len(data)):
             _chaos_latency("put", key)
             if chaos.fire("storage.write_fail", key=key):
                 raise IOError(

@@ -5,18 +5,40 @@ Capability parity with the reference's TableManager
 the operator's tables, restores them from the backend's restore manifest on
 open, flushes dirty state on checkpoint barriers, and swaps file references
 after compaction. Restore semantics per table kind:
-  * global: union of ALL subtasks' blobs (replication — rescale-aware
-    operators re-filter by key range themselves)
+  * global: union of ALL subtasks' blob chains (replication — rescale-aware
+    operators re-filter by key range themselves). Each subtask's manifest
+    entry carries a base+delta chain replayed in epoch order; entry stamps
+    make the cross-subtask merge deterministic (tables.GlobalTable).
   * time_key: read every subtask's live files, filter rows to this
     subtask's key range and retention (rescale = overlap re-read,
     reference parquet.rs + expiring_time_key_map.rs)
+
+Checkpointing is split into capture (synchronous at the barrier, O(dirty))
+and flush (storage I/O, safe to overlap later epochs): the runner keeps up
+to `state.max_inflight_flushes` epochs' flushes in flight, strictly
+epoch-ordered per subtask, so flush N always lands before flush N+1 runs —
+which is what lets flush-time bookkeeping (the cumulative time-key file
+list) read `table.files` without racing a later capture.
+
+Rebase policy: an incremental global table's chain is truncated with a
+fresh base once it carries `state.rebase_epochs` deltas or its delta bytes
+exceed `state.rebase_bytes_factor` x the base size (restore replays the
+whole chain, so the chain length is a restore-time tax).
 """
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional
 
 from .. import obs
+from ..config import config as get_config
+from ..metrics import (
+    STATE_BYTES,
+    STATE_CHAIN_LEN,
+    STATE_ROWS,
+    STATE_SPILLED_BYTES,
+)
 from ..types import TaskInfo
 from ..utils.logging import get_logger
 from .backend import StateBackend
@@ -33,6 +55,10 @@ class TableManager:
         self.op_idx = op_idx
         self.tables: Dict[str, object] = {}
         self.configs: Dict[str, TableConfig] = {}
+        # global tables' current blob chain: name -> [{"path", "bytes",
+        # "epoch", "base"}]. Extended at CAPTURE time (paths are
+        # deterministic) so pipelined flushes can't race the bookkeeping.
+        self._chains: Dict[str, list] = {}
 
     async def open(self, configs: Dict[str, TableConfig]):
         self.configs = dict(configs)
@@ -44,10 +70,68 @@ class TableManager:
             self.tables[name] = table
         if self.backend.restore_manifest:
             self._restore()
+        self._register_gauges()
+
+    def _register_gauges(self):
+        """Scrape-time state-size gauges (weakref pattern: a collected
+        table unregisters its refresher instead of pinning stale values)."""
+        jid, tid = self.task_info.job_id, self.task_info.task_id
+        for name, table in self.tables.items():
+            kind = self.configs[name].kind
+            tref = weakref.ref(table)
+            labels = dict(job=jid, task=tid, table=name, kind=kind)
+
+            def _bytes(tref=tref):
+                t = tref()
+                if t is None:
+                    return None
+                if isinstance(t, GlobalTable):
+                    return float(t.state_size()[0])
+                mem, spilled, _r, _b = t.entry_stats()
+                return float(mem + spilled)
+
+            def _rows(tref=tref):
+                t = tref()
+                if t is None:
+                    return None
+                if isinstance(t, GlobalTable):
+                    return float(t.state_size()[1])
+                return float(t.entry_stats()[2])
+
+            STATE_BYTES.labels(**labels).set_refresher(_bytes)
+            STATE_ROWS.labels(**labels).set_refresher(_rows)
+            if kind != "global":
+
+                def _spilled(tref=tref):
+                    t = tref()
+                    if t is None:
+                        return None
+                    return float(t.entry_stats()[1])
+
+                STATE_SPILLED_BYTES.labels(
+                    job=jid, task=tid, table=name
+                ).set_refresher(_spilled)
+            if kind == "global":
+                mref = weakref.ref(self)
+
+                def _chain(mref=mref, name=name):
+                    m = mref()
+                    if m is None:
+                        return None
+                    return float(len(m._chains.get(name, ())))
+
+                STATE_CHAIN_LEN.labels(job=jid, task=tid,
+                                       table=name).set_refresher(_chain)
 
     def _restore(self):
         node_id = self.task_info.node_id
-        per_subtask = self.backend.tables_for(node_id, self.op_idx)
+        # deterministic replay order: the cross-subtask union resolves
+        # stale replicated copies by entry stamp, and ties by replay
+        # order — sort so ties break the same way on every restore
+        per_subtask = sorted(
+            self.backend.tables_for(node_id, self.op_idx),
+            key=lambda e: e["subtask"],
+        )
         restore_wm = self.backend.restore_watermark(self.task_info.task_id)
         for name, table in self.tables.items():
             cfg = self.configs[name]
@@ -61,15 +145,24 @@ class TableManager:
                 op_idx=self.op_idx,
             ) as sp:
                 if cfg.kind == "global":
-                    blobs = []
+                    n_blobs = 0
                     for entry in per_subtask:
                         meta = entry["tables"].get(name)
-                        if meta and meta.get("path"):
-                            blob = self.backend.read_blob(meta["path"])
+                        if not meta:
+                            continue
+                        chain = meta.get("chain")
+                        if chain is None and meta.get("path"):
+                            chain = [{"path": meta["path"]}]
+                        blobs = []
+                        for f in chain or []:
+                            sp.event("read_blob", path=f["path"])
+                            blob = self.backend.read_blob(f["path"])
                             if blob is not None:
                                 blobs.append(blob)
-                    table.load(blobs)
-                    sp.set(blobs=len(blobs))
+                        if blobs:
+                            table.load_chain(blobs)
+                            n_blobs += len(blobs)
+                    sp.set(blobs=n_blobs)
                 else:
                     seen = set()
                     batches = []
@@ -103,56 +196,91 @@ class TableManager:
         One-shot form of capture() + flush_captured()."""
         return self.flush_captured(epoch, self.capture(epoch, watermark))
 
+    def _should_rebase(self, chain: list) -> bool:
+        st = get_config().state
+        if not chain:
+            return True
+        deltas = [f for f in chain if not f.get("base")]
+        if len(deltas) >= st.rebase_epochs:
+            return True
+        base_bytes = sum(
+            f.get("bytes", 0) for f in chain if f.get("base")
+        ) or 1
+        delta_bytes = sum(f.get("bytes", 0) for f in deltas)
+        return delta_bytes > st.rebase_bytes_factor * base_bytes
+
     def capture(self, epoch: int, watermark: Optional[int]) -> Dict:
         """Synchronously stage this epoch's state at the barrier: global
-        blobs are serialized now (cheap — incremental operators keep only
-        meta here), time-key deltas are detached from the tables (possibly
-        as unresolved thunks whose device->host copy completes later).
-        After capture the operator may resume processing; flush_captured
-        does the I/O."""
+        tables serialize only their dirty entries + tombstones (a base
+        when the chain is empty or the rebase policy fires), time-key
+        deltas are detached from the tables (possibly as unresolved
+        thunks whose device->host copy completes later). After capture
+        the operator may resume processing; flush_captured does the I/O."""
         staged: Dict[str, dict] = {}
+        ti = self.task_info
         for name, table in self.tables.items():
             cfg = self.configs[name]
             if cfg.kind == "global":
-                staged[name] = {"kind": "global", "blob": table.serialize()}
+                chain = self._chains.setdefault(name, [])
+                blob, is_base = table.serialize_delta(
+                    epoch, force_base=self._should_rebase(chain)
+                )
+                if blob is not None:
+                    path = self.backend.global_blob_path(
+                        epoch, ti.node_id, self.op_idx, name, ti.task_index
+                    )
+                    meta = {"path": path, "bytes": len(blob),
+                            "epoch": epoch, "base": is_base}
+                    if is_base:
+                        chain[:] = [meta]
+                    else:
+                        chain.append(meta)
+                staged[name] = {
+                    "kind": "global", "blob": blob,
+                    "chain": [dict(f) for f in chain],
+                }
             else:
                 dirty = table.take_dirty_staged()
-                files = table.live_files(watermark)
                 table.expire(watermark)
                 staged[name] = {
                     "kind": "time_key",
                     "dirty": dirty,
-                    "files": files,
+                    "watermark": watermark,
                     "table": table,
                 }
         return staged
 
     def flush_captured(self, epoch: int, staged: Dict) -> Dict:
         """Write captured state to storage; safe to run while the operator
-        processes the next epoch (captured data is immutable). Returns the
-        manifest metadata."""
+        processes later epochs (captured data is immutable), as long as
+        flushes stay epoch-ordered per subtask (the runner's flush queue
+        guarantees it — time-key file bookkeeping reads `table.files`
+        here, which epoch N must update before epoch N+1 flushes).
+        Returns the manifest metadata."""
         meta: Dict[str, dict] = {}
         ti = self.task_info
         for name, st in staged.items():
             cfg = self.configs[name]
             if st["kind"] == "global":
-                blob = st["blob"]
-                path = self.backend.write_global_blob(
-                    epoch, ti.node_id, self.op_idx, name, ti.task_index, blob
-                )
+                chain = st["chain"]
+                if st["blob"] is not None:
+                    self.backend.write_blob(chain[-1]["path"], st["blob"])
                 meta[name] = {
-                    "kind": "global", "path": path, "bytes": len(blob)
+                    "kind": "global",
+                    "chain": chain,
+                    "bytes": sum(f.get("bytes", 0) for f in chain),
                 }
             else:
                 dirty = TimeKeyTable.resolve_staged(st["dirty"])
-                files = st["files"]
+                table = st["table"]
+                files = table.live_files(st["watermark"])
                 if dirty is not None and dirty.num_rows:
                     f = self.backend.write_time_key_file(
                         epoch, ti.node_id, self.op_idx, name, ti.task_index,
                         dirty, timestamp_field=cfg.timestamp_field,
                     )
                     files = files + [f]
-                st["table"].files = files
+                table.files = files
                 meta[name] = {"kind": "time_key", "files": files}
         return meta
 

@@ -147,10 +147,48 @@ def build_admin_app(role: str, details_fn=None,
             obs.latency_report(request.query.get("job"))
         )
 
+    async def debug_state(request: web.Request):
+        """State-at-scale dump: per-(task, table, kind) state sizes, rows,
+        spill bytes and global-table delta-chain lengths from the
+        scrape-time-refreshed gauges — the live numbers the rebase/spill
+        knobs (state.rebase_epochs, state.memory_budget_bytes) are tuned
+        from. ?job=<id> narrows to one job's subtasks."""
+        from ..metrics import REGISTRY
+
+        job = request.query.get("job")
+        snap = REGISTRY.snapshot()
+        tables: dict = {}
+        fields = {
+            "arroyo_state_bytes": "bytes",
+            "arroyo_state_rows": "rows",
+            "arroyo_state_spilled_bytes": "spilled_bytes",
+            "arroyo_state_delta_chain_len": "chain_len",
+        }
+        for family, field in fields.items():
+            for labels, value in snap.get(family, []):
+                if job and labels.get("job") != job:
+                    continue
+                key = (labels.get("task", ""), labels.get("table", ""))
+                ent = tables.setdefault(key, {
+                    "task": labels.get("task"),
+                    "table": labels.get("table"),
+                    "kind": labels.get("kind"),
+                })
+                if labels.get("kind") and not ent.get("kind"):
+                    ent["kind"] = labels["kind"]
+                ent[field] = value
+        return web.json_response({
+            "tables": sorted(
+                tables.values(),
+                key=lambda e: (e["task"] or "", e["table"] or ""),
+            ),
+        })
+
     app = web.Application()
     app.router.add_get("/status", status)
     app.router.add_get("/name", name)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/state", debug_state)
     app.router.add_get("/debug/tasks", debug_tasks)
     app.router.add_get("/debug/stacks", debug_stacks)
     app.router.add_get("/debug/profile", debug_profile)

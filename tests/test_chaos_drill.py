@@ -68,6 +68,23 @@ def test_rescale_drill_exactly_once(tmp_path):
     assert (tmp_path / "autoscale_decisions.json").exists()
 
 
+def test_state_bloat_drill_flat_checkpoints(tmp_path):
+    """ISSUE 8 acceptance (ROADMAP item 4): session state grows ~10x
+    during the run, a worker is SIGKILLed mid-upload with storage
+    latency widening the in-flight flush window — output byte-identical
+    to the fault-free run AND checkpoint capture time + per-epoch delta
+    bytes stay ~flat as state grows (a full-snapshot design shows ~10x
+    growth on both)."""
+    res = drill.run_state_bloat_drill(seed=20260804, workdir=str(tmp_path))
+    assert res.passed, f"{res.error}\nextras: {res.extras}"
+    assert res.restarts >= 1  # the mid-upload SIGKILL forced a recovery
+    assert res.extras["epochs_measured"] >= 6, res.extras
+    assert (
+        res.extras["capture_ms_late_median"]
+        <= 2.0 * res.extras["capture_ms_early_median"] + 2.0
+    ), res.extras
+
+
 def test_kafka_exactly_once_drill(tmp_path):
     """VERDICT r5 item 8 wiring: the protocol-shaped kafka fake (fenced
     producer epochs, abortable transactions) driven through the embedded

@@ -86,10 +86,15 @@ def _spread_pct(doc: dict, metric: str) -> Optional[float]:
 
 
 def classify(metric: str) -> Optional[str]:
-    """'higher' (throughput), 'lower' (latency), or None (not gated)."""
+    """'higher' (throughput), 'lower' (latency/cost), or None (not
+    gated)."""
     if metric == "value" or metric.endswith("_eps"):
         return "higher"
     if metric.endswith("_ms"):
+        return "lower"
+    # state-at-scale costs (ISSUE 8): checkpoint capture latency and
+    # amortized upload volume both regress UPWARD
+    if metric.endswith("_ms_p99") or metric.endswith("_bytes_per_epoch"):
         return "lower"
     return None
 

@@ -29,6 +29,14 @@
         durable stop checkpoint and the reschedule; output must be
         byte-identical and the decision audit log is written next to
         the results.
+
+    python tools/chaos_drill.py --state-bloat
+        ROADMAP item 4 acceptance: session state grows ~10x during the
+        run, a worker is SIGKILLed mid-upload (storage latency widens
+        the in-flight flush window), and the drill requires
+        byte-identical output AND ~flat checkpoint capture time +
+        per-epoch delta bytes as state grows (<= 2x early-run medians;
+        a full-snapshot design shows ~10x on both).
 """
 
 import argparse
@@ -60,6 +68,10 @@ def main() -> int:
                     help="also run the autoscaler-rescale drill: worker "
                     "kill mid-automatic-rescale + reschedule failure, "
                     "byte-identical output required")
+    ap.add_argument("--state-bloat", action="store_true",
+                    help="also run the state-bloat drill: 10x state "
+                    "growth + SIGKILL mid-upload; requires byte-identical "
+                    "output and ~flat capture time / delta bytes")
     ap.add_argument("--out", type=str, default="",
                     help="write results + fired-fault log to this JSON file")
     ap.add_argument("--workdir", type=str, default="")
@@ -94,6 +106,12 @@ def main() -> int:
     if args.rescale:
         results.append(
             d.run_rescale_drill(args.seed, os.path.join(workdir, "rescale"))
+        )
+    if args.state_bloat:
+        results.append(
+            d.run_state_bloat_drill(
+                args.seed, os.path.join(workdir, "state-bloat")
+            )
         )
 
     ok = all(r.passed for r in results)

@@ -14,6 +14,14 @@ rules spanning three hazard layers (SURVEY §2.8; ISSUE 3):
   jax+config— host syncs inside jitted bodies, jit-captured mutable Python
               state, dotted config keys resolving to declared defaults
 
+Since ISSUE 9 the package also hosts the protocol MODEL CHECKER
+(``analysis/model/``): explicit-state exploration of the checkpoint/2PC/
+rescale machines extracted from this same tree (``tools/model_check.py``),
+with counterexamples that replay as seeded chaos drills — and lint rule
+PRO004 ties the dispatch code's epoch bookkeeping to the model's
+``@protocol_effect`` handler annotations. Reporters gained SARIF 2.1.0
+(``tools/lint.py --sarif``) so CI annotates PRs with findings.
+
 Run it via ``python tools/lint.py`` (``--strict`` is the CI/tier-1 mode);
 ``tests/test_lint.py`` executes the full tree inside the tier-1 suite.
 Inline suppressions: ``# arroyolint: disable=RULE`` on the offending line,

@@ -1,0 +1,35 @@
+"""Protocol model checker (ISSUE 9).
+
+An explicit-state model checker for the engine's distributed protocols:
+the controller `JobState` machine, the pipelined multi-inflight checkpoint
+epoch lifecycle, the runner/sink 2PC commit protocol with zombie fencing,
+and the autoscaler's RESCALING path — composed with N workers, a CAS
+storage, and per-worker FIFO control channels, with fault events (worker
+death, heartbeat blackout, barrier loss/duplication/reorder, CAS race,
+zombie fence, flush failure, zombie-generation write) as first-class
+transitions.
+
+The model is tied to the dispatch code, not parallel to it:
+
+  * the controller machine's legal moves are EXTRACTED from
+    `controller/state_machine.py`'s TRANSITIONS table by AST
+    (`extract.job_state_machine`) — the model cannot drift from the table;
+  * every modeled transition names the handler(s) implementing it via the
+    `@protocol_effect("<name>")` annotation DSL, and
+    `extract.check_bijection` enforces the PRO00x-style bijection:
+    annotation set == model binding set == live handler set.
+
+A violating run serializes to a trace (`explore.Trace`) that (a) replays
+deterministically against the model (`replay.replay_trace`) and (b)
+serializes to a seeded `chaos.FaultPlan` (`replay.trace_to_fault_plan`)
+runnable against the real embedded cluster via
+`tools/chaos_drill.py --plan` — static and dynamic correctness tooling as
+two ends of one pipeline.
+
+Entry points: `tools/model_check.py` (CLI, CI lanes) and
+`tests/test_model_check.py` (tier-1 smoke + mutant regression corpus).
+Only `effects` is imported eagerly — the runtime modules that carry
+annotations (controller, runner, state) must not pay for the checker.
+"""
+
+from .effects import protocol_effect  # noqa: F401 - the annotation DSL

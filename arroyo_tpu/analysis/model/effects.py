@@ -1,0 +1,39 @@
+"""The effect-annotation DSL binding protocol handlers to the model.
+
+`@protocol_effect("<effect>")` marks a function as the implementation of
+one named protocol effect. The decorator is a runtime no-op (it only tags
+the function), but it is load-bearing statically:
+
+  * `extract.annotated_handlers` finds every annotation by AST;
+  * `extract.check_bijection` enforces annotations == `spec.HANDLER_BINDINGS`
+    == the transition relation's `handlers` references, so the model
+    provably covers exactly the handlers the dispatch code declares;
+  * arroyolint PRO004 requires every `pending_epochs` / in-flight-flush
+    mutation site to be reachable from an annotated handler — no ad-hoc
+    epoch bookkeeping outside the modeled transitions.
+
+Effect names are dotted, component-first: `ctrl.*` (controller driver),
+`worker.*` (subtask runner), `state.*` (table manager), `storage.*`
+(checkpoint protocol over object storage).
+"""
+
+from __future__ import annotations
+
+EFFECT_ATTR = "__protocol_effect__"
+
+
+def protocol_effect(name: str):
+    """Tag `fn` as the implementation of protocol effect `name`.
+
+    Runtime no-op; the model checker's bijection check reads it from the
+    AST. The name must appear in `spec.HANDLER_BINDINGS` — an unknown
+    name fails `extract.check_bijection` (and so tier-1).
+    """
+    if not name or not isinstance(name, str):
+        raise ValueError("protocol_effect needs a non-empty literal name")
+
+    def deco(fn):
+        setattr(fn, EFFECT_ATTR, name)
+        return fn
+
+    return deco

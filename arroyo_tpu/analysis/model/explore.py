@@ -1,0 +1,238 @@
+"""Explicit-state BFS explorer with counterexample reconstruction.
+
+BFS over `Model.enabled` from `initial_state`, hashing NamedTuple states,
+with three checks:
+
+  * step violations (a `Step.violation` is an invariant broken by the
+    transition itself — publish order, chain atomicity, fencing, double
+    commit, illegal JobState moves);
+  * state invariants (`Model.check_state`: deadlock, stall, stranded
+    transaction at stop, fault-free FAILED);
+  * a post-pass on the explored graph: in an exhaustive run, every
+    non-terminal state must be able to reach a terminal one (the
+    "stuck non-terminal state" detector — backward reachability from
+    terminals over the recorded edges).
+
+Partial-order reduction (on by default, `por=False` disables): when
+several workers have purely worker-local steps enabled, only the
+lowest-index worker's local steps are expanded alongside all global
+steps. Worker-local steps on distinct workers commute (they touch
+disjoint worker tuples; their shared effects — blob/report insertion —
+are commutative set adds), deferred steps stay enabled (only a fault
+targeting that worker can disable them, and fault steps are global, so
+that interleaving is still explored), and the invariants never inspect
+the relative order of two workers' local steps. The mutant corpus test
+runs every mutant under both `por` settings and asserts identical
+verdicts — an empirical guard on the reduction, on top of the argument.
+
+A violating path serializes to a `Trace`: the (label, arg) event list
+from the initial state, the violation, and the handler effects each step
+cites (TRANSITION_HANDLERS) — which is what `replay.py` turns into a
+seeded chaos FaultPlan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .spec import (
+    Model,
+    ModelConfig,
+    Step,
+    Sys,
+    TRANSITION_HANDLERS,
+    initial_state,
+)
+
+# worker-local labels: touch one worker's tuple plus commutative global
+# set-inserts only (see the POR argument in the module docstring)
+_LOCAL_LABELS = ("w.capture", "w.flush", "w.commit", "w.finish")
+
+
+@dataclasses.dataclass
+class Trace:
+    """A reproducible counterexample: events from the initial state."""
+
+    violation: str
+    events: List[Tuple[str, Tuple]]  # (label, arg) in order
+    config: dict
+    mutant: str = ""
+
+    def fault_events(self) -> List[Tuple[str, Tuple]]:
+        return [(lb, arg) for (lb, arg) in self.events
+                if lb.startswith("fault.")]
+
+    def handlers_cited(self) -> List[str]:
+        seen: List[str] = []
+        for lb, _arg in self.events:
+            for h in TRANSITION_HANDLERS.get(lb, ()):
+                if h not in seen:
+                    seen.append(h)
+        return seen
+
+    def to_json(self) -> dict:
+        return {
+            "violation": self.violation,
+            "mutant": self.mutant,
+            "config": self.config,
+            "events": [[lb, list(arg)] for (lb, arg) in self.events],
+            "handlers_cited": self.handlers_cited(),
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Trace":
+        return cls(
+            violation=obj["violation"],
+            events=[(lb, tuple(arg)) for lb, arg in obj["events"]],
+            config=obj.get("config", {}),
+            mutant=obj.get("mutant", ""),
+        )
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    states: int
+    transitions: int
+    violations: List[Trace]
+    exhaustive: bool          # False when the state budget truncated BFS
+    terminal_states: int
+    max_frontier: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+
+def _reduce(steps: List[Step]) -> List[Step]:
+    """Ample-set-style reduction: keep all global steps, but expand only
+    the lowest-index worker's local steps when several workers have
+    them. (Deferred locals stay enabled in every successor.)"""
+    local_by_worker: Dict[int, List[Step]] = {}
+    out: List[Step] = []
+    for st in steps:
+        if st.label in _LOCAL_LABELS and not st.violation:
+            local_by_worker.setdefault(st.arg[0], []).append(st)
+        else:
+            out.append(st)
+    if local_by_worker:
+        out.extend(local_by_worker[min(local_by_worker)])
+    return out
+
+
+def explore(
+    model: Model,
+    budget: int = 2_000_000,
+    por: bool = True,
+    max_violations: int = 8,
+    first_violation: bool = False,
+) -> ExploreResult:
+    """BFS the model's state space. Stops early once `max_violations`
+    distinct violation kinds are collected (or the first, when
+    `first_violation`), or when `budget` states were expanded (the
+    result is then marked non-exhaustive)."""
+    init = initial_state(model.cfg)
+    # state -> (predecessor state, (label, arg)) for trace reconstruction
+    parent: Dict[Sys, Optional[Tuple[Sys, Tuple[str, Tuple]]]] = {init: None}
+    edges: Dict[Sys, List[Sys]] = {}
+    frontier = deque([init])
+    violations: List[Trace] = []
+    seen_kinds: set = set()
+    n_trans = 0
+    terminals = 0
+    exhausted = True
+    max_frontier = 1
+
+    def record(state: Sys, step_ev: Optional[Tuple[str, Tuple]],
+               violation: str):
+        kind = violation.split(":", 1)[0]
+        if kind in seen_kinds:
+            return
+        seen_kinds.add(kind)
+        events: List[Tuple[str, Tuple]] = [step_ev] if step_ev else []
+        cur = state
+        while parent[cur] is not None:
+            prev, ev = parent[cur]
+            events.append(ev)
+            cur = prev
+        events.reverse()
+        violations.append(Trace(
+            violation=violation,
+            events=events,
+            config=model.cfg._asdict(),
+            mutant=model.cfg.mutant,
+        ))
+
+    while frontier:
+        if len(parent) > budget:
+            exhausted = False
+            break
+        if violations and (first_violation
+                           or len(violations) >= max_violations):
+            exhausted = False
+            break
+        state = frontier.popleft()
+        steps = model.enabled(state)
+        inv = model.check_state(state, steps)
+        if inv is not None:
+            record(state, None, inv)
+            continue
+        if model.done(state):
+            terminals += 1
+            continue
+        if por:
+            steps = _reduce(steps)
+        succs = edges.setdefault(state, [])
+        for st in steps:
+            n_trans += 1
+            if st.violation:
+                record(state, (st.label, st.arg), st.violation)
+                continue
+            if st.nxt is None:
+                continue
+            succs.append(st.nxt)
+            if st.nxt not in parent:
+                parent[st.nxt] = (state, (st.label, st.arg))
+                frontier.append(st.nxt)
+        max_frontier = max(max_frontier, len(frontier))
+
+    # post-pass: stuck non-terminal states (exhaustive runs only — a
+    # truncated frontier makes "cannot reach a terminal" meaningless)
+    if exhausted and not violations:
+        can_finish = {s for s in parent if model.done(s)}
+        # reverse edges, then backward-propagate reachability
+        rev: Dict[Sys, List[Sys]] = {}
+        for src, dsts in edges.items():
+            for d in dsts:
+                rev.setdefault(d, []).append(src)
+        work = deque(can_finish)
+        while work:
+            cur = work.popleft()
+            for p in rev.get(cur, ()):
+                if p not in can_finish:
+                    can_finish.add(p)
+                    work.append(p)
+        for s in parent:
+            if s not in can_finish and not model.done(s):
+                record(
+                    s, None,
+                    "non-terminal-state-cannot-terminate: "
+                    f"{s.ctrl.js} (stop={s.ctrl.stop} "
+                    f"rescale={s.ctrl.rescale} pending={s.ctrl.pending})",
+                )
+                break
+
+    return ExploreResult(
+        states=len(parent),
+        transitions=n_trans,
+        violations=violations,
+        exhaustive=exhausted,
+        terminal_states=terminals,
+        max_frontier=max_frontier,
+    )
+
+
+def explore_config(cfg: ModelConfig, transitions, terminals,
+                   **kw) -> ExploreResult:
+    return explore(Model(cfg, transitions, terminals), **kw)

@@ -1,0 +1,190 @@
+"""AST extraction: derive the model's ground truth from the code itself.
+
+Two extractions feed the checker:
+
+  * `job_state_machine` parses `controller/state_machine.py` and returns
+    the JobState members, the terminal set, and the TRANSITIONS relation —
+    the model's controller machine consults THIS table (not a hand copy),
+    so a table edit changes the model in the same commit.
+
+  * `annotated_handlers` finds every `@protocol_effect("name")` annotation
+    in the tree; `check_bijection` then enforces the three-way bijection
+    between annotations, `spec.HANDLER_BINDINGS`, and the effects the
+    transition relation actually references. Any drift — a renamed
+    handler, a deleted annotation, a modeled effect with no code, an
+    annotated function the model ignores — is a finding, and tier-1 runs
+    the check strict-clean.
+
+Extraction reuses the arroyolint `Project`/`FileContext` machinery so the
+same code paths run against the real tree and against fixture mini-trees.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Project, last_attr, str_const
+from ..engine import collect_files, parse_project
+from ..rules_protocol import (
+    STATE_MACHINE_PATH,
+    _jobstate_members,
+    _terminal_states,
+    _transitions_table,
+)
+
+# annotated protocol handlers live under these roots only (tests and
+# fixture trees carry their own annotations for rule tests; the bijection
+# is about the engine tree)
+HANDLER_ROOTS = ("controller/", "operators/", "state/")
+
+
+class ExtractionError(Exception):
+    pass
+
+
+def load_project(root, roots: Iterable[str] = ("arroyo_tpu",)) -> Project:
+    root = Path(root)
+    return parse_project(root, collect_files(root, tuple(roots)))
+
+
+# -- JobState machine --------------------------------------------------------
+
+
+def job_state_machine(
+    project: Project,
+) -> Tuple[Set[str], Set[str], Dict[str, Set[str]]]:
+    """(members, terminals, transitions) from controller/state_machine.py,
+    parsed from source. Raises ExtractionError when the anchors are
+    missing — the model must never silently run against an empty table."""
+    sm = project.find(STATE_MACHINE_PATH)
+    if sm is None:
+        raise ExtractionError(f"{STATE_MACHINE_PATH} not found in project")
+    members = _jobstate_members(sm)
+    if not members:
+        raise ExtractionError("JobState enum not found")
+    parsed = _transitions_table(sm)
+    if parsed is None:
+        raise ExtractionError("TRANSITIONS table not found")
+    _node, table = parsed
+    terminals = _terminal_states(sm)
+    if not terminals:
+        raise ExtractionError("JobState.is_terminal() names no states")
+    return set(members), terminals, table
+
+
+def job_state_machine_from_root(root):
+    return job_state_machine(
+        load_project(root, roots=("arroyo_tpu/controller",))
+    )
+
+
+# -- @protocol_effect annotations --------------------------------------------
+
+
+def _decorator_effect(dec: ast.expr) -> Optional[str]:
+    """'name' for a `@protocol_effect("name")` decorator node."""
+    if (
+        isinstance(dec, ast.Call)
+        and last_attr(dec.func) == "protocol_effect"
+        and dec.args
+    ):
+        return str_const(dec.args[0])
+    return None
+
+
+def annotated_handlers(project: Project) -> Dict[str, List[Tuple[str, str, int]]]:
+    """effect name -> [(path, qualified function name, lineno)] for every
+    @protocol_effect annotation in the project."""
+    out: Dict[str, List[Tuple[str, str, int]]] = {}
+    for ctx in project:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                effect = _decorator_effect(dec)
+                if effect is not None:
+                    out.setdefault(effect, []).append(
+                        (ctx.path, node.name, node.lineno)
+                    )
+    return out
+
+
+def annotated_functions(ctx: FileContext) -> Set[str]:
+    """Function names carrying a @protocol_effect annotation in one file."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_effect(d) for d in node.decorator_list):
+                out.add(node.name)
+    return out
+
+
+# -- the bijection check -----------------------------------------------------
+
+
+def check_bijection(
+    project: Project,
+    bindings: Dict[str, Tuple[str, str]],
+    used_effects: Set[str],
+) -> List[str]:
+    """The model<->code drift detector. Returns problem strings (empty ==
+    strict-clean):
+
+      1. every binding's (file, function) exists and carries the matching
+         @protocol_effect annotation;
+      2. every annotation in the engine tree is declared in `bindings`
+         (an annotated handler the model doesn't know is drift);
+      3. an effect annotated on two different functions is ambiguous;
+      4. every binding is referenced by >=1 model transition and every
+         referenced effect is bound (the model can't cite handlers that
+         don't exist, nor declare bindings no transition uses).
+    """
+    problems: List[str] = []
+    found = annotated_handlers(project)
+
+    for effect, (suffix, fn_name) in sorted(bindings.items()):
+        ctx = project.find(suffix)
+        if ctx is None:
+            problems.append(f"{effect}: bound file {suffix} not in project")
+            continue
+        sites = [
+            (p, f, ln) for (p, f, ln) in found.get(effect, [])
+            if p == ctx.path and f == fn_name
+        ]
+        if not sites:
+            problems.append(
+                f"{effect}: {suffix}::{fn_name} is not annotated "
+                f"@protocol_effect({effect!r}) (or the function is gone)"
+            )
+
+    by_site: Dict[Tuple[str, str], List[str]] = {}
+    for effect, sites in found.items():
+        for (path, fn_name, lineno) in sites:
+            if not any(r in path for r in HANDLER_ROOTS):
+                continue
+            by_site.setdefault((path, fn_name), []).append(effect)
+            if effect not in bindings:
+                problems.append(
+                    f"{path}:{lineno} {fn_name}() is annotated "
+                    f"@protocol_effect({effect!r}) but the model declares "
+                    "no such binding (spec.HANDLER_BINDINGS)"
+                )
+        if len({(p, f) for (p, f, _ln) in sites}) > 1 and effect in bindings:
+            where = ", ".join(f"{p}::{f}" for (p, f, _ln) in sorted(sites))
+            problems.append(f"{effect}: annotated on multiple functions ({where})")
+
+    for effect in sorted(bindings):
+        if effect not in used_effects:
+            problems.append(
+                f"{effect}: declared in HANDLER_BINDINGS but no model "
+                "transition references it — dead binding"
+            )
+    for effect in sorted(used_effects):
+        if effect not in bindings:
+            problems.append(
+                f"{effect}: referenced by a model transition but not bound "
+                "to any handler in HANDLER_BINDINGS"
+            )
+    return problems

@@ -1,0 +1,176 @@
+"""The mutant regression corpus: reintroduced protocol bugs.
+
+Each mutant is a named flag `spec.Model` consults to re-create a bug in
+the MODEL (the code stays fixed); the corpus asserts the checker finds a
+counterexample for every one. Three are the historical 2PC/recovery bugs
+the PR 2 chaos drills originally exposed and fixed — the checker must
+never regress below what sampling already caught. The rest guard the
+pipelined-checkpoint (PR 8) and fencing invariants that no drill
+enumerates exhaustively.
+
+Every entry pins the expected violation KIND and the smallest
+configuration that exposes it, so the corpus stays fast enough for CI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+from .spec import FAULT_KINDS, ModelConfig, VIOLATIONS
+
+
+class Mutant(NamedTuple):
+    name: str
+    description: str
+    expect_violation: str     # violation-label prefix the corpus asserts
+    config: ModelConfig
+    historical: bool = False  # one of the PR 2 chaos-found bugs
+
+
+def _cfg(**kw) -> ModelConfig:
+    base = dict(workers=2, epochs=2, inflight=2, faults=0, restarts=2,
+                rescales=0, fault_kinds=FAULT_KINDS)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+MUTANTS: Dict[str, Mutant] = {
+    m.name: m
+    for m in [
+        Mutant(
+            name="stop_strands_commit",
+            description=(
+                "PR 2 bug #1: the sink does not hold a committing state "
+                "at stop — it closes after its stop-epoch flush without "
+                "awaiting the phase-2 CommitMsg, and the commit fan-out "
+                "silently drops the message to the closed worker. The "
+                "sealed sink transaction is stranded uncommitted at "
+                "STOPPED."
+            ),
+            expect_violation=VIOLATIONS.STRANDED,
+            config=_cfg(epochs=1, mutant="stop_strands_commit"),
+            historical=True,
+        ),
+        Mutant(
+            name="commit_fanout_all_workers",
+            description=(
+                "PR 2 bug #2: the phase-2 commit fans out to EVERY "
+                "worker instead of only those hosting committing "
+                "subtasks. A source-only worker legitimately finishes "
+                "and closes its rpc server right after the then_stop "
+                "barrier, so the commit rpc to it fails, the stop "
+                "recovers, retries, and loops to FAILED without any "
+                "injected fault."
+            ),
+            expect_violation=VIOLATIONS.FAILED_NO_FAULT,
+            config=_cfg(epochs=1, restarts=1,
+                        mutant="commit_fanout_all_workers"),
+            historical=True,
+        ),
+        Mutant(
+            name="no_liveness_in_stop_wait",
+            description=(
+                "PR 2 bug #3: the stop-checkpoint wait does not check "
+                "worker liveness, so a worker death mid-barrier leaves "
+                "only the 60s deadline to unstick the wait — a stall "
+                "the liveness check was added to kill."
+            ),
+            expect_violation=VIOLATIONS.STALL,
+            config=_cfg(epochs=1, faults=1,
+                        fault_kinds=("fault.kill",),
+                        mutant="no_liveness_in_stop_wait"),
+            historical=True,
+        ),
+        Mutant(
+            name="unordered_flush",
+            description=(
+                "PR 8 invariant: per-subtask checkpoint flushes must be "
+                "strictly epoch-ordered — a report for epoch N+1 implies "
+                "N's blob is durable, which is what makes abandoning an "
+                "overdue epoch and publishing a later one sound. LIFO "
+                "flushes break the chain: a manifest can publish "
+                "referencing an unflushed blob."
+            ),
+            expect_violation=VIOLATIONS.ATOMIC,
+            config=_cfg(epochs=2, inflight=2, faults=1,
+                        fault_kinds=("fault.kill",),
+                        mutant="unordered_flush"),
+        ),
+        Mutant(
+            name="unstamped_data_paths",
+            description=(
+                "PR 8 invariant: checkpoint data paths are generation-"
+                "stamped so a fenced zombie's late upload cannot "
+                "overwrite a live incarnation's blob for the same "
+                "(epoch, table, subtask). Unstamped paths let a "
+                "presumed-dead worker clobber live state."
+            ),
+            expect_violation=VIOLATIONS.OVERWRITE,
+            config=_cfg(epochs=2, faults=1,
+                        fault_kinds=("fault.blackout",),
+                        mutant="unstamped_data_paths"),
+        ),
+        Mutant(
+            name="publish_any_complete",
+            description=(
+                "pipelined-reap invariant: manifests must publish in "
+                "strict epoch order (manifest N+1 references chain "
+                "blobs first recorded in N). Publishing whichever "
+                "pending epoch completes first breaks the order."
+            ),
+            expect_violation=VIOLATIONS.ORDER,
+            config=_cfg(epochs=2, inflight=2,
+                        mutant="publish_any_complete"),
+        ),
+        Mutant(
+            name="publish_without_reports",
+            description=(
+                "reap-guard invariant: an epoch publishes only once its "
+                "full report set arrived. Publishing early half-commits "
+                "the epoch — the manifest references blobs nobody "
+                "flushed."
+            ),
+            expect_violation=VIOLATIONS.ATOMIC,
+            config=_cfg(epochs=1, mutant="publish_without_reports"),
+        ),
+        Mutant(
+            name="no_fence_check",
+            description=(
+                "generation-fencing invariant: a superseded generation "
+                "must be fenced at publish (protocol.check_current). "
+                "Without the check a zombie controller publishes "
+                "manifests under a stale generation."
+            ),
+            expect_violation=VIOLATIONS.FENCE,
+            config=_cfg(epochs=2, faults=1,
+                        fault_kinds=("fault.fence",),
+                        mutant="no_fence_check"),
+        ),
+        Mutant(
+            name="transitions_missing_recovering",
+            description=(
+                "state-machine mutant: the CHECKPOINT_STOPPING -> "
+                "RECOVERING edge is deleted from TRANSITIONS. A stop "
+                "checkpoint failure then has no legal move — the "
+                "extracted-table conformance catches the illegal "
+                "transition."
+            ),
+            expect_violation=VIOLATIONS.ILLEGAL_MOVE,
+            config=_cfg(epochs=1, faults=1,
+                        fault_kinds=("fault.cas_race",),
+                        mutant="transitions_missing_recovering"),
+        ),
+    ]
+}
+
+
+def get_mutant(name: str) -> Mutant:
+    if name not in MUTANTS:
+        raise KeyError(
+            f"unknown mutant {name!r}; known: {sorted(MUTANTS)}"
+        )
+    return MUTANTS[name]
+
+
+def historical_mutants() -> Tuple[Mutant, ...]:
+    return tuple(m for m in MUTANTS.values() if m.historical)

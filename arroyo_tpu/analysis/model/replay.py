@@ -1,0 +1,142 @@
+"""Counterexample replay: model traces -> seeded chaos FaultPlans.
+
+Two replay legs make a counterexample actionable:
+
+  * `replay_trace` re-executes a Trace's event list against a fresh
+    model instance and returns the violation it reaches — the
+    deterministic, assertable leg (the corpus test replays every
+    mutant's counterexample and requires the same violation kind).
+
+  * `trace_to_fault_plan` serializes the trace's fault events to a
+    seeded `chaos.FaultPlan` targeting the registered fault points
+    (FAULT_MAP below names each model fault's nearest dynamic seam), so
+    the same adversarial schedule runs against the REAL embedded
+    cluster via `tools/chaos_drill.py --plan <file>`. On fixed code the
+    drill passes (byte-identical output); were the modeled bug live,
+    this is the plan that demonstrates it end-to-end. The plan seed is
+    derived from the trace content, so identical counterexamples always
+    produce identical plans (the chaos subsystem's reproducibility
+    contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from typing import Dict, List, Optional, Tuple
+
+from .explore import Trace
+from .spec import Model, ModelConfig, initial_state
+from .mutants import get_mutant
+
+# model fault label -> (chaos fault point, match ctx, params, hit window).
+# The point is the nearest dynamic seam: the chaos registry injects at
+# real code seams, so some model faults map onto the seam that produces
+# the equivalent schedule rather than a literal twin.
+FAULT_MAP: Dict[str, Tuple[str, Optional[dict], Optional[dict],
+                           Tuple[int, int]]] = {
+    "fault.kill": ("worker.kill", None, None, (8, 16)),
+    "fault.blackout": ("worker.heartbeat_blackout", None,
+                       {"duration": 2.0}, (8, 16)),
+    "fault.drop_barrier": ("network.drop_connection", None, None, (4, 16)),
+    "fault.dup_barrier": ("network.partial_frame", None, None, (4, 16)),
+    "fault.reorder_inbox": ("worker.slow_barrier_ack", None,
+                            {"delay": 0.3}, (1, 3)),
+    "fault.cas_race": ("storage.cas_conflict",
+                       {"key": "checkpoint-manifest"}, None, (1, 2)),
+    "fault.fence": ("protocol.fenced_zombie", None, None, (1, 2)),
+    "fault.flush_fail": ("storage.write_fail", {"key": "/data/"},
+                         None, (1, 3)),
+    "fault.reschedule_fail": ("rescale.reschedule_fail", None, None, (1, 1)),
+    # a zombie's late upload = the blackout above plus storage latency
+    # stretching the upload window past the fencing
+    "fault.zombie_write": ("storage.latency", {"key": "/data/"},
+                           {"delay": 0.25}, (1, 4)),
+}
+
+
+def trace_seed(trace: Trace) -> int:
+    """Deterministic seed from the trace content (not object identity)."""
+    payload = json.dumps(trace.to_json(), sort_keys=True).encode()
+    return int.from_bytes(hashlib.sha1(payload).digest()[:4], "big")
+
+
+def trace_to_fault_plan(trace: Trace):
+    """Serialize a counterexample's fault schedule as a chaos FaultPlan.
+    Returns the installed-ready plan; `.to_json()` is what
+    `tools/chaos_drill.py --plan` consumes."""
+    from ... import chaos
+
+    seed = trace_seed(trace)
+    rng = random.Random(seed)
+    plan = chaos.FaultPlan(seed)
+    for label, _arg in trace.fault_events():
+        if label not in FAULT_MAP:
+            continue
+        point, match, params, window = FAULT_MAP[label]
+        plan.add(point, at_hits=(rng.randint(*window),), match=match,
+                 params=params)
+    return plan
+
+
+def counterexample_payload(trace: Trace) -> dict:
+    """The artifact written next to a violation: the trace plus its
+    replayable chaos plan and the drill command that runs it."""
+    plan = trace_to_fault_plan(trace)
+    return {
+        "trace": trace.to_json(),
+        "fault_plan": json.loads(plan.to_json()),
+        "replay_command": (
+            "python tools/chaos_drill.py --plan <this-file> "
+            "# runs the serialized fault_plan against the embedded cluster"
+        ),
+    }
+
+
+class ReplayDivergence(Exception):
+    """The trace names an event the model does not offer at that state."""
+
+
+def replay_trace(trace: Trace, transitions, terminals) -> str:
+    """Re-execute a Trace event-for-event on a fresh model built from its
+    recorded config. Returns the violation label reached (step violation
+    or end-state invariant). Raises ReplayDivergence if the model refuses
+    an event — which would mean the trace (or the model) changed."""
+    cfg_dict = dict(trace.config)
+    cfg_dict["fault_kinds"] = tuple(cfg_dict.get("fault_kinds", ()))
+    cfg = ModelConfig(**cfg_dict)
+    model = Model(cfg, transitions, terminals)
+    state = initial_state(cfg)
+    for i, (label, arg) in enumerate(trace.events):
+        steps = model.enabled(state)
+        match = [st for st in steps
+                 if st.label == label and tuple(st.arg) == tuple(arg)]
+        if not match:
+            offered = sorted({(st.label, st.arg) for st in steps})
+            raise ReplayDivergence(
+                f"event {i} {label}{arg}: not enabled; offered {offered}"
+            )
+        st = match[0]
+        if st.violation:
+            return st.violation
+        if st.nxt is None:
+            raise ReplayDivergence(
+                f"event {i} {label}{arg}: dead step without violation"
+            )
+        state = st.nxt
+    inv = model.check_state(state, model.enabled(state))
+    if inv is not None:
+        return inv
+    raise ReplayDivergence(
+        "trace replayed to a state with no violation"
+    )
+
+
+def replay_mutant_counterexample(name: str, trace: Trace,
+                                 transitions, terminals) -> bool:
+    """Corpus assertion: the trace reproduces the mutant's expected
+    violation kind under deterministic replay."""
+    mutant = get_mutant(name)
+    got = replay_trace(trace, transitions, terminals)
+    return got.split(":", 1)[0] == mutant.expect_violation

@@ -1,0 +1,896 @@
+"""The composed protocol model: controller x N workers x storage x channels.
+
+Explicit-state transition system over hashable NamedTuple states. Worker 0
+is the source-only role; the rest are transactional sinks sealing
+per-epoch commit data (the kafka-exactly-once shape, the hardest 2PC
+case). The controller machine's legal JobState moves come from the
+EXTRACTED TRANSITIONS table (`extract.job_state_machine`) — an illegal
+move is itself a reported violation, never a crash.
+
+Modeled from the dispatch code (each transition cites its handlers via
+TRANSITION_HANDLERS; the bijection check ties those to @protocol_effect
+annotations on the real functions):
+
+  * pipelined checkpoint cadence: up to `inflight` epochs fanned out
+    before the first publishes; manifests publish strictly in epoch
+    order; an epoch whose report set can no longer complete is abandoned
+    on deadline, and a LATER epoch may still publish — sound only
+    because per-worker flushes are epoch-ordered, which is exactly what
+    the V_ATOMIC chain check verifies at every publish;
+  * worker capture/flush split with `inflight` admission, strictly
+    epoch-ordered flushes, fail-fast flush errors (TaskFailedResp);
+  * 2PC: sinks seal a transaction per captured epoch, the controller
+    CAS-claims the commit record after the manifest publishes and fans
+    CommitMsg to committing workers only; commit application is
+    cumulative (epoch <= msg epoch), sinks hold a committing state at
+    stop, and a restore idempotently replays every claimed epoch's
+    commit from its manifest (the connectors' sealed-state replay);
+  * generation fencing: recovery claims a fresh generation; a superseded
+    generation's publish is fenced; data paths are generation-stamped so
+    a fenced zombie's late upload lands beside, never over, a live blob;
+  * RESCALING: drain -> stop checkpoint -> apply overrides -> teardown ->
+    fresh generation -> reschedule, with the documented failure windows
+    (pre-publish failures recover at the old parallelism, post-publish at
+    the new one).
+
+Timeouts (epoch deadline abandons) are modeled as "fair": enabled only
+when the awaited report set provably cannot complete — the wall-clock
+deadline never beats sub-second progress in the real system, and an
+always-enabled timeout would flood the space with unreal runs. The
+V_STALL invariant then asks that detection of a dead worker never
+REQUIRES a timeout (the PR 2 mid-barrier-death bug class).
+
+Fault events (first-class transitions, budgeted by `cfg.faults`): worker
+kill, heartbeat blackout (presumed-dead zombie), barrier loss (a
+data-plane connection drop surfacing as a task failure), barrier
+duplication (dedupe safety), cross-channel reorder (commit vs barrier),
+manifest CAS race, zombie fencing at publish, flush failure, rescale
+reschedule failure. Zombie late-writes are free consequences of a
+blackout teardown.
+
+Mutants (mutants.py) are named flags consulted here — every read is a
+`cfg.mutant == "..."` comparison so the modeled-bug diff is greppable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+# effect name -> (file suffix, function name): the handler bindings the
+# bijection check enforces against @protocol_effect annotations. Every
+# entry must be referenced by >=1 transition in TRANSITION_HANDLERS.
+HANDLER_BINDINGS: Dict[str, Tuple[str, str]] = {
+    "ctrl.run_cadence": ("controller/controller.py", "_run"),
+    "ctrl.checkpoint_start": ("controller/controller.py", "_checkpoint_start"),
+    "ctrl.checkpoint_reap": ("controller/controller.py", "_checkpoint_reap"),
+    "ctrl.drain_pending": ("controller/controller.py", "_drain_pending_epochs"),
+    "ctrl.stop_checkpoint": ("controller/controller.py", "_checkpoint_inner"),
+    "ctrl.publish_epoch": ("controller/controller.py", "_publish_epoch"),
+    "ctrl.rescale": ("controller/controller.py", "_rescale"),
+    "ctrl.recover": ("controller/controller.py", "_recover"),
+    "ctrl.schedule": ("controller/controller.py", "_schedule_inner"),
+    "worker.capture": ("operators/runner.py", "_checkpoint_chain"),
+    "worker.admit_flush": ("operators/runner.py", "_admit_flush"),
+    "worker.flush": ("operators/runner.py", "_flush_and_report"),
+    "worker.drain_flushes": ("operators/runner.py", "_await_pending_flush"),
+    "worker.commit": ("operators/runner.py", "_handle_commit"),
+    "worker.await_commit": ("operators/runner.py", "_await_commit"),
+    "state.capture_tables": ("state/table_manager.py", "capture"),
+    "state.flush_tables": ("state/table_manager.py", "flush_captured"),
+    "storage.new_generation": ("state/protocol.py", "initialize_generation"),
+    "storage.check_fence": ("state/protocol.py", "check_current"),
+    "storage.publish_manifest": ("state/protocol.py", "publish_checkpoint"),
+    "storage.prepare_commit": ("state/protocol.py", "prepare_commit"),
+    "storage.claim_commit": ("state/protocol.py", "claim_commit"),
+}
+
+_PUBLISH_EFFECTS = (
+    "ctrl.publish_epoch", "storage.check_fence", "storage.publish_manifest",
+    "storage.prepare_commit", "storage.claim_commit",
+)
+
+# transition label -> handler effects it exercises (drives the "every
+# binding used" direction of the bijection; cited in counterexamples)
+TRANSITION_HANDLERS: Dict[str, Tuple[str, ...]] = {
+    "ctrl.schedule_init": ("ctrl.schedule",),
+    "ck.start": ("ctrl.run_cadence", "ctrl.checkpoint_start"),
+    "ck.reap": ("ctrl.checkpoint_reap",) + _PUBLISH_EFFECTS,
+    "ck.abandon": ("ctrl.checkpoint_reap",),
+    "ctrl.detect_death": ("ctrl.run_cadence", "ctrl.drain_pending"),
+    "ctrl.recover": ("ctrl.recover", "storage.new_generation"),
+    "ctrl.fail": ("ctrl.recover",),
+    "ctrl.schedule": ("ctrl.schedule",),
+    "ctrl.recv": ("ctrl.run_cadence",),
+    "stop.request": ("ctrl.run_cadence",),
+    "stop.begin": ("ctrl.run_cadence", "ctrl.drain_pending"),
+    "stop.barrier": ("ctrl.stop_checkpoint",),
+    "stop.publish": ("ctrl.stop_checkpoint",) + _PUBLISH_EFFECTS,
+    "stop.abandon": ("ctrl.stop_checkpoint",),
+    "stop.finish": ("ctrl.run_cadence",),
+    "rescale.request": ("ctrl.rescale",),
+    "rescale.begin": ("ctrl.rescale",),
+    "rescale.barrier": ("ctrl.rescale", "ctrl.stop_checkpoint"),
+    "rescale.reschedule": ("ctrl.rescale", "storage.new_generation"),
+    "w.capture": ("worker.capture", "worker.admit_flush",
+                  "state.capture_tables"),
+    "w.flush": ("worker.flush", "state.flush_tables"),
+    "w.commit": ("worker.commit",),
+    "w.finish": ("worker.drain_flushes", "worker.await_commit"),
+    "fault.kill": ("ctrl.run_cadence",),
+    "fault.blackout": ("ctrl.run_cadence",),
+    "fault.drop_barrier": ("worker.capture",),
+    "fault.dup_barrier": ("worker.capture",),
+    "fault.reorder_inbox": ("worker.capture", "worker.commit"),
+    "fault.cas_race": ("storage.publish_manifest",),
+    "fault.fence": ("storage.check_fence",),
+    "fault.flush_fail": ("worker.flush",),
+    "fault.zombie_write": ("state.flush_tables",),
+    "fault.reschedule_fail": ("ctrl.rescale",),
+}
+
+USED_EFFECTS: Set[str] = {
+    e for effs in TRANSITION_HANDLERS.values() for e in effs
+}
+
+FAULT_KINDS = (
+    "fault.kill", "fault.blackout", "fault.drop_barrier",
+    "fault.dup_barrier", "fault.reorder_inbox", "fault.cas_race",
+    "fault.fence", "fault.flush_fail", "fault.reschedule_fail",
+)
+# modeled wall-clock deadlines; V_STALL asks that dead-worker detection
+# never REQUIRES one of these
+TIMEOUT_KINDS = ("ck.abandon", "stop.abandon")
+
+
+class ModelConfig(NamedTuple):
+    workers: int = 2          # >= 2; worker 0 is the source-only role
+    epochs: int = 3           # cadence epochs per incarnation
+    inflight: int = 2         # state.max_inflight_flushes analog
+    faults: int = 1           # total fault-event budget
+    restarts: int = 2         # controller max_restarts analog
+    rescales: int = 0         # rescale-request budget (0 or 1)
+    fault_kinds: Tuple[str, ...] = FAULT_KINDS
+    mutant: str = ""          # mutants.py flag (empty == faithful model)
+
+
+class WorkerS(NamedTuple):
+    alive: bool = True
+    blackout: bool = False    # presumed dead by the controller, still running
+    gen: int = 1
+    inbox: Tuple = ()         # FIFO: ("b", epoch, then_stop) | ("c", epoch)
+    seen_barrier: int = 0     # highest barrier epoch captured (dedupe)
+    captured: Tuple = ()      # epochs captured, flush pending (ordered)
+    flushed: int = 0          # highest flushed epoch this incarnation
+    flush_failed: bool = False
+    stopping: bool = False    # captured a then_stop barrier
+    sealed: Tuple = ()        # ((epoch, gen), ...) txs awaiting commit
+    finished: bool = False    # local work done; rpc server closed
+
+
+class CtrlS(NamedTuple):
+    js: str = "CREATED"
+    gen: int = 1
+    epoch: int = 0            # last issued epoch
+    epoch_budget: int = 0     # cadence epochs left this incarnation
+    pending: Tuple = ()       # fanned-out, unpublished epochs
+    reports: Tuple = ()       # ((epoch, widx), ...) completions received
+    finished: Tuple = ()      # widx whose TaskFinished arrived
+    restarts: int = 0
+    stop: int = 0             # 0 none, 1 requested, 2 stop barrier in flight
+    stop_epoch: int = 0
+    rescale: int = 0          # 0 none, 1 requested, 2 stop barrier in flight
+    rescaled: bool = False    # overrides applied (survives recovery)
+    failure: str = ""         # latest failure reason (trace readability)
+
+
+class StoreS(NamedTuple):
+    gen: int = 1              # current-generation.json
+    manifests: Tuple = ()     # ((epoch, gen), ...) in publish order
+    latest: int = 0           # latest.json
+    claimed: Tuple = ()       # epochs with a commit_done record
+    blobs: Tuple = ()         # sorted ((epoch, widx, gen), ...) data files
+    gen_base: Tuple = ()      # ((gen, restore_epoch), ...) chain bases
+
+
+class Sys(NamedTuple):
+    ctrl: CtrlS
+    workers: Tuple[WorkerS, ...]
+    store: StoreS
+    finalized: Tuple = ()     # ((epoch, gen), ...) visible committed txs
+    zombies: Tuple = ()       # ((widx, epoch, gen), ...) pending late writes
+    faults: int = 0           # fault budget spent
+
+
+class Step(NamedTuple):
+    label: str                # TRANSITION_HANDLERS key
+    arg: Tuple                # discriminating payload
+    nxt: Optional[Sys]        # successor (None when the step violates)
+    violation: str = ""       # non-empty == invariant broken BY this step
+
+
+def initial_state(cfg: ModelConfig) -> Sys:
+    return Sys(
+        ctrl=CtrlS(js="CREATED", epoch_budget=cfg.epochs),
+        workers=tuple(WorkerS() for _ in range(cfg.workers)),
+        store=StoreS(),
+    )
+
+
+def is_sink(widx: int) -> bool:
+    return widx != 0
+
+
+class _V:
+    """Violation labels (stable ids for traces, SARIF, tests)."""
+
+    ILLEGAL_MOVE = "illegal-jobstate-move"
+    ORDER = "manifest-publish-order"
+    ATOMIC = "epoch-half-committed"
+    FENCE = "zombie-generation-published"
+    OVERWRITE = "fenced-write-clobbered-live-blob"
+    DOUBLE_COMMIT = "transaction-committed-twice"
+    STRANDED = "sealed-transaction-stranded-at-stop"
+    FAILED_NO_FAULT = "failed-without-fault"
+    STALL = "dead-worker-undetected-stall"
+    DEADLOCK = "deadlock"
+    STUCK = "non-terminal-state-cannot-terminate"
+
+
+VIOLATIONS = _V
+
+
+# -- tuple helpers -----------------------------------------------------------
+
+
+def _sorted_add(t: Tuple, item) -> Tuple:
+    return t if item in t else tuple(sorted(t + (item,)))
+
+
+def _replace_worker(s: Sys, widx: int, w: WorkerS) -> Sys:
+    ws = list(s.workers)
+    ws[widx] = w
+    return s._replace(workers=tuple(ws))
+
+
+def _dead_unfinished(s: Sys) -> List[int]:
+    """Workers the controller's liveness view sees as dead (killed or
+    heartbeat-blacked-out) that never reported finished."""
+    return [
+        i for i, w in enumerate(s.workers)
+        if (not w.alive or w.blackout) and i not in s.ctrl.finished
+    ]
+
+
+class Model:
+    """Enumerates enabled transitions of the composed system. `transitions`
+    is the EXTRACTED JobState table, `terminals` the extracted terminal
+    set. A Step with `violation` set is a counterexample endpoint."""
+
+    def __init__(self, cfg: ModelConfig,
+                 transitions: Dict[str, Set[str]],
+                 terminals: Set[str]):
+        self.cfg = cfg
+        self.transitions = {k: set(v) for k, v in transitions.items()}
+        self.terminals = set(terminals)
+        if cfg.mutant == "transitions_missing_recovering":
+            # state-machine mutant: delete the CHECKPOINT_STOPPING ->
+            # RECOVERING edge (PR 2's "retry the stop after a failed stop
+            # checkpoint" fix)
+            self.transitions.get("CHECKPOINT_STOPPING", set()).discard(
+                "RECOVERING"
+            )
+
+    def done(self, s: Sys) -> bool:
+        return s.ctrl.js in self.terminals
+
+    # -- js moves through the extracted table --------------------------------
+
+    def _move(self, s: Sys, label: str, nxt_js: str, **updates) -> Step:
+        cur = s.ctrl.js
+        if nxt_js not in self.transitions.get(cur, set()):
+            return Step(label, (cur, nxt_js), None,
+                        f"{_V.ILLEGAL_MOVE}: {cur} -> {nxt_js}")
+        return Step(label, (cur, nxt_js),
+                    s._replace(ctrl=s.ctrl._replace(js=nxt_js, **updates)))
+
+    def _fail(self, s: Sys, label: str, reason: str) -> Step:
+        """The job.failure -> RECOVERING route every handler shares. A
+        stop request survives recovery (the stop is retried); a rescale
+        request is consumed (the autoscaler re-decides)."""
+        st = self._move(
+            s, label, "RECOVERING",
+            failure=reason, stop=(1 if s.ctrl.stop else 0), rescale=0,
+            stop_epoch=0, pending=(), reports=(),
+        )
+        return Step(label, (reason,), st.nxt, st.violation)
+
+    # -- report bookkeeping --------------------------------------------------
+
+    def _reports_complete(self, s: Sys, epoch: int) -> bool:
+        got = {w for (e, w) in s.ctrl.reports if e == epoch}
+        return all(i in got or i in s.ctrl.finished
+                   for i in range(len(s.workers)))
+
+    def _cannot_complete(self, s: Sys, epoch: int) -> bool:
+        """True when some missing report for `epoch` can never arrive —
+        the fair-timeout gate for deadline abandons."""
+        got = {w for (e, w) in s.ctrl.reports if e == epoch}
+        for i, w in enumerate(s.workers):
+            if i in got or i in s.ctrl.finished:
+                continue
+            if not w.alive or w.flush_failed:
+                return True
+            will_capture = (
+                epoch in w.captured
+                or w.seen_barrier >= epoch
+                or any(m[0] == "b" and m[1] == epoch for m in w.inbox)
+            )
+            if not will_capture:
+                return True
+            if w.flushed >= epoch and (epoch, i) not in s.ctrl.reports:
+                return True  # report lost forever (not modeled, safety net)
+        return False
+
+    def _chain_epochs(self, s: Sys, upto: int) -> List[int]:
+        """Epochs whose blobs a manifest at `upto` references under the
+        current generation: everything since the generation's restore
+        base (the incremental base+delta chain)."""
+        base = dict(s.store.gen_base).get(s.ctrl.gen, 0)
+        return list(range(base + 1, upto + 1))
+
+    # -- publish (shared by reap / stop / rescale) ---------------------------
+
+    def _publish(self, s: Sys, label: str, epoch: int,
+                 cas_race: bool = False) -> Step:
+        cfg = self.cfg
+        ctrl, store = s.ctrl, s.store
+        fenced = store.gen != ctrl.gen
+        if fenced and cfg.mutant == "no_fence_check":
+            return Step(label, (epoch,), None,
+                        f"{_V.FENCE}: gen {ctrl.gen} published epoch "
+                        f"{epoch} while gen {store.gen} is current")
+        if fenced:
+            # storage.check_fence: a superseded generation must not publish
+            return self._fail(s, label, "fenced")
+        if cas_race:
+            # storage.cas_conflict without key creation: the publish reads
+            # nothing back and raises Fenced -> failure -> recovery
+            return self._fail(s, label, "manifest-cas-race")
+        if (not self._reports_complete(s, epoch)
+                and cfg.mutant != "publish_without_reports"):
+            return Step(label, (epoch,), None,
+                        "publish guard broken: incomplete report set")
+        if store.manifests and epoch <= max(e for (e, _g) in store.manifests):
+            return Step(label, (epoch,), None,
+                        f"{_V.ORDER}: epoch {epoch} published after epoch "
+                        f"{max(e for (e, _g) in store.manifests)}")
+        # V_ATOMIC: the manifest references every worker's blob chain; all
+        # chain epochs must be durably flushed. Epoch-ordered flushes are
+        # what make an abandoned epoch's successor safe to publish.
+        blob_keys = {(e, w) for (e, w, g) in store.blobs if g == ctrl.gen}
+        for widx in range(len(s.workers)):
+            for e in self._chain_epochs(s, epoch):
+                if (e, widx) not in blob_keys:
+                    return Step(
+                        label, (epoch,), None,
+                        f"{_V.ATOMIC}: manifest {epoch} references "
+                        f"unflushed blob (epoch {e}, worker {widx})",
+                    )
+        new = s._replace(
+            store=store._replace(
+                manifests=store.manifests + ((epoch, ctrl.gen),),
+                latest=epoch,
+            ),
+            ctrl=ctrl._replace(
+                pending=tuple(e for e in ctrl.pending if e != epoch),
+                reports=tuple((e, w) for (e, w) in ctrl.reports if e != epoch),
+            ),
+        )
+        # 2PC phase 2: CAS-claim the commit record, then fan CommitMsg to
+        # committing (sink) workers only. A closed target's rpc raises ->
+        # failure -> recovery (claim + manifest stay durable; the restore
+        # replays the commit).
+        if epoch not in new.store.claimed:
+            new = new._replace(store=new.store._replace(
+                claimed=_sorted_add(new.store.claimed, epoch)
+            ))
+            targets = (range(len(s.workers))
+                       if cfg.mutant == "commit_fanout_all_workers"
+                       else [w for w in range(len(s.workers)) if is_sink(w)])
+            for widx in targets:
+                w = new.workers[widx]
+                if w.finished or not w.alive:
+                    if cfg.mutant == "stop_strands_commit":
+                        continue  # the bug: drop the commit silently
+                    return self._fail(
+                        new, label, f"commit-rpc-to-closed-worker-{widx}"
+                    )
+                new = _replace_worker(
+                    new, widx, w._replace(inbox=w.inbox + (("c", epoch),))
+                )
+        return Step(label, (epoch,), new)
+
+    # -- enumeration ---------------------------------------------------------
+
+    def enabled(self, s: Sys) -> List[Step]:
+        cfg = self.cfg
+        ctrl = s.ctrl
+        if self.done(s):
+            return []
+        # lifecycle states are atomic handler bodies in the code: model
+        # them as single steps (faults/zombies interleave before or after)
+        if ctrl.js == "CREATED":
+            return [self._move(s, "ctrl.schedule_init", "SCHEDULING")]
+        if ctrl.js == "RECOVERING":
+            return [self._recover(s)]
+        if ctrl.js == "SCHEDULING":
+            return [self._schedule(s)]
+
+        out: List[Step] = []
+        dead = _dead_unfinished(s)
+        if dead and not self._liveness_masked(s):
+            out.append(self._fail(s, "ctrl.detect_death",
+                                  f"heartbeat-timeout-w{dead[0]}"))
+
+        if ctrl.js == "RUNNING":
+            if (ctrl.stop == 0 and ctrl.rescale == 0
+                    and ctrl.epoch_budget > 0
+                    and len(ctrl.pending) < cfg.inflight):
+                out.append(self._ck_start(s))
+            if ctrl.pending:
+                out.extend(self._reap_steps(s))
+            if ctrl.stop == 0 and ctrl.rescale == 0:
+                out.append(Step("stop.request", (),
+                                s._replace(ctrl=ctrl._replace(stop=1))))
+                if cfg.rescales > 0 and not ctrl.rescaled:
+                    out.append(Step(
+                        "rescale.request", (),
+                        s._replace(ctrl=ctrl._replace(rescale=1)),
+                    ))
+            if ctrl.stop == 1:
+                out.append(self._move(s, "stop.begin", "CHECKPOINT_STOPPING"))
+            if ctrl.rescale == 1:
+                out.append(self._move(s, "rescale.begin", "RESCALING"))
+
+        if ctrl.js == "CHECKPOINT_STOPPING":
+            if ctrl.stop != 2 and ctrl.pending:
+                out.extend(self._reap_steps(s))
+            elif ctrl.stop == 1:
+                out.append(self._barrier(s, "stop.barrier", stop=2))
+            elif ctrl.stop == 2:
+                out.extend(self._stop_wait_steps(s))
+
+        if ctrl.js == "RESCALING":
+            if ctrl.rescale != 2 and ctrl.pending:
+                out.extend(self._reap_steps(s))
+            elif ctrl.rescale == 1:
+                out.append(self._barrier(s, "rescale.barrier", rescale=2))
+            elif ctrl.rescale == 2:
+                out.extend(self._rescale_wait_steps(s))
+
+        for widx, w in enumerate(s.workers):
+            if w.alive and not w.finished:
+                out.extend(self._worker_steps(s, widx, w))
+            if (w.alive and w.finished and widx not in ctrl.finished):
+                out.append(Step(
+                    "ctrl.recv", (widx,),
+                    s._replace(ctrl=ctrl._replace(
+                        finished=_sorted_add(ctrl.finished, widx)
+                    )),
+                ))
+
+        out.extend(self._fault_steps(s))
+        for z in s.zombies:
+            out.append(self._zombie_write(s, z))
+        return out
+
+    def _liveness_masked(self, s: Sys) -> bool:
+        if self.cfg.mutant == "no_liveness_in_stop_wait":
+            # the PR 2 bug class: the stop/checkpoint wait loops did not
+            # check worker liveness, so a mid-barrier death stalled the
+            # wait until the 60s deadline
+            return s.ctrl.js == "CHECKPOINT_STOPPING"
+        return False
+
+    # -- controller steps ----------------------------------------------------
+
+    def _ck_start(self, s: Sys) -> Step:
+        ctrl = s.ctrl
+        epoch = ctrl.epoch + 1
+        new = s._replace(ctrl=ctrl._replace(
+            epoch=epoch, epoch_budget=ctrl.epoch_budget - 1,
+            pending=ctrl.pending + (epoch,),
+        ))
+        return Step("ck.start", (epoch,), self._fanout(new, epoch, False))
+
+    def _barrier(self, s: Sys, label: str, **flags) -> Step:
+        """Stop/rescale barrier: one then_stop epoch fanned to all."""
+        ctrl = s.ctrl
+        epoch = ctrl.epoch + 1
+        new = s._replace(ctrl=ctrl._replace(
+            epoch=epoch, stop_epoch=epoch,
+            pending=ctrl.pending + (epoch,), **flags,
+        ))
+        return Step(label, (epoch,), self._fanout(new, epoch, True))
+
+    @staticmethod
+    def _fanout(s: Sys, epoch: int, then_stop: bool) -> Sys:
+        new = s
+        for widx, w in enumerate(new.workers):
+            if w.alive and not w.finished:
+                new = _replace_worker(new, widx, w._replace(
+                    inbox=w.inbox + (("b", epoch, then_stop),)
+                ))
+        return new
+
+    def _reap_steps(self, s: Sys) -> List[Step]:
+        """_checkpoint_reap: publish the LOWEST pending epoch once its
+        report set completes; abandon (deadline, fair-gated) an epoch
+        that can no longer complete. The order mutant publishes the
+        HIGHEST complete epoch instead."""
+        out: List[Step] = []
+        pending = sorted(s.ctrl.pending)
+        candidates = (sorted(pending, reverse=True)
+                      if self.cfg.mutant == "publish_any_complete"
+                      else pending[:1])
+        for e in candidates:
+            if (self._reports_complete(s, e)
+                    or self.cfg.mutant == "publish_without_reports"):
+                out.append(self._publish(s, "ck.reap", e))
+                break
+        e0 = pending[0]
+        if (not self._reports_complete(s, e0)
+                and self._cannot_complete(s, e0)):
+            out.append(Step(
+                "ck.abandon", (e0,),
+                s._replace(ctrl=s.ctrl._replace(
+                    pending=tuple(x for x in s.ctrl.pending if x != e0),
+                    reports=tuple((e, w) for (e, w) in s.ctrl.reports
+                                  if e != e0),
+                )),
+            ))
+        return out
+
+    def _stop_wait_steps(self, s: Sys) -> List[Step]:
+        e = s.ctrl.stop_epoch
+        if e in s.ctrl.pending:
+            if (self._reports_complete(s, e)
+                    or self.cfg.mutant == "publish_without_reports"):
+                return [self._publish(s, "stop.publish", e)]
+            if self._cannot_complete(s, e):
+                # the fixed code: an incomplete stopping checkpoint is a
+                # FAILURE (recover, retry the stop) — never a silent stop
+                return [self._fail(s, "stop.abandon",
+                                   "stop-checkpoint-incomplete")]
+            return []
+        if all(i in s.ctrl.finished for i in range(len(s.workers))):
+            return [self._move(s, "stop.finish", "STOPPED", stop=0)]
+        return []
+
+    def _rescale_wait_steps(self, s: Sys) -> List[Step]:
+        out: List[Step] = []
+        e = s.ctrl.stop_epoch
+        if e in s.ctrl.pending:
+            if (self._reports_complete(s, e)
+                    or self.cfg.mutant == "publish_without_reports"):
+                return [self._publish(s, "stop.publish", e)]
+            if self._cannot_complete(s, e):
+                return [self._fail(s, "stop.abandon",
+                                   "rescale-stop-checkpoint-incomplete")]
+            return []
+        # durable stop published: a dead worker is safe here (teardown is
+        # imminent; the restore replays the claimed commit)
+        if all(i in s.ctrl.finished or not w.alive
+               for i, w in enumerate(s.workers)):
+            applied = s._replace(ctrl=s.ctrl._replace(rescaled=True))
+            if (s.faults < self.cfg.faults
+                    and "fault.reschedule_fail" in self.cfg.fault_kinds):
+                out.append(self._fail(
+                    applied._replace(faults=applied.faults + 1),
+                    "fault.reschedule_fail", "rescale-reschedule-fail",
+                ))
+            torn = self._teardown(applied)
+            newgen = torn.store.gen + 1
+            torn = torn._replace(
+                store=torn.store._replace(
+                    gen=newgen,
+                    gen_base=torn.store.gen_base
+                    + ((newgen, torn.store.latest),),
+                ),
+                ctrl=torn.ctrl._replace(gen=newgen, rescale=0, stop_epoch=0,
+                                        pending=(), reports=(), finished=()),
+            )
+            out.append(self._move(torn, "rescale.reschedule", "SCHEDULING"))
+        return out
+
+    def _teardown(self, s: Sys) -> Sys:
+        """Force-stop every worker. A blacked-out (presumed-dead but
+        running) worker's unflushed captures become zombie late-writes
+        under its old generation."""
+        zombies = s.zombies
+        new = s
+        for widx, w in enumerate(s.workers):
+            if w.blackout and w.alive:
+                for e in w.captured:
+                    zombies = zombies + ((widx, e, w.gen),)
+            new = _replace_worker(new, widx, WorkerS(alive=False))
+        return new._replace(zombies=zombies)
+
+    def _recover(self, s: Sys) -> Step:
+        ctrl = s.ctrl
+        if ctrl.restarts >= self.cfg.restarts:
+            return self._move(s, "ctrl.fail", "FAILED")
+        torn = self._teardown(s)
+        newgen = torn.store.gen + 1
+        torn = torn._replace(
+            store=torn.store._replace(
+                gen=newgen,
+                gen_base=torn.store.gen_base + ((newgen, torn.store.latest),),
+            ),
+            ctrl=torn.ctrl._replace(
+                gen=newgen, restarts=ctrl.restarts + 1,
+                pending=(), reports=(), finished=(), rescale=0, stop_epoch=0,
+            ),
+        )
+        return self._move(torn, "ctrl.recover", "SCHEDULING")
+
+    def _schedule(self, s: Sys) -> Step:
+        """Spawn fresh workers under the current generation; restore from
+        the latest manifest. Restored sinks idempotently replay every
+        claimed epoch's commit from its manifest (the connectors'
+        sealed-state replay) — clashing generations are a violation."""
+        ctrl, store = s.ctrl, s.store
+        finalized = s.finalized
+        mgens = dict(store.manifests)
+        for e in store.claimed:
+            g = mgens.get(e)
+            if g is None:
+                continue
+            clash = [g2 for (e2, g2) in finalized if e2 == e and g2 != g]
+            if clash:
+                return Step("ctrl.schedule", (), None,
+                            f"{_V.DOUBLE_COMMIT}: restore replayed epoch "
+                            f"{e} under gen {g} over gen {clash[0]}")
+            finalized = _sorted_add(finalized, (e, g))
+        new = s._replace(
+            workers=tuple(WorkerS(gen=ctrl.gen)
+                          for _ in range(len(s.workers))),
+            finalized=finalized,
+            ctrl=ctrl._replace(
+                epoch=store.latest, epoch_budget=self.cfg.epochs,
+                pending=(), reports=(), finished=(), failure="",
+            ),
+        )
+        return self._move(new, "ctrl.schedule", "RUNNING")
+
+    # -- worker steps --------------------------------------------------------
+
+    def _worker_steps(self, s: Sys, widx: int, w: WorkerS) -> List[Step]:
+        cfg = self.cfg
+        out: List[Step] = []
+        if w.inbox:
+            msg = w.inbox[0]
+            if msg[0] == "b":
+                _tag, epoch, then_stop = msg
+                if epoch <= w.seen_barrier:
+                    # stale/duplicated barrier: alignment dedupes by epoch
+                    out.append(Step(
+                        "w.capture", (widx, epoch, "dup"),
+                        _replace_worker(s, widx,
+                                        w._replace(inbox=w.inbox[1:])),
+                    ))
+                elif len(w.captured) < cfg.inflight:
+                    nw = w._replace(
+                        inbox=w.inbox[1:],
+                        seen_barrier=epoch,
+                        captured=w.captured + (epoch,),
+                        stopping=w.stopping or then_stop,
+                        sealed=(w.sealed + ((epoch, w.gen),)
+                                if is_sink(widx) else w.sealed),
+                    )
+                    out.append(Step("w.capture", (widx, epoch),
+                                    _replace_worker(s, widx, nw)))
+                # else: admission full — the barrier blocks until a flush
+                # frees a slot (the flush step below is the way forward)
+            elif msg[0] == "c":
+                out.append(self._apply_commit(s, widx, w, msg[1]))
+        if w.captured and not w.flush_failed:
+            out.append(self._flush(s, widx, w))
+        if (w.stopping and not w.captured and not w.flush_failed
+                and not w.finished):
+            # committing state: a sink holds until its sealed txs commit
+            if (not w.sealed or not is_sink(widx)
+                    or cfg.mutant == "stop_strands_commit"):
+                out.append(Step(
+                    "w.finish", (widx,),
+                    _replace_worker(s, widx, w._replace(finished=True)),
+                ))
+        return out
+
+    def _flush(self, s: Sys, widx: int, w: WorkerS) -> Step:
+        # strictly epoch-ordered per subtask; the mutant flushes LIFO
+        if self.cfg.mutant == "unordered_flush" and len(w.captured) > 1:
+            e, rest = w.captured[-1], w.captured[:-1]
+        else:
+            e, rest = w.captured[0], w.captured[1:]
+        nw = w._replace(captured=rest, flushed=max(w.flushed, e))
+        new = _replace_worker(s, widx, nw)._replace(
+            store=s.store._replace(
+                blobs=_sorted_add(s.store.blobs, (e, widx, w.gen))
+            ),
+        )
+        # the completion report rides an awaited rpc: reliable, ordered
+        new = new._replace(ctrl=new.ctrl._replace(
+            reports=_sorted_add(new.ctrl.reports, (e, widx))
+        ))
+        return Step("w.flush", (widx, e), new)
+
+    def _apply_commit(self, s: Sys, widx: int, w: WorkerS,
+                      epoch: int) -> Step:
+        """Cumulative commit application (epochs <= msg epoch), matching
+        _handle_commit's `msg.epoch >= awaited` clearing and the sinks'
+        sealed-state semantics."""
+        finalized = s.finalized
+        for (e, g) in w.sealed:
+            if e > epoch:
+                continue
+            clash = [g2 for (e2, g2) in finalized if e2 == e and g2 != g]
+            if clash:
+                return Step("w.commit", (widx, epoch), None,
+                            f"{_V.DOUBLE_COMMIT}: epoch {e} visible under "
+                            f"gens {clash[0]} and {g}")
+            finalized = _sorted_add(finalized, (e, g))
+        nw = w._replace(
+            inbox=w.inbox[1:],
+            sealed=tuple((e, g) for (e, g) in w.sealed if e > epoch),
+        )
+        return Step("w.commit", (widx, epoch),
+                    _replace_worker(s, widx, nw)._replace(
+                        finalized=finalized))
+
+    # -- faults --------------------------------------------------------------
+
+    def _fault_steps(self, s: Sys) -> List[Step]:
+        cfg = self.cfg
+        if s.faults >= cfg.faults:
+            return []
+        out: List[Step] = []
+        spend = s.faults + 1
+        for widx, w in enumerate(s.workers):
+            if not w.alive or w.finished:
+                continue
+            if "fault.kill" in cfg.fault_kinds:
+                # SIGKILL: the process and its in-flight uploads die
+                out.append(Step(
+                    "fault.kill", (widx,),
+                    _replace_worker(s, widx, WorkerS(alive=False))
+                    ._replace(faults=spend),
+                ))
+            if "fault.blackout" in cfg.fault_kinds and not w.blackout:
+                # heartbeats stop; the process (and its uploads) do not
+                out.append(Step(
+                    "fault.blackout", (widx,),
+                    _replace_worker(s, widx, w._replace(blackout=True))
+                    ._replace(faults=spend),
+                ))
+            if w.inbox and w.inbox[0][0] == "b":
+                if "fault.drop_barrier" in cfg.fault_kinds:
+                    # a data-plane connection drop: the barrier frame is
+                    # lost AND the failure surfaces as a task error
+                    dropped = _replace_worker(
+                        s, widx, w._replace(inbox=w.inbox[1:])
+                    )._replace(faults=spend)
+                    out.append(self._fail(dropped, "fault.drop_barrier",
+                                          f"connection-drop-w{widx}"))
+                if "fault.dup_barrier" in cfg.fault_kinds:
+                    out.append(Step(
+                        "fault.dup_barrier", (widx,),
+                        _replace_worker(
+                            s, widx,
+                            w._replace(inbox=(w.inbox[0],) + w.inbox),
+                        )._replace(faults=spend),
+                    ))
+            if (len(w.inbox) > 1 and w.inbox[0][0] != w.inbox[1][0]
+                    and "fault.reorder_inbox" in cfg.fault_kinds):
+                # cross-channel race: a CommitMsg (control queue) passing
+                # a barrier (data plane) or vice versa
+                swapped = (w.inbox[1], w.inbox[0]) + w.inbox[2:]
+                out.append(Step(
+                    "fault.reorder_inbox", (widx,),
+                    _replace_worker(s, widx, w._replace(inbox=swapped))
+                    ._replace(faults=spend),
+                ))
+            if (w.captured and not w.flush_failed
+                    and "fault.flush_fail" in cfg.fault_kinds):
+                failed = _replace_worker(
+                    s, widx, w._replace(flush_failed=True)
+                )._replace(faults=spend)
+                # TaskFailedResp is reliable: the controller reacts
+                out.append(self._fail(failed, "fault.flush_fail",
+                                      f"flush-failed-w{widx}"))
+        pend = sorted(s.ctrl.pending)
+        if (pend and self._reports_complete(s, pend[0])
+                and s.ctrl.js in ("RUNNING", "CHECKPOINT_STOPPING",
+                                  "RESCALING")):
+            if "fault.cas_race" in cfg.fault_kinds:
+                out.append(self._publish(
+                    s._replace(faults=spend), "fault.cas_race", pend[0],
+                    cas_race=True,
+                ))
+        if (s.ctrl.js in ("RUNNING", "CHECKPOINT_STOPPING", "RESCALING")
+                and "fault.fence" in cfg.fault_kinds
+                and s.store.gen == s.ctrl.gen):
+            # zombie resurrect: another controller claims a newer
+            # generation out from under this one — every later publish by
+            # the current generation must fence
+            out.append(Step(
+                "fault.fence", (),
+                s._replace(
+                    faults=spend,
+                    store=s.store._replace(gen=s.store.gen + 1),
+                ),
+            ))
+        return out
+
+    def _zombie_write(self, s: Sys, z: Tuple) -> Step:
+        """A fenced incarnation's late upload finally lands. Generation-
+        stamped paths make it land beside the live blob; the
+        `unstamped_data_paths` mutant collapses the key to (epoch, worker)
+        and clobbers whatever is there."""
+        widx, epoch, gen = z
+        zombies = tuple(x for x in s.zombies if x != z)
+        if self.cfg.mutant == "unstamped_data_paths":
+            clobbered = [
+                (e, w, g) for (e, w, g) in s.store.blobs
+                if e == epoch and w == widx and g != gen
+            ]
+            if clobbered:
+                return Step(
+                    "fault.zombie_write", (widx, epoch), None,
+                    f"{_V.OVERWRITE}: gen {gen} late write over epoch "
+                    f"{epoch} worker {widx} blob of gen {clobbered[0][2]}",
+                )
+        return Step(
+            "fault.zombie_write", (widx, epoch),
+            s._replace(
+                zombies=zombies,
+                store=s.store._replace(
+                    blobs=_sorted_add(s.store.blobs, (epoch, widx, gen))
+                ),
+            ),
+        )
+
+    # -- state invariants (checked by the explorer on every state) -----------
+
+    def check_state(self, s: Sys, enabled: List[Step]) -> Optional[str]:
+        ctrl = s.ctrl
+        if ctrl.js == "STOPPED":
+            stranded = [
+                (widx, w.sealed) for widx, w in enumerate(s.workers)
+                if w.sealed
+            ]
+            invisible = [
+                e for e in s.store.claimed
+                if not any(fe == e for (fe, _g) in s.finalized)
+            ]
+            if stranded or invisible:
+                return (f"{_V.STRANDED}: stopped with sealed={stranded} "
+                        f"claimed-but-invisible={invisible}")
+        if ctrl.js == "FAILED" and s.faults == 0:
+            return (f"{_V.FAILED_NO_FAULT}: last failure "
+                    f"{ctrl.failure or 'unknown'!r}")
+        if not self.done(s):
+            if not enabled:
+                return f"{_V.DEADLOCK}: no enabled transitions in {ctrl.js}"
+            dead = _dead_unfinished(s)
+            waiting = ctrl.js in ("CHECKPOINT_STOPPING", "RESCALING")
+            if dead and waiting:
+                progress = {
+                    st.label for st in enabled
+                    if st.label not in TIMEOUT_KINDS
+                    and not st.label.startswith("fault.")
+                }
+                if not progress:
+                    return (f"{_V.STALL}: worker(s) {dead} dead in "
+                            f"{ctrl.js}, only deadline timeouts enabled")
+        return None

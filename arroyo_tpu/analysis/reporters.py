@@ -1,12 +1,25 @@
-"""Finding reporters: human text and machine JSON."""
+"""Finding reporters: human text, machine JSON, and SARIF 2.1.0.
+
+The SARIF reporter is what lets lint and model-check findings annotate
+PRs in CI (GitHub's code-scanning upload understands SARIF natively)
+instead of living only in job logs. `sarif_document` is shared by
+`tools/lint.py --sarif` and `tools/model_check.py --sarif`: both emit
+one `run` whose rules metadata comes from the registered rule objects
+(or the model checker's violation catalog)."""
 
 from __future__ import annotations
 
 import json
-from typing import IO
+from typing import IO, Iterable, List, Optional
 
-from .core import get_rule
+from .core import Finding, get_rule
 from .engine import LintResult
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def report_text(result: LintResult, out: IO, verbose: bool = False) -> None:
@@ -32,6 +45,83 @@ def report_text(result: LintResult, out: IO, verbose: bool = False) -> None:
         f"arroyolint: {status} — {result.n_files} files, "
         f"{result.n_rules} rules\n"
     )
+
+
+def _sarif_rule_meta(rule_id: str) -> dict:
+    try:
+        rule = get_rule(rule_id)
+        return {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.name},
+            "fullDescription": {"text": rule.description},
+        }
+    except KeyError:
+        return {"id": rule_id, "name": rule_id}
+
+
+def _sarif_result(f: Finding, level: str) -> dict:
+    return {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {
+                    "startLine": max(1, f.line),
+                    "startColumn": max(1, f.col + 1),
+                },
+            }
+        }],
+        "partialFingerprints": {"arroyolint/v1": f.fingerprint()},
+    }
+
+
+def sarif_document(
+    findings: Iterable[Finding],
+    tool_name: str = "arroyolint",
+    errors: Iterable[Finding] = (),
+    extra_rules: Optional[List[dict]] = None,
+) -> dict:
+    """One SARIF run over `findings` (level error) + `errors` (parse
+    failures, level error too — an unparseable file can hide anything).
+    `extra_rules` injects rule metadata for ids the lint registry does
+    not know (the model checker's violation catalog)."""
+    findings = list(findings)
+    errors = list(errors)
+    known_extra = {r["id"]: r for r in (extra_rules or [])}
+    rule_ids: List[str] = []
+    for f in findings + errors:
+        if f.rule not in rule_ids:
+            rule_ids.append(f.rule)
+    rules = [
+        known_extra.get(rid) or _sarif_rule_meta(rid) for rid in rule_ids
+    ]
+    results = [_sarif_result(f, "error") for f in findings]
+    results += [_sarif_result(f, "error") for f in errors]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": tool_name,
+                    "informationUri":
+                        "https://github.com/arroyo-tpu/arroyo-tpu",
+                    "rules": rules,
+                }
+            },
+            "results": results,
+        }],
+    }
+
+
+def report_sarif(result: LintResult, out: IO) -> None:
+    json.dump(
+        sarif_document(result.findings, errors=result.errors), out, indent=2
+    )
+    out.write("\n")
 
 
 def report_json(result: LintResult, out: IO) -> None:

@@ -276,6 +276,144 @@ class StateTransitionRule(Rule):
         return out
 
 
+# PRO004: epoch/flush bookkeeping the model checker owns. Every mutation
+# of these attributes must be reachable from a @protocol_effect-annotated
+# handler (analysis/model/effects.py) — ad-hoc bookkeeping outside the
+# modeled transitions is exactly the drift the model checker cannot see.
+_EPOCH_STATE_ATTRS = ("pending_epochs", "_inflight_flushes", "_last_flush")
+_MUTATING_METHODS = (
+    "clear", "append", "pop", "popitem", "setdefault", "update", "extend",
+    "remove", "insert",
+)
+
+
+def _protocol_effect_functions(ctx: FileContext) -> Set[str]:
+    """Function names carrying a @protocol_effect("...") decorator."""
+    out: Set[str] = set()
+    for node in iter_functions(ctx.tree):
+        for dec in node.decorator_list:
+            if (
+                isinstance(dec, ast.Call)
+                and last_attr(dec.func) == "protocol_effect"
+                and dec.args
+                and str_const(dec.args[0]) is not None
+            ):
+                out.add(node.name)
+    return out
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Function names `fn` calls (self.x(...) or x(...))."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = last_attr(node.func)
+            if name:
+                out.add(name)
+    return out
+
+
+def _reachable_from_handlers(ctx: FileContext) -> Set[str]:
+    """Annotated handlers plus everything they transitively call within
+    this file (simple name-based call graph — the dispatch code keeps its
+    epoch bookkeeping in methods of one class per file)."""
+    graph: Dict[str, Set[str]] = {
+        fn.name: _called_names(fn) for fn in iter_functions(ctx.tree)
+    }
+    reach = set(_protocol_effect_functions(ctx))
+    work = list(reach)
+    while work:
+        cur = work.pop()
+        for callee in graph.get(cur, ()):
+            if callee in graph and callee not in reach:
+                reach.add(callee)
+                work.append(callee)
+    return reach
+
+
+def _watched_attr(node: ast.AST) -> Optional[str]:
+    """The watched attribute name when `node` is (or indexes) one."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _EPOCH_STATE_ATTRS:
+        return node.attr
+    return None
+
+
+def _flatten_targets(targets) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_flatten_targets(t.elts))
+        else:
+            out.append(t)
+    return out
+
+
+@register
+class EpochBookkeepingRule(Rule):
+    id = "PRO004"
+    name = "protocol-epoch-bookkeeping"
+    description = (
+        "every mutation of pending_epochs / _inflight_flushes / "
+        "_last_flush must be reachable from a @protocol_effect-annotated "
+        "state-machine handler (or __init__ seeding) — ad-hoc epoch "
+        "bookkeeping outside the modeled transitions cannot be verified "
+        "by the protocol model checker (analysis/model/)"
+    )
+    scope = "file"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        # cheap pre-filter: most files never touch the watched attrs
+        if not any(a in ctx.source for a in _EPOCH_STATE_ATTRS):
+            return ()
+        reachable = _reachable_from_handlers(ctx)
+        out: List[Finding] = []
+
+        def site_ok(node: ast.AST) -> bool:
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                return False
+            return fn.name == "__init__" or fn.name in reachable
+
+        def flag(node: ast.AST, attr: str, how: str):
+            if not site_ok(node):
+                fn = ctx.enclosing_function(node)
+                where = fn.name + "()" if fn is not None else "module scope"
+                out.append(ctx.finding(
+                    self, node,
+                    f"{how} of {attr} in {where}, which is not reachable "
+                    "from any @protocol_effect-annotated handler — the "
+                    "model checker cannot account for this epoch "
+                    "bookkeeping",
+                ))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in _flatten_targets(targets):
+                    attr = _watched_attr(t)
+                    if attr:
+                        flag(node, attr, "assignment")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = _watched_attr(t)
+                    if attr:
+                        flag(node, attr, "deletion")
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATING_METHODS
+                ):
+                    attr = _watched_attr(node.func.value)
+                    if attr:
+                        flag(node, attr, f".{node.func.attr}() mutation")
+        return out
+
+
 def _fault_points(ctx: FileContext):
     """Parse FAULT_POINTS = {"name": ..., ...} -> {name: lineno}."""
     for node in ctx.tree.body:

@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import chaos, obs
+from ..analysis.model.effects import protocol_effect
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..state.backend import StateBackend
@@ -397,6 +398,7 @@ class ControllerServer:
         finally:
             job.rescale_trace = None
 
+    @protocol_effect("ctrl.schedule")
     async def _schedule_inner(self, job: JobHandle, n_workers: int):
         if job.storage_url and job.backend is None:
             job.backend = StateBackend(job.storage_url, job.job_id).initialize()
@@ -473,6 +475,7 @@ class ControllerServer:
             await w.client.call("WorkerGrpc", "StartProcessing", {})
         job.transition(JobState.RUNNING)
 
+    @protocol_effect("ctrl.run_cadence")
     async def _run(self, job: JobHandle):
         """Checkpoint cadence + completion/failure watching
         (reference job_controller/controller.rs:292-551)."""
@@ -557,6 +560,20 @@ class ControllerServer:
                         job.transition(JobState.RECOVERING)
                         return
                     await self._await_all_finished(job)
+                    if (len(job.finished_tasks) < job.n_subtasks
+                            and (self._heartbeat_expired(job)
+                                 or job.failure is not None)):
+                        # model checker (ISSUE 9, V_STRANDED): a worker
+                        # died between the durable stop checkpoint and its
+                        # finish — its sink may hold a sealed transaction
+                        # whose phase-2 commit never applied. Recover (the
+                        # restore replays the claimed commit) and retry
+                        # the stop instead of stopping over stranded state.
+                        job.failure = (job.failure
+                                       or "worker died finishing the stop")
+                        job.stop_requested = mode
+                        job.transition(JobState.RECOVERING)
+                        return
                     job.transition(JobState.STOPPED)
                 else:
                     job.transition(JobState.STOPPING)
@@ -581,6 +598,7 @@ class ControllerServer:
                 last_checkpoint = time.monotonic()
                 await self._checkpoint_start(job)
 
+    @protocol_effect("ctrl.rescale")
     async def _rescale(self, job: JobHandle):
         """Exactly-once automatic rescale (reference states/rescaling.rs;
         the autoscaler's actuation path): stop with a checkpoint, fold the
@@ -658,6 +676,7 @@ class ControllerServer:
             ).initialize()
         job.transition(JobState.SCHEDULING)
 
+    @protocol_effect("ctrl.checkpoint_start")
     async def _checkpoint_start(self, job: JobHandle):
         """Pipelined cadence: fan the barrier out and return — the epoch
         joins `pending_epochs` and publishes from _checkpoint_reap once
@@ -677,6 +696,7 @@ class ControllerServer:
             "trace": ck_trace,
         }
 
+    @protocol_effect("ctrl.checkpoint_reap")
     async def _checkpoint_reap(self, job: JobHandle):
         """Publish every pending epoch whose reports completed, strictly
         in epoch order (manifest N+1 references chain blobs first
@@ -704,6 +724,7 @@ class ControllerServer:
             if job.failure is not None:
                 return
 
+    @protocol_effect("ctrl.drain_pending")
     async def _drain_pending_epochs(self, job: JobHandle):
         """Settle every pending epoch (publish or abandon) — stop,
         rescale and recovery paths stay strictly drained, exactly as the
@@ -757,6 +778,7 @@ class ControllerServer:
         ):
             await self._checkpoint_inner(job, epoch, then_stop)
 
+    @protocol_effect("ctrl.stop_checkpoint")
     async def _checkpoint_inner(self, job: JobHandle, epoch: int,
                                 then_stop: bool):
         with obs.span("barrier_fanout", cat="controller"):
@@ -767,6 +789,12 @@ class ControllerServer:
                 if job.failure is not None or time.monotonic() > deadline:
                     logger.warning("checkpoint %d incomplete", epoch)
                     wait_span.set(outcome="incomplete")
+                    if then_stop and job.failure is None:
+                        # model checker (ISSUE 9, V_STRANDED): a stopping
+                        # checkpoint that never completed must not let the
+                        # stop proceed as if state were durable — fail it
+                        # so the stop routes through Recovering and retries
+                        job.failure = f"stop checkpoint {epoch} incomplete"
                     return
                 if self._heartbeat_expired(job):
                     # a worker died mid-barrier: its subtasks can never
@@ -791,6 +819,7 @@ class ControllerServer:
                 await asyncio.sleep(0.02)
         await self._publish_epoch(job, epoch, job.checkpoints[epoch])
 
+    @protocol_effect("ctrl.publish_epoch")
     async def _publish_epoch(self, job: JobHandle, epoch: int,
                              reports: Dict[str, dict]):
         """Manifest publish + 2PC commit + compaction/GC for one epoch
@@ -868,8 +897,15 @@ class ControllerServer:
                 logger.warning("job %s: tasks did not finish in time",
                                job.job_id)
                 return
+            if self._heartbeat_expired(job):
+                # a dead worker's tasks can never finish; don't sit out
+                # the deadline — callers decide whether that's fatal
+                logger.warning("job %s: worker died awaiting task finish",
+                               job.job_id)
+                return
             await asyncio.sleep(0.02)
 
+    @protocol_effect("ctrl.recover")
     async def _recover(self, job: JobHandle, n_workers: int):
         """reference states/recovering.rs:24-60 (escalating teardown) then
         reschedule from the latest durable checkpoint."""

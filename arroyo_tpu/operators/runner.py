@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 import pyarrow as pa
 
 from .. import obs
+from ..analysis.model.effects import protocol_effect
 from ..config import config
 from ..metrics import (
     BARRIER_ALIGNMENT_SECONDS,
@@ -382,6 +383,7 @@ class SubtaskRunner:
             SignalMessage.end_of_data() if is_eod else SignalMessage.stop()
         )
 
+    @protocol_effect("worker.await_commit")
     async def _await_commit(self, control_task, timeout: float = 10.0):
         """Committing state (reference states/committing.rs): the inputs
         closed, but the last checkpoint reported commit data whose phase-2
@@ -580,6 +582,7 @@ class SubtaskRunner:
         self._barrier_inputs.clear()
         # unblocking + re-arming happens in the main loop
 
+    @protocol_effect("worker.capture")
     async def _checkpoint_chain(self, barrier):
         """Capture every chain op's state at the barrier, re-broadcast the
         barrier downstream immediately, then flush (device->host
@@ -647,6 +650,7 @@ class SubtaskRunner:
         if barrier.then_stop:
             await self._await_pending_flush()
 
+    @protocol_effect("worker.admit_flush")
     async def _admit_flush(self):
         """Block until a flush slot is free (bounds capture-ahead: the
         barrier path stalls only once max_inflight epochs are uploading)."""
@@ -659,6 +663,7 @@ class SubtaskRunner:
                 t for t in self._inflight_flushes if not t.done()
             ]
 
+    @protocol_effect("worker.drain_flushes")
     async def _await_pending_flush(self):
         """Drain EVERY in-flight flush (stop/commit/close paths stay
         strictly drained — teardown must never strand an upload)."""
@@ -667,6 +672,7 @@ class SubtaskRunner:
             await flush
         self._last_flush = None
 
+    @protocol_effect("worker.flush")
     async def _flush_and_report(self, barrier, captured, commit_data,
                                 watermark, flush_span=obs.NULL_SPAN,
                                 prev: Optional[asyncio.Task] = None):
@@ -741,6 +747,7 @@ class SubtaskRunner:
                 "non-source %s got direct CheckpointMsg", self.task_info.task_id
             )
 
+    @protocol_effect("worker.commit")
     async def _handle_commit(self, msg: CommitMsg):
         span = obs.NULL_SPAN
         if msg.trace_id:

@@ -20,6 +20,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from .. import chaos
+from ..analysis.model.effects import protocol_effect
 from .storage import CasConflict, StorageProvider
 
 
@@ -82,6 +83,7 @@ class ProtocolPaths:
 # -- generations ------------------------------------------------------------
 
 
+@protocol_effect("storage.new_generation")
 def initialize_generation(storage: StorageProvider, paths: ProtocolPaths) -> int:
     """Claim the next generation; the CAS-created generation file is the
     fencing token (reference workflow.rs:223)."""
@@ -100,6 +102,7 @@ def initialize_generation(storage: StorageProvider, paths: ProtocolPaths) -> int
     return gen
 
 
+@protocol_effect("storage.check_fence")
 def check_current(storage: StorageProvider, paths: ProtocolPaths, gen: int):
     if chaos.fire("protocol.fenced_zombie", generation=gen,
                   job_id=paths.job_id):
@@ -117,6 +120,7 @@ def check_current(storage: StorageProvider, paths: ProtocolPaths, gen: int):
 # -- checkpoints ------------------------------------------------------------
 
 
+@protocol_effect("storage.publish_manifest")
 def publish_checkpoint(
     storage: StorageProvider,
     paths: ProtocolPaths,
@@ -171,6 +175,7 @@ def cleanup_checkpoints(
 # -- 2PC commit records -----------------------------------------------------
 
 
+@protocol_effect("storage.prepare_commit")
 def prepare_commit(
     storage: StorageProvider, paths: ProtocolPaths, gen: int, epoch: int,
     committing: Dict[str, Any],
@@ -186,6 +191,7 @@ def prepare_commit(
         pass  # already prepared (recovery replays are fine pre-commit)
 
 
+@protocol_effect("storage.claim_commit")
 def claim_commit(
     storage: StorageProvider, paths: ProtocolPaths, gen: int, epoch: int
 ) -> bool:

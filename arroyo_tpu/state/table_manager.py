@@ -32,6 +32,7 @@ import weakref
 from typing import Dict, Optional
 
 from .. import obs
+from ..analysis.model.effects import protocol_effect
 from ..config import config as get_config
 from ..metrics import (
     STATE_BYTES,
@@ -209,6 +210,7 @@ class TableManager:
         delta_bytes = sum(f.get("bytes", 0) for f in deltas)
         return delta_bytes > st.rebase_bytes_factor * base_bytes
 
+    @protocol_effect("state.capture_tables")
     def capture(self, epoch: int, watermark: Optional[int]) -> Dict:
         """Synchronously stage this epoch's state at the barrier: global
         tables serialize only their dirty entries + tombstones (a base
@@ -250,6 +252,7 @@ class TableManager:
                 }
         return staged
 
+    @protocol_effect("state.flush_tables")
     def flush_captured(self, epoch: int, staged: Dict) -> Dict:
         """Write captured state to storage; safe to run while the operator
         processes later epochs (captured data is immutable), as long as

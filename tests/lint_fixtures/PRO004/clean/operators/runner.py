@@ -1,0 +1,31 @@
+"""PRO004 clean fixture: every mutation reachable from an annotated
+handler (directly, via a helper, or __init__ seeding)."""
+
+
+def protocol_effect(name):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class SubtaskRunner:
+    def __init__(self):
+        self._inflight_flushes = []
+        self.pending_epochs = {}
+
+    @protocol_effect("worker.capture")
+    async def _checkpoint_chain(self, barrier):
+        self._inflight_flushes.append(barrier)
+        await self._reap_done()
+
+    @protocol_effect("worker.drain_flushes")
+    async def _await_pending_flush(self):
+        flushes, self._inflight_flushes = self._inflight_flushes, []
+        return flushes
+
+    async def _reap_done(self):
+        # helper called from an annotated handler: reachable, fine
+        self._inflight_flushes = [
+            t for t in self._inflight_flushes if not t.done()
+        ]
+        self.pending_epochs.pop(0, None)

@@ -1,0 +1,26 @@
+"""PRO004 firing fixture: epoch bookkeeping outside annotated handlers."""
+
+
+def protocol_effect(name):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class SubtaskRunner:
+    def __init__(self):
+        self._inflight_flushes = []  # seeding in __init__ is fine
+        self.pending_epochs = {}
+
+    @protocol_effect("worker.capture")
+    async def _checkpoint_chain(self, barrier):
+        self._inflight_flushes.append(barrier)  # annotated: fine
+
+    async def _sneaky_cleanup(self):
+        # NOT annotated and not called from any annotated handler:
+        # the model checker cannot account for this mutation
+        self._inflight_flushes = []
+        self.pending_epochs.clear()
+
+    async def _drop_epoch(self, epoch):
+        del self.pending_epochs[epoch]  # same: ad-hoc deletion

@@ -1,0 +1,403 @@
+"""Protocol model checker tests (ISSUE 9).
+
+Tier-1 coverage: extraction fidelity (the model runs on the SAME
+TRANSITIONS table the controller does), the model<->code bijection
+(strict-clean on the real tree, and drift actually detected), the
+JobState graph properties (every state reachable, every non-terminal
+state reaches a terminal — the dead/orphan-state detector), a
+small-budget exhaustive exploration with zero violations, the mutant
+regression corpus (every reintroduced bug — including the three
+historical PR 2 protocol bugs — yields a counterexample that replays
+deterministically and serializes to a valid seeded chaos plan), and the
+SARIF emission both reporters share.
+
+The full acceptance-scale exploration (2 workers x 3 epochs x 2
+in-flight x all fault kinds x rescale) runs in the nightly model-check
+CI lane; a slow-tier test pins it here too.
+"""
+
+import json
+import subprocess
+import sys
+from collections import deque
+from pathlib import Path
+
+import pytest
+
+from arroyo_tpu.analysis.model import explore as explore_mod
+from arroyo_tpu.analysis.model import mutants as mutants_mod
+from arroyo_tpu.analysis.model import replay as replay_mod
+from arroyo_tpu.analysis.model.extract import (
+    annotated_handlers,
+    check_bijection,
+    job_state_machine,
+    load_project,
+)
+from arroyo_tpu.analysis.model.spec import (
+    HANDLER_BINDINGS,
+    Model,
+    ModelConfig,
+    USED_EFFECTS,
+    VIOLATIONS,
+)
+from arroyo_tpu.controller import state_machine as sm
+
+REPO = Path(__file__).resolve().parents[1]
+
+_project = None
+
+
+def project():
+    global _project
+    if _project is None:
+        _project = load_project(REPO)
+    return _project
+
+
+def machine():
+    return job_state_machine(project())
+
+
+# -- extraction fidelity -----------------------------------------------------
+
+
+def test_extraction_matches_runtime_table():
+    members, terminals, table = machine()
+    assert members == {s.name for s in sm.JobState}
+    assert terminals == {
+        s.name for s in sm.JobState if s.is_terminal()
+    }
+    runtime = {
+        k.name: {v.name for v in vs} for k, vs in sm.TRANSITIONS.items()
+    }
+    assert table == runtime
+
+
+def test_extraction_refuses_empty_anchor(tmp_path):
+    from arroyo_tpu.analysis.model.extract import ExtractionError
+    from arroyo_tpu.analysis.engine import parse_project
+
+    (tmp_path / "controller").mkdir()
+    (tmp_path / "controller" / "state_machine.py").write_text("x = 1\n")
+    proj = parse_project(
+        tmp_path, [tmp_path / "controller" / "state_machine.py"]
+    )
+    with pytest.raises(ExtractionError):
+        job_state_machine(proj)
+
+
+# -- model <-> code bijection ------------------------------------------------
+
+
+def test_bijection_clean_on_real_tree():
+    problems = check_bijection(project(), HANDLER_BINDINGS, USED_EFFECTS)
+    assert not problems, "\n".join(problems)
+
+
+def test_bijection_catches_missing_annotation(tmp_path):
+    from arroyo_tpu.analysis.engine import parse_project
+
+    # a mini-tree whose controller lacks the annotation the model binds
+    (tmp_path / "controller").mkdir()
+    (tmp_path / "controller" / "controller.py").write_text(
+        "async def _checkpoint_start(job):\n    pass\n"
+    )
+    proj = parse_project(
+        tmp_path, [tmp_path / "controller" / "controller.py"]
+    )
+    problems = check_bijection(
+        proj, {"ctrl.checkpoint_start":
+               ("controller/controller.py", "_checkpoint_start")},
+        {"ctrl.checkpoint_start"},
+    )
+    assert any("not annotated" in p for p in problems)
+
+
+def test_bijection_catches_unknown_annotation(tmp_path):
+    from arroyo_tpu.analysis.engine import parse_project
+
+    (tmp_path / "controller").mkdir()
+    (tmp_path / "controller" / "controller.py").write_text(
+        "def protocol_effect(n):\n"
+        "    def deco(fn):\n        return fn\n    return deco\n\n"
+        "@protocol_effect('ctrl.not_a_real_effect')\n"
+        "async def _mystery(job):\n    pass\n"
+    )
+    proj = parse_project(
+        tmp_path, [tmp_path / "controller" / "controller.py"]
+    )
+    problems = check_bijection(proj, {}, set())
+    assert any("no such binding" in p for p in problems)
+
+
+def test_every_binding_annotated_exactly_once():
+    found = annotated_handlers(project())
+    for effect, (suffix, fn) in HANDLER_BINDINGS.items():
+        sites = {(p, f) for (p, f, _ln) in found.get(effect, ())}
+        assert len(sites) == 1, (effect, sites)
+
+
+# -- JobState graph properties (satellite: dead/orphan-state detector) -------
+
+
+def test_every_jobstate_reachable_from_initial():
+    members, _terminals, table = machine()
+    seen = {"CREATED"}
+    work = deque(seen)
+    while work:
+        cur = work.popleft()
+        for nxt in table.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                work.append(nxt)
+    assert seen == members, f"orphan states: {sorted(members - seen)}"
+
+
+def test_every_nonterminal_reaches_a_terminal():
+    members, terminals, table = machine()
+    # backward reachability from terminals over the declared edges
+    rev = {}
+    for src, dsts in table.items():
+        for d in dsts:
+            rev.setdefault(d, set()).add(src)
+    ok = set(terminals)
+    work = deque(ok)
+    while work:
+        cur = work.popleft()
+        for p in rev.get(cur, ()):
+            if p not in ok:
+                ok.add(p)
+                work.append(p)
+    stuck = members - ok
+    assert not stuck, f"states that cannot terminate: {sorted(stuck)}"
+
+
+def test_terminal_states_have_no_outgoing_edges():
+    _members, terminals, table = machine()
+    for t in terminals:
+        assert t not in table or not table[t], (
+            f"terminal state {t} has outgoing transitions"
+        )
+
+
+# -- exhaustive exploration (tier-1 smoke; full config runs nightly) ---------
+
+SMOKE = ModelConfig(workers=2, epochs=2, inflight=2, faults=1, restarts=1,
+                    rescales=0,
+                    fault_kinds=("fault.kill", "fault.cas_race"))
+
+
+def test_smoke_exploration_clean_and_exhaustive():
+    _m, terminals, table = machine()
+    res = explore_mod.explore(
+        Model(SMOKE, table, terminals), budget=200_000
+    )
+    assert res.exhaustive, "smoke config must fit the budget"
+    assert not res.violations, [t.violation for t in res.violations]
+    # sanity: the space is non-trivial and runs actually terminate
+    assert res.states > 1_000
+    assert res.terminal_states > 0
+
+
+def test_exploration_reports_truncation():
+    _m, terminals, table = machine()
+    res = explore_mod.explore(Model(SMOKE, table, terminals), budget=50)
+    assert not res.exhaustive
+
+
+@pytest.mark.slow
+def test_full_acceptance_config_exhaustive_clean():
+    """ISSUE 9 acceptance: >=2 workers, >=3 epochs, >=2 inflight, ALL
+    fault event types enabled, a rescale — zero violations, exhaustive."""
+    _m, terminals, table = machine()
+    cfg = ModelConfig(workers=2, epochs=3, inflight=2, faults=1,
+                      restarts=2, rescales=1)
+    res = explore_mod.explore(
+        Model(cfg, table, terminals), budget=2_000_000
+    )
+    assert res.exhaustive
+    assert not res.violations, [t.violation for t in res.violations]
+    assert res.states > 100_000
+
+
+# -- mutant regression corpus ------------------------------------------------
+
+
+@pytest.mark.parametrize("por", [True, False], ids=["por", "no-por"])
+@pytest.mark.parametrize("name", sorted(mutants_mod.MUTANTS))
+def test_mutant_yields_counterexample(name, por):
+    _m, terminals, table = machine()
+    m = mutants_mod.get_mutant(name)
+    res = explore_mod.explore(
+        Model(m.config, table, terminals), budget=300_000, por=por,
+        first_violation=True,
+    )
+    kinds = [t.violation.split(":", 1)[0] for t in res.violations]
+    assert m.expect_violation in kinds, (
+        f"{name}: expected {m.expect_violation}, got {kinds}"
+    )
+
+
+def test_corpus_includes_the_three_historical_bugs():
+    hist = {m.name for m in mutants_mod.historical_mutants()}
+    assert hist == {
+        "stop_strands_commit",
+        "commit_fanout_all_workers",
+        "no_liveness_in_stop_wait",
+    }
+
+
+def _first_counterexample(name):
+    _m, terminals, table = machine()
+    m = mutants_mod.get_mutant(name)
+    res = explore_mod.explore(
+        Model(m.config, table, terminals), budget=300_000,
+        first_violation=True,
+    )
+    hit = [t for t in res.violations
+           if t.violation.split(":", 1)[0] == m.expect_violation]
+    assert hit, f"{name} produced no counterexample"
+    return hit[0], table, terminals
+
+
+@pytest.mark.parametrize("name", sorted(mutants_mod.MUTANTS))
+def test_counterexample_replays_deterministically(name):
+    trace, table, terminals = _first_counterexample(name)
+    m = mutants_mod.get_mutant(name)
+    # replay the exact event list: same violation kind, twice
+    for _ in range(2):
+        got = replay_mod.replay_trace(trace, table, terminals)
+        assert got.split(":", 1)[0] == m.expect_violation
+    # a JSON round-trip must not change the replay
+    back = explore_mod.Trace.from_json(trace.to_json())
+    got = replay_mod.replay_trace(back, table, terminals)
+    assert got.split(":", 1)[0] == m.expect_violation
+
+
+def test_replay_divergence_detected():
+    trace, table, terminals = _first_counterexample("stop_strands_commit")
+    bogus = explore_mod.Trace(
+        violation=trace.violation,
+        events=[("w.flush", (0, 99))] + trace.events,
+        config=trace.config, mutant=trace.mutant,
+    )
+    with pytest.raises(replay_mod.ReplayDivergence):
+        replay_mod.replay_trace(bogus, table, terminals)
+
+
+# -- counterexample -> chaos plan (the replay pipeline) ----------------------
+
+
+def test_trace_serializes_to_valid_seeded_fault_plan():
+    from arroyo_tpu.chaos import FaultPlan
+
+    trace, _table, _terminals = _first_counterexample(
+        "no_liveness_in_stop_wait"
+    )
+    plan = replay_mod.trace_to_fault_plan(trace)
+    # the model's kill fault maps to the registered worker.kill seam
+    points = [s.point for s in plan.specs]
+    assert "worker.kill" in points
+    # every point passed FaultPlan's registry validation on construction;
+    # a JSON round trip preserves the schedule exactly
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.to_json() == plan.to_json()
+    # determinism: same trace content -> same seed -> same plan
+    plan2 = replay_mod.trace_to_fault_plan(trace)
+    assert plan2.seed == plan.seed
+    assert plan2.to_json() == plan.to_json()
+
+
+def test_counterexample_payload_is_drill_consumable(tmp_path):
+    from arroyo_tpu.chaos import FaultPlan
+
+    trace, _table, _terminals = _first_counterexample(
+        "unstamped_data_paths"
+    )
+    payload = replay_mod.counterexample_payload(trace)
+    # what tools/chaos_drill.py --plan loads: payload["fault_plan"]
+    plan = FaultPlan.from_json(json.dumps(payload["fault_plan"]))
+    assert plan.specs, "counterexample with faults must carry a schedule"
+    assert payload["trace"]["violation"].startswith(
+        VIOLATIONS.OVERWRITE
+    )
+    # round-trips through disk (the --trace-dir artifact)
+    p = tmp_path / "ce.json"
+    p.write_text(json.dumps(payload))
+    reloaded = json.loads(p.read_text())
+    back = explore_mod.Trace.from_json(reloaded["trace"])
+    assert back.events == trace.events
+
+
+def test_every_model_fault_maps_to_registered_point():
+    from arroyo_tpu.chaos import FAULT_POINTS
+
+    for label, (point, _m, _p, _w) in replay_mod.FAULT_MAP.items():
+        assert point in FAULT_POINTS, (label, point)
+
+
+# -- SARIF -------------------------------------------------------------------
+
+
+def test_sarif_document_from_lint_findings():
+    from arroyo_tpu.analysis.core import Finding
+    from arroyo_tpu.analysis.reporters import sarif_document
+
+    doc = sarif_document([
+        Finding(rule="PRO004", path="a/b.py", line=3, col=1, message="m"),
+    ])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "arroyolint"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == ["PRO004"]
+    res = run["results"][0]
+    assert res["ruleId"] == "PRO004"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a/b.py"
+    assert loc["region"]["startLine"] == 3
+    assert res["partialFingerprints"]["arroyolint/v1"]
+
+
+def test_lint_cli_sarif(tmp_path):
+    out = tmp_path / "lint.sarif"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"),
+         "--sarif", str(out)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []  # tree is clean
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_model_check_cli_smoke(tmp_path):
+    out = tmp_path / "summary.json"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "model_check.py"),
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "bijection: clean" in r.stdout
+    doc = json.loads(out.read_text())
+    assert doc["bijection"] == "clean"
+    run = doc["runs"][0]
+    assert run["exhaustive"] and not run["violations"]
+
+
+def test_model_check_cli_single_mutant(tmp_path):
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "model_check.py"),
+         "--mutant", "publish_without_reports",
+         "--trace-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(
+        (tmp_path / "publish_without_reports.json").read_text()
+    )
+    assert payload["trace"]["violation"].startswith(VIOLATIONS.ATOMIC)

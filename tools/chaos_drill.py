@@ -30,6 +30,15 @@
         byte-identical and the decision audit log is written next to
         the results.
 
+    python tools/chaos_drill.py --plan COUNTEREXAMPLE.json
+        Replay a model-checker counterexample (tools/model_check.py
+        --trace-dir) — or any serialized FaultPlan — against the real
+        embedded cluster: the golden drill runs under exactly that fault
+        schedule. Accepts either a bare FaultPlan JSON or a
+        counterexample payload with a "fault_plan" key. On fixed code
+        the drill passes byte-identical; were the modeled bug live,
+        this is the plan that demonstrates it end-to-end.
+
     python tools/chaos_drill.py --state-bloat
         ROADMAP item 4 acceptance: session state grows ~10x during the
         run, a worker is SIGKILLed mid-upload (storage latency widens
@@ -72,12 +81,16 @@ def main() -> int:
                     help="also run the state-bloat drill: 10x state "
                     "growth + SIGKILL mid-upload; requires byte-identical "
                     "output and ~flat capture time / delta bytes")
+    ap.add_argument("--plan", type=str, default="",
+                    help="run the drill under a serialized FaultPlan JSON "
+                    "(bare plan or a model-check counterexample payload "
+                    "with a 'fault_plan' key)")
     ap.add_argument("--out", type=str, default="",
                     help="write results + fired-fault log to this JSON file")
     ap.add_argument("--workdir", type=str, default="")
     args = ap.parse_args()
 
-    from arroyo_tpu.chaos import FAULT_POINTS
+    from arroyo_tpu.chaos import FAULT_POINTS, FaultPlan
     from arroyo_tpu.chaos import drill as d
 
     if args.list:
@@ -87,7 +100,22 @@ def main() -> int:
         return 0
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos-drill-")
-    if args.fast:
+    if args.plan:
+        with open(args.plan) as f:
+            doc = json.load(f)
+        plan_doc = doc.get("fault_plan", doc)  # payload or bare plan
+        plan_text = json.dumps(plan_doc)
+        trace = doc.get("trace", {})
+        if trace:
+            print(f"replaying counterexample: {trace.get('violation')} "
+                  f"(mutant {trace.get('mutant') or 'none'}, "
+                  f"{len(trace.get('events', []))} model events)")
+        queries = [q for q in args.queries.split(",") if q.strip()] or [
+            d.DEFAULT_DRILL_QUERIES[0]
+        ]
+        # a fresh plan per drill run: hit counters are stateful
+        plan_factory = lambda seed: FaultPlan.from_json(plan_text)  # noqa: E731
+    elif args.fast:
         queries = [d.DEFAULT_DRILL_QUERIES[0]]
         plan_factory = d.fast_plan
     else:
@@ -125,7 +153,8 @@ def main() -> int:
 
     payload = {
         "seed": args.seed,
-        "mode": "fast" if args.fast else "standard",
+        "mode": ("plan" if args.plan else
+                 "fast" if args.fast else "standard"),
         "passed": ok,
         "results": [r.to_json() for r in results],
     }

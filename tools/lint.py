@@ -31,7 +31,11 @@ sys.path.insert(0, str(REPO_ROOT))
 from arroyo_tpu.analysis import Baseline, all_rules, run_lint  # noqa: E402
 from arroyo_tpu.analysis.baseline import DEFAULT_BASELINE  # noqa: E402
 from arroyo_tpu.analysis.engine import DEFAULT_ROOTS  # noqa: E402
-from arroyo_tpu.analysis.reporters import report_json, report_text  # noqa: E402
+from arroyo_tpu.analysis.reporters import (  # noqa: E402
+    report_json,
+    report_sarif,
+    report_text,
+)
 from arroyo_tpu.analysis.rules_jax_config import config_key_table  # noqa: E402
 
 
@@ -49,6 +53,9 @@ def main(argv=None) -> int:
                          "unjustified baseline entries")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="JSON report on stdout")
+    ap.add_argument("--sarif", metavar="FILE", default=None,
+                    help="also write a SARIF 2.1.0 report (use '-' for "
+                         "stdout); CI uploads it so findings annotate PRs")
     ap.add_argument("--verbose", "-v", action="store_true",
                     help="include rule descriptions under each finding")
     ap.add_argument("--changed-only", action="store_true",
@@ -122,9 +129,17 @@ def main(argv=None) -> int:
               "--strict accepts it")
         return 0
 
+    if args.sarif:
+        if args.sarif == "-":
+            report_sarif(result, sys.stdout)
+        else:
+            with open(args.sarif, "w") as f:
+                report_sarif(result, f)
+            print(f"sarif report written to {args.sarif}", file=sys.stderr)
+
     if args.as_json:
         report_json(result, sys.stdout)
-    else:
+    elif args.sarif != "-":  # '-' owns stdout: SARIF must stay parseable
         report_text(result, sys.stdout, verbose=args.verbose)
 
     if args.strict:

@@ -1,0 +1,285 @@
+#!/usr/bin/env python3
+"""Protocol model checker CLI (ISSUE 9) — exhaustively verify the
+checkpoint/2PC/rescale state machines.
+
+    python tools/model_check.py
+        The acceptance configuration: 2 workers x 3 epochs x 2 in-flight
+        flushes, every fault event type enabled (1-fault budget), a
+        rescale, 2 restarts. Runs the model<->code bijection check, then
+        exhaustively explores the composed model; any invariant
+        violation (or truncation by --budget) fails the run. Add
+        --workers 3 for the bigger nightly sweep.
+
+    python tools/model_check.py --smoke
+        The tier-1 configuration: small enough for the test suite
+        (2 workers x 2 epochs, kill/cas faults only).
+
+    python tools/model_check.py --corpus
+        Mutation-test the checker: every mutant in the regression corpus
+        (including the three historical PR 2 protocol bugs) must produce
+        a counterexample of its expected kind, the counterexample must
+        REPLAY deterministically to the same violation, and it must
+        serialize to a valid seeded chaos FaultPlan.
+
+    python tools/model_check.py --mutant NAME --trace-dir DIR
+        Run one mutant; write the counterexample trace + its replayable
+        chaos plan to DIR (the README worked example). Feed the payload
+        to `tools/chaos_drill.py --plan <file>` to run the same
+        adversarial schedule against the real embedded cluster.
+
+    python tools/model_check.py --bijection-only
+        Just the PRO00x-style drift check: @protocol_effect annotations
+        on the dispatch code == spec.HANDLER_BINDINGS == the transition
+        relation's citations.
+
+Exit codes: 0 clean / all mutants caught, 1 violation or uncaught
+mutant or bijection drift, 2 internal error or budget truncation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from arroyo_tpu.analysis.model import explore as explore_mod  # noqa: E402
+from arroyo_tpu.analysis.model import mutants as mutants_mod  # noqa: E402
+from arroyo_tpu.analysis.model import replay as replay_mod  # noqa: E402
+from arroyo_tpu.analysis.model.extract import (  # noqa: E402
+    check_bijection,
+    job_state_machine,
+    load_project,
+)
+from arroyo_tpu.analysis.model.spec import (  # noqa: E402
+    FAULT_KINDS,
+    HANDLER_BINDINGS,
+    Model,
+    ModelConfig,
+    USED_EFFECTS,
+    VIOLATIONS,
+)
+
+SMOKE = ModelConfig(workers=2, epochs=2, inflight=2, faults=1, restarts=1,
+                    rescales=0,
+                    fault_kinds=("fault.kill", "fault.cas_race"))
+FULL = ModelConfig(workers=2, epochs=3, inflight=2, faults=1, restarts=2,
+                   rescales=1, fault_kinds=FAULT_KINDS)
+
+# SARIF rule metadata for the violation catalog (reporters.sarif_document)
+_VIOLATION_RULES = [
+    {"id": getattr(VIOLATIONS, n), "name": getattr(VIOLATIONS, n),
+     "shortDescription": {"text": getattr(VIOLATIONS, n)}}
+    for n in dir(VIOLATIONS) if not n.startswith("_")
+]
+
+
+def _violation_findings(traces):
+    from arroyo_tpu.analysis.core import Finding
+
+    out = []
+    for tr in traces:
+        kind = tr.violation.split(":", 1)[0]
+        cited = tr.handlers_cited()
+        anchor = None
+        for h in cited:
+            if h in HANDLER_BINDINGS:
+                anchor = HANDLER_BINDINGS[h]
+                break
+        path = f"arroyo_tpu/{anchor[0]}" if anchor else "arroyo_tpu"
+        out.append(Finding(
+            rule=kind, path=path, line=1, col=0,
+            message=(
+                f"model-check violation: {tr.violation} "
+                f"({len(tr.events)} events; handlers: {', '.join(cited)})"
+            ),
+        ))
+    return out
+
+
+def _write_sarif(path: str, traces) -> None:
+    from arroyo_tpu.analysis.reporters import sarif_document
+
+    doc = sarif_document(
+        _violation_findings(traces), tool_name="arroyo-model-check",
+        extra_rules=_VIOLATION_RULES,
+    )
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"sarif report written to {path}")
+
+
+def _dump_trace(trace_dir: str, name: str, trace) -> str:
+    os.makedirs(trace_dir, exist_ok=True)
+    payload = replay_mod.counterexample_payload(trace)
+    path = os.path.join(trace_dir, f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def run_bijection(root: str) -> list:
+    project = load_project(root)
+    return check_bijection(project, HANDLER_BINDINGS, USED_EFFECTS)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="model_check.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--root", default=REPO_ROOT)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--inflight", type=int, default=None)
+    ap.add_argument("--faults", type=int, default=None,
+                    help="total fault-event budget")
+    ap.add_argument("--restarts", type=int, default=None)
+    ap.add_argument("--rescales", type=int, default=None)
+    ap.add_argument("--budget", type=int, default=4_000_000,
+                    help="max states; truncation fails an exhaustive run")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 configuration (small, fast)")
+    ap.add_argument("--no-por", action="store_true",
+                    help="disable partial-order reduction")
+    ap.add_argument("--mutant", default=None,
+                    help="run one named mutant (expects a counterexample)")
+    ap.add_argument("--corpus", action="store_true",
+                    help="run the whole mutant regression corpus")
+    ap.add_argument("--list-mutants", action="store_true")
+    ap.add_argument("--bijection-only", action="store_true")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write counterexample traces + chaos plans here")
+    ap.add_argument("--sarif", default=None,
+                    help="write violations as SARIF to this file")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON result summary to this file")
+    args = ap.parse_args(argv)
+
+    if args.list_mutants:
+        for m in mutants_mod.MUTANTS.values():
+            tag = " [historical PR 2 bug]" if m.historical else ""
+            print(f"{m.name}{tag}\n    expects: {m.expect_violation}")
+            print(f"    {m.description}\n")
+        return 0
+
+    members, terminals, table = job_state_machine(load_project(args.root))
+
+    # the bijection gate always runs first: a drifted model checks nothing
+    problems = run_bijection(args.root)
+    for p in problems:
+        print(f"BIJECTION: {p}")
+    if problems:
+        print(f"model<->code bijection: {len(problems)} problem(s)")
+        return 1
+    print("model<->code bijection: clean "
+          f"({len(HANDLER_BINDINGS)} handler bindings)")
+    if args.bijection_only:
+        return 0
+
+    por = not args.no_por
+    summary = {"bijection": "clean", "runs": []}
+    rc = 0
+
+    def run_one(cfg: ModelConfig, name: str, expect: str = ""):
+        nonlocal rc
+        t0 = time.time()
+        res = explore_mod.explore(
+            Model(cfg, table, terminals), budget=args.budget, por=por,
+            first_violation=bool(expect),
+        )
+        dt = time.time() - t0
+        entry = {
+            "name": name, "config": cfg._asdict(), "states": res.states,
+            "transitions": res.transitions, "exhaustive": res.exhaustive,
+            "terminal_states": res.terminal_states, "seconds": round(dt, 2),
+            "violations": [t.violation for t in res.violations],
+        }
+        summary["runs"].append(entry)
+        if expect:
+            hit = [t for t in res.violations
+                   if t.violation.split(":", 1)[0] == expect]
+            if not hit:
+                print(f"{name}: MUTANT NOT CAUGHT (expected {expect}, "
+                      f"got {[t.violation for t in res.violations]})")
+                rc = rc or 1
+                return
+            tr = hit[0]
+            got = replay_mod.replay_trace(tr, table, terminals)
+            replay_ok = got.split(":", 1)[0] == expect
+            plan = replay_mod.trace_to_fault_plan(tr)
+            entry["replay"] = "ok" if replay_ok else f"diverged: {got}"
+            entry["plan_seed"] = plan.seed
+            entry["plan_faults"] = len(plan.specs)
+            if not replay_ok:
+                print(f"{name}: counterexample did not replay ({got})")
+                rc = rc or 1
+            where = ""
+            if args.trace_dir:
+                where = " -> " + _dump_trace(args.trace_dir, name, tr)
+            print(f"{name}: caught {tr.violation.split(':', 1)[0]} in "
+                  f"{len(tr.events)} events (states={res.states}, "
+                  f"replay={'ok' if replay_ok else 'DIVERGED'}, "
+                  f"plan seed={plan.seed}){where}")
+            return
+        status = "exhaustive" if res.exhaustive else "TRUNCATED"
+        print(f"{name}: {res.states} states, {res.transitions} transitions, "
+              f"{res.terminal_states} terminal, {status}, {dt:.1f}s")
+        if res.violations:
+            rc = 1
+            for t in res.violations:
+                print(f"  VIOLATION: {t.violation}")
+                for ev in t.events:
+                    print(f"    {ev[0]}{tuple(ev[1])}")
+                if args.trace_dir:
+                    _dump_trace(
+                        args.trace_dir,
+                        f"{name}-{t.violation.split(':', 1)[0]}", t,
+                    )
+        elif not res.exhaustive:
+            print(f"  state budget {args.budget} exceeded — raise --budget "
+                  "or shrink the configuration")
+            rc = 2
+        if args.sarif and res.violations:
+            _write_sarif(args.sarif, res.violations)
+
+    if args.mutant or args.corpus:
+        names = ([args.mutant] if args.mutant
+                 else list(mutants_mod.MUTANTS))
+        for name in names:
+            m = mutants_mod.get_mutant(name)
+            run_one(m.config, name, expect=m.expect_violation)
+        if rc == 0:
+            n_hist = len(mutants_mod.historical_mutants())
+            print(f"corpus: all {len(names)} mutant(s) caught "
+                  f"({n_hist} historical PR 2 bugs included)")
+    else:
+        cfg = SMOKE if args.smoke else FULL
+        overrides = {
+            k: getattr(args, k)
+            for k in ("workers", "epochs", "inflight", "faults",
+                      "restarts", "rescales")
+            if getattr(args, k) is not None
+        }
+        if overrides:
+            cfg = cfg._replace(**overrides)
+        run_one(cfg, "smoke" if args.smoke else "full")
+
+    if args.out:
+        parent = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(parent, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"summary written to {args.out}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,413 @@
+"""Two jobs x shared multiplexed workers: per-job recovery independence.
+
+The multiplexed worker (ISSUE 10) introduces a failure mode the
+single-job model (spec.py) cannot see: ONE worker process hosts subtasks
+of MANY jobs, so a worker death fails them all at once (shared fate).
+The safety property the control plane owes tenants is **per-job recovery
+independence**:
+
+  * job A's kill/recovery never moves job B's state machine illegally
+    (every move of EITHER job still goes through the extracted
+    TRANSITIONS table — spec's conformance check, lifted to the product);
+  * worker-side namespaces are job-scoped — a barrier fanned out by job
+    A lands only in job A's namespace on the shared worker (V_LEAK), and
+    job A's per-job teardown (StopJob) clears only job A's namespace
+    (V_TEARDOWN);
+  * a shared-worker death is observed and recovered by EACH hosted job
+    independently; one job's recovery heals the pool (the scheduler's
+    ensure-pool pass) without erasing the other's obligation to recover.
+
+Model shape: two reduced job machines (CREATED -> SCHEDULING -> RUNNING
+-> {RECOVERING -> SCHEDULING | STOPPING -> STOPPED | FAILED}, `epochs`
+cadence barriers each) over `workers` SHARED worker slots. Each worker
+slot holds one namespace per job (highest barrier epoch captured + live
+flag). The one fault is the shared-worker kill: the slot dies, BOTH
+jobs' namespaces on it vanish, and BOTH jobs' controllers are owed a
+death observation (`pending_death`).
+
+Mutants:
+
+  * `leak_barrier_across_jobs` — the bug the job-scoped data-plane route
+    namespaces prevent: a barrier fanned out by job A also lands in job
+    B's namespace on the shared worker (an un-namespaced quad route
+    match). Job B's namespace then carries an epoch B's machine never
+    issued, flagged the moment B's capture bookkeeping reads it.
+  * `teardown_clears_both_jobs` — job A's recovery teardown clears job
+    B's live namespace too (StopJob scoping broken). The invariant
+    observes the damage from B's side: RUNNING with no death owed, a
+    live worker slot, and a destroyed namespace.
+
+Explored exhaustively by `check_multitenant`; wired into
+tools/model_check.py (--multi, corpus) and tests/test_model_check.py.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from .extract import job_state_machine_from_root
+
+
+class MTConfig(NamedTuple):
+    workers: int = 2          # shared pool slots
+    epochs: int = 2           # cadence barriers per job per incarnation
+    kills: int = 1            # shared-worker kill budget
+    restarts: int = 2         # per-job recovery budget
+    mutant: str = ""          # "" | a MT_MUTANTS key
+
+
+class JobNS(NamedTuple):
+    """One job's namespace on one shared worker slot."""
+
+    seen: int = 0             # highest barrier epoch captured
+    live: bool = False        # namespace built (job scheduled here)
+
+
+class JobM(NamedTuple):
+    """One job's controller-side machine (reduced)."""
+
+    js: str = "CREATED"
+    epoch: int = 0            # last ISSUED barrier epoch
+    budget: int = 0
+    reports: Tuple = ()       # ((epoch, widx), ...) credited completions
+    restarts: int = 0
+    stop: bool = False
+    pending_death: bool = False  # a hosting worker died; recovery owed
+
+
+class MTSys(NamedTuple):
+    jobs: Tuple[JobM, ...]
+    # ns[j][w]: job j's namespace on worker slot w
+    ns: Tuple[Tuple[JobNS, ...], ...]
+    alive: Tuple[bool, ...]   # shared worker slot liveness
+    kills: int = 0
+
+
+class MTStep(NamedTuple):
+    label: str
+    arg: Tuple
+    nxt: Optional[MTSys]
+    violation: str = ""
+
+
+class MTTrace(NamedTuple):
+    violation: str
+    events: List[Tuple[str, Tuple]]
+    config: dict
+
+
+class MTResult(NamedTuple):
+    states: int
+    transitions: int
+    violations: List[MTTrace]
+    exhaustive: bool
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+
+V_ILLEGAL = "illegal-jobstate-move"
+V_LEAK = "cross-job-barrier-leak"
+V_TEARDOWN = "cross-job-teardown"
+V_DEADLOCK = "deadlock"
+
+
+def _initial(cfg: MTConfig) -> MTSys:
+    return MTSys(
+        jobs=tuple(JobM(budget=cfg.epochs) for _ in range(2)),
+        ns=tuple(
+            tuple(JobNS() for _ in range(cfg.workers)) for _ in range(2)
+        ),
+        alive=tuple(True for _ in range(cfg.workers)),
+    )
+
+
+class MTModel:
+    """Enabled-transition enumerator over the 2-job product. JobState
+    moves go through the SAME extracted table as the single-job model."""
+
+    def __init__(self, cfg: MTConfig,
+                 transitions: Optional[Dict[str, Set[str]]] = None,
+                 terminals: Optional[Set[str]] = None):
+        if transitions is None or terminals is None:
+            import os
+
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+            _members, ext_terminals, ext_transitions = (
+                job_state_machine_from_root(root)
+            )
+            transitions = (ext_transitions if transitions is None
+                           else transitions)
+            terminals = ext_terminals if terminals is None else terminals
+        self.transitions = transitions
+        self.terminals = terminals
+        self.cfg = cfg
+
+    # -- helpers -------------------------------------------------------------
+
+    def _move(self, s: MTSys, j: int, label: str, nxt_js: str,
+              **updates) -> MTStep:
+        cur = s.jobs[j].js
+        if nxt_js not in self.transitions.get(cur, set()):
+            return MTStep(label, (j, cur, nxt_js), None,
+                          f"{V_ILLEGAL}: job {j} {cur} -> {nxt_js}")
+        jobs = list(s.jobs)
+        jobs[j] = jobs[j]._replace(js=nxt_js, **updates)
+        return MTStep(label, (j, cur, nxt_js),
+                      s._replace(jobs=tuple(jobs)))
+
+    @staticmethod
+    def _set_ns(s: MTSys, j: int, w: int, ns: JobNS) -> MTSys:
+        rows = [list(r) for r in s.ns]
+        rows[j][w] = ns
+        return s._replace(ns=tuple(tuple(r) for r in rows))
+
+    def done(self, s: MTSys) -> bool:
+        return all(jm.js in self.terminals for jm in s.jobs)
+
+    # -- enumeration ---------------------------------------------------------
+
+    def enabled(self, s: MTSys) -> List[MTStep]:
+        cfg = self.cfg
+        out: List[MTStep] = []
+        for j, jm in enumerate(s.jobs):
+            if jm.js in self.terminals:
+                continue
+            if jm.js == "CREATED":
+                out.append(self._move(s, j, "mt.schedule_init",
+                                      "SCHEDULING"))
+            elif jm.js == "SCHEDULING":
+                out.append(self._schedule(s, j))
+            elif jm.js == "RECOVERING":
+                out.append(self._recover(s, j))
+            elif jm.js == "RUNNING":
+                if jm.pending_death:
+                    # shared fate: each hosted job observes the shared
+                    # worker's death via ITS heartbeat view and recovers
+                    # independently of its co-tenant
+                    out.append(self._move(
+                        s, j, "mt.detect_death", "RECOVERING",
+                    ))
+                if jm.budget > 0 and not jm.stop and not jm.pending_death:
+                    out.append(self._barrier(s, j))
+                out.extend(self._capture_steps(s, j))
+                if not jm.stop and not jm.pending_death:
+                    jobs = list(s.jobs)
+                    jobs[j] = jm._replace(stop=True)
+                    out.append(MTStep("mt.stop_request", (j,),
+                                      s._replace(jobs=tuple(jobs))))
+                if jm.stop and not jm.pending_death:
+                    out.append(self._finish(s, j))
+        if s.kills < cfg.kills:
+            for w in range(cfg.workers):
+                if s.alive[w]:
+                    alive = list(s.alive)
+                    alive[w] = False
+                    # the worker process dies: every job's namespace on
+                    # it vanishes at once, and every RUNNING job is owed
+                    # a death observation
+                    jobs = tuple(
+                        jm._replace(pending_death=True)
+                        if jm.js in ("RUNNING", "SCHEDULING") else jm
+                        for jm in s.jobs
+                    )
+                    nxt = s._replace(alive=tuple(alive), jobs=jobs,
+                                     kills=s.kills + 1)
+                    for j in range(2):
+                        nxt = self._set_ns(nxt, j, w, JobNS())
+                    out.append(MTStep("mt.kill_worker", (w,), nxt))
+        return out
+
+    def _schedule(self, s: MTSys, j: int) -> MTStep:
+        # the scheduler's ensure-pool pass replaces dead slots for
+        # EVERYONE, then ONLY job j's namespaces are (re)built — the
+        # co-tenant's pending death observation survives the heal
+        nxt = s._replace(alive=tuple(True for _ in s.alive))
+        for w in range(self.cfg.workers):
+            nxt = self._set_ns(nxt, j, w, JobNS(live=True))
+        return self._move(nxt, j, "mt.schedule", "RUNNING",
+                          epoch=0, budget=self.cfg.epochs, reports=(),
+                          pending_death=False)
+
+    def _recover(self, s: MTSys, j: int) -> MTStep:
+        jm = s.jobs[j]
+        if jm.restarts >= self.cfg.restarts:
+            return self._move(s, j, "mt.fail", "FAILED")
+        # per-job teardown: ONLY job j's namespaces are cleared; the
+        # teardown mutant wipes the co-tenant's too (StopJob unscoped)
+        nxt = s
+        for w in range(self.cfg.workers):
+            nxt = self._set_ns(nxt, j, w, JobNS())
+            if self.cfg.mutant == "teardown_clears_both_jobs":
+                nxt = self._set_ns(nxt, 1 - j, w, JobNS())
+        return self._move(nxt, j, "mt.recover", "SCHEDULING",
+                          restarts=jm.restarts + 1, reports=())
+
+    def _barrier(self, s: MTSys, j: int) -> MTStep:
+        jm = s.jobs[j]
+        epoch = jm.epoch + 1
+        jobs = list(s.jobs)
+        jobs[j] = jm._replace(epoch=epoch, budget=jm.budget - 1)
+        nxt = s._replace(jobs=tuple(jobs))
+        if self.cfg.mutant == "leak_barrier_across_jobs":
+            # the bug the job-scoped route namespaces prevent: the
+            # barrier frame matches the OTHER job's identical quad on
+            # the shared worker and lands in its namespace too
+            other = 1 - j
+            for w in range(self.cfg.workers):
+                if nxt.alive[w] and nxt.ns[other][w].live:
+                    leaked = nxt.ns[other][w]
+                    if epoch > leaked.seen:
+                        nxt = self._set_ns(
+                            nxt, other, w, leaked._replace(seen=epoch)
+                        )
+        return MTStep("mt.barrier", (j, epoch), nxt)
+
+    def _capture_steps(self, s: MTSys, j: int) -> List[MTStep]:
+        out: List[MTStep] = []
+        jm = s.jobs[j]
+        for w in range(self.cfg.workers):
+            nsw = s.ns[j][w]
+            if not s.alive[w] or not nsw.live:
+                continue
+            if nsw.seen > jm.epoch:
+                # the namespace carries an epoch this job's machine
+                # NEVER issued — a barrier leaked across job namespaces
+                out.append(MTStep(
+                    "mt.capture", (j, w, nsw.seen), None,
+                    f"{V_LEAK}: job {j} namespace on worker {w} holds "
+                    f"epoch {nsw.seen} but the job only issued {jm.epoch}",
+                ))
+                continue
+            if nsw.seen < jm.epoch:
+                e = nsw.seen + 1
+                nxt = self._set_ns(s, j, w, nsw._replace(seen=e))
+                if (e, w) not in jm.reports:
+                    jobs = list(nxt.jobs)
+                    jobs[j] = jobs[j]._replace(
+                        reports=tuple(sorted(jm.reports + ((e, w),)))
+                    )
+                    nxt = nxt._replace(jobs=tuple(jobs))
+                out.append(MTStep("mt.capture", (j, w, e), nxt))
+        return out
+
+    def _finish(self, s: MTSys, j: int) -> MTStep:
+        # reduced stop path: RUNNING -> STOPPING -> STOPPED must BOTH be
+        # legal per the extracted table
+        st = self._move(s, j, "mt.stop_begin", "STOPPING")
+        if st.nxt is None:
+            return st
+        st2 = self._move(st.nxt, j, "mt.stop_finish", "STOPPED",
+                         stop=False)
+        return MTStep("mt.stop", (j,), st2.nxt, st2.violation)
+
+    def check_state(self, s: MTSys,
+                    enabled: List[MTStep]) -> Optional[str]:
+        # per-job recovery independence: a RUNNING job owed no death
+        # observation must still have every namespace it was scheduled
+        # with — a destroyed namespace on a LIVE slot means someone
+        # else's teardown reached across job boundaries
+        for j, jm in enumerate(s.jobs):
+            if jm.js != "RUNNING" or jm.pending_death:
+                continue
+            for w in range(len(s.alive)):
+                if s.alive[w] and not s.ns[j][w].live:
+                    return (f"{V_TEARDOWN}: job {j} lost its namespace "
+                            f"on live worker {w} without a death to "
+                            f"observe (cross-job teardown)")
+        if not self.done(s) and not enabled:
+            return (f"{V_DEADLOCK}: jobs "
+                    f"{tuple(jm.js for jm in s.jobs)}")
+        return None
+
+
+def check_multitenant(cfg: MTConfig, budget: int = 500_000,
+                      transitions=None, terminals=None) -> MTResult:
+    """BFS the 2-job product; violations carry replayable event paths."""
+    model = MTModel(cfg, transitions=transitions, terminals=terminals)
+    init = _initial(cfg)
+    parent: Dict[MTSys, Optional[Tuple[MTSys, Tuple[str, Tuple]]]] = {
+        init: None
+    }
+    frontier = deque([init])
+    violations: List[MTTrace] = []
+    seen_kinds: Set[str] = set()
+    n_trans = 0
+    exhaustive = True
+
+    def record(state: MTSys, ev, violation: str):
+        kind = violation.split(":", 1)[0]
+        if kind in seen_kinds:
+            return
+        seen_kinds.add(kind)
+        events: List[Tuple[str, Tuple]] = [ev] if ev else []
+        cur = state
+        while parent[cur] is not None:
+            prev, e = parent[cur]
+            events.append(e)
+            cur = prev
+        events.reverse()
+        violations.append(MTTrace(violation, events, cfg._asdict()))
+
+    while frontier:
+        if len(parent) > budget:
+            exhaustive = False
+            break
+        state = frontier.popleft()
+        steps = model.enabled(state)
+        inv = model.check_state(state, steps)
+        if inv is not None:
+            record(state, None, inv)
+            continue
+        if model.done(state):
+            continue
+        for st in steps:
+            n_trans += 1
+            if st.violation:
+                record(state, (st.label, st.arg), st.violation)
+                continue
+            if st.nxt is None or st.nxt in parent:
+                continue
+            parent[st.nxt] = (state, (st.label, st.arg))
+            frontier.append(st.nxt)
+
+    return MTResult(states=len(parent), transitions=n_trans,
+                    violations=violations, exhaustive=exhaustive)
+
+
+class MTMutant(NamedTuple):
+    name: str
+    description: str
+    expect_violation: str
+    config: MTConfig
+
+
+MT_MUTANTS: Dict[str, MTMutant] = {
+    m.name: m
+    for m in [
+        MTMutant(
+            name="leak_barrier_across_jobs",
+            description=(
+                "a barrier fanned out by job A is also delivered into "
+                "job B's namespace on the shared worker (the bug the "
+                "job-scoped data-plane route namespaces prevent): job "
+                "B's namespace carries an epoch B's machine never issued"
+            ),
+            expect_violation=V_LEAK,
+            config=MTConfig(mutant="leak_barrier_across_jobs"),
+        ),
+        MTMutant(
+            name="teardown_clears_both_jobs",
+            description=(
+                "job A's recovery teardown clears job B's live "
+                "namespace on the shared worker (per-job StopJob "
+                "scoping broken): co-resident jobs are not independent"
+            ),
+            expect_violation=V_TEARDOWN,
+            config=MTConfig(mutant="teardown_clears_both_jobs"),
+        ),
+    ]
+}

@@ -94,6 +94,12 @@ MIGRATIONS = [
         "CREATE INDEX IF NOT EXISTS idx_jobs_pipeline "
         "ON jobs(pipeline_id)",
     ]),
+    # multi-tenant control plane: pipelines belong to a tenant whose
+    # admission quota + fair share govern slot scheduling
+    (3, [
+        "ALTER TABLE pipelines ADD COLUMN tenant TEXT "
+        "NOT NULL DEFAULT 'default'",
+    ]),
 ]
 
 
@@ -270,13 +276,15 @@ class ApiDb:
     # -- pipelines ----------------------------------------------------------
 
     def create_pipeline(self, name: str, query: str, parallelism: int,
-                        graph_json: Optional[dict] = None) -> dict:
+                        graph_json: Optional[dict] = None,
+                        tenant: str = "default") -> dict:
         pid = "pl_" + uuid.uuid4().hex[:12]
         self.conn.execute(
             "INSERT INTO pipelines (id, name, query, parallelism, state, "
-            "graph_json, created_at) VALUES (?,?,?,?,?,?,?)",
+            "graph_json, created_at, tenant) VALUES (?,?,?,?,?,?,?,?)",
             (pid, name, query, parallelism, "Created",
-             json.dumps(graph_json) if graph_json else None, time.time()),
+             json.dumps(graph_json) if graph_json else None, time.time(),
+             tenant or "default"),
         )
         self._commit()
         return self.get_pipeline(pid)
@@ -316,6 +324,7 @@ class ApiDb:
 
     @staticmethod
     def _pipeline(r) -> dict:
+        keys = r.keys() if hasattr(r, "keys") else []
         return {
             "id": r["id"],
             "name": r["name"],
@@ -323,6 +332,7 @@ class ApiDb:
             "parallelism": r["parallelism"],
             "state": r["state"],
             "created_at": r["created_at"],
+            "tenant": r["tenant"] if "tenant" in keys else "default",
         }
 
     # -- jobs ---------------------------------------------------------------

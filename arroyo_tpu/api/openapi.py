@@ -142,6 +142,9 @@ def _schemas() -> Dict[str, Any]:
         "PipelinePost": _obj(
             {"name": _str(), "query": _str(),
              "parallelism": _int(),
+             # multi-tenancy: admission quotas + fair slot scheduling
+             # apply per tenant (default "default")
+             "tenant": _str(),
              "checkpointIntervalMicros": _int(),
              "udfs": {"type": "array", "items": _str()},
              "previewSink": {"type": "boolean"}},

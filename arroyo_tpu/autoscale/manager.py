@@ -130,6 +130,18 @@ class Autoscaler:
             self._record(job, st, action, changed, decision.reasons,
                          signals)
             return
+        # multi-tenant arbitration (ROADMAP item 3): jobs sharing a
+        # saturated worker pool must not all win their DS2 scale-ups —
+        # clamp this decision against the pool's free slots so tenants
+        # degrade gracefully instead of thrashing rescale loops
+        changed, clamp_note = self._arbitrate(job, changed)
+        if not changed:
+            reasons = dict(decision.reasons)
+            reasons["_pool"] = clamp_note or "clamped to zero headroom"
+            self._record(job, st, "arbitrated", {}, reasons, signals)
+            return
+        if clamp_note:
+            decision.reasons["_pool"] = clamp_note
         # actuate: mint the rescale trace with the decision as its root
         # span; controller._rescale (stop-checkpoint -> override ->
         # restore) and the subsequent schedule parent under it, so the
@@ -145,6 +157,37 @@ class Autoscaler:
         logger.info("autoscale: job %s rescaling %s (%s)", job.job_id,
                     changed, decision.reasons)
         job.rescale_requested = dict(changed)
+
+    def _arbitrate(self, job, changed: Dict[int, int]):
+        """Clamp a rescale decision against the shared pool's free slots
+        (Flink slot-sharing accounting: a job's slot need is its max
+        operator parallelism). Jobs keep what they hold; a scale-up may
+        grow a job's max parallelism by at most the pool's free slots.
+        Returns (possibly-clamped targets, note-or-None); empty targets
+        mean the decision was arbitrated down to a no-op."""
+        ctrl = self.controller
+        admission = getattr(ctrl, "admission", None)
+        if (admission is None or not ctrl._pool_mode()
+                or not config().admission.enabled
+                or admission.capacity() <= 0):
+            return changed, None
+        current = {n.node_id: n.parallelism
+                   for n in job.graph.nodes.values()}
+        cur_slots = max(current.values(), default=1)
+        new_slots = max(
+            [changed.get(nid, p) for nid, p in current.items()], default=1
+        )
+        allowed = cur_slots + max(admission.free_slots(), 0)
+        if new_slots <= allowed:
+            return changed, None
+        clamped = {
+            nid: min(t, allowed)
+            for nid, t in changed.items()
+            if min(t, allowed) != current.get(nid)
+        }
+        note = (f"scale-up clamped to {allowed} slots "
+                f"({admission.free_slots()} free in the shared pool)")
+        return clamped, note
 
     async def _job_snapshot(self, job) -> Dict[str, Dict[tuple, object]]:
         """Union of the workers' registry snapshots; falls back to this

@@ -311,6 +311,53 @@ class AutoscaleConfig:
 
 
 @dataclasses.dataclass
+class ClusterConfig:
+    """Multi-tenant control plane (ROADMAP item 3): a shared worker pool
+    hosting subtasks of MANY jobs per worker process — one event loop and
+    one JAX runtime multiplexed across co-resident jobs — instead of
+    fork-per-job workers. Process count stays O(pool), not O(jobs x
+    workers)."""
+
+    # shared worker-pool size for the embedded and process schedulers: a
+    # job is placed onto (up to) its requested worker count of these
+    # long-lived workers instead of forking its own. The pool grows on
+    # demand to the largest single-job worker request, never shrinks
+    # below this floor while jobs run.
+    worker_pool_size: int = 2
+    # worker multiplexing: 'auto' shares pool workers across jobs for the
+    # embedded and process schedulers when the controller runs the job
+    # control loop and no multi-process device mesh is configured
+    # (tpu.mesh_processes < 2 — mesh ranks are per-job env assignments
+    # that cannot be shared); 'on' forces it for those schedulers; 'off'
+    # restores fork-per-job workers everywhere.
+    multiplexing: str = "auto"
+    # seconds a terminal job's metric series stay scrapeable before the
+    # cardinality GC drops them (UIs read a just-finished job's metric
+    # groups; a 1000-job churn run must not grow /metrics forever).
+    # 0 drops at the terminal transition.
+    metrics_ttl: float = 30.0
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Admission control + fair slot scheduling across tenants sharing
+    one controller and worker pool (Flink slot-sharing model: a job needs
+    max-operator-parallelism slots, one subtask of each operator shares a
+    slot)."""
+
+    # master switch: off = every job schedules immediately (legacy)
+    enabled: bool = True
+    # per-tenant ceiling on concurrently held slots; 0 = unlimited. A
+    # tenant at quota queues until one of its jobs releases slots.
+    tenant_quota_slots: int = 0
+    # max jobs waiting in the admission queue; submission past it fails
+    # fast instead of queueing unboundedly
+    max_queue: int = 1024
+    # seconds a queued job waits for admission before failing
+    queue_timeout: float = 300.0
+
+
+@dataclasses.dataclass
 class ControllerConfig:
     rpc_port: int = 9190  # controller gRPC port workers register against
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
@@ -397,10 +444,11 @@ class Config:
     queues, checkpointing), state (incremental snapshots, off-barrier
     flushes, spill tier), autoscale (closed-loop parallelism control),
     tls, chaos (fault injection), obs (flight recorder), tpu (device
-    kernels + mesh), controller, worker, api,
-    admin, database, logging. `tools/lint.py --config-table` prints the
-    full resolved key/default table; arroyolint CFG001 rejects reads of
-    undeclared keys."""
+    kernels + mesh), controller, cluster (shared worker pool /
+    multiplexing), admission (tenant quotas + fair slot scheduling),
+    worker, api, admin, database, logging. `tools/lint.py
+    --config-table` prints the full resolved key/default table;
+    arroyolint CFG001 rejects reads of undeclared keys."""
 
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
     state: StateConfig = dataclasses.field(default_factory=StateConfig)
@@ -410,6 +458,8 @@ class Config:
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
+    cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)
     api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
     admin: AdminConfig = dataclasses.field(default_factory=AdminConfig)

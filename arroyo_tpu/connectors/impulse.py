@@ -5,7 +5,12 @@ Capability parity with the reference's impulse connector
 rows {counter, subtask_index} at `event_rate` events/sec/subtask, optionally
 bounded by `message_count`; counter offset persists in state so restores
 resume exactly. Deterministic event-time mode (`start_time` + i/rate) for
-reproducible tests.
+reproducible tests. `realtime` paces generation by wall clock and stamps
+wall-clock event time; `replay = 'true'` (with `realtime`) keeps the wall
+pacing but stamps the synthetic `start_time + i/rate` timestamps instead,
+so a slow run's output is byte-identical to a fast one (the fleet harness
+and multiplexed chaos smokes park/kill jobs mid-run and still demand
+byte-identical output).
 """
 
 from __future__ import annotations
@@ -33,12 +38,14 @@ class ImpulseSource(SourceOperator):
         message_count: Optional[int] = None,
         start_time: Optional[int] = None,
         realtime: bool = False,
+        replay: bool = False,
     ):
         super().__init__("impulse")
         self.event_rate = event_rate
         self.message_count = message_count
         self.start_time = start_time
         self.realtime = realtime
+        self.replay = replay
         self.out_schema = IMPULSE_SCHEMA
         self.counter = 0
 
@@ -71,9 +78,25 @@ class ImpulseSource(SourceOperator):
             if self.realtime:
                 target = wall_start + self.counter * period
                 delay = target - time.monotonic()
-                if delay > 0:
-                    await asyncio.sleep(delay)
-                ts = now_nanos()
+                while delay > 0:
+                    # sleep in bounded slices: a low-rate source (parked
+                    # fleet jobs pace one event per tens of seconds) must
+                    # keep answering control — a stop or checkpoint
+                    # barrier cannot wait out a full inter-event gap
+                    await asyncio.sleep(min(delay, 0.5))
+                    finish = await ctx.check_control(collector)
+                    if finish is not None:
+                        return finish
+                    delay = target - time.monotonic()
+                # replay mode: wall-paced arrival, synthetic event time
+                # (byte-identical output whatever the wall clock did);
+                # plain realtime keeps stamping wall-clock time
+                if self.replay:
+                    ts = start + int(
+                        round(self.counter * (1e9 / self.event_rate))
+                    )
+                else:
+                    ts = now_nanos()
             else:
                 ts = start + int(round(self.counter * (1e9 / self.event_rate)))
             ctx.buffer_row(
@@ -98,12 +121,14 @@ class ImpulseConnector(Connector):
         "event_rate": {"type": "number", "required": True},
         "message_count": {"type": "integer"},
         "realtime": {"type": "boolean"},
+        "replay": {"type": "boolean"},
     }
 
     def validate_options(self, options, schema):
         out = {
             "event_rate": float(options.get("event_rate", 10_000)),
             "realtime": str(options.get("realtime", "false")).lower() == "true",
+            "replay": str(options.get("replay", "false")).lower() == "true",
         }
         if "message_count" in options:
             out["message_count"] = int(options["message_count"])
@@ -120,4 +145,5 @@ class ImpulseConnector(Connector):
             message_count=config.get("message_count"),
             start_time=config.get("start_time"),
             realtime=config.get("realtime", False),
+            replay=config.get("replay", False),
         )

@@ -114,6 +114,12 @@ class SingleFileSink(Operator):
         for rec in self.serializer.serialize(batch):
             self._fh.write(rec + b"\n")
             self.offset += len(rec) + 1
+        # flush per batch: a multiplexed per-job teardown cancels this
+        # subtask at an await point, and a GC-finalized file object later
+        # FLUSHES whatever the buffer still holds — interleaving stale
+        # bytes into the restarted incarnation's file. An empty buffer at
+        # every await point makes the finalizer a no-op.
+        self._fh.flush()
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         self._fh.flush()

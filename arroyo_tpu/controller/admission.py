@@ -1,0 +1,191 @@
+"""Admission control + fair slot scheduling over the shared worker pool.
+
+The multi-tenant control plane (ROADMAP item 3) schedules MANY jobs onto
+one pooled worker set, so slots become a contended resource. This module
+implements the Flink slot-sharing accounting (Carbone et al., 2015): one
+slot hosts one subtask of EACH operator of a job, so a job's slot
+requirement is its maximum operator parallelism, not its subtask count.
+On top of that:
+
+  * admission — a job enters SCHEDULING only once its slots fit the
+    pool's free capacity (`admission.enabled`); a submission burst queues
+    here instead of oversubscribing every worker at once;
+  * per-tenant quotas — `admission.tenant_quota_slots` caps the slots
+    one tenant may hold; a tenant at quota queues behind its own jobs
+    while other tenants keep being admitted;
+  * fair-share ordering — queued jobs are granted in ascending
+    (tenant-held-slots, arrival) order, so a tenant flooding the queue
+    cannot starve a light tenant (weighted fair queueing over tenants
+    with equal weights, DRF-degenerate single-resource case);
+  * progress guarantees — the first job always bootstraps an empty pool
+    (capacity is unknown before workers register), and a single job
+    larger than total capacity is admitted alone rather than wedged.
+
+The autoscaler's arbitration (autoscale/manager.py) reads `free_slots`
+to clamp scale-up decisions of jobs competing for the same saturated
+pool, so DS2 targets degrade gracefully instead of thrashing rescales.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Tuple
+
+from ..config import config
+from ..utils.logging import get_logger
+
+logger = get_logger("admission")
+
+
+class _Waiter:
+    __slots__ = ("seq", "job", "need", "fut", "deadline")
+
+    def __init__(self, seq: int, job, need: int, fut: asyncio.Future,
+                 deadline: float):
+        self.seq = seq
+        self.job = job
+        self.need = need
+        self.fut = fut
+        self.deadline = deadline
+
+
+class AdmissionController:
+    def __init__(self, controller):
+        self.controller = controller
+        # job_id -> (tenant, granted slots)
+        self.held: Dict[str, Tuple[str, int]] = {}
+        self.queue: List[_Waiter] = []
+        self._seq = 0
+
+    # -- accounting ----------------------------------------------------------
+
+    @staticmethod
+    def required_slots(job) -> int:
+        """Flink slot sharing: a slot hosts one subtask of each operator,
+        so the requirement is the job's max operator parallelism."""
+        return max(
+            (n.parallelism for n in job.graph.nodes.values()), default=1
+        )
+
+    def capacity(self) -> int:
+        """Total live pooled slots (dead workers don't count)."""
+        c = self.controller
+        return sum(
+            w.slots for w in c.workers.values()
+            if w.pooled and not c._worker_stale(w)
+        )
+
+    def held_slots(self) -> int:
+        return sum(s for (_t, s) in self.held.values())
+
+    def free_slots(self) -> int:
+        return self.capacity() - self.held_slots()
+
+    def tenant_held(self, tenant: str) -> int:
+        return sum(s for (t, s) in self.held.values() if t == tenant)
+
+    def _grantable(self, tenant: str, need: int) -> bool:
+        cap = self.capacity()
+        if not self.held:
+            # bootstrap: the pool may not be up yet (acquire precedes
+            # start_workers), and a lone oversized job must still run
+            return True
+        quota = int(config().admission.tenant_quota_slots or 0)
+        if quota and self.tenant_held(tenant) >= quota:
+            # soft quota: a tenant AT quota queues; a tenant under it may
+            # overshoot by at most one job (a job larger than the whole
+            # quota would otherwise wedge forever)
+            return False
+        return self.free_slots() >= min(need, cap)
+
+    def _grant(self, job, need: int):
+        cap = self.capacity()
+        self.held[job.job_id] = (job.tenant, min(need, cap) if cap else need)
+
+    # -- the fair-share queue ------------------------------------------------
+
+    async def acquire(self, job):
+        """Block until the job's slots are granted (fair-share order).
+        Idempotent across recovery reschedules: a job keeps its grant
+        (its requirement is re-read in case a rescale changed the
+        graph)."""
+        cfg = config().admission
+        if not cfg.enabled or not self.controller._pool_mode():
+            return
+        need = self.required_slots(job)
+        if job.job_id in self.held:
+            # recovery/rescale reschedule: refresh the size, keep the grant
+            self.held[job.job_id] = (job.tenant, need)
+            return
+        if self._grantable(job.tenant, need):
+            self._grant(job, need)
+            return
+        if len(self.queue) >= int(cfg.max_queue):
+            raise RuntimeError(
+                f"admission queue full ({len(self.queue)} jobs waiting)"
+            )
+        fut = asyncio.get_event_loop().create_future()
+        deadline = time.monotonic() + float(cfg.queue_timeout)
+        w = _Waiter(self._seq, job, need, fut, deadline)
+        self._seq += 1
+        self.queue.append(w)
+        self.controller.wheel.at(deadline, fut)
+        logger.info(
+            "job %s queued for admission (tenant=%s need=%d free=%d)",
+            job.job_id, job.tenant, need, self.free_slots(),
+        )
+        try:
+            granted = await fut
+        finally:
+            if w in self.queue:
+                self.queue.remove(w)
+        if not granted:
+            raise TimeoutError(
+                f"job {job.job_id} not admitted within "
+                f"{cfg.queue_timeout}s (tenant {job.tenant}, "
+                f"need {need}, free {self.free_slots()})"
+            )
+
+    def release(self, job):
+        """Return a terminal job's slots and admit queued jobs."""
+        if self.held.pop(job.job_id, None) is not None:
+            self.pump()
+
+    def pump(self):
+        """Grant queued jobs in fair-share order: ascending (tenant held
+        slots, arrival seq). Called on slot release and on worker
+        registration (fresh capacity)."""
+        while self.queue:
+            order = sorted(
+                self.queue,
+                key=lambda w: (self.tenant_held(w.job.tenant), w.seq),
+            )
+            progressed = False
+            for w in order:
+                if w.fut.done():
+                    self.queue.remove(w)
+                    progressed = True
+                    break
+                if self._grantable(w.job.tenant, w.need):
+                    self._grant(w.job, w.need)
+                    self.queue.remove(w)
+                    w.fut.set_result(True)
+                    progressed = True
+                    break
+            if not progressed:
+                return
+
+    def status(self) -> dict:
+        """Admin/debug surface: capacity, per-tenant usage, queue depth."""
+        tenants: Dict[str, int] = {}
+        for (t, s) in self.held.values():
+            tenants[t] = tenants.get(t, 0) + s
+        return {
+            "capacity": self.capacity(),
+            "held": self.held_slots(),
+            "free": self.free_slots(),
+            "jobs_admitted": len(self.held),
+            "queued": len(self.queue),
+            "tenants": tenants,
+        }

@@ -10,11 +10,23 @@ manifest assembly + publication through the generation protocol, phase-2
 commits — job_controller/controller.rs; failure handling: task errors and
 heartbeat timeouts escalate to Recovering, which tears the job down and
 reschedules from the latest durable checkpoint — states/recovering.rs).
+
+Multi-tenant control plane (ROADMAP item 3): the per-job drivers are
+EVENT-DRIVEN — every wait (cadence, report sets, task finishes, state
+watches) parks on the job's kick list and is woken by the RPC arrival
+that changes its predicate, with ONE coarse `TimerWheel` arming the
+deadline side (checkpoint cadence, heartbeat expiry horizons, epoch
+deadlines). Idle controller cost is therefore ~O(changed jobs), not
+O(jobs) x 50 Hz poll loops. Jobs schedule onto a SHARED pooled worker
+set (scheduler.multiplexing_active) through admission control + fair
+slot scheduling (controller/admission.py), and RPC dispatch is
+job-id-keyed (O(1) per event, not an O(jobs) ownership scan).
 """
 
 from __future__ import annotations
 
 import asyncio
+import heapq
 import json
 import time
 from typing import Dict, List, Optional
@@ -28,10 +40,69 @@ from ..types import now_nanos
 from ..utils.logging import get_logger
 from ..engine.rpc import RpcClient, RpcServer
 from ..operators.control import CheckpointReport
-from .scheduler import Scheduler, make_scheduler
+from .admission import AdmissionController
+from .scheduler import Scheduler, make_scheduler, multiplexing_active
 from .state_machine import JobState, check_transition
 
 logger = get_logger("controller")
+
+
+class TimerWheel:
+    """The controller's single coarse deadline scheduler: every parked
+    wait registers its absolute deadline here and ONE task sleeps until
+    the earliest, so a thousand parked jobs cost one pending timer
+    instead of a thousand 50 Hz poll loops. Deadlines are quantized up to
+    `granularity` so near-simultaneous deadlines coalesce into one
+    wakeup."""
+
+    def __init__(self, granularity: float = 0.05):
+        self.granularity = granularity
+        self._heap: list = []  # (deadline, seq, future)
+        self._seq = 0
+        self._dirty: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._dirty = asyncio.Event()
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+
+    def at(self, deadline: float, fut: asyncio.Future):
+        g = self.granularity
+        deadline = ((deadline // g) + 1) * g  # quantize up: coalesce
+        heapq.heappush(self._heap, (deadline, self._seq, fut))
+        self._seq += 1
+        if len(self._heap) > 4096:
+            # futures resolved by kicks before their deadline linger in
+            # the heap; sweep once it grows past any plausible live set
+            self._heap = [e for e in self._heap if not e[2].done()]
+            heapq.heapify(self._heap)
+        if self._dirty is not None:
+            self._dirty.set()
+
+    async def _loop(self):
+        while True:
+            now = time.monotonic()
+            while self._heap and (self._heap[0][0] <= now
+                                  or self._heap[0][2].done()):
+                _, _, fut = heapq.heappop(self._heap)
+                if not fut.done():
+                    fut.set_result(False)  # deadline wake (vs kick=True)
+            if self._heap:
+                delay = max(self._heap[0][0] - time.monotonic(), 0.0)
+                try:
+                    await asyncio.wait_for(self._dirty.wait(), delay)
+                except asyncio.TimeoutError:
+                    pass
+                self._dirty.clear()
+            else:
+                await self._dirty.wait()
+                self._dirty.clear()
 
 
 class NodeHandle:
@@ -47,25 +118,29 @@ class NodeHandle:
 
 class WorkerHandle:
     def __init__(self, worker_id: int, rpc_addr: str, data_addr: str,
-                 slots: int):
+                 slots: int, pooled: bool = False):
         self.worker_id = worker_id
         self.rpc_addr = rpc_addr
         self.data_addr = data_addr
         self.slots = slots
+        self.pooled = pooled
         self.last_heartbeat = time.monotonic()
         self.client = RpcClient(rpc_addr)
-        self.job_id: Optional[str] = None
+        self.job_id: Optional[str] = None  # dedicated-worker assignment
+        # pooled placement bookkeeping: job_id -> subtasks hosted here
+        self.assigned: Dict[str, int] = {}
 
 
 class JobHandle:
     def __init__(self, job_id: str, graph: LogicalGraph,
                  storage_url: Optional[str], sql: Optional[str] = None,
-                 parallelism: int = 1):
+                 parallelism: int = 1, tenant: str = "default"):
         self.job_id = job_id
         self.graph = graph
         self.sql = sql  # canonical program: workers re-plan deterministically
         self.parallelism = parallelism
         self.storage_url = storage_url
+        self.tenant = tenant
         self.state = JobState.CREATED
         self.backend: Optional[StateBackend] = None
         self.workers: List[WorkerHandle] = []
@@ -95,10 +170,39 @@ class JobHandle:
         self.failure: Optional[str] = None
         self.stop_requested: Optional[str] = None
         self.restarts = 0
+        self.schedules = 0  # StartExecution rounds (data-plane namespace)
         self.events: List[dict] = []
         # worker-leader mode: the leader finished its local work and handed
         # the checkpoint cadence back to the controller
         self.leader_resigned = False
+        # event-driven driver: parked waits register a future here and
+        # every RPC arrival / state change that can move this job's
+        # predicates kicks them. `wakeups` counts predicate-loop wakeups —
+        # the fleet harness and the parked-job regression test read it (a
+        # parked RUNNING job must sit at ZERO over a poll interval).
+        self._waiters: set = set()
+        self.wakeups = 0
+
+    def kick(self):
+        """Wake every parked wait of this job (an event arrived)."""
+        for fut in list(self._waiters):
+            if not fut.done():
+                fut.set_result(True)
+
+    async def wait_kick(self, wheel: TimerWheel,
+                        timeout: Optional[float]) -> bool:
+        """Park until kicked or until the coarse deadline passes. Returns
+        True when kicked (state possibly changed), False on deadline."""
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters.add(fut)
+        if timeout is not None:
+            wheel.at(time.monotonic() + max(timeout, 0.0), fut)
+        try:
+            kicked = await fut
+        finally:
+            self._waiters.discard(fut)
+        self.wakeups += 1
+        return kicked
 
     def apply_parallelism_overrides(self, overrides: Dict[int, int]) -> None:
         """Fold per-node targets into the job's graph and bookkeeping.
@@ -119,6 +223,7 @@ class JobHandle:
             {"time": now_nanos(), "from": self.state.value, "to": nxt.value}
         )
         self.state = nxt
+        self.kick()  # state watchers (wait_for_state) park on the job
 
 
 class ControllerServer:
@@ -134,6 +239,14 @@ class ControllerServer:
         self.jobs: Dict[str, JobHandle] = {}
         self.max_restarts = max_restarts
         self._job_tasks: Dict[str, asyncio.Task] = {}
+        self.wheel = TimerWheel()
+        self.admission = AdmissionController(self)
+        self._reg_waiters: set = set()  # scheduling waits on registration
+        # handles pruned on suspicion of death, kept so a heartbeat
+        # re-registration can resurrect the SAME object — jobs hold
+        # handle references, and a fresh object would leave them reading
+        # a permanently stale liveness view
+        self._benched: Dict[int, WorkerHandle] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -157,6 +270,7 @@ class ControllerServer:
         )
         port = await self.rpc.start()
         self.addr = f"{self.bind}:{port}"
+        self.wheel.start()
         # schedulers that place onto registered resources need the registry
         self.scheduler.controller = self
         # closed-loop autoscaler (autoscale.enabled gates the loop; the
@@ -171,6 +285,8 @@ class ControllerServer:
             "controller",
             lambda: {
                 "workers": len(self.workers),
+                "pool_workers": len(self._live_pool_workers()),
+                "admission": self.admission.status(),
                 "jobs": {j.job_id: j.state.value for j in self.jobs.values()},
             },
             extra_routes={
@@ -200,12 +316,13 @@ class ControllerServer:
         # over a running job must not strand worker servers (an
         # un-shut-down grpc server hangs interpreter exit joining its
         # poller thread from the completion queue's finalizer)
-        for job_id in list(self.jobs):
+        for job in list(self.jobs.values()):
             try:
-                await self.scheduler.stop_workers(job_id, force=True)
+                await self._release_job(job, force=True)
             except Exception as e:  # noqa: BLE001 - teardown best effort
-                logger.debug("stop_workers(%s) at controller stop: %s",
-                             job_id, e)
+                logger.debug("release_job(%s) at controller stop: %s",
+                             job.job_id, e)
+        await self.scheduler.shutdown()
         for w in self.workers.values():
             await w.client.close()
         for job in self.jobs.values():
@@ -215,9 +332,15 @@ class ControllerServer:
             await n.client.close()
         if getattr(self, "_admin", None) is not None:
             await self._admin.cleanup()
+        await self.wheel.stop()
         await self.rpc.stop()
 
     # -- ControllerGrpc -----------------------------------------------------
+
+    def _kick_registration(self):
+        for fut in list(self._reg_waiters):
+            if not fut.done():
+                fut.set_result(True)
 
     async def _register_node(self, req: dict) -> dict:
         """A node daemon offers worker slots (reference node scheduler)."""
@@ -228,38 +351,76 @@ class ControllerServer:
         return {}
 
     async def _register_worker(self, req: dict) -> dict:
-        w = WorkerHandle(req["worker_id"], req["rpc_addr"], req["data_addr"],
-                         req.get("slots", 1))
-        self.workers[w.worker_id] = w
-        logger.info("worker %s registered (%s)", w.worker_id, w.rpc_addr)
+        cur = self.workers.get(req["worker_id"])
+        benched = self._benched.get(req["worker_id"])
+        if cur is not None and cur.rpc_addr == req["rpc_addr"]:
+            # re-registration of a live handle (heartbeat self-heal):
+            # refresh in place so jobs holding this handle keep a live
+            # liveness view instead of reading a stale replacement
+            cur.last_heartbeat = time.monotonic()
+        elif benched is not None and benched.rpc_addr == req["rpc_addr"]:
+            # a pruned-but-alive worker came back: resurrect the SAME
+            # handle object — jobs still holding it heal instantly
+            benched.last_heartbeat = time.monotonic()
+            self.workers[benched.worker_id] = benched
+            del self._benched[benched.worker_id]
+        else:
+            w = WorkerHandle(req["worker_id"], req["rpc_addr"],
+                             req["data_addr"], req.get("slots", 1),
+                             pooled=bool(req.get("pooled")))
+            self.workers[w.worker_id] = w
+            logger.info("worker %s registered (%s%s)", w.worker_id,
+                        w.rpc_addr, ", pooled" if w.pooled else "")
+        self._kick_registration()
+        self.admission.pump()  # fresh capacity may admit queued jobs
         return {}
 
     async def _heartbeat(self, req: dict) -> dict:
         w = self.workers.get(req["worker_id"])
         if w is not None:
             w.last_heartbeat = time.monotonic()
-        return {}
+        # `known=False` tells a live worker it was pruned (a loop stall
+        # can age heartbeats past the timeout and a recovery then drops
+        # the handle); the worker re-registers and the registry
+        # self-heals instead of wedging scheduling forever
+        return {"known": w is not None}
+
+    def _req_job(self, req: dict) -> Optional[JobHandle]:
+        """O(1) job resolution from the event's job_id (workers stamp
+        every task event). Falls back to the legacy O(jobs) worker-
+        membership scan for payloads without one."""
+        jid = req.get("job_id")
+        if jid is not None:
+            return self.jobs.get(jid)
+        for job in self.jobs.values():
+            if any(w.worker_id == req.get("worker_id")
+                   for w in job.workers):
+                return job
+        return None
 
     async def _task_checkpoint_event(self, req: dict) -> dict:
         return {}
 
     async def _task_checkpoint_completed(self, req: dict) -> dict:
-        for job in self.jobs.values():
-            if any(w.worker_id == req["worker_id"] for w in job.workers):
-                job.checkpoints.setdefault(req["epoch"], {})[req["task_id"]] = req
+        job = self._req_job(req)
+        if job is not None:
+            job.checkpoints.setdefault(req["epoch"], {})[req["task_id"]] = req
+            job.kick()
         return {}
 
     async def _task_finished(self, req: dict) -> dict:
-        for job in self.jobs.values():
-            if any(w.worker_id == req["worker_id"] for w in job.workers):
-                job.finished_tasks.add(req["task_id"])
+        job = self._req_job(req)
+        if job is not None:
+            job.finished_tasks.add(req["task_id"])
+            job.kick()
         return {}
 
     async def _task_failed(self, req: dict) -> dict:
-        for job in self.jobs.values():
-            if any(w.worker_id == req["worker_id"] for w in job.workers):
-                if job.failure is None:
-                    job.failure = f"{req['task_id']}: {req['error']}"
+        job = self._req_job(req)
+        if job is not None:
+            if job.failure is None:
+                job.failure = f"{req['task_id']}: {req['error']}"
+            job.kick()
         return {}
 
     async def _worker_finished(self, req: dict) -> dict:
@@ -268,21 +429,23 @@ class ControllerServer:
     async def _leader_checkpoint_finished(self, req: dict) -> dict:
         """Worker-leader mode: the leader published a checkpoint manifest;
         track the epoch for observability and stop/restore bookkeeping."""
-        for job in self.jobs.values():
-            if any(w.worker_id == req["worker_id"] for w in job.workers):
-                job.epoch = max(job.epoch, req["epoch"])
+        job = self._req_job(req)
+        if job is not None:
+            job.epoch = max(job.epoch, req["epoch"])
+            job.kick()
         return {}
 
     async def _leader_resigned(self, req: dict) -> dict:
         """The job leader's local work ended before the job did: the
         controller takes the checkpoint cadence back (workers fall back to
         forwarding reports here when the leader stops answering)."""
-        for job in self.jobs.values():
-            if any(w.worker_id == req["worker_id"] for w in job.workers):
-                job.leader_resigned = True
-                # skip past every epoch the leader ISSUED (published or
-                # not) so controller-driven barriers never reuse one
-                job.epoch = max(job.epoch, req.get("epoch", 0))
+        job = self._req_job(req)
+        if job is not None:
+            job.leader_resigned = True
+            # skip past every epoch the leader ISSUED (published or
+            # not) so controller-driven barriers never reuse one
+            job.epoch = max(job.epoch, req.get("epoch", 0))
+            job.kick()
         return {}
 
     # -- job API ------------------------------------------------------------
@@ -295,6 +458,7 @@ class ControllerServer:
         storage_url: Optional[str] = None,
         n_workers: int = 1,
         parallelism: int = 1,
+        tenant: str = "default",
     ) -> JobHandle:
         """Submit by SQL (workers re-plan the canonical text — the moral
         equivalent of shipping the reference's ArrowProgram proto) or by a
@@ -304,7 +468,7 @@ class ControllerServer:
 
             graph = plan_query(sql, parallelism=parallelism).graph
         job = JobHandle(job_id, graph, storage_url, sql=sql,
-                        parallelism=parallelism)
+                        parallelism=parallelism, tenant=tenant)
         self.jobs[job_id] = job
         self._job_tasks[job_id] = asyncio.ensure_future(
             self._drive_job(job, n_workers)
@@ -312,7 +476,9 @@ class ControllerServer:
         return job
 
     async def stop_job(self, job_id: str, mode: str = "checkpoint"):
-        self.jobs[job_id].stop_requested = mode
+        job = self.jobs[job_id]
+        job.stop_requested = mode
+        job.kick()
 
     async def rescale_job(self, job_id: str, overrides: Dict[int, int]):
         """Request an exactly-once rescale of a running durable job to the
@@ -333,18 +499,129 @@ class ControllerServer:
             if p < 1:
                 raise ValueError(f"parallelism must be >= 1 (node {nid})")
         job.rescale_requested = overrides
+        job.kick()
 
     async def wait_for_state(self, job_id: str, *states: JobState,
                              timeout: float = 120.0):
         deadline = time.monotonic() + timeout
         job = self.jobs[job_id]
         while job.state not in states:
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(
                     f"job {job_id} stuck in {job.state} waiting for {states}"
                 )
-            await asyncio.sleep(0.02)
+            # parked on the job's kick list: transition() wakes us, the
+            # wheel bounds the wait — zero wakeups while nothing changes
+            await job.wait_kick(self.wheel, remaining)
         return job.state
+
+    # -- worker pool --------------------------------------------------------
+
+    @staticmethod
+    async def _worker_call(w: WorkerHandle, service: str, method: str,
+                           payload: dict, timeout: float = 30.0) -> dict:
+        """Worker rpc + liveness refresh: a successful rpc is evidence at
+        least as strong as a heartbeat. Under event-loop stalls (mass
+        recovery on a small host) heartbeats age past the timeout while
+        real rpcs keep succeeding — without this, spurious timeouts
+        stampede every co-scheduled job into recovery at once."""
+        resp = await w.client.call(service, method, payload,
+                                   timeout=timeout)
+        w.last_heartbeat = time.monotonic()
+        return resp
+
+    def _pool_mode(self) -> bool:
+        return multiplexing_active(getattr(self.scheduler, "kind", ""))
+
+    def _worker_stale(self, w: WorkerHandle) -> bool:
+        timeout = config().controller.heartbeat_timeout
+        return time.monotonic() - w.last_heartbeat > timeout
+
+    def _live_pool_workers(self) -> List[WorkerHandle]:
+        return [w for w in self.workers.values()
+                if w.pooled and not self._worker_stale(w)]
+
+    def _pick_pool_workers(self, n_workers: int) -> List[WorkerHandle]:
+        """Least-loaded placement over the live pool: spread jobs by
+        currently assigned subtask counts (ties by id for determinism)."""
+        live = sorted(
+            self._live_pool_workers(),
+            key=lambda w: (sum(w.assigned.values()), w.worker_id),
+        )
+        return live[:n_workers]
+
+    async def _wait_registration(self, predicate, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while not predicate():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("workers did not register in time")
+            fut = asyncio.get_event_loop().create_future()
+            self._reg_waiters.add(fut)
+            # liveness (heartbeat staleness) can change without an event:
+            # re-check at least once a second
+            self.wheel.at(time.monotonic() + min(remaining, 1.0), fut)
+            try:
+                await fut
+            finally:
+                self._reg_waiters.discard(fut)
+
+    async def _release_job(self, job: JobHandle, force: bool = False,
+                           expunge: bool = False):
+        """Release a job's workers. Pooled workers get a per-job StopJob
+        teardown (co-resident jobs keep running, dead workers are pruned
+        from the registry for the scheduler to replace); dedicated
+        workers are stopped through the scheduler as before. `expunge`
+        (terminal states) additionally drops the job's metric series and
+        returns its admission slots."""
+        if self._pool_mode() and any(w.pooled for w in job.workers):
+            for w in job.workers:
+                w.assigned.pop(job.job_id, None)
+                stale = self._worker_stale(w)
+                if stale and w.worker_id in self.workers:
+                    # dead pool worker: prune it; the scheduler's next
+                    # ensure-pool pass (any job's (re)schedule) replaces
+                    # it. Benched, not discarded: a loop stall can make a
+                    # LIVE worker look dead, and its next heartbeat
+                    # resurrects this same handle.
+                    if self.workers.pop(w.worker_id, None) is not None:
+                        self._benched[w.worker_id] = w
+                try:
+                    # StopJob goes to PRESUMED-DEAD workers too: a
+                    # pruned-but-alive worker (stalled heartbeats) would
+                    # otherwise keep running a ZOMBIE incarnation of this
+                    # job — cancelled nowhere, racing the restarted
+                    # incarnation's sink files. A truly dead worker's rpc
+                    # fails fast (connection refused).
+                    await self._worker_call(
+                        w, "WorkerGrpc", "StopJob",
+                        {"job_id": job.job_id, "force": True,
+                         "expunge": expunge},
+                        timeout=5.0 if stale else 30.0,
+                    )
+                except Exception as e:  # noqa: BLE001 - worker may be dying
+                    logger.warning("StopJob(%s) on worker %s failed: %s",
+                                   job.job_id, w.worker_id, e)
+            await self.scheduler.stop_workers(job.job_id, force=force)
+        else:
+            await self.scheduler.stop_workers(job.job_id, force=force)
+        if expunge:
+            self.admission.release(job)
+            from ..metrics import REGISTRY
+
+            # cardinality GC: a churned fleet must not grow /metrics
+            # forever — drop the terminal job's series in this process
+            # (pooled worker processes dropped theirs via StopJob
+            # expunge), after a grace window for UIs reading the
+            # just-finished job's metric groups
+            ttl = float(config().cluster.metrics_ttl or 0)
+            if ttl <= 0:
+                REGISTRY.drop_job(job.job_id)
+            else:
+                asyncio.get_event_loop().call_later(
+                    ttl, REGISTRY.drop_job, job.job_id
+                )
 
     # -- state machine driver ----------------------------------------------
 
@@ -368,6 +645,7 @@ class ControllerServer:
             job.failure = job.failure or "driver crashed"
             if not job.state.is_terminal():
                 job.transition(JobState.FAILED)
+                await self._release_job(job, force=True, expunge=True)
 
     async def _schedule(self, job: JobHandle, n_workers: int):
         """reference scheduling.rs:65-100. Worker-facing failures (a
@@ -402,15 +680,25 @@ class ControllerServer:
     async def _schedule_inner(self, job: JobHandle, n_workers: int):
         if job.storage_url and job.backend is None:
             job.backend = StateBackend(job.storage_url, job.job_id).initialize()
+        pool = self._pool_mode()
+        if pool:
+            # admission control + fair slot scheduling: the job waits its
+            # fair-share turn for pool slots (tenant quotas apply); a
+            # recovery reschedule keeps the grant it already holds
+            await self.admission.acquire(job)
         await self.scheduler.start_workers(self.addr, n_workers, job.job_id)
-        deadline = time.monotonic() + 30
-        while len(self._free_workers()) < n_workers:
-            if time.monotonic() > deadline:
-                raise TimeoutError("workers did not register in time")
-            await asyncio.sleep(0.02)
-        job.workers = self._free_workers()[:n_workers]
-        for w in job.workers:
-            w.job_id = job.job_id
+        if pool:
+            await self._wait_registration(
+                lambda: len(self._live_pool_workers()) >= n_workers
+            )
+            job.workers = self._pick_pool_workers(n_workers)
+        else:
+            await self._wait_registration(
+                lambda: len(self._free_workers()) >= n_workers
+            )
+            job.workers = self._free_workers()[:n_workers]
+            for w in job.workers:
+                w.job_id = job.job_id
         # round-robin subtask assignment
         job.assignments = {}
         wi = 0
@@ -420,11 +708,18 @@ class ControllerServer:
                     job.workers[wi % len(job.workers)].worker_id
                 )
                 wi += 1
+        if pool:
+            counts: Dict[int, int] = {}
+            for (_nid, _sub), wid in job.assignments.items():
+                counts[wid] = counts.get(wid, 0) + 1
+            for w in job.workers:
+                w.assigned[job.job_id] = counts.get(w.worker_id, 0)
         job.checkpoints.clear()
         job.pending_epochs.clear()
         job.finished_tasks.clear()
         job.failure = None
         job.leader_resigned = False
+        job.schedules += 1
         req = {
             "job_id": job.job_id,
             "sql": job.sql,
@@ -446,6 +741,10 @@ class ControllerServer:
             "storage_url": job.storage_url,
             "generation": job.backend.generation if job.backend else None,
             "restore_epoch": job.backend.restore_epoch if job.backend else None,
+            # route namespace: quads collide across multiplexed jobs, and
+            # the schedule counter fences straggler connections of a
+            # torn-down incarnation of this same job
+            "data_ns": f"{job.job_id}@{job.schedules}",
         }
         if job.backend and job.backend.restore_epoch:
             job.epoch = job.backend.restore_epoch
@@ -466,25 +765,56 @@ class ControllerServer:
             )
             req["n_subtasks"] = len(job.assignments)
         for w in job.workers:
-            await w.client.call(
-                "WorkerGrpc", "StartExecution",
-                {**req, "is_leader": leader_mode and w is job.workers[0]},
-            )
+            try:
+                await self._worker_call(
+                    w, "WorkerGrpc", "StartExecution",
+                    {**req, "is_leader": leader_mode and w is job.workers[0]},
+                )
+            except Exception:
+                # a worker refusing StartExecution is dead or wedged, but
+                # its handle can still look heartbeat-fresh (a chaos kill
+                # lands between beats): age it out NOW so the recovery
+                # retry prunes + replaces it instead of re-picking the
+                # same corpse until the restart budget burns out. A live
+                # worker's next heartbeat un-ages it.
+                w.last_heartbeat = float("-inf")
+                raise
         # all partitions built + routes registered: release the sources
         for w in job.workers:
-            await w.client.call("WorkerGrpc", "StartProcessing", {})
+            try:
+                await self._worker_call(w, "WorkerGrpc", "StartProcessing",
+                                        {"job_id": job.job_id})
+            except Exception:
+                w.last_heartbeat = float("-inf")
+                raise
         job.transition(JobState.RUNNING)
+
+    def _heartbeat_horizon(self, job: JobHandle) -> float:
+        """Earliest monotonic instant a worker of this job COULD be
+        declared dead — the deadline the timer wheel arms for liveness
+        re-checks (heartbeat arrivals push it forward without kicking)."""
+        timeout = config().controller.heartbeat_timeout
+        beats = [
+            w.last_heartbeat for w in job.workers
+            if not (job.leader_resigned and w is job.workers[0])
+        ]
+        if not beats:
+            return time.monotonic() + timeout
+        return min(beats) + timeout
 
     @protocol_effect("ctrl.run_cadence")
     async def _run(self, job: JobHandle):
         """Checkpoint cadence + completion/failure watching
-        (reference job_controller/controller.rs:292-551)."""
+        (reference job_controller/controller.rs:292-551). Event-driven:
+        each pass runs the same predicate checks the 50 Hz poll loop ran,
+        then parks until a task event kicks the job or the earliest
+        deadline (cadence due, heartbeat horizon, epoch deadline) fires
+        on the shared timer wheel."""
         cfg = config()
         interval = cfg.pipeline.checkpointing.interval
         leader_mode = cfg.controller.job_controller_mode == "worker"
         last_checkpoint = time.monotonic()
         while True:
-            await asyncio.sleep(0.02)
             if job.failure is not None:
                 job.transition(JobState.RECOVERING)
                 return
@@ -492,9 +822,13 @@ class ControllerServer:
             # finished worker stops heartbeating, and treating that as a
             # timeout would recover (and re-finish, and re-recover) forever
             if len(job.finished_tasks) >= job.n_subtasks:
+                # release BEFORE the terminal transition: a caller woken
+                # by wait_for_state(FINISHED) may immediately tear the
+                # controller down, and the expunge (slot return + metric
+                # GC) must not race that cancellation
                 job.transition(JobState.FINISHING)
+                await self._release_job(job, expunge=True)
                 job.transition(JobState.FINISHED)
-                await self.scheduler.stop_workers(job.job_id)
                 return
             if self._heartbeat_expired(job):
                 job.failure = "worker heartbeat timeout"
@@ -504,7 +838,7 @@ class ControllerServer:
                 job.transition(JobState.RESCALING)
                 return
             # reap pipelined epochs: publish (in epoch order) any whose
-            # report set completed since the last tick — completions can
+            # report set completed since the last wakeup — completions can
             # arrive >1 epoch late with multi-inflight worker flushes
             if job.backend is not None and job.pending_epochs:
                 await self._checkpoint_reap(job)
@@ -524,7 +858,8 @@ class ControllerServer:
                         # the leader runs the stopping checkpoint itself
                         try:
                             resp = await job.workers[0].client.call(
-                                "WorkerGrpc", "CheckpointStop", {},
+                                "WorkerGrpc", "CheckpointStop",
+                                {"job_id": job.job_id},
                                 timeout=90.0,
                             )
                             job.epoch = max(job.epoch, resp.get("epoch", 0))
@@ -545,7 +880,8 @@ class ControllerServer:
                                     try:
                                         await w.client.call(
                                             "WorkerGrpc", "StopExecution",
-                                            {"mode": "graceful"},
+                                            {"job_id": job.job_id,
+                                             "mode": "graceful"},
                                             timeout=5.0,
                                         )
                                     except Exception:  # noqa: BLE001
@@ -574,29 +910,51 @@ class ControllerServer:
                         job.stop_requested = mode
                         job.transition(JobState.RECOVERING)
                         return
+                    await self._release_job(job, expunge=True)
                     job.transition(JobState.STOPPED)
                 else:
                     job.transition(JobState.STOPPING)
                     for w in job.workers:
-                        await w.client.call(
-                            "WorkerGrpc", "StopExecution",
-                            {"mode": "graceful" if mode == "graceful"
-                             else "immediate"},
-                        )
+                        try:
+                            await w.client.call(
+                                "WorkerGrpc", "StopExecution",
+                                {"job_id": job.job_id,
+                                 "mode": "graceful" if mode == "graceful"
+                                 else "immediate"},
+                            )
+                        except Exception as e:  # noqa: BLE001 - dead worker
+                            logger.warning(
+                                "StopExecution to worker %s failed: %s",
+                                w.worker_id, e,
+                            )
                     await self._await_all_finished(job)
+                    await self._release_job(job, expunge=True)
                     job.transition(JobState.STOPPED)
-                await self.scheduler.stop_workers(job.job_id)
                 return
-            if (
+            cadence_armed = (
                 job.backend is not None
                 and (not leader_mode or job.leader_resigned)
                 and not job.finished_tasks
-                and time.monotonic() - last_checkpoint >= interval
                 and len(job.pending_epochs)
                 < max(1, config().state.max_inflight_flushes)
-            ):
+            )
+            if (cadence_armed
+                    and time.monotonic() - last_checkpoint >= interval):
                 last_checkpoint = time.monotonic()
                 await self._checkpoint_start(job)
+                continue
+            # park: RPC arrivals kick the job; the wheel wakes us at the
+            # earliest deadline that could change a predicate above
+            deadlines = [self._heartbeat_horizon(job)]
+            if cadence_armed:
+                deadlines.append(last_checkpoint + interval)
+            if job.pending_epochs:
+                deadlines.append(
+                    min(i["deadline"] for i in job.pending_epochs.values())
+                )
+            await job.wait_kick(
+                self.wheel, max(min(deadlines) - time.monotonic(), 0.0)
+            )
 
     @protocol_effect("ctrl.rescale")
     async def _rescale(self, job: JobHandle):
@@ -666,9 +1024,12 @@ class ControllerServer:
                 job.failure = "chaos: rescale reschedule failure"
                 job.transition(JobState.RECOVERING)
                 return
-            for w in job.workers:
-                self.workers.pop(w.worker_id, None)
-            await self.scheduler.stop_workers(job.job_id)
+            if self._pool_mode() and any(w.pooled for w in job.workers):
+                await self._release_job(job, force=True)
+            else:
+                for w in job.workers:
+                    self.workers.pop(w.worker_id, None)
+                await self.scheduler.stop_workers(job.job_id)
             # fresh generation fences any straggler; the restore epoch is
             # the stop checkpoint just published
             job.backend = StateBackend(
@@ -737,16 +1098,23 @@ class ControllerServer:
                 job.pending_epochs.clear()
                 return
             await self._checkpoint_reap(job)
-            if job.pending_epochs:
-                await asyncio.sleep(0.02)
+            if job.pending_epochs and job.failure is None:
+                deadline = min(
+                    [i["deadline"] for i in job.pending_epochs.values()]
+                    + [self._heartbeat_horizon(job)]
+                )
+                await job.wait_kick(
+                    self.wheel, max(deadline - time.monotonic(), 0.0)
+                )
 
     async def _fanout_barrier(self, job: JobHandle, epoch: int,
                               then_stop: bool):
         for w in job.workers:
             try:
-                await w.client.call(
-                    "WorkerGrpc", "Checkpoint",
-                    {"epoch": epoch, "then_stop": then_stop},
+                await self._worker_call(
+                    w, "WorkerGrpc", "Checkpoint",
+                    {"job_id": job.job_id, "epoch": epoch,
+                     "then_stop": then_stop},
                 )
             except Exception as e:  # noqa: BLE001 - resigned/dead worker
                 logger.warning(
@@ -816,7 +1184,10 @@ class ControllerServer:
                                 epoch)
                     wait_span.set(outcome="job_finished")
                     return
-                await asyncio.sleep(0.02)
+                park = min(deadline, self._heartbeat_horizon(job))
+                await job.wait_kick(
+                    self.wheel, max(park - time.monotonic(), 0.0)
+                )
         await self._publish_epoch(job, epoch, job.checkpoints[epoch])
 
     @protocol_effect("ctrl.publish_epoch")
@@ -857,9 +1228,10 @@ class ControllerServer:
                     for w in job.workers:
                         if w.worker_id not in commit_workers:
                             continue
-                        await w.client.call(
-                            "WorkerGrpc", "Commit",
-                            {"epoch": epoch, "committing": committing},
+                        await self._worker_call(
+                            w, "WorkerGrpc", "Commit",
+                            {"job_id": job.job_id, "epoch": epoch,
+                             "committing": committing},
                         )
         except Exception as e:  # noqa: BLE001
             logger.warning("checkpoint %d commit phase failed: %r", epoch, e)
@@ -878,8 +1250,9 @@ class ControllerServer:
                 for swap in swaps:
                     for w in job.workers:
                         try:
-                            await w.client.call(
-                                "WorkerGrpc", "LoadCompacted", swap
+                            await self._worker_call(
+                                w, "WorkerGrpc", "LoadCompacted",
+                                {**swap, "job_id": job.job_id},
                             )
                         except Exception as e:  # noqa: BLE001
                             logger.warning(
@@ -903,16 +1276,26 @@ class ControllerServer:
                 logger.warning("job %s: worker died awaiting task finish",
                                job.job_id)
                 return
-            await asyncio.sleep(0.02)
+            # parked on the job's kick list: TaskFinished/TaskFailed
+            # arrivals wake us; the wheel covers the deadline + liveness
+            park = min(deadline, self._heartbeat_horizon(job))
+            await job.wait_kick(self.wheel,
+                                max(park - time.monotonic(), 0.0))
 
     @protocol_effect("ctrl.recover")
     async def _recover(self, job: JobHandle, n_workers: int):
         """reference states/recovering.rs:24-60 (escalating teardown) then
-        reschedule from the latest durable checkpoint."""
+        reschedule from the latest durable checkpoint. Pool mode: the
+        job's state is torn down PER JOB on live shared workers (StopJob)
+        — co-scheduled jobs keep running — while actually-dead workers
+        are pruned from the registry for the scheduler to replace. Each
+        job sharing a dead worker runs this recovery independently
+        (shared-fate failure, per-job recovery independence — the model
+        checker's 2-job configuration pins that property)."""
         job.restarts += 1
         if job.restarts > self.max_restarts:
+            await self._release_job(job, force=True, expunge=True)
             job.transition(JobState.FAILED)
-            await self.scheduler.stop_workers(job.job_id, force=True)
             return
         logger.warning("job %s recovering (%s)", job.job_id, job.failure)
         job.pending_epochs.clear()  # unpublished epochs die with the gen
@@ -925,16 +1308,20 @@ class ControllerServer:
             cat="controller", job=job.job_id, restarts=job.restarts,
             failure=str(job.failure)[:300],
         ):
-            for w in job.workers:
-                try:
-                    await w.client.call(
-                        "WorkerGrpc", "StopExecution", {"mode": "immediate"},
-                        timeout=2.0,
-                    )
-                except Exception:  # noqa: BLE001 - worker may be dead
-                    pass
-                self.workers.pop(w.worker_id, None)
-            await self.scheduler.stop_workers(job.job_id, force=True)
+            if self._pool_mode() and any(w.pooled for w in job.workers):
+                await self._release_job(job, force=True)
+            else:
+                for w in job.workers:
+                    try:
+                        await w.client.call(
+                            "WorkerGrpc", "StopExecution",
+                            {"job_id": job.job_id, "mode": "immediate"},
+                            timeout=2.0,
+                        )
+                    except Exception:  # noqa: BLE001 - worker may be dead
+                        pass
+                    self.workers.pop(w.worker_id, None)
+                await self.scheduler.stop_workers(job.job_id, force=True)
             # new generation fences the old; restore from latest manifest
             if job.backend is not None:
                 job.backend = StateBackend(
@@ -945,7 +1332,8 @@ class ControllerServer:
     # -- helpers ------------------------------------------------------------
 
     def _free_workers(self) -> List[WorkerHandle]:
-        return [w for w in self.workers.values() if w.job_id is None]
+        return [w for w in self.workers.values()
+                if w.job_id is None and not w.pooled]
 
     def _heartbeat_expired(self, job: JobHandle) -> bool:
         timeout = config().controller.heartbeat_timeout
@@ -955,6 +1343,3 @@ class ControllerServer:
             # a resigned leader shut down after finishing its local work
             if not (job.leader_resigned and w is job.workers[0])
         )
-
-
-

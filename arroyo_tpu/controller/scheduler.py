@@ -24,7 +24,31 @@ from ..utils.logging import get_logger
 logger = get_logger("scheduler")
 
 
+def multiplexing_active(kind: str) -> bool:
+    """Whether jobs of this scheduler kind share a pooled, multiplexed
+    worker set (ROADMAP item 3). Only the embedded and process schedulers
+    own their worker lifecycle; multiplexing additionally requires the
+    controller-resident job control loop (worker-leader mode elects one
+    leader per job and assumes a dedicated worker set) and no
+    multi-process device mesh (mesh ranks are per-job env assignments a
+    shared process cannot take twice)."""
+    from ..config import config
+
+    cfg = config()
+    mode = cfg.cluster.multiplexing
+    if mode == "off" or kind not in ("embedded", "process"):
+        return False
+    if int(cfg.tpu.mesh_processes or 0) >= 2:
+        return False
+    if cfg.controller.job_controller_mode != "controller":
+        return False
+    return True  # "auto" and "on"
+
+
 class Scheduler:
+    kind = "?"  # scheduler kind (multiplexing_active gates on it)
+    controller = None  # ControllerServer, attached by start()
+
     async def start_workers(self, controller_addr: str, n_workers: int,
                             job_id: str) -> None:
         raise NotImplementedError
@@ -32,21 +56,61 @@ class Scheduler:
     async def stop_workers(self, job_id: str, force: bool = False) -> None:
         pass
 
+    async def shutdown(self) -> None:
+        """Tear down pooled workers (controller stop); per-job teardown
+        goes through stop_workers/StopJob instead."""
+
 
 _next_embedded_id = 1000
 
 
 class EmbeddedScheduler(Scheduler):
-    """Workers as asyncio tasks inside the controller process."""
+    """Workers as asyncio tasks inside the controller process. With
+    multiplexing active (the default), a shared pool of
+    `cluster.worker_pool_size` long-lived workers hosts every job;
+    otherwise each job gets dedicated workers (legacy)."""
+
+    kind = "embedded"
 
     def __init__(self):
-        self.jobs: Dict[str, List] = {}  # job_id -> [(worker, task)]
+        self.jobs: Dict[str, List] = {}  # job_id -> [(worker, task)] legacy
+        self.pool: List = []  # [(worker, serve_task)] shared across jobs
+        self._pool_lock: Optional[asyncio.Lock] = None
 
     async def start_workers(self, controller_addr, n_workers, job_id):
         global _next_embedded_id
 
+        from ..config import config
         from ..engine.worker import WorkerServer
 
+        if multiplexing_active("embedded"):
+            # serialized: concurrent job schedules must not each find the
+            # pool short and over-spawn it (the spawn loop awaits)
+            if self._pool_lock is None:
+                self._pool_lock = asyncio.Lock()
+            async with self._pool_lock:
+                # the pool grows on demand to the largest worker request —
+                # dead workers (chaos kill, crash) are pruned and replaced
+                # here, which is the path recovery rescheduling drives
+                want = max(int(config().cluster.worker_pool_size or 1),
+                           n_workers)
+                live = []
+                for w, t in self.pool:
+                    if getattr(w, "_shutdown_started", False) or t.done():
+                        t.cancel()
+                    else:
+                        live.append((w, t))
+                self.pool = live
+                while len(self.pool) < want:
+                    wid = _next_embedded_id
+                    _next_embedded_id += 1
+                    w = WorkerServer(controller_addr, worker_id=wid,
+                                     pooled=True)
+                    await w.start()
+                    self.pool.append(
+                        (w, asyncio.ensure_future(w.serve_forever()))
+                    )
+            return
         entries = self.jobs.setdefault(job_id, [])
         for _ in range(n_workers):
             wid = _next_embedded_id
@@ -58,6 +122,8 @@ class EmbeddedScheduler(Scheduler):
             )
 
     async def stop_workers(self, job_id, force=False):
+        # pooled workers are shared: the controller already tore the job
+        # down on them via StopJob; only dedicated (legacy) entries die
         entries = self.jobs.pop(job_id, [])
         if force:
             # full teardown: cancel runners, heartbeats and servers so no
@@ -68,6 +134,13 @@ class EmbeddedScheduler(Scheduler):
             await asyncio.gather(
                 *[t for _, t in entries], return_exceptions=True
             )
+
+    async def shutdown(self):
+        pool, self.pool = self.pool, []
+        for w, t in pool:
+            await w.shutdown()
+            t.cancel()
+        await asyncio.gather(*[t for _, t in pool], return_exceptions=True)
 
 
 _next_process_id = 2000
@@ -142,16 +215,36 @@ def pick_coordinator() -> str:
 
 
 class ProcessScheduler(Scheduler):
-    """Forks worker subprocesses (reference ProcessScheduler mod.rs:118)."""
+    """Forks worker subprocesses (reference ProcessScheduler mod.rs:118).
+    With multiplexing active, a shared pool of `cluster.worker_pool_size`
+    long-lived processes hosts every job (ARROYO_WORKER_POOLED=1 keeps
+    them serving past their first job); mesh jobs and worker-leader mode
+    fall back to fork-per-job."""
+
+    kind = "process"
 
     def __init__(self):
         self.procs: Dict[str, List[subprocess.Popen]] = {}
+        self.pool_procs: List[subprocess.Popen] = []
 
     async def start_workers(self, controller_addr, n_workers, job_id):
         global _next_process_id
 
         from ..config import config
 
+        if multiplexing_active("process"):
+            want = max(int(config().cluster.worker_pool_size or 1),
+                       n_workers)
+            self.pool_procs = [p for p in self.pool_procs
+                               if p.poll() is None]
+            while len(self.pool_procs) < want:
+                p = spawn_worker(
+                    controller_addr, _next_process_id,
+                    extra_env={"ARROYO_WORKER_POOLED": "1"},
+                )
+                _next_process_id += 1
+                self.pool_procs.append(p)
+            return
         coord = None
         if int(config().tpu.mesh_processes or 0) >= 2:
             coord = config().tpu.mesh_coordinator or pick_coordinator()
@@ -166,11 +259,17 @@ class ProcessScheduler(Scheduler):
     async def stop_workers(self, job_id, force=False):
         await terminate_procs(self.procs.pop(job_id, []), force)
 
+    async def shutdown(self):
+        procs, self.pool_procs = self.pool_procs, []
+        await terminate_procs(procs, force=True)
+
 
 class NodeScheduler(Scheduler):
     """Places workers on registered node daemons (reference node scheduler,
     schedulers/mod.rs): most-free-slots first; the node forks the worker
     processes. `controller` is attached by ControllerServer.start()."""
+
+    kind = "node"
 
     def __init__(self):
         self.controller = None  # ControllerServer, set on attach
@@ -253,6 +352,8 @@ class NodeScheduler(Scheduler):
 class ManualScheduler(Scheduler):
     """Workers join on their own (reference mod.rs:334)."""
 
+    kind = "manual"
+
     async def start_workers(self, controller_addr, n_workers, job_id):
         logger.info(
             "manual scheduler: waiting for %d workers to join %s",
@@ -264,6 +365,8 @@ class KubernetesScheduler(Scheduler):
     """Renders worker pod specs (reference schedulers/kubernetes/mod.rs:240);
     applies them with kubectl when present, else raises with the manifest
     path so operators can apply it themselves."""
+
+    kind = "kubernetes"
 
     def __init__(self, namespace: str = "default",
                  image: str = "arroyo-tpu:latest", task_slots: int = 4):

@@ -12,11 +12,20 @@ the bounded in-process queues through per-connection flow control
 drains). Signals ride the same framing msgpack-encoded.
 
 Frame layout (little-endian):
-  magic u32 = 0xA77051  | kind u8 (0=data,1=signal)
+  magic u32 = 0xA77051  | kind u8 (0=data,1=signal,2=hello)
   src_node u32 | src_subtask u32 | dst_node u32 | dst_subtask u32
   payload_len u64 | sent_ns u64 | trace_len u16
   trace bytes (msgpack {"t": trace_id, "s": span_id}, flight recorder)
   payload bytes
+
+Multi-tenancy: node ids are per-job, so quads collide across jobs
+multiplexed onto one worker. Each connection therefore opens with ONE
+hello frame (kind=2, payload msgpack {"ns": "<job_id>@<incarnation>"})
+binding every subsequent frame on that connection to the sender job's
+route namespace; the server routes on (ns, quad). The incarnation
+(controller schedule counter) additionally fences a straggler connection
+from a torn-down incarnation of the SAME job out of the fresh
+incarnation's queues.
 
 Every frame header carries the sender's wall-clock send timestamp, which
 the receiver folds into the `arroyo_exchange_frame_seconds` histogram;
@@ -125,6 +134,15 @@ def write_frame(writer: asyncio.StreamWriter, quad: Quad, item,
     writer.write(payload)
 
 
+def write_hello(writer: asyncio.StreamWriter, ns: str) -> None:
+    """Bind this connection to a job route namespace (first frame)."""
+    payload = msgpack.packb({"ns": ns})
+    writer.write(
+        _HEADER.pack(MAGIC, 2, 0, 0, 0, 0, len(payload), time.time_ns(), 0)
+    )
+    writer.write(payload)
+
+
 async def read_frame(reader: asyncio.StreamReader):
     """Returns (quad, item, sent_ns, trace-dict-or-None)."""
     header = await reader.readexactly(_HEADER.size)
@@ -135,8 +153,13 @@ async def read_frame(reader: asyncio.StreamReader):
     if tlen:
         trace = msgpack.unpackb(await reader.readexactly(tlen), raw=False)
     payload = await reader.readexactly(plen)
-    item = decode_signal(payload) if kind == 1 else decode_batch(payload)
-    return (sn, ss, dn, ds), item, sent_ns, trace
+    if kind == 2:
+        item = msgpack.unpackb(payload, raw=False)  # hello dict
+    elif kind == 1:
+        item = decode_signal(payload)
+    else:
+        item = decode_batch(payload)
+    return (sn, ss, dn, ds), kind, item, sent_ns, trace
 
 
 def _set_nodelay(writer: asyncio.StreamWriter) -> None:
@@ -162,12 +185,21 @@ class DataPlaneServer:
     def __init__(self, bind: str = "127.0.0.1", port: int = 0):
         self.bind = bind
         self.port = port
-        # (src_node, src_sub, dst_node, dst_sub) -> local queue
-        self.routes: Dict[Quad, BatchQueue] = {}
+        # (ns, (src_node, src_sub, dst_node, dst_sub)) -> local queue;
+        # ns is the sender job's "<job_id>@<incarnation>" namespace
+        # (quads collide across multiplexed jobs)
+        self.routes: Dict[tuple, BatchQueue] = {}
         self._server: Optional[asyncio.AbstractServer] = None
 
-    def register(self, quad: Quad, queue: BatchQueue):
-        self.routes[quad] = queue
+    def register(self, quad: Quad, queue: BatchQueue, ns: str = ""):
+        self.routes[(ns, quad)] = queue
+
+    def unregister_ns(self, ns: str):
+        """Per-job teardown: drop every route of one job namespace so a
+        co-resident job's routes stay live (and a straggler connection of
+        the torn-down job routes nowhere instead of into fresh queues)."""
+        for key in [k for k in self.routes if k[0] == ns]:
+            del self.routes[key]
 
     async def start(self) -> int:
         from ..utils.tls import data_server_context
@@ -183,14 +215,21 @@ class DataPlaneServer:
         _set_nodelay(writer)
         peer = writer.get_extra_info("peername")
         lat_handles: Dict[Quad, object] = {}
+        ns = ""  # bound by the connection's hello frame
         try:
             while True:
-                quad, item, sent_ns, trace = await read_frame(reader)
+                quad, kind, item, sent_ns, trace = await read_frame(reader)
+                if kind == 2:
+                    ns = item.get("ns", "")
+                    continue
                 latency = max(0, time.time_ns() - sent_ns) / 1e9
                 h = lat_handles.get(quad)
                 if h is None:
+                    # job label: the cardinality GC drops a stopped job's
+                    # exchange series with the rest of its families
                     h = lat_handles[quad] = EXCHANGE_FRAME_SECONDS.labels(
-                        task=f"{quad[2]}-{quad[3]}"
+                        task=f"{quad[2]}-{quad[3]}",
+                        job=ns.split("@", 1)[0],
                     )
                 h.observe(latency)
                 if trace and "t" in trace and obs.enabled():
@@ -209,9 +248,10 @@ class DataPlaneServer:
                         },
                         "events": [], "pid": _os.getpid(), "tid": 0,
                     })
-                queue = self.routes.get(quad)
+                queue = self.routes.get((ns, quad))
                 if queue is None:
-                    logger.warning("no route for %s from %s", quad, peer)
+                    logger.warning("no route for %s/%s from %s", ns, quad,
+                                   peer)
                     continue
                 await queue.send(item)
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -236,11 +276,12 @@ class RemoteEdgeSender:
     backpressure; the pump blocks on socket drain."""
 
     def __init__(self, address: str, quad: Quad, queue: BatchQueue,
-                 on_error=None):
+                 on_error=None, ns: str = ""):
         self.address = address
         self.quad = quad
         self.queue = queue
         self.on_error = on_error
+        self.ns = ns  # sender job's route namespace (hello frame)
         self.task: Optional[asyncio.Task] = None
         self.writer: Optional[asyncio.StreamWriter] = None
 
@@ -258,6 +299,8 @@ class RemoteEdgeSender:
             server_hostname=server_name if ctx is not None else None,
         )
         _set_nodelay(self.writer)
+        write_hello(self.writer, self.ns)
+        await self.writer.drain()
         self.task = asyncio.ensure_future(self._pump())
 
     async def _pump(self):

@@ -64,6 +64,7 @@ class Program:
         my_worker: Optional[int] = None,
         worker_addrs: Optional[Dict[int, str]] = None,
         data_server=None,
+        data_ns: str = "",
     ) -> "Program":
         """Construct operators, queues and runners.
 
@@ -102,21 +103,25 @@ class Program:
             quad = (edge.src, i, edge.dst, j)
             if not src_local and not dst_local:
                 return None
-            q = BatchQueue(qsize, qbytes, f"e{edge_idx}-{i}-{j}")
+            q = BatchQueue(qsize, qbytes,
+                           f"{self.job_id}/e{edge_idx}-{i}-{j}",
+                           job=self.job_id)
             if dst_local:
                 in_queues[(edge.dst, j)].append(
                     InputQueue(q, logical_input, f"{edge.src}-{i}")
                 )
                 if not src_local:
                     assert data_server is not None
-                    data_server.register(quad, q)
+                    data_server.register(quad, q, ns=data_ns)
                     return None  # sender is remote
                 return q
             # src local, dst remote: pump the queue over TCP
             from .network import RemoteEdgeSender
 
             addr = worker_addrs[owner(edge.dst, j)]
-            self.remote_senders.append(RemoteEdgeSender(addr, quad, q))
+            self.remote_senders.append(
+                RemoteEdgeSender(addr, quad, q, ns=data_ns)
+            )
             return q
 
         for edge_idx, edge in enumerate(self.graph.edges):

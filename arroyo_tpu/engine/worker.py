@@ -1,4 +1,4 @@
-"""Worker server: runs a partition of a job's subtasks.
+"""Worker server: runs partitions of one or MANY jobs' subtasks.
 
 Capability parity with the reference's WorkerServer
 (/root/reference/crates/arroyo-worker/src/lib.rs:666-1197): registers with
@@ -6,6 +6,16 @@ the controller (RegisterWorkerReq), serves WorkerGrpc (StartExecution,
 Checkpoint, Commit, StopExecution), heartbeats, streams task events
 (checkpoint progress, finish/failure) back to the controller, and hosts the
 TCP data plane endpoint for cross-worker edges.
+
+Multi-tenancy (ROADMAP item 3): one worker process multiplexes subtasks
+from MANY jobs onto one event loop and one JAX runtime — the Flink
+slot-sharing shape (Carbone et al., 2015). Every job lives in its own
+`_JobRuntime` namespace (program, runner tasks, response pump, control
+queues, data-plane route namespace, leader state), so per-job teardown
+(`StopJob`) cancels exactly that job's work and co-resident jobs never
+notice. All WorkerGrpc methods are job-scoped via a `job_id` field; a
+request without one resolves against a sole hosted job (dedicated-worker
+compatibility).
 """
 
 from __future__ import annotations
@@ -37,48 +47,88 @@ from .rpc import RpcClient, RpcServer
 logger = get_logger("worker")
 
 
-class WorkerServer:
-    def __init__(self, controller_addr: str, worker_id: Optional[int] = None,
-                 bind: str = "127.0.0.1"):
-        self.controller_addr = controller_addr
-        if worker_id is None:
-            worker_id = int(os.environ.get("ARROYO_WORKER_ID", os.getpid()))
-        self.worker_id = worker_id
-        self.bind = bind
-        self.rpc = RpcServer(bind)
-        self.data = DataPlaneServer(bind)
-        self.controller: Optional[RpcClient] = None
-        self.program: Optional[Program] = None
-        self.tasks = []
-        self._running = asyncio.Event()
-        self._finished = asyncio.Event()
-        self._n_running = 0
+class _JobRuntime:
+    """One job's execution namespace inside a (possibly multiplexed)
+    worker: the physical program, its runner tasks and response pump,
+    the data-plane route namespace, and — in worker-leader mode — the
+    job-control (cadence/manifest/2PC) state."""
+
+    def __init__(self, job_id: str, program: Program, data_ns: str):
+        self.job_id = job_id
+        self.program = program
+        self.data_ns = data_ns
+        self.tasks: list = []
+        self.pump_task: Optional[asyncio.Task] = None
+        self.n_running = 0
+        self.finished = asyncio.Event()
+        self.torn_down = False
+        self.assignments: Dict[tuple, int] = {}
         # worker-leader mode (reference job_controller/: the elected worker
         # runs the job-control loop — checkpoint cadence, manifest
         # assembly, 2PC — and peers forward checkpoint events to it)
-        self._is_leader = False
-        self._leader_client: Optional[RpcClient] = None
-        self._peer_clients: Dict[int, RpcClient] = {}
-        self._worker_rpc_addrs: Dict[int, str] = {}
-        self._assignments: Dict[tuple, int] = {}
-        self._leader_reports: Dict[int, Dict[str, dict]] = {}
-        self._leader_epoch = 0
-        self._lead_interval: Optional[float] = None
-        self._lead_task = None
-        self._shutdown_task = None  # retained chaos-kill teardown task
-        self._n_total_subtasks = 0
+        self.is_leader = False
+        self.leader_client: Optional[RpcClient] = None
+        self.worker_rpc_addrs: Dict[int, str] = {}
+        self.leader_reports: Dict[int, Dict[str, dict]] = {}
+        self.leader_epoch = 0
+        self.lead_interval: Optional[float] = None
+        self.lead_task = None
+        self.n_total_subtasks = 0
         # set while no leader checkpoint is in flight: teardown must not
         # close the rpc server under an active leadership duty (peers are
         # still delivering reports, the manifest isn't published yet).
         # Counted, because a cancelled cadence checkpoint's cleanup must
         # not mark idle while a stop checkpoint is still running.
-        self._lead_active = 0
-        self._lead_idle = asyncio.Event()
-        self._lead_idle.set()
-        self._current_ck = None  # in-flight cadence checkpoint task
-        self._leader_published = 0  # highest epoch published or abandoned
-        self._leader_durable = 0  # highest epoch with a published manifest
-        self._resigned = False
+        self.lead_active = 0
+        self.lead_idle = asyncio.Event()
+        self.lead_idle.set()
+        self.current_ck = None  # in-flight cadence checkpoint task
+        self.leader_published = 0  # highest epoch published or abandoned
+        self.leader_durable = 0  # highest epoch with a published manifest
+        self.resigned = False
+
+
+class WorkerServer:
+    def __init__(self, controller_addr: str, worker_id: Optional[int] = None,
+                 bind: str = "127.0.0.1", pooled: bool = False):
+        self.controller_addr = controller_addr
+        if worker_id is None:
+            worker_id = int(os.environ.get("ARROYO_WORKER_ID", os.getpid()))
+        self.worker_id = worker_id
+        self.bind = bind
+        self.pooled = pooled
+        self.rpc = RpcServer(bind)
+        self.data = DataPlaneServer(bind)
+        self.controller: Optional[RpcClient] = None
+        self._jobs: Dict[str, _JobRuntime] = {}
+        self._finished = asyncio.Event()  # worker-level shutdown signal
+        self._peer_clients: Dict[int, RpcClient] = {}
+        self._shutdown_task = None  # retained chaos-kill teardown task
+
+    # -- job resolution ------------------------------------------------------
+
+    def _job(self, req: dict) -> _JobRuntime:
+        jid = req.get("job_id")
+        if jid is not None:
+            jr = self._jobs.get(jid)
+            if jr is None:
+                raise KeyError(
+                    f"worker {self.worker_id} hosts no job {jid!r}"
+                )
+            return jr
+        if len(self._jobs) == 1:  # dedicated-worker compatibility
+            return next(iter(self._jobs.values()))
+        raise KeyError(
+            f"job_id required: worker {self.worker_id} hosts "
+            f"{len(self._jobs)} jobs"
+        )
+
+    @property
+    def program(self) -> Optional[Program]:
+        """Sole hosted job's program (dedicated-worker compatibility)."""
+        if len(self._jobs) == 1:
+            return next(iter(self._jobs.values())).program
+        return None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -98,6 +148,7 @@ class WorkerServer:
                 "TaskCheckpointCompleted": self.task_checkpoint_completed,
                 "CheckpointStop": self.checkpoint_stop,
                 "StopExecution": self.stop_execution,
+                "StopJob": self.stop_job_rpc,
                 "GetMetrics": self.get_metrics,
             },
         )
@@ -114,6 +165,7 @@ class WorkerServer:
                 "rpc_addr": self.rpc_addr,
                 "data_addr": self.data_addr,
                 "slots": config().worker.task_slots,
+                "pooled": self.pooled,
             },
         )
         from ..utils.admin import serve_admin
@@ -122,14 +174,19 @@ class WorkerServer:
             "worker",
             lambda: {
                 "worker_id": self.worker_id,
-                "running_subtasks": self._n_running,
-                "is_leader": self._is_leader,
+                "pooled": self.pooled,
+                "jobs": {
+                    jid: jr.n_running for jid, jr in self._jobs.items()
+                },
+                "running_subtasks": sum(
+                    jr.n_running for jr in self._jobs.values()
+                ),
             },
         )
         self._hb = asyncio.ensure_future(self._heartbeat())
         logger.info(
-            "worker %s up (rpc %s, data %s)", self.worker_id, self.rpc_addr,
-            self.data_addr,
+            "worker %s up (rpc %s, data %s%s)", self.worker_id,
+            self.rpc_addr, self.data_addr, ", pooled" if self.pooled else "",
         )
         return self
 
@@ -138,7 +195,9 @@ class WorkerServer:
             if chaos.fire("worker.kill", worker_id=self.worker_id):
                 # SIGKILL-equivalent: tear everything down abruptly, no
                 # goodbye to the controller — it must detect the death via
-                # heartbeat timeout and recover from the last checkpoint
+                # heartbeat timeout and recover from the last checkpoint.
+                # In a shared pool this is the shared-fate mode: EVERY
+                # job with subtasks here fails and recovers independently.
                 logger.warning(
                     "chaos[worker.kill]: abrupt teardown of worker %s",
                     self.worker_id,
@@ -156,10 +215,27 @@ class WorkerServer:
                 )
                 await asyncio.sleep(float(spec.param("duration", 3.0)))
             try:
-                await self.controller.call(
+                resp = await self.controller.call(
                     "ControllerGrpc", "Heartbeat",
                     {"worker_id": self.worker_id, "time": now_nanos()},
                 )
+                if resp.get("known") is False:
+                    # the controller pruned us (stalled heartbeats read
+                    # as death): re-register so the pool registry heals
+                    logger.warning(
+                        "worker %s unknown to controller; re-registering",
+                        self.worker_id,
+                    )
+                    await self.controller.call(
+                        "ControllerGrpc", "RegisterWorker",
+                        {
+                            "worker_id": self.worker_id,
+                            "rpc_addr": self.rpc_addr,
+                            "data_addr": self.data_addr,
+                            "slots": config().worker.task_slots,
+                            "pooled": self.pooled,
+                        },
+                    )
             except Exception as e:  # noqa: BLE001
                 logger.warning("heartbeat failed: %s", e)
             await asyncio.sleep(config().worker.heartbeat_interval)
@@ -195,16 +271,20 @@ class WorkerServer:
             (a["node_id"], a["subtask"]): a["worker_id"]
             for a in req["assignments"]
         }
-        self._assignments = assignments
         worker_addrs = {
             int(w): addr for w, addr in req["worker_data_addrs"].items()
         }
-        self.job_id = req["job_id"]
-        program = Program(graph, self.job_id)
+        job_id = req["job_id"]
+        # a stale incarnation of the same job (recovery rescheduling onto
+        # the same pool worker) must be gone before fresh routes register
+        stale = self._jobs.pop(job_id, None)
+        if stale is not None:
+            await self._teardown_job(stale, force=True)
+        program = Program(graph, job_id)
         if req.get("storage_url"):
             from ..state.backend import StateBackend
 
-            backend = StateBackend(req["storage_url"], self.job_id)
+            backend = StateBackend(req["storage_url"], job_id)
             backend.generation = req.get("generation")
             if req.get("restore_epoch") is not None:
                 from ..state import protocol
@@ -213,25 +293,28 @@ class WorkerServer:
                     backend.storage, backend.paths, req["restore_epoch"]
                 )
             program.with_state(backend)
+        data_ns = req.get("data_ns") or f"{job_id}@0"
         program.build(
             assignments=assignments,
             my_worker=self.worker_id,
             worker_addrs=worker_addrs,
             data_server=self.data,
+            data_ns=data_ns,
         )
-        self.program = program
-        self._is_leader = bool(req.get("is_leader"))
-        self._worker_rpc_addrs = {
+        jr = _JobRuntime(job_id, program, data_ns)
+        jr.assignments = assignments
+        jr.is_leader = bool(req.get("is_leader"))
+        jr.worker_rpc_addrs = {
             int(w): a for w, a in (req.get("worker_rpc_addrs") or {}).items()
         }
-        self._lead_interval = req.get("checkpoint_interval")
-        self._n_total_subtasks = req.get("n_subtasks") or len(
+        jr.lead_interval = req.get("checkpoint_interval")
+        jr.n_total_subtasks = req.get("n_subtasks") or len(
             req["assignments"]
         )
-        self._leader_epoch = req.get("restore_epoch") or 0
+        jr.leader_epoch = req.get("restore_epoch") or 0
         leader_addr = req.get("leader_addr")
-        if leader_addr and not self._is_leader:
-            self._leader_client = RpcClient(leader_addr)
+        if leader_addr and not jr.is_leader:
+            jr.leader_client = RpcClient(leader_addr)
 
         def pump_failed(quad, exc):
             program.control_resp.put_nowait(
@@ -244,6 +327,7 @@ class WorkerServer:
         for rs in program.remote_senders:
             rs.on_error = pump_failed
             await rs.start()
+        self._jobs[job_id] = jr
         return {"subtasks": len(program.subtasks)}
 
     async def start_processing(self, req: dict) -> dict:
@@ -251,14 +335,13 @@ class WorkerServer:
         Engine::start, engine.rs:525): runners only spawn once every worker
         has built its partition and registered its data-plane routes, so a
         fast source can't race peers' route registration."""
-        program = self.program
-        for sub in program.subtasks:
-            self.tasks.append(asyncio.ensure_future(sub.runner.run()))
-        self._n_running = len(program.subtasks)
-        self._pump_task = asyncio.ensure_future(self._pump_responses())
-        self._running.set()
-        if self._is_leader and self._lead_interval is not None:
-            self._lead_task = asyncio.ensure_future(self._lead_loop())
+        jr = self._job(req)
+        for sub in jr.program.subtasks:
+            jr.tasks.append(asyncio.ensure_future(sub.runner.run()))
+        jr.n_running = len(jr.program.subtasks)
+        jr.pump_task = asyncio.ensure_future(self._pump_responses(jr))
+        if jr.is_leader and jr.lead_interval is not None:
+            jr.lead_task = asyncio.ensure_future(self._lead_loop(jr))
         return {}
 
     async def checkpoint(self, req: dict) -> dict:
@@ -268,6 +351,7 @@ class WorkerServer:
             # stretch barrier alignment: peers' barriers race ahead while
             # this worker's sources delay injecting theirs
             await asyncio.sleep(float(spec.param("delay", 0.5)))
+        jr = self._job(req)
         # flight recorder: the barrier inherits the epoch trace from the
         # controller's rpc (ambient context) and carries it in-band
         with obs.span("worker.checkpoint", cat="worker",
@@ -277,11 +361,12 @@ class WorkerServer:
                 timestamp=now_nanos(), then_stop=req.get("then_stop", False),
                 trace_id=sp.trace_id, span_id=sp.span_id,
             )
-            for sub in self.program.source_subtasks():
+            for sub in jr.program.source_subtasks():
                 sub.control_rx.put_nowait(CheckpointMsg(barrier))
         return {}
 
     async def commit(self, req: dict) -> dict:
+        jr = self._job(req)
         data: Dict[int, dict] = {}
         for node_id, subs in (req.get("committing") or {}).items():
             data[int(node_id)] = {"data": {int(s): v for s, v in subs.items()}}
@@ -291,27 +376,81 @@ class WorkerServer:
             # phase-2 commits ride the control queue; attach the rpc's
             # trace so sink commit spans join the epoch tree
             msg.trace_id, msg.span_id = ctx
-        for sub in self.program.subtasks:
+        for sub in jr.program.subtasks:
             sub.control_rx.put_nowait(msg)
         return {}
 
     async def load_compacted(self, req: dict) -> dict:
         """Swap an operator table's file references for a compacted file
         (controller-driven compaction; reference LoadCompacted control)."""
-        if self.program is not None:
-            self.program.send_load_compacted(req)
+        jr = self._jobs.get(req.get("job_id")) if req.get("job_id") else (
+            next(iter(self._jobs.values())) if len(self._jobs) == 1 else None
+        )
+        if jr is not None:
+            jr.program.send_load_compacted(req)
         return {}
 
     async def stop_execution(self, req: dict) -> dict:
+        jr = self._job(req)
         mode = StopMode(req.get("mode", "graceful"))
         targets = (
-            self.program.source_subtasks()
+            jr.program.source_subtasks()
             if mode == StopMode.GRACEFUL
-            else self.program.subtasks
+            else jr.program.subtasks
         )
         for sub in targets:
             sub.control_rx.put_nowait(StopMsg(mode))
         return {}
+
+    async def stop_job_rpc(self, req: dict) -> dict:
+        """Per-job teardown on a shared worker: cancel exactly this job's
+        runners/pump/senders, unregister its data-plane routes, and (on
+        `expunge` — terminal job states) drop its metric series. Jobs
+        co-resident on this worker are untouched. Idempotent."""
+        jid = req.get("job_id")
+        jr = self._jobs.pop(jid, None)
+        if jr is not None:
+            await self._teardown_job(jr, force=bool(req.get("force", True)))
+        if req.get("expunge"):
+            from ..metrics import REGISTRY
+
+            ttl = float(config().cluster.metrics_ttl or 0)
+            if ttl <= 0:
+                REGISTRY.drop_job(jid)
+            else:
+                # grace window: UIs read a just-finished job's metric
+                # groups; the series drop lands after they could have
+                asyncio.get_event_loop().call_later(
+                    ttl, REGISTRY.drop_job, jid
+                )
+        return {"hosted": jr is not None}
+
+    async def _teardown_job(self, jr: _JobRuntime, force: bool = True):
+        """Cancel one job runtime's work and release its resources. The
+        route namespace is unregistered FIRST so a straggler frame of
+        this incarnation can never land in queues a restarted incarnation
+        is about to register."""
+        if jr.torn_down:
+            return
+        jr.torn_down = True
+        self.data.unregister_ns(jr.data_ns)
+        for t in jr.tasks:
+            t.cancel()
+        for attr in ("pump_task", "lead_task", "current_ck"):
+            t = getattr(jr, attr, None)
+            if t is not None:
+                t.cancel()
+        await asyncio.gather(*jr.tasks, return_exceptions=True)
+        if jr.pump_task is not None:
+            await asyncio.gather(jr.pump_task, return_exceptions=True)
+        for rs in jr.program.remote_senders:
+            if rs.task is not None:
+                rs.task.cancel()
+            if rs.writer is not None:
+                rs.writer.close()
+        if jr.leader_client is not None:
+            await jr.leader_client.close()
+        jr.finished.set()
 
     async def get_metrics(self, req: dict) -> dict:
         from ..metrics import REGISTRY
@@ -330,12 +469,13 @@ class WorkerServer:
         """Leader intake: a peer subtask finished its checkpoint. A
         resigned leader relays to the controller (which took the cadence)
         instead of swallowing the report."""
-        if self._resigned:
+        jr = self._job(req)
+        if jr.resigned:
             await self.controller.call(
                 "ControllerGrpc", "TaskCheckpointCompleted", req
             )
         else:
-            self._leader_intake(req)
+            self._leader_intake(jr, req)
         return {}
 
     async def checkpoint_stop(self, req: dict) -> dict:
@@ -343,47 +483,48 @@ class WorkerServer:
         path in worker-leader mode). An in-flight cadence checkpoint runs
         to completion first — cancelling it mid barrier fan-out would
         interleave two epochs' barriers in the pipeline."""
-        if self._lead_task is not None:
-            self._lead_task.cancel()
-        ck = self._current_ck
+        jr = self._job(req)
+        if jr.lead_task is not None:
+            jr.lead_task.cancel()
+        ck = jr.current_ck
         if ck is not None:
             await asyncio.gather(ck, return_exceptions=True)
-        await self._lead_checkpoint(then_stop=True)
+        await self._lead_checkpoint(jr, then_stop=True)
         # report only durable progress: an incomplete/timed-out stop
         # checkpoint must not advance the controller's epoch bookkeeping
-        return {"epoch": self._leader_durable}
+        return {"epoch": jr.leader_durable}
 
-    def _leader_intake(self, d: dict):
+    def _leader_intake(self, jr: _JobRuntime, d: dict):
         # late reports for epochs already published/abandoned would leak
-        if d["epoch"] <= self._leader_published:
+        if d["epoch"] <= jr.leader_published:
             return
-        self._leader_reports.setdefault(d["epoch"], {})[d["task_id"]] = d
+        jr.leader_reports.setdefault(d["epoch"], {})[d["task_id"]] = d
 
-    def _evict_reports(self, up_to_epoch: int):
+    def _evict_reports(self, jr: _JobRuntime, up_to_epoch: int):
         """Drop report state for epochs <= up_to_epoch (published, timed
         out, or abandoned) so stragglers can't grow memory unboundedly."""
-        self._leader_published = max(self._leader_published, up_to_epoch)
-        for e in [e for e in self._leader_reports if e <= up_to_epoch]:
-            del self._leader_reports[e]
+        jr.leader_published = max(jr.leader_published, up_to_epoch)
+        for e in [e for e in jr.leader_reports if e <= up_to_epoch]:
+            del jr.leader_reports[e]
 
-    def _peer(self, wid: int) -> RpcClient:
+    def _peer(self, jr: _JobRuntime, wid: int) -> RpcClient:
         if wid not in self._peer_clients:
-            self._peer_clients[wid] = RpcClient(self._worker_rpc_addrs[wid])
+            self._peer_clients[wid] = RpcClient(jr.worker_rpc_addrs[wid])
         return self._peer_clients[wid]
 
-    async def _lead_loop(self):
+    async def _lead_loop(self, jr: _JobRuntime):
         try:
-            while not self._finished.is_set():
-                await asyncio.sleep(self._lead_interval)
-                if self._finished.is_set() or self._n_running <= 0:
+            while not jr.finished.is_set():
+                await asyncio.sleep(jr.lead_interval)
+                if jr.finished.is_set() or jr.n_running <= 0:
                     return
                 # shielded: a CheckpointStop cancels THIS loop but must let
-                # the in-flight checkpoint finish (it reaps _current_ck)
-                self._current_ck = asyncio.ensure_future(
-                    self._lead_checkpoint(then_stop=False)
+                # the in-flight checkpoint finish (it reaps current_ck)
+                jr.current_ck = asyncio.ensure_future(
+                    self._lead_checkpoint(jr, then_stop=False)
                 )
                 try:
-                    await asyncio.shield(self._current_ck)
+                    await asyncio.shield(jr.current_ck)
                 except asyncio.CancelledError:
                     raise
                 except Exception:  # noqa: BLE001
@@ -395,59 +536,64 @@ class WorkerServer:
         except Exception:  # noqa: BLE001
             logger.exception("leader checkpoint loop failed")
 
-    async def _lead_checkpoint(self, then_stop: bool) -> int:
+    async def _lead_checkpoint(self, jr: _JobRuntime, then_stop: bool) -> int:
         """One full checkpoint driven by the leader worker: barrier fan-out,
         report collection, manifest publish, 2PC commit, compaction + GC
         (reference WorkerJobController, job_controller/controller.rs)."""
-        backend = self.program._state_backend
+        backend = jr.program._state_backend
         if backend is None:
             return 0
-        self._lead_active += 1
-        self._lead_idle.clear()
+        jr.lead_active += 1
+        jr.lead_idle.clear()
         try:
-            return await self._lead_checkpoint_inner(then_stop, backend)
+            return await self._lead_checkpoint_inner(jr, then_stop, backend)
         finally:
-            self._lead_active -= 1
-            if self._lead_active == 0:
-                self._lead_idle.set()
+            jr.lead_active -= 1
+            if jr.lead_active == 0:
+                jr.lead_idle.set()
 
-    async def _lead_checkpoint_inner(self, then_stop: bool, backend) -> int:
-        self._leader_epoch += 1
-        epoch = self._leader_epoch
+    async def _lead_checkpoint_inner(self, jr: _JobRuntime, then_stop: bool,
+                                     backend) -> int:
+        jr.leader_epoch += 1
+        epoch = jr.leader_epoch
         # worker-leader mode mints the epoch trace here — same tree shape
         # as the controller-driven cadence, rooted in the leader's process
         with obs.span(
-            "checkpoint", trace=obs.new_trace(self.job_id, f"ck-{epoch}"),
-            cat="controller", job=self.job_id, epoch=epoch,
+            "checkpoint", trace=obs.new_trace(jr.job_id, f"ck-{epoch}"),
+            cat="controller", job=jr.job_id, epoch=epoch,
             leader=self.worker_id, then_stop=then_stop,
         ):
-            return await self._lead_checkpoint_run(epoch, then_stop, backend)
+            return await self._lead_checkpoint_run(jr, epoch, then_stop,
+                                                   backend)
 
-    async def _lead_checkpoint_run(self, epoch: int, then_stop: bool,
-                                   backend) -> int:
-        for wid in self._worker_rpc_addrs:
-            payload = {"epoch": epoch, "then_stop": then_stop}
+    async def _lead_checkpoint_run(self, jr: _JobRuntime, epoch: int,
+                                   then_stop: bool, backend) -> int:
+        for wid in jr.worker_rpc_addrs:
+            payload = {"job_id": jr.job_id, "epoch": epoch,
+                       "then_stop": then_stop}
             if wid == self.worker_id:
                 await self.checkpoint(payload)
             else:
-                await self._peer(wid).call("WorkerGrpc", "Checkpoint", payload)
+                await self._peer(jr, wid).call(
+                    "WorkerGrpc", "Checkpoint", payload
+                )
         deadline = time.monotonic() + 60
         last_progress = time.monotonic()
         seen = 0
-        while len(self._leader_reports.get(epoch, {})) < self._n_total_subtasks:
-            n = len(self._leader_reports.get(epoch, {}))
+        while len(jr.leader_reports.get(epoch, {})) < jr.n_total_subtasks:
+            n = len(jr.leader_reports.get(epoch, {}))
             if n > seen:
                 seen, last_progress = n, time.monotonic()
             if time.monotonic() > deadline:
                 logger.warning("leader: checkpoint %d incomplete", epoch)
-                self._evict_reports(epoch)
+                self._evict_reports(jr, epoch)
                 return epoch
-            if self._n_running <= 0 and not then_stop:
+            if jr.n_running <= 0 and not then_stop:
                 logger.info("leader: checkpoint %d abandoned (job finished)",
                             epoch)
-                self._evict_reports(epoch)
+                self._evict_reports(jr, epoch)
                 return epoch
-            if (then_stop and self._finished.is_set()
+            if (then_stop and jr.finished.is_set()
                     and time.monotonic() - last_progress > 5.0):
                 # leader's own tasks finished and can't report; remaining
                 # peers stalled too — don't hold the stop for 60s
@@ -455,42 +601,44 @@ class WorkerServer:
                     "leader: stop checkpoint %d abandoned (no report "
                     "progress after local finish)", epoch,
                 )
-                self._evict_reports(epoch)
+                self._evict_reports(jr, epoch)
                 return epoch
             await asyncio.sleep(0.02)
-        reports = self._leader_reports.pop(epoch)
-        self._evict_reports(epoch)
+        reports = jr.leader_reports.pop(epoch)
+        self._evict_reports(jr, epoch)
         manifest = backend.publish_checkpoint(
             epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
         )
-        self._leader_durable = epoch
+        jr.leader_durable = epoch
         committing = manifest.get("committing")
         if committing and backend.claim_commit(epoch):
             # same worker targeting as the controller path: only peers
             # hosting committing subtasks get the phase-2 fan-out
             commit_workers = {
-                wid for (nid, _sub), wid in self._assignments.items()
+                wid for (nid, _sub), wid in jr.assignments.items()
                 if str(nid) in committing
             }
-            for wid in self._worker_rpc_addrs:
+            for wid in jr.worker_rpc_addrs:
                 if wid not in commit_workers:
                     continue
-                payload = {"epoch": epoch, "committing": committing}
+                payload = {"job_id": jr.job_id, "epoch": epoch,
+                           "committing": committing}
                 if wid == self.worker_id:
                     await self.commit(payload)
                 else:
-                    await self._peer(wid).call(
+                    await self._peer(jr, wid).call(
                         "WorkerGrpc", "Commit", payload
                     )
         swaps = await asyncio.to_thread(backend.compact_epoch, epoch, manifest)
         for swap in swaps:
-            for wid in self._worker_rpc_addrs:
+            for wid in jr.worker_rpc_addrs:
                 if wid == self.worker_id:
-                    self.program.send_load_compacted(swap)
+                    jr.program.send_load_compacted(swap)
                 else:
                     try:
-                        await self._peer(wid).call(
-                            "WorkerGrpc", "LoadCompacted", swap
+                        await self._peer(jr, wid).call(
+                            "WorkerGrpc", "LoadCompacted",
+                            {**swap, "job_id": jr.job_id},
                         )
                     except Exception as e:  # noqa: BLE001
                         logger.warning("LoadCompacted to %s failed: %s",
@@ -499,7 +647,8 @@ class WorkerServer:
         try:
             await self.controller.call(
                 "ControllerGrpc", "LeaderCheckpointFinished",
-                {"worker_id": self.worker_id, "epoch": epoch},
+                {"worker_id": self.worker_id, "job_id": jr.job_id,
+                 "epoch": epoch},
             )
         except Exception as e:  # noqa: BLE001
             logger.warning("leader checkpoint report failed: %s", e)
@@ -507,42 +656,48 @@ class WorkerServer:
 
     # -- task event forwarding ---------------------------------------------
 
-    async def _pump_responses(self):
-        q = self.program.control_resp
-        while self._n_running > 0:
+    async def _pump_responses(self, jr: _JobRuntime):
+        q = jr.program.control_resp
+        while jr.n_running > 0:
             resp = await q.get()
             try:
-                await self._forward(resp)
+                await self._forward(jr, resp)
             except Exception as e:  # noqa: BLE001
                 logger.warning("event forward failed: %s", e)
-        self._finished.set()
-        if self._is_leader:
+        jr.finished.set()
+        if not self.pooled and all(
+            j.finished.is_set() for j in self._jobs.values()
+        ):
+            self._finished.set()
+        if jr.is_leader:
             # local work ended; resign leadership so the controller takes
             # over the checkpoint cadence for any still-running peers. Wait
             # out an in-flight leader checkpoint first: resigning mid-epoch
             # would let the controller drive the same epoch concurrently.
-            if self._lead_task is not None:
-                self._lead_task.cancel()
-            await self._lead_idle.wait()
-            self._resigned = True
+            if jr.lead_task is not None:
+                jr.lead_task.cancel()
+            await jr.lead_idle.wait()
+            jr.resigned = True
             try:
                 await self.controller.call(
                     "ControllerGrpc", "LeaderResigned",
-                    {"worker_id": self.worker_id,
-                     "epoch": self._leader_epoch},
+                    {"worker_id": self.worker_id, "job_id": jr.job_id,
+                     "epoch": jr.leader_epoch},
                 )
             except Exception as e:  # noqa: BLE001
                 logger.warning("leader resignation failed: %s", e)
         await self.controller.call(
-            "ControllerGrpc", "WorkerFinished", {"worker_id": self.worker_id}
+            "ControllerGrpc", "WorkerFinished",
+            {"worker_id": self.worker_id, "job_id": jr.job_id},
         )
 
-    async def _forward(self, resp):
+    async def _forward(self, jr: _JobRuntime, resp):
         c = self.controller
         wid = self.worker_id
         if isinstance(resp, CheckpointCompletedResp):
             payload = {
                 "worker_id": wid,
+                "job_id": jr.job_id,
                 "task_id": resp.task_id,
                 "node_id": resp.node_id,
                 "subtask": resp.subtask_index,
@@ -558,11 +713,11 @@ class WorkerServer:
             # a TRANSIENT leader rpc failure also diverts this report, so
             # that epoch waits out its deadline unpublished — the next
             # cadence tick retries with a fresh epoch.
-            if self._is_leader:
-                self._leader_intake(payload)
-            elif self._leader_client is not None:
+            if jr.is_leader:
+                self._leader_intake(jr, payload)
+            elif jr.leader_client is not None:
                 try:
-                    await self._leader_client.call(
+                    await jr.leader_client.call(
                         "WorkerGrpc", "TaskCheckpointCompleted", payload
                     )
                 except Exception:  # noqa: BLE001
@@ -577,44 +732,43 @@ class WorkerServer:
             await c.call(
                 "ControllerGrpc", "TaskCheckpointEvent",
                 {
-                    "worker_id": wid, "task_id": resp.task_id,
+                    "worker_id": wid, "job_id": jr.job_id,
+                    "task_id": resp.task_id,
                     "epoch": resp.epoch, "event": resp.event,
                 },
             )
         elif isinstance(resp, TaskFinishedResp):
-            self._n_running -= 1
+            jr.n_running -= 1
             await c.call(
                 "ControllerGrpc", "TaskFinished",
-                {"worker_id": wid, "task_id": resp.task_id},
+                {"worker_id": wid, "job_id": jr.job_id,
+                 "task_id": resp.task_id},
             )
         elif isinstance(resp, TaskFailedResp):
-            self._n_running -= 1
+            jr.n_running -= 1
             await c.call(
                 "ControllerGrpc", "TaskFailed",
-                {"worker_id": wid, "task_id": resp.task_id,
-                 "error": resp.error},
+                {"worker_id": wid, "job_id": jr.job_id,
+                 "task_id": resp.task_id, "error": resp.error},
             )
 
     async def shutdown(self):
-        """Force teardown: cancel every task and close servers/clients so a
-        force-stopped embedded worker leaves no heartbeats or runners
-        behind. Idempotent: a chaos-killed worker is shut down again by
-        the recovery teardown."""
+        """Force teardown: cancel every job's tasks and close
+        servers/clients so a force-stopped embedded worker leaves no
+        heartbeats or runners behind. Idempotent: a chaos-killed worker is
+        shut down again by the recovery teardown."""
         if getattr(self, "_shutdown_started", False):
             return
         self._shutdown_started = True
         self._finished.set()
-        for t in self.tasks:
+        for jr in list(self._jobs.values()):
+            await self._teardown_job(jr, force=True)
+        self._jobs.clear()
+        t = getattr(self, "_hb", None)
+        if t is not None:
             t.cancel()
-        for attr in ("_hb", "_pump_task", "_lead_task", "_current_ck"):
-            t = getattr(self, attr, None)
-            if t is not None:
-                t.cancel()
-        await asyncio.gather(*self.tasks, return_exceptions=True)
         if self.controller is not None:
             await self.controller.close()
-        if self._leader_client is not None:
-            await self._leader_client.close()
         for c in self._peer_clients.values():
             await c.close()
         if getattr(self, "_admin", None) is not None:
@@ -623,16 +777,25 @@ class WorkerServer:
         await self.data.stop()
 
     async def run_until_finished(self):
+        """Dedicated-worker lifecycle: serve until the hosted job's local
+        work ends, then tear down (the process/embedded per-job mode)."""
         await self._finished.wait()
-        await asyncio.gather(*self.tasks, return_exceptions=True)
-        # a leader must finish its in-flight checkpoint (peer reports are
-        # still arriving over this worker's rpc server) before teardown
-        await self._lead_idle.wait()
+        for jr in self._jobs.values():
+            await asyncio.gather(*jr.tasks, return_exceptions=True)
+            # a leader must finish its in-flight checkpoint (peer reports
+            # are still arriving over this worker's rpc server) first
+            await jr.lead_idle.wait()
         self._hb.cancel()
         await asyncio.gather(self._hb, return_exceptions=True)
         await self.controller.close()
         await self.rpc.stop()
         await self.data.stop()
+
+    async def serve_forever(self):
+        """Pooled-worker lifecycle: serve jobs until shut down (the pool
+        owner — scheduler or process signal — ends the worker, never job
+        completion)."""
+        await self._finished.wait()
 
 
 async def worker_main(controller_addr: str):
@@ -643,6 +806,10 @@ async def worker_main(controller_addr: str):
     from ..parallel.multihost import ensure_initialized
 
     ensure_initialized()
-    w = WorkerServer(controller_addr)
+    pooled = os.environ.get("ARROYO_WORKER_POOLED") == "1"
+    w = WorkerServer(controller_addr, pooled=pooled)
     await w.start()
-    await w.run_until_finished()
+    if pooled:
+        await w.serve_forever()
+    else:
+        await w.run_until_finished()

@@ -282,6 +282,28 @@ class Registry:
                     m.hists.clear()
                     m.refreshers.clear()
 
+    def drop_job(self, job_id: str) -> int:
+        """Cardinality GC: remove every label set carrying job=job_id
+        (values, histograms, refreshers) across all families. Without
+        this, a 1000-job churn run grows /metrics exposition unboundedly
+        — per-subtask counters, queue gauges and latency histograms of
+        stopped jobs would be scraped forever. Handles held by a live
+        producer of the dropped job recreate a zeroed entry on their next
+        write, which is the counter-restart shape every consumer already
+        tolerates. Returns the number of label sets removed."""
+        match = ("job", job_id)
+        dropped = 0
+        with self.lock:
+            metrics = list(self.metrics.values())
+        for m in metrics:
+            with m.lock:
+                for store in (m.values, m.hists, m.refreshers):
+                    stale = [k for k in store if match in k]
+                    for k in stale:
+                        del store[k]
+                    dropped += len(stale)
+        return dropped
+
 
 REGISTRY = Registry()
 

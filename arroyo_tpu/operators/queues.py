@@ -33,7 +33,8 @@ class QueueClosed(Exception):
 class BatchQueue:
     """One edge queue between a (src_subtask, dst_subtask) pair."""
 
-    def __init__(self, max_batches: int, max_bytes: int, name: str = ""):
+    def __init__(self, max_batches: int, max_bytes: int, name: str = "",
+                 job: str = ""):
         self.max_batches = max(1, max_batches)
         self.max_bytes = max(1, max_bytes)
         self.name = name
@@ -43,8 +44,12 @@ class BatchQueue:
         self._readable = asyncio.Event()
         self._writable = asyncio.Event()
         self._writable.set()
-        self._size_gauge = QUEUE_SIZE.labels(queue=name) if name else None
-        self._bytes_gauge = QUEUE_BYTES.labels(queue=name) if name else None
+        # the job label lets the cardinality GC (Registry.drop_job) drop a
+        # stopped job's queue series in one pass — multiplexed workers
+        # otherwise accumulate every churned job's gauges forever
+        labels = {"queue": name, **({"job": job} if job else {})}
+        self._size_gauge = QUEUE_SIZE.labels(**labels) if name else None
+        self._bytes_gauge = QUEUE_BYTES.labels(**labels) if name else None
         if name:
             # the push/pop updates only run on the producer/consumer hot
             # paths, so a scrape between events (or after the last event —

@@ -577,6 +577,43 @@ def run_child(events: int, backend: str, timeout: float, env=None,
     return result
 
 
+def fleet_main(args):
+    """Run tools/fleet_harness.py as a child (fresh interpreter: the
+    harness hosts controller + pooled workers + REST server in-process)
+    and emit its metrics as a bench JSON line with the contention stamp
+    every other bench number carries."""
+    contended, cal = contention_probe()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "fleet_harness.py"),
+         "--jobs", str(args.fleet_jobs), "--pool", str(args.fleet_pool)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    report = {}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            report = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if not report:
+        sys.stderr.write(out.stderr[-2000:] + "\n")
+    # no "value" key: the fleet line is gated against the SAME
+    # BENCH_BASELINE.json as the q-suite line, and bench_compare gates
+    # every key present in both docs — a fleet "value" would collide
+    # with the q5 headline
+    print(json.dumps({
+        "metric": "fleet_jobs_per_controller",
+        "unit": "jobs",
+        "contended": contended,
+        **cal,
+        **{k: v for k, v in report.items() if k.startswith("fleet_")},
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=1_000_000)
@@ -599,7 +636,18 @@ def main():
     # median-of-n for every CPU measurement (single-shot numbers on the
     # 1-core bench host swing ±15%+; VERDICT r4 item 5)
     ap.add_argument("--repeats", type=int, default=3)
+    # fleet churn harness (ISSUE 10): drive N concurrent tiny pipelines
+    # through the REST API against one controller + shared worker pool
+    # and report jobs_per_controller / idle CPU per job / API p99 —
+    # printed as its own bench JSON line (gateable by bench_compare
+    # against the fleet_* keys in BENCH_BASELINE.json)
+    ap.add_argument("--fleet", action="store_true")
+    ap.add_argument("--fleet-jobs", type=int, default=100)
+    ap.add_argument("--fleet-pool", type=int, default=2)
     args = ap.parse_args()
+    if args.fleet:
+        fleet_main(args)
+        return
     if args.state_child:
         state_child(args.events)
         return

@@ -96,6 +96,11 @@ def classify(metric: str) -> Optional[str]:
     # amortized upload volume both regress UPWARD
     if metric.endswith("_ms_p99") or metric.endswith("_bytes_per_epoch"):
         return "lower"
+    # multi-tenant control plane (ISSUE 10): concurrent jobs one
+    # controller holds regresses DOWNWARD; idle CPU per parked job and
+    # API p99 (both *_ms) already classify as lower-is-better above
+    if metric.endswith("_jobs_per_controller"):
+        return "higher"
     return None
 
 

@@ -1,0 +1,383 @@
+#!/usr/bin/env python3
+"""Multi-tenant fleet churn harness (ISSUE 10, ROADMAP item 3).
+
+Drives create/preview/run/stop churn of hundreds of TINY pipelines
+through the REAL REST API against one controller + one shared
+multiplexed worker pool (the "millions of users" proxy), and reports the
+control-plane scaling metrics the bench gate pins:
+
+  fleet_jobs_per_controller   max concurrently RUNNING jobs one
+                              controller held (higher is better);
+  fleet_idle_cpu_ms           process CPU milliseconds per PARKED job
+                              per second at full scale (lower is better
+                              — the event-driven controller makes idle
+                              cost ~O(changed jobs), not O(jobs)·50 Hz);
+  fleet_api_p99_ms            REST p99 latency under churn (lower);
+  fleet_idle_cpu_flatness     total idle CPU at full scale over total at
+                              quarter scale (diagnostic: ~1 means idle
+                              cost is flat in job count; the old poll
+                              loops measured ~4, i.e. linear);
+  fleet_wakeups_per_job_s     controller driver wakeups per parked
+                              job-second (diagnostic; poll loops burned
+                              50/s);
+  fleet_exactly_once_ok       1 iff every sampled bounded job's output
+                              was byte-identical to its solo run.
+
+Exactly-once under churn: a sample of bounded deterministic impulse
+pipelines runs INSIDE the churning fleet; each output is compared
+byte-for-byte (canonical sorted JSON rows) against a solo run of the
+same SQL on a fresh single-job cluster. `--kill` additionally SIGKILLs
+one pool worker mid-churn, so the sampled jobs prove recovery-under-
+multiplexing (the fast-tier smoke test always does).
+
+Usage:
+  python tools/fleet_harness.py --jobs 100 --pool 2 --sample 8 \
+      [--churn 30] [--idle-seconds 10] [--kill] [--out fleet.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def sample_sql(outdir: str, tag: str, j: int, events: int) -> str:
+    """Bounded deterministic pipeline: byte-identical across runs."""
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '1000000',
+      message_count = '{events}', start_time = '0'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{outdir}/{tag}-{j}.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % 8 as k, tumble(interval '1 millisecond') as w,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+def parked_sql(outdir: str, j: int) -> str:
+    """A realtime trickle source (one event per 20 s): RUNNING but idle —
+    the parked-job shape whose control-plane cost the harness measures."""
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '0.05',
+      message_count = '1000000', start_time = '0', realtime = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{outdir}/parked-{j}.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % 4 as k, tumble(interval '1 second') as w,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+def canonical_rows(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return sorted(
+            json.dumps(json.loads(line), sort_keys=True)
+            for line in f if line.strip()
+        )
+
+
+def pct(samples: list, q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class _Api:
+    """Timed aiohttp client against the harness's REST server: every call
+    lands in the latency sample set the p99 gate reads."""
+
+    def __init__(self, session, base: str, latencies: list):
+        self.session = session
+        self.base = base
+        self.latencies = latencies
+
+    async def call(self, method: str, path: str, **kw):
+        t0 = time.monotonic()
+        async with self.session.request(
+            method, self.base + path, **kw
+        ) as resp:
+            body = await resp.json()
+        self.latencies.append((time.monotonic() - t0) * 1e3)
+        return resp.status, body
+
+
+async def _measure_idle(controller, n_jobs: int, seconds: float) -> dict:
+    """Park and measure: process CPU + controller driver wakeups over a
+    window with every fleet job RUNNING-idle."""
+    w0 = sum(j.wakeups for j in controller.jobs.values())
+    c0 = time.process_time()
+    t0 = time.monotonic()
+    await asyncio.sleep(seconds)
+    wall = time.monotonic() - t0
+    cpu = time.process_time() - c0
+    wakeups = sum(j.wakeups for j in controller.jobs.values()) - w0
+    return {
+        "cpu_s": cpu,
+        "wall_s": wall,
+        "cpu_ms_per_job_s": 1e3 * cpu / wall / max(n_jobs, 1),
+        "wakeups_per_job_s": wakeups / wall / max(n_jobs, 1),
+    }
+
+
+async def run_fleet(jobs: int = 100, pool: int = 2, sample: int = 8,
+                    churn: int = 30, previews: int = 5,
+                    idle_seconds: float = 10.0, kill: bool = False,
+                    workdir: str | None = None) -> dict:
+    from aiohttp import ClientSession, web
+
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    workdir = workdir or tempfile.mkdtemp(prefix="arroyo-fleet-")
+    os.makedirs(workdir, exist_ok=True)
+    report: dict = {"jobs": jobs, "pool": pool, "sample": sample,
+                    "churn": churn, "workdir": workdir}
+    latencies: list = []
+
+    # fleet jobs are tiny + stateless: no checkpoint storage (the chaos
+    # drills own durable exactly-once; the sampled jobs prove exactly-
+    # once of the MULTIPLEXED data plane under churn + kill)
+    with update(
+        pipeline={"checkpointing": {"storage_url": ""}},
+        cluster={"worker_pool_size": pool, "metrics_ttl": 1.0},
+        controller={"heartbeat_timeout": 10.0},
+        # slots sized for tiny-job density: N one-slot parked jobs (plus
+        # in-flight churn) must all be admitted concurrently — slot count
+        # is the admission currency, not a thread count
+        worker={"task_slots": max(4, (jobs + sample + churn) // pool + 4)},
+        obs={"latency_marker_interval": 0.0, "enabled": False},
+    ):
+        sched = EmbeddedScheduler()
+        controller = await ControllerServer(sched).start()
+        app = build_app(controller,
+                        db_path=os.path.join(workdir, "fleet.db"))
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}/api/v1"
+
+        async with ClientSession() as session:
+            api = _Api(session, base, latencies)
+
+            # -- phase 1: churn — create/finish/stop/delete bounded jobs
+            churn_pids = []
+            for j in range(churn):
+                _, body = await api.call("post", "/pipelines", json={
+                    "name": f"churn-{j}", "tenant": f"t{j % 4}",
+                    "query": sample_sql(workdir, "churn", j,
+                                        500 + 100 * (j % 5)),
+                })
+                churn_pids.append(body["id"])
+                if j % 3 == 2:  # stop every third one mid-run
+                    await api.call("patch", f"/pipelines/{churn_pids[-1]}",
+                                   json={"stop": "immediate"})
+            for j in range(previews):
+                await api.call("post", "/pipelines/preview", json={
+                    "name": f"pv-{j}",
+                    "query": (
+                        "CREATE TABLE impulse WITH (connector='impulse', "
+                        "event_rate='100000', message_count='200', "
+                        "start_time='0'); "
+                        "SELECT counter % 3 AS k FROM impulse;"
+                    ),
+                    "timeout": 20,
+                })
+
+            # -- phase 2: sampled exactly-once jobs run inside the churn
+            sample_pids = []
+            for j in range(sample):
+                _, body = await api.call("post", "/pipelines", json={
+                    "name": f"sample-{j}", "tenant": "golden",
+                    "query": sample_sql(workdir, "fleet", j,
+                                        1000 + 200 * j),
+                })
+                sample_pids.append(body["id"])
+
+            if kill:
+                # SIGKILL-equivalent on one pool worker mid-churn: every
+                # job with subtasks there fails and must recover
+                # independently (shared-fate, per-job recovery)
+                await asyncio.sleep(0.5)
+                live = [w for w, _t in sched.pool
+                        if not getattr(w, "_shutdown_started", False)]
+                if live:
+                    report["killed_worker"] = live[0].worker_id
+                    await live[0].shutdown()
+
+            # -- phase 3: ramp parked jobs to quarter scale, measure idle
+            q_scale = max(jobs // 4, 1)
+            parked_pids = []
+
+            async def ramp_to(n):
+                while len(parked_pids) < n:
+                    j = len(parked_pids)
+                    _, body = await api.call("post", "/pipelines", json={
+                        "name": f"parked-{j}", "tenant": f"t{j % 4}",
+                        "query": parked_sql(workdir, j),
+                    })
+                    parked_pids.append(body["id"])
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    running = sum(
+                        1 for job in controller.jobs.values()
+                        if job.state == JobState.RUNNING
+                    )
+                    if running >= n:
+                        return running
+                    await asyncio.sleep(0.25)
+                return sum(1 for job in controller.jobs.values()
+                           if job.state == JobState.RUNNING)
+
+            await ramp_to(q_scale)
+            idle_q = await _measure_idle(controller, q_scale,
+                                         idle_seconds / 2)
+
+            # -- phase 4: full scale
+            running = await ramp_to(jobs)
+            jobs_per_controller = max(
+                running,
+                sum(1 for job in controller.jobs.values()
+                    if job.state == JobState.RUNNING),
+            )
+            idle_full = await _measure_idle(controller, jobs, idle_seconds)
+
+            # -- phase 5: wait the sampled jobs out, then stop the fleet
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                states = [
+                    controller.jobs[j.job_id].state
+                    for j in controller.jobs.values()
+                    if j.tenant == "golden"
+                ]
+                if states and all(s.is_terminal() for s in states):
+                    break
+                await asyncio.sleep(0.25)
+            for pid in parked_pids:
+                await api.call("patch", f"/pipelines/{pid}",
+                               json={"stop": "immediate"})
+            for pid in parked_pids[: len(parked_pids) // 2]:
+                await api.call("delete", f"/pipelines/{pid}")
+
+            admission = controller.admission.status()
+        await runner.cleanup()
+        await controller.stop()
+
+    # -- solo goldens: the same sampled SQL, one job per fresh cluster
+    async def solo_runs():
+        with update(
+            pipeline={"checkpointing": {"storage_url": ""}},
+            obs={"latency_marker_interval": 0.0, "enabled": False},
+        ):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                for j in range(sample):
+                    await c.submit_job(
+                        f"solo-{j}",
+                        sql=sample_sql(workdir, "solo", j, 1000 + 200 * j),
+                        n_workers=2, parallelism=1,
+                    )
+                    await c.wait_for_state(
+                        f"solo-{j}", JobState.FINISHED, JobState.FAILED,
+                        timeout=60,
+                    )
+            finally:
+                await c.stop()
+
+    await solo_runs()
+    mismatches = []
+    for j in range(sample):
+        fleet_rows = canonical_rows(os.path.join(workdir,
+                                                 f"fleet-{j}.json"))
+        solo_rows = canonical_rows(os.path.join(workdir, f"solo-{j}.json"))
+        if not fleet_rows or fleet_rows != solo_rows:
+            mismatches.append(j)
+
+    report.update({
+        "fleet_jobs_per_controller": jobs_per_controller,
+        "fleet_idle_cpu_ms": round(idle_full["cpu_ms_per_job_s"], 3),
+        "fleet_api_p99_ms": round(pct(latencies, 0.99), 2),
+        "fleet_api_p50_ms": round(pct(latencies, 0.50), 2),
+        "fleet_api_calls": len(latencies),
+        "fleet_idle_cpu_flatness": round(
+            idle_full["cpu_s"] / idle_full["wall_s"]
+            / max(idle_q["cpu_s"] / idle_q["wall_s"], 1e-9), 2,
+        ),
+        "fleet_wakeups_per_job_s": round(
+            idle_full["wakeups_per_job_s"], 3
+        ),
+        "fleet_idle_quarter_cpu_ms": round(
+            idle_q["cpu_ms_per_job_s"], 3
+        ),
+        "fleet_exactly_once_ok": 0 if mismatches else 1,
+        "fleet_sample_mismatches": mismatches,
+        "fleet_admission": admission,
+    })
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=100,
+                    help="parked pipelines at full scale")
+    ap.add_argument("--pool", type=int, default=2,
+                    help="shared worker pool size")
+    ap.add_argument("--sample", type=int, default=8,
+                    help="bounded exactly-once sample jobs")
+    ap.add_argument("--churn", type=int, default=30,
+                    help="create/stop churn pipelines")
+    ap.add_argument("--previews", type=int, default=5)
+    ap.add_argument("--idle-seconds", type=float, default=10.0)
+    ap.add_argument("--kill", action="store_true",
+                    help="SIGKILL one pool worker mid-churn")
+    ap.add_argument("--workdir")
+    ap.add_argument("--out", help="write the report JSON here")
+    args = ap.parse_args(argv)
+    report = asyncio.run(run_fleet(
+        jobs=args.jobs, pool=args.pool, sample=args.sample,
+        churn=args.churn, previews=args.previews,
+        idle_seconds=args.idle_seconds, kill=args.kill,
+        workdir=args.workdir,
+    ))
+    print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    if not report["fleet_exactly_once_ok"]:
+        print(f"EXACTLY-ONCE MISMATCH: jobs "
+              f"{report['fleet_sample_mismatches']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

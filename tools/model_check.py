@@ -48,6 +48,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from arroyo_tpu.analysis.model import explore as explore_mod  # noqa: E402
+from arroyo_tpu.analysis.model import multitenant as mt_mod  # noqa: E402
 from arroyo_tpu.analysis.model import mutants as mutants_mod  # noqa: E402
 from arroyo_tpu.analysis.model import replay as replay_mod  # noqa: E402
 from arroyo_tpu.analysis.model.extract import (  # noqa: E402
@@ -151,7 +152,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mutant", default=None,
                     help="run one named mutant (expects a counterexample)")
     ap.add_argument("--corpus", action="store_true",
-                    help="run the whole mutant regression corpus")
+                    help="run the whole mutant regression corpus "
+                         "(single-job + 2-job multitenant)")
+    ap.add_argument("--multi", action="store_true",
+                    help="only the 2-job shared-worker configuration "
+                         "(per-job recovery independence)")
     ap.add_argument("--list-mutants", action="store_true")
     ap.add_argument("--bijection-only", action="store_true")
     ap.add_argument("--trace-dir", default=None,
@@ -167,6 +172,10 @@ def main(argv=None) -> int:
             tag = " [historical PR 2 bug]" if m.historical else ""
             print(f"{m.name}{tag}\n    expects: {m.expect_violation}")
             print(f"    {m.description}\n")
+        for mm in mt_mod.MT_MUTANTS.values():
+            print(f"{mm.name} [multitenant]\n"
+                  f"    expects: {mm.expect_violation}")
+            print(f"    {mm.description}\n")
         return 0
 
     members, terminals, table = job_state_machine(load_project(args.root))
@@ -249,16 +258,72 @@ def main(argv=None) -> int:
         if args.sarif and res.violations:
             _write_sarif(args.sarif, res.violations)
 
-    if args.mutant or args.corpus:
-        names = ([args.mutant] if args.mutant
-                 else list(mutants_mod.MUTANTS))
+    def run_multi(cfg, name, expect=""):
+        nonlocal rc
+        t0 = time.time()
+        res = mt_mod.check_multitenant(
+            cfg, budget=args.budget, transitions=table,
+            terminals=terminals,
+        )
+        dt = time.time() - t0
+        entry = {
+            "name": name, "config": cfg._asdict(), "states": res.states,
+            "transitions": res.transitions, "exhaustive": res.exhaustive,
+            "seconds": round(dt, 2),
+            "violations": [t.violation for t in res.violations],
+        }
+        summary["runs"].append(entry)
+        if expect:
+            hit = [t for t in res.violations
+                   if t.violation.split(":", 1)[0] == expect]
+            if not hit:
+                print(f"{name}: MULTITENANT MUTANT NOT CAUGHT (expected "
+                      f"{expect}, got "
+                      f"{[t.violation for t in res.violations]})")
+                rc = rc or 1
+                return
+            print(f"{name}: caught {hit[0].violation.split(':', 1)[0]} "
+                  f"in {len(hit[0].events)} events (states={res.states})")
+            return
+        status = "exhaustive" if res.exhaustive else "TRUNCATED"
+        print(f"{name}: {res.states} states, {res.transitions} "
+              f"transitions, {status}, {dt:.1f}s")
+        if res.violations:
+            rc = 1
+            for t in res.violations:
+                print(f"  VIOLATION: {t.violation}")
+                for ev in t.events:
+                    print(f"    {ev[0]}{tuple(ev[1])}")
+        elif not res.exhaustive:
+            rc = 2
+
+    if args.multi:
+        run_multi(mt_mod.MTConfig(), "multitenant-2job")
+        for mm in mt_mod.MT_MUTANTS.values():
+            run_multi(mm.config, mm.name, expect=mm.expect_violation)
+    elif args.mutant or args.corpus:
+        if args.mutant and args.mutant in mt_mod.MT_MUTANTS:
+            mm = mt_mod.MT_MUTANTS[args.mutant]
+            run_multi(mm.config, mm.name, expect=mm.expect_violation)
+            names = []
+        else:
+            names = ([args.mutant] if args.mutant
+                     else list(mutants_mod.MUTANTS))
         for name in names:
             m = mutants_mod.get_mutant(name)
             run_one(m.config, name, expect=m.expect_violation)
-        if rc == 0:
+        if args.corpus:
+            # the 2-job shared-worker configuration rides the corpus:
+            # faithful run clean + both cross-job mutants caught
+            run_multi(mt_mod.MTConfig(), "multitenant-2job")
+            for mm in mt_mod.MT_MUTANTS.values():
+                run_multi(mm.config, mm.name,
+                          expect=mm.expect_violation)
+        if rc == 0 and args.corpus:
             n_hist = len(mutants_mod.historical_mutants())
-            print(f"corpus: all {len(names)} mutant(s) caught "
-                  f"({n_hist} historical PR 2 bugs included)")
+            print(f"corpus: all {len(names) + len(mt_mod.MT_MUTANTS)} "
+                  f"mutant(s) caught ({n_hist} historical PR 2 bugs "
+                  "included; 2-job multitenant configuration clean)")
     else:
         cfg = SMOKE if args.smoke else FULL
         overrides = {

@@ -64,6 +64,12 @@ ROUTES: List[Route] = [
      "Latency-marker histograms (per-operator transit + end-to-end at "
      "the sinks) and XLA compile/dispatch telemetry of a job", "jobs",
      None, "LatencyReport"),
+    ("get", "/jobs/{job_id}/doctor", "job_doctor",
+     "Bottleneck doctor: ranked limiting-factor verdict (host-bound / "
+     "device-bound / exchange-bound / starved / noisy-neighbor) naming "
+     "the limiting operator — and, for noisy-neighbor, the co-resident "
+     "tenant suspected of holding the shared worker", "jobs",
+     None, "DoctorReport"),
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
@@ -309,8 +315,34 @@ def _schemas() -> Dict[str, Any]:
         "TraceDump": _obj(
             {"traceEvents": {"type": "array", "items": {"type": "object"}},
              "displayTimeUnit": _str(),
-             "spanCount": _int()},
+             "spanCount": _int(),
+             # present on ?fmt=perfetto exports: batch-phase ledger
+             # events included as named per-(job, phase) tracks
+             "phaseCount": {**_int(), "nullable": True}},
             ["traceEvents"],
+        ),
+        "DoctorCause": _obj(
+            {"cause": {"type": "string",
+                       "enum": ["host-bound", "device-bound",
+                                "exchange-bound", "starved",
+                                "noisy-neighbor"]},
+             "score": {"type": "number"}},
+            ["cause", "score"],
+        ),
+        "DoctorVerdict": _obj(
+            {"cause": _str(), "score": {"type": "number"},
+             "operator": {**_str(), "nullable": True},
+             "suspect": {**_str(), "nullable": True},
+             "confidence": {"type": "number"},
+             "detail": _str()},
+            ["cause"],
+        ),
+        "DoctorReport": _obj(
+            {"job": _str(),
+             "verdict": _ref("DoctorVerdict"),
+             "ranked": {"type": "array", "items": _ref("DoctorCause")},
+             "signals": {"type": "object"}},
+            ["job", "verdict", "ranked"],
         ),
         "LatencySeries": _obj(
             {"job": _str(), "task": _str(), "samples": _int(),

@@ -361,7 +361,12 @@ class ApiServer:
             trace_prefix=f"{jid}/",
             trace_id=request.query.get("trace"),
         )
-        body = obs.chrome_trace(spans)
+        if request.query.get("fmt") == "perfetto":
+            # Perfetto export: spans plus the batch-phase timeline
+            # ledger as named per-(job, phase) swimlanes
+            body = obs.perfetto_trace(spans, job=jid)
+        else:
+            body = obs.chrome_trace(spans)
         body["spanCount"] = len(spans)
         return json_response(body)
 
@@ -378,6 +383,24 @@ class ApiServer:
         return json_response(
             obs.latency_report(request.match_info["job_id"])
         )
+
+    async def job_doctor(self, request: web.Request):
+        """Bottleneck doctor (ISSUE 11): per-job busy ratio,
+        backpressure, queue depth, watermark lag, dispatch floor,
+        padding waste, loop lag and per-tenant attributed-cost shares
+        combined into a ranked verdict naming the limiting operator and
+        the suspected cause (host-bound / device-bound / exchange-bound
+        / starved / noisy-neighbor — the latter names the co-resident
+        tenant holding the shared worker). Reads this process's
+        registry; for multi-process deployments run the doctor on each
+        worker's admin server (/debug/doctor) or offline from a trace
+        dump via tools/trace_report.py --doctor."""
+        from ..obs import doctor
+
+        jid = request.match_info["job_id"]
+        if self.controller is not None and jid not in self.controller.jobs:
+            return error(404, "job not found")
+        return json_response(doctor.report(jid))
 
     def _autoscale_status(self, job) -> dict:
         return {

@@ -258,6 +258,28 @@ class ObsConfig:
     # bounded in-memory recompile-cause log entries (oldest dropped);
     # each names the program, shape signature and packing rung
     recompile_log_entries: int = 256
+    # per-job cost attribution on multiplexed workers (obs/attribution.py):
+    # a job-id contextvar threaded through the runner batch loop, exchange
+    # pumps, checkpoint flushes and InstrumentedJit accumulates per-job
+    # wall/CPU/device seconds, bytes and dispatch counts, rolled into the
+    # arroyo_job_attributed_* families by the worker accounting pump.
+    # Independent of obs.enabled (attribution is plain metrics, no spans)
+    # so the fleet harness can attribute cost with the recorder off.
+    attribution: bool = True
+    # seconds between accounting-pump flushes (pending per-job deltas ->
+    # metric families + process-CPU apportioning); scrapes and the doctor
+    # also flush on read, so this only bounds staleness between reads
+    attribution_flush_interval: float = 0.5
+    # seconds between event-loop lag probes (the pump sleeps this long and
+    # records the overshoot — scheduling delay — into
+    # arroyo_worker_loop_lag_seconds); 0 disables the lag sampler
+    loop_lag_interval: float = 0.25
+    # always-on batch timeline profiler (obs/timeline.py): per-batch phase
+    # instants (decode/pack -> device dispatch -> exchange -> emit ->
+    # checkpoint flush) in a bounded per-process ring, exported alongside
+    # spans in Perfetto dumps (/debug/trace?fmt=perfetto). Capacity in
+    # events; 0 disables phase recording entirely.
+    timeline_events: int = 8192
 
 
 @dataclasses.dataclass

@@ -615,13 +615,19 @@ class ControllerServer:
             # (pooled worker processes dropped theirs via StopJob
             # expunge), after a grace window for UIs reading the
             # just-finished job's metric groups
+            from .. import obs
+
             ttl = float(config().cluster.metrics_ttl or 0)
             if ttl <= 0:
                 REGISTRY.drop_job(job.job_id)
+                obs.expunge_job(job.job_id)
             else:
-                asyncio.get_event_loop().call_later(
-                    ttl, REGISTRY.drop_job, job.job_id
-                )
+                loop = asyncio.get_event_loop()
+                loop.call_later(ttl, REGISTRY.drop_job, job.job_id)
+                # the observatory sweep (trace-ring spans, timeline
+                # phase instants, attribution accumulators) rides the
+                # same grace window as the metric series drop
+                loop.call_later(ttl, obs.expunge_job, job.job_id)
 
     # -- state machine driver ----------------------------------------------
 

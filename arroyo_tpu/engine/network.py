@@ -308,6 +308,11 @@ class RemoteEdgeSender:
 
         sample_every = obs.frame_sample_every()
         n_frames = 0
+        # exchange attribution: the pump task belongs to one job (the ns
+        # is "<job_id>@<incarnation>"), so frame serialization + socket
+        # drain time lands on that tenant's exchange phase
+        job_id = self.ns.split("@", 1)[0] if self.ns else ""
+        obs.attribution.set_job(job_id)
         try:
             while True:
                 try:
@@ -347,8 +352,14 @@ class RemoteEdgeSender:
                     # per edge track in the dump, grouped by edge
                     sn, ss, dn, ds = self.quad
                     trace = {"t": f"exchange/{sn}-{ss}_{dn}-{ds}"}
+                t0 = time.perf_counter()
                 write_frame(self.writer, self.quad, item, trace)
                 await self.writer.drain()
+                if not isinstance(item, SignalMessage):
+                    obs.timeline.note(
+                        "exchange", time.perf_counter() - t0,
+                        task=f"{self.quad[0]}-{self.quad[1]}",
+                    )
                 if isinstance(item, SignalMessage) and item.kind in (
                     SignalKind.END_OF_DATA, SignalKind.STOP
                 ):

@@ -137,6 +137,11 @@ class WorkerServer:
         # spawned worker subprocesses through the config env layer)
         chaos.install_from_config()
         obs.set_role(f"worker-{self.worker_id}")
+        # fleet observatory: the accounting pump rolls per-job attributed
+        # cost into the arroyo_job_attributed_* families and samples
+        # event-loop lag (refcounted — embedded workers share one loop)
+        obs.attribution.ensure_pump()
+        self._pump_held = True
         self.rpc.add_service(
             "WorkerGrpc",
             {
@@ -417,12 +422,17 @@ class WorkerServer:
             ttl = float(config().cluster.metrics_ttl or 0)
             if ttl <= 0:
                 REGISTRY.drop_job(jid)
+                obs.expunge_job(jid)
             else:
                 # grace window: UIs read a just-finished job's metric
-                # groups; the series drop lands after they could have
-                asyncio.get_event_loop().call_later(
-                    ttl, REGISTRY.drop_job, jid
-                )
+                # groups; the series drop lands after they could have.
+                # The observatory expunge (trace ring, timeline ledger,
+                # attribution accumulators) rides the same deadline —
+                # the attributed families carry a job label and fall to
+                # drop_job, the span/phase rings need their own sweep.
+                loop = asyncio.get_event_loop()
+                loop.call_later(ttl, REGISTRY.drop_job, jid)
+                loop.call_later(ttl, obs.expunge_job, jid)
         return {"hosted": jr is not None}
 
     async def _teardown_job(self, jr: _JobRuntime, force: bool = True):
@@ -761,6 +771,9 @@ class WorkerServer:
             return
         self._shutdown_started = True
         self._finished.set()
+        if getattr(self, "_pump_held", False):
+            self._pump_held = False
+            obs.attribution.release_pump()
         for jr in list(self._jobs.values()):
             await self._teardown_job(jr, force=True)
         self._jobs.clear()
@@ -785,6 +798,9 @@ class WorkerServer:
             # a leader must finish its in-flight checkpoint (peer reports
             # are still arriving over this worker's rpc server) first
             await jr.lead_idle.wait()
+        if getattr(self, "_pump_held", False):
+            self._pump_held = False
+            obs.attribution.release_pump()
         self._hb.cancel()
         await asyncio.gather(self._hb, return_exceptions=True)
         await self.controller.close()

@@ -426,6 +426,47 @@ STATE_CHAIN_LEN = REGISTRY.gauge(
     "incremental global-table blob-chain length (base + deltas) per "
     "(task, table); the rebase policy (state.rebase_epochs / "
     "state.rebase_bytes_factor) bounds it")
+# Fleet observatory (ISSUE 11): per-job cost attribution on multiplexed
+# workers. Every family carries a `job` label so Registry.drop_job GCs a
+# terminal job's series with the rest; values are rolled up from the
+# job-id contextvar accounting (obs/attribution.py) by the per-worker
+# pump, so shared-worker usage sums to the worker's measured busy time
+# and fair-share grants can be audited against actual consumption.
+JOB_ATTR_BUSY_SECONDS = REGISTRY.counter(
+    "arroyo_job_attributed_busy_seconds",
+    "wall seconds of useful work attributed to a job via the ambient "
+    "job-id context (batch processing, watermark-driven emission, "
+    "ticks) — sums across co-resident jobs to a multiplexed worker's "
+    "arroyo_worker_busy_seconds total")
+JOB_ATTR_CPU_SECONDS = REGISTRY.counter(
+    "arroyo_job_attributed_cpu_seconds",
+    "process CPU seconds apportioned to a job by the accounting pump "
+    "(each flush splits the interval's process-CPU delta across jobs "
+    "proportional to their attributed busy time in that interval)")
+JOB_ATTR_DEVICE_SECONDS = REGISTRY.counter(
+    "arroyo_job_attributed_device_seconds",
+    "wall seconds inside jitted device programs (compiles + dispatches) "
+    "attributed to a job — the per-job dimension of the shared-program "
+    "XLA telemetry (programs are cached process-wide across jobs, so "
+    "the per-program families cannot carry a job label themselves)")
+JOB_ATTR_DISPATCHES = REGISTRY.counter(
+    "arroyo_job_attributed_dispatches",
+    "device program invocations (compile or dispatch) attributed to a "
+    "job via the ambient job-id context")
+JOB_ATTR_BYTES = REGISTRY.counter(
+    "arroyo_job_attributed_bytes",
+    "data-plane bytes (batches received by the job's subtasks) "
+    "attributed to a job via the ambient job-id context")
+JOB_ATTR_PHASE_SECONDS = REGISTRY.counter(
+    "arroyo_job_attributed_phase_seconds",
+    "wall seconds per batch-pipeline phase (phase=decode|process|"
+    "dispatch|exchange|emit|flush|watermark) attributed to a job — the "
+    "metric rollup of the timeline profiler's phase ledger")
+LOOP_LAG_SECONDS = REGISTRY.histogram(
+    "arroyo_worker_loop_lag_seconds",
+    "event-loop scheduling lag sampled by the accounting pump (sleep-"
+    "overshoot of a loop_lag_interval timer): how long a ready task "
+    "waits for the multiplexed worker loop — the noisy-neighbor signal")
 
 
 class RateWindow:

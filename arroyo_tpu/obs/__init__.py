@@ -39,6 +39,7 @@ from .trace import (  # noqa: F401 - public surface
     detach,
     new_span_id,
     new_trace,
+    perfetto_trace,
 )
 
 _RECORDER: Optional[TraceRecorder] = None
@@ -87,13 +88,30 @@ def recorder() -> TraceRecorder:
 
 
 def reset(capacity: Optional[int] = None) -> TraceRecorder:
-    """Drop the recorder and rebuild (tests; capacity override)."""
+    """Drop the recorder and rebuild (tests; capacity override). Also
+    clears the fleet-observatory side state (phase ledger, attribution
+    accounting) so tests start from a clean observatory."""
     global _RECORDER
+    timeline.clear()
+    attribution.ACCOUNTING.reset()
     if capacity is None:
         _RECORDER = None
         return recorder()
     _RECORDER = TraceRecorder(capacity, role=_ROLE or f"proc-{os.getpid()}")
     return _RECORDER
+
+
+def expunge_job(job_id: str) -> None:
+    """Job-scoped observatory GC, wired into the same paths as the
+    metrics cardinality GC (Registry.drop_job): drops the job's spans
+    from the trace ring, its phase instants from the timeline ledger,
+    and its attribution accumulator state. The arroyo_job_attributed_*
+    series themselves carry a `job` label and are dropped by
+    Registry.drop_job."""
+    if _RECORDER is not None:
+        _RECORDER.expunge_job(job_id)
+    timeline.expunge_job(job_id)
+    attribution.ACCOUNTING.drop_job(job_id)
 
 
 def span(name: str, *, trace: Optional[str] = None,
@@ -196,6 +214,12 @@ def latency_report(job_id: Optional[str] = None) -> dict:
     }
 
 
+# fleet observatory (ISSUE 11): per-job attribution, the batch-phase
+# timeline ledger, and the bottleneck doctor — imported before device
+# (InstrumentedJit notes per-job device seconds through attribution)
+from . import attribution, timeline  # noqa: F401,E402 - public surface
+
 # device-tier observatory (XLA compile/dispatch telemetry) — imported
 # last: device.py pulls in the metric families and the trace primitives
 from . import device  # noqa: F401,E402 - public surface
+from . import doctor  # noqa: F401,E402 - public surface

@@ -42,7 +42,7 @@ from ..metrics import (
     XLA_COMPILE_SECONDS,
     XLA_COMPILES,
 )
-from . import trace
+from . import attribution, timeline, trace
 
 logger = logging.getLogger("arroyo.obs.device")
 
@@ -222,6 +222,13 @@ class InstrumentedJit:
         t0 = time.perf_counter()
         out = self.fn(*args)
         dt = time.perf_counter() - t0
+        # per-job device attribution (ISSUE 11): jitted programs are
+        # cached process-wide ACROSS jobs, so the per-program families
+        # cannot carry a job label — the ambient job context gives
+        # dispatch/compile seconds their job dimension instead, and the
+        # timeline ledger its device swimlane
+        attribution.note(device=dt, dispatches=1)
+        timeline.note("dispatch", dt)
         if fresh:
             self.seen.add(key)
             self._compiles.inc()

@@ -1,0 +1,119 @@
+"""Always-on batch timeline profiler (ISSUE 11): the per-batch phase
+ledger.
+
+The runner's batch path decomposes into phases — arrow decode/pack,
+host operator processing, device dispatch, exchange, emit, checkpoint
+flush — and ROADMAP item 1 (async device pipelining) needs per-batch
+evidence of where the ~2ms dispatch floor and host decode time actually
+sit. Recording a real span per batch would churn the flight recorder's
+ring (that is why the compile anchors are lazy), so phases land in a
+dedicated bounded ring of plain tuples instead: one `perf_counter` pair
+plus a deque append per phase, cheap enough to leave on in production.
+
+The ledger exports into Perfetto dumps (`obs.perfetto_trace` renders
+each (job, phase) pair as its own named track) and rolls up into
+`arroyo_job_attributed_phase_seconds` via the attribution accounting —
+so a q5 checkpoint epoch or a rescale renders as a real timeline, and
+the bottleneck doctor can read phase shares online or offline from a
+trace dump. Gated on `obs.timeline_events > 0`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# ring entries: (ts_us_end, dur_us, phase, job, task)
+_RING: deque = deque(maxlen=8192)
+_LOCK = threading.Lock()
+
+# canonical phase order for reports (decode -> ... -> flush); unknown
+# phases sort after these
+PHASES = ("decode", "process", "dispatch", "exchange", "emit",
+          "watermark", "flush", "loop.lag")
+
+
+def enabled() -> bool:
+    from ..config import config
+
+    return int(config().obs.timeline_events) > 0
+
+
+def _resize() -> None:
+    from ..config import config
+
+    global _RING
+    cap = int(config().obs.timeline_events)
+    if cap > 0 and _RING.maxlen != cap:
+        with _LOCK:
+            _RING = deque(_RING, maxlen=cap)
+
+
+def note(phase: str, dur_s: float, *, job: Optional[str] = None,
+         task: str = "") -> None:
+    """Record one phase instant (duration ending now). `job` defaults to
+    the ambient attribution context; also feeds the per-job phase-seconds
+    rollup so the metric surface and the ledger cannot drift."""
+    from ..config import config
+
+    cap = int(config().obs.timeline_events)
+    if cap <= 0:
+        return
+    if _RING.maxlen != cap:
+        _resize()
+    from . import attribution
+
+    if job is None:
+        job = attribution.current_job()
+    _RING.append((time.time() * 1e6, dur_s * 1e6, phase, job, task))
+    attribution.note(job=job, phase=phase, phase_secs=dur_s)
+
+
+def snapshot(job: Optional[str] = None) -> List[dict]:
+    """The ledger as dicts, oldest first; `job` filters one job's
+    entries."""
+    with _LOCK:
+        entries = list(_RING)
+    out = []
+    for ts_us, dur_us, phase, j, task in entries:
+        if job is not None and j != job:
+            continue
+        out.append({"ts": ts_us - dur_us, "dur": dur_us, "phase": phase,
+                    "job": j, "task": task})
+    return out
+
+
+def phase_totals(job: Optional[str] = None) -> Dict[str, dict]:
+    """Per-phase {count, total_s, max_s} over the ledger window — the
+    offline doctor's primary signal when only a trace dump is at hand."""
+    totals: Dict[str, dict] = {}
+    for e in snapshot(job):
+        t = totals.setdefault(e["phase"],
+                              {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        t["count"] += 1
+        t["total_s"] += e["dur"] / 1e6
+        t["max_s"] = max(t["max_s"], e["dur"] / 1e6)
+    for t in totals.values():
+        t["total_s"] = round(t["total_s"], 6)
+        t["max_s"] = round(t["max_s"], 6)
+    return totals
+
+
+def expunge_job(job_id: str) -> int:
+    """Job-scoped GC (StopJob / Registry.drop_job path): drop the torn-
+    down job's phase instants instead of letting them linger until
+    overwrite. Returns the number removed."""
+    with _LOCK:
+        kept = [e for e in _RING if e[3] != job_id]
+        removed = len(_RING) - len(kept)
+        _RING.clear()
+        _RING.extend(kept)
+    return removed
+
+
+def clear() -> None:
+    with _LOCK:
+        _RING.clear()
+    _resize()

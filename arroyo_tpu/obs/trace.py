@@ -197,6 +197,22 @@ class TraceRecorder:
             self.spans.clear()
             self.dropped = 0
 
+    def expunge_job(self, job_id: str) -> int:
+        """Job-scoped GC: drop every span of one job (trace ids are
+        '{job_id}/...'-prefixed by new_trace). Without this, a torn-down
+        job's spans linger in the ring until overwrite — wired into the
+        StopJob / Registry.drop_job metrics-GC path so trace exports of
+        a multiplexed worker only show live tenants. Returns the number
+        of spans removed."""
+        prefix = f"{job_id}/"
+        with self._lock:
+            kept = [s for s in self.spans
+                    if not s.get("trace_id", "").startswith(prefix)]
+            removed = len(self.spans) - len(kept)
+            self.spans.clear()
+            self.spans.extend(kept)
+        return removed
+
     def __len__(self) -> int:
         with self._lock:
             return len(self.spans)
@@ -256,3 +272,65 @@ def chrome_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
         })
     events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _phase_tid(job: str, phase: str) -> int:
+    """Stable synthetic thread id for one (job, phase) ledger track —
+    kept far above real thread idents' low range is impossible (idents
+    are arbitrary), so phase tracks get their own namespace via a
+    deterministic hash with bit 62 set: collisions with a real tid would
+    merge a phase track into a span track."""
+    h = 0
+    for ch in f"{job}\x00{phase}":
+        h = (h * 131 + ord(ch)) & 0x3FFFFFFFFFFFFFFF
+    return h | (1 << 62)
+
+
+def perfetto_trace(spans: List[Dict[str, Any]],
+                   timeline: Optional[List[Dict[str, Any]]] = None,
+                   job: Optional[str] = None) -> Dict[str, Any]:
+    """Spans (+ the batch-phase ledger) as Perfetto-ready Chrome
+    trace-event JSON. On top of `chrome_trace`:
+
+    * each (job, phase) pair of the timeline ledger renders as its own
+      named track ('X' events with thread_name metadata), so a q5
+      checkpoint epoch or a rescale shows decode/dispatch/exchange/
+      emit/flush as parallel swimlanes under the process;
+    * `job` filters both spans (trace-id prefix) and ledger entries to
+      one tenant.
+
+    Served by `/debug/trace?fmt=perfetto`, the REST traces route, and
+    `tools/trace_report.py --perfetto`."""
+    if job is not None:
+        prefix = f"{job}/"
+        spans = [s for s in spans
+                 if s.get("trace_id", "").startswith(prefix)]
+    doc = chrome_trace(spans)
+    events = doc["traceEvents"]
+    if timeline is None:
+        from . import timeline as _timeline
+
+        timeline = _timeline.snapshot(job)
+    elif job is not None:
+        timeline = [e for e in timeline if e.get("job") == job]
+    pid = os.getpid()
+    named: set = set()
+    for e in timeline:
+        tid = _phase_tid(e.get("job", ""), e["phase"])
+        if tid not in named:
+            named.add(tid)
+            jlabel = e.get("job") or "worker"
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": e.get("pid", pid),
+                "tid": tid,
+                "args": {"name": f"{jlabel} · {e['phase']}"},
+            })
+        events.append({
+            "name": f"phase.{e['phase']}", "cat": "phase", "ph": "X",
+            "ts": e["ts"], "dur": max(0.0, e.get("dur") or 0.0),
+            "pid": e.get("pid", pid), "tid": tid,
+            "args": {"job": e.get("job", ""), "task": e.get("task", "")},
+        })
+    events.sort(key=lambda ev: (ev.get("ts", 0), ev.get("pid", 0)))
+    doc["phaseCount"] = len(timeline)
+    return doc

@@ -109,8 +109,17 @@ class SourceOperator(Operator):
         raise RuntimeError("sources do not process input batches")
 
     async def flush_buffer(self, ctx: SourceContext, collector):
+        # fleet observatory: take_buffer is the arrow pack moment (row
+        # dicts -> RecordBatch) — the host decode/pack cost ROADMAP item
+        # 1 wants overlapped with in-flight dispatch
+        from .. import obs
+        import time as _time
+
+        t0 = _time.perf_counter()
         batch = ctx.take_buffer()
         if batch is not None:
+            obs.timeline.note("decode", _time.perf_counter() - t0,
+                              task=ctx.task_info.task_id)
             await collector.collect(batch)
         # latency markers stamp at flush cadence (throttled by
         # obs.latency_marker_interval): they leave through the subtask's

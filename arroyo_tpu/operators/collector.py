@@ -11,12 +11,14 @@ forward edges are 1-1. Signals broadcast to every destination queue.
 
 from __future__ import annotations
 
+import time
 import weakref
 from typing import List, Optional
 
 import pyarrow as pa
 
 from ..metrics import BACKPRESSURE, BATCHES_SENT, BYTES_SENT, MESSAGES_SENT
+from ..obs import timeline
 from ..schema import StreamSchema
 from ..types import SignalMessage
 from .queues import BatchQueue, batch_bytes
@@ -122,8 +124,13 @@ class Collector:
         self._batch_counter.inc()
         self._msg_counter.inc(batch.num_rows)
         self._bytes_counter.inc(batch_bytes(batch))
+        # fleet observatory: emit time (partitioning + queue sends,
+        # INCLUDING any backpressure wait) is its own timeline phase —
+        # a batch stuck here points downstream, not at this operator
+        t0 = time.perf_counter()
         for edge in self.edges:
             await edge.send_batch(batch)
+        timeline.note("emit", time.perf_counter() - t0, task=self.task_id)
         self._bp_tick += 1
         if self._bp_tick == 1 or self._bp_tick % self._BP_SAMPLE_EVERY == 0:
             # post-send occupancy of the most-loaded out queue: 1.0 means

@@ -154,6 +154,14 @@ class SubtaskRunner:
         self._e2e_secs = E2E_LATENCY_SECONDS.labels(job=jid, task=tid)
         self._compile_trace = obs.new_trace(jid, f"batch-{tid}")
 
+    def _note_busy(self, dt: float, phase: str):
+        """Mirror one busy-seconds increment into the fleet observatory:
+        per-job attributed busy (the ambient job context is set by run(),
+        so flush tasks and device work inherit it) plus the batch-phase
+        timeline ledger. Both are single dict/deque updates when on."""
+        obs.attribution.note(busy=dt)
+        obs.timeline.note(phase, dt, task=self.task_info.task_id)
+
     @property
     def is_source(self) -> bool:
         return isinstance(self.ops[0], SourceOperator)
@@ -161,6 +169,11 @@ class SubtaskRunner:
     # ------------------------------------------------------------------ run
 
     async def run(self):
+        # bind the job-id attribution context for this runner task's whole
+        # dynamic extent: every await-descendant (checkpoint flush tasks,
+        # to_thread storage work, device dispatches) inherits it, so cost
+        # on a multiplexed worker rolls up to the right tenant
+        obs.attribution.set_job(self.task_info.job_id)
         try:
             # under the job.schedule trace (context inherited at task
             # spawn): table restore + operator on_start become visible
@@ -324,7 +337,9 @@ class SubtaskRunner:
                     for op, ctx, coll in zip(self.ops, self.ctxs, self.collectors):
                         if op.tick_interval():
                             await op.handle_tick(tick_count, ctx, coll)
-                    self._busy_secs.inc(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self._busy_secs.inc(dt)
+                    obs.attribution.note(busy=dt)
                     arm_tick()
                 elif isinstance(tag, tuple) and tag[0] == "opfut":
                     idx = tag[1]
@@ -441,7 +456,9 @@ class SubtaskRunner:
                         await self._chain_watermark(0, changed)
                     finally:
                         anchor.close()
-                    self._busy_secs.inc(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self._busy_secs.inc(dt)
+                    self._note_busy(dt, "watermark")
                 return True
             if item.kind == SignalKind.LATENCY_MARKER:
                 await self._handle_marker(item)
@@ -459,7 +476,9 @@ class SubtaskRunner:
         # data batch
         self._batches_recv.inc()
         self._msgs_recv.inc(item.num_rows)
-        self._bytes_recv.inc(batch_bytes(item))
+        nbytes = batch_bytes(item)
+        self._bytes_recv.inc(nbytes)
+        obs.attribution.note(nbytes=nbytes)
         t0 = time.perf_counter()
         anchor = obs.device.anchor(
             self._compile_trace, "batch.process",
@@ -474,6 +493,7 @@ class SubtaskRunner:
         dt = time.perf_counter() - t0
         self._batch_seconds.observe(dt)
         self._busy_secs.inc(dt)
+        self._note_busy(dt, "process")
         return True
 
     async def _handle_marker(self, item: SignalMessage):
@@ -717,7 +737,12 @@ class SubtaskRunner:
             if tok is not None:
                 flush_span.detach(tok)
             flush_span.finish()
-            self._phase_obs["flush"].observe(time.perf_counter() - t0)
+            flush_dt = time.perf_counter() - t0
+            self._phase_obs["flush"].observe(flush_dt)
+            # checkpoint flushes overlap later batches (off-barrier
+            # uploads): the timeline shows them as their own swimlane
+            obs.timeline.note("flush", flush_dt,
+                              task=self.task_info.task_id)
         self.control_tx.put_nowait(
             CheckpointCompletedResp(
                 self.task_info.task_id,

@@ -129,7 +129,16 @@ def build_admin_app(role: str, details_fn=None,
             trace_prefix=request.query.get("prefix"),
             trace_id=request.query.get("trace"),
         )
-        body = obs.chrome_trace(spans)
+        if request.query.get("fmt") == "perfetto":
+            # fleet-observatory export: spans + the batch-phase timeline
+            # ledger as named per-(job, phase) swimlanes (?prefix= still
+            # narrows spans; phase entries filter by the prefix's job)
+            prefix = request.query.get("prefix") or ""
+            body = obs.perfetto_trace(
+                spans, job=prefix.rstrip("/") or None
+            )
+        else:
+            body = obs.chrome_trace(spans)
         body["spanCount"] = len(spans)
         body["dropped"] = rec.dropped
         if request.query.get("clear"):
@@ -146,6 +155,29 @@ def build_admin_app(role: str, details_fn=None,
         return web.json_response(
             obs.latency_report(request.query.get("job"))
         )
+
+    async def debug_attribution(request: web.Request):
+        """Fleet-observatory dump: per-job attributed wall/CPU/device
+        seconds, dispatch counts and bytes, the coverage ratio vs the
+        unattributed bucket, and event-loop lag percentiles — the
+        numbers that let an operator audit the admission ledger's
+        fair-share grants against actual consumption on a multiplexed
+        worker."""
+        from ..obs import attribution
+
+        return web.json_response(attribution.ACCOUNTING.summary())
+
+    async def debug_doctor(request: web.Request):
+        """Bottleneck doctor for one job hosted in this process:
+        ?job=<id> (required) returns the ranked limiting-factor verdict
+        (see obs/doctor.py). The REST equivalent is
+        GET /api/v1/jobs/{id}/doctor."""
+        from ..obs import doctor
+
+        job = request.query.get("job")
+        if not job:
+            return web.Response(status=400, text="job param required\n")
+        return web.json_response(doctor.report(job))
 
     async def debug_state(request: web.Request):
         """State-at-scale dump: per-(task, table, kind) state sizes, rows,
@@ -194,6 +226,8 @@ def build_admin_app(role: str, details_fn=None,
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/latency", debug_latency)
+    app.router.add_get("/debug/attribution", debug_attribution)
+    app.router.add_get("/debug/doctor", debug_doctor)
     for path, handler in (extra_routes or {}).items():
         app.router.add_get(path, handler)
     return app

@@ -160,6 +160,9 @@ def child(events: int, backend: str, query: str = "q5",
 
     config().tpu.enabled = backend == "jax"
     config().pipeline.source_batch_size = 8192
+    # dense loop-lag sampling: bench children run well under a minute, and
+    # a p99 over a handful of 250ms probes would be pure noise
+    config().obs.loop_lag_interval = 0.05
     if mesh_devices:
         config().tpu.mesh_devices = mesh_devices
     if force_device_join:
@@ -186,9 +189,18 @@ def child(events: int, backend: str, query: str = "q5",
     )
     force_backend(plan, backend)
 
+    from arroyo_tpu.obs import attribution
+
     async def go():
-        eng = Engine(plan.graph).start()
-        await eng.join(600)
+        # fleet observatory: the accounting pump's loop-lag sampler runs
+        # exactly as it would on a worker, so the bench line carries a
+        # loop_lag_ms_p99 the nightly gate can pin
+        attribution.ensure_pump()
+        try:
+            eng = Engine(plan.graph).start()
+            await eng.join(600)
+        finally:
+            attribution.release_pump()
 
     t0 = time.monotonic()
     asyncio.run(go())
@@ -211,6 +223,10 @@ def child(events: int, backend: str, query: str = "q5",
     print(f"COMPILES {sum(p.get('compiles', 0) for p in progs.values())} "
           f"{sum(p.get('compile_s_total', 0.0) for p in progs.values()):.3f}",
           flush=True)
+    lags = sorted(attribution.ACCOUNTING.lag_samples)
+    if lags:
+        p99 = lags[min(len(lags) - 1, int(0.99 * len(lags)))]
+        print(f"LOOPLAG {1e3 * p99:.3f} {len(lags)}", flush=True)
     print(f"RESULT {events / dt:.1f} {len(results)} {dt:.2f}", flush=True)
 
 
@@ -550,6 +566,7 @@ def run_child(events: int, backend: str, timeout: float, env=None,
     result = None
     stats = None
     compiles = None
+    loop_lag = None
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):
             parts = line.split()
@@ -561,6 +578,9 @@ def run_child(events: int, backend: str, timeout: float, env=None,
         elif line.startswith("COMPILES "):
             parts = line.split()
             compiles = (int(parts[1]), float(parts[2]))
+        elif line.startswith("LOOPLAG "):
+            parts = line.split()
+            loop_lag = (float(parts[1]), int(parts[2]))
     if result is None:
         sys.stderr.write(out.stderr[-2000:] + "\n")
         return None
@@ -574,6 +594,8 @@ def run_child(events: int, backend: str, timeout: float, env=None,
             result["rows_combined"] = stats[5]
     if compiles is not None:
         result["compiles"], result["compile_s"] = compiles
+    if loop_lag is not None:
+        result["loop_lag_ms_p99"], result["loop_lag_samples"] = loop_lag
     return result
 
 
@@ -918,6 +940,26 @@ def main():
         sides["q5_p50_ms_dist"] = round(dist[0], 1)
         sides["q5_p99_ms_dist"] = round(dist[1], 1)
         sides["q5_lat_samples_dist"] = dist[2]
+    # fleet observatory (ISSUE 11): loop-lag p99 of the instrumented CPU
+    # headline run, plus the attribution-overhead check — one extra
+    # UNinstrumented q5 run (attribution + timeline off via the config
+    # env layer) against the instrumented median. Both gated by
+    # bench_compare (loop lag regresses upward; overhead is gated in
+    # absolute percentage points — the acceptance bar is < 2% cost).
+    if baseline is not None and "loop_lag_ms_p99" in baseline:
+        sides["loop_lag_ms_p99"] = baseline["loop_lag_ms_p99"]
+        sides["loop_lag_samples"] = baseline.get("loop_lag_samples", 0)
+    if baseline is not None:
+        attr_env = dict(cpu_env)
+        attr_env["ARROYO__OBS__ATTRIBUTION"] = "0"
+        attr_env["ARROYO__OBS__TIMELINE_EVENTS"] = "0"
+        r_off = run_child(args.events, "numpy", args.timeout, env=attr_env,
+                          force_device_join=args.force_device_join)
+        if r_off is not None:
+            sides["q5_attr_off_eps"] = round(r_off["eps"], 1)
+            sides["attr_overhead_pct"] = round(
+                max(0.0, 100.0 * (1.0 - baseline["eps"] / r_off["eps"])), 2
+            )
     baseline_real = baseline is not None
     if device is None:
         device = baseline

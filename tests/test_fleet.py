@@ -240,8 +240,14 @@ def test_parked_running_job_zero_wakeups(tmp_path):
 def test_metrics_cardinality_gc(tmp_path):
     """Satellite: churning N jobs must return /metrics exposition to
     ~baseline — per-job series (task counters, queue gauges with weakref
-    refreshers, latency histograms) are dropped at terminal states."""
+    refreshers, latency histograms, and the ISSUE 11
+    arroyo_job_attributed_* attribution families) are dropped at
+    terminal states, and the observatory side state (trace-ring spans,
+    timeline phase instants, attribution accumulators) is expunged on
+    the same path."""
+    from arroyo_tpu import obs
     from arroyo_tpu.metrics import REGISTRY
+    from arroyo_tpu.obs import attribution, timeline
 
     async def churn(tag, n):
         with update(cluster={"worker_pool_size": 2, "metrics_ttl": 0.0}):
@@ -260,14 +266,23 @@ def test_metrics_cardinality_gc(tmp_path):
             await c.stop()
 
     asyncio.run(churn("warm", 1))  # register every family once
+    # the warm job actually exercised the attribution families (they are
+    # part of the baseline length being asserted below)
+    assert "arroyo_job_attributed_busy_seconds" in REGISTRY.expose()
     baseline = len(REGISTRY.expose())
     asyncio.run(churn("gc", 6))
     after = len(REGISTRY.expose())
     # families/help text persist; per-job series must not accumulate
     assert after <= baseline * 1.25 + 2000, (baseline, after)
-    # and the dropped jobs are really gone from the exposition
+    # and the dropped jobs are really gone from the exposition — the
+    # attributed families included
     text = REGISTRY.expose()
     assert 'job="gc0"' not in text and 'job="gc5"' not in text
+    for j in range(6):
+        # spans of torn-down jobs no longer linger until ring overwrite
+        assert obs.recorder().snapshot(trace_prefix=f"gc{j}/") == []
+        assert timeline.snapshot(f"gc{j}") == []
+        assert f"gc{j}" not in attribution.ACCOUNTING.summary()["jobs"]
 
 
 def _stub_admission(slots_per_worker=2, n_workers=2):

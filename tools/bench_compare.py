@@ -101,6 +101,13 @@ def classify(metric: str) -> Optional[str]:
     # API p99 (both *_ms) already classify as lower-is-better above
     if metric.endswith("_jobs_per_controller"):
         return "higher"
+    # fleet observatory (ISSUE 11): attribution overhead (instrumented
+    # vs uninstrumented q5 eps, in percentage points) regresses upward —
+    # gated in ABSOLUTE points (see compare), because a relative delta
+    # on a near-zero overhead is pure noise. loop_lag_ms_p99 already
+    # classifies as lower-is-better via the *_ms_p99 suffix above.
+    if metric.endswith("_overhead_pct"):
+        return "lower_abs"
     return None
 
 
@@ -118,6 +125,29 @@ def compare(baseline: dict, current: dict, margin: float = 1.5,
             continue
         b, c = baseline[metric], current[metric]
         if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if direction == "lower_abs":
+            # absolute-points gate (attribution overhead): the value IS
+            # already a percentage, and its baseline is legitimately ~0,
+            # so relative deltas are meaningless. Allowed drift: 2 points
+            # (the <2% acceptance bar), widened with the latency floor
+            # (CI runners pass a bigger one) and under contention.
+            allowed_pts = max(2.0, latency_floor_pct / 12.5)
+            if contended:
+                allowed_pts *= 1.5
+            delta = c - b
+            status = ("regression" if delta > allowed_pts
+                      else "improved" if delta < -allowed_pts else "ok")
+            if status == "regression":
+                regressions.append(metric)
+            results[metric] = {
+                "baseline": b, "current": c,
+                "delta_pct": round(delta, 2),
+                "allowed_pct": round(allowed_pts, 2),
+                "spread_pcts": [],
+                "direction": direction,
+                "status": status,
+            }
             continue
         if not b or not c:
             # 0 means "that query failed that round" — a wedge, not a

@@ -23,6 +23,17 @@ control-plane scaling metrics the bench gate pins:
   fleet_exactly_once_ok       1 iff every sampled bounded job's output
                               was byte-identical to its solo run.
 
+Fleet observatory (ISSUE 11): unless --no-doctor, the harness also
+(a) audits per-job cost attribution — attributed busy seconds summed
+across tenants must cover >= 95% of the pool's measured busy time
+(fleet_attr_coverage_pct) — and (b) runs the noisy-neighbor scenario:
+one deliberately hot "hog" tenant floods the shared pool while the
+parked fleet idles, and the bottleneck doctor, asked about a parked
+victim job, must name the cause noisy-neighbor AND the hog job as the
+suspect (fleet_doctor_ok; exercised through the real REST
+/jobs/{id}/doctor route). Either failing exits 1, like an exactly-once
+mismatch.
+
 Exactly-once under churn: a sample of bounded deterministic impulse
 pipelines runs INSIDE the churning fleet; each output is compared
 byte-for-byte (canonical sorted JSON rows) against a solo run of the
@@ -147,6 +158,7 @@ async def _measure_idle(controller, n_jobs: int, seconds: float) -> dict:
 async def run_fleet(jobs: int = 100, pool: int = 2, sample: int = 8,
                     churn: int = 30, previews: int = 5,
                     idle_seconds: float = 10.0, kill: bool = False,
+                    doctor: bool = True, doctor_events: int = 1_500_000,
                     workdir: str | None = None) -> dict:
     from aiohttp import ClientSession, web
 
@@ -271,6 +283,92 @@ async def run_fleet(jobs: int = 100, pool: int = 2, sample: int = 8,
             )
             idle_full = await _measure_idle(controller, jobs, idle_seconds)
 
+            # -- phase 4b: fleet observatory — attribution audit + the
+            # noisy-neighbor doctor scenario (ISSUE 11)
+            if doctor:
+                from arroyo_tpu.metrics import REGISTRY
+                from arroyo_tpu.obs import attribution
+
+                # one deliberately hot tenant: a bounded impulse burst
+                # that runs flat-out on the shared pool while every
+                # parked job idles — the canonical noisy neighbor
+                _, body = await api.call("post", "/pipelines", json={
+                    "name": "hog", "tenant": "hog",
+                    "query": sample_sql(workdir, "hog", 0, doctor_events),
+                })
+                hog_pid = body["id"]
+                deadline = time.monotonic() + 60
+                hog_jid = None
+                while time.monotonic() < deadline and hog_jid is None:
+                    hog_jid = next(
+                        (j.job_id for j in controller.jobs.values()
+                         if j.tenant == "hog"), None,
+                    )
+                    if hog_jid is None:
+                        await asyncio.sleep(0.05)
+                # let the hog burn shared CPU while the fleet idles, then
+                # diagnose a parked victim mid-contention (through the
+                # real REST doctor route)
+                await asyncio.sleep(2.0)
+                victim = next(
+                    (j.job_id for j in controller.jobs.values()
+                     if j.tenant.startswith("t")
+                     and j.state == JobState.RUNNING), None,
+                )
+                verdict = {}
+                if victim is not None:
+                    _, verdict = await api.call(
+                        "get", f"/jobs/{victim}/doctor"
+                    )
+                v = verdict.get("verdict") or {}
+                report["fleet_doctor_victim"] = victim
+                report["fleet_doctor_verdict"] = v.get("cause")
+                report["fleet_doctor_suspect"] = v.get("suspect")
+                report["fleet_doctor_ok"] = int(
+                    v.get("cause") == "noisy-neighbor"
+                    and v.get("suspect") == hog_jid
+                )
+                # attribution audit: attributed busy summed across
+                # tenants vs the pool's measured busy time (the same
+                # per-subtask arroyo_worker_busy_seconds instrument the
+                # autoscaler trusts) — >= 95% means no shared-worker
+                # cost escapes the job dimension
+                attribution.ACCOUNTING.flush()
+                summary = attribution.ACCOUNTING.summary()
+                worker_busy = sum(
+                    v for _l, v in REGISTRY.snapshot().get(
+                        "arroyo_worker_busy_seconds", [])
+                )
+                report["fleet_attr_coverage_pct"] = round(
+                    100.0 * summary["attributed_busy_s"]
+                    / max(worker_busy, 1e-9), 2,
+                )
+                report["fleet_attr_jobs"] = len(summary["jobs"])
+                report["fleet_loop_lag_ms_p99"] = summary.get(
+                    "loop_lag_ms", {}).get("p99", 0.0)
+                # artifacts for the nightly lane: the doctor report and a
+                # Perfetto trace (phase ledger + any spans) land in the
+                # workdir so a red run ships its own diagnosis
+                from arroyo_tpu import obs as _obs
+
+                with open(os.path.join(workdir, "doctor_report.json"),
+                          "w") as f:
+                    json.dump(verdict, f, indent=2)
+                with open(os.path.join(workdir, "fleet_trace.json"),
+                          "w") as f:
+                    json.dump(
+                        _obs.perfetto_trace(_obs.recorder().snapshot()), f
+                    )
+                # stop the hog via the controller directly (non-blocking):
+                # the REST stop waits for the terminal state, and a hog
+                # that already ran to FINISHED would sit out that wait —
+                # a 60s outlier that belongs to the scenario, not to the
+                # API-latency sample the p99 gate reads
+                if (hog_jid in controller.jobs
+                        and not controller.jobs[hog_jid].state.is_terminal()):
+                    await controller.stop_job(hog_jid, "immediate")
+                report["fleet_hog_pid"] = hog_pid
+
             # -- phase 5: wait the sampled jobs out, then stop the fleet
             deadline = time.monotonic() + 180
             while time.monotonic() < deadline:
@@ -359,6 +457,11 @@ def main(argv=None) -> int:
     ap.add_argument("--idle-seconds", type=float, default=10.0)
     ap.add_argument("--kill", action="store_true",
                     help="SIGKILL one pool worker mid-churn")
+    ap.add_argument("--no-doctor", action="store_true",
+                    help="skip the attribution audit + noisy-neighbor "
+                         "doctor scenario")
+    ap.add_argument("--doctor-events", type=int, default=1_500_000,
+                    help="event count of the deliberately hot hog tenant")
     ap.add_argument("--workdir")
     ap.add_argument("--out", help="write the report JSON here")
     args = ap.parse_args(argv)
@@ -366,17 +469,31 @@ def main(argv=None) -> int:
         jobs=args.jobs, pool=args.pool, sample=args.sample,
         churn=args.churn, previews=args.previews,
         idle_seconds=args.idle_seconds, kill=args.kill,
+        doctor=not args.no_doctor, doctor_events=args.doctor_events,
         workdir=args.workdir,
     ))
     print(json.dumps(report))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
+    rc = 0
     if not report["fleet_exactly_once_ok"]:
         print(f"EXACTLY-ONCE MISMATCH: jobs "
               f"{report['fleet_sample_mismatches']}", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if not args.no_doctor:
+        if report.get("fleet_attr_coverage_pct", 0) < 95.0:
+            print(f"ATTRIBUTION GAP: attributed busy covers only "
+                  f"{report.get('fleet_attr_coverage_pct')}% of measured "
+                  "worker busy time", file=sys.stderr)
+            rc = 1
+        if not report.get("fleet_doctor_ok"):
+            print(f"DOCTOR MISS: verdict="
+                  f"{report.get('fleet_doctor_verdict')} suspect="
+                  f"{report.get('fleet_doctor_suspect')} (expected "
+                  "noisy-neighbor naming the hog job)", file=sys.stderr)
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Flight-recorder trace merger / summarizer (ISSUE 4).
+"""Flight-recorder trace merger / summarizer (ISSUE 4, extended by the
+ISSUE 11 fleet observatory).
 
 Merge Chrome trace-event JSON dumps from multiple processes (each
 worker's and the controller's `/debug/trace`, or the REST
@@ -10,11 +11,18 @@ chaos fire events).
 Usage:
   python tools/trace_report.py dump1.json dump2.json --out merged.json
   python tools/trace_report.py merged.json --summarize
-  python tools/trace_report.py --golden-ft --out golden-ft-trace.json
+  python tools/trace_report.py merged.json --job job7 --out job7.json
+  python tools/trace_report.py merged.json --doctor job7
+  python tools/trace_report.py --golden-ft --perfetto --out ft.json
 
 --golden-ft runs the golden windowed-aggregate fault-tolerance cycle
 (embedded cluster, seeded chaos faults, recovery from checkpoints) and
-writes its flight recording — CI uploads this on red runs.
+writes its flight recording — CI uploads this on red runs; with
+--perfetto the recording additionally carries the batch-phase timeline
+ledger as named per-(job, phase) tracks. --job filters any operation to
+one tenant's events; --doctor renders the bottleneck-doctor verdict
+OFFLINE from a dump (phase.* events reconstruct the signals), so a CI
+artifact is enough to name the limiting factor after the fact.
 """
 
 from __future__ import annotations
@@ -52,6 +60,23 @@ def load_events(paths: List[str]) -> List[dict]:
 
 def merge(paths: List[str]) -> dict:
     return {"traceEvents": load_events(paths), "displayTimeUnit": "ms"}
+
+
+def filter_job(events: List[dict], job_id: str) -> List[dict]:
+    """One tenant's events: spans by `{job_id}/` trace-id prefix, phase
+    ledger entries by their `job` arg, metadata rows kept (they name
+    tracks)."""
+    prefix = f"{job_id}/"
+    out = []
+    for ev in events:
+        if ev.get("ph") == "M":
+            out.append(ev)
+            continue
+        args = ev.get("args") or {}
+        tid = args.get("trace_id") or ""
+        if tid.startswith(prefix) or args.get("job") == job_id:
+            out.append(ev)
+    return out
 
 
 def group_traces(events: List[dict]) -> Dict[str, List[dict]]:
@@ -169,7 +194,40 @@ def latency_summary(report: dict, out=sys.stdout) -> None:
                   file=out)
 
 
-def run_golden_ft(out_path: str) -> int:
+def doctor_summary(events: List[dict], job_id: str, out=sys.stdout) -> int:
+    """Offline bottleneck doctor: reconstruct signals from a dump's
+    phase.* events and render the ranked verdict. Returns 0 when a
+    verdict could be produced, 1 when the dump carries no phase ledger
+    for the job (nothing to diagnose)."""
+    from arroyo_tpu.obs import doctor
+
+    sig = doctor.signals_from_trace(events, job_id)
+    if not sig["phases"] and not sig["neighbors"]:
+        print(f"no phase-ledger events for job {job_id!r} in the dump "
+              "(export with fmt=perfetto / --perfetto)", file=out)
+        return 1
+    rep = doctor.diagnose(sig)
+    v = rep["verdict"]
+    print(f"== doctor: {job_id}", file=out)
+    print(f"   verdict: {v['cause']} (score {v['score']}, confidence "
+          f"{v['confidence']})", file=out)
+    if v.get("suspect"):
+        print(f"   suspect: {v['suspect']}", file=out)
+    print(f"   {v['detail']}", file=out)
+    for r in rep["ranked"]:
+        print(f"   {r['cause']:<15} {r['score']}", file=out)
+    print(f"   busy_ratio={sig['busy_ratio']} window_s={sig['window_s']} "
+          f"loop_lag_ms_p99={sig['loop_lag_ms_p99']}", file=out)
+    if sig["phases"]:
+        print("   phases: " + " ".join(
+            f"{p}={s:.4f}s" for p, s in sorted(sig["phases"].items())
+        ), file=out)
+    for n in sig["neighbors"][:5]:
+        print(f"   neighbor {n['job']}: busy={n['busy_s']}s", file=out)
+    return 0
+
+
+def run_golden_ft(out_path: str, perfetto: bool = False) -> int:
     """Run the golden windowed-agg fault-tolerance cycle (embedded
     cluster + seeded faults + recovery) and write its flight recording.
     Returns 0 when the drill passed AND the checkpoint traces recorded."""
@@ -185,7 +243,7 @@ def run_golden_ft(out_path: str) -> int:
             plan_factory=drill.fast_plan, throttle=400.0,
         )
     spans = obs.recorder().snapshot()
-    doc = obs.chrome_trace(spans)
+    doc = obs.perfetto_trace(spans) if perfetto else obs.chrome_trace(spans)
     doc["drill"] = {"passed": res.passed, "error": res.error,
                     "restarts": res.restarts,
                     "fired": res.comparable_log}
@@ -209,11 +267,21 @@ def main(argv=None) -> int:
     ap.add_argument("--latency", action="store_true",
                     help="treat inputs as /debug/latency dumps and print "
                          "the device-tier observatory summary")
+    ap.add_argument("--job", help="filter every operation to one job's "
+                                  "events (spans by trace-id prefix, "
+                                  "phase entries by their job arg)")
+    ap.add_argument("--perfetto", action="store_true",
+                    help="with --golden-ft: include the batch-phase "
+                         "timeline ledger in the recording (named "
+                         "per-(job, phase) tracks)")
+    ap.add_argument("--doctor", metavar="JOB",
+                    help="render the bottleneck-doctor verdict OFFLINE "
+                         "from the input dumps' phase-ledger events")
     args = ap.parse_args(argv)
     if args.golden_ft:
         if not args.out:
             ap.error("--golden-ft requires --out")
-        return run_golden_ft(args.out)
+        return run_golden_ft(args.out, perfetto=args.perfetto)
     if args.latency:
         if not args.inputs:
             ap.error("no latency dumps given")
@@ -226,6 +294,10 @@ def main(argv=None) -> int:
     if not args.inputs:
         ap.error("no input dumps given")
     doc = merge(args.inputs)
+    if args.job:
+        doc["traceEvents"] = filter_job(doc["traceEvents"], args.job)
+    if args.doctor:
+        return doctor_summary(doc["traceEvents"], args.doctor)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(doc, f)

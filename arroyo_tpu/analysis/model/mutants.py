@@ -29,7 +29,7 @@ class Mutant(NamedTuple):
 
 def _cfg(**kw) -> ModelConfig:
     base = dict(workers=2, epochs=2, inflight=2, faults=0, restarts=2,
-                rescales=0, fault_kinds=FAULT_KINDS)
+                rescales=0, reads=0, fault_kinds=FAULT_KINDS)
     base.update(kw)
     return ModelConfig(**base)
 
@@ -145,6 +145,23 @@ MUTANTS: Dict[str, Mutant] = {
             config=_cfg(epochs=2, faults=1,
                         fault_kinds=("fault.fence",),
                         mutant="no_fence_check"),
+        ),
+        Mutant(
+            name="serve_reads_unpublished_epoch",
+            description=(
+                "StateServe invariant (ISSUE 12): queryable-state reads "
+                "serve at the last PUBLISHED epoch — the worker-side "
+                "view folds sealed epochs only up to the published "
+                "epoch the gateway resolved. The mutant reads at the "
+                "controller's last ISSUED epoch instead: a fanned-out-"
+                "but-unpublished checkpoint, i.e. a half-captured view "
+                "no manifest has made durable (and, post-recovery, one "
+                "a fenced generation may be superseding)."
+            ),
+            expect_violation=VIOLATIONS.SERVE,
+            config=_cfg(epochs=2, inflight=2, reads=1, faults=1,
+                        fault_kinds=("fault.kill",),
+                        mutant="serve_reads_unpublished_epoch"),
         ),
         Mutant(
             name="transitions_missing_recovering",

@@ -77,6 +77,7 @@ HANDLER_BINDINGS: Dict[str, Tuple[str, str]] = {
     "worker.await_commit": ("operators/runner.py", "_await_commit"),
     "state.capture_tables": ("state/table_manager.py", "capture"),
     "state.flush_tables": ("state/table_manager.py", "flush_captured"),
+    "serve.read": ("serve/store.py", "read"),
     "storage.new_generation": ("state/protocol.py", "initialize_generation"),
     "storage.check_fence": ("state/protocol.py", "check_current"),
     "storage.publish_manifest": ("state/protocol.py", "publish_checkpoint"),
@@ -126,6 +127,10 @@ TRANSITION_HANDLERS: Dict[str, Tuple[str, ...]] = {
     "fault.flush_fail": ("worker.flush",),
     "fault.zombie_write": ("state.flush_tables",),
     "fault.reschedule_fail": ("ctrl.rescale",),
+    # StateServe reader actor (ISSUE 12): reads at the last PUBLISHED
+    # epoch; the serve_reads_unpublished_epoch mutant reads at the last
+    # ISSUED epoch instead
+    "serve.read": ("serve.read",),
 }
 
 USED_EFFECTS: Set[str] = {
@@ -149,6 +154,7 @@ class ModelConfig(NamedTuple):
     faults: int = 1           # total fault-event budget
     restarts: int = 2         # controller max_restarts analog
     rescales: int = 0         # rescale-request budget (0 or 1)
+    reads: int = 0            # StateServe reader-actor event budget
     fault_kinds: Tuple[str, ...] = FAULT_KINDS
     mutant: str = ""          # mutants.py flag (empty == faithful model)
 
@@ -199,6 +205,7 @@ class Sys(NamedTuple):
     finalized: Tuple = ()     # ((epoch, gen), ...) visible committed txs
     zombies: Tuple = ()       # ((widx, epoch, gen), ...) pending late writes
     faults: int = 0           # fault budget spent
+    reads: int = 0            # serve-read budget spent
 
 
 class Step(NamedTuple):
@@ -234,6 +241,7 @@ class _V:
     STALL = "dead-worker-undetected-stall"
     DEADLOCK = "deadlock"
     STUCK = "non-terminal-state-cannot-terminate"
+    SERVE = "serve-read-inconsistent"
 
 
 VIOLATIONS = _V
@@ -479,10 +487,52 @@ class Model:
                     )),
                 ))
 
+        if (s.reads < cfg.reads
+                and ctrl.js in ("RUNNING", "CHECKPOINT_STOPPING",
+                                "RESCALING")):
+            out.append(self._serve_read(s))
+
         out.extend(self._fault_steps(s))
         for z in s.zombies:
             out.append(self._zombie_write(s, z))
         return out
+
+    # -- StateServe reader actor (ISSUE 12) ----------------------------------
+
+    def _serve_read(self, s: Sys) -> Step:
+        """One queryable-state read. Faithful model: the read resolves at
+        the last PUBLISHED epoch (store.latest) and its blobs under that
+        manifest's generation — the invariant is that no read observes a
+        partially-published epoch or a fenced generation's blob. The
+        `serve_reads_unpublished_epoch` mutant reads at the controller's
+        last ISSUED epoch instead (a fanned-out-but-unpublished
+        checkpoint), which is exactly the half-captured view the real
+        read path's published-epoch fold forbids."""
+        ctrl, store = s.ctrl, s.store
+        epoch = (ctrl.epoch
+                 if self.cfg.mutant == "serve_reads_unpublished_epoch"
+                 else store.latest)
+        nxt = s._replace(reads=s.reads + 1)
+        if epoch <= 0:
+            return Step("serve.read", (epoch,), nxt)  # empty view: fine
+        gen = dict(store.manifests).get(epoch)
+        if gen is None:
+            return Step(
+                "serve.read", (epoch,), None,
+                f"{_V.SERVE}: read observed epoch {epoch} with no "
+                f"published manifest (last published {store.latest})",
+            )
+        base = dict(store.gen_base).get(gen, 0)
+        blob_keys = set(store.blobs)
+        for widx in range(len(s.workers)):
+            for e in range(base + 1, epoch + 1):
+                if (e, widx, gen) not in blob_keys:
+                    return Step(
+                        "serve.read", (epoch,), None,
+                        f"{_V.SERVE}: read resolved a missing/fenced "
+                        f"blob (epoch {e}, worker {widx}, gen {gen})",
+                    )
+        return Step("serve.read", (epoch,), nxt)
 
     def _liveness_masked(self, s: Sys) -> bool:
         if self.cfg.mutant == "no_liveness_in_stop_wait":
@@ -889,6 +939,7 @@ class Model:
                     st.label for st in enabled
                     if st.label not in TIMEOUT_KINDS
                     and not st.label.startswith("fault.")
+                    and st.label != "serve.read"  # reads never unstick
                 }
                 if not progress:
                     return (f"{_V.STALL}: worker(s) {dead} dead in "

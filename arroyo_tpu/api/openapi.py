@@ -70,6 +70,18 @@ ROUTES: List[Route] = [
      "the limiting operator — and, for noisy-neighbor, the co-resident "
      "tenant suspected of holding the shared worker", "jobs",
      None, "DoctorReport"),
+    ("get", "/jobs/{job_id}/state", "job_state_tables",
+     "Queryable state tables of a running job (StateServe): every keyed "
+     "operator view with key/value fields and the published epoch reads "
+     "serve at", "state", None, "StateTableCollection"),
+    ("get", "/jobs/{job_id}/state/{table}", "job_state_get",
+     "Point lookup of one key's aggregate at the last published "
+     "checkpoint epoch (?key=K; JSON-encoded for non-string keys)",
+     "state", None, "StateReadResult"),
+    ("post", "/jobs/{job_id}/state/{table}", "job_state_bulk",
+     "Bulk multi-key lookup: keys fan out to their owning workers "
+     "concurrently and merge into one epoch-consistent response",
+     "state", "StateReadPost", "StateReadResult"),
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
@@ -359,6 +371,34 @@ def _schemas() -> Dict[str, Any]:
              "device": {"type": "object"}},
             ["operators", "end_to_end", "device"],
         ),
+        "StateTable": _obj(
+            {"table": _str(), "node_id": _int(), "parallelism": _int(),
+             "key_fields": {"type": "array", "items": _str()},
+             "key_kinds": {"type": "array", "items": _str()},
+             "value_fields": {"type": "array", "items": _str()},
+             "kind": {"type": "string", "enum": ["window", "updating"]},
+             "routable": {"type": "boolean"},
+             "live_mode": {"type": "boolean"}},
+            ["table", "node_id", "parallelism"],
+        ),
+        "StateReadPost": _obj(
+            {"keys": {"type": "array", "items": {}}}, ["keys"],
+        ),
+        "StateKeyResult": _obj(
+            {"key": {}, "found": {"type": "boolean"},
+             "value": {"type": "object", "nullable": True},
+             "cached": {"type": "boolean"},
+             "error": {**_str(), "nullable": True},
+             "retriable": {"type": "boolean"}},
+            ["found"],
+        ),
+        "StateReadResult": _obj(
+            {"job": _str(), "table": _str(),
+             "epoch": {**_int(), "nullable": True},
+             "results": {"type": "array", "items": _ref("StateKeyResult")},
+             "cache": {"type": "object"}},
+            ["results"],
+        ),
         "OutputData": _obj(
             {"rows": {"type": "array", "items": {"type": "object"}},
              "done": {"type": "boolean"},
@@ -378,6 +418,7 @@ def _schemas() -> Dict[str, Any]:
         ("ConnectionProfile", "ConnectionProfileCollection"),
         ("ConnectionTable", "ConnectionTableCollection"),
         ("GlobalUdf", "GlobalUdfCollection"),
+        ("StateTable", "StateTableCollection"),
     ]:
         s[name] = _collection(item)
     return s

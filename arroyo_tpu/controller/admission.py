@@ -85,6 +85,14 @@ class AdmissionController:
     def tenant_held(self, tenant: str) -> int:
         return sum(s for (t, s) in self.held.values() if t == tenant)
 
+    def tenant_at_quota(self, tenant: str) -> bool:
+        """True when the tenant's held slots reached its quota — the
+        serve gateway reads this (StateServe, ISSUE 12): a tenant
+        saturating its COMPUTE quota gets its READ quota clamped too,
+        so one hot tenant can't starve both sides of the fleet."""
+        quota = int(config().admission.tenant_quota_slots or 0)
+        return bool(quota) and self.tenant_held(tenant) >= quota
+
     def _grantable(self, tenant: str, need: int) -> bool:
         cap = self.capacity()
         if not self.held:

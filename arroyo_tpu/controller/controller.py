@@ -146,6 +146,10 @@ class JobHandle:
         self.workers: List[WorkerHandle] = []
         self.assignments: Dict[tuple, int] = {}
         self.epoch = 0
+        # last epoch whose manifest PUBLISHED (or restored from): the
+        # serving tier's read snapshot level — reads never observe a
+        # fanned-out-but-unpublished epoch (StateServe, ISSUE 12)
+        self.published_epoch = 0
         self.n_subtasks = sum(n.parallelism for n in graph.nodes.values())
         # autoscale/rescale state: per-node parallelism overrides applied
         # on top of the base plan (shipped to workers so their SQL re-plan
@@ -241,6 +245,12 @@ class ControllerServer:
         self._job_tasks: Dict[str, asyncio.Task] = {}
         self.wheel = TimerWheel()
         self.admission = AdmissionController(self)
+        # StateServe gateway (ISSUE 12): the queryable-state read path —
+        # key-routed worker fan-out, epoch-invalidated cache, per-tenant
+        # read admission. REST state routes and /debug/serve read it.
+        from ..serve.gateway import StateGateway
+
+        self.serve = StateGateway(self)
         self._reg_waiters: set = set()  # scheduling waits on registration
         # handles pruned on suspicion of death, kept so a heartbeat
         # re-registration can resurrect the SAME object — jobs hold
@@ -291,10 +301,32 @@ class ControllerServer:
             },
             extra_routes={
                 "/debug/autoscale": self._debug_autoscale,
+                "/debug/serve": self._debug_serve,
             },
         )
         logger.info("controller up at %s", self.addr)
         return self
+
+    async def _debug_serve(self, request):
+        """Admin surface: serve-gateway status (cache occupancy, tenant
+        quotas + noisy flags, slowest read); `?job=<id>` adds the job's
+        table registry + published epoch."""
+        from aiohttp import web
+
+        doc = self.serve.status()
+        jid = request.query.get("job")
+        if jid and jid in self.jobs:
+            job = self.jobs[jid]
+            doc["job"] = {
+                "id": jid,
+                "state": job.state.value,
+                "published_epoch": job.published_epoch,
+                "schedules": job.schedules,
+                "tables": await self.serve.tables(jid),
+            }
+        return web.json_response(
+            doc, dumps=lambda d: json.dumps(d, default=str)
+        )
 
     async def _debug_autoscale(self, request):
         """Admin surface: the autoscaler's per-job decision audit log."""
@@ -432,6 +464,10 @@ class ControllerServer:
         job = self._req_job(req)
         if job is not None:
             job.epoch = max(job.epoch, req["epoch"])
+            # worker-leader mode publishes manifests on the leader; this
+            # report is the controller's (and the serving tier's) only
+            # view of publication progress
+            job.published_epoch = max(job.published_epoch, req["epoch"])
             job.kick()
         return {}
 
@@ -608,6 +644,11 @@ class ControllerServer:
             await self.scheduler.stop_workers(job.job_id, force=force)
         if expunge:
             self.admission.release(job)
+            # serving-tier GC: cached reads and routing state of a
+            # terminal job go NOW (reads already refuse non-RUNNING
+            # jobs; the job-labeled arroyo_serve_* series ride the
+            # drop_job below)
+            self.serve.expunge_job(job.job_id)
             from ..metrics import REGISTRY
 
             # cardinality GC: a churned fleet must not grow /metrics
@@ -754,6 +795,9 @@ class ControllerServer:
         }
         if job.backend and job.backend.restore_epoch:
             job.epoch = job.backend.restore_epoch
+            # the restore manifest IS the last published state: reads
+            # resume at it the moment the job is RUNNING again
+            job.published_epoch = job.backend.restore_epoch
         # worker-leader mode: the first worker runs the job-control loop
         # (checkpoint cadence, manifests, 2PC); the controller only
         # supervises scheduling/recovery/stop (reference JobControllerMode)
@@ -869,6 +913,9 @@ class ControllerServer:
                                 timeout=90.0,
                             )
                             job.epoch = max(job.epoch, resp.get("epoch", 0))
+                            job.published_epoch = max(
+                                job.published_epoch, resp.get("epoch", 0)
+                            )
                         except Exception as e:  # noqa: BLE001
                             if len(job.finished_tasks) >= job.n_subtasks:
                                 logger.warning(
@@ -1218,6 +1265,9 @@ class ControllerServer:
             logger.warning("checkpoint %d publish failed: %r", epoch, e)
             job.failure = f"checkpoint {epoch} publish failed: {e!r}"
             return
+        # the manifest is durable: advance the serving tier's read
+        # snapshot (cache entries of earlier epochs self-invalidate)
+        job.published_epoch = max(job.published_epoch, epoch)
         try:
             committing = manifest.get("committing")
             if committing and job.backend.claim_commit(epoch):

@@ -155,6 +155,7 @@ class WorkerServer:
                 "StopExecution": self.stop_execution,
                 "StopJob": self.stop_job_rpc,
                 "GetMetrics": self.get_metrics,
+                "QueryState": self.query_state,
             },
         )
         rpc_port = await self.rpc.start()
@@ -461,6 +462,31 @@ class WorkerServer:
         if jr.leader_client is not None:
             await jr.leader_client.close()
         jr.finished.set()
+
+    async def query_state(self, req: dict) -> dict:
+        """StateServe read handler (ISSUE 12): answer point / bulk /
+        table-listing lookups against this worker's live serve views —
+        synchronous dict work on the event loop, nothing blocks the
+        batch path. Incarnation-fenced: a request carrying a data_ns of
+        a torn-down incarnation (rescale/recovery raced the gateway's
+        routing) answers `stale_route` instead of serving state a fresh
+        generation may be superseding."""
+        jid = req.get("job_id")
+        jr = self._jobs.get(jid) if jid is not None else (
+            next(iter(self._jobs.values())) if len(self._jobs) == 1
+            else None
+        )
+        if jr is None or jr.torn_down:
+            return {"error": f"stale_route: worker {self.worker_id} "
+                             f"hosts no live job {jid!r}",
+                    "retriable": True}
+        ns = req.get("data_ns")
+        if ns and ns != jr.data_ns:
+            return {"error": f"stale_route: {ns} != {jr.data_ns}",
+                    "retriable": True}
+        from ..serve import worker_read
+
+        return worker_read(jr.program, req)
 
     async def get_metrics(self, req: dict) -> dict:
         from ..metrics import REGISTRY

@@ -462,6 +462,32 @@ JOB_ATTR_PHASE_SECONDS = REGISTRY.counter(
     "wall seconds per batch-pipeline phase (phase=decode|process|"
     "dispatch|exchange|emit|flush|watermark) attributed to a job — the "
     "metric rollup of the timeline profiler's phase ledger")
+# StateServe (ISSUE 12): the queryable-state serving tier. Every family
+# carries a `job` label so Registry.drop_job GCs a stopped job's serve
+# series with the rest of its metrics; the tenant label on the request
+# counter is what per-tenant QPS dashboards and the noisy-neighbor
+# wiring read.
+SERVE_REQUEST_SECONDS = REGISTRY.histogram(
+    "arroyo_serve_request_seconds",
+    "gateway wall time serving one state read request (routing + cache "
+    "+ worker fan-out + merge), per job")
+SERVE_REQUESTS = REGISTRY.counter(
+    "arroyo_serve_requests_total",
+    "state read requests through the gateway per (job, tenant, outcome="
+    "ok|partial|throttled|stale_route|error) — the per-tenant QPS "
+    "series read quotas are audited against")
+SERVE_KEYS = REGISTRY.counter(
+    "arroyo_serve_keys_total",
+    "individual key lookups served per job (a bulk read counts each "
+    "key; the fleet harness's lookups/s gate reads this)")
+SERVE_CACHE_HITS = REGISTRY.counter(
+    "arroyo_serve_cache_hits_total",
+    "reads answered from the controller-side read-through cache "
+    "(entry's published epoch and schedule incarnation both matched)")
+SERVE_CACHE_MISSES = REGISTRY.counter(
+    "arroyo_serve_cache_misses_total",
+    "reads that fanned out to a worker (cold key, epoch-invalidated "
+    "entry, or cache disabled)")
 LOOP_LAG_SECONDS = REGISTRY.histogram(
     "arroyo_worker_loop_lag_seconds",
     "event-loop scheduling lag sampled by the accounting pump (sleep-"

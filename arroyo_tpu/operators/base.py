@@ -29,6 +29,11 @@ class Operator:
     """Base class for dataflow operators. Subclasses override the hooks they
     need; `process_batch` is the hot path."""
 
+    # StateServe: keyed operators get a ServeView attached at task start
+    # (serve.register_op); None everywhere else keeps the emission-path
+    # check a single attribute load
+    _serve_view = None
+
     def __init__(self, name: str = ""):
         self.name = name or type(self).__name__
 

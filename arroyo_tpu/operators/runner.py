@@ -180,11 +180,17 @@ class SubtaskRunner:
             # stages of a (re)start in the flight recording
             with obs.span("task.start", cat="runner",
                           task=self.task_info.task_id) as sp:
+                from ..serve import register_op as serve_register
+
                 for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
                     if ctx.table_manager is not None:
                         await ctx.table_manager.open(op.tables())
                     sp.event("on_start", op=type(op).__name__, op_idx=idx)
                     await op.on_start(ctx)
+                    # StateServe: keyed operators expose an epoch-
+                    # consistent read view (seeded from restored state,
+                    # so a recovered job serves immediately)
+                    serve_register(op, ctx)
             if self.is_source:
                 await self._run_source()
             else:
@@ -626,10 +632,17 @@ class SubtaskRunner:
         t0 = time.perf_counter()
         cap_span = self._barrier_span("checkpoint.capture", barrier)
         with cap_span:
+            from ..serve import seal_op
+
             captured = []
             commit_data = None
             for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
                 await op.handle_checkpoint(barrier, ctx, self.collectors[idx])
+                # StateServe: seal the view's staged rows under this
+                # epoch at the same synchronization point the state
+                # capture stamps dirty entries — reads at published
+                # epoch P then see exactly P's durable view
+                seal_op(op, barrier.epoch)
                 if ctx.table_manager is not None:
                     captured.append(
                         (

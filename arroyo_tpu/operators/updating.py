@@ -437,6 +437,21 @@ class UpdatingAggregateOperator(WindowOperatorBase):
                 append_keys.append(key)
                 append_vals.append(new_vals)
                 self.emitted[key] = new_vals
+        if self._serve_view is not None:
+            # StateServe: mirror the flushed aggregates into the serve
+            # view — appends overwrite the key, a fully-retracted key
+            # stages a tombstone (sealed at the next capture)
+            view = self._serve_view
+            for key, vals in zip(append_keys, append_vals):
+                view.stage(
+                    view.canon_key(self._key_tuple_to_values(key)),
+                    dict(zip(view.value_names, vals)),
+                )
+            for key, old in zip(retract_keys, retract_vals):
+                if key not in self.emitted:  # final retraction (dead key)
+                    view.stage_tomb(
+                        view.canon_key(self._key_tuple_to_values(key))
+                    )
         if not retract_keys and not append_keys:
             return
         # flushes before the first watermark stamp rows with the max

@@ -674,7 +674,15 @@ class WindowOperatorBase(Operator):
                     ))
                 else:
                     arrays.append(pa.array(col.astype(np.int64), type=f.type))
-        return pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
+        out = pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
+        if self._serve_view is not None:
+            # StateServe: mirror the emitted window results into the
+            # serve view's stage buffer (sealed at the next checkpoint
+            # capture; reads see them once that epoch publishes)
+            from ..serve import stage_batch
+
+            stage_batch(self._serve_view, out)
+        return out
 
     # -- checkpoint form ----------------------------------------------------
 

@@ -1,0 +1,39 @@
+"""StateServe: the queryable-state serving tier (ISSUE 12, ROADMAP 2).
+
+A partition-aware read path from HTTP request to worker-resident state
+and back — Flink queryable state (Carbone et al., VLDB'17) built on this
+engine's own epoch machinery, with a dash of Noria (Gjengset et al.,
+OSDI'18): reads are served from the dataflow's keyed views, not from
+sink output files.
+
+  * `store.py` — worker-side epoch-consistent views. Keyed operators
+    (windowed aggregates, updating aggregates) stage each emitted
+    (key -> aggregate) row into a per-operator `ServeView`; the runner
+    SEALS the staged rows at every checkpoint capture, stamping them
+    with the barrier's epoch (reusing PR 8's epoch-stamped capture
+    machinery), and reads fold sealed epochs up to the last *published*
+    epoch — so a read never observes a half-captured checkpoint and
+    needs no barrier coordination.
+  * `gateway.py` — the controller-resident router: key -> owning
+    worker/subtask via the same splitmix64 hash-range ownership map the
+    shuffle and rescale re-read use, bulk fan-out, a read-through cache
+    invalidated by published epoch, per-tenant QPS admission (wired to
+    the PR 11 doctor's noisy-neighbor verdict), and incarnation
+    fencing across rescale/recovery (PR 10's `{job}@{schedules}` route
+    namespaces).
+
+Surfaces: `GET/POST /api/v1/jobs/{id}/state[/{table}]` REST routes,
+`/debug/serve` on the controller admin server, and the `arroyo_serve_*`
+metric families (request latency, cache hit ratio, per-tenant QPS)
+flowing into the per-tenant attribution pump.
+"""
+
+from .store import (  # noqa: F401 - public surface
+    ServeView,
+    owner_subtask,
+    register_op,
+    seal_op,
+    stage_batch,
+    worker_read,
+)
+from .gateway import StateGateway  # noqa: F401

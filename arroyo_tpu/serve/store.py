@@ -1,0 +1,436 @@
+"""Worker-side serve views: epoch-consistent keyed read snapshots.
+
+A `ServeView` hangs off one keyed operator instance (one per subtask)
+and mirrors the operator's *emitted* aggregates as a key -> value map
+with three layers:
+
+  * `stage` — rows emitted since the last checkpoint barrier. Written
+    by the operator's emission path (window results at watermark
+    drains, updating-aggregate flushes); never visible to reads.
+  * `pending[epoch]` — rows sealed at capture of `epoch` (the runner
+    calls `seal_op` right after `handle_checkpoint`, i.e. at the exact
+    point PR 8's `serialize_delta` stamps dirty state with the epoch).
+  * `served` — the fold of every pending epoch <= the read's published
+    epoch. Reads fold lazily, so the view needs no notification when
+    the controller publishes a manifest: the published epoch rides in
+    on each QueryState request from the gateway.
+
+Durability alignment: state the controller published at epoch P is
+exactly what the operators had captured at P's barrier, so folding
+pending epochs <= P reproduces the last durable view — a read can never
+observe a half-captured epoch, a torn value, or (after recovery fenced
+a generation) anything newer than the state the restore will replay.
+Jobs WITHOUT durable state (no checkpoint barriers ever) run their
+views in live mode: staged rows apply immediately and reads see the
+latest emission, which is the only consistent level such a job has.
+
+Routing: `owner_subtask` mirrors the engine's shuffle partitioning
+exactly — per-column `types.hash_column` (splitmix64 / pandas siphash),
+`hash_arrays` combine, `server_for_hash_array` hash-range map — so the
+gateway's key -> subtask routing and a worker's local ownership check
+agree with `parallel/sharded_state.py owners_for` by construction.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis.model.effects import protocol_effect
+from ..config import config
+from ..types import hash_arrays, hash_column, server_for_hash_array
+from ..utils.logging import get_logger
+
+logger = get_logger("serve")
+
+_TOMB = object()  # sealed deletion marker (updating-aggregate retraction)
+
+# key-column kinds: how request/staged values canonicalize + hash.
+#   i = signed int / timestamp-as-int   u = unsigned int
+#   f = float   s = string   o = other (unroutable; fan-out reads)
+_KIND_DTYPE = {"i": np.int64, "u": np.uint64, "f": np.float64}
+
+
+def _kind_of(arrow_type) -> str:
+    import pyarrow as pa
+
+    if pa.types.is_unsigned_integer(arrow_type):
+        return "u"
+    if pa.types.is_integer(arrow_type) or pa.types.is_timestamp(arrow_type):
+        return "i"
+    if pa.types.is_floating(arrow_type):
+        return "f"
+    if pa.types.is_string(arrow_type) or pa.types.is_large_string(arrow_type):
+        return "s"
+    return "o"
+
+
+def canon_value(v, kind: str):
+    """Canonical python form of one key component: the same value staged
+    from an arrow column and parsed from a JSON request must compare AND
+    hash identically."""
+    if kind in ("i", "u"):
+        if isinstance(v, datetime.datetime):
+            return int(np.datetime64(v, "ns").astype(np.int64))
+        return int(v)
+    if kind == "f":
+        return float(v)
+    if kind == "s":
+        return str(v)
+    return _hashable(v)
+
+
+def _hashable(v):
+    """Hashable canonical form of an 'o'-kind key component (struct
+    keys arrive as dicts from arrow, as lists from JSON requests)."""
+    if isinstance(v, dict):
+        return tuple(_hashable(v[k]) for k in sorted(v))
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, datetime.datetime):
+        return int(np.datetime64(v, "ns").astype(np.int64))
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _plain(v):
+    """Msgpack/JSON-safe deep conversion of a staged value."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, datetime.datetime):
+        return int(np.datetime64(v, "ns").astype(np.int64))
+    if isinstance(v, dict):
+        return {str(k): _plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    if isinstance(v, np.generic):
+        return v.item()
+    return str(v)
+
+
+def owner_subtask(key: Tuple, kinds: Tuple[str, ...], parallelism: int) -> int:
+    """Owning subtask index for one canonical key tuple — the §2.9-2.11
+    routing contract: per-column splitmix64/siphash, seeded xor-mix
+    combine, contiguous hash-range map (types.server_for_hash_array)."""
+    if parallelism <= 1 or not key:
+        return 0
+    cols = []
+    for v, k in zip(key, kinds):
+        dtype = _KIND_DTYPE.get(k)
+        if dtype is not None:
+            arr = np.asarray([v]).astype(dtype)
+        else:
+            arr = np.array([v], dtype=object)
+        cols.append(hash_column(arr))
+    return int(server_for_hash_array(hash_arrays(cols), parallelism)[0])
+
+
+class ServeView:
+    """One subtask's epoch-consistent keyed view of an operator's
+    emitted aggregates (see module docstring for the layer semantics)."""
+
+    def __init__(self, *, job_id: str, table: str, node_id: int,
+                 task_index: int, parallelism: int,
+                 key_names: List[str], key_kinds: Tuple[str, ...],
+                 value_names: List[str], kind: str, live_mode: bool):
+        self.job_id = job_id
+        self.table = table
+        self.node_id = node_id
+        self.task_index = task_index
+        self.parallelism = parallelism
+        self.key_names = list(key_names)
+        self.key_kinds = tuple(key_kinds)
+        self.value_names = list(value_names)
+        self.kind = kind  # "window" | "updating"
+        self.live_mode = live_mode
+        self.routable = all(k in _KIND_DTYPE or k == "s"
+                            for k in self.key_kinds)
+        self.served: Dict[Tuple, Any] = {}
+        self.served_epoch = 0          # highest epoch folded into served
+        self.pending: Dict[int, Dict[Tuple, Any]] = {}
+        self._stage: Dict[Tuple, Any] = {}
+        self._max_pending = max(1, int(config().serve.max_pending_epochs))
+
+    # -- write side (operator emission + runner capture) ---------------------
+
+    def canon_key(self, values) -> Tuple:
+        return tuple(
+            canon_value(v, k) for v, k in zip(values, self.key_kinds)
+        )
+
+    def stage(self, key: Tuple, value):
+        if self.live_mode:
+            self.served[key] = value
+        else:
+            self._stage[key] = value
+
+    def stage_tomb(self, key: Tuple):
+        if self.live_mode:
+            self.served.pop(key, None)
+        else:
+            self._stage[key] = _TOMB
+
+    def seal(self, epoch: int):
+        """Move the staged rows under `epoch` (called at checkpoint
+        capture, synchronously at the barrier). Bounded: past
+        serve.max_pending_epochs the oldest pending epoch folds forward
+        (publication stalled far beyond the inflight window)."""
+        if not self._stage:
+            return
+        self.pending.setdefault(epoch, {}).update(self._stage)
+        self._stage = {}
+        while len(self.pending) > self._max_pending:
+            self._fold_one(min(self.pending))
+
+    def _fold_one(self, epoch: int):
+        for k, v in self.pending.pop(epoch).items():
+            if v is _TOMB:
+                self.served.pop(k, None)
+            else:
+                self.served[k] = v
+        self.served_epoch = max(self.served_epoch, epoch)
+
+    def fold_to(self, epoch: int):
+        for e in sorted(self.pending):
+            if e > epoch:
+                break
+            self._fold_one(e)
+
+    # -- read side -----------------------------------------------------------
+
+    @protocol_effect("serve.read")
+    def read(self, key: Tuple, epoch: Optional[int]):
+        """(found, value) at the given published epoch (None = live
+        mode: serve whatever has been folded/staged so far). Rows sealed
+        at epochs > `epoch` stay invisible — the no-torn-read contract
+        the model checker's reader actor pins."""
+        if epoch is not None and not self.live_mode:
+            self.fold_to(epoch)
+        if key in self.served:
+            return True, self.served[key]
+        return False, None
+
+    def stats(self) -> dict:
+        return {
+            "table": self.table,
+            "task_index": self.task_index,
+            "keys": len(self.served),
+            "pending_epochs": len(self.pending),
+            "staged": len(self._stage),
+            "served_epoch": self.served_epoch,
+        }
+
+    def describe(self) -> dict:
+        return {
+            "table": self.table,
+            "node_id": self.node_id,
+            "parallelism": self.parallelism,
+            "key_fields": self.key_names,
+            "key_kinds": list(self.key_kinds),
+            "value_fields": self.value_names,
+            "kind": self.kind,
+            "routable": self.routable,
+            "live_mode": self.live_mode,
+        }
+
+
+# -- operator integration -----------------------------------------------------
+
+
+def register_op(op, ctx) -> Optional[ServeView]:
+    """Attach a ServeView to a keyed operator at task start (called by
+    the runner after on_start, once restore has run). Returns None —
+    and leaves the operator untouched — when serving is disabled, the
+    operator kind has no keyed view, or the view would be meaningless
+    (keyless state on a parallel node holds per-subtask partials)."""
+    if not config().serve.enabled:
+        return None
+    from ..operators.updating import UpdatingAggregateOperator
+    from ..operators.windows import WindowOperatorBase
+    from ..schema import TIMESTAMP_FIELD
+
+    if isinstance(op, UpdatingAggregateOperator):
+        kind = "updating"
+    elif isinstance(op, WindowOperatorBase):
+        kind = "window"
+    else:
+        return None
+    key_names = list(getattr(op, "_key_names", None) or [])
+    ti = ctx.task_info
+    if not key_names and ti.parallelism > 1:
+        # keyless aggregate on a parallel node: every subtask holds a
+        # PARTIAL — no single owner can answer, so no view
+        return None
+    schema = op.out_schema.schema
+    name_to_type = {f.name: f.type for f in schema}
+    key_kinds = tuple(
+        _kind_of(name_to_type[n]) if n in name_to_type else "o"
+        for n in key_names
+    )
+    # every non-key output column is value payload EXCEPT the row
+    # timestamp and the updating meta column; planner-internal aggregate
+    # outputs (__agg_out_N) stay — they ARE the aggregate, the friendly
+    # alias often lives on a downstream projection node
+    if kind == "updating":
+        # updating flushes stage (key -> finalized spec values) directly,
+        # so the value names must align with the accumulator spec order
+        value_names = [s.name for s in op.specs]
+    else:
+        value_names = [
+            f.name for f in schema
+            if f.name not in key_names and f.name != TIMESTAMP_FIELD
+            and f.name != "__updating_meta"
+        ]
+    view = ServeView(
+        job_id=ti.job_id, table=op.name, node_id=ti.node_id,
+        task_index=ti.task_index, parallelism=ti.parallelism,
+        key_names=key_names, key_kinds=key_kinds,
+        value_names=value_names, kind=kind,
+        live_mode=ctx.table_manager is None,
+    )
+    op._serve_view = view
+    if kind == "updating" and getattr(op, "emitted", None):
+        # restore seeding: the restored `emitted` map IS the last
+        # published epoch's view — without it a recovered job would
+        # 404 every key until its next flush re-emits it
+        for k, vals in op.emitted.items():
+            try:
+                key = view.canon_key(op._key_tuple_to_values(k))
+            except Exception:  # noqa: BLE001 - exotic key shape
+                continue
+            view.served[key] = {
+                n: _plain(v) for n, v in zip(view.value_names, vals)
+            }
+    return view
+
+
+def stage_batch(view: ServeView, batch) -> None:
+    """Stage every row of an emitted output batch into the view (the
+    window operators' hook: one call per emitted window batch). Key
+    columns index by the view's key order; all other non-internal
+    columns become the value dict."""
+    names = batch.schema.names
+    cols = {n: batch.column(i).to_pylist() for i, n in enumerate(names)}
+    vnames = [n for n in view.value_names if n in cols]
+    knames = view.key_names
+    for r in range(batch.num_rows):
+        key = view.canon_key(tuple(cols[n][r] for n in knames))
+        view.stage(key, {n: _plain(cols[n][r]) for n in vnames})
+
+
+def seal_op(op, epoch: int) -> None:
+    """Runner hook at checkpoint capture: seal the operator's staged
+    rows under this barrier's epoch (no-op without a view)."""
+    view = getattr(op, "_serve_view", None)
+    if view is not None:
+        view.seal(epoch)
+
+
+# -- the worker read handler --------------------------------------------------
+
+
+def _views_of(program) -> Dict[str, Dict[int, ServeView]]:
+    """{table: {task_index: view}} over one job's local subtasks. Table
+    names qualify as `{name}@{node_id}` as well; the bare name resolves
+    when it is unique across nodes."""
+    out: Dict[str, Dict[int, ServeView]] = {}
+    nodes: Dict[str, set] = {}
+    for sub in program.subtasks:
+        for op in sub.runner.ops:
+            view = getattr(op, "_serve_view", None)
+            if view is None:
+                continue
+            out.setdefault(f"{view.table}@{view.node_id}", {})[
+                view.task_index] = view
+            nodes.setdefault(view.table, set()).add(view.node_id)
+    for name, nids in nodes.items():
+        if len(nids) == 1:
+            out[name] = out[f"{name}@{next(iter(nids))}"]
+    return out
+
+
+def worker_read(program, req: dict) -> dict:
+    """Answer one QueryState request against a job's local views —
+    synchronous dict work only, nothing here blocks the batch loop.
+
+    Modes: `tables` lists the views this worker hosts; `get` resolves
+    each key to its owning subtask (same hash the gateway used) and
+    reads the local view at the request's published epoch. A key whose
+    owner is not hosted here answers `not_owned` (gateway mis-route or
+    rescale race — retriable)."""
+    if not config().serve.enabled:
+        return {"error": "serving disabled", "retriable": False}
+    views = _views_of(program)
+    if req.get("mode") == "tables":
+        seen = []
+        for name, by_task in sorted(views.items()):
+            if "@" in name:
+                continue
+            any_view = next(iter(by_task.values()))
+            seen.append(any_view.describe())
+        for name, by_task in sorted(views.items()):
+            if "@" in name and name.split("@")[0] not in views:
+                seen.append(next(iter(by_task.values())).describe())
+        return {"tables": seen}
+    table = req.get("table") or ""
+    by_task = views.get(table)
+    if by_task is None:
+        # retriable: the gateway only routes tables its (fresh) listing
+        # knows, so a worker-side miss is a startup race — the runner
+        # has not reached on_start/register yet (recovery, rescale).
+        # Unknown table NAMES fail fast at the gateway, not here.
+        return {"error": f"no such table {table!r} (yet)",
+                "retriable": True}
+    epoch = req.get("epoch")  # None = live mode
+    max_keys = int(config().serve.max_keys)
+    keys = req.get("keys") or []
+    if len(keys) > max_keys:
+        return {"error": f"too many keys (> {max_keys})",
+                "retriable": False}
+    any_view = next(iter(by_task.values()))
+    results = []
+    for raw in keys:
+        vals = raw if isinstance(raw, (list, tuple)) else [raw]
+        if len(vals) != len(any_view.key_kinds):
+            results.append({"key": raw, "found": False,
+                            "error": "key arity mismatch",
+                            "retriable": False})
+            continue
+        try:
+            key = any_view.canon_key(vals)
+        except (TypeError, ValueError):
+            results.append({"key": raw, "found": False,
+                            "error": "bad key", "retriable": False})
+            continue
+        if any_view.routable:
+            owner = owner_subtask(key, any_view.key_kinds,
+                                  any_view.parallelism)
+            view = by_task.get(owner)
+            if view is None:
+                results.append({"key": raw, "found": False,
+                                "error": "not_owned", "retriable": True,
+                                "owner": owner})
+                continue
+            found, value = view.read(key, epoch)
+        else:
+            # unroutable key shape: check every local subtask's view
+            found, value = False, None
+            for view in by_task.values():
+                found, value = view.read(key, epoch)
+                if found:
+                    break
+        results.append({"key": raw, "found": found, "value": value})
+    return {"results": results, "epoch": epoch}
+
+
+def view_stats(program) -> List[dict]:
+    """Admin surface: per-view occupancy of one job's local views."""
+    return [
+        v.stats()
+        for name, by_task in sorted(_views_of(program).items())
+        if "@" in name
+        for v in by_task.values()
+    ]

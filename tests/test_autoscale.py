@@ -483,12 +483,18 @@ def test_autoscaler_e2e_backpressure_rescale(tmp_path):
     assert rescales >= 1, (
         f"autoscaler never actuated; decisions: {decisions[-8:]}"
     )
-    # some node runs at the scaled-up parallelism now
-    assert max(parallelism.values()) == 2
-
     # decision audit log: a rescale decision driven by backpressure
     acted = [d for d in decisions if d["action"] == "rescale"]
     assert acted, decisions
+    # some node ran at the scaled-up parallelism: assert the PEAK from
+    # the actuated decisions' targets rather than the final graph — on
+    # a slow/contended run the autoscaler legitimately scales back DOWN
+    # once the source drains, and the final parallelism is 1 again
+    peak = max(
+        max(int(p) for p in d["targets"].values())
+        for d in acted
+    )
+    assert peak == 2, acted
     reason = " ".join(acted[0]["reasons"].values())
     assert "saturation" in reason or "demand" in reason
     assert acted[0]["signals"], "rescale decision recorded without signals"

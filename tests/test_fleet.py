@@ -258,6 +258,12 @@ def test_metrics_cardinality_gc(tmp_path):
                     sql=bounded_sql(tmp_path, tag, j, n=1500),
                     n_workers=2,
                 )
+            # serving-tier GC (ISSUE 12): mint job-labeled
+            # arroyo_serve_* series + gateway routing/cache state for
+            # every churned job so the assertions below prove the serve
+            # tier rides the same expunge path as the rest
+            for j in range(n):
+                await c.serve.read(f"{tag}{j}", "tumbling_window", [0])
             for j in range(n):
                 await c.wait_for_state(
                     f"{tag}{j}", JobState.FINISHED, JobState.FAILED,
@@ -267,8 +273,10 @@ def test_metrics_cardinality_gc(tmp_path):
 
     asyncio.run(churn("warm", 1))  # register every family once
     # the warm job actually exercised the attribution families (they are
-    # part of the baseline length being asserted below)
+    # part of the baseline length being asserted below), and the serve
+    # read minted job-labeled arroyo_serve_* series
     assert "arroyo_job_attributed_busy_seconds" in REGISTRY.expose()
+    assert "arroyo_serve_requests_total" in REGISTRY.expose()
     baseline = len(REGISTRY.expose())
     asyncio.run(churn("gc", 6))
     after = len(REGISTRY.expose())
@@ -278,6 +286,8 @@ def test_metrics_cardinality_gc(tmp_path):
     # attributed families included
     text = REGISTRY.expose()
     assert 'job="gc0"' not in text and 'job="gc5"' not in text
+    # the serve families are job-labeled too: Registry.drop_job took the
+    # per-job serve series (request counts, cache hits) with the rest
     for j in range(6):
         # spans of torn-down jobs no longer linger until ring overwrite
         assert obs.recorder().snapshot(trace_prefix=f"gc{j}/") == []

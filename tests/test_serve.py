@@ -1,0 +1,591 @@
+"""StateServe — the queryable-state serving tier (ISSUE 12).
+
+Fast-tier coverage of the read path's three load-bearing contracts:
+
+  * epoch consistency — a read issued mid-checkpoint returns ONLY
+    last-published-epoch values (unit: the view's stage/seal/fold
+    layers; model: the reader actor explores clean with reads enabled);
+  * routing exactness — the gateway routes every key to the subtask
+    that actually owns it, for shard counts 2/4/8 and across a live
+    1 -> 4 -> 2 controller-driven rescale (cross-checked against
+    `MeshSlotDirectory.owners_for` and the job's assignment table);
+  * degradation — a worker SIGKILL mid-read-load yields retriable
+    errors or consistent values, never a torn one; a torn-down
+    incarnation's route fences (`stale_route`) instead of serving.
+
+Plus the serving-tier surfaces: REST point/bulk/table routes, the
+read-through cache's epoch invalidation, per-tenant QPS admission with
+the doctor's noisy-neighbor penalty, and GC on job stop.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.config import config, update
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+from arroyo_tpu.controller.state_machine import JobState
+from arroyo_tpu.serve import ServeView, owner_subtask
+from arroyo_tpu.serve.gateway import _Bucket, _Cache
+from arroyo_tpu.serve.store import canon_value
+from arroyo_tpu.types import (
+    hash_arrays,
+    hash_column,
+    server_for_hash_array,
+)
+
+
+def _view(**kw):
+    base = dict(job_id="j", table="t", node_id=1, task_index=0,
+                parallelism=1, key_names=["k"], key_kinds=("i",),
+                value_names=["cnt"], kind="window", live_mode=False)
+    base.update(kw)
+    return ServeView(**base)
+
+
+# -- epoch consistency (the acceptance unit test) ----------------------------
+
+
+def test_read_mid_checkpoint_returns_last_published_only():
+    """A read issued mid-checkpoint (state staged, sealed, or even
+    sealed-at-a-later-epoch) must return only values of the last
+    PUBLISHED epoch the gateway resolved."""
+    v = _view()
+    v.stage((1,), {"cnt": 10})
+    # staged but not yet captured: invisible at every published level
+    assert v.read((1,), 0) == (False, None)
+    v.seal(1)  # captured at epoch 1's barrier
+    # epoch 1 not published yet -> still invisible
+    assert v.read((1,), 0) == (False, None)
+    # epoch 1 published -> visible
+    assert v.read((1,), 1) == (True, {"cnt": 10})
+    # next interval: a newer value captured at epoch 2, published at 1:
+    # the read must keep answering epoch 1's value (no torn/early read)
+    v.stage((1,), {"cnt": 99})
+    assert v.read((1,), 1) == (True, {"cnt": 10})
+    v.seal(2)
+    assert v.read((1,), 1) == (True, {"cnt": 10})
+    assert v.read((1,), 2) == (True, {"cnt": 99})
+
+
+def test_view_tombstones_and_pending_cap():
+    v = _view()
+    v.stage((7,), {"cnt": 1})
+    v.seal(1)
+    v.stage_tomb((7,))
+    v.seal(2)
+    assert v.read((7,), 1) == (True, {"cnt": 1})
+    assert v.read((7,), 2) == (False, None)
+    # pending cap: publication stalls for > max_pending_epochs — the
+    # oldest epochs fold forward instead of growing without bound
+    with update(serve={"max_pending_epochs": 4}):
+        v2 = _view()
+        for e in range(1, 10):
+            v2.stage((e,), {"cnt": e})
+            v2.seal(e)
+        assert len(v2.pending) <= 4
+
+
+def test_view_live_mode_serves_latest():
+    """Jobs without durable state have no epochs: views serve live."""
+    v = _view(live_mode=True)
+    v.stage((1,), {"cnt": 5})
+    assert v.read((1,), None) == (True, {"cnt": 5})
+    v.stage_tomb((1,))
+    assert v.read((1,), None) == (False, None)
+
+
+def test_model_faithful_reader_clean_and_mutant_caught():
+    """The PR 9 checker with the reader actor: faithful model explores
+    exhaustively clean with reads enabled; the mutant's counterexample
+    is exercised by the standard corpus tests (test_model_check.py
+    parametrizes over every mutant, this one included)."""
+    from arroyo_tpu.analysis.model import explore as explore_mod
+    from arroyo_tpu.analysis.model import mutants as mutants_mod
+    from arroyo_tpu.analysis.model.extract import (
+        job_state_machine,
+        load_project,
+    )
+    from arroyo_tpu.analysis.model.spec import Model, ModelConfig
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    _m, terminals, table = job_state_machine(
+        load_project(repo, roots=("arroyo_tpu/controller",))
+    )
+    cfg = ModelConfig(workers=2, epochs=2, inflight=2, faults=1,
+                      restarts=2, reads=2,
+                      fault_kinds=("fault.kill",))
+    res = explore_mod.explore(Model(cfg, table, terminals),
+                              budget=400_000)
+    assert res.exhaustive
+    assert not res.violations, [t.violation for t in res.violations]
+    assert "serve_reads_unpublished_epoch" in mutants_mod.MUTANTS
+
+
+# -- routing exactness -------------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [2, 4, 8])
+def test_owner_subtask_matches_engine_ownership(shards):
+    """store.owner_subtask == the engine's shuffle partitioning == the
+    mesh directory's owners_for, for int / unsigned / string / composite
+    keys — the routing contract the gateway relies on."""
+    from arroyo_tpu.parallel.sharded_state import MeshSlotDirectory
+
+    msd = MeshSlotDirectory(shards)
+    int_keys = list(range(200)) + [10**12 + 7, -5]
+    for k in int_keys:
+        got = owner_subtask((canon_value(k, "i"),), ("i",), shards)
+        col = np.asarray([k], dtype=np.int64)
+        want = int(server_for_hash_array(
+            hash_arrays([hash_column(col)]), shards)[0])
+        assert got == want, (k, shards)
+        assert got == int(msd.owners_for([col], 1)[0]), (k, shards)
+    for k in ["", "a", "auction-17", "x" * 40]:
+        got = owner_subtask((canon_value(k, "s"),), ("s",), shards)
+        col = np.array([k], dtype=object)
+        want = int(server_for_hash_array(
+            hash_arrays([hash_column(col)]), shards)[0])
+        assert got == want, (k, shards)
+    # composite (int, str) keys: per-column hash + seeded combine
+    for k in [(1, "a"), (2, "bb"), (10**9, "ccc")]:
+        got = owner_subtask(
+            (canon_value(k[0], "i"), canon_value(k[1], "s")),
+            ("i", "s"), shards,
+        )
+        cols = [hash_column(np.asarray([k[0]], dtype=np.int64)),
+                hash_column(np.array([k[1]], dtype=object))]
+        want = int(server_for_hash_array(hash_arrays(cols), shards)[0])
+        assert got == want, (k, shards)
+
+
+# -- gateway cache + admission ----------------------------------------------
+
+
+def test_cache_epoch_and_incarnation_invalidation():
+    c = _Cache()
+    c.put(("j", "t", "1"), 3, 1, {"cnt": 5}, budget=1 << 20)
+    assert c.get(("j", "t", "1"), 3, 1) == {"cnt": 5}
+    # a newly published epoch silently invalidates
+    assert c.get(("j", "t", "1"), 4, 1) is None
+    c.put(("j", "t", "1"), 4, 1, {"cnt": 6}, budget=1 << 20)
+    # a reschedule (new incarnation) invalidates too
+    assert c.get(("j", "t", "1"), 4, 2) is None
+    # byte budget: inserting past it evicts LRU-first
+    small = _Cache()
+    for i in range(100):
+        small.put(("j", "t", str(i)), 1, 1, {"v": "x" * 50}, budget=2000)
+    assert small.bytes <= 2000
+    assert len(small.data) < 100
+    # job GC empties every entry of that job
+    c.drop_job("j")
+    assert not c.data and c.bytes == 0
+
+
+def test_tenant_bucket_throttles_and_noisy_penalty():
+    b = _Bucket(100.0)
+    # burst allows 2x rate up front, then sustained rate gates
+    assert b.take(150, 100.0)
+    assert not b.take(100, 100.0)
+    # noisy penalty wiring: a flagged tenant gets a squeezed rate
+    ctrl_stub = type("C", (), {"jobs": {}})()
+    from arroyo_tpu.serve.gateway import StateGateway
+
+    gw = StateGateway(ctrl_stub)
+    with update(serve={"tenant_qps": 50.0, "noisy_penalty": 0.1}):
+        assert gw._admit("quiet", 40)
+        gw.flag_noisy("hot")
+        # hot tenant's burst is 2 * 0.1 * 50 = 10 keys
+        assert not gw._admit("hot", 40)
+        assert gw._admit("hot", 5)
+    # doctor-report wiring: a noisy-neighbor verdict flags the suspect
+    # job's tenant
+    job = type("J", (), {"tenant": "hogt"})()
+    ctrl_stub.jobs["hog-job"] = job
+    gw.note_doctor_report({"verdict": {"cause": "noisy-neighbor",
+                                       "suspect": "hog-job"}})
+    assert "hogt" in gw.status()["noisy_tenants"]
+    # admission-quota wiring: a tenant at its COMPUTE slot quota gets
+    # its read rate clamped by the same penalty
+    class _Adm:
+        def tenant_at_quota(self, tenant):
+            return tenant == "satd"
+
+    ctrl_stub.admission = _Adm()
+    with update(serve={"tenant_qps": 50.0, "noisy_penalty": 0.1}):
+        assert not gw._admit("satd", 40)  # burst is 10, not 100
+        assert gw._admit("satd", 5)
+        assert gw._admit("roomy", 40)
+
+
+# -- end-to-end: embedded cluster, REST, rescale, kill -----------------------
+
+
+def _serve_sql(wd, keys=8, rate=20000, count=2_000_000):
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '{rate}',
+      message_count = '{count}', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{wd}/out.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % {keys} as k,
+             tumble(interval '100 millisecond') as w, count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+async def _wait_published(job, epoch=1, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while job.published_epoch < epoch:
+        assert time.monotonic() < deadline, (
+            f"no published epoch >= {epoch} (at {job.published_epoch})"
+        )
+        await asyncio.sleep(0.1)
+
+
+async def _wait_found(c, jid, table, key, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        out = await c.serve.read(jid, table, [key])
+        r = out.get("results", [{}])[0]
+        if r.get("found"):
+            return out
+        assert time.monotonic() < deadline, f"key {key} never served: {out}"
+        await asyncio.sleep(0.2)
+
+
+def test_e2e_point_bulk_rest_and_fencing(tmp_path):
+    """The worked-example path: run a keyed windowed aggregation, read a
+    point key and a bulk set through the REST routes at the published
+    epoch, hit the cache on the second read, fence a stale-incarnation
+    QueryState, and verify GC on stop."""
+    from aiohttp import ClientSession, web
+
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.metrics import REGISTRY
+
+    wd = str(tmp_path)
+
+    async def main():
+        with update(pipeline={"checkpointing": {
+                "interval": 0.5, "storage_url": f"{wd}/ck"}}):
+            sched = EmbeddedScheduler()
+            c = await ControllerServer(sched).start()
+            job = await c.submit_job(
+                "sv", sql=_serve_sql(wd), n_workers=2, parallelism=2,
+                storage_url=f"{wd}/ck/sv",
+            )
+            app = build_app(c, db_path=f"{wd}/api.db")
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            base = f"http://127.0.0.1:{port}/api/v1"
+            try:
+                await c.wait_for_state("sv", JobState.RUNNING, timeout=30)
+                await _wait_published(job, 1)
+                await _wait_found(c, "sv", "tumbling_window", 0)
+                async with ClientSession() as http:
+                    # table listing
+                    async with http.get(f"{base}/jobs/sv/state") as resp:
+                        assert resp.status == 200
+                        doc = await resp.json()
+                    tables = {d["table"] for d in doc["data"]}
+                    assert "tumbling_window" in tables
+                    assert doc["publishedEpoch"] >= 1
+                    # point read
+                    async with http.get(
+                        f"{base}/jobs/sv/state/tumbling_window?key=0"
+                    ) as resp:
+                        assert resp.status == 200
+                        point = await resp.json()
+                    r = point["results"][0]
+                    assert r["found"], point
+                    assert "__agg_out_1" in r["value"], r
+                    # bulk read: all keys found, each exactly once
+                    async with http.post(
+                        f"{base}/jobs/sv/state/tumbling_window",
+                        json={"keys": list(range(8))},
+                    ) as resp:
+                        assert resp.status == 200
+                        bulk = await resp.json()
+                    assert len(bulk["results"]) == 8
+                    assert all(x["found"] for x in bulk["results"]), bulk
+                    # cache: re-read the same keys at the same epoch
+                    async with http.post(
+                        f"{base}/jobs/sv/state/tumbling_window",
+                        json={"keys": list(range(8))},
+                    ) as resp:
+                        bulk2 = await resp.json()
+                    if bulk2.get("epoch") == bulk.get("epoch"):
+                        assert bulk2["cache"]["hits"] > 0, bulk2
+                    # unknown table 404s, missing key 400s
+                    async with http.get(
+                        f"{base}/jobs/sv/state/nope?key=1"
+                    ) as resp:
+                        assert resp.status == 404
+                    async with http.get(
+                        f"{base}/jobs/sv/state/tumbling_window"
+                    ) as resp:
+                        assert resp.status == 400
+                # incarnation fencing: a QueryState carrying a stale
+                # namespace answers stale_route (retriable), never data
+                w = job.workers[0]
+                resp = await w.client.call(
+                    "WorkerGrpc", "QueryState",
+                    {"job_id": "sv", "mode": "get",
+                     "table": "tumbling_window", "keys": [0],
+                     "epoch": job.published_epoch,
+                     "data_ns": "sv@999"},
+                )
+                assert "stale_route" in resp.get("error", "")
+                assert resp.get("retriable") is True
+                # GC on stop: cache + routing state expunged, serve
+                # series dropped with the job's metrics
+                assert c.serve.cache.data
+                await c.stop_job("sv", "immediate")
+                await c.wait_for_state(
+                    "sv", JobState.STOPPED, JobState.FAILED,
+                    JobState.FINISHED, timeout=30,
+                )
+                assert not any(
+                    k[0] == "sv" for k in c.serve.cache.data
+                )
+                assert "sv" not in c.serve._tables
+                REGISTRY.drop_job("sv")  # TTL path shortcut for the test
+                assert 'job="sv"' not in REGISTRY.expose()
+            finally:
+                await runner.cleanup()
+                await c.stop()
+
+    asyncio.run(main())
+
+
+def test_gateway_routing_is_engine_ownership_across_rescale(tmp_path):
+    """ISSUE 12 satellite: for every key the gateway routes to worker W
+    at subtask S, S actually owns the key (owners_for cross-check) and
+    the job's assignment table maps (node, S) -> W — held at parallelism
+    2 and re-held across a live controller-driven rescale to 4 and back
+    to 2 (fresh assignments + fresh view parallelism each time)."""
+    from arroyo_tpu.parallel.sharded_state import MeshSlotDirectory
+
+    wd = str(tmp_path)
+
+    async def assert_routing(c, sched, jid, table, keys):
+        info = (await c.serve.tables(jid))[table]
+        job = c.jobs[jid]
+        par = int(info["parallelism"])
+        kinds = tuple(info["key_kinds"])
+        node = int(info["node_id"])
+        msd = MeshSlotDirectory(par) if par >= 2 else None
+        host = {}  # task_index -> worker_id actually hosting the view
+        for w, _t in sched.pool:
+            jr = w._jobs.get(jid)
+            if jr is None:
+                continue
+            for sub in jr.program.subtasks:
+                for op in sub.runner.ops:
+                    v = getattr(op, "_serve_view", None)
+                    if v is not None and v.table == table:
+                        assert v.parallelism == par
+                        host[v.task_index] = w.worker_id
+        assert len(host) == par, (host, par)
+        for k in keys:
+            key = (canon_value(k, kinds[0]),)
+            own = owner_subtask(key, kinds, par)
+            if msd is not None:
+                col = np.asarray([key[0]], dtype=np.int64)
+                assert own == int(msd.owners_for([col], 1)[0]), (k, par)
+            # the gateway's worker choice == the ownership map's
+            assert job.assignments[(node, own)] == host[own], (k, par)
+        # and the fanned-out read actually finds every key (no
+        # mis-route ever answers not_owned)
+        out = await c.serve.read(jid, table, keys)
+        assert out["outcome"] == "ok", out
+        assert all(r["found"] for r in out["results"]), out
+
+    async def main():
+        with update(pipeline={"checkpointing": {
+                "interval": 0.4, "storage_url": f"{wd}/ck"}}):
+            sched = EmbeddedScheduler()
+            c = await ControllerServer(sched).start()
+            job = await c.submit_job(
+                "rs", sql=_serve_sql(wd, keys=16), n_workers=2,
+                parallelism=2, storage_url=f"{wd}/ck/rs",
+            )
+            try:
+                await c.wait_for_state("rs", JobState.RUNNING, timeout=30)
+                await _wait_published(job, 1)
+                await _wait_found(c, "rs", "tumbling_window", 0)
+                info = (await c.serve.tables("rs"))["tumbling_window"]
+                node = int(info["node_id"])
+                keys = list(range(16))
+                await assert_routing(c, sched, "rs", "tumbling_window",
+                                     keys)
+                for target in (4, 2):
+                    await c.rescale_job("rs", {node: target})
+                    deadline = time.monotonic() + 60
+                    while not (job.state == JobState.RUNNING
+                               and job.graph.nodes[node].parallelism
+                               == target):
+                        assert time.monotonic() < deadline, (
+                            target, job.state)
+                        await asyncio.sleep(0.2)
+                    await _wait_published(job, job.published_epoch + 1)
+                    await _wait_found(c, "rs", "tumbling_window", 0)
+                    await assert_routing(c, sched, "rs",
+                                         "tumbling_window", keys)
+            finally:
+                await c.stop_job("rs", "immediate")
+                await c.wait_for_state(
+                    "rs", JobState.STOPPED, JobState.FAILED,
+                    JobState.FINISHED, timeout=30,
+                )
+                await c.stop()
+
+    asyncio.run(main())
+
+
+def test_reads_degrade_retriable_on_worker_kill(tmp_path):
+    """Chaos shape (fast tier): SIGKILL one pool worker while reads
+    run. Every read outcome is found-at-published-epoch, not-found, or
+    a retriable error — never an exception, never a torn value (the
+    full deterministic-value variant runs in the fleet harness's
+    --serve-kill scenario). After recovery, reads serve again."""
+    wd = str(tmp_path)
+
+    async def main():
+        with update(
+            pipeline={"checkpointing": {
+                "interval": 0.5, "storage_url": f"{wd}/ck"}},
+            controller={"heartbeat_timeout": 6.0},
+        ):
+            sched = EmbeddedScheduler()
+            c = await ControllerServer(sched).start()
+            job = await c.submit_job(
+                "kl", sql=_serve_sql(wd), n_workers=2, parallelism=2,
+                storage_url=f"{wd}/ck/kl",
+            )
+            try:
+                await c.wait_for_state("kl", JobState.RUNNING, timeout=30)
+                await _wait_published(job, 1)
+                await _wait_found(c, "kl", "tumbling_window", 0)
+                live = [w for w, _t in sched.pool
+                        if not getattr(w, "_shutdown_started", False)]
+                kill_task = asyncio.ensure_future(live[0].shutdown())
+                outcomes = set()
+                deadline = time.monotonic() + 30
+                recovered_found = False
+                while time.monotonic() < deadline:
+                    out = await c.serve.read(
+                        "kl", "tumbling_window", [0, 1, 2, 3]
+                    )
+                    if out.get("error"):
+                        assert out.get("retriable"), out
+                        outcomes.add("req-error")
+                    else:
+                        for r in out["results"]:
+                            if r.get("found"):
+                                outcomes.add("found")
+                            elif r.get("error"):
+                                assert r.get("retriable", True), r
+                                outcomes.add("key-error")
+                            else:
+                                outcomes.add("miss")
+                        if (job.restarts > 0
+                                and job.state == JobState.RUNNING
+                                and all(r.get("found")
+                                        for r in out["results"])):
+                            recovered_found = True
+                            break
+                    await asyncio.sleep(0.2)
+                await kill_task
+                assert recovered_found, (
+                    f"post-recovery reads never served: {outcomes}, "
+                    f"restarts={job.restarts}, state={job.state}"
+                )
+            finally:
+                await c.stop_job("kl", "immediate")
+                await c.wait_for_state(
+                    "kl", JobState.STOPPED, JobState.FAILED,
+                    JobState.FINISHED, timeout=30,
+                )
+                await c.stop()
+
+    asyncio.run(main())
+
+
+def test_updating_aggregate_view_and_restore_seed(tmp_path):
+    """Updating aggregates serve their emitted values; a checkpoint-
+    stopped job's restart seeds the view from restored state, so reads
+    work before the first post-restore flush."""
+    wd = str(tmp_path)
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '20000',
+      message_count = '2000000', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{wd}/u.json',
+      format = 'debezium_json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT counter % 4 as k, count(*) as cnt FROM impulse GROUP BY 1;
+    """
+
+    async def main():
+        with update(pipeline={"checkpointing": {
+                "interval": 0.5, "storage_url": f"{wd}/ck"}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            job = await c.submit_job(
+                "up", sql=sql, n_workers=2, parallelism=2,
+                storage_url=f"{wd}/ck/up",
+            )
+            try:
+                await c.wait_for_state("up", JobState.RUNNING, timeout=30)
+                await _wait_published(job, 1)
+                tables = await c.serve.tables("up")
+                name = next(t for t in tables
+                            if tables[t]["kind"] == "updating")
+                vfield = tables[name]["value_fields"][0]
+                out = await _wait_found(c, "up", name, 0)
+                r = out["results"][0]
+                assert r["value"].get(vfield, 0) > 0, out
+                # checkpoint-stop, resubmit (same storage): the restored
+                # incarnation must serve the key BEFORE any new flush
+                await c.stop_job("up", "checkpoint")
+                await c.wait_for_state("up", JobState.STOPPED,
+                                       timeout=60)
+                job2 = await c.submit_job(
+                    "up2", sql=sql, n_workers=2, parallelism=2,
+                    storage_url=f"{wd}/ck/up",
+                )
+                await c.wait_for_state("up2", JobState.RUNNING,
+                                       timeout=30)
+                out2 = await _wait_found(c, "up2", name, 0, timeout=20)
+                assert vfield in out2["results"][0]["value"], out2
+            finally:
+                for jid in ("up", "up2"):
+                    if jid in c.jobs and not c.jobs[jid].state.is_terminal():
+                        await c.stop_job(jid, "immediate")
+                        await c.wait_for_state(
+                            jid, JobState.STOPPED, JobState.FAILED,
+                            JobState.FINISHED, timeout=30,
+                        )
+                await c.stop()
+
+    asyncio.run(main())

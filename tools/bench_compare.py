@@ -108,6 +108,12 @@ def classify(metric: str) -> Optional[str]:
     # classifies as lower-is-better via the *_ms_p99 suffix above.
     if metric.endswith("_overhead_pct"):
         return "lower_abs"
+    # StateServe (ISSUE 12): cache hit ratio regresses DOWNWARD; the
+    # read-path latency (serve_read_*_ms) and throughput keys
+    # (serve_lookup_eps, serve_pipeline_eps) classify via the suffix
+    # rules above
+    if metric.endswith("_hit_pct"):
+        return "higher"
     return None
 
 

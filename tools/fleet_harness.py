@@ -41,9 +41,26 @@ same SQL on a fresh single-job cluster. `--kill` additionally SIGKILLs
 one pool worker mid-churn, so the sampled jobs prove recovery-under-
 multiplexing (the fast-tier smoke test always does).
 
+StateServe read load (ISSUE 12): `--serve` switches to the queryable-
+state scenario — continuous keyed windowed-agg tenant pipelines + parked
+jobs on the shared pool, thousands of lookups/s through the REAL REST
+state routes (point GETs + bulk POSTs), measuring achieved lookups/s,
+read p50/p99, cache hit ratio, value LEGALITY (deterministic replay
+pacing makes every full window's per-key count exact) and per-key
+window-end monotonicity (a backwards window = a stale/torn read), plus
+the q5-shaped bounded pipeline's throughput solo vs under load
+(serve_pipeline_eps — the zero-impact gate key; on this 1-core host the
+solo-vs-loaded delta is bounded below by raw CPU sharing, so the GATE is
+the pinned loaded number, not the ratio). `--serve-kill` SIGKILLs a pool
+worker mid-load: reads must degrade to retriable errors — a wrong value
+or non-retriable error exits 1. serve_* keys gate against
+BENCH_BASELINE.json via tools/bench_compare.py in the nightly serve lane.
+
 Usage:
   python tools/fleet_harness.py --jobs 100 --pool 2 --sample 8 \
       [--churn 30] [--idle-seconds 10] [--kill] [--out fleet.json]
+  python tools/fleet_harness.py --serve [--serve-kill] \
+      [--serve-duration 10] [--serve-clients 6] [--out serve.json]
 """
 
 from __future__ import annotations
@@ -443,6 +460,326 @@ async def run_fleet(jobs: int = 100, pool: int = 2, sample: int = 8,
     return report
 
 
+def serve_sql(outdir: str, tenant: int, keys: int, rate: int) -> str:
+    """Continuous keyed windowed aggregation (deterministic replay
+    pacing): every FULL 100ms window holds exactly rate/10 events, so a
+    key's count is floor/ceil of rate/10/keys — any other served value
+    is WRONG (torn, stale-generation, or mis-keyed), which is what the
+    kill variant asserts never happens."""
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '{rate}',
+      message_count = '1000000000', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{outdir}/serve-t{tenant}.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % {keys} as k,
+             tumble(interval '100 millisecond') as w, count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
+                    duration: float = 10.0, clients: int = 6,
+                    bulk: int = 16, parked: int = 8, kill: bool = False,
+                    pool: int = 2, pipeline_events: int = 400_000,
+                    workdir: str | None = None) -> dict:
+    """StateServe read-load scenario (ISSUE 12): thousands of lookups/s
+    through the REAL REST state routes against a running multi-tenant
+    fleet, measuring read p50/p99, cache hit ratio, achieved lookups/s,
+    per-key value LEGALITY (full windows hold exactly rate/10 events)
+    and window-end MONOTONICITY per key (published epochs never move
+    backwards, so neither may served window results — a violation means
+    a stale-generation or torn read). A bounded windowed-agg pipeline
+    (the q5-shaped proxy) runs to completion twice — solo, then under
+    full read load — pinning the zero-impact requirement as
+    serve_pipeline_eps. `kill=True` SIGKILLs one pool worker mid-load:
+    reads must degrade to retriable errors, never wrong values."""
+    from aiohttp import ClientSession, web
+
+    from arroyo_tpu import obs
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+    from arroyo_tpu.metrics import REGISTRY
+
+    workdir = workdir or tempfile.mkdtemp(prefix="arroyo-serve-")
+    os.makedirs(workdir, exist_ok=True)
+    full = rate // 10  # events per full 100 ms window
+    legal = {full // keys, -(-full // keys)}  # floor/ceil per key
+    report: dict = {"tenants": tenants, "keys": keys, "rate": rate,
+                    "duration": duration, "clients": clients,
+                    "bulk": bulk, "kill": int(kill), "workdir": workdir}
+
+    with update(
+        pipeline={"checkpointing": {"interval": 0.5,
+                                    "storage_url": f"{workdir}/ck"}},
+        cluster={"worker_pool_size": pool, "metrics_ttl": 1.0},
+        controller={"heartbeat_timeout": 8.0},
+        worker={"task_slots": max(8, (tenants + parked + 4) * 2)},
+        obs={"latency_marker_interval": 0.0, "enabled": False},
+    ):
+        sched = EmbeddedScheduler()
+        controller = await ControllerServer(sched).start()
+        app = build_app(controller,
+                        db_path=os.path.join(workdir, "serve.db"))
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}/api/v1"
+
+        async with ClientSession() as session:
+            # -- the serving fleet: continuous tenant pipelines + parked
+            for t in range(tenants):
+                async with session.post(f"{base}/pipelines", json={
+                    "name": f"serve-{t}", "tenant": f"serve{t}",
+                    "query": serve_sql(workdir, t, keys, rate),
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+            for j in range(parked):
+                async with session.post(f"{base}/pipelines", json={
+                    "name": f"parked-{j}", "tenant": f"parked{j % 4}",
+                    "query": parked_sql(workdir, j),
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+            serve_jobs: list = []
+            deadline = time.monotonic() + 90
+            while len(serve_jobs) < tenants:
+                serve_jobs = sorted(
+                    j.job_id for j in controller.jobs.values()
+                    if j.tenant.startswith("serve")
+                    and j.state == JobState.RUNNING
+                )
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"serve fleet never came up: {len(serve_jobs)}"
+                    )
+                await asyncio.sleep(0.25)
+            # wait until every serving job lists its table and serves a key
+            tables: dict = {}
+            for jid in serve_jobs:
+                got = None
+                deadline = time.monotonic() + 60
+                while got is None:
+                    async with session.get(
+                        f"{base}/jobs/{jid}/state"
+                    ) as resp:
+                        doc = await resp.json() if resp.status == 200 else {}
+                    for d in doc.get("data", []):
+                        if d["kind"] == "window":
+                            got = d["table"]
+                    if got is None:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(f"{jid}: no serve table")
+                        await asyncio.sleep(0.25)
+                tables[jid] = got
+                deadline = time.monotonic() + 60
+                while True:
+                    async with session.get(
+                        f"{base}/jobs/{jid}/state/{got}?key=0"
+                    ) as resp:
+                        doc = await resp.json()
+                    if resp.status == 200 and doc.get("results", [{}])[0].get(
+                            "found"):
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"{jid}: key 0 never served")
+                    await asyncio.sleep(0.25)
+
+            # -- solo pipeline baseline (no read load)
+            async def run_bounded(tag: str) -> float:
+                t0 = time.monotonic()
+                async with session.post(f"{base}/pipelines", json={
+                    "name": tag, "tenant": "bench",
+                    "query": sample_sql(workdir, tag, 0, pipeline_events),
+                }) as resp:
+                    assert resp.status == 200
+                jid = None
+                while jid is None:
+                    jid = next((j.job_id for j in controller.jobs.values()
+                                if j.tenant == "bench"
+                                and not j.state.is_terminal()), None)
+                    await asyncio.sleep(0.05)
+                deadline = time.monotonic() + 300
+                while not controller.jobs[jid].state.is_terminal():
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"{tag} never finished")
+                    await asyncio.sleep(0.1)
+                dt = time.monotonic() - t0
+                return pipeline_events / dt
+
+            report["serve_pipeline_solo_eps"] = round(
+                await run_bounded("solo"), 1)
+
+            # -- the read load
+            lat_ms: list = []
+            outcomes = {"ok": 0, "miss": 0, "retriable": 0, "fatal": 0}
+            fatal_sample: list = []
+            wrong: list = []
+            high_water: dict = {}  # (jid, key) -> window end served
+            lookups = 0
+            stop_load = time.monotonic() + duration
+            rng_state = [12345]
+
+            def rng(n):
+                rng_state[0] = (rng_state[0] * 1103515245 + 12345) % (1 << 31)
+                return rng_state[0] % n
+
+            def check_value(jid, key, val):
+                nonlocal wrong
+                w = val.get("w") or {}
+                cnt = next((v for f, v in val.items()
+                            if f.startswith("__agg_out")
+                            or f == "cnt"), None)
+                end = w.get("end") if isinstance(w, dict) else None
+                if cnt is not None and cnt > max(legal):
+                    wrong.append({"job": jid, "key": key, "cnt": cnt,
+                                  "why": f"count above full window "
+                                         f"{max(legal)}"})
+                if end is not None:
+                    hw = high_water.get((jid, key))
+                    if hw is not None and end < hw:
+                        wrong.append({"job": jid, "key": key,
+                                      "end": end, "prev": hw,
+                                      "why": "window end went backwards "
+                                             "(stale read)"})
+                    else:
+                        high_water[(jid, key)] = end
+
+            async def reader(ci: int):
+                nonlocal lookups
+                while time.monotonic() < stop_load:
+                    jid = serve_jobs[rng(len(serve_jobs))]
+                    table = tables[jid]
+                    t0 = time.perf_counter()
+                    try:
+                        if ci % 3 == 0:  # point GET
+                            k = rng(keys)
+                            async with session.get(
+                                f"{base}/jobs/{jid}/state/{table}"
+                                f"?key={k}"
+                            ) as resp:
+                                doc = await resp.json()
+                                status = resp.status
+                            n = 1
+                        else:  # bulk POST
+                            ks = [rng(keys) for _ in range(bulk)]
+                            async with session.post(
+                                f"{base}/jobs/{jid}/state/{table}",
+                                json={"keys": ks},
+                            ) as resp:
+                                doc = await resp.json()
+                                status = resp.status
+                            n = len(ks)
+                    except Exception:  # noqa: BLE001 - conn reset midkill
+                        outcomes["retriable"] += 1
+                        continue
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                    lookups += n
+                    if status != 200:
+                        if doc.get("retriable"):
+                            outcomes["retriable"] += 1
+                        else:
+                            outcomes["fatal"] += 1
+                            if len(fatal_sample) < 5:
+                                fatal_sample.append(doc)
+                        continue
+                    for r in doc.get("results", []):
+                        if r.get("found"):
+                            outcomes["ok"] += 1
+                            check_value(jid, r.get("key"), r.get("value")
+                                        or {})
+                        elif r.get("error"):
+                            if r.get("retriable", True):
+                                outcomes["retriable"] += 1
+                            else:
+                                outcomes["fatal"] += 1
+                                if len(fatal_sample) < 5:
+                                    fatal_sample.append(r)
+                        else:
+                            outcomes["miss"] += 1
+
+            async def killer():
+                if not kill:
+                    return
+                await asyncio.sleep(duration / 3)
+                live = [w for w, _t in sched.pool
+                        if not getattr(w, "_shutdown_started", False)]
+                if live:
+                    report["serve_killed_worker"] = live[0].worker_id
+                    await live[0].shutdown()
+
+            load_t0 = time.monotonic()
+            bounded_task = asyncio.ensure_future(run_bounded("loaded"))
+            await asyncio.gather(killer(),
+                                 *(reader(i) for i in range(clients)))
+            load_wall = time.monotonic() - load_t0
+            try:
+                loaded_eps = await bounded_task
+            except Exception as e:  # noqa: BLE001
+                # the kill variant can take the bounded job's worker too
+                loaded_eps = 0.0 if kill else (_ for _ in ()).throw(e)
+
+            hits = sum(v for _l, v in REGISTRY.snapshot().get(
+                "arroyo_serve_cache_hits_total", []))
+            misses = sum(v for _l, v in REGISTRY.snapshot().get(
+                "arroyo_serve_cache_misses_total", []))
+            async with session.get(f"{base}/jobs/{serve_jobs[0]}/state") \
+                    as resp:
+                final_doc = await resp.json()
+            report.update({
+                "serve_lookup_eps": round(lookups / load_wall, 1),
+                "serve_read_p50_ms": round(pct(lat_ms, 0.50), 3),
+                "serve_read_p99_ms": round(pct(lat_ms, 0.99), 3),
+                "serve_reads": len(lat_ms),
+                "serve_lookups": lookups,
+                "serve_cache_hit_pct": round(
+                    100.0 * hits / max(hits + misses, 1), 2),
+                "serve_outcomes": outcomes,
+                "serve_fatal_sample": fatal_sample,
+                "serve_wrong_values": len(wrong),
+                "serve_wrong_sample": wrong[:5],
+                "serve_pipeline_eps": round(loaded_eps, 1),
+                "serve_published_epoch": final_doc.get("publishedEpoch"),
+                "serve_gateway": controller.serve.status(),
+            })
+            if report.get("serve_pipeline_solo_eps"):
+                report["serve_pipeline_impact_pct"] = round(
+                    100.0 * (1 - loaded_eps
+                             / report["serve_pipeline_solo_eps"]), 1)
+
+            # artifacts: the serve report's Perfetto trace (the serve
+            # phase ledger rides the timeline) + slowest-read pointer —
+            # the CI lane uploads both when the gate goes red
+            with open(os.path.join(workdir, "serve_trace.json"),
+                      "w") as f:
+                json.dump(obs.perfetto_trace(obs.recorder().snapshot()),
+                          f)
+            with open(os.path.join(workdir, "serve_slow_read.json"),
+                      "w") as f:
+                json.dump({"slowest_read":
+                           controller.serve.status()["slowest_read"],
+                           "p99_ms": report["serve_read_p99_ms"]}, f,
+                          indent=2)
+
+            for j in list(controller.jobs.values()):
+                if not j.state.is_terminal():
+                    await controller.stop_job(j.job_id, "immediate")
+        await runner.cleanup()
+        await controller.stop()
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jobs", type=int, default=100,
@@ -464,7 +801,59 @@ def main(argv=None) -> int:
                     help="event count of the deliberately hot hog tenant")
     ap.add_argument("--workdir")
     ap.add_argument("--out", help="write the report JSON here")
+    # StateServe read-load scenario (ISSUE 12)
+    ap.add_argument("--serve", action="store_true",
+                    help="run the queryable-state read-load scenario "
+                         "instead of the churn harness")
+    ap.add_argument("--serve-kill", action="store_true",
+                    help="serve scenario chaos variant: SIGKILL a pool "
+                         "worker mid-load (reads must degrade to "
+                         "retriable errors, never wrong values)")
+    ap.add_argument("--serve-duration", type=float, default=10.0)
+    ap.add_argument("--serve-clients", type=int, default=6)
+    ap.add_argument("--serve-tenants", type=int, default=4)
+    ap.add_argument("--serve-keys", type=int, default=64)
+    ap.add_argument("--serve-rate", type=int, default=10000)
+    ap.add_argument("--serve-bulk", type=int, default=16)
+    ap.add_argument("--serve-parked", type=int, default=8)
+    ap.add_argument("--serve-pipeline-events", type=int, default=400_000)
+    ap.add_argument("--min-lookups", type=float, default=2000.0,
+                    help="fail the (non-kill) serve scenario below this "
+                         "sustained lookups/s")
     args = ap.parse_args(argv)
+    if args.serve or args.serve_kill:
+        report = asyncio.run(run_serve(
+            tenants=args.serve_tenants, keys=args.serve_keys,
+            rate=args.serve_rate, duration=args.serve_duration,
+            clients=args.serve_clients, bulk=args.serve_bulk,
+            parked=args.serve_parked, kill=args.serve_kill,
+            pool=args.pool, pipeline_events=args.serve_pipeline_events,
+            workdir=args.workdir,
+        ))
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        rc = 0
+        if report["serve_wrong_values"]:
+            print(f"WRONG VALUES SERVED: "
+                  f"{report['serve_wrong_sample']}", file=sys.stderr)
+            rc = 1
+        if report["serve_outcomes"]["fatal"]:
+            print(f"NON-RETRIABLE READ ERRORS: "
+                  f"{report['serve_outcomes']}", file=sys.stderr)
+            rc = 1
+        if (not args.serve_kill
+                and report["serve_lookup_eps"] < args.min_lookups):
+            print(f"READ THROUGHPUT BELOW TARGET: "
+                  f"{report['serve_lookup_eps']} < {args.min_lookups} "
+                  "lookups/s", file=sys.stderr)
+            rc = 1
+        if args.serve_kill and not report["serve_outcomes"]["retriable"]:
+            print("KILL VARIANT SAW NO RETRIABLE DEGRADATION — the "
+                  "kill did not land mid-load", file=sys.stderr)
+            rc = 1
+        return rc
     report = asyncio.run(run_fleet(
         jobs=args.jobs, pool=args.pool, sample=args.sample,
         churn=args.churn, previews=args.previews,

@@ -143,6 +143,8 @@ def main(argv=None) -> int:
                     help="total fault-event budget")
     ap.add_argument("--restarts", type=int, default=None)
     ap.add_argument("--rescales", type=int, default=None)
+    ap.add_argument("--reads", type=int, default=None,
+                    help="StateServe reader-actor event budget")
     ap.add_argument("--budget", type=int, default=4_000_000,
                     help="max states; truncation fails an exhaustive run")
     ap.add_argument("--smoke", action="store_true",
@@ -329,7 +331,7 @@ def main(argv=None) -> int:
         overrides = {
             k: getattr(args, k)
             for k in ("workers", "epochs", "inflight", "faults",
-                      "restarts", "rescales")
+                      "restarts", "rescales", "reads")
             if getattr(args, k) is not None
         }
         if overrides:

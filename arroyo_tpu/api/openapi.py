@@ -82,6 +82,23 @@ ROUTES: List[Route] = [
      "Bulk multi-key lookup: keys fan out to their owning workers "
      "concurrently and merge into one epoch-consistent response",
      "state", "StateReadPost", "StateReadResult"),
+    ("get", "/jobs/{job_id}/alerts", "job_alerts",
+     "Watchtower SLO state of a job: per-rule alert states (ok / "
+     "pending / firing / clearing with hysteresis) and the job's slice "
+     "of the firing/cleared ledger with cause series attached", "jobs",
+     None, "AlertReport"),
+    ("get", "/jobs/{job_id}/metrics/history", "job_metrics_history",
+     "Retained metric history of a job: windowed samples plus derived "
+     "rate / delta / quantiles per series (?series= narrows to one "
+     "family, ?window= seconds of lookback)", "jobs", None,
+     "MetricHistory"),
+    ("get", "/jobs/{job_id}/bundles", "job_bundles",
+     "Diagnostic bundles captured for the job's SLO breaches (doctor "
+     "verdict + flight recording + Perfetto timeline + metric-history "
+     "window)", "jobs", None, "BundleCollection"),
+    ("get", "/jobs/{job_id}/bundles/{n}", "job_bundle",
+     "Download one diagnostic bundle by sequence number", "jobs", None,
+     "Bundle"),
     ("get", "/jobs/{job_id}/operator_metric_groups",
      "operator_metric_groups", "Per-operator metric groups", "jobs",
      None, "OperatorMetricGroupCollection"),
@@ -405,6 +422,55 @@ def _schemas() -> Dict[str, Any]:
              "error": {**_str(), "nullable": True}},
             ["rows", "done"],
         ),
+        # Watchtower (ISSUE 13): SLO alerts, metric history, bundles
+        "AlertEvent": _obj(
+            {"ts": {"type": "number"}, "event": _str(), "job": _str(),
+             "tenant": _str(), "rule": _str(),
+             "value": {"type": "number", "nullable": True},
+             "threshold": {"type": "number"}, "unit": _str(),
+             "cause": {"type": "array", "items": {"type": "object"}}},
+            ["ts", "event", "job", "rule"],
+        ),
+        "AlertReport": _obj(
+            {"job": _str(), "alerts": {"type": "object"},
+             "firing": {"type": "array", "items": _str()},
+             "ledger": {"type": "array", "items": _ref("AlertEvent")}},
+            ["job", "alerts", "firing", "ledger"],
+        ),
+        "MetricSeries": _obj(
+            {"name": _str(), "labels": {"type": "object"},
+             "kind": {"type": "string", "enum": ["scalar", "hist"]},
+             "samples": {"type": "array",
+                         "items": {"type": "array",
+                                   "items": {"type": "number"}}},
+             "rate": {"type": "number", "nullable": True},
+             "delta": {"type": "number", "nullable": True},
+             "max": {"type": "number", "nullable": True},
+             "quantiles": {"type": "object", "nullable": True}},
+            ["name", "labels", "kind", "samples"],
+        ),
+        "MetricHistory": _obj(
+            {"job": _str(), "window": {"type": "number"},
+             "series": {"type": "array", "items": _ref("MetricSeries")}},
+            ["job", "window", "series"],
+        ),
+        "BundleMeta": _obj(
+            {"n": _int(), "job": _str(), "tenant": _str(),
+             "rule": _str(), "captured_at": {"type": "number"},
+             "bytes": _int(), "spans": _int()},
+            ["n", "job", "rule", "captured_at"],
+        ),
+        "Bundle": _obj(
+            {"n": _int(), "job": _str(), "rule": _str(),
+             "captured_at": {"type": "number"},
+             "alert": {"type": "object"}, "doctor": {"type": "object"},
+             "flight_recorder": {"type": "array",
+                                 "items": {"type": "object"}},
+             "perfetto": {"type": "object"},
+             "history": {"type": "array", "items": _ref("MetricSeries")},
+             "ledger": {"type": "array", "items": {"type": "object"}}},
+            ["n", "job", "rule"],
+        ),
         "ErrorResp": _obj({"error": _str()}, ["error"]),
     }
     for item, name in [
@@ -419,6 +485,7 @@ def _schemas() -> Dict[str, Any]:
         ("ConnectionTable", "ConnectionTableCollection"),
         ("GlobalUdf", "GlobalUdfCollection"),
         ("StateTable", "StateTableCollection"),
+        ("BundleMeta", "BundleCollection"),
     ]:
         s[name] = _collection(item)
     return s

@@ -407,6 +407,68 @@ class ApiServer:
             self.controller.serve.note_doctor_report(rep)
         return json_response(rep)
 
+    # -- watchtower (ISSUE 13): alerts, metric history, bundles ------------
+
+    def _watchtower(self):
+        return getattr(self.controller, "watchtower", None)
+
+    async def job_alerts(self, request: web.Request):
+        """Watchtower SLO state for one job: per-rule alert states
+        (ok/pending/firing/clearing — hysteresis per obs/watchtower.py)
+        plus the job's slice of the firing/cleared ledger, each event
+        carrying the cause series' recent history."""
+        jid = request.match_info["job_id"]
+        wt = self._watchtower()
+        if wt is None:
+            return json_response({"job": jid, "alerts": {},
+                                  "firing": [], "ledger": []})
+        return json_response(wt.alerts_for(jid))
+
+    async def job_metrics_history(self, request: web.Request):
+        """Retained metric history for one job: windowed samples plus
+        derived rate/delta/quantiles per series (obs/history.py).
+        `?series=<family>` narrows to one metric family, `?window=<s>`
+        sets the lookback (default watch.window)."""
+        from ..obs.history import HISTORY
+
+        jid = request.match_info["job_id"]
+        wt = self._watchtower()
+        hist = wt.history if wt is not None else HISTORY
+        try:
+            window = float(request.query.get(
+                "window", config().watch.window))
+        except ValueError:
+            return error(400, "bad window")
+        series = request.query.get("series")
+        return json_response({
+            "job": jid,
+            "window": window,
+            "series": hist.export_job(jid, window=window, series=series),
+        })
+
+    async def job_bundles(self, request: web.Request):
+        """Diagnostic bundles captured for the job's SLO breaches:
+        the bounded-spool index (download one via .../bundles/{n})."""
+        jid = request.match_info["job_id"]
+        wt = self._watchtower()
+        metas = wt.bundles_for(jid) if wt is not None else []
+        return json_response({"data": metas})
+
+    async def job_bundle(self, request: web.Request):
+        """Download one diagnostic bundle (doctor verdict + flight
+        recording + Perfetto timeline + metric-history window around
+        the breach) by sequence number."""
+        jid = request.match_info["job_id"]
+        wt = self._watchtower()
+        try:
+            n = int(request.match_info["n"])
+        except ValueError:
+            return error(400, "bad bundle number")
+        bundle = wt.bundle(n) if wt is not None else None
+        if bundle is None or bundle.get("job") not in (None, jid):
+            return error(404, "no such bundle")
+        return json_response(bundle)
+
     # -- queryable state (StateServe, ISSUE 12) ----------------------------
 
     async def job_state_tables(self, request: web.Request):

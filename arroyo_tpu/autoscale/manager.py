@@ -191,7 +191,16 @@ class Autoscaler:
 
     async def _job_snapshot(self, job) -> Dict[str, Dict[tuple, object]]:
         """Union of the workers' registry snapshots; falls back to this
-        process's registry when no worker answers (pure-embedded runs)."""
+        process's registry when no worker answers (pure-embedded runs).
+        When the controller watchtower's scrape pump (ISSUE 13) holds a
+        fresh remote merge, reuse it instead of a second GetMetrics
+        round per control period."""
+        wt = getattr(self.controller, "watchtower", None)
+        if wt is not None:
+            snap = wt.fresh_remote_snapshot(
+                max_age=float(config().autoscale.period))
+            if snap:
+                return snap
         snaps = []
         for w in list(job.workers):
             try:

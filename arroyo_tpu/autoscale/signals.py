@@ -20,9 +20,14 @@ deltas into one `OperatorSignals` per logical node:
                            backpressure is high)
   watermark_lag            seconds the subtask watermark trails wall clock
 
-Counters restart from zero when a worker process is replaced (recovery,
-process scheduler); deltas clamp at the observed value so a restart reads
-as a small sample, not a negative rate.
+Since ISSUE 13 the sampler is backed by the retained metric-history
+tier (`obs/history.py`): each control period's merged snapshot is
+ingested into a private `MetricHistory` and every rate/delta/quantile
+is a WINDOWED query over it — counter-restart clamping (a replaced
+worker restarts counters at zero; the delta reads as the post-restart
+value, never negative) lives in `history.Series.delta`, the one
+rate-computation code path shared with the watchtower SLO engine and
+the doctor, instead of ad-hoc `prev`-dict diffing here.
 """
 
 from __future__ import annotations
@@ -30,6 +35,8 @@ from __future__ import annotations
 import dataclasses
 import time
 from typing import Dict, List, Optional, Tuple
+
+from ..obs.history import MetricHistory
 
 # metric families the sampler consumes (names, not handles: snapshots may
 # come over the wire from another process's registry)
@@ -96,83 +103,122 @@ def _task_values(merged: Dict[str, Dict[tuple, object]], metric: str,
     return out
 
 
-class SignalSampler:
-    """Stateful per-job sampler: keeps the previous period's counter sums
-    per node and turns the current snapshot into OperatorSignals."""
+# families the sampler retains in its private history instance — the
+# node-aggregated per-control-period view needs nothing else
+_SAMPLER_FAMILIES = (_RECV, _SENT, _BUSY, _BACKPRESSURE, _WM_LAG,
+                     _BATCH_HIST)
 
-    def __init__(self, job_id: str):
+
+def _node_of(series) -> Optional[int]:
+    task = series.label("task")
+    node, _, _sub = task.rpartition("-")
+    try:
+        return int(node)
+    except ValueError:
+        return None
+
+
+class SignalSampler:
+    """Stateful per-job sampler over the metric-history tier: every
+    control period's merged snapshot is ingested, and signals are
+    windowed queries over the retained series."""
+
+    def __init__(self, job_id: str,
+                 history: Optional[MetricHistory] = None):
         self.job_id = job_id
-        # node_id -> (recv_rows, sent_rows, busy_seconds)
-        self._prev: Dict[int, Tuple[float, float, float]] = {}
+        # a private, family-pinned history: the autoscaler's `now`
+        # timestamps come from its own control loop, not the pump's
+        self.history = history or MetricHistory(
+            retain=_SAMPLER_FAMILIES)
         self._prev_time: Optional[float] = None
 
     def reset(self) -> None:
         """Forget history (after a reschedule/rescale the topology and the
         worker set changed; the next sample only re-seeds the baseline)."""
-        self._prev.clear()
+        self.history.reset()
         self._prev_time = None
 
     def sample(self, merged: Dict[str, Dict[tuple, object]],
                node_parallelism: Dict[int, int],
                now: Optional[float] = None) -> Optional[Dict[int, OperatorSignals]]:
-        """Diff the merged snapshot against the previous period. Returns
-        None on the first call (baseline only — rates need two points)."""
+        """Ingest the merged snapshot and read windowed signals covering
+        the elapsed control period. Returns None on the first call
+        (baseline only — rates need two points)."""
+        now = time.monotonic() if now is None else now
+        self.history.ingest(merged, now=now)
+        prev_time, self._prev_time = self._prev_time, now
+        if prev_time is None:
+            return None
+        window = max(1e-6, now - prev_time)
+        return self.from_history(node_parallelism, window, now=now)
+
+    def from_history(self, node_parallelism: Dict[int, int],
+                     window: float,
+                     now: Optional[float] = None) -> Dict[int, OperatorSignals]:
+        """Windowed per-node signals straight from the history tier —
+        the one rate code path (`Series.delta`/`rate`/`hist_window`)
+        the watchtower and doctor also read. Callable directly against
+        a shared history instance (window = the control period)."""
         from ..metrics import hist_quantiles
 
         now = time.monotonic() if now is None else now
-        recv = _task_values(merged, _RECV, self.job_id)
-        sent = _task_values(merged, _SENT, self.job_id)
-        busy = _task_values(merged, _BUSY, self.job_id)
-        bp = _task_values(merged, _BACKPRESSURE, self.job_id)
-        lag = _task_values(merged, _WM_LAG, self.job_id)
-        hist = _task_values(merged, _BATCH_HIST, self.job_id)
 
-        sums: Dict[int, Tuple[float, float, float]] = {}
-        nodes = {n for n, _ in (*recv, *sent, *busy)} | set(node_parallelism)
-        for nid in nodes:
-            sums[nid] = (
-                sum(v for (n, _s), v in recv.items() if n == nid),
-                sum(v for (n, _s), v in sent.items() if n == nid),
-                sum(v for (n, _s), v in busy.items() if n == nid),
-            )
-        prev, prev_time = self._prev, self._prev_time
-        self._prev, self._prev_time = sums, now
-        if prev_time is None:
-            return None
-        dt = max(1e-6, now - prev_time)
+        def node_deltas(family: str) -> Dict[int, float]:
+            out: Dict[int, float] = {}
+            for s in self.history.get(family, job=self.job_id):
+                nid = _node_of(s)
+                if nid is None:
+                    continue
+                d = s.delta(window, now)
+                if d is not None:
+                    out[nid] = out.get(nid, 0.0) + d
+            return out
+
+        def node_latest_max(family: str) -> Dict[int, float]:
+            out: Dict[int, float] = {}
+            for s in self.history.get(family, job=self.job_id):
+                nid = _node_of(s)
+                v = s.latest()
+                if nid is None or v is None:
+                    continue
+                out[nid] = max(out.get(nid, 0.0), float(v))
+            return out
+
+        recv = node_deltas(_RECV)
+        sent = node_deltas(_SENT)
+        busy = node_deltas(_BUSY)
+        bp = node_latest_max(_BACKPRESSURE)
+        lag = node_latest_max(_WM_LAG)
 
         out: Dict[int, OperatorSignals] = {}
-        for nid, (r, s, b) in sums.items():
-            pr, ps, pb = prev.get(nid, (0.0, 0.0, 0.0))
-            # counter restarts (replaced worker process) read as the raw
-            # value, never a negative delta
-            dr = r - pr if r >= pr else r
-            ds = s - ps if s >= ps else s
-            db = b - pb if b >= pb else b
+        nodes = set(recv) | set(sent) | set(busy) | set(node_parallelism)
+        for nid in nodes:
+            dr = recv.get(nid, 0.0)
+            ds = sent.get(nid, 0.0)
+            db = busy.get(nid, 0.0)
             par = max(1, node_parallelism.get(nid, 1))
             sig = OperatorSignals(node_id=nid, parallelism=par)
-            sig.observed_rate = dr / dt
-            sig.output_rate = ds / dt
+            sig.observed_rate = dr / window
+            sig.output_rate = ds / window
             if db > 0:
-                sig.busy_ratio = min(1.0, db / (dt * par))
+                sig.busy_ratio = min(1.0, db / (window * par))
                 if dr > 0:
                     sig.true_rate_per_instance = dr / db
             sig.selectivity = (ds / dr) if dr > 0 else 1.0
-            sig.backpressure = max(
-                (float(v) for (n, _s), v in bp.items() if n == nid),
-                default=0.0,
-            )
-            sig.watermark_lag = max(
-                (float(v) for (n, _s), v in lag.items() if n == nid),
-                default=0.0,
-            )
-            node_hists = [v for (n, _s), v in hist.items()
-                          if n == nid and isinstance(v, dict)]
-            if node_hists:
-                p95s = [hist_quantiles(h, (0.95,)).get("p95")
-                        for h in node_hists]
-                p95s = [p for p in p95s if p is not None]
-                if p95s:
-                    sig.batch_p95 = max(p95s)
+            sig.backpressure = bp.get(nid, 0.0)
+            sig.watermark_lag = lag.get(nid, 0.0)
+            p95s = []
+            for s in self.history.get(_BATCH_HIST, job=self.job_id):
+                if _node_of(s) != nid:
+                    continue
+                # windowed tail latency: the cumulative-bucket diff over
+                # this control period, not the job's lifetime histogram
+                p95 = hist_quantiles(
+                    s.hist_window(window, now) or s.latest(), (0.95,)
+                ).get("p95")
+                if p95 is not None:
+                    p95s.append(p95)
+            if p95s:
+                sig.batch_p95 = max(p95s)
             out[nid] = sig
         return out

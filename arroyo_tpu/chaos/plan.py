@@ -87,6 +87,16 @@ FAULT_POINTS: Dict[str, str] = {
         "come back at the NEW parallelism from that checkpoint, "
         "exactly once)"
     ),
+    # operator runner (operators/runner.py)
+    "runner.stall": (
+        "operators/runner.py TaskRunner._handle_input_item — hold the "
+        "subtask's input loop params.delay seconds per fired hit (a "
+        "wedged operator / slow UDF / stuck sink dependency: the "
+        "canonical freshness-SLO failure — watermark lag grows while "
+        "the job stays RUNNING). Scope with match={'job': ...} to "
+        "stall ONE tenant on a multiplexed worker; the sleep is async, "
+        "so co-resident jobs keep flowing"
+    ),
     # checkpoint protocol (state/protocol.py)
     "protocol.fenced_zombie": (
         "state/protocol.py check_current — treat the caller's generation "

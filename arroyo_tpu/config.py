@@ -260,6 +260,92 @@ class ServeConfig:
     # folding early can serve a not-yet-published epoch in that
     # pathological case, traded for a hard memory bound)
     max_pending_epochs: int = 64
+    # /debug/serve slowest_read lookback (seconds): the slowest read is
+    # reported over this decaying window instead of high-water-mark-
+    # forever (one cold-start outlier used to pin the field for the
+    # process lifetime); ?clear=1 on /debug/serve empties it early
+    slow_read_window: float = 300.0
+
+
+@dataclasses.dataclass
+class WatchConfig:
+    """Watchtower (arroyo_tpu/obs/watchtower.py + obs/history.py): the
+    retained metric-history tier plus the per-job SLO engine. A scrape
+    pump samples the live Registry into bounded per-series ring buffers
+    (windowed rate/delta/quantile queries — the one rate-computation
+    code path the doctor and the autoscaler also read), and a
+    controller-resident evaluator runs declarative SLO rules with
+    hysteresis over that history, keeping an alert ledger and capturing
+    a diagnostic bundle (doctor verdict + flight recording + Perfetto
+    timeline + history window) on first breach."""
+
+    # master switch: off = no history is retained, no SLO rules run, the
+    # alert/bundle REST routes answer empty, and the doctor/autoscaler
+    # fall back to their non-windowed signal paths
+    enabled: bool = True
+    # seconds between registry samples into the history tier (per
+    # process; the worker accounting pump and the controller watchtower
+    # share one guarded sampler, so co-resident roles never double-pump)
+    sample_interval: float = 1.0
+    # per-series ring capacity; retention ~= samples * sample_interval
+    samples: int = 256
+    # hard cap on retained series per process (new series beyond it are
+    # counted as dropped, never grown unboundedly by a churn run)
+    max_series: int = 4096
+    # comma-separated extra metric families to retain on top of the
+    # built-in allowlist (history.DEFAULT_RETAIN)
+    retain_extra: str = ""
+    # seconds between SLO evaluations on the controller
+    eval_interval: float = 1.0
+    # default lookback window (seconds) for windowed rates/quantiles in
+    # SLO signals and the doctor's windowed busy shares
+    window: float = 30.0
+    # hysteresis: a breach must hold this many seconds before the alert
+    # fires (the ActuationGate warmup/cooldown pattern applied to SLOs)
+    sustain: float = 5.0
+    # ...and the signal must sit below the clear threshold this many
+    # seconds before a firing alert clears
+    clear_sustain: float = 10.0
+    # clear threshold = breach threshold * clear_ratio for upper-bound
+    # rules (divided for lower-bound rules) — the gap is what stops a
+    # signal wobbling on the threshold from flapping the alert
+    clear_ratio: float = 0.8
+    # built-in SLO: watermark freshness — max subtask watermark lag (s)
+    freshness_lag_s: float = 30.0
+    # built-in SLO: end-to-end latency-marker p99 (s) over `window`
+    e2e_p99_s: float = 10.0
+    # built-in SLO: processed/emitted rate ratio below this sustains a
+    # throughput breach (only judged above throughput_min_eps)
+    throughput_ratio: float = 0.5
+    # source rate floor (events/s) below which the throughput rule
+    # abstains — ratios over a trickle are noise
+    throughput_min_eps: float = 100.0
+    # built-in SLO: seconds since the job's published checkpoint epoch
+    # last advanced (durable jobs only — epoch stall / checkpoint age)
+    checkpoint_age_s: float = 60.0
+    # built-in SLO: serve-gateway read latency p99 (s) over `window`
+    serve_p99_s: float = 2.0
+    # built-in SLO: event-loop lag p99 (s) over `window` — the shared-
+    # worker contention signal
+    loop_lag_s: float = 0.25
+    # built-in SLO: sustained flight-recorder span drops per second
+    # (arroyo_trace_dropped_spans_total windowed rate)
+    trace_drop_rate: float = 1.0
+    # per-tenant / per-job rule overrides, inline JSON or a JSON file
+    # path: {"tenant:<t>"|"job:<id>": {"<rule>": {"threshold": ...,
+    # "clear": ..., "sustain": ..., "clear_sustain": ...,
+    # "disabled": true}}}
+    overrides: str = ""
+    # bounded alert ledger capacity (firing/cleared events, oldest out)
+    ledger_events: int = 1024
+    # bounded diagnostic-bundle spool: bundles kept on disk before the
+    # oldest is deleted
+    spool_bundles: int = 16
+    # spool directory; empty = a per-process directory under the system
+    # temp dir (bundles are diagnostics, not durable state)
+    spool_dir: str = ""
+    # seconds of metric history around the breach included in a bundle
+    bundle_window_s: float = 120.0
 
 
 @dataclasses.dataclass
@@ -509,8 +595,8 @@ class Config:
     """Root of the layered config tree. Sections: pipeline (batching,
     queues, checkpointing), state (incremental snapshots, off-barrier
     flushes, spill tier), serve (queryable-state serving tier),
-    autoscale (closed-loop parallelism control),
-    tls, chaos (fault injection), obs (flight recorder), tpu (device
+    autoscale (closed-loop parallelism control), watch (metric history
+    + SLO engine), tls, chaos (fault injection), obs (flight recorder), tpu (device
     kernels + mesh), controller, cluster (shared worker pool /
     multiplexing), admission (tenant quotas + fair slot scheduling),
     worker, api, admin, database, logging. `tools/lint.py
@@ -521,6 +607,7 @@ class Config:
     state: StateConfig = dataclasses.field(default_factory=StateConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     autoscale: AutoscaleConfig = dataclasses.field(default_factory=AutoscaleConfig)
+    watch: WatchConfig = dataclasses.field(default_factory=WatchConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)

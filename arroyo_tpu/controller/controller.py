@@ -289,6 +289,14 @@ class ControllerServer:
 
         self.autoscaler = Autoscaler(self)
         self.autoscaler.maybe_start()
+        # watchtower (ISSUE 13): the retained-history scrape pump + the
+        # per-job SLO engine with its alert ledger and diagnostic-bundle
+        # spool (watch.enabled gates the loop; the object always exists
+        # so REST/debug surfaces can report status)
+        from ..obs.watchtower import Watchtower
+
+        self.watchtower = Watchtower(self)
+        self.watchtower.maybe_start()
         from ..utils.admin import serve_admin
 
         self._admin, self.admin_port = await serve_admin(
@@ -302,6 +310,7 @@ class ControllerServer:
             extra_routes={
                 "/debug/autoscale": self._debug_autoscale,
                 "/debug/serve": self._debug_serve,
+                "/debug/watch": self._debug_watch,
             },
         )
         logger.info("controller up at %s", self.addr)
@@ -309,11 +318,16 @@ class ControllerServer:
 
     async def _debug_serve(self, request):
         """Admin surface: serve-gateway status (cache occupancy, tenant
-        quotas + noisy flags, slowest read); `?job=<id>` adds the job's
-        table registry + published epoch."""
+        quotas + noisy flags, slowest read over the decaying
+        serve.slow_read_window); `?job=<id>` adds the job's table
+        registry + published epoch, `?clear=1` empties the slow-read
+        window after reporting it."""
         from aiohttp import web
 
         doc = self.serve.status()
+        if request.query.get("clear"):
+            self.serve.clear_slow()
+            doc["slow_read_cleared"] = True
         jid = request.query.get("job")
         if jid and jid in self.jobs:
             job = self.jobs[jid]
@@ -337,7 +351,21 @@ class ControllerServer:
             dumps=lambda d: json.dumps(d, default=str),
         )
 
+    async def _debug_watch(self, request):
+        """Admin surface: watchtower status — history-tier stats, the
+        resolved rule table, non-ok alert states, the recent ledger and
+        the bundle index. `?job=<id>` narrows alerts/ledger/bundles to
+        one job."""
+        from aiohttp import web
+
+        return web.json_response(
+            self.watchtower.status(request.query.get("job")),
+            dumps=lambda d: json.dumps(d, default=str),
+        )
+
     async def stop(self):
+        if getattr(self, "watchtower", None) is not None:
+            await self.watchtower.stop()
         if getattr(self, "autoscaler", None) is not None:
             await self.autoscaler.stop()
         for t in self._job_tasks.values():
@@ -649,6 +677,12 @@ class ControllerServer:
             # jobs; the job-labeled arroyo_serve_* series ride the
             # drop_job below)
             self.serve.expunge_job(job.job_id)
+            # watchtower GC: a released job's alert state machines go
+            # with it (ledger events and captured bundles stay — they
+            # are diagnostics of the past, bounded by their own caps);
+            # its retained history series ride obs.expunge_job below
+            if getattr(self, "watchtower", None) is not None:
+                self.watchtower.expunge_job(job.job_id)
             from ..metrics import REGISTRY
 
             # cardinality GC: a churned fleet must not grow /metrics

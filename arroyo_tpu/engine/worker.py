@@ -139,7 +139,12 @@ class WorkerServer:
         obs.set_role(f"worker-{self.worker_id}")
         # fleet observatory: the accounting pump rolls per-job attributed
         # cost into the arroyo_job_attributed_* families and samples
-        # event-loop lag (refcounted — embedded workers share one loop)
+        # event-loop lag (refcounted — embedded workers share one loop).
+        # The watchtower's PER-WORKER scrape rides the same cadence: each
+        # pump interval offers this process's registry to the retained
+        # metric-history tier (obs/history.py), so a worker's windowed
+        # rates are inspectable locally via /debug/history even when the
+        # controller runs in another process.
         obs.attribution.ensure_pump()
         self._pump_held = True
         self.rpc.add_service(

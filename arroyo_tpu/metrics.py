@@ -488,6 +488,25 @@ SERVE_CACHE_MISSES = REGISTRY.counter(
     "arroyo_serve_cache_misses_total",
     "reads that fanned out to a worker (cold key, epoch-invalidated "
     "entry, or cache disabled)")
+# Watchtower (ISSUE 13): retained history + per-job SLO engine. The
+# alert counter is job-labeled (drop_job GCs it); published-epoch is the
+# gauge the checkpoint-age SLO watches for stalls; the trace-drop
+# counter makes flight-recorder ring overflow visible without catching
+# /debug/trace at the right moment.
+TRACE_DROPPED_SPANS = REGISTRY.counter(
+    "arroyo_trace_dropped_spans_total",
+    "flight-recorder spans dropped because the per-process ring buffer "
+    "(obs.trace_buffer_spans) was full — sustained drops mean the "
+    "recording of the next incident is incomplete; the watchtower's "
+    "trace_drops rule alerts on the windowed drop rate")
+JOB_PUBLISHED_EPOCH = REGISTRY.gauge(
+    "arroyo_job_published_epoch",
+    "the job's last PUBLISHED checkpoint epoch (set by the controller "
+    "watchtower each sample) — the checkpoint-age SLO fires when this "
+    "stops advancing on a durable job")
+WATCH_ALERTS = REGISTRY.counter(
+    "arroyo_watch_alerts_total",
+    "watchtower alert transitions per (job, rule, event=firing|cleared)")
 LOOP_LAG_SECONDS = REGISTRY.histogram(
     "arroyo_worker_loop_lag_seconds",
     "event-loop scheduling lag sampled by the accounting pump (sleep-"

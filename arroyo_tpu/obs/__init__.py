@@ -94,6 +94,7 @@ def reset(capacity: Optional[int] = None) -> TraceRecorder:
     global _RECORDER
     timeline.clear()
     attribution.ACCOUNTING.reset()
+    history.HISTORY.reset()
     if capacity is None:
         _RECORDER = None
         return recorder()
@@ -112,6 +113,7 @@ def expunge_job(job_id: str) -> None:
         _RECORDER.expunge_job(job_id)
     timeline.expunge_job(job_id)
     attribution.ACCOUNTING.drop_job(job_id)
+    history.HISTORY.drop_job(job_id)
 
 
 def span(name: str, *, trace: Optional[str] = None,
@@ -213,6 +215,11 @@ def latency_report(job_id: Optional[str] = None) -> dict:
         "device": device.summary(),
     }
 
+
+# watchtower (ISSUE 13): the retained metric-history tier — imported
+# first: attribution's pump samples it, the doctor reads windowed
+# rates from it
+from . import history  # noqa: E402 - public surface
 
 # fleet observatory (ISSUE 11): per-job attribution, the batch-phase
 # timeline ledger, and the bottleneck doctor — imported before device

@@ -300,11 +300,18 @@ _PUMP_REFS = 0
 
 
 async def _pump_loop():
-    """Flush cadence + event-loop lag sampler. One pump per process even
-    when several embedded WorkerServers share the loop (refcounted): a
-    second sampler would double-count lag observations."""
+    """Flush cadence + event-loop lag sampler + history scrape. One pump
+    per process even when several embedded WorkerServers share the loop
+    (refcounted): a second sampler would double-count lag observations.
+
+    The watchtower's per-worker scrape rides this cadence machinery
+    (ISSUE 13): each interval the pump offers the live registry to the
+    process's metric-history tier; `MetricHistory.sample_registry`'s own
+    `watch.sample_interval` guard turns the offer into the configured
+    sampling rate (and dedupes against a co-resident controller
+    watchtower pumping the same history)."""
     from ..config import config
-    from . import timeline
+    from . import history, timeline
 
     while True:
         cfg = config().obs
@@ -312,20 +319,31 @@ async def _pump_loop():
                                    cfg.attribution_flush_interval or 0.5))
         t0 = time.monotonic()
         await asyncio.sleep(interval)
-        lag = max(0.0, time.monotonic() - t0 - interval)
-        if cfg.loop_lag_interval:
-            ACCOUNTING.note_lag(lag)
-            if lag > 0.001:
-                # visible stalls land in the timeline ledger so Perfetto
-                # dumps and the offline doctor see loop pressure
-                timeline.note("loop.lag", lag, job="")
-        ACCOUNTING.flush()
+        if enabled():
+            lag = max(0.0, time.monotonic() - t0 - interval)
+            if cfg.loop_lag_interval:
+                ACCOUNTING.note_lag(lag)
+                if lag > 0.001:
+                    # visible stalls land in the timeline ledger so
+                    # Perfetto dumps and the offline doctor see loop
+                    # pressure
+                    timeline.note("loop.lag", lag, job="")
+            ACCOUNTING.flush()
+        history.HISTORY.sample_registry()
+
+
+def _history_enabled() -> bool:
+    from ..config import config
+
+    return bool(config().watch.enabled)
 
 
 def ensure_pump() -> None:
-    """Start (or ref) the process's accounting pump on the running loop."""
+    """Start (or ref) the process's accounting pump on the running loop.
+    Runs when attribution OR the watchtower history tier wants the
+    cadence (each part gates itself per iteration)."""
     global _PUMP_TASK, _PUMP_REFS
-    if not enabled():
+    if not (enabled() or _history_enabled()):
         return
     _PUMP_REFS += 1
     if _PUMP_TASK is None or _PUMP_TASK.done():

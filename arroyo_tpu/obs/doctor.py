@@ -43,9 +43,71 @@ NEIGHBOR_SHARE = 0.5
 DISPATCH_FLOOR_MS = 1.5
 
 
+def _windowed_overlay(sig: dict, job_id: str, ops: Dict[str, dict]) -> None:
+    """Re-point the busy/neighbor signals at the retained metric
+    history (ISSUE 13): cumulative attributed totals describe a job's
+    LIFETIME average, but the doctor is asked about NOW — overlay
+    windowed deltas (`watch.window` lookback, the same
+    `history.Series.delta` rate path the SLO engine and autoscaler
+    read) wherever the history has coverage, keeping the cumulative
+    values as the no-history fallback."""
+    from ..config import config
+    from .history import HISTORY
+
+    win = float(config().watch.window)
+    busy_series = HISTORY.get("arroyo_job_attributed_busy_seconds")
+    deltas: Dict[str, float] = {}
+    covered = 0.0
+    for s in busy_series:
+        d = s.delta(win)
+        if d is None:
+            continue
+        pts = s.window(win)
+        covered = max(covered, pts[-1][0] - pts[0][0])
+        job = s.label("job")
+        deltas[job] = deltas.get(job, 0.0) + d
+    if not deltas or covered <= 0:
+        return
+    sig["windowed"] = True
+    sig["window_s"] = round(min(win, covered), 3)
+    busy_s = deltas.get(job_id, 0.0)
+    sig["busy_s"] = round(busy_s, 4)
+    sig["busy_ratio"] = round(
+        min(1.0, busy_s / sig["window_s"]), 4
+    ) if sig["window_s"] > 0 else 0.0
+    neighbors = [
+        {"job": j, "busy_s": round(d, 4)}
+        for j, d in deltas.items() if j not in (job_id, "") and d > 0
+    ]
+    neighbors.sort(key=lambda n: -n["busy_s"])
+    others = sum(n["busy_s"] for n in neighbors)
+    sig["neighbors"] = neighbors[:8]
+    sig["neighbor_top_share"] = round(
+        neighbors[0]["busy_s"] / (busy_s + others), 4
+    ) if neighbors and (busy_s + others) > 0 else 0.0
+    dev = 0.0
+    for s in HISTORY.get("arroyo_job_attributed_device_seconds",
+                         job=job_id):
+        d = s.delta(win)
+        if d is not None:
+            dev += d
+    if dev:
+        sig["device_s"] = round(dev, 4)
+    # per-task busy: windowed where the series has coverage
+    for s in HISTORY.get("arroyo_worker_busy_seconds", job=job_id):
+        d = s.delta(win)
+        task = s.label("task")
+        if d is not None and task in ops:
+            ops[task]["busy_s"] = round(d, 4)
+    sig["operators"] = sorted(ops.values(),
+                              key=lambda o: -o.get("busy_s", 0.0))
+
+
 def collect(job_id: str, registry=None) -> dict:
     """Gather one job's doctor signals from this process's registry,
-    the attribution accounting, and the timeline ledger."""
+    the attribution accounting, the timeline ledger — and, where the
+    watchtower history tier has coverage, WINDOWED rates instead of
+    lifetime cumulatives (see _windowed_overlay)."""
     from ..metrics import REGISTRY, hist_quantiles
     from . import attribution, timeline
 
@@ -103,7 +165,7 @@ def collect(job_id: str, registry=None) -> dict:
         p: t["total_s"]
         for p, t in timeline.phase_totals(job_id).items()
     }
-    return {
+    sig = {
         "job": job_id,
         "window_s": round(window, 3),
         "busy_s": round(busy_s, 4),
@@ -130,6 +192,8 @@ def collect(job_id: str, registry=None) -> dict:
         ) if neighbors and (busy_s + others) > 0 else 0.0,
         "attribution_coverage": summary.get("coverage", 1.0),
     }
+    _windowed_overlay(sig, job_id, ops)
+    return sig
 
 
 def diagnose(sig: dict) -> dict:

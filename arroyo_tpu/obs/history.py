@@ -1,0 +1,456 @@
+"""Retained metric history (ISSUE 13): the windowed time-series tier.
+
+Every observability surface before this PR was an instantaneous
+snapshot: the doctor diagnosed from the current scrape, the autoscaler
+re-derived rates from ad-hoc counter diffs, and a 3am incident in a
+100-job fleet left nothing to look back on. Following Monarch's
+in-memory windowed store close to the workload (Adams et al., VLDB'20),
+this module keeps a bounded per-series ring of (t, value) samples
+scraped from the live `Registry` and answers the windowed queries
+everything else derives from:
+
+  delta(window)      counter increase over the window, RESET-AWARE: a
+                     replaced worker's restart reads as the post-restart
+                     value, never a negative delta (the clamping that
+                     used to live ad hoc in autoscale/signals.py —
+                     this is now the ONE rate-computation code path);
+  rate(window)       delta / window;
+  window_max/latest  gauge views;
+  hist_window        windowed histogram: the cumulative-bucket DIFF of
+                     the snapshots spanning the window, fed to
+                     `metrics.hist_quantiles` for windowed p50/p95/p99
+                     (a lifetime-cumulative histogram can never show
+                     "p99 over the last minute");
+  last_change_age    seconds since a value last moved (epoch stall).
+
+Series are keyed (family, sorted label items); families are bounded by
+an allowlist (`DEFAULT_RETAIN` + `watch.retain_extra`) and a hard
+`watch.max_series` cap, and job-labeled series GC through `drop_job`
+beside `Registry.drop_job` on the expunge path. One process-wide
+`HISTORY` instance is pumped by the worker accounting pump and the
+controller watchtower (a min-interval guard dedupes co-resident
+pumps); the autoscaler's `SignalSampler` owns a private instance fed
+from merged GetMetrics snapshots, so both read rates from this code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+# families the history tier retains by default: what the SLO engine,
+# the doctor and the autoscaler actually read. Everything else is
+# scraped and dropped — retention is RAM, and a churn fleet mints
+# thousands of series.
+DEFAULT_RETAIN = (
+    "arroyo_worker_messages_recv",
+    "arroyo_worker_messages_sent",
+    "arroyo_worker_busy_seconds",
+    "arroyo_worker_backpressure",
+    "arroyo_worker_queue_size",
+    "arroyo_worker_watermark_lag_seconds",
+    "arroyo_worker_batch_processing_seconds",
+    "arroyo_worker_e2e_latency_seconds",
+    "arroyo_worker_loop_lag_seconds",
+    "arroyo_serve_request_seconds",
+    "arroyo_job_attributed_busy_seconds",
+    "arroyo_job_attributed_device_seconds",
+    "arroyo_checkpoint_phase_seconds",
+    "arroyo_trace_dropped_spans_total",
+    "arroyo_job_published_epoch",
+)
+
+
+def _is_hist(value) -> bool:
+    return isinstance(value, dict) and "buckets" in value
+
+
+class Series:
+    """One metric labelset's bounded sample ring."""
+
+    __slots__ = ("name", "labels", "kind", "samples")
+
+    def __init__(self, name: str, labels: LabelSet, kind: str,
+                 capacity: int):
+        self.name = name
+        self.labels = labels
+        self.kind = kind  # "scalar" | "hist"
+        self.samples: deque = deque(maxlen=max(2, int(capacity)))
+
+    def add(self, t: float, value) -> None:
+        self.samples.append((t, value))
+
+    def label(self, key: str) -> str:
+        for k, v in self.labels:
+            if k == key:
+                return v
+        return ""
+
+    # -- queries (samples are (t, value), oldest first) ----------------------
+
+    def latest(self):
+        return self.samples[-1][1] if self.samples else None
+
+    def latest_time(self) -> Optional[float]:
+        return self.samples[-1][0] if self.samples else None
+
+    def window(self, window: float, now: Optional[float] = None,
+               include_base: bool = True) -> list:
+        """Samples covering [now - window, now]: every in-window sample
+        plus (for counters — include_base) the last sample at-or-before
+        the window start, the delta base without which the first
+        in-window increment is invisible. Gauge views (window_max) drop
+        the base: a stale pre-window value is not part of the window."""
+        now = time.monotonic() if now is None else now
+        cutoff = now - window
+        out: list = []
+        base = None
+        for t, v in self.samples:
+            if t > now:
+                break
+            if t <= cutoff:
+                base = (t, v)
+            else:
+                out.append((t, v))
+        if base is not None and include_base:
+            out.insert(0, base)
+        return out
+
+    def delta(self, window: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Counter increase over the window, reset-aware: consecutive
+        samples that go DOWN read as a restart and contribute the
+        post-restart value (Prometheus increase() semantics). None with
+        fewer than two covering samples — "no judgement", distinct
+        from a measured zero."""
+        pts = self.window(window, now)
+        if len(pts) < 2:
+            return None
+        total = 0.0
+        prev = float(pts[0][1])
+        for _t, v in pts[1:]:
+            v = float(v)
+            total += (v - prev) if v >= prev else v
+            prev = v
+        return total
+
+    def rate(self, window: float,
+             now: Optional[float] = None) -> Optional[float]:
+        d = self.delta(window, now)
+        if d is None:
+            return None
+        return d / window if window > 0 else 0.0
+
+    def window_max(self, window: float,
+                   now: Optional[float] = None) -> Optional[float]:
+        pts = self.window(window, now, include_base=False)
+        if not pts:
+            return None
+        return max(float(v) for _t, v in pts)
+
+    def last_change_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the value last changed; if it never changed in
+        the retained window, seconds since the oldest retained sample (a
+        floor on the true age — retention bounds what we can know)."""
+        if not self.samples:
+            return None
+        now = time.monotonic() if now is None else now
+        pts = list(self.samples)
+        last = pts[-1][1]
+        # the change happened at the first sample that HOLDS the current
+        # value, i.e. the sample after the last differing one
+        changed_at = pts[0][0]
+        for i in range(len(pts) - 1, 0, -1):
+            if pts[i - 1][1] != last:
+                changed_at = pts[i][0]
+                break
+        return max(0.0, now - changed_at)
+
+    def hist_window(self, window: float,
+                    now: Optional[float] = None) -> Optional[dict]:
+        """Windowed histogram: accumulate the cumulative-bucket diffs of
+        consecutive snapshots in the window (reset pairs contribute the
+        post-restart snapshot whole). Returns the same {"sum", "count",
+        "buckets": {le: cumulative}} shape `metrics.hist_quantiles`
+        consumes, or None without two covering snapshots."""
+        pts = self.window(window, now)
+        pts = [(t, v) for t, v in pts if _is_hist(v)]
+        if len(pts) < 2:
+            return None
+        buckets: Dict[str, float] = {}
+        total_sum = 0.0
+        total_count = 0
+        prev = pts[0][1]
+        for _t, cur in pts[1:]:
+            if cur.get("count", 0) >= prev.get("count", 0):
+                d_count = cur.get("count", 0) - prev.get("count", 0)
+                d_sum = cur.get("sum", 0.0) - prev.get("sum", 0.0)
+                les = set(cur.get("buckets", {})) | set(
+                    prev.get("buckets", {}))
+                for le in les:
+                    buckets[le] = buckets.get(le, 0) + max(
+                        0,
+                        cur.get("buckets", {}).get(le, 0)
+                        - prev.get("buckets", {}).get(le, 0),
+                    )
+            else:  # counter restart: the new snapshot IS the increment
+                d_count = cur.get("count", 0)
+                d_sum = cur.get("sum", 0.0)
+                for le, c in cur.get("buckets", {}).items():
+                    buckets[le] = buckets.get(le, 0) + c
+            total_sum += d_sum
+            total_count += d_count
+            prev = cur
+        return {"sum": total_sum, "count": total_count, "buckets": buckets}
+
+    def quantiles(self, window: float, now: Optional[float] = None,
+                  qs: Tuple[float, ...] = (0.5, 0.95, 0.99)) -> dict:
+        from ..metrics import hist_quantiles
+
+        return hist_quantiles(self.hist_window(window, now), qs)
+
+    def export(self, window: Optional[float] = None,
+               now: Optional[float] = None) -> dict:
+        """Structured view for REST / bundles: raw samples (histograms
+        reduced to counts) plus derived windowed stats."""
+        now = time.monotonic() if now is None else now
+        pts = (self.window(window, now) if window is not None
+               else list(self.samples))
+        # wall-clock conversion for humans reading bundles offline
+        off = time.time() - time.monotonic()
+        out = {
+            "name": self.name,
+            "labels": dict(self.labels),
+            "kind": self.kind,
+            "samples": [
+                [round(t + off, 3),
+                 (v.get("count", 0) if _is_hist(v) else v)]
+                for t, v in pts
+            ],
+        }
+        if window is not None:
+            if self.kind == "hist":
+                q = self.quantiles(window, now)
+                if q:
+                    out["quantiles"] = {k: round(v, 6)
+                                        for k, v in q.items()}
+                h = self.hist_window(window, now)
+                out["count_delta"] = h["count"] if h else 0
+            else:
+                d = self.delta(window, now)
+                if d is not None:
+                    out["delta"] = round(d, 6)
+                    out["rate"] = round(d / window, 6) if window else 0.0
+                m = self.window_max(window, now)
+                if m is not None:
+                    out["max"] = round(m, 6)
+                out["latest"] = self.latest()
+        return out
+
+
+class MetricHistory:
+    """Bounded multi-series history with a registry scrape front end.
+
+    `retain=None` reads the allowlist from config (`DEFAULT_RETAIN` +
+    `watch.retain_extra`) at each ingest; an explicit tuple pins it
+    (the autoscaler's private sampler instance does this)."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 retain: Optional[Iterable[str]] = None,
+                 max_series: Optional[int] = None):
+        self._series: Dict[Tuple[str, LabelSet], Series] = {}
+        # (family, job-label-or-"") -> [Series]: the SLO engine asks for
+        # one job's series of one family ~14x per job per tick — a flat
+        # scan over every retained series would be quadratic in fleet
+        # size right inside the idle-CPU-per-job budget
+        self._index: Dict[Tuple[str, str], List[Series]] = {}
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._retain = tuple(retain) if retain is not None else None
+        self._max_series = max_series
+        self.dropped_series = 0
+        self._last_sample = 0.0
+
+    # -- config-derived knobs ------------------------------------------------
+
+    def _cfg(self):
+        from ..config import config
+
+        return config().watch
+
+    def retained(self) -> frozenset:
+        if self._retain is not None:
+            return frozenset(self._retain)
+        cfg = self._cfg()
+        extra = tuple(
+            s.strip() for s in str(cfg.retain_extra or "").split(",")
+            if s.strip()
+        )
+        return frozenset(DEFAULT_RETAIN + extra)
+
+    def capacity(self) -> int:
+        return int(self._capacity or self._cfg().samples)
+
+    def series_cap(self) -> int:
+        return int(self._max_series or self._cfg().max_series)
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(self, snapshot: dict, now: Optional[float] = None) -> int:
+        """Append one scrape's samples. Accepts both snapshot shapes in
+        the codebase: `Registry.snapshot()`'s {name: [(labels, value)]}
+        and `merge_snapshots()`'s {name: {label_tuple: value}}. Returns
+        the number of samples appended."""
+        now = time.monotonic() if now is None else now
+        fams = self.retained()
+        cap = self.capacity()
+        series_cap = self.series_cap()
+        appended = 0
+        with self._lock:
+            for name, entries in (snapshot or {}).items():
+                if name not in fams:
+                    continue
+                items = (entries.items() if isinstance(entries, dict)
+                         else entries)
+                for labels, value in items:
+                    key_labels: LabelSet = (
+                        tuple(sorted(dict(labels).items()))
+                        if not isinstance(labels, tuple) else labels
+                    )
+                    key = (name, key_labels)
+                    s = self._series.get(key)
+                    if s is None:
+                        if len(self._series) >= series_cap:
+                            self.dropped_series += 1
+                            continue
+                        s = self._series[key] = Series(
+                            name, key_labels,
+                            "hist" if _is_hist(value) else "scalar", cap,
+                        )
+                        self._index.setdefault(
+                            (name, s.label("job")), []).append(s)
+                    s.add(now, value)
+                    appended += 1
+            self._last_sample = now
+        return appended
+
+    def sample_registry(self, registry=None,
+                        now: Optional[float] = None) -> int:
+        """Scrape the live registry into the history — the pump entry
+        point. Guarded by `watch.sample_interval`: co-resident pumps
+        (embedded worker accounting pump + controller watchtower share
+        one process) never double-sample. Returns samples appended (0
+        when guarded off or watch disabled)."""
+        cfg = self._cfg()
+        if not cfg.enabled:
+            return 0
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if now - self._last_sample < 0.9 * float(cfg.sample_interval):
+                return 0
+        if registry is None:
+            from ..metrics import REGISTRY as registry  # noqa: N813
+        return self.ingest(registry.snapshot(), now=now)
+
+    # -- queries -------------------------------------------------------------
+
+    def get(self, name: str, **labels) -> List[Series]:
+        """Series of one family whose labels contain all of `labels`.
+        A `job=` filter hits the (family, job) index directly."""
+        with self._lock:
+            if "job" in labels:
+                candidates = list(self._index.get(
+                    (name, labels["job"]), ()))
+            else:
+                candidates = [
+                    s for (n, j), lst in self._index.items()
+                    if n == name for s in lst
+                ]
+        rest = [(k, v) for k, v in labels.items() if k != "job"]
+        if not rest:
+            return candidates
+        out = []
+        for s in candidates:
+            d = dict(s.labels)
+            if all(d.get(k) == v for k, v in rest):
+                out.append(s)
+        return out
+
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted({n for n, _ in self._series})
+
+    def job_series(self, job_id: str) -> List[Series]:
+        with self._lock:
+            return [s for (_n, ls), s in self._series.items()
+                    if ("job", job_id) in ls]
+
+    def export_job(self, job_id: str, window: float,
+                   now: Optional[float] = None,
+                   series: Optional[str] = None) -> List[dict]:
+        """The REST/bundle payload: every retained series of one job
+        (plus the process-wide unlabeled families the job's SLOs read —
+        loop lag, trace drops), windowed."""
+        now = time.monotonic() if now is None else now
+        out = []
+        with self._lock:
+            entries = list(self._series.items())
+        for (name, ls), s in entries:
+            d = dict(ls)
+            owner = d.get("job")
+            if owner is not None and owner != job_id:
+                continue
+            if owner is None and name not in (
+                "arroyo_worker_loop_lag_seconds",
+                "arroyo_trace_dropped_spans_total",
+            ):
+                continue
+            if series is not None and name != series:
+                continue
+            out.append(s.export(window=window, now=now))
+        out.sort(key=lambda e: (e["name"], sorted(e["labels"].items())))
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def drop_job(self, job_id: str) -> int:
+        """Cardinality GC beside Registry.drop_job: a torn-down job's
+        retained series must not outlive its metric series."""
+        match = ("job", job_id)
+        with self._lock:
+            stale = [k for k in self._series if match in k[1]]
+            for k in stale:
+                del self._series[k]
+            for ikey in [i for i in self._index if i[1] == job_id]:
+                del self._index[ikey]
+            return len(stale)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._index.clear()
+            self.dropped_series = 0
+            self._last_sample = 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            n_samples = sum(len(s.samples) for s in self._series.values())
+            last = self._last_sample
+            return {
+                "series": len(self._series),
+                "samples": n_samples,
+                "dropped_series": self.dropped_series,
+                "capacity": self.capacity(),
+                "last_sample_age_s": round(
+                    max(0.0, time.monotonic() - last), 3
+                ) if last else None,
+            }
+
+
+# the process-wide history tier: pumped by the worker accounting pump
+# and the controller watchtower, read by the doctor and /debug surfaces
+HISTORY = MetricHistory()

@@ -178,8 +178,18 @@ class TraceRecorder:
         with self._lock:
             if len(self.spans) == self.capacity:
                 self.dropped += 1
+                dropped = True
+            else:
+                dropped = False
             span_dict.setdefault("role", self.role)
             self.spans.append(span_dict)
+        if dropped:
+            # exported as a real counter so sustained overflow is
+            # alertable (watchtower trace_drops rule) instead of only
+            # visible to someone reading /debug/trace at the right moment
+            from ..metrics import TRACE_DROPPED_SPANS
+
+            TRACE_DROPPED_SPANS.labels().inc()
 
     def snapshot(self, trace_prefix: Optional[str] = None,
                  trace_id: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional
 
 import pyarrow as pa
 
-from .. import obs
+from .. import chaos, obs
 from ..analysis.model.effects import protocol_effect
 from ..config import config
 from ..metrics import (
@@ -444,6 +444,14 @@ class SubtaskRunner:
 
     async def _handle_input_item(self, i: int, item) -> bool:
         """Process one message from input i. Returns whether to re-arm."""
+        spec = chaos.fire("runner.stall", job=self.task_info.job_id,
+                          task=self.task_info.task_id)
+        if spec is not None:
+            # a wedged operator: the input loop holds (async — only THIS
+            # subtask stalls; co-resident tenants keep their turns on the
+            # shared loop) while upstream queues back up and the
+            # watermark falls behind — the freshness-SLO drill's seam
+            await asyncio.sleep(float(spec.param("delay", 0.5)))
         iq = self.inputs[i]
         if isinstance(item, SignalMessage):
             if item.kind == SignalKind.WATERMARK:

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from ..config import config
@@ -129,7 +129,13 @@ class StateGateway:
         self._noisy: Dict[str, float] = {}
         # (job_id, schedules) -> {table: describe dict}
         self._tables: Dict[str, Tuple[int, Dict[str, dict]]] = {}
-        self._slow: Optional[dict] = None  # slowest read seen (debug)
+        # slow-read candidates over a decaying window (ISSUE 13): the
+        # old single high-water-mark pinned one cold-start outlier into
+        # /debug/serve forever. Bounded ring of per-second maxima
+        # (monotonic second, entry) — the window's true slowest read
+        # survives until it AGES OUT, at 1 s boundary resolution, and a
+        # read flood cannot evict it early.
+        self._slow: deque = deque(maxlen=512)
 
     # -- noisy-neighbor wiring (PR 11 doctor verdict) ------------------------
 
@@ -230,11 +236,41 @@ class StateGateway:
             job=job_id, tenant=out.pop("_tenant", ""),
             outcome=out.get("outcome", "error"),
         ).inc()
-        if self._slow is None or dt * 1e3 > self._slow["ms"]:
-            self._slow = {"ms": round(dt * 1e3, 3), "job": job_id,
-                          "table": table, "keys": len(keys),
-                          "outcome": out.get("outcome")}
+        self._note_slow(dt, job_id, table, len(keys),
+                        out.get("outcome"))
         return out
+
+    def _note_slow(self, dt: float, job_id: str, table: str,
+                   n_keys: int, outcome) -> None:
+        """Fold the read into its second's maximum (exact timestamps;
+        second-granular dedupe keeps a read flood from evicting the
+        window's true maximum out of the bounded ring)."""
+        now = time.monotonic()
+        ms = round(dt * 1e3, 3)
+        entry = {"ms": ms, "job": job_id, "table": table,
+                 "keys": n_keys, "outcome": outcome}
+        if self._slow and int(self._slow[-1][0]) == int(now):
+            if ms > self._slow[-1][1]["ms"]:
+                self._slow[-1] = (now, entry)
+        else:
+            self._slow.append((now, entry))
+
+    def slowest_read(self, now: Optional[float] = None) -> Optional[dict]:
+        """Slowest read within serve.slow_read_window, or None."""
+        now = time.monotonic() if now is None else now
+        window = float(config().serve.slow_read_window)
+        while self._slow and now - self._slow[0][0] > window:
+            self._slow.popleft()
+        if not self._slow:
+            return None
+        age, best = max(
+            ((now - ts, e) for ts, e in self._slow),
+            key=lambda p: p[1]["ms"],
+        )
+        return {**best, "age_s": round(age, 1)}
+
+    def clear_slow(self) -> None:
+        self._slow.clear()
 
     async def _read_inner(self, job_id: str, table: str,
                           keys: List) -> dict:
@@ -420,5 +456,5 @@ class StateGateway:
                 t for t, exp in self._noisy.items() if exp > now
             ),
             "routing_cached_jobs": sorted(self._tables),
-            "slowest_read": self._slow,
+            "slowest_read": self.slowest_read(now),
         }

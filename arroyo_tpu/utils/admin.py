@@ -167,6 +167,30 @@ def build_admin_app(role: str, details_fn=None,
 
         return web.json_response(attribution.ACCOUNTING.summary())
 
+    async def debug_history(request: web.Request):
+        """Metric-history tier dump for THIS process (ISSUE 13): ring
+        stats plus, with ?job=<id>, the job's retained series with
+        windowed rate/delta/quantiles (?window=<s>, ?series=<family>).
+        The controller's /debug/watch adds SLO/alert state on top; this
+        route exists on every role so a worker's local history is
+        inspectable in multi-process deployments."""
+        from ..obs.history import HISTORY
+
+        doc = {"history": HISTORY.stats(),
+               "families": HISTORY.families()}
+        job = request.query.get("job")
+        if job:
+            try:
+                window = float(request.query.get(
+                    "window", config().watch.window))
+            except ValueError:
+                return web.Response(status=400, text="bad window\n")
+            doc["job"] = job
+            doc["window"] = window
+            doc["series"] = HISTORY.export_job(
+                job, window=window, series=request.query.get("series"))
+        return web.json_response(doc)
+
     async def debug_doctor(request: web.Request):
         """Bottleneck doctor for one job hosted in this process:
         ?job=<id> (required) returns the ranked limiting-factor verdict
@@ -226,6 +250,7 @@ def build_admin_app(role: str, details_fn=None,
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/trace", debug_trace)
     app.router.add_get("/debug/latency", debug_latency)
+    app.router.add_get("/debug/history", debug_history)
     app.router.add_get("/debug/attribution", debug_attribution)
     app.router.add_get("/debug/doctor", debug_doctor)
     for path, handler in (extra_routes or {}).items():

@@ -960,6 +960,22 @@ def main():
             sides["attr_overhead_pct"] = round(
                 max(0.0, 100.0 * (1.0 - baseline["eps"] / r_off["eps"])), 2
             )
+    # watchtower overhead (ISSUE 13): one more UNinstrumented q5 run with
+    # the history tier + SLO engine off — the headline median already runs
+    # with watch on (the default), so the delta IS the watchtower's cost.
+    # Same absolute-points gate class as attr_overhead_pct (<= 2% bar).
+    if baseline is not None:
+        watch_env = dict(cpu_env)
+        watch_env["ARROYO__WATCH__ENABLED"] = "0"
+        r_woff = run_child(args.events, "numpy", args.timeout,
+                           env=watch_env,
+                           force_device_join=args.force_device_join)
+        if r_woff is not None:
+            sides["q5_watch_off_eps"] = round(r_woff["eps"], 1)
+            sides["watch_overhead_pct"] = round(
+                max(0.0, 100.0 * (1.0 - baseline["eps"] / r_woff["eps"])),
+                2,
+            )
     baseline_real = baseline is not None
     if device is None:
         device = baseline

@@ -114,6 +114,12 @@ def classify(metric: str) -> Optional[str]:
     # rules above
     if metric.endswith("_hit_pct"):
         return "higher"
+    # Watchtower (ISSUE 13): correctness counts that must be EXACTLY
+    # zero — no spread, no margin. One false-positive page or one wrong
+    # served value is a red gate, full stop.
+    if (metric.endswith("_false_positive_count")
+            or metric.endswith("_wrong_values")):
+        return "zero"
     return None
 
 
@@ -131,6 +137,17 @@ def compare(baseline: dict, current: dict, margin: float = 1.5,
             continue
         b, c = baseline[metric], current[metric]
         if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if direction == "zero":
+            status = "ok" if c == 0 else "regression"
+            if status == "regression":
+                regressions.append(metric)
+            results[metric] = {
+                "baseline": b, "current": c,
+                "delta_pct": float(c), "allowed_pct": 0.0,
+                "spread_pcts": [], "direction": direction,
+                "status": status,
+            }
             continue
         if direction == "lower_abs":
             # absolute-points gate (attribution overhead): the value IS

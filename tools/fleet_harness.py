@@ -56,6 +56,16 @@ worker mid-load: reads must degrade to retriable errors — a wrong value
 or non-retriable error exits 1. serve_* keys gate against
 BENCH_BASELINE.json via tools/bench_compare.py in the nightly serve lane.
 
+Watchtower SLO drill (ISSUE 13): `--watch` runs the alerting scenario —
+one victim tenant is stalled (chaos `runner.stall` on its job id +
+storage latency on its checkpoint data files + a sub-timeout heartbeat
+blackout) among `--watch-healthy` co-tenants; the watchtower must fire
+the freshness alert naming exactly the victim, capture a diagnostic
+bundle whose flight recording covers the breach window, and CLEAR after
+recovery, with watch_false_positive_count == 0 (any firing event naming
+a healthy tenant fails the run). Committed as WATCH_r01.json; the
+nightly `watch` CI lane gates it via bench_compare's exact-zero class.
+
 Usage:
   python tools/fleet_harness.py --jobs 100 --pool 2 --sample 8 \
       [--churn 30] [--idle-seconds 10] [--kill] [--out fleet.json]
@@ -780,6 +790,267 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
     return report
 
 
+def watch_sql(outdir: str, tag: str, rate: int, keys: int) -> str:
+    """Continuous keyed windowed aggregation with WALL-CLOCK event time
+    (plain realtime, no replay): the watermark tracks the wall clock, so
+    the freshness SLO's watermark-lag signal sits near zero while the
+    tenant is healthy and grows unboundedly the moment its pipeline
+    stalls — exactly the signal the drill injects a stall into."""
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '{rate}',
+      message_count = '1000000000', realtime = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{outdir}/watch-{tag}.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % {keys} as k,
+             tumble(interval '100 millisecond') as w, count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+async def run_watch(healthy: int = 10, rate: int = 2000, keys: int = 32,
+                    pool: int = 2, stall_hold: float = 2.0,
+                    fire_timeout: float = 45.0,
+                    clear_timeout: float = 60.0,
+                    workdir: str | None = None) -> dict:
+    """Watchtower SLO drill (ISSUE 13): one victim tenant + `healthy`
+    co-tenants run continuous keyed pipelines on a shared pool; a stall
+    is injected into the VICTIM ONLY (chaos `storage.latency` matched on
+    the victim's checkpoint keys — its flushes back up, barriers block
+    the runner, the source stalls and watermark lag grows — plus a
+    sub-timeout `worker.heartbeat_blackout` liveness wobble on the
+    shared pool that must NOT page anyone). The watchtower must fire
+    the freshness alert naming exactly the victim, capture a diagnostic
+    bundle whose flight recording covers the breach window, and CLEAR
+    after chaos lifts — with ZERO firing events on the healthy
+    co-tenants (`watch_false_positive_count == 0` gates the run)."""
+    from aiohttp import ClientSession, web
+
+    from arroyo_tpu import chaos
+    from arroyo_tpu.api.rest import build_app
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    workdir = workdir or tempfile.mkdtemp(prefix="arroyo-watch-")
+    os.makedirs(workdir, exist_ok=True)
+    bundles_dir = os.path.join(workdir, "bundles")
+    report: dict = {"healthy": healthy, "rate": rate, "keys": keys,
+                    "pool": pool, "workdir": workdir}
+
+    with update(
+        pipeline={"checkpointing": {"interval": 0.5,
+                                    "storage_url": f"{workdir}/ck"}},
+        cluster={"worker_pool_size": pool, "metrics_ttl": 1.0},
+        controller={"heartbeat_timeout": 8.0},
+        worker={"task_slots": max(8, (healthy + 4) * 2)},
+        # fast cadence + tight thresholds so the drill runs in tens of
+        # seconds; loop_lag is raised far above the 1-core CI host's
+        # ambient scheduling jitter — loop pressure there is the host,
+        # not a tenant signal
+        watch={"sample_interval": 0.25, "eval_interval": 0.25,
+               "window": 10.0, "sustain": 1.0, "clear_sustain": 1.5,
+               "freshness_lag_s": 3.0, "checkpoint_age_s": 8.0,
+               "loop_lag_s": 30.0, "trace_drop_rate": 1e9,
+               "spool_dir": bundles_dir},
+        obs={"latency_marker_interval": 0.0},
+    ):
+        sched = EmbeddedScheduler()
+        controller = await ControllerServer(sched).start()
+        wt = controller.watchtower
+        app = build_app(controller,
+                        db_path=os.path.join(workdir, "watch.db"))
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}/api/v1"
+
+        async with ClientSession() as session:
+            async def submit(name: str, tenant: str, tag: str):
+                async with session.post(f"{base}/pipelines", json={
+                    "name": name, "tenant": tenant,
+                    "query": watch_sql(workdir, tag, rate, keys),
+                }) as resp:
+                    assert resp.status == 200, await resp.text()
+
+            await submit("victim", "victim", "victim")
+            for t in range(healthy):
+                await submit(f"healthy-{t}", f"t{t}", f"h{t}")
+
+            # wait for the fleet to run AND every job's watermark-lag
+            # series to appear in the history (the freshness signal
+            # abstains until a watermark flows)
+            deadline = time.monotonic() + 120
+            victim_jid = None
+            while time.monotonic() < deadline:
+                running = [j for j in controller.jobs.values()
+                           if j.state == JobState.RUNNING]
+                victim_jid = next((j.job_id for j in running
+                                   if j.tenant == "victim"), None)
+                lags = {
+                    j.job_id: wt.history.get(
+                        "arroyo_worker_watermark_lag_seconds",
+                        job=j.job_id)
+                    for j in running
+                }
+                if (len(running) == healthy + 1 and victim_jid
+                        and all(lags.values())):
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise RuntimeError(
+                    f"watch fleet never became observable: "
+                    f"{len([j for j in controller.jobs.values()])} jobs"
+                )
+            report["watch_victim"] = victim_jid
+            await asyncio.sleep(2.0)  # clean baseline window
+
+            # -- inject the stall: storage latency on the VICTIM's
+            # checkpoint keys only (keys are '{job_id}/...'-prefixed),
+            # plus one short heartbeat blackout (< heartbeat_timeout) on
+            # the shared pool — a liveness wobble, not an outage
+            # three faults, one tenant:
+            # * runner.stall matched on the victim's job id wedges its
+            #   operators (async sleep per input item — co-residents
+            #   keep their turns on the shared loop): the watermark
+            #   falls behind the wall clock and the freshness SLO sees
+            #   a REAL data-plane stall. (Storage latency alone cannot
+            #   produce one: the controller backpressures the
+            #   checkpoint CADENCE, never the data plane.)
+            # * storage.latency on the victim's checkpoint DATA files
+            #   only ('{jid}/checkpoints' + op=put — those run in
+            #   to_thread flushes; the controller's sync manifest ops
+            #   on the shared loop stay fast) stalls epoch publication
+            #   for the checkpoint-age SLO.
+            # * one sub-timeout heartbeat blackout on the shared pool —
+            #   a liveness wobble that must NOT page anyone.
+            plan = chaos.FaultPlan(seed=1313)
+            plan.add("runner.stall", at_hits=list(range(1, 100000)),
+                     match={"job": victim_jid}, params={"delay": 0.5},
+                     max_fires=100000)
+            plan.add("storage.latency",
+                     at_hits=list(range(1, 400)),
+                     match={"key": f"{victim_jid}/checkpoints",
+                            "op": "put"},
+                     params={"delay": 6.0}, max_fires=400)
+            plan.add("worker.heartbeat_blackout", at_hits=(2,),
+                     params={"duration": 2.0}, max_fires=1)
+            chaos.install(plan)
+            stall_t0 = time.monotonic()
+            stall_wall_us = time.time() * 1e6
+            report["watch_stall_injected"] = True
+
+            fired_at = None
+            deadline = time.monotonic() + fire_timeout
+            while time.monotonic() < deadline:
+                async with session.get(
+                        f"{base}/jobs/{victim_jid}/alerts") as resp:
+                    doc = await resp.json()
+                if "freshness" in doc.get("firing", []):
+                    fired_at = time.monotonic()
+                    break
+                await asyncio.sleep(0.25)
+            report["watch_fired"] = int(fired_at is not None)
+            report["watch_fire_s"] = round(
+                (fired_at - stall_t0), 2) if fired_at else None
+            report["watch_victim_rules"] = (doc or {}).get("firing", [])
+            if fired_at:
+                await asyncio.sleep(stall_hold)
+
+            # -- lift the fault; the victim's flushes drain, the source
+            # resumes wall-clock stamping and lag collapses
+            chaos.clear()
+            fired_log = plan.comparable_log()
+            report["watch_faults_fired"] = len(fired_log)
+
+            cleared = False
+            deadline = time.monotonic() + clear_timeout
+            while time.monotonic() < deadline:
+                async with session.get(
+                        f"{base}/jobs/{victim_jid}/alerts") as resp:
+                    doc = await resp.json()
+                st = (doc.get("alerts") or {}).get("freshness", {})
+                if fired_at and st.get("state") == "ok":
+                    cleared = True
+                    break
+                await asyncio.sleep(0.25)
+            report["watch_cleared_ok"] = int(cleared)
+
+            # -- bundle: present for the victim, flight recording covers
+            # the breach window, history shows the lag above threshold
+            async with session.get(
+                    f"{base}/jobs/{victim_jid}/bundles") as resp:
+                idx = (await resp.json()).get("data", [])
+            report["watch_bundle_count"] = len(idx)
+            bundle_ok = 0
+            if idx:
+                # the throughput rule may fire first on the same backlog
+                # — judge the FRESHNESS bundle
+                meta = next((m for m in idx
+                             if m["rule"] == "freshness"), idx[0])
+                async with session.get(
+                        f"{base}/jobs/{victim_jid}/bundles/"
+                        f"{meta['n']}") as resp:
+                    bundle = await resp.json()
+                spans = bundle.get("flight_recorder", [])
+                in_window = [
+                    s for s in spans
+                    if stall_wall_us <= s.get("ts", 0)
+                    <= bundle.get("captured_at", 0) * 1e6
+                ]
+                lag_series = [
+                    s for s in bundle.get("history", [])
+                    if s["name"] == "arroyo_worker_watermark_lag_seconds"
+                ]
+                lag_max = max(
+                    (s.get("max", 0.0) or 0.0 for s in lag_series),
+                    default=0.0,
+                )
+                bundle_ok = int(
+                    bool(in_window)
+                    and bool(bundle.get("perfetto", {}).get(
+                        "traceEvents"))
+                    and bundle.get("doctor") is not None
+                    and lag_max >= 3.0
+                )
+                report["watch_bundle_spans_in_window"] = len(in_window)
+                report["watch_bundle_lag_max_s"] = round(lag_max, 2)
+                report["watch_bundle_file"] = idx[0].get("path")
+            report["watch_bundle_ok"] = bundle_ok
+
+            # -- zero false positives: no firing event may name a
+            # healthy co-tenant, across the whole run
+            false_pos = [
+                {k: v for k, v in e.items() if k != "cause"}
+                for e in wt.ledger
+                if e["event"] == "firing" and e["job"] != victim_jid
+            ]
+            report["watch_false_positive_count"] = len(false_pos)
+            report["watch_false_positives"] = false_pos[:10]
+            report["watch_ledger"] = [
+                {k: v for k, v in e.items() if k != "cause"}
+                for e in wt.ledger
+            ]
+            report["watch_healthy_observed"] = healthy
+
+            for j in list(controller.jobs.values()):
+                if not j.state.is_terminal():
+                    await controller.stop_job(j.job_id, "immediate")
+        await runner.cleanup()
+        await controller.stop()
+        chaos.clear()
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jobs", type=int, default=100,
@@ -820,7 +1091,45 @@ def main(argv=None) -> int:
     ap.add_argument("--min-lookups", type=float, default=2000.0,
                     help="fail the (non-kill) serve scenario below this "
                          "sustained lookups/s")
+    # Watchtower SLO drill (ISSUE 13)
+    ap.add_argument("--watch", action="store_true",
+                    help="run the watchtower SLO drill: stall one "
+                         "tenant, require the freshness alert to fire "
+                         "with the right job, bundle, and clear — zero "
+                         "false positives on healthy co-tenants")
+    ap.add_argument("--watch-healthy", type=int, default=10,
+                    help="healthy co-tenants beside the victim")
+    ap.add_argument("--watch-rate", type=int, default=2000)
+    ap.add_argument("--watch-keys", type=int, default=32)
     args = ap.parse_args(argv)
+    if args.watch:
+        report = asyncio.run(run_watch(
+            healthy=args.watch_healthy, rate=args.watch_rate,
+            keys=args.watch_keys, pool=args.pool,
+            workdir=args.workdir,
+        ))
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        rc = 0
+        if not report.get("watch_fired"):
+            print("WATCH DRILL: freshness alert never fired for the "
+                  "stalled victim", file=sys.stderr)
+            rc = 1
+        if report.get("watch_false_positive_count"):
+            print(f"WATCH DRILL: false positives on healthy tenants: "
+                  f"{report['watch_false_positives']}", file=sys.stderr)
+            rc = 1
+        if not report.get("watch_bundle_ok"):
+            print("WATCH DRILL: diagnostic bundle missing or does not "
+                  "cover the breach window", file=sys.stderr)
+            rc = 1
+        if not report.get("watch_cleared_ok"):
+            print("WATCH DRILL: alert never cleared after recovery",
+                  file=sys.stderr)
+            rc = 1
+        return rc
     if args.serve or args.serve_kill:
         report = asyncio.run(run_serve(
             tenants=args.serve_tenants, keys=args.serve_keys,

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Offline watchtower report renderer (ISSUE 13).
+
+Renders a watch-drill report (tools/fleet_harness.py --watch --out) or
+a captured diagnostic bundle as a human-readable alert timeline +
+bundle summary — the artifact a responder reads when only the CI
+uploads survived the incident.
+
+Usage:
+  python tools/watch_report.py WATCH_r01.json          # drill report
+  python tools/watch_report.py --bundle bundle-*.json  # one bundle
+  python tools/watch_report.py report.json --bundle b.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+
+def _fmt_ts(ts: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts)) + (
+        ".%01d" % int((ts % 1) * 10)
+    )
+
+
+def render_timeline(events: List[dict], out=sys.stdout) -> None:
+    """The alert ledger as a timeline: one line per firing/cleared
+    event, ordered by wall time."""
+    events = sorted(events or [], key=lambda e: e.get("ts", 0))
+    if not events:
+        print("  (no alert events)", file=out)
+        return
+    t0 = events[0].get("ts", 0)
+    for e in events:
+        flag = "!" if e.get("event") == "firing" else "+"
+        val = e.get("value")
+        val_s = f"{val:.3g}" if isinstance(val, (int, float)) else "?"
+        extra = ""
+        if e.get("sustained_s") is not None:
+            extra = f" after {e['sustained_s']}s sustained"
+        if e.get("fired_for_s") is not None:
+            extra = f" (fired for {e['fired_for_s']}s)"
+        print(
+            f"{flag} {_fmt_ts(e.get('ts', 0))} "
+            f"(+{e.get('ts', 0) - t0:6.1f}s) "
+            f"{e.get('event', '?').upper():<8} "
+            f"job={e.get('job', '?')} rule={e.get('rule', '?')} "
+            f"value={val_s}{e.get('unit', '')} "
+            f"(threshold {e.get('threshold')}){extra}",
+            file=out,
+        )
+
+
+def bundle_summary(bundle: dict, out=sys.stdout) -> None:
+    """One diagnostic bundle, summarized: what fired, what the doctor
+    said, and what evidence the bundle carries."""
+    print(f"bundle #{bundle.get('n')} — job {bundle.get('job')} "
+          f"(tenant {bundle.get('tenant')}) rule {bundle.get('rule')}",
+          file=out)
+    cap = bundle.get("captured_at")
+    if cap:
+        print(f"  captured {_fmt_ts(cap)}", file=out)
+    alert = bundle.get("alert") or {}
+    print(f"  breach: value={alert.get('value')}{alert.get('unit', '')} "
+          f"threshold={alert.get('threshold')}", file=out)
+    verdict = (bundle.get("doctor") or {}).get("verdict") or {}
+    if verdict:
+        line = (f"  doctor: {verdict.get('cause')} "
+                f"(operator {verdict.get('operator')}, "
+                f"confidence {verdict.get('confidence')})")
+        if verdict.get("suspect"):
+            line += f" suspect={verdict['suspect']}"
+        print(line, file=out)
+    spans = bundle.get("flight_recorder") or []
+    perf = (bundle.get("perfetto") or {}).get("traceEvents") or []
+    print(f"  flight recording: {len(spans)} spans, "
+          f"{len(perf)} perfetto events", file=out)
+    hist = bundle.get("history") or []
+    print(f"  history: {len(hist)} series", file=out)
+    for s in hist:
+        if s.get("max") or s.get("rate") or s.get("quantiles"):
+            stats = []
+            if s.get("max") is not None:
+                stats.append(f"max={s['max']:.3g}")
+            if s.get("rate") is not None:
+                stats.append(f"rate={s['rate']:.3g}/s")
+            for q, v in (s.get("quantiles") or {}).items():
+                stats.append(f"{q}={v:.3g}")
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted((s.get("labels") or {}).items()))
+            print(f"    {s['name']}{{{labels}}} "
+                  f"{' '.join(stats)} ({len(s.get('samples', []))} "
+                  "samples)", file=out)
+    cause = bundle.get("cause") or []
+    if cause:
+        print(f"  cause series: "
+              f"{', '.join(sorted({c['name'] for c in cause}))}",
+              file=out)
+
+
+def render_report(report: dict, out=sys.stdout) -> int:
+    """A --watch drill report: verdicts, then the alert timeline, then
+    the bundle index. Returns a shell rc (0 = drill passed)."""
+    print("watchtower drill report", file=out)
+    print(f"  victim: {report.get('watch_victim')} "
+          f"(+{report.get('watch_healthy_observed', '?')} healthy "
+          "co-tenants)", file=out)
+    checks = [
+        ("alert fired", bool(report.get("watch_fired"))),
+        ("bundle captured + covers breach window",
+         bool(report.get("watch_bundle_ok"))),
+        ("cleared after recovery",
+         bool(report.get("watch_cleared_ok"))),
+        ("zero false positives",
+         report.get("watch_false_positive_count", 1) == 0),
+    ]
+    for name, ok in checks:
+        print(f"  [{'ok' if ok else 'FAIL'}] {name}", file=out)
+    if report.get("watch_fire_s") is not None:
+        print(f"  time to fire: {report['watch_fire_s']}s "
+              f"(rules: {report.get('watch_victim_rules')})", file=out)
+    print("\nalert timeline:", file=out)
+    render_timeline(report.get("watch_ledger") or [], out=out)
+    if report.get("watch_bundle_file"):
+        print(f"\nbundle file: {report['watch_bundle_file']}", file=out)
+    return 0 if all(ok for _n, ok in checks) else 1
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("report", nargs="?",
+                    help="watch drill report JSON (--watch --out)")
+    ap.add_argument("--bundle", action="append", default=[],
+                    help="diagnostic bundle JSON file (repeatable)")
+    args = ap.parse_args(argv)
+    if not args.report and not args.bundle:
+        ap.error("give a report and/or --bundle")
+    rc = 0
+    if args.report:
+        try:
+            with open(args.report) as f:
+                report = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"watch_report: {e}", file=sys.stderr)
+            return 2
+        rc = render_report(report)
+    for path in args.bundle:
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"watch_report: {e}", file=sys.stderr)
+            return 2
+        print("", file=sys.stdout)
+        bundle_summary(bundle)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,3 +46,4 @@ from .engine import LintResult, collect_files, run_lint  # noqa: F401
 from . import rules_asyncio  # noqa: F401,E402
 from . import rules_protocol  # noqa: F401,E402
 from . import rules_jax_config  # noqa: F401,E402
+from . import rules_segments  # noqa: F401,E402

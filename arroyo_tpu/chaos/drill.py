@@ -236,21 +236,33 @@ def run_drill(
     timeout: float = 120.0,
 ) -> DrillResult:
     """Run one golden query fault-free, then under `plan_factory(seed)`,
-    and verify byte-identical canonical sink output."""
+    and verify byte-identical canonical sink output.
+
+    The fault-free reference intentionally runs with SEGMENT FUSION OFF
+    while the faulted run keeps the default (fusion + pipelining ON):
+    every drill is therefore also a fused-vs-unfused A/B — the fused
+    data plane must produce byte-identical output to the per-operator
+    plan AND survive the fault schedule (ISSUE 14)."""
+    from ..config import update
+
     query_path = os.path.join(golden_dir, "queries", f"{query_name}.sql")
     headers = query_headers(query_path)
     register_query_udfs(headers, golden_dir)
     os.makedirs(workdir, exist_ok=True)
 
-    # 1. fault-free reference through the same embedded cluster
+    # 1. fault-free reference through the same embedded cluster, on the
+    # UNFUSED data plane (segment fusion off)
     clean_out = os.path.join(workdir, f"{query_name}-clean.json")
     clean_sql = load_query(query_path, clean_out, golden_dir)
     assert chaos.installed() is None, "a fault plan is already installed"
-    _run_embedded(
-        clean_sql, f"drill-{query_name}-clean", None, n_workers, parallelism,
-        max_restarts=0, heartbeat_interval=heartbeat_interval,
-        heartbeat_timeout=30.0, checkpoint_interval=60.0, timeout=timeout,
-    )
+    with update(engine={"segment_fusion": False}):
+        _run_embedded(
+            clean_sql, f"drill-{query_name}-clean", None, n_workers,
+            parallelism, max_restarts=0,
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=30.0, checkpoint_interval=60.0,
+            timeout=timeout,
+        )
     want = canonicalize_output(clean_out, clean_sql, headers)
     if not want:
         raise RuntimeError(f"{query_name}: fault-free run produced no output")
@@ -388,6 +400,12 @@ def run_rescale_drill(seed: int, workdir: str,
     fault_sql = load_query(query_path, fault_out, golden_dir,
                            throttle=throttle)
     plan = chaos.install(rescale_plan(seed))
+    from .. import obs
+
+    # fresh span buffer: the drill reports barrier-drain time from the
+    # faulted run's runner.pipeline_drain spans (ISSUE 14 — the
+    # measurement ROADMAP item 4's generation-overlap rescale needs)
+    obs.recorder().clear()
     error = None
     restarts = rescales = 0
     decisions: List[dict] = []
@@ -447,6 +465,14 @@ def run_rescale_drill(seed: int, workdir: str,
         error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
     if error is None and rescales < 1:
         error = "the autoscaler never triggered a rescale"
+    # barrier-drain measurement: per-barrier pipeline drain time from the
+    # runner.pipeline_drain spans (the data the zero-downtime-rescale arc
+    # needs: how long a barrier waits on in-flight staged batches)
+    drains = [
+        s for s in obs.recorder().snapshot()
+        if s.get("name") == "runner.pipeline_drain"
+    ]
+    drain_ms = sorted(s["dur"] / 1000.0 for s in drains)
     return DrillResult(
         query=f"rescale_{query_name}",
         seed=seed,
@@ -458,6 +484,167 @@ def run_rescale_drill(seed: int, workdir: str,
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
         error=error,
+        extras={
+            "pipeline_drain_barriers": len(drains),
+            "pipeline_drain_ms_p50": round(
+                drain_ms[len(drain_ms) // 2], 3) if drain_ms else 0.0,
+            "pipeline_drain_ms_max": round(drain_ms[-1], 3)
+            if drain_ms else 0.0,
+            "pipeline_drain_staged_max": max(
+                (int(s.get("attrs", {}).get("staged", 0)) for s in drains),
+                default=0,
+            ),
+        },
+    )
+
+
+# -- fused-pipeline drill (ISSUE 14 acceptance) ------------------------------
+
+
+PIPELINE_DRILL_SQL = """
+CREATE TABLE src (
+  timestamp TIMESTAMP, k BIGINT NOT NULL, v BIGINT NOT NULL
+) WITH (
+  connector = 'single_file', path = '$src', format = 'json',
+  type = 'source'{throttle}, event_time_field = 'timestamp'
+);
+CREATE TABLE out (
+  k BIGINT NOT NULL, s BIGINT NOT NULL, c BIGINT NOT NULL
+) WITH (
+  connector = 'single_file', path = '$out', format = 'json', type = 'sink'
+);
+INSERT INTO out
+SELECT k, sum(v_eur) AS s, count(*) AS c FROM (
+  SELECT k, v_eur - v_eur % 10 AS v_eur FROM (
+    SELECT k % 8 AS k, v * 100 / 121 AS v_eur FROM src WHERE v > 0
+  )
+)
+GROUP BY k, tumble(interval '2 second');
+"""
+
+
+def pipeline_plan(seed: int) -> FaultPlan:
+    """SIGKILL a worker while the fused segment's staging queue holds an
+    in-flight batch (the throttled source + per-batch cadence keeps the
+    two-deep pipeline primed), plus a data-plane drop for good measure —
+    recovery must replay from the last durable epoch with no event lost
+    or duplicated out of the staged (not yet emitted) batches."""
+    rng = random.Random(int(seed))
+    plan = FaultPlan(seed)
+    plan.add("worker.kill", at_hits=(rng.randint(14, 26),))
+    plan.add("network.drop_connection", at_hits=(rng.randint(4, 12),))
+    return plan
+
+
+def run_pipeline_drill(seed: int, workdir: str, n_rows: int = 6000,
+                       timeout: float = 150.0) -> DrillResult:
+    """ISSUE 14 acceptance: exactly-once through the fused segment
+    runtime's double-buffered staging queue. A 3-op stateless chain
+    (filter -> convert -> round) feeds a tumbling aggregate; the clean
+    reference runs UNFUSED on the host kernels, the faulted run keeps
+    fusion + two-deep pipelining ON with the segment's jitted device
+    tier forced onto jax-CPU and small batches, so barriers routinely
+    arrive while a dispatched batch is staged un-materialized, and a
+    worker SIGKILL lands mid-stream. Passes iff
+    (a) canonical output is byte-identical (no staged event lost or
+    duplicated), (b) the kill forced a real recovery, and (c) the
+    runner.pipeline_drain spans prove at least one barrier actually
+    drained a staged batch (the scenario exercised what it claims)."""
+    from .. import obs
+    from ..config import update
+
+    os.makedirs(workdir, exist_ok=True)
+    src = os.path.join(workdir, "pipe-in.json")
+    with open(src, "w") as f:
+        for i in range(n_rows):
+            mins, secs = (i // 1200) % 60, (i // 20) % 60
+            f.write(json.dumps({
+                "k": i % 64,
+                "v": (i * 37) % 1000 + 1,
+                "timestamp": f"2023-03-01T00:{mins:02d}:{secs:02d}."
+                             f"{(i % 20) * 50:03d}Z",
+            }) + "\n")
+
+    clean_out = os.path.join(workdir, "pipe-clean.json")
+    clean_sql = PIPELINE_DRILL_SQL.replace("$src", src).replace(
+        "$out", clean_out).format(throttle="")
+    assert chaos.installed() is None, "a fault plan is already installed"
+    with update(engine={"segment_fusion": False}):
+        _run_embedded(
+            clean_sql, "drill-pipe-clean", None, 2, 1, max_restarts=0,
+            heartbeat_interval=0.1, heartbeat_timeout=30.0,
+            checkpoint_interval=60.0, timeout=timeout,
+        )
+    want = canonicalize_output(clean_out, clean_sql, {})
+    if not want:
+        raise RuntimeError("pipeline drill: fault-free run had no output")
+
+    fault_out = os.path.join(workdir, "pipe-faulted.json")
+    fault_sql = PIPELINE_DRILL_SQL.replace("$src", src).replace(
+        "$out", fault_out).format(
+        throttle=",\n  throttle_per_sec = '1500'")
+    plan = chaos.install(pipeline_plan(seed))
+    obs.recorder().clear()
+    error = None
+    restarts = 0
+    try:
+        # small batches + two-deep staging, with the segment's JAX tier
+        # forced (jax-CPU): dispatched-but-unmaterialized batches really
+        # sit in the staging queue, so barriers land mid-pipeline —
+        # host-tier results emit eagerly and would never stage
+        with update(engine={"segment_fusion": True, "pipeline_depth": 2},
+                    tpu={"enabled": True, "require_accelerator": False},
+                    pipeline={"source_batch_size": 64}):
+            restarts = _run_embedded(
+                fault_sql, "drill-pipe-faulted",
+                os.path.join(workdir, "pipe-ck"), 2, 1, max_restarts=8,
+                heartbeat_interval=0.1, heartbeat_timeout=1.5,
+                checkpoint_interval=0.15, timeout=timeout,
+            )
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+    finally:
+        chaos.clear()
+
+    got = canonicalize_output(fault_out, fault_sql, {})
+    drains = [
+        s for s in obs.recorder().snapshot()
+        if s.get("name") == "runner.pipeline_drain"
+    ]
+    staged_max = max(
+        (int(s.get("attrs", {}).get("staged", 0)) for s in drains),
+        default=0,
+    )
+    passed = (error is None and got == want and not plan.unfired()
+              and restarts >= 1 and staged_max >= 1)
+    if error is None and got != want:
+        error = f"output diverged: {len(got)} rows vs {len(want)}"
+    if error is None and plan.unfired():
+        error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    if error is None and restarts < 1:
+        error = "the SIGKILL never forced a recovery"
+    if error is None and staged_max < 1:
+        error = ("no barrier ever drained a staged batch — the drill "
+                 "did not exercise the mid-flight pipeline")
+    return DrillResult(
+        query="fused_pipeline_kill",
+        seed=seed,
+        passed=passed,
+        rows=len(got),
+        restarts=restarts,
+        fired=plan.fired_events,
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+        extras={
+            "pipeline_drain_barriers": len(drains),
+            "pipeline_drain_staged_max": staged_max,
+            "barriers_with_staged": sum(
+                1 for s in drains
+                if int(s.get("attrs", {}).get("staged", 0)) >= 1
+            ),
+        },
     )
 
 

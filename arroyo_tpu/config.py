@@ -181,6 +181,36 @@ class TpuConfig:
 
 
 @dataclasses.dataclass
+class EngineConfig:
+    """Fused segment runtime (arroyo_tpu/engine/segments.py): maximal
+    contiguous runs of stateless value operators inside a chained task
+    (filter -> project -> expression-eval) are compiled into ONE segment
+    program at plan time, so the runner makes one dispatch per segment
+    per batch instead of one per operator, and the batch path is
+    double-buffered so host Arrow decode/pack of batch k+1 overlaps the
+    in-flight dispatch of batch k."""
+
+    # master switch for plan-time segment fusion: off = every stateless
+    # operator keeps its own per-batch dispatch (the pre-fusion data
+    # plane; the nightly bench A/B child runs with this off)
+    segment_fusion: bool = True
+    # batches a fused segment may hold in flight (dispatch issued, output
+    # not yet materialized/emitted): 2 = double buffering — batch k's
+    # device dispatch overlaps batch k+1's host decode/pack. Emission
+    # stays strictly FIFO, watermarks are held while batches are staged,
+    # and checkpoint barriers drain the pipeline before capture
+    # (runner.pipeline_drain), so outputs are byte-identical at any
+    # depth. 1 disables staging.
+    pipeline_depth: int = 2
+    # donate segment input buffers to the jitted program (XLA in-place
+    # aliasing on the steady-state dispatch): 'auto' = only on real
+    # accelerators AND where the jax generation makes donation safe
+    # (ops/_jax.safe_donate — same gate as tpu.donate_state), 'on' =
+    # wherever safe_donate allows, 'off' = never
+    segment_donation: str = "auto"
+
+
+@dataclasses.dataclass
 class StateConfig:
     """State-at-scale knobs (arroyo_tpu/state): incremental global-table
     snapshots (blob chains + rebase policy), fully off-barrier checkpoint
@@ -593,7 +623,8 @@ class TlsConfig:
 @dataclasses.dataclass
 class Config:
     """Root of the layered config tree. Sections: pipeline (batching,
-    queues, checkpointing), state (incremental snapshots, off-barrier
+    queues, checkpointing), engine (fused segment runtime + device
+    pipelining), state (incremental snapshots, off-barrier
     flushes, spill tier), serve (queryable-state serving tier),
     autoscale (closed-loop parallelism control), watch (metric history
     + SLO engine), tls, chaos (fault injection), obs (flight recorder), tpu (device
@@ -604,6 +635,7 @@ class Config:
     arroyolint CFG001 rejects reads of undeclared keys."""
 
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     state: StateConfig = dataclasses.field(default_factory=StateConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     autoscale: AutoscaleConfig = dataclasses.field(default_factory=AutoscaleConfig)

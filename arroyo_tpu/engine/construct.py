@@ -52,3 +52,4 @@ def _ensure_registered():
     from ..operators import projection, watermark_generator, windows  # noqa: F401
     from ..operators import joins, updating, window_fn, async_udf  # noqa: F401
     from .. import connectors  # noqa: F401
+    from . import segments  # noqa: F401  (FUSED_SEGMENT factory)

@@ -38,6 +38,10 @@ class OperatorName(enum.Enum):
     UPDATING_AGGREGATE = "updating_aggregate"
     CONNECTOR_SOURCE = "connector_source"
     CONNECTOR_SINK = "connector_sink"
+    # a fused run of stateless value operators compiled into one segment
+    # program (engine/segments.py SegmentFusionPass): config carries the
+    # member ChainedOp dicts under "ops"
+    FUSED_SEGMENT = "fused_segment"
 
 
 class EdgeType(enum.Enum):
@@ -274,40 +278,45 @@ class LogicalGraph:
         return g
 
 
-def _config_json(config: Dict[str, Any]) -> Dict[str, Any]:
-    out = {}
-    for k, v in config.items():
-        if isinstance(v, StreamSchema):
-            out[k] = {
-                "__stream_schema__": {
-                    "ipc": v.schema.serialize().to_pybytes().hex(),
-                    "key_indices": list(v.key_indices),
-                }
+def _value_json(v: Any) -> Any:
+    if isinstance(v, StreamSchema):
+        return {
+            "__stream_schema__": {
+                "ipc": v.schema.serialize().to_pybytes().hex(),
+                "key_indices": list(v.key_indices),
             }
-        elif isinstance(v, bytes):
-            out[k] = {"__bytes__": v.hex()}
-        elif isinstance(v, dict):
-            out[k] = _config_json(v)
-        else:
-            out[k] = v
-    return out
+        }
+    if isinstance(v, bytes):
+        return {"__bytes__": v.hex()}
+    if isinstance(v, dict):
+        return _config_json(v)
+    if isinstance(v, list):
+        # fused-segment configs nest member op dicts under "ops"
+        return [_value_json(x) for x in v]
+    return v
+
+
+def _config_json(config: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: _value_json(v) for k, v in config.items()}
+
+
+def _value_unjson(v: Any) -> Any:
+    import pyarrow as pa
+
+    if isinstance(v, dict) and "__stream_schema__" in v:
+        d = v["__stream_schema__"]
+        return StreamSchema(
+            pa.ipc.read_schema(pa.py_buffer(bytes.fromhex(d["ipc"]))),
+            tuple(d["key_indices"]),
+        )
+    if isinstance(v, dict) and "__bytes__" in v:
+        return bytes.fromhex(v["__bytes__"])
+    if isinstance(v, dict):
+        return _config_unjson(v)
+    if isinstance(v, list):
+        return [_value_unjson(x) for x in v]
+    return v
 
 
 def _config_unjson(config: Dict[str, Any]) -> Dict[str, Any]:
-    import pyarrow as pa
-
-    out = {}
-    for k, v in config.items():
-        if isinstance(v, dict) and "__stream_schema__" in v:
-            d = v["__stream_schema__"]
-            out[k] = StreamSchema(
-                pa.ipc.read_schema(pa.py_buffer(bytes.fromhex(d["ipc"]))),
-                tuple(d["key_indices"]),
-            )
-        elif isinstance(v, dict) and "__bytes__" in v:
-            out[k] = bytes.fromhex(v["__bytes__"])
-        elif isinstance(v, dict):
-            out[k] = _config_unjson(v)
-        else:
-            out[k] = v
-    return out
+    return {k: _value_unjson(v) for k, v in config.items()}

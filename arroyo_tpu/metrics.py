@@ -397,6 +397,28 @@ DEVICE_PADDING_WASTE = REGISTRY.gauge(
     "arroyo_device_padding_waste",
     "fraction (0..1) of rows shipped to the device that were neutral "
     "padding filler, per program and packing rung (shape bucket)")
+# fused segment runtime (engine/segments.py): one dispatch per segment
+# per batch instead of one per operator — these families are what the
+# bench's dispatches_per_batch ratio and the per-segment ledger read
+SEGMENT_DISPATCH_SECONDS = REGISTRY.histogram(
+    "arroyo_segment_dispatch_seconds",
+    "per-batch execution wall time of fused stateless segments, per "
+    "segment program and tier (tier=jax: one jitted XLA program for the "
+    "whole chain; tier=host: the composed arrow/numpy program)")
+SEGMENT_FUSED_OPS = REGISTRY.gauge(
+    "arroyo_segment_fused_ops",
+    "operators fused into each segment program (the dispatches a batch "
+    "no longer pays individually)")
+SEGMENT_DISPATCHES = REGISTRY.counter(
+    "arroyo_segment_dispatches_total",
+    "stateless-chain dispatches by job/task and fused=1|0 — fused "
+    "segments count one per batch, unfused members of a planned run "
+    "count one per operator per batch (the A/B numerator of the bench's "
+    "dispatches_per_batch)")
+SEGMENT_BATCHES = REGISTRY.counter(
+    "arroyo_segment_batches_total",
+    "batches entering a planned stateless run (fused or not) by "
+    "job/task — the denominator of dispatches_per_batch")
 WATERMARK_LAG_SECONDS = REGISTRY.gauge(
     "arroyo_worker_watermark_lag_seconds",
     "wall-clock seconds the subtask's effective watermark trails now "

@@ -38,6 +38,7 @@ from ..metrics import (
     DEVICE_EXCHANGE_SECONDS,
     DEVICE_PADDING_WASTE,
     DEVICE_DISPATCH_SECONDS,
+    SEGMENT_DISPATCH_SECONDS,
     XLA_COMPILE_CACHE,
     XLA_COMPILE_SECONDS,
     XLA_COMPILES,
@@ -194,9 +195,10 @@ class InstrumentedJit:
     histogram will show it — but it still costs a python-side trace."""
 
     __slots__ = ("program", "fn", "seen", "_compiles", "_hit", "_miss",
-                 "_compile_h", "_dispatch_h", "_exchange_h")
+                 "_compile_h", "_dispatch_h", "_exchange_h", "_segment_h")
 
-    def __init__(self, program: str, fn, exchange: bool = False):
+    def __init__(self, program: str, fn, exchange: bool = False,
+                 segment: bool = False):
         self.program = program
         self.fn = fn
         self.seen: set = set()
@@ -211,6 +213,13 @@ class InstrumentedJit:
         self._exchange_h = (
             DEVICE_EXCHANGE_SECONDS.labels(program=program)
             if exchange else None
+        )
+        # fused-segment programs (engine/segments.py) additionally feed
+        # arroyo_segment_dispatch_seconds{tier="jax"} so the per-segment
+        # ledger separates whole-chain dispatches from other device work
+        self._segment_h = (
+            SEGMENT_DISPATCH_SECONDS.labels(program=program, tier="jax")
+            if segment else None
         )
 
     def __call__(self, *args, rung: Optional[int] = None):
@@ -241,6 +250,8 @@ class InstrumentedJit:
             self._dispatch_h.observe(dt)
             if self._exchange_h is not None:
                 self._exchange_h.observe(dt)
+            if self._segment_h is not None:
+                self._segment_h.observe(dt)
         return out
 
 
@@ -374,8 +385,24 @@ def summary() -> dict:
         for labels, v in snap.get("arroyo_device_padding_waste", [])
     ]
     padding.sort(key=lambda e: (e["program"], int(e["rung"] or 0)))
+    # fused-segment ledger (engine/segments.py): per-segment dispatch
+    # stats by tier plus the fused-op count — what the mesh_profile
+    # BASELINE ledger renders as per-segment rows
+    segments: Dict[str, dict] = {}
+    for labels, h in snap.get("arroyo_segment_dispatch_seconds", []):
+        s = segments.setdefault(labels.get("program", "?"), {})
+        tier = labels.get("tier", "?")
+        s[f"{tier}_dispatches"] = int(h.get("count", 0))
+        s[f"{tier}_s_total"] = round(h.get("sum", 0.0), 4)
+        s[f"{tier}_quantiles"] = {
+            q: round(v, 6) for q, v in hist_quantiles(h).items()
+        }
+    for labels, v in snap.get("arroyo_segment_fused_ops", []):
+        s = segments.setdefault(labels.get("program", "?"), {})
+        s["fused_ops"] = int(v)
     return {
         "programs": programs,
         "padding_waste": padding,
+        "segments": segments,
         "recompiles": recompile_log(),
     }

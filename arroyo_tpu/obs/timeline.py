@@ -31,7 +31,7 @@ _LOCK = threading.Lock()
 
 # canonical phase order for reports (decode -> ... -> flush); unknown
 # phases sort after these
-PHASES = ("decode", "process", "dispatch", "exchange", "emit",
+PHASES = ("decode", "process", "segment", "dispatch", "exchange", "emit",
           "watermark", "flush", "loop.lag")
 
 

@@ -23,30 +23,72 @@ from .base import Operator
 class BatchMapOperator(Operator):
     """Applies fn(RecordBatch) -> RecordBatch."""
 
+    # stateless value transform: registered fusable into segment runs
+    # (engine/segments.py). Lint JAX004 `segment-purity` enforces that a
+    # fusable operator never touches state tables or checkpoint hooks —
+    # a fused run executes with ONE dispatch and relies on having no
+    # per-operator capture to skip.
+    fusable = True
+    # set by the value factories when engine.segment_fusion is OFF and
+    # the planner marked this op as part of a would-be segment run: the
+    # op then counts its per-batch dispatch (and, for the run's lead op,
+    # the batch itself) into the arroyo_segment_* families, so the
+    # fused/unfused A/B reads dispatches_per_batch from the same place
+    segment_member = False
+    segment_lead = False
+
     def __init__(self, fn: Callable[[pa.RecordBatch], Optional[pa.RecordBatch]],
                  name: str = "map", out_schema=None):
         super().__init__(name)
         self.fn = fn
         self.out_schema = out_schema
+        self._seg_counters = None
+
+    def _count_unfused(self, ctx):
+        c = self._seg_counters
+        if c is None:
+            from ..metrics import SEGMENT_BATCHES, SEGMENT_DISPATCHES
+
+            ti = ctx.task_info
+            c = self._seg_counters = (
+                SEGMENT_DISPATCHES.labels(job=ti.job_id, task=ti.task_id,
+                                          fused="0"),
+                SEGMENT_BATCHES.labels(job=ti.job_id, task=ti.task_id)
+                if self.segment_lead else None,
+            )
+        c[0].inc()
+        if c[1] is not None:
+            c[1].inc()
 
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        if self.segment_member:
+            self._count_unfused(ctx)
         out = self.fn(batch)
         if out is not None and out.num_rows:
             await collector.collect(out)
+
+
+def _apply_segment_flags(op: BatchMapOperator, config: dict) -> BatchMapOperator:
+    if config.get("segment_member"):
+        op.segment_member = True
+        op.segment_lead = bool(config.get("segment_lead"))
+    return op
 
 
 @register_operator(OperatorName.ARROW_VALUE)
 @register_operator(OperatorName.PROJECTION)
 def _make_value(config: dict) -> Operator:
     if "py_fn" in config:
-        return BatchMapOperator(config["py_fn"], config.get("name", "map"),
-                                config.get("schema"))
+        return _apply_segment_flags(
+            BatchMapOperator(config["py_fn"], config.get("name", "map"),
+                             config.get("schema")), config)
     if "program" in config:
         from ..sql.expressions import CompiledProjection
 
         prog = CompiledProjection.from_config(config["program"])
-        return BatchMapOperator(prog, config.get("name", "project"),
-                                config.get("schema"))
+        return _apply_segment_flags(
+            BatchMapOperator(prog, config.get("name", "project"),
+                             config.get("schema")), config)
     raise ValueError("value operator config needs py_fn or program")
 
 
@@ -56,11 +98,15 @@ def _make_key(config: dict) -> Operator:
     schema (no separate key column materialization needed) — an ArrowKey node
     may still compute key expressions into columns before the shuffle."""
     if "py_fn" in config:
-        return BatchMapOperator(config["py_fn"], "key", config.get("schema"))
+        return _apply_segment_flags(
+            BatchMapOperator(config["py_fn"], "key", config.get("schema")),
+            config)
     if "program" in config:
         from ..sql.expressions import CompiledProjection
 
         prog = CompiledProjection.from_config(config["program"])
-        return BatchMapOperator(prog, "key", config.get("schema"))
+        return _apply_segment_flags(
+            BatchMapOperator(prog, "key", config.get("schema")), config)
     # identity: routing handled by edge schema key indices
-    return BatchMapOperator(lambda b: b, "key", config.get("schema"))
+    return _apply_segment_flags(
+        BatchMapOperator(lambda b: b, "key", config.get("schema")), config)

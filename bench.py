@@ -60,14 +60,25 @@ ON AuctionBids.window = MaxBids.window
    AND AuctionBids.num >= MaxBids.maxn;
 """
 
+# q1-shaped stateless chain (ISSUE 14): the currency conversion plus a
+# rounding normalization stage — filter -> project -> project -> sink
+# cast, which the planner chains into ONE task and the segment fusion
+# pass compiles into ONE dispatch per batch (4 per batch unfused). The
+# SEGSTATS line reports dispatches/batches from the arroyo_segment_*
+# counters; the nightly A/B child re-runs this with
+# ARROYO__ENGINE__SEGMENT_FUSION=0.
 Q1 = DDL + """
 CREATE TABLE sink (
   auction BIGINT, price_eur BIGINT, bidder BIGINT
 ) WITH (connector = 'blackhole', type = 'sink');
 INSERT INTO sink
-SELECT bid.auction as auction, bid.price * 100 / 121 as price_eur,
-       bid.bidder as bidder
-FROM nexmark WHERE bid IS NOT NULL;
+SELECT auction, price_eur, bidder FROM (
+  SELECT auction, price_eur - price_eur % 10 AS price_eur, bidder FROM (
+    SELECT bid.auction as auction, bid.price * 100 / 121 as price_eur,
+           bid.bidder as bidder
+    FROM nexmark WHERE bid IS NOT NULL
+  )
+);
 """
 
 Q7 = DDL + """
@@ -223,6 +234,40 @@ def child(events: int, backend: str, query: str = "q5",
     print(f"COMPILES {sum(p.get('compiles', 0) for p in progs.values())} "
           f"{sum(p.get('compile_s_total', 0.0) for p in progs.values()):.3f}",
           flush=True)
+    # fused segment runtime (ISSUE 14): stateless-chain dispatch count vs
+    # batches entering planned runs — 'SEGSTATS <dispatches> <batches>
+    # <max fused ops>' feeds dispatches_per_batch; with fusion off the
+    # same counters carry the per-operator dispatches the run pays
+    from arroyo_tpu.metrics import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    seg_disp = sum(
+        v for _l, v in snap.get("arroyo_segment_dispatches_total", [])
+    )
+    seg_batches = sum(
+        v for _l, v in snap.get("arroyo_segment_batches_total", [])
+    )
+    seg_ops = max(
+        (v for _l, v in snap.get("arroyo_segment_fused_ops", [])),
+        default=0,
+    )
+    print(f"SEGSTATS {int(seg_disp)} {int(seg_batches)} {int(seg_ops)}",
+          flush=True)
+    # per-segment ledger artifact (nightly CI uploads it on regression):
+    # the device observatory's per-segment dispatch stats + the raw
+    # segment counters of THIS child
+    ledger_path = os.environ.get("ARROYO_SEGMENT_LEDGER")
+    if ledger_path:
+        from arroyo_tpu.obs import device as obs_device
+
+        with open(ledger_path, "w") as f:
+            json.dump({
+                "query": query,
+                "segments": obs_device.summary()["segments"],
+                "seg_dispatches": int(seg_disp),
+                "seg_batches": int(seg_batches),
+                "recompiles": obs_device.summary()["recompiles"],
+            }, f, indent=1)
     lags = sorted(attribution.ACCOUNTING.lag_samples)
     if lags:
         p99 = lags[min(len(lags) - 1, int(0.99 * len(lags)))]
@@ -566,6 +611,7 @@ def run_child(events: int, backend: str, timeout: float, env=None,
     result = None
     stats = None
     compiles = None
+    segstats = None
     loop_lag = None
     for line in out.stdout.splitlines():
         if line.startswith("RESULT "):
@@ -578,6 +624,9 @@ def run_child(events: int, backend: str, timeout: float, env=None,
         elif line.startswith("COMPILES "):
             parts = line.split()
             compiles = (int(parts[1]), float(parts[2]))
+        elif line.startswith("SEGSTATS "):
+            parts = line.split()
+            segstats = tuple(int(p) for p in parts[1:])
         elif line.startswith("LOOPLAG "):
             parts = line.split()
             loop_lag = (float(parts[1]), int(parts[2]))
@@ -594,6 +643,13 @@ def run_child(events: int, backend: str, timeout: float, env=None,
             result["rows_combined"] = stats[5]
     if compiles is not None:
         result["compiles"], result["compile_s"] = compiles
+    if segstats is not None and len(segstats) >= 2 and segstats[1]:
+        result["seg_dispatches"], result["seg_batches"] = segstats[:2]
+        result["dispatches_per_batch"] = round(
+            segstats[0] / segstats[1], 3
+        )
+        if len(segstats) >= 3:
+            result["seg_fused_ops"] = segstats[2]
     if loop_lag is not None:
         result["loop_lag_ms_p99"], result["loop_lag_samples"] = loop_lag
     return result
@@ -807,6 +863,39 @@ def main():
             sides[f"{q}_warmup_eps"] = r["warmup_eps"]
         if r is not None and "compile_s" in r:
             sides[f"{q}_compile_s"] = r["compile_s"]
+        if q == "q1" and r is not None and "dispatches_per_batch" in r:
+            sides["q1_dispatches_per_batch"] = r["dispatches_per_batch"]
+            sides["q1_fused_ops"] = r.get("seg_fused_ops", 0)
+    # fused-segment A/B (ISSUE 14): re-run the q1 stateless chain with
+    # plan-time segment fusion OFF — same child, one env knob, always on
+    # the HOST tier (numpy + cpu env) so the pair is apples-to-apples
+    # even when the side metrics ran on the jax backend. The
+    # fused/unfused dispatches_per_batch pair pins the >=3x dispatch
+    # collapse; the eps pair is the fusion-on gain on this host.
+    seg_env = dict(cpu_env)
+    seg_env["ARROYO__ENGINE__SEGMENT_FUSION"] = "0"
+    r_off = run_median(args.events // 2, "numpy", args.timeout,
+                       env=seg_env, query="q1", n=args.repeats)
+    if r_off is not None:
+        sides["q1_fusion_off_eps"] = round(r_off["eps"], 1)
+        if "eps_runs" in r_off:
+            sides["q1_fusion_off_eps_runs"] = r_off["eps_runs"]
+        if "dispatches_per_batch" in r_off:
+            sides["q1_unfused_dispatches_per_batch"] = r_off[
+                "dispatches_per_batch"]
+    if side_backend != "numpy":
+        # the q1_eps side metric above ran on jax: add the host-tier
+        # fused reference so the fusion-on/off eps pair shares a backend
+        r_on = run_median(args.events // 2, "numpy", args.timeout,
+                          env=cpu_env, query="q1", n=args.repeats)
+        if r_on is not None:
+            sides["q1_fusion_on_eps"] = round(r_on["eps"], 1)
+            if "eps_runs" in r_on:
+                sides["q1_fusion_on_eps_runs"] = r_on["eps_runs"]
+            if "dispatches_per_batch" in r_on:
+                sides["q1_dispatches_per_batch"] = r_on[
+                    "dispatches_per_batch"]
+                sides["q1_fused_ops"] = r_on.get("seg_fused_ops", 0)
     # mesh execution path: q5 on an N-virtual-device CPU mesh (the
     # all_to_all + ShardedAccumulator path the dryrun only
     # correctness-checks). FULL headline event count: the mesh number

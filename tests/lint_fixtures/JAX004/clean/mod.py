@@ -1,0 +1,31 @@
+"""MUST NOT fire JAX004: a genuinely stateless fusable operator, plus a
+stateful operator that is (correctly) NOT registered fusable."""
+
+
+class PureMapOp:
+    fusable = True
+
+    def __init__(self, fn, name="map"):
+        self.fn = fn
+        self.name = name
+        self._seg_counters = None  # metric-handle memoization, not state
+
+    async def process_batch(self, batch, ctx, collector, input_index=0):
+        out = self.fn(batch)
+        if out is not None and out.num_rows:
+            await collector.collect(out)
+
+
+class WindowedOp:
+    # not fusable: free to keep state and checkpoint hooks
+    fusable = False
+
+    def __init__(self):
+        self._state = {}
+
+    def tables(self):
+        return {"w": object()}
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        table = await ctx.table("w")
+        table.put(0, self._state)

@@ -1,0 +1,25 @@
+"""MUST fire JAX004: a fusable (stateless-registered) operator that
+grows hidden state and participates in checkpoints."""
+
+
+class SneakyCountingOp:
+    fusable = True
+
+    def __init__(self):
+        self._state = {}
+
+    async def process_batch(self, batch, ctx, collector, input_index=0):
+        # hidden per-operator state: skips every barrier once fused
+        self._state["rows"] = self._state.get("rows", 0) + batch.num_rows
+        tm = ctx.table_manager  # reaching for the state tables
+        if tm is not None:
+            table = await ctx.table("t")
+            table.put("rows", self._state["rows"])
+        await collector.collect(batch)
+
+    def tables(self):
+        # checkpoint hook on a fusable operator
+        return {"t": object()}
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        pass

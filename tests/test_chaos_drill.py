@@ -68,6 +68,19 @@ def test_rescale_drill_exactly_once(tmp_path):
     assert (tmp_path / "autoscale_decisions.json").exists()
 
 
+def test_pipeline_drill_staged_batches_survive_kill(tmp_path):
+    """ISSUE 14 acceptance: a fused stateless segment with the two-deep
+    staging pipeline on takes a worker SIGKILL mid-flight — canonical
+    output byte-identical to the UNFUSED fault-free run (no staged event
+    lost or duplicated), and the runner.pipeline_drain spans prove a
+    barrier actually drained a staged batch."""
+    res = drill.run_pipeline_drill(seed=20260804, workdir=str(tmp_path))
+    assert res.passed, f"{res.error}\nextras: {res.extras}"
+    assert res.restarts >= 1
+    assert res.extras["pipeline_drain_staged_max"] >= 1
+    assert res.extras["barriers_with_staged"] >= 1
+
+
 def test_state_bloat_drill_flat_checkpoints(tmp_path):
     """ISSUE 8 acceptance (ROADMAP item 4): session state grows ~10x
     during the run, a worker is SIGKILLed mid-upload with storage

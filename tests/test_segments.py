@@ -1,0 +1,447 @@
+"""Fused segment runtime (ISSUE 14): plan-time fusion, host/vector/jax
+execution tiers, the double-buffered staging pipeline, and the barrier
+drain — every tier must be value-identical to the unfused per-operator
+plan, and the pipeline must be byte-order-identical at any depth."""
+
+import asyncio
+import json
+
+import pyarrow as pa
+import pytest
+
+from arroyo_tpu import obs
+from arroyo_tpu.config import update
+from arroyo_tpu.engine import Engine, segments
+from arroyo_tpu.engine.segments import (
+    FusedSegmentOperator,
+    SegmentFusionPass,
+    build_program,
+    plan_runs,
+)
+from arroyo_tpu.graph.logical import OperatorName
+from arroyo_tpu.metrics import REGISTRY
+from arroyo_tpu.sql import plan_query
+
+NEXMARK_DDL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '20000', message_count = '40000',
+  start_time = '0'
+);
+"""
+
+CHAIN_SQL = NEXMARK_DDL + """
+CREATE TABLE sink (
+  auction BIGINT, price_eur BIGINT, bidder BIGINT
+) WITH (connector = 'blackhole', type = 'sink');
+INSERT INTO sink
+SELECT auction, price_eur, bidder FROM (
+  SELECT auction, price_eur - price_eur % 10 AS price_eur, bidder FROM (
+    SELECT bid.auction as auction, bid.price * 100 / 121 as price_eur,
+           bid.bidder as bidder
+    FROM nexmark WHERE bid IS NOT NULL
+  )
+);
+"""
+
+PREVIEW_SQL = NEXMARK_DDL + """
+SELECT auction, price_eur - price_eur % 10 AS price_eur, bidder FROM (
+  SELECT bid.auction as auction, bid.price * 100 / 121 as price_eur,
+         bid.bidder as bidder
+  FROM nexmark WHERE bid IS NOT NULL
+);
+"""
+
+
+def canon(rows):
+    return sorted(json.dumps(r, sort_keys=True, default=str) for r in rows)
+
+
+def run_engine(sql, results=None, timeout=120):
+    plan = plan_query(sql, preview_results=results)
+
+    async def go():
+        eng = Engine(plan.graph).start()
+        await eng.join(timeout)
+
+    asyncio.run(go())
+    return plan
+
+
+def seg_counts():
+    snap = REGISTRY.snapshot()
+    disp = sum(
+        v for _l, v in snap.get("arroyo_segment_dispatches_total", [])
+    )
+    batches = sum(
+        v for _l, v in snap.get("arroyo_segment_batches_total", [])
+    )
+    return disp, batches
+
+
+# -- plan-time fusion --------------------------------------------------------
+
+
+def test_plan_fuses_stateless_run_into_one_segment():
+    with update(engine={"segment_fusion": True}):
+        plan = plan_query(CHAIN_SQL)
+    segs = [
+        op
+        for n in plan.graph.nodes.values()
+        for op in n.chain
+        if op.operator == OperatorName.FUSED_SEGMENT
+    ]
+    assert len(segs) == 1
+    # select + normalize + select + sink_cast
+    assert len(segs[0].config["ops"]) == 4
+    # no stray members left behind
+    assert not any(
+        op.config.get("segment_member")
+        for n in plan.graph.nodes.values()
+        for op in n.chain
+    )
+
+
+def test_fusion_off_annotates_members_for_ab_accounting():
+    with update(engine={"segment_fusion": False}):
+        plan = plan_query(CHAIN_SQL)
+    members = [
+        op
+        for n in plan.graph.nodes.values()
+        for op in n.chain
+        if op.config.get("segment_member")
+    ]
+    leads = [op for op in members if op.config.get("segment_lead")]
+    assert len(members) == 4 and len(leads) == 1
+    assert not any(
+        op.operator == OperatorName.FUSED_SEGMENT
+        for n in plan.graph.nodes.values()
+        for op in n.chain
+    )
+
+
+def test_single_value_op_runs_are_not_fused():
+    from arroyo_tpu.graph.logical import ChainedOp
+
+    chain = [
+        ChainedOp(OperatorName.CONNECTOR_SOURCE, {}),
+        ChainedOp(OperatorName.ARROW_VALUE, {}),
+        ChainedOp(OperatorName.TUMBLING_WINDOW_AGGREGATE, {}),
+        ChainedOp(OperatorName.ARROW_VALUE, {}),
+        ChainedOp(OperatorName.ARROW_VALUE, {}),
+    ]
+    assert plan_runs(chain) == [(3, 5)]
+
+
+def test_segment_config_json_round_trips_nested_op_lists():
+    """FUSED_SEGMENT configs nest member op dicts in a LIST — the config
+    (un)serializer must recurse through lists (StreamSchema and bytes
+    values inside member configs survive the round trip)."""
+    from arroyo_tpu.graph.logical import _config_json, _config_unjson
+    from arroyo_tpu.schema import StreamSchema
+
+    schema = StreamSchema(
+        pa.schema([pa.field("a", pa.int64()), pa.field("b", pa.float64())]),
+        (0,),
+    )
+    cfg = {
+        "ops": [
+            {"operator": "arrow_value",
+             "config": {"schema": schema, "blob": b"\x01\x02"},
+             "description": "select"},
+            {"operator": "arrow_key", "config": {}, "description": "key"},
+        ],
+        "schema": schema,
+    }
+    out = _config_unjson(json.loads(json.dumps(_config_json(cfg))))
+    assert out["ops"][0]["config"]["blob"] == b"\x01\x02"
+    rt = out["ops"][0]["config"]["schema"]
+    assert rt.schema.equals(schema.schema)
+    assert tuple(rt.key_indices) == (0,)
+    assert out["ops"][1] == {"operator": "arrow_key", "config": {},
+                             "description": "key"}
+
+
+# -- execution tiers ---------------------------------------------------------
+
+
+def test_fused_output_byte_identical_to_unfused():
+    outs = {}
+    for fusion in (True, False):
+        REGISTRY.reset()
+        with update(engine={"segment_fusion": fusion},
+                    tpu={"enabled": False}):
+            results = []
+            run_engine(PREVIEW_SQL, results)
+            outs[fusion] = results
+    assert len(outs[True]) == len(outs[False]) > 0
+    assert canon(outs[True]) == canon(outs[False])
+
+
+def test_dispatches_per_batch_collapse_at_least_3x():
+    dpb = {}
+    for fusion in (True, False):
+        REGISTRY.reset()
+        with update(engine={"segment_fusion": fusion},
+                    tpu={"enabled": False}):
+            run_engine(CHAIN_SQL)
+        disp, batches = seg_counts()
+        assert batches > 0
+        dpb[fusion] = disp / batches
+    assert dpb[True] == pytest.approx(1.0)
+    assert dpb[False] / dpb[True] >= 3.0
+
+
+def test_jax_tier_matches_host_tier():
+    """Whole-chain jit: one compiled program, identical output — incl.
+    null handling through the bid struct fields (non-bid rows)."""
+    outs = {}
+    for jax_on in (False, True):
+        REGISTRY.reset()
+        with update(
+            engine={"segment_fusion": True},
+            tpu={"enabled": jax_on, "require_accelerator": False},
+        ):
+            results = []
+            run_engine(PREVIEW_SQL, results)
+            outs[jax_on] = results
+            snap = REGISTRY.snapshot()
+            tiers = {
+                l.get("tier"): v.get("count", 0)
+                for l, v in snap.get("arroyo_segment_dispatch_seconds", [])
+            }
+        if jax_on:
+            assert tiers.get("jax", 0) > 0, tiers
+        else:
+            assert "jax" not in tiers
+    assert canon(outs[True]) == canon(outs[False])
+
+
+def test_jax_tier_recompiles_once_per_rung_change():
+    with update(
+        engine={"segment_fusion": True},
+        tpu={"enabled": True, "require_accelerator": False},
+    ):
+        plan = plan_query(CHAIN_SQL)
+        node = next(
+            n for n in plan.graph.nodes.values()
+            if any(op.operator == OperatorName.FUSED_SEGMENT
+                   for op in n.chain)
+        )
+        seg_cfg = next(
+            op for op in node.chain
+            if op.operator == OperatorName.FUSED_SEGMENT
+        )
+        op = FusedSegmentOperator(seg_cfg.config["ops"], None, "t")
+        prog = op._program()
+        assert prog is not None and op._use_jax
+        # two batch sizes inside one rung -> one signature; a bigger
+        # batch climbs the rung -> exactly one more compile. Real input
+        # batches are captured from one engine run.
+        batches = []
+        orig = FusedSegmentOperator.process_batch
+
+        async def cap(self, batch, ctx, collector, input_index=0):
+            batches.append(batch)
+            return await orig(self, batch, ctx, collector, input_index)
+
+        FusedSegmentOperator.process_batch = cap
+        try:
+            run_engine(CHAIN_SQL)
+        finally:
+            FusedSegmentOperator.process_batch = orig
+        assert batches
+        b = batches[0]
+        seen0 = len(prog.jit.seen) if prog.jit else 0
+        r1 = op._dispatch_jax(b.slice(0, min(100, b.num_rows)), prog)
+        r2 = op._dispatch_jax(b.slice(0, min(120, b.num_rows)), prog)
+        assert r1 is not None and r2 is not None
+        after_small = len(prog.jit.seen)
+        assert after_small == seen0 + 1  # both fit one rung: ONE signature
+        # climb: a batch past the rung compiles exactly once more
+        big = pa.concat_tables(
+            [pa.Table.from_batches([b])] * 6
+        ).combine_chunks().to_batches()[0]
+        r3 = op._dispatch_jax(big, prog)
+        assert r3 is not None
+        assert len(prog.jit.seen) == after_small + 1
+
+
+def test_vector_tier_filter_late_matches_view_tier():
+    """The numpy vector tier (filter-late over unfiltered leaves) must
+    equal the lazy-view tier batch for batch, including all-filtered
+    and no-predicate-hit batches."""
+    with update(engine={"segment_fusion": True}, tpu={"enabled": False}):
+        plan = plan_query(CHAIN_SQL)
+        node = next(
+            n for n in plan.graph.nodes.values()
+            if any(op.operator == OperatorName.FUSED_SEGMENT
+                   for op in n.chain)
+        )
+        seg_cfg = next(
+            op for op in node.chain
+            if op.operator == OperatorName.FUSED_SEGMENT
+        )
+        op = FusedSegmentOperator(seg_cfg.config["ops"], None, "t")
+        prog = op._program()
+        assert prog is not None and prog.exact
+        batches = []
+        orig = FusedSegmentOperator.process_batch
+
+        async def cap(self, batch, ctx, collector, input_index=0):
+            batches.append(batch)
+            return await orig(self, batch, ctx, collector, input_index)
+
+        FusedSegmentOperator.process_batch = cap
+        try:
+            run_engine(CHAIN_SQL)
+        finally:
+            FusedSegmentOperator.process_batch = orig
+        assert batches
+        for b in batches[:5]:
+            view = op._run_host(b)
+            vec = op._run_vector(b, prog)
+            assert vec is not b, "vector tier unexpectedly fell back"
+            if view is None:
+                assert vec is None
+            else:
+                assert view.equals(vec)
+
+
+# -- pipelining / staging ----------------------------------------------------
+
+
+def test_pipeline_depths_emit_identical_output():
+    """Staging engages on the jax tier (dispatched-but-unmaterialized
+    results); every depth must emit the SAME rows in the SAME order."""
+    outs = {}
+    for depth in (1, 2, 4):
+        REGISTRY.reset()
+        with update(engine={"segment_fusion": True,
+                            "pipeline_depth": depth},
+                    tpu={"enabled": True, "require_accelerator": False},
+                    pipeline={"source_batch_size": 128}):
+            results = []
+            run_engine(PREVIEW_SQL, results)
+            outs[depth] = [
+                json.dumps(r, sort_keys=True, default=str) for r in results
+            ]
+    # ORDER-identical, not just set-identical: staging is strictly FIFO
+    assert outs[1] == outs[2] == outs[4]
+
+
+def test_windowed_aggregate_downstream_of_segment_is_exact():
+    """Watermark hold/release: a tumbling aggregate fed by a fused
+    segment must see every pre-watermark row before the watermark (or
+    window counts would drop staged rows)."""
+    sql = NEXMARK_DDL + """
+    CREATE TABLE sink (a BIGINT, c BIGINT)
+    WITH (connector = 'blackhole', type = 'sink');
+    INSERT INTO sink
+    SELECT auction, count(*) FROM (
+      SELECT auction, price_eur FROM (
+        SELECT bid.auction as auction,
+               bid.price * 100 / 121 as price_eur
+        FROM nexmark WHERE bid IS NOT NULL
+      )
+    )
+    GROUP BY 1, tumble(interval '5 second');
+    """
+    outs = {}
+    for fusion in (True, False):
+        REGISTRY.reset()
+        # fused run on the jitted tier (staging + watermark hold really
+        # engage); unfused reference on the plain host kernels
+        tpu = ({"enabled": True, "require_accelerator": False}
+               if fusion else {"enabled": False})
+        with update(engine={"segment_fusion": fusion,
+                            "pipeline_depth": 2},
+                    tpu=tpu,
+                    pipeline={"source_batch_size": 128}):
+            plan = plan_query(sql)
+            segs = [
+                op for n in plan.graph.nodes.values() for op in n.chain
+                if op.operator == OperatorName.FUSED_SEGMENT
+            ]
+            if fusion:
+                assert segs, "chain did not fuse"
+            results = []
+            run_engine(sql, results)
+            outs[fusion] = results
+    assert canon(outs[True]) == canon(outs[False])
+
+
+def test_barrier_drain_records_pipeline_drain_span(tmp_storage):
+    """Checkpoint barriers drain the staging queue before capture and
+    record a runner.pipeline_drain span per barrier."""
+    from arroyo_tpu.engine.engine import Engine as EmbeddedEngine
+
+    obs.recorder().clear()
+    REGISTRY.reset()
+    with update(engine={"segment_fusion": True, "pipeline_depth": 2},
+                tpu={"enabled": False},
+                pipeline={"source_batch_size": 64}):
+        sql = NEXMARK_DDL.replace("20000", "4000").replace(
+            "40000", "20000") + """
+        SELECT auction, price_eur, bidder FROM (
+          SELECT auction, price_eur - price_eur % 10 AS price_eur,
+                 bidder FROM (
+            SELECT bid.auction as auction,
+                   bid.price * 100 / 121 as price_eur,
+                   bid.bidder as bidder
+            FROM nexmark WHERE bid IS NOT NULL
+          )
+        );
+        """
+        results = []
+        plan = plan_query(sql, preview_results=results)
+
+        async def go():
+            eng = EmbeddedEngine(plan.graph, job_id="seg-drain",
+                                 storage_url=tmp_storage).start()
+            done = asyncio.ensure_future(eng.join(120))
+            ck = 0
+            while not done.done() and ck < 3:
+                await asyncio.sleep(0.3)
+                if done.done():
+                    break
+                try:
+                    await eng.checkpoint_and_wait()
+                    ck += 1
+                except Exception:  # noqa: BLE001 - racing stream end
+                    break
+            await done
+
+        asyncio.run(go())
+    drains = [
+        s for s in obs.recorder().snapshot()
+        if s.get("name") == "runner.pipeline_drain"
+    ]
+    assert drains, "no runner.pipeline_drain span recorded at barriers"
+    assert all("staged" in s.get("attrs", {}) for s in drains)
+
+
+# -- metrics / observability -------------------------------------------------
+
+
+def test_segment_families_and_summary():
+    REGISTRY.reset()
+    with update(engine={"segment_fusion": True}, tpu={"enabled": False}):
+        run_engine(CHAIN_SQL)
+    from arroyo_tpu.obs import device as obs_device
+
+    summ = obs_device.summary()
+    assert summ["segments"], "device summary carries no segment ledger"
+    (name, entry), = list(summ["segments"].items())[:1] or [(None, None)]
+    assert name and name.startswith("segment.")
+    assert entry.get("fused_ops") == 4
+    assert entry.get("host_dispatches", 0) > 0
+
+
+def test_exposition_includes_segment_families():
+    REGISTRY.reset()
+    with update(engine={"segment_fusion": True}, tpu={"enabled": False}):
+        run_engine(CHAIN_SQL)
+    text = REGISTRY.expose()
+    assert "arroyo_segment_dispatch_seconds" in text
+    assert "arroyo_segment_fused_ops" in text
+    assert "arroyo_segment_dispatches_total" in text

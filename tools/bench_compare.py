@@ -120,6 +120,12 @@ def classify(metric: str) -> Optional[str]:
     if (metric.endswith("_false_positive_count")
             or metric.endswith("_wrong_values")):
         return "zero"
+    # fused segment runtime (ISSUE 14): stateless-chain dispatches per
+    # batch regress UPWARD — a segment silently splitting back into
+    # per-operator dispatches (or a new operator joining the chain
+    # unfused) shows up here before it shows up as an eps loss
+    if metric.endswith("_per_batch"):
+        return "lower"
     return None
 
 

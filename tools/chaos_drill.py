@@ -46,6 +46,15 @@
         byte-identical output AND ~flat checkpoint capture time +
         per-epoch delta bytes as state grows (<= 2x early-run medians;
         a full-snapshot design shows ~10x on both).
+
+    python tools/chaos_drill.py --pipeline
+        ISSUE 14 acceptance: a stateless chain fused into ONE segment
+        with the two-deep staging pipeline on, worker SIGKILL lands
+        while a batch is staged; requires byte-identical output vs the
+        UNFUSED fault-free run AND runner.pipeline_drain evidence that
+        a barrier actually drained a staged batch. (Every standard
+        drill is also a fused-vs-unfused A/B: clean references run with
+        segment fusion OFF, faulted runs keep the fused default.)
 """
 
 import argparse
@@ -81,6 +90,12 @@ def main() -> int:
                     help="also run the state-bloat drill: 10x state "
                     "growth + SIGKILL mid-upload; requires byte-identical "
                     "output and ~flat capture time / delta bytes")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="also run the fused-pipeline drill: a stateless "
+                    "chain fused into one segment with two-deep staging, "
+                    "SIGKILL mid-flight; requires byte-identical output "
+                    "vs the UNFUSED clean run and proof that a barrier "
+                    "drained a staged batch")
     ap.add_argument("--plan", type=str, default="",
                     help="run the drill under a serialized FaultPlan JSON "
                     "(bare plan or a model-check counterexample payload "
@@ -139,6 +154,12 @@ def main() -> int:
         results.append(
             d.run_state_bloat_drill(
                 args.seed, os.path.join(workdir, "state-bloat")
+            )
+        )
+    if args.pipeline:
+        results.append(
+            d.run_pipeline_drill(
+                args.seed, os.path.join(workdir, "pipeline")
             )
         )
 

@@ -98,9 +98,14 @@ def child(events: int, mesh: int, linger: float) -> None:
         # budget so the mesh refactor has a before/after ledger
         from arroyo_tpu.obs import device as obs_device
 
+        summ = obs_device.summary()
         print("DEVICE " + json.dumps({
-            "programs": obs_device.summary()["programs"],
-            "padding_waste": obs_device.summary()["padding_waste"],
+            "programs": summ["programs"],
+            "padding_waste": summ["padding_waste"],
+            # fused segment runtime (ISSUE 14): per-segment dispatch
+            # stats by tier + fused-op counts, so the BASELINE ledger
+            # carries a per-segment row set next to the device programs
+            "segments": summ["segments"],
         }), flush=True)
         print(f"RESULT {events / dt:.1f} 0 {dt:.2f}", flush=True)
         if linger > 0:
@@ -330,6 +335,26 @@ def main() -> int:
                 for w in waste:
                     print(f"| {w['program']} | {w['rung']} "
                           f"| {100.0 * w['waste']:.1f}% |")
+            segs = device.get("segments", {})
+            if segs:
+                # per-segment ledger (ISSUE 14): one row per fused
+                # segment program — how many operator dispatches each
+                # batch no longer pays, and what the single dispatch
+                # costs per tier
+                print("\n| segment | fused ops | tier | dispatches "
+                      "| total s | p50/p95 |")
+                print("|---|---|---|---|---|---|")
+                for name, s in sorted(segs.items()):
+                    for tier in ("host", "jax"):
+                        n = s.get(f"{tier}_dispatches")
+                        if not n:
+                            continue
+                        q = s.get(f"{tier}_quantiles", {})
+                        print(f"| {name} | {s.get('fused_ops', '?')} "
+                              f"| {tier} | {n} "
+                              f"| {s.get(f'{tier}_s_total', 0)} "
+                              f"| {q.get('p50', 'n/a')}/"
+                              f"{q.get('p95', 'n/a')} s |")
     return 0
 
 

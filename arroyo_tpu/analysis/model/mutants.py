@@ -147,6 +147,24 @@ MUTANTS: Dict[str, Mutant] = {
                         mutant="no_fence_check"),
         ),
         Mutant(
+            name="overlap_double_emission",
+            description=(
+                "generation-overlap rescale invariant (ISSUE 15): the "
+                "new incarnation is prepared against the last PUBLISHED "
+                "manifest while the old incarnation drains its final "
+                "epoch, and activation must advance the restore to the "
+                "durable rescale checkpoint (the stop epoch) before "
+                "releasing sources. The mutant activates at the PREPARED "
+                "epoch instead — sources rewind behind the stop epoch "
+                "and the new generation re-seals output the old "
+                "generation already committed: the same epoch becomes "
+                "visible under two generations."
+            ),
+            expect_violation=VIOLATIONS.OVERLAP_EMIT,
+            config=_cfg(epochs=1, inflight=2, rescales=1, overlap=1,
+                        mutant="overlap_double_emission"),
+        ),
+        Mutant(
             name="serve_reads_unpublished_epoch",
             description=(
                 "StateServe invariant (ISSUE 12): queryable-state reads "

@@ -67,6 +67,9 @@ HANDLER_BINDINGS: Dict[str, Tuple[str, str]] = {
     "ctrl.stop_checkpoint": ("controller/controller.py", "_checkpoint_inner"),
     "ctrl.publish_epoch": ("controller/controller.py", "_publish_epoch"),
     "ctrl.rescale": ("controller/controller.py", "_rescale"),
+    "ctrl.overlap_prepare": ("controller/controller.py", "_overlap_prepare"),
+    "ctrl.overlap_activate": ("controller/controller.py",
+                              "_overlap_activate"),
     "ctrl.recover": ("controller/controller.py", "_recover"),
     "ctrl.schedule": ("controller/controller.py", "_schedule_inner"),
     "worker.capture": ("operators/runner.py", "_checkpoint_chain"),
@@ -112,6 +115,14 @@ TRANSITION_HANDLERS: Dict[str, Tuple[str, ...]] = {
     "rescale.begin": ("ctrl.rescale",),
     "rescale.barrier": ("ctrl.rescale", "ctrl.stop_checkpoint"),
     "rescale.reschedule": ("ctrl.rescale", "storage.new_generation"),
+    # generation-overlap rescale (ISSUE 15): the new incarnation is
+    # PREPARED (workers acquired, program built, state restored
+    # read-only) while the old incarnation drains its final epoch, then
+    # ACTIVATED — claiming the fresh generation and resuming from the
+    # durable rescale checkpoint — once that epoch published and the old
+    # generation settled. RESCALING -> RUNNING, never through SCHEDULING.
+    "overlap.prepare": ("ctrl.rescale", "ctrl.overlap_prepare"),
+    "overlap.activate": ("ctrl.overlap_activate", "storage.new_generation"),
     "w.capture": ("worker.capture", "worker.admit_flush",
                   "state.capture_tables"),
     "w.flush": ("worker.flush", "state.flush_tables"),
@@ -154,6 +165,7 @@ class ModelConfig(NamedTuple):
     faults: int = 1           # total fault-event budget
     restarts: int = 2         # controller max_restarts analog
     rescales: int = 0         # rescale-request budget (0 or 1)
+    overlap: int = 0          # 1 = rescales use the generation-overlap path
     reads: int = 0            # StateServe reader-actor event budget
     fault_kinds: Tuple[str, ...] = FAULT_KINDS
     mutant: str = ""          # mutants.py flag (empty == faithful model)
@@ -186,6 +198,10 @@ class CtrlS(NamedTuple):
     stop_epoch: int = 0
     rescale: int = 0          # 0 none, 1 requested, 2 stop barrier in flight
     rescaled: bool = False    # overrides applied (survives recovery)
+    # generation-overlap rescale: 1 = the new incarnation is prepared
+    # (restored read-only at prep_epoch) while the old one drains
+    overlap: int = 0
+    prep_epoch: int = -1      # published epoch the prepared restore used
     failure: str = ""         # latest failure reason (trace readability)
 
 
@@ -242,6 +258,10 @@ class _V:
     DEADLOCK = "deadlock"
     STUCK = "non-terminal-state-cannot-terminate"
     SERVE = "serve-read-inconsistent"
+    # generation-overlap rescale: a sink sealed an epoch another
+    # generation already made visible — the new incarnation resumed
+    # behind the durable rescale checkpoint and re-emitted its output
+    OVERLAP_EMIT = "epoch-emitted-by-both-generations"
 
 
 VIOLATIONS = _V
@@ -309,6 +329,9 @@ class Model:
             s, label, "RECOVERING",
             failure=reason, stop=(1 if s.ctrl.stop else 0), rescale=0,
             stop_epoch=0, pending=(), reports=(),
+            # a failed overlap discards the prepared incarnation: it
+            # restored read-only and claimed nothing durable
+            overlap=0, prep_epoch=-1,
         )
         return Step(label, (reason,), st.nxt, st.violation)
 
@@ -474,6 +497,18 @@ class Model:
             elif ctrl.rescale == 1:
                 out.append(self._barrier(s, "rescale.barrier", rescale=2))
             elif ctrl.rescale == 2:
+                if cfg.overlap and ctrl.overlap == 0:
+                    # overlap window: prepare the new incarnation (acquire
+                    # workers, build, restore read-only from the last
+                    # PUBLISHED manifest) while the old one drains the
+                    # stop epoch. Claims nothing durable — a failure
+                    # anywhere discards it for free.
+                    out.append(Step(
+                        "overlap.prepare", (s.store.latest,),
+                        s._replace(ctrl=ctrl._replace(
+                            overlap=1, prep_epoch=s.store.latest,
+                        )),
+                    ))
                 out.extend(self._rescale_wait_steps(s))
 
         for widx, w in enumerate(s.workers):
@@ -639,6 +674,9 @@ class Model:
                     applied._replace(faults=applied.faults + 1),
                     "fault.reschedule_fail", "rescale-reschedule-fail",
                 ))
+            if applied.ctrl.overlap == 1:
+                out.append(self._overlap_activate(applied))
+                return out
             torn = self._teardown(applied)
             newgen = torn.store.gen + 1
             torn = torn._replace(
@@ -652,6 +690,52 @@ class Model:
             )
             out.append(self._move(torn, "rescale.reschedule", "SCHEDULING"))
         return out
+
+    def _overlap_activate(self, s: Sys) -> Step:
+        """Generation-overlap activation: the prepared incarnation claims
+        the fresh generation and resumes FROM THE DURABLE RESCALE
+        CHECKPOINT (store.latest — the stop epoch it watched publish),
+        promoting RESCALING -> RUNNING without a SCHEDULING pass. Like a
+        restore, it idempotently replays every claimed epoch's commit
+        from its manifest (the old incarnation's sealed sinks may have
+        died post-publish, pre-commit). The `overlap_double_emission`
+        mutant activates at the PREPARED epoch instead — skipping the
+        stop epoch's chain replay — so its sources rewind behind output
+        the old generation already made visible."""
+        base = (s.ctrl.prep_epoch
+                if self.cfg.mutant == "overlap_double_emission"
+                else s.store.latest)
+        torn = self._teardown(s)
+        newgen = torn.store.gen + 1
+        # restore-time commit replay (same rule as ctrl.schedule): every
+        # claimed epoch's manifest commit becomes visible exactly once
+        finalized = torn.finalized
+        mgens = dict(torn.store.manifests)
+        for e in torn.store.claimed:
+            g = mgens.get(e)
+            if g is None:
+                continue
+            clash = [g2 for (e2, g2) in finalized if e2 == e and g2 != g]
+            if clash:
+                return Step("overlap.activate", (), None,
+                            f"{_V.DOUBLE_COMMIT}: overlap restore replayed "
+                            f"epoch {e} under gen {g} over gen {clash[0]}")
+            finalized = _sorted_add(finalized, (e, g))
+        torn = torn._replace(
+            finalized=finalized,
+            workers=tuple(WorkerS(gen=newgen)
+                          for _ in range(len(s.workers))),
+            store=torn.store._replace(
+                gen=newgen,
+                gen_base=torn.store.gen_base + ((newgen, base),),
+            ),
+            ctrl=torn.ctrl._replace(
+                gen=newgen, rescale=0, stop_epoch=0, overlap=0,
+                prep_epoch=-1, epoch=base, epoch_budget=self.cfg.epochs,
+                pending=(), reports=(), finished=(), failure="",
+            ),
+        )
+        return self._move(torn, "overlap.activate", "RUNNING")
 
     def _teardown(self, s: Sys) -> Sys:
         """Force-stop every worker. A blacked-out (presumed-dead but
@@ -680,6 +764,7 @@ class Model:
             ctrl=torn.ctrl._replace(
                 gen=newgen, restarts=ctrl.restarts + 1,
                 pending=(), reports=(), finished=(), rescale=0, stop_epoch=0,
+                overlap=0, prep_epoch=-1,
             ),
         )
         return self._move(torn, "ctrl.recover", "SCHEDULING")
@@ -730,6 +815,23 @@ class Model:
                                         w._replace(inbox=w.inbox[1:])),
                     ))
                 elif len(w.captured) < cfg.inflight:
+                    emitted_by_other_gen = [
+                        g for (e2, g) in s.finalized
+                        if e2 == epoch and g != w.gen
+                    ]
+                    if is_sink(widx) and emitted_by_other_gen:
+                        # generation-overlap invariant (ISSUE 15): a sink
+                        # sealing an epoch ANOTHER generation already made
+                        # visible means the incarnation resumed behind the
+                        # durable rescale checkpoint and is re-emitting
+                        # committed output
+                        out.append(Step(
+                            "w.capture", (widx, epoch), None,
+                            f"{_V.OVERLAP_EMIT}: gen {w.gen} sealed epoch "
+                            f"{epoch} already visible under gen "
+                            f"{emitted_by_other_gen[0]}",
+                        ))
+                        return out
                     nw = w._replace(
                         inbox=w.inbox[1:],
                         seen_barrier=epoch,

@@ -31,24 +31,45 @@ from typing import Callable, Dict, List, Optional, Protocol
 from .signals import OperatorSignals
 
 
+# source connectors whose offset state repartitions (split/merge at the
+# checkpoint boundary — connectors/splits.py): the actuator can change
+# their parallelism without gap or replay. Kafka's offsets are split-
+# keyed too, but its partition count is broker-side and unknowable here,
+# so it stays out of AUTOMATIC source scaling.
+ELASTIC_SOURCE_CONNECTORS = frozenset({"impulse", "nexmark"})
+
+
 @dataclasses.dataclass
 class Topology:
     """The policy's view of the job DAG: node ids in topological order,
     upstream adjacency, current parallelism, and which nodes the actuator
-    may scale (sources and sinks keep their planned parallelism — source
-    splits and sink fan-in are externally constrained, matching
-    LogicalGraph.set_parallelism(internal_only=True))."""
+    may scale. Sinks keep their planned parallelism (sink fan-in is
+    externally constrained); sources are scalable exactly when their
+    connector's split state repartitions (ISSUE 15 — impulse/nexmark
+    offset splits subdivide at the checkpoint boundary, so DS2 source
+    targets are actuable instead of refused)."""
 
     order: List[int]
     upstream: Dict[int, List[int]]
     current: Dict[int, int]
     scalable: Dict[int, bool]
+    source: Dict[int, bool] = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_graph(cls, graph) -> "Topology":
         nodes = graph.topo_order()
 
         def _scalable(n) -> bool:
+            # sources: scalable iff the connector's offset state is
+            # repartitionable (split elasticity); the policy additionally
+            # gates actuation on autoscale.scale_sources
+            if n.is_source:
+                return (
+                    n.head.config.get("connector")
+                    in ELASTIC_SOURCE_CONNECTORS
+                )
+            if n.is_sink:
+                return False
             # only nodes whose every input is KEY-partitioned are safe to
             # rescale: their state re-reads by key range on restore and
             # their shuffle re-partitions by the same hash. Unkeyed inputs
@@ -56,8 +77,6 @@ class Topology:
             # benefit) or a global accumulator that MUST stay at its
             # planned parallelism — the planner encodes that constraint
             # only through the edge keys, so respect it
-            if n.is_source or n.is_sink:
-                return False
             in_edges = graph.in_edges(n.node_id)
             return bool(in_edges) and all(
                 getattr(e.schema, "key_indices", None) for e in in_edges
@@ -71,6 +90,7 @@ class Topology:
             },
             current={n.node_id: n.parallelism for n in nodes},
             scalable={n.node_id: _scalable(n) for n in nodes},
+            source={n.node_id: n.is_source for n in nodes},
         )
 
 
@@ -109,12 +129,68 @@ class DS2Policy:
         demand_out: Dict[int, float] = {}
         targets: Dict[int, int] = {}
         reasons: Dict[int, str] = {}
+
+        def gate(nid: int, cur: int, target: int, reason: str) -> None:
+            # hysteresis dead band, then per-step cap, then hard clamps
+            # (clamps last and unconditional: min_parallelism must win)
+            if target != cur and cur > 0 and (
+                abs(target - cur) / cur <= cfg.hysteresis
+            ):
+                target, reason = cur, ""
+            if target > cur:
+                target = min(target, math.ceil(cur * cfg.scale_factor_cap))
+            elif target < cur:
+                target = max(target, max(1, math.floor(
+                    cur / cfg.scale_factor_cap)))
+            clamped = min(max(target, cfg.min_parallelism),
+                          cfg.max_parallelism)
+            if clamped != cur and not reason:
+                reason = (
+                    f"clamped to [{cfg.min_parallelism}, "
+                    f"{cfg.max_parallelism}]: {cur} -> {clamped}"
+                )
+            targets[nid] = clamped
+            if clamped != cur and reason:
+                reasons[nid] = reason
+
         for nid in topo.order:
             sig = signals.get(nid)
             cur = topo.current.get(nid, 1)
-            if sig is None or not topo.scalable.get(nid, False) or not topo.upstream.get(nid):
-                # sources (no upstream) seed the demand with their observed
-                # output; unscalable/unobserved nodes pass demand through
+            if not topo.upstream.get(nid):
+                # sources seed the demand with their observed output
+                demand_out[nid] = sig.output_rate if sig else 0.0
+                if (sig is None
+                        or not topo.scalable.get(nid, False)
+                        or not getattr(cfg, "scale_sources", False)):
+                    targets[nid] = cur
+                    continue
+                # source elasticity (ISSUE 15): a source has no upstream
+                # demand to propagate, so size it from its own busy
+                # ratio — generation/ingest time over wall time. Busy at
+                # busy_high means the source cannot hold wall pace at
+                # this parallelism (the split repartition makes the
+                # target actuable); deep idleness consolidates splits
+                # back toward the utilization band.
+                busy = sig.busy_ratio if sig.busy_ratio is not None else 0.0
+                if busy >= cfg.busy_high:
+                    target = math.ceil(cur * cfg.saturation_step)
+                    reason = (
+                        f"source busy {busy:.2f} >= {cfg.busy_high}: "
+                        f"{cur} -> {target}"
+                    )
+                elif busy <= cfg.busy_low and cur > 1:
+                    target = max(1, math.ceil(
+                        cur * busy / max(cfg.busy_high, 1e-9)))
+                    reason = (
+                        f"source busy {busy:.2f} <= {cfg.busy_low}: "
+                        f"{cur} -> {target}"
+                    )
+                else:
+                    target, reason = cur, ""
+                gate(nid, cur, target, reason)
+                continue
+            if sig is None or not topo.scalable.get(nid, False):
+                # unscalable/unobserved nodes pass demand through
                 targets[nid] = cur
                 demand_out[nid] = sig.output_rate if sig else 0.0
                 continue
@@ -152,28 +228,7 @@ class DS2Policy:
                 )
             else:
                 target, reason = cur, ""
-            # hysteresis dead band, then per-step cap, then hard clamps
-            # (clamps last and unconditional: min_parallelism must win)
-            if target != cur and cur > 0 and (
-                abs(target - cur) / cur <= cfg.hysteresis
-            ):
-                target, reason = cur, ""
-            if target > cur:
-                target = min(target, math.ceil(cur * cfg.scale_factor_cap))
-            elif target < cur:
-                target = max(target, max(1, math.floor(
-                    cur / cfg.scale_factor_cap)))
-            clamped = min(max(target, cfg.min_parallelism),
-                          cfg.max_parallelism)
-            if clamped != cur and not reason:
-                reason = (
-                    f"clamped to [{cfg.min_parallelism}, "
-                    f"{cfg.max_parallelism}]: {cur} -> {clamped}"
-                )
-            target = clamped
-            targets[nid] = target
-            if target != cur and reason:
-                reasons[nid] = reason
+            gate(nid, cur, target, reason)
             # demand the downstream sees if this operator were scaled to
             # keep up: its full input demand times its selectivity
             demand_out[nid] = demand_in * sig.selectivity

@@ -66,10 +66,21 @@ def fire(point: str, **ctx) -> Optional[FaultSpec]:
 def install_from_config() -> Optional[FaultPlan]:
     """Install a plan from `chaos.plan` config (inline JSON or a JSON file
     path) if one is configured and none is installed yet. Idempotent;
-    returns the installed plan (or the existing one)."""
+    returns the installed plan (or the existing one).
+
+    Incarnation dedupe (carried robustness bug): a RESPAWNED worker
+    process (ARROYO_CHAOS_SPAWN_GEN > 0, stamped by the process
+    scheduler) does NOT re-arm the plan — each respawn used to get fresh
+    hit/fire counters, turning a heartbeat-hit worker.kill into a kill
+    LOOP that ground the job down to a prefix of its output. A plan that
+    genuinely wants per-incarnation re-arming opts in with
+    `"rearm": true` in its JSON."""
     global _PLAN
     if _PLAN is not None:
         return _PLAN
+    import json as _json
+    import os as _os
+
     from ..config import config
 
     raw = (config().chaos.plan or "").strip()
@@ -80,6 +91,21 @@ def install_from_config() -> Optional[FaultPlan]:
     else:
         with open(raw) as f:
             text = f.read()
+    spawn_gen = int(_os.environ.get("ARROYO_CHAOS_SPAWN_GEN", "0") or 0)
+    if spawn_gen > 0:
+        try:
+            rearm = bool(_json.loads(text).get("rearm"))
+        except Exception:  # noqa: BLE001 - malformed plans fail below anyway
+            rearm = False
+        if not rearm:
+            from ..utils.logging import get_logger
+
+            get_logger("chaos").warning(
+                "chaos plan NOT re-armed in respawned worker "
+                "(spawn generation %d); set \"rearm\": true to override",
+                spawn_gen,
+            )
+            return None
     plan = FaultPlan.from_json(text)
     if not plan.seed:
         plan.seed = int(config().chaos.seed or 0)

@@ -339,9 +339,11 @@ def run_drills(query_names, seed: int, workdir: str,
 def rescale_plan(seed: int) -> FaultPlan:
     """Faults aimed at the autoscaler's actuation path: stretch the
     decide->stop window, SIGKILL a worker inside it (the stop checkpoint
-    fails, the job recovers, the autoscaler re-decides), then fail the
-    job between a LATER rescale's durable stop checkpoint and its
-    reschedule (recovery must come back at the new parallelism). Every
+    fails, the job recovers, the autoscaler re-decides), then — on the
+    rescale that survives to the generation-OVERLAP window (stop
+    checkpoint durable, old generation draining, new incarnation staged
+    and restoring) — SIGKILL a pool worker INSIDE that window and fail
+    the promote (recovery must come back at the new parallelism). Every
     rescale.* fault implies a rescale actually triggered."""
     rng = random.Random(int(seed))
     plan = FaultPlan(seed)
@@ -351,10 +353,110 @@ def rescale_plan(seed: int) -> FaultPlan:
     # kill around the first rescale decision (~0.9s in) so it interrupts
     # the decide/stop window the delay above holds open
     plan.add("worker.kill", at_hits=(rng.randint(16, 26),))
+    # the first rescale to reach the overlap window (the staged new
+    # incarnation is restoring, the old one draining): SIGKILL a pool
+    # worker right there — byte-identical output is still required
+    plan.add("rescale.overlap_kill", at_hits=(1,))
     # always the FIRST reschedule attempt: a rescale that survives the
     # kill may be the only one (min==max converges in a single step)
     plan.add("rescale.reschedule_fail", at_hits=(1,))
     return plan
+
+
+def _measure_rescale_gap(mode: str, workdir: str,
+                         timeout: float = 90.0) -> dict:
+    """Output-gap probe (ISSUE 15): run a fault-free replay-impulse
+    windowed pipeline, trigger ONE manual 1->2 rescale (source + window —
+    the elastic-source path), and measure the RESCALING -> RUNNING wall
+    time from the job's transition log plus the `rescale.overlap` span's
+    own gap_ms. `mode` pins rescale.mode, so the same probe measures the
+    generation-overlap path AND the stop-the-world baseline."""
+    import asyncio as aio
+
+    from .. import obs
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    os.makedirs(workdir, exist_ok=True)
+    out = os.path.join(workdir, f"gap-{mode}.json")
+    n = 4000
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '2000',
+      message_count = '{n}', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, start TIMESTAMP, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{out}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, window.start as start, cnt FROM (
+      SELECT counter % 4 as k, tumble(interval '500 millisecond') as window,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+    async def go():
+        with update(pipeline={"checkpointing": {"interval": 0.25}},
+                    rescale={"mode": mode}):
+            obs.reset()
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    f"gap-{mode}", sql=sql,
+                    storage_url=os.path.join(workdir, f"gap-{mode}-ck"),
+                    n_workers=2, parallelism=1,
+                )
+                await c.wait_for_state(f"gap-{mode}", JobState.RUNNING,
+                                       timeout=30)
+                await aio.sleep(0.8)
+                job = c.jobs[f"gap-{mode}"]
+                targets = {
+                    nid: 2 for nid, nd in job.graph.nodes.items()
+                    if not nd.is_sink
+                }
+                await c.rescale_job(f"gap-{mode}", targets)
+                state = await c.wait_for_state(
+                    f"gap-{mode}", JobState.FINISHED, JobState.FAILED,
+                    timeout=timeout,
+                )
+                events = list(job.events)
+                spans = [
+                    dict(s.get("attrs", {}))
+                    for s in obs.recorder().snapshot()
+                    if s.get("name") == "rescale.overlap"
+                ]
+                return state, job.failure, job.rescales, events, spans
+            finally:
+                await c.stop()
+
+    state, failure, rescales, events, spans = asyncio.run(go())
+    # RESCALING-entry -> back-to-RUNNING from the transition log: the
+    # comparable gap measure across both modes (covers drain + stop
+    # checkpoint + handoff; sources resume right after RUNNING)
+    gaps = []
+    t_resc = None
+    for e in events:
+        if e["to"] == "Rescaling":
+            t_resc = e["time"]
+        elif e["to"] == "Running" and t_resc is not None:
+            gaps.append((e["time"] - t_resc) / 1e6)
+            t_resc = None
+    span_gaps = sorted(float(s["gap_ms"]) for s in spans if "gap_ms" in s)
+    return {
+        "mode": mode,
+        "finished": str(state),
+        "failure": failure,
+        "rescales": rescales,
+        "rescaling_to_running_ms": [round(g, 1) for g in sorted(gaps)],
+        "overlap_gap_ms_p50": round(
+            span_gaps[len(span_gaps) // 2], 1) if span_gaps else None,
+        "overlap_gap_ms_max": round(span_gaps[-1], 1) if span_gaps else None,
+    }
 
 
 def run_rescale_drill(seed: int, workdir: str,
@@ -473,6 +575,29 @@ def run_rescale_drill(seed: int, workdir: str,
         if s.get("name") == "runner.pipeline_drain"
     ]
     drain_ms = sorted(s["dur"] / 1000.0 for s in drains)
+    # output-gap-per-rescale probes (ISSUE 15): a fault-free 1->2
+    # source+window rescale per mode — the generation-overlap gap
+    # (rescale.overlap span, checkpoint interval 0.25s) with the
+    # stop-the-world teardown+reschedule baseline recorded alongside
+    gap_overlap = gap_stw = None
+    gap_error = None
+    try:
+        gap_overlap = _measure_rescale_gap(
+            "overlap", os.path.join(workdir, "gap"))
+        gap_stw = _measure_rescale_gap(
+            "stop_the_world", os.path.join(workdir, "gap"))
+        if "FINISHED" not in gap_overlap["finished"]:
+            gap_error = f"overlap gap probe: {gap_overlap['failure']}"
+        elif gap_overlap["rescales"] < 1:
+            gap_error = "overlap gap probe: no rescale happened"
+        elif gap_overlap["overlap_gap_ms_p50"] is None:
+            gap_error = "overlap gap probe: no rescale.overlap span"
+        elif "FINISHED" not in gap_stw["finished"]:
+            gap_error = f"stop-the-world gap probe: {gap_stw['failure']}"
+    except Exception as e:  # noqa: BLE001 - probe failure fails the drill
+        gap_error = f"gap probe crashed: {e!r}"
+    if error is None and gap_error is not None:
+        error, passed = gap_error, False
     return DrillResult(
         query=f"rescale_{query_name}",
         seed=seed,
@@ -494,6 +619,8 @@ def run_rescale_drill(seed: int, workdir: str,
                 (int(s.get("attrs", {}).get("staged", 0)) for s in drains),
                 default=0,
             ),
+            "rescale_gap_overlap": gap_overlap,
+            "rescale_gap_stop_the_world": gap_stw,
         },
     )
 

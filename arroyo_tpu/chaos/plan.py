@@ -87,6 +87,13 @@ FAULT_POINTS: Dict[str, str] = {
         "come back at the NEW parallelism from that checkpoint, "
         "exactly once)"
     ),
+    "rescale.overlap_kill": (
+        "controller/controller.py _overlap_activate — SIGKILL-equivalent "
+        "teardown of a pool worker INSIDE the generation-overlap window "
+        "(old generation draining its final epoch, new generation staged "
+        "and restoring); the rescale must recover at the new parallelism "
+        "with byte-identical output"
+    ),
     # operator runner (operators/runner.py)
     "runner.stall": (
         "operators/runner.py TaskRunner._handle_input_item — hold the "

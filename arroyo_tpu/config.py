@@ -490,6 +490,39 @@ class AutoscaleConfig:
     saturation_step: float = 2.0
     # per-job decision audit entries kept in memory (REST + /debug surface)
     decision_history: int = 256
+    # source elasticity (ISSUE 15): when on, DS2 source targets are
+    # computed AND actuated for connectors with repartitionable split
+    # state (impulse, nexmark — offset splits subdivide at the checkpoint
+    # boundary; kafka re-keys offsets per partition but its partition
+    # count is broker-side, so it stays out of automatic source scaling).
+    # Off restores the pre-ISSUE-15 behavior: sources keep their planned
+    # split count and the policy never targets them.
+    scale_sources: bool = True
+
+
+@dataclasses.dataclass
+class RescaleConfig:
+    """Zero-downtime rescale (ISSUE 15). The generation-overlap path
+    stages the NEW incarnation — worker acquisition, program build, state
+    restore from the durable rescale checkpoint — while the OLD
+    incarnation drains its final epoch, then promotes it in place
+    (RESCALING -> RUNNING, no stop-the-world teardown+reschedule), so the
+    output gap per rescale drops from a full teardown+restore cycle to
+    roughly one checkpoint interval. Modeled first in
+    analysis/model/spec.py (overlap.prepare / overlap.activate, the
+    epoch-emitted-by-both-generations invariant, and the
+    overlap_double_emission mutant)."""
+
+    # "overlap" stages + promotes the new incarnation while the old one
+    # drains (requires a pooled multiplexed worker set — the default
+    # embedded/process shape; other schedulers fall back automatically);
+    # "stop_the_world" forces the legacy stop-checkpoint -> teardown ->
+    # reschedule path everywhere.
+    mode: str = "overlap"
+    # seconds the overlap prepare (worker acquisition + staged start of
+    # the new incarnation) may take before the rescale falls back to a
+    # recovery reschedule at the new parallelism
+    prepare_timeout: float = 60.0
 
 
 @dataclasses.dataclass
@@ -628,7 +661,8 @@ class Config:
     flushes, spill tier), serve (queryable-state serving tier),
     autoscale (closed-loop parallelism control), watch (metric history
     + SLO engine), tls, chaos (fault injection), obs (flight recorder), tpu (device
-    kernels + mesh), controller, cluster (shared worker pool /
+    kernels + mesh), controller, rescale (generation-overlap
+    zero-downtime rescale), cluster (shared worker pool /
     multiplexing), admission (tenant quotas + fair slot scheduling),
     worker, api, admin, database, logging. `tools/lint.py
     --config-table` prints the full resolved key/default table;
@@ -645,6 +679,7 @@ class Config:
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
+    rescale: RescaleConfig = dataclasses.field(default_factory=RescaleConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)

@@ -24,6 +24,7 @@ import pyarrow as pa
 from ..operators.base import SourceFinishType, SourceOperator
 from ..schema import StreamSchema
 from ..types import now_nanos
+from . import splits as splits_mod
 from .base import ConnectionSchema, Connector, register_connector
 
 IMPULSE_SCHEMA = StreamSchema.from_fields(
@@ -47,7 +48,18 @@ class ImpulseSource(SourceOperator):
         self.realtime = realtime
         self.replay = replay
         self.out_schema = IMPULSE_SCHEMA
-        self.counter = 0
+        # owned splits (ISSUE 15 source elasticity): counter progressions
+        # {emit, next, step, hi} keyed by split id — offset state is
+        # checkpointed per SPLIT, so the autoscaler can repartition this
+        # source at any checkpoint boundary (connectors/splits.py)
+        self.splits: dict = {}
+
+    @property
+    def counter(self) -> int:
+        """Legacy single-split view (tests/bench introspection): the
+        lowest unemitted counter across owned splits."""
+        nxt = [int(p["next"]) for p in self.splits.values()]
+        return min(nxt) if nxt else 0
 
     def tables(self):
         from ..state.table_config import global_table
@@ -55,60 +67,118 @@ class ImpulseSource(SourceOperator):
         return {"i": global_table("i")}
 
     async def on_start(self, ctx):
+        p = ctx.task_info.parallelism
+        me = ctx.task_info.task_index
+        stored: dict = {}
         if ctx.table_manager is not None:
             table = await ctx.table("i")
-            stored = table.get(ctx.task_info.task_index)
-            if stored is not None:
-                self.counter = stored
+            stored = splits_mod.load_splits(table)
+            if not stored:
+                # legacy per-subtask counters (pre-elasticity layouts)
+                # upgrade in place: subtask k's counter becomes split
+                # "ik"'s position
+                for k, v in table.items():
+                    if isinstance(k, int):
+                        stored[f"i{k}"] = {
+                            "emit": k, "next": int(v), "step": 1,
+                            "hi": self.message_count,
+                        }
+        if not stored:
+            stored = splits_mod.impulse_plan(p, self.message_count)
+        stored = splits_mod.ensure_splits(
+            stored, p, splits_mod.impulse_subdivide
+        )
+        self.splits = splits_mod.owned(stored, p, me)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("i")
-            table.put(ctx.task_info.task_index, self.counter)
+            for sid, payload in self.splits.items():
+                table.put(splits_mod.split_key(sid), dict(payload))
+
+    def drain_status(self):
+        if self.message_count is None:
+            return None  # unbounded: FINAL only ever means exhausted-less
+        rem = {
+            sid: n for sid, p in self.splits.items()
+            if (n := splits_mod.impulse_remaining(p))
+        }
+        if not rem:
+            return (True, "")
+        return (False, f"impulse splits undrained: {rem}")
+
+    def _next_split(self):
+        """The owned split with the lowest pending counter (None when
+        every split is exhausted): events leave in global counter order,
+        matching the classic single-progression schedule."""
+        best = None
+        for sid, p in self.splits.items():
+            hi = p.get("hi")
+            if hi is not None and int(p["next"]) >= int(hi):
+                continue
+            if best is None or int(p["next"]) < int(self.splits[best]["next"]):
+                best = sid
+        return best
 
     async def run(self, ctx, collector) -> SourceFinishType:
-        subtask = ctx.task_info.task_index
         start = self.start_time if self.start_time is not None else now_nanos()
         period = 1.0 / self.event_rate if self.event_rate > 0 else 0.0
-        wall_start = time.monotonic()
-        while self.message_count is None or self.counter < self.message_count:
+        # schedule origin shifted by the restored position so a restore /
+        # rescale resumes pacing at "now" instead of stalling out the
+        # entire pre-checkpoint runtime (the nexmark source's fix)
+        wall_start = time.monotonic() - self.counter * period
+        busy_t0 = time.perf_counter()
+        while True:
+            sid = self._next_split()
+            if sid is None:
+                break
+            sp = self.splits[sid]
+            nxt = int(sp["next"])
             finish = await ctx.check_control(collector)
             if finish is not None:
                 return finish
             if self.realtime:
-                target = wall_start + self.counter * period
+                target = wall_start + nxt * period
                 delay = target - time.monotonic()
-                while delay > 0:
-                    # sleep in bounded slices: a low-rate source (parked
-                    # fleet jobs pace one event per tens of seconds) must
-                    # keep answering control — a stop or checkpoint
-                    # barrier cannot wait out a full inter-event gap
-                    await asyncio.sleep(min(delay, 0.5))
-                    finish = await ctx.check_control(collector)
-                    if finish is not None:
-                        return finish
-                    delay = target - time.monotonic()
+                if delay > 0:
+                    # pacing sleep: close the busy burst first so the
+                    # autoscaler's busy ratio reflects generation time,
+                    # not wall time (DS2 source sizing reads it)
+                    ctx.note_busy(time.perf_counter() - busy_t0)
+                    while delay > 0:
+                        # sleep in bounded slices: a low-rate source
+                        # (parked fleet jobs pace one event per tens of
+                        # seconds) must keep answering control — a stop
+                        # or checkpoint barrier cannot wait out a full
+                        # inter-event gap
+                        await asyncio.sleep(min(delay, 0.5))
+                        finish = await ctx.check_control(collector)
+                        if finish is not None:
+                            return finish
+                        delay = target - time.monotonic()
+                    busy_t0 = time.perf_counter()
                 # replay mode: wall-paced arrival, synthetic event time
                 # (byte-identical output whatever the wall clock did);
                 # plain realtime keeps stamping wall-clock time
                 if self.replay:
-                    ts = start + int(
-                        round(self.counter * (1e9 / self.event_rate))
-                    )
+                    ts = start + int(round(nxt * (1e9 / self.event_rate)))
                 else:
                     ts = now_nanos()
             else:
-                ts = start + int(round(self.counter * (1e9 / self.event_rate)))
+                ts = start + int(round(nxt * (1e9 / self.event_rate)))
             ctx.buffer_row(
-                {"counter": self.counter, "subtask_index": subtask,
+                {"counter": nxt, "subtask_index": int(sp["emit"]),
                  "_timestamp": ts}
             )
-            self.counter += 1
+            sp["next"] = nxt + int(sp.get("step", 1))
             if ctx.should_flush():
                 await self.flush_buffer(ctx, collector)
+                ctx.note_busy(time.perf_counter() - busy_t0)
                 # yield so queues/control stay live even in non-realtime mode
                 await asyncio.sleep(0)
+                busy_t0 = time.perf_counter()
         await self.flush_buffer(ctx, collector)
+        ctx.note_busy(time.perf_counter() - busy_t0)
         return SourceFinishType.FINAL
 
 

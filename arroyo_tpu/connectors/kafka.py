@@ -70,8 +70,12 @@ class KafkaSource(SourceOperator):
         self.proto_descriptor = proto_descriptor
         self.schema_registry = schema_registry
         self.avro_schema = avro_schema
-        # partition -> next offset (checkpointed)
+        # partition -> next offset (checkpointed per partition)
         self.offsets: Dict[int, int] = {}
+        # partitions assigned to THIS subtask (set by run); checkpoints
+        # persist only these — writing a restored foreign partition's
+        # offset would stamp a stale copy over its live owner's progress
+        self._mine: Optional[set] = None
 
     def tables(self):
         from ..state.table_config import global_table
@@ -80,18 +84,45 @@ class KafkaSource(SourceOperator):
 
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
+            from . import splits as splits_mod
+
             table = await ctx.table("k")
-            stored = table.get(ctx.task_info.task_index)
-            if stored is not None:
-                self.offsets = {int(p): o for p, o in stored.items()}
+            # offsets are keyed PER PARTITION (ISSUE 15 source
+            # elasticity): any parallelism re-reads the partitions it is
+            # assigned from the replicated union, so a rescale at the
+            # checkpoint boundary neither gaps nor replays. Kafka splits
+            # never subdivide (partitions are broker-side), so elasticity
+            # here is reassignment only.
+            for k, v in table.items():
+                if isinstance(k, str) and k.startswith(splits_mod.SPLIT_PREFIX):
+                    payload = dict(v)
+                    self.offsets[int(payload["partition"])] = int(
+                        payload["offset"]
+                    )
+            if not self.offsets:
+                # legacy layout: one {partition: offset} dict per subtask
+                # index — union every entry (rescale-safe upgrade: the
+                # partitions this subtask is NOT assigned are ignored by
+                # run()'s assignment filter)
+                for k, v in table.items():
+                    if isinstance(k, int) and isinstance(v, dict):
+                        for p, o in v.items():
+                            self.offsets[int(p)] = max(
+                                int(o), self.offsets.get(int(p), 0)
+                            )
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
+            from . import splits as splits_mod
+
             table = await ctx.table("k")
-            table.put(
-                ctx.task_info.task_index,
-                {str(p): o for p, o in self.offsets.items()},
-            )
+            for p, o in self.offsets.items():
+                if self._mine is not None and int(p) not in self._mine:
+                    continue
+                table.put(
+                    splits_mod.split_key(f"p{int(p)}"),
+                    {"partition": int(p), "offset": int(o)},
+                )
 
     async def run(self, ctx, collector) -> SourceFinishType:
         kafka = _load_client()
@@ -126,6 +157,7 @@ class KafkaSource(SourceOperator):
             p for i, p in enumerate(partitions)
             if i % ctx.task_info.parallelism == ctx.task_info.task_index
         ]
+        self._mine = set(mine)
         tps = []
         for p in mine:
             tp = kafka.TopicPartition(self.topic, p)

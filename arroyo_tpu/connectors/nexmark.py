@@ -27,6 +27,7 @@ import pyarrow as pa
 from ..operators.base import SourceFinishType, SourceOperator
 from ..schema import StreamSchema
 from ..types import now_nanos
+from . import splits as splits_mod
 from .base import ConnectionSchema, Connector, register_connector
 
 PERSON_T = pa.struct(
@@ -464,7 +465,17 @@ class NexmarkSource(SourceOperator):
         self.realtime = realtime
         self.out_schema = NEXMARK_SCHEMA
         self.gen = NexmarkGenerator()
-        self.index = 0  # local sequence position (strided by parallelism)
+        # owned splits (ISSUE 15 source elasticity): residue classes of
+        # the GLOBAL event sequence {r, mod, i} keyed by split id —
+        # offset state checkpoints per split so the autoscaler can
+        # repartition this source at any checkpoint boundary
+        self.splits: dict = {}
+
+    @property
+    def index(self) -> int:
+        """Legacy view: the smallest per-split local index (tests)."""
+        idx = [int(p["i"]) for p in self.splits.values()]
+        return min(idx) if idx else 0
 
     def tables(self):
         from ..state.table_config import global_table
@@ -472,66 +483,118 @@ class NexmarkSource(SourceOperator):
         return {"n": global_table("n")}
 
     async def on_start(self, ctx):
+        p = ctx.task_info.parallelism
+        me = ctx.task_info.task_index
+        stored: dict = {}
         if ctx.table_manager is not None:
             table = await ctx.table("n")
-            stored = table.get(ctx.task_info.task_index)
-            if stored is not None:
-                self.index = stored
+            stored = splits_mod.load_splits(table)
+            if not stored:
+                # legacy per-subtask strided indices: subtask k of the OLD
+                # parallelism (the number of legacy entries) generated
+                # n = k + i*old_p — exactly split {r: k, mod: old_p, i}
+                legacy = {
+                    k: int(v) for k, v in table.items()
+                    if isinstance(k, int)
+                }
+                old_p = len(legacy)
+                for k, v in legacy.items():
+                    stored[f"n{k}"] = {"r": k, "mod": old_p, "i": v}
+        if not stored:
+            stored = splits_mod.nexmark_plan(p)
+        stored = splits_mod.ensure_splits(
+            stored, p, splits_mod.nexmark_subdivide
+        )
+        self.splits = splits_mod.owned(stored, p, me)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("n")
-            table.put(ctx.task_info.task_index, self.index)
+            for sid, payload in self.splits.items():
+                table.put(splits_mod.split_key(sid), dict(payload))
+
+    def drain_status(self):
+        if self.message_count is None:
+            return None
+        rem = {
+            sid: n for sid, p in self.splits.items()
+            if (n := splits_mod.nexmark_remaining(p, self.message_count))
+        }
+        if not rem:
+            return (True, "")
+        return (False, f"nexmark splits undrained: {rem}")
+
+    def _next_split(self):
+        """The owned split with the lowest pending global sequence
+        number (None when exhausted against message_count): chunks leave
+        in near-global order so event time stays monotone per subtask."""
+        best = None
+        best_n = None
+        for sid, p in self.splits.items():
+            n = splits_mod.nexmark_next_n(p)
+            if self.message_count is not None and n >= self.message_count:
+                continue
+            if best_n is None or n < best_n:
+                best, best_n = sid, n
+        return best
 
     async def run(self, ctx, collector) -> SourceFinishType:
-        p = ctx.task_info.parallelism
-        me = ctx.task_info.task_index
         start = self.start_time if self.start_time is not None else now_nanos()
         nanos_per_event = 1e9 / self.event_rate if self.event_rate > 0 else 0
         # vectorized chunked generation for BOTH modes (a scalar per-event
         # loop caps out around 50k events/s and falls seconds behind its own
         # event times, showing up as phantom end-to-end latency). Realtime
         # paces pipeline.realtime_chunk_seconds chunks (default 20 ms)
-        # against a schedule origin shifted by the restored index, so a
+        # against a schedule origin shifted by the restored position, so a
         # checkpoint restore resumes at "now" instead of stalling for the
         # entire pre-checkpoint runtime.
         import numpy as np
 
+        first = self._next_split()
+        chunk_for = {}
         if self.realtime:
             from ..config import config as config_fn
 
             chunk_s = config_fn().pipeline.realtime_chunk_seconds
-            chunk = max(1, min(ctx.batch_size,
-                               int(self.event_rate * chunk_s / p) or 1))
-            wall_start = (
-                time.monotonic() - (self.index * p) * nanos_per_event / 1e9
-            )
-        else:
-            chunk = ctx.batch_size
+            chunk_for = {
+                sid: max(1, min(ctx.batch_size,
+                                int(self.event_rate * chunk_s
+                                    / int(p["mod"])) or 1))
+                for sid, p in self.splits.items()
+            }
+            n_first = (splits_mod.nexmark_next_n(self.splits[first])
+                       if first is not None else 0)
+            wall_start = time.monotonic() - n_first * nanos_per_event / 1e9
+        busy_t0 = time.perf_counter()
         while True:
-            n0 = self.index * p + me
-            if self.message_count is not None and n0 >= self.message_count:
+            sid = self._next_split()
+            if sid is None:
                 break
+            sp = self.splits[sid]
+            m = int(sp["mod"])
+            n0 = splits_mod.nexmark_next_n(sp)
             finish = await ctx.check_control(collector)
             if finish is not None:
                 return finish
-            count = chunk
+            count = chunk_for.get(sid, ctx.batch_size)
             if self.message_count is not None:
-                remaining = (self.message_count - 1 - n0) // p + 1
-                count = min(chunk, remaining)
+                remaining = (self.message_count - 1 - n0) // m + 1
+                count = min(count, remaining)
             if self.realtime:
-                target = (
-                    wall_start + (self.index * p) * nanos_per_event / 1e9
-                )
+                target = wall_start + n0 * nanos_per_event / 1e9
                 delay = target - time.monotonic()
                 if delay > 0:
+                    ctx.note_busy(time.perf_counter() - busy_t0)
                     await asyncio.sleep(delay)
-            ns = n0 + np.arange(count, dtype=np.int64) * p
+                    busy_t0 = time.perf_counter()
+            ns = n0 + np.arange(count, dtype=np.int64) * m
             # schedule-based event times (wall-aligned under pacing)
             ts = start + np.round(ns * nanos_per_event).astype(np.int64)
             await collector.collect(gen_batch(ns, ts))
-            self.index += count
+            sp["i"] = int(sp["i"]) + count
+            ctx.note_busy(time.perf_counter() - busy_t0)
             await asyncio.sleep(0)
+            busy_t0 = time.perf_counter()
         return SourceFinishType.FINAL
 
 

@@ -171,6 +171,12 @@ class JobHandle:
         # flight); manifests still publish strictly in epoch order.
         self.pending_epochs: Dict[int, dict] = {}
         self.finished_tasks: set = set()
+        # bounded sources that reported FINAL completion WITHOUT having
+        # drained their assigned range (task_id -> detail): the controller
+        # refuses to FINISH over these — a truncated source run must
+        # recover, not masquerade as success (carried robustness bug:
+        # chaos kill loops turned "prefix of the output" into FINISHED)
+        self.undrained_sources: Dict[str, str] = {}
         self.failure: Optional[str] = None
         self.stop_requested: Optional[str] = None
         self.restarts = 0
@@ -472,6 +478,13 @@ class ControllerServer:
         job = self._req_job(req)
         if job is not None:
             job.finished_tasks.add(req["task_id"])
+            if req.get("source_drained") is False:
+                # a bounded source claims completion without having
+                # emitted its full assigned range: record it — the run
+                # loop refuses to FINISH the job over truncated output
+                job.undrained_sources[req["task_id"]] = str(
+                    req.get("source_drain_detail") or "undrained"
+                )
             job.kick()
         return {}
 
@@ -781,52 +794,18 @@ class ControllerServer:
             for w in job.workers:
                 w.job_id = job.job_id
         # round-robin subtask assignment
-        job.assignments = {}
-        wi = 0
-        for node in job.graph.topo_order():
-            for i in range(node.parallelism):
-                job.assignments[(node.node_id, i)] = (
-                    job.workers[wi % len(job.workers)].worker_id
-                )
-                wi += 1
+        job.assignments, counts = self._assign_subtasks(job, job.workers)
         if pool:
-            counts: Dict[int, int] = {}
-            for (_nid, _sub), wid in job.assignments.items():
-                counts[wid] = counts.get(wid, 0) + 1
             for w in job.workers:
                 w.assigned[job.job_id] = counts.get(w.worker_id, 0)
         job.checkpoints.clear()
         job.pending_epochs.clear()
         job.finished_tasks.clear()
+        job.undrained_sources.clear()
         job.failure = None
         job.leader_resigned = False
         job.schedules += 1
-        req = {
-            "job_id": job.job_id,
-            "sql": job.sql,
-            "parallelism": job.parallelism,
-            # rescale overrides layered on the base plan: workers re-plan
-            # canonical SQL at `parallelism`, then apply these, landing on
-            # this controller's exact graph (assignments must agree)
-            "parallelism_overrides": {
-                str(n): p for n, p in job.parallelism_overrides.items()
-            },
-            "graph": None if job.sql else job.graph.to_json(),
-            "assignments": [
-                {"node_id": n, "subtask": s, "worker_id": w}
-                for (n, s), w in job.assignments.items()
-            ],
-            "worker_data_addrs": {
-                str(w.worker_id): w.data_addr for w in job.workers
-            },
-            "storage_url": job.storage_url,
-            "generation": job.backend.generation if job.backend else None,
-            "restore_epoch": job.backend.restore_epoch if job.backend else None,
-            # route namespace: quads collide across multiplexed jobs, and
-            # the schedule counter fences straggler connections of a
-            # torn-down incarnation of this same job
-            "data_ns": f"{job.job_id}@{job.schedules}",
-        }
+        req = self._start_request(job, job.workers, job.assignments)
         if job.backend and job.backend.restore_epoch:
             job.epoch = job.backend.restore_epoch
             # the restore manifest IS the last published state: reads
@@ -873,6 +852,58 @@ class ControllerServer:
                 raise
         job.transition(JobState.RUNNING)
 
+    @staticmethod
+    def _assign_subtasks(job: JobHandle, workers) -> tuple:
+        """Round-robin subtask assignment over `workers`: returns
+        (assignments, per-worker subtask counts). Pure — callers decide
+        when the result becomes the job's live assignment (the overlap
+        rescale computes the NEW incarnation's map while the old one is
+        still running on the current map)."""
+        assignments: Dict[tuple, int] = {}
+        wi = 0
+        for node in job.graph.topo_order():
+            for i in range(node.parallelism):
+                assignments[(node.node_id, i)] = (
+                    workers[wi % len(workers)].worker_id
+                )
+                wi += 1
+        counts: Dict[int, int] = {}
+        for (_nid, _sub), wid in assignments.items():
+            counts[wid] = counts.get(wid, 0) + 1
+        return assignments, counts
+
+    @staticmethod
+    def _start_request(job: JobHandle, workers, assignments: Dict[tuple, int]) -> dict:
+        """The StartExecution payload for one incarnation of the job
+        (shared by the schedule path and the overlap rescale's staged
+        start)."""
+        return {
+            "job_id": job.job_id,
+            "sql": job.sql,
+            "parallelism": job.parallelism,
+            # rescale overrides layered on the base plan: workers re-plan
+            # canonical SQL at `parallelism`, then apply these, landing on
+            # this controller's exact graph (assignments must agree)
+            "parallelism_overrides": {
+                str(n): p for n, p in job.parallelism_overrides.items()
+            },
+            "graph": None if job.sql else job.graph.to_json(),
+            "assignments": [
+                {"node_id": n, "subtask": s, "worker_id": w}
+                for (n, s), w in assignments.items()
+            ],
+            "worker_data_addrs": {
+                str(w.worker_id): w.data_addr for w in workers
+            },
+            "storage_url": job.storage_url,
+            "generation": job.backend.generation if job.backend else None,
+            "restore_epoch": job.backend.restore_epoch if job.backend else None,
+            # route namespace: quads collide across multiplexed jobs, and
+            # the schedule counter fences straggler connections of a
+            # torn-down incarnation of this same job
+            "data_ns": f"{job.job_id}@{job.schedules}",
+        }
+
     def _heartbeat_horizon(self, job: JobHandle) -> float:
         """Earliest monotonic instant a worker of this job COULD be
         declared dead — the deadline the timer wheel arms for liveness
@@ -905,6 +936,19 @@ class ControllerServer:
             # finished-check MUST precede heartbeat expiry: a cleanly
             # finished worker stops heartbeating, and treating that as a
             # timeout would recover (and re-finish, and re-recover) forever
+            if (len(job.finished_tasks) >= job.n_subtasks
+                    and job.undrained_sources and not job.stop_requested):
+                # FINISH guard: every task "finished", but a bounded
+                # source completed without draining its assigned range.
+                # FINISHED here would bless a prefix of the output as the
+                # whole result — recover and replay from the last durable
+                # checkpoint instead.
+                job.failure = (
+                    "source finished without draining: "
+                    f"{dict(job.undrained_sources)}"
+                )
+                job.transition(JobState.RECOVERING)
+                return
             if len(job.finished_tasks) >= job.n_subtasks:
                 # release BEFORE the terminal transition: a caller woken
                 # by wait_for_state(FINISHED) may immediately tear the
@@ -1046,21 +1090,36 @@ class ControllerServer:
     @protocol_effect("ctrl.rescale")
     async def _rescale(self, job: JobHandle):
         """Exactly-once automatic rescale (reference states/rescaling.rs;
-        the autoscaler's actuation path): stop with a checkpoint, fold the
-        per-node parallelism overrides into the graph, tear the workers
-        down, and reschedule — the restore re-reads key-range-sharded
-        state at the new parallelism. Failures anywhere before the
-        reschedule route through Recovering: either nothing durable
-        changed yet (stop checkpoint failed — recover at the old
-        parallelism) or the stop checkpoint IS durable (overrides applied
-        — recovery reschedules at the new one). Fully flight-recorded as
-        the `{job}/rescale-N` trace."""
+        the autoscaler's actuation path). Two modes:
+
+        * generation-overlap (`rescale.mode = overlap`, pooled
+          multiplexed workers — the default shape): while the stop
+          barrier drains, the NEW incarnation's workers are acquired
+          (`_overlap_prepare`); once the rescale checkpoint publishes,
+          the new incarnation is STAGED — built and restored from that
+          durable checkpoint with its sources parked — concurrently with
+          the old generation draining its final epoch, then promoted in
+          place (`_overlap_activate`, RESCALING -> RUNNING). Output gap
+          per rescale is the `rescale.overlap` span, ~one checkpoint
+          interval instead of a full teardown+restore.
+        * stop-the-world (fallback / `rescale.mode = stop_the_world`):
+          stop with a checkpoint, fold the overrides into the graph, tear
+          the workers down, reschedule.
+
+        Failures anywhere route through Recovering: before the stop
+        checkpoint published nothing durable changed (recover at the old
+        parallelism); after it, overrides are applied (recovery
+        reschedules at the new one) — the model checker's overlap window
+        (`analysis/model/spec.py` overlap.prepare/overlap.activate, the
+        epoch-emitted-by-both-generations invariant) pins both windows.
+        Fully flight-recorded as the `{job}/rescale-N` trace."""
         overrides = job.rescale_requested or {}
         job.rescale_requested = None
         job.rescales += 1
         trace, parent = job.rescale_trace or (
             obs.new_trace(job.job_id, f"rescale-{job.rescales}"), None
         )
+        overlap_done = False
         with obs.span(
             "job.rescale", trace=trace, parent=parent, cat="controller",
             job=job.job_id, rescale=job.rescales, overrides=str(overrides),
@@ -1088,6 +1147,18 @@ class ControllerServer:
                 job.rescale_trace = None
                 job.transition(JobState.RECOVERING)
                 return
+            overlap = (
+                config().rescale.mode == "overlap"
+                and self._pool_mode()
+                and bool(job.workers)
+                and all(w.pooled for w in job.workers)
+            )
+            prep: Optional[asyncio.Task] = None
+            if overlap:
+                # overlap leg 1, concurrent with the stop barrier + report
+                # wait: make sure the new incarnation's workers exist
+                prep = asyncio.ensure_future(self._overlap_prepare(job))
+            barrier_at = time.monotonic()
             with obs.span("rescale.stop_checkpoint", cat="controller"):
                 await self._checkpoint(job, then_stop=True, nested=True)
             if job.failure is not None:
@@ -1095,34 +1166,211 @@ class ControllerServer:
                 # mid-rescale, storage fault): nothing changed durably, so
                 # recover at the CURRENT parallelism — the autoscaler
                 # re-decides once rates stabilize
+                if prep is not None:
+                    prep.cancel()
                 job.rescale_trace = None
                 job.transition(JobState.RECOVERING)
                 return
-            await self._await_all_finished(job)
-            job.apply_parallelism_overrides(overrides)
-            if chaos.fire("rescale.reschedule_fail", job=job.job_id):
-                # crash window between the durable stop checkpoint and the
-                # reschedule: recovery must come back AT the new
-                # parallelism from that checkpoint, exactly once
-                logger.warning(
-                    "chaos[rescale.reschedule_fail]: job %s failing before "
-                    "the post-rescale schedule", job.job_id,
-                )
-                job.failure = "chaos: rescale reschedule failure"
-                job.transition(JobState.RECOVERING)
-                return
-            if self._pool_mode() and any(w.pooled for w in job.workers):
-                await self._release_job(job, force=True)
+            if overlap:
+                with obs.span(
+                    "rescale.overlap", cat="controller", job=job.job_id,
+                    rescale=job.rescales,
+                ) as osp:
+                    overlap_done = await self._overlap_activate(
+                        job, overrides, prep, barrier_at, osp
+                    )
+                job.rescale_trace = None
+                if not overlap_done:
+                    job.transition(JobState.RECOVERING)
+                    return
             else:
-                for w in job.workers:
-                    self.workers.pop(w.worker_id, None)
-                await self.scheduler.stop_workers(job.job_id)
-            # fresh generation fences any straggler; the restore epoch is
-            # the stop checkpoint just published
-            job.backend = StateBackend(
-                job.storage_url, job.job_id
-            ).initialize()
-        job.transition(JobState.SCHEDULING)
+                await self._await_all_finished(job)
+                job.apply_parallelism_overrides(overrides)
+                if chaos.fire("rescale.reschedule_fail", job=job.job_id):
+                    # crash window between the durable stop checkpoint and
+                    # the reschedule: recovery must come back AT the new
+                    # parallelism from that checkpoint, exactly once
+                    logger.warning(
+                        "chaos[rescale.reschedule_fail]: job %s failing "
+                        "before the post-rescale schedule", job.job_id,
+                    )
+                    job.failure = "chaos: rescale reschedule failure"
+                    job.transition(JobState.RECOVERING)
+                    return
+                if self._pool_mode() and any(w.pooled for w in job.workers):
+                    await self._release_job(job, force=True)
+                else:
+                    for w in job.workers:
+                        self.workers.pop(w.worker_id, None)
+                    await self.scheduler.stop_workers(job.job_id)
+                # fresh generation fences any straggler; the restore epoch
+                # is the stop checkpoint just published
+                job.backend = StateBackend(
+                    job.storage_url, job.job_id
+                ).initialize()
+        job.transition(
+            JobState.RUNNING if overlap_done else JobState.SCHEDULING
+        )
+
+    @protocol_effect("ctrl.overlap_prepare")
+    async def _overlap_prepare(self, job: JobHandle) -> int:
+        """Overlap leg 1 (modeled as `overlap.prepare`): runs concurrently
+        with the rescale's stop barrier — grow/heal the shared pool to the
+        job's worker count and wait for registration. Claims nothing
+        durable; a failure anywhere simply discards the attempt."""
+        n_workers = max(1, len(job.workers))
+        await self.scheduler.start_workers(self.addr, n_workers, job.job_id)
+        await self._wait_registration(
+            lambda: len(self._live_pool_workers()) >= n_workers
+        )
+        return n_workers
+
+    @protocol_effect("ctrl.overlap_activate")
+    async def _overlap_activate(self, job: JobHandle,
+                                overrides: Dict[int, int],
+                                prep: asyncio.Task, barrier_at: float,
+                                span) -> bool:
+        """Overlap leg 2 (modeled as `overlap.activate`): the durable
+        rescale checkpoint is published, so claim the fresh generation,
+        STAGE the new incarnation — StartExecution(staged): program built,
+        state restored from that checkpoint, sources parked on the release
+        gate — while the old generation drains its final epoch (sink
+        commits applying, tasks finishing), then promote it in place.
+        Returns False (with job.failure set) to route to Recovering —
+        safe in every window: the checkpoint is durable and overrides are
+        applied, so recovery comes back at the NEW parallelism, and the
+        incarnation-fenced route namespaces + generation-stamped blob
+        paths keep any old-generation straggler harmless."""
+        old_workers = list(job.workers)
+        old_subtasks = job.n_subtasks
+        job.apply_parallelism_overrides(overrides)
+        # fresh generation NOW: the old generation publishes nothing after
+        # its stop manifest, and gen-stamped data paths keep its straggler
+        # uploads beside — never over — the new generation's blobs
+        job.backend = StateBackend(job.storage_url, job.job_id).initialize()
+        drain = asyncio.ensure_future(
+            self._await_all_finished(job, expected=old_subtasks)
+        )
+        new_workers: List[WorkerHandle] = []
+        assignments: Dict[tuple, int] = {}
+        counts: Dict[int, int] = {}
+        try:
+            n_workers = await asyncio.wait_for(
+                asyncio.shield(prep), config().rescale.prepare_timeout
+            )
+            # refresh the admission grant for the new size (idempotent —
+            # the job keeps the slots it holds)
+            await self.admission.acquire(job)
+            new_workers = self._pick_pool_workers(n_workers)
+            if len(new_workers) < n_workers:
+                raise RuntimeError(
+                    f"{len(new_workers)} live pool workers, need {n_workers}"
+                )
+            job.schedules += 1  # fresh data_ns fences old-gen stragglers
+            assignments, counts = self._assign_subtasks(job, new_workers)
+            req = self._start_request(job, new_workers, assignments)
+            req["staged"] = True
+            for w in new_workers:
+                await self._worker_call(
+                    w, "WorkerGrpc", "StartExecution",
+                    {**req, "is_leader": False},
+                )
+            # chaos seams land at the heart of the overlap window: the
+            # old generation is draining its final epoch AND the new
+            # generation is staged and restoring
+            if chaos.fire("rescale.overlap_kill", job=job.job_id) is not None:
+                self._chaos_kill_pool_worker(job)
+            if chaos.fire("rescale.reschedule_fail", job=job.job_id):
+                raise RuntimeError("chaos: rescale reschedule failure")
+        except Exception as e:  # noqa: BLE001 - every window recovers
+            prep.cancel()
+            drain.cancel()
+            await asyncio.gather(drain, return_exceptions=True)
+            logger.warning("job %s overlap prepare failed: %r",
+                           job.job_id, e)
+            job.failure = f"overlap prepare failed: {e!r}"
+            return False
+        # the overlap window proper: staged restore completes while the
+        # old generation drains (a post-publish worker death is safe —
+        # the restore idempotently replays the claimed commit)
+        await drain
+        if job.failure is not None:
+            # a staged-restore failure (or old-generation teardown noise)
+            # surfaced as a task failure: recover at the new parallelism
+            logger.warning("job %s overlap window failed: %s",
+                           job.job_id, job.failure)
+            return False
+        try:
+            for w in new_workers:
+                await self._worker_call(
+                    w, "WorkerGrpc", "StartProcessing",
+                    {"job_id": job.job_id, "promote": True},
+                )
+            # old-generation release: promotion already tore down the old
+            # runtime on every shared worker; workers that dropped out of
+            # the placement get an explicit per-job teardown
+            for w in old_workers:
+                if w in new_workers:
+                    continue
+                w.assigned.pop(job.job_id, None)
+                try:
+                    await self._worker_call(
+                        w, "WorkerGrpc", "StopJob",
+                        {"job_id": job.job_id, "force": True},
+                        timeout=5.0,
+                    )
+                except Exception as e:  # noqa: BLE001 - may be dying
+                    logger.warning("StopJob(%s) on worker %s failed: %s",
+                                   job.job_id, w.worker_id, e)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("job %s overlap promote failed: %r",
+                           job.job_id, e)
+            job.failure = f"overlap promote failed: {e!r}"
+            return False
+        job.workers = new_workers
+        job.assignments = assignments
+        for w in new_workers:
+            w.assigned[job.job_id] = counts.get(w.worker_id, 0)
+        job.checkpoints.clear()
+        job.pending_epochs.clear()
+        job.finished_tasks.clear()
+        job.undrained_sources.clear()
+        job.failure = None
+        job.leader_resigned = False
+        restore = job.backend.restore_epoch or 0
+        job.epoch = max(job.epoch, restore)
+        # the rescale checkpoint IS the published state: serving resumes
+        # at it the moment the new generation runs
+        job.published_epoch = max(job.published_epoch, restore)
+        gap_ms = round((time.monotonic() - barrier_at) * 1e3, 3)
+        span.set(gap_ms=gap_ms, workers=len(new_workers),
+                 restore_epoch=restore)
+        logger.info(
+            "job %s generation-overlap rescale complete: output gap "
+            "%.1f ms (barrier -> sources released), restore epoch %d",
+            job.job_id, gap_ms, restore,
+        )
+        return True
+
+    def _chaos_kill_pool_worker(self, job: JobHandle) -> None:
+        """chaos[rescale.overlap_kill]: SIGKILL-equivalent teardown of a
+        pool worker hosting this job INSIDE the overlap window (old
+        generation draining its final epoch, new generation restoring).
+        Embedded pools only — the drill's shape."""
+        pool = getattr(self.scheduler, "pool", None) or []
+        targets = {w.worker_id for w in job.workers}
+        for w, _t in pool:
+            if w.worker_id in targets:
+                logger.warning(
+                    "chaos[rescale.overlap_kill]: killing worker %s inside "
+                    "the overlap window", w.worker_id,
+                )
+                # retained: a GC'd teardown task would half-kill the worker
+                self._chaos_kill_task = asyncio.ensure_future(w.shutdown())
+                return
+        logger.warning(
+            "chaos[rescale.overlap_kill]: no embedded pool worker to kill"
+        )
 
     @protocol_effect("ctrl.checkpoint_start")
     async def _checkpoint_start(self, job: JobHandle):
@@ -1353,9 +1601,15 @@ class ControllerServer:
         except Exception:  # noqa: BLE001
             logger.exception("checkpoint %d compaction/GC failed", epoch)
 
-    async def _await_all_finished(self, job: JobHandle, timeout: float = 60.0):
+    async def _await_all_finished(self, job: JobHandle, timeout: float = 60.0,
+                                  expected: Optional[int] = None):
+        """Wait for the job's tasks to finish. `expected` pins the count
+        when the caller already changed job.n_subtasks (the overlap
+        rescale drains the OLD incarnation after applying the new
+        parallelism overrides)."""
+        want = job.n_subtasks if expected is None else expected
         deadline = time.monotonic() + timeout
-        while len(job.finished_tasks) < job.n_subtasks:
+        while len(job.finished_tasks) < want:
             if time.monotonic() > deadline:
                 logger.warning("job %s: tasks did not finish in time",
                                job.job_id)

@@ -147,12 +147,19 @@ _next_process_id = 2000
 
 
 def spawn_worker(controller_addr: str, worker_id: int,
-                 extra_env: Optional[dict] = None) -> subprocess.Popen:
+                 extra_env: Optional[dict] = None,
+                 spawn_generation: int = 0) -> subprocess.Popen:
     """Fork one `arroyo-tpu worker` subprocess (shared by the process
-    scheduler and node daemons)."""
+    scheduler and node daemons). `spawn_generation` counts RESPAWNS of
+    this scheduling slot: a config-installed fault plan
+    (ARROYO__CHAOS__PLAN) arms only in generation 0 by default, so a
+    heartbeat-hit worker.kill cannot become a kill LOOP — each respawned
+    process used to re-read the env and re-install the plan with fresh
+    hit counters (the carried truncation-as-FINISHED bug)."""
     env = dict(os.environ)
     env.update(extra_env or {})
     env["ARROYO_WORKER_ID"] = str(worker_id)
+    env["ARROYO_CHAOS_SPAWN_GEN"] = str(int(spawn_generation))
     return subprocess.Popen(
         [sys.executable, "-m", "arroyo_tpu", "worker",
          "--controller", controller_addr],
@@ -226,6 +233,11 @@ class ProcessScheduler(Scheduler):
     def __init__(self):
         self.procs: Dict[str, List[subprocess.Popen]] = {}
         self.pool_procs: List[subprocess.Popen] = []
+        # chaos-plan dedupe across incarnations: replacements of dead
+        # pool processes (and per-job respawn rounds) carry a spawn
+        # generation > 0, which suppresses ARROYO__CHAOS__PLAN re-arming
+        self._pool_spawn_gen = 0
+        self._job_spawn_rounds: Dict[str, int] = {}
 
     async def start_workers(self, controller_addr, n_workers, job_id):
         global _next_process_id
@@ -235,12 +247,17 @@ class ProcessScheduler(Scheduler):
         if multiplexing_active("process"):
             want = max(int(config().cluster.worker_pool_size or 1),
                        n_workers)
-            self.pool_procs = [p for p in self.pool_procs
-                               if p.poll() is None]
+            live = [p for p in self.pool_procs if p.poll() is None]
+            if len(live) < len(self.pool_procs):
+                # dead workers pruned: the spawns below are REPLACEMENTS
+                # (respawned incarnations), not pool growth
+                self._pool_spawn_gen += 1
+            self.pool_procs = live
             while len(self.pool_procs) < want:
                 p = spawn_worker(
                     controller_addr, _next_process_id,
                     extra_env={"ARROYO_WORKER_POOLED": "1"},
+                    spawn_generation=self._pool_spawn_gen,
                 )
                 _next_process_id += 1
                 self.pool_procs.append(p)
@@ -248,10 +265,13 @@ class ProcessScheduler(Scheduler):
         coord = None
         if int(config().tpu.mesh_processes or 0) >= 2:
             coord = config().tpu.mesh_coordinator or pick_coordinator()
+        spawn_round = self._job_spawn_rounds.get(job_id, 0)
+        self._job_spawn_rounds[job_id] = spawn_round + 1
         for i in range(n_workers):
             p = spawn_worker(
                 controller_addr, _next_process_id,
                 extra_env=mesh_env_for_worker(i, n_workers, coord),
+                spawn_generation=spawn_round,
             )
             _next_process_id += 1
             self.procs.setdefault(job_id, []).append(p)

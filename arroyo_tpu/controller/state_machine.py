@@ -63,9 +63,13 @@ TRANSITIONS = {
     JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
     # a rescale whose stop checkpoint fails (worker killed mid-rescale,
     # storage fault) recovers from the latest durable manifest instead of
-    # failing — the autoscaler retries once rates re-stabilize
+    # failing — the autoscaler retries once rates re-stabilize. The
+    # RUNNING edge is the generation-overlap activation (ISSUE 15): the
+    # new incarnation was staged and restored WHILE the old one drained,
+    # so a successful overlap rescale never passes through SCHEDULING.
     JobState.RESCALING: {
-        JobState.SCHEDULING, JobState.FAILED, JobState.RECOVERING,
+        JobState.SCHEDULING, JobState.RUNNING, JobState.FAILED,
+        JobState.RECOVERING,
     },
     JobState.RESTARTING: {JobState.SCHEDULING, JobState.FAILED},
     JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},

@@ -57,6 +57,12 @@ class _JobRuntime:
         self.job_id = job_id
         self.program = program
         self.data_ns = data_ns
+        # generation-overlap rescale (ISSUE 15): a STAGED incarnation's
+        # runners start immediately (building state, restoring from the
+        # durable rescale checkpoint) but its sources park on this gate
+        # until the controller promotes the incarnation — so restore
+        # overlaps the old generation's drain without double emission
+        self.release: Optional[asyncio.Event] = None
         self.tasks: list = []
         self.pump_task: Optional[asyncio.Task] = None
         self.n_running = 0
@@ -101,6 +107,10 @@ class WorkerServer:
         self.data = DataPlaneServer(bind)
         self.controller: Optional[RpcClient] = None
         self._jobs: Dict[str, _JobRuntime] = {}
+        # staged incarnations awaiting promotion (generation-overlap
+        # rescale): keyed by job id, coexisting with the live runtime of
+        # the SAME job while the old generation drains its final epoch
+        self._staged: Dict[str, _JobRuntime] = {}
         self._finished = asyncio.Event()  # worker-level shutdown signal
         self._peer_clients: Dict[int, RpcClient] = {}
         self._shutdown_task = None  # retained chaos-kill teardown task
@@ -286,11 +296,22 @@ class WorkerServer:
             int(w): addr for w, addr in req["worker_data_addrs"].items()
         }
         job_id = req["job_id"]
-        # a stale incarnation of the same job (recovery rescheduling onto
-        # the same pool worker) must be gone before fresh routes register
-        stale = self._jobs.pop(job_id, None)
-        if stale is not None:
-            await self._teardown_job(stale, force=True)
+        staged = bool(req.get("staged"))
+        if staged:
+            # generation-overlap rescale: the NEW incarnation builds and
+            # restores beside the still-draining live runtime of the same
+            # job (distinct data_ns — routes never collide). Only a
+            # previous staged attempt is torn down.
+            prev = self._staged.pop(job_id, None)
+            if prev is not None:
+                await self._teardown_job(prev, force=True)
+        else:
+            # a stale incarnation of the same job (recovery rescheduling
+            # onto the same pool worker) must be gone before fresh routes
+            # register
+            stale = self._jobs.pop(job_id, None)
+            if stale is not None:
+                await self._teardown_job(stale, force=True)
         program = Program(graph, job_id)
         if req.get("storage_url"):
             from ..state.backend import StateBackend
@@ -338,6 +359,22 @@ class WorkerServer:
         for rs in program.remote_senders:
             rs.on_error = pump_failed
             await rs.start()
+        if staged:
+            # staged start: runners spawn NOW — state tables open and the
+            # restore from the durable rescale checkpoint runs while the
+            # old generation drains — but every source parks on the
+            # release gate until promotion, so nothing is emitted twice.
+            # (Safe single-phase: no data can flow anywhere until the
+            # gate opens, so peers' route registration cannot be raced.)
+            jr.release = asyncio.Event()
+            for sub in jr.program.subtasks:
+                sub.runner.source_gate = jr.release
+            self._staged[job_id] = jr
+            for sub in jr.program.subtasks:
+                jr.tasks.append(asyncio.ensure_future(sub.runner.run()))
+            jr.n_running = len(jr.program.subtasks)
+            jr.pump_task = asyncio.ensure_future(self._pump_responses(jr))
+            return {"subtasks": len(program.subtasks), "staged": True}
         self._jobs[job_id] = jr
         return {"subtasks": len(program.subtasks)}
 
@@ -345,7 +382,27 @@ class WorkerServer:
         """Phase 2 of the barrier-synchronized start (reference
         Engine::start, engine.rs:525): runners only spawn once every worker
         has built its partition and registered its data-plane routes, so a
-        fast source can't race peers' route registration."""
+        fast source can't race peers' route registration.
+
+        With `promote` (generation-overlap rescale), the staged
+        incarnation — already running, restored, sources parked — replaces
+        the live runtime of the job and its sources are released."""
+        if req.get("promote"):
+            jid = req.get("job_id")
+            jr = self._staged.pop(jid, None)
+            if jr is None:
+                raise KeyError(
+                    f"worker {self.worker_id} has no staged incarnation "
+                    f"of job {jid!r} to promote"
+                )
+            old = self._jobs.pop(jid, None)
+            if old is not None:
+                # the old generation should be drained by now; force for
+                # stragglers — generation fencing makes that safe
+                await self._teardown_job(old, force=True)
+            self._jobs[jid] = jr
+            jr.release.set()
+            return {"promoted": True}
         jr = self._job(req)
         for sub in jr.program.subtasks:
             jr.tasks.append(asyncio.ensure_future(sub.runner.run()))
@@ -422,6 +479,11 @@ class WorkerServer:
         jr = self._jobs.pop(jid, None)
         if jr is not None:
             await self._teardown_job(jr, force=bool(req.get("force", True)))
+        staged = self._staged.pop(jid, None)
+        if staged is not None:
+            # an un-promoted staged incarnation dies with the job: it
+            # restored read-only and claimed nothing durable
+            await self._teardown_job(staged, force=True)
         if req.get("expunge"):
             from ..metrics import REGISTRY
 
@@ -783,7 +845,11 @@ class WorkerServer:
             await c.call(
                 "ControllerGrpc", "TaskFinished",
                 {"worker_id": wid, "job_id": jr.job_id,
-                 "task_id": resp.task_id},
+                 "task_id": resp.task_id,
+                 "source_drained": getattr(resp, "source_drained", None),
+                 "source_drain_detail": getattr(
+                     resp, "source_drain_detail", ""),
+                },
             )
         elif isinstance(resp, TaskFailedResp):
             jr.n_running -= 1
@@ -808,6 +874,9 @@ class WorkerServer:
         for jr in list(self._jobs.values()):
             await self._teardown_job(jr, force=True)
         self._jobs.clear()
+        for jr in list(self._staged.values()):
+            await self._teardown_job(jr, force=True)
+        self._staged.clear()
         t = getattr(self, "_hb", None)
         if t is not None:
             t.cancel()

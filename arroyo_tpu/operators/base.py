@@ -110,6 +110,14 @@ class SourceOperator(Operator):
     async def run(self, ctx: SourceContext, collector) -> SourceFinishType:
         raise NotImplementedError
 
+    def drain_status(self):
+        """For bounded sources: (drained, detail) after a FINAL finish —
+        whether the source actually emitted its whole assigned range.
+        None = unbounded/unknown. The runner attaches this to
+        TaskFinishedResp; the controller refuses to FINISH a job whose
+        source claims completion undrained (truncated-output guard)."""
+        return None
+
     async def process_batch(self, batch, ctx, collector, input_index: int = 0):
         raise RuntimeError("sources do not process input batches")
 

@@ -141,6 +141,22 @@ class SourceContext(OperatorContext):
         assert self._runner is not None
         return await self._runner.source_handle_control(collector)
 
+    def note_busy(self, dt: float) -> None:
+        """Source busy accounting: generation/ingest time EXCLUDING
+        pacing sleeps feeds this subtask's arroyo_worker_busy_seconds
+        (and the per-tenant attributed mirror), so the autoscaler's DS2
+        policy can size sources — busy ratio ~1 means the source cannot
+        hold wall pace at its current parallelism (ISSUE 15 source
+        elasticity)."""
+        if dt <= 0:
+            return
+        r = self._runner
+        if r is not None and getattr(r, "_busy_secs", None) is not None:
+            r._busy_secs.inc(dt)
+            from .. import obs
+
+            obs.attribution.note(busy=dt)
+
     def buffer_row(self, row: Dict[str, Any]):
         if self._buffer_started is None:
             self._buffer_started = time.monotonic()

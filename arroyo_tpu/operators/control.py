@@ -100,6 +100,12 @@ class TaskFinishedResp:
     task_id: str
     node_id: int
     subtask_index: int
+    # FINAL-finishing bounded sources report whether they actually
+    # emitted their whole assigned range (None = not a source / unknown /
+    # stop-requested): the controller refuses to FINISH a job whose
+    # source claims completion undrained (truncated-output guard)
+    source_drained: Optional[bool] = None
+    source_drain_detail: str = ""
 
 
 ControlResp = Any  # union of the above

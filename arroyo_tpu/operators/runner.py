@@ -109,6 +109,10 @@ class SubtaskRunner:
             ctx._runner = self  # back-ref for in-chain watermark injection
         self.task_info = ctxs[0].task_info
         self.watermarks = ctxs[0].watermarks
+        # generation-overlap rescale: a staged incarnation's sources park
+        # on this gate after on_start/restore until promotion releases
+        # them (None everywhere else — zero cost on the normal path)
+        self.source_gate: Optional[asyncio.Event] = None
         self._finish_kinds: Dict[int, SignalKind] = {}
         self._barrier_inputs: set[int] = set()
         self._current_barrier = None
@@ -197,8 +201,14 @@ class SubtaskRunner:
                     # consistent read view (seeded from restored state,
                     # so a recovered job serves immediately)
                     serve_register(op, ctx)
+            drained: Optional[bool] = None
+            detail = ""
             if self.is_source:
-                await self._run_source()
+                finish = await self._run_source()
+                if finish == SourceFinishType.FINAL:
+                    status = self.ops[0].drain_status()
+                    if status is not None:
+                        drained, detail = bool(status[0]), str(status[1])
             else:
                 await self._run_operator_loop()
             self.control_tx.put_nowait(
@@ -206,6 +216,8 @@ class SubtaskRunner:
                     self.task_info.task_id,
                     self.task_info.node_id,
                     self.task_info.task_index,
+                    source_drained=drained,
+                    source_drain_detail=detail,
                 )
             )
         except Exception:
@@ -252,6 +264,11 @@ class SubtaskRunner:
         src: SourceOperator = self.ops[0]  # type: ignore[assignment]
         ctx: SourceContext = self.ctxs[0]  # type: ignore[assignment]
         ctx._runner = self  # check_control delegates here
+        if self.source_gate is not None:
+            # staged incarnation: state is restored (on_start already
+            # ran), now hold emission until the controller promotes this
+            # generation — the old one is still draining its final epoch
+            await self.source_gate.wait()
         finish = await src.run(ctx, self.collectors[0])
         await src.flush_buffer(ctx, self.collectors[0])
         if finish == SourceFinishType.FINAL:
@@ -261,6 +278,7 @@ class SubtaskRunner:
             await self._close_chain(is_eod=False)
             await self.tail.broadcast(SignalMessage.stop())
         # IMMEDIATE: tear down silently
+        return finish
 
     async def source_handle_control(self, collector) -> Optional[SourceFinishType]:
         """Called by sources between emissions (via ctx.check_control):

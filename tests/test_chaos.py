@@ -92,6 +92,82 @@ def test_install_from_config(tmp_path):
     assert chaos.install_from_config() is None
 
 
+def test_install_from_config_dedupes_across_incarnations(tmp_path, monkeypatch):
+    """Carried robustness bug (ISSUE 15 satellite): a RESPAWNED worker
+    (spawn generation > 0, stamped by the process scheduler) must NOT
+    re-arm a config-installed plan — re-arming gave every incarnation
+    fresh hit counters and turned a heartbeat-hit worker.kill into a
+    kill loop. Plans opt back in with "rearm": true."""
+    from arroyo_tpu.config import update
+
+    plan_json = json.dumps(
+        {"faults": [{"point": "worker.kill", "at_hits": [2]}]}
+    )
+    # a respawned incarnation: the plan stays un-armed
+    monkeypatch.setenv("ARROYO_CHAOS_SPAWN_GEN", "3")
+    with update(chaos={"plan": plan_json}):
+        assert chaos.install_from_config() is None
+        assert chaos.installed() is None
+    # explicit opt-in re-arms
+    rearm_json = json.dumps(
+        {"rearm": True,
+         "faults": [{"point": "worker.kill", "at_hits": [2]}]}
+    )
+    with update(chaos={"plan": rearm_json}):
+        assert chaos.install_from_config() is not None
+    chaos.clear()
+    # first incarnation (gen 0) arms as always
+    monkeypatch.setenv("ARROYO_CHAOS_SPAWN_GEN", "0")
+    with update(chaos={"plan": plan_json}):
+        assert chaos.install_from_config() is not None
+    chaos.clear()
+
+
+def test_process_scheduler_stamps_spawn_generations(monkeypatch):
+    """The process scheduler marks pool REPLACEMENTS (and per-job respawn
+    rounds) with an increasing spawn generation, which is what suppresses
+    chaos-plan re-arming across incarnations."""
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller import scheduler as sched_mod
+
+    spawns = []
+
+    class FakeProc:
+        def __init__(self, gen):
+            self.gen = gen
+            self.dead = False
+
+        def poll(self):
+            return 1 if self.dead else None
+
+    def fake_spawn(addr, wid, extra_env=None, spawn_generation=0):
+        p = FakeProc(spawn_generation)
+        spawns.append(p)
+        return p
+
+    monkeypatch.setattr(sched_mod, "spawn_worker", fake_spawn)
+
+    async def go():
+        s = sched_mod.ProcessScheduler()
+        with update(cluster={"multiplexing": "on",
+                             "worker_pool_size": 2}):
+            await s.start_workers("127.0.0.1:1", 2, "j1")
+            assert [p.gen for p in spawns] == [0, 0]
+            # a pool worker dies; the replacement is generation 1
+            spawns[0].dead = True
+            await s.start_workers("127.0.0.1:1", 2, "j2")
+            assert [p.gen for p in spawns] == [0, 0, 1]
+        spawns.clear()
+        with update(cluster={"multiplexing": "off"}):
+            s2 = sched_mod.ProcessScheduler()
+            await s2.start_workers("127.0.0.1:1", 1, "j3")
+            # recovery reschedule of the same job: respawn round 1
+            await s2.start_workers("127.0.0.1:1", 1, "j3")
+            assert [p.gen for p in spawns] == [0, 1]
+
+    asyncio.run(go())
+
+
 # -- registry coverage: every seam is listed, every listing has a seam ------
 
 

@@ -220,6 +220,42 @@ def test_full_acceptance_config_exhaustive_clean():
     assert res.states > 100_000
 
 
+def test_overlap_smoke_exploration_clean_and_exhaustive():
+    """Generation-overlap rescale (ISSUE 15), tier-1 smoke: the overlap
+    window (prepare while the old generation drains, activate at the
+    durable rescale checkpoint, RESCALING -> RUNNING) is exhaustive-clean
+    with a kill/reschedule-fail fault budget."""
+    _m, terminals, table = machine()
+    cfg = ModelConfig(
+        workers=2, epochs=2, inflight=2, faults=1, restarts=1,
+        rescales=1, overlap=1,
+        fault_kinds=("fault.kill", "fault.reschedule_fail"),
+    )
+    res = explore_mod.explore(Model(cfg, table, terminals), budget=500_000)
+    assert res.exhaustive
+    assert not res.violations, [t.violation for t in res.violations]
+    # the overlap path is actually taken: activation events exist on the
+    # explored graph — pin it by finding a trace-free exhaustive run with
+    # a non-trivial space (prepare/activate multiply the rescale states)
+    assert res.states > 10_000
+
+
+@pytest.mark.slow
+def test_full_acceptance_overlap_exhaustive_clean():
+    """ISSUE 15 acceptance: the overlap protocol is exhaustive-clean at
+    the acceptance config — 2 workers x 3 epochs x 2 inflight x the full
+    fault-kind set x a rescale THROUGH the overlap window."""
+    _m, terminals, table = machine()
+    cfg = ModelConfig(workers=2, epochs=3, inflight=2, faults=1,
+                      restarts=2, rescales=1, overlap=1)
+    res = explore_mod.explore(
+        Model(cfg, table, terminals), budget=2_000_000
+    )
+    assert res.exhaustive
+    assert not res.violations, [t.violation for t in res.violations]
+    assert res.states > 200_000
+
+
 # -- mutant regression corpus ------------------------------------------------
 
 
@@ -245,6 +281,20 @@ def test_corpus_includes_the_three_historical_bugs():
         "commit_fanout_all_workers",
         "no_liveness_in_stop_wait",
     }
+
+
+def test_overlap_mutant_counterexample_crosses_the_overlap_window():
+    """The overlap_double_emission counterexample is a real overlap run:
+    it prepares BEFORE the stop epoch publishes, activates, and the new
+    generation re-seals an epoch the old generation committed."""
+    trace, _table, _terminals = _first_counterexample(
+        "overlap_double_emission"
+    )
+    labels = [lb for lb, _arg in trace.events]
+    assert "overlap.prepare" in labels
+    assert "overlap.activate" in labels
+    assert labels.index("overlap.prepare") < labels.index("stop.publish")
+    assert trace.violation.startswith(VIOLATIONS.OVERLAP_EMIT)
 
 
 def _first_counterexample(name):

@@ -1,0 +1,291 @@
+"""Generation-overlap rescale + source elasticity e2e (ISSUE 15).
+
+The tentpole acceptance paths, against the real embedded cluster:
+
+  * the autoscaler applies a DS2 SOURCE target end-to-end — the impulse
+    source's parallelism actually changes (split repartition), output is
+    exactly-once, and no tumbling window straddling the rescale boundary
+    splits into two rows;
+  * the rescale itself runs the generation-overlap protocol: the new
+    incarnation stages and restores while the old one drains, the job
+    moves RESCALING -> RUNNING without a SCHEDULING pass, and the
+    `rescale.overlap` span records the output gap;
+  * a cluster stop/restore across a straddling tumbling window emits
+    ONE row per (key, window) — the carried window-split regression;
+  * the controller refuses to FINISH a job whose bounded source claims
+    completion without draining its assigned range (truncation guard).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from arroyo_tpu import obs
+from arroyo_tpu.config import update
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+from arroyo_tpu.controller.state_machine import JobState
+
+
+def _windowed_sql(out_path, n, rate=1000, keys=4, window="1 second"):
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '{rate}',
+      message_count = '{n}', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, start TIMESTAMP, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{out_path}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, window.start as start, cnt FROM (
+      SELECT counter % {keys} as k, tumble(interval '{window}') as window,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+def _read_rows(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _assert_no_window_split(rows, n, keys):
+    """Every (k, window.start) appears EXACTLY once and totals are exact
+    — a straddling window split across a boundary would show the same
+    window twice with partial counts (totals still exact)."""
+    seen = {}
+    total = 0
+    for r in rows:
+        seen.setdefault((r["k"], r["start"]), []).append(r["cnt"])
+        total += r["cnt"]
+    dups = {kw: v for kw, v in seen.items() if len(v) > 1}
+    assert not dups, f"window split into multiple rows: {dups}"
+    assert total == n, f"lost/duplicated events: {total} vs {n}"
+
+
+def test_autoscaler_source_target_via_overlap_rescale(tmp_path):
+    """ISSUE 15 acceptance: the autoscaler's DS2 source target is applied
+    end-to-end. `min_parallelism = 2` clamps every SCALABLE node — now
+    including the elastic impulse source — so the first post-warmup
+    decision deterministically rescales source + window 1 -> 2 through
+    the generation-overlap path. Exactly-once output, no straddling-
+    window split, RESCALING -> RUNNING with no SCHEDULING pass, and the
+    rescale.overlap span carries the measured output gap."""
+    n = 4000
+    out = tmp_path / "out.json"
+    sql = _windowed_sql(out, n)
+
+    async def go():
+        with update(
+            pipeline={"checkpointing": {"interval": 0.25}},
+            obs={"trace_buffer_spans": 32768},
+            autoscale={
+                "enabled": True, "period": 0.3, "warmup_periods": 1,
+                "cooldown_periods": 2, "min_parallelism": 2,
+                "max_parallelism": 2,
+            },
+        ):
+            obs.reset()
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    "ovl", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=2, parallelism=1,
+                )
+                state = await c.wait_for_state(
+                    "ovl", JobState.FINISHED, JobState.FAILED, timeout=90
+                )
+                job = c.jobs["ovl"]
+                spans = [
+                    s for s in obs.recorder().snapshot()
+                    if s.get("name") == "rescale.overlap"
+                ]
+                src_par = {
+                    nid: nd.parallelism
+                    for nid, nd in job.graph.nodes.items()
+                    if nd.is_source
+                }
+                return (state, job.failure, job.rescales, job.restarts,
+                        [(e["from"], e["to"]) for e in job.events],
+                        spans, src_par,
+                        list(job.autoscale_decisions))
+            finally:
+                await c.stop()
+
+    (state, failure, rescales, restarts, events, spans, src_par,
+     decisions) = asyncio.run(go())
+    assert state == JobState.FINISHED, failure
+    assert rescales >= 1, decisions[-6:]
+    # the DS2 source target was ACTUATED: source parallelism changed
+    assert list(src_par.values()) == [2], src_par
+    acted = [d for d in decisions if d["action"] == "rescale"]
+    assert acted, decisions
+    src_nid = next(iter(src_par))
+    assert any(int(d["targets"].get(str(src_nid), d["targets"].get(src_nid, 0)))
+               == 2 for d in acted), (src_nid, acted)
+    # generation overlap: a clean rescale promotes RESCALING -> RUNNING
+    # directly — never through SCHEDULING (no stop-the-world reschedule)
+    if restarts == 0:
+        assert ("Rescaling", "Running") in events, events
+        assert ("Rescaling", "Scheduling") not in events, events
+        # the output-gap span exists and carries the measurement
+        assert spans, "no rescale.overlap span recorded"
+        assert all(float(s["attrs"]["gap_ms"]) > 0 for s in spans)
+    # exactly-once, and the straddling window emitted ONE row
+    _assert_no_window_split(_read_rows(out), n, keys=4)
+
+
+def test_manual_source_rescale_exactly_once(tmp_path):
+    """Direct rescale_job of the SOURCE node (1 -> 2) mid-run: the
+    impulse splits subdivide at the checkpoint boundary, every counter
+    appears exactly once, and the window straddling the boundary stays
+    one row."""
+    n = 4000
+    out = tmp_path / "out.json"
+    sql = _windowed_sql(out, n)
+
+    async def go():
+        with update(pipeline={"checkpointing": {"interval": 0.25}}):
+            obs.reset()
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    "msrc", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=2, parallelism=1,
+                )
+                await c.wait_for_state("msrc", JobState.RUNNING, timeout=30)
+                await asyncio.sleep(1.3)
+                job = c.jobs["msrc"]
+                targets = {
+                    nid: 2 for nid, nd in job.graph.nodes.items()
+                    if nd.is_source
+                }
+                assert targets, "no source node found"
+                await c.rescale_job("msrc", targets)
+                state = await c.wait_for_state(
+                    "msrc", JobState.FINISHED, JobState.FAILED, timeout=90
+                )
+                return (state, job.failure, job.rescales,
+                        {nid: nd.parallelism
+                         for nid, nd in job.graph.nodes.items()
+                         if nd.is_source})
+            finally:
+                await c.stop()
+
+    state, failure, rescales, src_par = asyncio.run(go())
+    assert state == JobState.FINISHED, failure
+    assert rescales == 1
+    assert list(src_par.values()) == [2]
+    rows = _read_rows(out)
+    _assert_no_window_split(rows, n, keys=4)
+    # counter-level exactly-once: counts per key are the planned share
+    per_k = {}
+    for r in rows:
+        per_k[r["k"]] = per_k.get(r["k"], 0) + r["cnt"]
+    assert per_k == {k: n // 4 for k in range(4)}, per_k
+
+
+def test_stop_restore_straddling_window_single_row(tmp_path):
+    """Carried robustness regression (ROADMAP watch item): a tumbling
+    window straddling a cluster stop/restore must emit ONE row — the
+    restore re-opens the straddling window's accumulator (replay-mode
+    impulse resumes INSIDE the window, so the restored partial and the
+    post-restore remainder must merge)."""
+    n = 4000
+    out = tmp_path / "out.json"
+    sql = _windowed_sql(out, n)
+
+    async def phase1():
+        with update(pipeline={"checkpointing": {"interval": 0.25}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    "wsr", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=1, parallelism=1,
+                )
+                await c.wait_for_state("wsr", JobState.RUNNING, timeout=30)
+                # stop ~1.6s in: the 1s tumbling window [1s, 2s) straddles
+                await asyncio.sleep(1.6)
+                await c.stop_job("wsr", mode="checkpoint")
+                state = await c.wait_for_state(
+                    "wsr", JobState.STOPPED, JobState.FAILED, timeout=60
+                )
+                assert state == JobState.STOPPED, c.jobs["wsr"].failure
+            finally:
+                await c.stop()
+
+    async def phase2():
+        with update(pipeline={"checkpointing": {"interval": 0.25}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job(
+                    "wsr", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=1, parallelism=1,
+                )
+                state = await c.wait_for_state(
+                    "wsr", JobState.FINISHED, JobState.FAILED, timeout=90
+                )
+                assert state == JobState.FINISHED, c.jobs["wsr"].failure
+            finally:
+                await c.stop()
+
+    asyncio.run(phase1())
+    asyncio.run(phase2())
+    _assert_no_window_split(_read_rows(out), n, keys=4)
+
+
+def test_controller_refuses_finish_of_undrained_source(tmp_path, monkeypatch):
+    """FINISH guard (carried chaos-plan re-arm bug, second half): a
+    bounded source that returns FINAL with splits undrained must not let
+    the job report FINISHED over a prefix of its output — the controller
+    recovers instead, and with the truncation persisting the job ends
+    FAILED, never falsely FINISHED."""
+    from arroyo_tpu.connectors.impulse import ImpulseSource
+    from arroyo_tpu.operators.base import SourceFinishType
+
+    real_run = ImpulseSource.run
+
+    async def truncated_run(self, ctx, collector):
+        # emit roughly half the range, then lie: claim FINAL completion
+        half = (self.message_count or 0) // 2
+        for sp in self.splits.values():
+            sp["hi"] = min(int(sp["hi"]), half)
+        finish = await real_run(self, ctx, collector)
+        if finish == SourceFinishType.FINAL:
+            # restore the true bound so drain_status sees the deficit
+            for sp in self.splits.values():
+                sp["hi"] = self.message_count
+        return finish
+
+    monkeypatch.setattr(ImpulseSource, "run", truncated_run)
+
+    n = 800
+    out = tmp_path / "out.json"
+    sql = _windowed_sql(out, n, rate=100000)
+
+    async def go():
+        with update(pipeline={"checkpointing": {"interval": 0.25}}):
+            c = await ControllerServer(
+                EmbeddedScheduler(), max_restarts=1
+            ).start()
+            try:
+                await c.submit_job(
+                    "trunc", sql=sql, storage_url=str(tmp_path / "ck"),
+                    n_workers=1, parallelism=1,
+                )
+                state = await c.wait_for_state(
+                    "trunc", JobState.FINISHED, JobState.FAILED, timeout=60
+                )
+                return state, c.jobs["trunc"].failure
+            finally:
+                await c.stop()
+
+    state, failure = asyncio.run(go())
+    assert state == JobState.FAILED, (
+        f"a truncated source run must never report FINISHED ({state})"
+    )
+    assert "without draining" in str(failure), failure

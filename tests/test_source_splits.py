@@ -1,0 +1,249 @@
+"""Source split elasticity (ISSUE 15): repartitionable offset state.
+
+Property tests over connectors/splits.py: offsets conserved — no gap,
+no overlap — across 1 -> 4 -> 2 -> 3 repartitions with interleaved
+progress, per connector split algebra (impulse counter progressions,
+nexmark residue classes, kafka partition reassignment), plus the
+operator-level round trip through the real global-table checkpoint
+keys (parent splits superseded by their checkpointed children)."""
+
+import asyncio
+import random
+
+import pytest
+
+from arroyo_tpu.connectors import splits as sm
+
+
+# -- simulation helpers -------------------------------------------------------
+
+
+def _advance_impulse(payload, k):
+    """Emit up to k events from an impulse split; returns emitted counters."""
+    out = []
+    step = int(payload.get("step", 1))
+    hi = payload.get("hi")
+    for _ in range(k):
+        nxt = int(payload["next"])
+        if hi is not None and nxt >= int(hi):
+            break
+        out.append((int(payload["emit"]), nxt))
+        payload["next"] = nxt + step
+    return out
+
+
+def _advance_nexmark(payload, k, message_count):
+    out = []
+    m = int(payload["mod"])
+    for _ in range(k):
+        n = sm.nexmark_next_n(payload)
+        if n >= message_count:
+            break
+        out.append(n)
+        payload["i"] = int(payload["i"]) + 1
+    return out
+
+
+def _repartition(splits, parallelism, subdivide):
+    """What the N subtasks of one incarnation collectively do at restore:
+    derive the subdivided set from the same union and take disjoint
+    ownership. Returns [owned-dict per subtask]."""
+    ensured = sm.ensure_splits(splits, parallelism, subdivide)
+    owners = [sm.owned(ensured, parallelism, i) for i in range(parallelism)]
+    # ownership is a disjoint cover of the ensured set
+    ids = sorted(sid for o in owners for sid in o)
+    assert ids == sorted(ensured), "ownership must cover exactly once"
+    return owners
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_impulse_offsets_conserved_across_1_4_2_3(seed):
+    """1 -> 4 -> 2 -> 3 repartitions with random interleaved progress:
+    the union of emitted (emit, counter) pairs is exactly each planned
+    stream's [0, hi) with no duplicate."""
+    rng = random.Random(seed)
+    hi = 500
+    splits = sm.impulse_plan(1, hi)
+    emitted = []
+    for parallelism in (1, 4, 2, 3):
+        owners = _repartition(splits, parallelism, sm.impulse_subdivide)
+        # random partial progress per subtask (checkpoint mid-stream)
+        for owned in owners:
+            for payload in owned.values():
+                emitted += _advance_impulse(payload, rng.randint(0, 120))
+        # "checkpoint": the union the next incarnation restores is every
+        # subtask's owned splits as-progressed
+        splits = {sid: p for o in owners for sid, p in o.items()}
+    # final incarnation drains everything
+    owners = _repartition(splits, 2, sm.impulse_subdivide)
+    for owned in owners:
+        for payload in owned.values():
+            emitted += _advance_impulse(payload, hi + 1)
+    assert sorted(emitted) == [(0, c) for c in range(hi)], (
+        f"gap/overlap: {len(emitted)} emitted vs {hi} expected"
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("initial_p", [1, 3])
+def test_nexmark_sequence_conserved_across_repartitions(seed, initial_p):
+    """The nexmark residue-class algebra conserves the GLOBAL sequence
+    exactly across 1 -> 4 -> 2 -> 3 (or 3 -> 4 -> 2 -> 3) repartitions:
+    every n in [0, message_count) generated once."""
+    rng = random.Random(seed)
+    mc = 700
+    splits = sm.nexmark_plan(initial_p)
+    emitted = []
+    for parallelism in (initial_p, 4, 2, 3):
+        owners = _repartition(splits, parallelism, sm.nexmark_subdivide)
+        for owned in owners:
+            for payload in owned.values():
+                emitted += _advance_nexmark(payload, rng.randint(0, 90), mc)
+        splits = {sid: p for o in owners for sid, p in o.items()}
+    owners = _repartition(splits, 4, sm.nexmark_subdivide)
+    for owned in owners:
+        for payload in owned.values():
+            emitted += _advance_nexmark(payload, mc, mc)
+    assert sorted(emitted) == list(range(mc)), (
+        f"gap/overlap: {len(emitted)} emitted vs {mc}"
+    )
+
+
+def test_nexmark_subdivision_is_index_exact():
+    """(r, m, i) -> (r, 2m, ceil(i/2)) + (r+m, 2m, floor(i/2)): the
+    children's remaining sets partition the parent's remaining set, for
+    every progress point."""
+    mc = 97
+    for i in range(0, 40):
+        parent = {"r": 1, "mod": 3, "i": i}
+        kids = sm.nexmark_subdivide("n1", dict(parent))
+        remaining_parent = set(sm.nexmark_sequence(parent, mc))
+        remaining_kids = set()
+        for p in kids.values():
+            s = set(sm.nexmark_sequence(p, mc))
+            assert not (s & remaining_kids), "overlapping children"
+            remaining_kids |= s
+        assert remaining_kids == remaining_parent, f"i={i}"
+
+
+def test_impulse_subdivision_handles_unbounded_and_exhausted():
+    # unbounded splits subdivide (stride doubling needs no upper bound)
+    kids = sm.impulse_subdivide("i0", {"emit": 0, "next": 7, "step": 1,
+                                       "hi": None})
+    assert set(kids) == {"i0.0", "i0.1"}
+    a, b = kids["i0.0"], kids["i0.1"]
+    assert (a["next"], a["step"]) == (7, 2)
+    assert (b["next"], b["step"]) == (8, 2)
+    # exhausted splits refuse (nothing left to repartition)
+    assert sm.impulse_subdivide(
+        "i0", {"emit": 0, "next": 5, "step": 1, "hi": 5}
+    ) is None
+
+
+def test_ensure_splits_is_deterministic_and_position_free():
+    """Every subtask derives the identical subdivision from the identical
+    union — the property the coordination-free restore relies on."""
+    base = sm.nexmark_plan(2)
+    a = sm.ensure_splits(base, 7, sm.nexmark_subdivide)
+    b = sm.ensure_splits(base, 7, sm.nexmark_subdivide)
+    assert a == b and len(a) >= 7
+    # and it never mutates its input
+    assert base == sm.nexmark_plan(2)
+
+
+def test_load_splits_drops_superseded_parents():
+    class FakeTable:
+        def __init__(self, d):
+            self.d = d
+
+        def items(self):
+            return self.d.items()
+
+    t = FakeTable({
+        sm.split_key("i0"): {"emit": 0, "next": 3, "step": 1, "hi": 10},
+        sm.split_key("i0.0"): {"emit": 0, "next": 4, "step": 2, "hi": 10},
+        sm.split_key("i0.1"): {"emit": 0, "next": 5, "step": 2, "hi": 10},
+        sm.split_key("i1"): {"emit": 1, "next": 0, "step": 1, "hi": 10},
+        7: 123,  # legacy int key ignored
+    })
+    got = sm.load_splits(t)
+    assert set(got) == {"i0.0", "i0.1", "i1"}
+
+
+# -- operator-level round trip (real checkpoint keys) -------------------------
+
+
+class _Table:
+    """Minimal global-table stand-in with the replicated-union shape."""
+
+    def __init__(self):
+        self.d = {}
+
+    def items(self):
+        return dict(self.d).items()
+
+    def get(self, k, default=None):
+        return self.d.get(k, default)
+
+    def put(self, k, v):
+        self.d[k] = v
+
+
+class _Ctx:
+    def __init__(self, table, index, parallelism):
+        from arroyo_tpu.types import TaskInfo
+
+        self.table_manager = object()  # non-None: state path active
+        self.task_info = TaskInfo("j", 1, "src", index, parallelism)
+        self._t = table
+
+    async def table(self, name):
+        return self._t
+
+
+def _impulse_round(table, parallelism, advance):
+    """One incarnation, barrier-shaped like the real lifecycle: EVERY
+    subtask restores from the same epoch's union first, then progresses,
+    then all checkpoint at the same barrier. Returns emitted
+    (emit, counter) pairs."""
+    from arroyo_tpu.connectors.impulse import ImpulseSource
+
+    emitted = []
+
+    async def go():
+        incarnation = []
+        for i in range(parallelism):
+            src = ImpulseSource(message_count=40)
+            ctx = _Ctx(table, i, parallelism)
+            await src.on_start(ctx)
+            incarnation.append((src, ctx))
+        for src, _ctx in incarnation:
+            for payload in src.splits.values():
+                emitted.extend(_advance_impulse(payload, advance))
+        for src, ctx in incarnation:
+            await src.handle_checkpoint(None, ctx, None)
+
+    asyncio.run(go())
+    return emitted
+
+
+def test_impulse_operator_round_trip_1_4_2():
+    table = _Table()
+    emitted = _impulse_round(table, 1, 13)
+    emitted += _impulse_round(table, 4, 5)
+    emitted += _impulse_round(table, 2, 100)
+    assert sorted(emitted) == [(0, c) for c in range(40)]
+    # split state persisted under split keys, never bare subtask ints
+    assert all(
+        isinstance(k, str) and k.startswith(sm.SPLIT_PREFIX)
+        for k in table.d
+    )
+
+
+def test_impulse_legacy_state_upgrades_in_place():
+    """A pre-elasticity checkpoint (bare int task-index -> counter) is
+    adopted as split positions, so old checkpoints restore exactly."""
+    table = _Table()
+    table.put(0, 17)  # legacy: subtask 0 at counter 17
+    emitted = _impulse_round(table, 1, 100)
+    assert sorted(emitted) == [(0, c) for c in range(17, 40)]

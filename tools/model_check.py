@@ -143,6 +143,10 @@ def main(argv=None) -> int:
                     help="total fault-event budget")
     ap.add_argument("--restarts", type=int, default=None)
     ap.add_argument("--rescales", type=int, default=None)
+    ap.add_argument("--overlap", type=int, default=None,
+                    help="1 = rescales use the generation-overlap window "
+                    "(ISSUE 15: prepare while draining, activate at the "
+                    "durable rescale checkpoint)")
     ap.add_argument("--reads", type=int, default=None,
                     help="StateServe reader-actor event budget")
     ap.add_argument("--budget", type=int, default=4_000_000,
@@ -331,7 +335,7 @@ def main(argv=None) -> int:
         overrides = {
             k: getattr(args, k)
             for k in ("workers", "epochs", "inflight", "faults",
-                      "restarts", "rescales", "reads")
+                      "restarts", "rescales", "overlap", "reads")
             if getattr(args, k) is not None
         }
         if overrides:

@@ -1,0 +1,447 @@
+"""Shared-plan operator lifecycle: one barrier, per-tenant epochs.
+
+Shared-plan multi-tenancy (ISSUE 16) mounts N tenant jobs onto ONE
+shared source/operator chain (a hidden host job). The host checkpoints
+on its own cadence; each mounted tenant checkpoints its consumed bus
+OFFSET in its own manifest chain. Exactly-once then hangs on a single
+cross-job obligation the single-job model (spec.py) and the 2-job
+shared-WORKER model (multitenant.py) cannot see:
+
+  the host's durable restore offset must never pass a mounted tenant's
+  durable position. After a crash the host replays from its last
+  PUBLISHED epoch's offset; a tenant whose published position is behind
+  that offset has a gap the host will never re-emit — silent per-tenant
+  data loss.
+
+The controller's publication gate discharges it: host epoch E publishes
+only once every MOUNTED subscriber has published a tenant checkpoint at
+position >= E's offset (shared fate on the barrier, per-tenant epochs
+reconciled). Detach (refcounted, job-scoped) removes a tenant from the
+gate set so one tenant's stop never stalls co-mounted tenants, and the
+host is torn down only when the LAST tenant detaches.
+
+Model shape: one host counter pair (captured epoch `h_cap`, published
+epoch `h_pub`, at most one epoch in flight) over `tenants` subscriber
+machines, each with a captured/published position pair (epoch-granular:
+position k == the offset of host epoch k), a mounted flag, and a gate
+membership flag. The one fault is the process kill: host restores to
+`h_pub`, every tenant restores to its published position.
+
+Mutants (each expected to be CAUGHT; the faithful model is clean):
+
+  * `leaked_barrier_across_tenants` — the publication gate is skipped:
+    the host publishes epoch E while a mounted tenant's durable
+    position is still behind it. The kill then restores the host AHEAD
+    of that tenant (V_LOSS): the barrier's shared fate leaked across
+    tenant epoch chains. The counterexample's kill serializes to a
+    seeded chaos FaultPlan replayable via tools/chaos_drill.py --plan.
+  * `detach_leaves_gate` — detach clears the mount but NOT the gate
+    membership: a stopped tenant keeps gating host publication forever
+    and co-mounted tenants stall behind a barrier that can never clear
+    (V_STALL).
+  * `teardown_on_first_detach` — the refcount is ignored: the FIRST
+    detach tears the shared host down under the remaining tenants
+    (V_ORPHAN).
+
+Explored exhaustively by `check_sharedplan`; wired into
+tools/model_check.py (--shared, corpus) and tests/test_model_check.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+
+class SPConfig(NamedTuple):
+    tenants: int = 2          # jobs mounted on the shared chain
+    epochs: int = 3           # host checkpoint epochs
+    kills: int = 1            # process-kill fault budget
+    mutant: str = ""          # "" | a SP_MUTANTS key
+
+
+class SPSys(NamedTuple):
+    h_cap: int = 0            # host epoch captured (offset frozen)
+    h_pub: int = 0            # host epoch published (durable restore point)
+    host_alive: bool = True   # shared chain still running
+    mounted: Tuple[bool, ...] = ()
+    gate: Tuple[bool, ...] = ()   # publication-gate membership
+    cap: Tuple[int, ...] = ()     # tenant captured position (epochs)
+    pub: Tuple[int, ...] = ()     # tenant published position (durable)
+    kills: int = 0
+
+
+class SPStep(NamedTuple):
+    label: str
+    arg: Tuple
+    nxt: Optional[SPSys]
+    violation: str = ""
+
+
+class SPTrace(NamedTuple):
+    violation: str
+    events: List[Tuple[str, Tuple]]
+    config: dict
+
+    def to_json(self) -> dict:
+        return {
+            "violation": self.violation,
+            "events": [[label, list(arg)] for label, arg in self.events],
+            "config": dict(self.config),
+            "model": "sharedplan",
+        }
+
+    def fault_events(self) -> List[Tuple[str, Tuple]]:
+        return [(label, arg) for label, arg in self.events
+                if label == "sp.kill"]
+
+
+class SPResult(NamedTuple):
+    states: int
+    transitions: int
+    violations: List[SPTrace]
+    exhaustive: bool
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+
+V_LOSS = "tenant-position-behind-host-restore"
+V_STALL = "detached-tenant-gates-barrier"
+V_ORPHAN = "host-torn-down-under-tenant"
+V_DEADLOCK = "sharedplan-deadlock"
+
+
+def _initial(cfg: SPConfig) -> SPSys:
+    n = cfg.tenants
+    return SPSys(
+        mounted=tuple(True for _ in range(n)),
+        gate=tuple(True for _ in range(n)),
+        cap=tuple(0 for _ in range(n)),
+        pub=tuple(0 for _ in range(n)),
+    )
+
+
+def _set(t: Tuple, i: int, v) -> Tuple:
+    lst = list(t)
+    lst[i] = v
+    return tuple(lst)
+
+
+class SPModel:
+    """Enabled-transition enumerator over host x tenant positions."""
+
+    def __init__(self, cfg: SPConfig):
+        self.cfg = cfg
+
+    def done(self, s: SPSys) -> bool:
+        # the host is refcount-released when the last tenant detaches;
+        # a run where every tenant detached is terminal
+        return not any(s.mounted)
+
+    # -- enumeration ---------------------------------------------------------
+
+    def enabled(self, s: SPSys) -> List[SPStep]:
+        cfg = self.cfg
+        out: List[SPStep] = []
+        if s.host_alive and any(s.mounted):
+            # host capture: freeze the next epoch's offset (one barrier
+            # for everyone; at most one epoch in flight)
+            if s.h_cap < cfg.epochs and s.h_cap == s.h_pub:
+                out.append(SPStep(
+                    "sp.host_capture", (s.h_cap + 1,),
+                    s._replace(h_cap=s.h_cap + 1),
+                ))
+            # host publish: the PUBLICATION GATE — epoch h_cap becomes
+            # the durable restore point only once every gate member has
+            # durably published a position that covers its offset. The
+            # leaked-barrier mutant skips the gate entirely.
+            if s.h_pub < s.h_cap:
+                gated = (cfg.mutant != "leaked_barrier_across_tenants"
+                         and any(s.gate[t] and s.pub[t] < s.h_cap
+                                 for t in range(cfg.tenants)))
+                if not gated:
+                    out.append(SPStep(
+                        "sp.host_publish", (s.h_cap,),
+                        s._replace(h_pub=s.h_cap),
+                    ))
+        for t in range(cfg.tenants):
+            if not s.mounted[t]:
+                continue
+            # tenant capture: the mounted source checkpoints its
+            # consumed offset (it can always catch up to the host's
+            # captured epoch — the offset total order makes any capture
+            # alignment safe)
+            if s.cap[t] < s.h_cap:
+                out.append(SPStep(
+                    "sp.tenant_capture", (t, s.cap[t] + 1),
+                    s._replace(cap=_set(s.cap, t, s.cap[t] + 1)),
+                ))
+            # tenant publish: the tenant's own manifest chain commits
+            if s.pub[t] < s.cap[t]:
+                out.append(SPStep(
+                    "sp.tenant_publish", (t, s.cap[t]),
+                    s._replace(pub=_set(s.pub, t, s.cap[t])),
+                ))
+            out.append(self._detach(s, t))
+        if s.kills < cfg.kills and s.host_alive and any(s.mounted):
+            out.append(self._kill(s))
+        return out
+
+    def _detach(self, s: SPSys, t: int) -> SPStep:
+        cfg = self.cfg
+        mounted = _set(s.mounted, t, False)
+        # job-scoped detach: leave the gate with the mount — the
+        # detach_leaves_gate mutant forgets the gate half, so a stopped
+        # tenant keeps stalling the co-mounted ones
+        gate = (s.gate if cfg.mutant == "detach_leaves_gate"
+                else _set(s.gate, t, False))
+        host_alive = s.host_alive and any(mounted)
+        if cfg.mutant == "teardown_on_first_detach":
+            # refcount ignored: the first stop tears the host down
+            host_alive = False
+        return SPStep(
+            "sp.tenant_detach", (t,),
+            s._replace(mounted=mounted, gate=gate, host_alive=host_alive),
+        )
+
+    def _kill(self, s: SPSys) -> SPStep:
+        cfg = self.cfg
+        # process kill + recovery: the host restores from its last
+        # PUBLISHED epoch's offset; every tenant restores from its own
+        # published position. A mounted tenant behind the host's restore
+        # point has a gap the replay will never cover — per-tenant data
+        # loss, the exact state the publication gate makes unreachable.
+        for t in range(cfg.tenants):
+            if s.mounted[t] and s.pub[t] < s.h_pub:
+                return SPStep(
+                    "sp.kill", (), None,
+                    f"{V_LOSS}: tenant {t} restored at position "
+                    f"{s.pub[t]} but the host replays from published "
+                    f"epoch {s.h_pub} — rows in between are lost for "
+                    f"this tenant (publication gate leaked)",
+                )
+        return SPStep(
+            "sp.kill", (),
+            s._replace(
+                h_cap=s.h_pub,
+                cap=tuple(s.pub[t] if s.mounted[t] else s.cap[t]
+                          for t in range(cfg.tenants)),
+                kills=s.kills + 1,
+            ),
+        )
+
+    # -- invariants ----------------------------------------------------------
+
+    def check_state(self, s: SPSys,
+                    enabled: List[SPStep]) -> Optional[str]:
+        # refcount independence: a mounted tenant must always have a
+        # live host under it
+        for t in range(self.cfg.tenants):
+            if s.mounted[t] and not s.host_alive:
+                return (f"{V_ORPHAN}: tenant {t} is still mounted but "
+                        f"the shared host was torn down (refcounted "
+                        f"release broken)")
+        # detach independence: if publication is blocked and every
+        # MOUNTED tenant has already published past the barrier, the
+        # only thing holding the gate is a tenant that already detached
+        # — one tenant's stop is stalling its co-tenants forever
+        if s.host_alive and s.h_pub < s.h_cap:
+            mounted_ready = all(
+                s.pub[t] >= s.h_cap
+                for t in range(self.cfg.tenants) if s.mounted[t]
+            )
+            stale = [t for t in range(self.cfg.tenants)
+                     if s.gate[t] and not s.mounted[t]
+                     and s.pub[t] < s.h_cap]
+            if mounted_ready and stale:
+                return (f"{V_STALL}: host epoch {s.h_cap} cannot "
+                        f"publish — detached tenant(s) {stale} still "
+                        f"hold the publication gate while every mounted "
+                        f"tenant has already reconciled")
+        if not self.done(s) and not enabled:
+            return (f"{V_DEADLOCK}: host {s.h_cap}/{s.h_pub}, "
+                    f"tenants cap={s.cap} pub={s.pub}")
+        return None
+
+
+def check_sharedplan(cfg: SPConfig, budget: int = 500_000) -> SPResult:
+    """BFS the host x tenants product; violations carry replayable
+    event paths."""
+    model = SPModel(cfg)
+    init = _initial(cfg)
+    parent: Dict[SPSys, Optional[Tuple[SPSys, Tuple[str, Tuple]]]] = {
+        init: None
+    }
+    frontier = deque([init])
+    violations: List[SPTrace] = []
+    seen_kinds: Set[str] = set()
+    n_trans = 0
+    exhaustive = True
+
+    def record(state: SPSys, ev, violation: str):
+        kind = violation.split(":", 1)[0]
+        if kind in seen_kinds:
+            return
+        seen_kinds.add(kind)
+        events: List[Tuple[str, Tuple]] = [ev] if ev else []
+        cur = state
+        while parent[cur] is not None:
+            prev, e = parent[cur]
+            events.append(e)
+            cur = prev
+        events.reverse()
+        violations.append(SPTrace(violation, events, cfg._asdict()))
+
+    while frontier:
+        if len(parent) > budget:
+            exhaustive = False
+            break
+        state = frontier.popleft()
+        steps = model.enabled(state)
+        inv = model.check_state(state, steps)
+        if inv is not None:
+            record(state, None, inv)
+            continue
+        if model.done(state):
+            continue
+        for st in steps:
+            n_trans += 1
+            if st.violation:
+                record(state, (st.label, st.arg), st.violation)
+                continue
+            if st.nxt is None or st.nxt in parent:
+                continue
+            parent[st.nxt] = (state, (st.label, st.arg))
+            frontier.append(st.nxt)
+
+    return SPResult(states=len(parent), transitions=n_trans,
+                    violations=violations, exhaustive=exhaustive)
+
+
+# -- replay: deterministic re-execution + seeded chaos plan ------------------
+
+
+class SPReplayDivergence(Exception):
+    """The trace names an event the model does not offer at that state."""
+
+
+def replay_sharedplan(trace: SPTrace) -> str:
+    """Re-execute an SPTrace event-for-event on a fresh model built from
+    its recorded config; return the violation label reached."""
+    cfg = SPConfig(**{k: v for k, v in dict(trace.config).items()
+                      if k in SPConfig._fields})
+    model = SPModel(cfg)
+    state = _initial(cfg)
+    for i, (label, arg) in enumerate(trace.events):
+        steps = model.enabled(state)
+        match = [st for st in steps
+                 if st.label == label and tuple(st.arg) == tuple(arg)]
+        if not match:
+            offered = sorted({(st.label, tuple(st.arg)) for st in steps})
+            raise SPReplayDivergence(
+                f"event {i} {label}{tuple(arg)}: not enabled; "
+                f"offered {offered}"
+            )
+        st = match[0]
+        if st.violation:
+            return st.violation
+        if st.nxt is None:
+            raise SPReplayDivergence(
+                f"event {i} {label}{tuple(arg)}: dead step without "
+                f"violation"
+            )
+        state = st.nxt
+    inv = model.check_state(state, model.enabled(state))
+    if inv is not None:
+        return inv
+    raise SPReplayDivergence("trace replayed to a state with no violation")
+
+
+def sp_trace_seed(trace: SPTrace) -> int:
+    """Deterministic seed from the trace content (not object identity)."""
+    payload = json.dumps(trace.to_json(), sort_keys=True).encode()
+    return int.from_bytes(hashlib.sha1(payload).digest()[:4], "big")
+
+
+def sp_trace_to_fault_plan(trace: SPTrace):
+    """Serialize the counterexample's kill schedule as a seeded chaos
+    FaultPlan: the model's process kill maps onto the worker.kill seam
+    mid-checkpoint, which is exactly the window where a leaked
+    publication gate would lose a tenant's rows end-to-end."""
+    import random
+
+    from ... import chaos
+
+    seed = sp_trace_seed(trace)
+    rng = random.Random(seed)
+    plan = chaos.FaultPlan(seed)
+    for _label, _arg in trace.fault_events():
+        plan.add("worker.kill", at_hits=(rng.randint(8, 16),))
+    return plan
+
+
+def sp_counterexample_payload(trace: SPTrace) -> dict:
+    """The artifact written next to a violation: trace + replayable
+    chaos plan + the drill command that runs it (the shared-fleet drill,
+    so the kill lands on a worker hosting the shared chain)."""
+    plan = sp_trace_to_fault_plan(trace)
+    return {
+        "trace": trace.to_json(),
+        "fault_plan": json.loads(plan.to_json()),
+        "replay_command": (
+            "python tools/chaos_drill.py --shared --plan <this-file> "
+            "# runs the serialized fault_plan against a shared-mount "
+            "embedded fleet"
+        ),
+    }
+
+
+class SPMutant(NamedTuple):
+    name: str
+    description: str
+    expect_violation: str
+    config: SPConfig
+
+
+SP_MUTANTS: Dict[str, SPMutant] = {
+    m.name: m
+    for m in [
+        SPMutant(
+            name="leaked_barrier_across_tenants",
+            description=(
+                "the host publishes a checkpoint epoch without waiting "
+                "for every mounted tenant's durable position to cover "
+                "it (publication gate skipped): a kill then restores "
+                "the shared chain AHEAD of a tenant's manifest chain "
+                "and that tenant's gap rows are never replayed"
+            ),
+            expect_violation=V_LOSS,
+            config=SPConfig(mutant="leaked_barrier_across_tenants"),
+        ),
+        SPMutant(
+            name="detach_leaves_gate",
+            description=(
+                "a tenant's detach removes the mount but not its "
+                "publication-gate membership: the stopped tenant gates "
+                "every later host epoch and co-mounted tenants stall "
+                "forever (job-scoped detach broken)"
+            ),
+            expect_violation=V_STALL,
+            config=SPConfig(mutant="detach_leaves_gate"),
+        ),
+        SPMutant(
+            name="teardown_on_first_detach",
+            description=(
+                "the mount refcount is ignored and the first tenant's "
+                "stop tears down the shared host under the remaining "
+                "mounted tenants"
+            ),
+            expect_violation=V_ORPHAN,
+            config=SPConfig(mutant="teardown_on_first_detach"),
+        ),
+    ]
+}

@@ -573,6 +573,31 @@ class AdmissionConfig:
 
 
 @dataclasses.dataclass
+class SharingConfig:
+    """Shared-plan multi-tenancy (ISSUE 16): jobs whose source scans
+    fingerprint identically (sql/fingerprint.py) mount one shared scan —
+    a hidden `__shared/<fp>` host job publishing into a process-local
+    retained-log bus (engine/shared.py) — instead of each spawning a
+    copy. Only deterministic-replay sources (impulse/nexmark with an
+    explicit start_time, non-wall-clock event time) at source
+    parallelism 1 qualify; everything else spawns unshared as before."""
+
+    # master switch: off = every job owns its data plane (legacy). Kept
+    # off by default — mounting changes which process generates a job's
+    # rows, so fleets opt in explicitly.
+    enabled: bool = False
+    # rows the bus retains past the slowest attached reader before the
+    # host scan blocks (shared-fate backpressure); also the soft cap
+    # past which fully-consumed entries below every tenant's durable
+    # restore floor are trimmed
+    max_retained_rows: int = 4_194_304
+    # storage url for the hidden host job's checkpoints; empty = host
+    # runs without durable state (a host restart replays the scan from
+    # offset 0, which deterministic sources make byte-identical)
+    host_storage_url: str = ""
+
+
+@dataclasses.dataclass
 class ControllerConfig:
     rpc_port: int = 9190  # controller gRPC port workers register against
     scheduler: str = "embedded"  # embedded | process | node | kubernetes
@@ -664,7 +689,8 @@ class Config:
     kernels + mesh), controller, rescale (generation-overlap
     zero-downtime rescale), cluster (shared worker pool /
     multiplexing), admission (tenant quotas + fair slot scheduling),
-    worker, api, admin, database, logging. `tools/lint.py
+    sharing (shared-plan multi-tenancy: fingerprint-matched jobs mount
+    one source scan), worker, api, admin, database, logging. `tools/lint.py
     --config-table` prints the full resolved key/default table;
     arroyolint CFG001 rejects reads of undeclared keys."""
 
@@ -679,6 +705,7 @@ class Config:
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     tpu: TpuConfig = dataclasses.field(default_factory=TpuConfig)
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
+    sharing: SharingConfig = dataclasses.field(default_factory=SharingConfig)
     rescale: RescaleConfig = dataclasses.field(default_factory=RescaleConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)

@@ -35,6 +35,7 @@ from . import nats  # noqa: F401,E402
 from . import rabbitmq  # noqa: F401,E402
 from . import kinesis  # noqa: F401,E402
 from . import fluvio  # noqa: F401,E402
+from . import shared  # noqa: F401,E402
 
 
 def _conn_schema(config: dict) -> ConnectionSchema:

@@ -1,6 +1,14 @@
 """AWS Kinesis connector (reference: crates/arroyo-connectors/src/kinesis/,
 955 LoC). Shard iterators checkpoint by sequence number. Client gated on
-boto3/aioboto3."""
+boto3/aioboto3.
+
+Offset state rides the per-SPLIT scheme (connectors/splits.py): each
+shard is one split, checkpointed under `split_key(shard_id)` instead of
+the consuming subtask's index, so a rescale moves the shard's position
+with its ownership from the replicated union — no per-subtask snapshot
+merging. Shards cannot subdivide broker-side (like kafka partitions),
+so elasticity is reassignment-only. Legacy per-subtask snapshots
+(task_index -> {shard: seq}) still merge on restore."""
 
 from __future__ import annotations
 
@@ -12,6 +20,7 @@ from ..formats.de import Deserializer
 from ..formats.ser import Serializer
 from ._gated import require_client
 from .base import ConnectionSchema, Connector, register_connector
+from .splits import SPLIT_PREFIX, split_key
 
 # position sentinel for a shard fully drained after a split/merge
 CLOSED = "__closed__"
@@ -47,34 +56,43 @@ class KinesisSource(SourceOperator):
 
         return {"kin": global_table("kin")}
 
+    def _merge_position(self, sid: str, pos) -> None:
+        """Entries can overlap after a reassignment (or a split entry vs
+        a legacy snapshot): CLOSED wins, else the furthest sequence
+        number (Kinesis sequence numbers are numeric strings)."""
+        if pos is None:
+            return
+        cur = self.positions.get(sid)
+        if cur == CLOSED:
+            return
+        if pos == CLOSED:
+            self.positions[sid] = pos
+        elif cur is None or _seq_ge(pos, cur):
+            self.positions[sid] = pos
+
     async def on_start(self, ctx):
         if ctx.table_manager is not None:
             table = await ctx.table("kin")
-            # merge every subtask's snapshot: shard ownership is by hash,
-            # so a rescale can move a shard between subtasks and its
-            # position must follow it. Snapshots can overlap after a
-            # rescale — CLOSED wins, else the furthest sequence number
-            # (Kinesis sequence numbers are numeric strings)
-            for stored in table.all_values():
-                for sid, pos in (stored or {}).items():
-                    cur = self.positions.get(sid)
-                    if cur == CLOSED:
-                        continue
-                    if pos == CLOSED:
-                        self.positions[sid] = pos
-                    elif cur is None or _seq_ge(pos, cur):
-                        self.positions[sid] = pos
+            # per-SPLIT entries (split_key(shard) -> {"seq": pos}), plus
+            # legacy per-subtask snapshots: shard ownership is by hash,
+            # so a rescale moves a shard between subtasks and its
+            # position follows it through the replicated union
+            for k, stored in table.items():
+                if isinstance(k, str) and k.startswith(SPLIT_PREFIX):
+                    self._merge_position(k[len(SPLIT_PREFIX):],
+                                         (stored or {}).get("seq"))
+                else:
+                    for sid, pos in (stored or {}).items():
+                        self._merge_position(sid, pos)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         if ctx.table_manager is not None:
             table = await ctx.table("kin")
-            table.put(
-                ctx.task_info.task_index,
-                {
-                    sid: pos for sid, pos in self.positions.items()
-                    if self._owned(sid, ctx)
-                },
-            )
+            # one entry per SPLIT (shard), keyed by the shard id, never
+            # the consuming subtask's index (reassignment-only scheme)
+            for sid, pos in self.positions.items():
+                if self._owned(sid, ctx):
+                    table.put(split_key(sid), {"seq": pos})
 
     def _owned(self, shard_id: str, ctx) -> bool:
         """Stable shard -> subtask assignment: crc32 of the shard's ROOT

@@ -4,19 +4,29 @@ Capability parity with the reference's polling_http connector
 (/root/reference/crates/arroyo-connectors/src/polling_http/, 521 LoC):
 polls an endpoint on an interval, optionally emitting only when the
 response body changes.
+
+State rides the per-SPLIT scheme (connectors/splits.py) as a single
+split `p0` holding the last-emitted body digest and the poll count, so
+`emit_behavior = changed` deduplicates ACROSS restarts: a restore does
+not re-emit the body it already delivered before the crash. The single
+split's round-robin owner is subtask 0 at any parallelism.
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
 from typing import Optional
 
 from ..operators.base import SourceFinishType, SourceOperator
 from ..formats.de import Deserializer
 from .base import ConnectionSchema, Connector, register_connector
+from . import splits as sm
 
 
 class PollingHttpSource(SourceOperator):
+    SPLIT_ID = "p0"
+
     def __init__(self, endpoint: str, interval: float, emit_behavior: str,
                  method: str, body: Optional[str], headers: dict,
                  schema, format: str, bad_data: str):
@@ -31,12 +41,33 @@ class PollingHttpSource(SourceOperator):
         self.deserializer = Deserializer(schema, format=format or "json",
                                          bad_data=bad_data,
                                          framing="newline")
-        self.last_body: Optional[bytes] = None
+        self.last_sha: Optional[str] = None  # digest of last emitted body
+        self.polls = 0
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"poll": global_table("poll")}
+
+    async def on_start(self, ctx):
+        if ctx.table_manager is not None:
+            table = await ctx.table("poll")
+            stored = sm.load_splits(table).get(self.SPLIT_ID)
+            if stored:
+                self.last_sha = stored.get("etag")
+                self.polls = int(stored.get("polls", 0))
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None and ctx.task_info.task_index == 0:
+            table = await ctx.table("poll")
+            table.put(sm.split_key(self.SPLIT_ID),
+                      {"etag": self.last_sha, "polls": self.polls})
 
     async def run(self, ctx, collector) -> SourceFinishType:
         import aiohttp
 
         if ctx.task_info.task_index != 0:
+            # the single split's owner (round-robin rank 0)
             return SourceFinishType.FINAL
         async with aiohttp.ClientSession() as session:
             while True:
@@ -53,8 +84,11 @@ class PollingHttpSource(SourceOperator):
                     ctx.error_reporter.report("poll failed", str(e))
                     await asyncio.sleep(self.interval)
                     continue
-                if self.emit_behavior != "changed" or payload != self.last_body:
-                    self.last_body = payload
+                self.polls += 1
+                digest = hashlib.sha256(payload).hexdigest()
+                if self.emit_behavior != "changed" \
+                        or digest != self.last_sha:
+                    self.last_sha = digest
                     for row in self.deserializer.deserialize_slice(
                         payload, error_reporter=ctx.error_reporter
                     ):

@@ -1,0 +1,200 @@
+"""Shared-plan connectors (ISSUE 16): the host tail and the tenant mount.
+
+Two halves of one seam (see engine/shared.py for the bus semantics):
+
+  * `shared_bus` (sink) — the HOST job's tail. The hidden
+    `__shared/<fp>` job is just `deterministic source -> shared_bus`;
+    this sink assigns each batch its absolute cumulative row offset,
+    publishes it into the SharedChannel, and checkpoints the offset so
+    a host restart resumes (and rewinds the log to) exactly where the
+    last published epoch left off.
+  * `mounted` (source) — each TENANT job's head. The controller rewrote
+    the tenant's source op to this connector at admission; it attaches
+    to the channel at its checkpointed position and re-emits the host's
+    batches verbatim (they already carry `_timestamp`), so the rest of
+    the tenant pipeline — watermarks, windows, sinks — is untouched
+    and unaware it shares a scan.
+
+Per-tenant exactly-once rests on three legs: (1) absolute row offsets —
+a restored tenant re-reads from its checkpointed position and a host
+rewind re-publishes identical rows (deterministic sources only, see
+sql/fingerprint.py); (2) the controller's publication gate
+(controller/sharing.py) keeps the host's durable offset from
+overtaking any mounted tenant's durable position; (3) positions ride
+the tenants' own manifest chains (a global state table per tenant
+job), so one tenant's restore never touches another's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..engine.shared import BUS
+from ..operators.base import Operator, SourceFinishType, SourceOperator
+from .base import ConnectionSchema, Connector, register_connector
+
+
+class SharedTailSink(Operator):
+    """Host-side tail: stamps batches with absolute row offsets and
+    publishes them into the shared channel."""
+
+    def __init__(self, fingerprint: str, max_retained_rows: int = 1 << 22):
+        super().__init__("shared_bus")
+        self.fingerprint = fingerprint
+        self.max_retained_rows = max_retained_rows
+        self.offset = 0  # cumulative rows published, checkpointed
+        self.channel = None
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"o": global_table("o")}
+
+    async def on_start(self, ctx):
+        if ctx.task_info.parallelism != 1:
+            raise RuntimeError(
+                "shared_bus requires parallelism 1 (offsets are a single "
+                "total order)"
+            )
+        if ctx.table_manager is not None:
+            table = await ctx.table("o")
+            stored = table.get("offset")
+            if stored is not None:
+                self.offset = int(stored)
+        self.channel = BUS.get_or_create(
+            self.fingerprint, self.max_retained_rows
+        )
+
+    async def process_batch(self, batch, ctx, collector, input_index: int = 0):
+        n = batch.num_rows
+        if n == 0:
+            return
+        await self.channel.publish(self.offset, batch)
+        self.offset += n
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("o")
+            table.put("offset", self.offset)
+        self.channel.note_host_capture(barrier.epoch, self.offset)
+
+    async def on_close(self, ctx, collector, is_eod: bool):
+        if self.channel is not None and is_eod:
+            await self.channel.close()
+        return None
+
+
+class MountedSource(SourceOperator):
+    """Tenant-side head: replays the shared channel from this job's own
+    checkpointed position, emitting the host's batches verbatim."""
+
+    def __init__(self, fingerprint: str):
+        super().__init__("mounted")
+        self.fingerprint = fingerprint
+        self.position = 0  # absolute row offset of the next row to emit
+        self.channel = None
+        self._job_id: Optional[str] = None
+
+    def tables(self):
+        from ..state.table_config import global_table
+
+        return {"m": global_table("m")}
+
+    async def on_start(self, ctx):
+        if ctx.task_info.parallelism != 1:
+            raise RuntimeError(
+                "mounted source requires parallelism 1 (the channel is one "
+                "total order; fan-out happens downstream)"
+            )
+        self._job_id = ctx.task_info.job_id
+        if ctx.table_manager is not None:
+            table = await ctx.table("m")
+            stored = table.get("pos")
+            if stored is not None:
+                self.position = int(stored)
+        self.channel = BUS.get(self.fingerprint)
+        if self.channel is None:
+            raise RuntimeError(
+                f"mounted source: no shared channel {self.fingerprint!r} "
+                "(host job not running?)"
+            )
+        ok = await self.channel.attach(self._job_id, self.position)
+        if not ok:
+            raise RuntimeError(
+                f"mounted source: channel {self.fingerprint!r} no longer "
+                f"retains offset {self.position} (base "
+                f"{self.channel.base})"
+            )
+
+    async def handle_checkpoint(self, barrier, ctx, collector):
+        if ctx.table_manager is not None:
+            table = await ctx.table("m")
+            table.put("pos", self.position)
+        self.channel.note_tenant_capture(
+            self._job_id, barrier.epoch, self.position
+        )
+
+    def drain_status(self):
+        if self.channel is None:
+            return None
+        if not self.channel.closed:
+            return (False, "mounted: host scan still streaming")
+        if self.position < self.channel.end:
+            return (
+                False,
+                f"mounted: {self.channel.end - self.position} rows behind",
+            )
+        return (True, "")
+
+    async def run(self, ctx, collector) -> SourceFinishType:
+        # re-seek on every (re)entry: a rescale/restore may have reset
+        # position after the attach in on_start
+        await self.channel.seek(self._job_id, self.position)
+        while True:
+            finish = await ctx.check_control(collector)
+            if finish is not None:
+                return finish
+            batches = await self.channel.read(self._job_id, max_wait=0.25)
+            if batches is None:
+                if self.channel.closed and self.position >= self.channel.end:
+                    return SourceFinishType.FINAL
+                # detached under us (controller tore the mount down);
+                # park until control arrives with the actual verdict
+                await asyncio.sleep(0.05)
+                continue
+            for batch in batches:
+                await collector.collect(batch)
+                self.position += batch.num_rows
+            if batches:
+                await asyncio.sleep(0)
+
+
+@register_connector
+class SharedBusConnector(Connector):
+    name = "shared_bus"
+    description = "host tail of a shared source scan (internal)"
+    sink = True
+    config_schema = {
+        "fingerprint": {"type": "string", "required": True},
+        "max_retained_rows": {"type": "integer"},
+    }
+
+    def make_sink(self, config, schema: ConnectionSchema) -> SharedTailSink:
+        return SharedTailSink(
+            fingerprint=config["fingerprint"],
+            max_retained_rows=int(config.get("max_retained_rows", 1 << 22)),
+        )
+
+
+@register_connector
+class MountedConnector(Connector):
+    name = "mounted"
+    description = "tenant mount onto a shared source scan (internal)"
+    source = True
+    config_schema = {
+        "fingerprint": {"type": "string", "required": True},
+    }
+
+    def make_source(self, config, schema: ConnectionSchema) -> MountedSource:
+        return MountedSource(fingerprint=config["fingerprint"])

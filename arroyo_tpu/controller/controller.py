@@ -185,6 +185,15 @@ class JobHandle:
         # worker-leader mode: the leader finished its local work and handed
         # the checkpoint cadence back to the controller
         self.leader_resigned = False
+        # shared-plan multi-tenancy (ISSUE 16): the scan fingerprint this
+        # job is mounted on (None = owns its data plane), the mount
+        # directive shipped to workers ({node_id, fingerprint,
+        # connector} — sql/fingerprint.py apply_mount), and the
+        # accelerated-cadence flag the sharing manager sets while a host
+        # epoch is gated on this tenant's next durable checkpoint
+        self.shared_fp: Optional[str] = None
+        self.mount: Optional[dict] = None
+        self.checkpoint_asap = False
         # event-driven driver: parked waits register a future here and
         # every RPC arrival / state change that can move this job's
         # predicates kicks them. `wakeups` counts predicate-loop wakeups —
@@ -257,6 +266,11 @@ class ControllerServer:
         from ..serve.gateway import StateGateway
 
         self.serve = StateGateway(self)
+        # shared-plan multi-tenancy (ISSUE 16): mount-vs-spawn admission,
+        # refcounted host lifecycle, publication gate
+        from .sharing import SharingManager
+
+        self.sharing = SharingManager(self)
         self._reg_waiters: set = set()  # scheduling waits on registration
         # handles pruned on suspicion of death, kept so a heartbeat
         # re-registration can resurrect the SAME object — jobs hold
@@ -317,6 +331,7 @@ class ControllerServer:
                 "/debug/autoscale": self._debug_autoscale,
                 "/debug/serve": self._debug_serve,
                 "/debug/watch": self._debug_watch,
+                "/debug/sharing": self._debug_sharing,
             },
         )
         logger.info("controller up at %s", self.addr)
@@ -366,6 +381,16 @@ class ControllerServer:
 
         return web.json_response(
             self.watchtower.status(request.query.get("job")),
+            dumps=lambda d: json.dumps(d, default=str),
+        )
+
+    async def _debug_sharing(self, request):
+        """Admin surface: shared-plan mounts — per-fingerprint host job,
+        refcount, tenants, and the bus's retained-log/subscriber view."""
+        from aiohttp import web
+
+        return web.json_response(
+            self.sharing.status(),
             dumps=lambda d: json.dumps(d, default=str),
         )
 
@@ -544,8 +569,15 @@ class ControllerServer:
             from ..sql import plan_query
 
             graph = plan_query(sql, parallelism=parallelism).graph
+        # shared-plan admission (ISSUE 16): an eligible scan mounts onto
+        # the shared host instead of spawning a copy. The mount directive
+        # rides StartExecution so workers re-planning the canonical SQL
+        # apply the identical source rewrite.
+        mount = self.sharing.try_mount(job_id, graph)
         job = JobHandle(job_id, graph, storage_url, sql=sql,
                         parallelism=parallelism, tenant=tenant)
+        job.mount = mount
+        job.shared_fp = mount["fingerprint"] if mount else None
         self.jobs[job_id] = job
         self._job_tasks[job_id] = asyncio.ensure_future(
             self._drive_job(job, n_workers)
@@ -684,6 +716,10 @@ class ControllerServer:
         else:
             await self.scheduler.stop_workers(job.job_id, force=force)
         if expunge:
+            # shared-plan detach (ISSUE 16): a terminal tenant releases
+            # its mount (the LAST one stops the host); a terminal host
+            # drops its bus channel
+            await self.sharing.on_job_expunged(job)
             self.admission.release(job)
             # serving-tier GC: cached reads and routing state of a
             # terminal job go NOW (reads already refuse non-RUNNING
@@ -888,6 +924,10 @@ class ControllerServer:
                 str(n): p for n, p in job.parallelism_overrides.items()
             },
             "graph": None if job.sql else job.graph.to_json(),
+            # shared-plan mount directive (ISSUE 16): applied after the
+            # worker's re-plan (deterministic node ids make it land on
+            # the same source node the controller rewrote)
+            "mount": job.mount,
             "assignments": [
                 {"node_id": n, "subtask": s, "worker_id": w}
                 for (n, s), w in assignments.items()
@@ -1070,7 +1110,13 @@ class ControllerServer:
                 < max(1, config().state.max_inflight_flushes)
             )
             if (cadence_armed
-                    and time.monotonic() - last_checkpoint >= interval):
+                    and (job.checkpoint_asap
+                         or time.monotonic() - last_checkpoint >= interval)):
+                # checkpoint_asap (ISSUE 16): the sharing manager pulls a
+                # mounted tenant's next checkpoint forward while a host
+                # epoch is gated on its durable position — reconciliation
+                # bounded by a round-trip, not a cadence interval
+                job.checkpoint_asap = False
                 last_checkpoint = time.monotonic()
                 await self._checkpoint_start(job)
                 continue
@@ -1412,6 +1458,15 @@ class ControllerServer:
                     del job.pending_epochs[epoch]
                     continue
                 return  # strict order: later epochs wait for this one
+            if self.sharing.gate_blocks(job, epoch):
+                # publication gate (ISSUE 16): a shared host's epoch
+                # must not publish while a mounted durable tenant's own
+                # durable position trails the host's captured offset — a
+                # host restore would resume the scan beyond rows that
+                # tenant still needs. Tenant publishes/detaches kick
+                # this job, so the wait is event-driven; reports are
+                # complete, so the abandon deadline doesn't apply.
+                return
             del job.pending_epochs[epoch]
             tid, sid = info["trace"]
             with obs.span("checkpoint.finish", trace=tid, parent=sid,
@@ -1550,6 +1605,10 @@ class ControllerServer:
         # the manifest is durable: advance the serving tier's read
         # snapshot (cache entries of earlier epochs self-invalidate)
         job.published_epoch = max(job.published_epoch, epoch)
+        # shared-plan (ISSUE 16): a mounted tenant's publish raises its
+        # durable restore floor on the bus and may clear the host's
+        # gated epoch
+        self.sharing.note_publish(job)
         try:
             committing = manifest.get("committing")
             if committing and job.backend.claim_commit(epoch):

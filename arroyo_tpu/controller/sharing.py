@@ -1,0 +1,326 @@
+"""Shared-plan admission + lifecycle (ISSUE 16): mount-vs-spawn.
+
+The controller-side half of shared-plan multi-tenancy. At submission
+(`try_mount`, called from ControllerServer.submit_job) a job's graph is
+fingerprinted (sql/fingerprint.py); when its source scan matches a
+shareable configuration, the job is MOUNTED instead of spawned whole:
+
+  * the first eligible job triggers a hidden, registry-owned host job
+    `__shared/<fp>` — just `source -> shared_bus` (engine/shared.py) at
+    parallelism 1 — and EVERY eligible job, including the first, mounts
+    symmetrically as a bus subscriber (its source op is rewritten to
+    the `mounted` connector, the rest of its pipeline untouched);
+  * the mount is refcounted: each tenant detaches on ITS terminal
+    release (`on_job_expunged`), and only the last detach stops the
+    host. One tenant's stop/rescale/failure never tears down or stalls
+    the others (modeled: V_ORPHAN in analysis/model/sharedplan.py).
+
+The publication gate (`gate_blocks`, consulted by the controller's
+_checkpoint_reap for host jobs) is the shared-fate barrier contract:
+one host barrier, per-tenant epochs reconciled. A host epoch E captured
+at bus offset F may only PUBLISH once every mounted durable tenant's
+own durable position has reached F — otherwise a host restart would
+resume the scan beyond rows a tenant restore still needs (the model's
+V_LOSS violation; the `leaked_barrier_across_tenants` mutant is exactly
+this gate deleted). Tenants without durable state restore from offset 0
+and rely on the bus's retained log instead, so they don't gate. While
+a host epoch is gated, waiting tenants get `checkpoint_asap` so their
+next cadence fires immediately — reconciliation is bounded by a tenant
+checkpoint round-trip, not a full cadence interval.
+
+Attribution rides the bus's per-subscriber consumed-row counts: the
+apportioner (obs/attribution.py) splits the host job's busy/device
+seconds across mounted tenants pro-rata, sum-preserving, so per-tenant
+cost accounting survives the collapse of N scans into one.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Set, TYPE_CHECKING
+
+from ..config import config
+from ..engine.shared import BUS, HOST_PREFIX
+from ..graph.logical import (
+    EdgeType,
+    LogicalGraph,
+    LogicalNode,
+    OperatorName,
+)
+from ..sql.fingerprint import apply_mount, shareable_source
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .controller import ControllerServer, JobHandle
+
+logger = logging.getLogger("arroyo.sharing")
+
+
+def host_job_id(fingerprint: str) -> str:
+    return HOST_PREFIX + fingerprint
+
+
+def is_host_job(job_id: str) -> bool:
+    return job_id.startswith(HOST_PREFIX)
+
+
+class SharedHost:
+    """One running shared scan and its mounted tenants (the refcount)."""
+
+    def __init__(self, fingerprint: str, connector: str, source_config: dict):
+        self.fingerprint = fingerprint
+        self.job_id = host_job_id(fingerprint)
+        self.connector = connector
+        self.source_config = dict(source_config)
+        self.tenants: Set[str] = set()
+        self.mounts = 0      # total mounts ever (debug surface)
+        self.stopping = False
+        # host job reached a terminal state while tenants were still
+        # mounted (a bounded scan FINISHES on EOS long before slow or
+        # late tenants drain the retained log): the channel must outlive
+        # the host job until the LAST tenant detaches
+        self.defunct = False
+        self.spawn_task = None  # retained submit task (not GC'd mid-flight)
+
+
+class SharingManager:
+    def __init__(self, controller: "ControllerServer"):
+        self.controller = controller
+        self.hosts: Dict[str, SharedHost] = {}
+        self.by_job: Dict[str, str] = {}  # tenant job_id -> fingerprint
+
+    # -- admission ------------------------------------------------------------
+
+    def _eligible(self, job_id: str, graph: LogicalGraph):
+        if not config().sharing.enabled or is_host_job(job_id):
+            return None
+        scan = shareable_source(graph)
+        if scan is None:
+            return None
+        if graph.nodes[scan.node_id].parallelism != 1:
+            # the bus is one total order; source fan-out happens
+            # downstream of the mount, not at the scan
+            return None
+        return scan
+
+    def try_mount(self, job_id: str, graph: LogicalGraph) -> Optional[dict]:
+        """Mount-vs-spawn decision. On mount: rewrites `graph`'s source
+        op to the `mounted` connector IN PLACE, ensures the host job is
+        running, registers the tenant, and returns the mount directive
+        {node_id, fingerprint, connector} that rides StartExecution
+        (workers re-plan canonical SQL, then apply the same rewrite —
+        sql/fingerprint.py apply_mount). Returns None to spawn unshared
+        (ineligible, or the bus no longer retains the rows a fresh
+        mount needs)."""
+        scan = self._eligible(job_id, graph)
+        if scan is None:
+            return None
+        fp = scan.fingerprint
+        host = self.hosts.get(fp)
+        if host is not None and (host.stopping or host.defunct):
+            # teardown in flight, or the host already hit a terminal
+            # state (EOS/failure) and only lingers for attached readers;
+            # don't race either — spawn unshared, the next submission
+            # re-hosts
+            return None
+        channel = BUS.get(fp)
+        if channel is not None and channel.base > 0:
+            # retention already trimmed the prefix a fresh tenant needs
+            return None
+        if channel is not None and channel.closed:
+            # host scan already hit EOS; a new tenant wants a live scan
+            return None
+        if host is None:
+            host = self._spawn_host(scan)
+            if host is None:
+                return None
+        mount = {"node_id": scan.node_id, "fingerprint": fp,
+                 "connector": scan.connector}
+        apply_mount(graph, mount)
+        host.tenants.add(job_id)
+        host.mounts += 1
+        self.by_job[job_id] = fp
+        # retention must hold the full log until this tenant's
+        # MountedSource actually attaches (scheduling is async)
+        BUS.get_or_create(fp, config().sharing.max_retained_rows).expect(
+            job_id
+        )
+        logger.info("job %s mounted onto shared scan %s (refcount %d)",
+                    job_id, fp, len(host.tenants))
+        return mount
+
+    @staticmethod
+    def _source_schema(connector: str):
+        from ..connectors.base import get_connector
+
+        return get_connector(connector).table_schema()
+
+    def _spawn_host(self, scan) -> Optional[SharedHost]:
+        cfg = config().sharing
+        fp = scan.fingerprint
+        schema = self._source_schema(scan.connector)
+        g = LogicalGraph()
+        g.add_node(LogicalNode.single(
+            1, OperatorName.CONNECTOR_SOURCE, dict(scan.config),
+            description=f"shared_scan[{scan.connector}]",
+        ))
+        g.add_node(LogicalNode.single(
+            2, OperatorName.CONNECTOR_SINK,
+            {"connector": "shared_bus", "fingerprint": fp,
+             "max_retained_rows": cfg.max_retained_rows},
+            description=f"shared_bus[{fp}]",
+        ))
+        g.add_edge(1, 2, EdgeType.FORWARD, schema)
+        host = SharedHost(fp, scan.connector, scan.config)
+        self.hosts[fp] = host
+        # the channel must exist before any tenant's MountedSource
+        # starts (worker scheduling order is unconstrained)
+        BUS.get_or_create(fp, cfg.max_retained_rows)
+        import asyncio
+
+        async def _submit():
+            job = await self.controller.submit_job(
+                host.job_id,
+                graph=g,
+                storage_url=cfg.host_storage_url or None,
+                n_workers=1,
+                parallelism=1,
+                tenant="__shared",
+            )
+            # the bus is ONE total order of offsets: the scan cannot fan
+            # out without making replay order nondeterministic, so the
+            # autoscaler must not actuate it. Aggregate load still sizes
+            # the scan's PACE — the slowest tenant's backpressure
+            # throttles publish, and every faster tenant rides the same
+            # retained log (see engine/shared.py).
+            job.autoscale_pinned = True
+
+        host.spawn_task = asyncio.ensure_future(_submit())
+        logger.info("spawned shared host %s for scan %s", host.job_id, fp)
+        return host
+
+    # -- publication gate -----------------------------------------------------
+
+    def gate_blocks(self, job: "JobHandle", epoch: int) -> bool:
+        """True when host `job`'s epoch must NOT publish yet: some
+        mounted durable tenant's durable position is still behind the
+        host's captured offset for this epoch."""
+        if not is_host_job(job.job_id):
+            return False
+        fp = job.job_id[len(HOST_PREFIX):]
+        host = self.hosts.get(fp)
+        channel = BUS.get(fp)
+        if host is None or channel is None:
+            return False
+        offset = channel.epoch_offsets.get(epoch)
+        if offset is None:
+            return False  # pre-gate epoch (no capture recorded)
+        blocked = False
+        for tid in host.tenants:
+            tenant = self.controller.jobs.get(tid)
+            if tenant is None or tenant.backend is None:
+                continue  # non-durable tenants restore from 0 (the log)
+            if tenant.state.is_terminal():
+                continue  # release hook will detach it momentarily
+            pos = channel.tenant_durable_position(
+                tid, tenant.published_epoch
+            )
+            if pos < offset:
+                blocked = True
+                # accelerate reconciliation: the tenant checkpoints on
+                # its next driver pass instead of the full cadence
+                if not tenant.checkpoint_asap:
+                    tenant.checkpoint_asap = True
+                    tenant.kick()
+        return blocked
+
+    def note_publish(self, job: "JobHandle") -> None:
+        """A job published an epoch. For a mounted tenant: raise its
+        durable restore floor on the bus (retention may trim below it)
+        and kick the host (a gated epoch may now clear)."""
+        fp = self.by_job.get(job.job_id)
+        if fp is None:
+            return
+        channel = BUS.get(fp)
+        if channel is not None:
+            channel.set_floor(
+                job.job_id,
+                channel.tenant_durable_position(
+                    job.job_id, job.published_epoch
+                ),
+            )
+        hj = self.controller.jobs.get(host_job_id(fp))
+        if hj is not None:
+            hj.kick()
+
+    # -- refcounted release ---------------------------------------------------
+
+    async def on_job_expunged(self, job: "JobHandle") -> None:
+        """Terminal release hook (controller._release_job expunge path).
+        Tenants detach from the bus; the LAST detach stops the host;
+        the host's own release drops the channel."""
+        if is_host_job(job.job_id):
+            fp = job.job_id[len(HOST_PREFIX):]
+            host = self.hosts.get(fp)
+            channel = BUS.get(fp)
+            if host is not None and host.tenants or (
+                channel is not None
+                and (channel.cursors or channel.expected)
+            ):
+                # a bounded scan FINISHES on EOS while tenants are still
+                # draining the retained log (or haven't attached yet):
+                # the channel outlives the host job; the LAST tenant
+                # detach below drops it. New submissions spawn unshared
+                # (defunct guard in try_mount).
+                if host is not None:
+                    host.defunct = True
+                return
+            self.hosts.pop(fp, None)
+            BUS.drop(fp)
+            return
+        fp = self.by_job.pop(job.job_id, None)
+        if fp is None:
+            return
+        channel = BUS.get(fp)
+        if channel is not None:
+            await channel.detach(job.job_id)
+        host = self.hosts.get(fp)
+        if host is None:
+            return
+        host.tenants.discard(job.job_id)
+        hj = self.controller.jobs.get(host.job_id)
+        if hj is not None:
+            hj.kick()  # a gated epoch may have been waiting on this tenant
+        if not host.tenants and host.defunct:
+            # the host job already finished; this was the last reader
+            self.hosts.pop(fp, None)
+            BUS.drop(fp)
+            return
+        if not host.tenants and not host.stopping:
+            host.stopping = True
+            logger.info("shared scan %s refcount 0: stopping host", fp)
+            try:
+                mode = "checkpoint" if hj is not None and hj.backend \
+                    else "immediate"
+                await self.controller.stop_job(host.job_id, mode=mode)
+            except KeyError:
+                pass  # host never finished scheduling / already gone
+
+    # -- introspection --------------------------------------------------------
+
+    def status(self) -> dict:
+        out = {}
+        for fp, host in sorted(self.hosts.items()):
+            hj = self.controller.jobs.get(host.job_id)
+            channel = BUS.get(fp)
+            out[fp] = {
+                "host_job": host.job_id,
+                "host_state": hj.state.value if hj is not None else None,
+                "connector": host.connector,
+                "refcount": len(host.tenants),
+                "tenants": sorted(host.tenants),
+                "mounts": host.mounts,
+                "stopping": host.stopping,
+                "defunct": host.defunct,
+                "bus": channel.stats() if channel is not None else None,
+            }
+        return out

@@ -1,0 +1,299 @@
+"""Shared-plan data bus (ISSUE 16): one source scan, N tenant readers.
+
+When the controller admits a job whose source scan fingerprints equal to
+one already running (sql/fingerprint.py), it does NOT spawn a second
+scan. Instead a hidden, registry-owned *host* job `__shared/<fp>` runs
+the scan once and publishes every batch into a process-local
+`SharedChannel`; each tenant job runs a `mounted` source
+(connectors/shared.py) that reads the channel from its own cursor. The
+bus is the seam where N similar jobs collapse to ~1× source work.
+
+Design — a retained log, not per-subscriber queues:
+
+  * the channel holds `(start_offset, batch)` entries where offsets are
+    ABSOLUTE cumulative row counts over the host scan's lifetime. A
+    batch is therefore self-identifying: a reader at cursor C skips rows
+    below C (slicing a straddling batch) no matter how many times the
+    host re-published them;
+  * late joiners replay from offset 0 through the retained log, so a
+    tenant mounted minutes after the host started still sees every row;
+  * on host restart the scan resumes from its checkpointed offset and
+    re-publishes; `publish()` at an offset below the log tail REWINDS
+    the log (drops entries at/after it). Host sources are restricted to
+    deterministic-replay configs, so the re-published rows are
+    byte-identical and no reader observes divergence;
+  * retention is trimmed only below every attached tenant's durable
+    restore floor (their last *published* checkpoint position — the
+    deepest any restart can rewind them). A mount whose requested
+    position predates the retained base is refused; the controller
+    falls back to an unshared spawn;
+  * backpressure is shared fate: `publish()` blocks while the slowest
+    attached reader is more than `max_retained_rows` behind, so one
+    stalled tenant throttles the scan rather than ballooning memory
+    (exactly the semantics a per-job scan would have had).
+
+Epoch bookkeeping for the publication gate (controller/sharing.py): the
+host tail records epoch -> offset at each of ITS barriers
+(`note_host_capture`); tenants record epoch -> position at each of
+THEIRS (`note_tenant_capture`). The controller refuses to publish a
+host epoch E until every mounted tenant's durable position has reached
+the host's offset at E — otherwise a host restart could resume the
+scan beyond rows some tenant still needs (the `sp.kill` V_LOSS
+violation in analysis/model/sharedplan.py, and the
+`leaked_barrier_across_tenants` mutant's counterexample).
+
+Process-local by design: embedded and pooled workers are in-process
+asyncio tasks, so a module-level registry keyed by fingerprint is the
+correct transport. A multi-host bus would ride the same interface over
+the shuffle layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+# job-id namespace of hidden host jobs (`__shared/<fp>`): defined here,
+# at the lowest layer, so obs/attribution can recognize host jobs
+# without importing the controller
+HOST_PREFIX = "__shared/"
+
+
+class SharedChannel:
+    """The retained log for one fingerprinted source scan."""
+
+    def __init__(self, fingerprint: str, max_retained_rows: int = 1 << 22):
+        self.fingerprint = fingerprint
+        self.max_retained_rows = max_retained_rows
+        # (start_offset, batch) entries, ascending, non-overlapping
+        self.log: List[Tuple[int, object]] = []
+        self.base = 0            # offset of the first retained row
+        self.end = 0             # offset one past the last published row
+        self.closed = False      # host scan reached EOS
+        self.cursors: Dict[str, int] = {}    # job_id -> next offset to read
+        self.expected: set = set()           # mounted but not yet attached
+        self.floors: Dict[str, int] = {}     # job_id -> durable restore floor
+        self.consumed: Dict[str, int] = {}   # job_id -> rows delivered (obs)
+        # host epoch -> offset at capture; tenant job -> {epoch: position}
+        self.epoch_offsets: Dict[int, int] = {}
+        self.tenant_epochs: Dict[str, Dict[int, int]] = {}
+        self._cond = asyncio.Condition()
+
+    # -- host side ------------------------------------------------------------
+
+    async def publish(self, start_offset: int, batch) -> None:
+        """Append (or rewind-and-append after a host restart). Blocks
+        while the slowest attached reader is over the retention cap
+        behind (shared-fate backpressure)."""
+        async with self._cond:
+            if start_offset < self.end:
+                # host restarted below the tail: deterministic replay
+                # regenerates identical rows, so superseded entries go
+                self.log = [e for e in self.log if e[0] < start_offset]
+                self.end = self.log[-1][0] + self.log[-1][1].num_rows \
+                    if self.log else self.base
+                # a restart can't rewind below the retained base
+                assert start_offset >= self.end, (
+                    f"host republish at {start_offset} inside retained "
+                    f"entry ending {self.end}"
+                )
+            if not self.log and start_offset > self.end:
+                # fresh channel, host restored mid-stream (durable host,
+                # new bus incarnation): rows below the restore offset
+                # were never retained here — reflect that in the base so
+                # a from-zero mount is refused, not silently truncated
+                self.base = self.end = start_offset
+            n = batch.num_rows
+            if n:
+                self.log.append((start_offset, batch))
+                self.end = start_offset + n
+            self._cond.notify_all()
+            while (
+                self.cursors
+                and self.end - min(self.cursors.values())
+                    > self.max_retained_rows
+                and not self.closed
+            ):
+                await self._cond.wait()
+            self._trim()
+
+    def _trim(self) -> None:
+        """Drop entries no restart can ever need: below every attached
+        tenant's durable floor (and every live cursor). Only kicks in
+        past the soft cap, so late joiners usually find a full log."""
+        if self.end - self.base <= self.max_retained_rows:
+            return
+        if self.cursors:
+            safe = min(
+                min(self.cursors.values()),
+                min((self.floors.get(j, 0) for j in self.cursors),
+                    default=0),
+            )
+        elif self.expected:
+            # a mounted tenant hasn't attached yet (worker still
+            # scheduling): it reads from its restore position, which may
+            # be 0 — hold the full log until it shows up
+            return
+        else:
+            # zero subscribers: keep a cap-sized tail so a FUTURE mount
+            # attempt sees an honest base (and falls back to an
+            # unshared spawn if it needed the trimmed prefix)
+            safe = self.end - self.max_retained_rows
+        while self.log:
+            start, batch = self.log[0]
+            if start + batch.num_rows > safe:
+                break
+            self.log.pop(0)
+            self.base = self.log[0][0] if self.log else self.end
+
+    async def close(self) -> None:
+        async with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def note_host_capture(self, epoch: int, offset: int) -> None:
+        self.epoch_offsets[epoch] = offset
+
+    # -- tenant side ----------------------------------------------------------
+
+    async def attach(self, job_id: str, position: int) -> bool:
+        """Mount a reader at `position`. Refused (False) when the log no
+        longer retains that offset — the caller must spawn unshared."""
+        async with self._cond:
+            if position < self.base:
+                return False
+            self.cursors[job_id] = max(position, 0)
+            self.expected.discard(job_id)
+            self.consumed.setdefault(job_id, 0)
+            self._cond.notify_all()
+            return True
+
+    def expect(self, job_id: str) -> None:
+        """Admission-time reservation: the tenant is mounted but its
+        MountedSource hasn't attached yet; retention holds the full log
+        for it (see _trim)."""
+        self.expected.add(job_id)
+
+    async def detach(self, job_id: str) -> None:
+        async with self._cond:
+            self.cursors.pop(job_id, None)
+            self.expected.discard(job_id)
+            self.floors.pop(job_id, None)
+            self.tenant_epochs.pop(job_id, None)
+            self._cond.notify_all()
+
+    async def read(
+        self, job_id: str, max_wait: float = 0.25
+    ) -> Optional[List[object]]:
+        """Batches at/after the reader's cursor, cursor-sliced so the
+        first row delivered is exactly the cursor row. Empty list on
+        timeout (caller re-checks control), None when the host closed
+        and the log is drained."""
+        async with self._cond:
+            cursor = self.cursors.get(job_id)
+            if cursor is None:
+                return None  # detached under us
+            if cursor >= self.end:
+                if self.closed:
+                    return None
+                try:
+                    await asyncio.wait_for(self._cond.wait(), max_wait)
+                except asyncio.TimeoutError:
+                    return []
+                cursor = self.cursors.get(job_id)
+                if cursor is None:
+                    return None
+                if cursor >= self.end:
+                    return None if self.closed else []
+            out: List[object] = []
+            delivered = 0
+            for start, batch in self.log:
+                n = batch.num_rows
+                if start + n <= cursor:
+                    continue
+                if start < cursor:
+                    batch = batch.slice(cursor - start)
+                out.append(batch)
+                delivered += batch.num_rows
+            self.cursors[job_id] = self.end
+            self.consumed[job_id] = self.consumed.get(job_id, 0) + delivered
+            self._cond.notify_all()  # publisher may be waiting on retention
+            return out
+
+    async def seek(self, job_id: str, position: int) -> None:
+        """Rewind/advance a reader (tenant restore re-attaches here)."""
+        async with self._cond:
+            if job_id in self.cursors:
+                self.cursors[job_id] = position
+                self._cond.notify_all()
+
+    def note_tenant_capture(self, job_id: str, epoch: int,
+                            position: int) -> None:
+        self.tenant_epochs.setdefault(job_id, {})[epoch] = position
+
+    def tenant_durable_position(self, job_id: str,
+                                published_epoch: int) -> int:
+        """The deepest position this tenant restores to: its latest
+        position captured at an epoch its controller already published.
+        0 until the first published checkpoint (a restart replays the
+        log from the start)."""
+        caps = self.tenant_epochs.get(job_id, {})
+        durable = [p for e, p in caps.items() if e <= published_epoch]
+        return max(durable) if durable else 0
+
+    def set_floor(self, job_id: str, position: int) -> None:
+        """Raise the tenant's durable restore floor (retention may trim
+        below it). Monotone: floors never regress."""
+        if position > self.floors.get(job_id, 0):
+            self.floors[job_id] = position
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "base": self.base,
+            "end": self.end,
+            "retained_rows": self.end - self.base,
+            "retained_batches": len(self.log),
+            "closed": self.closed,
+            "subscribers": {
+                j: {
+                    "cursor": c,
+                    "lag": self.end - c,
+                    "consumed": self.consumed.get(j, 0),
+                    "floor": self.floors.get(j, 0),
+                }
+                for j, c in sorted(self.cursors.items())
+            },
+            "host_epochs": dict(sorted(self.epoch_offsets.items())),
+        }
+
+
+class SharedBus:
+    """Process-local registry of shared channels, keyed by the source
+    scan fingerprint (sql/fingerprint.py source_scan_fingerprint)."""
+
+    def __init__(self):
+        self.channels: Dict[str, SharedChannel] = {}
+
+    def get_or_create(self, fingerprint: str,
+                      max_retained_rows: int = 1 << 22) -> SharedChannel:
+        ch = self.channels.get(fingerprint)
+        if ch is None:
+            ch = SharedChannel(fingerprint, max_retained_rows)
+            self.channels[fingerprint] = ch
+        return ch
+
+    def get(self, fingerprint: str) -> Optional[SharedChannel]:
+        return self.channels.get(fingerprint)
+
+    def drop(self, fingerprint: str) -> None:
+        self.channels.pop(fingerprint, None)
+
+    def stats(self) -> dict:
+        return {fp: ch.stats() for fp, ch in sorted(self.channels.items())}
+
+
+# the process-wide bus (embedded/pooled workers share this interpreter)
+BUS = SharedBus()

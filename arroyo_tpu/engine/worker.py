@@ -288,6 +288,13 @@ class WorkerServer:
                 )
         else:
             graph = LogicalGraph.from_json(req["graph"])
+        if req.get("mount"):
+            # shared-plan tenant (ISSUE 16): swap the source op for the
+            # `mounted` connector reading the shared bus — after the
+            # re-plan, so the rewrite lands on the controller's node
+            from ..sql.fingerprint import apply_mount
+
+            apply_mount(graph, req["mount"])
         assignments = {
             (a["node_id"], a["subtask"]): a["worker_id"]
             for a in req["assignments"]

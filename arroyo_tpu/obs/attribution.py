@@ -20,6 +20,15 @@ metric families:
   the per-program families cannot carry a job label themselves);
 * bytes, and per-phase wall seconds (the timeline ledger's rollup).
 
+Shared-plan apportioning (ISSUE 16): a shared source scan runs as a
+hidden host job `__shared/<fp>`, so its runner notes busy/device time
+under a job id no tenant owns. Each flush reassigns the host's pending
+deltas across the scan's subscribers pro-rata by the rows each consumed
+from the bus in the interval (`SharedChannel.consumed`), sum-preserving
+— attributed cost per tenant survives the collapse of N scans into one,
+and the fleet harness's >= 95% coverage gate holds over shared fleets
+with no `__shared/*` escape bucket.
+
 The pump also samples event-loop lag (sleep-overshoot of a fixed
 timer) into `arroyo_worker_loop_lag_seconds` — the signal that
 separates "my job is starved" from "a co-resident tenant is hogging
@@ -107,6 +116,9 @@ class Accounting:
         # per-job active window [first note, last note] for busy ratios
         self._windows: Dict[str, List[float]] = {}
         self._handles: Dict[str, dict] = {}
+        # shared-plan apportioning: fp -> last-seen per-tenant consumed
+        # row counts (the deltas weight each interval's split)
+        self._shared_marks: Dict[str, Dict[str, int]] = {}
         self._cpu_mark: Optional[float] = None
         # bounded loop-lag sample window (seconds) for p99 without
         # histogram-bucket snapping
@@ -142,6 +154,69 @@ class Accounting:
         LOOP_LAG_SECONDS.labels().observe(lag)
 
     # ------------------------------------------------------------- flush
+
+    def _apportion_shared(self, pending: Dict[str, _Pending]) -> None:
+        """Reassign `__shared/<fp>` host-job deltas across the scan's
+        subscribers, weighted by the rows each consumed from the bus
+        since the last flush (even split across attached readers when no
+        rows moved — an idle scan's heartbeat cost is theirs too).
+        Sum-preserving: float fields give the last tenant the exact
+        remainder, integer fields apportion by floor with the remainder
+        on the heaviest consumer. A host with no subscribers keeps its
+        own bucket — still attributed, visible as unapportioned scan
+        cost. Caller holds self._lock."""
+        from ..engine.shared import BUS, HOST_PREFIX
+
+        for host_id in [j for j in pending if j.startswith(HOST_PREFIX)]:
+            channel = BUS.get(host_id[len(HOST_PREFIX):])
+            if channel is None:
+                continue
+            consumed = dict(channel.consumed)
+            marks = self._shared_marks.get(channel.fingerprint, {})
+            self._shared_marks[channel.fingerprint] = consumed
+            weights = {
+                t: c - marks.get(t, 0)
+                for t, c in consumed.items() if c - marks.get(t, 0) > 0
+            }
+            if not weights:
+                weights = {t: 1 for t in channel.cursors}
+            if not weights:
+                continue
+            p = pending.pop(host_id)
+            total = sum(weights.values())
+            tenants = sorted(weights)
+
+            def split_f(value):
+                out, acc = {}, 0.0
+                for t in tenants[:-1]:
+                    out[t] = value * weights[t] / total
+                    acc += out[t]
+                out[tenants[-1]] = value - acc
+                return out
+
+            def split_i(value):
+                out = {t: value * weights[t] // total for t in tenants}
+                heaviest = max(tenants, key=lambda t: weights[t])
+                out[heaviest] += value - sum(out.values())
+                return out
+
+            busy = split_f(p.busy)
+            device = split_f(p.device)
+            disp = split_i(p.dispatches)
+            nbytes = split_i(p.bytes)
+            phases = {ph: split_f(s) for ph, s in p.phases.items()}
+            for t in tenants:
+                q = pending.get(t)
+                if q is None:
+                    q = pending[t] = _Pending()
+                q.busy += busy[t]
+                q.device += device[t]
+                q.dispatches += disp[t]
+                q.bytes += nbytes[t]
+                for ph, share in phases.items():
+                    q.phases[ph] = q.phases.get(ph, 0.0) + share[t]
+                q.first_ts = min(q.first_ts, p.first_ts)
+                q.last_ts = max(q.last_ts, p.last_ts)
 
     def _job_handles(self, job: str) -> dict:
         from ..metrics import (
@@ -179,6 +254,8 @@ class Accounting:
                 else 0.0
             )
             self._cpu_mark = cpu_now
+            if any(j.startswith("__shared/") for j in pending):
+                self._apportion_shared(pending)
         if not pending:
             return
         busy_total = sum(p.busy for p in pending.values())
@@ -273,6 +350,8 @@ class Accounting:
             self._handles.pop(job_id, None)
             self._totals.pop(job_id, None)
             self._windows.pop(job_id, None)
+            if job_id.startswith("__shared/"):
+                self._shared_marks.pop(job_id[len("__shared/"):], None)
 
     def reset(self) -> None:
         with self._lock:
@@ -280,6 +359,7 @@ class Accounting:
             self._handles.clear()
             self._totals.clear()
             self._windows.clear()
+            self._shared_marks.clear()
             self._cpu_mark = None
             self.lag_samples.clear()
 

@@ -1,0 +1,175 @@
+"""Plan fingerprints: canonical hashes over logical sub-DAGs (ISSUE 16).
+
+The shared-plan admission pass needs to answer "is this job's prefix the
+SAME computation as one already running?" without being fooled by
+surface differences: table aliases, SELECT-item naming, node-id
+assignment order, or parallelism hints. This module computes a stable
+fingerprint per logical node:
+
+    fp(node) = sha256(canonical(ops of the node's chain)
+                      + sorted upstream (fp, edge_type) pairs)
+
+Canonicalization rules:
+
+  * node ids, descriptions, and parallelism are EXCLUDED — ids depend on
+    planner allocation order, descriptions carry aliases, and
+    parallelism is a deployment knob, not a computation;
+  * op configs serialize through the same `_config_json` used for graph
+    distribution (schemas as Arrow IPC bytes), then dump with sorted
+    keys, so dict ordering never matters;
+  * upstream fingerprints are sorted, so sibling edge enumeration order
+    never matters (joins keep their left/right identity via the
+    edge_type component).
+
+Two jobs that plan `SELECT count(*) FROM events_a` and
+`SELECT count(*) FROM my_alias` over identically-configured tables get
+identical source fingerprints; the controller mounts the second onto
+the first's running scan (controller/sharing.py).
+
+`shareable_source` is the admission predicate: sharing a scan is only
+sound when replaying the source from checkpointed split state
+reproduces rows AND event times byte-for-byte (the per-tenant
+exactly-once guarantee is anchored on the host's deterministic replay),
+so only deterministic source configurations qualify — impulse/nexmark
+with an explicit `start_time` (synthetic event time) and no wall-clock
+timestamp mode.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..graph.logical import (
+    ChainedOp,
+    LogicalGraph,
+    LogicalNode,
+    OperatorName,
+    _config_json,
+)
+
+
+def _canonical_ops(node: LogicalNode) -> List[dict]:
+    # descriptions are alias-bearing display strings; drop them
+    return [
+        {"operator": op.operator.value, "config": _config_json(op.config)}
+        for op in node.chain
+    ]
+
+
+def _opaque(v) -> dict:
+    """Live runtime objects in configs (e.g. compiled projections in
+    embedded mode) have no canonical text; hash a structural descriptor
+    and keep them OUT of sharing keys (admission only fingerprints the
+    source op, whose config is plain JSON)."""
+    desc = {"__opaque__": type(v).__name__}
+    out = getattr(v, "out_schema", None)
+    if out is not None:
+        desc["out_schema"] = str(out)
+    return desc
+
+
+def _digest(doc) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, default=_opaque).encode()
+    ).hexdigest()[:16]
+
+
+def node_fingerprints(graph: LogicalGraph) -> Dict[int, str]:
+    """Fingerprint every node: operator kinds + canonical configs +
+    sorted upstream fingerprints (alias/ordering-normalized)."""
+    fps: Dict[int, str] = {}
+    for node in graph.topo_order():
+        ups = sorted(
+            (fps[e.src], e.edge_type.value)
+            for e in graph.edges if e.dst == node.node_id
+        )
+        fps[node.node_id] = _digest({
+            "ops": _canonical_ops(node),
+            "upstream": [list(u) for u in ups],
+        })
+    return fps
+
+
+class SourceScan(NamedTuple):
+    """An admission-eligible shared source scan."""
+
+    node_id: int              # the tenant graph's source node
+    fingerprint: str          # hash of the source OP alone (mount key)
+    connector: str
+    config: dict              # the source op's config (verbatim)
+
+
+# connectors whose replay from checkpointed split state is
+# deterministic enough to anchor per-tenant exactly-once on: synthetic
+# generators with explicit synthetic event time
+_DETERMINISTIC_CONNECTORS = ("impulse", "nexmark")
+
+
+def _deterministic_source(connector: str, cfg: dict) -> bool:
+    if connector not in _DETERMINISTIC_CONNECTORS:
+        return False
+    if cfg.get("start_time") is None:
+        return False  # event time would be wall-clock-at-start
+    if connector == "impulse":
+        # realtime stamps wall-clock event time unless replay mode
+        # re-synthesizes it
+        return not cfg.get("realtime") or bool(cfg.get("replay"))
+    return not cfg.get("realtime")
+
+
+def source_scan_fingerprint(op_config: dict) -> str:
+    """The mount key: hash of the source operator alone (kind + canonical
+    config). Chained downstream ops do NOT contribute — tenants with
+    different projections over the same scan still share it."""
+    return _digest({
+        "operator": OperatorName.CONNECTOR_SOURCE.value,
+        "config": _config_json(op_config),
+    })
+
+
+def apply_mount(graph: LogicalGraph, mount: dict) -> None:
+    """Rewrite the graph's source op to the `mounted` connector
+    (connectors/shared.py) per a controller mount directive
+    {node_id, fingerprint, connector}. Workers re-plan canonical SQL and
+    then apply this — planner node ids are deterministic, so the rewrite
+    lands on the same node the controller rewrote. Graph shape is
+    untouched (same nodes/edges/parallelism): shipped assignments stay
+    valid. Idempotent."""
+    from ..connectors.base import get_connector
+
+    node = graph.nodes[int(mount["node_id"])]
+    fp = mount["fingerprint"]
+    node.chain[0] = ChainedOp(
+        OperatorName.CONNECTOR_SOURCE,
+        {"connector": "mounted", "fingerprint": fp,
+         "schema": get_connector(mount["connector"]).table_schema()},
+        description=f"mounted[{fp}]",
+    )
+
+
+def shareable_source(graph: LogicalGraph) -> Optional[SourceScan]:
+    """The admission predicate: return the job's single shareable source
+    scan, or None if this job must spawn its own data plane.
+
+    Requirements: exactly one source node (multi-source jobs keep their
+    own planes in v1), and a deterministic-replay connector config (see
+    module docstring)."""
+    sources: List[Tuple[int, dict]] = []
+    for node_id, node in graph.nodes.items():
+        first = node.chain[0]
+        if first.operator is OperatorName.CONNECTOR_SOURCE:
+            sources.append((node_id, first.config))
+    if len(sources) != 1:
+        return None
+    node_id, cfg = sources[0]
+    connector = cfg.get("connector", "")
+    if not _deterministic_source(connector, cfg):
+        return None
+    return SourceScan(
+        node_id=node_id,
+        fingerprint=source_scan_fingerprint(cfg),
+        connector=connector,
+        config=cfg,
+    )

@@ -663,12 +663,17 @@ def fleet_main(args):
     contended, cal = contention_probe()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "fleet_harness.py"),
+           "--jobs", str(args.fleet_jobs), "--pool", str(args.fleet_pool)]
+    if getattr(args, "fleet_shared", False):
+        # shared-plan A/B (ISSUE 16): same child, different scenario —
+        # its fleet_shared_* keys ride the same bench line and gate
+        # against BENCH_BASELINE.json like every other fleet_* key
+        cmd.append("--shared-fleet")
     out = subprocess.run(
-        [sys.executable,
-         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "tools", "fleet_harness.py"),
-         "--jobs", str(args.fleet_jobs), "--pool", str(args.fleet_pool)],
-        env=env, capture_output=True, text=True, timeout=900,
+        cmd, env=env, capture_output=True, text=True, timeout=900,
     )
     report = {}
     for line in reversed(out.stdout.strip().splitlines()):
@@ -684,8 +689,11 @@ def fleet_main(args):
     # every key present in both docs — a fleet "value" would collide
     # with the q5 headline
     print(json.dumps({
-        "metric": "fleet_jobs_per_controller",
-        "unit": "jobs",
+        "metric": ("fleet_shared_agg_eps"
+                   if getattr(args, "fleet_shared", False)
+                   else "fleet_jobs_per_controller"),
+        "unit": ("events/s" if getattr(args, "fleet_shared", False)
+                 else "jobs"),
         "contended": contended,
         **cal,
         **{k: v for k, v in report.items() if k.startswith("fleet_")},
@@ -722,8 +730,12 @@ def main():
     ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--fleet-jobs", type=int, default=100)
     ap.add_argument("--fleet-pool", type=int, default=2)
+    # shared-plan fleet A/B (ISSUE 16): N tenants on one shared source
+    # scan vs unshared — emits fleet_shared_agg_eps /
+    # fleet_unshared_agg_eps (pinned + gated like the other fleet keys)
+    ap.add_argument("--fleet-shared", action="store_true")
     args = ap.parse_args()
-    if args.fleet:
+    if args.fleet or args.fleet_shared:
         fleet_main(args)
         return
     if args.state_child:

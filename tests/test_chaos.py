@@ -317,6 +317,68 @@ def test_multihost_init_failure_names_coordinator(monkeypatch):
 # -- the fast smoke drill (default suite) -----------------------------------
 
 
+def test_stall_extreme_hold_never_double_emits(tmp_path):
+    """An extreme `runner.stall` hold — an operator wedged for seconds
+    mid-stream with barriers still flowing, and NO restart — must delay
+    window emission, never repeat it: every (key, window) pair emits
+    exactly once and the output is byte-identical to the unstalled run
+    (ISSUE 16: the shared-plan gate reasons about stalled tenants, so
+    the stall seam itself must be emission-safe without recovery)."""
+    from arroyo_tpu.chaos import drill
+
+    def sql(out):
+        return f"""
+        CREATE TABLE impulse WITH (
+          connector = 'impulse', event_rate = '5000',
+          message_count = '1500', start_time = '0'
+        );
+        CREATE TABLE out (k BIGINT UNSIGNED, start TIMESTAMP, cnt BIGINT)
+        WITH (
+          connector = 'single_file', path = '{out}', format = 'json',
+          type = 'sink'
+        );
+        INSERT INTO out
+        SELECT k, window.start as start, cnt FROM (
+          SELECT counter % 4 as k,
+                 tumble(interval '100 millisecond') as window,
+                 count(*) as cnt
+          FROM impulse GROUP BY 1, 2
+        );
+        """
+
+    clean = str(tmp_path / "clean.json")
+    drill._run_embedded(sql(clean), "stall-clean", None, 1, 1,
+                        max_restarts=0, heartbeat_interval=0.1,
+                        heartbeat_timeout=30.0, checkpoint_interval=60.0,
+                        timeout=60.0)
+
+    stalled = str(tmp_path / "stalled.json")
+    plan = FaultPlan(7).add(
+        "runner.stall", at_hits=(2, 3, 4), match={"job": "stall-hold"},
+        params={"delay": 1.5}, max_fires=3,
+    )
+    chaos.install(plan)
+    try:
+        restarts = drill._run_embedded(
+            sql(stalled), "stall-hold", str(tmp_path / "ck"), 1, 1,
+            max_restarts=0, heartbeat_interval=0.1,
+            heartbeat_timeout=30.0, checkpoint_interval=0.2,
+            timeout=60.0,
+        )
+    finally:
+        chaos.clear()
+    assert restarts == 0  # the hold is a delay, never a recovery path
+    assert not plan.unfired()
+
+    def rows(path):
+        return sorted(open(path).read().splitlines())
+
+    got = rows(stalled)
+    assert got and got == rows(clean)
+    keys = [(json.loads(r)["k"], json.loads(r)["start"]) for r in got]
+    assert len(keys) == len(set(keys)), "a window emitted twice"
+
+
 def test_fast_smoke_drill(tmp_path):
     """1 golden, 2 faults (data-plane drop + manifest CAS loss) through
     the real embedded cluster: output identical to the fault-free run,

@@ -498,3 +498,77 @@ def test_multitenant_mutant_yields_counterexample(name):
     assert trace.events and trace.events[0][0] in (
         "mt.schedule_init", "mt.kill_worker"
     )
+
+
+# -- shared-plan: N tenants mounted on one operator chain (ISSUE 16) ---------
+
+
+def test_sharedplan_faithful_clean_and_exhaustive():
+    """The shared-plan lifecycle: one host barrier, per-tenant epoch
+    chains reconciled by the publication gate, refcounted detach, a kill
+    budget. The faithful model must explore exhaustively with zero
+    violations at the acceptance configuration."""
+    from arroyo_tpu.analysis.model import sharedplan as sp
+
+    res = sp.check_sharedplan(sp.SPConfig())
+    assert res.exhaustive, f"budget truncated at {res.states} states"
+    assert res.clean, [t.violation for t in res.violations]
+    assert res.states > 100  # host x tenant positions genuinely explored
+
+
+@pytest.mark.parametrize(
+    "name", sorted(__import__(
+        "arroyo_tpu.analysis.model.sharedplan",
+        fromlist=["SP_MUTANTS"],
+    ).SP_MUTANTS),
+)
+def test_sharedplan_mutant_yields_counterexample(name):
+    """Each shared-lifecycle mutant (publication gate leaked across
+    tenants; detach leaving its gate membership; refcount-ignoring
+    teardown) must produce a counterexample of its declared violation
+    kind, and the counterexample must REPLAY deterministically to the
+    same violation."""
+    from arroyo_tpu.analysis.model import sharedplan as sp
+
+    m = sp.SP_MUTANTS[name]
+    res = sp.check_sharedplan(m.config)
+    kinds = {t.violation.split(":", 1)[0] for t in res.violations}
+    assert m.expect_violation in kinds, (name, kinds)
+    trace = next(t for t in res.violations
+                 if t.violation.startswith(m.expect_violation))
+    got = sp.replay_sharedplan(trace)
+    assert got.split(":", 1)[0] == m.expect_violation
+
+
+def test_sharedplan_leaked_barrier_plan_is_seeded_kill():
+    """The leaked_barrier_across_tenants counterexample must serialize
+    to a seeded chaos FaultPlan containing the worker kill that
+    demonstrates the modeled loss end-to-end (the drill CI replays)."""
+    from arroyo_tpu.analysis.model import sharedplan as sp
+
+    m = sp.SP_MUTANTS["leaked_barrier_across_tenants"]
+    res = sp.check_sharedplan(m.config)
+    trace = next(t for t in res.violations
+                 if t.violation.startswith(m.expect_violation))
+    payload = sp.sp_counterexample_payload(trace)
+    assert payload["fault_plan"]["faults"], payload
+    assert payload["fault_plan"]["faults"][0]["point"] == "worker.kill"
+    # deterministic: same trace -> same seed -> same plan
+    assert (sp.sp_trace_to_fault_plan(trace).seed
+            == sp.sp_trace_to_fault_plan(trace).seed)
+
+
+def test_model_check_cli_shared_lane(tmp_path):
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "model_check.py"),
+         "--shared", "--trace-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(
+        (tmp_path / "leaked_barrier_across_tenants.json").read_text()
+    )
+    assert payload["trace"]["violation"].startswith(
+        "tenant-position-behind-host-restore"
+    )
+    assert payload["fault_plan"]["faults"]

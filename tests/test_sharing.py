@@ -1,0 +1,395 @@
+"""Shared-plan multi-tenancy (ISSUE 16).
+
+Fast-tier proofs of the sharing seams: plan fingerprints are
+alias/ordering-normalized (two differently-written jobs over the same
+scan config share a mount key); the shared bus is a retained log with
+exact cursor slicing, honest late-join refusal, hold-for-expected
+retention and shared-fate backpressure; the attribution apportioner
+splits a `__shared/<fp>` host's cost across subscribers sum-preserving;
+and the E2E mount path — two tenants on one scan produce byte-identical
+output vs their solo runs, one tenant's stop never perturbs the other,
+and the last detach tears the host down (refcounted release).
+"""
+
+import asyncio
+
+import pytest
+
+from arroyo_tpu.config import update
+from arroyo_tpu.engine.shared import BUS, SharedChannel
+from arroyo_tpu.sql import plan_query
+from arroyo_tpu.sql.fingerprint import (
+    apply_mount,
+    node_fingerprints,
+    shareable_source,
+)
+
+
+def pipeline_sql(table="impulse", out="/tmp/unused.json", n=500,
+                 rate=1000, start_time=True, realtime=False,
+                 replay=False, key_mod=4):
+    opts = f"connector = 'impulse', event_rate = '{rate}', " \
+           f"message_count = '{n}'"
+    if start_time:
+        opts += ", start_time = '0'"
+    if realtime:
+        opts += ", realtime = 'true'"
+    if replay:
+        opts += ", replay = 'true'"
+    return f"""
+    CREATE TABLE {table} WITH ({opts});
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{out}', format = 'json',
+      type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % {key_mod} as k,
+             tumble(interval '100 millisecond') as w, count(*) as cnt
+      FROM {table} GROUP BY 1, 2
+    );
+    """
+
+
+# -- fingerprints ------------------------------------------------------------
+
+
+def test_fingerprint_alias_invariant():
+    a = shareable_source(plan_query(pipeline_sql(table="events_a")).graph)
+    b = shareable_source(
+        plan_query(pipeline_sql(table="my_other_name")).graph
+    )
+    assert a is not None and b is not None
+    assert a.fingerprint == b.fingerprint
+
+
+def test_fingerprint_differs_on_source_config():
+    a = shareable_source(plan_query(pipeline_sql(rate=1000)).graph)
+    b = shareable_source(plan_query(pipeline_sql(rate=2000)).graph)
+    assert a.fingerprint != b.fingerprint
+
+
+def test_fingerprint_ignores_downstream_pipeline():
+    """Tenants with different queries over the same scan share the key."""
+    a = shareable_source(plan_query(pipeline_sql(key_mod=4)).graph)
+    b = shareable_source(plan_query(pipeline_sql(key_mod=8)).graph)
+    assert a.fingerprint == b.fingerprint
+
+
+def test_node_fingerprints_cover_graph():
+    g = plan_query(pipeline_sql()).graph
+    fps = node_fingerprints(g)
+    assert set(fps) == set(g.nodes)
+    assert len(set(fps.values())) == len(fps)  # distinct per node here
+
+
+def test_shareable_requires_deterministic_replay():
+    # wall-clock event time (no start_time) is not replayable
+    assert shareable_source(
+        plan_query(pipeline_sql(start_time=False)).graph) is None
+    # realtime without replay stamps wall-clock event time
+    assert shareable_source(
+        plan_query(pipeline_sql(realtime=True)).graph) is None
+    # realtime + replay re-synthesizes event time: shareable
+    assert shareable_source(
+        plan_query(pipeline_sql(realtime=True, replay=True)).graph
+    ) is not None
+
+
+def test_apply_mount_rewrites_in_place():
+    g = plan_query(pipeline_sql()).graph
+    scan = shareable_source(g)
+    shape = (len(g.nodes), len(g.edges))
+    mount = {"node_id": scan.node_id, "fingerprint": scan.fingerprint,
+             "connector": scan.connector}
+    apply_mount(g, mount)
+    op = g.nodes[scan.node_id].chain[0]
+    assert op.config["connector"] == "mounted"
+    assert op.config["fingerprint"] == scan.fingerprint
+    assert op.config["schema"] is not None
+    assert (len(g.nodes), len(g.edges)) == shape
+    apply_mount(g, mount)  # idempotent
+    assert g.nodes[scan.node_id].chain[0].config["connector"] == "mounted"
+
+
+# -- the shared bus ----------------------------------------------------------
+
+
+class Rows:
+    """Offset-carrying stand-in batch: slice() keeps row identity."""
+
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    @property
+    def num_rows(self):
+        return self.hi - self.lo
+
+    def slice(self, offset, length=None):
+        hi = self.hi if length is None else self.lo + offset + length
+        return Rows(self.lo + offset, hi)
+
+    def span(self):
+        return (self.lo, self.hi)
+
+
+def test_bus_late_joiner_and_cursor_slicing():
+    async def go():
+        ch = SharedChannel("fp-slice", max_retained_rows=10_000)
+        await ch.publish(0, Rows(0, 100))
+        await ch.publish(100, Rows(100, 250))
+        assert await ch.attach("t1", 0)
+        assert [b.span() for b in await ch.read("t1")] \
+            == [(0, 100), (100, 250)]
+        # late joiner lands mid-batch: first delivered row is exactly
+        # its cursor row
+        assert await ch.attach("t2", 150)
+        assert [b.span() for b in await ch.read("t2")] == [(150, 250)]
+        assert ch.consumed == {"t1": 250, "t2": 100}
+        # EOS: drained readers see None, not a hang
+        await ch.close()
+        assert await ch.read("t1") is None
+
+    asyncio.run(go())
+
+
+def test_bus_rewind_on_host_restart():
+    async def go():
+        ch = SharedChannel("fp-rewind", max_retained_rows=10_000)
+        await ch.publish(0, Rows(0, 100))
+        await ch.publish(100, Rows(100, 200))
+        # host restarts from its epoch at offset 100 and re-publishes
+        await ch.publish(100, Rows(100, 180))
+        assert ch.end == 180
+        assert [s for s, _b in ch.log] == [0, 100]
+        # a fresh reader replays the rewound log seamlessly
+        assert await ch.attach("t", 0)
+        assert [b.span() for b in await ch.read("t")] \
+            == [(0, 100), (100, 180)]
+
+    asyncio.run(go())
+
+
+def test_bus_refuses_mount_below_base():
+    async def go():
+        ch = SharedChannel("fp-trim", max_retained_rows=100)
+        for i in range(6):
+            await ch.publish(i * 50, Rows(i * 50, (i + 1) * 50))
+        # zero subscribers: retention kept a cap-sized tail
+        assert ch.base == 200
+        assert not await ch.attach("late", 0)  # caller spawns unshared
+        assert await ch.attach("ok", 250)
+
+    asyncio.run(go())
+
+
+def test_bus_holds_retention_for_expected_mounts():
+    async def go():
+        ch = SharedChannel("fp-expect", max_retained_rows=100)
+        ch.expect("t")
+        for i in range(6):
+            await ch.publish(i * 50, Rows(i * 50, (i + 1) * 50))
+        assert ch.base == 0  # full log held for the pending mount
+        assert await ch.attach("t", 0)
+        assert sum(b.num_rows for b in await ch.read("t")) == 300
+
+    asyncio.run(go())
+
+
+def test_bus_fresh_channel_advances_base_for_restored_host():
+    async def go():
+        # durable host restores mid-stream onto a NEW bus incarnation:
+        # rows below its restore offset were never retained here
+        ch = SharedChannel("fp-mid", max_retained_rows=10_000)
+        await ch.publish(500, Rows(500, 600))
+        assert ch.base == 500
+        assert not await ch.attach("t0", 0)  # honest refusal, not a gap
+
+    asyncio.run(go())
+
+
+def test_bus_backpressure_is_shared_fate():
+    async def go():
+        ch = SharedChannel("fp-bp", max_retained_rows=100)
+        assert await ch.attach("slow", 0)
+        await ch.publish(0, Rows(0, 50))
+        blocked = asyncio.ensure_future(ch.publish(50, Rows(50, 150)))
+        await asyncio.sleep(0.05)
+        assert not blocked.done()  # slowest reader throttles the scan
+        assert sum(b.num_rows for b in await ch.read("slow")) == 150
+        await asyncio.wait_for(blocked, 1.0)
+
+    asyncio.run(go())
+
+
+def test_bus_epoch_bookkeeping():
+    ch = SharedChannel("fp-epoch")
+    ch.note_host_capture(1, 100)
+    ch.note_host_capture(2, 300)
+    ch.note_tenant_capture("t", 1, 80)
+    ch.note_tenant_capture("t", 2, 300)
+    # only PUBLISHED tenant epochs are durable restore points
+    assert ch.tenant_durable_position("t", 0) == 0
+    assert ch.tenant_durable_position("t", 1) == 80
+    assert ch.tenant_durable_position("t", 2) == 300
+    ch.set_floor("t", 80)
+    ch.set_floor("t", 40)  # monotone
+    assert ch.floors["t"] == 80
+
+
+# -- attribution apportioning ------------------------------------------------
+
+
+def test_shared_host_cost_apportioned_sum_preserving():
+    from arroyo_tpu.obs.attribution import Accounting
+
+    fp = "fp-attr"
+    host = "__shared/" + fp
+    ch = BUS.get_or_create(fp, 1000)
+    try:
+        ch.consumed.update({"a": 300, "b": 100})
+        acct = Accounting()
+        acct.note(job=host, busy=4.0, device=2.0, dispatches=7,
+                  nbytes=1001)
+        acct.note(job="a", busy=1.0)
+        acct.flush()
+        # pro-rata by consumed rows (a:b = 3:1), sum-preserving
+        assert acct._totals["a"]["busy"] == pytest.approx(1.0 + 3.0)
+        assert acct._totals["b"]["busy"] == pytest.approx(1.0)
+        assert acct._totals["a"]["device"] \
+            + acct._totals["b"]["device"] == pytest.approx(2.0)
+        assert acct._totals["a"]["dispatches"] \
+            + acct._totals["b"]["dispatches"] == 7
+        assert acct._totals["a"]["bytes"] \
+            + acct._totals["b"]["bytes"] == 1001
+        # the host bucket is fully reassigned: no __shared/* escape from
+        # the per-tenant coverage accounting
+        assert host not in acct._totals
+        assert not any(j.startswith("__shared/")
+                       for j in acct.summary()["jobs"])
+
+        # second interval: no rows moved, but readers are attached —
+        # idle scan cost splits evenly instead of escaping
+        ch.cursors.update({"a": 400, "b": 400})
+        acct.note(job=host, busy=1.0)
+        acct.flush()
+        assert acct._totals["a"]["busy"] \
+            + acct._totals["b"]["busy"] == pytest.approx(6.0)
+    finally:
+        BUS.drop(fp)
+
+
+# -- E2E: mount, per-tenant isolation, refcounted teardown -------------------
+
+
+def canonical(path):
+    with open(path) as f:
+        return sorted(line for line in f.read().splitlines() if line)
+
+
+def test_shared_mount_end_to_end(tmp_path):
+    """Two tenants mount one impulse scan; both outputs are
+    byte-identical to unshared solo runs of the same SQL; the hidden
+    host is torn down by the last tenant's release."""
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    def sql(tag, enabled_dir):
+        return pipeline_sql(out=str(tmp_path / f"{enabled_dir}-{tag}.json"),
+                            n=800, rate=100_000)
+
+    async def fleet(tag, enabled):
+        fps = []
+        with update(sharing={"enabled": enabled},
+                    pipeline={"checkpointing": {"interval": 0.3,
+                                                "storage_url": ""}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                for j in range(2):
+                    await c.submit_job(f"t{j}", sql=sql(f"t{j}", tag),
+                                       n_workers=1, parallelism=1)
+                for j in range(2):
+                    st = await c.wait_for_state(
+                        f"t{j}", JobState.FINISHED, JobState.FAILED,
+                        timeout=60,
+                    )
+                    assert st == JobState.FINISHED, c.jobs[f"t{j}"].failure
+                fps = [c.jobs[f"t{j}"].shared_fp for j in range(2)]
+                # refcounted teardown: the finished tenants' releases
+                # drained the host and dropped the channel
+                deadline = asyncio.get_event_loop().time() + 10
+                while c.sharing.hosts and \
+                        asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.1)
+                assert not c.sharing.hosts
+            finally:
+                await c.stop()
+        return fps
+
+    fps = asyncio.run(fleet("sh", True))
+    assert fps[0] and fps[0] == fps[1], fps
+    assert BUS.get(fps[0]) is None
+    solo_fps = asyncio.run(fleet("solo", False))
+    assert not any(solo_fps)
+    for j in range(2):
+        shared = canonical(tmp_path / f"sh-t{j}.json")
+        solo = canonical(tmp_path / f"solo-t{j}.json")
+        assert shared and shared == solo, f"t{j} diverged under sharing"
+
+
+def test_shared_tenant_stop_leaves_cotenant_intact(tmp_path):
+    """Stopping one mounted tenant mid-run must not perturb the other:
+    the survivor's output stays byte-identical to its solo run, and the
+    host keeps running until the LAST tenant detaches."""
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    def sql(tag):
+        # wall-paced replay (~2.5 s): the stop lands mid-stream
+        return pipeline_sql(out=str(tmp_path / f"{tag}.json"), n=2500,
+                            rate=1000, realtime=True, replay=True)
+
+    async def fleet():
+        with update(sharing={"enabled": True},
+                    pipeline={"checkpointing": {"interval": 0.3,
+                                                "storage_url": ""}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job("keep", sql=sql("keep"), n_workers=1,
+                                   parallelism=1)
+                await c.submit_job("gone", sql=sql("gone"), n_workers=1,
+                                   parallelism=1)
+                await asyncio.sleep(0.8)
+                status = c.sharing.status()
+                assert status and list(status.values())[0]["refcount"] == 2
+                await c.stop_job("gone", "immediate")
+                st = await c.wait_for_state(
+                    "keep", JobState.FINISHED, JobState.FAILED, timeout=60
+                )
+                assert st == JobState.FINISHED, c.jobs["keep"].failure
+                # the survivor held the host alive past the co-tenant's
+                # stop; its own release then tears everything down
+            finally:
+                await c.stop()
+
+    async def solo():
+        with update(pipeline={"checkpointing": {"interval": 0.3,
+                                                "storage_url": ""}}):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                await c.submit_job("solo", sql=sql("solo"), n_workers=1,
+                                   parallelism=1)
+                st = await c.wait_for_state(
+                    "solo", JobState.FINISHED, JobState.FAILED, timeout=60
+                )
+                assert st == JobState.FINISHED, c.jobs["solo"].failure
+            finally:
+                await c.stop()
+
+    asyncio.run(fleet())
+    asyncio.run(solo())
+    keep = canonical(tmp_path / "keep.json")
+    assert keep and keep == canonical(tmp_path / "solo.json"), \
+        "co-tenant stop perturbed the survivor's output"

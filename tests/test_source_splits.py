@@ -247,3 +247,98 @@ def test_impulse_legacy_state_upgrades_in_place():
     table.put(0, 17)  # legacy: subtask 0 at counter 17
     emitted = _impulse_round(table, 1, 100)
     assert sorted(emitted) == [(0, c) for c in range(17, 40)]
+
+
+# -- kinesis (reassignment-only splits) ---------------------------------------
+
+
+def _kinesis():
+    from arroyo_tpu.connectors.kinesis import KinesisSource
+
+    return KinesisSource("stream", "us-east-1", "latest", None, None,
+                         "fail")
+
+
+def test_kinesis_ownership_is_disjoint_total_and_lineage_stable():
+    """The no-gap/no-overlap property for a reassignment-only source:
+    crc32-root ownership partitions the shard set at every parallelism,
+    and reshard children always land on their root ancestor's owner."""
+    from types import SimpleNamespace
+
+    from arroyo_tpu.types import TaskInfo
+
+    src = _kinesis()
+    src._parent_of = {"child-1": "shard-2", "grand-1": "child-1"}
+    shards = [f"shard-{i}" for i in range(8)] + ["child-1", "grand-1"]
+    for par in (1, 2, 3, 5):
+        owners = {
+            sid: [
+                i for i in range(par)
+                if src._owned(sid, SimpleNamespace(
+                    task_info=TaskInfo("j", 1, "src", i, par)))
+            ]
+            for sid in shards
+        }
+        assert all(len(v) == 1 for v in owners.values()), owners
+        assert owners["child-1"] == owners["shard-2"]
+        assert owners["grand-1"] == owners["shard-2"]
+
+
+def test_kinesis_checkpoints_per_split_and_merges_legacy():
+    """Positions persist under split keys ({"seq": pos} per shard), and
+    restore merges split entries with legacy per-subtask snapshots —
+    CLOSED wins, else the furthest sequence number."""
+    from arroyo_tpu.connectors.kinesis import CLOSED
+
+    table = _Table()
+
+    async def go():
+        src = _kinesis()
+        ctx = _Ctx(table, 0, 1)
+        await src.on_start(ctx)
+        src.positions = {"a": "100", "b": CLOSED}
+        await src.handle_checkpoint(None, ctx, None)
+        assert set(table.d) == {sm.split_key("a"), sm.split_key("b")}
+        # a legacy per-subtask snapshot: a new shard plus a STALE
+        # overlap for 'a' that the furthest-position merge must lose
+        table.put(3, {"c": "7", "a": "50"})
+        restored = _kinesis()
+        await restored.on_start(_Ctx(table, 1, 2))
+        assert restored.positions == {"a": "100", "b": CLOSED, "c": "7"}
+
+    asyncio.run(go())
+
+
+# -- polling_http (single-split state) ----------------------------------------
+
+
+def test_polling_http_single_split_round_trip():
+    """The changed-dedup digest and poll count survive a restart through
+    the single `p0` split (no re-emit of the already-delivered body)."""
+    from types import SimpleNamespace
+
+    from arroyo_tpu.connectors.polling_http import PollingHttpSource
+
+    def mk():
+        schema = SimpleNamespace(schema=[])  # fieldless stand-in
+        return PollingHttpSource("http://x", 1.0, "changed", "GET", None,
+                                 {}, schema, "json", "fail")
+
+    table = _Table()
+
+    async def go():
+        src = mk()
+        ctx = _Ctx(table, 0, 1)
+        await src.on_start(ctx)
+        assert (src.last_sha, src.polls) == (None, 0)
+        src.last_sha, src.polls = "abc123", 5
+        await src.handle_checkpoint(None, ctx, None)
+        assert set(table.d) == {sm.split_key("p0")}
+        restored = mk()
+        await restored.on_start(_Ctx(table, 0, 2))
+        assert (restored.last_sha, restored.polls) == ("abc123", 5)
+        # non-owners never write the split
+        await restored.handle_checkpoint(None, _Ctx(table, 1, 2), None)
+        assert set(table.d) == {sm.split_key("p0")}
+
+    asyncio.run(go())

@@ -44,8 +44,19 @@
         run, a worker is SIGKILLed mid-upload (storage latency widens
         the in-flight flush window), and the drill requires
         byte-identical output AND ~flat checkpoint capture time +
-        per-epoch delta bytes as state grows (<= 2x early-run medians;
+        delta byte RATE (bytes per second of epoch wall time) as state
+        grows (<= 2x early-run medians;
         a full-snapshot design shows ~10x on both).
+
+    python tools/chaos_drill.py --shared
+        ISSUE 16 acceptance: two tenants whose scans fingerprint
+        identically mount ONE shared host scan, a worker SIGKILL lands
+        mid-checkpoint, and each tenant's output must be byte-identical
+        to its own SOLO unshared fault-free run. With --plan, the
+        serialized counterexample (e.g. the sharedplan model's
+        leaked_barrier_across_tenants kill schedule from
+        tools/model_check.py --shared --trace-dir) replays against the
+        shared fleet instead of a golden query.
 
     python tools/chaos_drill.py --pipeline
         ISSUE 14 acceptance: a stateless chain fused into ONE segment
@@ -96,6 +107,13 @@ def main() -> int:
                     "SIGKILL mid-flight; requires byte-identical output "
                     "vs the UNFUSED clean run and proof that a barrier "
                     "drained a staged batch")
+    ap.add_argument("--shared", action="store_true",
+                    help="also run the shared-plan fleet drill: two "
+                    "tenants mount ONE shared scan, a worker SIGKILL "
+                    "lands mid-checkpoint; each tenant's output must be "
+                    "byte-identical to its SOLO unshared run (with "
+                    "--plan: the counterexample replays against the "
+                    "shared fleet instead of a golden)")
     ap.add_argument("--plan", type=str, default="",
                     help="run the drill under a serialized FaultPlan JSON "
                     "(bare plan or a model-check counterexample payload "
@@ -125,9 +143,10 @@ def main() -> int:
             print(f"replaying counterexample: {trace.get('violation')} "
                   f"(mutant {trace.get('mutant') or 'none'}, "
                   f"{len(trace.get('events', []))} model events)")
-        queries = [q for q in args.queries.split(",") if q.strip()] or [
-            d.DEFAULT_DRILL_QUERIES[0]
-        ]
+        queries = [] if args.shared else (
+            [q for q in args.queries.split(",") if q.strip()]
+            or [d.DEFAULT_DRILL_QUERIES[0]]
+        )
         # a fresh plan per drill run: hit counters are stateful
         plan_factory = lambda seed: FaultPlan.from_json(plan_text)  # noqa: E731
     elif args.fast:
@@ -160,6 +179,13 @@ def main() -> int:
         results.append(
             d.run_pipeline_drill(
                 args.seed, os.path.join(workdir, "pipeline")
+            )
+        )
+    if args.shared:
+        shared_kw = {"plan_factory": plan_factory} if args.plan else {}
+        results.append(
+            d.run_shared_drill(
+                args.seed, os.path.join(workdir, "shared"), **shared_kw
             )
         )
 

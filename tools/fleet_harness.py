@@ -66,11 +66,24 @@ recovery, with watch_false_positive_count == 0 (any firing event naming
 a healthy tenant fails the run). Committed as WATCH_r01.json; the
 nightly `watch` CI lane gates it via bench_compare's exact-zero class.
 
+Shared-plan fleet A/B (ISSUE 16): `--shared-fleet` runs the SAME
+`--jobs` tenants twice — identical deterministic source scan with
+per-tenant tails, once all mounted on one hidden `__shared/<fp>` host
+(sharing on) and once each owning its full data plane (sharing off) —
+and gates: aggregate source events/s with sharing must exceed 5x the
+unshared run (fleet_shared_agg_eps / fleet_unshared_agg_eps, both
+pinned in BENCH_BASELINE.json), every tenant's output byte-identical
+across the passes, the mount actually engaged (refcount peak == jobs),
+and the cost apportioner keeping the >= 95% attributed-coverage gate
+over the shared fleet with no `__shared/*` bucket left behind.
+
 Usage:
   python tools/fleet_harness.py --jobs 100 --pool 2 --sample 8 \
       [--churn 30] [--idle-seconds 10] [--kill] [--out fleet.json]
   python tools/fleet_harness.py --serve [--serve-kill] \
       [--serve-duration 10] [--serve-clients 6] [--out serve.json]
+  python tools/fleet_harness.py --shared-fleet --jobs 100 \
+      [--shared-events 50000] [--out shared_fleet.json]
 """
 
 from __future__ import annotations
@@ -466,6 +479,162 @@ async def run_fleet(jobs: int = 100, pool: int = 2, sample: int = 8,
         "fleet_exactly_once_ok": 0 if mismatches else 1,
         "fleet_sample_mismatches": mismatches,
         "fleet_admission": admission,
+    })
+    return report
+
+
+def shared_fleet_sql(outdir: str, tag: str, j: int, events: int) -> str:
+    """One fleet tenant: every tenant's SCAN is config-identical (the
+    shared-plan fingerprint matches), the tail differs per tenant. The
+    tail is deliberately thin (a residue filter) — the scenario measures
+    what sharing amortizes, the per-row source scan."""
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '1000000',
+      message_count = '{events}', start_time = '0'
+    );
+    CREATE TABLE out (c BIGINT UNSIGNED) WITH (
+      connector = 'single_file', path = '{outdir}/{tag}-{j}.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out SELECT counter as c FROM impulse
+    WHERE counter % 997 = {j % 997};
+    """
+
+
+async def run_shared_fleet(jobs: int = 100, events: int = 50000,
+                           pool: int = 2,
+                           workdir: str | None = None) -> dict:
+    """Shared-plan A/B (ISSUE 16): the SAME `jobs` tenants — identical
+    source scan, per-tenant tails — run once with sharing ON (all mount
+    one `__shared/<fp>` host scan) and once unshared (each job owns its
+    data plane). Reports aggregate source events/s for both
+    (fleet_shared_agg_eps / fleet_unshared_agg_eps — the pinned bench
+    keys), requires byte-identical per-tenant output across the two
+    passes, the mount to actually reach refcount == jobs, and the
+    attribution apportioner to keep the >= 95% attributed-coverage gate
+    over the shared fleet (the host's cost must land on tenants, not in
+    a `__shared/*` escape bucket)."""
+    from arroyo_tpu.config import update
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+    from arroyo_tpu.metrics import REGISTRY
+    from arroyo_tpu.obs import attribution
+
+    workdir = workdir or tempfile.mkdtemp(prefix="arroyo-shared-fleet-")
+    os.makedirs(workdir, exist_ok=True)
+    report: dict = {"jobs": jobs, "events": events, "pool": pool,
+                    "workdir": workdir}
+
+    async def one_pass(shared: bool, tag: str,
+                       busy_baseline: float = 0.0) -> dict:
+        out: dict = {"refcount_peak": 0}
+        # big source batches: the per-tenant tail cost is per-BATCH
+        # (vectorized), the scan cost is per-ROW — the fleet bench runs
+        # both passes on the same batching so the A/B isolates sharing
+        with update(
+            sharing={"enabled": shared},
+            pipeline={"checkpointing": {"storage_url": ""},
+                      "source_batch_size": 8192},
+            # long metrics_ttl: the attribution audit reads per-job
+            # totals after ALL tenants finish; the default churn GC
+            # would drop early finishers' totals mid-pass
+            cluster={"worker_pool_size": pool, "metrics_ttl": 600.0},
+            controller={"heartbeat_timeout": 10.0},
+            worker={"task_slots": max(4, (jobs + 8) // pool + 4)},
+            obs={"latency_marker_interval": 0.0, "enabled": False},
+            # a 100-job burst on a small pool trivially breaches the
+            # loop-lag SLO; the watchtower is not under test here
+            watch={"enabled": False},
+        ):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            try:
+                t0 = time.monotonic()
+                for j in range(jobs):
+                    await c.submit_job(
+                        f"t{j}",
+                        sql=shared_fleet_sql(workdir, tag, j, events),
+                        n_workers=1, parallelism=1,
+                    )
+                pending = set(range(jobs))
+                deadline = time.monotonic() + 600
+                while pending and time.monotonic() < deadline:
+                    if shared:
+                        for st in c.sharing.status().values():
+                            out["refcount_peak"] = max(
+                                out["refcount_peak"], st["refcount"]
+                            )
+                    for j in list(pending):
+                        state = c.jobs[f"t{j}"].state
+                        if state == JobState.FAILED:
+                            raise RuntimeError(
+                                f"t{j}: {c.jobs[f't{j}'].failure}"
+                            )
+                        if state.is_terminal():
+                            pending.discard(j)
+                    await asyncio.sleep(0.05)
+                if pending:
+                    raise RuntimeError(
+                        f"shared-fleet pass {tag}: {len(pending)} jobs "
+                        "never finished"
+                    )
+                out["wall_s"] = time.monotonic() - t0
+                if shared:
+                    # audit BEFORE teardown: metrics_ttl GC drops
+                    # per-job attribution totals once jobs expunge.
+                    # Host cost must be apportioned onto tenants
+                    # (>= 95% of measured pool busy time), and no
+                    # __shared/* bucket may be left in the summary —
+                    # that would mean cost escaped the apportioner.
+                    summary = attribution.ACCOUNTING.summary()
+                    worker_busy = sum(
+                        v for _l, v in REGISTRY.snapshot().get(
+                            "arroyo_worker_busy_seconds", [])
+                    ) - busy_baseline
+                    out["attr_coverage_pct"] = round(
+                        100.0 * summary["attributed_busy_s"]
+                        / max(worker_busy, 1e-9), 2,
+                    )
+                    out["attr_shared_bucket"] = [
+                        j for j in summary["jobs"]
+                        if j.startswith("__shared/")
+                    ]
+            finally:
+                await c.stop()
+        return out
+
+    # shared pass FIRST: the coverage audit reads process-cumulative
+    # busy counters, so it must run before the unshared pass adds 100
+    # unattributed-scan-free jobs worth of busy time
+    attribution.ACCOUNTING.reset()
+    busy0 = sum(v for _l, v in REGISTRY.snapshot().get(
+        "arroyo_worker_busy_seconds", []))
+    shared_pass = await one_pass(True, "shr", busy_baseline=busy0)
+    unshared_pass = await one_pass(False, "uns")
+
+    mismatches = []
+    for j in range(jobs):
+        a = canonical_rows(os.path.join(workdir, f"shr-{j}.json"))
+        b = canonical_rows(os.path.join(workdir, f"uns-{j}.json"))
+        if not a or a != b:
+            mismatches.append(j)
+
+    shared_eps = jobs * events / shared_pass["wall_s"]
+    unshared_eps = jobs * events / unshared_pass["wall_s"]
+    report.update({
+        "fleet_shared_agg_eps": round(shared_eps, 1),
+        "fleet_unshared_agg_eps": round(unshared_eps, 1),
+        "fleet_shared_speedup": round(shared_eps / unshared_eps, 2),
+        "fleet_shared_wall_s": round(shared_pass["wall_s"], 2),
+        "fleet_unshared_wall_s": round(unshared_pass["wall_s"], 2),
+        "fleet_shared_refcount_peak": shared_pass["refcount_peak"],
+        "fleet_shared_outputs_ok": 0 if mismatches else 1,
+        "fleet_shared_mismatches": mismatches,
+        "fleet_shared_attr_coverage_pct":
+            shared_pass["attr_coverage_pct"],
+        "fleet_shared_attr_bucket": shared_pass.get(
+            "attr_shared_bucket", []),
     })
     return report
 
@@ -1101,7 +1270,53 @@ def main(argv=None) -> int:
                     help="healthy co-tenants beside the victim")
     ap.add_argument("--watch-rate", type=int, default=2000)
     ap.add_argument("--watch-keys", type=int, default=32)
+    # Shared-plan fleet A/B (ISSUE 16)
+    ap.add_argument("--shared-fleet", action="store_true",
+                    help="run the shared-plan A/B: the same tenants "
+                         "once mounted on one shared source scan, once "
+                         "unshared; gates >5x aggregate eps, identical "
+                         "outputs, full mount engagement, and the 95%% "
+                         "attribution coverage over the shared fleet")
+    ap.add_argument("--shared-events", type=int, default=50000,
+                    help="source events per tenant in the A/B")
     args = ap.parse_args(argv)
+    if args.shared_fleet:
+        report = asyncio.run(run_shared_fleet(
+            jobs=args.jobs, events=args.shared_events,
+            pool=args.pool, workdir=args.workdir,
+        ))
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        rc = 0
+        if not report["fleet_shared_outputs_ok"]:
+            print(f"SHARED FLEET: per-tenant outputs diverged between "
+                  f"shared and unshared passes: "
+                  f"{report['fleet_shared_mismatches'][:10]}",
+                  file=sys.stderr)
+            rc = 1
+        if report["fleet_shared_refcount_peak"] < args.jobs:
+            print(f"SHARED FLEET: sharing never fully engaged — "
+                  f"refcount peak {report['fleet_shared_refcount_peak']}"
+                  f" < {args.jobs} tenants", file=sys.stderr)
+            rc = 1
+        if report["fleet_shared_speedup"] <= 5.0:
+            print(f"SHARED FLEET: aggregate speedup "
+                  f"{report['fleet_shared_speedup']}x is not > 5x",
+                  file=sys.stderr)
+            rc = 1
+        if report["fleet_shared_attr_coverage_pct"] < 95.0:
+            print(f"SHARED FLEET: attribution coverage "
+                  f"{report['fleet_shared_attr_coverage_pct']}% < 95%",
+                  file=sys.stderr)
+            rc = 1
+        if report["fleet_shared_attr_bucket"]:
+            print(f"SHARED FLEET: host cost escaped apportioning into "
+                  f"{report['fleet_shared_attr_bucket']}",
+                  file=sys.stderr)
+            rc = 1
+        return rc
     if args.watch:
         report = asyncio.run(run_watch(
             healthy=args.watch_healthy, rate=args.watch_rate,

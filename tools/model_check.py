@@ -51,6 +51,7 @@ from arroyo_tpu.analysis.model import explore as explore_mod  # noqa: E402
 from arroyo_tpu.analysis.model import multitenant as mt_mod  # noqa: E402
 from arroyo_tpu.analysis.model import mutants as mutants_mod  # noqa: E402
 from arroyo_tpu.analysis.model import replay as replay_mod  # noqa: E402
+from arroyo_tpu.analysis.model import sharedplan as sp_mod  # noqa: E402
 from arroyo_tpu.analysis.model.extract import (  # noqa: E402
     check_bijection,
     job_state_machine,
@@ -115,9 +116,10 @@ def _write_sarif(path: str, traces) -> None:
     print(f"sarif report written to {path}")
 
 
-def _dump_trace(trace_dir: str, name: str, trace) -> str:
+def _dump_trace(trace_dir: str, name: str, trace,
+                payload_fn=None) -> str:
     os.makedirs(trace_dir, exist_ok=True)
-    payload = replay_mod.counterexample_payload(trace)
+    payload = (payload_fn or replay_mod.counterexample_payload)(trace)
     path = os.path.join(trace_dir, f"{name}.json")
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
@@ -163,6 +165,14 @@ def main(argv=None) -> int:
     ap.add_argument("--multi", action="store_true",
                     help="only the 2-job shared-worker configuration "
                          "(per-job recovery independence)")
+    ap.add_argument("--shared", action="store_true",
+                    help="only the shared-plan operator lifecycle "
+                         "configuration (one barrier, per-tenant epochs "
+                         "reconciled) + its mutants")
+    ap.add_argument("--tenants", type=int, default=None,
+                    help="shared-plan configuration: mounted tenant count")
+    ap.add_argument("--kills", type=int, default=None,
+                    help="shared-plan configuration: process-kill budget")
     ap.add_argument("--list-mutants", action="store_true")
     ap.add_argument("--bijection-only", action="store_true")
     ap.add_argument("--trace-dir", default=None,
@@ -182,6 +192,10 @@ def main(argv=None) -> int:
             print(f"{mm.name} [multitenant]\n"
                   f"    expects: {mm.expect_violation}")
             print(f"    {mm.description}\n")
+        for sm in sp_mod.SP_MUTANTS.values():
+            print(f"{sm.name} [sharedplan]\n"
+                  f"    expects: {sm.expect_violation}")
+            print(f"    {sm.description}\n")
         return 0
 
     members, terminals, table = job_state_machine(load_project(args.root))
@@ -303,7 +317,74 @@ def main(argv=None) -> int:
         elif not res.exhaustive:
             rc = 2
 
-    if args.multi:
+    def run_shared(cfg, name, expect=""):
+        nonlocal rc
+        t0 = time.time()
+        res = sp_mod.check_sharedplan(cfg, budget=args.budget)
+        dt = time.time() - t0
+        entry = {
+            "name": name, "config": cfg._asdict(), "states": res.states,
+            "transitions": res.transitions, "exhaustive": res.exhaustive,
+            "seconds": round(dt, 2),
+            "violations": [t.violation for t in res.violations],
+        }
+        summary["runs"].append(entry)
+        if expect:
+            hit = [t for t in res.violations
+                   if t.violation.split(":", 1)[0] == expect]
+            if not hit:
+                print(f"{name}: SHAREDPLAN MUTANT NOT CAUGHT (expected "
+                      f"{expect}, got "
+                      f"{[t.violation for t in res.violations]})")
+                rc = rc or 1
+                return
+            tr = hit[0]
+            got = sp_mod.replay_sharedplan(tr)
+            replay_ok = got.split(":", 1)[0] == expect
+            plan = sp_mod.sp_trace_to_fault_plan(tr)
+            entry["replay"] = "ok" if replay_ok else f"diverged: {got}"
+            entry["plan_seed"] = plan.seed
+            entry["plan_faults"] = len(plan.specs)
+            if not replay_ok:
+                print(f"{name}: counterexample did not replay ({got})")
+                rc = rc or 1
+            where = ""
+            if args.trace_dir:
+                where = " -> " + _dump_trace(
+                    args.trace_dir, name, tr,
+                    payload_fn=sp_mod.sp_counterexample_payload,
+                )
+            print(f"{name}: caught {tr.violation.split(':', 1)[0]} in "
+                  f"{len(tr.events)} events (states={res.states}, "
+                  f"replay={'ok' if replay_ok else 'DIVERGED'}, "
+                  f"plan seed={plan.seed}){where}")
+            return
+        status = "exhaustive" if res.exhaustive else "TRUNCATED"
+        print(f"{name}: {res.states} states, {res.transitions} "
+              f"transitions, {status}, {dt:.1f}s")
+        if res.violations:
+            rc = 1
+            for t in res.violations:
+                print(f"  VIOLATION: {t.violation}")
+                for ev in t.events:
+                    print(f"    {ev[0]}{tuple(ev[1])}")
+        elif not res.exhaustive:
+            rc = 2
+
+    def _sp_acceptance_cfg():
+        cfg = sp_mod.SPConfig()
+        overrides = {
+            k: getattr(args, k)
+            for k in ("tenants", "epochs", "kills")
+            if getattr(args, k) is not None
+        }
+        return cfg._replace(**overrides) if overrides else cfg
+
+    if args.shared:
+        run_shared(_sp_acceptance_cfg(), "sharedplan-lifecycle")
+        for sm in sp_mod.SP_MUTANTS.values():
+            run_shared(sm.config, sm.name, expect=sm.expect_violation)
+    elif args.multi:
         run_multi(mt_mod.MTConfig(), "multitenant-2job")
         for mm in mt_mod.MT_MUTANTS.values():
             run_multi(mm.config, mm.name, expect=mm.expect_violation)
@@ -311,6 +392,10 @@ def main(argv=None) -> int:
         if args.mutant and args.mutant in mt_mod.MT_MUTANTS:
             mm = mt_mod.MT_MUTANTS[args.mutant]
             run_multi(mm.config, mm.name, expect=mm.expect_violation)
+            names = []
+        elif args.mutant and args.mutant in sp_mod.SP_MUTANTS:
+            sm = sp_mod.SP_MUTANTS[args.mutant]
+            run_shared(sm.config, sm.name, expect=sm.expect_violation)
             names = []
         else:
             names = ([args.mutant] if args.mutant
@@ -325,11 +410,19 @@ def main(argv=None) -> int:
             for mm in mt_mod.MT_MUTANTS.values():
                 run_multi(mm.config, mm.name,
                           expect=mm.expect_violation)
+            # likewise the shared-plan operator lifecycle (ISSUE 16)
+            run_shared(sp_mod.SPConfig(), "sharedplan-lifecycle")
+            for sm in sp_mod.SP_MUTANTS.values():
+                run_shared(sm.config, sm.name,
+                           expect=sm.expect_violation)
         if rc == 0 and args.corpus:
             n_hist = len(mutants_mod.historical_mutants())
-            print(f"corpus: all {len(names) + len(mt_mod.MT_MUTANTS)} "
+            n_all = (len(names) + len(mt_mod.MT_MUTANTS)
+                     + len(sp_mod.SP_MUTANTS))
+            print(f"corpus: all {n_all} "
                   f"mutant(s) caught ({n_hist} historical PR 2 bugs "
-                  "included; 2-job multitenant configuration clean)")
+                  "included; 2-job multitenant and shared-plan "
+                  "configurations clean)")
     else:
         cfg = SMOKE if args.smoke else FULL
         overrides = {

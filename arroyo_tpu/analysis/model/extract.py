@@ -37,7 +37,8 @@ from ..rules_protocol import (
 # annotated protocol handlers live under these roots only (tests and
 # fixture trees carry their own annotations for rule tests; the bijection
 # is about the engine tree)
-HANDLER_ROOTS = ("controller/", "operators/", "state/", "serve/")
+HANDLER_ROOTS = ("controller/", "operators/", "state/", "serve/",
+                 "failover/")
 
 
 class ExtractionError(Exception):

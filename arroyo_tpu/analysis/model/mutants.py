@@ -165,6 +165,26 @@ MUTANTS: Dict[str, Mutant] = {
                         mutant="overlap_double_emission"),
         ),
         Mutant(
+            name="promote_while_primary_alive",
+            description=(
+                "hot-standby failover invariant (ISSUE 17): promotion "
+                "must re-resolve the LATEST published manifest when it "
+                "claims the fresh generation — the standby's tailed "
+                "restore may be an epoch behind a primary that is "
+                "merely slow (heartbeat blackout), not dead. The mutant "
+                "promotes at the standby's tailed epoch instead: the "
+                "still-running primary already published and committed "
+                "a later epoch, so the promoted generation rewinds "
+                "behind visible output and re-emits it — the "
+                "overlap_double_emission invariant generalized to "
+                "failover."
+            ),
+            expect_violation=VIOLATIONS.OVERLAP_EMIT,
+            config=_cfg(epochs=1, inflight=2, faults=1,
+                        fault_kinds=("fault.blackout",), standby=1,
+                        mutant="promote_while_primary_alive"),
+        ),
+        Mutant(
             name="serve_reads_unpublished_epoch",
             description=(
                 "StateServe invariant (ISSUE 12): queryable-state reads "

@@ -72,6 +72,12 @@ HANDLER_BINDINGS: Dict[str, Tuple[str, str]] = {
                               "_overlap_activate"),
     "ctrl.recover": ("controller/controller.py", "_recover"),
     "ctrl.schedule": ("controller/controller.py", "_schedule_inner"),
+    "ctrl.failover_promote": ("controller/controller.py",
+                              "_failover_promote"),
+    "failover.arm": ("failover/manager.py", "_arm"),
+    "failover.tail": ("failover/manager.py", "_tail"),
+    "failover.promote": ("failover/manager.py", "_promote"),
+    "state.tail_chains": ("state/table_manager.py", "tail_chains"),
     "worker.capture": ("operators/runner.py", "_checkpoint_chain"),
     "worker.admit_flush": ("operators/runner.py", "_admit_flush"),
     "worker.flush": ("operators/runner.py", "_flush_and_report"),
@@ -123,6 +129,16 @@ TRANSITION_HANDLERS: Dict[str, Tuple[str, ...]] = {
     # generation settled. RESCALING -> RUNNING, never through SCHEDULING.
     "overlap.prepare": ("ctrl.rescale", "ctrl.overlap_prepare"),
     "overlap.activate": ("ctrl.overlap_activate", "storage.new_generation"),
+    # hot-standby failover (ISSUE 17): a warm standby incarnation is
+    # ARMED beside the live generation (staged restore, sources parked),
+    # TAILED forward on every published epoch's delta chain, and
+    # PROMOTED in place on heartbeat loss — RUNNING stays RUNNING, no
+    # SCHEDULING pass. Promotion claims a fresh generation, which is
+    # what fences a merely-slow primary.
+    "standby.arm": ("failover.arm",),
+    "standby.tail": ("failover.tail", "state.tail_chains"),
+    "failover.promote": ("ctrl.failover_promote", "failover.promote",
+                         "storage.new_generation"),
     "w.capture": ("worker.capture", "worker.admit_flush",
                   "state.capture_tables"),
     "w.flush": ("worker.flush", "state.flush_tables"),
@@ -167,6 +183,7 @@ class ModelConfig(NamedTuple):
     rescales: int = 0         # rescale-request budget (0 or 1)
     overlap: int = 0          # 1 = rescales use the generation-overlap path
     reads: int = 0            # StateServe reader-actor event budget
+    standby: int = 0          # 1 = a hot-standby incarnation may be armed
     fault_kinds: Tuple[str, ...] = FAULT_KINDS
     mutant: str = ""          # mutants.py flag (empty == faithful model)
 
@@ -202,6 +219,11 @@ class CtrlS(NamedTuple):
     # (restored read-only at prep_epoch) while the old one drains
     overlap: int = 0
     prep_epoch: int = -1      # published epoch the prepared restore used
+    # hot-standby failover: 0 = none, 1 = armed (staged restore parked
+    # beside the live generation); standby_epoch is the published epoch
+    # its tailed restore has reached
+    standby: int = 0
+    standby_epoch: int = -1
     failure: str = ""         # latest failure reason (trace readability)
 
 
@@ -330,8 +352,9 @@ class Model:
             failure=reason, stop=(1 if s.ctrl.stop else 0), rescale=0,
             stop_epoch=0, pending=(), reports=(),
             # a failed overlap discards the prepared incarnation: it
-            # restored read-only and claimed nothing durable
-            overlap=0, prep_epoch=-1,
+            # restored read-only and claimed nothing durable — the same
+            # holds for an armed standby (it re-arms after recovery)
+            overlap=0, prep_epoch=-1, standby=0, standby_epoch=-1,
         )
         return Step(label, (reason,), st.nxt, st.violation)
 
@@ -460,6 +483,12 @@ class Model:
         out: List[Step] = []
         dead = _dead_unfinished(s)
         if dead and not self._liveness_masked(s):
+            # failover (ISSUE 17): with a standby armed the controller
+            # may promote it in place instead of recovering. Both moves
+            # stay enabled — promotion can fail in the real system and
+            # fall back to the cold path, so the model verifies both.
+            if ctrl.js == "RUNNING" and ctrl.standby == 1:
+                out.append(self._failover_promote(s))
             out.append(self._fail(s, "ctrl.detect_death",
                                   f"heartbeat-timeout-w{dead[0]}"))
 
@@ -482,6 +511,27 @@ class Model:
                 out.append(self._move(s, "stop.begin", "CHECKPOINT_STOPPING"))
             if ctrl.rescale == 1:
                 out.append(self._move(s, "rescale.begin", "RESCALING"))
+            if cfg.standby:
+                if ctrl.standby == 0:
+                    # arm: stage a read-only restore at the last
+                    # PUBLISHED manifest beside the live generation
+                    # (sources parked on the release gate — claims
+                    # nothing durable)
+                    out.append(Step(
+                        "standby.arm", (s.store.latest,),
+                        s._replace(ctrl=ctrl._replace(
+                            standby=1, standby_epoch=s.store.latest,
+                        )),
+                    ))
+                elif ctrl.standby_epoch < s.store.latest:
+                    # tail: replay the newly published epoch's delta
+                    # chain onto the standby's tables
+                    out.append(Step(
+                        "standby.tail", (s.store.latest,),
+                        s._replace(ctrl=ctrl._replace(
+                            standby_epoch=s.store.latest,
+                        )),
+                    ))
 
         if ctrl.js == "CHECKPOINT_STOPPING":
             if ctrl.stop != 2 and ctrl.pending:
@@ -737,6 +787,59 @@ class Model:
         )
         return self._move(torn, "overlap.activate", "RUNNING")
 
+    def _failover_promote(self, s: Sys) -> Step:
+        """Hot-standby promotion (ISSUE 17): on heartbeat loss the armed
+        standby claims a fresh generation and takes over IN PLACE —
+        RUNNING stays RUNNING, no SCHEDULING pass. Promotion re-resolves
+        the LATEST published manifest at claim time (the standby's
+        tailed restore may be an epoch behind) and, like any restore,
+        idempotently replays every claimed epoch's commit. The fresh
+        generation is the fence: a merely-slow (heartbeat-blacked-out)
+        primary keeps running, but its publishes fence and its late
+        uploads land beside, never over, live blobs. The
+        `promote_while_primary_alive` mutant promotes at the standby's
+        TAILED epoch without re-resolving latest — resuming behind
+        output the still-alive primary already made visible, so the
+        promoted generation re-emits a committed epoch (the
+        overlap_double_emission invariant generalized to failover)."""
+        base = (s.ctrl.standby_epoch
+                if self.cfg.mutant == "promote_while_primary_alive"
+                else s.store.latest)
+        torn = self._teardown(s)
+        newgen = torn.store.gen + 1
+        # restore-time commit replay (same rule as ctrl.schedule /
+        # overlap.activate): every claimed epoch's manifest commit
+        # becomes visible exactly once
+        finalized = torn.finalized
+        mgens = dict(torn.store.manifests)
+        for e in torn.store.claimed:
+            g = mgens.get(e)
+            if g is None:
+                continue
+            clash = [g2 for (e2, g2) in finalized if e2 == e and g2 != g]
+            if clash:
+                return Step("failover.promote", (), None,
+                            f"{_V.DOUBLE_COMMIT}: promoted restore "
+                            f"replayed epoch {e} under gen {g} over gen "
+                            f"{clash[0]}")
+            finalized = _sorted_add(finalized, (e, g))
+        nxt = torn._replace(
+            finalized=finalized,
+            workers=tuple(WorkerS(gen=newgen)
+                          for _ in range(len(s.workers))),
+            store=torn.store._replace(
+                gen=newgen,
+                gen_base=torn.store.gen_base + ((newgen, base),),
+            ),
+            ctrl=torn.ctrl._replace(
+                gen=newgen, stop=(1 if s.ctrl.stop else 0), rescale=0,
+                stop_epoch=0, standby=0, standby_epoch=-1,
+                epoch=base, epoch_budget=self.cfg.epochs,
+                pending=(), reports=(), finished=(), failure="",
+            ),
+        )
+        return Step("failover.promote", (base,), nxt)
+
     def _teardown(self, s: Sys) -> Sys:
         """Force-stop every worker. A blacked-out (presumed-dead but
         running) worker's unflushed captures become zombie late-writes
@@ -764,7 +867,7 @@ class Model:
             ctrl=torn.ctrl._replace(
                 gen=newgen, restarts=ctrl.restarts + 1,
                 pending=(), reports=(), finished=(), rescale=0, stop_epoch=0,
-                overlap=0, prep_epoch=-1,
+                overlap=0, prep_epoch=-1, standby=0, standby_epoch=-1,
             ),
         )
         return self._move(torn, "ctrl.recover", "SCHEDULING")

@@ -526,6 +526,47 @@ class RescaleConfig:
 
 
 @dataclasses.dataclass
+class FailoverConfig:
+    """Hot-standby failover (ISSUE 17). A standby manager keeps a warm
+    standby incarnation per durable job: staged beside the live
+    generation via the rescale path's StartExecution{staged} (sources
+    parked on the release gate), continuously re-restored by tailing
+    each published epoch's delta chains instead of full restores, and
+    promoted IN PLACE on heartbeat loss — RUNNING stays RUNNING, no
+    SCHEDULING pass — so a SIGKILL costs a sub-second output gap
+    instead of a multi-second teardown + reschedule + cold restore.
+    Promotion claims a fresh generation, which fences a merely-slow
+    primary (modeled first: analysis/model/spec.py standby.arm /
+    standby.tail / failover.promote and the promote_while_primary_alive
+    mutant)."""
+
+    # master switch: off = heartbeat loss takes the legacy RECOVERING ->
+    # SCHEDULING cold path. Arming needs a pooled multiplexed worker set
+    # (the default embedded/process shape) and a durable job; anything
+    # else falls back automatically.
+    enabled: bool = False
+    # seconds after a promotion during which the watchtower suppresses
+    # freshness/e2e SLO pages for the job — a sub-second failover must
+    # not page (the kill still shows in metrics, just not as an alert)
+    grace: float = 5.0
+    # seconds a promotion (catch-up tail + generation claim + release)
+    # may take before the controller abandons it and falls back to the
+    # cold recovery path
+    promote_timeout: float = 10.0
+    # re-arm a fresh standby automatically after a promotion consumes
+    # the previous one
+    rearm: bool = True
+    # task-local recovery: workers keep their last flushed chain blobs
+    # in process memory so a restore/tail landing on the same worker
+    # skips the storage round-trip (cache entries are invalidated by
+    # publish epoch as chains rebase)
+    local_chain_cache: bool = True
+    # per-process cap on cached chain bytes (oldest-epoch entries are
+    # evicted first once the cap is hit)
+    cache_max_bytes: int = 268_435_456
+
+
+@dataclasses.dataclass
 class ClusterConfig:
     """Multi-tenant control plane (ROADMAP item 3): a shared worker pool
     hosting subtasks of MANY jobs per worker process — one event loop and
@@ -687,7 +728,8 @@ class Config:
     autoscale (closed-loop parallelism control), watch (metric history
     + SLO engine), tls, chaos (fault injection), obs (flight recorder), tpu (device
     kernels + mesh), controller, rescale (generation-overlap
-    zero-downtime rescale), cluster (shared worker pool /
+    zero-downtime rescale), failover (hot-standby generations +
+    task-local recovery), cluster (shared worker pool /
     multiplexing), admission (tenant quotas + fair slot scheduling),
     sharing (shared-plan multi-tenancy: fingerprint-matched jobs mount
     one source scan), worker, api, admin, database, logging. `tools/lint.py
@@ -707,6 +749,7 @@ class Config:
     controller: ControllerConfig = dataclasses.field(default_factory=ControllerConfig)
     sharing: SharingConfig = dataclasses.field(default_factory=SharingConfig)
     rescale: RescaleConfig = dataclasses.field(default_factory=RescaleConfig)
+    failover: FailoverConfig = dataclasses.field(default_factory=FailoverConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)

@@ -181,6 +181,9 @@ class JobHandle:
         self.stop_requested: Optional[str] = None
         self.restarts = 0
         self.schedules = 0  # StartExecution rounds (data-plane namespace)
+        # hot-standby failover (ISSUE 17): promotions of a warm standby
+        # generation in place of a cold recovery reschedule
+        self.promotions = 0
         self.events: List[dict] = []
         # worker-leader mode: the leader finished its local work and handed
         # the checkpoint cadence back to the controller
@@ -271,6 +274,11 @@ class ControllerServer:
         from .sharing import SharingManager
 
         self.sharing = SharingManager(self)
+        # hot-standby failover (ISSUE 17): warm standby generations per
+        # durable job + sub-second promotion on heartbeat loss
+        from ..failover import StandbyManager
+
+        self.failover = StandbyManager(self)
         self._reg_waiters: set = set()  # scheduling waits on registration
         # handles pruned on suspicion of death, kept so a heartbeat
         # re-registration can resurrect the SAME object — jobs hold
@@ -295,6 +303,7 @@ class ControllerServer:
                 "WorkerFinished": self._worker_finished,
                 "LeaderCheckpointFinished": self._leader_checkpoint_finished,
                 "LeaderResigned": self._leader_resigned,
+                "StandbyTaskFailed": self._standby_task_failed,
                 "RegisterNode": self._register_node,
             },
         )
@@ -332,6 +341,7 @@ class ControllerServer:
                 "/debug/serve": self._debug_serve,
                 "/debug/watch": self._debug_watch,
                 "/debug/sharing": self._debug_sharing,
+                "/debug/failover": self._debug_failover,
             },
         )
         logger.info("controller up at %s", self.addr)
@@ -391,6 +401,17 @@ class ControllerServer:
 
         return web.json_response(
             self.sharing.status(),
+            dumps=lambda d: json.dumps(d, default=str),
+        )
+
+    async def _debug_failover(self, request):
+        """Admin surface: hot-standby state — armed standbys with their
+        tailed epochs, promotion count, active grace windows, and the
+        task-local chain cache's occupancy."""
+        from aiohttp import web
+
+        return web.json_response(
+            self.failover.status(),
             dumps=lambda d: json.dumps(d, default=str),
         )
 
@@ -519,6 +540,15 @@ class ControllerServer:
             if job.failure is None:
                 job.failure = f"{req['task_id']}: {req['error']}"
             job.kick()
+        return {}
+
+    async def _standby_task_failed(self, req: dict) -> dict:
+        """A PARKED standby runner failed (restore error, local fault):
+        strictly a failover-manager concern — the primary incarnation of
+        the job is untouched."""
+        self.failover.on_standby_task_failed(
+            req.get("job_id"), str(req.get("error"))
+        )
         return {}
 
     async def _worker_finished(self, req: dict) -> dict:
@@ -716,6 +746,12 @@ class ControllerServer:
         else:
             await self.scheduler.stop_workers(job.job_id, force=force)
         if expunge:
+            # failover (ISSUE 17): standby workers are usually NOT in
+            # job.workers, so the StopJob loop above misses them — tear
+            # the staged incarnation down explicitly and drop the
+            # per-job promotion bookkeeping
+            await self.failover.discard(job)
+            self.failover.on_job_expunged(job.job_id)
             # shared-plan detach (ISSUE 16): a terminal tenant releases
             # its mount (the LAST one stops the host); a terminal host
             # drops its bus channel
@@ -971,6 +1007,13 @@ class ControllerServer:
         last_checkpoint = time.monotonic()
         while True:
             if job.failure is not None:
+                # hot-standby failover (ISSUE 17): a task failure while
+                # RUNNING (worker death surfaces as peer connection
+                # failures long before the heartbeat horizon) promotes
+                # the warm standby instead of cold-recovering
+                if await self._failover_promote(job):
+                    last_checkpoint = time.monotonic()
+                    continue
                 job.transition(JobState.RECOVERING)
                 return
             # finished-check MUST precede heartbeat expiry: a cleanly
@@ -999,6 +1042,9 @@ class ControllerServer:
                 job.transition(JobState.FINISHED)
                 return
             if self._heartbeat_expired(job):
+                if await self._failover_promote(job):
+                    last_checkpoint = time.monotonic()
+                    continue
                 job.failure = "worker heartbeat timeout"
                 job.transition(JobState.RECOVERING)
                 return
@@ -1120,6 +1166,9 @@ class ControllerServer:
                 last_checkpoint = time.monotonic()
                 await self._checkpoint_start(job)
                 continue
+            # hot-standby failover (ISSUE 17): keep a warm standby armed
+            # for every eligible job (no-op guard off the failover path)
+            self.failover.note_running(job)
             # park: RPC arrivals kick the job; the wheel wakes us at the
             # earliest deadline that could change a predicate above
             deadlines = [self._heartbeat_horizon(job)]
@@ -1129,9 +1178,28 @@ class ControllerServer:
                 deadlines.append(
                     min(i["deadline"] for i in job.pending_epochs.values())
                 )
+            rearm_at = self.failover.wake_deadline(job)
+            if rearm_at is not None:
+                # an eligible job without a standby (arm backing off):
+                # wake at the backoff horizon so re-arming isn't starved
+                deadlines.append(rearm_at)
             await job.wait_kick(
                 self.wheel, max(min(deadlines) - time.monotonic(), 0.0)
             )
+
+    @protocol_effect("ctrl.failover_promote")
+    async def _failover_promote(self, job: JobHandle) -> bool:
+        """Hot-standby promotion (ISSUE 17): on heartbeat loss or a task
+        failure while RUNNING, swap the warm standby generation in for
+        the (possibly merely slow) primary WITHOUT a SCHEDULING pass.
+        RUNNING stays RUNNING on success; False falls back to the normal
+        RECOVERING path. The promotion protocol is exhaustively model-
+        checked (analysis/model: standby.arm / standby.tail /
+        failover.promote) — in particular, the fresh generation re-
+        resolves the LATEST published manifest rather than trusting the
+        standby's tailed epoch (see the promote_while_primary_alive
+        mutant)."""
+        return await self.failover.try_promote(job)
 
     @protocol_effect("ctrl.rescale")
     async def _rescale(self, job: JobHandle):
@@ -1162,6 +1230,10 @@ class ControllerServer:
         overrides = job.rescale_requested or {}
         job.rescale_requested = None
         job.rescales += 1
+        # hot-standby failover (ISSUE 17): the overlap rescale stages its
+        # OWN incarnation under the same job id — discard the standby
+        # (worker `_staged` would collide) and re-arm after the rescale
+        await self.failover.discard(job)
         trace, parent = job.rescale_trace or (
             obs.new_trace(job.job_id, f"rescale-{job.rescales}"), None
         )
@@ -1609,6 +1681,9 @@ class ControllerServer:
         # durable restore floor on the bus and may clear the host's
         # gated epoch
         self.sharing.note_publish(job)
+        # failover (ISSUE 17): wake the standby's tailer so it applies
+        # this epoch's delta chains and stays within one epoch of us
+        self.failover.note_publish(job)
         try:
             committing = manifest.get("committing")
             if committing and job.backend.claim_commit(epoch):
@@ -1700,6 +1775,9 @@ class ControllerServer:
             await self._release_job(job, force=True, expunge=True)
             job.transition(JobState.FAILED)
             return
+        # a cold recovery replaces the generation and reschedules — any
+        # parked standby is stale the moment that happens (ISSUE 17)
+        await self.failover.discard(job)
         logger.warning("job %s recovering (%s)", job.job_id, job.failure)
         job.pending_epochs.clear()  # unpublished epochs die with the gen
         # flight recorder: each recovery is its own lifecycle trace; the

@@ -63,6 +63,12 @@ class _JobRuntime:
         # until the controller promotes the incarnation — so restore
         # overlaps the old generation's drain without double emission
         self.release: Optional[asyncio.Event] = None
+        # hot-standby failover (ISSUE 17): a standby incarnation restores
+        # at arm time and is kept warm by tailing each published epoch's
+        # delta chains; `standby_epoch` is the highest manifest epoch
+        # applied so far
+        self.standby = False
+        self.standby_epoch = 0
         self.tasks: list = []
         self.pump_task: Optional[asyncio.Task] = None
         self.n_running = 0
@@ -162,6 +168,7 @@ class WorkerServer:
             {
                 "StartExecution": self.start_execution,
                 "StartProcessing": self.start_processing,
+                "TailCheckpoint": self.tail_checkpoint,
                 "Checkpoint": self.checkpoint,
                 "Commit": self.commit,
                 "LoadCompacted": self.load_compacted,
@@ -374,8 +381,15 @@ class WorkerServer:
             # (Safe single-phase: no data can flow anywhere until the
             # gate opens, so peers' route registration cannot be raced.)
             jr.release = asyncio.Event()
+            jr.standby = bool(req.get("standby"))
+            jr.standby_epoch = int(req.get("restore_epoch") or 0)
             for sub in jr.program.subtasks:
                 sub.runner.source_gate = jr.release
+                if jr.standby:
+                    # hot standby (ISSUE 17): restore runs at arm time but
+                    # ALL on_start calls defer to promotion — the tables
+                    # keep being tailed forward until then
+                    sub.runner.standby_gate = jr.release
             self._staged[job_id] = jr
             for sub in jr.program.subtasks:
                 jr.tasks.append(asyncio.ensure_future(sub.runner.run()))
@@ -385,6 +399,41 @@ class WorkerServer:
         self._jobs[job_id] = jr
         return {"subtasks": len(program.subtasks)}
 
+    async def tail_checkpoint(self, req: dict) -> dict:
+        """Hot-standby tailing (ISSUE 17): replay a newly published
+        epoch's delta-chain suffix onto the staged standby's open tables,
+        keeping its restore within one epoch of the primary without a
+        full re-restore."""
+        jid = req.get("job_id")
+        jr = self._staged.get(jid)
+        if jr is None or not jr.standby:
+            return {"tailed": False,
+                    "error": f"no standby incarnation of job {jid!r}"}
+        applied = await self._tail_staged(jr, int(req["epoch"]))
+        return {"tailed": True, "epoch": jr.standby_epoch,
+                "applied": applied}
+
+    async def _tail_staged(self, jr: _JobRuntime, epoch: int) -> int:
+        backend = jr.program._state_backend
+        if backend is None or epoch <= jr.standby_epoch:
+            return 0
+        from ..state import protocol
+
+        manifest = await asyncio.to_thread(
+            protocol.load_manifest, backend.storage, backend.paths, epoch
+        )
+        if manifest is None:
+            raise ValueError(f"no manifest at epoch {epoch} to tail")
+        backend.restore_manifest = manifest
+        applied = 0
+        for sub in jr.program.subtasks:
+            for ctx in sub.runner.ctxs:
+                tm = getattr(ctx, "table_manager", None)
+                if tm is not None and tm.tables:
+                    applied += await asyncio.to_thread(tm.tail_chains)
+        jr.standby_epoch = epoch
+        return applied
+
     async def start_processing(self, req: dict) -> dict:
         """Phase 2 of the barrier-synchronized start (reference
         Engine::start, engine.rs:525): runners only spawn once every worker
@@ -393,7 +442,11 @@ class WorkerServer:
 
         With `promote` (generation-overlap rescale), the staged
         incarnation — already running, restored, sources parked — replaces
-        the live runtime of the job and its sources are released."""
+        the live runtime of the job and its sources are released. A
+        failover promotion (ISSUE 17) additionally ships the freshly
+        claimed generation and a final tail target: the standby restored
+        read-only under the PRIMARY's generation, so its backend must
+        adopt the new one before any of its state writes land."""
         if req.get("promote"):
             jid = req.get("job_id")
             jr = self._staged.pop(jid, None)
@@ -402,6 +455,17 @@ class WorkerServer:
                     f"worker {self.worker_id} has no staged incarnation "
                     f"of job {jid!r} to promote"
                 )
+            backend = jr.program._state_backend
+            if req.get("generation") is not None and backend is not None:
+                backend.generation = req["generation"]
+            if req.get("tail_epoch") is not None:
+                # catch-up tail to the last published manifest; failure
+                # here must leave the standby discardable, not half-live
+                try:
+                    await self._tail_staged(jr, int(req["tail_epoch"]))
+                except Exception:
+                    self._staged[jid] = jr
+                    raise
             old = self._jobs.pop(jid, None)
             if old is not None:
                 # the old generation should be drained by now; force for
@@ -409,7 +473,7 @@ class WorkerServer:
                 await self._teardown_job(old, force=True)
             self._jobs[jid] = jr
             jr.release.set()
-            return {"promoted": True}
+            return {"promoted": True, "epoch": jr.standby_epoch}
         jr = self._job(req)
         for sub in jr.program.subtasks:
             jr.tasks.append(asyncio.ensure_future(sub.runner.run()))
@@ -483,6 +547,14 @@ class WorkerServer:
         `expunge` — terminal job states) drop its metric series. Jobs
         co-resident on this worker are untouched. Idempotent."""
         jid = req.get("job_id")
+        if req.get("staged_only"):
+            # discard a standby/staged incarnation WITHOUT touching the
+            # live runtime of the same job (failover discard on a worker
+            # hosting both)
+            staged = self._staged.pop(jid, None)
+            if staged is not None:
+                await self._teardown_job(staged, force=True)
+            return {"hosted": staged is not None}
         jr = self._jobs.pop(jid, None)
         if jr is not None:
             await self._teardown_job(jr, force=bool(req.get("force", True)))
@@ -804,6 +876,24 @@ class WorkerServer:
     async def _forward(self, jr: _JobRuntime, resp):
         c = self.controller
         wid = self.worker_id
+        if jr.standby and not jr.release.is_set():
+            # a PARKED standby's task events must never reach the primary
+            # incarnation's controller bookkeeping (same job id!): a
+            # standby restore failure is a failover-manager concern, not
+            # a job failure
+            if isinstance(resp, TaskFailedResp):
+                jr.n_running -= 1
+                await c.call(
+                    "ControllerGrpc", "StandbyTaskFailed",
+                    {"worker_id": wid, "job_id": jr.job_id,
+                     "task_id": resp.task_id, "error": resp.error},
+                )
+            else:
+                logger.warning(
+                    "dropping %s from parked standby of job %s",
+                    type(resp).__name__, jr.job_id,
+                )
+            return
         if isinstance(resp, CheckpointCompletedResp):
             payload = {
                 "worker_id": wid,

@@ -1,0 +1,27 @@
+"""Phoenix: hot-standby generations + task-local recovery (ISSUE 17).
+
+Sub-second failover for durable jobs on a shared pool: the controller
+keeps a WARM standby incarnation per job — staged via the PR 15
+`StartExecution{staged}` path, restored at arm time, then continuously
+re-restored by tailing each published epoch's delta chains (PR 8) instead
+of full restores. On heartbeat loss (or a task failure while RUNNING) the
+standby is PROMOTED in place of a cold recovery: a fresh generation is
+claimed (fencing the possibly-merely-slow primary), the standby catches
+up to the latest published manifest, and its runners start processing —
+no SCHEDULING pass, no worker acquisition, no cold restore.
+
+The promotion protocol is modeled first (analysis/model): the
+`promote_while_primary_alive` mutant shows why promotion must re-resolve
+the LATEST published manifest at claim time rather than trusting the
+standby's tailed epoch — a blacked-out primary may have published and
+committed a later epoch, and promoting behind it re-emits visible output
+(the generalized `overlap_double_emission` violation).
+
+Task-local recovery rides along in `state/chain_cache.py`: workers keep
+their last flushed chain blobs in process memory so a same-worker restart
+or tailing standby skips the storage round-trip.
+"""
+
+from .manager import StandbyManager
+
+__all__ = ["StandbyManager"]

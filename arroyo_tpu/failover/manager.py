@@ -1,0 +1,468 @@
+"""StandbyManager: arm, tail, and promote hot-standby generations.
+
+Lifecycle per durable job (all driven off the controller's event loop):
+
+  arm      — pick pool workers (preferring ones NOT hosting the primary),
+             send the PR 15 staged StartExecution with `standby: true`:
+             runners spawn and restore table state from the last published
+             manifest under the PRIMARY's generation (read-only — claiming
+             a generation at arm time would fence the primary!), but every
+             operator's on_start defers until promotion. The standby's
+             data namespace uses ordinal `job.schedules + 1` WITHOUT
+             bumping the job's counter — the serving tier keeps routing by
+             the primary's namespace until promotion syncs it.
+
+  tail     — on each manifest publish, ship the new epoch to the standby
+             workers; they replay only the delta-chain SUFFIX onto the
+             open tables (TableManager.tail_chains), staying within one
+             epoch of the primary at delta cost, not restore cost.
+
+  promote  — on heartbeat loss: claim a FRESH generation (re-resolving
+             the LATEST published manifest — see the
+             promote_while_primary_alive model mutant), catch the standby
+             up to it, ship the new generation + release the gates
+             (StartProcessing{promote}), and swap the controller's job
+             bookkeeping. RUNNING stays RUNNING: no SCHEDULING pass. The
+             fenced zombie primary cannot publish (generation CAS) and
+             its straggler workers get a best-effort StopJob.
+
+  discard  — on recovery/rescale/stop/expunge, or when the standby itself
+             fails: tear the staged runtimes down (staged_only — a worker
+             hosting BOTH primary and standby keeps the primary) and
+             re-arm later. Promotion storms (a poisoned job failing over
+             repeatedly) fall back to cold recovery, whose restart budget
+             bounds them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..analysis.model.effects import protocol_effect
+from ..config import config
+from ..state.backend import StateBackend
+from ..utils.logging import get_logger
+
+logger = get_logger("failover")
+
+# promotions within this window before refusing and falling back to cold
+# recovery (which consumes the bounded restart budget)
+_STORM_WINDOW = 60.0
+_STORM_LIMIT = 3
+_REARM_BACKOFF = 5.0
+
+
+class _Standby:
+    """One armed standby incarnation's controller-side record."""
+
+    def __init__(self, workers: list, assignments: dict, counts: dict,
+                 ns_ordinal: int, epoch: int):
+        self.workers = workers
+        self.assignments = assignments
+        self.counts = counts
+        # data-plane namespace ordinal reserved for this incarnation
+        # (job.schedules + 1 at arm time; promotion syncs the counter)
+        self.ns_ordinal = ns_ordinal
+        self.epoch = epoch  # highest manifest epoch tailed so far
+        self.armed_at = time.monotonic()
+        self.promoting = False
+
+
+class StandbyManager:
+    def __init__(self, ctrl):
+        self.ctrl = ctrl
+        self._standbys: Dict[str, _Standby] = {}
+        self._arm_tasks: Dict[str, asyncio.Task] = {}
+        self._tail_tasks: Dict[str, asyncio.Task] = {}
+        self._tail_pending: Dict[str, int] = {}
+        self._next_arm: Dict[str, float] = {}
+        self._grace_until: Dict[str, float] = {}
+        self._promote_times: Dict[str, List[float]] = {}
+        self._discard_tasks: set = set()  # retained: GC'd mid-teardown
+        self.promotions = 0
+
+    # -- eligibility / arming ------------------------------------------------
+
+    def eligible(self, job) -> bool:
+        cfg = config()
+        return (
+            cfg.failover.enabled
+            and job.backend is not None
+            and job.mount is None  # tenants ride their host's data plane
+            # worker-leader cadence owns publish on the primary; the
+            # interaction with a promoted generation is unmodeled — skip
+            and cfg.controller.job_controller_mode != "worker"
+            and self.ctrl._pool_mode()
+            and bool(job.workers)
+            and all(w.pooled for w in job.workers)
+            and not job.stop_requested
+        )
+
+    def note_running(self, job):
+        """Called on every _run pass: keep exactly one standby armed (or
+        one arm attempt in flight) per eligible job. Cheap no-op guard on
+        the non-failover path."""
+        if not self.eligible(job):
+            return
+        jid = job.job_id
+        if jid in self._standbys or jid in self._arm_tasks:
+            return
+        if time.monotonic() < self._next_arm.get(jid, 0.0):
+            return
+        self._arm_tasks[jid] = asyncio.ensure_future(self._arm_guard(job))
+
+    def wake_deadline(self, job) -> Optional[float]:
+        """A timer-wheel horizon for _run's park: when an eligible job has
+        no standby (arm failed and is backing off), wake at the backoff
+        deadline so re-arming isn't starved on a quiet job."""
+        if not self.eligible(job):
+            return None
+        jid = job.job_id
+        if jid in self._standbys or jid in self._arm_tasks:
+            return None
+        return max(time.monotonic(), self._next_arm.get(jid, 0.0)) + 0.05
+
+    async def _arm_guard(self, job):
+        jid = job.job_id
+        try:
+            await self._arm(job)
+        except Exception as e:  # noqa: BLE001 - arming is best-effort
+            logger.warning("standby arm for %s failed: %r", jid, e)
+            self._next_arm[jid] = time.monotonic() + _REARM_BACKOFF
+        finally:
+            self._arm_tasks.pop(jid, None)
+            job.kick()
+
+    @protocol_effect("failover.arm")
+    async def _arm(self, job):
+        """Stage a standby incarnation: restore runs NOW under the
+        primary's generation (read-only), sources and on_start park until
+        promotion."""
+        ctrl = self.ctrl
+        n = len(job.workers)
+        live = ctrl._live_pool_workers()
+        others = sorted(
+            (w for w in live if w not in job.workers),
+            key=lambda w: (sum(w.assigned.values()), w.worker_id),
+        )
+        primary = [w for w in live if w in job.workers]
+        # prefer disjoint placement (a primary kill should not take the
+        # standby with it); co-locate only when the pool is too small —
+        # the standby-also-dies drill covers that fate
+        chosen = (others + primary)[:n]
+        if len(chosen) < n:
+            raise RuntimeError(
+                f"only {len(chosen)}/{n} live pool workers for standby"
+            )
+        assignments, counts = ctrl._assign_subtasks(job, chosen)
+        epoch = int(job.published_epoch or 0)
+        ns_ordinal = job.schedules + 1
+        req = ctrl._start_request(job, chosen, assignments)
+        req["staged"] = True
+        req["standby"] = True
+        req["data_ns"] = f"{job.job_id}@{ns_ordinal}"
+        req["restore_epoch"] = epoch or None
+        with obs.span(
+            "failover.arm",
+            trace=obs.new_trace(job.job_id, "standby"),
+            cat="controller", job=job.job_id,
+            epoch=epoch, workers=[w.worker_id for w in chosen],
+            disjoint=all(w not in job.workers for w in chosen),
+        ):
+            started = []
+            try:
+                for w in chosen:
+                    await ctrl._worker_call(
+                        w, "WorkerGrpc", "StartExecution", req
+                    )
+                    started.append(w)
+            except Exception:
+                await self._stop_staged(job.job_id, started)
+                raise
+        self._standbys[job.job_id] = _Standby(
+            chosen, assignments, counts, ns_ordinal, epoch
+        )
+        logger.info(
+            "standby armed for %s at epoch %d on workers %s",
+            job.job_id, epoch, [w.worker_id for w in chosen],
+        )
+
+    # -- tailing -------------------------------------------------------------
+
+    def note_publish(self, job):
+        """Called after each manifest publish: schedule a (coalesced) tail
+        of the new epoch onto the standby."""
+        jid = job.job_id
+        sb = self._standbys.get(jid)
+        if sb is None or sb.promoting:
+            return
+        target = int(job.published_epoch or 0)
+        if target <= sb.epoch:
+            return
+        self._tail_pending[jid] = max(self._tail_pending.get(jid, 0), target)
+        if jid not in self._tail_tasks:
+            self._tail_tasks[jid] = asyncio.ensure_future(
+                self._tail_guard(job)
+            )
+
+    async def _tail_guard(self, job):
+        jid = job.job_id
+        try:
+            while True:
+                sb = self._standbys.get(jid)
+                target = self._tail_pending.get(jid)
+                if sb is None or sb.promoting or target is None \
+                        or target <= sb.epoch:
+                    return
+                await self._tail(job, sb, target)
+        except Exception as e:  # noqa: BLE001 - a broken standby re-arms
+            logger.warning(
+                "standby tail for %s failed: %r; discarding", jid, e
+            )
+            await self.discard(job)
+            self._next_arm[jid] = time.monotonic() + _REARM_BACKOFF
+        finally:
+            self._tail_tasks.pop(jid, None)
+
+    @protocol_effect("failover.tail")
+    async def _tail(self, job, sb: _Standby, target: int):
+        with obs.span(
+            "failover.tail",
+            trace=obs.new_trace(job.job_id, "standby"),
+            cat="controller", job=job.job_id,
+            from_epoch=sb.epoch, epoch=target,
+        ):
+            for w in sb.workers:
+                await self.ctrl._worker_call(
+                    w, "WorkerGrpc", "TailCheckpoint",
+                    {"job_id": job.job_id, "epoch": target},
+                    timeout=60.0,
+                )
+        sb.epoch = target
+
+    # -- promotion -----------------------------------------------------------
+
+    async def try_promote(self, job) -> bool:
+        """Attempt standby promotion instead of cold recovery. Returns
+        True when the job is RUNNING again on the promoted generation;
+        False (after discarding the standby) means the caller proceeds to
+        the normal RECOVERING path."""
+        jid = job.job_id
+        sb = self._standbys.get(jid)
+        if sb is None or sb.promoting or not config().failover.enabled:
+            return False
+        times = [
+            t for t in self._promote_times.get(jid, [])
+            if time.monotonic() - t < _STORM_WINDOW
+        ]
+        if len(times) >= _STORM_LIMIT:
+            logger.warning(
+                "job %s: %d promotions in %.0fs — falling back to cold "
+                "recovery (restart budget applies)",
+                jid, len(times), _STORM_WINDOW,
+            )
+            await self.discard(job)
+            return False
+        if any(self.ctrl._worker_stale(w) for w in sb.workers):
+            # the standby died with the primary (co-located, or a host
+            # fault): cold restore is the only path
+            logger.warning("job %s: standby workers stale; discarding", jid)
+            await self.discard(job)
+            return False
+        sb.promoting = True
+        detect_at = time.monotonic()
+        tail_task = self._tail_tasks.get(jid)
+        if tail_task is not None:
+            # let an in-flight tail settle; promotion re-tails anyway
+            await asyncio.gather(tail_task, return_exceptions=True)
+        try:
+            # flight recorder: each promotion is its own lifecycle trace
+            # (like job.recover) carrying the measured gap_ms — the drill
+            # and the README worked example both read it from here
+            with obs.span(
+                "failover.promote",
+                trace=obs.new_trace(jid, f"promote-{job.promotions + 1}"),
+                cat="controller", job=jid,
+                standby_epoch=sb.epoch, failure=str(job.failure or ""),
+            ) as sp:
+                await asyncio.wait_for(
+                    self._promote(job, sb, detect_at, sp),
+                    timeout=config().failover.promote_timeout,
+                )
+        except Exception as e:  # noqa: BLE001 - fall back to cold restore
+            logger.warning(
+                "job %s: standby promotion failed (%r); falling back to "
+                "cold recovery", jid, e,
+            )
+            await self.discard(job)
+            return False
+        self._standbys.pop(jid, None)
+        self._tail_pending.pop(jid, None)
+        times.append(time.monotonic())
+        self._promote_times[jid] = times
+        self._grace_until[jid] = (
+            time.monotonic() + config().failover.grace
+        )
+        self.promotions += 1
+        job.promotions += 1
+        if config().failover.rearm:
+            # the next _run pass re-arms a fresh standby via note_running
+            self._next_arm[jid] = time.monotonic() + _REARM_BACKOFF
+        return True
+
+    @protocol_effect("failover.promote")
+    async def _promote(self, job, sb: _Standby, detect_at: float, sp):
+        ctrl = self.ctrl
+        # claim the FRESH generation, re-resolving the LATEST published
+        # manifest. This is THE invariant the promote_while_primary_alive
+        # model mutant violates: promoting at the standby's tailed epoch
+        # (sb.epoch) would rewind behind an epoch a merely-slow primary
+        # already published + committed, re-emitting visible output.
+        newb = await asyncio.to_thread(
+            lambda: StateBackend(job.storage_url, job.job_id).initialize()
+        )
+        target = int(newb.restore_epoch or 0)
+        sp.set(restore_epoch=target, generation=newb.generation)
+        # data-plane fence BEFORE releasing the standby: storage is
+        # fenced by the generation CAS, but file sinks append outside it
+        # — an alive-but-silent zombie (heartbeat blackout) writing after
+        # the standby truncates to the checkpointed offset would
+        # double-emit. A dead worker refuses the connection in
+        # milliseconds, so the common (actually-dead) case stays well
+        # under the gap budget; co-located workers are never in this set
+        # (they host the standby too).
+        old_workers = [w for w in job.workers if w not in sb.workers]
+        for w in old_workers:
+            try:
+                await ctrl._worker_call(
+                    w, "WorkerGrpc", "StopJob",
+                    {"job_id": job.job_id, "force": True},
+                    timeout=1.0,
+                )
+            except Exception as e:  # noqa: BLE001 - usually dead
+                logger.debug("pre-promote StopJob to %s failed: %s",
+                             w.worker_id, e)
+        # release the standby: adopt the new generation, catch up the tail
+        # to the latest manifest, run on_start on the tailed tables, go
+        for w in sb.workers:
+            await ctrl._worker_call(
+                w, "WorkerGrpc", "StartProcessing",
+                {"job_id": job.job_id, "promote": True,
+                 "generation": newb.generation,
+                 "tail_epoch": target or None},
+                timeout=config().failover.promote_timeout,
+            )
+        gap_ms = round((time.monotonic() - detect_at) * 1e3, 3)
+        # controller bookkeeping swap (mirrors _overlap_activate)
+        for w in job.workers:
+            w.assigned.pop(job.job_id, None)
+        job.backend = newb
+        job.workers = list(sb.workers)
+        job.assignments = dict(sb.assignments)
+        for w in job.workers:
+            w.assigned[job.job_id] = sb.counts.get(w.worker_id, 0)
+        # sync the namespace counter to the standby's reserved ordinal —
+        # serve routing and straggler fencing now point at the promoted
+        # incarnation
+        job.schedules = sb.ns_ordinal
+        job.checkpoints.clear()
+        job.pending_epochs.clear()
+        job.finished_tasks.clear()
+        job.undrained_sources.clear()
+        job.failure = None
+        job.leader_resigned = False
+        job.epoch = max(job.epoch, target)
+        job.published_epoch = max(job.published_epoch, target)
+        # prune dead handles from the registry so the scheduler replaces
+        # them (the fence RPC above already stopped live stragglers)
+        for w in old_workers:
+            if ctrl._worker_stale(w) and w.worker_id in ctrl.workers:
+                if ctrl.workers.pop(w.worker_id, None) is not None:
+                    ctrl._benched[w.worker_id] = w
+        sp.set(gap_ms=gap_ms, workers=len(job.workers),
+               promoted_ns=sb.ns_ordinal)
+        logger.info(
+            "job %s promoted standby (gen %s, epoch %d) in %.1fms",
+            job.job_id, newb.generation, target, gap_ms,
+        )
+
+    # -- discard / hooks -----------------------------------------------------
+
+    async def discard(self, job_or_id):
+        """Tear down a job's standby (if any): staged-only StopJob so a
+        worker hosting BOTH primary and standby keeps the primary."""
+        jid = getattr(job_or_id, "job_id", job_or_id)
+        sb = self._standbys.pop(jid, None)
+        t = self._tail_tasks.pop(jid, None)
+        if t is not None:
+            t.cancel()
+        self._tail_pending.pop(jid, None)
+        if sb is None:
+            return
+        await self._stop_staged(jid, sb.workers)
+
+    async def _stop_staged(self, jid: str, workers):
+        for w in workers:
+            try:
+                await self.ctrl._worker_call(
+                    w, "WorkerGrpc", "StopJob",
+                    {"job_id": jid, "staged_only": True},
+                    timeout=5.0,
+                )
+            except Exception as e:  # noqa: BLE001 - worker may be dying
+                logger.debug("standby StopJob to %s failed: %s",
+                             w.worker_id, e)
+
+    def on_standby_task_failed(self, jid: str, error: str):
+        """A parked standby runner failed (restore error, worker-local
+        fault): discard and back off — never the primary's problem."""
+        logger.warning("standby task of %s failed: %s", jid, error)
+        self._next_arm[jid] = time.monotonic() + _REARM_BACKOFF
+        job = self.ctrl.jobs.get(jid)
+        if job is not None:
+            t = asyncio.ensure_future(self.discard(job))
+            self._discard_tasks.add(t)
+            t.add_done_callback(self._discard_tasks.discard)
+
+    def on_job_expunged(self, jid: str):
+        self._next_arm.pop(jid, None)
+        self._grace_until.pop(jid, None)
+        self._promote_times.pop(jid, None)
+
+    # -- observability -------------------------------------------------------
+
+    def in_grace(self, jid: str) -> bool:
+        """True while a just-promoted job is inside `failover.grace`:
+        watchtower freshness/e2e rules suppress paging — the sub-second
+        gap shows up in the metrics but is an engineered, bounded event."""
+        return time.monotonic() < self._grace_until.get(jid, 0.0)
+
+    def status(self) -> dict:
+        from ..state.chain_cache import CACHE
+
+        return {
+            "enabled": bool(config().failover.enabled),
+            "promotions": self.promotions,
+            "standbys": {
+                jid: {
+                    "workers": [w.worker_id for w in sb.workers],
+                    "epoch": sb.epoch,
+                    "ns_ordinal": sb.ns_ordinal,
+                    "armed_for_s": round(
+                        time.monotonic() - sb.armed_at, 1
+                    ),
+                    "promoting": sb.promoting,
+                }
+                for jid, sb in self._standbys.items()
+            },
+            "arming": sorted(self._arm_tasks),
+            "grace": {
+                jid: round(t - time.monotonic(), 2)
+                for jid, t in self._grace_until.items()
+                if t > time.monotonic()
+            },
+            "chain_cache": CACHE.stats(),
+        }

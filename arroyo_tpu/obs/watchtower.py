@@ -473,10 +473,29 @@ class Watchtower:
                     continue
                 self._step(job_id, tenant, job, spec, value, now)
 
+    # rules a hot-standby promotion legitimately blips (ISSUE 17): the
+    # promoted incarnation's watermarks and latency markers start from
+    # its tailed state and catch up within the failover.grace window —
+    # paging on that would page on every successful sub-second failover
+    _FAILOVER_GRACE_RULES = ("freshness", "e2e_p99")
+
+    def _in_failover_grace(self, job_id: str) -> bool:
+        fo = getattr(self.controller, "failover", None)
+        return fo is not None and fo.in_grace(job_id)
+
     def _step(self, job_id: str, tenant: str, job, spec: RuleSpec,
               value: Optional[float], now: float) -> None:
         st = self.alerts.setdefault((job_id, spec.name), AlertState())
         st.value = value
+        if (spec.name in self._FAILOVER_GRACE_RULES
+                and self._in_failover_grace(job_id)):
+            # suppress NEW pages only: a pre-existing firing alert keeps
+            # firing (the promotion did not fix it), but breach time
+            # must not accrue against the catch-up blip
+            if st.state == "pending":
+                st.state = "ok"
+            if st.state == "ok":
+                return
         breached = value is not None and spec.breached(value)
         cleared = value is not None and spec.cleared(value)
         if st.state == "ok":

@@ -113,6 +113,12 @@ class SubtaskRunner:
         # on this gate after on_start/restore until promotion releases
         # them (None everywhere else — zero cost on the normal path)
         self.source_gate: Optional[asyncio.Event] = None
+        # hot-standby failover (ISSUE 17): a standby incarnation restores
+        # its tables at arm time but parks HERE before any operator's
+        # on_start — on_start derives in-memory state from the tables
+        # non-idempotently (joins append, sources read offsets once), so
+        # it must run exactly once, on the final promoted/tailed state
+        self.standby_gate: Optional[asyncio.Event] = None
         self._finish_kinds: Dict[int, SignalKind] = {}
         self._barrier_inputs: set[int] = set()
         self._current_barrier = None
@@ -185,6 +191,17 @@ class SubtaskRunner:
         # on a multiplexed worker rolls up to the right tenant
         obs.attribution.set_job(self.task_info.job_id)
         try:
+            if self.standby_gate is not None:
+                # hot-standby arm (ISSUE 17): pay the storage restore NOW,
+                # while the primary generation is still running — the
+                # controller tails later epochs' delta chains onto these
+                # open tables until promotion releases the gate
+                with obs.span("task.standby_arm", cat="runner",
+                              task=self.task_info.task_id):
+                    for op, ctx in zip(self.ops, self.ctxs):
+                        if ctx.table_manager is not None:
+                            await ctx.table_manager.open(op.tables())
+                await self.standby_gate.wait()
             # under the job.schedule trace (context inherited at task
             # spawn): table restore + operator on_start become visible
             # stages of a (re)start in the flight recording
@@ -193,7 +210,8 @@ class SubtaskRunner:
                 from ..serve import register_op as serve_register
 
                 for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
-                    if ctx.table_manager is not None:
+                    if (ctx.table_manager is not None
+                            and self.standby_gate is None):
                         await ctx.table_manager.open(op.tables())
                     sp.event("on_start", op=type(op).__name__, op_idx=idx)
                     await op.on_start(ctx)

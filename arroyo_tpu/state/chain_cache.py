@@ -1,0 +1,127 @@
+"""Task-local chain cache: same-worker restarts skip the storage round-trip.
+
+Hot-standby failover (ISSUE 17) restores and then continuously tails
+delta-chain blobs. The blobs a worker READS at restore/tail time are very
+often blobs the same process WROTE at flush time an epoch earlier — a
+restarted or promoted incarnation landing on the same worker would
+otherwise pay a full storage round-trip per chain entry for bytes it just
+uploaded. This cache keeps the last published chains' blobs in process
+memory, keyed by their storage path (paths are generation-stamped and
+written exactly once, so an entry can never go stale — only unreferenced).
+
+Sizing and invalidation:
+  * LRU with a byte cap (`failover.cache_max_bytes`) — eviction is the
+    normal lifecycle.
+  * `invalidate_below(job_id, epoch)` drops entries for checkpoint epochs
+    a newer manifest no longer references (rebase truncated the chain, or
+    GC retired the epoch) — called when tailing observes the chain floor
+    moving.
+  * `invalidate_job(job_id)` on job expunge.
+
+The cache is process-global (workers multiplex many jobs on one loop) and
+gated by `config().failover.local_chain_cache`; with the gate off every
+call is a cheap no-op and reads fall through to storage.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..config import config
+from ..metrics import REGISTRY
+
+CHAIN_CACHE_HITS = REGISTRY.counter(
+    "arroyo_chain_cache_hits",
+    "task-local chain cache hits (storage reads skipped)",
+)
+CHAIN_CACHE_MISSES = REGISTRY.counter(
+    "arroyo_chain_cache_misses",
+    "task-local chain cache misses (read fell through to storage)",
+)
+
+_EPOCH_RE = re.compile(r"checkpoint-(\d+)")
+
+
+class ChainCache:
+    def __init__(self):
+        # (storage url, path) -> bytes; OrderedDict gives LRU order
+        self._entries: "OrderedDict[Tuple[str, str], bytes]" = OrderedDict()
+        self._bytes = 0
+        self._hits = 0
+        self._misses = 0
+        self._lock = threading.Lock()  # flushes run in to_thread workers
+
+    @staticmethod
+    def _enabled() -> bool:
+        return bool(config().failover.local_chain_cache)
+
+    @staticmethod
+    def _job_of(path: str) -> str:
+        return path.split("/", 1)[0]
+
+    @staticmethod
+    def _epoch_of(path: str) -> Optional[int]:
+        m = _EPOCH_RE.search(path)
+        return int(m.group(1)) if m else None
+
+    def put(self, storage_url: str, path: str, blob: bytes):
+        if not self._enabled() or blob is None:
+            return
+        cap = int(config().failover.cache_max_bytes)
+        if len(blob) > cap:
+            return
+        with self._lock:
+            key = (storage_url, path)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._entries[key] = blob
+            self._bytes += len(blob)
+            while self._bytes > cap and self._entries:
+                _k, v = self._entries.popitem(last=False)
+                self._bytes -= len(v)
+
+    def get(self, storage_url: str, path: str) -> Optional[bytes]:
+        if not self._enabled():
+            return None
+        with self._lock:
+            blob = self._entries.get((storage_url, path))
+            if blob is not None:
+                self._entries.move_to_end((storage_url, path))
+        job = self._job_of(path)
+        if blob is not None:
+            self._hits += 1
+            CHAIN_CACHE_HITS.labels(job=job).inc()
+        else:
+            self._misses += 1
+            CHAIN_CACHE_MISSES.labels(job=job).inc()
+        return blob
+
+    def invalidate_below(self, job_id: str, epoch: int):
+        """Drop cached blobs of `job_id` whose checkpoint epoch is below
+        `epoch` — the tailed manifest's chain floor moved past them."""
+        with self._lock:
+            for key in list(self._entries):
+                path = key[1]
+                if self._job_of(path) != job_id:
+                    continue
+                e = self._epoch_of(path)
+                if e is not None and e < epoch:
+                    self._bytes -= len(self._entries.pop(key))
+
+    def invalidate_job(self, job_id: str):
+        with self._lock:
+            for key in list(self._entries):
+                if self._job_of(key[1]) == job_id:
+                    self._bytes -= len(self._entries.pop(key))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self._hits, "misses": self._misses}
+
+
+CACHE = ChainCache()

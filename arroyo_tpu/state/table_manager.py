@@ -43,6 +43,7 @@ from ..metrics import (
 from ..types import TaskInfo
 from ..utils.logging import get_logger
 from .backend import StateBackend
+from .chain_cache import CACHE
 from .table_config import TableConfig
 from .tables import GlobalTable, TimeKeyTable
 
@@ -60,6 +61,22 @@ class TableManager:
         # "epoch", "base"}]. Extended at CAPTURE time (paths are
         # deterministic) so pipelined flushes can't race the bookkeeping.
         self._chains: Dict[str, list] = {}
+        # hot-standby tailing (ISSUE 17): highest manifest epoch whose
+        # chain suffix has been replayed onto the open tables
+        self._tailed_epoch = -1
+
+    def _read_chain_blob(self, path: str, sp) -> Optional[bytes]:
+        """One chain blob, preferring the task-local cache (same-worker
+        restart / tail of a blob this process flushed) over storage."""
+        blob = CACHE.get(self.backend.storage.url, path)
+        if blob is not None:
+            sp.event("cached_blob", path=path)
+            return blob
+        sp.event("read_blob", path=path)
+        blob = self.backend.read_blob(path)
+        if blob is not None:
+            CACHE.put(self.backend.storage.url, path, blob)
+        return blob
 
     async def open(self, configs: Dict[str, TableConfig]):
         self.configs = dict(configs)
@@ -156,8 +173,7 @@ class TableManager:
                             chain = [{"path": meta["path"]}]
                         blobs = []
                         for f in chain or []:
-                            sp.event("read_blob", path=f["path"])
-                            blob = self.backend.read_blob(f["path"])
+                            blob = self._read_chain_blob(f["path"], sp)
                             if blob is not None:
                                 blobs.append(blob)
                         if blobs:
@@ -188,6 +204,96 @@ class TableManager:
                     )
                     sp.event("filter_expired", watermark=restore_wm)
                     table.filter_expired(restore_wm)
+        restored = self.backend.restore_epoch
+        self._tailed_epoch = restored if restored is not None else -1
+
+    @protocol_effect("state.tail_chains")
+    def tail_chains(self) -> int:
+        """Hot-standby tailing (ISSUE 17): replay the delta-chain SUFFIX of
+        a newer published manifest onto the already-open tables instead of
+        re-restoring from scratch. The caller points
+        `backend.restore_manifest` at the new manifest first; only chain
+        entries for epochs beyond `_tailed_epoch` are read and applied.
+
+        Safe to re-apply overlapping entries: the cross-subtask global
+        merge resolves replicated copies by entry stamp, so a rebase base
+        that subsumes already-applied deltas loads idempotently. Time-key
+        tables load only files not already referenced, then adopt the new
+        manifest's file list. Returns the number of blobs/files applied."""
+        target = self.backend.restore_epoch
+        if target is None or target <= self._tailed_epoch:
+            return 0
+        node_id = self.task_info.node_id
+        per_subtask = sorted(
+            self.backend.tables_for(node_id, self.op_idx),
+            key=lambda e: e["subtask"],
+        )
+        applied = 0
+        with obs.span(
+            "state.tail_chains", cat="storage",
+            task=self.task_info.task_id, op_idx=self.op_idx,
+            from_epoch=self._tailed_epoch, to_epoch=target,
+        ) as sp:
+            for name, table in self.tables.items():
+                cfg = self.configs[name]
+                if cfg.kind == "global":
+                    floor = None
+                    for entry in per_subtask:
+                        meta = entry["tables"].get(name)
+                        chain = (meta or {}).get("chain") or []
+                        blobs = []
+                        for f in chain:
+                            e = f.get("epoch")
+                            if e is not None and floor is not None:
+                                floor = min(floor, e)
+                            elif e is not None:
+                                floor = e
+                            if e is None or e <= self._tailed_epoch:
+                                continue
+                            blob = self._read_chain_blob(f["path"], sp)
+                            if blob is not None:
+                                blobs.append(blob)
+                        if blobs:
+                            table.load_chain(blobs)
+                            applied += len(blobs)
+                    if floor is not None and floor > 0:
+                        # the chain floor moved (rebase/GC): cached blobs
+                        # below it are unreferenced now
+                        CACHE.invalidate_below(
+                            self.task_info.job_id, floor
+                        )
+                else:
+                    seen = {f["path"] for f in table.files}
+                    batches = []
+                    files = []
+                    for entry in per_subtask:
+                        meta = entry["tables"].get(name)
+                        for f in (meta or {}).get("files", []):
+                            if f["path"] in {x["path"] for x in files}:
+                                continue
+                            files.append(dict(f))
+                            if f["path"] in seen:
+                                continue
+                            sp.event("read_file", path=f["path"])
+                            t = self.backend.read_parquet(f["path"])
+                            if t is not None:
+                                batches.extend(t.to_batches())
+                                applied += 1
+                    if batches:
+                        table.load_batches(
+                            batches,
+                            key_indices=None,
+                            parallelism=self.task_info.parallelism,
+                            task_index=self.task_info.task_index,
+                        )
+                    table.files = files
+                    wm = self.backend.restore_watermark(
+                        self.task_info.task_id
+                    )
+                    table.filter_expired(wm)
+            sp.set(applied=applied)
+        self._tailed_epoch = target
+        return applied
 
     async def get_table(self, name: str):
         return self.tables[name]
@@ -268,6 +374,11 @@ class TableManager:
                 chain = st["chain"]
                 if st["blob"] is not None:
                     self.backend.write_blob(chain[-1]["path"], st["blob"])
+                    # task-local recovery (ISSUE 17): a same-worker restart
+                    # or tailing standby re-reads this exact blob; keep it
+                    # in process memory so that read skips storage
+                    CACHE.put(self.backend.storage.url, chain[-1]["path"],
+                              st["blob"])
                 meta[name] = {
                     "kind": "global",
                     "chain": chain,

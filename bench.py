@@ -29,6 +29,14 @@ import os
 import subprocess
 import sys
 
+# Measurement era of this harness. Bump whenever the bench host class,
+# event counts, query set, or harness methodology changes in a way that
+# makes old eps numbers incomparable with new ones — bench_compare.py
+# refuses to gate a current run against a baseline stamped with a
+# different era (ISSUE 17: pre-era baselines silently trended across
+# harness changes instead of failing loudly).
+PIN_ERA = "r2-shared-1core"
+
 DDL = """
 CREATE TABLE nexmark WITH (
   connector = 'nexmark',
@@ -1087,6 +1095,7 @@ def main():
     events = grant_extra.get("device_events") or args.events
     print(json.dumps({
         "metric": "nexmark_q5_events_per_sec",
+        "pin_era": PIN_ERA,
         "value": round(device["eps"], 1),
         "unit": "events/s",
         # which backend produced the q1/q7/q8/latency side metrics —

@@ -1,0 +1,369 @@
+"""Hot-standby failover (ISSUE 17).
+
+Fast-tier proofs of the failover seams: the task-local chain cache is a
+byte-capped LRU whose invalidation tracks the tailed manifest's chain
+floor; the watchtower suppresses NEW freshness/e2e pages inside the
+`failover.grace` window without silencing alerts that were already
+firing; the bench gate refuses cross-era comparisons (`pin_era`); and
+the E2E path — a SIGKILLed primary with an armed standby promotes with
+ZERO cold restarts and byte-identical output (the `failover.promote`
+span carries the measured gap), the standby tails within one epoch of
+the primary, same-process restores hit the chain cache instead of
+storage, and an alive-but-silent (heartbeat-blackout) zombie primary is
+fenced before the standby's sink truncation so it cannot double-emit.
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from arroyo_tpu.config import update
+from arroyo_tpu.metrics import REGISTRY
+from arroyo_tpu.state.chain_cache import ChainCache
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+
+
+# -- task-local chain cache --------------------------------------------------
+
+
+def _cache(cap=1 << 20):
+    return update(failover={"local_chain_cache": True,
+                            "cache_max_bytes": cap})
+
+
+def test_chain_cache_hit_miss_and_stats():
+    with _cache():
+        c = ChainCache()
+        c.put("mem://a", "jobx/checkpoints/checkpoint-1/chain-0", b"abc")
+        assert c.get("mem://a",
+                     "jobx/checkpoints/checkpoint-1/chain-0") == b"abc"
+        # different storage url is a different key
+        assert c.get("mem://b",
+                     "jobx/checkpoints/checkpoint-1/chain-0") is None
+        st = c.stats()
+        assert st["entries"] == 1 and st["bytes"] == 3
+        assert st["hits"] == 1 and st["misses"] == 1
+    REGISTRY.drop_job("jobx")
+
+
+def test_chain_cache_lru_evicts_by_bytes():
+    with _cache(cap=10):
+        c = ChainCache()
+        c.put("u", "jobx/checkpoints/checkpoint-1/a", b"aaaa")
+        c.put("u", "jobx/checkpoints/checkpoint-1/b", b"bbbb")
+        # touch `a` so `b` is the LRU victim
+        assert c.get("u", "jobx/checkpoints/checkpoint-1/a") == b"aaaa"
+        c.put("u", "jobx/checkpoints/checkpoint-2/c", b"cccc")
+        assert c.get("u", "jobx/checkpoints/checkpoint-1/b") is None
+        assert c.get("u", "jobx/checkpoints/checkpoint-1/a") == b"aaaa"
+        assert c.get("u", "jobx/checkpoints/checkpoint-2/c") == b"cccc"
+        assert c.stats()["bytes"] <= 10
+        # a blob above the cap is never admitted (it would evict all)
+        c.put("u", "jobx/checkpoints/checkpoint-3/huge", b"x" * 11)
+        assert c.get("u", "jobx/checkpoints/checkpoint-3/huge") is None
+    REGISTRY.drop_job("jobx")
+
+
+def test_chain_cache_invalidate_scopes_job_and_epoch():
+    with _cache():
+        c = ChainCache()
+        c.put("u", "j1/checkpoints/checkpoint-1/a", b"1")
+        c.put("u", "j1/checkpoints/checkpoint-3/b", b"3")
+        c.put("u", "j2/checkpoints/checkpoint-1/c", b"1")
+        # the chain floor moved to epoch 3: epochs below it drop, the
+        # OTHER job's entries are untouched
+        c.invalidate_below("j1", 3)
+        assert c.get("u", "j1/checkpoints/checkpoint-1/a") is None
+        assert c.get("u", "j1/checkpoints/checkpoint-3/b") == b"3"
+        assert c.get("u", "j2/checkpoints/checkpoint-1/c") == b"1"
+        c.invalidate_job("j2")
+        assert c.get("u", "j2/checkpoints/checkpoint-1/c") is None
+        assert c.stats()["entries"] == 1
+    REGISTRY.drop_job("j1")
+    REGISTRY.drop_job("j2")
+
+
+def test_chain_cache_gate_off_is_a_noop():
+    with update(failover={"local_chain_cache": False}):
+        c = ChainCache()
+        c.put("u", "jobx/checkpoints/checkpoint-1/a", b"abc")
+        assert c.get("u", "jobx/checkpoints/checkpoint-1/a") is None
+        assert c.stats()["entries"] == 0
+        # gated gets do not mint miss metrics either
+        assert c.stats()["misses"] == 0
+
+
+# -- watchtower: failover.grace suppression ----------------------------------
+
+
+class _FakeFailover:
+    def __init__(self):
+        self.grace_jobs = set()
+
+    def in_grace(self, jid):
+        return jid in self.grace_jobs
+
+
+class _FakeCtrl:
+    def __init__(self):
+        self.failover = _FakeFailover()
+
+
+class _Job:
+    def __init__(self, job_id, tenant="t0"):
+        self.job_id = job_id
+        self.tenant = tenant
+        self.backend = object()
+        self.graph = None
+
+
+_LAG = "arroyo_worker_watermark_lag_seconds"
+
+
+def _evaluate_seq(wt, job, values, t0=100.0, dt=1.0):
+    for i, v in enumerate(values):
+        now = t0 + i * dt
+        wt.history.ingest(
+            {_LAG: [({"job": job.job_id, "task": "2-0"}, v)]}, now=now)
+        wt.evaluate(now=now, jobs=[(job.job_id, job.tenant, job)])
+
+
+def _grace_tower(tmp_path):
+    from arroyo_tpu.obs.history import MetricHistory
+    from arroyo_tpu.obs.watchtower import Watchtower
+
+    ctrl = _FakeCtrl()
+    wt = Watchtower(controller=ctrl,
+                    history=MetricHistory(retain=(_LAG,)))
+    return wt, ctrl
+
+
+def test_failover_grace_suppresses_new_freshness_pages(tmp_path):
+    with update(watch={"freshness_lag_s": 3.0, "sustain": 2.0,
+                       "clear_sustain": 2.0, "clear_ratio": 0.5,
+                       "spool_dir": str(tmp_path / "spool")}):
+        wt, ctrl = _grace_tower(tmp_path)
+        job = _Job("gsup")
+        ctrl.failover.grace_jobs.add("gsup")
+        # a catch-up lag blip inside the grace window: breach time must
+        # not accrue and nothing fires
+        _evaluate_seq(wt, job, [0.1, 5.0, 6.0, 7.0, 8.0])
+        st = wt.alerts.get(("gsup", "freshness"))
+        assert st is None or st.state == "ok"
+        assert not [e for e in wt.ledger if e["event"] == "firing"]
+        # grace over, lag still bad: the rule pages as usual
+        ctrl.failover.grace_jobs.clear()
+        _evaluate_seq(wt, job, [9.0, 9.0, 9.0, 9.0], t0=200.0)
+        assert wt.alerts[("gsup", "freshness")].state == "firing"
+    REGISTRY.drop_job("gsup")
+
+
+def test_failover_grace_keeps_preexisting_firing_alert(tmp_path):
+    with update(watch={"freshness_lag_s": 3.0, "sustain": 2.0,
+                       "clear_sustain": 2.0, "clear_ratio": 0.5,
+                       "spool_dir": str(tmp_path / "spool")}):
+        wt, ctrl = _grace_tower(tmp_path)
+        job = _Job("gfire")
+        _evaluate_seq(wt, job, [0.1, 5.0, 6.0, 7.0, 8.0])
+        assert wt.alerts[("gfire", "freshness")].state == "firing"
+        # a promotion mid-incident must not silence the page: the
+        # failover did not fix the lag
+        ctrl.failover.grace_jobs.add("gfire")
+        _evaluate_seq(wt, job, [9.0, 9.0], t0=200.0)
+        assert wt.alerts[("gfire", "freshness")].state == "firing"
+    REGISTRY.drop_job("gfire")
+
+
+def test_failover_grace_only_covers_catchup_rules(tmp_path):
+    """Rules OUTSIDE the grace set (e.g. checkpoint age) page normally
+    even while the job is in grace — grace is scoped to the catch-up
+    blip, not a blanket mute."""
+    from arroyo_tpu.obs.watchtower import Watchtower
+
+    assert set(Watchtower._FAILOVER_GRACE_RULES) == {"freshness", "e2e_p99"}
+
+
+# -- bench gate: pin_era -----------------------------------------------------
+
+
+def _bench_compare():
+    sys.path.insert(0, TOOLS)
+    try:
+        import bench_compare
+    finally:
+        sys.path.remove(TOOLS)
+    return bench_compare
+
+
+def test_pin_era_gate():
+    bc = _bench_compare()
+    # matching eras (or a shared absence, pre-era baselines) compare
+    assert bc.check_pin_era({"pin_era": "r2"}, {"pin_era": "r2"}) is None
+    assert bc.check_pin_era({}, {}) is None
+    # any disagreement — including one side missing — refuses loudly
+    assert bc.check_pin_era({"pin_era": "r1"},
+                            {"pin_era": "r2"}) is not None
+    assert bc.check_pin_era({}, {"pin_era": "r2"}) is not None
+    assert bc.check_pin_era({"pin_era": "r1"}, {}) is not None
+
+
+def test_bench_payload_is_era_stamped():
+    import bench as bench_mod
+
+    assert isinstance(bench_mod.PIN_ERA, str) and bench_mod.PIN_ERA
+    import json
+
+    with open(os.path.join(os.path.dirname(TOOLS),
+                           "BENCH_BASELINE.json")) as f:
+        baseline = json.load(f)
+    assert baseline.get("pin_era") == bench_mod.PIN_ERA
+
+
+# -- E2E: arm, tail, promote -------------------------------------------------
+
+
+def _pipeline_sql(out, n=4000, rate=1500):
+    return f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '{rate}',
+      message_count = '{n}', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, start TIMESTAMP, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{out}',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, window.start as start, cnt FROM (
+      SELECT counter % 4 as k, tumble(interval '500 millisecond') as window,
+             count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+
+def _canonical(path):
+    with open(path) as f:
+        return sorted(line for line in f.read().splitlines() if line)
+
+
+async def _wait_armed(c, jid, min_epoch=0, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sb = c.failover._standbys.get(jid)
+        if sb is not None and sb.epoch >= min_epoch:
+            return sb
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"standby for {jid} never armed/tailed "
+                         f"to epoch {min_epoch}")
+
+
+async def _run_job(tmp_path, tag, failover_on, fault=None,
+                   heartbeat_timeout=0.5, checkpoint_interval=0.25):
+    """One embedded run; `fault` (if set) is an async callable invoked
+    once the job is RUNNING that installs the chaos plan."""
+    from arroyo_tpu import chaos, obs
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    out = str(tmp_path / f"{tag}.json")
+    with update(
+        failover={"enabled": failover_on},
+        worker={"heartbeat_interval": 0.05},
+        controller={"heartbeat_timeout": heartbeat_timeout},
+        pipeline={"checkpointing": {"interval": checkpoint_interval}},
+    ):
+        obs.reset()
+        c = await ControllerServer(EmbeddedScheduler(),
+                                   max_restarts=4).start()
+        try:
+            await c.submit_job(tag, sql=_pipeline_sql(out),
+                               storage_url=str(tmp_path / f"{tag}-ck"),
+                               n_workers=1, parallelism=1)
+            await c.wait_for_state(tag, JobState.RUNNING, timeout=30)
+            job = c.jobs[tag]
+            if fault is not None:
+                await fault(c, job)
+            st = await c.wait_for_state(
+                tag, JobState.FINISHED, JobState.FAILED, timeout=60)
+            assert st == JobState.FINISHED, job.failure
+            spans = [dict(s.get("attrs", {}))
+                     for s in obs.recorder().snapshot()
+                     if s.get("name") == "failover.promote"]
+            return (_canonical(out), job.promotions, job.restarts, spans)
+        finally:
+            chaos.clear()
+            await c.stop()
+
+
+def test_e2e_promotion_is_byte_identical_and_restart_free(tmp_path):
+    """SIGKILL the primary with a standby armed: the standby promotes
+    (no SCHEDULING pass, zero cold restarts), output is byte-identical
+    to the failover-off run, the gap is measured on the
+    `failover.promote` span, the standby was tailing within one epoch
+    of the primary at kill time, and same-process restores hit the
+    task-local chain cache."""
+    from arroyo_tpu import chaos
+    from arroyo_tpu.state.chain_cache import CACHE
+
+    async def kill_primary(c, job):
+        sb = await _wait_armed(c, job.job_id, min_epoch=1)
+        # delta tailing keeps the standby within one epoch of the
+        # primary's published chain
+        assert sb.epoch >= job.published_epoch - 1
+        wid = job.workers[0].worker_id
+        plan = chaos.FaultPlan(0)
+        plan.add("worker.kill", at_hits=(1,),
+                 match={"worker_id": str(wid)})
+        chaos.install(plan)
+
+    want, _, _, _ = asyncio.run(_run_job(tmp_path, "foe2e-clean", False))
+    hits_before = CACHE.stats()["hits"]
+    got, promotions, restarts, spans = asyncio.run(
+        _run_job(tmp_path, "foe2e", True, fault=kill_primary))
+    assert got == want
+    assert promotions >= 1
+    assert restarts == 0  # promotion, not cold recovery
+    gaps = [s["gap_ms"] for s in spans if "gap_ms" in s]
+    assert gaps and all(0 <= g < 500.0 for g in gaps)
+    # the standby restores/tails blobs this process just wrote: the
+    # chain cache serves them without a storage round-trip
+    assert CACHE.stats()["hits"] > hits_before
+    CACHE.invalidate_job("foe2e")
+    REGISTRY.drop_job("foe2e")
+    REGISTRY.drop_job("foe2e-clean")
+
+
+def test_e2e_fenced_zombie_primary_cannot_double_emit(tmp_path):
+    """The promote_while_primary_alive shape: the primary goes silent
+    (heartbeat blackout) but stays ALIVE; the standby promotes over it.
+    The zombie must be fenced before the standby's sink truncation —
+    byte-identical output proves it never appended a straggler row."""
+    from arroyo_tpu import chaos
+
+    async def blackout_primary(c, job):
+        # fan-out RPCs refresh worker liveness, so the checkpoint
+        # period must exceed the heartbeat timeout for a pure blackout
+        # to trip detection (same cadence the drill replay uses)
+        sb = await _wait_armed(c, job.job_id, min_epoch=1)
+        wid = job.workers[0].worker_id
+        plan = chaos.FaultPlan(0)
+        plan.add("worker.heartbeat_blackout", at_hits=(1,),
+                 match={"worker_id": str(wid)},
+                 params={"duration": 2.0}, max_fires=1)
+        chaos.install(plan)
+
+    want, _, _, _ = asyncio.run(_run_job(tmp_path, "fozomb-clean", False))
+    got, promotions, restarts, _ = asyncio.run(
+        _run_job(tmp_path, "fozomb", True, fault=blackout_primary,
+                 heartbeat_timeout=0.4, checkpoint_interval=1.0))
+    assert got == want  # the fenced zombie emitted nothing extra
+    assert promotions >= 1
+    assert restarts == 0
+    REGISTRY.drop_job("fozomb")
+    REGISTRY.drop_job("fozomb-clean")

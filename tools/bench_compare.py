@@ -25,8 +25,14 @@ A baseline or current measured on a CONTENDED host (bench.py's
 calibration probe) widens every floor by the contention factor — the
 numbers were taken under interference and say less.
 
+Both documents carry a `pin_era` stamp (bench.py PIN_ERA): the bench
+era the numbers were measured under. A baseline pinned under a
+different era than the current run is rejected OUTRIGHT (exit 2) —
+cross-era eps comparisons silently trend instead of gating (ISSUE 17).
+
 Exit status: 0 = no regression, 1 = at least one metric regressed,
-2 = usage/IO error. `--json` writes the full comparison for CI upload.
+2 = usage/IO error or pin_era mismatch. `--json` writes the full
+comparison for CI upload.
 
 Usage:
   python tools/bench_compare.py BENCH_BASELINE.json current.json \
@@ -64,6 +70,26 @@ def baseline_provenance(path: str) -> dict:
     except Exception:  # noqa: BLE001 - provenance is best-effort
         pass
     return prov
+
+
+def check_pin_era(baseline: dict, current: dict) -> Optional[str]:
+    """Cross-era guard (ISSUE 17): a baseline pinned under one bench era
+    (host class, event counts, harness methodology) must never gate a run
+    measured under another — the eps deltas would silently trend instead
+    of measuring anything. Returns an error string on mismatch, None when
+    the comparison is era-valid. Era-less documents on BOTH sides are
+    pre-era legacy and pass with a warning from the caller; an era on
+    exactly one side is itself a mismatch (somebody re-pinned or forgot
+    to)."""
+    b, c = baseline.get("pin_era"), current.get("pin_era")
+    if b is None and c is None:
+        return None
+    if b != c:
+        return (f"pin_era mismatch: baseline pinned under era {b!r}, "
+                f"current measured under era {c!r} — cross-era eps "
+                "comparisons are meaningless; re-pin BENCH_BASELINE.json "
+                "from a run of the current harness (bench.py PIN_ERA)")
+    return None
 
 
 def _spread_pct(doc: dict, metric: str) -> Optional[float]:
@@ -215,6 +241,9 @@ def compare(baseline: dict, current: dict, margin: float = 1.5,
         "regressions": regressions,
         "contended": contended,
         "margin": margin,
+        # the era both documents were measured under (check_pin_era has
+        # already rejected a mismatch by the time compare() runs)
+        "pin_era": current.get("pin_era") or baseline.get("pin_era"),
         "metrics": results,
     }
 
@@ -267,6 +296,14 @@ def main(argv=None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
+    era_error = check_pin_era(baseline, current)
+    if era_error:
+        print(f"bench_compare: {era_error}", file=sys.stderr)
+        return 2
+    if "pin_era" not in baseline:
+        print("bench_compare: warning: baseline carries no pin_era stamp "
+              "(pre-era pin) — cannot verify the current run is "
+              "era-comparable", file=sys.stderr)
     doc = compare(baseline, current, margin=args.margin,
                   floor_pct=args.floor_pct,
                   latency_floor_pct=args.latency_floor_pct)

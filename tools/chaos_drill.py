@@ -30,6 +30,18 @@
         byte-identical and the decision audit log is written next to
         the results.
 
+    python tools/chaos_drill.py --failover
+        ISSUE 17 acceptance: SIGKILL the primary under load with a hot
+        standby armed and tailing; the standby must promote with zero
+        cold restarts, sub-500ms gap (failover.promote span, recorded
+        in the drill extras) and byte-identical output — then the
+        standby-also-dies variant kills BOTH workers and requires the
+        cold-restore fallback. With --plan, the serialized
+        counterexample (e.g. promote_while_primary_alive's heartbeat
+        blackout from tools/model_check.py --trace-dir) replays against
+        the armed fleet: the standby promotes over an alive-but-silent
+        primary and the fenced zombie must not double-emit.
+
     python tools/chaos_drill.py --plan COUNTEREXAMPLE.json
         Replay a model-checker counterexample (tools/model_check.py
         --trace-dir) — or any serialized FaultPlan — against the real
@@ -114,6 +126,13 @@ def main() -> int:
                     "byte-identical to its SOLO unshared run (with "
                     "--plan: the counterexample replays against the "
                     "shared fleet instead of a golden)")
+    ap.add_argument("--failover", action="store_true",
+                    help="also run the hot-standby failover drill: "
+                    "SIGKILL the primary with a standby armed "
+                    "(sub-500ms promotion, byte-identical output) plus "
+                    "the standby-also-dies cold-restore fallback (with "
+                    "--plan: replay the counterexample against the "
+                    "armed fleet)")
     ap.add_argument("--plan", type=str, default="",
                     help="run the drill under a serialized FaultPlan JSON "
                     "(bare plan or a model-check counterexample payload "
@@ -143,7 +162,7 @@ def main() -> int:
             print(f"replaying counterexample: {trace.get('violation')} "
                   f"(mutant {trace.get('mutant') or 'none'}, "
                   f"{len(trace.get('events', []))} model events)")
-        queries = [] if args.shared else (
+        queries = [] if (args.shared or args.failover) else (
             [q for q in args.queries.split(",") if q.strip()]
             or [d.DEFAULT_DRILL_QUERIES[0]]
         )
@@ -186,6 +205,13 @@ def main() -> int:
         results.append(
             d.run_shared_drill(
                 args.seed, os.path.join(workdir, "shared"), **shared_kw
+            )
+        )
+    if args.failover:
+        fo_kw = {"plan_factory": plan_factory} if args.plan else {}
+        results.append(
+            d.run_failover_drill(
+                args.seed, os.path.join(workdir, "failover"), **fo_kw
             )
         )
 

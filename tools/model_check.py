@@ -151,6 +151,10 @@ def main(argv=None) -> int:
                     "durable rescale checkpoint)")
     ap.add_argument("--reads", type=int, default=None,
                     help="StateServe reader-actor event budget")
+    ap.add_argument("--standby", type=int, default=None,
+                    help="1 = a hot-standby incarnation may be armed "
+                    "(ISSUE 17: arm/tail beside the live generation, "
+                    "promote in place on heartbeat loss)")
     ap.add_argument("--budget", type=int, default=4_000_000,
                     help="max states; truncation fails an exhaustive run")
     ap.add_argument("--smoke", action="store_true",
@@ -428,7 +432,8 @@ def main(argv=None) -> int:
         overrides = {
             k: getattr(args, k)
             for k in ("workers", "epochs", "inflight", "faults",
-                      "restarts", "rescales", "overlap", "reads")
+                      "restarts", "rescales", "overlap", "reads",
+                      "standby")
             if getattr(args, k) is not None
         }
         if overrides:

@@ -47,3 +47,4 @@ from . import rules_asyncio  # noqa: F401,E402
 from . import rules_protocol  # noqa: F401,E402
 from . import rules_jax_config  # noqa: F401,E402
 from . import rules_segments  # noqa: F401,E402
+from .races import rules_races  # noqa: F401,E402 - RACE00x (ISSUE 18)

@@ -1,0 +1,48 @@
+"""arroyoracer — asyncio race & atomicity analysis (ISSUE 18).
+
+Every past concurrency bug in this tree (PR 2's stranded commits, PR 9's
+stop-path holes, PR 10's heartbeat stampede) was an *interleaving* bug:
+correct-looking code whose shared state was mutated by another task
+between a read and the dependent write. Per-file AST rules cannot see
+that — the read, the yield point, and the conflicting writer live in
+different functions, files, and task-spawn roots. This package is the
+lockset/happens-before answer (Eraser, SOSP'97; FastTrack, PLDI'09)
+adapted to asyncio's cooperative model, in two cooperating halves:
+
+static (``callgraph`` + ``rules_races``)
+    A project-wide interprocedural engine: a cross-file call graph with
+    async-context propagation (which functions run under which
+    task-spawn roots — runner loop, control pump, heartbeat, checkpoint
+    flush, failover manager, TimerWheel callbacks), locksets propagated
+    through call edges, and the RACE00x rule family over fields declared
+    with the ``shared_state``/``guarded_by`` annotation DSL
+    (``annotations``, runtime no-op like ``@protocol_effect``):
+
+      RACE001  shared field written from >= 2 task roots with no common
+               lock and no ``multi_writer`` declaration
+      RACE002  atomicity violation: a read of shared state crosses an
+               ``await`` before the dependent write, with no
+               revalidation (the asyncio TOCTOU)
+      RACE003  ``guarded_by`` field accessed without holding its lock
+      RACE004  awaiting while holding a ``guarded_by`` lock whose
+               fields a concurrent task root mutates
+
+static debugging: ``tools/lint.py --call-graph`` dumps roots ->
+reachable functions -> shared-field accesses as JSON.
+
+dynamic (``sanitizer``)
+    An opt-in interleaving sanitizer (``ARROYO_RACE_SANITIZER=1``):
+    annotated classes get access-recording instrumentation keyed by
+    (task root, yield epoch); lost-update windows (read -> another
+    root's write -> write-back) and undeclared cross-root write/write
+    pairs are flagged live. Wired into the chaos drill runner and the
+    ``runner.stall``-driven starvation drill
+    (``tools/chaos_drill.py --starvation``).
+"""
+
+from .annotations import (  # noqa: F401 - public surface
+    GUARDED_BY_ATTR,
+    SHARED_STATE_ATTR,
+    guarded_by,
+    shared_state,
+)

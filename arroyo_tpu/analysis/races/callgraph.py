@@ -1,0 +1,546 @@
+"""Project-wide interprocedural call graph with async-context propagation.
+
+This is what grows arroyolint beyond per-file AST rules: one shared
+analysis (built once per `Project`, cached — all four RACE rules and the
+``--call-graph`` debug dump reuse it) that answers three questions the
+RACE00x family needs:
+
+  roots      which *task-spawn roots* can a function run under?  Every
+             ``asyncio.ensure_future(...)`` / ``create_task(...)`` call
+             site defines a root named after the spawned coroutine (the
+             runner loop, the worker heartbeat, the response pump, the
+             checkpoint flush chain, the TimerWheel loop, the job drive
+             task...). Root membership propagates through call edges —
+             but NOT through spawn edges: the spawned task is a new
+             concurrent context, which is the whole point. Functions
+             reachable from no spawn site run under the implicit
+             ``main`` root (the submitting / RPC-serving context).
+
+  locksets   which locks are held at a statement?  Intraprocedurally a
+             bare ``with self._lock:`` / ``async with self._lock:``
+             contributes its attribute name; interprocedurally a
+             function's *entry lockset* is the intersection over all
+             call sites of (caller entry lockset | locks held at the
+             site) — the classic Eraser-style conservative summary.
+             Spawned functions enter lock-free by definition.
+
+  accesses   where are the ``shared_state``/``guarded_by`` declared
+             fields read and written?  Matching is by attribute name
+             (Python has no types to resolve receivers), which is why
+             the DSL — and the deliberately distinctive field names it
+             declares — bounds the false-positive surface: undeclared
+             fields are invisible to the rules.
+
+Call-edge resolution is heuristic by necessity: ``self.m()`` binds to
+the enclosing class (then same-file classes); bare names bind within the
+module; ``obj.m()`` binds to any method named ``m`` project-wide unless
+the name is too ambiguous (> _AMBIG_CAP candidates), in which case the
+edge is dropped rather than poisoning reachability. ``--call-graph``
+exists so a surprising finding can be traced to the exact edges and
+roots that produced it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import weakref
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Project, dotted_name
+
+MAIN_ROOT = "main"
+
+# beyond this many same-named method candidates an obj.m() edge is noise
+_AMBIG_CAP = 4
+
+_SPAWN_CALLS = {
+    "asyncio.ensure_future", "ensure_future",
+    "asyncio.create_task", "create_task",
+}
+
+# calls that mutate a container field in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "clear",
+    "update", "setdefault", "add", "discard", "pop", "popitem",
+    "put_nowait",
+}
+
+_CONSTRUCTORS = {"__init__", "__post_init__"}
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldDecl:
+    """One field declared via shared_state()/guarded_by() on some class."""
+
+    field: str
+    cls: str
+    path: str
+    guard: Optional[str]      # lock attribute name, or None
+    multi_writer: bool
+
+
+@dataclasses.dataclass
+class Access:
+    field: str
+    kind: str                 # "read" | "write"
+    path: str
+    line: int
+    col: int
+    lockset: FrozenSet[str]   # locks held at the site (intraprocedural)
+    receiver: str = "?"       # dotted receiver expr ("self", "job", "?")
+
+
+@dataclasses.dataclass
+class AwaitSite:
+    line: int
+    col: int
+    lockset: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str             # "path::Class.name" | "path::name"
+    path: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST
+    is_async: bool
+    calls: List[Tuple[str, str, FrozenSet[str]]]  # (kind, name, lockset)
+    spawns: List[Tuple[str, str, int]]            # (kind, name, line)
+    accesses: List[Access]
+    awaits: List[AwaitSite]
+
+
+def _literal_strs(nodes: Iterable[ast.AST]) -> List[str]:
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append(n.value)
+    return out
+
+
+def extract_decls(project: Project) -> Dict[str, FieldDecl]:
+    """field name -> declaration, from decorator ASTs across the project.
+
+    A field name declared on two classes keeps the first declaration but
+    merges pessimistically (multi_writer only if both said so; a guard
+    from either) — name-keyed analysis cannot tell the receivers apart.
+    """
+    decls: Dict[str, FieldDecl] = {}
+    for ctx in project:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                name = dotted_name(dec.func)
+                base = name.split(".")[-1] if name else None
+                if base == "shared_state":
+                    multi = set()
+                    for kw in dec.keywords:
+                        if kw.arg == "multi_writer" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)
+                        ):
+                            multi.update(_literal_strs(kw.value.elts))
+                    for f in _literal_strs(dec.args):
+                        _merge_decl(decls, FieldDecl(
+                            f, node.name, ctx.path, None, f in multi
+                        ))
+                elif base == "guarded_by":
+                    strs = _literal_strs(dec.args)
+                    if len(strs) >= 2:
+                        lock, fields = strs[0], strs[1:]
+                        for f in fields:
+                            _merge_decl(decls, FieldDecl(
+                                f, node.name, ctx.path, lock, True
+                            ))
+    return decls
+
+
+def _merge_decl(decls: Dict[str, FieldDecl], d: FieldDecl) -> None:
+    prev = decls.get(d.field)
+    if prev is None:
+        decls[d.field] = d
+        return
+    decls[d.field] = FieldDecl(
+        d.field, prev.cls, prev.path,
+        prev.guard or d.guard,
+        prev.multi_writer and d.multi_writer,
+    )
+
+
+# -- per-function extraction -------------------------------------------------
+
+
+class _FuncScan:
+    """One pass over a function body (nested defs excluded) tracking the
+    with-lock stack, collecting call edges, spawn sites, awaits, and
+    declared-field accesses."""
+
+    def __init__(self, ctx: FileContext, fields: Set[str]):
+        self.ctx = ctx
+        self.fields = fields
+        self.calls: List[Tuple[str, str, FrozenSet[str]]] = []
+        self.spawns: List[Tuple[str, str, int]] = []
+        self.accesses: List[Access] = []
+        self.awaits: List[AwaitSite] = []
+
+    def scan(self, fn: ast.AST) -> None:
+        for stmt in fn.body:
+            self._stmt(stmt, frozenset())
+
+    # locks: bare Name/Attribute with-contexts ("with self._lock:") count;
+    # calls ("with open(p) as f:") don't — locks are held, not created here
+    def _with_locks(self, node, locks: FrozenSet[str]) -> FrozenSet[str]:
+        extra = set()
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, (ast.Name, ast.Attribute)):
+                name = dotted_name(expr)
+                if name:
+                    extra.add(name.split(".")[-1])
+        return locks | extra
+
+    def _stmt(self, node: ast.AST, locks: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # a nested scope is its own FuncInfo
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = self._with_locks(node, locks)
+            for item in node.items:
+                self._expr(item.context_expr, locks)
+            if isinstance(node, ast.AsyncWith):
+                self.awaits.append(AwaitSite(node.lineno, node.col_offset,
+                                             locks))
+            for s in node.body:
+                self._stmt(s, inner)
+            return
+        if isinstance(node, ast.AsyncFor):
+            self._expr(node.iter, locks)
+            self.awaits.append(AwaitSite(node.lineno, node.col_offset, locks))
+            for s in node.body + node.orelse:
+                self._stmt(s, locks)
+            return
+        # generic statement: expressions at this lockset, then children
+        for field_name, value in ast.iter_fields(node):
+            if isinstance(value, ast.AST) and not isinstance(value, ast.stmt):
+                self._expr(value, locks)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v, locks)
+                    elif isinstance(v, ast.excepthandler):
+                        if v.type is not None:
+                            self._expr(v.type, locks)
+                        for s in v.body:
+                            self._stmt(s, locks)
+                    elif isinstance(v, ast.AST):
+                        self._expr(v, locks)
+
+    def _expr(self, node: ast.AST, locks: FrozenSet[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue  # deferred execution context
+            if isinstance(sub, ast.Await):
+                self.awaits.append(
+                    AwaitSite(sub.lineno, sub.col_offset, locks)
+                )
+            elif isinstance(sub, ast.Call):
+                self._call(sub, locks)
+            elif isinstance(sub, ast.Attribute) and sub.attr in self.fields:
+                self._access(sub, locks)
+
+    def _call(self, node: ast.Call, locks: FrozenSet[str]) -> None:
+        name = dotted_name(node.func)
+        if name is None:
+            return
+        if name in _SPAWN_CALLS or name.endswith(".create_task"):
+            target = node.args[0] if node.args else None
+            kind_name = None
+            if isinstance(target, ast.Call):
+                kind_name = self._callee(target.func)
+            elif isinstance(target, ast.Name):
+                kind_name = ("plain", target.id)
+            if kind_name:
+                self.spawns.append(
+                    (kind_name[0], kind_name[1], node.lineno)
+                )
+            return
+        kn = self._callee(node.func)
+        if kn:
+            self.calls.append((kn[0], kn[1], locks))
+
+    @staticmethod
+    def _callee(func: ast.AST) -> Optional[Tuple[str, str]]:
+        name = dotted_name(func)
+        if name is None:
+            if isinstance(func, ast.Attribute):  # call on a call result etc
+                return ("attr", func.attr)
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            return ("plain", parts[0])
+        if parts[0] == "self" and len(parts) == 2:
+            return ("self", parts[1])
+        return ("attr", parts[-1])
+
+    def _access(self, node: ast.Attribute, locks: FrozenSet[str]) -> None:
+        parent = self.ctx.parent(node)
+        recv = dotted_name(node.value) or "?"
+        kind = "read"
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+            if isinstance(parent, ast.AugAssign) and parent.target is node:
+                # x.f += 1 is a read AND a write
+                self.accesses.append(Access(
+                    node.attr, "read", self.ctx.path, node.lineno,
+                    node.col_offset, locks, recv,
+                ))
+        elif isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+            gp = self.ctx.parent(parent)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                kind = "write"  # x.f.append(...) mutates f in place
+        elif isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                kind = "write"  # x.f[k] = v / del x.f[k]
+        self.accesses.append(Access(
+            node.attr, kind, self.ctx.path, node.lineno, node.col_offset,
+            locks, recv,
+        ))
+
+
+# -- the graph ---------------------------------------------------------------
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.decls: Dict[str, FieldDecl] = extract_decls(project)
+        self.funcs: Dict[str, FuncInfo] = {}
+        self._by_method: Dict[str, List[str]] = {}
+        self._by_plain: Dict[Tuple[str, str], str] = {}
+        self._by_class: Dict[Tuple[str, str, str], str] = {}
+        self._extract()
+        self.edges: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {
+            q: self._resolve_edges(f) for q, f in self.funcs.items()
+        }
+        self.roots_of: Dict[str, Set[str]] = {}
+        self.root_spawn_sites: Dict[str, List[Tuple[str, int]]] = {}
+        self._propagate_roots()
+        self.entry_locks: Dict[str, FrozenSet[str]] = {}
+        self._propagate_locksets()
+
+    # -- extraction ----------------------------------------------------------
+
+    def _extract(self) -> None:
+        fields = set(self.decls)
+        for ctx in self.project:
+            self._extract_file(ctx, fields)
+
+    def _extract_file(self, ctx: FileContext, fields: Set[str]) -> None:
+        class_stack: List[str] = []
+
+        def visit(node):
+            if isinstance(node, ast.ClassDef):
+                class_stack.append(node.name)
+                for child in node.body:
+                    visit(child)
+                class_stack.pop()
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls = class_stack[-1] if class_stack else None
+                qual = (f"{ctx.path}::{cls}.{node.name}" if cls
+                        else f"{ctx.path}::{node.name}")
+                scan = _FuncScan(ctx, fields)
+                scan.scan(node)
+                info = FuncInfo(
+                    qualname=qual, path=ctx.path, cls=cls, name=node.name,
+                    node=node, is_async=isinstance(node, ast.AsyncFunctionDef),
+                    calls=scan.calls, spawns=scan.spawns,
+                    accesses=scan.accesses, awaits=scan.awaits,
+                )
+                # first definition wins on qualname collisions (overloads
+                # via if TYPE_CHECKING etc. are rare and equivalent here)
+                self.funcs.setdefault(qual, info)
+                if cls:
+                    self._by_method.setdefault(node.name, []).append(qual)
+                    self._by_class[(ctx.path, cls, node.name)] = qual
+                else:
+                    self._by_plain.setdefault((ctx.path, node.name), qual)
+                    self._by_method.setdefault(node.name, []).append(qual)
+                for child in node.body:
+                    visit(child)  # nested defs become their own FuncInfo
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(ctx.tree)
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve(self, kind: str, name: str, frm: FuncInfo) -> List[str]:
+        if kind == "self" and frm.cls:
+            q = self._by_class.get((frm.path, frm.cls, name))
+            if q:
+                return [q]
+            same_file = [
+                x for x in self._by_method.get(name, ())
+                if x.startswith(frm.path + "::")
+            ]
+            if same_file:
+                return same_file
+            kind = "attr"
+        if kind == "plain":
+            q = self._by_plain.get((frm.path, name))
+            return [q] if q else []
+        cands = self._by_method.get(name, ())
+        return list(cands) if 0 < len(cands) <= _AMBIG_CAP else []
+
+    def _resolve_edges(self, f: FuncInfo):
+        out = []
+        for kind, name, locks in f.calls:
+            for target in self._resolve(kind, name, f):
+                out.append((target, locks))
+        return out
+
+    # -- roots ---------------------------------------------------------------
+
+    def _propagate_roots(self) -> None:
+        for f in self.funcs.values():
+            for kind, name, line in f.spawns:
+                targets = self._resolve(kind, name, f) or [
+                    f"{f.path}:{line}:<spawn>"
+                ]
+                for t in targets:
+                    root = t
+                    self.roots_of.setdefault(t, set()).add(root)
+                    self.root_spawn_sites.setdefault(root, []).append(
+                        (f.path, line)
+                    )
+        work = [q for q in self.roots_of if q in self.funcs]
+        while work:
+            q = work.pop()
+            mine = self.roots_of[q]
+            for callee, _locks in self.edges.get(q, ()):
+                have = self.roots_of.setdefault(callee, set())
+                before = len(have)
+                have |= mine
+                if len(have) != before:
+                    work.append(callee)
+
+    def roots(self, qualname: str) -> Set[str]:
+        """Task roots `qualname` can run under; `main` when unspawned."""
+        return self.roots_of.get(qualname) or {MAIN_ROOT}
+
+    # -- locksets ------------------------------------------------------------
+
+    def _propagate_locksets(self) -> None:
+        incoming: Dict[str, int] = {q: 0 for q in self.funcs}
+        for q, edges in self.edges.items():
+            for callee, _ in edges:
+                if callee in incoming:
+                    incoming[callee] += 1
+        empty: FrozenSet[str] = frozenset()
+        work: List[str] = []
+        for q in self.funcs:
+            # entry points: never called, or spawned DIRECTLY as a task
+            # (a task starts on a fresh stack — spawn-site locks are NOT
+            # held). A direct spawn target carries its own qualname in
+            # its root set; functions that merely inherit a root through
+            # call edges keep their callers' locksets.
+            if incoming[q] == 0 or q in self.roots_of.get(q, ()):
+                self.entry_locks[q] = empty
+                work.append(q)
+        while work:
+            q = work.pop()
+            base = self.entry_locks[q]
+            for callee, site_locks in self.edges.get(q, ()):
+                if callee not in self.funcs:
+                    continue
+                new = base | site_locks
+                cur = self.entry_locks.get(callee)
+                if cur is None:
+                    self.entry_locks[callee] = new
+                    work.append(callee)
+                elif not (cur <= new):
+                    self.entry_locks[callee] = cur & new
+                    work.append(callee)
+
+    def entry_lockset(self, qualname: str) -> FrozenSet[str]:
+        return self.entry_locks.get(qualname, frozenset())
+
+    # -- queries -------------------------------------------------------------
+
+    def field_writes(self, field: str) -> List[Tuple[FuncInfo, Access]]:
+        out = []
+        for f in self.funcs.values():
+            if f.name in _CONSTRUCTORS:
+                continue  # construction precedes sharing
+            for a in f.accesses:
+                if a.field == field and a.kind == "write":
+                    out.append((f, a))
+        return out
+
+    def field_accesses(self, field: str) -> List[Tuple[FuncInfo, Access]]:
+        out = []
+        for f in self.funcs.values():
+            for a in f.accesses:
+                if a.field == field:
+                    out.append((f, a))
+        return out
+
+    # -- debug dump (tools/lint.py --call-graph) -----------------------------
+
+    def to_debug_json(self) -> dict:
+        roots: Dict[str, dict] = {}
+        for q, f in sorted(self.funcs.items()):
+            for root in sorted(self.roots(q)):
+                entry = roots.setdefault(root, {
+                    "spawned_at": [
+                        f"{p}:{ln}" for p, ln in
+                        sorted(self.root_spawn_sites.get(root, ()))
+                    ],
+                    "functions": [],
+                    "shared_accesses": [],
+                })
+                entry["functions"].append(q)
+                for a in f.accesses:
+                    entry["shared_accesses"].append({
+                        "field": a.field,
+                        "kind": a.kind,
+                        "site": f"{a.path}:{a.line}",
+                        "function": q,
+                        "lockset": sorted(
+                            self.entry_lockset(q) | a.lockset
+                        ),
+                    })
+        return {
+            "declared_fields": {
+                name: {
+                    "class": d.cls, "path": d.path, "guard": d.guard,
+                    "multi_writer": d.multi_writer,
+                }
+                for name, d in sorted(self.decls.items())
+            },
+            "n_functions": len(self.funcs),
+            "roots": roots,
+        }
+
+
+# one graph per Project: the four RACE rules and the --call-graph dump all
+# reuse it, which is the cache that keeps full-tree --strict wall time at
+# ~1 extra pass instead of 4+ (ISSUE 18 satellite)
+_CACHE: "weakref.WeakKeyDictionary[Project, CallGraph]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def build(project: Project) -> CallGraph:
+    graph = _CACHE.get(project)
+    if graph is None:
+        graph = CallGraph(project)
+        _CACHE[project] = graph
+    return graph

@@ -1,0 +1,579 @@
+"""The RACE00x rule family: lockset + atomicity checks over the fields
+declared with the ``shared_state``/``guarded_by`` DSL, on top of the
+interprocedural call graph (``callgraph.build`` — cached per Project, so
+four rules cost one graph).
+
+All four rules are project-scope: the read, the yield point, and the
+conflicting writer typically live in different files. All four analyze
+ONLY declared fields — the DSL is the precision contract that keeps a
+name-heuristic analysis quiet on the real tree.
+
+RACE001  a declared field is written from >= 2 task-spawn roots, no lock
+         is common to all write sites, and the field is not declared
+         ``multi_writer``. In a lock-free asyncio program every lockset
+         is empty, so the teeth are in the root count: the fix is either
+         a ``multi_writer`` declaration (making last-writer-wins an
+         explicit, reviewable policy) or serializing the writers.
+
+RACE002  the asyncio TOCTOU: shared state is read, the coroutine crosses
+         an ``await`` (any interleaving may run), and a dependent write
+         lands without revalidation. Detected by a flow-sensitive
+         abstract interpretation of each async function: branch states
+         split and merge (a branch-local await does not poison the
+         fallthrough path), loop bodies run twice (read-in-iteration-1 /
+         write-in-iteration-2 is caught), and staleness tracks both the
+         field itself and locals tainted by it (``m = job.f`` ... await
+         ... ``job.f = m``). A fresh read after the last await — even in
+         the writing statement itself (``job.f = job.f or m``) —
+         revalidates and silences the rule. ``multi_writer`` does NOT
+         waive RACE002: lost updates are never the design.
+
+RACE003  a ``guarded_by`` field is accessed at a site whose lockset
+         (interprocedural entry lockset | locks held at the site) lacks
+         the declared lock.
+
+RACE004  an ``await`` (or ``async with`` / ``async for``) is reached
+         while holding a ``guarded_by`` lock whose fields some
+         *concurrent* root also mutates — the classic
+         lock-held-across-yield convoy/starvation hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, Rule, dotted_name, register
+from . import callgraph
+from .callgraph import _MUTATORS, Access, CallGraph, FieldDecl, FuncInfo
+
+
+def _short(qualname: str) -> str:
+    return qualname.split("::", 1)[-1]
+
+
+def _relevant(func: FuncInfo, access: Access, decl: FieldDecl) -> bool:
+    """Receiver-based precision filter: a ``self.field`` access inside a
+    class that is not the declaring class is a different attribute that
+    happens to share the name. Non-self receivers can't be type-resolved
+    and stay in (that's how ``job.stop_requested`` writes in the REST
+    layer are seen)."""
+    if access.receiver == "self" and func.cls is not None:
+        return func.cls == decl.cls
+    return True
+
+
+def _site_lockset(graph: CallGraph, func: FuncInfo,
+                  locks: FrozenSet[str]) -> FrozenSet[str]:
+    return graph.entry_lockset(func.qualname) | locks
+
+
+@register
+class RaceMultiRootWrite(Rule):
+    id = "RACE001"
+    name = "race-multi-root-write"
+    description = (
+        "Shared field written from >= 2 task-spawn roots with no common "
+        "lock and no multi_writer declaration; declare the policy or "
+        "serialize the writers"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = callgraph.build(project)
+        for field, decl in sorted(graph.decls.items()):
+            if decl.multi_writer:
+                continue
+            writes = [
+                (f, a) for f, a in graph.field_writes(field)
+                if _relevant(f, a, decl)
+            ]
+            if not writes:
+                continue
+            roots: Set[str] = set()
+            common: Optional[FrozenSet[str]] = None
+            for f, a in writes:
+                roots |= graph.roots(f.qualname)
+                ls = _site_lockset(graph, f, a.lockset)
+                common = ls if common is None else (common & ls)
+            if len(roots) < 2 or common:
+                continue
+            root_names = ", ".join(sorted(_short(r) for r in roots))
+            for f, a in writes:
+                yield Finding(
+                    rule=self.id, path=a.path, line=a.line, col=a.col,
+                    message=(
+                        f"shared field '{decl.cls}.{field}' is written "
+                        f"from {len(roots)} task roots ({root_names}) "
+                        f"with no common lock; declare it "
+                        f"multi_writer or serialize the writers"
+                    ),
+                )
+
+
+# -- RACE002: flow-sensitive atomicity interpretation ------------------------
+
+
+class _State:
+    """Abstract state at a program point. `pending[key]` is the last
+    un-overwritten read of a shared access path ("job.stop_requested");
+    `taints[name][key]` means local `name` holds a value derived from
+    `key`. The bool is 'crossed an await since'."""
+
+    __slots__ = ("pending", "taints")
+
+    def __init__(self):
+        self.pending: Dict[str, Tuple[int, bool]] = {}
+        self.taints: Dict[str, Dict[str, Tuple[int, bool]]] = {}
+
+    def copy(self) -> "_State":
+        st = _State()
+        st.pending = dict(self.pending)
+        st.taints = {k: dict(v) for k, v in self.taints.items()}
+        return st
+
+    def cross(self) -> None:
+        for k, (line, _) in self.pending.items():
+            self.pending[k] = (line, True)
+        for name, per in self.taints.items():
+            for k, (line, _) in per.items():
+                per[k] = (line, True)
+
+
+def _merge(a: Optional[_State], b: Optional[_State]) -> Optional[_State]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    out = a.copy()
+    for k, (line, crossed) in b.pending.items():
+        if k in out.pending:
+            l0, c0 = out.pending[k]
+            out.pending[k] = (min(l0, line), c0 or crossed)
+        else:
+            out.pending[k] = (line, crossed)
+    for name, per in b.taints.items():
+        dst = out.taints.setdefault(name, {})
+        for k, (line, crossed) in per.items():
+            if k in dst:
+                l0, c0 = dst[k]
+                dst[k] = (min(l0, line), c0 or crossed)
+            else:
+                dst[k] = (line, crossed)
+    return out
+
+
+def _has_yield_point(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+    return False
+
+
+class _AtomicityScan:
+    """Interpret one async function; findings accumulate in `fired`."""
+
+    def __init__(self, rule: Rule, func: FuncInfo, keys_of):
+        self.rule = rule
+        self.func = func
+        self.keys_of = keys_of  # Attribute node -> access key, or None
+        self.fired: Dict[Tuple[str, int], Finding] = {}
+
+    # -- events --------------------------------------------------------------
+
+    def read(self, st: _State, key: str, line: int) -> None:
+        st.pending[key] = (line, False)
+
+    def write(self, st: _State, key: str, line: int, col: int,
+              value_names: Iterable[str],
+              rhs_reads: Iterable[str] = ()) -> None:
+        p = st.pending.get(key)
+        why = None
+        if p and p[1]:
+            why = (f"'{key}' read at line {p[0]} crossed an await before "
+                   f"this write")
+        elif key in rhs_reads and p is not None:
+            # the RHS itself re-read the key after the last await
+            # (`job.f = job.f or mode`): the write is revalidated
+            pass
+        else:
+            for name in value_names:
+                t = st.taints.get(name, {}).get(key)
+                if t and t[1]:
+                    why = (f"'{key}' was read into '{name}' at line "
+                           f"{t[0]} and crossed an await before being "
+                           f"written back")
+                    break
+        if why is not None and (key, line) not in self.fired:
+            self.fired[(key, line)] = Finding(
+                rule=self.rule.id, path=self.func.path, line=line, col=col,
+                message=(
+                    f"atomicity violation in {_short(self.func.qualname)}: "
+                    f"{why}; another task may have changed it in between — "
+                    f"re-read and revalidate after the await"
+                ),
+            )
+        st.pending.pop(key, None)
+
+    # -- expression walk (in evaluation order) -------------------------------
+
+    def eval_expr(self, node: Optional[ast.AST], st: _State) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # deferred execution; not this coroutine's timeline
+        if isinstance(node, ast.Await):
+            self.eval_expr(node.value, st)
+            st.cross()
+            return
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS
+                    and isinstance(func.value, ast.Attribute)):
+                key = self.keys_of(func.value)
+                if key is not None:
+                    self.read(st, key, func.value.lineno)
+                    for a in node.args:
+                        self.eval_expr(a, st)
+                    for kw in node.keywords:
+                        self.eval_expr(kw.value, st)
+                    # the mutation commits only now: if an argument
+                    # awaited, the receiver read above is stale
+                    self.write(st, key, node.lineno, node.col_offset, ())
+                    return
+            for child in ast.iter_child_nodes(node):
+                self.eval_expr(child, st)
+            return
+        if isinstance(node, ast.Attribute):
+            key = self.keys_of(node)
+            if key is not None and isinstance(node.ctx, ast.Load):
+                self.eval_expr(node.value, st)
+                self.read(st, key, node.lineno)
+                return
+            for child in ast.iter_child_nodes(node):
+                self.eval_expr(child, st)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.eval_expr(child, st)
+
+    def _value_names(self, node: Optional[ast.AST]) -> List[str]:
+        if node is None:
+            return []
+        return [
+            n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        ]
+
+    def _reads_in(self, node: Optional[ast.AST], st: _State) -> List[str]:
+        """Access keys read within `node` that are still pending."""
+        if node is None:
+            return []
+        keys = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                key = self.keys_of(sub)
+                if key is not None and key in st.pending:
+                    keys.append(key)
+        return keys
+
+    def assign_target(self, st: _State, target: ast.AST, value_names,
+                      read_keys) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.assign_target(st, el, value_names, read_keys)
+            return
+        if isinstance(target, ast.Starred):
+            self.assign_target(st, target.value, value_names, read_keys)
+            return
+        if isinstance(target, ast.Name):
+            # local now derives from whatever shared keys the RHS read
+            per: Dict[str, Tuple[int, bool]] = {}
+            for key in read_keys:
+                if key in st.pending:
+                    per[key] = st.pending[key]
+            # and inherits taints of the RHS's locals (m2 = m)
+            for name in value_names:
+                for key, info in st.taints.get(name, {}).items():
+                    if key not in per or info[1]:
+                        per[key] = info
+            if per:
+                st.taints[target.id] = per
+            else:
+                st.taints.pop(target.id, None)
+            return
+        if isinstance(target, ast.Attribute):
+            key = self.keys_of(target)
+            if key is not None:
+                self.eval_expr(target.value, st)
+                self.write(st, key, target.lineno, target.col_offset,
+                           value_names, read_keys)
+                return
+        if isinstance(target, ast.Subscript):
+            inner = target.value
+            if isinstance(inner, ast.Attribute):
+                key = self.keys_of(inner)
+                if key is not None:
+                    self.eval_expr(inner.value, st)
+                    self.eval_expr(target.slice, st)
+                    self.write(st, key, target.lineno, target.col_offset,
+                               value_names, read_keys)
+                    return
+        self.eval_expr(target, st)
+
+    # -- statement walk ------------------------------------------------------
+
+    def exec_block(self, stmts, st: Optional[_State]) -> Optional[_State]:
+        for stmt in stmts:
+            if st is None:
+                return None
+            st = self.exec_stmt(stmt, st)
+        return st
+
+    def exec_stmt(self, node: ast.AST, st: _State) -> Optional[_State]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return st
+        if isinstance(node, ast.Assign):
+            self.eval_expr(node.value, st)
+            read_keys = self._reads_in(node.value, st)  # pending post-eval
+            names = self._value_names(node.value)
+            for target in node.targets:
+                self.assign_target(st, target, names, read_keys)
+            return st
+        if isinstance(node, ast.AnnAssign):
+            self.eval_expr(node.value, st)
+            read_keys = self._reads_in(node.value, st)
+            names = self._value_names(node.value)
+            self.assign_target(st, node.target, names, read_keys)
+            return st
+        if isinstance(node, ast.AugAssign):
+            # x.f += v re-reads f right here: the RMW is await-free iff
+            # the value expression is
+            if isinstance(node.target, ast.Attribute):
+                key = self.keys_of(node.target)
+                if key is not None:
+                    self.eval_expr(node.target.value, st)
+                    self.read(st, key, node.lineno)
+                    self.eval_expr(node.value, st)
+                    self.write(st, key, node.lineno, node.col_offset,
+                               self._value_names(node.value), (key,))
+                    return st
+            self.eval_expr(node.value, st)
+            read_keys = self._reads_in(node.value, st)
+            if isinstance(node.target, ast.Name):
+                self.assign_target(st, node.target,
+                                   self._value_names(node.value) +
+                                   [node.target.id],
+                                   read_keys)
+            else:
+                self.assign_target(st, node.target,
+                                   self._value_names(node.value), read_keys)
+            return st
+        if isinstance(node, (ast.Return, ast.Raise)):
+            self.eval_expr(getattr(node, "value", None) or
+                           getattr(node, "exc", None), st)
+            return None
+        if isinstance(node, (ast.Break, ast.Continue)):
+            return None
+        if isinstance(node, ast.If):
+            self.eval_expr(node.test, st)
+            a = self.exec_block(node.body, st.copy())
+            b = self.exec_block(node.orelse, st.copy())
+            return _merge(a, b)
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            return self._exec_loop(node, st)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.eval_expr(item.context_expr, st)
+            if isinstance(node, ast.AsyncWith):
+                st.cross()
+            return self.exec_block(node.body, st)
+        if isinstance(node, ast.Try):
+            body_st = self.exec_block(node.body, st.copy())
+            h_entry = st.copy()
+            if any(_has_yield_point(s) for s in node.body):
+                h_entry.cross()  # the body may yield before raising
+            h_entry = _merge(h_entry, body_st)
+            outs: List[Optional[_State]] = []
+            for handler in node.handlers:
+                hs = h_entry.copy()
+                if handler.type is not None:
+                    self.eval_expr(handler.type, hs)
+                if handler.name:
+                    hs.taints.pop(handler.name, None)
+                outs.append(self.exec_block(handler.body, hs))
+            if node.orelse and body_st is not None:
+                body_st = self.exec_block(node.orelse, body_st)
+            outs.append(body_st)
+            merged = None
+            for o in outs:
+                merged = _merge(merged, o)
+            if node.finalbody:
+                fin_in = merged if merged is not None else h_entry
+                return self.exec_block(node.finalbody, fin_in)
+            return merged
+        if isinstance(node, (ast.Expr, ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(node):
+                self.eval_expr(child, st)
+            return st
+        # anything else (Global, Import, Pass...): walk exprs generically
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.stmt):
+                self.eval_expr(child, st)
+        return st
+
+    def _exec_loop(self, node, st: _State) -> Optional[_State]:
+        if isinstance(node, ast.While):
+            pre = lambda s: self.eval_expr(node.test, s)  # noqa: E731
+        else:
+            self.eval_expr(node.iter, st)
+            if isinstance(node, ast.AsyncFor):
+                def pre(s):
+                    s.cross()  # each iteration awaits the iterator
+                    self.assign_target(s, node.target, [], [])
+            else:
+                def pre(s):
+                    self.assign_target(s, node.target, [], [])
+        s_in: Optional[_State] = st
+        # two symbolic iterations: the second sees iteration-1 state, so
+        # read->await->write-next-iteration patterns fire; merging with
+        # the pre-loop state keeps the zero-iteration path sound
+        for _ in range(2):
+            if s_in is None:
+                break
+            pre(s_in)
+            s_out = self.exec_block(node.body, s_in.copy())
+            s_in = _merge(s_in, s_out)
+        if s_in is not None and node.orelse:
+            s_in = self.exec_block(node.orelse, s_in)
+        return s_in
+
+
+@register
+class RaceAwaitSpanningRMW(Rule):
+    id = "RACE002"
+    name = "race-atomicity-await"
+    description = (
+        "Read-modify-write on shared state spans an await with no "
+        "revalidation (asyncio TOCTOU); re-read the field after the "
+        "last await before writing"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = callgraph.build(project)
+        if not graph.decls:
+            return
+        out: List[Finding] = []
+        for func in graph.funcs.values():
+            if not func.is_async or not func.awaits:
+                continue
+            if func.name in callgraph._CONSTRUCTORS:
+                continue
+            if not any(
+                _relevant(func, a, graph.decls[a.field])
+                for a in func.accesses if a.field in graph.decls
+            ):
+                continue
+            out.extend(self._scan(graph, func))
+        return out
+
+    def _scan(self, graph: CallGraph, func: FuncInfo) -> List[Finding]:
+        decls = graph.decls
+
+        def keys_of(node: ast.Attribute) -> Optional[str]:
+            decl = decls.get(node.attr)
+            if decl is None:
+                return None
+            recv = dotted_name(node.value) or "?"
+            if recv == "self" and func.cls is not None \
+                    and func.cls != decl.cls:
+                return None
+            return f"{recv}.{node.attr}"
+
+        scan = _AtomicityScan(self, func, keys_of)
+        scan.exec_block(func.node.body, _State())
+        return list(scan.fired.values())
+
+
+@register
+class RaceGuardedFieldUnlocked(Rule):
+    id = "RACE003"
+    name = "race-guarded-by-unlocked"
+    description = (
+        "guarded_by field accessed at a site whose lockset does not "
+        "include the declared lock"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = callgraph.build(project)
+        for field, decl in sorted(graph.decls.items()):
+            if decl.guard is None:
+                continue
+            for func, a in graph.field_accesses(field):
+                if not _relevant(func, a, decl):
+                    continue
+                if func.name in callgraph._CONSTRUCTORS:
+                    continue
+                if decl.guard in _site_lockset(graph, func, a.lockset):
+                    continue
+                yield Finding(
+                    rule=self.id, path=a.path, line=a.line, col=a.col,
+                    message=(
+                        f"'{decl.cls}.{field}' is guarded by "
+                        f"'{decl.guard}' but this {a.kind} in "
+                        f"{_short(func.qualname)} does not hold it"
+                    ),
+                )
+
+
+@register
+class RaceAwaitUnderLock(Rule):
+    id = "RACE004"
+    name = "race-await-holding-lock"
+    description = (
+        "await reached while holding a guarded_by lock whose fields a "
+        "concurrent task root mutates; yielding under the lock invites "
+        "convoy/starvation"
+    )
+    scope = "project"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        graph = callgraph.build(project)
+        guards: Dict[str, List[FieldDecl]] = {}
+        for decl in graph.decls.values():
+            if decl.guard is not None:
+                guards.setdefault(decl.guard, []).append(decl)
+        if not guards:
+            return
+        writer_roots: Dict[str, Set[str]] = {}
+        for lock, decls in guards.items():
+            roots: Set[str] = set()
+            for decl in decls:
+                for f, a in graph.field_writes(decl.field):
+                    if _relevant(f, a, decl):
+                        roots |= graph.roots(f.qualname)
+            writer_roots[lock] = roots
+        for func in graph.funcs.values():
+            entry = graph.entry_lockset(func.qualname)
+            for aw in func.awaits:
+                held = entry | aw.lockset
+                for lock in sorted(held & set(guards)):
+                    others = writer_roots[lock] - graph.roots(func.qualname)
+                    if not others:
+                        continue
+                    fields = ", ".join(
+                        sorted(d.field for d in guards[lock])
+                    )
+                    yield Finding(
+                        rule=self.id, path=func.path, line=aw.line,
+                        col=aw.col,
+                        message=(
+                            f"{_short(func.qualname)} awaits while "
+                            f"holding '{lock}' (guarding {fields}), "
+                            f"which concurrent roots also need"
+                        ),
+                    )

@@ -1,0 +1,344 @@
+"""Dynamic interleaving sanitizer: the runtime half of arroyoracer.
+
+The static rules prove what *can* interleave; this module observes what
+*does*. Opt-in (``ARROYO_RACE_SANITIZER=1`` or :func:`enable`): every
+class decorated with ``@shared_state``/``@guarded_by`` gets class-level
+``__getattribute__``/``__setattr__`` instrumentation that records each
+access to a *declared* field as ``(task root, yield epoch, kind, site)``
+and checks two conflict shapes as they happen:
+
+lost-update (``read-await-write``)
+    root A reads a field, root B writes it, then A writes it back
+    without re-reading — A's write is computed from a stale value and
+    B's update is silently destroyed. This is PR 9's stop-path bug and
+    PR 10's heartbeat-restore bug, observed live instead of post-hoc.
+    ``multi_writer`` does NOT waive it: last-writer-wins is a defensible
+    policy, resurrecting overwritten state is not.
+
+write/write
+    two different task roots write a field not declared
+    ``multi_writer`` — the dynamic mirror of RACE001.
+
+Design notes, in decreasing order of subtlety:
+
+* In single-threaded asyncio, *any* interleaved access by another root
+  between A's read and A's write proves a yield happened in between —
+  so lost-update detection needs only access ordering, not precise
+  yield-epoch bookkeeping. Epochs (a global counter bumped whenever the
+  recording (thread, task) changes) are still recorded: they key the
+  access log and the Perfetto dump, where "which scheduling burst did
+  this land in" is what a human reads.
+* Instrumentation is per-class, not per-object (no proxies): wrapping
+  instances would break ``isinstance`` and identity checks throughout
+  the engine. :func:`disable` restores the original class attributes.
+* The first write to a not-yet-existing attribute is initialization
+  (the constructor publishing the field) and seeds no conflict state —
+  otherwise every field would count its creator as a concurrent writer.
+* Accesses can arrive from storage/executor threads (FaultPlan's seams
+  fire under them), so recording takes a ``threading.Lock`` and the
+  task root falls back from the ContextVar to "main".
+
+Zero overhead when disabled beyond an ``is_enabled()`` check at class
+decoration time; nothing is imported into hot paths.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+ENV_FLAG = "ARROYO_RACE_SANITIZER"
+
+_MAX_RECORDS = 200_000  # ring-buffer cap on the access log
+
+_enabled = False
+_lock = threading.Lock()
+
+_task_root: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "arroyo_race_task_root", default="main"
+)
+
+# class -> {"fields": {...}, "multi_writer": {...},
+#           "saved": {attr: original-or-None}}
+_instrumented: Dict[type, dict] = {}
+
+_records: List[dict] = []
+_dropped = 0
+_conflicts: List[dict] = []
+_seq = 0
+_epoch = 0
+_last_actor: Optional[Tuple[int, int]] = None  # (thread ident, task id)
+
+# (obj id, field) -> {"readers": {root: seq}, "last_write": (root, seq, site)}
+_state: Dict[Tuple[int, str], dict] = {}
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+def enable() -> None:
+    """Switch the sanitizer on and instrument every decorated class."""
+    global _enabled
+    if _enabled:
+        return
+    _enabled = True
+    from .annotations import decorated_classes
+
+    for cls in decorated_classes():
+        instrument_class(cls)
+
+
+def disable() -> None:
+    """Switch off and restore original class attributes; keeps findings."""
+    global _enabled
+    _enabled = False
+    for cls, info in list(_instrumented.items()):
+        for attr, orig in info["saved"].items():
+            if orig is None:
+                try:
+                    delattr(cls, attr)
+                except AttributeError:
+                    pass
+            else:
+                setattr(cls, attr, orig)
+    _instrumented.clear()
+
+
+def maybe_enable_from_env() -> bool:
+    if enabled_by_env():
+        enable()
+        return True
+    return False
+
+
+def reset() -> None:
+    """Drop the access log, conflicts, and per-object state (keeps on)."""
+    global _seq, _epoch, _last_actor, _dropped
+    with _lock:
+        _records.clear()
+        _conflicts.clear()
+        _state.clear()
+        _seq = 0
+        _epoch = 0
+        _dropped = 0
+        _last_actor = None
+
+
+class task_root:
+    """Name the current task's spawn root for sanitizer reports.
+
+    Context manager placed at task-root entry points (the runner loop,
+    heartbeat loop, pump loops, drive task...). Setting a ContextVar in
+    the task's own context scopes the name to that task and everything
+    it awaits — exactly the static analysis' root-propagation rule.
+    """
+
+    __slots__ = ("name", "_token")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._token = None
+
+    def __enter__(self) -> "task_root":
+        self._token = _task_root.set(self.name)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _task_root.reset(self._token)
+            self._token = None
+
+
+def set_task_root(name: str) -> None:
+    """Set-and-forget variant for the first line of a root coroutine:
+    the ContextVar lives in the task's own context, so it dies with the
+    task — no reset needed, no indentation tax on instrumented loops."""
+    _task_root.set(name)
+
+
+def current_root() -> str:
+    return _task_root.get()
+
+
+def instrument_class(cls: type) -> None:
+    """Install access recording for `cls`'s declared fields."""
+    from .annotations import SHARED_STATE_ATTR
+
+    if cls in _instrumented:
+        return
+    decl = getattr(cls, SHARED_STATE_ATTR, None)
+    if not decl:
+        return
+    fields = frozenset(decl)
+    multi = frozenset(f for f, meta in decl.items() if meta["multi_writer"])
+    saved = {
+        "__setattr__": cls.__dict__.get("__setattr__"),
+        "__getattribute__": cls.__dict__.get("__getattribute__"),
+    }
+    orig_set = cls.__setattr__
+    orig_get = cls.__getattribute__
+    cls_name = cls.__name__
+
+    def __setattr__(self, name, value):
+        if _enabled and name in fields:
+            init = name not in getattr(self, "__dict__", {})
+            _record(self, cls_name, name, "init" if init else "write",
+                    name in multi)
+        orig_set(self, name, value)
+
+    def __getattribute__(self, name):
+        if _enabled and name in fields:
+            _record(self, cls_name, name, "read", name in multi)
+        return orig_get(self, name)
+
+    cls.__setattr__ = __setattr__
+    cls.__getattribute__ = __getattribute__
+    _instrumented[cls] = {"fields": fields, "multi": multi, "saved": saved}
+
+
+def _caller_site() -> str:
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _record(obj: Any, cls_name: str, field: str, kind: str,
+            multi_writer: bool) -> None:
+    global _seq, _epoch, _last_actor, _dropped
+    try:
+        import asyncio
+
+        task = asyncio.current_task()
+    except RuntimeError:
+        task = None
+    root = _task_root.get()
+    site = _caller_site()
+    actor = (threading.get_ident(), id(task) if task else 0)
+    with _lock:
+        _seq += 1
+        if actor != _last_actor:
+            _epoch += 1
+            _last_actor = actor
+        rec = {
+            "seq": _seq, "epoch": _epoch, "root": root, "class": cls_name,
+            "field": field, "kind": kind, "site": site,
+        }
+        if len(_records) >= _MAX_RECORDS:
+            _records.pop(0)
+            _dropped += 1
+        _records.append(rec)
+        key = (id(obj), field)
+        st = _state.setdefault(key, {"readers": {}, "last_write": None})
+        if kind == "read":
+            st["readers"][root] = (_seq, site)
+        elif kind == "init":
+            # constructor publishing the field: reset conflict state
+            st["readers"] = {root: (_seq, site)}
+            st["last_write"] = None
+        else:  # write
+            lw = st["last_write"]
+            my_read = st["readers"].get(root)
+            if lw is not None and lw[0] != root:
+                if my_read is not None and my_read[0] < lw[1]:
+                    _conflicts.append({
+                        "kind": "lost-update",
+                        "class": cls_name, "field": field,
+                        "root": root, "other_root": lw[0],
+                        "read_site": my_read[1],
+                        "intervening_write_site": lw[2],
+                        "write_site": site,
+                        "detail": (
+                            f"{root} read {cls_name}.{field} at "
+                            f"{my_read[1]}, {lw[0]} wrote it at {lw[2]}, "
+                            f"then {root} wrote it back at {site} without "
+                            f"re-reading — {lw[0]}'s update is destroyed"
+                        ),
+                    })
+                elif not multi_writer:
+                    _conflicts.append({
+                        "kind": "write/write",
+                        "class": cls_name, "field": field,
+                        "root": root, "other_root": lw[0],
+                        "other_site": lw[2], "write_site": site,
+                        "detail": (
+                            f"{cls_name}.{field} written by roots "
+                            f"{lw[0]} ({lw[2]}) and {root} ({site}) but "
+                            f"not declared multi_writer"
+                        ),
+                    })
+            st["last_write"] = (root, _seq, site)
+            st["readers"][root] = (_seq, site)
+
+
+def conflicts() -> List[dict]:
+    with _lock:
+        return list(_conflicts)
+
+
+def access_log() -> List[dict]:
+    with _lock:
+        return list(_records)
+
+
+def report() -> dict:
+    with _lock:
+        return {
+            "enabled": _enabled,
+            "accesses": len(_records) + _dropped,
+            "dropped": _dropped,
+            "epochs": _epoch,
+            "conflicts": list(_conflicts),
+        }
+
+
+def dump(path: str) -> None:
+    """Write the access log + conflicts as JSON (CI failure artifact)."""
+    doc = report()
+    doc["log"] = access_log()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+
+
+def dump_trace(path: str) -> None:
+    """Write the access log as a Perfetto-loadable Chrome trace: one
+    instant event per access, one track per task root, conflicts on
+    their own track — scrubbing the interleaving beats reading seqs."""
+    roots = sorted({r["root"] for r in access_log()}) or ["main"]
+    tid_of = {root: i + 1 for i, root in enumerate(roots)}
+    events: List[dict] = [{
+        "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+        "args": {"name": f"root:{root}"},
+    } for root, tid in tid_of.items()]
+    events.append({
+        "name": "thread_name", "ph": "M", "pid": 1, "tid": 0,
+        "args": {"name": "conflicts"},
+    })
+    for rec in access_log():
+        events.append({
+            "name": f"{rec['kind']} {rec['class']}.{rec['field']}",
+            "ph": "i", "s": "t", "pid": 1,
+            "tid": tid_of.get(rec["root"], 0),
+            "ts": rec["seq"] * 10,  # synthetic time: order is the data
+            "args": {"site": rec["site"], "epoch": rec["epoch"]},
+        })
+    for i, c in enumerate(conflicts()):
+        events.append({
+            "name": f"{c['kind']} {c['class']}.{c['field']}",
+            "ph": "i", "s": "g", "pid": 1, "tid": 0, "ts": i * 10,
+            "args": {k: v for k, v in c.items() if isinstance(v, str)},
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)

@@ -313,7 +313,7 @@ def run_drill(
         passed=passed,
         rows=len(got),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -604,7 +604,7 @@ def run_rescale_drill(seed: int, workdir: str,
         passed=passed,
         rows=len(got),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -759,7 +759,7 @@ def run_pipeline_drill(seed: int, workdir: str, n_rows: int = 6000,
         passed=passed,
         rows=len(got),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -980,7 +980,7 @@ def run_state_bloat_drill(seed: int, workdir: str, n_rows: int = 6000,
         passed=passed,
         rows=len(got),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -1137,7 +1137,7 @@ def run_kafka_drill(seed: int, workdir: str, n_rows: int = 120,
         passed=passed,
         rows=len(got),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -1325,7 +1325,7 @@ def run_shared_drill(seed: int, workdir: str, n_rows: int = 4000,
         passed=passed,
         rows=sum(len(v) for v in got.values()),
         restarts=restarts,
-        fired=plan.fired_events,
+        fired=plan.fired_log(),
         comparable_log=plan.comparable_log(),
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
@@ -1594,5 +1594,182 @@ def run_failover_drill(seed: int, workdir: str, n_rows: int = 4000,
             "replayed_plan": replay_plan is not None,
             "chain_cache_hits": cache.get("hits"),
             "chain_cache_misses": cache.get("misses"),
+        },
+    )
+
+
+# -- event-loop starvation drill (ISSUE 18: the double-emit watch item) ------
+
+
+def starvation_plan(seed: int) -> FaultPlan:
+    """Blocking `runner.stall` hits, tenant-scoped to the victim job: a
+    CPU-bound UDF that never yields wedges the WHOLE shared event loop
+    (params.block) on each of the victim's first 12 input items, while
+    the squeezed heartbeat/checkpoint cadences keep ticking against it."""
+    plan = FaultPlan(seed)
+    plan.add("runner.stall", at_hits=tuple(range(1, 13)), max_fires=12,
+             match={"job": "starve-victim"},
+             params={"delay": 0.15, "block": True})
+    return plan
+
+
+def run_starvation_drill(seed: int, workdir: str, n_rows: int = 3000,
+                         rate: int = 1500, timeout: float = 120.0,
+                         plan_factory: Callable[[int], FaultPlan]
+                         = starvation_plan) -> DrillResult:
+    """ROADMAP watch item: can extreme event-loop lag double-emit a
+    window without a restart? (Observed once when a rescale drill ran
+    concurrently with a full-tree lint; never reproduced standalone.)
+
+    Two tenants run the replay-deterministic 500 ms tumbling aggregate
+    on one embedded cluster. The victim's input loop takes repeated
+    BLOCKING stalls (`runner.stall` params.block — a UDF that never
+    yields, starving heartbeat loops and the co-resident bystander),
+    heartbeat and checkpoint cadences are squeezed tight around the
+    stall width, and `max_restarts=0` so any heartbeat false-positive
+    fails the run outright. The interleaving sanitizer
+    (analysis/races/sanitizer.py) records every access to
+    `@shared_state` fields live. The drill passes iff both tenants'
+    outputs are byte-identical to their fault-free references, no
+    (key, window) pair is emitted twice, restarts == 0, every scheduled
+    stall fired, and the sanitizer saw zero conflicts. On failure the
+    access log and a Perfetto trace land in the workdir (CI uploads
+    them)."""
+    from ..analysis.races import sanitizer
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    os.makedirs(workdir, exist_ok=True)
+    tenants = ("starve-victim", "starve-bystander")
+
+    def tenant_sql(tag: str, out: str) -> str:
+        return (FAILOVER_DRILL_SQL
+                .replace("$out", out)
+                .replace("$n", str(n_rows))
+                .replace("$rate", str(rate)))
+
+    # 1. fault-free references (stall off, loose cadences)
+    assert chaos.installed() is None, "a fault plan is already installed"
+    want: Dict[str, List[str]] = {}
+    for tid in tenants:
+        ref_out = os.path.join(workdir, f"{tid}-ref.json")
+        _run_embedded(
+            tenant_sql(tid, ref_out), f"{tid}-ref", None, 1, 1,
+            max_restarts=0, heartbeat_interval=0.1, heartbeat_timeout=30.0,
+            checkpoint_interval=60.0, timeout=timeout,
+        )
+        want[tid] = canonicalize_output(ref_out, "", {})
+        if not want[tid]:
+            raise RuntimeError(
+                f"starvation drill: reference for {tid} had no output"
+            )
+
+    # 2. faulted run: both tenants, blocking stalls on the victim,
+    # heartbeat/checkpoint cadences squeezed around the stall width
+    fault_outs = {tid: os.path.join(workdir, f"{tid}-stall.json")
+                  for tid in tenants}
+    plan = chaos.install(plan_factory(seed))
+    sanitizer.reset()
+    sanitizer.enable()
+    error = None
+    restarts = 0
+
+    async def go():
+        c = await ControllerServer(
+            EmbeddedScheduler(), max_restarts=0
+        ).start()
+        try:
+            for tid in tenants:
+                await c.submit_job(
+                    tid, sql=tenant_sql(tid, fault_outs[tid]),
+                    storage_url=os.path.join(workdir, f"{tid}-ck"),
+                    n_workers=1, parallelism=1,
+                )
+            total = 0
+            for tid in tenants:
+                state = await c.wait_for_state(
+                    tid, JobState.FINISHED, JobState.FAILED, timeout=timeout,
+                )
+                job = c.jobs[tid]
+                if state != JobState.FINISHED:
+                    raise RuntimeError(
+                        f"starvation drill job {tid} failed: {job.failure}"
+                    )
+                total += job.restarts
+            return total
+        finally:
+            await c.stop()
+
+    try:
+        with update(
+            worker={"heartbeat_interval": 0.05},
+            controller={"heartbeat_timeout": 1.0},
+            pipeline={"checkpointing": {"interval": 0.25},
+                      "source_batch_size": 64},
+        ):
+            restarts = asyncio.run(go())
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+    finally:
+        chaos.clear()
+        sanitizer.disable()
+
+    conflicts = sanitizer.conflicts()
+    race_report = sanitizer.report()
+    got = {tid: canonicalize_output(fault_outs[tid], "", {})
+           for tid in tenants}
+    dup: Dict[str, List] = {}
+    for tid in tenants:
+        rows = read_rows(fault_outs[tid])
+        seen: Dict[tuple, int] = {}
+        for r in rows:
+            seen[(r.get("k"), r.get("start"))] = \
+                seen.get((r.get("k"), r.get("start")), 0) + 1
+        dup[tid] = sorted(k for k, n in seen.items() if n > 1)
+    diverged = [tid for tid in tenants if got[tid] != want[tid]]
+
+    if error is None and any(dup.values()):
+        error = ("a window was emitted twice without a restart: " +
+                 "; ".join(f"{tid}: {dup[tid]}" for tid in tenants
+                           if dup[tid]))
+    if error is None and diverged:
+        error = "output diverged from fault-free references: " + ", ".join(
+            f"{tid} ({len(got[tid])} rows vs {len(want[tid])})"
+            for tid in diverged
+        )
+    if error is None and restarts:
+        error = f"squeezed heartbeats tripped {restarts} restart(s)"
+    if error is None and plan.unfired():
+        error = f"unfired stalls: {[s.describe() for s in plan.unfired()]}"
+    if error is None and conflicts:
+        error = (f"sanitizer flagged {len(conflicts)} interleaving "
+                 f"conflict(s): {conflicts[0]['detail']}")
+    passed = error is None
+    if not passed:
+        # CI failure artifacts: the full access log + a Perfetto trace
+        sanitizer.dump(os.path.join(workdir, "race_access_log.json"))
+        sanitizer.dump_trace(os.path.join(workdir, "race_trace.json"))
+    return DrillResult(
+        query="starvation_double_emit",
+        seed=seed,
+        passed=passed,
+        rows=sum(len(v) for v in got.values()),
+        restarts=restarts,
+        fired=plan.fired_log(),
+        comparable_log=plan.comparable_log(),
+        expected_log=plan.expected_log(),
+        unfired=[s.describe() for s in plan.unfired()],
+        error=error,
+        extras={
+            "duplicate_windows": {tid: [list(k) for k in v]
+                                  for tid, v in dup.items()},
+            "tenant_rows": {tid: len(v) for tid, v in got.items()},
+            "sanitizer": {
+                "accesses": race_report["accesses"],
+                "epochs": race_report["epochs"],
+                "conflicts": conflicts,
+            },
         },
     )

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..analysis.races import guarded_by
+
 # -- fault-point registry ----------------------------------------------------
 
 # name -> (seam, effect description). Keep in sync with the chaos.fire()
@@ -102,7 +104,9 @@ FAULT_POINTS: Dict[str, str] = {
         "canonical freshness-SLO failure — watermark lag grows while "
         "the job stays RUNNING). Scope with match={'job': ...} to "
         "stall ONE tenant on a multiplexed worker; the sleep is async, "
-        "so co-resident jobs keep flowing"
+        "so co-resident jobs keep flowing. params.block=True instead "
+        "sleeps BLOCKING (a CPU-bound UDF that never yields), starving "
+        "the whole event loop — the starvation drill's seam"
     ),
     # checkpoint protocol (state/protocol.py)
     "protocol.fenced_zombie": (
@@ -169,6 +173,9 @@ class FaultSpec:
         return f"FaultSpec({self.point!r}, at_hits={self.at_hits})"
 
 
+# fire() appends from storage/executor threads while drill code reads
+# the log from the event loop — every touch goes through _lock (RACE003)
+@guarded_by("_lock", "fired_events")
 class FaultPlan:
     """A seeded, deterministic schedule of faults plus the log of what
     actually fired. Thread-safe: storage seams run under to_thread."""
@@ -263,6 +270,13 @@ class FaultPlan:
 
     # -- logs ---------------------------------------------------------------
 
+    def fired_log(self) -> List[Dict[str, Any]]:
+        """Locked snapshot of the raw fired-fault log. Readers must come
+        through here (or comparable_log): iterating `fired_events` bare
+        races the storage-thread seams appending mid-iteration."""
+        with self._lock:
+            return [dict(e) for e in self.fired_events]
+
     def comparable_log(self) -> List[Dict[str, Any]]:
         """The reproducible view of the fired-fault log: which specs fired,
         at which configured hit, with which parameters — sorted so
@@ -272,11 +286,16 @@ class FaultPlan:
             (
                 {"point": e["point"], "hit": e["hit"], "match": e["match"],
                  "params": e["params"]}
-                for e in self.fired_events
+                for e in self.fired_log()
             ),
             key=lambda e: (e["point"], e["hit"], json.dumps(e["match"],
                                                             sort_keys=True)),
         )
+
+    def unfired(self) -> List[FaultSpec]:
+        # spec counters advance under _lock in fire(); read them there too
+        with self._lock:
+            return [s for s in self.specs if s.fired < s.max_fires]
 
     def expected_log(self) -> List[Dict[str, Any]]:
         """What comparable_log() must equal when every spec fires to its
@@ -291,6 +310,3 @@ class FaultPlan:
             key=lambda e: (e["point"], e["hit"], json.dumps(e["match"],
                                                             sort_keys=True)),
         )
-
-    def unfired(self) -> List[FaultSpec]:
-        return [s for s in self.specs if s.fired < s.max_fires]

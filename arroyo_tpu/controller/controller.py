@@ -33,6 +33,8 @@ from typing import Dict, List, Optional
 
 from .. import chaos, obs
 from ..analysis.model.effects import protocol_effect
+from ..analysis.races import shared_state
+from ..analysis.races.sanitizer import set_task_root
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..state.backend import StateBackend
@@ -86,6 +88,7 @@ class TimerWheel:
             self._dirty.set()
 
     async def _loop(self):
+        set_task_root("timer-wheel")
         while True:
             now = time.monotonic()
             while self._heap and (self._heap[0][0] <= now
@@ -116,6 +119,11 @@ class NodeHandle:
         self.client = RpcClient(addr)
 
 
+# last_heartbeat is a mailbox: the heartbeat RPC handler stamps it, the
+# failover manager's monitor loop reads it, and recovery paths reset it —
+# last-writer-wins is the design (multi_writer), but RACE002 still
+# forbids restoring a stale copy across an await (PR 10's stampede bug)
+@shared_state("last_heartbeat", multi_writer=("last_heartbeat",))
 class WorkerHandle:
     def __init__(self, worker_id: int, rpc_addr: str, data_addr: str,
                  slots: int, pooled: bool = False):
@@ -131,6 +139,26 @@ class WorkerHandle:
         self.assigned: Dict[str, int] = {}
 
 
+# The job handle is the rendezvous of every control-plane task root: the
+# per-job drive loop, RPC handlers (stop/rescale/report arrivals), the
+# failover manager, the checkpoint flush chain, and the sharing manager
+# all mutate it between each other's awaits. Fields declared here are
+# what the RACE00x rules and the interleaving sanitizer police; the
+# multi_writer list is the explicit last-writer-wins policy (RACE001) —
+# it does NOT license stale read-modify-write across awaits (RACE002).
+@shared_state(
+    "stop_requested", "failure", "pending_epochs", "finished_tasks",
+    "undrained_sources", "published_epoch", "leader_resigned",
+    "rescale_requested", "checkpoint_asap",
+    # finished_tasks / undrained_sources / published_epoch are mutated
+    # both by the drive task and by RPC report handlers ("main" root) by
+    # design: set/dict ops are atomic between yields and published_epoch
+    # only moves via monotonic max-merge.
+    multi_writer=("stop_requested", "failure", "leader_resigned",
+                  "rescale_requested", "checkpoint_asap",
+                  "finished_tasks", "undrained_sources",
+                  "published_epoch"),
+)
 class JobHandle:
     def __init__(self, job_id: str, graph: LogicalGraph,
                  storage_url: Optional[str], sql: Optional[str] = None,
@@ -248,6 +276,12 @@ class JobHandle:
         self.kick()  # state watchers (wait_for_state) park on the job
 
 
+# registration waiters and the benched-worker registry are touched by
+# the registration RPC handler, release paths inside per-job drive
+# loops, and TimerWheel deadline kicks; individual dict/set ops are
+# atomic between yields, so multi_writer is the declared policy
+@shared_state("_benched", "_reg_waiters",
+              multi_writer=("_benched", "_reg_waiters"))
 class ControllerServer:
     def __init__(self, scheduler: Optional[Scheduler] = None,
                  bind: str = "127.0.0.1", max_restarts: int = 3):
@@ -490,7 +524,9 @@ class ControllerServer:
     async def _heartbeat(self, req: dict) -> dict:
         w = self.workers.get(req["worker_id"])
         if w is not None:
-            w.last_heartbeat = time.monotonic()
+            # monotonic merge: _worker_call's liveness refresh races this
+            # from the drive roots; a max keeps the newest evidence
+            w.last_heartbeat = max(w.last_heartbeat, time.monotonic())
         # `known=False` tells a live worker it was pruned (a loop stall
         # can age heartbeats past the timeout and a recovery then drops
         # the handle); the worker re-registers and the registry
@@ -667,7 +703,8 @@ class ControllerServer:
         stampede every co-scheduled job into recovery at once."""
         resp = await w.client.call(service, method, payload,
                                    timeout=timeout)
-        w.last_heartbeat = time.monotonic()
+        # monotonic merge (see _heartbeat): never regress fresher evidence
+        w.last_heartbeat = max(w.last_heartbeat, time.monotonic())
         return resp
 
     def _pool_mode(self) -> bool:
@@ -792,6 +829,7 @@ class ControllerServer:
     # -- state machine driver ----------------------------------------------
 
     async def _drive_job(self, job: JobHandle, n_workers: int):
+        set_task_root(f"drive:{job.job_id}")
         try:
             while not job.state.is_terminal():
                 if job.state == JobState.CREATED:
@@ -1045,7 +1083,9 @@ class ControllerServer:
                 if await self._failover_promote(job):
                     last_checkpoint = time.monotonic()
                     continue
-                job.failure = "worker heartbeat timeout"
+                # the promote attempt awaited: a real task failure
+                # arriving meanwhile is the better diagnosis — keep it
+                job.failure = job.failure or "worker heartbeat timeout"
                 job.transition(JobState.RECOVERING)
                 return
             if job.rescale_requested and not job.stop_requested:
@@ -1065,7 +1105,10 @@ class ControllerServer:
                     job.transition(JobState.CHECKPOINT_STOPPING)
                     await self._drain_pending_epochs(job)
                     if job.failure is not None:
-                        job.stop_requested = mode
+                        # re-arm the stop, but never clobber a stop mode
+                        # that arrived while the drain was awaiting: the
+                        # newer request wins (RACE002: `mode` is stale)
+                        job.stop_requested = job.stop_requested or mode
                         job.transition(JobState.RECOVERING)
                         return
                     if leader_mode and not job.leader_resigned:
@@ -1109,7 +1152,8 @@ class ControllerServer:
                         # the stopping checkpoint could not publish
                         # (storage fault / fencing): don't pretend the
                         # state is durable — recover and retry the stop
-                        job.stop_requested = mode
+                        # (a stop requested during the await wins)
+                        job.stop_requested = job.stop_requested or mode
                         job.transition(JobState.RECOVERING)
                         return
                     await self._await_all_finished(job)
@@ -1124,7 +1168,7 @@ class ControllerServer:
                         # the stop instead of stopping over stranded state.
                         job.failure = (job.failure
                                        or "worker died finishing the stop")
-                        job.stop_requested = mode
+                        job.stop_requested = job.stop_requested or mode
                         job.transition(JobState.RECOVERING)
                         return
                     await self._release_job(job, expunge=True)
@@ -1312,7 +1356,10 @@ class ControllerServer:
                         "chaos[rescale.reschedule_fail]: job %s failing "
                         "before the post-rescale schedule", job.job_id,
                     )
-                    job.failure = "chaos: rescale reschedule failure"
+                    # drain awaited above: don't clobber a real
+                    # failure that landed during it
+                    job.failure = (job.failure
+                                   or "chaos: rescale reschedule failure")
                     job.transition(JobState.RECOVERING)
                     return
                 if self._pool_mode() and any(w.pooled for w in job.workers):
@@ -1443,7 +1490,17 @@ class ControllerServer:
         except Exception as e:  # noqa: BLE001
             logger.warning("job %s overlap promote failed: %r",
                            job.job_id, e)
-            job.failure = f"overlap promote failed: {e!r}"
+            job.failure = job.failure or f"overlap promote failed: {e!r}"
+            return False
+        if job.failure is not None:
+            # a task failure landed WHILE the promote RPCs were awaiting
+            # (e.g. a new-generation worker died mid-promote). The
+            # pre-drain check above read job.failure before those awaits;
+            # clearing it blindly below would mask the failure and serve
+            # a half-promoted generation — re-read and route to recovery
+            # (RACE002: revalidate after the last await)
+            logger.warning("job %s failed during overlap promote: %s",
+                           job.job_id, job.failure)
             return False
         job.workers = new_workers
         job.assignments = assignments

@@ -26,6 +26,8 @@ import time
 from typing import Dict, Optional
 
 from .. import chaos, obs
+from ..analysis.races import shared_state
+from ..analysis.races.sanitizer import set_task_root
 from ..config import config
 from ..graph.logical import LogicalGraph
 from ..operators.control import (
@@ -47,6 +49,20 @@ from .rpc import RpcClient, RpcServer
 logger = get_logger("worker")
 
 
+# the runtime namespace is shared between the response pump, the
+# leader cadence loop, RPC handlers (stage/tail/promote/stop), and
+# teardown; the multi_writer entries are counters/latches whose
+# individual updates are atomic between yields — RACE002 still polices
+# stale read-modify-write across awaits on all of them
+@shared_state(
+    "lead_active", "leader_reports", "leader_epoch", "leader_published",
+    "leader_durable", "standby_epoch", "torn_down", "resigned",
+    # leader_epoch is written by the lead loop's checkpoint cadence and
+    # by StartExecution's restore ("main" root) by design: the restore
+    # happens before the lead loop is spawned for that generation.
+    multi_writer=("lead_active", "leader_reports", "leader_published",
+                  "leader_durable", "torn_down", "leader_epoch"),
+)
 class _JobRuntime:
     """One job's execution namespace inside a (possibly multiplexed)
     worker: the physical program, its runner tasks and response pump,
@@ -100,6 +116,10 @@ class _JobRuntime:
         self.resigned = False
 
 
+# staged incarnations are installed by the StageJob RPC, tailed by
+# TailStaged, consumed by promote/stop/teardown paths running under
+# other roots; dict ops are atomic between yields (multi_writer)
+@shared_state("_staged", multi_writer=("_staged",))
 class WorkerServer:
     def __init__(self, controller_addr: str, worker_id: Optional[int] = None,
                  bind: str = "127.0.0.1", pooled: bool = False):
@@ -219,6 +239,7 @@ class WorkerServer:
         return self
 
     async def _heartbeat(self):
+        set_task_root("worker-heartbeat")
         while not self._finished.is_set():
             if chaos.fire("worker.kill", worker_id=self.worker_id):
                 # SIGKILL-equivalent: tear everything down abruptly, no
@@ -431,7 +452,10 @@ class WorkerServer:
                 tm = getattr(ctx, "table_manager", None)
                 if tm is not None and tm.tables:
                     applied += await asyncio.to_thread(tm.tail_chains)
-        jr.standby_epoch = epoch
+        # concurrent tails (a TailStaged RPC racing a promote's final
+        # tail) both pass the entry guard during the to_thread awaits: a
+        # slower, older tail must not regress the high-water mark
+        jr.standby_epoch = max(jr.standby_epoch, epoch)
         return applied
 
     async def start_processing(self, req: dict) -> dict:
@@ -695,6 +719,7 @@ class WorkerServer:
         return self._peer_clients[wid]
 
     async def _lead_loop(self, jr: _JobRuntime):
+        set_task_root(f"lead:{jr.job_id}")
         try:
             while not jr.finished.is_set():
                 await asyncio.sleep(jr.lead_interval)
@@ -839,6 +864,7 @@ class WorkerServer:
     # -- task event forwarding ---------------------------------------------
 
     async def _pump_responses(self, jr: _JobRuntime):
+        set_task_root(f"pump:{jr.job_id}")
         q = jr.program.control_resp
         while jr.n_running > 0:
             resp = await q.get()

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from .. import obs
 from ..analysis.model.effects import protocol_effect
+from ..analysis.races.sanitizer import set_task_root
 from ..config import config
 from ..state.backend import StateBackend
 from ..utils.logging import get_logger
@@ -127,6 +128,7 @@ class StandbyManager:
 
     async def _arm_guard(self, job):
         jid = job.job_id
+        set_task_root(f"failover-arm:{jid}")
         try:
             await self._arm(job)
         except Exception as e:  # noqa: BLE001 - arming is best-effort
@@ -210,6 +212,7 @@ class StandbyManager:
 
     async def _tail_guard(self, job):
         jid = job.job_id
+        set_task_root(f"failover-tail:{jid}")
         try:
             while True:
                 sb = self._standbys.get(jid)

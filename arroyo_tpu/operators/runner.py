@@ -27,6 +27,8 @@ import pyarrow as pa
 
 from .. import chaos, obs
 from ..analysis.model.effects import protocol_effect
+from ..analysis.races import shared_state
+from ..analysis.races.sanitizer import set_task_root
 from ..config import config
 from ..metrics import (
     BARRIER_ALIGNMENT_SECONDS,
@@ -85,6 +87,16 @@ class ChainCollector:
             await r.tail.collect(batch)
 
 
+# runner state is shared between the main select loop, the pipelined
+# flush tasks it spawns (which set _flush_failed), and stop/commit
+# control arrivals; the pipelined-flush bookkeeping is the hottest
+# read-modify-write-across-await surface in the tree (ROADMAP item 4)
+@shared_state(
+    "_await_commit_epoch", "_inflight_flushes", "_flush_failed",
+    "_flush_hwm", "_stopping", "_current_barrier", "_barrier_inputs",
+    "_finish_kinds", "_last_flush",
+    multi_writer=("_flush_failed", "_stopping"),
+)
 class SubtaskRunner:
     """Executes one subtask: a chain of operators with shared inputs/outputs."""
 
@@ -190,6 +202,7 @@ class SubtaskRunner:
         # to_thread storage work, device dispatches) inherits it, so cost
         # on a multiplexed worker rolls up to the right tenant
         obs.attribution.set_job(self.task_info.job_id)
+        set_task_root(f"runner:{self.task_info.task_id}")
         try:
             if self.standby_gate is not None:
                 # hot-standby arm (ISSUE 17): pay the storage restore NOW,
@@ -493,7 +506,13 @@ class SubtaskRunner:
             # subtask stalls; co-resident tenants keep their turns on the
             # shared loop) while upstream queues back up and the
             # watermark falls behind — the freshness-SLO drill's seam
-            await asyncio.sleep(float(spec.param("delay", 0.5)))
+            if spec.param("block", False):
+                # params.block: a CPU-bound/blocking UDF that never yields
+                # — starves the WHOLE event loop (heartbeats, co-tenants),
+                # the starvation drill's attack on squeezed deadlines
+                time.sleep(float(spec.param("delay", 0.5)))  # arroyolint: disable=ASY002
+            else:
+                await asyncio.sleep(float(spec.param("delay", 0.5)))
         iq = self.inputs[i]
         if isinstance(item, SignalMessage):
             if item.kind == SignalKind.WATERMARK:
@@ -654,8 +673,12 @@ class SubtaskRunner:
         self._align_span.finish()
         self._align_span = obs.NULL_SPAN
         await self._checkpoint_chain(barrier)
-        self._current_barrier = None
-        self._barrier_inputs.clear()
+        # clear only the barrier we just processed: alignment state is
+        # select-loop-confined today, and the guard keeps that true even
+        # if a future path re-arms a new epoch under the chain's awaits
+        if self._current_barrier is barrier:
+            self._current_barrier = None
+            self._barrier_inputs.clear()
         # unblocking + re-arming happens in the main loop
 
     @protocol_effect("worker.capture")
@@ -780,6 +803,7 @@ class SubtaskRunner:
     async def _flush_and_report(self, barrier, captured, commit_data,
                                 watermark, flush_span=obs.NULL_SPAN,
                                 prev: Optional[asyncio.Task] = None):
+        set_task_root(f"flush:{self.task_info.task_id}")
         if prev is not None and not prev.done():
             await asyncio.wait({prev})
         if self._flush_failed:
@@ -806,7 +830,10 @@ class SubtaskRunner:
                 "checkpoint flush failed for %s epoch %s",
                 self.task_info.task_id, barrier.epoch,
             )
-            self._flush_failed = True
+            # monotonic latch: True is the only post-init value, so a
+            # concurrent setter is idempotent and the stale entry guard
+            # only ever skips work already doomed
+            self._flush_failed = True  # arroyolint: disable=RACE002
             flush_span.set(error=traceback.format_exc(limit=3)[:300])
             self.control_tx.put_nowait(
                 TaskFailedResp(

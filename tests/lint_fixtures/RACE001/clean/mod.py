@@ -1,0 +1,30 @@
+"""Must NOT fire RACE001: both escape hatches. `counter` is written from
+two roots but always under the same lock; `epoch` is written from two
+roots but declares ``multi_writer`` — an explicit, reviewable policy."""
+import asyncio
+
+from arroyo_tpu.analysis.races import shared_state
+
+
+@shared_state("counter", "epoch", multi_writer=("epoch",))
+class Job:
+    def __init__(self):
+        self.counter = 0
+        self.epoch = 0
+        self._lock = None
+
+
+class Engine:
+    async def drive(self, job):
+        with job._lock:
+            job.counter = 1
+        job.epoch = 1
+
+    async def checkpoint(self, job):
+        with job._lock:
+            job.counter = 2
+        job.epoch = 2
+
+    def start(self, job):
+        asyncio.ensure_future(self.drive(job))
+        asyncio.ensure_future(self.checkpoint(job))

@@ -1,0 +1,24 @@
+"""MUST fire RACE001: `counter` is written from two task-spawn roots
+(`drive` and `checkpoint`) with no common lock and is not declared
+``multi_writer`` — last-writer-wins here is an accident, not a policy."""
+import asyncio
+
+from arroyo_tpu.analysis.races import shared_state
+
+
+@shared_state("counter")
+class Job:
+    def __init__(self):
+        self.counter = 0
+
+
+class Engine:
+    async def drive(self, job):
+        job.counter = 1
+
+    async def checkpoint(self, job):
+        job.counter = 2
+
+    def start(self, job):
+        asyncio.ensure_future(self.drive(job))
+        asyncio.ensure_future(self.checkpoint(job))

@@ -1,0 +1,33 @@
+"""Must NOT fire RACE002: every post-await write revalidates by
+re-reading the field fresh in its own RHS — the or-restore (newer value
+wins), the fresh-read increment, and the monotonic max-merge."""
+import asyncio
+
+from arroyo_tpu.analysis.races import shared_state
+
+
+@shared_state("stop_requested", "counter",
+              multi_writer=("stop_requested", "counter"))
+class Job:
+    def __init__(self):
+        self.stop_requested = None
+        self.counter = 0
+
+
+class Engine:
+    async def drive(self, job):
+        mode = job.stop_requested
+        job.stop_requested = None
+        await self.checkpoint(job)
+        job.stop_requested = job.stop_requested or mode
+
+    async def bump(self, job):
+        await asyncio.sleep(0)
+        job.counter = job.counter + 1
+
+    async def raise_hwm(self, job, epoch):
+        await asyncio.sleep(0)
+        job.counter = max(job.counter, epoch)
+
+    async def checkpoint(self, job):
+        await asyncio.sleep(0)

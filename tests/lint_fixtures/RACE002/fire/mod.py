@@ -1,0 +1,31 @@
+"""MUST fire RACE002 (both patterns): `drive` writes back a value read
+before an await (stale-local write-back — PR 9's stop-path bug shape);
+`bump` computes from a pre-await read (read-modify-write spanning a
+yield). ``multi_writer`` is declared and does NOT waive either."""
+import asyncio
+
+from arroyo_tpu.analysis.races import shared_state
+
+
+@shared_state("stop_requested", "counter",
+              multi_writer=("stop_requested", "counter"))
+class Job:
+    def __init__(self):
+        self.stop_requested = None
+        self.counter = 0
+
+
+class Engine:
+    async def drive(self, job):
+        mode = job.stop_requested
+        job.stop_requested = None
+        await self.checkpoint(job)
+        job.stop_requested = mode  # clobbers anything set during the await
+
+    async def bump(self, job):
+        c = job.counter
+        await asyncio.sleep(0)
+        job.counter = c + 1  # increment computed from a stale snapshot
+
+    async def checkpoint(self, job):
+        await asyncio.sleep(0)

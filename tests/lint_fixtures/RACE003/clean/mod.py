@@ -1,0 +1,29 @@
+"""Must NOT fire RACE003: every access to the guarded field happens with
+`_lock` held (directly or in a callee whose every call site holds it);
+constructor initialization is exempt."""
+from arroyo_tpu.analysis.races import guarded_by
+
+
+@guarded_by("_lock", "fired")
+class Plan:
+    def __init__(self):
+        self.fired = []
+        self._lock = None
+
+
+class Driver:
+    def touch(self, plan):
+        with plan._lock:
+            plan.fired.append(1)
+
+    def drain(self, plan):
+        with plan._lock:
+            self._drain_locked(plan)
+
+    def _drain_locked(self, plan):
+        # entry lockset: every caller holds _lock
+        plan.fired.clear()
+
+    def peek(self, plan):
+        with plan._lock:
+            return len(plan.fired)

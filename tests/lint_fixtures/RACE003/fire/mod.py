@@ -1,0 +1,21 @@
+"""MUST fire RACE003: `fired` is declared ``guarded_by("_lock")`` but is
+mutated (append, clear) and read without the lock held."""
+from arroyo_tpu.analysis.races import guarded_by
+
+
+@guarded_by("_lock", "fired")
+class Plan:
+    def __init__(self):
+        self.fired = []
+        self._lock = None
+
+
+class Driver:
+    def touch(self, plan):
+        plan.fired.append(1)
+
+    def drain(self, plan):
+        plan.fired.clear()
+
+    def peek(self, plan):
+        return len(plan.fired)

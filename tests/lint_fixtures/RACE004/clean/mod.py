@@ -1,0 +1,30 @@
+"""Must NOT fire RACE004: the only root that awaits under `_lock` is
+also the only root that writes its guarded field — no concurrent writer
+is shut out; the reader root releases the lock before awaiting."""
+import asyncio
+
+from arroyo_tpu.analysis.races import guarded_by
+
+
+@guarded_by("_lock", "fired")
+class Plan:
+    def __init__(self):
+        self.fired = []
+        self._lock = None
+
+
+class Driver:
+    async def hold(self, plan):
+        with plan._lock:
+            await asyncio.sleep(0)
+            plan.fired.append(1)
+
+    async def reader(self, plan):
+        with plan._lock:
+            n = len(plan.fired)
+        await asyncio.sleep(0)
+        return n
+
+    def start(self, plan):
+        asyncio.ensure_future(self.hold(plan))
+        asyncio.ensure_future(self.reader(plan))

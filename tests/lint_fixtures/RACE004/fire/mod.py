@@ -1,0 +1,28 @@
+"""MUST fire RACE004: `hold` awaits while holding `_lock`, and a
+concurrent task root (`mutate`) writes a field that lock guards — the
+await window invites lock-ordering stalls and convoying on state the
+holder believes is frozen."""
+import asyncio
+
+from arroyo_tpu.analysis.races import guarded_by
+
+
+@guarded_by("_lock", "fired")
+class Plan:
+    def __init__(self):
+        self.fired = []
+        self._lock = None
+
+
+class Driver:
+    async def hold(self, plan):
+        with plan._lock:
+            await asyncio.sleep(0)
+
+    async def mutate(self, plan):
+        with plan._lock:
+            plan.fired.append(1)
+
+    def start(self, plan):
+        asyncio.ensure_future(self.hold(plan))
+        asyncio.ensure_future(self.mutate(plan))

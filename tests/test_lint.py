@@ -33,6 +33,14 @@ def test_registry_size():
     assert len(RULE_IDS) == len(set(RULE_IDS))
 
 
+def test_race_family_registered():
+    # ISSUE 18: the RACE family must stay registered — if its rule-module
+    # import were dropped, the parametrized fixture tests would silently
+    # shrink instead of failing
+    for rid in ("RACE001", "RACE002", "RACE003", "RACE004"):
+        assert rid in RULE_IDS, f"{rid} not registered"
+
+
 def test_every_rule_has_fixture_pair():
     # meta-test: a rule without fixtures is an unproven rule
     for rule in all_rules():

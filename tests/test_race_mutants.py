@@ -1,0 +1,163 @@
+"""Mutation harness: reintroduce three historical interleaving bugs and
+prove the race tooling catches each one (ISSUE 18 acceptance).
+
+1. PR 9's stop-without-durable-state hole — the controller's stop-path
+   restore wrote a pre-await snapshot of `job.stop_requested` back after
+   checkpoint awaits, destroying any stop mode requested meanwhile. The
+   mutant reverts today's revalidating or-restore in a copy of the REAL
+   controller.py; RACE002 must fire on the mutant and stay quiet on the
+   unmutated file.
+
+2. PR 10's pre-stampede heartbeat path — a heartbeat restore wrote a
+   stale timestamp over fresher liveness evidence. Replayed as a live
+   two-task scenario under the dynamic sanitizer: the stale restore must
+   flag a lost-update, the monotonic max-merge (today's idiom at
+   controller._heartbeat/_worker_call) must run clean.
+
+3. An injected await-spanning read-modify-write in a copy of the REAL
+   operators/runner.py (`hwm = self._flush_hwm; await ...;
+   self._flush_hwm = hwm + 1`); RACE002 must fire on the mutant and stay
+   quiet on the unmutated file.
+
+Static mutants lint a single-file copy of the real source, so these
+tests also pin that the production files are RACE002-clean standalone.
+"""
+
+import asyncio
+from pathlib import Path
+
+import pytest
+
+from arroyo_tpu.analysis import get_rule, run_lint
+from arroyo_tpu.analysis.races import sanitizer, shared_state
+
+REPO = Path(__file__).resolve().parents[1]
+
+STOP_RESTORE_FIXED = "job.stop_requested = job.stop_requested or mode"
+STOP_RESTORE_BUGGY = "job.stop_requested = mode"
+
+FLUSH_ANCHOR = 'set_task_root(f"flush:{self.task_info.task_id}")'
+FLUSH_RMW = (
+    FLUSH_ANCHOR
+    + "\n        hwm = self._flush_hwm"
+    + "\n        await asyncio.sleep(0)"
+    + "\n        self._flush_hwm = hwm + 1"
+)
+
+
+def _race002(tmp_path: Path, source: str):
+    (tmp_path / "mod.py").write_text(source)
+    res = run_lint(tmp_path, rules=[get_rule("RACE002")], roots=(".",))
+    assert not res.errors, res.errors
+    return res.findings
+
+
+# -- mutant 1: PR 9 stop-restore clobber (static catch) ----------------------
+
+
+def test_stop_restore_revert_caught_by_race002(tmp_path):
+    src = (REPO / "arroyo_tpu" / "controller" / "controller.py").read_text()
+    assert src.count(STOP_RESTORE_FIXED) == 3, (
+        "stop-restore or-idiom sites moved; update this mutant"
+    )
+    assert not _race002(tmp_path, src), (
+        "real controller.py is not RACE002-clean standalone"
+    )
+    mutant = src.replace(STOP_RESTORE_FIXED, STOP_RESTORE_BUGGY)
+    findings = _race002(tmp_path, mutant)
+    assert len(findings) >= 3, findings
+    assert all("stop_requested" in f.message for f in findings)
+
+
+# -- mutant 2: PR 10 stale heartbeat restore (dynamic catch) -----------------
+
+
+@shared_state("last_heartbeat", multi_writer=("last_heartbeat",))
+class _Worker:
+    def __init__(self):
+        self.last_heartbeat = 0.0
+
+
+def _heartbeat_scenario(restore):
+    """Drive root snapshots the heartbeat, the RPC root refreshes it
+    during the drive root's await, then `restore` writes it back."""
+
+    async def go():
+        w = _Worker()
+        seen, done = asyncio.Event(), asyncio.Event()
+
+        async def drive():
+            sanitizer.set_task_root("drive")
+            stale = w.last_heartbeat
+            seen.set()
+            await done.wait()
+            restore(w, stale)
+
+        async def rpc():
+            sanitizer.set_task_root("main")
+            await seen.wait()
+            w.last_heartbeat = 100.0  # fresher evidence lands mid-await
+            done.set()
+
+        await asyncio.gather(asyncio.create_task(drive()),
+                             asyncio.create_task(rpc()))
+        return w
+
+    sanitizer.enable()
+    sanitizer.reset()
+    try:
+        w = asyncio.run(go())
+        return w, sanitizer.conflicts()
+    finally:
+        sanitizer.disable()
+
+
+def test_stale_heartbeat_restore_caught_by_sanitizer():
+    def buggy(w, stale):
+        w.last_heartbeat = stale  # PR 10's shape: destroys the refresh
+
+    w, conflicts = _heartbeat_scenario(buggy)
+    assert w.last_heartbeat == 0.0  # the refresh really was destroyed
+    assert [c["kind"] for c in conflicts] == ["lost-update"], conflicts
+    assert conflicts[0]["field"] == "last_heartbeat"
+
+
+def test_monotonic_heartbeat_merge_is_clean():
+    def fixed(w, stale):
+        w.last_heartbeat = max(w.last_heartbeat, stale)
+
+    w, conflicts = _heartbeat_scenario(fixed)
+    assert w.last_heartbeat == 100.0  # newest evidence survives
+    assert conflicts == [], conflicts
+
+
+# -- mutant 3: injected await-spanning RMW in the runner (static catch) ------
+
+
+def test_injected_runner_rmw_caught_by_race002(tmp_path):
+    src = (REPO / "arroyo_tpu" / "operators" / "runner.py").read_text()
+    assert FLUSH_ANCHOR in src, (
+        "flush task-root anchor moved; update this mutant"
+    )
+    assert not _race002(tmp_path, src), (
+        "real runner.py is not RACE002-clean standalone"
+    )
+    mutant = src.replace(FLUSH_ANCHOR, FLUSH_RMW, 1)
+    findings = _race002(tmp_path, mutant)
+    assert len(findings) == 1, findings
+    assert "_flush_hwm" in findings[0].message
+
+
+# -- the suppressions the mutants must not hide behind -----------------------
+
+
+@pytest.mark.parametrize("path, expected", [
+    ("arroyo_tpu/operators/runner.py", 1),
+    ("arroyo_tpu/controller/controller.py", 0),
+    ("arroyo_tpu/engine/worker.py", 0),
+])
+def test_inline_race_suppression_budget(path, expected):
+    """Inline RACE suppressions are justified one-offs, not a release
+    valve: new ones need the same scrutiny these tests encode."""
+    text = (REPO / path).read_text()
+    assert text.count("arroyolint: disable=RACE") == expected, path

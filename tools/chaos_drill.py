@@ -70,6 +70,17 @@
         tools/model_check.py --shared --trace-dir) replays against the
         shared fleet instead of a golden query.
 
+    python tools/chaos_drill.py --starvation
+        ROADMAP double-emit watch item: blocking `runner.stall` hits
+        (params.block — a UDF that never yields) wedge one tenant's
+        input loop and starve the shared event loop while heartbeat and
+        checkpoint cadences are squeezed around the stall width, with
+        the interleaving sanitizer (ARROYO_RACE_SANITIZER machinery)
+        recording every shared-state access. Requires byte-identical
+        output for BOTH tenants, no (key, window) row emitted twice,
+        zero restarts, and a sanitizer-clean log; on failure the access
+        log + Perfetto trace land in the workdir.
+
     python tools/chaos_drill.py --pipeline
         ISSUE 14 acceptance: a stateless chain fused into ONE segment
         with the two-deep staging pipeline on, worker SIGKILL lands
@@ -133,6 +144,18 @@ def main() -> int:
                     "the standby-also-dies cold-restore fallback (with "
                     "--plan: replay the counterexample against the "
                     "armed fleet)")
+    ap.add_argument("--starvation", action="store_true",
+                    help="also run the event-loop starvation drill: "
+                    "blocking runner.stall hits on one tenant under "
+                    "squeezed heartbeat/checkpoint cadences with the "
+                    "race sanitizer recording shared-state accesses; "
+                    "requires byte-identical output, no duplicated "
+                    "(key, window) row, zero restarts, and a "
+                    "sanitizer-clean interleaving log (ROADMAP "
+                    "double-emit watch item)")
+    ap.add_argument("--no-golden", action="store_true",
+                    help="skip the golden-query drills; run only the "
+                    "specialty drills selected by the other flags")
     ap.add_argument("--plan", type=str, default="",
                     help="run the drill under a serialized FaultPlan JSON "
                     "(bare plan or a model-check counterexample payload "
@@ -178,8 +201,8 @@ def main() -> int:
         )
         plan_factory = d.standard_plan
 
-    results = d.run_drills(queries, args.seed, workdir,
-                           plan_factory=plan_factory)
+    results = [] if args.no_golden else d.run_drills(
+        queries, args.seed, workdir, plan_factory=plan_factory)
     if args.kafka:
         results.append(
             d.run_kafka_drill(args.seed, os.path.join(workdir, "kafka"))
@@ -212,6 +235,12 @@ def main() -> int:
         results.append(
             d.run_failover_drill(
                 args.seed, os.path.join(workdir, "failover"), **fo_kw
+            )
+        )
+    if args.starvation:
+        results.append(
+            d.run_starvation_drill(
+                args.seed, os.path.join(workdir, "starvation")
             )
         )
 

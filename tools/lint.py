@@ -9,6 +9,8 @@ Usage:
     python tools/lint.py --json           # machine-readable findings
     python tools/lint.py --list-rules     # registered rules + descriptions
     python tools/lint.py --config-table   # resolved config key/default table
+    python tools/lint.py --call-graph     # RACE rules' async call graph as
+                                          #   JSON (roots, locksets, accesses)
     python tools/lint.py --update-baseline  # grandfather current findings
                                             # (each entry then needs a
                                             #  human-written justification)
@@ -69,6 +71,10 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--config-table", action="store_true",
                     help="print the declared config key/default table")
+    ap.add_argument("--call-graph", action="store_true",
+                    help="dump the async call graph the RACE rules analyze "
+                         "as JSON: task roots -> reachable functions -> "
+                         "shared-field accesses with locksets")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -90,6 +96,18 @@ def main(argv=None) -> int:
         for key, default in table:
             print(f"{key:<{width}}  {default}")
         print(f"{len(table)} declared config keys")
+        return 0
+
+    if args.call_graph:
+        import json as _json
+
+        from arroyo_tpu.analysis.engine import collect_files, parse_project
+        from arroyo_tpu.analysis.races import callgraph
+
+        project = parse_project(root, collect_files(root, roots))
+        _json.dump(callgraph.build(project).to_debug_json(), sys.stdout,
+                   indent=1, sort_keys=True)
+        print()
         return 0
 
     rules = None

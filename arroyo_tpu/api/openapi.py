@@ -92,6 +92,11 @@ ROUTES: List[Route] = [
      "rate / delta / quantiles per series (?series= narrows to one "
      "family, ?window= seconds of lookback)", "jobs", None,
      "MetricHistory"),
+    ("get", "/jobs/{job_id}/audit", "job_audit",
+     "Conservation ledger of a job: per-edge epoch attestations "
+     "(sender/receiver row counts + order-insensitive digests), flow "
+     "checks and every recorded exactly-once breach", "jobs", None,
+     "AuditReport"),
     ("get", "/jobs/{job_id}/bundles", "job_bundles",
      "Diagnostic bundles captured for the job's SLO breaches (doctor "
      "verdict + flight recording + Perfetto timeline + metric-history "
@@ -470,6 +475,23 @@ def _schemas() -> Dict[str, Any]:
              "history": {"type": "array", "items": _ref("MetricSeries")},
              "ledger": {"type": "array", "items": {"type": "object"}}},
             ["n", "job", "rule"],
+        ),
+        # Conservation ledger (obs/audit.py)
+        "AuditBreach": _obj(
+            {"job": _str(), "kind": _str(), "edge": _str(),
+             "epoch": _int(), "detail": _str(), "ts": {"type": "number"}},
+            ["job", "kind", "edge", "epoch"],
+        ),
+        "AuditReport": _obj(
+            {"job": _str(),
+             "incarnation": {**_int(), "nullable": True},
+             "epochs_reconciled": _int(), "edges_verified": _int(),
+             "rows_attested": _int(),
+             "last_epoch": {**_int(), "nullable": True},
+             "breach_count": _int(),
+             "breaches": {"type": "array", "items": _ref("AuditBreach")},
+             "edges": {"type": "object"}},
+            ["job"],
         ),
         "ErrorResp": _obj({"error": _str()}, ["error"]),
     }

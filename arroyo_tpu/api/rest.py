@@ -446,6 +446,18 @@ class ApiServer:
             "series": hist.export_job(jid, window=window, series=series),
         })
 
+    async def job_audit(self, request: web.Request):
+        """Conservation ledger for one job: per-edge epoch attestations
+        (sender/receiver counts + digests), flow-check results and every
+        recorded exactly-once breach (obs/audit.py)."""
+        from ..obs import audit
+
+        jid = request.match_info["job_id"]
+        if (self.controller is not None and jid not in self.controller.jobs
+                and audit.peek(jid) is None):
+            return error(404, "job not found")
+        return json_response(audit.status(jid))
+
     async def job_bundles(self, request: web.Request):
         """Diagnostic bundles captured for the job's SLO breaches:
         the bounded-spool index (download one via .../bundles/{n})."""

@@ -175,9 +175,40 @@ class DrillResult:
     error: Optional[str] = None
     # drill-specific measurements (e.g. the state-bloat flatness stats)
     extras: Optional[dict] = None
+    # conservation-ledger breaches recorded DURING the drill (obs/audit.py):
+    # auditing is on by default and every drill asserts audit SILENCE, so
+    # this must be empty for passed=True
+    audit_breaches: List[dict] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _audit_mark() -> int:
+    """Snapshot the conservation-ledger breach ring before a drill run.
+    The ring survives job expunge precisely so this assertion works after
+    the embedded controller tears the drill jobs down."""
+    from ..obs import audit
+
+    return audit.breach_mark()
+
+
+def _audit_verdict(mark: int, passed: bool, error: Optional[str]):
+    """Fold conservation breaches recorded since `mark` into the drill
+    verdict: a single breach fails the drill even when the sink output
+    is byte-identical — silent corruption is exactly what the ledger
+    exists to catch."""
+    from ..obs import audit
+
+    breaches = audit.breaches_since(mark)
+    if breaches and error is None:
+        b = breaches[0]
+        error = (
+            f"{len(breaches)} conservation breach(es); first: "
+            f"[{b['kind']}] edge={b['edge']} epoch={b['epoch']}: "
+            f"{b['detail']}"
+        )
+    return passed and not breaches, error, breaches
 
 
 def _run_embedded(sql: str, job_id: str, storage_url: Optional[str],
@@ -249,6 +280,7 @@ def run_drill(
     headers = query_headers(query_path)
     register_query_udfs(headers, golden_dir)
     os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
 
     # 1. fault-free reference through the same embedded cluster, on the
     # UNFUSED data plane (segment fusion off)
@@ -307,6 +339,7 @@ def run_drill(
         )
     if error is None and plan.unfired():
         error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query=query_name,
         seed=seed,
@@ -318,6 +351,7 @@ def run_drill(
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
         error=error,
+        audit_breaches=audit_breaches,
     )
 
 
@@ -485,6 +519,10 @@ def run_rescale_drill(seed: int, workdir: str,
     headers = query_headers(query_path)
     register_query_udfs(headers, golden_dir)
     os.makedirs(workdir, exist_ok=True)
+    # explicit audit-silence assertion (ISSUE 19): the rescale drill's
+    # generation-overlap window is exactly where rewind/zombie classes
+    # would surface — a breach here fails the drill outright
+    audit_mark = _audit_mark()
 
     clean_out = os.path.join(workdir, f"{query_name}-clean.json")
     clean_sql = load_query(query_path, clean_out, golden_dir)
@@ -598,6 +636,7 @@ def run_rescale_drill(seed: int, workdir: str,
         gap_error = f"gap probe crashed: {e!r}"
     if error is None and gap_error is not None:
         error, passed = gap_error, False
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query=f"rescale_{query_name}",
         seed=seed,
@@ -622,6 +661,7 @@ def run_rescale_drill(seed: int, workdir: str,
             "rescale_gap_overlap": gap_overlap,
             "rescale_gap_stop_the_world": gap_stw,
         },
+        audit_breaches=audit_breaches,
     )
 
 
@@ -681,6 +721,7 @@ def run_pipeline_drill(seed: int, workdir: str, n_rows: int = 6000,
     from ..config import update
 
     os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
     src = os.path.join(workdir, "pipe-in.json")
     with open(src, "w") as f:
         for i in range(n_rows):
@@ -753,6 +794,7 @@ def run_pipeline_drill(seed: int, workdir: str, n_rows: int = 6000,
     if error is None and staged_max < 1:
         error = ("no barrier ever drained a staged batch — the drill "
                  "did not exercise the mid-flight pipeline")
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query="fused_pipeline_kill",
         seed=seed,
@@ -772,6 +814,7 @@ def run_pipeline_drill(seed: int, workdir: str, n_rows: int = 6000,
                 if int(s.get("attrs", {}).get("staged", 0)) >= 1
             ),
         },
+        audit_breaches=audit_breaches,
     )
 
 
@@ -832,6 +875,7 @@ def run_state_bloat_drill(seed: int, workdir: str, n_rows: int = 6000,
     from ..config import update
 
     os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
     src = os.path.join(workdir, "bloat-in.json")
     with open(src, "w") as f:
         for i in range(n_rows):
@@ -974,6 +1018,7 @@ def run_state_bloat_drill(seed: int, workdir: str, n_rows: int = 6000,
         error = (f"delta byte rate grew with state: "
                  f"early {early_b:.0f} B/s -> late {late_b:.0f} B/s "
                  f"({len(byte_series)} epochs)")
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query="state_bloat_session",
         seed=seed,
@@ -993,6 +1038,7 @@ def run_state_bloat_drill(seed: int, workdir: str, n_rows: int = 6000,
             "rebase_base_bytes": base_bytes,
             "epochs_measured": len(byte_series),
         },
+        audit_breaches=audit_breaches,
     )
 
 
@@ -1051,6 +1097,7 @@ def run_kafka_drill(seed: int, workdir: str, n_rows: int = 120,
     from ..controller.scheduler import EmbeddedScheduler
     from ..controller.state_machine import JobState
 
+    audit_mark = _audit_mark()
     broker = FakeKafkaBroker(partitions_per_topic=2)
     for i in range(n_rows):
         broker.append("in", i % 2, None, json.dumps({"n": i}).encode(),
@@ -1131,6 +1178,7 @@ def run_kafka_drill(seed: int, workdir: str, n_rows: int = 120,
         )
     if error is None and plan.unfired():
         error = f"unfired faults: {[s.describe() for s in plan.unfired()]}"
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query="kafka_exactly_once",
         seed=seed,
@@ -1142,6 +1190,7 @@ def run_kafka_drill(seed: int, workdir: str, n_rows: int = 120,
         expected_log=plan.expected_log(),
         unfired=[s.describe() for s in plan.unfired()],
         error=error,
+        audit_breaches=audit_breaches,
     )
 
 
@@ -1206,6 +1255,7 @@ def run_shared_drill(seed: int, workdir: str, n_rows: int = 4000,
     from ..controller.state_machine import JobState
 
     os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
     tenants = {"ta": 3, "tb": 5}
 
     # 1. fault-free SOLO references, sharing OFF: the A/B is
@@ -1319,6 +1369,7 @@ def run_shared_drill(seed: int, workdir: str, n_rows: int = 4000,
     if error is None and refcount_peak < len(tenants):
         error = (f"tenants never co-mounted: peak refcount "
                  f"{refcount_peak} < {len(tenants)}")
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query="shared_plan_fleet",
         seed=seed,
@@ -1335,6 +1386,7 @@ def run_shared_drill(seed: int, workdir: str, n_rows: int = 4000,
             "shared_fingerprint": host_fp,
             "tenant_rows": {tid: len(v) for tid, v in got.items()},
         },
+        audit_breaches=audit_breaches,
     )
 
 # -- hot-standby failover drill (ISSUE 17 acceptance) ------------------------
@@ -1403,6 +1455,7 @@ def run_failover_drill(seed: int, workdir: str, n_rows: int = 4000,
     from ..state.chain_cache import CACHE
 
     os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
 
     # 1. fault-free reference, failover off
     clean_out = os.path.join(workdir, "clean.json")
@@ -1574,6 +1627,7 @@ def run_failover_drill(seed: int, workdir: str, n_rows: int = 4000,
             error = repr(e)
 
     passed = error is None
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
     return DrillResult(
         query="failover_hot_standby",
         seed=seed,
@@ -1595,6 +1649,7 @@ def run_failover_drill(seed: int, workdir: str, n_rows: int = 4000,
             "chain_cache_hits": cache.get("hits"),
             "chain_cache_misses": cache.get("misses"),
         },
+        audit_breaches=audit_breaches,
     )
 
 
@@ -1642,6 +1697,11 @@ def run_starvation_drill(seed: int, workdir: str, n_rows: int = 3000,
     from ..controller.state_machine import JobState
 
     os.makedirs(workdir, exist_ok=True)
+    # explicit audit-silence assertion (ISSUE 19): this drill IS the
+    # double-emit watch item's resurface detector — if extreme loop lag
+    # ever re-emits a window, the conservation ledger flags the exact
+    # (edge, epoch) even when the sink output happens to dedupe
+    audit_mark = _audit_mark()
     tenants = ("starve-victim", "starve-bystander")
 
     def tenant_sql(tag: str, out: str) -> str:
@@ -1746,7 +1806,8 @@ def run_starvation_drill(seed: int, workdir: str, n_rows: int = 3000,
     if error is None and conflicts:
         error = (f"sanitizer flagged {len(conflicts)} interleaving "
                  f"conflict(s): {conflicts[0]['detail']}")
-    passed = error is None
+    passed, error, audit_breaches = _audit_verdict(audit_mark,
+                                                    error is None, error)
     if not passed:
         # CI failure artifacts: the full access log + a Perfetto trace
         sanitizer.dump(os.path.join(workdir, "race_access_log.json"))
@@ -1772,4 +1833,5 @@ def run_starvation_drill(seed: int, workdir: str, n_rows: int = 3000,
                 "conflicts": conflicts,
             },
         },
+        audit_breaches=audit_breaches,
     )

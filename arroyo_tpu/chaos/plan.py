@@ -108,6 +108,33 @@ FAULT_POINTS: Dict[str, str] = {
         "sleeps BLOCKING (a CPU-bound UDF that never yields), starving "
         "the whole event loop — the starvation drill's seam"
     ),
+    # conservation-ledger mutation seams (obs/audit.py): each models one
+    # exactly-once violation class the auditor must flag with the exact
+    # (edge, epoch); tests/test_audit_mutations.py drives them
+    "audit.dup_frame": (
+        "engine/network.py DataPlaneServer._handle — deliver a received "
+        "data frame TWICE into the destination queue (duplicated delivery "
+        "past the transport: receiver attests more rows than the sender "
+        "sealed -> count_mismatch on that edge/epoch)"
+    ),
+    "audit.drop_batch": (
+        "operators/collector.py EdgeSender._send_data — drop a batch "
+        "AFTER the sender tap attested it (lost delivery / dropped flush: "
+        "sender attests rows the receiver never sees -> count_mismatch)"
+    ),
+    "audit.rewind_epoch": (
+        "engine/worker.py WorkerServer._forward — re-emit a checkpoint "
+        "report for epoch - params.back (default 2), a source rewound "
+        "behind committed output (the PR 15 overlap_double_emission "
+        "class) -> rewind_behind_commit flagged at intake, report fenced"
+    ),
+    "audit.zombie_append": (
+        "engine/worker.py WorkerServer._forward — append an extra report "
+        "for the NEXT epoch stamped with params.gen (default: the "
+        "previous incarnation of this job's data namespace), a fenced "
+        "generation appending a new epoch past its fencing -> "
+        "zombie_generation flagged at intake, report fenced"
+    ),
     # checkpoint protocol (state/protocol.py)
     "protocol.fenced_zombie": (
         "state/protocol.py check_current — treat the caller's generation "

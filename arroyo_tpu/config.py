@@ -376,6 +376,28 @@ class WatchConfig:
     spool_dir: str = ""
     # seconds of metric history around the breach included in a bundle
     bundle_window_s: float = 120.0
+    # built-in SLO: conservation-ledger breach count (obs/audit.py) — any
+    # recorded breach (>= the 0.5 threshold) fires; the auditor abstains
+    # while the job has no reconciler yet
+    conservation_breaches: float = 0.5
+
+
+@dataclasses.dataclass
+class AuditConfig:
+    """Conservation ledger (arroyo_tpu/obs/audit.py): always-on
+    exactly-once auditing. Every data-plane edge accumulates per-epoch
+    (row count, order-insensitive digest) attestations sealed at barrier
+    alignment on both sender and receiver; they ride the checkpoint
+    reports to a controller-resident reconciler that flags dup/lost/torn
+    delivery, flow-consistency violations, and recovery-conservation
+    breaches (rewind-behind-commit, zombie-generation append) with the
+    exact (edge, epoch) culprit."""
+
+    # master switch: off = no taps accumulate, reports carry no
+    # attestations, the reconciler never runs, and the conservation SLO
+    # abstains (the bench's audit_overhead_pct child sets
+    # ARROYO__AUDIT__ENABLED=0)
+    enabled: bool = True
 
 
 @dataclasses.dataclass
@@ -726,7 +748,8 @@ class Config:
     pipelining), state (incremental snapshots, off-barrier
     flushes, spill tier), serve (queryable-state serving tier),
     autoscale (closed-loop parallelism control), watch (metric history
-    + SLO engine), tls, chaos (fault injection), obs (flight recorder), tpu (device
+    + SLO engine), audit (conservation ledger), tls, chaos (fault
+    injection), obs (flight recorder), tpu (device
     kernels + mesh), controller, rescale (generation-overlap
     zero-downtime rescale), failover (hot-standby generations +
     task-local recovery), cluster (shared worker pool /
@@ -742,6 +765,7 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     autoscale: AutoscaleConfig = dataclasses.field(default_factory=AutoscaleConfig)
     watch: WatchConfig = dataclasses.field(default_factory=WatchConfig)
+    audit: AuditConfig = dataclasses.field(default_factory=AuditConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     tls: TlsConfig = dataclasses.field(default_factory=TlsConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)

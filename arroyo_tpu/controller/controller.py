@@ -32,6 +32,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import chaos, obs
+from ..obs import audit
 from ..analysis.model.effects import protocol_effect
 from ..analysis.races import shared_state
 from ..analysis.races.sanitizer import set_task_root
@@ -376,6 +377,7 @@ class ControllerServer:
                 "/debug/watch": self._debug_watch,
                 "/debug/sharing": self._debug_sharing,
                 "/debug/failover": self._debug_failover,
+                "/debug/audit": self._debug_audit,
             },
         )
         logger.info("controller up at %s", self.addr)
@@ -405,6 +407,17 @@ class ControllerServer:
             }
         return web.json_response(
             doc, dumps=lambda d: json.dumps(d, default=str)
+        )
+
+    async def _debug_audit(self, request):
+        """Admin surface: the conservation ledger — every live job's
+        reconciler status (per-edge attestations, flow checks, breach
+        records). `?job=<id>` narrows to one job's reconciler."""
+        from aiohttp import web
+
+        return web.json_response(
+            audit.status(request.query.get("job")),
+            dumps=lambda d: json.dumps(d, default=str),
         )
 
     async def _debug_autoscale(self, request):
@@ -552,6 +565,17 @@ class ControllerServer:
     async def _task_checkpoint_completed(self, req: dict) -> dict:
         job = self._req_job(req)
         if job is not None:
+            # conservation ledger: recovery checks (rewind behind the
+            # published epoch, zombie-generation append) run at intake,
+            # and a flagged/stale report is FENCED out of the epoch's
+            # bookkeeping instead of folded into a manifest
+            if req.get("audit") is not None and audit.reconciler(
+                job.job_id
+            ).intake(
+                req["task_id"], req["epoch"], req["audit"],
+                job.published_epoch or None,
+            ):
+                return {}
             job.checkpoints.setdefault(req["epoch"], {})[req["task_id"]] = req
             job.kick()
         return {}
@@ -640,6 +664,11 @@ class ControllerServer:
         # rides StartExecution so workers re-planning the canonical SQL
         # apply the identical source rewrite.
         mount = self.sharing.try_mount(job_id, graph)
+        # a fresh submission is a NEW job even when the id is reused (a
+        # re-created pipeline, a drill phase, a test): drop any stale
+        # conservation reconciler so its incarnation fencing and published
+        # horizon don't outlive the job that earned them
+        audit.expunge_job(job_id)
         job = JobHandle(job_id, graph, storage_url, sql=sql,
                         parallelism=parallelism, tenant=tenant)
         job.mount = mount
@@ -1734,6 +1763,12 @@ class ControllerServer:
         # the manifest is durable: advance the serving tier's read
         # snapshot (cache entries of earlier epochs self-invalidate)
         job.published_epoch = max(job.published_epoch, epoch)
+        # conservation ledger: join this epoch's sealed per-edge
+        # attestations (sender == receiver) + flow checks, now that the
+        # full report set is durable
+        audits = {tid: r.get("audit") for tid, r in reports.items()}
+        if any(a is not None for a in audits.values()):
+            audit.reconciler(job.job_id).reconcile(epoch, audits)
         # shared-plan (ISSUE 16): a mounted tenant's publish raises its
         # durable restore floor on the bus and may clear the host's
         # gated epoch

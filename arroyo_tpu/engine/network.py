@@ -253,6 +253,16 @@ class DataPlaneServer:
                     logger.warning("no route for %s/%s from %s", ns, quad,
                                    peer)
                     continue
+                if kind == 0 and chaos.fire(
+                    "audit.dup_frame",
+                    edge=getattr(queue, "audit_edge", None)
+                    or f"{quad[0]}:{quad[1]}->{quad[2]}:{quad[3]}",
+                ):
+                    # duplicated data-frame delivery past the TCP layer:
+                    # the receiver tap attests the rows twice while the
+                    # sender attested them once — the conservation
+                    # reconciler must name this edge+epoch
+                    await queue.send(item)
                 await queue.send(item)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass

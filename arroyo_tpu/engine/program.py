@@ -24,6 +24,7 @@ from ..operators.context import (
     SourceContext,
     WatermarkHolder,
 )
+from ..obs.audit import edge_key as audit_edge_key
 from ..operators.queues import BatchQueue, InputQueue
 from ..operators.runner import SubtaskRunner
 from ..types import TaskInfo
@@ -106,6 +107,11 @@ class Program:
             q = BatchQueue(qsize, qbytes,
                            f"{self.job_id}/e{edge_idx}-{i}-{j}",
                            job=self.job_id)
+            # conservation ledger (obs/audit.py): stamp the routing quad's
+            # canonical edge key on the queue — the sender tap (EdgeSender)
+            # and the receiver tap (runner input loop) both read it, so
+            # local AND remote-bridged channels attest under the same name
+            q.audit_edge = audit_edge_key(edge.src, i, edge.dst, j)
             if dst_local:
                 in_queues[(edge.dst, j)].append(
                     InputQueue(q, logical_input, f"{edge.src}-{i}")

@@ -701,6 +701,16 @@ class WorkerServer:
         return {"epoch": jr.leader_durable}
 
     def _leader_intake(self, jr: _JobRuntime, d: dict):
+        # conservation ledger: recovery checks run BEFORE the stale drop —
+        # a re-emitted epoch behind the published one is exactly what the
+        # drop would silently discard, and silence is what we're auditing
+        if d.get("audit") is not None and obs.audit.reconciler(
+            jr.job_id
+        ).intake(
+            d["task_id"], d["epoch"], d["audit"],
+            jr.leader_published or None,
+        ):
+            return
         # late reports for epochs already published/abandoned would leak
         if d["epoch"] <= jr.leader_published:
             return
@@ -816,6 +826,11 @@ class WorkerServer:
         manifest = backend.publish_checkpoint(
             epoch, {tid: CheckpointReport(r) for tid, r in reports.items()}
         )
+        # conservation ledger: join the epoch's sealed attestations now
+        # that every task reported — same point the controller path uses
+        audits = {tid: r.get("audit") for tid, r in reports.items()}
+        if any(a is not None for a in audits.values()):
+            obs.audit.reconciler(jr.job_id).reconcile(epoch, audits)
         jr.leader_durable = epoch
         committing = manifest.get("committing")
         if committing and backend.claim_commit(epoch):
@@ -921,6 +936,13 @@ class WorkerServer:
                 )
             return
         if isinstance(resp, CheckpointCompletedResp):
+            # conservation ledger: stamp the report's attestations with
+            # this runtime's data-plane generation — the reconciler's
+            # zombie check compares incarnations across reports
+            audit_payload = (
+                dict(resp.audit, gen=jr.data_ns)
+                if resp.audit is not None else None
+            )
             payload = {
                 "worker_id": wid,
                 "job_id": jr.job_id,
@@ -931,7 +953,38 @@ class WorkerServer:
                 "metadata": resp.subtask_metadata,
                 "watermark": resp.watermark,
                 "commit_data": resp.commit_data,
+                "audit": audit_payload,
             }
+            reports = [payload]
+            # mutation seams (tests/test_audit_mutations.py): re-emit a
+            # strictly-stale epoch's report (a source rewound behind
+            # committed output)...
+            spec = chaos.fire("audit.rewind_epoch", job=jr.job_id,
+                              task=resp.task_id, epoch=resp.epoch)
+            if spec is not None and resp.epoch > 1:
+                back = max(1, int(spec.param("back", 2)))
+                reports.append(
+                    dict(payload, epoch=max(1, resp.epoch - back))
+                )
+            # ...or append a report stamped with an already-fenced
+            # generation for the NEXT epoch — a zombie incarnation
+            # appending a new epoch past its fencing. (An old-generation
+            # straggler redelivering an already-published epoch is benign
+            # and fenced silently; writing an epoch it does not own is
+            # the breach.) The real report stays intact so the epoch
+            # still assembles.
+            spec = chaos.fire("audit.zombie_append", job=jr.job_id,
+                              task=resp.task_id, epoch=resp.epoch)
+            if spec is not None and audit_payload is not None:
+                try:
+                    cur = int(jr.data_ns.rsplit("@", 1)[1])
+                except (IndexError, ValueError):
+                    cur = 0
+                stale_gen = str(spec.param("gen", f"{jr.job_id}@{cur - 1}"))
+                reports.append(
+                    dict(payload, epoch=resp.epoch + 1,
+                         audit=dict(audit_payload, gen=stale_gen))
+                )
             # worker-leader mode: checkpoint reports go to the job leader
             # (who assembles the manifest), not the controller. If the
             # leader resigned (its local work ended), fall back to the
@@ -939,21 +992,23 @@ class WorkerServer:
             # a TRANSIENT leader rpc failure also diverts this report, so
             # that epoch waits out its deadline unpublished — the next
             # cadence tick retries with a fresh epoch.
-            if jr.is_leader:
-                self._leader_intake(jr, payload)
-            elif jr.leader_client is not None:
-                try:
-                    await jr.leader_client.call(
-                        "WorkerGrpc", "TaskCheckpointCompleted", payload
-                    )
-                except Exception:  # noqa: BLE001
+            for report in reports:
+                if jr.is_leader:
+                    self._leader_intake(jr, report)
+                elif jr.leader_client is not None:
+                    try:
+                        await jr.leader_client.call(
+                            "WorkerGrpc", "TaskCheckpointCompleted", report
+                        )
+                    except Exception:  # noqa: BLE001
+                        await c.call(
+                            "ControllerGrpc", "TaskCheckpointCompleted",
+                            report,
+                        )
+                else:
                     await c.call(
-                        "ControllerGrpc", "TaskCheckpointCompleted", payload
+                        "ControllerGrpc", "TaskCheckpointCompleted", report
                     )
-            else:
-                await c.call(
-                    "ControllerGrpc", "TaskCheckpointCompleted", payload
-                )
         elif isinstance(resp, CheckpointEventResp):
             await c.call(
                 "ControllerGrpc", "TaskCheckpointEvent",

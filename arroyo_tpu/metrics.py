@@ -529,6 +529,26 @@ JOB_PUBLISHED_EPOCH = REGISTRY.gauge(
 WATCH_ALERTS = REGISTRY.counter(
     "arroyo_watch_alerts_total",
     "watchtower alert transitions per (job, rule, event=firing|cleared)")
+# Conservation ledger (ISSUE 19): per-edge epoch attestation auditing.
+# Every family carries a `job` label so Registry.drop_job GCs a terminal
+# job's audit series with the rest; the breach counter additionally
+# carries the breach kind (digest_mismatch|count_mismatch|flow_violation|
+# rewind_behind_commit|zombie_generation) and is what the watchtower's
+# `conservation` SLO rule and the retained-history allowlist read.
+AUDIT_EPOCHS = REGISTRY.counter(
+    "arroyo_audit_epochs_reconciled_total",
+    "checkpoint epochs whose per-edge attestations the controller "
+    "reconciler joined at manifest publish, per job")
+AUDIT_EDGES_VERIFIED = REGISTRY.counter(
+    "arroyo_audit_edges_verified_total",
+    "per-epoch edge attestations that matched on both sides (sender "
+    "row count + commutative digest == receiver's), per job")
+AUDIT_BREACHES = REGISTRY.counter(
+    "arroyo_audit_breaches_total",
+    "conservation breaches flagged by the reconciler per (job, kind): "
+    "attestation joins that diverged, flow-consistency violations, and "
+    "recovery-conservation breaches (rewind-behind-commit / "
+    "zombie-generation append) — each names its exact (edge, epoch)")
 LOOP_LAG_SECONDS = REGISTRY.histogram(
     "arroyo_worker_loop_lag_seconds",
     "event-loop scheduling lag sampled by the accounting pump (sleep-"

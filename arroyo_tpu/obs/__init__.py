@@ -95,6 +95,7 @@ def reset(capacity: Optional[int] = None) -> TraceRecorder:
     timeline.clear()
     attribution.ACCOUNTING.reset()
     history.HISTORY.reset()
+    audit.reset()
     if capacity is None:
         _RECORDER = None
         return recorder()
@@ -114,6 +115,10 @@ def expunge_job(job_id: str) -> None:
     timeline.expunge_job(job_id)
     attribution.ACCOUNTING.drop_job(job_id)
     history.HISTORY.drop_job(job_id)
+    # conservation ledger: the job's reconciler goes with it (the
+    # process-wide breach ring deliberately survives — drills assert
+    # audit silence after the embedded controller tears the job down)
+    audit.expunge_job(job_id)
 
 
 def span(name: str, *, trace: Optional[str] = None,
@@ -230,3 +235,8 @@ from . import attribution, timeline  # noqa: F401,E402 - public surface
 # last: device.py pulls in the metric families and the trace primitives
 from . import device  # noqa: F401,E402 - public surface
 from . import doctor  # noqa: F401,E402 - public surface
+
+# conservation ledger (ISSUE 19): per-edge epoch attestations + the
+# controller-resident reconciler — imports nothing heavier than the
+# metric families, so it can ride at the tail of the package
+from . import audit  # noqa: F401,E402 - public surface

@@ -62,6 +62,9 @@ DEFAULT_RETAIN = (
     "arroyo_checkpoint_phase_seconds",
     "arroyo_trace_dropped_spans_total",
     "arroyo_job_published_epoch",
+    # conservation ledger: the watchtower's conservation rule reads the
+    # breach count; FIRING bundles attach this family's recent history
+    "arroyo_audit_breaches_total",
 )
 
 

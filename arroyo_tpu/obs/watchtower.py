@@ -16,7 +16,10 @@ signal wobbling on a threshold cannot flap an alert. Built-in rules:
   serve_p99        serve-gateway read latency p99 over the window;
   loop_lag         event-loop lag p99 (shared-worker contention);
   trace_drops      flight-recorder span-drop rate (the recording of
-                   the NEXT incident is silently incomplete).
+                   the NEXT incident is silently incomplete);
+  conservation     exactly-once conservation breaches recorded by the
+                   audit ledger (obs/audit.py) — any count above zero
+                   means rows were duplicated, lost, or re-emitted.
 
 Per-tenant / per-job threshold overrides ride `watch.overrides`.
 
@@ -56,6 +59,7 @@ _EPOCH = "arroyo_job_published_epoch"
 _SERVE = "arroyo_serve_request_seconds"
 _LOOP_LAG = "arroyo_worker_loop_lag_seconds"
 _TRACE_DROPS = "arroyo_trace_dropped_spans_total"
+_AUDIT_BREACHES = "arroyo_audit_breaches_total"
 
 
 @dataclasses.dataclass
@@ -175,6 +179,16 @@ def sig_loop_lag(ctx: SLOContext) -> Optional[float]:
     return _windowed_p99(ctx, _LOOP_LAG)
 
 
+def sig_conservation(ctx: SLOContext) -> Optional[float]:
+    """Conservation-ledger breach count for the job (obs/audit.py):
+    any recorded breach — digest/count mismatch, flow violation, rewind
+    behind commit, zombie append — fires the rule. Abstains until the
+    job's reconciler exists (no attested epoch yet)."""
+    from . import audit
+
+    return audit.breach_count(ctx.job_id)
+
+
 def sig_trace_drops(ctx: SLOContext) -> Optional[float]:
     rates = [
         r for r in (
@@ -236,6 +250,9 @@ BUILTIN_RULES: Tuple[tuple, ...] = (
      "above", "loop_lag_s", _LOOP_LAG, "s"),
     ("trace_drops", "flight-recorder span-drop rate", sig_trace_drops,
      "above", "trace_drop_rate", _TRACE_DROPS, "/s"),
+    ("conservation", "exactly-once conservation breaches (audit ledger)",
+     sig_conservation, "above", "conservation_breaches", _AUDIT_BREACHES,
+     "count"),
 )
 
 

@@ -26,6 +26,8 @@ from .base import Operator
 
 
 class AsyncUdfOperator(Operator):
+    flow_class = "buffering"  # rows sit in flight across barriers
+
     def __init__(self, config: dict):
         super().__init__("async_udf")
         self.udf_name: str = config["udf"]

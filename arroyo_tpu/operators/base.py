@@ -34,6 +34,13 @@ class Operator:
     # check a single attribute load
     _serve_view = None
 
+    # conservation ledger (obs/audit.py): declared selectivity class,
+    # checked per epoch by the reconciler against the runner's in/out row
+    # counts. "exact" = out == in (pure row-wise transforms), "contracting"
+    # = out <= in (filters), "buffering"/"any" = unchecked (windows,
+    # joins, and anything that holds rows across barriers)
+    flow_class = "any"
+
     def __init__(self, name: str = ""):
         self.name = name or type(self).__name__
 

@@ -17,10 +17,11 @@ from typing import List, Optional
 
 import pyarrow as pa
 
+from .. import chaos
 from ..metrics import BACKPRESSURE, BATCHES_SENT, BYTES_SENT, MESSAGES_SENT
 from ..obs import timeline
 from ..schema import StreamSchema
-from ..types import SignalMessage
+from ..types import SignalKind, SignalMessage
 from .queues import BatchQueue, batch_bytes
 
 
@@ -41,23 +42,73 @@ class EdgeSender:
         self._rr = src_subtask  # round-robin cursor for unkeyed shuffles
         self._marker_rr = src_subtask  # separate cursor for latency markers
         self._is_forward = edge_type == EdgeType.FORWARD
+        # conservation ledger (obs/audit.py): one sender-side attestation
+        # tap per destination queue, built lazily on the first send so
+        # config is resolved once. None entries = auditing off or a queue
+        # the wiring didn't stamp (engine-internal previews).
+        self._audit_taps: Optional[list] = None
+
+    def _taps(self) -> list:
+        if self._audit_taps is None:
+            from ..obs import audit
+
+            if audit.enabled():
+                self._audit_taps = [
+                    audit.EdgeTap(q.audit_edge)
+                    if getattr(q, "audit_edge", None) else None
+                    for q in self.queues
+                ]
+            else:
+                self._audit_taps = [None] * len(self.queues)
+        return self._audit_taps
+
+    async def _send_data(self, idx: int, batch: pa.RecordBatch):
+        """All data batches leave through here: attest to the queue's tap
+        FIRST (the attestation states what the operator chain emitted),
+        then pass the chaos dropped-flush seam — a fired drop means rows
+        the sender attested never reach the receiver, which is exactly
+        the lost-delivery shape the reconciler must flag."""
+        tap = self._taps()[idx]
+        if tap is not None:
+            tap.observe(batch)
+            if chaos.fire("audit.drop_batch", edge=tap.edge):
+                return
+        await self.queues[idx].send(batch)
 
     async def send_batch(self, batch: pa.RecordBatch):
         n = len(self.queues)
         if self._is_forward or n == 1:
-            q = self.queues[self.src_subtask % n] if self._is_forward else self.queues[0]
-            await q.send(batch)
+            idx = self.src_subtask % n if self._is_forward else 0
+            await self._send_data(idx, batch)
             return
         if self.schema.key_indices:
             parts = self.schema.partition(batch, n)
             for i, part in enumerate(parts):
                 if part is not None and part.num_rows:
-                    await self.queues[i].send(part)
+                    await self._send_data(i, part)
         else:
             self._rr = (self._rr + 1) % n
-            await self.queues[self._rr].send(batch)
+            await self._send_data(self._rr, batch)
+
+    def seal_audit(self, epoch: int) -> None:
+        """Seal every destination tap's running attestation at this
+        epoch's barrier broadcast (the sender-side epoch cut)."""
+        for tap in self._taps():
+            if tap is not None:
+                tap.seal(epoch)
+
+    def drain_audit(self, epoch: int, out: dict) -> None:
+        """Move this sender's sealed epoch attestations into `out`
+        (edge -> [rows, digest]) for the checkpoint report."""
+        for tap in self._taps():
+            if tap is not None:
+                v = tap.drain(epoch)
+                if v is not None:
+                    out[tap.edge] = [v[0], v[1]]
 
     async def broadcast(self, signal: SignalMessage):
+        if signal.kind == SignalKind.BARRIER:
+            self.seal_audit(signal.barrier.epoch)
         if self._is_forward:
             await self.queues[self.src_subtask % len(self.queues)].send(signal)
         else:

@@ -57,6 +57,7 @@ class CheckpointReport:
         self.subtask_metadata = d.get("metadata") or {}
         self.watermark = d.get("watermark")
         self.commit_data = d.get("commit_data")
+        self.audit = d.get("audit")
 
 
 ControlMessage = Any  # union of the above
@@ -85,6 +86,11 @@ class CheckpointCompletedResp:
     watermark: Optional[int] = None
     has_commit_data: bool = False
     commit_data: Optional[bytes] = None
+    # conservation ledger (obs/audit.py): this subtask's sealed per-edge
+    # epoch attestations + selectivity counts ({"tx", "rx", "ops",
+    # "flow"}, plus "gen" stamped by the worker forward path); None when
+    # auditing is disabled
+    audit: Optional[dict] = None
 
 
 @dataclasses.dataclass

@@ -35,6 +35,8 @@ _JOIN_TYPE_MAP = {
 
 
 class JoinBase(Operator):
+    flow_class = "buffering"  # buffers both sides; emits on match/expiry
+
     def __init__(self, config: dict, name: str):
         super().__init__(name)
         self.n_keys = int(config["n_keys"])

@@ -75,6 +75,17 @@ def _apply_segment_flags(op: BatchMapOperator, config: dict) -> BatchMapOperator
     return op
 
 
+def _declare_flow(op: BatchMapOperator, prog) -> BatchMapOperator:
+    """Conservation ledger: a compiled projection's selectivity is known
+    statically — row-wise without a predicate (out == in), filtering with
+    one (out <= in). py_fn operators stay "any" (arbitrary callables)."""
+    op.flow_class = (
+        "contracting" if getattr(prog, "predicate", None) is not None
+        else "exact"
+    )
+    return op
+
+
 @register_operator(OperatorName.ARROW_VALUE)
 @register_operator(OperatorName.PROJECTION)
 def _make_value(config: dict) -> Operator:
@@ -87,8 +98,9 @@ def _make_value(config: dict) -> Operator:
 
         prog = CompiledProjection.from_config(config["program"])
         return _apply_segment_flags(
-            BatchMapOperator(prog, config.get("name", "project"),
-                             config.get("schema")), config)
+            _declare_flow(
+                BatchMapOperator(prog, config.get("name", "project"),
+                                 config.get("schema")), prog), config)
     raise ValueError("value operator config needs py_fn or program")
 
 
@@ -106,7 +118,10 @@ def _make_key(config: dict) -> Operator:
 
         prog = CompiledProjection.from_config(config["program"])
         return _apply_segment_flags(
-            BatchMapOperator(prog, "key", config.get("schema")), config)
+            _declare_flow(
+                BatchMapOperator(prog, "key", config.get("schema")), prog),
+            config)
     # identity: routing handled by edge schema key indices
-    return _apply_segment_flags(
-        BatchMapOperator(lambda b: b, "key", config.get("schema")), config)
+    op = BatchMapOperator(lambda b: b, "key", config.get("schema"))
+    op.flow_class = "exact"  # identity pass-through
+    return _apply_segment_flags(op, config)

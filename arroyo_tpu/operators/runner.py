@@ -81,6 +81,12 @@ class ChainCollector:
             return
         nxt = self.op_idx + 1
         r = self.runner
+        if r._audit_on:
+            # conservation ledger: per-epoch selectivity counts — rows
+            # leaving op i are rows entering op i+1 (direct call, no queue)
+            r._op_counts[self.op_idx][1] += batch.num_rows
+            if nxt < len(r.ops):
+                r._op_counts[nxt][0] += batch.num_rows
         if nxt < len(r.ops):
             await r.ops[nxt].process_batch(batch, r.ctxs[nxt], r.collectors[nxt], 0)
         else:
@@ -181,6 +187,21 @@ class SubtaskRunner:
             i for i, op in enumerate(ops)
             if getattr(op, "is_fused_segment", False)
         ]
+        # conservation ledger (obs/audit.py): receiver-side attestation
+        # taps (one per input whose queue the wiring stamped with its
+        # edge key) + per-operator in/out selectivity counts. All state
+        # here is select-loop-confined: _collect_audit snapshots it by
+        # value before handing the payload to the pipelined flush task.
+        self._audit_on = obs.audit.enabled()
+        if self._audit_on:
+            self._rx_taps: List[Optional[obs.audit.EdgeTap]] = [
+                obs.audit.EdgeTap(e)
+                if (e := getattr(iq.queue, "audit_edge", None)) else None
+                for iq in inputs
+            ]
+        else:
+            self._rx_taps = [None] * len(inputs)
+        self._op_counts = [[0, 0] for _ in ops]
 
     def _note_busy(self, dt: float, phase: str):
         """Mirror one busy-seconds increment into the fleet observatory:
@@ -554,6 +575,11 @@ class SubtaskRunner:
         nbytes = batch_bytes(item)
         self._bytes_recv.inc(nbytes)
         obs.attribution.note(nbytes=nbytes)
+        if self._audit_on:
+            tap = self._rx_taps[i]
+            if tap is not None:
+                tap.observe(item)
+            self._op_counts[0][0] += item.num_rows
         t0 = time.perf_counter()
         anchor = obs.device.anchor(
             self._compile_trace, "batch.process",
@@ -639,6 +665,13 @@ class SubtaskRunner:
     async def _handle_barrier(self, i: int, barrier) -> bool:
         """Align: block input i until all live inputs delivered the barrier
         (reference operator.rs:673-708, 1036-1046)."""
+        if self._audit_on:
+            # receiver-side epoch cut: aligned inputs deliver no further
+            # rows for this epoch once their barrier arrives, so input
+            # i's attestation is complete right here
+            tap = self._rx_taps[i]
+            if tap is not None:
+                tap.seal(barrier.epoch)
         if self._current_barrier is None:
             self._current_barrier = barrier
             self._align_started = time.perf_counter()
@@ -738,6 +771,12 @@ class SubtaskRunner:
                 if cap_span.recording else barrier
             )
             await self.tail.broadcast(SignalMessage.barrier_of(out_barrier))
+        # the broadcast sealed every sender-side tap at this epoch; the
+        # receiver taps sealed at alignment — snapshot both (plus the
+        # selectivity counts) by value NOW, before the select loop can
+        # process post-barrier rows, and let the attestation ride the
+        # pipelined completion report
+        audit = self._collect_audit(barrier.epoch)
         self._phase_obs["capture"].observe(time.perf_counter() - t0)
         flush_span = self._barrier_span(
             "checkpoint.flush", barrier,
@@ -746,7 +785,8 @@ class SubtaskRunner:
         flush = asyncio.ensure_future(
             self._flush_and_report(barrier, captured, commit_data,
                                    self.watermarks.current_nanos(),
-                                   flush_span, prev=self._last_flush)
+                                   flush_span, prev=self._last_flush,
+                                   audit=audit)
         )
         self._last_flush = flush
         self._inflight_flushes.append(flush)
@@ -756,6 +796,34 @@ class SubtaskRunner:
         )
         if barrier.then_stop:
             await self._await_pending_flush()
+
+    def _collect_audit(self, epoch: int) -> Optional[dict]:
+        """Assemble this subtask's conservation attestation for one epoch:
+        sealed sender (tx) and receiver (rx) edge attestations plus the
+        per-operator selectivity ledger, reset for the next epoch. Runs
+        synchronously inside the barrier path, so the counts cut exactly
+        at the epoch boundary."""
+        if not self._audit_on:
+            return None
+        tx: Dict[str, list] = {}
+        for edge in self.tail.edges:
+            edge.drain_audit(epoch, tx)
+        rx: Dict[str, list] = {}
+        for tap in self._rx_taps:
+            if tap is not None:
+                v = tap.drain(epoch)
+                if v is not None:
+                    rx[tap.edge] = [v[0], v[1]]
+        ops: Dict[str, list] = {}
+        flow: Dict[str, str] = {}
+        for idx, op in enumerate(self.ops):
+            cnt = self._op_counts[idx]
+            name = f"{idx}:{op.name}"
+            ops[name] = [cnt[0], cnt[1]]
+            flow[name] = getattr(op, "flow_class", "any")
+            cnt[0] = 0
+            cnt[1] = 0
+        return {"tx": tx, "rx": rx, "ops": ops, "flow": flow}
 
     async def _drain_pipeline(self, barrier):
         """Drain every fused segment's staged (double-buffered) batches
@@ -802,7 +870,8 @@ class SubtaskRunner:
     @protocol_effect("worker.flush")
     async def _flush_and_report(self, barrier, captured, commit_data,
                                 watermark, flush_span=obs.NULL_SPAN,
-                                prev: Optional[asyncio.Task] = None):
+                                prev: Optional[asyncio.Task] = None,
+                                audit: Optional[dict] = None):
         set_task_root(f"flush:{self.task_info.task_id}")
         if prev is not None and not prev.done():
             await asyncio.wait({prev})
@@ -864,6 +933,7 @@ class SubtaskRunner:
                 watermark=watermark,
                 has_commit_data=commit_data is not None,
                 commit_data=commit_data,
+                audit=audit,
             )
         )
 

@@ -28,6 +28,8 @@ from .base import Operator
 
 
 class UpdatingJoinOperator(Operator):
+    flow_class = "buffering"  # retract/append streams decouple in/out counts
+
     def __init__(self, config: dict):
         super().__init__("updating_join")
         self.n_keys = int(config["n_keys"])

@@ -21,6 +21,10 @@ from .base import Operator
 
 
 class WatermarkGenerator(Operator):
+    # conservation ledger: every data batch passes through unchanged —
+    # watermarks travel out-of-band via the runner's signal chain
+    flow_class = "exact"
+
     def __init__(
         self,
         interval_nanos: int = 0,

@@ -27,6 +27,8 @@ SUPPORTED = ("row_number", "rank", "dense_rank", "count")
 
 
 class WindowFunctionOperator(Operator):
+    flow_class = "buffering"  # buffers partitions until the watermark closes them
+
     def __init__(self, config: dict):
         super().__init__("window_fn")
         self.fn: str = config["fn"]  # row_number | rank | dense_rank

@@ -53,6 +53,8 @@ def _specs_from_config(config: dict) -> List[AggSpec]:
 class WindowOperatorBase(Operator):
     """Shared machinery: accumulator, directory, output batch building."""
 
+    flow_class = "buffering"  # holds rows across barriers until windows fire
+
     def __init__(self, config: dict, name: str):
         super().__init__(name)
         self.specs = _specs_from_config(config)

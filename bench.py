@@ -1085,6 +1085,24 @@ def main():
                 max(0.0, 100.0 * (1.0 - baseline["eps"] / r_woff["eps"])),
                 2,
             )
+    # conservation ledger (ISSUE 19): one more UNinstrumented q5 run with
+    # the always-on audit ledger off — the headline median runs with
+    # auditing on (the default), so the delta IS the attestation cost
+    # (per-batch commutative hashing + per-epoch seal/drain/report).
+    # Same absolute-points gate class as attr_overhead_pct; the ISSUE 19
+    # acceptance target is <= 3%.
+    if baseline is not None:
+        audit_env = dict(cpu_env)
+        audit_env["ARROYO__AUDIT__ENABLED"] = "0"
+        r_aoff = run_child(args.events, "numpy", args.timeout,
+                           env=audit_env,
+                           force_device_join=args.force_device_join)
+        if r_aoff is not None:
+            sides["q5_audit_off_eps"] = round(r_aoff["eps"], 1)
+            sides["audit_overhead_pct"] = round(
+                max(0.0, 100.0 * (1.0 - baseline["eps"] / r_aoff["eps"])),
+                2,
+            )
     baseline_real = baseline is not None
     if device is None:
         device = baseline

@@ -1,0 +1,136 @@
+"""Mutation harness for the conservation ledger (ISSUE 19 acceptance).
+
+Each `audit.*` chaos seam injects exactly one conservation violation into
+a live embedded cluster — a duplicated TCP data frame, a batch dropped
+after sender attestation, a checkpoint report re-emitted for an epoch
+behind the published one, a report stamped with a fenced generation —
+and the reconciler must flag it with the CORRECT breach kind, edge, and
+epoch, pulled from the chaos plan's fired log so the assertions name the
+exact mutation site. The mutations corrupt accounting, not liveness: the
+job itself must still FINISH under every one of them."""
+
+import json
+import os
+
+import pytest
+
+from arroyo_tpu import chaos
+from arroyo_tpu.chaos import FaultPlan
+from arroyo_tpu.chaos.drill import PIPELINE_DRILL_SQL, _run_embedded
+from arroyo_tpu.obs import audit
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    chaos.clear()
+    audit.reset()
+    yield
+    chaos.clear()
+    audit.reset()
+
+
+def _write_src(tmp_path, n=2400):
+    src = os.path.join(str(tmp_path), "in.json")
+    with open(src, "w") as f:
+        for i in range(n):
+            mins, secs = (i // 1200) % 60, (i // 20) % 60
+            f.write(json.dumps({
+                "k": i % 64,
+                "v": (i * 37) % 1000 + 1,
+                "timestamp": f"2023-03-01T00:{mins:02d}:{secs:02d}."
+                             f"{(i % 20) * 50:03d}Z",
+            }) + "\n")
+    return src
+
+
+def _run_mutated(tmp_path, job_id, point, at_hits, params=None, n_workers=1):
+    """Run the pipeline-drill query with a single scheduled mutation;
+    return (fired_log, breaches_for_job). The source is throttled so the
+    run spans many checkpoint epochs and the mutation lands mid-stream,
+    well inside sealed attestations (not the unattested trailing
+    segment). Raises if the job does not FINISH."""
+    src = _write_src(tmp_path)
+    out = os.path.join(str(tmp_path), "out.json")
+    sql = PIPELINE_DRILL_SQL.replace("$src", src).replace("$out", out).format(
+        throttle=",\n  throttle_per_sec = '1200'")
+    plan = chaos.install(
+        FaultPlan(1).add(point, at_hits=at_hits, params=params or {})
+    )
+    mark = audit.breach_mark()
+    try:
+        _run_embedded(
+            sql, job_id, os.path.join(str(tmp_path), "ck"), n_workers, 2,
+            max_restarts=0, heartbeat_interval=0.1, heartbeat_timeout=30.0,
+            checkpoint_interval=0.15, timeout=120.0,
+        )
+    finally:
+        fired = plan.fired_log()
+        hits = plan.specs[0].hits
+        chaos.clear()
+    assert [e["point"] for e in fired] == [point], (
+        f"mutation did not fire ({hits} hits observed): {fired}"
+    )
+    return fired[0], audit.breaches_since(mark, job_id)
+
+
+def test_duplicated_remote_frame_is_flagged(tmp_path):
+    """audit.dup_frame double-delivers one data frame past the TCP layer
+    (needs 2 workers so edges actually cross the data plane): receiver
+    attests more rows than the sender on exactly that edge."""
+    fired, breaches = _run_mutated(
+        tmp_path, "mut-dup", "audit.dup_frame", at_hits=(40,), n_workers=2,
+    )
+    assert breaches, "duplicated frame went unflagged"
+    kinds = {b["kind"] for b in breaches}
+    assert kinds == {"count_mismatch"}
+    (b,) = breaches
+    assert b["edge"] == fired["ctx"]["edge"]
+    assert b["epoch"] >= 1
+    assert "receiver" in b["detail"]
+
+
+def test_dropped_batch_is_flagged(tmp_path):
+    """audit.drop_batch swallows one batch AFTER the sender tap attested
+    it: rows the sender swears it emitted never reach the receiver."""
+    fired, breaches = _run_mutated(
+        tmp_path, "mut-drop", "audit.drop_batch", at_hits=(30,),
+    )
+    assert breaches, "dropped batch went unflagged"
+    kinds = {b["kind"] for b in breaches}
+    assert kinds == {"count_mismatch"}
+    (b,) = breaches
+    assert b["edge"] == fired["ctx"]["edge"]
+    assert b["epoch"] >= 1
+
+
+def test_rewound_epoch_report_is_flagged(tmp_path):
+    """audit.rewind_epoch re-emits a checkpoint report for an epoch
+    strictly behind the published epoch — the source-rewind-behind-
+    committed-output shape. Flagged with the stale epoch, not the live
+    one."""
+    fired, breaches = _run_mutated(
+        tmp_path, "mut-rewind", "audit.rewind_epoch", at_hits=(48,),
+        params={"back": 4},
+    )
+    assert breaches, "rewound epoch report went unflagged"
+    kinds = {b["kind"] for b in breaches}
+    assert kinds == {"rewind_behind_commit"}
+    live_epoch = int(fired["ctx"]["epoch"])
+    assert all(b["epoch"] == max(1, live_epoch - 4) for b in breaches)
+
+
+def test_zombie_generation_report_is_flagged(tmp_path):
+    """audit.zombie_append delivers an extra NEXT-epoch report stamped
+    with the PREVIOUS data-plane generation: an old incarnation appending
+    a new epoch past its fencing. Flagged at the epoch the zombie wrote
+    into (one past the live report it rode in on)."""
+    fired, breaches = _run_mutated(
+        tmp_path, "mut-zombie", "audit.zombie_append", at_hits=(12,),
+    )
+    assert breaches, "zombie-generation report went unflagged"
+    kinds = {b["kind"] for b in breaches}
+    assert kinds == {"zombie_generation"}
+    zombie_epoch = int(fired["ctx"]["epoch"]) + 1
+    assert all(b["epoch"] == zombie_epoch for b in breaches)
+    assert all("fenced generation" in b["detail"]
+               or "mixed generations" in b["detail"] for b in breaches)

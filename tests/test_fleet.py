@@ -264,6 +264,15 @@ def test_metrics_cardinality_gc(tmp_path):
             # tier rides the same expunge path as the rest
             for j in range(n):
                 await c.serve.read(f"{tag}{j}", "tumbling_window", [0])
+            # conservation-ledger GC (ISSUE 19): mint a reconciler and
+            # its job-labeled arroyo_audit_* series per churned job —
+            # expunged with the job, same path
+            from arroyo_tpu.obs import audit
+            for j in range(n):
+                audit.reconciler(f"{tag}{j}").reconcile(
+                    1, {"t": {"tx": {"e": [1, 2]}, "rx": {"e": [1, 2]},
+                              "ops": {}, "flow": {}}},
+                )
             for j in range(n):
                 await c.wait_for_state(
                     f"{tag}{j}", JobState.FINISHED, JobState.FAILED,
@@ -277,6 +286,7 @@ def test_metrics_cardinality_gc(tmp_path):
     # read minted job-labeled arroyo_serve_* series
     assert "arroyo_job_attributed_busy_seconds" in REGISTRY.expose()
     assert "arroyo_serve_requests_total" in REGISTRY.expose()
+    assert "arroyo_audit_epochs_reconciled_total" in REGISTRY.expose()
     baseline = len(REGISTRY.expose())
     asyncio.run(churn("gc", 6))
     after = len(REGISTRY.expose())
@@ -288,11 +298,14 @@ def test_metrics_cardinality_gc(tmp_path):
     assert 'job="gc0"' not in text and 'job="gc5"' not in text
     # the serve families are job-labeled too: Registry.drop_job took the
     # per-job serve series (request counts, cache hits) with the rest
+    from arroyo_tpu.obs import audit
     for j in range(6):
         # spans of torn-down jobs no longer linger until ring overwrite
         assert obs.recorder().snapshot(trace_prefix=f"gc{j}/") == []
         assert timeline.snapshot(f"gc{j}") == []
         assert f"gc{j}" not in attribution.ACCOUNTING.summary()["jobs"]
+        # the job's conservation reconciler went with it too
+        assert audit.peek(f"gc{j}") is None
 
 
 def _stub_admission(slots_per_worker=2, n_workers=2):

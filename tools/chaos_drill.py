@@ -253,6 +253,28 @@ def main() -> int:
         print(f"{r.query:<24} {status:<10} rows={r.rows} "
               f"restarts={r.restarts} fired=[{fired}]")
 
+    # conservation-ledger dump (ISSUE 19): the reconciler registry in the
+    # /debug/audit shape, with ring breaches folded back in for jobs whose
+    # reconciler was already expunged with the job (the ring survives
+    # expunge precisely for this). Consumable offline by
+    # `python tools/trace_report.py <file> --audit`.
+    from arroyo_tpu.obs import audit
+
+    audit_doc = audit.status()
+    ring = [b for b in audit.breaches_since(0)
+            if (b.get("job") or "?") not in audit_doc["jobs"]]
+    for b in ring:
+        j = audit_doc["jobs"].setdefault(
+            b["job"], {"job": b["job"], "breaches": []})
+        j["breaches"].append(b)
+        j["breach_count"] = len(j["breaches"])
+    os.makedirs(workdir, exist_ok=True)
+    audit_path = os.path.join(workdir, "audit_status.json")
+    with open(audit_path, "w") as f:
+        json.dump(audit_doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {audit_path}")
+
     payload = {
         "seed": args.seed,
         "mode": ("plan" if args.plan else

@@ -14,6 +14,7 @@ Usage:
   python tools/trace_report.py merged.json --job job7 --out job7.json
   python tools/trace_report.py merged.json --doctor job7
   python tools/trace_report.py --golden-ft --perfetto --out ft.json
+  python tools/trace_report.py audit.json reports.json --audit
 
 --golden-ft runs the golden windowed-aggregate fault-tolerance cycle
 (embedded cluster, seeded chaos faults, recovery from checkpoints) and
@@ -227,6 +228,99 @@ def doctor_summary(events: List[dict], job_id: str, out=sys.stdout) -> int:
     return 0
 
 
+def audit_report(paths: List[str], out=sys.stdout) -> int:
+    """Offline conservation reconciliation (ISSUE 19). Accepts two
+    artifact shapes per input file:
+
+      * a `/debug/audit` (or `GET /api/v1/jobs/{id}/audit`) payload —
+        the reconciler's own status, rendered as-is;
+      * a raw checkpoint-report dump (a JSON list of
+        {job_id, task_id, epoch, audit} dicts, in arrival order) —
+        REPLAYED through a fresh Reconciler, so a CI artifact of the
+        reports is enough to re-derive the breach verdict after the
+        fact, intake fencing included.
+
+    Prints a per-edge attestation table per job and points at the first
+    divergence. Returns 1 when any breach is present, 0 when the ledger
+    is clean."""
+    from arroyo_tpu.obs import audit as audit_mod
+
+    jobs: Dict[str, dict] = {}
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        if isinstance(doc, list):
+            job_id = next(
+                (r.get("job_id") for r in doc if r.get("job_id")),
+                os.path.basename(p),
+            )
+            rec = audit_mod.Reconciler(job_id)
+            # replay in arrival order: an epoch reconciles (and becomes
+            # the published horizon) once a later epoch starts reporting,
+            # which is exactly when the controller's pipelined publish
+            # would have sealed it
+            pending: Dict[int, Dict[str, dict]] = {}
+            published = 0
+            for r in doc:
+                if r.get("audit") is None:
+                    continue
+                epoch = int(r["epoch"])
+                for done in sorted(e for e in pending if e < epoch):
+                    rec.reconcile(done, {
+                        t: rr.get("audit")
+                        for t, rr in pending.pop(done).items()
+                    })
+                    published = max(published, done)
+                if rec.intake(r.get("task_id", "?"), epoch, r["audit"],
+                              published or None):
+                    continue
+                pending.setdefault(epoch, {})[r.get("task_id", "?")] = r
+            for done in sorted(pending):
+                rec.reconcile(done, {
+                    t: rr.get("audit") for t, rr in pending[done].items()
+                })
+            jobs[job_id] = rec.status()
+        elif "jobs" in doc:
+            jobs.update(doc["jobs"])
+        elif doc.get("job"):
+            jobs[doc["job"]] = doc
+    if not jobs:
+        print("no audit payloads found in the inputs", file=out)
+        return 1
+    breached = False
+    for job_id, st in sorted(jobs.items()):
+        print(f"== audit: {job_id}", file=out)
+        print(f"   incarnation={st.get('incarnation')} "
+              f"epochs_reconciled={st.get('epochs_reconciled', 0)} "
+              f"edges_verified={st.get('edges_verified', 0)} "
+              f"rows_attested={st.get('rows_attested', 0)}", file=out)
+        edges = st.get("edges") or {}
+        if edges:
+            print(f"   {'edge':<24} {'epoch':>5} "
+                  f"{'tx rows':>8} {'rx rows':>8}  digest ok", file=out)
+            for edge, v in sorted(edges.items()):
+                tx, rx = v.get("tx") or [0, 0], v.get("rx") or [0, 0]
+                print(f"   {edge:<24} {v.get('epoch', 0):>5} "
+                      f"{tx[0]:>8} {rx[0]:>8}  "
+                      f"{'ok' if v.get('ok') else 'DIVERGED'}", file=out)
+        breaches = st.get("breaches") or []
+        if breaches:
+            breached = True
+            first = min(breaches, key=lambda b: (b.get("epoch", 0),
+                                                 b.get("ts", 0)))
+            print(f"   BREACHES ({len(breaches)}):", file=out)
+            for b in breaches:
+                print(f"     [{b.get('kind')}] edge={b.get('edge')} "
+                      f"epoch={b.get('epoch')}: {b.get('detail')}",
+                      file=out)
+            print(f"   first divergence: epoch {first.get('epoch')} "
+                  f"edge {first.get('edge')} [{first.get('kind')}]",
+                  file=out)
+        else:
+            print("   conservation ledger clean", file=out)
+    return 1 if breached else 0
+
+
 def run_golden_ft(out_path: str, perfetto: bool = False) -> int:
     """Run the golden windowed-agg fault-tolerance cycle (embedded
     cluster + seeded faults + recovery) and write its flight recording.
@@ -277,6 +371,11 @@ def main(argv=None) -> int:
     ap.add_argument("--doctor", metavar="JOB",
                     help="render the bottleneck-doctor verdict OFFLINE "
                          "from the input dumps' phase-ledger events")
+    ap.add_argument("--audit", action="store_true",
+                    help="treat inputs as conservation-ledger artifacts "
+                         "(/debug/audit payloads or raw checkpoint-report "
+                         "dumps) and reconcile them offline: per-edge "
+                         "attestation table + first-divergence pointer")
     args = ap.parse_args(argv)
     if args.golden_ft:
         if not args.out:
@@ -293,6 +392,8 @@ def main(argv=None) -> int:
         return 0
     if not args.inputs:
         ap.error("no input dumps given")
+    if args.audit:
+        return audit_report(args.inputs)
     doc = merge(args.inputs)
     if args.job:
         doc["traceEvents"] = filter_job(doc["traceEvents"], args.job)

@@ -202,6 +202,26 @@ MUTANTS: Dict[str, Mutant] = {
                         mutant="serve_reads_unpublished_epoch"),
         ),
         Mutant(
+            name="follower_serves_unpublished_epoch",
+            description=(
+                "follower read-replica invariant (ISSUE 20): a follower "
+                "may LAG the published epoch, never lead it — every "
+                "(re)attach must re-resolve latest.json from storage "
+                "and every tail advances only to a published manifest. "
+                "The mutant reattaches a died follower from the "
+                "controller's in-memory issued-epoch counter instead of "
+                "re-resolving latest.json: the counter is ahead of "
+                "publication whenever a checkpoint is in flight, so the "
+                "reattached follower serves a fanned-out-but-"
+                "unpublished epoch no manifest has made durable."
+            ),
+            expect_violation=VIOLATIONS.REPLICA,
+            config=_cfg(epochs=1, inflight=2, reads=1, faults=1,
+                        followers=1,
+                        fault_kinds=("fault.follower_die",),
+                        mutant="follower_serves_unpublished_epoch"),
+        ),
+        Mutant(
             name="transitions_missing_recovering",
             description=(
                 "state-machine mutant: the CHECKPOINT_STOPPING -> "

@@ -49,6 +49,9 @@ FAULT_MAP: Dict[str, Tuple[str, Optional[dict], Optional[dict],
     "fault.flush_fail": ("storage.write_fail", {"key": "/data/"},
                          None, (1, 3)),
     "fault.reschedule_fail": ("rescale.reschedule_fail", None, None, (1, 1)),
+    # follower death (ISSUE 20): kill the replica's tail loop mid-run —
+    # the gateway must fail over worker-ward with zero wrong values
+    "fault.follower_die": ("replica.kill", None, None, (1, 3)),
     # a zombie's late upload = the blackout above plus storage latency
     # stretching the upload window past the fencing
     "fault.zombie_write": ("storage.latency", {"key": "/data/"},

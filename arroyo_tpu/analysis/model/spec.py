@@ -77,6 +77,10 @@ HANDLER_BINDINGS: Dict[str, Tuple[str, str]] = {
     "failover.arm": ("failover/manager.py", "_arm"),
     "failover.tail": ("failover/manager.py", "_tail"),
     "failover.promote": ("failover/manager.py", "_promote"),
+    "replica.subscribe": ("replica/follower.py", "_subscribe"),
+    "replica.tail": ("replica/follower.py", "_tail"),
+    "replica.serve": ("replica/follower.py", "read"),
+    "replica.detach": ("replica/manager.py", "detach"),
     "state.tail_chains": ("state/table_manager.py", "tail_chains"),
     "worker.capture": ("operators/runner.py", "_checkpoint_chain"),
     "worker.admit_flush": ("operators/runner.py", "_admit_flush"),
@@ -139,6 +143,17 @@ TRANSITION_HANDLERS: Dict[str, Tuple[str, ...]] = {
     "standby.tail": ("failover.tail", "state.tail_chains"),
     "failover.promote": ("ctrl.failover_promote", "failover.promote",
                          "storage.new_generation"),
+    # follower read replicas (ISSUE 20): a follower is structurally a
+    # standby that SERVES instead of waiting to promote — it subscribes
+    # with a read-only restore at the last published manifest, tails
+    # each newly published epoch's delta chain, and answers reads at its
+    # own tailed epoch (never past what storage made durable). Follower
+    # death is non-fatal: the gateway falls back worker-ward, and a
+    # reattach re-resolves latest.json from scratch.
+    "follower.subscribe": ("replica.subscribe",),
+    "follower.tail": ("replica.tail", "state.tail_chains"),
+    "follower.serve": ("replica.serve",),
+    "fault.follower_die": ("replica.detach",),
     "w.capture": ("worker.capture", "worker.admit_flush",
                   "state.capture_tables"),
     "w.flush": ("worker.flush", "state.flush_tables"),
@@ -168,6 +183,7 @@ FAULT_KINDS = (
     "fault.kill", "fault.blackout", "fault.drop_barrier",
     "fault.dup_barrier", "fault.reorder_inbox", "fault.cas_race",
     "fault.fence", "fault.flush_fail", "fault.reschedule_fail",
+    "fault.follower_die",
 )
 # modeled wall-clock deadlines; V_STALL asks that dead-worker detection
 # never REQUIRES one of these
@@ -184,6 +200,7 @@ class ModelConfig(NamedTuple):
     overlap: int = 0          # 1 = rescales use the generation-overlap path
     reads: int = 0            # StateServe reader-actor event budget
     standby: int = 0          # 1 = a hot-standby incarnation may be armed
+    followers: int = 0        # 1 = a read replica may subscribe (ISSUE 20)
     fault_kinds: Tuple[str, ...] = FAULT_KINDS
     mutant: str = ""          # mutants.py flag (empty == faithful model)
 
@@ -224,6 +241,15 @@ class CtrlS(NamedTuple):
     # its tailed restore has reached
     standby: int = 0
     standby_epoch: int = -1
+    # follower read replica (ISSUE 20): 0 = none, 1 = subscribed;
+    # follower_epoch is the published epoch its tailed restore has
+    # reached (-1 = detached). A follower SURVIVES job recovery — it
+    # tails published manifests, which outlive any one incarnation —
+    # so _fail does not reset it. follower_deaths counts fault-driven
+    # detaches (a reattach must re-resolve latest.json from scratch).
+    follower: int = 0
+    follower_epoch: int = -1
+    follower_deaths: int = 0
     failure: str = ""         # latest failure reason (trace readability)
 
 
@@ -280,6 +306,10 @@ class _V:
     DEADLOCK = "deadlock"
     STUCK = "non-terminal-state-cannot-terminate"
     SERVE = "serve-read-inconsistent"
+    # follower read replicas (ISSUE 20): a follower answered a read at
+    # an epoch no published manifest has made durable — the replica
+    # tier's one invariant (it may LAG the published epoch, never lead)
+    REPLICA = "follower-served-unpublished-epoch"
     # generation-overlap rescale: a sink sealed an epoch another
     # generation already made visible — the new incarnation resumed
     # behind the durable rescale checkpoint and re-emitted its output
@@ -532,6 +562,19 @@ class Model:
                             standby_epoch=s.store.latest,
                         )),
                     ))
+            if cfg.followers:
+                if ctrl.follower == 0:
+                    out.append(self._follower_subscribe(s))
+                elif ctrl.follower_epoch < s.store.latest:
+                    # tail: replay the newly published epoch's delta
+                    # chain onto the follower's serve tables (the same
+                    # tail_chains suffix replay the standby uses)
+                    out.append(Step(
+                        "follower.tail", (s.store.latest,),
+                        s._replace(ctrl=ctrl._replace(
+                            follower_epoch=s.store.latest,
+                        )),
+                    ))
 
         if ctrl.js == "CHECKPOINT_STOPPING":
             if ctrl.stop != 2 and ctrl.pending:
@@ -576,6 +619,11 @@ class Model:
                 and ctrl.js in ("RUNNING", "CHECKPOINT_STOPPING",
                                 "RESCALING")):
             out.append(self._serve_read(s))
+            if ctrl.follower == 1:
+                # a subscribed follower keeps serving through stop and
+                # rescale windows — its view is pinned to published
+                # manifests, not to any live incarnation
+                out.append(self._follower_serve(s))
 
         out.extend(self._fault_steps(s))
         for z in s.zombies:
@@ -618,6 +666,56 @@ class Model:
                         f"blob (epoch {e}, worker {widx}, gen {gen})",
                     )
         return Step("serve.read", (epoch,), nxt)
+
+    # -- follower read replica (ISSUE 20) ------------------------------------
+
+    def _follower_subscribe(self, s: Sys) -> Step:
+        """Subscribe (or reattach): the follower resolves the LAST
+        PUBLISHED manifest from storage (latest.json) and restores
+        read-only at it. The `follower_serves_unpublished_epoch` mutant
+        reattaches a died follower from the controller's in-memory
+        issued-epoch counter instead of re-resolving latest.json — a
+        fanned-out-but-unpublished checkpoint nobody made durable."""
+        ctrl = s.ctrl
+        epoch = (ctrl.epoch
+                 if (self.cfg.mutant == "follower_serves_unpublished_epoch"
+                     and ctrl.follower_deaths > 0)
+                 else s.store.latest)
+        return Step(
+            "follower.subscribe", (epoch,),
+            s._replace(ctrl=ctrl._replace(follower=1, follower_epoch=epoch)),
+        )
+
+    def _follower_serve(self, s: Sys) -> Step:
+        """One follower-routed read at the follower's OWN tailed epoch.
+        Faithful model: follower_epoch only ever advances to
+        store.latest, so the served epoch always has a published
+        manifest and a complete blob chain — the invariant is that a
+        follower may lag the published epoch but never lead it."""
+        ctrl, store = s.ctrl, s.store
+        epoch = ctrl.follower_epoch
+        nxt = s._replace(reads=s.reads + 1)
+        if epoch <= 0:
+            return Step("follower.serve", (epoch,), nxt)  # empty view: fine
+        gen = dict(store.manifests).get(epoch)
+        if gen is None:
+            return Step(
+                "follower.serve", (epoch,), None,
+                f"{_V.REPLICA}: follower served epoch {epoch} with no "
+                f"published manifest (last published {store.latest})",
+            )
+        base = dict(store.gen_base).get(gen, 0)
+        blob_keys = set(store.blobs)
+        for widx in range(len(s.workers)):
+            for e in range(base + 1, epoch + 1):
+                if (e, widx, gen) not in blob_keys:
+                    return Step(
+                        "follower.serve", (epoch,), None,
+                        f"{_V.REPLICA}: follower resolved a missing/"
+                        f"fenced blob (epoch {e}, worker {widx}, "
+                        f"gen {gen})",
+                    )
+        return Step("follower.serve", (epoch,), nxt)
 
     def _liveness_masked(self, s: Sys) -> bool:
         if self.cfg.mutant == "no_liveness_in_stop_wait":
@@ -1063,6 +1161,21 @@ class Model:
                 # TaskFailedResp is reliable: the controller reacts
                 out.append(self._fail(failed, "fault.flush_fail",
                                       f"flush-failed-w{widx}"))
+        if (s.ctrl.follower == 1
+                and "fault.follower_die" in cfg.fault_kinds):
+            # follower death is NON-FATAL: the gateway falls back
+            # worker-ward; the job never notices. The budget spend keeps
+            # the die/reattach cycle finite.
+            out.append(Step(
+                "fault.follower_die", (),
+                s._replace(
+                    faults=spend,
+                    ctrl=s.ctrl._replace(
+                        follower=0, follower_epoch=-1,
+                        follower_deaths=s.ctrl.follower_deaths + 1,
+                    ),
+                ),
+            ))
         pend = sorted(s.ctrl.pending)
         if (pend and self._reports_complete(s, pend[0])
                 and s.ctrl.js in ("RUNNING", "CHECKPOINT_STOPPING",
@@ -1144,7 +1257,8 @@ class Model:
                     st.label for st in enabled
                     if st.label not in TIMEOUT_KINDS
                     and not st.label.startswith("fault.")
-                    and st.label != "serve.read"  # reads never unstick
+                    and st.label not in ("serve.read", "follower.serve")
+                    # reads never unstick a dead-worker wait
                 }
                 if not progress:
                     return (f"{_V.STALL}: worker(s) {dead} dead in "

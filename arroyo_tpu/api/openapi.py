@@ -79,8 +79,10 @@ ROUTES: List[Route] = [
      "checkpoint epoch (?key=K; JSON-encoded for non-string keys)",
      "state", None, "StateReadResult"),
     ("post", "/jobs/{job_id}/state/{table}", "job_state_bulk",
-     "Bulk multi-key lookup: keys fan out to their owning workers "
-     "concurrently and merge into one epoch-consistent response",
+     "Bulk multi-key lookup: durable jobs serve follower-first off the "
+     "checkpoint stream (staleness-bounded, zero worker RPCs); "
+     "remaining keys fan out to their owning workers concurrently and "
+     "merge into one epoch-consistent response",
      "state", "StateReadPost", "StateReadResult"),
     ("get", "/jobs/{job_id}/alerts", "job_alerts",
      "Watchtower SLO state of a job: per-rule alert states (ok / "
@@ -417,6 +419,13 @@ def _schemas() -> Dict[str, Any]:
         "StateReadResult": _obj(
             {"job": _str(), "table": _str(),
              "epoch": {**_int(), "nullable": True},
+             # follower replicas (ISSUE 20): the epoch actually served,
+             # its lag behind publication (bounded by
+             # replica.max_lag_epochs — one checkpoint interval), and
+             # which tier answered
+             "served_epoch": {**_int(), "nullable": True},
+             "staleness": _int(),
+             "source": {**_str(), "enum": ["follower", "worker"]},
              "results": {"type": "array", "items": _ref("StateKeyResult")},
              "cache": {"type": "object"}},
             ["results"],

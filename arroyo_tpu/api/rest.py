@@ -493,9 +493,14 @@ class ApiServer:
             return error(404, "job not found")
         job = self.controller.jobs[jid]
         tables = await self.controller.serve.tables(jid)
+        # follower replicas (ISSUE 20): surface whether reads route to
+        # the follower tier and how far it trails publication
+        replicas = getattr(self.controller, "replicas", None)
+        lag = replicas.lag_epochs(job) if replicas is not None else None
         return json_response({
             "data": sorted(tables.values(), key=lambda d: d["table"]),
             "publishedEpoch": job.published_epoch,
+            "replicaLagEpochs": lag,
             "state": job.state.value,
         })
 

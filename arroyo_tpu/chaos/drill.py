@@ -1653,6 +1653,208 @@ def run_failover_drill(seed: int, workdir: str, n_rows: int = 4000,
     )
 
 
+# -- follower replica drill (ISSUE 20: serving-tier death mid-tail) ----------
+
+
+def run_follower_drill(seed: int, workdir: str, n_rows: int = 12000,
+                       rate: int = 1500, timeout: float = 120.0,
+                       ) -> DrillResult:
+    """ISSUE 20 acceptance: follower read-replica death mid-tail.
+
+    One durable replay-deterministic windowed pipeline with a follower
+    tailing its checkpoint stream, read continuously through the REAL
+    serve gateway for the whole run:
+
+      1. fault-free reference with the replica tier OFF — the data
+         plane's byte-identical output baseline (followers are read-only
+         consumers of published state, so the bar is that their
+         existence, death, and reattach change NOTHING downstream).
+      2. follower phase: replica ON, wait until gateway reads route
+         follower-first, then fire the `replica.kill` chaos seam — the
+         follower dies abruptly mid-tail (mounts dropped, no graceful
+         detach). Reads must fail over worker-ward instantly (zero
+         wrong values, zero non-retriable errors), the follower must
+         reattach through the full _subscribe path — re-resolving
+         latest.json, never an in-memory epoch (the
+         follower_serves_unpublished_epoch mutant is the shortcut this
+         forbids) — and reads must come back follower-sourced. Every
+         read's staleness (published epoch minus served) stays <= 1
+         checkpoint interval; the sink output stays byte-identical.
+
+    The read log's source transitions (follower -> worker -> follower),
+    the kill count, and the staleness ceiling land in the drill extras."""
+    from ..config import update
+    from ..controller.controller import ControllerServer
+    from ..controller.scheduler import EmbeddedScheduler
+    from ..controller.state_machine import JobState
+
+    os.makedirs(workdir, exist_ok=True)
+    audit_mark = _audit_mark()
+
+    # 1. fault-free reference, replica off
+    clean_out = os.path.join(workdir, "clean.json")
+    clean_sql = _failover_sql(clean_out, n_rows, rate)
+    assert chaos.installed() is None, "a fault plan is already installed"
+    _run_embedded(
+        clean_sql, "drill-follower-clean", None, 1, 1, max_restarts=0,
+        heartbeat_interval=0.1, heartbeat_timeout=30.0,
+        checkpoint_interval=60.0, timeout=timeout,
+    )
+    want = canonicalize_output(clean_out, clean_sql, {})
+    if not want:
+        raise RuntimeError("follower drill: fault-free run had no output")
+
+    async def faulted():
+        """Follower phase. Returns (stats, canonical output)."""
+        out = os.path.join(workdir, "follower.json")
+        fsql = _failover_sql(out, n_rows, rate)
+        c = await ControllerServer(
+            EmbeddedScheduler(), max_restarts=2
+        ).start()
+        stats = {"follower_reads": 0, "worker_reads": 0,
+                 "staleness_max": 0, "wrong": 0, "fatal": 0,
+                 "kills": 0, "reattached": False}
+        try:
+            await c.submit_job(
+                "drill-follower", sql=fsql,
+                storage_url=os.path.join(workdir, "follower-ck"),
+                n_workers=1, parallelism=1,
+            )
+            await c.wait_for_state("drill-follower", JobState.RUNNING,
+                                   timeout=30)
+
+            async def read_table():
+                tabs = await c.serve.tables("drill-follower")
+                for name, info in tabs.items():
+                    if info.get("kind") == "window":
+                        return name
+                return None
+
+            loop = asyncio.get_event_loop()
+            table = None
+            deadline = loop.time() + 30.0
+            while table is None and loop.time() < deadline:
+                table = await read_table()
+                if table is None:
+                    await asyncio.sleep(0.1)
+            if table is None:
+                raise RuntimeError("no serve table ever listed")
+
+            async def read_once():
+                """One 4-key gateway read; folds into stats, returns
+                the response's source ('' on a non-200 response)."""
+                resp = await c.serve.read("drill-follower", table,
+                                          [0, 1, 2, 3])
+                if resp.get("status") != 200:
+                    if not resp.get("retriable", True):
+                        stats["fatal"] += 1
+                    return ""
+                src = resp.get("source", "")
+                key = {"follower": "follower_reads",
+                       "worker": "worker_reads"}.get(src)
+                if key:
+                    stats[key] += 1
+                stats["staleness_max"] = max(
+                    stats["staleness_max"], int(resp.get("staleness", 0)))
+                for r in resp.get("results", []):
+                    v = r.get("value") or {}
+                    cnt = next((x for f, x in v.items()
+                                if f.startswith("__agg_out")
+                                or f == "cnt"), None)
+                    if r.get("found") and cnt is not None and cnt > rate:
+                        stats["wrong"] += 1  # > 1 s of events in 500 ms
+                return src
+
+            async def wait_source(srcname: str, secs: float) -> bool:
+                end = loop.time() + secs
+                while loop.time() < end:
+                    if await read_once() == srcname:
+                        return True
+                    await asyncio.sleep(0.05)
+                return False
+
+            # (a) reads go follower-first once the mount catches up
+            if not await wait_source("follower", 30.0):
+                raise RuntimeError(
+                    f"reads never follower-routed: {c.replicas.status()}")
+            # (b) abrupt follower death mid-tail via the chaos seam
+            kp = FaultPlan(seed)
+            kp.add("replica.kill", at_hits=(1,))
+            chaos.install(kp)
+            deadline = loop.time() + 20.0
+            while c.replicas.kills < 1 and loop.time() < deadline:
+                await read_once()
+                await asyncio.sleep(0.05)
+            chaos.clear()
+            if c.replicas.kills < 1:
+                raise RuntimeError("replica.kill never fired")
+            stats["kills"] = c.replicas.kills
+            # (c) worker-ward fallback serves while the follower is down
+            if not await wait_source("worker", 10.0):
+                raise RuntimeError(
+                    "no worker-ward fallback read after the kill")
+            # (d) reattach: back through _subscribe off latest.json
+            stats["reattached"] = await wait_source("follower", 30.0)
+            if not stats["reattached"]:
+                raise RuntimeError(
+                    f"follower never reattached: {c.replicas.status()}")
+            # keep reading to the finish line: staleness and value
+            # checks must hold for the job's whole life
+            while not c.jobs["drill-follower"].state.is_terminal():
+                await read_once()
+                await asyncio.sleep(0.1)
+            state = c.jobs["drill-follower"].state
+            if state != JobState.FINISHED:
+                raise RuntimeError(
+                    f"follower drill job failed: "
+                    f"{c.jobs['drill-follower'].failure}")
+            return stats, canonicalize_output(out, fsql, {})
+        finally:
+            chaos.clear()
+            await c.stop()
+
+    error = None
+    stats: dict = {}
+    got: list = []
+    try:
+        with update(
+            replica={"followers": 1, "reattach_backoff": 0.5},
+            worker={"heartbeat_interval": 0.05},
+            controller={"heartbeat_timeout": 2.0},
+            pipeline={"checkpointing": {"interval": 0.25}},
+        ):
+            stats, got = asyncio.run(faulted())
+        if got != want:
+            error = (f"follower phase diverged: {len(got)} rows vs "
+                     f"{len(want)} fault-free")
+        elif stats["wrong"]:
+            error = f"{stats['wrong']} wrong values served"
+        elif stats["fatal"]:
+            error = f"{stats['fatal']} non-retriable read errors"
+        elif stats["staleness_max"] > 1:
+            error = (f"staleness {stats['staleness_max']} epochs exceeds "
+                     "one checkpoint interval")
+    except Exception as e:  # noqa: BLE001 - recorded in the result
+        error = repr(e)
+
+    passed = error is None
+    passed, error, audit_breaches = _audit_verdict(audit_mark, passed, error)
+    return DrillResult(
+        query="follower_replica_kill",
+        seed=seed,
+        passed=passed,
+        rows=len(want),
+        restarts=0,
+        fired=[],
+        comparable_log=[],
+        expected_log=[],
+        unfired=[],
+        error=error,
+        extras=stats or None,
+        audit_breaches=audit_breaches,
+    )
+
+
 # -- event-loop starvation drill (ISSUE 18: the double-emit watch item) ------
 
 

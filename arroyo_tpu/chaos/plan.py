@@ -135,6 +135,13 @@ FAULT_POINTS: Dict[str, str] = {
         "generation appending a new epoch past its fencing -> "
         "zombie_generation flagged at intake, report fenced"
     ),
+    # follower read replicas (replica/manager.py)
+    "replica.kill": (
+        "replica/manager.py ReplicaManager._tail_one — detach the "
+        "follower abruptly mid-tail (views dropped, subscription gone; "
+        "the serve gateway must fail over worker-ward with zero wrong "
+        "values and zero fatal reads until a reattach catches back up)"
+    ),
     # checkpoint protocol (state/protocol.py)
     "protocol.fenced_zombie": (
         "state/protocol.py check_current — treat the caller's generation "

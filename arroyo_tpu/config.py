@@ -361,6 +361,12 @@ class WatchConfig:
     # built-in SLO: sustained flight-recorder span drops per second
     # (arroyo_trace_dropped_spans_total windowed rate)
     trace_drop_rate: float = 1.0
+    # built-in SLO: follower read-replica lag in epochs behind
+    # publication (arroyo_replica_lag_epochs). 1 is the healthy
+    # in-flight-tail transient, so the default pages only a STUCK
+    # follower; the rule's sustain window supplies the time dimension,
+    # and it is suppressed inside failover.grace like freshness
+    replica_lag_epochs: float = 1.5
     # per-tenant / per-job rule overrides, inline JSON or a JSON file
     # path: {"tenant:<t>"|"job:<id>": {"<rule>": {"threshold": ...,
     # "clear": ..., "sustain": ..., "clear_sustain": ...,
@@ -589,6 +595,39 @@ class FailoverConfig:
 
 
 @dataclasses.dataclass
+class ReplicaConfig:
+    """Follower read replicas (ISSUE 20, arroyo_tpu/replica): a serving
+    tier off the checkpoint stream. Controller-managed read-only restore
+    loops subscribe to each durable job's published manifests and tail
+    the per-(table, subtask) delta-chain suffix (the PR 17 tail path),
+    materializing epoch-stamped ServeViews identical to the worker-side
+    ones. The serve gateway routes point/bulk lookups to followers by
+    default — worker fan-out remains only for live (non-durable) jobs
+    and tables a follower has not caught up on — so read QPS stops
+    contending with batch throughput on the compute workers. A follower
+    may LAG publication, never lead it: every (re)attach re-resolves
+    latest.json (modeled first: analysis/model/spec.py follower.* and
+    the follower_serves_unpublished_epoch mutant)."""
+
+    # master switch for follower routing: off = the gateway never
+    # consults the replica tier (worker fan-out as in PR 12). Followers
+    # also need `followers` > 0 to exist at all.
+    enabled: bool = True
+    # number of follower serving loops the controller hosts. 0 (the
+    # default) disables the tier entirely; each durable job's serve
+    # tables are mounted on exactly one follower (least-loaded).
+    followers: int = 0
+    # maximum follower lag, in epochs, the gateway will serve at. A
+    # follower more than this many epochs behind the published epoch
+    # falls back worker-ward for that read — which is what bounds every
+    # reported per-read staleness at one checkpoint interval by default.
+    max_lag_epochs: int = 1
+    # seconds between a failed subscribe/tail and the next reattach
+    # attempt for that job (mirrors failover's re-arm backoff)
+    reattach_backoff: float = 2.0
+
+
+@dataclasses.dataclass
 class ClusterConfig:
     """Multi-tenant control plane (ROADMAP item 3): a shared worker pool
     hosting subtasks of MANY jobs per worker process — one event loop and
@@ -752,7 +791,8 @@ class Config:
     injection), obs (flight recorder), tpu (device
     kernels + mesh), controller, rescale (generation-overlap
     zero-downtime rescale), failover (hot-standby generations +
-    task-local recovery), cluster (shared worker pool /
+    task-local recovery), replica (follower read replicas serving off
+    the checkpoint stream), cluster (shared worker pool /
     multiplexing), admission (tenant quotas + fair slot scheduling),
     sharing (shared-plan multi-tenancy: fingerprint-matched jobs mount
     one source scan), worker, api, admin, database, logging. `tools/lint.py
@@ -774,6 +814,7 @@ class Config:
     sharing: SharingConfig = dataclasses.field(default_factory=SharingConfig)
     rescale: RescaleConfig = dataclasses.field(default_factory=RescaleConfig)
     failover: FailoverConfig = dataclasses.field(default_factory=FailoverConfig)
+    replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     worker: WorkerConfig = dataclasses.field(default_factory=WorkerConfig)

@@ -314,6 +314,13 @@ class ControllerServer:
         from ..failover import StandbyManager
 
         self.failover = StandbyManager(self)
+        # follower read replicas (ISSUE 20): controller-hosted serving
+        # tier tailing each durable job's published delta chains — the
+        # gateway routes reads follower-first, worker fan-out becomes
+        # the fallback
+        from ..replica import ReplicaManager
+
+        self.replicas = ReplicaManager(self)
         self._reg_waiters: set = set()  # scheduling waits on registration
         # handles pruned on suspicion of death, kept so a heartbeat
         # re-registration can resurrect the SAME object — jobs hold
@@ -377,6 +384,7 @@ class ControllerServer:
                 "/debug/watch": self._debug_watch,
                 "/debug/sharing": self._debug_sharing,
                 "/debug/failover": self._debug_failover,
+                "/debug/replica": self._debug_replica,
                 "/debug/audit": self._debug_audit,
             },
         )
@@ -459,6 +467,17 @@ class ControllerServer:
 
         return web.json_response(
             self.failover.status(),
+            dumps=lambda d: json.dumps(d, default=str),
+        )
+
+    async def _debug_replica(self, request):
+        """Admin surface: follower read-replica state — per-follower
+        mounts with served epochs and view sizes, job assignments, kill
+        count, and in-flight subscribes/tails."""
+        from aiohttp import web
+
+        return web.json_response(
+            self.replicas.status(),
             dumps=lambda d: json.dumps(d, default=str),
         )
 
@@ -624,6 +643,9 @@ class ControllerServer:
             # report is the controller's (and the serving tier's) only
             # view of publication progress
             job.published_epoch = max(job.published_epoch, req["epoch"])
+            # follower replicas tail off publication regardless of who
+            # publishes — worker-leader jobs get the same serving tier
+            self.replicas.note_publish(job)
             job.kick()
         return {}
 
@@ -818,6 +840,11 @@ class ControllerServer:
             # per-job promotion bookkeeping
             await self.failover.discard(job)
             self.failover.on_job_expunged(job.job_id)
+            # follower replicas (ISSUE 20): a terminal job unmounts from
+            # its follower; the job-labeled arroyo_replica_* series ride
+            # the drop_job below
+            self.replicas.detach(job.job_id)
+            self.replicas.on_job_expunged(job.job_id)
             # shared-plan detach (ISSUE 16): a terminal tenant releases
             # its mount (the LAST one stops the host); a terminal host
             # drops its bus channel
@@ -1242,6 +1269,9 @@ class ControllerServer:
             # hot-standby failover (ISSUE 17): keep a warm standby armed
             # for every eligible job (no-op guard off the failover path)
             self.failover.note_running(job)
+            # follower replicas (ISSUE 20): keep each eligible job
+            # mounted on a follower (reattaches after follower death)
+            self.replicas.note_running(job)
             # park: RPC arrivals kick the job; the wheel wakes us at the
             # earliest deadline that could change a predicate above
             deadlines = [self._heartbeat_horizon(job)]
@@ -1776,6 +1806,9 @@ class ControllerServer:
         # failover (ISSUE 17): wake the standby's tailer so it applies
         # this epoch's delta chains and stays within one epoch of us
         self.failover.note_publish(job)
+        # follower replicas (ISSUE 20): same wake for the serving tier's
+        # tailer — follower staleness stays <= one checkpoint interval
+        self.replicas.note_publish(job)
         try:
             committing = manifest.get("committing")
             if committing and job.backend.claim_commit(epoch):

@@ -510,6 +510,37 @@ SERVE_CACHE_MISSES = REGISTRY.counter(
     "arroyo_serve_cache_misses_total",
     "reads that fanned out to a worker (cold key, epoch-invalidated "
     "entry, or cache disabled)")
+SERVE_WORKER_RPCS = REGISTRY.counter(
+    "arroyo_serve_worker_rpcs_total",
+    "QueryState RPCs the gateway issued to workers per job — the "
+    "follower tier's headline win: ~0 for durable jobs once followers "
+    "are caught up (the fleet harness asserts it)")
+# Follower read replicas (ISSUE 20): controller-hosted serving tier off
+# the checkpoint stream. Every family is job-labeled so Registry.
+# drop_job GCs a stopped job's replica series with the rest (the fleet
+# churn test asserts it); staleness is the replica_staleness SLO input.
+REPLICA_TAILS = REGISTRY.counter(
+    "arroyo_replica_tails_total",
+    "delta-chain suffix tails applied by followers per job (one per "
+    "published epoch caught up, per mounted job)")
+REPLICA_SERVED_EPOCH = REGISTRY.gauge(
+    "arroyo_replica_served_epoch",
+    "the epoch a job's follower currently serves at (its last fully "
+    "tailed published manifest)")
+REPLICA_LAG_EPOCHS = REGISTRY.gauge(
+    "arroyo_replica_lag_epochs",
+    "published_epoch - follower served epoch per job: 0 when caught "
+    "up, transiently 1 while a tail is in flight; > max_lag_epochs "
+    "routes reads worker-ward and feeds the replica_staleness SLO")
+REPLICA_LOOKUPS = REGISTRY.counter(
+    "arroyo_replica_lookups_total",
+    "individual key lookups answered from follower views per job (the "
+    "fleet harness's serve_follower_lookup_eps reads this)")
+REPLICA_SUBSCRIBES = REGISTRY.counter(
+    "arroyo_replica_subscribes_total",
+    "follower (re)attach restores per job — 1 at mount, +1 per "
+    "post-death reattach (each re-resolves latest.json from storage; "
+    "see the follower_serves_unpublished_epoch model mutant)")
 # Watchtower (ISSUE 13): retained history + per-job SLO engine. The
 # alert counter is job-labeled (drop_job GCs it); published-epoch is the
 # gauge the checkpoint-age SLO watches for stalls; the trace-drop

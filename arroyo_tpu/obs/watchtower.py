@@ -60,6 +60,7 @@ _SERVE = "arroyo_serve_request_seconds"
 _LOOP_LAG = "arroyo_worker_loop_lag_seconds"
 _TRACE_DROPS = "arroyo_trace_dropped_spans_total"
 _AUDIT_BREACHES = "arroyo_audit_breaches_total"
+_REPLICA_LAG = "arroyo_replica_lag_epochs"
 
 
 @dataclasses.dataclass
@@ -189,6 +190,20 @@ def sig_conservation(ctx: SLOContext) -> Optional[float]:
     return audit.breach_count(ctx.job_id)
 
 
+def sig_replica_staleness(ctx: SLOContext) -> Optional[float]:
+    """Follower read-replica lag (ISSUE 20): epochs the job's follower
+    trails publication (arroyo_replica_lag_epochs). Transiently 1 while
+    a tail is in flight — the threshold defaults above that so only a
+    STUCK follower (storage trouble, death/reattach loop) pages, with
+    the rule's sustain window supplying the time dimension. Abstains
+    for jobs with no mounted follower (no series)."""
+    vals = [
+        s.latest() for s in ctx.history.get(_REPLICA_LAG, job=ctx.job_id)
+    ]
+    vals = [float(v) for v in vals if v is not None]
+    return max(vals) if vals else None
+
+
 def sig_trace_drops(ctx: SLOContext) -> Optional[float]:
     rates = [
         r for r in (
@@ -253,6 +268,9 @@ BUILTIN_RULES: Tuple[tuple, ...] = (
     ("conservation", "exactly-once conservation breaches (audit ledger)",
      sig_conservation, "above", "conservation_breaches", _AUDIT_BREACHES,
      "count"),
+    ("replica_staleness", "follower epochs behind publication",
+     sig_replica_staleness, "above", "replica_lag_epochs", _REPLICA_LAG,
+     "epochs"),
 )
 
 
@@ -493,8 +511,11 @@ class Watchtower:
     # rules a hot-standby promotion legitimately blips (ISSUE 17): the
     # promoted incarnation's watermarks and latency markers start from
     # its tailed state and catch up within the failover.grace window —
-    # paging on that would page on every successful sub-second failover
-    _FAILOVER_GRACE_RULES = ("freshness", "e2e_p99")
+    # paging on that would page on every successful sub-second failover.
+    # replica_staleness joins them (ISSUE 20): the promoted generation
+    # publishes under a fresh manifest lineage the follower re-tails,
+    # so its lag legitimately spikes for the same bounded window.
+    _FAILOVER_GRACE_RULES = ("freshness", "e2e_p99", "replica_staleness")
 
     def _in_failover_grace(self, job_id: str) -> bool:
         fo = getattr(self.controller, "failover", None)

@@ -232,9 +232,14 @@ class SubtaskRunner:
                 # open tables until promotion releases the gate
                 with obs.span("task.standby_arm", cat="runner",
                               task=self.task_info.task_id):
+                    from ..serve import serve_mirror_tables
+
                     for op, ctx in zip(self.ops, self.ctxs):
                         if ctx.table_manager is not None:
-                            await ctx.table_manager.open(op.tables())
+                            await ctx.table_manager.open({
+                                **op.tables(),
+                                **serve_mirror_tables(op, self.task_info),
+                            })
                 await self.standby_gate.wait()
             # under the job.schedule trace (context inherited at task
             # spawn): table restore + operator on_start become visible
@@ -242,11 +247,17 @@ class SubtaskRunner:
             with obs.span("task.start", cat="runner",
                           task=self.task_info.task_id) as sp:
                 from ..serve import register_op as serve_register
+                from ..serve import serve_mirror_tables
 
                 for idx, (op, ctx) in enumerate(zip(self.ops, self.ctxs)):
                     if (ctx.table_manager is not None
                             and self.standby_gate is None):
-                        await ctx.table_manager.open(op.tables())
+                        # viewed operators additionally open the
+                        # `__serve__` mirror table followers tail
+                        await ctx.table_manager.open({
+                            **op.tables(),
+                            **serve_mirror_tables(op, self.task_info),
+                        })
                     sp.event("on_start", op=type(op).__name__, op_idx=idx)
                     await op.on_start(ctx)
                     # StateServe: keyed operators expose an epoch-
@@ -749,7 +760,7 @@ class SubtaskRunner:
                 # epoch at the same synchronization point the state
                 # capture stamps dirty entries — reads at published
                 # epoch P then see exactly P's durable view
-                seal_op(op, barrier.epoch)
+                seal_op(op, barrier.epoch, ctx.table_manager)
                 if ctx.table_manager is not None:
                     captured.append(
                         (

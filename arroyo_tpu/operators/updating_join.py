@@ -476,6 +476,57 @@ class UpdatingJoinOperator(Operator):
                 self._col_cache = [None, None]
         return watermark
 
+    def serve_stage_snapshot(self, view) -> None:
+        """Serve the join's current row set per key (ISSUE 20
+        satellite). Called by seal_op at checkpoint capture: each key's
+        joined rows — cross product when both sides match, null-padded
+        per outer semantics otherwise — stage as `{"rows": [...]}`
+        with output field names, the same shape a sink would
+        accumulate. Snapshot cost is O(state), which is already this
+        operator's per-checkpoint norm (handle_checkpoint puts the
+        whole store). Keys whose row set vanished since the last
+        capture are tombstoned; null-component keys are skipped (null
+        never equals anything, so no row can join on it). register_op
+        refuses residual joins a view entirely (see _view_plan)."""
+        from ..serve.store import _plain
+
+        left_outer = self.join_type in ("left", "full")
+        right_outer = self.join_type in ("right", "full")
+        prev = getattr(self, "_serve_join_keys", set())
+        cur: set = set()
+        for key in set(self.state[0]) | set(self.state[1]):
+            if any(k is None for k in key):
+                continue
+            l_rows = self.state[0].get(key, [])
+            r_rows = self.state[1].get(key, [])
+            rows: List[dict] = []
+            if l_rows and r_rows:
+                for l in l_rows:
+                    for r in r_rows:
+                        row = dict(zip(self.left_out, l))
+                        row.update(zip(self.right_out, r))
+                        rows.append(row)
+            elif l_rows and left_outer:
+                pad = dict.fromkeys(self.right_out)
+                for l in l_rows:
+                    rows.append({**dict(zip(self.left_out, l)), **pad})
+            elif r_rows and right_outer:
+                pad = dict.fromkeys(self.left_out)
+                for r in r_rows:
+                    rows.append({**pad, **dict(zip(self.right_out, r))})
+            if not rows:
+                continue  # inner join with a lone side: nothing visible
+            ck = view.canon_key(key)
+            view.stage(
+                ck,
+                {"rows": [{f: _plain(v) for f, v in r.items()}
+                          for r in rows]},
+            )
+            cur.add(ck)
+        for ck in prev - cur:
+            view.stage_tomb(ck)
+        self._serve_join_keys = cur
+
     # -- output -------------------------------------------------------------
 
     def _build(self, rows: List[tuple], is_retract: bool, ts: int):

@@ -562,6 +562,7 @@ class WindowOperatorBase(Operator):
         end: int,
         ts_value: Optional[int] = None,
         key_arrays: Optional[List[np.ndarray]] = None,
+        serve_stage: bool = True,
     ) -> pa.RecordBatch:
         """Build an output batch for one window [start, end). `key_arrays`
         (one int64 array per key column, raw directory bit-patterns) is the
@@ -677,10 +678,12 @@ class WindowOperatorBase(Operator):
                 else:
                     arrays.append(pa.array(col.astype(np.int64), type=f.type))
         out = pa.RecordBatch.from_arrays(arrays, schema=self.out_schema.schema)
-        if self._serve_view is not None:
+        if serve_stage and self._serve_view is not None:
             # StateServe: mirror the emitted window results into the
             # serve view's stage buffer (sealed at the next checkpoint
-            # capture; reads see them once that epoch publishes)
+            # capture; reads see them once that epoch publishes).
+            # serve_stage=False is the session-partial snapshot path,
+            # which stages its batch itself with the partial flag set.
             from ..serve import stage_batch
 
             stage_batch(self._serve_view, out)
@@ -1259,6 +1262,14 @@ class SessionWindowOperator(WindowOperatorBase):
         # cost is O(touched sessions), not O(live sessions)
         self._ckpt_dirty: set = set()
         self._ckpt_dead: set = set()
+        # serve partial staging (ISSUE 20) follows the same delta
+        # discipline: only keys whose sessions changed since the last
+        # capture are re-staged as partials (unchanged partials persist
+        # in the cumulative view/mirror), so the capture span stays
+        # O(touched sessions) under growing live-session counts
+        self._serve_dirty: set = set()
+        self._serve_dead: set = set()
+        self._serve_partial_keys: set = set()
         self._next_shard = 0
         # block-refilled slot pool: one vectorized alloc_slots call per
         # _POOL_BLOCK sessions instead of one Python directory call per
@@ -1306,6 +1317,7 @@ class SessionWindowOperator(WindowOperatorBase):
             # per-subtask snapshot — there is no key to partition by
             for snap in _snaps_for_me(table, ctx, False):
                 self._restore_sessions(snap, ctx)
+            self._serve_dirty.update(self.sessions)
             return
         legacy, per_key = [], []
         for k, v in table.items():
@@ -1326,9 +1338,15 @@ class SessionWindowOperator(WindowOperatorBase):
         # everything restored re-persists at the first post-restore epoch
         # (covers legacy-format upgrades and the pruned replicas)
         self._ckpt_dirty.update(self.sessions)
+        self._serve_dirty.update(self.sessions)
 
     async def handle_checkpoint(self, barrier, ctx, collector):
         self._return_pool()
+        if self._serve_view is None:
+            # no attached view consumes the serve delta sets; keep them
+            # bounded on unviewed jobs
+            self._serve_dirty.clear()
+            self._serve_dead.clear()
         if ctx.table_manager is None:
             return
         table = await ctx.table("sess")
@@ -1498,6 +1516,8 @@ class SessionWindowOperator(WindowOperatorBase):
                 )
                 self._ckpt_dirty.add(key)
                 self._ckpt_dead.discard(key)
+                self._serve_dirty.add(key)
+                self._serve_dead.discard(key)
             row_slots[li[order]] = seg_slots[seg_id]
         keep = row_slots >= 0
         if keep.any():
@@ -1561,6 +1581,76 @@ class SessionWindowOperator(WindowOperatorBase):
         a[0] = min(a[0], b[0])
         a[1] = max(a[1], b[1])
 
+    def serve_stage_snapshot(self, view) -> None:
+        """Serve OPEN sessions as partials (ISSUE 20 satellite). Called
+        by seal_op inside the checkpoint capture span. Delta-staged:
+        only keys whose sessions changed since the last capture — new
+        events, merges, expiries, tracked in `_serve_dirty` beside the
+        incremental-checkpoint sets — are re-gathered and re-staged
+        flagged `partial: True` (end is the would-be close `last_ts +
+        gap`), so point reads — worker- and follower-side alike — see
+        in-flight sessions at the published epoch instead of a 404
+        until the gap closes. Unchanged partials persist in the
+        cumulative view/mirror, keeping capture cost O(touched
+        sessions) rather than O(live sessions) — the state-bloat
+        flatness gate depends on this. Requires a side-effect-free
+        `gather`; mesh-fused accumulators expose only gather_and_reset,
+        so they skip partials (a documented known limit — finals are
+        unaffected). A key whose sessions all closed since the last
+        capture is tombstoned ONLY if no final landed in this barrier
+        interval, so partials never clobber a just-emitted final."""
+        gather = getattr(self.acc, "gather", None)
+        prev = getattr(self, "_serve_partial_keys", set())
+        if gather is None:
+            return
+        dirty = getattr(self, "_serve_dirty", None)
+        delta = dirty is not None
+        if not delta:
+            # stub operators (tests) without the delta sets: stage the
+            # full open set and diff against prev for tombs
+            dirty = set(self.sessions)
+        dead = getattr(self, "_serve_dead", set())
+        keys: List[tuple] = []
+        starts: List[int] = []
+        ends: List[int] = []
+        slots: List[int] = []
+        for key in dirty:
+            for s in self.sessions.get(key, ()):
+                # one row per session; staging overwrites per key, so a
+                # multi-session key serves its latest (max-start) session
+                keys.append(key)
+                starts.append(s[0])
+                ends.append(s[1] + self.gap)
+                slots.append(s[2])
+        staged: set = set()
+        if keys:
+            from ..serve import stage_batch
+
+            slot_arr = np.asarray(slots, dtype=np.int64)
+            agg_cols = self.acc.finalize(gather(slot_arr))
+            out = self._build_output(
+                keys, agg_cols,
+                np.asarray(starts, dtype=np.int64),
+                np.asarray(ends, dtype=np.int64),
+                serve_stage=False,
+            )
+            staged = set(stage_batch(view, out, partial=True))
+        gone = (prev & dead) if delta else (prev - staged)
+        for k in gone:
+            if view.has_staged(k):
+                continue  # a final landed this interval; keep it
+            if view.live_mode:
+                v = view.served.get(k)
+                if not (isinstance(v, dict) and v.get("partial")):
+                    continue
+            view.stage_tomb(k)
+        # gone keys leave the partial set either way: tombed, or their
+        # staged row this interval is a final, no longer a partial
+        self._serve_partial_keys = (prev - gone) | staged
+        if delta:
+            dirty.clear()
+            dead.clear()
+
     async def handle_watermark(self, watermark, ctx, collector):
         if watermark.kind != WatermarkKind.EVENT_TIME:
             return watermark
@@ -1588,11 +1678,16 @@ class SessionWindowOperator(WindowOperatorBase):
                 self.sessions[key] = remaining
                 if expired_any:
                     self._ckpt_dirty.add(key)
+                    # the expiry final overwrote the key's served
+                    # partial; re-stage the still-open session
+                    self._serve_dirty.add(key)
             else:
                 del self.sessions[key]
                 if expired_any:
                     self._ckpt_dead.add(key)
                     self._ckpt_dirty.discard(key)
+                    self._serve_dead.add(key)
+                    self._serve_dirty.discard(key)
         if exp_slots:
             slot_arr = np.asarray(exp_slots, dtype=np.int64)
             fused = getattr(self.acc, "gather_and_reset", None)

@@ -1,0 +1,38 @@
+"""Follower read replicas (ISSUE 20): a serving tier off the
+checkpoint stream.
+
+Workers mirror every serve view's sealed rows into a `__serve__`
+GlobalTable inside the SAME epoch's delta chain as the operator state
+(serve/store.py seal_op). A follower is a controller-hosted, READ-ONLY
+restore loop over that chain — structurally PR 17's standby (restore
+once, then `TableManager.tail_chains` the published suffix per epoch),
+except it serves instead of waiting to promote:
+
+  * `follower.py` — one follower's mounts: per durable job a
+    generation-less `StateBackend` (NEVER `initialize()` — claiming a
+    generation would fence the primary), one `TableManager` per
+    (node, op) that published a `__serve__` table, and epoch-stamped
+    `ServeView`s rebuilt from the mirrored rows + the `__serve_meta__`
+    describe record — identical in shape to the worker-side views, so
+    the gateway's merge/canon/read code does not fork.
+  * `manager.py` — the controller-side lifecycle: mount each eligible
+    job on the least-loaded follower, coalesced suffix tails on every
+    manifest publish (the StandbyManager pattern), abrupt-death chaos
+    seam (`replica.kill`), graceful detach on job terminal states, and
+    the job-labeled `arroyo_replica_*` metric families.
+
+The one invariant everything here defends: a follower may LAG
+publication, never lead it. Every (re)attach re-resolves `latest.json`
+from storage and every tail advances only to a manifest read back from
+storage — never a controller in-memory counter (see the
+`follower_serves_unpublished_epoch` model mutant and the `follower.*`
+actor in analysis/model/spec.py, which models this tier exhaustively).
+The gateway routes durable-job reads follower-first with per-read
+staleness `published_epoch - served_epoch`, bounded at
+`replica.max_lag_epochs` (one checkpoint interval); beyond the bound —
+or after a follower death — reads fall back worker-ward, never to a
+wrong value.
+"""
+
+from .follower import Follower  # noqa: F401 - public surface
+from .manager import ReplicaManager  # noqa: F401

@@ -1,0 +1,311 @@
+"""ReplicaManager: mount, tail, and serve follower read replicas.
+
+Lifecycle per durable job (all driven off the controller's event loop,
+the StandbyManager pattern):
+
+  mount  — on every _run pass (note_running), an eligible job with no
+           mount gets assigned the least-loaded follower and a
+           subscribe guard restores its serve tables from the latest
+           PUBLISHED manifest (Follower._subscribe: read-only, no
+           generation claim — a follower can never fence the primary).
+
+  tail   — on each manifest publish (note_publish), a coalesced tail
+           guard replays the delta-chain suffix onto the mount
+           (Follower._tail), keeping follower lag at <= 1 checkpoint
+           interval at delta cost. `replica.kill` is the chaos seam
+           here: the drill detaches the follower abruptly mid-tail and
+           asserts the gateway fails over worker-ward with zero wrong
+           values; reattach goes back through _subscribe, re-resolving
+           latest.json (the follower_serves_unpublished_epoch mutant
+           is the reattach shortcut this forbids).
+
+  serve  — the gateway calls route(job, table): the mounted view when
+           follower lag <= replica.max_lag_epochs, else None
+           (worker-ward fallback). tables_meta answers the gateway's
+           table listing from the mirrored describe records, so durable
+           jobs' serve traffic needs ZERO worker QueryState RPCs.
+
+  detach — on job stop/expunge/terminal states: drop the mount and all
+           pending work. Metrics are job-labeled; Registry.drop_job on
+           the expunge path GCs the arroyo_replica_* series.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from .. import chaos
+from ..analysis.model.effects import protocol_effect
+from ..analysis.races.sanitizer import set_task_root
+from ..config import config
+from ..metrics import (
+    REPLICA_LAG_EPOCHS,
+    REPLICA_SERVED_EPOCH,
+    REPLICA_SUBSCRIBES,
+    REPLICA_TAILS,
+)
+from ..utils.logging import get_logger
+from .follower import Follower
+
+logger = get_logger("replica")
+
+
+class ReplicaManager:
+    def __init__(self, ctrl):
+        self.ctrl = ctrl
+        self.followers: List[Follower] = []
+        self._assign: Dict[str, int] = {}     # job -> follower index
+        self._sub_tasks: Dict[str, asyncio.Task] = {}
+        self._tail_tasks: Dict[str, asyncio.Task] = {}
+        self._tail_pending: Dict[str, int] = {}
+        self._next_attach: Dict[str, float] = {}
+        self.kills = 0
+
+    # -- eligibility / mounting ----------------------------------------------
+
+    def eligible(self, job) -> bool:
+        cfg = config()
+        return (
+            cfg.replica.enabled
+            and int(cfg.replica.followers) > 0
+            and job.backend is not None   # durable jobs only
+            and job.mount is None         # tenants ride their host's views
+            and not job.stop_requested
+        )
+
+    def _ensure_followers(self) -> None:
+        want = int(config().replica.followers)
+        while len(self.followers) < want:
+            self.followers.append(Follower(len(self.followers)))
+
+    def _mount(self, jid: str):
+        idx = self._assign.get(jid)
+        if idx is None or idx >= len(self.followers):
+            return None
+        return self.followers[idx].mounts.get(jid)
+
+    def note_running(self, job):
+        """Called on every _run pass: keep each eligible job mounted on
+        exactly one follower (or one subscribe attempt in flight). Cheap
+        no-op guard on the non-replica path."""
+        if not self.eligible(job):
+            return
+        self._ensure_followers()
+        jid = job.job_id
+        if jid in self._sub_tasks or self._mount(jid) is not None:
+            return
+        if time.monotonic() < self._next_attach.get(jid, 0.0):
+            return
+        idx = self._assign.get(jid)
+        if idx is None or idx >= len(self.followers):
+            idx = min(
+                range(len(self.followers)),
+                key=lambda i: (len(self.followers[i].mounts), i),
+            )
+            self._assign[jid] = idx
+        self._sub_tasks[jid] = asyncio.ensure_future(
+            self._subscribe_guard(job, idx)
+        )
+
+    async def _subscribe_guard(self, job, idx: int):
+        jid = job.job_id
+        set_task_root(f"replica-subscribe:{jid}")
+        try:
+            ok = await self.followers[idx]._subscribe(jid, job.storage_url)
+            if not ok:
+                # nothing published yet — back off and retry later
+                self._next_attach[jid] = (
+                    time.monotonic() + config().replica.reattach_backoff
+                )
+                return
+            REPLICA_SUBSCRIBES.labels(job=jid).inc()
+            self._gauges(job)
+            # catch up anything published while the restore ran
+            self.note_publish(job)
+        except Exception as e:  # noqa: BLE001 - mounting is best-effort
+            logger.warning("follower subscribe for %s failed: %r", jid, e)
+            self._next_attach[jid] = (
+                time.monotonic() + config().replica.reattach_backoff
+            )
+        finally:
+            self._sub_tasks.pop(jid, None)
+            job.kick()
+
+    # -- tailing -------------------------------------------------------------
+
+    def note_publish(self, job):
+        """Called after each manifest publish: schedule a (coalesced)
+        suffix tail of the new epoch onto the job's mount."""
+        jid = job.job_id
+        mount = self._mount(jid)
+        if mount is None:
+            return
+        self._gauges(job)
+        target = int(job.published_epoch or 0)
+        if target <= mount.epoch:
+            return
+        self._tail_pending[jid] = max(self._tail_pending.get(jid, 0),
+                                      target)
+        if jid not in self._tail_tasks:
+            self._tail_tasks[jid] = asyncio.ensure_future(
+                self._tail_guard(job)
+            )
+
+    async def _tail_guard(self, job):
+        jid = job.job_id
+        set_task_root(f"replica-tail:{jid}")
+        try:
+            while True:
+                mount = self._mount(jid)
+                target = self._tail_pending.get(jid)
+                if (mount is None or target is None
+                        or target <= mount.epoch):
+                    return
+                await self._tail_one(job, target)
+        except Exception as e:  # noqa: BLE001 - a broken mount reattaches
+            logger.warning(
+                "follower tail for %s failed: %r; detaching", jid, e
+            )
+            self.detach(jid)
+            self._next_attach[jid] = (
+                time.monotonic() + config().replica.reattach_backoff
+            )
+        finally:
+            self._tail_tasks.pop(jid, None)
+            job.kick()
+
+    async def _tail_one(self, job, target: int):
+        jid = job.job_id
+        idx = self._assign.get(jid)
+        if idx is None:
+            return
+        if chaos.fire("replica.kill", job_id=jid, follower=idx):
+            # abrupt follower death mid-tail: every mount on this
+            # follower drops without graceful detach. The gateway fails
+            # over worker-ward instantly (route() finds no mount);
+            # note_running reattaches via _subscribe, which re-resolves
+            # latest.json — never the in-memory target epoch.
+            self.kill(idx)
+            raise RuntimeError(f"chaos: follower {idx} killed mid-tail")
+        applied = await self.followers[idx]._tail(jid, target)
+        mount = self._mount(jid)
+        if mount is not None:
+            REPLICA_TAILS.labels(job=jid).inc()
+            self._gauges(job)
+            logger.debug(
+                "follower %d tailed %s to epoch %d (%d blobs)",
+                idx, jid, mount.epoch, applied,
+            )
+
+    # -- serving (the gateway's entry points) --------------------------------
+
+    def route(self, job, table: str):
+        """The gateway's follower-first lookup: the mounted ServeView
+        for (job, table) when the follower is within
+        replica.max_lag_epochs of publication, else None — the caller
+        falls back worker-ward (live jobs, unmounted tables, dead or
+        lagging followers all land here, never on a wrong value)."""
+        if not config().replica.enabled:
+            return None
+        jid = job.job_id
+        mount = self._mount(jid)
+        if mount is None:
+            return None
+        idx = self._assign[jid]
+        view = self.followers[idx].view(jid, table)
+        if view is None:
+            return None
+        lag = int(job.published_epoch or 0) - mount.epoch
+        if lag > int(config().replica.max_lag_epochs):
+            return None
+        return view
+
+    def read_one(self, job_id: str, table: str,
+                 key_values) -> Optional[dict]:
+        """One key lookup through the mounted follower's effect-
+        annotated read path (replica.serve). None when the mount
+        vanished since route() — the gateway degrades that key to a
+        retriable error, never a wrong value."""
+        idx = self._assign.get(job_id)
+        if idx is None or idx >= len(self.followers):
+            return None
+        return self.followers[idx].read(job_id, table, key_values)
+
+    def tables_meta(self, job_id: str) -> Optional[Dict[str, dict]]:
+        """The job's table listing from mirrored describe records — the
+        gateway's zero-RPC replacement for the per-worker `tables` fan
+        when the job is mounted. None when unmounted (worker fallback)."""
+        if not config().replica.enabled:
+            return None
+        mount = self._mount(job_id)
+        if mount is None or not mount.meta:
+            return None
+        return dict(mount.meta)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def kill(self, idx: int):
+        """Abrupt follower death (the chaos drill's seam, also exposed
+        on /debug/replica-kill): drop every mount with no graceful
+        detach. Jobs reattach through the full _subscribe path."""
+        if idx >= len(self.followers):
+            return
+        f = self.followers[idx]
+        dropped = sorted(f.mounts)
+        f.mounts.clear()
+        for jid in dropped:
+            self._assign.pop(jid, None)
+            self._tail_pending.pop(jid, None)
+        self.kills += 1
+        logger.warning(
+            "follower %d killed (%d mounts dropped: %s)",
+            idx, len(dropped), dropped,
+        )
+
+    @protocol_effect("replica.detach")
+    def detach(self, job_id: str):
+        """Graceful unmount on job stop/terminal/expunge: cancel pending
+        work, drop the mount and assignment. Metric GC rides the expunge
+        path's Registry.drop_job (all replica families are job-labeled)."""
+        idx = self._assign.pop(job_id, None)
+        for tasks in (self._sub_tasks, self._tail_tasks):
+            t = tasks.pop(job_id, None)
+            if t is not None:
+                t.cancel()
+        self._tail_pending.pop(job_id, None)
+        if idx is not None and idx < len(self.followers):
+            self.followers[idx].mounts.pop(job_id, None)
+
+    def on_job_expunged(self, jid: str):
+        self._next_attach.pop(jid, None)
+
+    # -- observability -------------------------------------------------------
+
+    def _gauges(self, job):
+        mount = self._mount(job.job_id)
+        if mount is None:
+            return
+        REPLICA_SERVED_EPOCH.labels(job=job.job_id).set(float(mount.epoch))
+        REPLICA_LAG_EPOCHS.labels(job=job.job_id).set(
+            float(max(0, int(job.published_epoch or 0) - mount.epoch))
+        )
+
+    def lag_epochs(self, job) -> Optional[int]:
+        """published - served for a mounted job (the replica_staleness
+        SLO input); None when unmounted."""
+        mount = self._mount(job.job_id)
+        if mount is None:
+            return None
+        return max(0, int(job.published_epoch or 0) - mount.epoch)
+
+    def status(self) -> dict:
+        return {
+            "enabled": bool(config().replica.enabled),
+            "followers": [f.stats() for f in self.followers],
+            "assignments": dict(self._assign),
+            "kills": self.kills,
+            "subscribing": sorted(self._sub_tasks),
+            "tail_pending": dict(self._tail_pending),
+        }

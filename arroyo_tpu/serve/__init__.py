@@ -29,10 +29,13 @@ flowing into the per-tenant attribution pump.
 """
 
 from .store import (  # noqa: F401 - public surface
+    META_KEY,
+    SERVE_TABLE,
     ServeView,
     owner_subtask,
     register_op,
     seal_op,
+    serve_mirror_tables,
     stage_batch,
     worker_read,
 )

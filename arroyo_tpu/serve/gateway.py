@@ -38,11 +38,13 @@ from typing import Dict, List, Optional, Tuple
 
 from ..config import config
 from ..metrics import (
+    REPLICA_LOOKUPS,
     SERVE_CACHE_HITS,
     SERVE_CACHE_MISSES,
     SERVE_KEYS,
     SERVE_REQUEST_SECONDS,
     SERVE_REQUESTS,
+    SERVE_WORKER_RPCS,
 )
 from ..obs import attribution, timeline
 from ..utils.logging import get_logger
@@ -190,10 +192,21 @@ class StateGateway:
         cached = self._tables.get(job_id)
         if cached is not None and cached[0] == job.schedules:
             return cached[1]
+        # follower replicas (ISSUE 20): a mounted durable job's listing
+        # comes from the mirrored describe records — zero worker RPCs
+        # (the mirror carries the WORKER's describe, true parallelism
+        # included, so worker-ward fallback routing still works)
+        replicas = getattr(self.controller, "replicas", None)
+        if replicas is not None:
+            meta = replicas.tables_meta(job_id)
+            if meta:
+                self._tables[job_id] = (job.schedules, meta)
+                return meta
         out: Dict[str, dict] = {}
         ns = f"{job.job_id}@{job.schedules}"
         for w in job.workers:
             try:
+                SERVE_WORKER_RPCS.labels(job=job_id).inc()
                 resp = await self.controller._worker_call(
                     w, "WorkerGrpc", "QueryState",
                     {"job_id": job_id, "mode": "tables", "data_ns": ns},
@@ -317,12 +330,25 @@ class StateGateway:
         budget = int(config().serve.cache_bytes)
         kinds = tuple(info["key_kinds"])
         SERVE_KEYS.labels(job=job.job_id).inc(len(keys))
+        # follower replicas (ISSUE 20): durable jobs route follower-
+        # first when a caught-up mount exists; live jobs and lagging/
+        # dead followers fall back to the worker fan-out below. The
+        # cache keys on the SOURCE's epoch — the follower's served
+        # epoch when follower-routed — so a lagging follower can never
+        # serve a cache entry newer than its own epoch (and a worker-
+        # cached entry at a newer published epoch never answers a
+        # follower-routed read).
+        replicas = getattr(self.controller, "replicas", None)
+        follower = None
+        if replicas is not None and epoch is not None:
+            follower = replicas.route(job, table)
+        src_epoch = follower.served_epoch if follower is not None else epoch
         results: List[Optional[dict]] = [None] * len(keys)
         misses: List[int] = []
         hits = 0
         for i, raw in enumerate(keys):
             ck = (job.job_id, table, str(raw))
-            value = self.cache.get(ck, epoch, sched)
+            value = self.cache.get(ck, src_epoch, sched)
             if value is not None:
                 results[i] = {"key": raw, "found": True, "value": value,
                               "cached": True}
@@ -332,7 +358,35 @@ class StateGateway:
         SERVE_CACHE_HITS.labels(job=job.job_id).inc(hits)
         SERVE_CACHE_MISSES.labels(job=job.job_id).inc(len(misses))
         stale = False
-        if misses:
+        if misses and follower is not None:
+            REPLICA_LOOKUPS.labels(job=job.job_id).inc(len(misses))
+            for i in misses:
+                raw = keys[i]
+                vals = raw if isinstance(raw, (list, tuple)) else [raw]
+                if len(vals) != len(kinds):
+                    results[i] = {"key": raw, "found": False,
+                                  "error": "bad key", "retriable": False}
+                    continue
+                try:
+                    resp = replicas.read_one(job.job_id, table,
+                                             tuple(vals))
+                except (TypeError, ValueError):
+                    results[i] = {"key": raw, "found": False,
+                                  "error": "bad key", "retriable": False}
+                    continue
+                if resp is None:
+                    # follower died between route() and the read
+                    results[i] = {"key": raw, "found": False,
+                                  "error": "follower detached",
+                                  "retriable": True}
+                    continue
+                results[i] = {"key": raw, "found": resp["found"]}
+                if resp["found"]:
+                    results[i]["value"] = resp["value"]
+                    self.cache.put((job.job_id, table, str(raw)),
+                                   src_epoch, sched, resp["value"],
+                                   budget)
+        elif misses:
             by_worker: Dict[int, List[int]] = {}
             broadcast = not info["routable"]
             for i in misses:
@@ -369,8 +423,17 @@ class StateGateway:
             return {"outcome": "stale_route"}
         errors = sum(1 for r in results if r and r.get("error"))
         outcome = "ok" if errors == 0 else "partial"
+        # every response reports its read staleness: published epoch
+        # minus the epoch actually served. Worker-routed reads serve AT
+        # publication (0); follower-routed reads lag by at most
+        # replica.max_lag_epochs — one checkpoint interval (route()
+        # refuses beyond that, falling back worker-ward).
+        staleness = ((epoch - src_epoch)
+                     if epoch is not None and src_epoch is not None else 0)
         return {
             "job": job.job_id, "table": table, "epoch": epoch,
+            "served_epoch": src_epoch, "staleness": staleness,
+            "source": "follower" if follower is not None else "worker",
             "results": [r or {"found": False} for r in results],
             "cache": {"hits": hits, "misses": len(misses)},
             "outcome": outcome, "status": 200,
@@ -396,6 +459,7 @@ class StateGateway:
                 "data_ns": ns,
             }
             try:
+                SERVE_WORKER_RPCS.labels(job=job.job_id).inc()
                 resp = await self.controller._worker_call(
                     w, "WorkerGrpc", "QueryState", payload,
                     timeout=timeout,

@@ -37,6 +37,7 @@ import datetime
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+import pyarrow as pa
 
 from ..analysis.model.effects import protocol_effect
 from ..config import config
@@ -46,6 +47,17 @@ from ..utils.logging import get_logger
 logger = get_logger("serve")
 
 _TOMB = object()  # sealed deletion marker (updating-aggregate retraction)
+
+# Follower read replicas (ISSUE 20): every viewed operator on a durable
+# job mirrors its sealed view rows into a dedicated `__serve__`
+# GlobalTable. seal_op runs inside the runner's capture span BEFORE
+# table_manager.capture, so mirror writes land in the SAME epoch's delta
+# chain as the operator state they reflect — a follower tailing the
+# published chains reconstructs exactly the view a worker serves at that
+# published epoch. The reserved meta key carries the view's describe()
+# so a follower can serve without the compiled program.
+SERVE_TABLE = "__serve__"
+META_KEY = "__serve_meta__"
 
 # key-column kinds: how request/staged values canonicalize + hash.
 #   i = signed int / timestamp-as-int   u = unsigned int
@@ -173,17 +185,24 @@ class ServeView:
         else:
             self._stage[key] = _TOMB
 
-    def seal(self, epoch: int):
+    def has_staged(self, key: Tuple) -> bool:
+        return key in self._stage
+
+    def seal(self, epoch: int) -> Optional[Dict[Tuple, Any]]:
         """Move the staged rows under `epoch` (called at checkpoint
         capture, synchronously at the barrier). Bounded: past
         serve.max_pending_epochs the oldest pending epoch folds forward
-        (publication stalled far beyond the inflight window)."""
+        (publication stalled far beyond the inflight window). Returns
+        the sealed delta (None when nothing was staged) — seal_op
+        mirrors it into the `__serve__` state table for followers."""
         if not self._stage:
-            return
-        self.pending.setdefault(epoch, {}).update(self._stage)
+            return None
+        sealed = self._stage
+        self.pending.setdefault(epoch, {}).update(sealed)
         self._stage = {}
         while len(self.pending) > self._max_pending:
             self._fold_one(min(self.pending))
+        return sealed
 
     def _fold_one(self, epoch: int):
         for k, v in self.pending.pop(epoch).items():
@@ -240,15 +259,14 @@ class ServeView:
 # -- operator integration -----------------------------------------------------
 
 
-def register_op(op, ctx) -> Optional[ServeView]:
-    """Attach a ServeView to a keyed operator at task start (called by
-    the runner after on_start, once restore has run). Returns None —
-    and leaves the operator untouched — when serving is disabled, the
-    operator kind has no keyed view, or the view would be meaningless
-    (keyless state on a parallel node holds per-subtask partials)."""
-    if not config().serve.enabled:
-        return None
+def _view_plan(op, task_info) -> Optional[tuple]:
+    """(kind, key_names, key_kinds, value_names) for an operator that
+    gets a serve view, else None. Shared by register_op (attach at task
+    start) and serve_mirror_tables (declare the `__serve__` mirror
+    table BEFORE TableManager.open runs — both must agree, or a viewed
+    operator would have no chain for followers to tail)."""
     from ..operators.updating import UpdatingAggregateOperator
+    from ..operators.updating_join import UpdatingJoinOperator
     from ..operators.windows import WindowOperatorBase
     from ..schema import TIMESTAMP_FIELD
 
@@ -256,11 +274,21 @@ def register_op(op, ctx) -> Optional[ServeView]:
         kind = "updating"
     elif isinstance(op, WindowOperatorBase):
         kind = "window"
+    elif isinstance(op, UpdatingJoinOperator):
+        # join views (ISSUE 20 satellite): key -> current joined row
+        # set. Residual (non-equi) predicates filter EMITTED rows only;
+        # serving the stored match set would show rows the residual
+        # rejected, so such joins stay unserved rather than wrong.
+        if op.residual is not None:
+            return None
+        kind = "join"
     else:
         return None
-    key_names = list(getattr(op, "_key_names", None) or [])
-    ti = ctx.task_info
-    if not key_names and ti.parallelism > 1:
+    if kind == "join":
+        key_names = [f"__key{i}" for i in range(op.n_keys)]
+    else:
+        key_names = list(getattr(op, "_key_names", None) or [])
+    if not key_names and task_info.parallelism > 1:
         # keyless aggregate on a parallel node: every subtask holds a
         # PARTIAL — no single owner can answer, so no view
         return None
@@ -279,11 +307,67 @@ def register_op(op, ctx) -> Optional[ServeView]:
         # so the value names must align with the accumulator spec order
         value_names = [s.name for s in op.specs]
     else:
+        # join views serve {"rows": [{field: value}]}; value_names
+        # documents the per-row payload fields either way
         value_names = [
             f.name for f in schema
             if f.name not in key_names and f.name != TIMESTAMP_FIELD
             and f.name != "__updating_meta"
         ]
+    return kind, key_names, key_kinds, value_names
+
+
+def _mirror_eligible(op, task_info) -> bool:
+    """Will this operator (ever) carry a serve view? The open-time
+    twin of _view_plan's gate: serve_mirror_tables runs BEFORE
+    on_start, when window/updating operators haven't captured their
+    key NAMES yet (`_key_names` lands in _capture_key_meta), so
+    keyedness is judged from construction-time attributes instead
+    (`key_cols` / `n_keys`). Erring open is harmless — an unwritten
+    mirror table captures empty and followers skip it for lack of a
+    `__serve_meta__` record; erring closed would leave a viewed
+    operator with no chain for followers to tail."""
+    from ..operators.updating_join import UpdatingJoinOperator
+    from ..operators.windows import WindowOperatorBase
+
+    if isinstance(op, UpdatingJoinOperator):
+        if op.residual is not None:
+            return False
+        keyed = int(op.n_keys) > 0
+    elif isinstance(op, WindowOperatorBase):  # updating subclasses it
+        keyed = bool(getattr(op, "key_cols", None)
+                     or getattr(op, "_key_names", None))
+    else:
+        return False
+    return keyed or task_info.parallelism == 1
+
+
+def serve_mirror_tables(op, task_info) -> Dict[str, Any]:
+    """Extra table configs the runner merges into op.tables() at open:
+    viewed operators on durable jobs get the `__serve__` mirror
+    GlobalTable (see module constants). Empty for everything else."""
+    if not config().serve.enabled:
+        return {}
+    if not _mirror_eligible(op, task_info):
+        return {}
+    from ..state.table_config import global_table
+
+    return {SERVE_TABLE: global_table(SERVE_TABLE)}
+
+
+def register_op(op, ctx) -> Optional[ServeView]:
+    """Attach a ServeView to a keyed operator at task start (called by
+    the runner after on_start, once restore has run). Returns None —
+    and leaves the operator untouched — when serving is disabled, the
+    operator kind has no keyed view, or the view would be meaningless
+    (keyless state on a parallel node holds per-subtask partials)."""
+    if not config().serve.enabled:
+        return None
+    ti = ctx.task_info
+    plan = _view_plan(op, ti)
+    if plan is None:
+        return None
+    kind, key_names, key_kinds, value_names = plan
     view = ServeView(
         job_id=ti.job_id, table=op.name, node_id=ti.node_id,
         task_index=ti.task_index, parallelism=ti.parallelism,
@@ -292,10 +376,28 @@ def register_op(op, ctx) -> Optional[ServeView]:
         live_mode=ctx.table_manager is None,
     )
     op._serve_view = view
+    if ctx.table_manager is not None:
+        # restore seeding from the mirror table: the restored `__serve__`
+        # chain IS the last published epoch's view (window finals,
+        # session partials, join row sets alike) — without it a
+        # recovered job would 404 every key until re-emission. The
+        # restore unions ALL subtasks' chains; keep only owned keys so
+        # per-subtask memory stays O(owned), not O(table).
+        mirror = ctx.table_manager.tables.get(SERVE_TABLE)
+        if mirror is not None:
+            for k, v in mirror.items():
+                if k == META_KEY or not isinstance(k, tuple):
+                    continue
+                if (view.routable and view.parallelism > 1
+                        and owner_subtask(k, view.key_kinds,
+                                          view.parallelism)
+                        != view.task_index):
+                    continue
+                view.served[k] = v
     if kind == "updating" and getattr(op, "emitted", None):
-        # restore seeding: the restored `emitted` map IS the last
-        # published epoch's view — without it a recovered job would
-        # 404 every key until its next flush re-emits it
+        # restore seeding (pre-mirror jobs): the restored `emitted` map
+        # is authoritative for updating aggregates — overwrite any
+        # mirror-seeded copy
         for k, vals in op.emitted.items():
             try:
                 key = view.canon_key(op._key_tuple_to_values(k))
@@ -307,26 +409,84 @@ def register_op(op, ctx) -> Optional[ServeView]:
     return view
 
 
-def stage_batch(view: ServeView, batch) -> None:
+def _fast_pylist(col) -> list:
+    """to_pylist with temporal values pre-cast to epoch nanos. Staged
+    values land as int nanos anyway (_plain / canon_value), and int64
+    to_pylist skips the per-element pandas Timestamp round-trip that
+    dominates the staging hot path — including inside struct columns
+    (window bounds are struct<start, end> of timestamps)."""
+    if pa.types.is_timestamp(col.type):
+        col = col.cast(pa.timestamp("ns")).cast(pa.int64())
+    elif pa.types.is_struct(col.type) and col.null_count == 0:
+        fields = [col.type.field(j).name
+                  for j in range(col.type.num_fields)]
+        children = [_fast_pylist(col.field(j))
+                    for j in range(col.type.num_fields)]
+        return [dict(zip(fields, row)) for row in zip(*children)]
+    return col.to_pylist()
+
+
+def stage_batch(view: ServeView, batch, partial: bool = False) -> list:
     """Stage every row of an emitted output batch into the view (the
     window operators' hook: one call per emitted window batch). Key
     columns index by the view's key order; all other non-internal
-    columns become the value dict."""
+    columns become the value dict. `partial=True` (session-window open
+    sessions) flags each value dict with `partial: True` — finals carry
+    no flag. Returns the canonical keys staged (partial bookkeeping)."""
     names = batch.schema.names
-    cols = {n: batch.column(i).to_pylist() for i, n in enumerate(names)}
+    cols = {n: _fast_pylist(batch.column(i)) for i, n in enumerate(names)}
     vnames = [n for n in view.value_names if n in cols]
     knames = view.key_names
+    # column-wise canonicalization: one pass per column, not one
+    # isinstance chain per cell (this runs inside the checkpoint
+    # capture span — per-row overhead is barrier latency)
+    kcols = [[canon_value(v, k) for v in cols[n]]
+             for n, k in zip(knames, view.key_kinds)]
+    vcols = [(n, [_plain(v) for v in cols[n]]) for n in vnames]
+    stage = view.stage
+    staged = []
     for r in range(batch.num_rows):
-        key = view.canon_key(tuple(cols[n][r] for n in knames))
-        view.stage(key, {n: _plain(cols[n][r]) for n in vnames})
+        key = tuple(c[r] for c in kcols)
+        value = {n: c[r] for n, c in vcols}
+        if partial:
+            value["partial"] = True
+        stage(key, value)
+        staged.append(key)
+    return staged
 
 
-def seal_op(op, epoch: int) -> None:
+def seal_op(op, epoch: int, table_manager=None) -> None:
     """Runner hook at checkpoint capture: seal the operator's staged
-    rows under this barrier's epoch (no-op without a view)."""
+    rows under this barrier's epoch (no-op without a view). Operators
+    exposing `serve_stage_snapshot` (session partials, join row sets)
+    stage their snapshot delta first — inside the same barrier, so the
+    snapshot rides this epoch. With a table manager, the sealed delta
+    mirrors into the `__serve__` GlobalTable before capture serializes
+    it, keeping the follower-visible chain in lockstep with the view."""
     view = getattr(op, "_serve_view", None)
-    if view is not None:
-        view.seal(epoch)
+    if view is None:
+        return
+    snap = getattr(op, "serve_stage_snapshot", None)
+    if snap is not None:
+        try:
+            snap(view)
+        except Exception:  # noqa: BLE001 - serving must not fail a barrier
+            logger.exception("serve snapshot staging failed for %s",
+                             view.table)
+    sealed = view.seal(epoch)
+    if table_manager is None or view.live_mode:
+        return
+    mirror = table_manager.tables.get(SERVE_TABLE)
+    if mirror is None:
+        return
+    desc = view.describe()
+    if mirror.get(META_KEY) != desc:
+        mirror.put(META_KEY, desc)
+    for k, v in (sealed or {}).items():
+        if v is _TOMB:
+            mirror.delete(k)
+        else:
+            mirror.put(k, v)
 
 
 # -- the worker read handler --------------------------------------------------

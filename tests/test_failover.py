@@ -184,7 +184,8 @@ def test_failover_grace_only_covers_catchup_rules(tmp_path):
     blip, not a blanket mute."""
     from arroyo_tpu.obs.watchtower import Watchtower
 
-    assert set(Watchtower._FAILOVER_GRACE_RULES) == {"freshness", "e2e_p99"}
+    assert set(Watchtower._FAILOVER_GRACE_RULES) == {
+        "freshness", "e2e_p99", "replica_staleness"}
 
 
 # -- bench gate: pin_era -----------------------------------------------------

@@ -273,6 +273,21 @@ def test_metrics_cardinality_gc(tmp_path):
                     1, {"t": {"tx": {"e": [1, 2]}, "rx": {"e": [1, 2]},
                               "ops": {}, "flow": {}}},
                 )
+            # replica-tier GC (ISSUE 20): mint the job-labeled
+            # arroyo_replica_* families (tail counts, served-epoch /
+            # lag gauges) per churned job — Registry.drop_job on the
+            # expunge path must take them with the rest (these bounded
+            # jobs finish before a follower could mount, so the series
+            # are minted directly like the audit ones above)
+            from arroyo_tpu.metrics import (
+                REPLICA_LAG_EPOCHS,
+                REPLICA_SERVED_EPOCH,
+                REPLICA_TAILS,
+            )
+            for j in range(n):
+                REPLICA_TAILS.labels(job=f"{tag}{j}").inc()
+                REPLICA_SERVED_EPOCH.labels(job=f"{tag}{j}").set(1.0)
+                REPLICA_LAG_EPOCHS.labels(job=f"{tag}{j}").set(0.0)
             for j in range(n):
                 await c.wait_for_state(
                     f"{tag}{j}", JobState.FINISHED, JobState.FAILED,
@@ -287,6 +302,7 @@ def test_metrics_cardinality_gc(tmp_path):
     assert "arroyo_job_attributed_busy_seconds" in REGISTRY.expose()
     assert "arroyo_serve_requests_total" in REGISTRY.expose()
     assert "arroyo_audit_epochs_reconciled_total" in REGISTRY.expose()
+    assert "arroyo_replica_tails_total" in REGISTRY.expose()
     baseline = len(REGISTRY.expose())
     asyncio.run(churn("gc", 6))
     after = len(REGISTRY.expose())

@@ -1,0 +1,502 @@
+"""Follower read replicas — the serving tier off the checkpoint stream
+(ISSUE 20).
+
+Coverage of the replica tier's load-bearing contracts:
+
+  * the model: with a follower enabled and follower-death faults in
+    the alphabet, the faithful protocol explores exhaustively clean
+    (the `follower_serves_unpublished_epoch` mutant's counterexample is
+    exercised by test_model_check.py's per-mutant parametrization);
+  * cache-vs-staleness (satellite 3): the gateway's read-through cache
+    keys on the SOURCE's epoch, so a lagging follower can never serve
+    a cached entry newer than its own served epoch;
+  * view plans (satellite 1): session windows serve open sessions as
+    `partial: true` rows; updating joins serve per-key joined row sets
+    (cross product / outer null-padding) and refuse residual joins;
+  * end to end: a durable job's reads route follower-first with
+    response-carried staleness <= replica.max_lag_epochs (one
+    checkpoint interval) and ZERO further worker QueryState RPCs;
+    killing the follower fails reads over worker-ward (no fatal, no
+    wrong value) and the mount reattaches by re-resolving latest.json.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from arroyo_tpu.config import config, update
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import EmbeddedScheduler
+from arroyo_tpu.controller.state_machine import JobState
+from arroyo_tpu.serve import ServeView
+from arroyo_tpu.serve.gateway import StateGateway
+
+from test_serve import _serve_sql, _wait_found, _wait_published
+
+
+# -- the model: faithful protocol clean with followers enabled ---------------
+
+
+def test_model_faithful_with_followers_clean():
+    """The PR 9 checker with the follower actor enabled and abrupt
+    follower death in the fault alphabet: the faithful protocol
+    explores exhaustively with no REPLICA violation — every reattach
+    re-resolves latest.json, so no reachable interleaving serves an
+    unpublished epoch. (The mutant that reattaches from the in-memory
+    issued-epoch counter is caught with a replayable counterexample in
+    test_model_check.py.)"""
+    from pathlib import Path
+
+    from arroyo_tpu.analysis.model import explore as explore_mod
+    from arroyo_tpu.analysis.model import mutants as mutants_mod
+    from arroyo_tpu.analysis.model.extract import (
+        job_state_machine,
+        load_project,
+    )
+    from arroyo_tpu.analysis.model.spec import Model, ModelConfig
+
+    repo = Path(__file__).resolve().parents[1]
+    _m, terminals, table = job_state_machine(
+        load_project(repo, roots=("arroyo_tpu/controller",))
+    )
+    cfg = ModelConfig(workers=2, epochs=1, inflight=2, faults=1,
+                      restarts=2, reads=1, followers=1,
+                      fault_kinds=("fault.follower_die",))
+    res = explore_mod.explore(Model(cfg, table, terminals),
+                              budget=400_000)
+    assert res.exhaustive
+    assert not res.violations, [t.violation for t in res.violations]
+    assert "follower_serves_unpublished_epoch" in mutants_mod.MUTANTS
+
+
+# -- satellite 3: the cache can never outrun its source ----------------------
+
+
+class _StubFollowerView:
+    def __init__(self, served_epoch, values):
+        self.served_epoch = served_epoch
+        self.values = values
+
+
+class _StubReplicas:
+    """route()/read_one() shaped like ReplicaManager, pinned to one
+    lagging follower view."""
+
+    def __init__(self, view):
+        self._view = view
+
+    def route(self, job, table):
+        return self._view
+
+    def read_one(self, job_id, table, key_values):
+        if self._view is None:
+            return None
+        found = key_values in self._view.values
+        return {"found": found,
+                "value": self._view.values.get(key_values),
+                "epoch": self._view.served_epoch}
+
+    def tables_meta(self, job_id):
+        return None
+
+    def lag_epochs(self, job):
+        return None
+
+
+def _stub_job(published_epoch=5):
+    class _State:
+        value = "Running"
+
+        @staticmethod
+        def is_terminal():
+            return False
+
+    return type("J", (), {
+        "job_id": "j", "tenant": "t", "schedules": 1,
+        "backend": object(), "published_epoch": published_epoch,
+        "state": _State, "workers": [], "assignments": {},
+        "mount": None, "stop_requested": False,
+    })()
+
+
+def test_cache_never_serves_newer_than_follower_epoch():
+    """Satellite 3 regression: pre-seed the cache with a value cached
+    at the PUBLISHED epoch (5) by a worker-routed read; a follower-
+    routed read whose mount is one epoch behind (served_epoch 4) must
+    NOT answer from that newer cache entry — it serves the follower's
+    own (older) value and re-caches it at the follower's epoch."""
+    job = _stub_job(published_epoch=5)
+    ctrl = type("C", (), {})()
+    ctrl.jobs = {"j": job}
+    follower = _StubFollowerView(4, {(0,): {"cnt": "follower-old"}})
+    ctrl.replicas = _StubReplicas(follower)
+    gw = StateGateway(ctrl)
+    info = {"table": "t", "node_id": 1, "parallelism": 1,
+            "key_kinds": ["i"], "routable": True}
+    gw._tables["j"] = (job.schedules, {"t": info})
+
+    async def main():
+        # a worker-routed read cached this key at epoch 5
+        gw.cache.put(("j", "t", "0"), 5, job.schedules,
+                     {"cnt": "worker-new"}, budget=1 << 20)
+        out = await gw._routed_read(job, "t", [0])
+        assert out["source"] == "follower"
+        assert out["served_epoch"] == 4
+        assert out["staleness"] == 1
+        r = out["results"][0]
+        assert r["found"] and not r.get("cached"), out
+        # the follower's value won, never the newer cached one
+        assert r["value"] == {"cnt": "follower-old"}, out
+        # the entry is now keyed at the follower's epoch: a follower
+        # re-read hits it, a worker-routed probe at 5 drops it
+        out2 = await gw._routed_read(job, "t", [0])
+        assert out2["results"][0].get("cached"), out2
+        assert out2["served_epoch"] == 4
+        ctrl.replicas._view = None  # follower detached -> worker probe
+        assert gw.cache.get(("j", "t", "0"), 5, job.schedules) is None
+
+    asyncio.run(main())
+
+
+def test_follower_detach_between_route_and_read_is_retriable():
+    """A follower dying between route() and the key lookup degrades
+    those keys to retriable errors — never a fatal, never a value."""
+    job = _stub_job(published_epoch=3)
+    ctrl = type("C", (), {})()
+    ctrl.jobs = {"j": job}
+
+    class _Vanishing(_StubReplicas):
+        def read_one(self, job_id, table, key_values):
+            return None  # mount vanished after route()
+
+    ctrl.replicas = _Vanishing(_StubFollowerView(3, {}))
+    gw = StateGateway(ctrl)
+    gw._tables["j"] = (job.schedules, {"t": {
+        "table": "t", "node_id": 1, "parallelism": 1,
+        "key_kinds": ["i"], "routable": True}})
+
+    async def main():
+        out = await gw._routed_read(job, "t", [0, 1])
+        assert out["outcome"] == "partial"
+        for r in out["results"]:
+            assert not r["found"] and r["retriable"], out
+
+    asyncio.run(main())
+
+
+# -- satellite 1: view plans for session windows and updating joins ----------
+
+
+def _plan_view(**kw):
+    base = dict(job_id="j", table="t", node_id=1, task_index=0,
+                parallelism=1, key_names=["__key0"], key_kinds=("i",),
+                value_names=["rows"], kind="join", live_mode=False)
+    base.update(kw)
+    return ServeView(**base)
+
+
+def test_join_view_plan_refuses_residual():
+    """_view_plan gates which operators get views: a residual
+    (non-equi) join is refused — its output rows are filtered AFTER
+    the cross product, so the per-key row-set snapshot would overserve
+    (a documented known limit)."""
+    from arroyo_tpu.operators.updating_join import UpdatingJoinOperator
+    from arroyo_tpu.serve.store import _view_plan
+    from arroyo_tpu.types import TaskInfo
+
+    op = UpdatingJoinOperator.__new__(UpdatingJoinOperator)
+    op.n_keys = 1
+    op.residual = None
+    op.out_schema = type("S", (), {"schema": [
+        type("F", (), {"name": "l_v", "type": None})(),
+        type("F", (), {"name": "r_v", "type": None})(),
+    ]})()
+    ti = TaskInfo("j", 1, "join", 0, 1)
+    plan = _view_plan(op, ti)
+    assert plan is not None
+    kind, key_names, _kinds, vals = plan
+    assert kind == "join" and key_names == ["__key0"]
+    assert vals == ["l_v", "r_v"]
+    op.residual = lambda b: b
+    assert _view_plan(op, ti) is None
+
+
+def test_join_snapshot_cross_product_outer_padding_and_tombs():
+    """The join's serve snapshot: cross product when both sides match,
+    null-padding per outer semantics, lone-side inner keys invisible,
+    vanished keys tombstoned on the next capture."""
+    from arroyo_tpu.operators.updating_join import UpdatingJoinOperator
+
+    op = type("Op", (), {})()
+    op.join_type = "left"
+    op.left_out = ["l_v"]
+    op.right_out = ["r_v"]
+    op.state = [
+        {(1,): [("L1",), ("L2",)], (2,): [("Lonly",)]},
+        {(1,): [("R1",)]},
+    ]
+    v = _plan_view()
+    UpdatingJoinOperator.serve_stage_snapshot(op, v)
+    v.seal(1)
+    found, val = v.read((1,), 1)
+    assert found
+    assert val["rows"] == [{"l_v": "L1", "r_v": "R1"},
+                           {"l_v": "L2", "r_v": "R1"}]
+    # left outer: lone left side null-pads the right
+    found, val = v.read((2,), 1)
+    assert found and val["rows"] == [{"l_v": "Lonly", "r_v": None}]
+    # inner join: a lone side serves nothing; retired keys tombstone
+    op.join_type = "inner"
+    op.state = [{(1,): [("L1",)]}, {}]
+    UpdatingJoinOperator.serve_stage_snapshot(op, v)
+    v.seal(2)
+    assert v.read((1,), 2) == (False, None)
+    assert v.read((2,), 2) == (False, None)
+
+
+def test_session_partial_tomb_never_clobbers_final():
+    """Session partials tombstone a key whose sessions all closed ONLY
+    when no final landed in the same barrier interval (the final wins);
+    in live mode a non-partial served value is likewise protected."""
+    from arroyo_tpu.operators.windows import SessionWindowOperator
+
+    v = _plan_view(kind="window", key_names=["k"],
+                   value_names=["cnt"])
+    op = type("Op", (), {})()
+    op.acc = type("A", (), {"gather": None})()  # mesh-fused: skip
+    op.sessions = {}
+    op._serve_partial_keys = {(7,), (8,)}
+    # key 7's final landed this interval (staged); key 8 just vanished
+    v.stage((7,), {"cnt": 42})
+    SessionWindowOperator.serve_stage_snapshot(op, v)
+    # gather is None -> partials skipped entirely, including tombs
+    v.seal(1)
+    assert v.read((7,), 1) == (True, {"cnt": 42})
+
+    class _Gather:
+        @staticmethod
+        def gather(slots):
+            return []
+
+        @staticmethod
+        def finalize(x):
+            return []
+
+    op2 = type("Op", (), {})()
+    op2.acc = _Gather()
+    op2.gap = 10
+    op2.sessions = {}
+    op2._serve_partial_keys = {(7,), (8,)}
+    v2 = _plan_view(kind="window", key_names=["k"],
+                    value_names=["cnt"])
+    v2.stage((7,), {"cnt": 42})  # the final, staged this interval
+    SessionWindowOperator.serve_stage_snapshot(op2, v2)
+    v2.seal(1)
+    assert v2.read((7,), 1) == (True, {"cnt": 42})  # final survived
+    assert v2.read((8,), 1) == (False, None)        # stale partial gone
+
+
+# -- end to end: follower-first serving, kill, reattach ----------------------
+
+
+def test_e2e_follower_serves_with_zero_worker_rpcs(tmp_path):
+    """The acceptance path: a durable job's reads route to the
+    follower mount (source=follower) with staleness <=
+    replica.max_lag_epochs and ZERO further worker QueryState RPCs;
+    killing the follower mid-serve fails over worker-ward (reads keep
+    answering, nothing fatal, nothing wrong) and the mount reattaches
+    from latest.json; stop detaches the mount and job-metric GC drops
+    the arroyo_replica_* series."""
+    from arroyo_tpu.metrics import (
+        REGISTRY,
+        REPLICA_LOOKUPS,
+        SERVE_WORKER_RPCS,
+    )
+
+    wd = str(tmp_path)
+
+    async def _wait_follower(c, jid, keys, timeout=40.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            out = await c.serve.read(jid, "tumbling_window", keys)
+            if (out.get("source") == "follower"
+                    and all(r.get("found") for r in out["results"])):
+                return out
+            assert time.monotonic() < deadline, (
+                f"reads never went follower-routed: {out}, "
+                f"replica={c.replicas.status()}"
+            )
+            await asyncio.sleep(0.3)
+
+    async def main():
+        with update(
+            pipeline={"checkpointing": {
+                "interval": 0.5, "storage_url": f"{wd}/ck"}},
+            replica={"followers": 1, "reattach_backoff": 1.0},
+        ):
+            sched = EmbeddedScheduler()
+            c = await ControllerServer(sched).start()
+            job = await c.submit_job(
+                "fl", sql=_serve_sql(wd), n_workers=2, parallelism=2,
+                storage_url=f"{wd}/ck/fl",
+            )
+            try:
+                await c.wait_for_state("fl", JobState.RUNNING,
+                                       timeout=30)
+                await _wait_published(job, 1)
+                await _wait_found(c, "fl", "tumbling_window", 0)
+                keys = list(range(8))
+                out = await _wait_follower(c, "fl", keys)
+                # response-carried staleness, bounded at one interval
+                lag_cap = int(config().replica.max_lag_epochs)
+                assert out["staleness"] <= lag_cap, out
+                assert out["served_epoch"] <= job.published_epoch
+                # zero worker QueryState RPCs on follower-routed reads:
+                # epochs advance every 0.5 s, so these reads MISS the
+                # cache and still never leave the controller (a
+                # transiently lagging mount may route a read worker-
+                # ward — those legs are allowed RPCs; follower-routed
+                # ones get none)
+                look0 = REPLICA_LOOKUPS.labels(job="fl").get()
+                follower_reads = 0
+                for _ in range(40):
+                    before = SERVE_WORKER_RPCS.labels(job="fl").get()
+                    out = await c.serve.read("fl", "tumbling_window",
+                                             keys)
+                    after = SERVE_WORKER_RPCS.labels(job="fl").get()
+                    if out.get("source") == "follower":
+                        assert after == before, out
+                        assert out["staleness"] <= lag_cap, out
+                        follower_reads += 1
+                        if follower_reads >= 5:
+                            break
+                    await asyncio.sleep(0.3)
+                assert follower_reads >= 5, c.replicas.status()
+                assert REPLICA_LOOKUPS.labels(job="fl").get() > look0
+                # REST surfaces the replica lag on the table listing
+                lag = c.replicas.lag_epochs(job)
+                assert lag is not None and lag <= lag_cap
+                # follower death: reads fail over worker-ward with no
+                # fatal and no wrong value, then the mount reattaches
+                c.replicas.kill(0)
+                out = await c.serve.read("fl", "tumbling_window", keys)
+                assert out["source"] == "worker", out
+                assert out["staleness"] == 0
+                for r in out["results"]:
+                    assert r.get("found") or r.get("retriable"), out
+                assert c.replicas.kills == 1
+                out = await _wait_follower(c, "fl", keys)
+                assert out["source"] == "follower"
+                # detach on stop: mount gone, replica series GC'd with
+                # the job's metrics
+                await c.stop_job("fl", "immediate")
+                await c.wait_for_state(
+                    "fl", JobState.STOPPED, JobState.FAILED,
+                    JobState.FINISHED, timeout=30,
+                )
+                assert all("fl" not in f.mounts
+                           for f in c.replicas.followers)
+                assert "fl" not in c.replicas._assign
+                REGISTRY.drop_job("fl")  # TTL path shortcut for the test
+                text = REGISTRY.expose()
+                assert 'arroyo_replica_lag_epochs{job="fl"}' not in text
+            finally:
+                if "fl" in c.jobs and not c.jobs["fl"].state.is_terminal():
+                    await c.stop_job("fl", "immediate")
+                    await c.wait_for_state(
+                        "fl", JobState.STOPPED, JobState.FAILED,
+                        JobState.FINISHED, timeout=30,
+                    )
+                await c.stop()
+
+    asyncio.run(main())
+
+
+def test_e2e_session_partials_served(tmp_path):
+    """Satellite 1 end to end: a session-window job with sessions held
+    open by a continuous impulse serves per-key partials (`partial:
+    true`, count still growing) at the published epoch — worker-ward
+    and, once the mount catches up, follower-routed off the mirrored
+    checkpoint stream."""
+    wd = str(tmp_path)
+    sql = f"""
+    CREATE TABLE impulse WITH (
+      connector = 'impulse', event_rate = '20000',
+      message_count = '2000000', start_time = '0',
+      realtime = 'true', replay = 'true'
+    );
+    CREATE TABLE out (k BIGINT UNSIGNED, cnt BIGINT) WITH (
+      connector = 'single_file', path = '{wd}/out.json',
+      format = 'json', type = 'sink'
+    );
+    INSERT INTO out
+    SELECT k, cnt FROM (
+      SELECT counter % 4 as k,
+             session(interval '30 second') as w, count(*) as cnt
+      FROM impulse GROUP BY 1, 2
+    );
+    """
+
+    async def main():
+        with update(
+            pipeline={"checkpointing": {
+                "interval": 0.5, "storage_url": f"{wd}/ck"}},
+            replica={"followers": 1, "reattach_backoff": 1.0},
+        ):
+            c = await ControllerServer(EmbeddedScheduler()).start()
+            job = await c.submit_job(
+                "se", sql=sql, n_workers=2, parallelism=2,
+                storage_url=f"{wd}/ck/se",
+            )
+            try:
+                await c.wait_for_state("se", JobState.RUNNING,
+                                       timeout=30)
+                await _wait_published(job, 1)
+                tables = await c.serve.tables("se")
+                name = next(t for t in tables
+                            if tables[t]["kind"] == "window")
+                out = await _wait_found(c, "se", name, 0)
+                r = out["results"][0]
+                # the 30 s gap is far longer than the test: the session
+                # is open, so this MUST be a partial with a live count
+                assert r["value"].get("partial") is True, out
+                num_fields = [f for f, v in r["value"].items()
+                              if f != "partial"
+                              and isinstance(v, (int, float))]
+                assert num_fields, r
+                # and the partial keeps growing across epochs (the
+                # session count rises; start/end may shift too — any
+                # numeric field strictly increasing proves re-staging)
+                deadline = time.monotonic() + 30
+                while True:
+                    out2 = await _wait_found(c, "se", name, 0)
+                    v2 = out2["results"][0]["value"]
+                    if any(v2.get(f, 0) > r["value"][f]
+                           for f in num_fields):
+                        break
+                    assert time.monotonic() < deadline, (r, out2)
+                    await asyncio.sleep(0.5)
+                assert v2.get("partial") is True, out2
+                # follower-routed partials off the mirrored stream
+                deadline = time.monotonic() + 40
+                while True:
+                    out3 = await c.serve.read("se", name, [0, 1, 2, 3])
+                    if (out3.get("source") == "follower"
+                            and all(x.get("found")
+                                    for x in out3["results"])):
+                        break
+                    assert time.monotonic() < deadline, (
+                        out3, c.replicas.status())
+                    await asyncio.sleep(0.3)
+                for x in out3["results"]:
+                    assert x["value"].get("partial") is True, out3
+            finally:
+                await c.stop_job("se", "immediate")
+                await c.wait_for_state(
+                    "se", JobState.STOPPED, JobState.FAILED,
+                    JobState.FINISHED, timeout=30,
+                )
+                await c.stop()
+
+    asyncio.run(main())

@@ -146,6 +146,14 @@ def classify(metric: str) -> Optional[str]:
     if (metric.endswith("_false_positive_count")
             or metric.endswith("_wrong_values")):
         return "zero"
+    # Follower replicas (ISSUE 20): worker QueryState RPCs issued while
+    # followers are mounted must be EXACTLY zero — the whole point of
+    # the tier is that durable-job reads never touch workers. Staleness
+    # percentiles (serve_staleness_*) are deliberately suffix-less here:
+    # the harness itself hard-fails any read beyond one checkpoint
+    # interval, so the comparison only reports them.
+    if metric.endswith("_worker_rpcs"):
+        return "zero"
     # fused segment runtime (ISSUE 14): stateless-chain dispatches per
     # batch regress UPWARD — a segment silently splitting back into
     # per-operator dispatches (or a new operator joining the chain

@@ -70,6 +70,18 @@
         tools/model_check.py --shared --trace-dir) replays against the
         shared fleet instead of a golden query.
 
+    python tools/chaos_drill.py --follower
+        ISSUE 20 acceptance: a durable windowed pipeline with a
+        follower read replica tailing its checkpoint stream, read
+        continuously through the real serve gateway. Once reads route
+        follower-first, the `replica.kill` seam drops the follower
+        abruptly mid-tail: reads must fail over worker-ward with zero
+        wrong values, the follower must reattach through the full
+        _subscribe path (re-resolving latest.json — never an in-memory
+        epoch), reads must come back follower-sourced, staleness stays
+        <= 1 checkpoint interval throughout, and the sink output is
+        byte-identical to the replica-off fault-free run.
+
     python tools/chaos_drill.py --starvation
         ROADMAP double-emit watch item: blocking `runner.stall` hits
         (params.block — a UDF that never yields) wedge one tenant's
@@ -144,6 +156,13 @@ def main() -> int:
                     "the standby-also-dies cold-restore fallback (with "
                     "--plan: replay the counterexample against the "
                     "armed fleet)")
+    ap.add_argument("--follower", action="store_true",
+                    help="also run the follower-replica drill: kill the "
+                    "follower abruptly mid-tail via the replica.kill "
+                    "seam; requires worker-ward failover with zero wrong "
+                    "values, a full _subscribe reattach off latest.json, "
+                    "staleness <= 1 checkpoint interval throughout, and "
+                    "byte-identical sink output")
     ap.add_argument("--starvation", action="store_true",
                     help="also run the event-loop starvation drill: "
                     "blocking runner.stall hits on one tenant under "
@@ -235,6 +254,12 @@ def main() -> int:
         results.append(
             d.run_failover_drill(
                 args.seed, os.path.join(workdir, "failover"), **fo_kw
+            )
+        )
+    if args.follower:
+        results.append(
+            d.run_follower_drill(
+                args.seed, os.path.join(workdir, "follower")
             )
         )
     if args.starvation:

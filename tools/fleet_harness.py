@@ -56,6 +56,18 @@ worker mid-load: reads must degrade to retriable errors — a wrong value
 or non-retriable error exits 1. serve_* keys gate against
 BENCH_BASELINE.json via tools/bench_compare.py in the nightly serve lane.
 
+Follower read replicas (ISSUE 20): `--serve --followers N` adds the
+controller-hosted follower tier tailing the serving jobs' checkpoint
+stream. The load only starts once every serving job answers reads with
+source == "follower", then gates: worker QueryState RPC count over the
+serving jobs stays EXACTLY zero (serve_follower_worker_rpcs — followers
+serve off published state, never off workers), every read's staleness
+(published epoch minus served epoch) is bounded at one checkpoint
+interval, and serve_follower_lookup_eps pins follower-leg throughput.
+`--serve-kill-follower` kills follower 0 mid-load: reads must fail over
+worker-ward (staleness 0) with zero wrong values, and the follower must
+reattach from latest.json within the controller's cadence.
+
 Watchtower SLO drill (ISSUE 13): `--watch` runs the alerting scenario —
 one victim tenant is stalled (chaos `runner.stall` on its job id +
 storage latency on its checkpoint data files + a sub-timeout heartbeat
@@ -82,6 +94,8 @@ Usage:
       [--churn 30] [--idle-seconds 10] [--kill] [--out fleet.json]
   python tools/fleet_harness.py --serve [--serve-kill] \
       [--serve-duration 10] [--serve-clients 6] [--out serve.json]
+  python tools/fleet_harness.py --serve --followers 1 \
+      [--serve-kill-follower] [--out serve_follower.json]
   python tools/fleet_harness.py --shared-fleet --jobs 100 \
       [--shared-events 50000] [--out shared_fleet.json]
 """
@@ -98,6 +112,8 @@ import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
+
+from bench import PIN_ERA  # noqa: E402 - era-stamps every harness report
 
 
 def sample_sql(outdir: str, tag: str, j: int, events: int) -> str:
@@ -668,6 +684,7 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
                     duration: float = 10.0, clients: int = 6,
                     bulk: int = 16, parked: int = 8, kill: bool = False,
                     pool: int = 2, pipeline_events: int = 400_000,
+                    followers: int = 0, kill_follower: bool = False,
                     workdir: str | None = None) -> dict:
     """StateServe read-load scenario (ISSUE 12): thousands of lookups/s
     through the REAL REST state routes against a running multi-tenant
@@ -679,7 +696,16 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
     (the q5-shaped proxy) runs to completion twice — solo, then under
     full read load — pinning the zero-impact requirement as
     serve_pipeline_eps. `kill=True` SIGKILLs one pool worker mid-load:
-    reads must degrade to retriable errors, never wrong values."""
+    reads must degrade to retriable errors, never wrong values.
+
+    `followers=N` (ISSUE 20) brings up the follower replica tier off the
+    checkpoint stream: the load waits until every serving job routes
+    follower-first, then measures serve_follower_lookup_eps, per-read
+    staleness (published minus served epoch, hard-bounded at one
+    checkpoint interval), and the worker QueryState RPC count over the
+    serving jobs, which MUST stay zero — follower reads never touch
+    workers. `kill_follower=True` kills follower 0 mid-load: reads must
+    fail over to workers (staleness 0) and the follower must reattach."""
     from aiohttp import ClientSession, web
 
     from arroyo_tpu import obs
@@ -696,7 +722,10 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
     legal = {full // keys, -(-full // keys)}  # floor/ceil per key
     report: dict = {"tenants": tenants, "keys": keys, "rate": rate,
                     "duration": duration, "clients": clients,
-                    "bulk": bulk, "kill": int(kill), "workdir": workdir}
+                    "bulk": bulk, "kill": int(kill),
+                    "followers": followers,
+                    "kill_follower": int(kill_follower),
+                    "workdir": workdir}
 
     with update(
         pipeline={"checkpointing": {"interval": 0.5,
@@ -704,6 +733,7 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
         cluster={"worker_pool_size": pool, "metrics_ttl": 1.0},
         controller={"heartbeat_timeout": 8.0},
         worker={"task_slots": max(8, (tenants + parked + 4) * 2)},
+        replica={"followers": followers, "reattach_backoff": 1.0},
         obs={"latency_marker_interval": 0.0, "enabled": False},
     ):
         sched = EmbeddedScheduler()
@@ -775,6 +805,35 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
                         raise RuntimeError(f"{jid}: key 0 never served")
                     await asyncio.sleep(0.25)
 
+            def serve_worker_rpcs() -> float:
+                """Worker QueryState RPCs issued on behalf of the serving
+                jobs — with followers mounted this must not move."""
+                snap = REGISTRY.snapshot().get(
+                    "arroyo_serve_worker_rpcs_total", [])
+                jids = set(serve_jobs)
+                return sum(v for labels, v in snap
+                           if dict(labels).get("job") in jids)
+
+            if followers:
+                # a follower mounts only after the job's first published
+                # checkpoint is tailed; wait until EVERY serving job's
+                # reads actually route follower-first before measuring
+                for jid in serve_jobs:
+                    deadline = time.monotonic() + 90
+                    while True:
+                        async with session.get(
+                            f"{base}/jobs/{jid}/state/{tables[jid]}?key=0"
+                        ) as resp:
+                            doc = await resp.json()
+                        if resp.status == 200 \
+                                and doc.get("source") == "follower":
+                            break
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"{jid}: reads never went follower-"
+                                f"routed: {controller.replicas.status()}")
+                        await asyncio.sleep(0.25)
+
             # -- solo pipeline baseline (no read load)
             async def run_bounded(tag: str) -> float:
                 t0 = time.monotonic()
@@ -807,6 +866,9 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
             wrong: list = []
             high_water: dict = {}  # (jid, key) -> window end served
             lookups = 0
+            sources = {"follower": 0, "worker": 0}  # keyed lookups by leg
+            staleness: list = []  # published minus served epoch, per read
+            rpcs0 = serve_worker_rpcs()
             stop_load = time.monotonic() + duration
             rng_state = [12345]
 
@@ -873,6 +935,12 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
                             if len(fatal_sample) < 5:
                                 fatal_sample.append(doc)
                         continue
+                    src = doc.get("source")
+                    if src in sources:
+                        sources[src] += n
+                    stal = doc.get("staleness")
+                    if isinstance(stal, int):
+                        staleness.append(stal)
                     for r in doc.get("results", []):
                         if r.get("found"):
                             outcomes["ok"] += 1
@@ -889,6 +957,10 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
                             outcomes["miss"] += 1
 
             async def killer():
+                if kill_follower:
+                    await asyncio.sleep(duration / 3)
+                    controller.replicas.kill(0)
+                    report["serve_killed_follower"] = 0
                 if not kill:
                     return
                 await asyncio.sleep(duration / 3)
@@ -936,6 +1008,39 @@ async def run_serve(tenants: int = 4, keys: int = 64, rate: int = 10000,
                 report["serve_pipeline_impact_pct"] = round(
                     100.0 * (1 - loaded_eps
                              / report["serve_pipeline_solo_eps"]), 1)
+
+            if followers:
+                report.update({
+                    "serve_follower_lookup_eps": round(
+                        sources["follower"] / load_wall, 1),
+                    "serve_follower_reads": sources["follower"],
+                    "serve_worker_reads": sources["worker"],
+                    "serve_staleness_p50": round(
+                        pct(staleness, 0.50), 2),
+                    "serve_staleness_p99": round(
+                        pct(staleness, 0.99), 2),
+                    "serve_staleness_max": max(staleness, default=0),
+                    "serve_follower_worker_rpcs":
+                        serve_worker_rpcs() - rpcs0,
+                    "serve_replica": controller.replicas.status(),
+                })
+                if kill_follower:
+                    # the killed follower must reattach (the controller
+                    # re-resolves latest.json on its next cadence wake)
+                    reattached = 0
+                    deadline = time.monotonic() + 30
+                    while time.monotonic() < deadline and not reattached:
+                        async with session.get(
+                            f"{base}/jobs/{serve_jobs[0]}/state/"
+                            f"{tables[serve_jobs[0]]}?key=0"
+                        ) as resp:
+                            doc = await resp.json()
+                        if resp.status == 200 \
+                                and doc.get("source") == "follower":
+                            reattached = 1
+                        else:
+                            await asyncio.sleep(0.5)
+                    report["serve_follower_reattached"] = reattached
 
             # artifacts: the serve report's Perfetto trace (the serve
             # phase ledger rides the timeline) + slowest-read pointer —
@@ -1260,6 +1365,16 @@ def main(argv=None) -> int:
     ap.add_argument("--min-lookups", type=float, default=2000.0,
                     help="fail the (non-kill) serve scenario below this "
                          "sustained lookups/s")
+    # Follower read replicas (ISSUE 20)
+    ap.add_argument("--followers", type=int, default=0,
+                    help="serve scenario: follower replicas tailing the "
+                         "checkpoint stream; reads must route follower-"
+                         "first with ZERO worker QueryState RPCs and "
+                         "staleness bounded at one checkpoint interval")
+    ap.add_argument("--serve-kill-follower", action="store_true",
+                    help="serve scenario chaos variant: kill follower 0 "
+                         "mid-load — reads must fail over worker-ward "
+                         "and the follower must reattach")
     # Watchtower SLO drill (ISSUE 13)
     ap.add_argument("--watch", action="store_true",
                     help="run the watchtower SLO drill: stall one "
@@ -1285,6 +1400,7 @@ def main(argv=None) -> int:
             jobs=args.jobs, events=args.shared_events,
             pool=args.pool, workdir=args.workdir,
         ))
+        report["pin_era"] = PIN_ERA
         print(json.dumps(report))
         if args.out:
             with open(args.out, "w") as f:
@@ -1345,15 +1461,20 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             rc = 1
         return rc
-    if args.serve or args.serve_kill:
+    if args.serve_kill_follower and not args.followers:
+        args.followers = 1
+    if args.serve or args.serve_kill or args.serve_kill_follower:
         report = asyncio.run(run_serve(
             tenants=args.serve_tenants, keys=args.serve_keys,
             rate=args.serve_rate, duration=args.serve_duration,
             clients=args.serve_clients, bulk=args.serve_bulk,
             parked=args.serve_parked, kill=args.serve_kill,
             pool=args.pool, pipeline_events=args.serve_pipeline_events,
+            followers=args.followers,
+            kill_follower=args.serve_kill_follower,
             workdir=args.workdir,
         ))
+        report["pin_era"] = PIN_ERA
         print(json.dumps(report))
         if args.out:
             with open(args.out, "w") as f:
@@ -1377,6 +1498,29 @@ def main(argv=None) -> int:
             print("KILL VARIANT SAW NO RETRIABLE DEGRADATION — the "
                   "kill did not land mid-load", file=sys.stderr)
             rc = 1
+        if args.followers:
+            if report["serve_staleness_max"] > 1:
+                print(f"STALENESS ABOVE ONE CHECKPOINT INTERVAL: max "
+                      f"{report['serve_staleness_max']} epochs",
+                      file=sys.stderr)
+                rc = 1
+            if (not args.serve_kill and not args.serve_kill_follower
+                    and (report["serve_follower_worker_rpcs"]
+                         or report["serve_worker_reads"])):
+                print(f"FOLLOWER READS TOUCHED WORKERS: "
+                      f"{report['serve_follower_worker_rpcs']} QueryState"
+                      f" RPCs, {report['serve_worker_reads']} worker-"
+                      f"sourced lookups (must both be 0)",
+                      file=sys.stderr)
+                rc = 1
+        if args.serve_kill_follower:
+            if not report.get("serve_follower_reattached"):
+                print("KILLED FOLLOWER NEVER REATTACHED", file=sys.stderr)
+                rc = 1
+            if not report.get("serve_worker_reads"):
+                print("FOLLOWER KILL DID NOT LAND — no worker-ward "
+                      "fallback reads observed mid-load", file=sys.stderr)
+                rc = 1
         return rc
     report = asyncio.run(run_fleet(
         jobs=args.jobs, pool=args.pool, sample=args.sample,
@@ -1385,6 +1529,7 @@ def main(argv=None) -> int:
         doctor=not args.no_doctor, doctor_events=args.doctor_events,
         workdir=args.workdir,
     ))
+    report["pin_era"] = PIN_ERA
     print(json.dumps(report))
     if args.out:
         with open(args.out, "w") as f:
